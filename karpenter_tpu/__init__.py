@@ -1,0 +1,26 @@
+"""karpenter-tpu: a TPU-native rebuild of the Karpenter node-autoscaling framework.
+
+The control plane (reconcilers, cluster state, nodeclaim lifecycle, disruption
+orchestration) mirrors the capabilities of sigs.k8s.io/karpenter (reference at
+/root/reference); the computational core -- the pending-pod bin-packing scheduler
+(reference: pkg/controllers/provisioning/scheduling/scheduler.go:440) and multi-node
+consolidation search (pkg/controllers/disruption/multinodeconsolidation.go:117) -- is
+re-architected as batched tensor solvers on TPU via JAX/XLA.
+
+Package layout:
+  apis/           NodePool / NodeClaim / NodeOverlay / CapacityBuffer API types
+  scheduling/     Requirements algebra, taints, host ports, volume usage
+  cloudprovider/  CloudProvider SPI, InstanceType/Offering model, fake + KWOK providers
+  kube/           in-memory API-server substrate (objects, watches, patches)
+  state/          in-memory cluster state (Cluster / StateNode) + informers
+  controllers/    provisioning, disruption, nodeclaim, node, nodepool, ... reconcilers
+  solver/         Solver plugin point: FFD oracle + TPU tensor backend
+  models/         jittable solver cores (scheduler model, consolidation model)
+  ops/            low-level JAX kernels (packed bitsets, masked argmin, segments)
+  parallel/       device-mesh sharding of the solver (pjit / shard_map)
+  operator/       options, runtime wiring
+  metrics/        Prometheus-style metrics registry
+  events/         dedup-cached event recorder
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""API types: NodePool, NodeClaim, NodeOverlay, CapacityBuffer + well-known labels."""

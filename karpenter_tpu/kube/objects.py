@@ -1,0 +1,214 @@
+"""Typed, lightweight equivalents of the corev1 objects the framework consumes.
+
+These are plain dataclasses — not a port of client-go — carrying exactly the
+fields the reference's controllers read (pod scheduling constraints, node
+capacity/taints, metadata with finalizers/owner-refs). Everything else is
+intentionally absent.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..scheduling.taints import Taint
+from ..utils.quantity import Quantity
+
+
+def new_uid() -> str:
+    return f"{uuid.uuid4()}"
+
+
+@dataclass
+class OwnerReference:
+    kind: str
+    name: str
+    uid: str
+    api_version: str = "v1"
+    controller: bool = False
+    block_owner_deletion: bool = False
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=new_uid)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    finalizers: list[str] = field(default_factory=list)
+    owner_references: list[OwnerReference] = field(default_factory=list)
+    resource_version: int = 0
+    generation: int = 1
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    # resources: {"requests": {res: Quantity}, "limits": {res: Quantity}}
+    resources: dict[str, dict[str, Quantity]] = field(default_factory=dict)
+    ports: list[dict] = field(default_factory=list)  # {containerPort, hostPort?, hostIP?, protocol?}
+    # For init containers: restart_policy == "Always" marks a sidecar (KEP-753).
+    restart_policy: str | None = None
+
+    def is_sidecar(self) -> bool:
+        return self.restart_policy == "Always"
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int
+    preference: list[dict] = field(default_factory=list)  # [{key, operator, values}]
+
+
+@dataclass
+class NodeAffinity:
+    # required: list of OR'd terms; each term is a list of AND'd {key, operator, values}
+    required: list[list[dict]] = field(default_factory=list)
+    preferred: list[PreferredSchedulingTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: dict | None = None  # {"matchLabels": {...}, "matchExpressions": [...]}
+    topology_key: str = ""
+    namespaces: list[str] = field(default_factory=list)
+    namespace_selector: dict | None = None
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 1
+    term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class Affinity:
+    node_affinity: NodeAffinity | None = None
+    pod_affinity_required: list[PodAffinityTerm] = field(default_factory=list)
+    pod_affinity_preferred: list[WeightedPodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity_required: list[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity_preferred: list[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: dict | None = None
+    min_domains: int | None = None
+    node_affinity_policy: str = "Honor"  # Honor | Ignore
+    node_taints_policy: str = "Ignore"  # Honor | Ignore
+
+
+@dataclass
+class PodSpec:
+    containers: list[Container] = field(default_factory=lambda: [Container()])
+    init_containers: list[Container] = field(default_factory=list)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    affinity: Affinity | None = None
+    tolerations: list[Any] = field(default_factory=list)
+    topology_spread_constraints: list[TopologySpreadConstraint] = field(default_factory=list)
+    node_name: str = ""
+    priority: int | None = None
+    priority_class_name: str = ""
+    preemption_policy: str = "PreemptLowerPriority"
+    scheduler_name: str = "default-scheduler"
+    overhead: dict[str, Quantity] = field(default_factory=dict)
+    volumes: list[dict] = field(default_factory=list)
+    termination_grace_period_seconds: int | None = 30
+    restart_policy: str = "Always"
+    host_network: bool = False
+    resource_claims: list[dict] = field(default_factory=list)  # DRA: [{name, resourceClaimName | resourceClaimTemplateName}]
+
+
+@dataclass
+class PodCondition:
+    type: str
+    status: str = "True"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"
+    conditions: list[PodCondition] = field(default_factory=list)
+    nominated_node_name: str = ""
+    start_time: Optional[float] = None
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+    kind: str = "Pod"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class NodeSpec:
+    provider_id: str = ""
+    taints: list[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+
+
+@dataclass
+class NodeCondition:
+    type: str
+    status: str = "True"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class NodeStatus:
+    capacity: dict[str, Quantity] = field(default_factory=dict)
+    allocatable: dict[str, Quantity] = field(default_factory=dict)
+    conditions: list[NodeCondition] = field(default_factory=list)
+    node_info: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+    kind: str = "Node"
+
+    def key(self) -> str:
+        return self.metadata.name
+
+
+def match_label_selector(selector: dict | None, labels: dict[str, str]) -> bool:
+    """metav1.LabelSelector matching: matchLabels AND matchExpressions."""
+    if selector is None:
+        return False
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions") or []:
+        key, op, values = expr["key"], expr["operator"], expr.get("values", [])
+        val = labels.get(key)
+        if op == "In":
+            if val not in values:
+                return False
+        elif op == "NotIn":
+            if val in values:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+    return True

@@ -1,0 +1,223 @@
+"""An in-process apiserver substitute: typed object store with optimistic
+concurrency, finalizer-gated deletion, and watch fan-out.
+
+Plays the role of the reference's L0 (kube-apiserver/etcd — SURVEY.md layer
+map): all durable state lives here; controllers coordinate exclusively through
+it. Semantics kept: resourceVersion conflict on stale writes, deletionTimestamp
++ finalizers two-phase delete, watch events (ADDED/MODIFIED/DELETED) delivered
+to informers.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Callable, Iterable, Optional
+
+
+class NotFound(Exception):
+    pass
+
+
+class Conflict(Exception):
+    pass
+
+
+class AlreadyExists(Exception):
+    pass
+
+
+# kinds that are cluster-scoped (key = name, not namespace/name)
+CLUSTER_SCOPED = {
+    "Node",
+    "NodeClaim",
+    "NodePool",
+    "NodeOverlay",
+    "KWOKNodeClass",
+    "PriorityClass",
+    "StorageClass",
+    "PersistentVolume",
+    "ResourceSlice",
+    "DeviceClass",
+}
+
+WatchFn = Callable[[str, object], None]  # (event_type, obj)
+
+
+def obj_key(obj) -> str:
+    meta = obj.metadata
+    if obj.kind in CLUSTER_SCOPED:
+        return meta.name
+    return f"{meta.namespace}/{meta.name}"
+
+
+class Store:
+    """The in-memory 'cluster'. Thread-safe; objects are deep-copied on the
+    way in and out so callers can never mutate stored state in place."""
+
+    def __init__(self, clock=None):
+        self._lock = threading.RLock()
+        self._objects: dict[str, dict[str, object]] = {}  # kind -> key -> obj
+        self._watchers: dict[str, list[WatchFn]] = {}
+        self._rv = 0
+        self._clock = clock
+        # watch delivery: events are enqueued under self._lock (commit order)
+        # and drained FIFO under self._deliver_lock, so watchers always observe
+        # ADDED < MODIFIED < DELETED in resourceVersion order even with
+        # concurrent writers.
+        self._pending: list[tuple[str, object]] = []
+        self._deliver_lock = threading.RLock()
+
+    def _now(self) -> float:
+        return self._clock.now() if self._clock else 0.0
+
+    # -- watches ---------------------------------------------------------------
+    def watch(self, kind: str, fn: WatchFn) -> None:
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(fn)
+
+    def _enqueue(self, event: str, obj) -> None:
+        # caller must hold self._lock
+        self._pending.append((event, obj))
+
+    def _drain(self) -> None:
+        with self._deliver_lock:
+            while True:
+                with self._lock:
+                    if not self._pending:
+                        return
+                    event, obj = self._pending.pop(0)
+                    watchers = list(self._watchers.get(obj.kind, ()))
+                for fn in watchers:
+                    fn(event, copy.deepcopy(obj))
+
+    # -- CRUD ------------------------------------------------------------------
+    def create(self, obj):
+        with self._lock:
+            kind_map = self._objects.setdefault(obj.kind, {})
+            key = obj_key(obj)
+            if key in kind_map:
+                raise AlreadyExists(f"{obj.kind} {key} already exists")
+            self._rv += 1
+            obj = copy.deepcopy(obj)
+            obj.metadata.resource_version = self._rv
+            if not obj.metadata.creation_timestamp:
+                obj.metadata.creation_timestamp = self._now()
+            kind_map[key] = obj
+            self._enqueue("ADDED", obj)
+        self._drain()
+        return copy.deepcopy(obj)
+
+    def get(self, kind: str, name: str, namespace: str = "default"):
+        with self._lock:
+            key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
+            obj = self._objects.get(kind, {}).get(key)
+            if obj is None:
+                raise NotFound(f"{kind} {key} not found")
+            return copy.deepcopy(obj)
+
+    def try_get(self, kind: str, name: str, namespace: str = "default"):
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def list(self, kind: str, namespace: Optional[str] = None, label_selector: Optional[dict] = None) -> list:
+        with self._lock:
+            out = []
+            for obj in self._objects.get(kind, {}).values():
+                if namespace is not None and obj.kind not in CLUSTER_SCOPED and obj.metadata.namespace != namespace:
+                    continue
+                if label_selector is not None and not _labels_match(label_selector, obj.metadata.labels):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def update(self, obj):
+        """Optimistic-concurrency full update; raises Conflict on stale RV."""
+        with self._lock:
+            kind_map = self._objects.setdefault(obj.kind, {})
+            key = obj_key(obj)
+            current = kind_map.get(key)
+            if current is None:
+                raise NotFound(f"{obj.kind} {key} not found")
+            if obj.metadata.resource_version != current.metadata.resource_version:
+                raise Conflict(
+                    f"{obj.kind} {key}: resourceVersion {obj.metadata.resource_version} != {current.metadata.resource_version}"
+                )
+            self._rv += 1
+            obj = copy.deepcopy(obj)
+            # deletionTimestamp is set only by delete(); preserve server-side value
+            obj.metadata.deletion_timestamp = current.metadata.deletion_timestamp
+            obj.metadata.resource_version = self._rv
+            if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+                del kind_map[key]
+                self._enqueue("DELETED", obj)
+            else:
+                kind_map[key] = obj
+                self._enqueue("MODIFIED", obj)
+        self._drain()
+        return copy.deepcopy(obj)
+
+    def patch(self, kind: str, name: str, fn: Callable[[object], None], namespace: str = "default", retries: int = 10):
+        """Read-modify-write with retry — the common controller patch idiom."""
+        for _ in range(retries):
+            obj = self.get(kind, name, namespace)
+            fn(obj)
+            try:
+                return self.update(obj)
+            except Conflict:
+                continue
+        raise Conflict(f"{kind} {name}: too many conflicts")
+
+    def update_status(self, obj):
+        """Status-subresource style update: spec/labels on the server win."""
+        def apply(cur):
+            cur.status = copy.deepcopy(obj.status)
+        ns = getattr(obj.metadata, "namespace", "default")
+        return self.patch(obj.kind, obj.metadata.name, apply, namespace=ns)
+
+    def delete(self, kind: str, name: str, namespace: str = "default", grace: bool = True):
+        """Two-phase delete: with finalizers present, sets deletionTimestamp and
+        MODIFIED; otherwise removes and emits DELETED."""
+        with self._lock:
+            key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
+            kind_map = self._objects.get(kind, {})
+            obj = kind_map.get(key)
+            if obj is None:
+                raise NotFound(f"{kind} {key} not found")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            if obj.metadata.finalizers and grace:
+                if obj.metadata.deletion_timestamp is None:
+                    obj.metadata.deletion_timestamp = self._now()
+                self._enqueue("MODIFIED", copy.deepcopy(obj))
+            else:
+                del kind_map[key]
+                self._enqueue("DELETED", obj)
+        self._drain()
+
+    def try_delete(self, kind: str, name: str, namespace: str = "default") -> bool:
+        try:
+            self.delete(kind, name, namespace)
+            return True
+        except NotFound:
+            return False
+
+    # -- helpers ---------------------------------------------------------------
+    def remove_finalizer(self, kind: str, name: str, finalizer: str, namespace: str = "default"):
+        def fn(obj):
+            if finalizer in obj.metadata.finalizers:
+                obj.metadata.finalizers.remove(finalizer)
+        try:
+            self.patch(kind, name, fn, namespace=namespace)
+        except NotFound:
+            pass
+
+    def count(self, kind: str) -> int:
+        with self._lock:
+            return len(self._objects.get(kind, {}))
+
+
+def _labels_match(selector: dict, labels: dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())

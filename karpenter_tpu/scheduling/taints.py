@@ -1,0 +1,80 @@
+"""Taints and tolerations (reference: pkg/scheduling/taints.go).
+
+A pod fails against a node iff some NoSchedule/NoExecute taint is untolerated.
+PreferNoSchedule taints never block placement.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable
+
+NO_SCHEDULE = "NoSchedule"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+NO_EXECUTE = "NoExecute"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    effect: str = NO_SCHEDULE
+    value: str = ""
+
+    def to_dict(self) -> dict:
+        return {"key": self.key, "value": self.value, "effect": self.effect}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Taint":
+        return cls(key=d["key"], effect=d.get("effect", NO_SCHEDULE), value=d.get("value", ""))
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # empty matches all effects
+    toleration_seconds: int | None = field(default=None, compare=False)
+
+    def tolerates(self, taint: Taint) -> bool:
+        """corev1.Toleration.ToleratesTaint semantics."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Toleration":
+        return cls(
+            key=d.get("key", ""),
+            operator=d.get("operator", "Equal"),
+            value=d.get("value", ""),
+            effect=d.get("effect", ""),
+            toleration_seconds=d.get("tolerationSeconds"),
+        )
+
+
+def taints_tolerate_pod(taints: Iterable[Taint], pod) -> str | None:
+    """Error string naming the first untolerated NoSchedule/NoExecute taint,
+    or None (reference: taints.go Taints.ToleratesPod)."""
+    tolerations = [t if isinstance(t, Toleration) else Toleration.from_dict(t) for t in (pod.spec.tolerations or ())]
+    for taint in taints:
+        if taint.effect == PREFER_NO_SCHEDULE:
+            continue
+        if not any(tol.tolerates(taint) for tol in tolerations):
+            return f"did not tolerate {taint.key}={taint.value}:{taint.effect}"
+    return None
+
+
+def merge_taints(existing: list[Taint], incoming: Iterable[Taint]) -> list[Taint]:
+    """Add taints absent by (key, effect)."""
+    have = {(t.key, t.effect) for t in existing}
+    out = list(existing)
+    for t in incoming:
+        if (t.key, t.effect) not in have:
+            out.append(t)
+            have.add((t.key, t.effect))
+    return out

@@ -1,0 +1,16 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh BEFORE jax import.
+
+Multi-chip sharding is validated on host CPU devices
+(xla_force_host_platform_device_count), as only one real TPU chip is available
+in CI; the driver separately dry-runs the multi-chip path.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

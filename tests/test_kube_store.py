@@ -1,0 +1,61 @@
+import pytest
+
+from karpenter_tpu.kube import Node, ObjectMeta, Pod, Store
+from karpenter_tpu.kube.store import AlreadyExists, Conflict, NotFound
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def test_create_get_list():
+    s = Store()
+    s.create(Pod(metadata=ObjectMeta(name="a", namespace="ns1")))
+    s.create(Pod(metadata=ObjectMeta(name="b", namespace="ns2")))
+    assert s.get("Pod", "a", "ns1").metadata.name == "a"
+    assert len(s.list("Pod")) == 2
+    assert len(s.list("Pod", namespace="ns1")) == 1
+    with pytest.raises(AlreadyExists):
+        s.create(Pod(metadata=ObjectMeta(name="a", namespace="ns1")))
+
+
+def test_optimistic_concurrency():
+    s = Store()
+    s.create(Node(metadata=ObjectMeta(name="n1")))
+    a = s.get("Node", "n1")
+    b = s.get("Node", "n1")
+    a.metadata.labels["x"] = "1"
+    s.update(a)
+    b.metadata.labels["y"] = "2"
+    with pytest.raises(Conflict):
+        s.update(b)
+    # patch retries through conflicts
+    s.patch("Node", "n1", lambda n: n.metadata.labels.update({"y": "2"}))
+    assert s.get("Node", "n1").metadata.labels == {"x": "1", "y": "2"}
+
+
+def test_isolation_deep_copy():
+    s = Store()
+    s.create(Node(metadata=ObjectMeta(name="n1")))
+    n = s.get("Node", "n1")
+    n.metadata.labels["mutated"] = "yes"
+    assert "mutated" not in s.get("Node", "n1").metadata.labels
+
+
+def test_finalizer_two_phase_delete():
+    clock = FakeClock()
+    s = Store(clock=clock)
+    s.create(Node(metadata=ObjectMeta(name="n1", finalizers=["karpenter.sh/termination"])))
+    s.delete("Node", "n1")
+    n = s.get("Node", "n1")  # still present: finalizer holds it
+    assert n.metadata.deletion_timestamp is not None
+    s.remove_finalizer("Node", "n1", "karpenter.sh/termination")
+    with pytest.raises(NotFound):
+        s.get("Node", "n1")
+
+
+def test_watch_events():
+    s = Store()
+    events = []
+    s.watch("Pod", lambda e, o: events.append((e, o.metadata.name)))
+    s.create(Pod(metadata=ObjectMeta(name="a")))
+    s.patch("Pod", "a", lambda p: p.metadata.labels.update({"x": "1"}))
+    s.delete("Pod", "a")
+    assert events == [("ADDED", "a"), ("MODIFIED", "a"), ("DELETED", "a")]

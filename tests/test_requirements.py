@@ -1,0 +1,158 @@
+"""Requirements algebra behavior specs.
+
+Modeled on the reference's pkg/scheduling/suite_test.go coverage: operator
+combinations, intersection truth table, bounds canonicalization, compatibility
+with well-known vs custom labels.
+"""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.scheduling import Operator, Requirement, Requirements
+
+
+def req(key, op, *values, min_values=None):
+    return Requirement(key, op, values, min_values=min_values)
+
+
+class TestRequirement:
+    def test_in_has(self):
+        r = req("zone", "In", "a", "b")
+        assert r.has("a") and r.has("b") and not r.has("c")
+        assert r.operator() == Operator.IN
+
+    def test_not_in_has(self):
+        r = req("zone", "NotIn", "a")
+        assert not r.has("a") and r.has("b")
+        assert r.operator() == Operator.NOT_IN
+
+    def test_exists_dne(self):
+        assert req("k", "Exists").has("anything")
+        assert not req("k", "DoesNotExist").has("anything")
+        assert req("k", "DoesNotExist").operator() == Operator.DOES_NOT_EXIST
+
+    def test_gt_lt_canonicalization(self):
+        gt = req("cpu", "Gt", "4")
+        assert gt.gte == 5 and gt.has("5") and not gt.has("4")
+        lt = req("cpu", "Lt", "4")
+        assert lt.lte == 3 and lt.has("3") and not lt.has("4")
+        # non-integer values never satisfy bounds
+        assert not gt.has("abc")
+
+    def test_gte_lte(self):
+        assert req("cpu", "Gte", "4").has("4")
+        assert req("cpu", "Lte", "4").has("4")
+
+    def test_intersection_in_in(self):
+        r = req("z", "In", "a", "b").intersection(req("z", "In", "b", "c"))
+        assert r.values == {"b"} and not r.complement
+
+    def test_intersection_in_notin(self):
+        r = req("z", "In", "a", "b").intersection(req("z", "NotIn", "b"))
+        assert r.values == {"a"} and not r.complement
+
+    def test_intersection_notin_notin(self):
+        r = req("z", "NotIn", "a").intersection(req("z", "NotIn", "b"))
+        assert r.complement and r.values == {"a", "b"}
+
+    def test_intersection_bounds_conflict_is_empty(self):
+        r = req("cpu", "Gt", "10").intersection(req("cpu", "Lt", "5"))
+        assert r.operator() == Operator.DOES_NOT_EXIST
+
+    def test_intersection_bounds_filter_values(self):
+        r = req("cpu", "In", "2", "8", "abc").intersection(req("cpu", "Gt", "4"))
+        assert r.values == {"8"}
+
+    def test_has_intersection_matrix(self):
+        a = req("z", "In", "a")
+        b = req("z", "In", "b")
+        assert not a.has_intersection(b)
+        assert a.has_intersection(req("z", "Exists"))
+        assert a.has_intersection(req("z", "NotIn", "b"))
+        assert not a.has_intersection(req("z", "NotIn", "a"))
+        assert req("z", "NotIn", "a").has_intersection(req("z", "NotIn", "a"))
+
+    def test_normalized_labels(self):
+        r = req("beta.kubernetes.io/arch", "In", "x86_64")
+        assert r.key == wk.ARCH_LABEL_KEY
+        assert r.values == {wk.ARCH_AMD64}
+
+    def test_len_complement(self):
+        assert len(req("z", "In", "a", "b")) == 2
+        assert len(req("z", "Exists")) > 10**9
+
+
+class TestRequirements:
+    def test_add_intersects(self):
+        rs = Requirements(req("z", "In", "a", "b"))
+        rs.add(req("z", "In", "b", "c"))
+        assert rs.get("z").values == {"b"}
+
+    def test_get_undefined_is_exists(self):
+        rs = Requirements()
+        assert rs.get("anything").operator() == Operator.EXISTS
+
+    def test_compatible_well_known_undefined_ok(self):
+        node = Requirements(req(wk.INSTANCE_TYPE_LABEL_KEY, "In", "m5.large"))
+        pod = Requirements(req(wk.ZONE_LABEL_KEY, "In", "a"))
+        assert node.compatible(pod, allow_undefined=wk.WELL_KNOWN_LABELS) is None
+
+    def test_compatible_custom_undefined_fails(self):
+        node = Requirements()
+        pod = Requirements(req("team", "In", "infra"))
+        err = node.compatible(pod, allow_undefined=wk.WELL_KNOWN_LABELS)
+        assert err is not None and "team" in err
+
+    def test_compatible_custom_notin_ok_when_undefined(self):
+        node = Requirements()
+        pod = Requirements(req("team", "NotIn", "infra"))
+        assert node.compatible(pod, allow_undefined=wk.WELL_KNOWN_LABELS) is None
+
+    def test_intersects_conflict(self):
+        a = Requirements(req("z", "In", "a"))
+        b = Requirements(req("z", "In", "b"))
+        assert a.intersects(b) is not None
+        assert a.compatible(b) is not None
+
+    def test_from_labels(self):
+        rs = Requirements.from_labels({"a": "1", "b": "2"})
+        assert rs.get("a").has("1") and not rs.get("a").has("2")
+
+    def test_labels_roundtrip(self):
+        rs = Requirements(req("z", "In", "a"), req("x", "Exists"))
+        assert rs.labels() == {"z": "a"}
+
+    def test_min_values(self):
+        rs = Requirements(req(wk.INSTANCE_TYPE_LABEL_KEY, "In", "a", "b", min_values=2))
+        assert rs.has_min_values()
+        # intersection keeps the max minValues
+        merged = req("k", "In", "a", min_values=1).intersection(req("k", "In", "a", min_values=3))
+        assert merged.min_values == 3
+
+
+class TestPodRequirements:
+    def test_node_selector_and_affinity(self):
+        from karpenter_tpu.kube import Affinity, NodeAffinity, Pod, PodSpec, PreferredSchedulingTerm
+
+        pod = Pod(
+            spec=PodSpec(
+                node_selector={"team": "ml"},
+                affinity=Affinity(
+                    node_affinity=NodeAffinity(
+                        required=[[{"key": "zone", "operator": "In", "values": ["a", "b"]}]],
+                        preferred=[
+                            PreferredSchedulingTerm(weight=10, preference=[{"key": "size", "operator": "In", "values": ["big"]}]),
+                            PreferredSchedulingTerm(weight=1, preference=[{"key": "size", "operator": "In", "values": ["small"]}]),
+                        ],
+                    )
+                ),
+            )
+        )
+        rs = Requirements.from_pod(pod)
+        assert rs.get("team").has("ml")
+        assert rs.get("zone").values == {"a", "b"}
+        # heaviest preference treated as required
+        assert rs.get("size").values == {"big"}
+        # strict drops preferences
+        strict = Requirements.from_pod(pod, strict=True)
+        assert not strict.has("size")

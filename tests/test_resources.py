@@ -1,0 +1,71 @@
+from karpenter_tpu.kube import Container, Pod, PodSpec
+from karpenter_tpu.utils import resources
+from karpenter_tpu.utils.quantity import Quantity
+
+
+class TestQuantity:
+    def test_parse_milli(self):
+        assert Quantity.parse("100m").milli == 100
+        assert Quantity.parse("1").milli == 1000
+        assert Quantity.parse("1.5").milli == 1500
+
+    def test_parse_binary(self):
+        assert Quantity.parse("1Ki").value == 1024
+        assert Quantity.parse("2Gi").value == 2 * 1024**3
+
+    def test_parse_decimal_si(self):
+        assert Quantity.parse("1k").value == 1000
+        assert Quantity.parse("5M").value == 5_000_000
+
+    def test_arithmetic(self):
+        assert (Quantity.parse("1") + Quantity.parse("500m")).milli == 1500
+        assert (Quantity.parse("1") - Quantity.parse("2")).milli == -1000
+        assert (Quantity.parse("2") * 3).value == 6
+
+    def test_ordering(self):
+        assert Quantity.parse("100m") < Quantity.parse("1")
+        assert max(Quantity.parse("1"), Quantity.parse("2Gi")).value == 2 * 1024**3
+
+    def test_str(self):
+        assert str(Quantity.parse("100m")) == "100m"
+        assert str(Quantity.parse("2Gi")) == "2Gi"
+        assert str(Quantity.parse("3")) == "3"
+
+
+def mkpod(requests=None, limits=None, init_requests=None):
+    containers = [Container(resources={"requests": resources.parse_resource_list(requests or {}), "limits": resources.parse_resource_list(limits or {})})]
+    init = []
+    if init_requests:
+        init = [Container(resources={"requests": resources.parse_resource_list(init_requests)})]
+    return Pod(spec=PodSpec(containers=containers, init_containers=init))
+
+
+class TestResources:
+    def test_merge_subtract(self):
+        a = resources.parse_resource_list({"cpu": "1", "memory": "1Gi"})
+        b = resources.parse_resource_list({"cpu": "500m", "gpu": "1"})
+        m = resources.merge(a, b)
+        assert m["cpu"].milli == 1500 and m["gpu"].value == 1
+        s = resources.subtract(a, b)
+        assert s["cpu"].milli == 500 and s["gpu"].milli == -1000
+
+    def test_fits(self):
+        cand = resources.parse_resource_list({"cpu": "2"})
+        total = resources.parse_resource_list({"cpu": "4", "memory": "8Gi"})
+        assert resources.fits(cand, total)
+        assert not resources.fits(resources.parse_resource_list({"cpu": "8"}), total)
+        # resource absent from total => zero capacity
+        assert not resources.fits(resources.parse_resource_list({"gpu": "1"}), total)
+
+    def test_pod_requests_includes_pods_slot(self):
+        p = mkpod(requests={"cpu": "1"})
+        r = resources.pod_requests(p)
+        assert r["cpu"].value == 1 and r["pods"].value == 1
+
+    def test_init_container_ceiling(self):
+        p = mkpod(requests={"cpu": "1"}, init_requests={"cpu": "4"})
+        assert resources.pod_requests(p)["cpu"].value == 4
+
+    def test_requests_for_pods(self):
+        total = resources.requests_for_pods([mkpod(requests={"cpu": "1"}), mkpod(requests={"cpu": "2"})])
+        assert total["cpu"].value == 3 and total["pods"].value == 2
