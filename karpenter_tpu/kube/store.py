@@ -123,12 +123,14 @@ class Store:
             return None
 
     def list(self, kind: str, namespace: Optional[str] = None, label_selector: Optional[dict] = None) -> list:
+        """label_selector accepts either the flat {key: value} form or the
+        metav1 {matchLabels, matchExpressions} form."""
         with self._lock:
             out = []
             for obj in self._objects.get(kind, {}).values():
                 if namespace is not None and obj.kind not in CLUSTER_SCOPED and obj.metadata.namespace != namespace:
                     continue
-                if label_selector is not None and not _labels_match(label_selector, obj.metadata.labels):
+                if label_selector is not None and not _selector_matches(label_selector, obj.metadata.labels):
                     continue
                 out.append(copy.deepcopy(obj))
             return out
@@ -219,5 +221,9 @@ class Store:
             return len(self._objects.get(kind, {}))
 
 
-def _labels_match(selector: dict, labels: dict[str, str]) -> bool:
-    return all(labels.get(k) == v for k, v in selector.items())
+def _selector_matches(selector: dict, labels: dict[str, str]) -> bool:
+    from .objects import match_label_selector
+
+    if "matchLabels" in selector or "matchExpressions" in selector:
+        return match_label_selector(selector, labels)
+    return match_label_selector({"matchLabels": selector}, labels)

@@ -59,20 +59,26 @@ class Requirement:
             return
         self.complement = operator != Operator.DOES_NOT_EXIST
         self.values = set(values) if operator == Operator.NOT_IN else set()
-        if operator == Operator.GT:
-            v = int(values[0])
-            if v == _MAXINT:
-                # Gt MaxInt matches nothing (requirement.go:89-92)
-                self.complement = False
-                self.values = set()
+        if operator in (Operator.GT, Operator.LT, Operator.GTE, Operator.LTE):
+            if not values:
+                raise ValueError(f"requirement {key}: operator {operator.value} requires a single integer value")
+            try:
+                v = int(values[0])
+            except ValueError:
+                raise ValueError(f"requirement {key}: operator {operator.value} value {values[0]!r} is not an integer") from None
+            if operator == Operator.GT:
+                if v == _MAXINT:
+                    # Gt MaxInt matches nothing (requirement.go:89-92)
+                    self.complement = False
+                    self.values = set()
+                else:
+                    self.gte = v + 1
+            elif operator == Operator.LT:
+                self.lte = v - 1
+            elif operator == Operator.GTE:
+                self.gte = v
             else:
-                self.gte = v + 1
-        elif operator == Operator.LT:
-            self.lte = int(values[0]) - 1
-        elif operator == Operator.GTE:
-            self.gte = int(values[0])
-        elif operator == Operator.LTE:
-            self.lte = int(values[0])
+                self.lte = v
 
     # -- internal constructor --------------------------------------------------
     @classmethod
@@ -138,8 +144,15 @@ class Requirement:
         if op == Operator.IN:
             return sorted(self.values)[0]
         if op in (Operator.NOT_IN, Operator.EXISTS):
-            lo_ = self.gte if self.gte is not None else 0
-            hi_ = (self.lte + 1) if self.lte is not None else 2**31
+            if self.gte is not None:
+                lo_ = self.gte
+            elif self.lte is not None and self.lte < 0:
+                lo_ = self.lte - 1000
+            else:
+                lo_ = 0
+            hi_ = (self.lte + 1) if self.lte is not None else max(lo_ + 1, 2**31)
+            if hi_ <= lo_:
+                return ""  # inverted bounds match nothing
             for _ in range(100):
                 v = str(random.randrange(lo_, hi_))
                 if v not in self.values:
@@ -279,15 +292,21 @@ class Requirements:
                 req = req.intersection(existing)
             self._m[req.key] = req
 
+    def replace(self, req: Requirement) -> None:
+        """Overwrite (not intersect) the requirement for req.key."""
+        self._m[req.key] = req
+
     def get(self, key: str) -> Requirement:
-        """Undefined keys behave as Exists (requirements.go:160-166)."""
+        """Undefined keys behave as Exists (requirements.go:160-166).
+        Lookup keys are normalized like stored keys (beta aliases resolve)."""
+        key = wk.normalize_key(key)
         r = self._m.get(key)
         if r is None:
             return Requirement(key, Operator.EXISTS)
         return r
 
     def has(self, key: str) -> bool:
-        return key in self._m
+        return wk.normalize_key(key) in self._m
 
     def keys(self) -> set[str]:
         return set(self._m.keys())
@@ -307,7 +326,7 @@ class Requirements:
         return len(self._m)
 
     def __contains__(self, key: str) -> bool:
-        return key in self._m
+        return wk.normalize_key(key) in self._m
 
     def __iter__(self) -> Iterator[str]:
         return iter(self._m)
@@ -358,11 +377,10 @@ class Requirements:
         return out
 
     def labels(self) -> dict[str, str]:
-        """Concrete labels for requirements that pin a single value
-        (requirements.go Labels())."""
+        """Concrete labels for requirements that pin exactly one value."""
         out = {}
         for key, req in self._m.items():
-            if req.operator() == Operator.IN and len(req.values) >= 1:
+            if req.operator() == Operator.IN and len(req.values) == 1:
                 out[key] = req.any()
         return out
 

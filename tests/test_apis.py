@@ -1,0 +1,103 @@
+"""NodePool budgets math, conditions, durations/cron — modeled on the
+reference's pkg/apis/v1 suite coverage."""
+
+import pytest
+
+from karpenter_tpu.apis.conditions import ConditionSet
+from karpenter_tpu.apis.nodepool import (
+    REASON_DRIFTED,
+    REASON_EMPTY,
+    REASON_UNDERUTILIZED,
+    Budget,
+    NodePool,
+)
+from karpenter_tpu.utils.durations import Cron, parse_duration
+
+NOW = 1_700_000_000.0  # 2023-11-14T22:13:20Z (Tuesday)
+
+
+class TestDurations:
+    def test_parse(self):
+        assert parse_duration("10s") == 10
+        assert parse_duration("1h30m") == 5400
+        assert parse_duration("Never") == float("inf")
+        with pytest.raises(ValueError):
+            parse_duration("10x")
+
+    def test_cron_basic(self):
+        c = Cron("* * * * *")
+        assert c.active_within(NOW, 60)
+
+    def test_cron_macro(self):
+        c = Cron("@daily")
+        # within last 24h there is always a midnight
+        assert c.active_within(NOW, 24 * 3600)
+        assert not c.active_within(NOW, 60)  # 22:13 is not midnight
+
+
+class TestBudget:
+    def test_percentage_rounds_up(self):
+        b = Budget(nodes="10%")
+        assert b.allowed_disruptions(NOW, 10) == (1, None)
+        assert b.allowed_disruptions(NOW, 5) == (1, None)  # ceil(0.5)
+        assert b.allowed_disruptions(NOW, 0) == (0, None)
+
+    def test_absolute(self):
+        assert Budget(nodes="5").allowed_disruptions(NOW, 100) == (5, None)
+        assert Budget(nodes="0").allowed_disruptions(NOW, 100) == (0, None)
+
+    def test_inactive_schedule_unbounded(self):
+        # schedule fires at midnight for 1h; NOW is 22:13 -> inactive
+        b = Budget(nodes="0", schedule="0 0 * * *", duration="1h")
+        allowed, err = b.allowed_disruptions(NOW, 100)
+        assert err is None and allowed == 2**31 - 1
+
+    def test_active_schedule(self):
+        # every hour on the hour, 30m duration; 22:13 is within [22:00, 22:30]
+        b = Budget(nodes="3", schedule="0 * * * *", duration="30m")
+        assert b.allowed_disruptions(NOW, 100) == (3, None)
+
+    def test_misconfigured_fails_closed(self):
+        b = Budget(nodes="5", schedule="bad cron here really bad", duration="1h")
+        allowed, err = b.allowed_disruptions(NOW, 100)
+        assert allowed == 0 and err is not None
+
+    def test_nodepool_most_restrictive(self):
+        np = NodePool()
+        np.spec.disruption.budgets = [
+            Budget(nodes="10"),
+            Budget(nodes="5", reasons=[REASON_EMPTY]),
+            Budget(nodes="2", reasons=[REASON_DRIFTED]),
+        ]
+        assert np.allowed_disruptions(NOW, 100, REASON_UNDERUTILIZED) == 10
+        assert np.allowed_disruptions(NOW, 100, REASON_EMPTY) == 5
+        assert np.allowed_disruptions(NOW, 100, REASON_DRIFTED) == 2
+
+
+class TestNodePool:
+    def test_hash_ignores_requirements(self):
+        a, b = NodePool(), NodePool()
+        b.spec.template.requirements = [{"key": "zone", "operator": "In", "values": ["a"]}]
+        assert a.hash() == b.hash()
+        b.spec.template.labels = {"x": "1"}
+        assert a.hash() != b.hash()
+
+    def test_limits(self):
+        from karpenter_tpu.utils.quantity import Quantity
+
+        np = NodePool()
+        np.spec.limits = {"cpu": Quantity.parse("10")}
+        assert np.limits_exceeded_by({"cpu": Quantity.parse("8")}) is None
+        assert np.limits_exceeded_by({"cpu": Quantity.parse("12")}) is not None
+        assert np.limits_exceeded_by({"memory": Quantity.parse("1Ti")}) is None  # unlimited
+
+
+class TestConditions:
+    def test_set_transitions(self):
+        cs = ConditionSet()
+        assert cs.set_true("Launched", now=1.0)
+        assert cs.is_true("Launched")
+        assert not cs.set_true("Launched", now=2.0)  # no transition
+        assert cs.get("Launched").last_transition_time == 1.0
+        assert cs.set_false("Launched", "gone", now=3.0)
+        assert cs.get("Launched").last_transition_time == 3.0

@@ -1,0 +1,173 @@
+"""InstanceType/Offering model + KWOK provider behavior specs."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.kwoknodeclass import KWOKNodeClass
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.cloudprovider.errors import InsufficientCapacityError, NodeClaimNotFoundError
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider, instance_types_assorted
+from karpenter_tpu.cloudprovider.kwok import KWOKCloudProvider
+from karpenter_tpu.cloudprovider.types import (
+    cheapest,
+    compatible_instance_types,
+    offerings_compatible,
+    order_by_price,
+    satisfies_min_values,
+    worst_launch_price,
+)
+from karpenter_tpu.kube import Store
+from karpenter_tpu.scheduling.requirements import Requirement, Requirements
+from karpenter_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture
+def types():
+    return catalog.construct_instance_types()
+
+
+class TestCatalog:
+    def test_cardinality(self, types):
+        assert len(types) == 144
+        names = {t.name for t in types}
+        assert "c-1x-amd64-linux" in names and "m-256x-arm64-windows" in names
+
+    def test_capacity_shape(self, types):
+        it = next(t for t in types if t.name == "s-4x-amd64-linux")
+        assert it.capacity["cpu"].value == 4
+        assert it.capacity["memory"].value == 16 * 1024**3
+        assert it.capacity["pods"].value == 64
+        # allocatable < capacity due to overhead
+        assert it.allocatable()["cpu"].milli == 3900
+
+    def test_offerings(self, types):
+        it = types[0]
+        assert len(it.offerings) == 8  # 4 zones x {spot, on-demand}
+        spot = [o for o in it.offerings if o.capacity_type() == wk.CAPACITY_TYPE_SPOT]
+        od = [o for o in it.offerings if o.capacity_type() == wk.CAPACITY_TYPE_ON_DEMAND]
+        assert len(spot) == 4 and len(od) == 4
+        assert spot[0].price < od[0].price
+
+    def test_price_monotone_in_size(self, types):
+        c1 = next(t for t in types if t.name == "c-1x-amd64-linux")
+        c4 = next(t for t in types if t.name == "c-4x-amd64-linux")
+        assert cheapest(c1.offerings).price < cheapest(c4.offerings).price
+
+
+class TestInstanceTypeOps:
+    def test_order_by_price(self, types):
+        reqs = Requirements(Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [wk.CAPACITY_TYPE_ON_DEMAND]))
+        ordered = order_by_price(types, reqs)
+        prices = []
+        for it in ordered[:10]:
+            compat = [o for o in it.offerings if reqs.intersects(o.requirements) is None]
+            prices.append(min(o.price for o in compat))
+        assert prices == sorted(prices)
+
+    def test_compatible_filters_arch(self, types):
+        reqs = Requirements(Requirement(wk.ARCH_LABEL_KEY, "In", [wk.ARCH_ARM64]))
+        out = compatible_instance_types(types, reqs)
+        assert out and all("arm64" in it.name for it in out)
+
+    def test_worst_launch_price_prefers_reserved_then_spot(self):
+        it = catalog.make_instance_type("c", 4, include_reserved=True)
+        all_reqs = Requirements()
+        # with all capacity types present, reserved wins the precedence
+        p = worst_launch_price(it.offerings, all_reqs)
+        reserved = [o for o in it.offerings if o.capacity_type() == wk.CAPACITY_TYPE_RESERVED]
+        assert p == max(o.price for o in reserved)
+        # restrict to on-demand
+        od_reqs = Requirements(Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [wk.CAPACITY_TYPE_ON_DEMAND]))
+        od = [o for o in it.offerings if o.capacity_type() == wk.CAPACITY_TYPE_ON_DEMAND]
+        assert worst_launch_price(it.offerings, od_reqs) == max(o.price for o in od)
+
+    def test_min_values(self, types):
+        reqs = Requirements(
+            Requirement(wk.INSTANCE_TYPE_LABEL_KEY, "Exists", min_values=3),
+        )
+        needed, unsat = satisfies_min_values(types[:5], reqs)
+        assert unsat is None and needed == 3
+        needed, unsat = satisfies_min_values(types[:2], reqs)
+        assert unsat == {wk.INSTANCE_TYPE_LABEL_KEY: 2}
+
+
+def mkclaim(instance_types, extra_reqs=()):
+    nc = NodeClaim()
+    nc.metadata.name = "test-claim"
+    nc.spec.requirements = [
+        {"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": instance_types},
+        *extra_reqs,
+    ]
+    return nc
+
+
+class TestKWOKProvider:
+    def setup_method(self):
+        self.store = Store()
+        self.store.create(KWOKNodeClass())
+        self.clock = FakeClock()
+        self.cp = KWOKCloudProvider(self.store, catalog.construct_instance_types(), clock=self.clock)
+
+    def test_create_picks_cheapest_offering(self):
+        out = self.cp.create(mkclaim(["c-4x-amd64-linux", "c-2x-amd64-linux"]))
+        # cheaper of the two is c-2x; cheapest capacity type is spot
+        assert out.metadata.labels[wk.INSTANCE_TYPE_LABEL_KEY] == "c-2x-amd64-linux"
+        assert out.metadata.labels[wk.CAPACITY_TYPE_LABEL_KEY] == wk.CAPACITY_TYPE_SPOT
+        nodes = self.store.list("Node")
+        assert len(nodes) == 1
+        assert nodes[0].spec.provider_id.startswith("kwok://")
+        assert any(t.key == wk.UNREGISTERED_TAINT_KEY for t in nodes[0].spec.taints)
+
+    def test_create_respects_capacity_type_requirement(self):
+        out = self.cp.create(
+            mkclaim(
+                ["c-2x-amd64-linux"],
+                extra_reqs=[{"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_ON_DEMAND]}],
+            )
+        )
+        assert out.metadata.labels[wk.CAPACITY_TYPE_LABEL_KEY] == wk.CAPACITY_TYPE_ON_DEMAND
+
+    def test_create_unknown_type_fails(self):
+        with pytest.raises(InsufficientCapacityError):
+            self.cp.create(mkclaim(["no-such-type"]))
+
+    def test_registration_delay(self):
+        nodeclass = self.store.get("KWOKNodeClass", "default")
+        nodeclass.spec.node_registration_delay = 30.0
+        self.store.update(nodeclass)
+        self.cp.create(mkclaim(["c-2x-amd64-linux"]))
+        assert self.store.count("Node") == 0
+        self.clock.step(31)
+        assert self.cp.flush_pending() == 1
+        assert self.store.count("Node") == 1
+
+    def test_get_delete_roundtrip(self):
+        out = self.cp.create(mkclaim(["c-2x-amd64-linux"]))
+        pid = self.store.list("Node")[0].spec.provider_id
+        got = self.cp.get(pid)
+        assert got.status.provider_id == pid
+        self.cp.delete(got)
+        with pytest.raises(NodeClaimNotFoundError):
+            self.cp.get(pid)
+
+    def test_list(self):
+        self.cp.create(mkclaim(["c-2x-amd64-linux"]))
+        self.cp.create(mkclaim(["m-8x-amd64-linux"]))
+        assert len(self.cp.list()) == 2
+
+
+class TestFakeProvider:
+    def test_scripted_error(self):
+        fp = FakeCloudProvider()
+        fp.next_create_err = RuntimeError("boom")
+        with pytest.raises(RuntimeError):
+            fp.create(mkclaim([fp.instance_types[0].name]))
+        # next call succeeds and records
+        fp.create(NodeClaim())
+        assert len(fp.create_calls) == 2
+
+    def test_assorted_generator(self):
+        its = instance_types_assorted(400)
+        assert len(its) == 400
+        assert len({it.name for it in its}) == 400
