@@ -1,0 +1,74 @@
+"""ExistingNode: scheduling against an already-running (or launching) node.
+
+Reference: scheduling/existingnode.go — remaining resources start at
+allocatable minus current pods minus expected daemon overhead; CanAdd checks
+taints, host ports, resources, requirements, then topology.
+"""
+
+from __future__ import annotations
+
+from ....apis import labels as wk
+from ....scheduling.requirements import Requirement, Requirements
+from ....scheduling.taints import taints_tolerate_pod
+from ....scheduling.hostports import pod_host_ports
+from ....utils import resources as res
+from ....utils.quantity import Quantity
+
+
+class ExistingNode:
+    def __init__(self, state_node, topology, taints, daemon_resources: dict[str, Quantity], is_under_consolidate_after: bool = False):
+        self.state_node = state_node
+        self.topology = topology
+        self.taints = taints
+        self.pods: list = []
+        self.is_under_consolidate_after = is_under_consolidate_after
+
+        # remaining = allocatable - committed pods - headroom for daemons that
+        # haven't scheduled yet (existingnode.go:45-60)
+        remaining = res.subtract(state_node.allocatable(), state_node.total_pod_requests())
+        daemon_headroom = res.subtract(daemon_resources, state_node.total_daemon_requests())
+        daemon_headroom = {k: v for k, v in daemon_headroom.items() if v.milli > 0}
+        self.remaining_resources = res.subtract(remaining, daemon_headroom)
+
+        self.host_port_usage = state_node.host_port_usage.copy()
+        self.requirements = Requirements.from_labels(state_node.labels())
+        self.requirements.add(Requirement(wk.HOSTNAME_LABEL_KEY, "In", [state_node.hostname()]))
+        topology.register(wk.HOSTNAME_LABEL_KEY, state_node.hostname())
+
+    def name(self) -> str:
+        return self.state_node.name()
+
+    def can_add(self, pod, pod_data):
+        """Returns (updated_requirements, None) or error string
+        (existingnode.go:78-140)."""
+        err = taints_tolerate_pod(self.taints, pod)
+        if err is not None:
+            return None, err
+        ports = pod_host_ports(pod)
+        cerr = self.host_port_usage.conflicts(pod.key(), ports)
+        if cerr is not None:
+            return None, cerr
+        if not res.fits(pod_data.requests, self.remaining_resources):
+            return None, "exceeds node resources"
+        cerr = self.requirements.compatible(pod_data.requirements)
+        if cerr is not None:
+            return None, cerr
+        base = Requirements()
+        base.add(*self.requirements.values())
+        base.add(*pod_data.requirements.values())
+
+        topo = self.topology.add_requirements(pod, self.taints, pod_data.strict_requirements, base)
+        if isinstance(topo, str):
+            return None, topo
+        cerr = base.compatible(topo)
+        if cerr is not None:
+            return None, cerr
+        base.add(*topo.values())
+        return base, None
+
+    def add(self, pod, pod_data, updated_requirements: Requirements) -> None:
+        self.pods.append(pod)
+        self.requirements = updated_requirements
+        self.remaining_resources = res.subtract(self.remaining_resources, pod_data.requests)
+        self.host_port_usage.add(pod.key(), pod_host_ports(pod))
+        self.topology.record(pod, self.taints, self.requirements)

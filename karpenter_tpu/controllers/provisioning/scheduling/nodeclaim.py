@@ -1,0 +1,293 @@
+"""In-flight scheduling NodeClaim: template, CanAdd, instance-type filtering.
+
+Reference: scheduling/nodeclaim.go (CanAdd :124-208, filterInstanceTypes
+:541-640, FinalizeScheduling :383-409) and nodeclaimtemplate.go (requirement
+assembly, MaxInstanceTypes truncation, capacity-type narrowing).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ....apis import labels as wk
+from ....apis.nodeclaim import NodeClaim as APINodeClaim
+from ....apis.nodeclaim import NodeClaimSpec, NodeClassReference
+from ....cloudprovider.types import InstanceType, order_by_price
+from ....kube.objects import ObjectMeta
+from ....scheduling.hostports import HostPortUsage, pod_host_ports
+from ....scheduling.requirements import Operator, Requirement, Requirements
+from ....scheduling.taints import taints_tolerate_pod
+from ....utils import resources as res
+from ....utils.durations import parse_duration
+from ....utils.quantity import Quantity
+
+MAX_INSTANCE_TYPES = 600
+
+_hostname_seq = itertools.count(1)
+
+
+@dataclass
+class DaemonOverheadGroup:
+    """Instance types sharing a daemon-compatibility class and hence the same
+    daemon overhead (scheduler.go:963-1004)."""
+
+    instance_types: list[InstanceType]
+    daemon_overhead: dict[str, Quantity]
+    host_port_usage: HostPortUsage = field(default_factory=HostPortUsage)
+
+    def copy(self) -> "DaemonOverheadGroup":
+        return DaemonOverheadGroup(self.instance_types, self.daemon_overhead, self.host_port_usage.copy())
+
+
+class NodeClaimTemplate:
+    """Scheduling view of a NodePool's NodeClaim template
+    (nodeclaimtemplate.go:55-95)."""
+
+    def __init__(self, node_pool):
+        self.node_pool = node_pool
+        self.nodepool_name = node_pool.metadata.name
+        self.weight = node_pool.spec.weight
+        self.is_static = node_pool.is_static()
+        self.labels = dict(node_pool.spec.template.labels)
+        self.labels[wk.NODEPOOL_LABEL_KEY] = node_pool.metadata.name
+        self.annotations = dict(node_pool.spec.template.annotations)
+        self.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = node_pool.hash()
+        self.taints = list(node_pool.spec.template.taints)
+        self.startup_taints = list(node_pool.spec.template.startup_taints)
+        self.instance_type_options: list[InstanceType] = []
+        self.requirements = Requirements()
+        self.requirements.add(*Requirements.from_node_selector_terms(node_pool.spec.template.requirements).values())
+        self.requirements.add(*Requirements.from_labels(self.labels).values())
+        # simulation-only keys so DaemonSets with affinity on them count
+        self.requirements.add(Requirement(wk.NODE_REGISTERED_LABEL_KEY, "In", ["true"]))
+        self.requirements.add(Requirement(wk.NODE_INITIALIZED_LABEL_KEY, "In", ["true"]))
+
+
+class SchedulingNodeClaim:
+    """A NodeClaim being built up during a single Solve
+    (scheduling/nodeclaim.go:52-120)."""
+
+    def __init__(self, template: NodeClaimTemplate, topology, daemon_overhead_groups: list[DaemonOverheadGroup], instance_types: list[InstanceType]):
+        self.template = template
+        self.topology = topology
+        self.daemon_overhead_groups = [g.copy() for g in daemon_overhead_groups]
+        self.pods: list = []
+        self.instance_type_options = instance_types
+        self.requirements = Requirements()
+        self.requirements.add(*template.requirements.values())
+        self.hostname = f"hostname-placeholder-{next(_hostname_seq):05d}"
+        self.requirements.add(Requirement(wk.HOSTNAME_LABEL_KEY, "In", [self.hostname]))
+        topology.register(wk.HOSTNAME_LABEL_KEY, self.hostname)
+        self.spec_requests: dict[str, Quantity] = {}  # accumulated pod requests
+
+    @property
+    def nodepool_name(self) -> str:
+        return self.template.nodepool_name
+
+    def can_add(self, pod, pod_data, relax_min_values: bool = False):
+        """Returns (updated_requirements, remaining_instance_types) or an error
+        string (nodeclaim.go:124-208)."""
+        err = taints_tolerate_pod(self.template.taints, pod)
+        if err is not None:
+            return None, None, err
+
+        base = Requirements()
+        base.add(*self.requirements.values())
+        cerr = base.compatible(pod_data.requirements, allow_undefined=wk.WELL_KNOWN_LABELS)
+        if cerr is not None:
+            return None, None, f"incompatible requirements, {cerr}"
+        base.add(*pod_data.requirements.values())
+
+        topo = self.topology.add_requirements(
+            pod, self.template.taints, pod_data.strict_requirements, base, allow_undefined=wk.WELL_KNOWN_LABELS
+        )
+        if isinstance(topo, str):
+            return None, None, topo
+        cerr = base.compatible(topo, allow_undefined=wk.WELL_KNOWN_LABELS)
+        if cerr is not None:
+            return None, None, cerr
+        base.add(*topo.values())
+
+        requests = res.merge(self.spec_requests, pod_data.requests)
+        remaining, unsatisfiable, ferr = filter_instance_types(
+            self.instance_type_options, base, pod, pod_data.requests, self.daemon_overhead_groups, requests, relax_min_values
+        )
+        if relax_min_values:
+            for key, mv in unsatisfiable.items():
+                base.get(key).min_values = mv
+        if ferr is not None:
+            return None, None, ferr
+        return base, remaining, None
+
+    def add(self, pod, pod_data, updated_requirements: Requirements, updated_instance_types: list[InstanceType]) -> None:
+        self.pods.append(pod)
+        self.requirements = updated_requirements
+        self.instance_type_options = updated_instance_types
+        self.spec_requests = res.merge(self.spec_requests, pod_data.requests)
+        # track host ports per daemon group so future pods see conflicts
+        ports = pod_host_ports(pod)
+        for g in self.daemon_overhead_groups:
+            g.host_port_usage.add(pod.key(), ports)
+        self.topology.record(pod, self.template.taints, self.requirements)
+
+    def finalize(self) -> None:
+        """Drop the hostname placeholder so the claim can land anywhere
+        (nodeclaim.go:383-409)."""
+        reqs = Requirements()
+        for key, r in self.requirements.items():
+            if key != wk.HOSTNAME_LABEL_KEY:
+                reqs.replace(r)
+        self.requirements = reqs
+
+    def to_api_node_claim(self, clock=None) -> APINodeClaim:
+        """Produce the API NodeClaim to create (nodeclaimtemplate.go ToNodeClaim):
+        price-ordered truncated instance types and narrowed capacity types."""
+        its = order_by_price(self.instance_type_options, self.requirements)[:MAX_INSTANCE_TYPES]
+        reqs = Requirements()
+        for key, r in self.requirements.items():
+            if key not in (wk.NODE_REGISTERED_LABEL_KEY, wk.NODE_INITIALIZED_LABEL_KEY):
+                reqs.replace(r.copy())
+        mv = self.requirements.get(wk.INSTANCE_TYPE_LABEL_KEY).min_values
+        reqs.replace(Requirement(wk.INSTANCE_TYPE_LABEL_KEY, "In", [it.name for it in its], min_values=mv))
+        cts = sorted(
+            {
+                o.capacity_type()
+                for it in its
+                for o in it.offerings
+                if o.available and reqs.intersects(o.requirements) is None
+            }
+        )
+        if cts:
+            reqs.add(Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", cts))
+
+        tmpl = self.template
+        req_dicts = [d for r in reqs.values() for d in _req_to_dicts(r)]
+        # keep the instance-type values price-ordered (cheapest first) so
+        # downstream pickers and truncation see the intended preference
+        for d in req_dicts:
+            if d["key"] == wk.INSTANCE_TYPE_LABEL_KEY and d["operator"] == "In":
+                d["values"] = [it.name for it in its]
+        nc = APINodeClaim(
+            metadata=ObjectMeta(
+                name=f"{tmpl.nodepool_name}-{_rand_suffix()}",
+                labels={**tmpl.labels, **_concrete_labels(reqs)},
+                annotations=dict(tmpl.annotations),
+                finalizers=[wk.TERMINATION_FINALIZER],
+            ),
+            spec=NodeClaimSpec(
+                taints=list(tmpl.taints),
+                startup_taints=list(tmpl.startup_taints),
+                requirements=req_dicts,
+                resources=dict(self.spec_requests),
+                node_class_ref=NodeClassReference(**tmpl.node_pool.spec.template.node_class_ref)
+                if isinstance(tmpl.node_pool.spec.template.node_class_ref, dict)
+                else tmpl.node_pool.spec.template.node_class_ref,
+                termination_grace_period=parse_duration(tmpl.node_pool.spec.template.termination_grace_period),
+                expire_after=parse_duration(tmpl.node_pool.spec.template.expire_after),
+            ),
+        )
+        return nc
+
+
+def _concrete_labels(reqs: Requirements) -> dict[str, str]:
+    out = {}
+    for key, r in reqs.items():
+        if key in (wk.NODE_REGISTERED_LABEL_KEY, wk.NODE_INITIALIZED_LABEL_KEY, wk.HOSTNAME_LABEL_KEY):
+            continue
+        if r.operator() == Operator.IN and len(r.values) == 1:
+            out[key] = r.any()
+    return out
+
+
+def _req_to_dicts(r: Requirement) -> list[dict]:
+    """Serialize back to NodeSelectorRequirement dicts; a requirement carrying
+    both bounds emits two entries (requirement.go:116-126)."""
+    out: list[dict] = []
+    if r.gte is not None:
+        out.append({"key": r.key, "operator": "Gte", "values": [str(r.gte)]})
+    if r.lte is not None:
+        out.append({"key": r.key, "operator": "Lte", "values": [str(r.lte)]})
+    if not out:
+        out.append({"key": r.key, "operator": r.operator().value, "values": r.values_list()})
+    if r.min_values is not None:
+        for d in out:
+            d["minValues"] = r.min_values
+    return out
+
+
+def _rand_suffix() -> str:
+    import random
+
+    return f"{random.randrange(16**5):05x}"
+
+
+def filter_instance_types(
+    instance_types: list[InstanceType],
+    requirements: Requirements,
+    pod,
+    pod_requests: dict[str, Quantity],
+    daemon_overhead_groups: list[DaemonOverheadGroup],
+    total_requests: dict[str, Quantity],
+    relax_min_values: bool = False,
+) -> tuple[Optional[list[InstanceType]], dict[str, int], Optional[str]]:
+    """compat x fits x offering filter per daemon-overhead group
+    (nodeclaim.go:541-640). Returns (remaining, unsatisfiable_min_values, err)."""
+    remaining: list[InstanceType] = []
+    ports = pod_host_ports(pod)
+    eligible = {id(it) for it in instance_types}
+    any_compat = any_fits = any_offering = False
+
+    for group in daemon_overhead_groups:
+        if group.host_port_usage.conflicts(pod.key(), ports) is not None:
+            continue
+        total = res.merge(total_requests, group.daemon_overhead) if group.daemon_overhead else total_requests
+        for it in group.instance_types:
+            if id(it) not in eligible:
+                continue
+            compat = it.requirements.intersects(requirements) is None
+            fits, has_offering = _fits_and_offering(it, total, requirements)
+            any_compat |= compat
+            any_fits |= fits
+            any_offering |= has_offering
+            if compat and fits and has_offering:
+                remaining.append(it)
+
+    unsatisfiable: dict[str, int] = {}
+    if requirements.has_min_values():
+        from ....cloudprovider.types import satisfies_min_values
+
+        _, unsat = satisfies_min_values(remaining, requirements)
+        if unsat:
+            if not relax_min_values:
+                return None, {}, (
+                    f"minValues requirement is not met for {sorted(unsat)} "
+                    f"(observed {unsat})"
+                )
+            unsatisfiable = unsat
+
+    if not remaining:
+        parts = []
+        if not any_compat:
+            parts.append("no instance type satisfied requirements")
+        if not any_fits:
+            parts.append(f"no instance type has enough resources for {res.fmt(total_requests)}")
+        if not any_offering:
+            parts.append("no instance type has a compatible offering")
+        if not parts:
+            parts.append("no single instance type met requirements/fits/offering simultaneously")
+        return None, unsatisfiable, "; ".join(parts)
+    return remaining, unsatisfiable, None
+
+
+def _fits_and_offering(it: InstanceType, requests: dict[str, Quantity], requirements: Requirements) -> tuple[bool, bool]:
+    """(fits, has_offering) against allocatable and compatible+available offerings
+    (nodeclaim.go:626-640)."""
+    fits = res.fits(requests, it.allocatable())
+    has_offering = False
+    for o in it.offerings:
+        if o.available and requirements.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None:
+            has_offering = True
+            break
+    return fits, has_offering

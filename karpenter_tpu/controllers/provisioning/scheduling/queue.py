@@ -1,0 +1,41 @@
+"""FFD pod queue (reference: scheduling/queue.go:31-108).
+
+Pods are sorted CPU-descending then memory-descending (first-fit-decreasing);
+Pop stops when the queue cycles without progress.
+"""
+
+from __future__ import annotations
+
+
+class Queue:
+    def __init__(self, pods: list, pod_data: dict):
+        self.pods = sorted(pods, key=lambda p: _sort_key(p, pod_data))
+        self._last_len: dict[str, int] = {}
+
+    def pop(self):
+        if not self.pods:
+            return None
+        p = self.pods[0]
+        if self._last_len.get(p.metadata.uid) == len(self.pods):
+            return None  # cycled through with no progress
+        self.pods = self.pods[1:]
+        return p
+
+    def push(self, pod) -> None:
+        self.pods.append(pod)
+        self._last_len[pod.metadata.uid] = len(self.pods)
+
+    def list(self) -> list:
+        return list(self.pods)
+
+
+def _sort_key(pod, pod_data):
+    req = pod_data[pod.metadata.uid].requests
+    cpu = req.get("cpu")
+    mem = req.get("memory")
+    return (
+        -(cpu.milli if cpu else 0),
+        -(mem.milli if mem else 0),
+        pod.metadata.creation_timestamp,
+        pod.metadata.uid,
+    )

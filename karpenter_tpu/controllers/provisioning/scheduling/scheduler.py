@@ -1,0 +1,362 @@
+"""The scheduling simulation: Solve() bin-packs pending pods onto existing
+nodes, in-flight NodeClaims, and new NodeClaims from NodePool templates.
+
+Reference: scheduling/scheduler.go:440-1004 — the FFD loop with preference
+relaxation and daemon-overhead groups. This host implementation is the exact
+correctness oracle; the TPU tensor solver (karpenter_tpu/solver/tpu.py) is
+validated against it and plugs in through the same Solver interface.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from ....apis import labels as wk
+from ....scheduling.requirements import Requirements
+from ....scheduling.taints import taints_tolerate_pod
+from ....utils import resources as res
+from ....utils.quantity import Quantity
+from .existingnode import ExistingNode
+from .nodeclaim import DaemonOverheadGroup, NodeClaimTemplate, SchedulingNodeClaim
+from .preferences import Preferences
+from .queue import Queue
+from .topology import Topology
+
+
+@dataclass
+class PodData:
+    requests: dict
+    requirements: Requirements
+    strict_requirements: Requirements
+
+
+@dataclass
+class Results:
+    """Outcome of a Solve (scheduler.go Results)."""
+
+    new_node_claims: list[SchedulingNodeClaim] = field(default_factory=list)
+    existing_nodes: list[ExistingNode] = field(default_factory=list)
+    pod_errors: dict = field(default_factory=dict)  # pod key -> error string
+    timed_out: bool = False
+
+    def all_pods_scheduled(self) -> bool:
+        return not self.pod_errors
+
+    def non_pending_pod_scheduling_errors(self) -> str:
+        return "; ".join(f"{k}: {v}" for k, v in self.pod_errors.items())
+
+    def node_pod_count(self) -> dict[str, int]:
+        out = {}
+        for n in self.existing_nodes:
+            if n.pods:
+                out[n.name()] = len(n.pods)
+        return out
+
+    def total_new_nodes(self) -> int:
+        return len(self.new_node_claims)
+
+
+class Scheduler:
+    def __init__(
+        self,
+        store,
+        cluster,
+        node_pools: list,
+        instance_types: dict[str, list],  # nodepool name -> instance types
+        state_nodes: list,
+        daemonset_pods: list,
+        clock,
+        preference_policy: str = "Respect",
+        min_values_policy: str = "Strict",
+        enforce_consolidate_after: bool = False,
+        deleting_node_names: set[str] | None = None,
+        timeout_seconds: float = 60.0,
+    ):
+        self.store = store
+        self.cluster = cluster
+        self.clock = clock
+        self.preference_policy = preference_policy
+        self.min_values_policy = min_values_policy
+        self.deleting_node_names = deleting_node_names or set()
+        self.timeout_seconds = timeout_seconds
+        self.preferences = Preferences(tolerate_prefer_no_schedule=(preference_policy == "Ignore"))
+        self.cached_pod_data: dict[str, PodData] = {}
+
+        # NodePools ordered by weight desc (provisioner.go:268-289)
+        pools = sorted(node_pools, key=lambda np: (-np.spec.weight, np.metadata.name))
+        self.templates: list[NodeClaimTemplate] = []
+        for np in pools:
+            t = NodeClaimTemplate(np)
+            its = [it for it in instance_types.get(np.metadata.name, []) if _template_compatible(t, it)]
+            if not its:
+                continue
+            t.instance_type_options = its
+            self.templates.append(t)
+
+        # remaining resources per nodepool for limit enforcement: start from the
+        # raw limits; each state node is subtracted exactly once below
+        # (scheduler.go:183-185, 840)
+        self.remaining_resources: dict[str, dict[str, Quantity]] = {}
+        for np in pools:
+            if np.spec.limits:
+                self.remaining_resources[np.metadata.name] = {k: Quantity(v.milli) for k, v in np.spec.limits.items()}
+
+        self.topology = Topology(
+            store,
+            cluster,
+            state_nodes,
+            pools,
+            instance_types,
+            pods=[],
+            preference_policy=preference_policy,
+        )
+
+        # daemon overhead groups per template (scheduler.go:963-1004)
+        self.daemon_overhead_groups: dict[int, list[DaemonOverheadGroup]] = {}
+        self.daemonset_pods = daemonset_pods
+        for t in self.templates:
+            self.daemon_overhead_groups[id(t)] = _compute_daemon_overhead_groups(t, daemonset_pods)
+
+        nodepool_map = {np.metadata.name: np for np in pools}
+        self.existing_nodes: list[ExistingNode] = []
+        for sn in sorted(state_nodes, key=lambda n: n.name()):
+            taints = sn.taints()
+            daemons = [
+                d
+                for d in daemonset_pods
+                if _daemon_compatible_with_node(sn, taints, d)
+            ]
+            under_ca = False
+            if enforce_consolidate_after and sn.node_claim is not None:
+                np = nodepool_map.get(sn.nodepool_name())
+                under_ca = _is_under_consolidate_after(np, sn.node_claim, clock)
+            self.existing_nodes.append(
+                ExistingNode(sn, self.topology, taints, res.requests_for_pods(daemons), under_ca)
+            )
+            self._update_remaining_resources(sn)
+
+        self.new_node_claims: list[SchedulingNodeClaim] = []
+
+    def _update_remaining_resources(self, sn) -> None:
+        pool = sn.nodepool_name()
+        if pool in self.remaining_resources:
+            self.remaining_resources[pool] = res.subtract(self.remaining_resources[pool], sn.capacity())
+
+    # -- the solve loop (scheduler.go:440-494) ---------------------------------
+    def solve(self, pods: list) -> Results:
+        import copy
+
+        pod_errors: dict[str, tuple] = {}  # uid -> (pod, error)
+        self.topology.prepare(pods)
+        for p in pods:
+            self._update_cached_pod_data(p)
+
+        q = Queue(pods, self.cached_pod_data)
+        start = self.clock.now()
+        timed_out = False
+        while True:
+            pod = q.pop()
+            if pod is None:
+                break
+            if self.clock.now() - start > self.timeout_seconds:
+                # surface every unattempted pod so callers never mistake a
+                # partial simulation for a complete one (scheduler.go:520)
+                timed_out = True
+                pod_errors[pod.metadata.uid] = (pod, "scheduling simulation timed out")
+                for rest in q.list():
+                    pod_errors.setdefault(rest.metadata.uid, (rest, "scheduling simulation timed out"))
+                break
+            err = self._try_schedule(copy.deepcopy(pod))
+            if err is not None:
+                pod_errors[pod.metadata.uid] = (pod, err)
+                self.topology.update(pod)
+                self._update_cached_pod_data(pod)
+                q.push(pod)
+            else:
+                pod_errors.pop(pod.metadata.uid, None)
+
+        for nc in self.new_node_claims:
+            nc.finalize()
+
+        return Results(
+            new_node_claims=list(self.new_node_claims),
+            existing_nodes=list(self.existing_nodes),
+            pod_errors={p.key(): e for p, e in pod_errors.values()},
+            timed_out=timed_out,
+        )
+
+    def _update_cached_pod_data(self, pod) -> None:
+        if self.preference_policy == "Ignore":
+            requirements = Requirements.from_pod(pod, strict=True)
+        else:
+            requirements = Requirements.from_pod(pod)
+        strict = requirements
+        aff = pod.spec.affinity.node_affinity if pod.spec.affinity else None
+        if aff is not None and aff.preferred:
+            strict = Requirements.from_pod(pod, strict=True)
+        self.cached_pod_data[pod.metadata.uid] = PodData(
+            requests=res.pod_requests(pod),
+            requirements=requirements,
+            strict_requirements=strict,
+        )
+
+    def _try_schedule(self, pod) -> str | None:
+        """Relaxation loop (scheduler.go:521-552)."""
+        while True:
+            err = self._add(pod)
+            if err is None:
+                return None
+            if not self.preferences.relax(pod):
+                return err
+            self.topology.update(pod)
+            self._update_cached_pod_data(pod)
+
+    def _add(self, pod) -> str | None:
+        if self._add_to_existing_node(pod) is None:
+            return None
+        # inflight claims sorted fewest-pods-first (scheduler.go:598)
+        self.new_node_claims.sort(key=lambda m: len(m.pods))
+        if self._add_to_inflight_node(pod) is None:
+            return None
+        if not self.templates:
+            return "nodepool requirements filtered out all available instance types"
+        return self._add_to_new_node_claim(pod)
+
+    def _add_to_existing_node(self, pod) -> str | None:
+        pod_data = self.cached_pod_data[pod.metadata.uid]
+        is_pending = not pod.spec.node_name
+        for node in self.existing_nodes:
+            if node.is_under_consolidate_after and not is_pending and pod.spec.node_name not in self.deleting_node_names:
+                continue
+            reqs, err = node.can_add(pod, pod_data)
+            if err is None:
+                node.add(pod, pod_data, reqs)
+                return None
+        return "failed scheduling pod to existing nodes"
+
+    def _add_to_inflight_node(self, pod) -> str | None:
+        pod_data = self.cached_pod_data[pod.metadata.uid]
+        for nc in self.new_node_claims:
+            reqs, its, err = nc.can_add(pod, pod_data, relax_min_values=(self.min_values_policy == "BestEffort"))
+            if err is None:
+                nc.add(pod, pod_data, reqs, its)
+                return None
+        return "failed scheduling pod to inflight nodes"
+
+    def _add_to_new_node_claim(self, pod) -> str | None:
+        pod_data = self.cached_pod_data[pod.metadata.uid]
+        errs = []
+        for t in self.templates:
+            its = t.instance_type_options
+            remaining = self.remaining_resources.get(t.nodepool_name)
+            if remaining is not None:
+                nodes_left = remaining.get("nodes")
+                if nodes_left is not None and nodes_left.milli <= 0:
+                    errs.append(f"node limits exhausted for nodepool {t.nodepool_name}")
+                    continue
+                its = _filter_by_remaining_resources(its, remaining)
+                if not its:
+                    errs.append(f"all available instance types exceed limits for nodepool {t.nodepool_name}")
+                    continue
+            nc = SchedulingNodeClaim(t, self.topology, self.daemon_overhead_groups[id(t)], its)
+            reqs, rem_its, err = nc.can_add(pod, pod_data, relax_min_values=(self.min_values_policy == "BestEffort"))
+            if err is not None:
+                errs.append(f"{t.nodepool_name}: {err}")
+                continue
+            nc.add(pod, pod_data, reqs, rem_its)
+            self.new_node_claims.append(nc)
+            if remaining is not None:
+                self.remaining_resources[t.nodepool_name] = _subtract_max(remaining, nc.instance_type_options)
+            return None
+        return "; ".join(errs) if errs else "no nodepool matched pod"
+
+
+def _template_compatible(template: NodeClaimTemplate, it) -> bool:
+    """Instance type passes the template requirements and has an offering."""
+    if it.requirements.intersects(template.requirements) is not None:
+        return False
+    return any(o.available and template.requirements.intersects(o.requirements) is None for o in it.offerings)
+
+
+def _compute_daemon_overhead_groups(template: NodeClaimTemplate, daemonset_pods: list) -> list[DaemonOverheadGroup]:
+    """Group instance types by which daemons would schedule to them
+    (scheduler.go:963-1004): the daemon overhead depends on daemon
+    nodeSelector/affinity/taints vs the concrete instance type."""
+    groups: dict[tuple, DaemonOverheadGroup] = {}
+    for it in template.instance_type_options:
+        compatible: list = []
+        for d in daemonset_pods:
+            if _daemon_compatible_with_instance_type(template, it, d):
+                compatible.append(d)
+        key = tuple(sorted(id(d) for d in compatible))
+        g = groups.get(key)
+        if g is None:
+            overhead = res.requests_for_pods(compatible)
+            g = DaemonOverheadGroup(instance_types=[], daemon_overhead=overhead)
+            groups[key] = g
+        g.instance_types.append(it)
+    return list(groups.values())
+
+
+def _daemon_compatible_with_instance_type(template: NodeClaimTemplate, it, daemon_pod) -> bool:
+    if taints_tolerate_pod(template.taints, daemon_pod) is not None:
+        return False
+    reqs = Requirements()
+    reqs.add(*template.requirements.values())
+    reqs.add(*it.requirements.values())
+    pod_reqs = Requirements.from_pod(daemon_pod, strict=True)
+    if reqs.compatible(pod_reqs, allow_undefined=wk.WELL_KNOWN_LABELS) is not None:
+        return False
+    return res.fits(res.pod_requests(daemon_pod), it.allocatable())
+
+
+def _daemon_compatible_with_node(sn, taints, daemon_pod) -> bool:
+    if taints_tolerate_pod(taints, daemon_pod) is not None:
+        return False
+    node_reqs = Requirements.from_labels(sn.labels())
+    pod_reqs = Requirements.from_pod(daemon_pod, strict=True)
+    return node_reqs.compatible(pod_reqs) is None
+
+
+def _filter_by_remaining_resources(its: list, remaining: dict[str, Quantity]) -> list:
+    """Drop instance types that would exceed the nodepool limits; only the
+    limited resource names are consulted (scheduler.go:1069-1085)."""
+    out = []
+    for it in its:
+        if all(it.capacity.get(k, Quantity(0)).milli <= v.milli for k, v in remaining.items()):
+            out.append(it)
+    return out
+
+
+def _subtract_max(remaining: dict[str, Quantity], its: list) -> dict[str, Quantity]:
+    """Subtract the worst-case capacity of the chosen instance types, keyed by
+    the limited resources (scheduler.go:1049-1066). We additionally decrement
+    the synthetic "nodes" resource by 1 per in-flight claim — the reference
+    gates node limits via the early IsZero check plus existing-node counting."""
+    worst: dict[str, Quantity] = {}
+    for it in its:
+        for k, v in it.capacity.items():
+            if k not in worst or v.milli > worst[k].milli:
+                worst[k] = v
+    out = {k: v - worst.get(k, Quantity(0)) for k, v in remaining.items()}
+    if "nodes" in remaining:
+        out["nodes"] = remaining["nodes"] - Quantity.parse(1)
+    return out
+
+
+def _is_under_consolidate_after(np, node_claim, clock) -> bool:
+    """IsUnderConsolidateAfter (utils/disruption.go:80-100): node had pod churn
+    more recently than consolidateAfter allows."""
+    if np is None or node_claim is None:
+        return False
+    ca = np.spec.disruption.consolidate_after_seconds()
+    if ca == 0 or ca == math.inf:
+        return False
+    from ....apis.nodeclaim import COND_INITIALIZED
+
+    cond = node_claim.status.conditions.get(COND_INITIALIZED)
+    if cond is None or cond.status != "True":
+        return False
+    base = node_claim.status.last_pod_event_time or cond.last_transition_time
+    return clock.now() - base < ca

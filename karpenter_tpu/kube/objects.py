@@ -189,6 +189,45 @@ class Node:
         return self.metadata.name
 
 
+@dataclass
+class DaemonSet:
+    """Minimal DaemonSet: the scheduler precomputes per-node daemon overhead
+    from its pod template."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    template_spec: PodSpec = field(default_factory=PodSpec)
+    template_metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    kind: str = "DaemonSet"
+
+    def to_pod(self) -> "Pod":
+        import copy as _copy
+
+        pod = Pod(spec=_copy.deepcopy(self.template_spec))
+        pod.metadata.namespace = self.metadata.namespace
+        pod.metadata.name = f"{self.metadata.name}-daemon"
+        pod.metadata.labels = dict(self.template_metadata.labels)
+        pod.metadata.owner_references = [
+            OwnerReference(kind="DaemonSet", name=self.metadata.name, uid=self.metadata.uid, controller=True)
+        ]
+        return pod
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: dict | None = None  # metav1 label selector
+    min_available: int | str | None = None
+    max_unavailable: int | str | None = None
+    kind: str = "PodDisruptionBudget"
+
+
+@dataclass
+class PriorityClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    kind: str = "PriorityClass"
+
+
 def match_label_selector(selector: dict | None, labels: dict[str, str]) -> bool:
     """metav1.LabelSelector matching: matchLabels AND matchExpressions."""
     if selector is None:

@@ -1,0 +1,293 @@
+"""Cluster: the in-memory mirror of nodes/nodeclaims/pods/bindings.
+
+Reference: pkg/controllers/state/cluster.go:54-126 — fed by informer watch
+events, gates the provisioning and disruption loops via synced(), tracks
+pod-ack times, the per-pool consolidated state, and anti-affinity pods.
+
+This layer is also where the TPU solver's incremental tensor cache hooks in:
+every mutation bumps a generation counter so the encoder can avoid re-uploading
+unchanged snapshots (SURVEY.md §7 stage 3).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..apis import labels as wk
+from ..apis.nodeclaim import NodeClaim
+from ..utils import pods as pod_utils
+from ..utils import resources as res
+from ..utils.quantity import Quantity
+from .statenode import StateNode
+
+
+class Cluster:
+    def __init__(self, store, clock):
+        self.store = store
+        self.clock = clock
+        self._lock = threading.RLock()
+        self._nodes: dict[str, StateNode] = {}  # provider-id (or node name) -> StateNode
+        self._node_name_to_provider_id: dict[str, str] = {}
+        self._nodeclaim_name_to_provider_id: dict[str, str] = {}
+        self._bindings: dict[str, str] = {}  # pod key -> node name
+        self._anti_affinity_pods: set[str] = set()  # pod keys with required anti-affinity
+        self._pod_acks: dict[str, float] = {}  # pod key -> first-seen-pending time
+        self._pod_scheduling_decisions: dict[str, float] = {}
+        self._pod_to_node_claim: dict[str, str] = {}
+        self._consolidated_at: float = 0.0
+        self._unsynced_start: Optional[float] = None
+        self.generation = 0  # bumped on every mutation (solver cache key)
+        self._on_change: list[Callable[[], None]] = []
+
+    # -- change hooks ----------------------------------------------------------
+    def on_change(self, fn: Callable[[], None]) -> None:
+        self._on_change.append(fn)
+
+    def _bump(self) -> None:
+        self.generation += 1
+        self.mark_unconsolidated()
+        for fn in self._on_change:
+            fn()
+
+    # -- synced gate (cluster.go:128-168) -------------------------------------
+    def synced(self) -> bool:
+        """True when every NodeClaim with a provider id has a StateNode and all
+        store nodes are mirrored. With the in-process store we are effectively
+        always synced once informers ran; the check still guards tests that
+        bypass informers."""
+        with self._lock:
+            claim_ids = {
+                nc.status.provider_id
+                for nc in self.store.list("NodeClaim")
+                if nc.status.provider_id and nc.metadata.deletion_timestamp is None
+            }
+            node_ids = {n.spec.provider_id for n in self.store.list("Node") if n.spec.provider_id}
+            known = set(self._nodes.keys())
+            return claim_ids.issubset(known) and node_ids.issubset(known)
+
+    # -- accessors -------------------------------------------------------------
+    def nodes(self) -> list[StateNode]:
+        with self._lock:
+            return [n.shallow_copy() for n in self._nodes.values()]
+
+    def node_for_name(self, name: str) -> Optional[StateNode]:
+        with self._lock:
+            pid = self._node_name_to_provider_id.get(name)
+            n = self._nodes.get(pid) if pid else None
+            return n.shallow_copy() if n else None
+
+    def node_for_claim(self, claim_name: str) -> Optional[StateNode]:
+        with self._lock:
+            pid = self._nodeclaim_name_to_provider_id.get(claim_name)
+            n = self._nodes.get(pid) if pid else None
+            return n.shallow_copy() if n else None
+
+    def bindings(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._bindings)
+
+    def nodepool_resources(self, nodepool_name: str) -> dict[str, Quantity]:
+        """Total launched resources per pool (for limits enforcement)."""
+        with self._lock:
+            totals: list[dict[str, Quantity]] = []
+            for n in self._nodes.values():
+                if n.labels().get(wk.NODEPOOL_LABEL_KEY) == nodepool_name and not n.deleted():
+                    totals.append(n.capacity())
+            return res.merge(*totals)
+
+    def nodepool_node_count(self, nodepool_name: str) -> int:
+        with self._lock:
+            return sum(
+                1
+                for n in self._nodes.values()
+                if n.labels().get(wk.NODEPOOL_LABEL_KEY) == nodepool_name and not n.deleted()
+            )
+
+    # -- consolidation timestamp (cluster.go:583-607) --------------------------
+    def consolidated(self) -> bool:
+        with self._lock:
+            return self._consolidated_at > 0 and (self.clock.now() - self._consolidated_at) < 300.0
+
+    def mark_consolidated(self) -> None:
+        with self._lock:
+            self._consolidated_at = self.clock.now()
+
+    def mark_unconsolidated(self) -> None:
+        self._consolidated_at = 0.0
+
+    # -- updates (driven by informers; cluster.go:360-442) ---------------------
+    def update_node(self, node) -> None:
+        with self._lock:
+            pid = node.spec.provider_id or node.metadata.name
+            old_pid = self._node_name_to_provider_id.get(node.metadata.name)
+            if old_pid is not None and old_pid != pid:
+                # node gained its provider id: migrate the name-keyed StateNode
+                # so it is never double-counted (cluster.go:399-405 refuses to
+                # track managed nodes until providerID is set)
+                stale = self._nodes.pop(old_pid, None)
+                if stale is not None and pid not in self._nodes:
+                    self._nodes[pid] = stale
+            existing = self._nodes.get(pid)
+            if existing is None:
+                self._nodes[pid] = StateNode(node=node)
+            else:
+                existing.node = node
+            self._node_name_to_provider_id[node.metadata.name] = pid
+            # re-pair claim if one exists with this provider id
+            for claim_name, claim_pid in list(self._nodeclaim_name_to_provider_id.items()):
+                if claim_pid == pid and self._nodes[pid].node_claim is None:
+                    nc = self.store.try_get("NodeClaim", claim_name)
+                    if nc is not None:
+                        self._nodes[pid].node_claim = nc
+            self._rebind_pods_for_node(node.metadata.name)
+            self._bump()
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            pid = self._node_name_to_provider_id.pop(name, None)
+            if pid is None:
+                return
+            sn = self._nodes.get(pid)
+            if sn is not None:
+                if sn.node_claim is not None:
+                    sn.node = None  # claim still owns the slot
+                else:
+                    del self._nodes[pid]
+            self._bump()
+
+    def update_node_claim(self, nc: NodeClaim) -> None:
+        with self._lock:
+            if not nc.status.provider_id:
+                return  # not launched yet
+            pid = nc.status.provider_id
+            old_pid = self._nodeclaim_name_to_provider_id.get(nc.metadata.name)
+            if old_pid is not None and old_pid != pid and old_pid in self._nodes:
+                del self._nodes[old_pid]
+            self._nodeclaim_name_to_provider_id[nc.metadata.name] = pid
+            existing = self._nodes.get(pid)
+            if existing is None:
+                self._nodes[pid] = StateNode(node_claim=nc)
+            else:
+                existing.node_claim = nc
+            if nc.metadata.deletion_timestamp is not None:
+                self._nodes[pid].marked_for_deletion = True
+            self._bump()
+
+    def delete_node_claim(self, name: str) -> None:
+        with self._lock:
+            pid = self._nodeclaim_name_to_provider_id.pop(name, None)
+            if pid is None:
+                return
+            sn = self._nodes.get(pid)
+            if sn is not None:
+                if sn.node is not None:
+                    sn.node_claim = None
+                else:
+                    del self._nodes[pid]
+            self._bump()
+
+    def update_pod(self, pod) -> None:
+        with self._lock:
+            key = pod.key()
+            if pod_utils.is_terminal(pod) or pod.metadata.deletion_timestamp is not None:
+                bound_node = self._bindings.get(key)
+                self._remove_pod_usage(key)
+                if bound_node is not None and not pod_utils.is_owned_by_daemonset(pod):
+                    self._record_pod_event_on_claim(bound_node)
+            elif pod.spec.node_name:
+                old_node = self._bindings.get(key)
+                newly_bound = old_node != pod.spec.node_name
+                if old_node is not None and newly_bound:
+                    self._remove_pod_usage(key)
+                self._bindings[key] = pod.spec.node_name
+                sn = self._state_node_for(pod.spec.node_name)
+                if sn is not None:
+                    sn.update_for_pod(pod)
+                self._pod_acks.pop(key, None)
+                # lastPodEventTime: only on genuine bind transitions, never for
+                # DaemonSet pods, deduped at 10s (podevents/controller.go:110-121)
+                if newly_bound and not pod_utils.is_owned_by_daemonset(pod):
+                    self._record_pod_event_on_claim(pod.spec.node_name)
+            else:
+                self._pod_acks.setdefault(key, self.clock.now())
+            if _has_required_anti_affinity(pod):
+                if pod_utils.is_active(pod):
+                    self._anti_affinity_pods.add(key)
+                else:
+                    self._anti_affinity_pods.discard(key)
+            self._bump()
+
+    def delete_pod(self, key: str) -> None:
+        with self._lock:
+            self._remove_pod_usage(key)
+            self._anti_affinity_pods.discard(key)
+            self._pod_acks.pop(key, None)
+            self._bump()
+
+    # -- helpers ---------------------------------------------------------------
+    def _state_node_for(self, node_name: str) -> Optional[StateNode]:
+        pid = self._node_name_to_provider_id.get(node_name)
+        return self._nodes.get(pid) if pid else None
+
+    def _remove_pod_usage(self, key: str) -> None:
+        node_name = self._bindings.pop(key, None)
+        if node_name is not None:
+            sn = self._state_node_for(node_name)
+            if sn is not None:
+                sn.cleanup_for_pod(key)
+
+    def _rebind_pods_for_node(self, node_name: str) -> None:
+        """When a node (re)appears, replay bound pods onto its StateNode."""
+        sn = self._state_node_for(node_name)
+        if sn is None:
+            return
+        for pod in self.store.list("Pod"):
+            if pod.spec.node_name == node_name and pod_utils.is_active(pod):
+                self._bindings[pod.key()] = node_name
+                sn.update_for_pod(pod)
+
+    def _record_pod_event_on_claim(self, node_name: str) -> None:
+        sn = self._state_node_for(node_name)
+        if sn is not None and sn.node_claim is not None:
+            now = self.clock.now()
+            if now - sn.node_claim.status.last_pod_event_time >= 10.0:  # dedupe window
+                sn.node_claim.status.last_pod_event_time = now
+
+    def pods_with_anti_affinity(self) -> list:
+        with self._lock:
+            out = []
+            for key in self._anti_affinity_pods:
+                ns, name = key.split("/", 1)
+                pod = self.store.try_get("Pod", name, ns)
+                if pod is not None:
+                    out.append(pod)
+            return out
+
+    def ack_pods(self, keys: list[str]) -> None:
+        pass  # scheduling-latency metrics hook; recorded via _pod_acks
+
+    def mark_for_deletion(self, provider_ids: list[str]) -> None:
+        with self._lock:
+            for pid in provider_ids:
+                if pid in self._nodes:
+                    self._nodes[pid].marked_for_deletion = True
+            self._bump()
+
+    def unmark_for_deletion(self, provider_ids: list[str]) -> None:
+        with self._lock:
+            for pid in provider_ids:
+                if pid in self._nodes:
+                    self._nodes[pid].marked_for_deletion = False
+            self._bump()
+
+    def nominate_node(self, node_name: str) -> None:
+        with self._lock:
+            sn = self._state_node_for(node_name)
+            if sn is not None:
+                sn.nominate(self.clock.now())
+
+
+def _has_required_anti_affinity(pod) -> bool:
+    aff = pod.spec.affinity
+    return aff is not None and bool(aff.pod_anti_affinity_required)

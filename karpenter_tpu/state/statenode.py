@@ -1,0 +1,188 @@
+"""StateNode: the in-memory mirror of a (Node, NodeClaim) pair.
+
+Reference: pkg/controllers/state/statenode.go:126-500 — caches capacity,
+daemon requests, pod requests, host ports, deletion/nomination flags, and the
+disruptability checks used by the disruption controller.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..apis import labels as wk
+from ..apis.nodeclaim import (
+    COND_INITIALIZED,
+    COND_REGISTERED,
+    NodeClaim,
+)
+from ..scheduling.hostports import HostPortUsage, pod_host_ports
+from ..scheduling.taints import Taint
+from ..utils import disruption as disruption_utils
+from ..utils import pods as pod_utils
+from ..utils import resources as res
+from ..utils.quantity import Quantity
+
+NOMINATION_WINDOW_SECONDS = 20.0
+
+
+class StateNode:
+    def __init__(self, node=None, node_claim: Optional[NodeClaim] = None):
+        self.node = node
+        self.node_claim = node_claim
+        self.pod_requests: dict[str, dict[str, Quantity]] = {}
+        self.pod_limits: dict[str, dict[str, Quantity]] = {}
+        self.pod_disruption_costs: dict[str, float] = {}
+        self.daemonset_requests: dict[str, dict[str, Quantity]] = {}
+        self.host_port_usage = HostPortUsage()
+        self.marked_for_deletion = False
+        self.nominated_until = 0.0
+
+    # -- identity --------------------------------------------------------------
+    def name(self) -> str:
+        if self.node is not None:
+            return self.node.metadata.name
+        return self.node_claim.status.node_name if self.node_claim else ""
+
+    def provider_id(self) -> str:
+        if self.node is not None and self.node.spec.provider_id:
+            return self.node.spec.provider_id
+        return self.node_claim.status.provider_id if self.node_claim else ""
+
+    def hostname(self) -> str:
+        return self.labels().get(wk.HOSTNAME_LABEL_KEY, self.name())
+
+    def managed(self) -> bool:
+        """Karpenter-managed = has a NodeClaim (statenode.go:459)."""
+        return self.node_claim is not None
+
+    def nodepool_name(self) -> Optional[str]:
+        return self.labels().get(wk.NODEPOOL_LABEL_KEY)
+
+    # -- merged metadata (nodeclaim wins until node registers; statenode.go:281-339)
+    def labels(self) -> dict[str, str]:
+        out: dict[str, str] = {}
+        if self.node_claim is not None:
+            out.update(self.node_claim.metadata.labels)
+        if self.node is not None:
+            out.update(self.node.metadata.labels)
+        return out
+
+    def annotations(self) -> dict[str, str]:
+        out: dict[str, str] = {}
+        if self.node_claim is not None:
+            out.update(self.node_claim.metadata.annotations)
+        if self.node is not None:
+            out.update(self.node.metadata.annotations)
+        return out
+
+    def taints(self) -> list[Taint]:
+        """Node taints, filtering the transient karpenter lifecycle taints that
+        scheduling must ignore (statenode.go:311-339)."""
+        source = []
+        if self.node is not None and self.registered():
+            source = self.node.spec.taints
+        elif self.node_claim is not None:
+            source = self.node_claim.spec.taints
+        elif self.node is not None:
+            source = self.node.spec.taints
+        ephemeral = {wk.UNREGISTERED_TAINT_KEY, wk.DISRUPTED_TAINT_KEY}
+        return [t for t in source if t.key not in ephemeral]
+
+    def registered(self) -> bool:
+        if self.node_claim is not None:
+            return self.node_claim.status.conditions.is_true(COND_REGISTERED)
+        return self.node is not None  # unmanaged nodes count as registered
+
+    def initialized(self) -> bool:
+        if self.node_claim is not None:
+            return self.node_claim.status.conditions.is_true(COND_INITIALIZED)
+        return self.node is not None
+
+    # -- resources -------------------------------------------------------------
+    def capacity(self) -> dict[str, Quantity]:
+        """Node capacity plus the synthetic nodes:1 resource used for
+        node-count limits (statenode.go:359-374)."""
+        if self.node is not None and self.registered() and self.node.status.capacity:
+            base = self.node.status.capacity
+        elif self.node_claim is not None and self.node_claim.status.capacity:
+            base = self.node_claim.status.capacity
+        else:
+            base = self.node.status.capacity if self.node is not None else {}
+        return {**base, "nodes": Quantity.parse(1)}
+
+    def allocatable(self) -> dict[str, Quantity]:
+        if self.node is not None and self.initialized() and self.node.status.allocatable:
+            return self.node.status.allocatable
+        if self.node_claim is not None and self.node_claim.status.allocatable:
+            return self.node_claim.status.allocatable
+        return self.node.status.allocatable if self.node is not None else {}
+
+    def total_pod_requests(self) -> dict[str, Quantity]:
+        return res.merge(*self.pod_requests.values())
+
+    def total_daemon_requests(self) -> dict[str, Quantity]:
+        return res.merge(*self.daemonset_requests.values())
+
+    def available(self) -> dict[str, Quantity]:
+        """allocatable - all pod requests (statenode.go:395)."""
+        return res.subtract(self.allocatable(), self.total_pod_requests())
+
+    def disruption_cost(self) -> float:
+        return sum(self.pod_disruption_costs.values())
+
+    # -- pod tracking ----------------------------------------------------------
+    def update_for_pod(self, pod) -> None:
+        key = pod.key()
+        requests = res.pod_requests(pod)
+        self.pod_requests[key] = requests
+        self.pod_limits[key] = res.pod_limits(pod)
+        self.pod_disruption_costs[key] = disruption_utils.eviction_cost(pod)
+        if pod_utils.is_owned_by_daemonset(pod):
+            self.daemonset_requests[key] = requests
+        self.host_port_usage.add(key, pod_host_ports(pod))
+
+    def cleanup_for_pod(self, key: str) -> None:
+        self.pod_requests.pop(key, None)
+        self.pod_limits.pop(key, None)
+        self.pod_disruption_costs.pop(key, None)
+        self.daemonset_requests.pop(key, None)
+        self.host_port_usage.remove(key)
+
+    # -- disruption flags ------------------------------------------------------
+    def nominate(self, now: float) -> None:
+        self.nominated_until = now + NOMINATION_WINDOW_SECONDS
+
+    def nominated(self, now: float) -> bool:
+        return self.nominated_until > now
+
+    def deleted(self) -> bool:
+        return (self.node is not None and self.node.metadata.deletion_timestamp is not None) or (
+            self.node_claim is not None and self.node_claim.metadata.deletion_timestamp is not None
+        )
+
+    def validate_node_disruptable(self, now: float) -> str | None:
+        """Gate for disruption candidacy (statenode.go:212-242)."""
+        if self.node_claim is None or self.node is None:
+            return "node is not managed or not yet paired"
+        if not self.initialized():
+            return "node is not initialized"
+        if self.marked_for_deletion or self.deleted():
+            return "node is deleting or marked for deletion"
+        if self.nominated(now):
+            return "node is nominated for pending pods"
+        if self.annotations().get(wk.DO_NOT_DISRUPT_ANNOTATION_KEY) == "true":
+            return "disruption is blocked through the do-not-disrupt annotation"
+        if self.nodepool_name() is None:
+            return "node does not have the nodepool label"
+        return None
+
+    def shallow_copy(self) -> "StateNode":
+        c = StateNode(self.node, self.node_claim)
+        c.pod_requests = dict(self.pod_requests)
+        c.pod_limits = dict(self.pod_limits)
+        c.pod_disruption_costs = dict(self.pod_disruption_costs)
+        c.daemonset_requests = dict(self.daemonset_requests)
+        c.host_port_usage = self.host_port_usage.copy()
+        c.marked_for_deletion = self.marked_for_deletion
+        c.nominated_until = self.nominated_until
+        return c

@@ -1,0 +1,58 @@
+"""Pod predicates (reference: pkg/utils/pod/scheduling.go).
+
+The provisioner acts on "provisionable" pods: pending, unbound, and not
+destined for termination. Reschedulability feeds disruption decisions.
+"""
+
+from __future__ import annotations
+
+from .disruption import DO_NOT_DISRUPT_ANNOTATION
+
+TERMINAL_PHASES = ("Succeeded", "Failed")
+
+
+def is_scheduled(pod) -> bool:
+    return bool(pod.spec.node_name)
+
+
+def is_terminal(pod) -> bool:
+    return pod.status.phase in TERMINAL_PHASES
+
+
+def is_terminating(pod) -> bool:
+    return pod.metadata.deletion_timestamp is not None
+
+
+def is_provisionable(pod) -> bool:
+    """Unbound, non-terminal, not terminating — the pods the provisioner batches."""
+    return not is_scheduled(pod) and not is_terminal(pod) and not is_terminating(pod)
+
+
+def is_active(pod) -> bool:
+    return not is_terminal(pod) and not is_terminating(pod)
+
+
+def is_reschedulable(pod) -> bool:
+    """Pods that must fit elsewhere if their node is disrupted: active and not
+    owned by the node itself (static/mirror pods) or a DaemonSet."""
+    return is_active(pod) and not is_owned_by_daemonset(pod) and not is_owned_by_node(pod)
+
+
+def is_owned_by_daemonset(pod) -> bool:
+    return any(ref.kind == "DaemonSet" for ref in pod.metadata.owner_references)
+
+
+def is_owned_by_node(pod) -> bool:
+    return any(ref.kind == "Node" for ref in pod.metadata.owner_references)
+
+
+def has_do_not_disrupt(pod) -> bool:
+    return pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true"
+
+
+def is_disruptable(pod) -> bool:
+    return not has_do_not_disrupt(pod)
+
+
+def is_eviction_blocked(pod) -> bool:
+    return has_do_not_disrupt(pod) and is_active(pod)

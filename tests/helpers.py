@@ -1,0 +1,100 @@
+"""Shared object builders (reference: pkg/test fixtures)."""
+
+from __future__ import annotations
+
+import itertools
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.kube import (
+    Affinity,
+    Container,
+    NodeAffinity,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    PodSpec,
+    PreferredSchedulingTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.utils.resources import parse_resource_list
+
+_seq = itertools.count(1)
+
+
+def make_pod(
+    name=None,
+    ns="default",
+    cpu="1",
+    memory=None,
+    labels=None,
+    node_selector=None,
+    node_name="",
+    required_affinity=None,  # list of term lists
+    preferred_affinity=None,  # list of (weight, term list)
+    tolerations=None,
+    tsc=None,  # list of TopologySpreadConstraint
+    anti_affinity=None,  # list of PodAffinityTerm
+    pod_affinity=None,
+    priority=None,
+    annotations=None,
+    owner_refs=None,
+):
+    name = name or f"pod-{next(_seq)}"
+    requests = {"cpu": cpu}
+    if memory:
+        requests["memory"] = memory
+    affinity = None
+    if required_affinity or preferred_affinity or anti_affinity or pod_affinity:
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=required_affinity or [],
+                preferred=[PreferredSchedulingTerm(weight=w, preference=t) for w, t in (preferred_affinity or [])],
+            )
+            if (required_affinity or preferred_affinity)
+            else None,
+            pod_anti_affinity_required=anti_affinity or [],
+            pod_affinity_required=pod_affinity or [],
+        )
+    pod = Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}, annotations=annotations or {}),
+        spec=PodSpec(
+            containers=[Container(resources={"requests": parse_resource_list(requests)})],
+            node_selector=node_selector or {},
+            node_name=node_name,
+            affinity=affinity,
+            tolerations=tolerations or [],
+            topology_spread_constraints=tsc or [],
+            priority=priority,
+        ),
+    )
+    if owner_refs:
+        pod.metadata.owner_references = owner_refs
+    return pod
+
+
+def make_nodepool(name="default-pool", requirements=None, taints=None, limits=None, weight=0, labels=None, replicas=None):
+    np = NodePool(metadata=ObjectMeta(name=name))
+    np.spec.weight = weight
+    np.spec.replicas = replicas
+    np.spec.template.requirements = requirements or [
+        {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_ON_DEMAND, wk.CAPACITY_TYPE_SPOT]},
+    ]
+    np.spec.template.taints = taints or []
+    np.spec.template.labels = labels or {}
+    if limits:
+        np.spec.limits = parse_resource_list(limits)
+    return np
+
+
+def zone_spread(max_skew=1, selector=None, when="DoNotSchedule"):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=wk.ZONE_LABEL_KEY,
+        when_unsatisfiable=when,
+        label_selector=selector,
+    )
+
+
+def hostname_anti_affinity(selector):
+    return PodAffinityTerm(label_selector=selector, topology_key=wk.HOSTNAME_LABEL_KEY)

@@ -1,0 +1,341 @@
+"""Scheduler behavior specs, modeled on the reference's
+scheduling/suite_test.go + topology_test.go + instance_selection_test.go.
+"""
+
+import pytest
+
+from helpers import hostname_anti_affinity, make_nodepool, make_pod, zone_spread
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.controllers.provisioning.scheduling import Scheduler
+from karpenter_tpu.kube import Store
+from karpenter_tpu.scheduling.taints import Taint
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def build_env(node_pools=None, types=None):
+    store = Store()
+    clock = FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    node_pools = node_pools if node_pools is not None else [make_nodepool(requirements=LINUX_AMD64)]
+    for np in node_pools:
+        store.create(np)
+    types = types if types is not None else catalog.construct_instance_types()
+    return store, clock, cluster, node_pools, types
+
+
+def make_scheduler(store, clock, cluster, node_pools, types, daemons=(), **kw):
+    return Scheduler(
+        store,
+        cluster,
+        node_pools,
+        {np.metadata.name: types for np in node_pools},
+        cluster.nodes(),
+        list(daemons),
+        clock,
+        **kw,
+    )
+
+
+class TestBasicScheduling:
+    def test_single_pod_new_nodeclaim(self):
+        env = build_env()
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(cpu="1")])
+        assert results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 1
+        nc = results.new_node_claims[0]
+        assert len(nc.pods) == 1
+        # instance types should all fit the pod and be linux/amd64
+        assert all("amd64-linux" in it.name for it in nc.instance_type_options)
+
+    def test_pods_pack_onto_one_inflight_node(self):
+        env = build_env()
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(cpu="1") for _ in range(4)])
+        assert results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 1
+        assert len(results.new_node_claims[0].pods) == 4
+
+    def test_huge_pod_unschedulable(self):
+        env = build_env()
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(cpu="10000")])
+        assert not results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 0
+
+    def test_node_selector_pins_zone(self):
+        env = build_env()
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"})])
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        assert nc.requirements.get(wk.ZONE_LABEL_KEY).values == {"test-zone-b"}
+
+    def test_impossible_zone_fails(self):
+        env = build_env()
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(node_selector={wk.ZONE_LABEL_KEY: "mars"})])
+        assert not results.all_pods_scheduled()
+
+    def test_incompatible_custom_label_fails(self):
+        env = build_env()
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(node_selector={"team": "infra"})])
+        assert not results.all_pods_scheduled()
+
+    def test_custom_nodepool_label_schedules(self):
+        np = make_nodepool(requirements=LINUX_AMD64, labels={"team": "infra"})
+        env = build_env([np])
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(node_selector={"team": "infra"})])
+        assert results.all_pods_scheduled()
+
+
+class TestTaints:
+    def test_untolerated_taint_fails(self):
+        np = make_nodepool(requirements=LINUX_AMD64, taints=[Taint(key="dedicated", value="gpu")])
+        env = build_env([np])
+        s = make_scheduler(*env)
+        assert not s.solve([make_pod()]).all_pods_scheduled()
+
+    def test_tolerated_taint_schedules(self):
+        np = make_nodepool(requirements=LINUX_AMD64, taints=[Taint(key="dedicated", value="gpu")])
+        env = build_env([np])
+        s = make_scheduler(*env)
+        pod = make_pod(tolerations=[{"key": "dedicated", "operator": "Equal", "value": "gpu"}])
+        assert s.solve([pod]).all_pods_scheduled()
+
+
+class TestExistingNodes:
+    def test_existing_capacity_used(self):
+        from karpenter_tpu.apis.nodeclaim import COND_INITIALIZED, COND_REGISTERED, NodeClaim
+        from karpenter_tpu.kube import Node, ObjectMeta
+        from karpenter_tpu.kube.objects import NodeSpec, NodeStatus
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        store, clock, cluster, pools, types = build_env()
+        nc = NodeClaim(metadata=ObjectMeta(name="c1", labels={wk.NODEPOOL_LABEL_KEY: "default-pool"}))
+        nc.status.provider_id = "kwok://n1"
+        nc.status.conditions.set_true(COND_REGISTERED)
+        nc.status.conditions.set_true(COND_INITIALIZED)
+        store.create(nc)
+        node = Node(
+            metadata=ObjectMeta(
+                name="n1",
+                labels={
+                    wk.NODEPOOL_LABEL_KEY: "default-pool",
+                    wk.HOSTNAME_LABEL_KEY: "n1",
+                    wk.ZONE_LABEL_KEY: "test-zone-a",
+                },
+            ),
+            spec=NodeSpec(provider_id="kwok://n1"),
+            status=NodeStatus(
+                capacity=parse_resource_list({"cpu": "4", "memory": "8Gi", "pods": "110"}),
+                allocatable=parse_resource_list({"cpu": "4", "memory": "8Gi", "pods": "110"}),
+            ),
+        )
+        store.create(node)
+        s = make_scheduler(store, clock, cluster, pools, types)
+        results = s.solve([make_pod(cpu="2")])
+        assert results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 0
+        assert results.node_pod_count() == {"n1": 1}
+
+
+class TestTopologySpread:
+    def test_zone_spread_across_new_claims(self):
+        env = build_env()
+        s = make_scheduler(*env)
+        selector = {"matchLabels": {"app": "web"}}
+        pods = [make_pod(labels={"app": "web"}, tsc=[zone_spread(selector=selector)]) for _ in range(6)]
+        results = s.solve(pods)
+        assert results.all_pods_scheduled()
+        zones = {}
+        for nc in results.new_node_claims:
+            z = nc.requirements.get(wk.ZONE_LABEL_KEY)
+            assert len(z.values) == 1
+            zones[next(iter(z.values))] = zones.get(next(iter(z.values)), 0) + len(nc.pods)
+        # 6 pods over 4 zones with maxSkew 1: counts must differ by <= 1
+        assert max(zones.values()) - min(zones.values()) <= 1
+        assert sum(zones.values()) == 6
+
+    def test_hostname_anti_affinity_one_per_node(self):
+        env = build_env()
+        s = make_scheduler(*env)
+        selector = {"matchLabels": {"app": "web"}}
+        pods = [
+            make_pod(labels={"app": "web"}, anti_affinity=[hostname_anti_affinity(selector)], cpu="1")
+            for _ in range(5)
+        ]
+        results = s.solve(pods)
+        assert results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 5
+        assert all(len(nc.pods) == 1 for nc in results.new_node_claims)
+
+    def test_zone_anti_affinity_limits_count(self):
+        from karpenter_tpu.kube import PodAffinityTerm
+
+        env = build_env()
+        s = make_scheduler(*env)
+        selector = {"matchLabels": {"app": "db"}}
+        pods = [
+            make_pod(
+                labels={"app": "db"},
+                anti_affinity=[PodAffinityTerm(label_selector=selector, topology_key=wk.ZONE_LABEL_KEY)],
+            )
+            for _ in range(5)
+        ]
+        results = s.solve(pods)
+        # Late committal (reference topology_test.go:2683): within one batch a
+        # new claim's zone isn't collapsed, so it conservatively blocks all
+        # zones — exactly one anti-affinity pod schedules per batch.
+        assert len(results.pod_errors) == 4
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 1
+
+    def test_pod_affinity_colocates(self):
+        from karpenter_tpu.kube import PodAffinityTerm
+
+        env = build_env()
+        s = make_scheduler(*env)
+        selector = {"matchLabels": {"app": "cache"}}
+        pods = [
+            make_pod(labels={"app": "cache"}, pod_affinity=[PodAffinityTerm(label_selector=selector, topology_key=wk.ZONE_LABEL_KEY)])
+            for _ in range(4)
+        ]
+        results = s.solve(pods)
+        assert results.all_pods_scheduled()
+        zones = set()
+        for nc in results.new_node_claims:
+            zones.update(nc.requirements.get(wk.ZONE_LABEL_KEY).values)
+        assert len(zones) == 1  # all in same zone
+
+
+class TestPreferences:
+    def test_preferred_affinity_relaxed(self):
+        env = build_env()
+        s = make_scheduler(*env)
+        pod = make_pod(preferred_affinity=[(10, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["mars"]}])])
+        results = s.solve([pod])
+        assert results.all_pods_scheduled()  # preference dropped
+
+    def test_preferred_affinity_respected_when_possible(self):
+        env = build_env()
+        s = make_scheduler(*env)
+        pod = make_pod(preferred_affinity=[(10, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-c"]}])])
+        results = s.solve([pod])
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        assert nc.requirements.get(wk.ZONE_LABEL_KEY).values == {"test-zone-c"}
+
+    def test_required_or_terms_fallback(self):
+        env = build_env()
+        s = make_scheduler(*env)
+        pod = make_pod(
+            required_affinity=[
+                [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["mars"]}],
+                [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}],
+            ]
+        )
+        results = s.solve([pod])
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        assert nc.requirements.get(wk.ZONE_LABEL_KEY).values == {"test-zone-a"}
+
+
+class TestLimitsAndWeights:
+    def test_nodepool_weight_ordering(self):
+        heavy = make_nodepool("heavy", requirements=LINUX_AMD64, weight=50, labels={"pool": "heavy"})
+        light = make_nodepool("light", requirements=LINUX_AMD64, weight=1, labels={"pool": "light"})
+        env = build_env([light, heavy])
+        s = make_scheduler(*env)
+        results = s.solve([make_pod()])
+        assert results.all_pods_scheduled()
+        assert results.new_node_claims[0].nodepool_name == "heavy"
+
+    def test_node_limit_enforced(self):
+        np = make_nodepool(requirements=LINUX_AMD64, limits={"nodes": "1"})
+        env = build_env([np])
+        s = make_scheduler(*env)
+        # force 2 nodes via hostname anti-affinity
+        selector = {"matchLabels": {"app": "x"}}
+        pods = [make_pod(labels={"app": "x"}, anti_affinity=[hostname_anti_affinity(selector)]) for _ in range(2)]
+        results = s.solve(pods)
+        assert len(results.new_node_claims) == 1
+        assert len(results.pod_errors) == 1
+
+    def test_cpu_limit_enforced(self):
+        np = make_nodepool(requirements=LINUX_AMD64, limits={"cpu": "2"})
+        env = build_env([np])
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(cpu="100")])
+        assert not results.all_pods_scheduled()
+
+
+class TestDaemonOverhead:
+    def test_daemon_overhead_reserved(self):
+        env = build_env()
+        daemon = make_pod(name="daemon", cpu="1")
+        s = make_scheduler(*env, daemons=[daemon])
+        results = s.solve([make_pod(cpu="1")])
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        # all surviving instance types must fit pod + daemon: > 2 cpu needed
+        # (1x types have 0.9 allocatable cpu and cannot hold 1+1)
+        assert all(it.capacity["cpu"].value >= 4 for it in nc.instance_type_options)
+
+
+class TestInstanceSelection:
+    def test_cheapest_types_survive(self):
+        env = build_env()
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(cpu="3")])
+        nc = results.new_node_claims[0]
+        api_nc = nc.to_api_node_claim()
+        req = next(r for r in api_nc.spec.requirements if r["key"] == wk.INSTANCE_TYPE_LABEL_KEY)
+        # price-ordered: first should be the smallest fitting type (c-4x is
+        # cheapest 4-cpu; 1x/2x don't fit 3 cpu + overhead)
+        assert req["values"][0].endswith("amd64-linux")
+        assert "c-4x-amd64-linux" == req["values"][0]
+
+    def test_min_values_strict_fails_when_unsatisfiable(self):
+        np = make_nodepool(
+            requirements=[
+                *LINUX_AMD64,
+                {
+                    "key": wk.INSTANCE_TYPE_LABEL_KEY,
+                    "operator": "In",
+                    "values": ["c-4x-amd64-linux"],
+                    "minValues": 2,
+                },
+            ]
+        )
+        env = build_env([np])
+        s = make_scheduler(*env)
+        results = s.solve([make_pod()])
+        assert not results.all_pods_scheduled()
+
+    def test_min_values_satisfiable(self):
+        np = make_nodepool(
+            requirements=[
+                *LINUX_AMD64,
+                {
+                    "key": wk.INSTANCE_TYPE_LABEL_KEY,
+                    "operator": "In",
+                    "values": ["c-4x-amd64-linux", "c-8x-amd64-linux"],
+                    "minValues": 2,
+                },
+            ]
+        )
+        env = build_env([np])
+        s = make_scheduler(*env)
+        results = s.solve([make_pod()])
+        assert results.all_pods_scheduled()

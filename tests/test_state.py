@@ -1,0 +1,122 @@
+"""Cluster/StateNode mirror behavior (reference: pkg/controllers/state suite)."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.kube import Container, Node, ObjectMeta, Pod, PodSpec
+from karpenter_tpu.kube.objects import NodeSpec, NodeStatus, OwnerReference
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.kube import Store
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.resources import parse_resource_list
+
+
+def mknode(name, pid=None, cpu="4", nodepool="default-pool"):
+    return Node(
+        metadata=ObjectMeta(name=name, labels={wk.NODEPOOL_LABEL_KEY: nodepool, wk.HOSTNAME_LABEL_KEY: name}),
+        spec=NodeSpec(provider_id=pid or f"kwok://{name}"),
+        status=NodeStatus(
+            capacity=parse_resource_list({"cpu": cpu, "memory": "8Gi", "pods": "110"}),
+            allocatable=parse_resource_list({"cpu": cpu, "memory": "7Gi", "pods": "110"}),
+        ),
+    )
+
+
+def mkpod(name, node_name="", cpu="1", ns="default", daemonset=False):
+    pod = Pod(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=PodSpec(
+            node_name=node_name,
+            containers=[Container(resources={"requests": parse_resource_list({"cpu": cpu})})],
+        ),
+    )
+    if daemonset:
+        pod.metadata.owner_references = [OwnerReference(kind="DaemonSet", name="ds", uid="u1")]
+    return pod
+
+
+class TestCluster:
+    def setup_method(self):
+        self.store = Store()
+        self.clock = FakeClock()
+        self.cluster = Cluster(self.store, self.clock)
+        start_informers(self.store, self.cluster)
+
+    def test_node_lifecycle(self):
+        self.store.create(mknode("n1"))
+        nodes = self.cluster.nodes()
+        assert len(nodes) == 1 and nodes[0].name() == "n1"
+        self.store.delete("Node", "n1")
+        assert self.cluster.nodes() == []
+
+    def test_pod_binding_updates_usage(self):
+        self.store.create(mknode("n1"))
+        self.store.create(mkpod("p1", node_name="n1", cpu="2"))
+        sn = self.cluster.node_for_name("n1")
+        assert sn.total_pod_requests()["cpu"].value == 2
+        assert sn.available()["cpu"].value == 2  # 4 - 2
+        self.store.delete("Pod", "p1")
+        sn = self.cluster.node_for_name("n1")
+        assert sn.available()["cpu"].value == 4
+
+    def test_daemonset_requests_tracked(self):
+        self.store.create(mknode("n1"))
+        self.store.create(mkpod("ds-pod", node_name="n1", cpu="1", daemonset=True))
+        sn = self.cluster.node_for_name("n1")
+        assert sn.total_daemon_requests()["cpu"].value == 1
+
+    def test_claim_then_node_pairing(self):
+        nc = NodeClaim(metadata=ObjectMeta(name="claim-1", labels={wk.NODEPOOL_LABEL_KEY: "default-pool"}))
+        nc.status.provider_id = "kwok://n1"
+        nc.status.capacity = parse_resource_list({"cpu": "4"})
+        self.store.create(nc)
+        assert len(self.cluster.nodes()) == 1
+        assert self.cluster.nodes()[0].node is None
+        # node arrives with same provider id -> same StateNode
+        self.store.create(mknode("n1", pid="kwok://n1"))
+        nodes = self.cluster.nodes()
+        assert len(nodes) == 1
+        assert nodes[0].node is not None and nodes[0].node_claim is not None
+
+    def test_pods_bound_before_node_known_are_replayed(self):
+        self.store.create(mkpod("p1", node_name="n1", cpu="2"))
+        self.store.create(mknode("n1"))
+        sn = self.cluster.node_for_name("n1")
+        assert sn.total_pod_requests()["cpu"].value == 2
+
+    def test_synced_gate(self):
+        assert self.cluster.synced()
+        nc = NodeClaim(metadata=ObjectMeta(name="c1"))
+        nc.status.provider_id = "kwok://nx"
+        self.store.create(nc)
+        assert self.cluster.synced()  # informer saw it
+
+    def test_marked_for_deletion_on_claim_deleting(self):
+        nc = NodeClaim(metadata=ObjectMeta(name="c1", finalizers=["karpenter.sh/termination"]))
+        nc.status.provider_id = "kwok://n1"
+        self.store.create(nc)
+        self.store.delete("NodeClaim", "c1")
+        assert self.cluster.nodes()[0].marked_for_deletion
+
+    def test_consolidated_timestamp(self):
+        self.cluster.mark_consolidated()
+        assert self.cluster.consolidated()
+        self.store.create(mkpod("p1"))  # any change invalidates
+        assert not self.cluster.consolidated()
+
+    def test_nodepool_resources(self):
+        self.store.create(mknode("n1", cpu="4"))
+        self.store.create(mknode("n2", cpu="8"))
+        total = self.cluster.nodepool_resources("default-pool")
+        assert total["cpu"].value == 12
+        assert self.cluster.nodepool_node_count("default-pool") == 2
+
+    def test_nomination_window(self):
+        self.store.create(mknode("n1"))
+        self.cluster.nominate_node("n1")
+        sn = self.cluster.node_for_name("n1")
+        assert sn.nominated(self.clock.now())
+        assert sn.validate_node_disruptable(self.clock.now()) is not None
+        self.clock.step(30)
+        sn = self.cluster.node_for_name("n1")
+        assert not sn.nominated(self.clock.now())
