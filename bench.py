@@ -1,0 +1,104 @@
+"""Benchmark: the TPU scheduling solver vs the reference's envelope.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's asserted scheduler throughput floor of 100 pods/sec
+(scheduling_benchmark_test.go:58) on its 10k-pod-scale scenarios.
+vs_baseline = our pods/sec / 100.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+
+
+def build_snapshot(n_pods: int, n_types: int):
+    from helpers import make_nodepool, make_pod, zone_spread
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.cloudprovider.fake import instance_types_assorted
+    from karpenter_tpu.kube import Store
+    from karpenter_tpu.solver.snapshot import SolverSnapshot
+    from karpenter_tpu.state import Cluster
+    from karpenter_tpu.state.informer import start_informers
+    from karpenter_tpu.utils.clock import FakeClock
+
+    LINUX = [
+        {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+        {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+    ]
+    rng = random.Random(0)
+    store, clock = Store(), FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    np_ = make_nodepool(requirements=LINUX)
+    store.create(np_)
+    sel = {"matchLabels": {"app": "web"}}
+    pods = []
+    for _ in range(n_pods):
+        k = rng.random()
+        if k < 0.6:
+            pods.append(make_pod(cpu=rng.choice(["250m", "500m", "1", "2"]), memory=rng.choice(["512Mi", "1Gi", "2Gi"])))
+        elif k < 0.8:
+            pods.append(make_pod(cpu="1", memory="1Gi", labels={"app": "web"}, tsc=[zone_spread(selector=sel)]))
+        else:
+            pods.append(make_pod(cpu="1", node_selector={wk.ZONE_LABEL_KEY: rng.choice(["test-zone-a", "test-zone-b"])}))
+    return SolverSnapshot(
+        store=store,
+        cluster=cluster,
+        node_pools=[np_],
+        instance_types={np_.metadata.name: instance_types_assorted(n_types)},
+        state_nodes=[],
+        daemonset_pods=[],
+        pods=pods,
+        clock=clock,
+    )
+
+
+def main():
+    from karpenter_tpu.models.scheduler_model import greedy_pack, make_tensors
+    from karpenter_tpu.solver.encode import encode
+
+    n_pods = int(os.environ.get("BENCH_PODS", "10000"))
+    n_types = int(os.environ.get("BENCH_TYPES", "100"))
+    snap = build_snapshot(n_pods, n_types)
+    enc = encode(snap)
+    assert not enc.fallback_reasons, enc.fallback_reasons
+    t = make_tensors(enc, n_slots=enc.n_existing + min(n_pods, 4096))
+
+    # warmup/compile
+    out = greedy_pack(t)
+    out[0].block_until_ready()
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = greedy_pack(t)
+        out[0].block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+
+    import numpy as np
+
+    scheduled = int((np.asarray(out[0]) >= 0).sum())
+    assert scheduled == n_pods, f"only {scheduled}/{n_pods} scheduled"
+    pods_per_sec = n_pods / best
+    print(
+        json.dumps(
+            {
+                "metric": f"schedule_{n_pods}pods_x_{n_types}types_pods_per_sec",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/sec",
+                "vs_baseline": round(pods_per_sec / 100.0, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -132,10 +132,13 @@ def instance_types_assorted(count: int = 400) -> list[InstanceType]:
         ]
     )
     seen = set()
-    zones_cycle = itertools.cycle([["test-zone-a"], ["test-zone-b"], ["test-zone-a", "test-zone-b"], catalog.ZONES])
+    zone_opts = [["test-zone-a"], ["test-zone-b"], ["test-zone-a", "test-zone-b"], catalog.ZONES]
     while len(out) < count:
         f, c, a, o = next(combos)
-        zones = next(zones_cycle)
+        # mix a div-4 term in so zone variety survives the period-4 arch/os
+        # cycle (a pure linear index collapses on multiples of 4)
+        i = len(out)
+        zones = zone_opts[(i + i // 4) % len(zone_opts)]
         key = (f, c, a, o, tuple(zones))
         it = catalog.make_instance_type(f, c, a, o, zones=zones)
         if key in seen:

@@ -116,7 +116,12 @@ class SchedulingNodeClaim:
         )
         if relax_min_values:
             for key, mv in unsatisfiable.items():
-                base.get(key).min_values = mv
+                # copy-on-write: base aliases Requirement objects owned by the
+                # template; mutating in place would relax minValues for every
+                # subsequent claim in the solve
+                relaxed = base.get(key).copy()
+                relaxed.min_values = mv
+                base.replace(relaxed)
         if ferr is not None:
             return None, None, ferr
         return base, remaining, None
@@ -163,6 +168,15 @@ class SchedulingNodeClaim:
             reqs.add(Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", cts))
 
         tmpl = self.template
+        # include daemon overhead in the claim's resource requests (reference
+        # FinalizeScheduling -> addDaemonRequests): take the overhead of the
+        # group backing the cheapest surviving instance type
+        spec_requests = dict(self.spec_requests)
+        surviving = {id(x) for x in its}
+        for g in self.daemon_overhead_groups:
+            if any(id(x) in surviving for x in g.instance_types):
+                spec_requests = res.merge(spec_requests, g.daemon_overhead)
+                break
         req_dicts = [d for r in reqs.values() for d in _req_to_dicts(r)]
         # keep the instance-type values price-ordered (cheapest first) so
         # downstream pickers and truncation see the intended preference
@@ -180,7 +194,7 @@ class SchedulingNodeClaim:
                 taints=list(tmpl.taints),
                 startup_taints=list(tmpl.startup_taints),
                 requirements=req_dicts,
-                resources=dict(self.spec_requests),
+                resources=spec_requests,
                 node_class_ref=NodeClassReference(**tmpl.node_pool.spec.template.node_class_ref)
                 if isinstance(tmpl.node_pool.spec.template.node_class_ref, dict)
                 else tmpl.node_pool.spec.template.node_class_ref,

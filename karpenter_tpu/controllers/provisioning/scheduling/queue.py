@@ -7,9 +7,12 @@ Pop stops when the queue cycles without progress.
 from __future__ import annotations
 
 
+from collections import deque
+
+
 class Queue:
     def __init__(self, pods: list, pod_data: dict):
-        self.pods = sorted(pods, key=lambda p: _sort_key(p, pod_data))
+        self.pods = deque(sorted(pods, key=lambda p: _sort_key(p, pod_data)))
         self._last_len: dict[str, int] = {}
 
     def pop(self):
@@ -18,7 +21,7 @@ class Queue:
         p = self.pods[0]
         if self._last_len.get(p.metadata.uid) == len(self.pods):
             return None  # cycled through with no progress
-        self.pods = self.pods[1:]
+        self.pods.popleft()
         return p
 
     def push(self, pod) -> None:

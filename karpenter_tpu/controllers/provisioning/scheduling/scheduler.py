@@ -238,7 +238,8 @@ class Scheduler:
     def _add_to_inflight_node(self, pod) -> str | None:
         pod_data = self.cached_pod_data[pod.metadata.uid]
         for nc in self.new_node_claims:
-            reqs, its, err = nc.can_add(pod, pod_data, relax_min_values=(self.min_values_policy == "BestEffort"))
+            # in-flight claims never relax minValues (scheduler.go:669)
+            reqs, its, err = nc.can_add(pod, pod_data, relax_min_values=False)
             if err is None:
                 nc.add(pod, pod_data, reqs, its)
                 return None
@@ -299,14 +300,32 @@ def _compute_daemon_overhead_groups(template: NodeClaimTemplate, daemonset_pods:
     return list(groups.values())
 
 
+def _daemon_requirement_alternatives(daemon_pod) -> list[Requirements]:
+    """Node-selector + each required node-affinity OR-term — the reference
+    relaxes daemons across all OR-terms (isDaemonPodCompatible,
+    scheduler.go:1023-1040), so a daemon counts if ANY term matches."""
+    base = Requirements.from_labels(daemon_pod.spec.node_selector)
+    aff = daemon_pod.spec.affinity.node_affinity if daemon_pod.spec.affinity else None
+    if aff is None or not aff.required:
+        return [base]
+    out = []
+    for term in aff.required:
+        r = base.copy()
+        r.add(*Requirements.from_node_selector_terms(term).values())
+        out.append(r)
+    return out
+
+
 def _daemon_compatible_with_instance_type(template: NodeClaimTemplate, it, daemon_pod) -> bool:
     if taints_tolerate_pod(template.taints, daemon_pod) is not None:
         return False
     reqs = Requirements()
     reqs.add(*template.requirements.values())
     reqs.add(*it.requirements.values())
-    pod_reqs = Requirements.from_pod(daemon_pod, strict=True)
-    if reqs.compatible(pod_reqs, allow_undefined=wk.WELL_KNOWN_LABELS) is not None:
+    if not any(
+        reqs.compatible(alt, allow_undefined=wk.WELL_KNOWN_LABELS) is None
+        for alt in _daemon_requirement_alternatives(daemon_pod)
+    ):
         return False
     return res.fits(res.pod_requests(daemon_pod), it.allocatable())
 
@@ -315,8 +334,7 @@ def _daemon_compatible_with_node(sn, taints, daemon_pod) -> bool:
     if taints_tolerate_pod(taints, daemon_pod) is not None:
         return False
     node_reqs = Requirements.from_labels(sn.labels())
-    pod_reqs = Requirements.from_pod(daemon_pod, strict=True)
-    return node_reqs.compatible(pod_reqs) is None
+    return any(node_reqs.compatible(alt) is None for alt in _daemon_requirement_alternatives(daemon_pod))
 
 
 def _filter_by_remaining_resources(its: list, remaining: dict[str, Quantity]) -> list:

@@ -1,0 +1,4 @@
+"""Jittable solver cores (the "models" of this framework): the greedy packer
+for provisioning and the annealed repacker for consolidation."""
+
+from .scheduler_model import SchedulerTensors, greedy_pack, make_tensors  # noqa: F401

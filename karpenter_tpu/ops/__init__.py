@@ -1,0 +1,4 @@
+"""Low-level JAX kernels: packed bitsets, masked argmin/first-fit selection."""
+
+from .bitset import pack_bool_masks, test_bit  # noqa: F401
+from .select import first_true_index, masked_argmin  # noqa: F401

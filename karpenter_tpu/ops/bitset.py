@@ -1,0 +1,44 @@
+"""Packed uint32 bitset kernels.
+
+A Requirement is a membership mask over an interned value vocabulary
+(SURVEY.md §7 stage 1); we store masks packed 32 values per uint32 lane so a
+pod's full requirement set is a [K, W] uint32 block and membership tests are
+gather + shift on the VPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover - host-only paths
+    jnp = None
+
+
+def words_for(n_values: int) -> int:
+    return max(1, (n_values + 31) // 32)
+
+
+def pack_bool_masks(bools: np.ndarray) -> np.ndarray:
+    """[..., V] bool -> [..., ceil(V/32)] uint32 (little-endian bit order)."""
+    *lead, v = bools.shape
+    w = words_for(v)
+    padded = np.zeros((*lead, w * 32), dtype=bool)
+    padded[..., :v] = bools
+    r = padded.reshape(*lead, w, 32)
+    weights = (1 << np.arange(32, dtype=np.uint64)).astype(np.uint64)
+    packed = (r.astype(np.uint64) * weights).sum(axis=-1)
+    return packed.astype(np.uint32)
+
+
+def test_bit(masks, idx):
+    """masks: [..., W] uint32; idx: [...] int32 value ids -> [...] bool.
+
+    Gathers the word then tests the bit; idx < 0 returns False.
+    """
+    word_idx = jnp.clip(idx // 32, 0, masks.shape[-1] - 1)
+    bit_idx = (idx % 32).astype(jnp.uint32)
+    words = jnp.take_along_axis(masks, word_idx[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    hit = (words >> bit_idx) & jnp.uint32(1)
+    return jnp.where(idx >= 0, hit.astype(bool), False)

@@ -1,0 +1,25 @@
+"""Masked selection kernels for the greedy packer."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+BIG = jnp.float32(3.4e38)
+
+
+def first_true_index(mask):
+    """Lowest index where mask is True, else -1 (first-fit order)."""
+    n = mask.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    cand = jnp.where(mask, idx, n)
+    best = jnp.min(cand)
+    return jnp.where(best < n, best, -1).astype(jnp.int32)
+
+
+def masked_argmin(values, mask):
+    """Index of the minimum value among mask==True (ties -> lowest index),
+    else -1."""
+    n = values.shape[0]
+    v = jnp.where(mask, values, BIG)
+    best = jnp.argmin(v)  # argmin returns first occurrence on ties
+    return jnp.where(mask[best], best.astype(jnp.int32), jnp.int32(-1))

@@ -1,0 +1,9 @@
+"""Device-mesh sharding of the solver (multi-chip growth path).
+
+SURVEY.md §5: the reference's only scale axis is problem size per solve; on
+TPU that axis becomes the batch dimension of the feasibility tensor, sharded
+over a `jax.sharding.Mesh` when it outgrows one chip (the scaling-book recipe:
+pick a mesh, annotate shardings, let XLA insert collectives).
+"""
+
+from .sharded import sharded_compat_matrix, dryrun_step  # noqa: F401

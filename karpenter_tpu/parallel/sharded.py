@@ -1,0 +1,80 @@
+"""Sharded solver stages over a jax.sharding.Mesh.
+
+The provisioning solve has two parallelizable stages:
+
+1. the pod x row compatibility matrix — embarrassingly parallel over pods
+   (data-parallel axis "pods") and rows (model-parallel axis "rows");
+2. the greedy pack scan — sequential over pods, but its per-step vector work
+   (slot feasibility, row feasibility) shards over the "rows"/slot axis with
+   psum/all_gather reductions for the argmin choices.
+
+On one v5e chip none of this is needed (SURVEY.md §5: the solver is
+single-chip for the v0 target); this module is the ICI growth path and the
+driver's multi-chip dry-run target.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.scheduler_model import SchedulerTensors, greedy_pack
+from ..ops.bitset import test_bit
+
+
+def make_mesh(devices=None, axis: str = "pods") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+def sharded_compat_matrix(t: SchedulerTensors, mesh: Mesh):
+    """Pod x row compatibility, data-parallel over the pods axis.
+
+    Pods shard across devices; row tensors are replicated. XLA inserts no
+    collectives in the forward pass (pure map); the all_gather happens only
+    if the caller requests a fully-replicated result.
+    """
+    P_, K, W = t.pod_mask.shape
+    axis = mesh.axis_names[0]
+    pod_sharding = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+
+    n_dev = mesh.size
+    pad = (-P_) % n_dev
+    pod_mask = jnp.pad(t.pod_mask, ((0, pad), (0, 0), (0, 0)))
+    pod_taint_ok = jnp.pad(t.pod_taint_ok, ((0, pad), (0, 0)), constant_values=False)
+    pod_mask = jax.device_put(pod_mask, pod_sharding)
+    pod_taint_ok = jax.device_put(pod_taint_ok, pod_sharding)
+    row_labels = jax.device_put(t.row_labels, rep)
+    row_taint_class = jax.device_put(t.row_taint_class, rep)
+    zone_key = t.zone_key
+
+    @jax.jit
+    def compute(pod_mask, pod_taint_ok, row_labels, row_taint_class):
+        def one(mask_k_w, taint_ok_c):
+            vids = row_labels
+            masks = jnp.broadcast_to(mask_k_w[None, :, :], (vids.shape[0],) + mask_k_w.shape)
+            ok = test_bit(masks, vids)
+            if zone_key >= 0:
+                ok = ok.at[:, zone_key].set(True)
+            return jnp.all(ok, axis=1) & taint_ok_c[row_taint_class]
+
+        return jax.vmap(one)(pod_mask, pod_taint_ok)
+
+    out = compute(pod_mask, pod_taint_ok, row_labels, row_taint_class)
+    return out[:P_]
+
+
+def dryrun_step(t: SchedulerTensors, mesh: Mesh):
+    """One full sharded solve step: sharded compat + the pack scan.
+
+    This is the driver's multi-chip validation entry: it must compile and
+    execute under an N-device mesh with real shardings.
+    """
+    compat = sharded_compat_matrix(t, mesh)
+    compat.block_until_ready()
+    out = greedy_pack(t)
+    out[0].block_until_ready()
+    return out

@@ -1,0 +1,14 @@
+"""The Solver plugin point (BASELINE.json north star).
+
+`Solver.solve(snapshot) -> Results` sits beside the CloudProvider SPI on the
+provisioning controller. Two implementations:
+
+- `ffd.FFDSolver` — the exact host scheduler (default, correctness oracle)
+- `tpu.TPUSolver` — batched tensor solver on TPU via JAX; handles the common
+  constraint families (resources, requirements/taints compatibility, zonal
+  topology spread, hostname spread/anti-affinity) and falls back to FFD when a
+  pod uses constraints outside the tensor subset.
+"""
+
+from .ffd import FFDSolver  # noqa: F401
+from .snapshot import SolverSnapshot  # noqa: F401

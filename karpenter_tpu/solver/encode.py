@@ -1,0 +1,486 @@
+"""Snapshot -> tensor lowering for the TPU solver.
+
+The critical insight (SURVEY.md §7 stage 1): a Requirement's set/complement/
+integer-bound representation (reference requirement.go:36-110) is exactly
+encodable as a fixed-width membership bitmask over an interned (label, value)
+vocabulary. This module builds that vocabulary and lowers:
+
+- candidate "rows" (existing nodes + (template x instance type x offering))
+  to label-value-id vectors, allocatable vectors, prices, taint classes;
+- pods to request vectors and packed requirement bitmasks;
+- the supported topology constraint families (zonal spread, hostname spread,
+  hostname anti-affinity) to group membership matrices and count tensors.
+
+Pods/snapshots outside the supported subset report a fallback reason and the
+solve is handled by the host FFD path (the reference-behavior oracle).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..apis import labels as wk
+from ..controllers.provisioning.scheduling.nodeclaim import NodeClaimTemplate
+from ..controllers.provisioning.scheduling.scheduler import (
+    _compute_daemon_overhead_groups,
+    _daemon_compatible_with_node,
+    _template_compatible,
+)
+from ..kube.objects import match_label_selector
+from ..ops.bitset import pack_bool_masks, words_for
+from ..scheduling.requirements import Operator, Requirements
+from ..scheduling.taints import taints_tolerate_pod
+from ..utils import pods as pod_utils
+from ..utils import resources as res
+from ..utils.quantity import Quantity
+
+ABSENT = 0  # reserved value id per key: "row does not define this label"
+
+KIND_ZONE_SPREAD = 0
+KIND_HOST_SPREAD = 1
+KIND_HOST_ANTI = 2
+
+
+class Vocabulary:
+    """Interning of label keys and per-key values (value id 0 = absent)."""
+
+    def __init__(self):
+        self.keys: dict[str, int] = {}
+        self.values: list[dict[str, int]] = []  # per key: value -> id (>=1)
+
+    def key_id(self, key: str) -> int:
+        kid = self.keys.get(key)
+        if kid is None:
+            kid = len(self.keys)
+            self.keys[key] = kid
+            self.values.append({})
+        return kid
+
+    def value_id(self, key: str, value: str) -> int:
+        kid = self.key_id(key)
+        vals = self.values[kid]
+        vid = vals.get(value)
+        if vid is None:
+            vid = len(vals) + 1  # 0 is reserved for absent
+            vals[value] = vid
+        return vid
+
+    @property
+    def n_keys(self) -> int:
+        return len(self.keys)
+
+    def max_values(self) -> int:
+        return max((len(v) + 1 for v in self.values), default=1)
+
+
+@dataclass
+class EncodedSnapshot:
+    """All tensors the device solver consumes (numpy, host-built)."""
+
+    resource_names: list[str]
+    vocab: Vocabulary
+
+    # rows: existing nodes [0, n_existing) then offerings
+    n_existing: int
+    row_alloc: np.ndarray  # [Nrows, R] f32
+    row_price: np.ndarray  # [Nrows] f32
+    row_labels: np.ndarray  # [Nrows, K] i32 (value id, ABSENT=0)
+    row_zone: np.ndarray  # [Nrows] i32 zone domain id, -1 if none
+    row_pool_rank: np.ndarray  # [Nrows] i32 (0 = highest weight; existing = -1)
+    row_taint_class: np.ndarray  # [Nrows] i32
+    row_meta: list  # per row: ("existing", state_node) | ("offering", template, it, offering)
+
+    # pods (already FFD-sorted)
+    pods: list
+    pod_req: np.ndarray  # [P, R] f32
+    pod_mask: np.ndarray  # [P, K, W] uint32
+    pod_taint_ok: np.ndarray  # [P, C] bool
+    pod_zone_allowed: np.ndarray  # [P, Z] bool
+
+    # topology groups
+    n_zones: int
+    zone_names: list[str]
+    rank_zoneset: np.ndarray  # [Q, Z] bool — zones each template offers
+    zone_key_id: int
+    group_kind: np.ndarray  # [G] i32
+    group_skew: np.ndarray  # [G] i32
+    member: np.ndarray  # [P, G] bool
+    counts_zone_init: np.ndarray  # [G, Z] i32
+    counts_host_existing: np.ndarray  # [G, n_existing] i32
+
+    fallback_reasons: list[str] = field(default_factory=list)
+
+    @property
+    def n_rows(self) -> int:
+        return self.row_alloc.shape[0]
+
+    @property
+    def n_pods(self) -> int:
+        return len(self.pods)
+
+    @property
+    def n_groups(self) -> int:
+        return self.group_kind.shape[0]
+
+
+def check_capability(snap) -> list[str]:
+    """Reasons the snapshot cannot run on the tensor path (empty = OK)."""
+    reasons = []
+    if snap.min_values_policy != "Strict":
+        pass  # relaxation happens host-side per claim decode; fine
+    for np_ in snap.node_pools:
+        reqs = Requirements.from_node_selector_terms(np_.spec.template.requirements)
+        if reqs.has_min_values():
+            reasons.append("nodepool uses minValues")
+            break
+    for pod in snap.pods:
+        aff = pod.spec.affinity
+        if aff is not None:
+            if aff.pod_affinity_required or aff.pod_affinity_preferred:
+                reasons.append(f"{pod.key()}: pod affinity")
+                break
+            if any(t.topology_key != wk.HOSTNAME_LABEL_KEY for t in aff.pod_anti_affinity_required):
+                reasons.append(f"{pod.key()}: non-hostname anti-affinity")
+                break
+            if aff.pod_anti_affinity_preferred:
+                reasons.append(f"{pod.key()}: preferred anti-affinity")
+                break
+            na = aff.node_affinity
+            if na is not None and (na.preferred or len(na.required) > 1):
+                reasons.append(f"{pod.key()}: relaxable node affinity")
+                break
+        for tsc in pod.spec.topology_spread_constraints:
+            if tsc.when_unsatisfiable != "DoNotSchedule":
+                reasons.append(f"{pod.key()}: ScheduleAnyway spread")
+                break
+            if tsc.topology_key not in (wk.ZONE_LABEL_KEY, wk.HOSTNAME_LABEL_KEY):
+                reasons.append(f"{pod.key()}: spread key {tsc.topology_key}")
+                break
+            if tsc.min_domains is not None or tsc.node_taints_policy == "Honor":
+                reasons.append(f"{pod.key()}: spread policies")
+                break
+            if tsc.node_affinity_policy == "Honor" and (pod.spec.node_selector or (aff and aff.node_affinity)):
+                # node-filtered counting not tensorized yet
+                reasons.append(f"{pod.key()}: node-filtered spread counting")
+                break
+        else:
+            from ..scheduling.hostports import pod_host_ports
+
+            if pod_host_ports(pod):
+                reasons.append(f"{pod.key()}: host ports")
+                break
+            continue
+        break
+    # inverse anti-affinity from already-running pods isn't tensorized
+    if snap.cluster.pods_with_anti_affinity():
+        reasons.append("cluster has running pods with required anti-affinity")
+    return reasons
+
+
+def encode(snap) -> EncodedSnapshot:
+    vocab = Vocabulary()
+    reasons = check_capability(snap)
+
+    # -- resource axis ---------------------------------------------------------
+    rnames = ["cpu", "memory", "pods", "ephemeral-storage"]
+    seen = set(rnames)
+    for pod in snap.pods:
+        for k in res.pod_requests(pod):
+            if k not in seen:
+                seen.add(k)
+                rnames.append(k)
+    ridx = {k: i for i, k in enumerate(rnames)}
+    R = len(rnames)
+
+    def rl_to_vec(rl: dict) -> np.ndarray:
+        v = np.zeros(R, dtype=np.float32)
+        for k, q in rl.items():
+            i = ridx.get(k)
+            if i is not None:
+                v[i] = _scale(k, q)
+        return v
+
+    # -- zone vocabulary (index 0 reserved: "row has no zone label") -----------
+    zone_names: list[str] = [""]
+    zone_ids: dict[str, int] = {"": 0}
+
+    def zone_id(z: str) -> int:
+        if z not in zone_ids:
+            zone_ids[z] = len(zone_names)
+            zone_names.append(z)
+        return zone_ids[z]
+
+    # -- taint classes ---------------------------------------------------------
+    taint_classes: dict[tuple, int] = {}
+    taint_sets: list[list] = []
+
+    def taint_class(taints) -> int:
+        key = tuple(sorted((t.key, t.value, t.effect) for t in taints))
+        c = taint_classes.get(key)
+        if c is None:
+            c = len(taint_sets)
+            taint_classes[key] = c
+            taint_sets.append(list(taints))
+        return c
+
+    # -- templates (weight-ordered like the scheduler) -------------------------
+    pools = sorted(snap.node_pools, key=lambda p: (-p.spec.weight, p.metadata.name))
+    templates: list[NodeClaimTemplate] = []
+    for np_ in pools:
+        t = NodeClaimTemplate(np_)
+        its = [it for it in snap.instance_types.get(np_.metadata.name, []) if _template_compatible(t, it)]
+        if its:
+            t.instance_type_options = its
+            templates.append(t)
+
+    # -- rows ------------------------------------------------------------------
+    row_alloc_l, row_price_l, row_labels_l, row_zone_l = [], [], [], []
+    row_rank_l, row_taint_l, row_meta = [], [], []
+
+    def intern_labels(labels: dict[str, str]) -> dict[int, int]:
+        return {vocab.key_id(k): vocab.value_id(k, v) for k, v in labels.items()}
+
+    # existing nodes first
+    state_nodes = sorted(snap.state_nodes, key=lambda n: n.name())
+    for sn in state_nodes:
+        remaining = res.subtract(sn.allocatable(), sn.total_pod_requests())
+        daemons = [d for d in snap.daemonset_pods if _daemon_compatible_with_node(sn, sn.taints(), d)]
+        headroom = res.subtract(res.requests_for_pods(daemons), sn.total_daemon_requests())
+        headroom = {k: v for k, v in headroom.items() if v.milli > 0}
+        remaining = res.subtract(remaining, headroom)
+        row_alloc_l.append(rl_to_vec(remaining))
+        row_price_l.append(0.0)
+        row_labels_l.append(intern_labels(sn.labels()))
+        z = sn.labels().get(wk.ZONE_LABEL_KEY)
+        row_zone_l.append(zone_id(z) if z else 0)
+        row_rank_l.append(-1)
+        row_taint_l.append(taint_class(sn.taints()))
+        row_meta.append(("existing", sn))
+
+    n_existing = len(row_meta)
+
+    for rank, t in enumerate(templates):
+        groups = _compute_daemon_overhead_groups(t, snap.daemonset_pods)
+        overhead_by_it = {}
+        for g in groups:
+            for it in g.instance_types:
+                overhead_by_it[id(it)] = g.daemon_overhead
+        tmpl_label_ids = intern_labels(t.labels)
+        tclass = taint_class(t.taints)
+        for it in t.instance_type_options:
+            it_label_ids = dict(tmpl_label_ids)
+            for key, r in it.requirements.items():
+                if r.operator() == Operator.IN and len(r.values) == 1:
+                    it_label_ids[vocab.key_id(key)] = vocab.value_id(key, r.any())
+            alloc = res.subtract(it.allocatable(), overhead_by_it.get(id(it), {}))
+            alloc_vec = rl_to_vec({k: v for k, v in alloc.items() if v.milli > 0})
+            for o in it.offerings:
+                if not o.available:
+                    continue
+                if t.requirements.intersects(o.requirements) is not None:
+                    continue
+                labels_o = dict(it_label_ids)
+                for key, r in o.requirements.items():
+                    if r.operator() == Operator.IN and len(r.values) == 1:
+                        labels_o[vocab.key_id(key)] = vocab.value_id(key, r.any())
+                row_alloc_l.append(alloc_vec)
+                row_price_l.append(o.price)
+                row_labels_l.append(labels_o)
+                z = o.zone()
+                row_zone_l.append(zone_id(z) if z else 0)
+                row_rank_l.append(rank)
+                row_taint_l.append(tclass)
+                row_meta.append(("offering", t, it, o))
+
+    n_rows = len(row_meta)
+    K = max(vocab.n_keys, 1)
+    row_labels = np.zeros((n_rows, K), dtype=np.int32)
+    for i, lbl in enumerate(row_labels_l):
+        for kid, vid in lbl.items():
+            row_labels[i, kid] = vid
+
+    # -- pods ------------------------------------------------------------------
+    # FFD order (queue.py): cpu desc, mem desc, creation, uid
+    def ffd_key(pod):
+        r = res.pod_requests(pod)
+        return (
+            -(r.get("cpu", Quantity(0)).milli),
+            -(r.get("memory", Quantity(0)).milli),
+            pod.metadata.creation_timestamp,
+            pod.metadata.uid,
+        )
+
+    pods = sorted(snap.pods, key=ffd_key)
+    P = len(pods)
+    pod_req = np.zeros((P, R), dtype=np.float32)
+    pod_requirements: list[Requirements] = []
+    for i, pod in enumerate(pods):
+        pod_req[i] = rl_to_vec(res.pod_requests(pod))
+        pod_requirements.append(Requirements.from_pod(pod, strict=True))
+
+    # vocabulary must be closed before masks are sized; pod requirement values
+    # not present on any row still need ids (they simply never match)
+    for reqs in pod_requirements:
+        for key, r in reqs.items():
+            vocab.key_id(key)
+            for v in r.values:
+                vocab.value_id(key, v)
+
+    K = vocab.n_keys
+    Vmax = vocab.max_values()
+    W = words_for(Vmax)
+    # re-pad row_labels to the final K
+    if row_labels.shape[1] < K:
+        row_labels = np.pad(row_labels, ((0, 0), (0, K - row_labels.shape[1])))
+
+    bool_masks = np.ones((P, K, Vmax), dtype=bool)
+    for i, reqs in enumerate(pod_requirements):
+        for key, r in reqs.items():
+            kid = vocab.keys[key]
+            vals = vocab.values[kid]
+            allowed = np.zeros(Vmax, dtype=bool)
+            # absent-value semantics: row lacking the key is compatible iff the
+            # operator permits absence (NotIn/DoesNotExist) or the key is
+            # well-known (requirements.go:181-199 Compatible w/ AllowUndefined)
+            op = r.operator()
+            absent_ok = op in (Operator.NOT_IN, Operator.DOES_NOT_EXIST) or key in wk.WELL_KNOWN_LABELS
+            allowed[ABSENT] = absent_ok
+            for value, vid in vals.items():
+                allowed[vid] = r.has(value)
+            bool_masks[i, kid] = allowed
+    pod_mask = pack_bool_masks(bool_masks)
+
+    C = len(taint_sets)
+    pod_taint_ok = np.ones((P, C), dtype=bool)
+    for i, pod in enumerate(pods):
+        for c, taints in enumerate(taint_sets):
+            pod_taint_ok[i, c] = taints_tolerate_pod(taints, pod) is None
+
+    Z = len(zone_names)
+    pod_zone_allowed = np.ones((P, Z), dtype=bool)
+    for i, reqs in enumerate(pod_requirements):
+        if reqs.has(wk.ZONE_LABEL_KEY):
+            r = reqs.get(wk.ZONE_LABEL_KEY)
+            for z, zid in zone_ids.items():
+                if zid == 0:
+                    # "no zone label": zone is well-known, so an absent label is
+                    # only acceptable for complement operators
+                    pod_zone_allowed[i, 0] = r.operator() in (Operator.NOT_IN, Operator.DOES_NOT_EXIST)
+                else:
+                    pod_zone_allowed[i, zid] = r.has(z)
+
+    # zones offered per template rank
+    n_ranks = max(len(templates), 1)
+    rank_zoneset = np.zeros((n_ranks, Z), dtype=bool)
+    for i in range(n_existing, n_rows):
+        rank_zoneset[row_rank_l[i], row_zone_l[i]] = True
+
+    zone_key_id = vocab.keys.get(wk.ZONE_LABEL_KEY, -1)
+
+    # -- topology groups -------------------------------------------------------
+    group_defs: dict[tuple, dict] = {}  # identity -> {kind, skew}
+    memberships: list[tuple[int, tuple]] = []  # (pod idx, identity)
+    for i, pod in enumerate(pods):
+        for tsc in pod.spec.topology_spread_constraints:
+            kind = KIND_ZONE_SPREAD if tsc.topology_key == wk.ZONE_LABEL_KEY else KIND_HOST_SPREAD
+            ident = (kind, tsc.max_skew, _sel_key(tsc.label_selector), pod.metadata.namespace)
+            group_defs.setdefault(ident, {"kind": kind, "skew": tsc.max_skew, "selector": tsc.label_selector, "ns": pod.metadata.namespace})
+            memberships.append((i, ident))
+        aff = pod.spec.affinity
+        if aff is not None:
+            for term in aff.pod_anti_affinity_required:
+                ident = (KIND_HOST_ANTI, 0, _sel_key(term.label_selector), pod.metadata.namespace)
+                group_defs.setdefault(ident, {"kind": KIND_HOST_ANTI, "skew": 0, "selector": term.label_selector, "ns": pod.metadata.namespace})
+                memberships.append((i, ident))
+
+    idents = list(group_defs.keys())
+    gidx = {ident: g for g, ident in enumerate(idents)}
+    G = len(idents)
+    group_kind = np.array([group_defs[i]["kind"] for i in idents], dtype=np.int32) if G else np.zeros(0, np.int32)
+    group_skew = np.array([group_defs[i]["skew"] for i in idents], dtype=np.int32) if G else np.zeros(0, np.int32)
+    member = np.zeros((P, G), dtype=bool)
+    # membership = the group's selector selects the pod (counting), which for
+    # these families equals the pod that declared it; also match other pods
+    # selected by the same selector
+    for g, ident in enumerate(idents):
+        d = group_defs[ident]
+        for i, pod in enumerate(pods):
+            if pod.metadata.namespace == d["ns"] and d["selector"] is not None and match_label_selector(d["selector"], pod.metadata.labels):
+                member[i, g] = True
+    for i, ident in memberships:
+        member[i, gidx[ident]] = True
+
+    # initial counts from already-scheduled cluster pods
+    counts_zone_init = np.zeros((G, Z), dtype=np.int32)
+    counts_host_existing = np.zeros((G, max(n_existing, 1)), dtype=np.int32)
+    if G:
+        node_by_name = {sn.name(): j for j, sn in enumerate(state_nodes)}
+        scheduled = [p for p in snap.store.list("Pod") if p.spec.node_name and pod_utils.is_active(p)]
+        solve_uids = {p.metadata.uid for p in pods}
+        for p in scheduled:
+            if p.metadata.uid in solve_uids:
+                continue
+            for g, ident in enumerate(idents):
+                d = group_defs[ident]
+                if p.metadata.namespace != d["ns"] or d["selector"] is None:
+                    continue
+                if not match_label_selector(d["selector"], p.metadata.labels):
+                    continue
+                node = snap.store.try_get("Node", p.spec.node_name)
+                if node is None:
+                    continue
+                if group_kind[g] == KIND_ZONE_SPREAD:
+                    z = node.metadata.labels.get(wk.ZONE_LABEL_KEY)
+                    if z is not None and z in zone_ids:
+                        counts_zone_init[g, zone_ids[z]] += 1
+                else:
+                    j = node_by_name.get(p.spec.node_name)
+                    if j is not None:
+                        counts_host_existing[g, j] += 1
+
+    return EncodedSnapshot(
+        resource_names=rnames,
+        vocab=vocab,
+        n_existing=n_existing,
+        row_alloc=np.stack(row_alloc_l) if row_alloc_l else np.zeros((0, R), np.float32),
+        row_price=np.array(row_price_l, dtype=np.float32),
+        row_labels=row_labels,
+        row_zone=np.array(row_zone_l, dtype=np.int32),
+        row_pool_rank=np.array(row_rank_l, dtype=np.int32),
+        row_taint_class=np.array(row_taint_l, dtype=np.int32),
+        row_meta=row_meta,
+        pods=pods,
+        pod_req=pod_req,
+        pod_mask=pod_mask,
+        pod_taint_ok=pod_taint_ok,
+        pod_zone_allowed=pod_zone_allowed,
+        n_zones=Z,
+        zone_names=zone_names,
+        rank_zoneset=rank_zoneset,
+        zone_key_id=zone_key_id,
+        group_kind=group_kind,
+        group_skew=group_skew,
+        member=member,
+        counts_zone_init=counts_zone_init,
+        counts_host_existing=counts_host_existing,
+        fallback_reasons=reasons,
+    )
+
+
+def _scale(resource: str, q: Quantity) -> float:
+    """Exact-in-f32 scaling: cpu stays in milli; memory/storage in MiB."""
+    if resource in ("memory", "ephemeral-storage"):
+        return q.milli / 1000.0 / (1024.0**2)
+    return float(q.milli)
+
+
+def _sel_key(selector) -> tuple:
+    if selector is None:
+        return ()
+    ml = tuple(sorted((selector.get("matchLabels") or {}).items()))
+    me = tuple(
+        sorted((e["key"], e["operator"], tuple(sorted(e.get("values", [])))) for e in (selector.get("matchExpressions") or []))
+    )
+    return (ml, me)

@@ -1,0 +1,26 @@
+"""FFDSolver: the exact host scheduler behind the Solver interface."""
+
+from __future__ import annotations
+
+from ..controllers.provisioning.scheduling import Results, Scheduler
+from .snapshot import SolverSnapshot
+
+
+class FFDSolver:
+    name = "ffd"
+
+    def solve(self, snap: SolverSnapshot) -> Results:
+        scheduler = Scheduler(
+            snap.store,
+            snap.cluster,
+            snap.node_pools,
+            snap.instance_types,
+            snap.state_nodes,
+            snap.daemonset_pods,
+            snap.clock,
+            preference_policy=snap.preference_policy,
+            min_values_policy=snap.min_values_policy,
+            enforce_consolidate_after=snap.enforce_consolidate_after,
+            deleting_node_names=snap.deleting_node_names,
+        )
+        return scheduler.solve(snap.pods)

@@ -1,0 +1,25 @@
+"""SolverSnapshot: everything one provisioning solve needs, host-side.
+
+Built by the provisioner from cluster state (the reference's equivalent is the
+argument set of NewScheduler, provisioner.go:261-348).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class SolverSnapshot:
+    store: object
+    cluster: object
+    node_pools: list
+    instance_types: dict  # nodepool name -> [InstanceType]
+    state_nodes: list
+    daemonset_pods: list
+    pods: list
+    clock: object
+    preference_policy: str = "Respect"
+    min_values_policy: str = "Strict"
+    enforce_consolidate_after: bool = False
+    deleting_node_names: set = field(default_factory=set)

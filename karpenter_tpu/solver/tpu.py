@@ -1,0 +1,155 @@
+"""TPUSolver: the tensor backend behind the Solver plugin point.
+
+Pipeline: encode (host, numpy) -> greedy_pack (device, one fused lax.scan) ->
+decode (host: slots -> SchedulingNodeClaim/ExistingNode results). Snapshots
+using constraint families outside the tensor subset fall back to the host FFD
+solver (the reference-semantics oracle) — mirroring the opt-in design of
+BASELINE.json ("the Go FFD path stays the default").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..apis import labels as wk
+from ..controllers.provisioning.scheduling.existingnode import ExistingNode
+from ..controllers.provisioning.scheduling.nodeclaim import (
+    NodeClaimTemplate,
+    SchedulingNodeClaim,
+    filter_instance_types,
+)
+from ..controllers.provisioning.scheduling.scheduler import Results
+from ..models.scheduler_model import greedy_pack, make_tensors
+from ..scheduling.requirements import Operator, Requirement, Requirements
+from ..utils import resources as res
+from .encode import encode
+from .ffd import FFDSolver
+from .snapshot import SolverSnapshot
+
+
+class _NullTopology:
+    """Decode-time stand-in: claims are fully determined by the device result."""
+
+    def register(self, *a, **k):
+        pass
+
+    def record(self, *a, **k):
+        pass
+
+    def add_requirements(self, *a, **k):  # pragma: no cover - not used in decode
+        return Requirements()
+
+
+class TPUSolver:
+    name = "tpu"
+
+    def __init__(self, fallback: FFDSolver | None = None, force: bool = False):
+        self.fallback = fallback or FFDSolver()
+        self.force = force  # raise instead of falling back (tests)
+        self.last_backend: str = ""
+        self.last_fallback_reasons: list[str] = []
+
+    def solve(self, snap: SolverSnapshot) -> Results:
+        enc = encode(snap)
+        self.last_fallback_reasons = enc.fallback_reasons
+        if enc.fallback_reasons:
+            if self.force:
+                raise RuntimeError(f"tensor path unsupported: {enc.fallback_reasons}")
+            self.last_backend = "ffd-fallback"
+            return self.fallback.solve(snap)
+        if enc.n_pods == 0 or enc.n_rows == 0:
+            self.last_backend = "ffd-fallback"
+            return self.fallback.solve(snap)
+
+        # cap the slot axis for O(P * n_slots) scan cost; retry uncapped on the
+        # rare overflow (every slot opened AND pods left unplaced)
+        cap = enc.n_existing + min(enc.n_pods, 4096)
+        t = make_tensors(enc, n_slots=cap)
+        assignment, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack(t)
+        if int(open_count) == cap and bool((np.asarray(assignment) < 0).any()) and cap < enc.n_existing + enc.n_pods:
+            t = make_tensors(enc)
+            assignment, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack(t)
+        return self._decode(snap, enc, np.asarray(assignment), np.asarray(slot_basis), np.asarray(slot_zoneset))
+
+    # -- decode ----------------------------------------------------------------
+    def _decode(self, snap: SolverSnapshot, enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zoneset: np.ndarray) -> Results:
+        self.last_backend = "tpu"
+        null_topo = _NullTopology()
+
+        # group pods by slot
+        pods_by_slot: dict[int, list[int]] = {}
+        pod_errors: dict[str, str] = {}
+        for i, j in enumerate(assignment):
+            if j < 0:
+                pod_errors[enc.pods[i].key()] = "no feasible placement found by tensor solver"
+            else:
+                pods_by_slot.setdefault(int(j), []).append(i)
+
+        existing_nodes: list[ExistingNode] = []
+        existing_by_slot: dict[int, ExistingNode] = {}
+        for j in range(enc.n_existing):
+            kind, sn = enc.row_meta[j][0], enc.row_meta[j][1]
+            daemons = []  # daemon headroom already folded into row_alloc
+            en = ExistingNode(sn, null_topo, sn.taints(), {}, False)
+            existing_nodes.append(en)
+            existing_by_slot[j] = en
+
+        overhead_groups_cache: dict[int, list] = {}
+        new_claims: list[SchedulingNodeClaim] = []
+        for j, pod_idxs in sorted(pods_by_slot.items()):
+            pods = [enc.pods[i] for i in pod_idxs]
+            requests = res.requests_for_pods(pods)
+            if j < enc.n_existing:
+                en = existing_by_slot[j]
+                for p in pods:
+                    en.pods.append(p)
+                    en.remaining_resources = res.subtract(en.remaining_resources, res.pod_requests(p))
+                continue
+
+            row = int(slot_basis[j])
+            _, template, it, offering = enc.row_meta[row]
+            claim = SchedulingNodeClaim.__new__(SchedulingNodeClaim)
+            claim.template = template
+            claim.topology = null_topo
+            claim.daemon_overhead_groups = self._overhead_groups(template, snap, overhead_groups_cache)
+            claim.pods = pods
+            claim.hostname = f"tpu-slot-{j}"
+            claim.spec_requests = requests
+
+            reqs = Requirements()
+            reqs.add(*template.requirements.values())
+            for i in pod_idxs:
+                reqs.add(*Requirements.from_pod(enc.pods[i], strict=True).values())
+            # zone: pin only when the packer committed/narrowed the slot to a
+            # single zone (late committal — matches the FFD's topology narrowing)
+            zones = [enc.zone_names[z] for z in np.nonzero(slot_zoneset[j])[0] if z != 0]
+            template_zones = {z for z in enc.zone_names[1:]}
+            if zones and set(zones) != template_zones:
+                reqs.add(Requirement(wk.ZONE_LABEL_KEY, "In", zones))
+            claim.requirements = reqs
+
+            remaining, _, err = filter_instance_types(
+                template.instance_type_options,
+                reqs,
+                pods[0],
+                res.pod_requests(pods[0]),
+                claim.daemon_overhead_groups,
+                requests,
+            )
+            claim.instance_type_options = remaining if remaining else [it]
+            new_claims.append(claim)
+
+        return Results(
+            new_node_claims=new_claims,
+            existing_nodes=existing_nodes,
+            pod_errors=pod_errors,
+        )
+
+    @staticmethod
+    def _overhead_groups(template: NodeClaimTemplate, snap: SolverSnapshot, cache: dict) -> list:
+        from ..controllers.provisioning.scheduling.scheduler import _compute_daemon_overhead_groups
+
+        key = id(template)
+        if key not in cache:
+            cache[key] = _compute_daemon_overhead_groups(template, snap.daemonset_pods)
+        return cache[key]

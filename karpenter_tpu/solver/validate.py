@@ -1,0 +1,98 @@
+"""Exact host-side validation of solver Results — used in tests and as the
+safety net for the tensor backend (SURVEY.md §7: "validated by
+simulation-equivalence (all pods schedulable, cost <=), not bit-identical
+placement").
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..apis import labels as wk
+from ..kube.objects import match_label_selector
+from ..scheduling.requirements import Requirements
+from ..scheduling.taints import taints_tolerate_pod
+from ..utils import resources as res
+
+
+def validate_results(snap, results) -> list[str]:
+    """Returns a list of violations (empty = valid)."""
+    errors: list[str] = []
+
+    # per new claim: resources, requirements, taints
+    for idx, nc in enumerate(results.new_node_claims):
+        if not nc.pods:
+            continue
+        total = res.requests_for_pods(nc.pods)
+        if not nc.instance_type_options:
+            errors.append(f"claim {idx}: no instance types")
+            continue
+        fits_any = any(res.fits(total, it.allocatable()) for it in nc.instance_type_options)
+        if not fits_any:
+            errors.append(f"claim {idx}: pods exceed every instance type allocatable")
+        for p in nc.pods:
+            reqs = Requirements.from_pod(p, strict=True)
+            if nc.requirements.compatible(reqs, allow_undefined=wk.WELL_KNOWN_LABELS) is not None:
+                errors.append(f"claim {idx}: pod {p.key()} incompatible with claim requirements")
+            err = taints_tolerate_pod(nc.template.taints, p)
+            if err is not None:
+                errors.append(f"claim {idx}: pod {p.key()} {err}")
+
+    for en in results.existing_nodes:
+        if not en.pods:
+            continue
+        for r, q in en.remaining_resources.items():
+            if q.milli < 0:
+                errors.append(f"existing node {en.name()}: over-committed {r}")
+                break
+
+    # topology: spread skew and anti-affinity over the final placement
+    placements = []  # (pod, zone, host)
+    for nc in results.new_node_claims:
+        zone_req = nc.requirements.get(wk.ZONE_LABEL_KEY)
+        zone = zone_req.any() if len(zone_req.values) == 1 else None
+        for p in nc.pods:
+            placements.append((p, zone, id(nc)))
+    for en in results.existing_nodes:
+        zone = en.state_node.labels().get(wk.ZONE_LABEL_KEY)
+        for p in en.pods:
+            placements.append((p, zone, en.name()))
+        # include already-bound pods for counting
+        for key in en.state_node.pod_requests:
+            ns, name = key.split("/", 1)
+            pod = snap.store.try_get("Pod", name, ns)
+            if pod is not None:
+                placements.append((pod, zone, en.name()))
+
+    solve_keys = {p.key() for p in snap.pods}
+    for pod in snap.pods:
+        for tsc in pod.spec.topology_spread_constraints:
+            if tsc.when_unsatisfiable != "DoNotSchedule":
+                continue
+            counts = defaultdict(int)
+            for q, zone, host in placements:
+                if q.metadata.namespace != pod.metadata.namespace:
+                    continue
+                if not match_label_selector(tsc.label_selector, q.metadata.labels):
+                    continue
+                domain = zone if tsc.topology_key == wk.ZONE_LABEL_KEY else host
+                if domain is not None:
+                    counts[domain] += 1
+            if counts and tsc.topology_key == wk.ZONE_LABEL_KEY:
+                skew = max(counts.values()) - min(counts.values())
+                if skew > tsc.max_skew:
+                    errors.append(f"pod {pod.key()}: zone skew {skew} > {tsc.max_skew} ({dict(counts)})")
+        aff = pod.spec.affinity
+        if aff is not None:
+            for term in aff.pod_anti_affinity_required:
+                if term.topology_key != wk.HOSTNAME_LABEL_KEY:
+                    continue
+                my = next(((z, h) for q, z, h in placements if q.key() == pod.key()), None)
+                if my is None:
+                    continue
+                for q, zone, host in placements:
+                    if q.key() == pod.key() or host != my[1]:
+                        continue
+                    if q.metadata.namespace == pod.metadata.namespace and match_label_selector(term.label_selector, q.metadata.labels):
+                        errors.append(f"pod {pod.key()}: hostname anti-affinity violated with {q.key()}")
+    return errors

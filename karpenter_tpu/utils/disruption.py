@@ -2,8 +2,9 @@
 
 from __future__ import annotations
 
+from ..apis.labels import DO_NOT_DISRUPT_ANNOTATION_KEY as DO_NOT_DISRUPT_ANNOTATION
+
 PD_DELETION_COST_ANNOTATION = "controller.kubernetes.io/pod-deletion-cost"
-DO_NOT_DISRUPT_ANNOTATION = "karpenter.sh/do-not-disrupt"
 
 
 def eviction_cost(pod) -> float:

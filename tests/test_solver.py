@@ -1,0 +1,180 @@
+"""TPU tensor solver: equivalence vs the host FFD oracle.
+
+Validation criterion (SURVEY.md §7): all-pods-scheduled parity and cost <=,
+plus exact constraint validation of the tensor placement — not bit-identical
+placement.
+"""
+
+import random
+
+import pytest
+
+from helpers import hostname_anti_affinity, make_nodepool, make_pod, zone_spread
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.kube import Store
+from karpenter_tpu.solver import FFDSolver, SolverSnapshot
+from karpenter_tpu.solver.tpu import TPUSolver
+from karpenter_tpu.solver.validate import validate_results
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_snapshot(pods, node_pools=None, types=None):
+    store = Store()
+    clock = FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    node_pools = node_pools or [make_nodepool(requirements=LINUX_AMD64)]
+    for np in node_pools:
+        store.create(np)
+    types = types if types is not None else catalog.construct_instance_types()
+    return SolverSnapshot(
+        store=store,
+        cluster=cluster,
+        node_pools=node_pools,
+        instance_types={np.metadata.name: types for np in node_pools},
+        state_nodes=cluster.nodes(),
+        daemonset_pods=[],
+        pods=pods,
+        clock=clock,
+    )
+
+
+def claims_cost(results):
+    total = 0.0
+    for nc in results.new_node_claims:
+        best = min(
+            (
+                o.price
+                for it in nc.instance_type_options
+                for o in it.offerings
+                if o.available and nc.requirements.intersects(o.requirements) is None
+            ),
+            default=float("inf"),
+        )
+        total += best
+    return total
+
+
+def compare_backends(pods, node_pools=None, cost_tol=1.001):
+    snap = make_snapshot(pods, node_pools)
+    ffd_results = FFDSolver().solve(snap)
+
+    snap2 = make_snapshot(pods, node_pools)
+    tpu = TPUSolver(force=True)
+    tpu_results = tpu.solve(snap2)
+    assert tpu.last_backend == "tpu"
+
+    assert set(tpu_results.pod_errors) == set(ffd_results.pod_errors), (
+        f"scheduled-set mismatch: tpu={tpu_results.pod_errors} ffd={ffd_results.pod_errors}"
+    )
+    violations = validate_results(snap2, tpu_results)
+    assert not violations, violations
+    if ffd_results.new_node_claims:
+        assert claims_cost(tpu_results) <= claims_cost(ffd_results) * cost_tol, (
+            f"tpu cost {claims_cost(tpu_results)} > ffd cost {claims_cost(ffd_results)}"
+        )
+    return tpu_results, ffd_results
+
+
+class TestTPUEquivalence:
+    def test_single_pod(self):
+        tpu, ffd = compare_backends([make_pod(cpu="1")])
+        assert len(tpu.new_node_claims) == 1
+
+    def test_homogeneous_packing(self):
+        tpu, ffd = compare_backends([make_pod(cpu="1") for _ in range(20)])
+        assert len(tpu.new_node_claims) == len(ffd.new_node_claims)
+
+    def test_mixed_sizes(self):
+        pods = [make_pod(cpu=c, memory=m) for c, m in [("4", "8Gi"), ("1", "2Gi"), ("2", "1Gi"), ("500m", "512Mi")] * 5]
+        compare_backends(pods)
+
+    def test_zone_selector(self):
+        pods = [make_pod(node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"}) for _ in range(3)]
+        tpu, _ = compare_backends(pods)
+        for nc in tpu.new_node_claims:
+            assert nc.requirements.get(wk.ZONE_LABEL_KEY).values == {"test-zone-b"}
+
+    def test_unschedulable_pod(self):
+        tpu, ffd = compare_backends([make_pod(cpu="10000"), make_pod(cpu="1")])
+        assert len(tpu.pod_errors) == 1
+
+    def test_custom_label_unschedulable(self):
+        compare_backends([make_pod(node_selector={"team": "infra"})])
+
+    def test_zone_spread(self):
+        sel = {"matchLabels": {"app": "web"}}
+        pods = [make_pod(labels={"app": "web"}, tsc=[zone_spread(selector=sel)]) for _ in range(8)]
+        tpu, _ = compare_backends(pods)
+        zones = {}
+        for nc in tpu.new_node_claims:
+            z = next(iter(nc.requirements.get(wk.ZONE_LABEL_KEY).values))
+            zones[z] = zones.get(z, 0) + sum(1 for p in nc.pods if p.metadata.labels.get("app") == "web")
+        assert max(zones.values()) - min(zones.values()) <= 1
+
+    def test_hostname_anti_affinity(self):
+        sel = {"matchLabels": {"app": "db"}}
+        pods = [make_pod(labels={"app": "db"}, anti_affinity=[hostname_anti_affinity(sel)]) for _ in range(6)]
+        tpu, ffd = compare_backends(pods)
+        assert len(tpu.new_node_claims) == 6
+
+    def test_taints_respected(self):
+        from karpenter_tpu.scheduling.taints import Taint
+
+        tainted = make_nodepool("tainted", requirements=LINUX_AMD64, taints=[Taint(key="dedicated", value="x")], weight=50)
+        normal = make_nodepool("normal", requirements=LINUX_AMD64, weight=1)
+        pods = [make_pod()]  # no toleration -> must use 'normal' despite weight
+        tpu, _ = compare_backends(pods, node_pools=[tainted, normal])
+        assert tpu.new_node_claims[0].template.nodepool_name == "normal"
+
+    def test_weight_priority(self):
+        heavy = make_nodepool("heavy", requirements=LINUX_AMD64, weight=50)
+        light = make_nodepool("light", requirements=LINUX_AMD64, weight=1)
+        tpu, _ = compare_backends([make_pod()], node_pools=[light, heavy])
+        assert tpu.new_node_claims[0].template.nodepool_name == "heavy"
+
+    def test_random_fuzz_equivalence(self):
+        rng = random.Random(42)
+        for trial in range(3):
+            pods = []
+            for i in range(rng.randrange(10, 40)):
+                kind = rng.random()
+                if kind < 0.5:
+                    pods.append(make_pod(cpu=rng.choice(["250m", "500m", "1", "2", "4"]), memory=rng.choice(["512Mi", "1Gi", "4Gi"])))
+                elif kind < 0.7:
+                    pods.append(make_pod(cpu="1", node_selector={wk.ZONE_LABEL_KEY: rng.choice(catalog.ZONES)}))
+                elif kind < 0.9:
+                    sel = {"matchLabels": {"app": f"w{trial}"}}
+                    pods.append(make_pod(cpu="500m", labels={"app": f"w{trial}"}, tsc=[zone_spread(selector=sel)]))
+                else:
+                    pods.append(make_pod(cpu="8", memory="16Gi"))
+            compare_backends(pods)
+
+
+class TestFallback:
+    def test_pod_affinity_falls_back(self):
+        from karpenter_tpu.kube import PodAffinityTerm
+
+        sel = {"matchLabels": {"app": "x"}}
+        pods = [make_pod(labels={"app": "x"}, pod_affinity=[PodAffinityTerm(label_selector=sel, topology_key=wk.ZONE_LABEL_KEY)])]
+        snap = make_snapshot(pods)
+        solver = TPUSolver()
+        results = solver.solve(snap)
+        assert solver.last_backend == "ffd-fallback"
+        assert results.all_pods_scheduled()
+
+    def test_preferred_affinity_falls_back(self):
+        pods = [make_pod(preferred_affinity=[(10, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["mars"]}])])]
+        snap = make_snapshot(pods)
+        solver = TPUSolver()
+        results = solver.solve(snap)
+        assert solver.last_backend == "ffd-fallback"
+        assert results.all_pods_scheduled()
