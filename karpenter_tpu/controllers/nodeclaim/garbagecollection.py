@@ -1,0 +1,39 @@
+"""NodeClaim garbage collection (reference: nodeclaim/garbagecollection):
+deletes claims whose cloud instance disappeared, and cloud instances with no
+claim (leak protection).
+"""
+
+from __future__ import annotations
+
+from ...cloudprovider.errors import NodeClaimNotFoundError
+
+
+class GarbageCollectionController:
+    def __init__(self, store, cluster, cloud_provider, clock):
+        self.store = store
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+
+    def reconcile(self) -> None:
+        claims = self.store.list("NodeClaim")
+        by_pid = {nc.status.provider_id: nc for nc in claims if nc.status.provider_id}
+
+        # claims whose instance is gone -> delete claim
+        for nc in claims:
+            if not nc.status.provider_id or not nc.is_registered():
+                continue
+            if nc.metadata.deletion_timestamp is not None:
+                continue
+            try:
+                self.cloud_provider.get(nc.status.provider_id)
+            except NodeClaimNotFoundError:
+                self.store.try_delete("NodeClaim", nc.metadata.name)
+
+        # cloud instances with no claim -> delete instance (leaked)
+        for cloud_nc in self.cloud_provider.list():
+            if cloud_nc.status.provider_id not in by_pid:
+                try:
+                    self.cloud_provider.delete(cloud_nc)
+                except NodeClaimNotFoundError:
+                    pass

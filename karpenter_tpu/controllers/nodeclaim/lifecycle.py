@@ -1,0 +1,179 @@
+"""NodeClaim lifecycle: Launch -> Registration -> Initialization -> Liveness.
+
+Reference: nodeclaim/lifecycle/{controller,launch,registration,initialization,
+liveness}.go (call stack SURVEY.md §3.3). Each phase is an idempotent
+sub-reconciler flipping a status condition; conditions are the durable
+checkpoints of the system.
+"""
+
+from __future__ import annotations
+
+from ...apis import labels as wk
+from ...apis.nodeclaim import (
+    COND_INITIALIZED,
+    COND_LAUNCHED,
+    COND_REGISTERED,
+    NodeClaim,
+)
+from ...cloudprovider.errors import InsufficientCapacityError, NodeClassNotReadyError
+from ...kube.store import NotFound
+from ...utils import resources as res
+
+REGISTRATION_TTL_SECONDS = 15 * 60  # liveness.go:39 registrationTTL
+
+
+class LifecycleController:
+    def __init__(self, store, cluster, cloud_provider, clock, recorder=None):
+        self.store = store
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+        self.recorder = recorder
+
+    def reconcile_all(self) -> None:
+        for nc in self.store.list("NodeClaim"):
+            self.reconcile(nc.metadata.name)
+
+    def reconcile(self, name: str) -> None:
+        try:
+            nc = self.store.get("NodeClaim", name)
+        except NotFound:
+            return
+        if nc.metadata.deletion_timestamp is not None:
+            self._terminate(nc)
+            return
+        changed = False
+        changed |= self._launch(nc)
+        changed |= self._register(nc)
+        changed |= self._initialize(nc)
+        if changed:
+            try:
+                self.store.update(nc)
+                self.cluster.update_node_claim(nc)
+            except NotFound:
+                return
+        self._liveness(nc)
+
+    # -- Launch (launch.go): cloudProvider.Create -> providerID ----------------
+    def _launch(self, nc: NodeClaim) -> bool:
+        if nc.is_launched() or nc.status.provider_id:
+            return False
+        try:
+            created = self.cloud_provider.create(nc)
+        except InsufficientCapacityError as e:
+            # terminal for this claim: delete so the provisioner retries
+            nc.status.conditions.set_false(COND_LAUNCHED, "InsufficientCapacity", str(e), now=self.clock.now())
+            self.store.update(nc)
+            self.store.delete("NodeClaim", nc.metadata.name, grace=False)
+            return False
+        except NodeClassNotReadyError as e:
+            nc.status.conditions.set_false(COND_LAUNCHED, "NodeClassNotReady", str(e), now=self.clock.now())
+            return True
+        nc.status.provider_id = created.status.provider_id
+        nc.status.image_id = created.status.image_id
+        nc.status.capacity = dict(created.status.capacity)
+        nc.status.allocatable = dict(created.status.allocatable)
+        # adopt resolved labels (instance type, zone, capacity type)
+        for k, v in created.metadata.labels.items():
+            nc.metadata.labels.setdefault(k, v)
+        nc.status.conditions.set_true(COND_LAUNCHED, now=self.clock.now())
+        return True
+
+    # -- Registration (registration.go): node with matching providerID joined --
+    def _register(self, nc: NodeClaim) -> bool:
+        if nc.is_registered() or not nc.is_launched():
+            return False
+        node = self._node_for(nc)
+        if node is None:
+            return False
+        # sync labels/taints/annotations from the claim onto the node and drop
+        # the unregistered taint
+        def apply(n):
+            for k, v in nc.metadata.labels.items():
+                n.metadata.labels.setdefault(k, v)
+            n.metadata.labels[wk.NODE_REGISTERED_LABEL_KEY] = "true"
+            for k, v in nc.metadata.annotations.items():
+                n.metadata.annotations.setdefault(k, v)
+            existing = {(t.key, t.effect) for t in n.spec.taints}
+            for t in list(nc.spec.taints) + list(nc.spec.startup_taints):
+                if (t.key, t.effect) not in existing:
+                    n.spec.taints.append(t)
+            n.spec.taints = [t for t in n.spec.taints if t.key != wk.UNREGISTERED_TAINT_KEY]
+            if wk.TERMINATION_FINALIZER not in n.metadata.finalizers:
+                n.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+
+        self.store.patch("Node", node.metadata.name, apply)
+        nc.status.node_name = node.metadata.name
+        nc.status.conditions.set_true(COND_REGISTERED, now=self.clock.now())
+        return True
+
+    # -- Initialization (initialization.go): node ready + resources registered -
+    def _initialize(self, nc: NodeClaim) -> bool:
+        if nc.is_initialized() or not nc.is_registered():
+            return False
+        node = self.store.try_get("Node", nc.status.node_name)
+        if node is None:
+            return False
+        if not _node_ready(node):
+            return False
+        # startup taints must have cleared — matched by full identity, so a
+        # permanent taint sharing a key doesn't wedge initialization
+        startup = {(t.key, t.value, t.effect) for t in nc.spec.startup_taints}
+        if any((t.key, t.value, t.effect) in startup for t in node.spec.taints):
+            return False
+        # all claim-known resources must be registered on the node
+        for name, q in nc.status.allocatable.items():
+            if name == "pods":
+                continue
+            if node.status.allocatable.get(name) is None:
+                return False
+
+        def apply(n):
+            n.metadata.labels[wk.NODE_INITIALIZED_LABEL_KEY] = "true"
+
+        self.store.patch("Node", node.metadata.name, apply)
+        nc.status.conditions.set_true(COND_INITIALIZED, now=self.clock.now())
+        return True
+
+    # -- Liveness (liveness.go:62): kill claims that never register ------------
+    def _liveness(self, nc: NodeClaim) -> None:
+        if nc.is_registered():
+            return
+        age = self.clock.now() - nc.metadata.creation_timestamp
+        if age > REGISTRATION_TTL_SECONDS:
+            self.store.try_delete("NodeClaim", nc.metadata.name)
+
+    # -- claim termination (lifecycle/termination.go): instance gone, node
+    # deleted, finalizer released. The graceful pod-drain path lives in the
+    # node termination controller; this is the claim-side teardown.
+    def _terminate(self, nc: NodeClaim) -> None:
+        from ...cloudprovider.errors import NodeClaimNotFoundError
+
+        if nc.status.provider_id:
+            try:
+                self.cloud_provider.delete(nc)
+            except NodeClaimNotFoundError:
+                pass
+        if nc.status.node_name:
+            node = self.store.try_get("Node", nc.status.node_name)
+            if node is not None and node.metadata.deletion_timestamp is None:
+                self.store.try_delete("Node", nc.status.node_name)
+                node = self.store.try_get("Node", nc.status.node_name)
+            if node is not None:
+                # claim-side teardown releases the node finalizer too when no
+                # separate termination controller is driving the drain
+                self.store.remove_finalizer("Node", nc.status.node_name, wk.TERMINATION_FINALIZER)
+        self.store.remove_finalizer("NodeClaim", nc.metadata.name, wk.TERMINATION_FINALIZER)
+
+    def _node_for(self, nc: NodeClaim):
+        for node in self.store.list("Node"):
+            if node.spec.provider_id == nc.status.provider_id:
+                return node
+        return None
+
+
+def _node_ready(node) -> bool:
+    for c in node.status.conditions:
+        if c.type == "Ready":
+            return c.status == "True"
+    return True  # KWOK nodes have no kubelet; absence of conditions counts ready

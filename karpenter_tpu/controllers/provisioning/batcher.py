@@ -1,0 +1,32 @@
+"""Pod batcher: idle/max windows (reference: provisioning/batcher.go:33-110).
+
+Triggers accumulate; a batch fires after BatchIdleDuration of quiet or
+BatchMaxDuration since the first trigger (defaults 1s/10s, options.go:129-130).
+"""
+
+from __future__ import annotations
+
+
+class Batcher:
+    def __init__(self, clock, idle_seconds: float = 1.0, max_seconds: float = 10.0):
+        self.clock = clock
+        self.idle = idle_seconds
+        self.max = max_seconds
+        self._first: float | None = None
+        self._last: float | None = None
+
+    def trigger(self, uid: str = "") -> None:
+        now = self.clock.now()
+        if self._first is None:
+            self._first = now
+        self._last = now
+
+    def ready(self) -> bool:
+        if self._first is None:
+            return False
+        now = self.clock.now()
+        return (now - self._last) >= self.idle or (now - self._first) >= self.max
+
+    def reset(self) -> None:
+        self._first = None
+        self._last = None

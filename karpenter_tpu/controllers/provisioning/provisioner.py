@@ -1,0 +1,130 @@
+"""Provisioner: batch pending pods -> solve -> create NodeClaims.
+
+Reference: provisioning/provisioner.go:127-513 — the singleton reconciler at
+the top of call stack §3.1. The Solve step goes through the Solver plugin
+point (FFD default, TPU opt-in — BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ...apis import labels as wk
+from ...solver import FFDSolver, SolverSnapshot
+from ...utils import pods as pod_utils
+from ...utils import resources as res
+from .batcher import Batcher
+from .scheduling.scheduler import Results
+
+
+@dataclass
+class ProvisionerOptions:
+    preference_policy: str = "Respect"
+    min_values_policy: str = "Strict"
+    batch_idle_seconds: float = 1.0
+    batch_max_seconds: float = 10.0
+
+
+class Provisioner:
+    def __init__(self, store, cluster, cloud_provider, clock, solver=None, recorder=None, options: ProvisionerOptions | None = None):
+        self.store = store
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+        self.solver = solver or FFDSolver()
+        self.recorder = recorder
+        self.options = options or ProvisionerOptions()
+        self.batcher = Batcher(clock, self.options.batch_idle_seconds, self.options.batch_max_seconds)
+
+    # -- triggering (provisioning/controller.go) -------------------------------
+    def trigger(self, uid: str = "") -> None:
+        self.batcher.trigger(uid)
+
+    def reconcile(self, force: bool = False) -> Results | None:
+        """One pass: fire when the batch window closes and state is synced."""
+        if not force and not self.batcher.ready():
+            return None
+        if not self.cluster.synced():
+            return None
+        self.batcher.reset()
+        return self.provision()
+
+    # -- the provisioning pass (provisioner.go:350-458) ------------------------
+    def provision(self) -> Results:
+        pods = self.get_pending_pods()
+        results = self.schedule(pods)
+        for claim in results.new_node_claims:
+            if claim.pods:
+                self.create_node_claim(claim)
+        # nominate existing nodes that received pods so disruption leaves them be
+        for existing in results.existing_nodes:
+            if existing.pods:
+                self.cluster.nominate_node(existing.name())
+        return results
+
+    def get_pending_pods(self) -> list:
+        """Provisionable pods (provisioner.go:192-221)."""
+        out = []
+        for pod in self.store.list("Pod"):
+            if not pod_utils.is_provisionable(pod):
+                continue
+            out.append(pod)
+        return out
+
+    def schedule(self, pods: list) -> Results:
+        if not pods:
+            return Results()
+        snapshot = self.make_snapshot(pods)
+        if not snapshot.node_pools:
+            return Results(pod_errors={p.key(): "no ready nodepools" for p in pods})
+        return self.solver.solve(snapshot)
+
+    def make_snapshot(self, pods: list, state_nodes=None, exclude_deleting: bool = True) -> SolverSnapshot:
+        """Snapshot assembly (provisioner.go:261-348 NewScheduler)."""
+        node_pools = [np for np in self.store.list("NodePool") if not np.is_static()]
+        instance_types = {}
+        for np in node_pools:
+            its = self.cloud_provider.get_instance_types(np)
+            if its:
+                instance_types[np.metadata.name] = its
+        node_pools = [np for np in node_pools if np.metadata.name in instance_types]
+        if state_nodes is None:
+            state_nodes = [
+                n
+                for n in self.cluster.nodes()
+                if not (exclude_deleting and (n.marked_for_deletion or n.deleted()))
+            ]
+        daemonset_pods = [ds.to_pod() for ds in self.store.list("DaemonSet")]
+        return SolverSnapshot(
+            store=self.store,
+            cluster=self.cluster,
+            node_pools=node_pools,
+            instance_types=instance_types,
+            state_nodes=state_nodes,
+            daemonset_pods=daemonset_pods,
+            pods=pods,
+            clock=self.clock,
+            preference_policy=self.options.preference_policy,
+            min_values_policy=self.options.min_values_policy,
+        )
+
+    def create_node_claim(self, scheduling_claim) -> str | None:
+        """Limits check + API create (provisioner.go:460-513). Returns the
+        created claim name or None when limits forbid it."""
+        nc = scheduling_claim.to_api_node_claim(self.clock)
+        pool_name = scheduling_claim.nodepool_name if hasattr(scheduling_claim, "nodepool_name") else scheduling_claim.template.nodepool_name
+        node_pool = self.store.try_get("NodePool", pool_name)
+        if node_pool is None:
+            return None
+        if node_pool.spec.limits:
+            # reject when current usage already exceeds limits (provisioner.go
+            # Create: ExceededBy(current)); forward-looking enforcement happens
+            # in the scheduler via remainingResources filtering
+            current = self.cluster.nodepool_resources(pool_name)
+            err = node_pool.limits_exceeded_by(current)
+            if err is not None:
+                return None
+        created = self.store.create(nc)
+        # immediately mirror into cluster state so the next solve sees it
+        self.cluster.update_node_claim(created)
+        return created.metadata.name

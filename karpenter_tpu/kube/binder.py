@@ -1,0 +1,106 @@
+"""A minimal kube-scheduler stand-in: binds pending pods to Ready nodes.
+
+The reference relies on the real kube-scheduler (via kind/KWOK) to bind pods
+once Karpenter has provisioned capacity; in this hermetic substrate the Binder
+plays that role for e2e flows. First-fit over nodes: resources, taints,
+node-selector/affinity, registered + schedulable.
+"""
+
+from __future__ import annotations
+
+from ..apis import labels as wk
+from ..scheduling.requirements import Requirements
+from ..scheduling.taints import taints_tolerate_pod
+from ..utils import pods as pod_utils
+from ..utils import resources as res
+
+
+class Binder:
+    def __init__(self, store, cluster, clock):
+        self.store = store
+        self.cluster = cluster
+        self.clock = clock
+
+    def bind_all(self) -> int:
+        """One scheduling pass; returns number of pods bound."""
+        bound = 0
+        nodes = sorted(self.store.list("Node"), key=lambda n: n.metadata.name)
+        node_reqs = {n.metadata.name: Requirements.from_labels(n.metadata.labels) for n in nodes}
+        all_pods = self.store.list("Pod")
+        for pod in all_pods:
+            if not pod_utils.is_provisionable(pod):
+                continue
+            node = self._find_node(pod, nodes, node_reqs, all_pods)
+            if node is not None:
+                self._bind(pod, node)
+                pod.spec.node_name = node.metadata.name  # keep local view current for spread counting
+                bound += 1
+        return bound
+
+    def _find_node(self, pod, nodes, node_reqs_cache, all_pods):
+        reqs = Requirements.from_pod(pod, strict=True)
+        requests = res.pod_requests(pod)
+        for node in nodes:
+            if node.spec.unschedulable or node.metadata.deletion_timestamp is not None:
+                continue
+            if any(t.key == wk.UNREGISTERED_TAINT_KEY for t in node.spec.taints):
+                continue
+            if taints_tolerate_pod(node.spec.taints, pod) is not None:
+                continue
+            if node_reqs_cache[node.metadata.name].compatible(reqs) is not None:
+                continue
+            sn = self.cluster.node_for_name(node.metadata.name)
+            available = sn.available() if sn is not None else node.status.allocatable
+            if not res.fits(requests, available):
+                continue
+            if not self._topology_ok(pod, node, nodes, all_pods):
+                continue
+            return node
+        return None
+
+    def _topology_ok(self, pod, node, nodes, all_pods) -> bool:
+        """Honor DoNotSchedule spread constraints and required hostname
+        anti-affinity — the kube-scheduler behaviors the e2e flows rely on."""
+        from .objects import match_label_selector
+
+        node_domain = {n.metadata.name: n.metadata.labels for n in nodes}
+        for tsc in pod.spec.topology_spread_constraints:
+            if tsc.when_unsatisfiable != "DoNotSchedule":
+                continue
+            counts: dict[str, int] = {}
+            for n in nodes:
+                d = n.metadata.labels.get(tsc.topology_key)
+                if d is not None:
+                    counts.setdefault(d, 0)
+            for q in all_pods:
+                if not q.spec.node_name or q.metadata.namespace != pod.metadata.namespace:
+                    continue
+                if not match_label_selector(tsc.label_selector, q.metadata.labels):
+                    continue
+                d = node_domain.get(q.spec.node_name, {}).get(tsc.topology_key)
+                if d is not None:
+                    counts[d] = counts.get(d, 0) + 1
+            my_domain = node.metadata.labels.get(tsc.topology_key)
+            if my_domain is None:
+                continue
+            if counts:
+                if counts.get(my_domain, 0) + 1 - min(counts.values()) > tsc.max_skew:
+                    return False
+        aff = pod.spec.affinity
+        if aff is not None:
+            for term in aff.pod_anti_affinity_required:
+                if term.topology_key != wk.HOSTNAME_LABEL_KEY:
+                    continue
+                for q in all_pods:
+                    if q.spec.node_name == node.metadata.name and q.metadata.namespace == pod.metadata.namespace:
+                        if match_label_selector(term.label_selector, q.metadata.labels):
+                            return False
+        return True
+
+    def _bind(self, pod, node) -> None:
+        def apply(p):
+            p.spec.node_name = node.metadata.name
+            p.status.phase = "Running"
+            p.status.start_time = self.clock.now()
+
+        self.store.patch("Pod", pod.metadata.name, apply, namespace=pod.metadata.namespace)

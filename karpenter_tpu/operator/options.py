@@ -1,0 +1,49 @@
+"""Global options + feature gates (reference: pkg/operator/options/options.go:68-135).
+
+Flag/env parsing collapses to a dataclass; controllers receive it explicitly
+instead of via context injection.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class FeatureGates:
+    node_repair: bool = False
+    reserved_capacity: bool = True
+    spot_to_spot_consolidation: bool = False
+    node_overlay: bool = False
+    static_capacity: bool = False
+    capacity_buffer: bool = False
+    dynamic_resources: bool = False
+
+
+@dataclass
+class Options:
+    batch_max_duration: float = 10.0
+    batch_idle_duration: float = 1.0
+    preference_policy: str = "Respect"  # Respect | Ignore
+    min_values_policy: str = "Strict"  # Strict | BestEffort
+    solver_backend: str = "ffd"  # ffd | tpu
+    feature_gates: FeatureGates = field(default_factory=FeatureGates)
+
+    @classmethod
+    def from_env(cls) -> "Options":
+        o = cls()
+        o.batch_max_duration = float(os.environ.get("BATCH_MAX_DURATION", o.batch_max_duration))
+        o.batch_idle_duration = float(os.environ.get("BATCH_IDLE_DURATION", o.batch_idle_duration))
+        o.preference_policy = os.environ.get("PREFERENCE_POLICY", o.preference_policy)
+        o.min_values_policy = os.environ.get("MIN_VALUES_POLICY", o.min_values_policy)
+        o.solver_backend = os.environ.get("SOLVER_BACKEND", o.solver_backend)
+        gates = os.environ.get("FEATURE_GATES", "")
+        for item in gates.split(","):
+            if "=" in item:
+                k, v = item.split("=", 1)
+                key = k.strip().replace("-", "_")
+                snake = "".join("_" + c.lower() if c.isupper() else c for c in key).lstrip("_")
+                if hasattr(o.feature_gates, snake):
+                    setattr(o.feature_gates, snake, v.strip().lower() == "true")
+        return o

@@ -158,9 +158,10 @@ class Cluster:
 
     def update_node_claim(self, nc: NodeClaim) -> None:
         with self._lock:
-            if not nc.status.provider_id:
-                return  # not launched yet
-            pid = nc.status.provider_id
+            # claims are tracked from creation (pre-launch) under a synthetic
+            # key so back-to-back solves see in-flight capacity; the entry is
+            # migrated once the provider id appears
+            pid = nc.status.provider_id or f"nodeclaim://{nc.metadata.name}"
             old_pid = self._nodeclaim_name_to_provider_id.get(nc.metadata.name)
             if old_pid is not None and old_pid != pid and old_pid in self._nodes:
                 del self._nodes[old_pid]

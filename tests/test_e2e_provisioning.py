@@ -1,0 +1,137 @@
+"""End-to-end slice (SURVEY.md §7 stage 6): pending pods -> batcher -> solve
+-> NodeClaim create -> KWOK node Ready -> pods bound.
+
+Modeled on the reference's provisioning suite + ExpectProvisioned harness.
+"""
+
+import pytest
+
+from helpers import make_nodepool, make_pod, zone_spread
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_env(**kw):
+    env = Environment(options=Options(**kw))
+    env.store.create(make_nodepool(requirements=LINUX_AMD64))
+    return env
+
+
+class TestEndToEnd:
+    def test_single_pod_provisions_and_binds(self):
+        env = make_env()
+        env.store.create(make_pod(cpu="1"))
+        env.settle()
+        assert env.store.count("NodeClaim") == 1
+        assert env.store.count("Node") == 1
+        pod = env.store.list("Pod")[0]
+        assert pod.spec.node_name != ""
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.is_launched() and nc.is_registered() and nc.is_initialized()
+        node = env.store.list("Node")[0]
+        assert wk.UNREGISTERED_TAINT_KEY not in [t.key for t in node.spec.taints]
+        assert node.metadata.labels[wk.NODE_REGISTERED_LABEL_KEY] == "true"
+
+    def test_batch_packs_pods_onto_one_node(self):
+        env = make_env()
+        for _ in range(5):
+            env.store.create(make_pod(cpu="1"))
+        env.settle()
+        assert env.store.count("NodeClaim") == 1
+        assert all(p.spec.node_name for p in env.store.list("Pod"))
+
+    def test_batcher_windows(self):
+        env = make_env()
+        env.store.create(make_pod(cpu="1"))
+        # idle window (1s default) has not elapsed -> no provisioning
+        env.tick()
+        assert env.store.count("NodeClaim") == 0
+        env.clock.step(1.5)
+        env.tick()
+        assert env.store.count("NodeClaim") == 1
+
+    def test_second_batch_reuses_inflight_capacity(self):
+        env = make_env()
+        env.store.create(make_pod(cpu="1"))
+        env.settle(rounds=3)
+        assert env.store.count("NodeClaim") == 1
+        # another small pod fits on the existing node
+        env.store.create(make_pod(cpu="500m"))
+        env.settle(rounds=3)
+        assert env.store.count("NodeClaim") == 1
+        assert all(p.spec.node_name for p in env.store.list("Pod"))
+
+    def test_no_nodepool_no_claims(self):
+        env = Environment()
+        env.store.create(make_pod(cpu="1"))
+        env.settle(rounds=3)
+        assert env.store.count("NodeClaim") == 0
+
+    def test_registration_delay(self):
+        env = make_env()
+        nodeclass = env.store.get("KWOKNodeClass", "default")
+        nodeclass.spec.node_registration_delay = 5.0
+        env.store.update(nodeclass)
+        env.store.create(make_pod(cpu="1"))
+        env.clock.step(1.5)
+        env.tick()
+        assert env.store.count("NodeClaim") == 1
+        assert env.store.count("Node") == 0  # not registered yet
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.is_launched() and not nc.is_registered()
+        env.clock.step(6)
+        env.tick()
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.is_registered()
+
+    def test_liveness_kills_unregistered_claims(self):
+        env = make_env()
+        nodeclass = env.store.get("KWOKNodeClass", "default")
+        nodeclass.spec.node_registration_delay = 10**9  # never registers
+        env.store.update(nodeclass)
+        env.store.create(make_pod(cpu="1"))
+        env.clock.step(1.5)
+        env.tick()
+        assert env.store.count("NodeClaim") == 1
+        env.clock.step(16 * 60)
+        env.tick()
+        assert env.store.count("NodeClaim") == 0
+
+    def test_zone_spread_e2e(self):
+        env = make_env()
+        sel = {"matchLabels": {"app": "web"}}
+        for _ in range(4):
+            env.store.create(make_pod(labels={"app": "web"}, tsc=[zone_spread(selector=sel)]))
+        env.settle()
+        nodes = {n.metadata.name: n for n in env.store.list("Node")}
+        pods = env.store.list("Pod")
+        assert all(p.spec.node_name for p in pods)
+        # 4 pods / maxSkew 1: every pod must land in a distinct zone
+        pod_zones = [nodes[p.spec.node_name].metadata.labels[wk.ZONE_LABEL_KEY] for p in pods]
+        assert sorted(pod_zones) == sorted({z for z in pod_zones}), pod_zones
+        assert len(set(pod_zones)) == 4
+
+    def test_tpu_backend_e2e(self):
+        env = Environment(options=Options(solver_backend="tpu"))
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        for _ in range(6):
+            env.store.create(make_pod(cpu="1"))
+        env.settle()
+        assert all(p.spec.node_name for p in env.store.list("Pod"))
+        assert env.provisioner.solver.last_backend == "tpu"
+
+    def test_nodepool_limits_cap_fleet(self):
+        np = make_nodepool(requirements=LINUX_AMD64, limits={"cpu": "4"})
+        env = Environment()
+        env.store.create(np)
+        for _ in range(40):
+            env.store.create(make_pod(cpu="1"))
+        env.settle()
+        total_cpu = sum(n.status.capacity["cpu"].value for n in env.store.list("Node"))
+        assert total_cpu <= 4
