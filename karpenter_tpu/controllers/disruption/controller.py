@@ -1,0 +1,113 @@
+"""Disruption controller: the 10s-poll loop running methods in priority order
+(reference: disruption/controller.go:101-183).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ...apis import labels as wk
+from ...utils.pdb import PDBLimits
+from .helpers import build_disruption_budget_mapping
+from .methods import Drift, Emptiness, MultiNodeConsolidation, SingleNodeConsolidation
+from .queue import OrchestrationQueue
+from .types import build_candidate
+
+POLL_SECONDS = 10.0
+
+
+@dataclass
+class _Ctx:
+    store: object
+    cluster: object
+    provisioner: object
+    clock: object
+    options: object
+
+
+class DisruptionController:
+    def __init__(self, store, cluster, provisioner, cloud_provider, clock, options, recorder=None):
+        self.store = store
+        self.cluster = cluster
+        self.provisioner = provisioner
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+        self.options = options
+        ctx = _Ctx(store, cluster, provisioner, clock, options)
+        self.methods = [
+            Emptiness(ctx),
+            Drift(ctx),
+            MultiNodeConsolidation(ctx),
+            SingleNodeConsolidation(ctx),
+        ]
+        self.queue = OrchestrationQueue(store, cluster, provisioner, clock, recorder)
+        self._last_run = -1e18
+
+    def reconcile(self, force: bool = False) -> None:
+        self.queue.reconcile()
+        now = self.clock.now()
+        if not force and now - self._last_run < POLL_SECONDS:
+            return
+        self._last_run = now
+        if not self.cluster.synced():
+            return
+        if self.cluster.consolidated():
+            return
+        self._cleanup_leftover_taints()
+        executed = self.disrupt()
+        if not executed:
+            self.cluster.mark_consolidated()
+
+    def disrupt(self) -> bool:
+        """Run methods in priority order; execute the first command batch
+        (controller.go:166-179)."""
+        for method in self.methods:
+            candidates = self.get_candidates()
+            if not candidates:
+                return False
+            budgets = build_disruption_budget_mapping(self.store, self.cluster, self.clock, method.reason)
+            commands = method.compute_commands(candidates, budgets)
+            started = False
+            for cmd in commands:
+                if cmd.candidates and self.queue.start_command(cmd):
+                    started = True
+            if started:
+                return True
+        return False
+
+    def get_candidates(self) -> list:
+        node_pools = {np.metadata.name: np for np in self.store.list("NodePool")}
+        instance_types = {
+            name: self.cloud_provider.get_instance_types(np) for name, np in node_pools.items()
+        }
+        pdb = PDBLimits(self.store)
+        disrupting = self.queue.disrupting_names()
+        out = []
+        for sn in self.cluster.nodes():
+            if sn.name() in disrupting:
+                continue
+            candidate, err = build_candidate(
+                self.cluster, self.store, self.clock, sn, node_pools, instance_types, pdb
+            )
+            if candidate is not None:
+                out.append(candidate)
+        return out
+
+    def _cleanup_leftover_taints(self) -> None:
+        """Idempotently clear disruption taints on nodes that are not part of
+        an in-flight command (controller.go:147-164)."""
+        active = self.queue.disrupting_names()
+        for node in self.store.list("Node"):
+            if node.metadata.name in active or node.metadata.deletion_timestamp is not None:
+                continue
+            sn = self.cluster.node_for_name(node.metadata.name)
+            if sn is not None and (sn.marked_for_deletion or sn.deleted()):
+                continue  # mid-teardown nodes keep their taint (controller.go:151)
+            if any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints):
+                def untaint(n):
+                    n.spec.taints = [t for t in n.spec.taints if t.key != wk.DISRUPTED_TAINT_KEY]
+
+                self.store.patch("Node", node.metadata.name, untaint)
+                sn = self.cluster.node_for_name(node.metadata.name)
+                if sn is not None:
+                    self.cluster.unmark_for_deletion([sn.provider_id()])

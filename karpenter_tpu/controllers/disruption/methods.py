@@ -1,0 +1,281 @@
+"""Disruption methods, run in priority order: Emptiness -> Drift ->
+MultiNodeConsolidation -> SingleNodeConsolidation.
+
+Reference: disruption/{emptiness,drift,consolidation,multinodeconsolidation,
+singlenodeconsolidation}.go. Each method computes Commands from candidates
+under budget constraints; the controller executes the first non-empty one.
+"""
+
+from __future__ import annotations
+
+from ...apis import labels as wk
+from ...apis.nodeclaim import COND_CONSOLIDATABLE, COND_DRIFTED
+from ...apis.nodepool import BALANCED_K, WHEN_EMPTY, WHEN_EMPTY_OR_UNDERUTILIZED
+from ...cloudprovider.types import order_by_price
+from .helpers import all_non_pending_scheduled, simulate_scheduling
+from .types import REASON_DRIFTED, REASON_EMPTY, REASON_UNDERUTILIZED, Command
+
+MULTI_NODE_CONSOLIDATION_CANDIDATE_CAP = 100  # multinodeconsolidation.go:35
+
+
+class Emptiness:
+    """Delete nodes with no reschedulable pods (emptiness.go)."""
+
+    reason = REASON_EMPTY
+    consolidation_type = "empty"
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def should_disrupt(self, candidate) -> bool:
+        # every consolidation policy permits removing empty nodes; the
+        # Consolidatable condition (consolidateAfter) is the only gate
+        if candidate.node_claim is None:
+            return False
+        if not candidate.node_claim.status.conditions.is_true(COND_CONSOLIDATABLE):
+            return False
+        return len(candidate.reschedulable_pods) == 0
+
+    def compute_commands(self, candidates, budgets) -> list[Command]:
+        empty = [c for c in candidates if self.should_disrupt(c)]
+        allowed = dict(budgets)
+        chosen = []
+        for c in empty:
+            pool = c.node_pool.metadata.name
+            if allowed.get(pool, 0) > 0:
+                chosen.append(c)
+                allowed[pool] -= 1
+        if not chosen:
+            return []
+        return [Command(reason=REASON_EMPTY, candidates=chosen)]
+
+
+class Drift:
+    """Replace drifted nodes (drift.go); drift is detected by the nodeclaim
+    disruption controller setting the Drifted condition."""
+
+    reason = REASON_DRIFTED
+    consolidation_type = "drift"
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def should_disrupt(self, candidate) -> bool:
+        return candidate.node_claim is not None and candidate.node_claim.status.conditions.is_true(COND_DRIFTED)
+
+    def compute_commands(self, candidates, budgets) -> list[Command]:
+        drifted = sorted(
+            (c for c in candidates if self.should_disrupt(c)),
+            key=lambda c: c.disruption_cost,
+        )
+        allowed = dict(budgets)
+        out = []
+        for c in drifted:
+            pool = c.node_pool.metadata.name
+            if allowed.get(pool, 0) <= 0:
+                continue
+            results = simulate_scheduling(self.ctx.provisioner, self.ctx.cluster, [c], self.ctx.clock)
+            if not all_non_pending_scheduled(results, [c]):
+                continue
+            allowed[pool] -= 1
+            out.append(
+                Command(
+                    reason=REASON_DRIFTED,
+                    candidates=[c],
+                    replacements=[nc for nc in results.new_node_claims],
+                    results=results,
+                )
+            )
+        return out
+
+
+class _ConsolidationBase:
+    reason = REASON_UNDERUTILIZED
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def should_disrupt(self, candidate) -> bool:
+        if candidate.node_claim is None:
+            return False
+        policy = candidate.node_pool.spec.disruption.consolidation_policy
+        if policy == WHEN_EMPTY:
+            return False  # only emptiness may disrupt
+        return candidate.node_claim.status.conditions.is_true(COND_CONSOLIDATABLE)
+
+    def compute_consolidation(self, candidates) -> Command:
+        """The consolidation decision (consolidation.go:159-254)."""
+        ctx = self.ctx
+        results = simulate_scheduling(ctx.provisioner, ctx.cluster, candidates, ctx.clock)
+        if not all_non_pending_scheduled(results, candidates):
+            return Command()
+        if len(results.new_node_claims) == 0:
+            return Command(reason=self.reason, candidates=list(candidates), results=results)
+        if len(results.new_node_claims) != 1:
+            return Command()
+
+        candidate_price = sum(c.price for c in candidates)
+        replacement = results.new_node_claims[0]
+        replacement.instance_type_options = order_by_price(replacement.instance_type_options, replacement.requirements)
+
+        all_spot = all(c.capacity_type == wk.CAPACITY_TYPE_SPOT for c in candidates)
+        ct_req = replacement.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY)
+        if all_spot and ct_req.has(wk.CAPACITY_TYPE_SPOT):
+            return self._spot_to_spot(candidates, results, candidate_price)
+
+        # keep only strictly cheaper replacement types (nodeclaim.go:411
+        # RemoveInstanceTypeOptionsByPriceAndMinValues)
+        kept = _filter_by_price(replacement, candidate_price)
+        if not kept:
+            return Command()
+        replacement.instance_type_options = kept
+
+        # if both spot and on-demand survive, force spot so a failed spot
+        # launch doesn't fall back to a pricier on-demand node
+        ct_req = replacement.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY)
+        if ct_req.has(wk.CAPACITY_TYPE_SPOT) and ct_req.has(wk.CAPACITY_TYPE_ON_DEMAND):
+            from ...scheduling.requirements import Requirement
+
+            replacement.requirements.add(Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [wk.CAPACITY_TYPE_SPOT]))
+
+        return Command(reason=self.reason, candidates=list(candidates), replacements=[replacement], results=results)
+
+    def _spot_to_spot(self, candidates, results, candidate_price) -> Command:
+        """Spot-to-spot consolidation (consolidation.go:261-343): gated on the
+        feature flag; single-node requires >= 15 cheaper types and the current
+        instance NOT among the 15 cheapest to avoid churn."""
+        ctx = self.ctx
+        if not ctx.options.feature_gates.spot_to_spot_consolidation:
+            return Command()
+        replacement = results.new_node_claims[0]
+        kept = _filter_by_price(replacement, candidate_price)
+        if not kept:
+            return Command()
+        if len(candidates) == 1:
+            if len(kept) < 15:
+                return Command()
+            cheapest_names = {it.name for it in kept[:15]}
+            if candidates[0].instance_type is not None and candidates[0].instance_type.name in cheapest_names:
+                return Command()
+            kept = kept[:15]
+        replacement.instance_type_options = kept
+        return Command(reason=self.reason, candidates=list(candidates), replacements=[replacement], results=results)
+
+    def _passes_balanced(self, command: Command) -> bool:
+        """Balanced policy gate (balanced.go:108-130): savings%/disruption%
+        >= 1/k with k=2."""
+        balanced = [c for c in command.candidates if c.node_pool.spec.disruption.consolidation_policy == "Balanced"]
+        if not balanced:
+            return True
+        savings = sum(c.price for c in command.candidates) - _replacement_price(command)
+        total_price = sum(c.price for c in command.candidates) or 1e-9
+        disruption = sum(c.disruption_cost for c in command.candidates)
+        total_cost = sum(
+            n.disruption_cost() for n in self.ctx.cluster.nodes() if n.nodepool_name() is not None
+        ) or 1e-9
+        savings_pct = savings / total_price
+        disruption_pct = disruption / total_cost
+        if disruption_pct <= 0:
+            return True
+        return (savings_pct / disruption_pct) >= 1.0 / BALANCED_K
+
+
+class SingleNodeConsolidation(_ConsolidationBase):
+    """Try candidates one at a time, sorted by disruption cost
+    (singlenodeconsolidation.go)."""
+
+    consolidation_type = "single"
+
+    def compute_commands(self, candidates, budgets) -> list[Command]:
+        eligible = sorted((c for c in candidates if self.should_disrupt(c)), key=lambda c: c.disruption_cost)
+        allowed = dict(budgets)
+        for c in eligible:
+            pool = c.node_pool.metadata.name
+            if allowed.get(pool, 0) <= 0:
+                continue
+            cmd = self.compute_consolidation([c])
+            if cmd.candidates and self._passes_balanced(cmd):
+                return [cmd]
+        return []
+
+
+class MultiNodeConsolidation(_ConsolidationBase):
+    """Binary search over candidate-batch size; each probe is a full
+    scheduling simulation (multinodeconsolidation.go:52-191)."""
+
+    consolidation_type = "multi"
+
+    def compute_commands(self, candidates, budgets) -> list[Command]:
+        eligible = [c for c in candidates if self.should_disrupt(c)]
+        # disrupt lowest-cost nodes first
+        eligible.sort(key=lambda c: c.disruption_cost)
+        # budget filter up-front: take at most allowed per pool
+        allowed = dict(budgets)
+        filtered = []
+        for c in eligible:
+            pool = c.node_pool.metadata.name
+            if allowed.get(pool, 0) > 0:
+                filtered.append(c)
+                allowed[pool] -= 1
+        filtered = filtered[:MULTI_NODE_CONSOLIDATION_CANDIDATE_CAP]
+        if len(filtered) < 2:
+            return []
+        cmd = self._first_n_consolidation_option(filtered)
+        if cmd.candidates and self._passes_balanced(cmd):
+            return [cmd]
+        return []
+
+    def _first_n_consolidation_option(self, candidates) -> Command:
+        """firstNConsolidationOption (multinodeconsolidation.go:117-191)."""
+        min_n, max_n = 1, len(candidates)
+        last_valid = Command()
+        while min_n <= max_n:
+            mid = (min_n + max_n) // 2
+            cmd = self.compute_consolidation(candidates[: mid + 1])
+            if not cmd.candidates:
+                max_n = mid - 1
+                continue
+            # replacing with a node of equal price to one being removed is
+            # pointless churn (multinodeconsolidation.go:150-170)
+            if cmd.replacements:
+                replacement_price = _replacement_price(cmd)
+                if any(abs(c.price - replacement_price) < 1e-9 for c in cmd.candidates):
+                    max_n = mid - 1
+                    continue
+            last_valid = cmd
+            min_n = mid + 1
+        return last_valid
+
+
+def _filter_by_price(replacement, max_price: float):
+    """Instance types strictly cheaper than max_price, preserving minValues
+    satisfiability; returns [] when impossible."""
+    from ...cloudprovider.types import satisfies_min_values
+
+    kept = []
+    for it in replacement.instance_type_options:
+        compat = [
+            o
+            for o in it.offerings
+            if o.available and replacement.requirements.intersects(o.requirements) is None
+        ]
+        if compat and min(o.price for o in compat) < max_price:
+            kept.append(it)
+    if kept and replacement.requirements.has_min_values():
+        _, unsat = satisfies_min_values(kept, replacement.requirements)
+        if unsat:
+            return []
+    return kept
+
+
+def _replacement_price(command: Command) -> float:
+    total = 0.0
+    for nc in command.replacements:
+        best = float("inf")
+        for it in nc.instance_type_options:
+            for o in it.offerings:
+                if o.available and nc.requirements.intersects(o.requirements) is None and o.price < best:
+                    best = o.price
+        if best < float("inf"):
+            total += best
+    return total

@@ -1,0 +1,123 @@
+"""Node termination: finalizer-driven drain (reference:
+node/termination/{controller,terminator}.go — call stack SURVEY.md §3.4).
+
+Flow: node deleted (disruption queue or user) -> taint disrupted ->
+evict pods in priority groups (PDB-aware) -> cloud instance deleted ->
+finalizer released.
+
+Eviction in this hermetic substrate models controller-recreated workloads:
+an evicted pod is reset to Pending/unbound (as a ReplicaSet would recreate
+it), which feeds straight back into the provisioner's pending-pod batch.
+DaemonSet- and node-owned pods are deleted with their node.
+"""
+
+from __future__ import annotations
+
+from ...apis import labels as wk
+from ...cloudprovider.errors import NodeClaimNotFoundError
+from ...scheduling.taints import NO_SCHEDULE, Taint
+from ...utils import pods as pod_utils
+from ...utils.pdb import PDBLimits
+
+DISRUPTED_TAINT = Taint(key=wk.DISRUPTED_TAINT_KEY, effect=NO_SCHEDULE)
+
+
+class TerminationController:
+    def __init__(self, store, cluster, cloud_provider, clock, recorder=None):
+        self.store = store
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+        self.recorder = recorder
+
+    def reconcile(self) -> None:
+        for node in self.store.list("Node"):
+            if node.metadata.deletion_timestamp is None:
+                continue
+            if wk.TERMINATION_FINALIZER not in node.metadata.finalizers:
+                continue
+            self._terminate(node)
+
+    def _terminate(self, node) -> None:
+        name = node.metadata.name
+        # 1. taint so nothing new schedules (terminator.go:55)
+        if not any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints):
+            def taint(n):
+                if not any(t.key == wk.DISRUPTED_TAINT_KEY for t in n.spec.taints):
+                    n.spec.taints.append(DISRUPTED_TAINT)
+
+            self.store.patch("Node", name, taint)
+
+        # 2. drain: evict by descending priority groups (terminator.go:96-138)
+        bound = [p for p in self.store.list("Pod") if p.spec.node_name == name and pod_utils.is_active(p)]
+        evictable = [p for p in bound if not pod_utils.is_owned_by_daemonset(p) and not pod_utils.is_owned_by_node(p)]
+        tgp_expired = self._grace_period_expired(node)
+        if evictable:
+            pdb = PDBLimits(self.store)
+            # evict the LOWEST priority group first; critical pods drain last
+            # (terminator.go groupPodsByPriority / graceful-shutdown order)
+            groups = sorted({(p.spec.priority or 0) for p in evictable})
+            first = [p for p in evictable if (p.spec.priority or 0) == groups[0]]
+            progressed = False
+            for p in first:
+                if not tgp_expired:
+                    if pod_utils.is_eviction_blocked(p):
+                        continue  # do-not-disrupt pods wait for TGP
+                    ok, _ = pdb.can_evict(p)
+                    if not ok:
+                        continue
+                    pdb.note_eviction(p)
+                self._evict(p)
+                progressed = True
+            if not progressed and not tgp_expired:
+                return  # blocked; retry next reconcile
+            if len(evictable) > len(first) or not progressed:
+                return  # more groups remain; drain continues next reconcile
+
+        # recheck: everything evictable gone?
+        still = [
+            p
+            for p in self.store.list("Pod")
+            if p.spec.node_name == name and pod_utils.is_active(p) and not pod_utils.is_owned_by_daemonset(p) and not pod_utils.is_owned_by_node(p)
+        ]
+        if still and not tgp_expired:
+            return
+
+        # 3. delete daemon pods with the node
+        for p in self.store.list("Pod"):
+            if p.spec.node_name == name:
+                self.store.try_delete("Pod", p.metadata.name, namespace=p.metadata.namespace)
+
+        # 4. cloud delete + release finalizer (controller.go + [cloud boundary])
+        claim = self._claim_for(node)
+        if claim is not None:
+            try:
+                self.cloud_provider.delete(claim)
+            except NodeClaimNotFoundError:
+                pass
+        self.store.remove_finalizer("Node", name, wk.TERMINATION_FINALIZER)
+
+    def _evict(self, pod) -> None:
+        """Evict = reset to pending (modeling controller recreation)."""
+
+        def apply(p):
+            p.spec.node_name = ""
+            p.status.phase = "Pending"
+            p.status.start_time = None
+
+        self.store.patch("Pod", pod.metadata.name, apply, namespace=pod.metadata.namespace)
+
+    def _grace_period_expired(self, node) -> bool:
+        raw = node.metadata.annotations.get(wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY)
+        if raw is None:
+            return False
+        try:
+            return self.clock.now() >= float(raw)
+        except ValueError:
+            return False
+
+    def _claim_for(self, node):
+        for nc in self.store.list("NodeClaim"):
+            if nc.status.provider_id and nc.status.provider_id == node.spec.provider_id:
+                return nc
+        return None

@@ -1,0 +1,84 @@
+"""NodeClaim disruption-readiness controller: sets the Consolidatable and
+Drifted status conditions (reference: nodeclaim/disruption/{consolidation.go:40,
+drift.go:51-86}).
+"""
+
+from __future__ import annotations
+
+import math
+
+from ...apis import labels as wk
+from ...apis.nodeclaim import COND_CONSOLIDATABLE, COND_DRIFTED, COND_INITIALIZED
+from ...scheduling.requirements import Requirements
+
+
+class NodeClaimDisruptionController:
+    def __init__(self, store, cluster, cloud_provider, clock):
+        self.store = store
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+
+    def reconcile(self) -> None:
+        pools = {np.metadata.name: np for np in self.store.list("NodePool")}
+        for nc in self.store.list("NodeClaim"):
+            if nc.metadata.deletion_timestamp is not None:
+                continue
+            pool = pools.get(nc.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, ""))
+            if pool is None:
+                continue
+            changed = self._consolidatable(nc, pool)
+            changed |= self._drifted(nc, pool)
+            if changed:
+                try:
+                    self.store.update(nc)
+                    self.cluster.update_node_claim(nc)
+                except Exception:
+                    pass
+
+    def _consolidatable(self, nc, pool) -> bool:
+        """Consolidatable flips true once consolidateAfter has elapsed since
+        the last pod event (or initialization)."""
+        if not nc.status.conditions.is_true(COND_INITIALIZED):
+            return nc.status.conditions.clear(COND_CONSOLIDATABLE)
+        ca = pool.spec.disruption.consolidate_after_seconds()
+        if ca == math.inf:  # Never
+            return nc.status.conditions.clear(COND_CONSOLIDATABLE)
+        init = nc.status.conditions.get(COND_INITIALIZED)
+        base = nc.status.last_pod_event_time or init.last_transition_time
+        if self.clock.now() - base >= ca:
+            return nc.status.conditions.set_true(COND_CONSOLIDATABLE, now=self.clock.now())
+        return nc.status.conditions.set_false(
+            COND_CONSOLIDATABLE, "NotConsolidatable", now=self.clock.now()
+        )
+
+    def _drifted(self, nc, pool) -> bool:
+        """Drift = cloud-provider drift, nodepool static-hash drift, or
+        requirement drift (drift.go:51-150)."""
+        if not nc.is_launched():
+            return False
+        reason = ""
+        cp_reason = self.cloud_provider.is_drifted(nc)
+        if cp_reason:
+            reason = cp_reason
+        elif self._static_drift(nc, pool):
+            reason = "NodePoolStaticDrift"
+        elif self._requirement_drift(nc, pool):
+            reason = "RequirementsDrifted"
+        if reason:
+            return nc.status.conditions.set_true(COND_DRIFTED, reason=reason, now=self.clock.now())
+        return nc.status.conditions.clear(COND_DRIFTED)
+
+    @staticmethod
+    def _static_drift(nc, pool) -> bool:
+        claim_hash = nc.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION_KEY)
+        return claim_hash is not None and claim_hash != pool.hash()
+
+    @staticmethod
+    def _requirement_drift(nc, pool) -> bool:
+        """compatible(), not intersects(): a NodePool requirement on a key the
+        claim lacks entirely must flag drift (drift.go:175)."""
+        pool_reqs = Requirements.from_node_selector_terms(pool.spec.template.requirements)
+        pool_reqs.add(*Requirements.from_labels(pool.spec.template.labels).values())
+        claim_labels = Requirements.from_labels(nc.metadata.labels)
+        return claim_labels.compatible(pool_reqs) is not None

@@ -143,26 +143,37 @@ class LifecycleController:
         if age > REGISTRATION_TTL_SECONDS:
             self.store.try_delete("NodeClaim", nc.metadata.name)
 
-    # -- claim termination (lifecycle/termination.go): instance gone, node
-    # deleted, finalizer released. The graceful pod-drain path lives in the
-    # node termination controller; this is the claim-side teardown.
+    # -- claim termination (lifecycle/termination.go): node drained first (the
+    # node termination controller owns the drain), then instance gone, then
+    # the claim finalizer is released.
     def _terminate(self, nc: NodeClaim) -> None:
         from ...cloudprovider.errors import NodeClaimNotFoundError
 
+        node = None
+        if nc.status.node_name:
+            node = self.store.try_get("Node", nc.status.node_name)
+        if node is None and nc.status.provider_id:
+            node = next(
+                (n for n in self.store.list("Node") if n.spec.provider_id == nc.status.provider_id), None
+            )
+        if node is not None:
+            if node.metadata.deletion_timestamp is None:
+                # stamp the forced-drain deadline so terminationGracePeriod can
+                # override blocked PDBs / do-not-disrupt (termination.go TGP)
+                if nc.spec.termination_grace_period is not None:
+                    deadline = self.clock.now() + nc.spec.termination_grace_period
+
+                    def stamp(n):
+                        n.metadata.annotations[wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY] = str(deadline)
+
+                    self.store.patch("Node", node.metadata.name, stamp)
+                self.store.try_delete("Node", node.metadata.name)  # graceful: drain runs
+            return  # wait for the termination controller to finish the drain
         if nc.status.provider_id:
             try:
                 self.cloud_provider.delete(nc)
             except NodeClaimNotFoundError:
                 pass
-        if nc.status.node_name:
-            node = self.store.try_get("Node", nc.status.node_name)
-            if node is not None and node.metadata.deletion_timestamp is None:
-                self.store.try_delete("Node", nc.status.node_name)
-                node = self.store.try_get("Node", nc.status.node_name)
-            if node is not None:
-                # claim-side teardown releases the node finalizer too when no
-                # separate termination controller is driving the drain
-                self.store.remove_finalizer("Node", nc.status.node_name, wk.TERMINATION_FINALIZER)
         self.store.remove_finalizer("NodeClaim", nc.metadata.name, wk.TERMINATION_FINALIZER)
 
     def _node_for(self, nc: NodeClaim):

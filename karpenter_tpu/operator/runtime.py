@@ -13,6 +13,9 @@ from __future__ import annotations
 from ..apis.kwoknodeclass import KWOKNodeClass
 from ..cloudprovider import catalog
 from ..cloudprovider.kwok import KWOKCloudProvider
+from ..controllers.disruption import DisruptionController
+from ..controllers.nodeclaim.disruption import NodeClaimDisruptionController
+from ..controllers.node.termination import TerminationController
 from ..controllers.nodeclaim.garbagecollection import GarbageCollectionController
 from ..controllers.nodeclaim.lifecycle import LifecycleController
 from ..controllers.provisioning.provisioner import Provisioner, ProvisionerOptions
@@ -59,7 +62,12 @@ class Environment:
         self.lifecycle = LifecycleController(self.store, self.cluster, self.cloud_provider, self.clock)
         self.gc = GarbageCollectionController(self.store, self.cluster, self.cloud_provider, self.clock)
         self.binder = Binder(self.store, self.cluster, self.clock)
-        self.extra_controllers: list = []  # disruption etc. appended as built
+        self.termination = TerminationController(self.store, self.cluster, self.cloud_provider, self.clock)
+        self.nodeclaim_disruption = NodeClaimDisruptionController(self.store, self.cluster, self.cloud_provider, self.clock)
+        self.disruption = DisruptionController(
+            self.store, self.cluster, self.provisioner, self.cloud_provider, self.clock, self.options
+        )
+        self.extra_controllers: list = []  # later controllers appended as built
 
         # pod watch triggers the provisioner batcher (state informer §3.5)
         self.store.watch("Pod", lambda e, p: self.provisioner.trigger(p.metadata.uid) if e != "DELETED" else None)
@@ -81,8 +89,12 @@ class Environment:
         if hasattr(self.cloud_provider, "flush_pending"):
             self.cloud_provider.flush_pending()
         self.lifecycle.reconcile_all()
+        self.termination.reconcile()
+        self.lifecycle.reconcile_all()  # claims whose node finished draining release
         self.gc.reconcile()
         self.binder.bind_all()
+        self.nodeclaim_disruption.reconcile()
+        self.disruption.reconcile()
         for c in self.extra_controllers:
             c.reconcile()
 
