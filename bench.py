@@ -61,7 +61,58 @@ def build_snapshot(n_pods: int, n_types: int):
     )
 
 
+def bench_consolidation():
+    """256-node multi-node consolidation search (BASELINE north star: <5s)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.models.consolidation_model import ConsolidationTensors, anneal
+
+    rng = np.random.default_rng(0)
+    N = int(os.environ.get("BENCH_NODES", "256"))
+    util = rng.uniform(0.2, 0.8, N)
+    cap = rng.choice([4, 8, 16, 32], N).astype(np.float32)
+    used = (cap * util).astype(np.float32)
+    T = 500
+    t = ConsolidationTensors(
+        node_price=jnp.asarray(cap * 0.027),
+        node_cost=jnp.asarray(rng.uniform(0.5, 5.0, N).astype(np.float32)),
+        node_slack=jnp.asarray(np.stack([cap - used, (cap - used) * 2, np.full(N, 50.0), np.full(N, 20.0)], 1).astype(np.float32)),
+        node_used=jnp.asarray(np.stack([used, used * 2, util * 10, used * 0.1], 1).astype(np.float32)),
+        node_npods=jnp.asarray((util * 10).astype(np.float32)),
+        pod_compat=jnp.asarray((np.ones((N, N)) - np.eye(N)).astype(np.float32)),
+        row_alloc=jnp.asarray(
+            np.stack([np.tile([3.9, 7.9, 15.9, 31.9, 63.9], 100), np.tile([7.8, 15.8, 31.8, 63.8, 127.8], 100), np.full(T, 110.0), np.full(T, 20.0)], 1).astype(np.float32)
+        ),
+        row_price=jnp.asarray(np.tile([0.108, 0.217, 0.434, 0.868, 1.74], 100).astype(np.float32)),
+    )
+    key = jax.random.PRNGKey(0)
+    out = anneal(t, key, n_chains=128, n_steps=2048)
+    out[1].block_until_ready()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        bx, bs = anneal(t, key, n_chains=128, n_steps=2048)
+        bs.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    print(
+        json.dumps(
+            {
+                "metric": f"consolidation_{N}nodes_anneal_seconds",
+                "value": round(best, 4),
+                "unit": "s",
+                "vs_baseline": round(5.0 / best, 2),  # north-star 5s budget / actual
+            }
+        )
+    )
+
+
 def main():
+    if os.environ.get("BENCH_MODE") == "consolidation":
+        bench_consolidation()
+        return
     from karpenter_tpu.models.scheduler_model import greedy_pack, make_tensors
     from karpenter_tpu.solver.encode import encode
 

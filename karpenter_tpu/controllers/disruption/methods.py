@@ -220,10 +220,49 @@ class MultiNodeConsolidation(_ConsolidationBase):
         filtered = filtered[:MULTI_NODE_CONSOLIDATION_CANDIDATE_CAP]
         if len(filtered) < 2:
             return []
+        # TPU backend: annealed subset search proposes candidate sets; each is
+        # exact-validated through the same simulation before use (stage 8)
+        if getattr(self.ctx.options, "solver_backend", "ffd") == "tpu":
+            cmd = self._annealed_option(filtered)
+            if cmd.candidates and self._passes_balanced(cmd):
+                return [cmd]
         cmd = self._first_n_consolidation_option(filtered)
         if cmd.candidates and self._passes_balanced(cmd):
             return [cmd]
         return []
+
+    def _annealed_option(self, candidates) -> Command:
+        """Device subset search + host exact validation."""
+        import logging
+
+        from ...solver.consolidation import propose_subsets
+
+        pools = {c.node_pool.metadata.name: c.node_pool for c in candidates}
+        its = []
+        for name in pools:
+            its.extend(self.ctx.provisioner.cloud_provider.get_instance_types(pools[name]))
+        try:
+            proposals = propose_subsets(candidates, its)
+        except (ValueError, TypeError, RuntimeError) as e:
+            logging.getLogger("karpenter.disruption").warning("annealed consolidation search failed, falling back: %s", e)
+            return Command()
+        for subset in proposals:
+            chosen = [candidates[i] for i in subset]
+            cmd = self.compute_consolidation(chosen)
+            if cmd.candidates:
+                if self._is_pointless_churn(cmd):
+                    continue
+                return cmd
+        return Command()
+
+    @staticmethod
+    def _is_pointless_churn(cmd: Command) -> bool:
+        """Replacing with a node priced equal to one being removed is churn
+        (multinodeconsolidation.go:150-170)."""
+        if not cmd.replacements:
+            return False
+        rep = _replacement_price(cmd)
+        return any(abs(c.price - rep) < 1e-9 for c in cmd.candidates)
 
     def _first_n_consolidation_option(self, candidates) -> Command:
         """firstNConsolidationOption (multinodeconsolidation.go:117-191)."""
@@ -235,13 +274,9 @@ class MultiNodeConsolidation(_ConsolidationBase):
             if not cmd.candidates:
                 max_n = mid - 1
                 continue
-            # replacing with a node of equal price to one being removed is
-            # pointless churn (multinodeconsolidation.go:150-170)
-            if cmd.replacements:
-                replacement_price = _replacement_price(cmd)
-                if any(abs(c.price - replacement_price) < 1e-9 for c in cmd.candidates):
-                    max_n = mid - 1
-                    continue
+            if self._is_pointless_churn(cmd):
+                max_n = mid - 1
+                continue
             last_valid = cmd
             min_n = mid + 1
         return last_valid

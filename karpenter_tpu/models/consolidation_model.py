@@ -1,0 +1,120 @@
+"""Multi-node consolidation as a batched simulated-annealing repack on TPU.
+
+Replaces the reference's binary-search-over-prefix (multinodeconsolidation.go:
+117-191: O(log N) full scheduling simulations over a cost-sorted prefix) with
+a parallel subset search: decision vector x[node] in {keep, delete}, many
+independent annealing chains vmapped across the chip, objective =
+price-saved - churn - replacement cost.
+
+Feasibility inside the chain is the RELAXED capacity test (per-resource slack
+of kept nodes + at most one replacement row must cover the displaced pods,
+and each displaced pod must be compatible with spare capacity somewhere) —
+cheap enough for O(steps x chains) evaluation. The winning subsets are
+re-validated exactly on the host through the same scheduling simulation the
+reference uses (SURVEY.md §7 stage 8: "validate the winning command exactly
+... before execution"), so relaxation can only cost optimality, never
+correctness.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+BIG = jnp.float32(3.4e38)
+
+
+@dataclass
+class ConsolidationTensors:
+    """Device inputs for one consolidation search."""
+
+    node_price: jnp.ndarray  # [N] current price of each candidate node
+    node_cost: jnp.ndarray  # [N] disruption (churn) cost
+    node_slack: jnp.ndarray  # [N, R] free allocatable on each node if kept
+    node_used: jnp.ndarray  # [N, R] resources its reschedulable pods need
+    node_npods: jnp.ndarray  # [N] reschedulable pod count
+    pod_compat: jnp.ndarray  # [N, N] indexed [j host, i deleted]: 1.0 when host
+    #                           node j's labels satisfy deleted node i's pods
+    row_alloc: jnp.ndarray  # [T, R] allocatable of replacement rows
+    row_price: jnp.ndarray  # [T] price of replacement rows
+    # pure price savings by default: the reference's search doesn't penalize
+    # churn (budgets and the Balanced policy own that tradeoff); a tiny weight
+    # still breaks ties toward disrupting cheap-to-move nodes
+    churn_weight: float = 1e-4
+
+
+jax.tree_util.register_dataclass(
+    ConsolidationTensors,
+    data_fields=["node_price", "node_cost", "node_slack", "node_used", "node_npods", "pod_compat", "row_alloc", "row_price"],
+    meta_fields=["churn_weight"],
+)
+
+
+def _objective(t: ConsolidationTensors, x):
+    """x: [N] bool (True = delete). Returns (score, feasible).
+
+    Relaxed feasibility: displaced pod mass must fit the aggregate slack of
+    kept+compatible nodes plus at most one replacement row; the replacement is
+    the cheapest row whose allocatable covers the shortfall.
+    """
+    xf = x.astype(jnp.float32)
+    keep = 1.0 - xf
+
+    displaced = (t.node_used * xf[:, None]).sum(axis=0)  # [R]
+    n_displaced = jnp.maximum((t.node_npods * xf).sum(), 1.0)
+    avg_pod = displaced / n_displaced  # [R] — pods are atomic: a kept node's
+    # slack only counts if it can host at least one average displaced pod
+    compat_to_any_deleted = jnp.max(t.pod_compat * xf[None, :], axis=1)  # [N]
+    can_host_one = jnp.all(t.node_slack >= avg_pod[None, :], axis=1).astype(jnp.float32)  # [N]
+    usable_slack = (t.node_slack * (keep * compat_to_any_deleted * can_host_one)[:, None]).sum(axis=0)  # [R]
+
+    shortfall = jnp.maximum(displaced - usable_slack, 0.0)  # [R]
+    needs_replacement = jnp.any(shortfall > 0)
+
+    row_fits = jnp.all(t.row_alloc >= shortfall[None, :], axis=1)  # [T]
+    row_cost = jnp.where(row_fits, t.row_price, BIG)
+    best_row_cost = jnp.min(row_cost)
+    replacement_cost = jnp.where(needs_replacement, best_row_cost, 0.0)
+    feasible = jnp.logical_or(~needs_replacement, best_row_cost < BIG)
+
+    savings = (t.node_price * xf).sum() - replacement_cost
+    churn = t.churn_weight * (t.node_cost * xf).sum()
+    score = jnp.where(feasible, savings - churn, -BIG)
+    return score, feasible
+
+
+@partial(jax.jit, static_argnames=("n_chains", "n_steps"))
+def anneal(t: ConsolidationTensors, key, n_chains: int = 64, n_steps: int = 512):
+    """Parallel annealing chains; returns (best_x [n_chains, N], best_score
+    [n_chains]) — the host picks, dedups and exact-validates the top subsets."""
+    N = t.node_price.shape[0]
+
+    def chain(key):
+        k_init, k_loop = jax.random.split(key)
+        # start from "delete the cheap-to-disrupt half" style random inits
+        x0 = jax.random.bernoulli(k_init, 0.3, (N,))
+        s0, _ = _objective(t, x0)
+
+        def step(i, carry):
+            x, s, best_x, best_s, key = carry
+            key, k1, k2 = jax.random.split(key, 3)
+            flip = jax.random.randint(k1, (), 0, N)
+            x2 = x.at[flip].set(~x[flip])
+            s2, _ = _objective(t, x2)
+            temp = jnp.maximum(0.02, 1.0 - i / n_steps) * (jnp.abs(s) * 0.1 + 1e-3)
+            accept = jnp.logical_or(s2 >= s, jax.random.uniform(k2) < jnp.exp(jnp.clip((s2 - s) / temp, -50, 0)))
+            x = jnp.where(accept, x2, x)
+            s = jnp.where(accept, s2, s)
+            improved = s > best_s
+            best_x = jnp.where(improved, x, best_x)
+            best_s = jnp.where(improved, s, best_s)
+            return (x, s, best_x, best_s, key)
+
+        x, s, best_x, best_s, _ = jax.lax.fori_loop(0, n_steps, step, (x0, s0, x0, s0, k_loop))
+        return best_x, best_s
+
+    keys = jax.random.split(key, n_chains)
+    return jax.vmap(chain)(keys)

@@ -1,0 +1,157 @@
+"""TPU-accelerated multi-node consolidation: encode candidates, run the
+annealed subset search on device, exact-validate winners on host.
+
+Plugs into MultiNodeConsolidation as the candidate-subset proposer; the
+reference's binary search stays as the fallback/default path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..apis import labels as wk
+from ..scheduling.requirements import Requirements
+from ..utils import resources as res
+from .encode import _scale
+
+
+def encode_candidates(candidates, instance_types, template_reqs=None):
+    """Candidates + replacement catalog -> ConsolidationTensors (numpy)."""
+    import jax.numpy as jnp
+
+    from ..models.consolidation_model import ConsolidationTensors
+
+    rnames = ["cpu", "memory", "pods", "ephemeral-storage"]
+    seen = set(rnames)
+    for c in candidates:
+        for p in c.reschedulable_pods:
+            for k in res.pod_requests(p):
+                if k not in seen:
+                    seen.add(k)
+                    rnames.append(k)  # extended resources (accelerators etc.)
+    ridx = {k: i for i, k in enumerate(rnames)}
+    N = len(candidates)
+    R = len(rnames)
+
+    def vec(rl):
+        v = np.zeros(R, dtype=np.float32)
+        for k, q in rl.items():
+            i = ridx.get(k)
+            if i is not None:
+                v[i] = _scale(k, q)
+        return v
+
+    node_price = np.array([c.price for c in candidates], dtype=np.float32)
+    node_cost = np.array([c.disruption_cost for c in candidates], dtype=np.float32)
+    node_slack = np.zeros((N, R), dtype=np.float32)
+    node_used = np.zeros((N, R), dtype=np.float32)
+    node_npods = np.zeros(N, dtype=np.float32)
+    for i, c in enumerate(candidates):
+        sn = c.state_node
+        node_slack[i] = vec(res.subtract(sn.allocatable(), sn.total_pod_requests()))
+        node_used[i] = vec(res.requests_for_pods(c.reschedulable_pods))
+        node_npods[i] = len(c.reschedulable_pods)
+
+    # pod-mass compatibility between candidate nodes: node j can host node i's
+    # pods if j's labels satisfy the pods' common requirements (cheap proxy:
+    # same-pool or compatible label sets)
+    reqs_per_node = []
+    for c in candidates:
+        merged = Requirements()
+        for p in c.reschedulable_pods:
+            merged.add(*Requirements.from_pod(p, strict=True).values())
+        reqs_per_node.append(merged)
+    compat = np.ones((N, N), dtype=np.float32)
+    for j, cj in enumerate(candidates):
+        labels_j = Requirements.from_labels(cj.state_node.labels())
+        for i in range(N):
+            if i == j:
+                compat[j, i] = 0.0  # a deleted node can't host its own pods
+                continue
+            compat[j, i] = 1.0 if labels_j.compatible(reqs_per_node[i]) is None else 0.0
+
+    rows_alloc, rows_price = [], []
+    for it in instance_types:
+        alloc = vec(it.allocatable())
+        for o in it.offerings:
+            if not o.available:
+                continue
+            rows_alloc.append(alloc)
+            rows_price.append(o.price)
+    if not rows_alloc:
+        rows_alloc = [np.zeros(R, dtype=np.float32)]
+        rows_price = [np.float32(3.4e38)]
+
+    # pad N and T up to repeatable buckets so anneal() (jitted on shape)
+    # doesn't retrace every time the fleet size changes
+    padded_n = _bucket(N)
+    if padded_n > N:
+        pad = padded_n - N
+        node_price = np.pad(node_price, (0, pad))  # price 0: deleting a pad row never helps
+        node_cost = np.pad(node_cost, (0, pad), constant_values=1e6)
+        node_slack = np.pad(node_slack, ((0, pad), (0, 0)))
+        node_used = np.pad(node_used, ((0, pad), (0, 0)))
+        node_npods = np.pad(node_npods, (0, pad))
+        compat = np.pad(compat, ((0, pad), (0, pad)))
+    rows_alloc_arr = np.stack(rows_alloc)
+    rows_price_arr = np.array(rows_price, dtype=np.float32)
+    padded_t = _bucket(rows_alloc_arr.shape[0])
+    if padded_t > rows_alloc_arr.shape[0]:
+        pad = padded_t - rows_alloc_arr.shape[0]
+        rows_alloc_arr = np.pad(rows_alloc_arr, ((0, pad), (0, 0)))  # zero alloc: never fits
+        rows_price_arr = np.pad(rows_price_arr, (0, pad), constant_values=3.4e38)
+
+    return ConsolidationTensors(
+        node_price=jnp.asarray(node_price),
+        node_cost=jnp.asarray(node_cost),
+        node_slack=jnp.asarray(node_slack),
+        node_used=jnp.asarray(node_used),
+        node_npods=jnp.asarray(node_npods),
+        pod_compat=jnp.asarray(compat),  # [j host, i deleted]
+        row_alloc=jnp.asarray(rows_alloc_arr),
+        row_price=jnp.asarray(rows_price_arr),
+    )
+
+
+def _bucket(n: int) -> int:
+    """Round up to the next power-of-two-ish bucket (min 16)."""
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+def propose_subsets(candidates, instance_types, seed: int = 0, max_proposals: int = 8) -> list[list[int]]:
+    """Run the device search; return candidate-index subsets, best first."""
+    import jax
+
+    from ..models.consolidation_model import anneal
+
+    if len(candidates) < 2:
+        return []
+    n = len(candidates)
+    t = encode_candidates(candidates, instance_types)
+    best_x, best_s = anneal(t, jax.random.PRNGKey(seed))
+    best_x = np.asarray(best_x)
+    best_s = np.asarray(best_s)
+    order = np.argsort(-best_s)
+    seen = set()
+    out: list[list[int]] = []
+    for idx in order:
+        if best_s[idx] <= 0:
+            continue
+        subset = tuple(i for i in np.nonzero(best_x[idx])[0].tolist() if i < n)
+        if not subset or subset in seen:
+            continue
+        seen.add(subset)
+        out.append(list(subset))
+        if len(out) >= max_proposals:
+            break
+    # when the annealer DID find profitable subsets, also offer the full set:
+    # the relaxed objective can prefer subsets whose exact validation is
+    # churn-rejected while the full set is profitable. With zero proposals
+    # there's no signal to justify an extra full-fleet simulation.
+    full = tuple(range(n))
+    if out and full not in seen:
+        out.append(list(full))
+    return out
