@@ -23,12 +23,13 @@ REGISTRATION_TTL_SECONDS = 15 * 60  # liveness.go:39 registrationTTL
 
 
 class LifecycleController:
-    def __init__(self, store, cluster, cloud_provider, clock, recorder=None):
+    def __init__(self, store, cluster, cloud_provider, clock, recorder=None, np_state=None):
         self.store = store
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock
         self.recorder = recorder
+        self.np_state = np_state  # nodepoolhealth.NodePoolHealthState
 
     def reconcile_all(self) -> None:
         for nc in self.store.list("NodeClaim"):
@@ -105,6 +106,7 @@ class LifecycleController:
         self.store.patch("Node", node.metadata.name, apply)
         nc.status.node_name = node.metadata.name
         nc.status.conditions.set_true(COND_REGISTERED, now=self.clock.now())
+        self._record_registration_outcome(nc, success=True)
         return True
 
     # -- Initialization (initialization.go): node ready + resources registered -
@@ -141,7 +143,45 @@ class LifecycleController:
             return
         age = self.clock.now() - nc.metadata.creation_timestamp
         if age > REGISTRATION_TTL_SECONDS:
+            self._record_registration_outcome(nc, success=False)
             self.store.try_delete("NodeClaim", nc.metadata.name)
+
+    def _record_registration_outcome(self, nc: NodeClaim, success: bool) -> None:
+        """Feed the per-pool health tracker and flip NodeRegistrationHealthy
+        when the windowed outcome crosses the threshold (registration.go:178-200,
+        liveness.go:113-145)."""
+        if self.np_state is None or not nc.nodepool_name:
+            return
+        pool = self.store.try_get("NodePool", nc.nodepool_name)
+        if pool is None:
+            return
+        from ...apis.nodepool import COND_NODE_REGISTRATION_HEALTHY
+        from ...state import nodepoolhealth
+
+        uid = pool.metadata.uid
+        if success:
+            if self.np_state.dry_run(uid, True) == nodepoolhealth.STATUS_HEALTHY and not pool.status.conditions.is_true(
+                COND_NODE_REGISTRATION_HEALTHY
+            ):
+                def apply(obj):
+                    obj.status.conditions.set_true(COND_NODE_REGISTRATION_HEALTHY, now=self.clock.now())
+
+                self.store.patch("NodePool", pool.metadata.name, apply)
+        else:
+            if self.np_state.dry_run(uid, False) == nodepoolhealth.STATUS_UNHEALTHY and not pool.status.conditions.is_false(
+                COND_NODE_REGISTRATION_HEALTHY
+            ):
+                launched = nc.status.conditions.get("Launched")
+                if launched is not None and launched.status != "True":
+                    reason, message = launched.reason, launched.message
+                else:
+                    reason, message = "RegistrationFailed", "Failed to register node"
+
+                def apply(obj, reason=reason, message=message):
+                    obj.status.conditions.set_false(COND_NODE_REGISTRATION_HEALTHY, reason, message, now=self.clock.now())
+
+                self.store.patch("NodePool", pool.metadata.name, apply)
+        self.np_state.update(uid, success)
 
     # -- claim termination (lifecycle/termination.go): node drained first (the
     # node termination controller owns the drain), then instance gone, then

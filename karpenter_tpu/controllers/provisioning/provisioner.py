@@ -10,6 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ...apis import labels as wk
+from ...apis.nodepool import COND_NODEPOOL_READY
 from ...solver import FFDSolver, SolverSnapshot
 from ...utils import pods as pod_utils
 from ...utils import resources as res
@@ -81,7 +82,16 @@ class Provisioner:
 
     def make_snapshot(self, pods: list, state_nodes=None, exclude_deleting: bool = True) -> SolverSnapshot:
         """Snapshot assembly (provisioner.go:261-348 NewScheduler)."""
-        node_pools = [np for np in self.store.list("NodePool") if not np.is_static()]
+        # skip static pools, deleting pools, and pools an aux controller has
+        # explicitly marked not-Ready (provisioner.go:272-281; absence of the
+        # condition counts ready so direct-wired tests need no readiness pass)
+        node_pools = [
+            np
+            for np in self.store.list("NodePool")
+            if not np.is_static()
+            and np.metadata.deletion_timestamp is None
+            and not np.status.conditions.is_false(COND_NODEPOOL_READY)
+        ]
         instance_types = {}
         for np in node_pools:
             its = self.cloud_provider.get_instance_types(np)

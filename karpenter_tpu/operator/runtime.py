@@ -18,12 +18,20 @@ from ..controllers.nodeclaim.disruption import NodeClaimDisruptionController
 from ..controllers.node.termination import TerminationController
 from ..controllers.nodeclaim.garbagecollection import GarbageCollectionController
 from ..controllers.nodeclaim.lifecycle import LifecycleController
+from ..controllers.nodepool import (
+    NodePoolCounterController,
+    NodePoolHashController,
+    NodePoolReadinessController,
+    NodePoolRegistrationHealthController,
+    NodePoolValidationController,
+)
 from ..controllers.provisioning.provisioner import Provisioner, ProvisionerOptions
 from ..kube import Store
 from ..kube.binder import Binder
 from ..solver import FFDSolver
 from ..state import Cluster
 from ..state.informer import start_informers
+from ..state.nodepoolhealth import NodePoolHealthState
 from ..utils.clock import Clock, FakeClock
 from .options import Options
 
@@ -59,7 +67,10 @@ class Environment:
                 batch_max_seconds=self.options.batch_max_duration,
             ),
         )
-        self.lifecycle = LifecycleController(self.store, self.cluster, self.cloud_provider, self.clock)
+        self.np_state = NodePoolHealthState()
+        self.lifecycle = LifecycleController(
+            self.store, self.cluster, self.cloud_provider, self.clock, np_state=self.np_state
+        )
         self.gc = GarbageCollectionController(self.store, self.cluster, self.cloud_provider, self.clock)
         self.binder = Binder(self.store, self.cluster, self.clock)
         self.termination = TerminationController(self.store, self.cluster, self.cloud_provider, self.clock)
@@ -67,6 +78,11 @@ class Environment:
         self.disruption = DisruptionController(
             self.store, self.cluster, self.provisioner, self.cloud_provider, self.clock, self.options
         )
+        self.nodepool_hash = NodePoolHashController(self.store)
+        self.nodepool_counter = NodePoolCounterController(self.store, self.cluster)
+        self.nodepool_readiness = NodePoolReadinessController(self.store, self.clock)
+        self.nodepool_registration_health = NodePoolRegistrationHealthController(self.store, self.np_state, self.clock)
+        self.nodepool_validation = NodePoolValidationController(self.store, self.clock)
         self.extra_controllers: list = []  # later controllers appended as built
 
         # pod watch triggers the provisioner batcher (state informer §3.5)
@@ -84,6 +100,10 @@ class Environment:
         """One controller round: provision -> launch/register/init -> bind."""
         if hasattr(self.cloud_provider, "flush_pending"):
             self.cloud_provider.flush_pending()
+        self.nodepool_hash.reconcile()
+        self.nodepool_validation.reconcile()
+        self.nodepool_registration_health.reconcile()
+        self.nodepool_readiness.reconcile()
         self.provisioner.reconcile(force=provision_force)
         self.lifecycle.reconcile_all()
         if hasattr(self.cloud_provider, "flush_pending"):
@@ -93,6 +113,7 @@ class Environment:
         self.lifecycle.reconcile_all()  # claims whose node finished draining release
         self.gc.reconcile()
         self.binder.bind_all()
+        self.nodepool_counter.reconcile()
         self.nodeclaim_disruption.reconcile()
         self.disruption.reconcile()
         for c in self.extra_controllers:

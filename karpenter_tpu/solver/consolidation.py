@@ -9,13 +9,12 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..apis import labels as wk
 from ..scheduling.requirements import Requirements
 from ..utils import resources as res
 from .encode import _scale
 
 
-def encode_candidates(candidates, instance_types, template_reqs=None):
+def encode_candidates(candidates, instance_types):
     """Candidates + replacement catalog -> ConsolidationTensors (numpy)."""
     import jax.numpy as jnp
 

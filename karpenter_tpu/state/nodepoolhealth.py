@@ -1,0 +1,85 @@
+"""Per-NodePool registration-health tracking.
+
+Reference: pkg/state/nodepoolhealth/tracker.go — a 4-slot ring buffer of
+launch/registration outcomes per NodePool UID; >=50% failures within the
+window flips the pool's NodeRegistrationHealthy condition False.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.ringbuffer import RingBuffer
+
+BUFFER_SIZE = 4
+THRESHOLD_FALSE = 0.5  # fraction of failures for StatusUnhealthy
+
+STATUS_UNKNOWN = "Unknown"
+STATUS_HEALTHY = "Healthy"
+STATUS_UNHEALTHY = "Unhealthy"
+
+
+class Tracker:
+    def __init__(self, capacity: int = BUFFER_SIZE):
+        self._lock = threading.RLock()
+        self._capacity = capacity
+        self._buffer: RingBuffer[bool] = RingBuffer(capacity)
+
+    def update(self, success: bool) -> None:
+        with self._lock:
+            self._buffer.insert(success)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._buffer.reset()
+
+    def status(self) -> str:
+        with self._lock:
+            if len(self._buffer) == 0:
+                return STATUS_UNKNOWN
+            failures = sum(1 for v in self._buffer.items() if not v)
+            if failures / self._capacity >= THRESHOLD_FALSE:
+                return STATUS_UNHEALTHY
+            return STATUS_HEALTHY
+
+    def set_status(self, status: str) -> None:
+        with self._lock:
+            self._buffer.reset()
+            if status == STATUS_HEALTHY:
+                self._buffer.insert(True)
+            elif status == STATUS_UNHEALTHY:
+                for _ in range(int(self._capacity * THRESHOLD_FALSE)):
+                    self._buffer.insert(False)
+
+    def snapshot(self) -> list[bool]:
+        with self._lock:
+            return self._buffer.items()
+
+
+class NodePoolHealthState:
+    """Map of NodePool UID -> Tracker (reference: tracker.go State)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._trackers: dict[str, Tracker] = {}
+
+    def _tracker(self, uid: str) -> Tracker:
+        with self._lock:
+            return self._trackers.setdefault(uid, Tracker())
+
+    def status(self, uid: str) -> str:
+        return self._tracker(uid).status()
+
+    def update(self, uid: str, success: bool) -> None:
+        self._tracker(uid).update(success)
+
+    def set_status(self, uid: str, status: str) -> None:
+        self._tracker(uid).set_status(status)
+
+    def dry_run(self, uid: str, success: bool) -> str:
+        """Status as-if one more outcome were recorded, without recording it."""
+        t = Tracker()
+        for item in self._tracker(uid).snapshot():
+            t.update(item)
+        t.update(success)
+        return t.status()

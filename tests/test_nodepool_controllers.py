@@ -1,0 +1,236 @@
+"""NodePool aux controllers (reference: pkg/controllers/nodepool/{hash,counter,
+readiness,registrationhealth,validation}).
+"""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import COND_DRIFTED
+from karpenter_tpu.apis.nodepool import (
+    COND_NODE_REGISTRATION_HEALTHY,
+    COND_NODEPOOL_READY,
+    COND_NODEPOOL_VALIDATION_SUCCEEDED,
+)
+from karpenter_tpu.controllers.nodepool.hash import NODEPOOL_HASH_VERSION
+from karpenter_tpu.controllers.nodepool.readiness import COND_NODECLASS_READY
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.state import nodepoolhealth
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_env(pool=None):
+    env = Environment(options=Options())
+    env.store.create(pool or make_nodepool(requirements=LINUX_AMD64))
+    return env
+
+
+class TestHash:
+    def test_stamps_hash_and_version_annotations(self):
+        env = make_env()
+        env.nodepool_hash.reconcile()
+        np = env.store.list("NodePool")[0]
+        assert np.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] == np.hash()
+        assert np.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] == NODEPOOL_HASH_VERSION
+
+    def test_hash_changes_when_template_changes(self):
+        env = make_env()
+        env.nodepool_hash.reconcile()
+        before = env.store.list("NodePool")[0].metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY]
+
+        def mutate(np):
+            np.spec.template.labels["team"] = "infra"
+
+        env.store.patch("NodePool", "default-pool", mutate)
+        env.nodepool_hash.reconcile()
+        after = env.store.list("NodePool")[0].metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY]
+        assert before != after
+
+    def test_version_bump_rehashes_undrifted_claims_only(self):
+        env = make_env()
+        env.store.create(make_pod())
+        env.settle()
+        # simulate claims stamped by an older hash version
+        for nc in env.store.list("NodeClaim"):
+            def stale(obj):
+                obj.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = "v0"
+                obj.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = "stale"
+            env.store.patch("NodeClaim", nc.metadata.name, stale)
+        def stale_np(obj):
+            obj.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = "v0"
+        env.store.patch("NodePool", "default-pool", stale_np)
+        env.nodepool_hash.reconcile()
+        np = env.store.list("NodePool")[0]
+        for nc in env.store.list("NodeClaim"):
+            assert nc.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] == NODEPOOL_HASH_VERSION
+            assert nc.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] == np.hash()
+
+    def test_version_bump_keeps_drifted_claim_hash(self):
+        env = make_env()
+        env.store.create(make_pod())
+        env.settle()
+        nc = env.store.list("NodeClaim")[0]
+        def drift(obj):
+            obj.status.conditions.set_true(COND_DRIFTED, now=env.clock.now())
+            obj.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = "v0"
+            obj.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = "stale"
+        env.store.patch("NodeClaim", nc.metadata.name, drift)
+        def stale_np(obj):
+            obj.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = "v0"
+        env.store.patch("NodePool", "default-pool", stale_np)
+        env.nodepool_hash.reconcile()
+        nc = env.store.get("NodeClaim", nc.metadata.name)
+        assert nc.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] == "stale"
+        assert nc.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] == NODEPOOL_HASH_VERSION
+
+
+class TestCounter:
+    def test_counts_nodes_and_resources(self):
+        env = make_env()
+        for _ in range(3):
+            env.store.create(make_pod(cpu="3"))
+        env.settle()
+        np = env.store.list("NodePool")[0]
+        assert np.status.node_count == len(env.store.list("Node"))
+        assert np.status.resources["cpu"].value >= 3
+        assert "memory" in np.status.resources
+
+    def test_zero_after_scale_down(self):
+        env = make_env()
+        env.store.create(make_pod())
+        env.settle()
+        assert env.store.list("NodePool")[0].status.node_count >= 1
+        for p in env.store.list("Pod"):
+            env.store.delete("Pod", p.metadata.name, namespace=p.metadata.namespace, grace=False)
+        env.settle(rounds=30)
+        np = env.store.list("NodePool")[0]
+        assert np.status.node_count == env.store.count("Node")
+
+
+class TestValidation:
+    def test_valid_pool_passes(self):
+        env = make_env()
+        env.nodepool_validation.reconcile()
+        np = env.store.list("NodePool")[0]
+        assert np.status.conditions.is_true(COND_NODEPOOL_VALIDATION_SUCCEEDED)
+
+    def test_restricted_label_fails(self):
+        pool = make_nodepool(requirements=LINUX_AMD64)
+        pool.spec.template.labels["karpenter.sh/custom"] = "x"
+        env = make_env(pool)
+        env.nodepool_validation.reconcile()
+        np = env.store.list("NodePool")[0]
+        assert np.status.conditions.is_false(COND_NODEPOOL_VALIDATION_SUCCEEDED)
+
+    def test_nodepool_key_in_requirements_fails(self):
+        pool = make_nodepool(requirements=LINUX_AMD64 + [{"key": wk.NODEPOOL_LABEL_KEY, "operator": "In", "values": ["x"]}])
+        env = make_env(pool)
+        env.nodepool_validation.reconcile()
+        assert env.store.list("NodePool")[0].status.conditions.is_false(COND_NODEPOOL_VALIDATION_SUCCEEDED)
+
+    def test_bad_operator_fails(self):
+        pool = make_nodepool(requirements=LINUX_AMD64 + [{"key": "team", "operator": "Wat", "values": ["x"]}])
+        env = make_env(pool)
+        env.nodepool_validation.reconcile()
+        assert env.store.list("NodePool")[0].status.conditions.is_false(COND_NODEPOOL_VALIDATION_SUCCEEDED)
+
+    def test_gt_requires_single_integer(self):
+        pool = make_nodepool(requirements=LINUX_AMD64 + [{"key": "slots", "operator": "Gt", "values": ["a"]}])
+        env = make_env(pool)
+        env.nodepool_validation.reconcile()
+        assert env.store.list("NodePool")[0].status.conditions.is_false(COND_NODEPOOL_VALIDATION_SUCCEEDED)
+
+    def test_duplicate_taint_fails(self):
+        from karpenter_tpu.scheduling.taints import Taint
+
+        pool = make_nodepool(requirements=LINUX_AMD64)
+        pool.spec.template.taints = [Taint("a", "x", "NoSchedule"), Taint("a", "y", "NoSchedule")]
+        env = make_env(pool)
+        env.nodepool_validation.reconcile()
+        assert env.store.list("NodePool")[0].status.conditions.is_false(COND_NODEPOOL_VALIDATION_SUCCEEDED)
+
+
+class TestReadiness:
+    def test_ready_with_kwok_nodeclass(self):
+        env = make_env()
+        env.nodepool_readiness.reconcile()
+        np = env.store.list("NodePool")[0]
+        assert np.status.conditions.is_true(COND_NODECLASS_READY)
+        assert np.status.conditions.is_true(COND_NODEPOOL_READY)
+
+    def test_missing_nodeclass_blocks(self):
+        pool = make_nodepool(requirements=LINUX_AMD64)
+        pool.spec.template.node_class_ref = {"group": "karpenter.kwok.sh", "kind": "KWOKNodeClass", "name": "missing"}
+        env = make_env(pool)
+        env.nodepool_readiness.reconcile()
+        np = env.store.list("NodePool")[0]
+        assert np.status.conditions.is_false(COND_NODECLASS_READY)
+        assert np.status.conditions.is_false(COND_NODEPOOL_READY)
+
+    def test_not_ready_pool_is_not_provisioned(self):
+        pool = make_nodepool(requirements=LINUX_AMD64)
+        pool.spec.template.node_class_ref = {"group": "karpenter.kwok.sh", "kind": "KWOKNodeClass", "name": "missing"}
+        env = make_env(pool)
+        env.store.create(make_pod())
+        env.settle()
+        assert env.store.count("NodeClaim") == 0
+
+
+class TestRegistrationHealth:
+    def test_successful_registrations_mark_healthy(self):
+        env = make_env()
+        env.store.create(make_pod())
+        env.settle()
+        np = env.store.list("NodePool")[0]
+        assert np.status.conditions.is_true(COND_NODE_REGISTRATION_HEALTHY)
+
+    def test_repeated_failures_mark_unhealthy(self):
+        env = make_env()
+        pool = env.store.list("NodePool")[0]
+        uid = pool.metadata.uid
+        env.nodepool_registration_health.reconcile()
+        for _ in range(2):
+            env.np_state.update(uid, False)
+        assert env.np_state.status(uid) == nodepoolhealth.STATUS_UNHEALTHY
+
+    def test_liveness_timeout_flips_condition_false(self):
+        from karpenter_tpu.controllers.nodeclaim.lifecycle import REGISTRATION_TTL_SECONDS
+
+        env = make_env()
+        # provision directly (no lifecycle tick): the claim is never launched,
+        # so no node ever appears and registration can only time out
+        env.store.create(make_pod())
+        env.clock.step(2.0)
+        # provision but block node materialization: drop pending nodes forever
+        env.provisioner.reconcile(force=True)
+        assert env.store.count("NodeClaim") == 1
+        # two registration timeouts in a row -> unhealthy
+        for _ in range(2):
+            nc = env.store.list("NodeClaim")[0]
+            env.clock.step(REGISTRATION_TTL_SECONDS + 1)
+            env.lifecycle._liveness(nc)
+            if env.store.count("NodeClaim") == 0:
+                env.provisioner.trigger(None)
+                env.clock.step(2.0)
+                env.provisioner.reconcile(force=True)
+        np = env.store.list("NodePool")[0]
+        assert np.status.conditions.is_false(COND_NODE_REGISTRATION_HEALTHY)
+
+    def test_spec_change_resets_to_unknown(self):
+        env = make_env()
+        env.store.create(make_pod())
+        env.settle()
+        assert env.store.list("NodePool")[0].status.conditions.is_true(COND_NODE_REGISTRATION_HEALTHY)
+
+        def bump(np):
+            np.metadata.generation += 1
+            np.spec.template.labels["x"] = "y"
+
+        env.store.patch("NodePool", "default-pool", bump)
+        env.nodepool_registration_health.reconcile()
+        np = env.store.list("NodePool")[0]
+        cond = np.status.conditions.get(COND_NODE_REGISTRATION_HEALTHY)
+        assert cond is not None and cond.status == "Unknown"
