@@ -60,7 +60,7 @@ def validate_taints(taints: list, startup_taints: list) -> list[str]:
                 errs.append(f"empty taint key in {field_name}")
             elif not is_qualified_name(t.key):
                 errs.append(f"invalid taint key {t.key!r} in {field_name}")
-            if t.value and not is_qualified_name(t.value):
+            if t.value and not is_valid_label_value(t.value):
                 errs.append(f"invalid taint value {t.value!r} in {field_name}")
             if t.effect not in TAINT_EFFECTS:
                 errs.append(f"invalid taint effect {t.effect!r} in {field_name}")

@@ -159,8 +159,10 @@ class LifecycleController:
         from ...state import nodepoolhealth
 
         uid = pool.metadata.uid
+        self.np_state.update(uid, success)
+        status = self.np_state.status(uid)
         if success:
-            if self.np_state.dry_run(uid, True) == nodepoolhealth.STATUS_HEALTHY and not pool.status.conditions.is_true(
+            if status == nodepoolhealth.STATUS_HEALTHY and not pool.status.conditions.is_true(
                 COND_NODE_REGISTRATION_HEALTHY
             ):
                 def apply(obj):
@@ -168,7 +170,7 @@ class LifecycleController:
 
                 self.store.patch("NodePool", pool.metadata.name, apply)
         else:
-            if self.np_state.dry_run(uid, False) == nodepoolhealth.STATUS_UNHEALTHY and not pool.status.conditions.is_false(
+            if status == nodepoolhealth.STATUS_UNHEALTHY and not pool.status.conditions.is_false(
                 COND_NODE_REGISTRATION_HEALTHY
             ):
                 launched = nc.status.conditions.get("Launched")
@@ -181,7 +183,6 @@ class LifecycleController:
                     obj.status.conditions.set_false(COND_NODE_REGISTRATION_HEALTHY, reason, message, now=self.clock.now())
 
                 self.store.patch("NodePool", pool.metadata.name, apply)
-        self.np_state.update(uid, success)
 
     # -- claim termination (lifecycle/termination.go): node drained first (the
     # node termination controller owns the drain), then instance gone, then

@@ -23,7 +23,11 @@ class NodePoolRegistrationHealthController:
         self._observed: dict[str, tuple[int, int]] = {}
 
     def reconcile(self) -> None:
-        for np in self.store.list("NodePool"):
+        pools = self.store.list("NodePool")
+        live = {np.metadata.uid for np in pools}
+        self.np_state.prune(live)
+        self._observed = {uid: v for uid, v in self._observed.items() if uid in live}
+        for np in pools:
             ref = np.spec.template.node_class_ref
             kind = ref["kind"] if isinstance(ref, dict) else ref.kind
             name = ref["name"] if isinstance(ref, dict) else ref.name

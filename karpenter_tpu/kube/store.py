@@ -152,6 +152,10 @@ class Store:
             # deletionTimestamp is set only by delete(); preserve server-side value
             obj.metadata.deletion_timestamp = current.metadata.deletion_timestamp
             obj.metadata.resource_version = self._rv
+            # apiserver semantics: generation increments on spec change only
+            obj.metadata.generation = current.metadata.generation
+            if getattr(obj, "spec", None) != getattr(current, "spec", None):
+                obj.metadata.generation += 1
             if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
                 del kind_map[key]
                 self._enqueue("DELETED", obj)

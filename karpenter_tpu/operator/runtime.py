@@ -14,7 +14,11 @@ from ..apis.kwoknodeclass import KWOKNodeClass
 from ..cloudprovider import catalog
 from ..cloudprovider.kwok import KWOKCloudProvider
 from ..controllers.disruption import DisruptionController
+from ..controllers.nodeclaim.consistency import ConsistencyController
 from ..controllers.nodeclaim.disruption import NodeClaimDisruptionController
+from ..controllers.nodeclaim.expiration import ExpirationController
+from ..controllers.nodeclaim.hydration import HydrationController
+from ..controllers.nodeclaim.podevents import PodEventsController
 from ..controllers.node.termination import TerminationController
 from ..controllers.nodeclaim.garbagecollection import GarbageCollectionController
 from ..controllers.nodeclaim.lifecycle import LifecycleController
@@ -78,6 +82,11 @@ class Environment:
         self.disruption = DisruptionController(
             self.store, self.cluster, self.provisioner, self.cloud_provider, self.clock, self.options
         )
+        self.expiration = ExpirationController(self.store, self.clock)
+        self.consistency = ConsistencyController(self.store, self.clock)
+        self.hydration = HydrationController(self.store)
+        self.podevents = PodEventsController(self.store, self.clock)
+        self.podevents.register()
         self.nodepool_hash = NodePoolHashController(self.store)
         self.nodepool_counter = NodePoolCounterController(self.store, self.cluster)
         self.nodepool_readiness = NodePoolReadinessController(self.store, self.clock)
@@ -114,6 +123,9 @@ class Environment:
         self.gc.reconcile()
         self.binder.bind_all()
         self.nodepool_counter.reconcile()
+        self.hydration.reconcile()
+        self.consistency.reconcile()
+        self.expiration.reconcile()
         self.nodeclaim_disruption.reconcile()
         self.disruption.reconcile()
         for c in self.extra_controllers:

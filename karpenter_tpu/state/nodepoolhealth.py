@@ -51,11 +51,6 @@ class Tracker:
                 for _ in range(int(self._capacity * THRESHOLD_FALSE)):
                     self._buffer.insert(False)
 
-    def snapshot(self) -> list[bool]:
-        with self._lock:
-            return self._buffer.items()
-
-
 class NodePoolHealthState:
     """Map of NodePool UID -> Tracker (reference: tracker.go State)."""
 
@@ -76,10 +71,9 @@ class NodePoolHealthState:
     def set_status(self, uid: str, status: str) -> None:
         self._tracker(uid).set_status(status)
 
-    def dry_run(self, uid: str, success: bool) -> str:
-        """Status as-if one more outcome were recorded, without recording it."""
-        t = Tracker()
-        for item in self._tracker(uid).snapshot():
-            t.update(item)
-        t.update(success)
-        return t.status()
+    def prune(self, live_uids: set[str]) -> None:
+        """Drop trackers for deleted pools so pool churn doesn't leak memory."""
+        with self._lock:
+            for uid in list(self._trackers):
+                if uid not in live_uids:
+                    del self._trackers[uid]

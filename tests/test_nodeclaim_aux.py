@@ -1,0 +1,146 @@
+"""NodeClaim aux controllers (reference: pkg/controllers/nodeclaim/
+{expiration,consistency,podevents,hydration}).
+"""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import COND_CONSISTENT_STATE_FOUND
+from karpenter_tpu.controllers.nodeclaim.consistency import SCAN_PERIOD_SECONDS, node_shape_issues
+from karpenter_tpu.controllers.nodeclaim.hydration import node_class_label_key
+from karpenter_tpu.controllers.nodeclaim.podevents import DEDUPE_TIMEOUT_SECONDS
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.utils.quantity import Quantity
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_env(expire_after=None):
+    env = Environment(options=Options())
+    pool = make_nodepool(requirements=LINUX_AMD64)
+    if expire_after is not None:
+        pool.spec.template.expire_after = expire_after
+    env.store.create(pool)
+    return env
+
+
+class TestExpiration:
+    def test_claim_expires_after_ttl(self):
+        env = make_env(expire_after="1h")
+        env.store.create(make_pod())
+        env.settle()
+        assert env.store.count("NodeClaim") == 1
+        env.clock.step(3601)
+        env.tick()
+        # claim deleted -> drain -> next settle reprovisions for the pod
+        env.settle(rounds=20)
+        claims = env.store.list("NodeClaim")
+        assert all(env.clock.now() - c.metadata.creation_timestamp < 3600 for c in claims)
+
+    def test_never_expires_without_expire_after(self):
+        env = make_env(expire_after="Never")
+        env.store.create(make_pod())
+        env.settle()
+        nc = env.store.list("NodeClaim")[0]
+        env.clock.step(10 * 24 * 3600)
+        env.tick()
+        assert env.store.try_get("NodeClaim", nc.metadata.name) is not None
+
+    def test_not_expired_before_ttl(self):
+        env = make_env(expire_after="2h")
+        env.store.create(make_pod())
+        env.settle()
+        nc = env.store.list("NodeClaim")[0]
+        env.clock.step(3600)
+        env.tick()
+        assert env.store.try_get("NodeClaim", nc.metadata.name) is not None
+
+
+class TestConsistency:
+    def test_clean_scan_sets_condition(self):
+        env = make_env()
+        env.store.create(make_pod())
+        env.settle()
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.status.conditions.is_true(COND_CONSISTENT_STATE_FOUND)
+
+    def test_node_shape_issue_detected(self):
+        env = make_env()
+        env.store.create(make_pod())
+        env.settle()
+        nc = env.store.list("NodeClaim")[0]
+        node = env.store.get("Node", nc.status.node_name)
+        # shrink the node's actual capacity below 90% of promised
+        nc.spec.resources = {"cpu": Quantity.parse("1")}
+        node.status.capacity["cpu"] = nc.status.capacity["cpu"] * 0.5
+        issues = node_shape_issues(node, nc)
+        assert issues and "cpu" in issues[0]
+
+    def test_scan_period_dedupes(self):
+        env = make_env()
+        env.store.create(make_pod())
+        env.settle()
+        nc = env.store.list("NodeClaim")[0]
+        first = env.consistency._last_scanned[nc.metadata.uid]
+        env.clock.step(60)
+        env.consistency.reconcile()
+        assert env.consistency._last_scanned[nc.metadata.uid] == first
+        env.clock.step(SCAN_PERIOD_SECONDS)
+        env.consistency.reconcile()
+        assert env.consistency._last_scanned[nc.metadata.uid] > first
+
+
+class TestPodEvents:
+    def test_bind_stamps_last_pod_event(self):
+        env = make_env()
+        env.store.create(make_pod())
+        env.settle()
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.status.last_pod_event_time > 0
+
+    def test_dedupe_window(self):
+        env = make_env()
+        env.store.create(make_pod())
+        env.settle()
+        nc = env.store.list("NodeClaim")[0]
+
+        # re-stamp to "now" so the next bind lands inside the dedupe window
+        def stamp(obj):
+            obj.status.last_pod_event_time = env.clock.now()
+
+        env.store.patch("NodeClaim", nc.metadata.name, stamp)
+        t0 = env.clock.now()
+        env.store.create(make_pod(cpu="100m"))
+        env.settle(rounds=3, step_seconds=DEDUPE_TIMEOUT_SECONDS / 10)
+        nc = env.store.get("NodeClaim", nc.metadata.name)
+        assert nc.status.last_pod_event_time == t0
+
+    def test_terminating_pod_stamps(self):
+        env = make_env()
+        env.store.create(make_pod())
+        env.settle()
+        nc = env.store.list("NodeClaim")[0]
+        t0 = nc.status.last_pod_event_time
+        env.clock.step(DEDUPE_TIMEOUT_SECONDS + 1)
+        pod = env.store.list("Pod")[0]
+
+        def fin(p):
+            p.metadata.finalizers.append("test/hold")
+
+        env.store.patch("Pod", pod.metadata.name, fin, namespace=pod.metadata.namespace)
+        env.store.delete("Pod", pod.metadata.name, namespace=pod.metadata.namespace)
+        nc = env.store.get("NodeClaim", nc.metadata.name)
+        assert nc.status.last_pod_event_time > t0
+
+
+class TestHydration:
+    def test_node_class_label_backfilled(self):
+        env = make_env()
+        env.store.create(make_pod())
+        env.settle()
+        nc = env.store.list("NodeClaim")[0]
+        key = node_class_label_key(nc.spec.node_class_ref.group, nc.spec.node_class_ref.kind)
+        assert nc.metadata.labels[key] == nc.spec.node_class_ref.name

@@ -225,8 +225,8 @@ class TestRegistrationHealth:
         env.settle()
         assert env.store.list("NodePool")[0].status.conditions.is_true(COND_NODE_REGISTRATION_HEALTHY)
 
+        # a spec change alone must reset health: the store bumps generation
         def bump(np):
-            np.metadata.generation += 1
             np.spec.template.labels["x"] = "y"
 
         env.store.patch("NodePool", "default-pool", bump)
