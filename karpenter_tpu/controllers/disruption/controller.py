@@ -26,7 +26,7 @@ class _Ctx:
 
 
 class DisruptionController:
-    def __init__(self, store, cluster, provisioner, cloud_provider, clock, options, recorder=None):
+    def __init__(self, store, cluster, provisioner, cloud_provider, clock, options, recorder=None, metrics=None):
         self.store = store
         self.cluster = cluster
         self.provisioner = provisioner
@@ -41,6 +41,7 @@ class DisruptionController:
             SingleNodeConsolidation(ctx),
         ]
         self.queue = OrchestrationQueue(store, cluster, provisioner, clock, recorder)
+        self.metrics = metrics
         self._last_run = -1e18
 
     def reconcile(self, force: bool = False) -> None:
@@ -61,16 +62,35 @@ class DisruptionController:
     def disrupt(self) -> bool:
         """Run methods in priority order; execute the first command batch
         (controller.go:166-179)."""
+        import time as _time
+
         for method in self.methods:
+            ctype = getattr(method, "consolidation_type", "")
+            mname = type(method).__name__
             candidates = self.get_candidates()
+            if self.metrics is not None:
+                from ... import metrics as m
+
+                self.metrics.gauge(m.DISRUPTION_ELIGIBLE_NODES).set(len(candidates), method=mname, consolidation_type=ctype)
             if not candidates:
                 return False
             budgets = build_disruption_budget_mapping(self.store, self.cluster, self.clock, method.reason)
+            t0 = _time.perf_counter()
             commands = method.compute_commands(candidates, budgets)
             started = False
             for cmd in commands:
                 if cmd.candidates and self.queue.start_command(cmd):
                     started = True
+            if self.metrics is not None:
+                from ... import metrics as m
+
+                self.metrics.histogram(m.DISRUPTION_DECISION_EVAL_DURATION).observe(_time.perf_counter() - t0, method=mname)
+                for cmd in commands:
+                    if cmd.candidates:
+                        decision = "replace" if cmd.replacements else "delete"
+                        self.metrics.counter(m.DISRUPTION_DECISIONS_TOTAL).inc(
+                            decision=decision, method=mname, consolidation_type=ctype
+                        )
             if started:
                 return True
         return False

@@ -23,12 +23,13 @@ DISRUPTED_TAINT = Taint(key=wk.DISRUPTED_TAINT_KEY, effect=NO_SCHEDULE)
 
 
 class TerminationController:
-    def __init__(self, store, cluster, cloud_provider, clock, recorder=None):
+    def __init__(self, store, cluster, cloud_provider, clock, recorder=None, metrics=None):
         self.store = store
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock
         self.recorder = recorder
+        self.metrics = metrics
 
     def reconcile(self) -> None:
         for node in self.store.list("Node"):
@@ -96,6 +97,20 @@ class TerminationController:
             except NodeClaimNotFoundError:
                 pass
         self.store.remove_finalizer("Node", name, wk.TERMINATION_FINALIZER)
+        if self.metrics is not None:
+            from ... import metrics as m
+
+            pool = node.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")
+            zone = node.metadata.labels.get(wk.ZONE_LABEL_KEY, "")
+            self.metrics.counter(m.NODES_TERMINATED_TOTAL).inc(nodepool=pool, zone=zone)
+            if claim is not None:
+                self.metrics.counter(m.NODECLAIMS_TERMINATED_TOTAL).inc(
+                    nodepool=pool,
+                    capacity_type=node.metadata.labels.get(wk.CAPACITY_TYPE_LABEL_KEY, ""),
+                    zone=zone,
+                )
+        if self.recorder is not None:
+            self.recorder.publish(node, "NodeTerminated", f"node {name} drained and terminated")
 
     def _evict(self, pod) -> None:
         """Evict = reset to pending (modeling controller recreation)."""

@@ -38,7 +38,10 @@ class ConsistencyController:
         self._last_scanned: dict[str, float] = {}  # claim uid -> time
 
     def reconcile(self) -> None:
-        for nc in self.store.list("NodeClaim"):
+        claims = self.store.list("NodeClaim")
+        live = {nc.metadata.uid for nc in claims}
+        self._last_scanned = {uid: t for uid, t in self._last_scanned.items() if uid in live}
+        for nc in claims:
             if not nc.status.provider_id:
                 continue
             last = self._last_scanned.get(nc.metadata.uid)

@@ -28,9 +28,10 @@ class ExpirationController:
                 continue
             self.store.try_delete("NodeClaim", nc.metadata.name)
             if self.metrics is not None:
+                from ... import metrics as m
                 from ...apis import labels as wk
 
-                self.metrics.counter("karpenter_nodeclaims_disrupted_total").inc(
+                self.metrics.counter(m.NODECLAIMS_DISRUPTED_TOTAL).inc(
                     reason="expired",
                     nodepool=nc.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, ""),
                     capacity_type=nc.metadata.labels.get(wk.CAPACITY_TYPE_LABEL_KEY, ""),

@@ -23,13 +23,14 @@ REGISTRATION_TTL_SECONDS = 15 * 60  # liveness.go:39 registrationTTL
 
 
 class LifecycleController:
-    def __init__(self, store, cluster, cloud_provider, clock, recorder=None, np_state=None):
+    def __init__(self, store, cluster, cloud_provider, clock, recorder=None, np_state=None, metrics=None):
         self.store = store
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock
         self.recorder = recorder
         self.np_state = np_state  # nodepoolhealth.NodePoolHealthState
+        self.metrics = metrics
 
     def reconcile_all(self) -> None:
         for nc in self.store.list("NodeClaim"):
@@ -107,6 +108,13 @@ class LifecycleController:
         nc.status.node_name = node.metadata.name
         nc.status.conditions.set_true(COND_REGISTERED, now=self.clock.now())
         self._record_registration_outcome(nc, success=True)
+        if self.metrics is not None:
+            from ... import metrics as m
+
+            self.metrics.counter(m.NODES_CREATED_TOTAL).inc(
+                nodepool=nc.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, ""),
+                zone=nc.metadata.labels.get(wk.ZONE_LABEL_KEY, ""),
+            )
         return True
 
     # -- Initialization (initialization.go): node ready + resources registered -

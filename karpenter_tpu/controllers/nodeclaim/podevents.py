@@ -30,16 +30,25 @@ class PodEventsController:
         prev = self._observed.get(key, ("", False, False))
         terminal = pod.status.phase in ("Succeeded", "Failed")
         terminating = pod.metadata.deletion_timestamp is not None
-        cur = (pod.spec.node_name, terminal, terminating)
+        if pod_utils.is_owned_by_daemonset(pod):
+            return
         if event == "DELETED":
+            # a finalizer-less delete emits only DELETED; the node the pod
+            # leaves still needs its consolidateAfter idle clock reset
             self._observed.pop(key, None)
+            if prev[0]:
+                self._stamp(prev[0])
             return
-        self._observed[key] = cur
-        if not pod.spec.node_name or pod_utils.is_owned_by_daemonset(pod):
-            return
+        self._observed[key] = (pod.spec.node_name, terminal, terminating)
         bound = prev[0] == "" and pod.spec.node_name != ""
+        unbound = prev[0] != "" and pod.spec.node_name == ""  # eviction unbind
         went_terminal = not prev[1] and terminal
         went_terminating = not prev[2] and terminating
+        if unbound:
+            self._stamp(prev[0])
+            return
+        if not pod.spec.node_name:
+            return
         if not (bound or went_terminal or went_terminating):
             return
         self._stamp(pod.spec.node_name)

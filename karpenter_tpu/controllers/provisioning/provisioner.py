@@ -27,7 +27,7 @@ class ProvisionerOptions:
 
 
 class Provisioner:
-    def __init__(self, store, cluster, cloud_provider, clock, solver=None, recorder=None, options: ProvisionerOptions | None = None):
+    def __init__(self, store, cluster, cloud_provider, clock, solver=None, recorder=None, options: ProvisionerOptions | None = None, metrics=None):
         self.store = store
         self.cluster = cluster
         self.cloud_provider = cloud_provider
@@ -35,6 +35,7 @@ class Provisioner:
         self.solver = solver or FFDSolver()
         self.recorder = recorder
         self.options = options or ProvisionerOptions()
+        self.metrics = metrics
         self.batcher = Batcher(clock, self.options.batch_idle_seconds, self.options.batch_max_seconds)
 
     # -- triggering (provisioning/controller.go) -------------------------------
@@ -74,11 +75,27 @@ class Provisioner:
 
     def schedule(self, pods: list) -> Results:
         if not pods:
+            if self.metrics is not None:
+                from ... import metrics as m
+
+                self.metrics.gauge(m.SCHEDULER_QUEUE_DEPTH).set(0)
+                self.metrics.gauge(m.SCHEDULER_UNSCHEDULABLE_PODS).set(0)
             return Results()
         snapshot = self.make_snapshot(pods)
         if not snapshot.node_pools:
             return Results(pod_errors={p.key(): "no ready nodepools" for p in pods})
-        return self.solver.solve(snapshot)
+        if self.metrics is None:
+            return self.solver.solve(snapshot)
+        import time as _time
+
+        from ... import metrics as m
+
+        self.metrics.gauge(m.SCHEDULER_QUEUE_DEPTH).set(len(pods))
+        t0 = _time.perf_counter()
+        results = self.solver.solve(snapshot)
+        self.metrics.histogram(m.SCHEDULER_SCHEDULING_DURATION).observe(_time.perf_counter() - t0)
+        self.metrics.gauge(m.SCHEDULER_UNSCHEDULABLE_PODS).set(len(results.pod_errors))
+        return results
 
     def make_snapshot(self, pods: list, state_nodes=None, exclude_deleting: bool = True) -> SolverSnapshot:
         """Snapshot assembly (provisioner.go:261-348 NewScheduler)."""
@@ -137,4 +154,11 @@ class Provisioner:
         created = self.store.create(nc)
         # immediately mirror into cluster state so the next solve sees it
         self.cluster.update_node_claim(created)
+        if self.metrics is not None:
+            from ... import metrics as m
+
+            relaxed = wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY in nc.metadata.annotations
+            self.metrics.counter(m.NODECLAIMS_CREATED_TOTAL).inc(
+                reason="provisioning", nodepool=pool_name, min_values_relaxed=str(relaxed).lower()
+            )
         return created.metadata.name

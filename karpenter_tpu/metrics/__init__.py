@@ -1,0 +1,106 @@
+"""Metrics registry + the well-known karpenter_ metric definitions.
+
+Reference: pkg/metrics/metrics.go:36-107 and the per-controller metric files
+(scheduling/metrics.go, disruption/metrics.go, controllers/metrics/*). The
+names below match the reference's fully-qualified prometheus names.
+"""
+
+from __future__ import annotations
+
+from .registry import (
+    DEFAULT_BUCKETS,
+    DURATION_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+
+# -- well-known metric names (reference: pkg/metrics/metrics.go) --------------
+NODECLAIMS_CREATED_TOTAL = "karpenter_nodeclaims_created_total"
+NODECLAIMS_TERMINATED_TOTAL = "karpenter_nodeclaims_terminated_total"
+NODECLAIMS_DISRUPTED_TOTAL = "karpenter_nodeclaims_disrupted_total"
+PODS_DISRUPTION_INITIATED_TOTAL = "karpenter_pods_disruption_initiated_total"
+NODES_CREATED_TOTAL = "karpenter_nodes_created_total"
+NODES_TERMINATED_TOTAL = "karpenter_nodes_terminated_total"
+
+SCHEDULER_SCHEDULING_DURATION = "karpenter_scheduler_scheduling_duration_seconds"
+SCHEDULER_QUEUE_DEPTH = "karpenter_scheduler_queue_depth"
+SCHEDULER_UNFINISHED_WORK = "karpenter_scheduler_unfinished_work_seconds"
+SCHEDULER_IGNORED_PODS = "karpenter_scheduler_ignored_pods_count"
+SCHEDULER_UNSCHEDULABLE_PODS = "karpenter_scheduler_unschedulable_pods_count"
+
+DISRUPTION_DECISIONS_TOTAL = "karpenter_voluntary_disruption_decisions_total"
+DISRUPTION_ELIGIBLE_NODES = "karpenter_voluntary_disruption_eligible_nodes"
+DISRUPTION_CONSOLIDATION_TIMEOUTS_TOTAL = "karpenter_voluntary_disruption_consolidation_timeouts_total"
+DISRUPTION_FAILED_VALIDATIONS_TOTAL = "karpenter_voluntary_disruption_failed_validations_total"
+DISRUPTION_QUEUE_FAILURES_TOTAL = "karpenter_voluntary_disruption_queue_failures_total"
+DISRUPTION_DECISION_EVAL_DURATION = "karpenter_voluntary_disruption_decision_evaluation_duration_seconds"
+NODEPOOL_ALLOWED_DISRUPTIONS = "karpenter_nodepools_allowed_disruptions"
+
+PODS_STARTUP_DURATION = "karpenter_pods_startup_duration_seconds"
+PODS_BOUND_DURATION = "karpenter_pods_bound_duration_seconds"
+PODS_UNBOUND_TIME = "karpenter_pods_unbound_time_seconds"
+PODS_PROVISIONING_BOUND_DURATION = "karpenter_pods_provisioning_bound_duration_seconds"
+PODS_STATE = "karpenter_pods_state"
+
+NODES_ALLOCATABLE = "karpenter_nodes_allocatable"
+NODES_TOTAL_POD_REQUESTS = "karpenter_nodes_total_pod_requests"
+NODES_TOTAL_DAEMON_REQUESTS = "karpenter_nodes_total_daemon_requests"
+NODES_UTILIZATION = "karpenter_nodes_utilization_percent"
+NODES_CURRENT_LIFETIME = "karpenter_nodes_current_lifetime_seconds"
+
+NODEPOOL_USAGE = "karpenter_nodepools_usage"
+NODEPOOL_LIMIT = "karpenter_nodepools_limit"
+
+CLUSTER_STATE_SYNCED = "karpenter_cluster_state_synced"
+CLUSTER_STATE_NODE_COUNT = "karpenter_cluster_state_node_count"
+
+
+def make_registry() -> Registry:
+    """A registry pre-populated with the reference's metric families."""
+    r = Registry()
+    r.counter(NODECLAIMS_CREATED_TOTAL, "Number of nodeclaims created", ("reason", "nodepool", "min_values_relaxed"))
+    r.counter(NODECLAIMS_TERMINATED_TOTAL, "Number of nodeclaims terminated", ("nodepool", "capacity_type", "zone"))
+    r.counter(NODECLAIMS_DISRUPTED_TOTAL, "Number of nodeclaims disrupted", ("reason", "nodepool", "capacity_type"))
+    r.counter(PODS_DISRUPTION_INITIATED_TOTAL, "Pod disruptions initiated", ("reason", "nodepool", "capacity_type"))
+    r.counter(NODES_CREATED_TOTAL, "Nodes created", ("nodepool", "zone"))
+    r.counter(NODES_TERMINATED_TOTAL, "Nodes terminated", ("nodepool", "zone"))
+    r.histogram(SCHEDULER_SCHEDULING_DURATION, "Duration of one scheduling solve", (), DURATION_BUCKETS)
+    r.gauge(SCHEDULER_QUEUE_DEPTH, "Pods waiting in the scheduling queue", ())
+    r.gauge(SCHEDULER_UNFINISHED_WORK, "Seconds the in-flight solve has been running", ())
+    r.gauge(SCHEDULER_IGNORED_PODS, "Pods ignored by the scheduler", ())
+    r.gauge(SCHEDULER_UNSCHEDULABLE_PODS, "Pods the last solve could not place", ())
+    r.counter(DISRUPTION_DECISIONS_TOTAL, "Disruption decisions", ("decision", "method", "consolidation_type"))
+    r.gauge(DISRUPTION_ELIGIBLE_NODES, "Nodes eligible for disruption", ("method", "consolidation_type"))
+    r.counter(DISRUPTION_CONSOLIDATION_TIMEOUTS_TOTAL, "Consolidation probes aborted on timeout", ("method",))
+    r.counter(DISRUPTION_FAILED_VALIDATIONS_TOTAL, "Commands dropped by the validator", ("method",))
+    r.counter(DISRUPTION_QUEUE_FAILURES_TOTAL, "Disruption commands that failed in the queue", ("method",))
+    r.histogram(DISRUPTION_DECISION_EVAL_DURATION, "Time to compute a disruption decision", ("method",), DURATION_BUCKETS)
+    r.gauge(NODEPOOL_ALLOWED_DISRUPTIONS, "Budget-allowed disruptions", ("nodepool", "reason"))
+    r.histogram(PODS_STARTUP_DURATION, "Pod creation to running", (), DURATION_BUCKETS)
+    r.histogram(PODS_BOUND_DURATION, "Pod creation to bound", (), DURATION_BUCKETS)
+    r.gauge(PODS_UNBOUND_TIME, "Seconds a pod has been unbound", ("name", "namespace"))
+    r.histogram(PODS_PROVISIONING_BOUND_DURATION, "Karpenter-provisioned pod creation to bound", (), DURATION_BUCKETS)
+    r.gauge(PODS_STATE, "Pod state", ("name", "namespace", "phase"))
+    r.gauge(NODES_ALLOCATABLE, "Node allocatable by resource", ("node_name", "nodepool", "resource_type", "zone"))
+    r.gauge(NODES_TOTAL_POD_REQUESTS, "Pod requests on node", ("node_name", "nodepool", "resource_type"))
+    r.gauge(NODES_TOTAL_DAEMON_REQUESTS, "Daemon requests on node", ("node_name", "nodepool", "resource_type"))
+    r.gauge(NODES_UTILIZATION, "Requested/allocatable percent", ("node_name", "nodepool", "resource_type"))
+    r.gauge(NODES_CURRENT_LIFETIME, "Node age", ("node_name", "nodepool"))
+    r.gauge(NODEPOOL_USAGE, "Per-pool resource usage", ("nodepool", "resource_type"))
+    r.gauge(NODEPOOL_LIMIT, "Per-pool resource limits", ("nodepool", "resource_type"))
+    r.gauge(CLUSTER_STATE_SYNCED, "1 if cluster state is synced", ())
+    r.gauge(CLUSTER_STATE_NODE_COUNT, "Nodes tracked by cluster state", ())
+    return r
+
+
+__all__ = [
+    "Registry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "make_registry",
+    "DEFAULT_BUCKETS",
+    "DURATION_BUCKETS",
+]

@@ -30,8 +30,15 @@ from ..controllers.nodepool import (
     NodePoolValidationController,
 )
 from ..controllers.provisioning.provisioner import Provisioner, ProvisionerOptions
+from ..controllers.metrics import (
+    NodeMetricsController,
+    NodePoolMetricsController,
+    PodMetricsController,
+)
+from ..events import Recorder
 from ..kube import Store
 from ..kube.binder import Binder
+from ..metrics import make_registry
 from ..solver import FFDSolver
 from ..state import Cluster
 from ..state.informer import start_informers
@@ -46,6 +53,8 @@ class Environment:
     def __init__(self, options: Options | None = None, clock=None, cloud_provider=None, instance_types=None):
         self.options = options or Options()
         self.clock = clock or FakeClock()
+        self.registry = make_registry()
+        self.recorder = Recorder(self.clock)
         self.store = Store(clock=self.clock)
         self.cluster = Cluster(self.store, self.clock)
         start_informers(self.store, self.cluster)
@@ -64,6 +73,8 @@ class Environment:
             self.cloud_provider,
             self.clock,
             solver=solver,
+            recorder=self.recorder,
+            metrics=self.registry,
             options=ProvisionerOptions(
                 preference_policy=self.options.preference_policy,
                 min_values_policy=self.options.min_values_policy,
@@ -73,17 +84,22 @@ class Environment:
         )
         self.np_state = NodePoolHealthState()
         self.lifecycle = LifecycleController(
-            self.store, self.cluster, self.cloud_provider, self.clock, np_state=self.np_state
+            self.store, self.cluster, self.cloud_provider, self.clock,
+            recorder=self.recorder, np_state=self.np_state, metrics=self.registry,
         )
         self.gc = GarbageCollectionController(self.store, self.cluster, self.cloud_provider, self.clock)
         self.binder = Binder(self.store, self.cluster, self.clock)
-        self.termination = TerminationController(self.store, self.cluster, self.cloud_provider, self.clock)
+        self.termination = TerminationController(
+            self.store, self.cluster, self.cloud_provider, self.clock,
+            recorder=self.recorder, metrics=self.registry,
+        )
         self.nodeclaim_disruption = NodeClaimDisruptionController(self.store, self.cluster, self.cloud_provider, self.clock)
         self.disruption = DisruptionController(
-            self.store, self.cluster, self.provisioner, self.cloud_provider, self.clock, self.options
+            self.store, self.cluster, self.provisioner, self.cloud_provider, self.clock, self.options,
+            recorder=self.recorder, metrics=self.registry,
         )
-        self.expiration = ExpirationController(self.store, self.clock)
-        self.consistency = ConsistencyController(self.store, self.clock)
+        self.expiration = ExpirationController(self.store, self.clock, metrics=self.registry)
+        self.consistency = ConsistencyController(self.store, self.clock, recorder=self.recorder)
         self.hydration = HydrationController(self.store)
         self.podevents = PodEventsController(self.store, self.clock)
         self.podevents.register()
@@ -92,6 +108,9 @@ class Environment:
         self.nodepool_readiness = NodePoolReadinessController(self.store, self.clock)
         self.nodepool_registration_health = NodePoolRegistrationHealthController(self.store, self.np_state, self.clock)
         self.nodepool_validation = NodePoolValidationController(self.store, self.clock)
+        self.pod_metrics = PodMetricsController(self.store, self.clock, self.registry)
+        self.node_metrics = NodeMetricsController(self.store, self.cluster, self.clock, self.registry)
+        self.nodepool_metrics = NodePoolMetricsController(self.store, self.registry)
         self.extra_controllers: list = []  # later controllers appended as built
 
         # pod watch triggers the provisioner batcher (state informer §3.5)
@@ -128,6 +147,13 @@ class Environment:
         self.expiration.reconcile()
         self.nodeclaim_disruption.reconcile()
         self.disruption.reconcile()
+        self.pod_metrics.reconcile()
+        self.node_metrics.reconcile()
+        self.nodepool_metrics.reconcile()
+        from .. import metrics as m
+
+        self.registry.gauge(m.CLUSTER_STATE_SYNCED).set(1.0 if self.cluster.synced() else 0.0)
+        self.registry.gauge(m.CLUSTER_STATE_NODE_COUNT).set(len(self.cluster.nodes()))
         for c in self.extra_controllers:
             c.reconcile()
 

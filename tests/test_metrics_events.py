@@ -1,0 +1,166 @@
+"""Metrics registry + events recorder (reference: pkg/metrics, pkg/events,
+pkg/controllers/metrics/*)."""
+
+import math
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu import metrics as m
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.events import Recorder
+from karpenter_tpu.metrics import make_registry
+from karpenter_tpu.metrics.registry import Registry
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.utils.clock import FakeClock
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+class TestRegistry:
+    def test_counter_inc_and_labels(self):
+        r = Registry()
+        c = r.counter("test_total", "help", ("a", "b"))
+        c.inc(a="x", b="y")
+        c.inc(2, a="x", b="y")
+        c.inc(a="z")
+        assert c.value(a="x", b="y") == 3
+        assert c.value(a="z", b="") == 1
+        assert c.total() == 4
+
+    def test_gauge_set_add_reset(self):
+        r = Registry()
+        g = r.gauge("test_gauge", "help", ("k",))
+        g.set(5, k="a")
+        g.add(2, k="a")
+        assert g.value(k="a") == 7
+        g.reset()
+        assert g.value(k="a") == 0
+
+    def test_histogram_observe(self):
+        r = Registry()
+        h = r.histogram("test_seconds", "help", (), buckets=(0.1, 1, 10))
+        for v in (0.05, 0.5, 5, 50):
+            h.observe(v)
+        assert h.count() == 4
+        assert h.sum() == 55.55
+        assert h.percentile(0.5) in (0.1, 1)
+
+    def test_histogram_percentile_empty_is_nan(self):
+        r = Registry()
+        h = r.histogram("empty_seconds", "", ())
+        assert math.isnan(h.percentile(0.5))
+
+    def test_expose_text_format(self):
+        r = Registry()
+        r.counter("karpenter_things_total", "things", ("kind",)).inc(kind="a")
+        r.gauge("karpenter_level", "level", ()).set(3)
+        r.histogram("karpenter_dur_seconds", "dur", (), buckets=(1, 2)).observe(1.5)
+        text = r.expose()
+        assert '# TYPE karpenter_things_total counter' in text
+        assert 'karpenter_things_total{kind="a"} 1' in text
+        assert "karpenter_level 3" in text
+        assert 'karpenter_dur_seconds_bucket{le="2"} 1' in text
+        assert 'karpenter_dur_seconds_count 1' in text
+
+    def test_type_mismatch_raises(self):
+        import pytest
+
+        r = Registry()
+        r.counter("x_total", "", ())
+        with pytest.raises(TypeError):
+            r.gauge("x_total", "", ())
+
+    def test_unknown_label_raises(self):
+        import pytest
+
+        r = Registry()
+        c = r.counter("y_total", "", ("a",))
+        with pytest.raises(ValueError):
+            c.inc(b="nope")
+
+
+class TestRecorder:
+    def test_dedupe_window(self):
+        clock = FakeClock()
+        rec = Recorder(clock)
+
+        class Obj:
+            kind = "NodeClaim"
+
+            class metadata:
+                name = "nc-1"
+
+        assert rec.publish(Obj(), "Launched", "msg")
+        assert not rec.publish(Obj(), "Launched", "msg")  # deduped
+        clock.step(121)
+        assert rec.publish(Obj(), "Launched", "msg")  # window elapsed
+        assert len(rec.events) == 2
+
+    def test_different_reasons_not_deduped(self):
+        clock = FakeClock()
+        rec = Recorder(clock)
+
+        class Obj:
+            kind = "Node"
+
+            class metadata:
+                name = "n-1"
+
+        assert rec.publish(Obj(), "A", "m1")
+        assert rec.publish(Obj(), "B", "m2")
+        assert rec.reasons() == ["A", "B"]
+
+
+class TestEndToEndMetrics:
+    def make_env(self):
+        env = Environment(options=Options())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        return env
+
+    def test_provisioning_flow_instruments(self):
+        env = self.make_env()
+        for _ in range(3):
+            env.store.create(make_pod())
+        env.settle()
+        reg = env.registry
+        assert reg.counter(m.NODECLAIMS_CREATED_TOTAL).total() >= 1
+        assert reg.counter(m.NODES_CREATED_TOTAL).total() >= 1
+        assert reg.histogram(m.SCHEDULER_SCHEDULING_DURATION).count() >= 1
+        assert reg.histogram(m.PODS_BOUND_DURATION).count() == 3
+        assert reg.histogram(m.PODS_STARTUP_DURATION).count() == 3
+        assert reg.gauge(m.CLUSTER_STATE_SYNCED).value() == 1.0
+        assert reg.gauge(m.CLUSTER_STATE_NODE_COUNT).value() == env.store.count("Node")
+        # per-node gauges labeled by node/pool
+        node = env.store.list("Node")[0]
+        pool = node.metadata.labels[wk.NODEPOOL_LABEL_KEY]
+        zone = node.metadata.labels[wk.ZONE_LABEL_KEY]
+        assert (
+            reg.gauge(m.NODES_ALLOCATABLE).value(
+                node_name=node.metadata.name, nodepool=pool, resource_type="cpu", zone=zone
+            )
+            > 0
+        )
+
+    def test_termination_counters(self):
+        env = self.make_env()
+        env.store.create(make_pod())
+        env.settle()
+        for p in env.store.list("Pod"):
+            env.store.delete("Pod", p.metadata.name, namespace=p.metadata.namespace, grace=False)
+        env.settle(rounds=30)
+        assert env.store.count("Node") == 0
+        assert env.registry.counter(m.NODES_TERMINATED_TOTAL).total() >= 1
+        assert env.registry.counter(m.NODECLAIMS_TERMINATED_TOTAL).total() >= 1
+        # disruption decisions recorded (emptiness consolidation)
+        assert env.registry.counter(m.DISRUPTION_DECISIONS_TOTAL).total() >= 1
+
+    def test_expose_contains_karpenter_namespace(self):
+        env = self.make_env()
+        env.store.create(make_pod())
+        env.settle()
+        text = env.registry.expose()
+        assert "karpenter_nodeclaims_created_total" in text
+        assert "karpenter_scheduler_scheduling_duration_seconds_bucket" in text
