@@ -211,9 +211,16 @@ class LifecycleController:
                 # override blocked PDBs / do-not-disrupt (termination.go TGP)
                 if nc.spec.termination_grace_period is not None:
                     deadline = self.clock.now() + nc.spec.termination_grace_period
+                    # an earlier deadline already stamped (e.g. by node repair's
+                    # force-drain) wins; never extend it
+                    existing = nc.metadata.annotations.get(wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY)
+                    if existing is not None:
+                        deadline = min(deadline, float(existing))
 
                     def stamp(n):
-                        n.metadata.annotations[wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY] = str(deadline)
+                        cur = n.metadata.annotations.get(wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY)
+                        if cur is None or float(cur) > deadline:
+                            n.metadata.annotations[wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY] = str(deadline)
 
                     self.store.patch("Node", node.metadata.name, stamp)
                 self.store.try_delete("Node", node.metadata.name)  # graceful: drain runs

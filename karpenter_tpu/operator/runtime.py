@@ -19,6 +19,7 @@ from ..controllers.nodeclaim.disruption import NodeClaimDisruptionController
 from ..controllers.nodeclaim.expiration import ExpirationController
 from ..controllers.nodeclaim.hydration import HydrationController
 from ..controllers.nodeclaim.podevents import PodEventsController
+from ..controllers.node.health import HealthController
 from ..controllers.node.termination import TerminationController
 from ..controllers.nodeclaim.garbagecollection import GarbageCollectionController
 from ..controllers.nodeclaim.lifecycle import LifecycleController
@@ -93,6 +94,11 @@ class Environment:
             self.store, self.cluster, self.cloud_provider, self.clock,
             recorder=self.recorder, metrics=self.registry,
         )
+        self.health = HealthController(
+            self.store, self.cluster, self.cloud_provider, self.clock,
+            recorder=self.recorder, metrics=self.registry,
+            enabled=self.options.feature_gates.node_repair,
+        )
         self.nodeclaim_disruption = NodeClaimDisruptionController(self.store, self.cluster, self.cloud_provider, self.clock)
         self.disruption = DisruptionController(
             self.store, self.cluster, self.provisioner, self.cloud_provider, self.clock, self.options,
@@ -145,6 +151,7 @@ class Environment:
         self.hydration.reconcile()
         self.consistency.reconcile()
         self.expiration.reconcile()
+        self.health.reconcile()
         self.nodeclaim_disruption.reconcile()
         self.disruption.reconcile()
         self.pod_metrics.reconcile()

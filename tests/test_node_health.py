@@ -1,0 +1,83 @@
+"""Node health/repair controller (reference: pkg/controllers/node/health)."""
+
+from helpers import hostname_anti_affinity, make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.kube.objects import NodeCondition
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_env(node_repair=True, pods=5):
+    opts = Options()
+    opts.feature_gates.node_repair = node_repair
+    env = Environment(options=opts)
+    env.store.create(make_nodepool(requirements=LINUX_AMD64))
+    # hostname anti-affinity forces one node per pod -> multi-node pool
+    sel = {"matchLabels": {"app": "spread"}}
+    for _ in range(pods):
+        env.store.create(
+            make_pod(cpu="1", labels={"app": "spread"}, anti_affinity=[hostname_anti_affinity(sel)])
+        )
+    env.settle()
+    return env
+
+
+def mark_unhealthy(env, node_name, status="False", age=0.0):
+    def apply(n):
+        n.status.conditions = [c for c in n.status.conditions if c.type != "Ready"]
+        n.status.conditions.append(
+            NodeCondition(type="Ready", status=status, last_transition_time=env.clock.now() - age)
+        )
+
+    env.store.patch("Node", node_name, apply)
+
+
+class TestNodeHealth:
+    def test_unhealthy_node_repaired_after_toleration(self):
+        env = make_env()
+        nodes = env.store.list("Node")
+        assert len(nodes) >= 4
+        victim = nodes[0].metadata.name
+        mark_unhealthy(env, victim, age=11 * 60)  # past the 10m KWOK toleration
+        env.settle(rounds=25)
+        assert env.store.try_get("Node", victim) is None
+        # pods rescheduled, node replaced
+        assert all(p.spec.node_name for p in env.store.list("Pod"))
+        assert "NodeRepair" in env.recorder.reasons()
+
+    def test_within_toleration_not_repaired(self):
+        env = make_env()
+        victim = env.store.list("Node")[0].metadata.name
+        mark_unhealthy(env, victim, age=60.0)
+        env.health.reconcile()
+        assert env.store.try_get("Node", victim) is not None
+
+    def test_gate_off_no_repair(self):
+        env = make_env(node_repair=False)
+        victim = env.store.list("Node")[0].metadata.name
+        mark_unhealthy(env, victim, age=11 * 60)
+        env.health.reconcile()
+        env.settle(rounds=3)
+        assert env.store.try_get("Node", victim) is not None
+
+    def test_mass_unhealthy_blocks_repair(self):
+        env = make_env()
+        nodes = env.store.list("Node")
+        # make >20% of the pool unhealthy
+        for n in nodes:
+            mark_unhealthy(env, n.metadata.name, age=11 * 60)
+        env.health.reconcile()
+        assert env.store.count("Node") == len(nodes)  # nothing deleted
+        assert "NodeRepairBlocked" in env.recorder.reasons()
+
+    def test_unknown_status_matches_policy(self):
+        env = make_env()
+        victim = env.store.list("Node")[0].metadata.name
+        mark_unhealthy(env, victim, status="Unknown", age=11 * 60)
+        env.settle(rounds=25)
+        assert env.store.try_get("Node", victim) is None
