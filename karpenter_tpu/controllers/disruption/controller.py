@@ -23,17 +23,34 @@ class _Ctx:
     provisioner: object
     clock: object
     options: object
+    cluster_cost: object = None
+    # per-round candidate set + lazily-memoized balanced-scoring totals
+    # (balanced.go computeNodePoolTotals); only consolidation methods touching
+    # a Balanced pool ever pay for the totals pass
+    round_candidates: list | None = None
+    node_pool_totals: dict | None = None
+
+    def balanced_totals(self) -> dict:
+        if self.node_pool_totals is None:
+            from .balanced import compute_node_pool_totals
+
+            self.node_pool_totals = compute_node_pool_totals(
+                self.round_candidates or [], self.cluster.nodes(), self.cluster_cost
+            )
+        return self.node_pool_totals
 
 
 class DisruptionController:
-    def __init__(self, store, cluster, provisioner, cloud_provider, clock, options, recorder=None, metrics=None):
+    def __init__(self, store, cluster, provisioner, cloud_provider, clock, options, recorder=None, metrics=None, cluster_cost=None):
         self.store = store
         self.cluster = cluster
         self.provisioner = provisioner
         self.cloud_provider = cloud_provider
         self.clock = clock
         self.options = options
-        ctx = _Ctx(store, cluster, provisioner, clock, options)
+        self.cluster_cost = cluster_cost
+        ctx = _Ctx(store, cluster, provisioner, clock, options, cluster_cost=cluster_cost)
+        self.ctx = ctx
         self.methods = [
             Emptiness(ctx),
             Drift(ctx),
@@ -74,6 +91,8 @@ class DisruptionController:
                 self.metrics.gauge(m.DISRUPTION_ELIGIBLE_NODES).set(len(candidates), method=mname, consolidation_type=ctype)
             if not candidates:
                 return False
+            self.ctx.round_candidates = candidates
+            self.ctx.node_pool_totals = None
             budgets = build_disruption_budget_mapping(self.store, self.cluster, self.clock, method.reason)
             t0 = _time.perf_counter()
             commands = method.compute_commands(candidates, budgets)

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from ...apis import labels as wk
 from ...apis.nodeclaim import COND_CONSOLIDATABLE, COND_DRIFTED
-from ...apis.nodepool import BALANCED_K, WHEN_EMPTY, WHEN_EMPTY_OR_UNDERUTILIZED
+from ...apis.nodepool import BALANCED, WHEN_EMPTY, WHEN_EMPTY_OR_UNDERUTILIZED
 from ...cloudprovider.types import order_by_price
 from .helpers import all_non_pending_scheduled, simulate_scheduling
 from .types import REASON_DRIFTED, REASON_EMPTY, REASON_UNDERUTILIZED, Command
@@ -162,22 +162,14 @@ class _ConsolidationBase:
         return Command(reason=self.reason, candidates=list(candidates), replacements=[replacement], results=results)
 
     def _passes_balanced(self, command: Command) -> bool:
-        """Balanced policy gate (balanced.go:108-130): savings%/disruption%
-        >= 1/k with k=2."""
-        balanced = [c for c in command.candidates if c.node_pool.spec.disruption.consolidation_policy == "Balanced"]
-        if not balanced:
+        """Balanced policy gate (balanced.go:131-182): every Balanced pool the
+        move touches must clear the 1/k score threshold against the per-pool
+        totals the controller computed for this round."""
+        if not any(c.node_pool.spec.disruption.consolidation_policy == BALANCED for c in command.candidates):
             return True
-        savings = sum(c.price for c in command.candidates) - _replacement_price(command)
-        total_price = sum(c.price for c in command.candidates) or 1e-9
-        disruption = sum(c.disruption_cost for c in command.candidates)
-        total_cost = sum(
-            n.disruption_cost() for n in self.ctx.cluster.nodes() if n.nodepool_name() is not None
-        ) or 1e-9
-        savings_pct = savings / total_price
-        disruption_pct = disruption / total_cost
-        if disruption_pct <= 0:
-            return True
-        return (savings_pct / disruption_pct) >= 1.0 / BALANCED_K
+        from .balanced import evaluate_balanced_move
+
+        return evaluate_balanced_move(command, _replacement_price(command), self.ctx.balanced_totals())
 
 
 class SingleNodeConsolidation(_ConsolidationBase):

@@ -27,6 +27,9 @@ class Candidate:
     price: float
     reschedulable_pods: list
     disruption_cost: float
+    # 1.0 base + sum of positive pod eviction costs; the numerator/denominator
+    # unit of balanced scoring (types.go:85-89 RescheduleDisruptionCost)
+    reschedule_disruption_cost: float = 1.0
 
     def name(self) -> str:
         return self.state_node.name()
@@ -103,6 +106,8 @@ def build_candidate(cluster, store, clock, state_node, node_pools_by_name, insta
             price=price,
             reschedulable_pods=reschedulable,
             disruption_cost=cost,
+            reschedule_disruption_cost=1.0
+            + sum(max(0.0, disruption_utils.eviction_cost(p)) for p in reschedulable),
         ),
         None,
     )

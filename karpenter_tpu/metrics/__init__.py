@@ -52,6 +52,8 @@ NODES_CURRENT_LIFETIME = "karpenter_nodes_current_lifetime_seconds"
 
 NODEPOOL_USAGE = "karpenter_nodepools_usage"
 NODEPOOL_LIMIT = "karpenter_nodepools_limit"
+NODEPOOL_COST_TOTAL = "karpenter_nodepools_cost_total"
+NODEPOOL_COST_TRACKER_ERRORS_TOTAL = "karpenter_nodepools_cost_tracker_errors_total"
 
 CLUSTER_STATE_SYNCED = "karpenter_cluster_state_synced"
 CLUSTER_STATE_NODE_COUNT = "karpenter_cluster_state_node_count"
@@ -90,6 +92,8 @@ def make_registry() -> Registry:
     r.gauge(NODES_CURRENT_LIFETIME, "Node age", ("node_name", "nodepool"))
     r.gauge(NODEPOOL_USAGE, "Per-pool resource usage", ("nodepool", "resource_type"))
     r.gauge(NODEPOOL_LIMIT, "Per-pool resource limits", ("nodepool", "resource_type"))
+    r.gauge(NODEPOOL_COST_TOTAL, "Total tracked cost of the nodepool (not authoritative for billing)", ("nodepool",))
+    r.counter(NODEPOOL_COST_TRACKER_ERRORS_TOTAL, "Cost tracking errors", ("nodepool",))
     r.gauge(CLUSTER_STATE_SYNCED, "1 if cluster state is synced", ())
     r.gauge(CLUSTER_STATE_NODE_COUNT, "Nodes tracked by cluster state", ())
     return r

@@ -42,6 +42,7 @@ from ..kube.binder import Binder
 from ..metrics import make_registry
 from ..solver import FFDSolver
 from ..state import Cluster
+from ..state.cost import ClusterCost, PricingController, start_cost_informer
 from ..state.informer import start_informers
 from ..state.nodepoolhealth import NodePoolHealthState
 from ..utils.clock import Clock, FakeClock
@@ -66,6 +67,10 @@ class Environment:
             its = instance_types if instance_types is not None else catalog.construct_instance_types()
             self.store.create(KWOKNodeClass())
             self.cloud_provider = KWOKCloudProvider(self.store, its, clock=self.clock)
+
+        self.cluster_cost = ClusterCost(self.store, self.cloud_provider, metrics=self.registry)
+        start_cost_informer(self.store, self.cluster_cost)
+        self.pricing = PricingController(self.store, self.cloud_provider, self.cluster_cost, self.clock)
 
         solver = self._make_solver()
         self.provisioner = Provisioner(
@@ -102,7 +107,7 @@ class Environment:
         self.nodeclaim_disruption = NodeClaimDisruptionController(self.store, self.cluster, self.cloud_provider, self.clock)
         self.disruption = DisruptionController(
             self.store, self.cluster, self.provisioner, self.cloud_provider, self.clock, self.options,
-            recorder=self.recorder, metrics=self.registry,
+            recorder=self.recorder, metrics=self.registry, cluster_cost=self.cluster_cost,
         )
         self.expiration = ExpirationController(self.store, self.clock, metrics=self.registry)
         self.consistency = ConsistencyController(self.store, self.clock, recorder=self.recorder)
@@ -116,7 +121,7 @@ class Environment:
         self.nodepool_validation = NodePoolValidationController(self.store, self.clock)
         self.pod_metrics = PodMetricsController(self.store, self.clock, self.registry)
         self.node_metrics = NodeMetricsController(self.store, self.cluster, self.clock, self.registry)
-        self.nodepool_metrics = NodePoolMetricsController(self.store, self.registry)
+        self.nodepool_metrics = NodePoolMetricsController(self.store, self.registry, cluster_cost=self.cluster_cost)
         self.extra_controllers: list = []  # later controllers appended as built
 
         # pod watch triggers the provisioner batcher (state informer §3.5)
@@ -154,6 +159,7 @@ class Environment:
         self.health.reconcile()
         self.nodeclaim_disruption.reconcile()
         self.disruption.reconcile()
+        self.pricing.reconcile()
         self.pod_metrics.reconcile()
         self.node_metrics.reconcile()
         self.nodepool_metrics.reconcile()

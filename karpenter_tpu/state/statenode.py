@@ -128,7 +128,9 @@ class StateNode:
         return res.subtract(self.allocatable(), self.total_pod_requests())
 
     def disruption_cost(self) -> float:
-        return sum(self.pod_disruption_costs.values())
+        """1.0 per-node base + positive non-daemon pod eviction costs
+        (statenode.go:427-434)."""
+        return 1.0 + sum(self.pod_disruption_costs.values())
 
     # -- pod tracking ----------------------------------------------------------
     def update_for_pod(self, pod) -> None:
@@ -136,8 +138,16 @@ class StateNode:
         requests = res.pod_requests(pod)
         self.pod_requests[key] = requests
         self.pod_limits[key] = res.pod_limits(pod)
-        self.pod_disruption_costs[key] = disruption_utils.eviction_cost(pod)
-        if pod_utils.is_owned_by_daemonset(pod):
+        # only non-daemon pods with positive eviction cost contribute to the
+        # node's disruption cost, matching the Candidate numerator units
+        # (statenode.go:477-488)
+        if not pod_utils.is_owned_by_daemonset(pod):
+            cost = disruption_utils.eviction_cost(pod)
+            if cost > 0:
+                self.pod_disruption_costs[key] = cost
+            else:
+                self.pod_disruption_costs.pop(key, None)
+        else:
             self.daemonset_requests[key] = requests
         self.host_port_usage.add(key, pod_host_ports(pod))
 

@@ -1,0 +1,185 @@
+"""ClusterCost tracking + balanced scoring specs (reference:
+pkg/state/cost/suite_test.go, disruption/balanced.go coverage)."""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.controllers.disruption.balanced import (
+    NodePoolTotals,
+    ScoreResult,
+    compute_node_pool_totals,
+    evaluate_balanced_move,
+    score_move,
+)
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_env(**np_kwargs):
+    env = Environment(options=Options())
+    np_kwargs.setdefault("requirements", LINUX_AMD64)
+    env.store.create(make_nodepool(**np_kwargs))
+    return env
+
+
+class TestClusterCost:
+    def test_tracks_provisioned_claims(self):
+        env = make_env()
+        for i in range(3):
+            env.store.create(make_pod(cpu="1", name=f"p{i}"))
+        env.settle(rounds=6)
+        assert env.store.count("NodeClaim") >= 1
+        total = env.cluster_cost.get_cluster_cost()
+        assert total > 0
+        assert abs(total - env.cluster_cost.get_nodepool_cost("default-pool")) < 1e-12
+
+    def test_cost_matches_offering_price(self):
+        env = make_env()
+        env.store.create(make_pod(cpu="1", name="p"))
+        env.settle(rounds=6)
+        nc = env.store.list("NodeClaim")[0]
+        labels = nc.metadata.labels
+        np_ = env.store.get("NodePool", "default-pool")
+        it = next(
+            it
+            for it in env.cloud_provider.get_instance_types(np_)
+            if it.name == labels[wk.INSTANCE_TYPE_LABEL_KEY]
+        )
+        price = it.offering_price(labels[wk.ZONE_LABEL_KEY], labels[wk.CAPACITY_TYPE_LABEL_KEY])
+        assert abs(env.cluster_cost.get_cluster_cost() - price) < 1e-9
+
+    def test_deleted_claim_decrements(self):
+        env = make_env()
+        env.store.create(make_pod(cpu="1", name="p"))
+        env.settle(rounds=6)
+        assert env.cluster_cost.get_cluster_cost() > 0
+        env.store.delete("Pod", "p")
+        for _ in range(12):
+            env.clock.step(30)
+            env.tick(provision_force=True)
+        assert env.store.count("NodeClaim") == 0
+        assert env.cluster_cost.get_cluster_cost() == 0
+
+    def test_claim_without_labels_ignored_until_labeled(self):
+        from karpenter_tpu.apis.nodeclaim import NodeClaim
+        from karpenter_tpu.kube import ObjectMeta
+
+        env = make_env()
+        nc = NodeClaim(metadata=ObjectMeta(name="bare"))
+        env.store.create(nc)
+        assert env.cluster_cost.get_cluster_cost() == 0
+
+        def label(obj):
+            obj.metadata.labels.update(
+                {
+                    wk.NODEPOOL_LABEL_KEY: "default-pool",
+                    wk.INSTANCE_TYPE_LABEL_KEY: "c-4x-amd64-linux",
+                    wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND,
+                    wk.ZONE_LABEL_KEY: "test-zone-a",
+                }
+            )
+
+        env.store.patch("NodeClaim", "bare", label)
+        # MODIFIED event retries the add now that labels are present
+        assert "bare" in env.cluster_cost._claims
+        np_ = env.store.get("NodePool", "default-pool")
+        it = next(
+            it for it in env.cloud_provider.get_instance_types(np_) if it.name == "c-4x-amd64-linux"
+        )
+        price = it.offering_price("test-zone-a", wk.CAPACITY_TYPE_ON_DEMAND)
+        assert abs(env.cluster_cost.get_nodepool_cost("default-pool") - price) < 1e-9
+
+    def test_delete_node_pool_clears(self):
+        env = make_env()
+        env.store.create(make_pod(cpu="1", name="p"))
+        env.settle(rounds=6)
+        env.cluster_cost.delete_node_pool("default-pool")
+        assert env.cluster_cost.get_cluster_cost() == 0
+
+    def test_pricing_controller_refreshes_prices(self):
+        """Catalog price changes reach the totals via the periodic pricing
+        refresh (informer/pricing.go)."""
+        env = make_env()
+        env.store.create(make_pod(cpu="1", name="p"))
+        env.settle(rounds=6)
+        before = env.cluster_cost.get_cluster_cost()
+        assert before > 0
+        for it in env.cloud_provider.instance_types:
+            for o in it.offerings:
+                o.price *= 3
+        env.pricing.reconcile(force=True)
+        assert abs(env.cluster_cost.get_cluster_cost() - 3 * before) < 1e-9
+
+    def test_update_offerings_reprices(self):
+        env = make_env()
+        env.store.create(make_pod(cpu="1", name="p"))
+        env.settle(rounds=6)
+        np_ = env.store.get("NodePool", "default-pool")
+        its = env.cloud_provider.get_instance_types(np_)
+        for it in its:
+            for o in it.offerings:
+                o.price = o.price * 2
+        before = env.cluster_cost.get_cluster_cost()
+        env.cluster_cost.update_offerings(np_, its)
+        assert abs(env.cluster_cost.get_cluster_cost() - 2 * before) < 1e-9
+
+
+class TestBalancedScoring:
+    def test_score_move_threshold(self):
+        totals = NodePoolTotals(total_cost=10.0, total_disruption_cost=10.0)
+        # savings 10% of pool cost, disrupting 10% of pool: score 1.0 >= 0.5
+        assert score_move(1.0, 1.0, totals).approved()
+        # savings 1% while disrupting 10%: score 0.1 < 0.5
+        assert not score_move(0.1, 1.0, totals).approved()
+
+    def test_zero_totals_not_approved(self):
+        assert not score_move(1.0, 1.0, NodePoolTotals()).approved()
+
+    def test_zero_disruption_is_infinite_score(self):
+        r = ScoreResult(savings_fraction=0.5, disruption_fraction=0.0)
+        assert r.score() == float("inf") and r.approved()
+
+    def test_evaluate_only_gates_balanced_pools(self):
+        """A command touching no Balanced pool is approved by the method-level
+        gate before evaluate_balanced_move is even called; here we check
+        evaluate skips non-Balanced pools."""
+        env = make_env()
+        env.store.create(make_pod(cpu="1", name="p"))
+        env.settle(rounds=6)
+        ctrl = env.disruption
+        candidates = ctrl.get_candidates()
+        assert candidates
+        totals = compute_node_pool_totals(candidates, env.cluster.nodes(), env.cluster_cost)
+        assert totals["default-pool"].total_cost > 0
+        assert totals["default-pool"].total_disruption_cost >= 1.0
+
+        from karpenter_tpu.controllers.disruption.types import Command
+
+        cmd = Command(reason="Underutilized", candidates=candidates)
+        # default policy is not Balanced -> every pool skipped -> approved
+        assert evaluate_balanced_move(cmd, 0.0, totals)
+
+    def test_balanced_pool_blocks_tiny_savings(self):
+        env = make_env()
+        env.store.create(make_pod(cpu="1", name="p"))
+        env.settle(rounds=6)
+        def set_balanced(np_):
+            np_.spec.disruption.consolidation_policy = "Balanced"
+
+        env.store.patch("NodePool", "default-pool", set_balanced)
+        candidates = env.disruption.get_candidates()
+        assert candidates
+        totals = compute_node_pool_totals(candidates, env.cluster.nodes(), env.cluster_cost)
+
+        from karpenter_tpu.controllers.disruption.types import Command
+
+        cmd = Command(reason="Underutilized", candidates=candidates)
+        source = sum(c.price for c in candidates)
+        # replacement nearly as expensive -> tiny savings -> blocked
+        assert not evaluate_balanced_move(cmd, source * 0.999, totals)
+        # free replacement -> savings = 100% of pool cost -> approved
+        assert evaluate_balanced_move(cmd, 0.0, totals)
