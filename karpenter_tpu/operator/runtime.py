@@ -13,6 +13,8 @@ from __future__ import annotations
 from ..apis.kwoknodeclass import KWOKNodeClass
 from ..cloudprovider import catalog
 from ..cloudprovider.kwok import KWOKCloudProvider
+from ..cloudprovider.metrics import MetricsCloudProvider
+from ..cloudprovider.overlay import OverlayCloudProvider
 from ..controllers.disruption import DisruptionController
 from ..controllers.nodeclaim.consistency import ConsistencyController
 from ..controllers.nodeclaim.disruption import NodeClaimDisruptionController
@@ -30,6 +32,7 @@ from ..controllers.nodepool import (
     NodePoolRegistrationHealthController,
     NodePoolValidationController,
 )
+from ..controllers.nodeoverlay import InstanceTypeStore, NodeOverlayController
 from ..controllers.provisioning.provisioner import Provisioner, ProvisionerOptions
 from ..controllers.metrics import (
     NodeMetricsController,
@@ -62,11 +65,23 @@ class Environment:
         start_informers(self.store, self.cluster)
 
         if cloud_provider is not None:
-            self.cloud_provider = cloud_provider
+            base_cloud_provider = cloud_provider
         else:
             its = instance_types if instance_types is not None else catalog.construct_instance_types()
             self.store.create(KWOKNodeClass())
-            self.cloud_provider = KWOKCloudProvider(self.store, its, clock=self.clock)
+            base_cloud_provider = KWOKCloudProvider(self.store, its, clock=self.clock)
+        # decorator stack (kwok/main.go:36-37 + cloudprovider/metrics): the
+        # overlay controller reads the undecorated provider; everyone else the
+        # overlay+metrics-decorated one
+        self.base_cloud_provider = base_cloud_provider
+        self.instance_type_store = InstanceTypeStore()
+        self.cloud_provider = MetricsCloudProvider(
+            OverlayCloudProvider(base_cloud_provider, self.instance_type_store, self.options), self.registry
+        )
+        self.nodeoverlay = NodeOverlayController(
+            self.store, base_cloud_provider, self.instance_type_store, self.cluster, self.clock,
+            options=self.options,
+        )
 
         self.cluster_cost = ClusterCost(self.store, self.cloud_provider, metrics=self.registry)
         start_cost_informer(self.store, self.cluster_cost)
@@ -139,6 +154,7 @@ class Environment:
         """One controller round: provision -> launch/register/init -> bind."""
         if hasattr(self.cloud_provider, "flush_pending"):
             self.cloud_provider.flush_pending()
+        self.nodeoverlay.reconcile()
         self.nodepool_hash.reconcile()
         self.nodepool_validation.reconcile()
         self.nodepool_registration_health.reconcile()

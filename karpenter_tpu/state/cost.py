@@ -22,6 +22,15 @@ NECESSARY_LABELS = (
 )
 
 
+def _build_price_index(instance_types) -> dict[tuple[str, str, str], float]:
+    """(zone, capacity_type, instance_name) -> price for a pool's catalog."""
+    index: dict[tuple[str, str, str], float] = {}
+    for it in instance_types:
+        for o in it.offerings:
+            index[(o.zone(), o.capacity_type(), it.name)] = o.price
+    return index
+
+
 @dataclass
 class _OfferingCount:
     count: int = 0
@@ -100,10 +109,7 @@ class ClusterCost:
     # -- price refresh (cost.go:128-157) ---------------------------------------
     def update_offerings(self, node_pool, instance_types) -> None:
         """Re-price active offerings after catalog/pricing changes."""
-        prices = {}
-        for it in instance_types:
-            for o in it.offerings:
-                prices[(o.zone(), o.capacity_type(), it.name)] = o.price
+        prices = _build_price_index(instance_types)
         self._price_index[node_pool.metadata.name] = prices
         npc = self._pools.get(node_pool.metadata.name)
         if npc is None:
@@ -136,10 +142,7 @@ class ClusterCost:
             np_ = self.store.try_get("NodePool", pool)
             if np_ is None:
                 return 0.0
-            index = {}
-            for it in self.cloud_provider.get_instance_types(np_):
-                for o in it.offerings:
-                    index[(o.zone(), o.capacity_type(), it.name)] = o.price
+            index = _build_price_index(self.cloud_provider.get_instance_types(np_))
             self._price_index[pool] = index
         return index.get(key, 0.0)
 

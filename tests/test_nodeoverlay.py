@@ -1,0 +1,275 @@
+"""NodeOverlay specs, modeled on the reference's
+pkg/controllers/nodeoverlay/{suite,store}_test.go coverage."""
+
+import pytest
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeoverlay import (
+    COND_VALIDATION_SUCCEEDED,
+    NodeOverlay,
+    NodeOverlaySpec,
+    order_by_weight,
+)
+from karpenter_tpu.kube import ObjectMeta
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.utils.resources import parse_resource_list
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_env(**opt_kwargs):
+    opts = Options(**opt_kwargs)
+    opts.feature_gates.node_overlay = True
+    env = Environment(options=opts)
+    env.store.create(make_nodepool(requirements=LINUX_AMD64))
+    return env
+
+
+def overlay(name, requirements=None, price=None, price_adjustment=None, capacity=None, weight=0):
+    return NodeOverlay(
+        metadata=ObjectMeta(name=name),
+        spec=NodeOverlaySpec(
+            requirements=requirements or [],
+            price=price,
+            price_adjustment=price_adjustment,
+            capacity=parse_resource_list(capacity) if capacity else {},
+            weight=weight,
+        ),
+    )
+
+
+def types_by_name(env, pool="default-pool"):
+    np_ = env.store.get("NodePool", pool)
+    return {it.name: it for it in env.cloud_provider.get_instance_types(np_)}
+
+
+class TestPriceOverlay:
+    def test_absolute_price_override(self):
+        env = make_env()
+        env.store.create(
+            overlay(
+                "cheap-c",
+                requirements=[{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["c-4x-amd64-linux"]}],
+                price="0.001",
+            )
+        )
+        env.tick()
+        it = types_by_name(env)["c-4x-amd64-linux"]
+        assert all(abs(o.price - 0.001) < 1e-12 for o in it.offerings)
+        assert all(o.price_overlaid for o in it.offerings)
+        # untouched types share un-overlaid prices
+        other = types_by_name(env)["c-8x-amd64-linux"]
+        assert not any(o.price_overlaid for o in other.offerings)
+
+    def test_percentage_adjustment(self):
+        env = make_env()
+        env.tick()  # evaluate pools so the decorated provider serves types
+        before = {(o.zone(), o.capacity_type()): o.price for o in types_by_name(env)["c-4x-amd64-linux"].offerings}
+        env.store.create(
+            overlay(
+                "half-off",
+                requirements=[{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["c-4x-amd64-linux"]}],
+                price_adjustment="-50%",
+            )
+        )
+        env.tick()
+        after = types_by_name(env)["c-4x-amd64-linux"]
+        for o in after.offerings:
+            assert abs(o.price - before[(o.zone(), o.capacity_type())] * 0.5) < 1e-9
+
+    def test_higher_weight_wins(self):
+        env = make_env()
+        sel = [{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["c-4x-amd64-linux"]}]
+        env.store.create(overlay("low", requirements=sel, price="5.0", weight=1))
+        env.store.create(overlay("high", requirements=sel, price="9.0", weight=10))
+        env.tick()
+        it = types_by_name(env)["c-4x-amd64-linux"]
+        assert all(abs(o.price - 9.0) < 1e-12 for o in it.offerings)
+        # both validate clean: different weights are not a conflict
+        for name in ("low", "high"):
+            ov = env.store.get("NodeOverlay", name)
+            assert ov.status.conditions.is_true(COND_VALIDATION_SUCCEEDED)
+
+    def test_equal_weight_conflict_detected(self):
+        env = make_env()
+        sel = [{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["c-4x-amd64-linux"]}]
+        env.store.create(overlay("aaa", requirements=sel, price="5.0", weight=3))
+        env.store.create(overlay("bbb", requirements=sel, price="9.0", weight=3))
+        env.tick()
+        # 'bbb' (later alphabetically) is processed first and wins; 'aaa' conflicts
+        it = types_by_name(env)["c-4x-amd64-linux"]
+        assert all(abs(o.price - 9.0) < 1e-12 for o in it.offerings)
+        assert env.store.get("NodeOverlay", "bbb").status.conditions.is_true(COND_VALIDATION_SUCCEEDED)
+        cond = env.store.get("NodeOverlay", "aaa").status.conditions.get(COND_VALIDATION_SUCCEEDED)
+        assert cond is not None and cond.status == "False" and cond.reason == "Conflict"
+
+    def test_zone_scoped_price_overlay(self):
+        env = make_env()
+        env.store.create(
+            overlay(
+                "zone-a-free",
+                requirements=[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}],
+                price="0.0",
+            )
+        )
+        env.tick()
+        it = types_by_name(env)["c-4x-amd64-linux"]
+        for o in it.offerings:
+            if o.zone() == "test-zone-a":
+                assert o.price == 0.0
+            else:
+                assert o.price > 0.0
+
+    def test_scheduling_uses_overlaid_prices(self):
+        """Making one mid-size type nearly free steers the scheduler's
+        price-ordering to it (launch still resolves against the provider's own
+        catalog, as in the reference's KWOK Create)."""
+        env = make_env()
+        env.store.create(
+            overlay(
+                "free-16x",
+                requirements=[{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["c-16x-amd64-linux"]}],
+                price="0.0001",
+            )
+        )
+        env.tick()
+        results = env.provisioner.schedule([make_pod(cpu="1", name="p")])
+        assert len(results.new_node_claims) == 1
+        nc = results.new_node_claims[0].to_api_node_claim(env.clock)
+        it_values = next(r["values"] for r in nc.spec.requirements if r["key"] == wk.INSTANCE_TYPE_LABEL_KEY)
+        assert it_values[0] == "c-16x-amd64-linux"  # cheapest by overlaid price
+
+
+class TestCapacityOverlay:
+    def test_extended_resource_added(self):
+        env = make_env()
+        env.store.create(
+            overlay(
+                "gpuify",
+                requirements=[{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["c-4x-amd64-linux"]}],
+                capacity={"example.com/gpu": "4"},
+            )
+        )
+        env.tick()
+        it = types_by_name(env)["c-4x-amd64-linux"]
+        assert it.capacity["example.com/gpu"].value == 4
+        assert it.capacity_overlaid
+
+    def test_extended_resource_schedules_pod(self):
+        env = make_env()
+        env.store.create(
+            overlay(
+                "gpuify",
+                requirements=[{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["c-4x-amd64-linux"]}],
+                capacity={"example.com/gpu": "4"},
+            )
+        )
+        env.tick()
+        pod = make_pod(cpu="1", name="gpu-pod")
+        pod.spec.containers[0].resources["requests"].update(parse_resource_list({"example.com/gpu": "1"}))
+        results = env.provisioner.schedule([pod])
+        # only the overlaid type can host the extended resource
+        assert len(results.new_node_claims) == 1
+        assert [it.name for it in results.new_node_claims[0].instance_type_options] == ["c-4x-amd64-linux"]
+        assert not results.pod_errors
+
+    def test_restricted_capacity_rejected(self):
+        env = make_env()
+        env.store.create(overlay("bad", requirements=[], capacity={"cpu": "100"}))
+        env.tick()
+        cond = env.store.get("NodeOverlay", "bad").status.conditions.get(COND_VALIDATION_SUCCEEDED)
+        assert cond is not None and cond.status == "False" and cond.reason == "RuntimeValidation"
+        # and it is not applied
+        it = types_by_name(env)["c-4x-amd64-linux"]
+        assert not it.capacity_overlaid
+
+
+class TestOverlayStability:
+    def test_reconcile_converges_no_self_retrigger(self):
+        """Status patches must not re-dirty the controller forever; once
+        settled, further ticks neither re-patch nor clear the consolidation
+        debounce."""
+        env = make_env()
+        env.store.create(
+            overlay(
+                "cheap",
+                requirements=[{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["c-4x-amd64-linux"]}],
+                price="0.5",
+            )
+        )
+        env.tick()
+        env.tick()  # absorbs the status-patch event
+        assert not env.nodeoverlay._dirty
+        env.cluster.mark_consolidated()
+        rv_before = env.store.get("NodeOverlay", "cheap").metadata.resource_version
+        env.tick()
+        assert env.store.get("NodeOverlay", "cheap").metadata.resource_version == rv_before
+        assert env.cluster.consolidated()
+
+    def test_non_adjacent_equal_weight_capacity_conflict(self):
+        env = make_env()
+        sel = [{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["c-4x-amd64-linux"]}]
+        env.store.create(overlay("aa", requirements=sel, capacity={"example.com/gpu": "1"}, weight=5))
+        env.store.create(overlay("bb", requirements=sel, capacity={"example.com/tpu": "1"}, weight=5))
+        env.store.create(overlay("cc", requirements=sel, capacity={"example.com/gpu": "2"}, weight=5))
+        env.tick()
+        # processed in name-desc order: cc first, then bb (distinct resource,
+        # fine), then aa conflicts with cc on example.com/gpu
+        cond = env.store.get("NodeOverlay", "aa").status.conditions.get(COND_VALIDATION_SUCCEEDED)
+        assert cond is not None and cond.reason == "Conflict"
+        assert env.store.get("NodeOverlay", "bb").status.conditions.is_true(COND_VALIDATION_SUCCEEDED)
+        assert env.store.get("NodeOverlay", "cc").status.conditions.is_true(COND_VALIDATION_SUCCEEDED)
+        it = types_by_name(env)["c-4x-amd64-linux"]
+        assert it.capacity["example.com/gpu"].value == 2
+        assert it.capacity["example.com/tpu"].value == 1
+
+
+class TestOverlayValidation:
+    def test_price_and_adjustment_mutually_exclusive(self):
+        ov = overlay("both", price="1.0", price_adjustment="+10%")
+        assert any("cannot set both" in e for e in ov.runtime_validate())
+
+    def test_gte_lte_single_integer(self):
+        ov = overlay("bad-gte", requirements=[{"key": "karpenter.kwok.sh/instance-cpu", "operator": "Gte", "values": ["a"]}])
+        assert ov.runtime_validate()
+        ok = overlay("ok-gte", requirements=[{"key": "karpenter.kwok.sh/instance-cpu", "operator": "Gte", "values": ["4"]}])
+        assert not ok.runtime_validate()
+
+    def test_order_by_weight(self):
+        a, b, c = overlay("a", weight=1), overlay("b", weight=5), overlay("c", weight=1)
+        assert [o.metadata.name for o in order_by_weight([a, b, c])] == ["b", "c", "a"]
+
+
+class TestOverlayGating:
+    def test_gate_off_no_overlay(self):
+        opts = Options()  # node_overlay gate defaults off
+        env = Environment(options=opts)
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        env.store.create(
+            overlay(
+                "cheap",
+                requirements=[{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["c-4x-amd64-linux"]}],
+                price="0.001",
+            )
+        )
+        env.tick()
+        it = types_by_name(env)["c-4x-amd64-linux"]
+        assert not any(o.price_overlaid for o in it.offerings)
+
+    def test_unevaluated_pool_returns_no_types(self):
+        """Before the overlay controller publishes, the decorated provider
+        must not hand out un-overlaid prices (overlay/cloudprovider.go:47-52)."""
+        opts = Options()
+        opts.feature_gates.node_overlay = True
+        env = Environment(options=opts)
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        env.instance_type_store.reset()  # simulate pre-publish state
+        np_ = env.store.get("NodePool", "default-pool")
+        assert env.cloud_provider.get_instance_types(np_) == []
+        env.nodeoverlay.reconcile(force=True)
+        assert env.cloud_provider.get_instance_types(np_)
