@@ -58,6 +58,16 @@ class NodeOverlay:
         errs = []
         if self.spec.price is not None and self.spec.price_adjustment is not None:
             errs.append("cannot set both 'price' and 'priceAdjustment'")
+        # format checks mirror the CRD CEL patterns (nodeoverlay.go:70,80):
+        # price is a plain non-negative decimal; priceAdjustment is a signed
+        # decimal or signed percentage
+        if self.spec.price is not None and not _is_decimal(self.spec.price):
+            errs.append(f"invalid price {self.spec.price!r}, must be a non-negative decimal")
+        if self.spec.price_adjustment is not None:
+            adj = self.spec.price_adjustment.strip()
+            body = adj[:-1] if adj.endswith("%") else adj
+            if not (body.startswith(("+", "-")) and _is_decimal(body[1:])):
+                errs.append(f"invalid priceAdjustment {self.spec.price_adjustment!r}, must be signed decimal or percentage")
         for req in self.spec.requirements:
             op = req.get("operator", "")
             if op not in OVERLAY_OPERATORS:
@@ -75,6 +85,16 @@ class NodeOverlay:
             if res_name in RESTRICTED_CAPACITY_RESOURCES:
                 errs.append(f"invalid capacity: {res_name} in resource, restricted")
         return errs
+
+
+def _is_decimal(s: str) -> bool:
+    s = s.strip()
+    if not s or not s[0].isdigit():  # no sign prefix, matching the CRD pattern
+        return False
+    try:
+        return float(s) >= 0.0
+    except ValueError:
+        return False
 
 
 def order_by_weight(overlays: list[NodeOverlay]) -> list[NodeOverlay]:

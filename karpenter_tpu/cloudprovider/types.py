@@ -44,19 +44,24 @@ class Offering:
         key = f"{wk.GROUP}/reservation-id"
         return r.get(key).any() if r.has(key) else ""
 
-    def apply_price_overlay(self, adjustment: str) -> None:
+    def apply_price_overlay(self, adjustment: str, absolute: bool | None = None) -> None:
         """NodeOverlay price adjustment: absolute ("1.5"), delta ("+0.1"/"-0.1"),
         or percentage ("+10%"/"-10%") — types.go:488-527 AdjustedPrice."""
-        self.price = adjusted_price(self.price, adjustment)
+        self.price = adjusted_price(self.price, adjustment, absolute)
         self.price_overlaid = True
 
 
-def adjusted_price(price: float, change: str) -> float:
+def adjusted_price(price: float, change: str, absolute: bool | None = None) -> float:
+    """`absolute` disambiguates which overlay field the change came from
+    (price vs priceAdjustment); a "+1.5" absolute price must override, not
+    add. None falls back to format sniffing for callers without that context."""
     change = change.strip()
+    if absolute is True:
+        return max(float(change), 0.0)
     if change.endswith("%"):
         pct = float(change[:-1])
         return max(price * (1 + pct / 100.0), 0.0)
-    if change.startswith(("+", "-")):
+    if change.startswith(("+", "-")) or absolute is False:
         return max(price + float(change), 0.0)
     return max(float(change), 0.0)
 

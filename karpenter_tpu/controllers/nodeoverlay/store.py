@@ -36,9 +36,9 @@ def _offering_key(offering):
 
 @dataclass
 class _PriceUpdate:
-    # the winning overlay's price ("1.5") or adjustment ("+10%"), store.go:30-33;
-    # adjusted_price() in cloudprovider/types.py disambiguates by format
+    # the winning overlay's price ("1.5") or adjustment ("+10%"), store.go:30-33
     update: str | None = None
+    absolute: bool = False  # True = spec.price, False = spec.priceAdjustment
     lowest_weight: int = 0
 
 
@@ -78,7 +78,7 @@ class InternalInstanceTypeStore:
             if existing is not None:
                 existing.lowest_weight = overlay.spec.weight
                 continue
-            itu.price[key] = _PriceUpdate(update=price, lowest_weight=overlay.spec.weight)
+            itu.price[key] = _PriceUpdate(update=price, absolute=absolute, lowest_weight=overlay.spec.weight)
 
     def is_offering_update_conflicting(self, pool: str, type_name: str, offering, overlay) -> bool:
         """store.go:267-286 — same weight touching an already-claimed offering."""
@@ -155,7 +155,7 @@ class InternalInstanceTypeStore:
                     available=o.available,
                     reservation_capacity=o.reservation_capacity,
                 )
-                copied.apply_price_overlay(pu.update)
+                copied.apply_price_overlay(pu.update, pu.absolute)
                 offerings.append(copied)
             out.offerings = offerings
         else:

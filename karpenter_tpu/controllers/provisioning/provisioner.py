@@ -65,10 +65,20 @@ class Provisioner:
         return results
 
     def get_pending_pods(self) -> list:
-        """Provisionable pods (provisioner.go:192-221)."""
+        """Provisionable pods (provisioner.go:192-221); pods referencing
+        invalid PVCs are skipped the way kube-scheduler rejects them
+        (provisioner.go:556-566)."""
+        from .scheduling.volumetopology import VolumeTopology
+
+        vt = VolumeTopology(self.store)
         out = []
         for pod in self.store.list("Pod"):
             if not pod_utils.is_provisionable(pod):
+                continue
+            verr = vt.validate_persistent_volume_claims(pod)
+            if verr is not None:
+                if self.recorder is not None:
+                    self.recorder.publish(pod, "FailedScheduling", f"ignoring pod, {verr}", type_="Warning")
                 continue
             out.append(pod)
         return out

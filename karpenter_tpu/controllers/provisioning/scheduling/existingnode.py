@@ -31,6 +31,7 @@ class ExistingNode:
         self.remaining_resources = res.subtract(remaining, daemon_headroom)
 
         self.host_port_usage = state_node.host_port_usage.copy()
+        self.volume_usage = state_node.volume_usage.copy()
         self.requirements = Requirements.from_labels(state_node.labels())
         self.requirements.add(Requirement(wk.HOSTNAME_LABEL_KEY, "In", [state_node.hostname()]))
         topology.register(wk.HOSTNAME_LABEL_KEY, state_node.hostname())
@@ -40,10 +41,13 @@ class ExistingNode:
 
     def can_add(self, pod, pod_data):
         """Returns (updated_requirements, None) or error string
-        (existingnode.go:78-140)."""
+        (existingnode.go:81-139)."""
         err = taints_tolerate_pod(self.taints, pod)
         if err is not None:
             return None, err
+        verr = self.volume_usage.exceeds_limits(pod_data.volumes)
+        if verr is not None:
+            return None, f"checking volume usage, {verr}"
         ports = pod_host_ports(pod)
         cerr = self.host_port_usage.conflicts(pod.key(), ports)
         if cerr is not None:
@@ -57,18 +61,41 @@ class ExistingNode:
         base.add(*self.requirements.values())
         base.add(*pod_data.requirements.values())
 
-        topo = self.topology.add_requirements(pod, self.taints, pod_data.strict_requirements, base)
+        # try each volume topology alternative; the selected constraints shape
+        # the topology checks (existingnode.go:108-137)
+        last_err = None
+        for vol_reqs in pod_data.volume_requirements or [None]:
+            reqs, err = self._try_volume_alternative(pod, pod_data, base, vol_reqs)
+            if err is not None:
+                last_err = err
+                continue
+            return reqs, None
+        return None, last_err
+
+    def _try_volume_alternative(self, pod, pod_data, base: Requirements, vol_reqs):
+        """Volume requirements bind to the node only — never to pod affinity —
+        so spread counting keeps the pod's own constraints
+        (existingnode.go:143-168)."""
+        node_reqs = Requirements()
+        node_reqs.add(*base.values())
+        if vol_reqs is not None:
+            cerr = node_reqs.compatible(vol_reqs)
+            if cerr is not None:
+                return None, f"incompatible volume requirements, {cerr}"
+            node_reqs.add(*vol_reqs.values())
+        topo = self.topology.add_requirements(pod, self.taints, pod_data.strict_requirements, node_reqs)
         if isinstance(topo, str):
             return None, topo
-        cerr = base.compatible(topo)
+        cerr = node_reqs.compatible(topo)
         if cerr is not None:
             return None, cerr
-        base.add(*topo.values())
-        return base, None
+        node_reqs.add(*topo.values())
+        return node_reqs, None
 
     def add(self, pod, pod_data, updated_requirements: Requirements) -> None:
         self.pods.append(pod)
         self.requirements = updated_requirements
         self.remaining_resources = res.subtract(self.remaining_resources, pod_data.requests)
         self.host_port_usage.add(pod.key(), pod_host_ports(pod))
+        self.volume_usage.add(pod.key(), pod_data.volumes)
         self.topology.record(pod, self.taints, self.requirements)

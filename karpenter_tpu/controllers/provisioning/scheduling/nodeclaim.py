@@ -88,7 +88,7 @@ class SchedulingNodeClaim:
 
     def can_add(self, pod, pod_data, relax_min_values: bool = False):
         """Returns (updated_requirements, remaining_instance_types) or an error
-        string (nodeclaim.go:124-208)."""
+        string (nodeclaim.go:124-158)."""
         err = taints_tolerate_pod(self.template.taints, pod)
         if err is not None:
             return None, None, err
@@ -100,31 +100,54 @@ class SchedulingNodeClaim:
             return None, None, f"incompatible requirements, {cerr}"
         base.add(*pod_data.requirements.values())
 
+        # try each volume topology alternative; the selected constraints affect
+        # downstream topology and instance-type checks (nodeclaim.go:138-157)
+        last_err = None
+        for vol_reqs in pod_data.volume_requirements or [None]:
+            reqs, its, err = self._try_volume_alternative(pod, pod_data, base, vol_reqs, relax_min_values)
+            if err is not None:
+                last_err = err
+                continue
+            return reqs, its, None
+        return None, None, last_err
+
+    def _try_volume_alternative(self, pod, pod_data, base: Requirements, vol_reqs, relax_min_values: bool):
+        """One alternative: volume reqs -> topology -> instance-type filter
+        (nodeclaim.go:164-240). Volume reqs narrow the claim only, never the
+        pod's affinity, preserving TSC counting semantics."""
+        claim_reqs = Requirements()
+        claim_reqs.add(*base.values())
+        if vol_reqs is not None:
+            cerr = claim_reqs.compatible(vol_reqs, allow_undefined=wk.WELL_KNOWN_LABELS)
+            if cerr is not None:
+                return None, None, f"incompatible volume requirements, {cerr}"
+            claim_reqs.add(*vol_reqs.values())
+
         topo = self.topology.add_requirements(
-            pod, self.template.taints, pod_data.strict_requirements, base, allow_undefined=wk.WELL_KNOWN_LABELS
+            pod, self.template.taints, pod_data.strict_requirements, claim_reqs, allow_undefined=wk.WELL_KNOWN_LABELS
         )
         if isinstance(topo, str):
             return None, None, topo
-        cerr = base.compatible(topo, allow_undefined=wk.WELL_KNOWN_LABELS)
+        cerr = claim_reqs.compatible(topo, allow_undefined=wk.WELL_KNOWN_LABELS)
         if cerr is not None:
             return None, None, cerr
-        base.add(*topo.values())
+        claim_reqs.add(*topo.values())
 
         requests = res.merge(self.spec_requests, pod_data.requests)
         remaining, unsatisfiable, ferr = filter_instance_types(
-            self.instance_type_options, base, pod, pod_data.requests, self.daemon_overhead_groups, requests, relax_min_values
+            self.instance_type_options, claim_reqs, pod, pod_data.requests, self.daemon_overhead_groups, requests, relax_min_values
         )
         if relax_min_values:
             for key, mv in unsatisfiable.items():
-                # copy-on-write: base aliases Requirement objects owned by the
-                # template; mutating in place would relax minValues for every
+                # copy-on-write: claim_reqs aliases Requirement objects owned by
+                # the template; mutating in place would relax minValues for every
                 # subsequent claim in the solve
-                relaxed = base.get(key).copy()
+                relaxed = claim_reqs.get(key).copy()
                 relaxed.min_values = mv
-                base.replace(relaxed)
+                claim_reqs.replace(relaxed)
         if ferr is not None:
             return None, None, ferr
-        return base, remaining, None
+        return claim_reqs, remaining, None
 
     def add(self, pod, pod_data, updated_requirements: Requirements, updated_instance_types: list[InstanceType]) -> None:
         self.pods.append(pod)

@@ -17,11 +17,13 @@ from ....scheduling.requirements import Requirements
 from ....scheduling.taints import taints_tolerate_pod
 from ....utils import resources as res
 from ....utils.quantity import Quantity
+from ....scheduling.volumeusage import get_volumes
 from .existingnode import ExistingNode
 from .nodeclaim import DaemonOverheadGroup, NodeClaimTemplate, SchedulingNodeClaim
 from .preferences import Preferences
 from .queue import Queue
 from .topology import Topology
+from .volumetopology import VolumeTopology
 
 
 @dataclass
@@ -29,6 +31,10 @@ class PodData:
     requests: dict
     requirements: Requirements
     strict_requirements: Requirements
+    # volume topology requirement alternatives (scheduler.go:222) and the
+    # pod's PVC volumes grouped by driver for limit tracking (scheduler.go:623)
+    volume_requirements: list = field(default_factory=list)
+    volumes: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -82,6 +88,7 @@ class Scheduler:
         self.timeout_seconds = timeout_seconds
         self.preferences = Preferences(tolerate_prefer_no_schedule=(preference_policy == "Ignore"))
         self.cached_pod_data: dict[str, PodData] = {}
+        self.volume_topology = VolumeTopology(store)
 
         # NodePools ordered by weight desc (provisioner.go:268-289)
         pools = sorted(node_pools, key=lambda np: (-np.spec.weight, np.metadata.name))
@@ -199,6 +206,8 @@ class Scheduler:
             requests=res.pod_requests(pod),
             requirements=requirements,
             strict_requirements=strict,
+            volume_requirements=self.volume_topology.get_requirements(pod),
+            volumes=get_volumes(self.store, pod),
         )
 
     def _try_schedule(self, pod) -> str | None:

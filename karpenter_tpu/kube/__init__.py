@@ -11,16 +11,21 @@ whole control plane is testable hermetically and deterministically.
 from .objects import (  # noqa: F401
     Affinity,
     Container,
+    CSINode,
+    CSINodeDriver,
     NodeAffinity,
     Node,
     NodeSpec,
     NodeStatus,
     ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
     Pod,
     PodAffinityTerm,
     PodSpec,
     PodStatus,
     PreferredSchedulingTerm,
+    StorageClass,
     TopologySpreadConstraint,
     WeightedPodAffinityTerm,
 )

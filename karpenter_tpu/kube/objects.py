@@ -213,6 +213,58 @@ class DaemonSet:
 
 
 @dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    # spec
+    volume_name: str = ""  # bound PV name ("" = unbound)
+    storage_class_name: str | None = None  # None = default class; "" = disabled
+    # status
+    phase: str = "Pending"  # Pending | Bound | Lost
+    kind: str = "PersistentVolumeClaim"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    csi_driver: str = ""  # spec.csi.driver ("" = non-CSI)
+    # spec.nodeAffinity.required.nodeSelectorTerms: OR'd terms, each a list of
+    # AND'd {key, operator, values} dicts
+    node_affinity_required: list[list[dict]] = field(default_factory=list)
+    local: bool = False  # spec.local set
+    host_path: bool = False  # spec.hostPath set
+    kind: str = "PersistentVolume"
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    volume_binding_mode: str = "Immediate"  # Immediate | WaitForFirstConsumer
+    # AllowedTopologies: OR'd TopologySelectorTerms, each a list of AND'd
+    # {key, values} matchLabelExpressions
+    allowed_topologies: list[list[dict]] = field(default_factory=list)
+    kind: str = "StorageClass"
+
+
+@dataclass
+class CSINodeDriver:
+    name: str = ""
+    allocatable_count: int | None = None  # max volumes this driver can attach
+
+
+@dataclass
+class CSINode:
+    """Named after the node it describes; carries per-driver volume limits."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    drivers: list[CSINodeDriver] = field(default_factory=list)
+    kind: str = "CSINode"
+
+
+@dataclass
 class PodDisruptionBudget:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: dict | None = None  # metav1 label selector

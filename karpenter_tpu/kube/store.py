@@ -37,6 +37,7 @@ CLUSTER_SCOPED = {
     "PriorityClass",
     "StorageClass",
     "PersistentVolume",
+    "CSINode",
     "ResourceSlice",
     "DeviceClass",
 }

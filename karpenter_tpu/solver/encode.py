@@ -171,6 +171,10 @@ def check_capability(snap) -> list[str]:
             if pod_host_ports(pod):
                 reasons.append(f"{pod.key()}: host ports")
                 break
+            if any(v.get("persistentVolumeClaim") or v.get("ephemeral") is not None for v in pod.spec.volumes):
+                # PVC topology alternatives + per-driver limits stay host-side
+                reasons.append(f"{pod.key()}: PVC-backed volumes")
+                break
             continue
         break
     # inverse anti-affinity from already-running pods isn't tensorized

@@ -16,6 +16,7 @@ from typing import Callable, Optional
 
 from ..apis import labels as wk
 from ..apis.nodeclaim import NodeClaim
+from ..scheduling.volumeusage import get_volumes
 from ..utils import pods as pod_utils
 from ..utils import resources as res
 from ..utils.quantity import Quantity
@@ -134,6 +135,10 @@ class Cluster:
             else:
                 existing.node = node
             self._node_name_to_provider_id[node.metadata.name] = pid
+            # per-driver volume limits from the node's CSINode (cluster.go:854)
+            csi = self.store.try_get("CSINode", node.metadata.name)
+            if csi is not None:
+                self._apply_csi_limits(self._nodes[pid], csi)
             # re-pair claim if one exists with this provider id
             for claim_name, claim_pid in list(self._nodeclaim_name_to_provider_id.items()):
                 if claim_pid == pid and self._nodes[pid].node_claim is None:
@@ -188,6 +193,21 @@ class Cluster:
                     del self._nodes[pid]
             self._bump()
 
+    def apply_csi_node(self, csi) -> None:
+        """CSINode events arrive after node registration in practice; refresh
+        the paired StateNode's per-driver limits whenever one lands."""
+        with self._lock:
+            sn = self._state_node_for(csi.metadata.name)
+            if sn is not None:
+                self._apply_csi_limits(sn, csi)
+                self._bump()
+
+    @staticmethod
+    def _apply_csi_limits(sn: StateNode, csi) -> None:
+        for driver in csi.drivers:
+            if driver.allocatable_count is not None:
+                sn.volume_usage.add_limit(driver.name, driver.allocatable_count)
+
     def update_pod(self, pod) -> None:
         with self._lock:
             key = pod.key()
@@ -204,7 +224,7 @@ class Cluster:
                 self._bindings[key] = pod.spec.node_name
                 sn = self._state_node_for(pod.spec.node_name)
                 if sn is not None:
-                    sn.update_for_pod(pod)
+                    sn.update_for_pod(pod, volumes=get_volumes(self.store, pod))
                 self._pod_acks.pop(key, None)
                 # lastPodEventTime: only on genuine bind transitions, never for
                 # DaemonSet pods, deduped at 10s (podevents/controller.go:110-121)
@@ -246,7 +266,7 @@ class Cluster:
         for pod in self.store.list("Pod"):
             if pod.spec.node_name == node_name and pod_utils.is_active(pod):
                 self._bindings[pod.key()] = node_name
-                sn.update_for_pod(pod)
+                sn.update_for_pod(pod, volumes=get_volumes(self.store, pod))
 
     def _record_pod_event_on_claim(self, node_name: str) -> None:
         sn = self._state_node_for(node_name)

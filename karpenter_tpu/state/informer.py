@@ -33,11 +33,18 @@ def start_informers(store, cluster: Cluster) -> None:
     def on_change(event: str, obj) -> None:
         cluster.mark_unconsolidated()
 
+    def on_csi_node(event: str, csi) -> None:
+        # CSI drivers typically publish limits AFTER the node registers;
+        # re-apply on every CSINode event so late/updated limits take effect
+        if event != "DELETED":
+            cluster.apply_csi_node(csi)
+
     store.watch("Node", on_node)
     store.watch("NodeClaim", on_node_claim)
     store.watch("Pod", on_pod)
     store.watch("NodePool", on_change)
     store.watch("DaemonSet", on_change)
+    store.watch("CSINode", on_csi_node)
 
     # replay current contents so late-started informers converge (cluster.Reset)
     for nc in store.list("NodeClaim"):

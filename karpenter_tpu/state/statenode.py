@@ -16,6 +16,7 @@ from ..apis.nodeclaim import (
     NodeClaim,
 )
 from ..scheduling.hostports import HostPortUsage, pod_host_ports
+from ..scheduling.volumeusage import VolumeUsage
 from ..scheduling.taints import Taint
 from ..utils import disruption as disruption_utils
 from ..utils import pods as pod_utils
@@ -34,6 +35,7 @@ class StateNode:
         self.pod_disruption_costs: dict[str, float] = {}
         self.daemonset_requests: dict[str, dict[str, Quantity]] = {}
         self.host_port_usage = HostPortUsage()
+        self.volume_usage = VolumeUsage()
         self.marked_for_deletion = False
         self.nominated_until = 0.0
 
@@ -133,7 +135,7 @@ class StateNode:
         return 1.0 + sum(self.pod_disruption_costs.values())
 
     # -- pod tracking ----------------------------------------------------------
-    def update_for_pod(self, pod) -> None:
+    def update_for_pod(self, pod, volumes: dict | None = None) -> None:
         key = pod.key()
         requests = res.pod_requests(pod)
         self.pod_requests[key] = requests
@@ -150,6 +152,8 @@ class StateNode:
         else:
             self.daemonset_requests[key] = requests
         self.host_port_usage.add(key, pod_host_ports(pod))
+        if volumes:
+            self.volume_usage.add(key, volumes)
 
     def cleanup_for_pod(self, key: str) -> None:
         self.pod_requests.pop(key, None)
@@ -157,6 +161,7 @@ class StateNode:
         self.pod_disruption_costs.pop(key, None)
         self.daemonset_requests.pop(key, None)
         self.host_port_usage.remove(key)
+        self.volume_usage.remove(key)
 
     # -- disruption flags ------------------------------------------------------
     def nominate(self, now: float) -> None:
@@ -193,6 +198,7 @@ class StateNode:
         c.pod_disruption_costs = dict(self.pod_disruption_costs)
         c.daemonset_requests = dict(self.daemonset_requests)
         c.host_port_usage = self.host_port_usage.copy()
+        c.volume_usage = self.volume_usage.copy()
         c.marked_for_deletion = self.marked_for_deletion
         c.nominated_until = self.nominated_until
         return c

@@ -240,6 +240,26 @@ class TestOverlayValidation:
         ok = overlay("ok-gte", requirements=[{"key": "karpenter.kwok.sh/instance-cpu", "operator": "Gte", "values": ["4"]}])
         assert not ok.runtime_validate()
 
+    def test_malformed_price_rejected(self):
+        assert any("invalid price" in e for e in overlay("p", price="free").runtime_validate())
+        assert any("invalid price" in e for e in overlay("p2", price="+1.5").runtime_validate())
+        assert not overlay("p3", price="1.5").runtime_validate()
+
+    def test_malformed_adjustment_rejected(self):
+        assert any("invalid priceAdjustment" in e for e in overlay("a1", price_adjustment="abc%").runtime_validate())
+        assert any("invalid priceAdjustment" in e for e in overlay("a2", price_adjustment="0.5").runtime_validate())
+        for ok in ("+0.5", "-0.5", "+10%", "-10%"):
+            assert not overlay(f"ok{ok}", price_adjustment=ok).runtime_validate(), ok
+
+    def test_absolute_flag_disambiguates(self):
+        from karpenter_tpu.cloudprovider.types import adjusted_price
+
+        # a "+1.5"-shaped string applied as an absolute price must override
+        assert adjusted_price(2.0, "+1.5", absolute=True) == 1.5
+        # an unsigned delta from priceAdjustment adds
+        assert adjusted_price(2.0, "0.5", absolute=False) == 2.5
+        assert adjusted_price(2.0, "-10%", absolute=False) == 1.8
+
     def test_order_by_weight(self):
         a, b, c = overlay("a", weight=1), overlay("b", weight=5), overlay("c", weight=1)
         assert [o.metadata.name for o in order_by_weight([a, b, c])] == ["b", "c", "a"]

@@ -1,0 +1,344 @@
+"""Volume topology + volume-limit behavior specs.
+
+Modeled on the reference's provisioning/scheduling volumetopology_test.go and
+the VolumeUsage coverage in suite_test.go.
+"""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import COND_INITIALIZED, COND_REGISTERED, NodeClaim
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.controllers.provisioning.scheduling import Scheduler
+from karpenter_tpu.controllers.provisioning.scheduling.volumetopology import VolumeTopology
+from karpenter_tpu.kube import (
+    CSINode,
+    CSINodeDriver,
+    Node,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+    Store,
+)
+from karpenter_tpu.kube.objects import NodeSpec, NodeStatus
+from karpenter_tpu.scheduling.volumeusage import BIND_COMPLETED_ANNOTATION, VolumeUsage, get_volumes
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.resources import parse_resource_list
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+CSI = "csi.test.io"
+
+
+def build_env():
+    store = Store()
+    clock = FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    np = make_nodepool(requirements=LINUX_AMD64)
+    store.create(np)
+    return store, clock, cluster, [np], catalog.construct_instance_types()
+
+
+def make_scheduler(store, clock, cluster, pools, types):
+    return Scheduler(store, cluster, pools, {np.metadata.name: types for np in pools}, cluster.nodes(), [], clock)
+
+
+def bound_pvc(store, name, zone=None, driver=CSI, local=False, hostname_term=False, ns="default"):
+    """A PVC bound to a PV, optionally carrying zone node affinity."""
+    terms = []
+    if zone is not None:
+        terms.append([{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": [zone]}])
+    if hostname_term:
+        terms.append([{"key": wk.HOSTNAME_LABEL_KEY, "operator": "In", "values": ["old-node"]}])
+    pv = PersistentVolume(metadata=ObjectMeta(name=f"pv-{name}"), csi_driver=driver, node_affinity_required=terms, local=local)
+    store.create(pv)
+    pvc = PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name, namespace=ns, annotations={BIND_COMPLETED_ANNOTATION: "yes"}),
+        volume_name=f"pv-{name}",
+        phase="Bound",
+    )
+    store.create(pvc)
+    return pvc
+
+
+def pod_with_pvcs(*claim_names, **kw):
+    pod = make_pod(**kw)
+    pod.spec.volumes = [{"name": f"v{i}", "persistentVolumeClaim": {"claimName": c}} for i, c in enumerate(claim_names)]
+    return pod
+
+
+class TestVolumeTopology:
+    def test_bound_pv_zone_pins_nodeclaim(self):
+        store, clock, cluster, pools, types = build_env()
+        bound_pvc(store, "claim-a", zone="test-zone-b")
+        s = make_scheduler(store, clock, cluster, pools, types)
+        results = s.solve([pod_with_pvcs("claim-a")])
+        assert results.all_pods_scheduled()
+        req = results.new_node_claims[0].requirements.get(wk.ZONE_LABEL_KEY)
+        assert req.values_list() == ["test-zone-b"]
+
+    def test_storage_class_allowed_topologies(self):
+        store, clock, cluster, pools, types = build_env()
+        store.create(
+            StorageClass(
+                metadata=ObjectMeta(name="wait-sc"),
+                provisioner=CSI,
+                volume_binding_mode="WaitForFirstConsumer",
+                allowed_topologies=[[{"key": wk.ZONE_LABEL_KEY, "values": ["test-zone-c"]}]],
+            )
+        )
+        store.create(PersistentVolumeClaim(metadata=ObjectMeta(name="unbound"), storage_class_name="wait-sc"))
+        s = make_scheduler(store, clock, cluster, pools, types)
+        results = s.solve([pod_with_pvcs("unbound")])
+        assert results.all_pods_scheduled()
+        req = results.new_node_claims[0].requirements.get(wk.ZONE_LABEL_KEY)
+        assert req.values_list() == ["test-zone-c"]
+
+    def test_multiple_allowed_topology_terms_are_alternatives(self):
+        # SC allows zones a OR b; the pod's selector pins b — the b alternative
+        # must be chosen rather than failing on the first term
+        store, clock, cluster, pools, types = build_env()
+        store.create(
+            StorageClass(
+                metadata=ObjectMeta(name="multi-sc"),
+                provisioner=CSI,
+                volume_binding_mode="WaitForFirstConsumer",
+                allowed_topologies=[
+                    [{"key": wk.ZONE_LABEL_KEY, "values": ["test-zone-a"]}],
+                    [{"key": wk.ZONE_LABEL_KEY, "values": ["test-zone-b"]}],
+                ],
+            )
+        )
+        store.create(PersistentVolumeClaim(metadata=ObjectMeta(name="unbound"), storage_class_name="multi-sc"))
+        s = make_scheduler(store, clock, cluster, pools, types)
+        pod = pod_with_pvcs("unbound", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"})
+        results = s.solve([pod])
+        assert results.all_pods_scheduled()
+        req = results.new_node_claims[0].requirements.get(wk.ZONE_LABEL_KEY)
+        assert req.values_list() == ["test-zone-b"]
+
+    def test_conflicting_volume_zones_unschedulable(self):
+        store, clock, cluster, pools, types = build_env()
+        bound_pvc(store, "in-a", zone="test-zone-a")
+        bound_pvc(store, "in-b", zone="test-zone-b")
+        s = make_scheduler(store, clock, cluster, pools, types)
+        results = s.solve([pod_with_pvcs("in-a", "in-b")])
+        assert not results.all_pods_scheduled()
+
+    def test_local_volume_hostname_affinity_ignored(self):
+        store, clock, cluster, pools, types = build_env()
+        bound_pvc(store, "local-claim", local=True, hostname_term=True)
+        s = make_scheduler(store, clock, cluster, pools, types)
+        results = s.solve([pod_with_pvcs("local-claim")])
+        # hostname-only terms on local PVs are unconstrained alternatives
+        assert results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 1
+
+    def test_get_requirements_empty_without_volumes(self):
+        store, *_ = build_env()
+        vt = VolumeTopology(store)
+        assert vt.get_requirements(make_pod()) == []
+
+
+class TestPVCValidation:
+    def _validate(self, store, pod):
+        return VolumeTopology(store).validate_persistent_volume_claims(pod)
+
+    def test_missing_pvc_rejected(self):
+        store, *_ = build_env()
+        assert "not found" in self._validate(store, pod_with_pvcs("ghost"))
+
+    def test_unbound_immediate_rejected(self):
+        store, *_ = build_env()
+        store.create(StorageClass(metadata=ObjectMeta(name="imm"), provisioner=CSI, volume_binding_mode="Immediate"))
+        store.create(PersistentVolumeClaim(metadata=ObjectMeta(name="c"), storage_class_name="imm"))
+        assert "immediate" in self._validate(store, pod_with_pvcs("c"))
+
+    def test_unbound_wait_for_first_consumer_ok(self):
+        store, *_ = build_env()
+        store.create(StorageClass(metadata=ObjectMeta(name="w"), provisioner=CSI, volume_binding_mode="WaitForFirstConsumer"))
+        store.create(PersistentVolumeClaim(metadata=ObjectMeta(name="c"), storage_class_name="w"))
+        assert self._validate(store, pod_with_pvcs("c")) is None
+
+    def test_bound_without_bind_annotation_rejected(self):
+        store, *_ = build_env()
+        store.create(PersistentVolume(metadata=ObjectMeta(name="pv-x"), csi_driver=CSI))
+        store.create(PersistentVolumeClaim(metadata=ObjectMeta(name="c"), volume_name="pv-x"))
+        assert BIND_COMPLETED_ANNOTATION in self._validate(store, pod_with_pvcs("c"))
+
+    def test_bound_valid_ok(self):
+        store, *_ = build_env()
+        bound_pvc(store, "good", zone="test-zone-a")
+        assert self._validate(store, pod_with_pvcs("good")) is None
+
+    def test_lost_pvc_rejected(self):
+        store, *_ = build_env()
+        pvc = PersistentVolumeClaim(metadata=ObjectMeta(name="lost"), volume_name="gone", phase="Lost")
+        store.create(pvc)
+        assert "non-existent" in self._validate(store, pod_with_pvcs("lost"))
+
+    def test_provisioner_skips_invalid_pvc_pods(self):
+        from karpenter_tpu.cloudprovider.kwok import KWOKCloudProvider
+        from karpenter_tpu.controllers.provisioning.provisioner import Provisioner
+
+        store, clock, cluster, pools, types = build_env()
+        prov = Provisioner(store, cluster, KWOKCloudProvider(store, types, clock), clock)
+        store.create(pod_with_pvcs("ghost", name="bad-pod"))
+        store.create(make_pod(name="good-pod"))
+        pending = prov.get_pending_pods()
+        assert [p.metadata.name for p in pending] == ["good-pod"]
+
+
+class TestVolumeLimits:
+    def test_volume_usage_limits(self):
+        u = VolumeUsage()
+        u.add_limit(CSI, 2)
+        u.add("p1", {CSI: {"default/a"}})
+        assert u.exceeds_limits({CSI: {"default/b"}}) is None
+        u.add("p2", {CSI: {"default/b"}})
+        assert u.exceeds_limits({CSI: {"default/c"}}) is not None
+        # duplicate PVC on another pod does not double count
+        assert u.exceeds_limits({CSI: {"default/a"}}) is None
+        u.remove("p2")
+        assert u.exceeds_limits({CSI: {"default/c"}}) is None
+
+    def test_existing_node_respects_csinode_limit(self):
+        store, clock, cluster, pools, types = build_env()
+        for c in ("c1", "c2", "c3"):
+            bound_pvc(store, c)
+        nc = NodeClaim(metadata=ObjectMeta(name="claim-1", labels={wk.NODEPOOL_LABEL_KEY: "default-pool"}))
+        nc.status.provider_id = "kwok://n1"
+        nc.status.conditions.set_true(COND_REGISTERED)
+        nc.status.conditions.set_true(COND_INITIALIZED)
+        store.create(nc)
+        store.create(CSINode(metadata=ObjectMeta(name="n1"), drivers=[CSINodeDriver(name=CSI, allocatable_count=2)]))
+        store.create(
+            Node(
+                metadata=ObjectMeta(
+                    name="n1",
+                    labels={
+                        wk.NODEPOOL_LABEL_KEY: "default-pool",
+                        wk.HOSTNAME_LABEL_KEY: "n1",
+                        wk.ZONE_LABEL_KEY: "test-zone-a",
+                        wk.ARCH_LABEL_KEY: "amd64",
+                        wk.OS_LABEL_KEY: "linux",
+                    },
+                ),
+                spec=NodeSpec(provider_id="kwok://n1"),
+                status=NodeStatus(
+                    capacity=parse_resource_list({"cpu": "16", "memory": "32Gi", "pods": "110"}),
+                    allocatable=parse_resource_list({"cpu": "16", "memory": "32Gi", "pods": "110"}),
+                ),
+            )
+        )
+        s = make_scheduler(store, clock, cluster, pools, types)
+        pods = [pod_with_pvcs(c, name=f"pod-{c}", cpu="100m") for c in ("c1", "c2", "c3")]
+        results = s.solve(pods)
+        assert results.all_pods_scheduled()
+        # only two volume-bearing pods fit the node's CSI attach limit
+        assert results.node_pod_count().get("n1") == 2
+        assert len(results.new_node_claims) == 1
+
+    def test_state_node_tracks_bound_pod_volumes(self):
+        store, clock, cluster, pools, types = build_env()
+        bound_pvc(store, "c1")
+        store.create(
+            Node(
+                metadata=ObjectMeta(name="n1", labels={wk.HOSTNAME_LABEL_KEY: "n1"}),
+                spec=NodeSpec(provider_id="kwok://n1"),
+                status=NodeStatus(
+                    capacity=parse_resource_list({"cpu": "4", "pods": "110"}),
+                    allocatable=parse_resource_list({"cpu": "4", "pods": "110"}),
+                ),
+            )
+        )
+        pod = pod_with_pvcs("c1", name="bound-pod", node_name="n1")
+        store.create(pod)
+        sn = cluster.node_for_name("n1")
+        assert sn.volume_usage.exceeds_limits({}) is None
+        sn.volume_usage.add_limit(CSI, 1)
+        assert sn.volume_usage.exceeds_limits({CSI: {"default/other"}}) is not None
+
+    def test_get_volumes_resolves_drivers(self):
+        store, *_ = build_env()
+        bound_pvc(store, "c1")
+        store.create(StorageClass(metadata=ObjectMeta(name="w"), provisioner="other.csi"))
+        store.create(PersistentVolumeClaim(metadata=ObjectMeta(name="c2"), storage_class_name="w"))
+        pod = pod_with_pvcs("c1", "c2")
+        vols = get_volumes(store, pod)
+        assert vols == {CSI: {"default/c1"}, "other.csi": {"default/c2"}}
+
+    def test_ephemeral_volume_resolves_pod_scoped_claim(self):
+        store, *_ = build_env()
+        pod = make_pod(name="web-0")
+        pod.spec.volumes = [{"name": "scratch", "ephemeral": {}}]
+        bound_pvc(store, "web-0-scratch")
+        vols = get_volumes(store, pod)
+        assert vols == {CSI: {"default/web-0-scratch"}}
+
+    def test_ephemeral_template_constrains_before_pvc_exists(self):
+        # the ephemeral controller hasn't created the PVC yet: the
+        # volumeClaimTemplate's StorageClass topology must still apply
+        store, clock, cluster, pools, types = build_env()
+        store.create(
+            StorageClass(
+                metadata=ObjectMeta(name="zonal"),
+                provisioner=CSI,
+                volume_binding_mode="WaitForFirstConsumer",
+                allowed_topologies=[[{"key": wk.ZONE_LABEL_KEY, "values": ["test-zone-d"]}]],
+            )
+        )
+        pod = make_pod(name="eph-0")
+        pod.spec.volumes = [
+            {"name": "scratch", "ephemeral": {"volumeClaimTemplate": {"spec": {"storageClassName": "zonal"}}}}
+        ]
+        s = make_scheduler(store, clock, cluster, pools, types)
+        results = s.solve([pod])
+        assert results.all_pods_scheduled()
+        req = results.new_node_claims[0].requirements.get(wk.ZONE_LABEL_KEY)
+        assert req.values_list() == ["test-zone-d"]
+
+    def test_default_storage_class_applies(self):
+        from karpenter_tpu.scheduling.volumeusage import DEFAULT_STORAGE_CLASS_ANNOTATION
+
+        store, clock, cluster, pools, types = build_env()
+        store.create(
+            StorageClass(
+                metadata=ObjectMeta(name="standard", annotations={DEFAULT_STORAGE_CLASS_ANNOTATION: "true"}),
+                provisioner=CSI,
+                volume_binding_mode="WaitForFirstConsumer",
+                allowed_topologies=[[{"key": wk.ZONE_LABEL_KEY, "values": ["test-zone-b"]}]],
+            )
+        )
+        # PVC with storageClassName omitted relies on the cluster default
+        store.create(PersistentVolumeClaim(metadata=ObjectMeta(name="dflt"), storage_class_name=None))
+        assert VolumeTopology(store).validate_persistent_volume_claims(pod_with_pvcs("dflt")) is None
+        s = make_scheduler(store, clock, cluster, pools, types)
+        results = s.solve([pod_with_pvcs("dflt")])
+        assert results.all_pods_scheduled()
+        req = results.new_node_claims[0].requirements.get(wk.ZONE_LABEL_KEY)
+        assert req.values_list() == ["test-zone-b"]
+        assert get_volumes(store, pod_with_pvcs("dflt")) == {CSI: {"default/dflt"}}
+
+    def test_csinode_arriving_after_node_applies_limits(self):
+        store, clock, cluster, pools, types = build_env()
+        store.create(
+            Node(
+                metadata=ObjectMeta(name="n1", labels={wk.HOSTNAME_LABEL_KEY: "n1"}),
+                spec=NodeSpec(provider_id="kwok://n1"),
+                status=NodeStatus(
+                    capacity=parse_resource_list({"cpu": "4", "pods": "110"}),
+                    allocatable=parse_resource_list({"cpu": "4", "pods": "110"}),
+                ),
+            )
+        )
+        # CSINode lands AFTER the node (the real-world ordering)
+        store.create(CSINode(metadata=ObjectMeta(name="n1"), drivers=[CSINodeDriver(name=CSI, allocatable_count=1)]))
+        sn = cluster.node_for_name("n1")
+        assert sn.volume_usage.exceeds_limits({CSI: {"default/a", "default/b"}}) is not None
