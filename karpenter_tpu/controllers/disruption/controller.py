@@ -9,7 +9,7 @@ from dataclasses import dataclass
 from ...apis import labels as wk
 from ...utils.pdb import PDBLimits
 from .helpers import build_disruption_budget_mapping
-from .methods import Drift, Emptiness, MultiNodeConsolidation, SingleNodeConsolidation
+from .methods import Drift, Emptiness, MultiNodeConsolidation, SingleNodeConsolidation, StaticDrift
 from .queue import OrchestrationQueue
 from .types import build_candidate
 
@@ -53,6 +53,7 @@ class DisruptionController:
         self.ctx = ctx
         self.methods = [
             Emptiness(ctx),
+            StaticDrift(ctx),
             Drift(ctx),
             MultiNodeConsolidation(ctx),
             SingleNodeConsolidation(ctx),

@@ -29,8 +29,9 @@ class Emptiness:
 
     def should_disrupt(self, candidate) -> bool:
         # every consolidation policy permits removing empty nodes; the
-        # Consolidatable condition (consolidateAfter) is the only gate
-        if candidate.node_claim is None:
+        # Consolidatable condition (consolidateAfter) is the only gate.
+        # Static fleets hold their replica count (emptiness.go:43).
+        if candidate.node_claim is None or candidate.owned_by_static_node_pool():
             return False
         if not candidate.node_claim.status.conditions.is_true(COND_CONSOLIDATABLE):
             return False
@@ -50,6 +51,59 @@ class Emptiness:
         return [Command(reason=REASON_EMPTY, candidates=chosen)]
 
 
+class StaticDrift:
+    """Replace drifted static-fleet nodes 1:1 from the pool template
+    (staticdrift.go:50-106): no scheduling simulation — the replacement is a
+    fresh template claim, created before the drifted one drains."""
+
+    reason = REASON_DRIFTED
+    consolidation_type = ""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def should_disrupt(self, candidate) -> bool:
+        return (
+            candidate.node_claim is not None
+            and candidate.owned_by_static_node_pool()
+            and candidate.node_claim.status.conditions.is_true(COND_DRIFTED)
+        )
+
+    def compute_commands(self, candidates, budgets) -> list[Command]:
+        from ..static.provisioning import build_static_claim, node_limit_headroom
+
+        by_pool: dict[str, list] = {}
+        for c in candidates:
+            if self.should_disrupt(c):
+                by_pool.setdefault(c.node_pool.metadata.name, []).append(c)
+        out = []
+        for pool_name, cs in by_pool.items():
+            np = cs[0].node_pool
+            allowed = budgets.get(pool_name, 0)
+            if allowed <= 0:
+                continue
+            # don't churn while the pool is above its replica count — scale
+            # down first (staticdrift.go:74-78)
+            live = sum(
+                1
+                for sn in self.ctx.cluster.nodes()
+                if sn.labels().get(wk.NODEPOOL_LABEL_KEY) == pool_name and not sn.deleted()
+            )
+            if live > (np.spec.replicas or 0):
+                continue
+            max_drifts = min(allowed, len(cs), node_limit_headroom(np, live))
+            if max_drifts <= 0:
+                continue
+            its = self.ctx.provisioner.cloud_provider.get_instance_types(np)
+            if not its:
+                continue
+            for c in cs[:max_drifts]:
+                out.append(
+                    Command(reason=REASON_DRIFTED, candidates=[c], replacements=[build_static_claim(np, its)])
+                )
+        return out
+
+
 class Drift:
     """Replace drifted nodes (drift.go); drift is detected by the nodeclaim
     disruption controller setting the Drifted condition."""
@@ -61,7 +115,11 @@ class Drift:
         self.ctx = ctx
 
     def should_disrupt(self, candidate) -> bool:
-        return candidate.node_claim is not None and candidate.node_claim.status.conditions.is_true(COND_DRIFTED)
+        return (
+            candidate.node_claim is not None
+            and not candidate.owned_by_static_node_pool()  # StaticDrift's job (drift.go:59)
+            and candidate.node_claim.status.conditions.is_true(COND_DRIFTED)
+        )
 
     def compute_commands(self, candidates, budgets) -> list[Command]:
         drifted = sorted(
@@ -96,7 +154,7 @@ class _ConsolidationBase:
         self.ctx = ctx
 
     def should_disrupt(self, candidate) -> bool:
-        if candidate.node_claim is None:
+        if candidate.node_claim is None or candidate.owned_by_static_node_pool():
             return False
         policy = candidate.node_pool.spec.disruption.consolidation_policy
         if policy == WHEN_EMPTY:

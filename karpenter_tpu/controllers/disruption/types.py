@@ -34,6 +34,11 @@ class Candidate:
     def name(self) -> str:
         return self.state_node.name()
 
+    def owned_by_static_node_pool(self) -> bool:
+        """Static fleets are replaced 1:1 by StaticDrift, never consolidated
+        (types.go:147)."""
+        return self.node_pool is not None and self.node_pool.is_static()
+
 
 @dataclass
 class Command:

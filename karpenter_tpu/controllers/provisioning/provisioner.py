@@ -145,7 +145,7 @@ class Provisioner:
             min_values_policy=self.options.min_values_policy,
         )
 
-    def create_node_claim(self, scheduling_claim) -> str | None:
+    def create_node_claim(self, scheduling_claim, reason: str = "provisioning") -> str | None:
         """Limits check + API create (provisioner.go:460-513). Returns the
         created claim name or None when limits forbid it."""
         nc = scheduling_claim.to_api_node_claim(self.clock)
@@ -169,6 +169,6 @@ class Provisioner:
 
             relaxed = wk.NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY in nc.metadata.annotations
             self.metrics.counter(m.NODECLAIMS_CREATED_TOTAL).inc(
-                reason="provisioning", nodepool=pool_name, min_values_relaxed=str(relaxed).lower()
+                reason=reason, nodepool=pool_name, min_values_relaxed=str(relaxed).lower()
             )
         return created.metadata.name

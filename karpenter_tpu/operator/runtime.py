@@ -34,6 +34,7 @@ from ..controllers.nodepool import (
 )
 from ..controllers.nodeoverlay import InstanceTypeStore, NodeOverlayController
 from ..controllers.provisioning.provisioner import Provisioner, ProvisionerOptions
+from ..controllers.static import StaticDeprovisioningController, StaticProvisioningController
 from ..controllers.metrics import (
     NodeMetricsController,
     NodePoolMetricsController,
@@ -103,6 +104,12 @@ class Environment:
                 batch_max_seconds=self.options.batch_max_duration,
             ),
         )
+        self.static_provisioning = StaticProvisioningController(
+            self.store, self.cluster, self.cloud_provider, self.provisioner, self.clock, metrics=self.registry
+        )
+        self.static_deprovisioning = StaticDeprovisioningController(
+            self.store, self.cluster, self.cloud_provider, self.clock, recorder=self.recorder, metrics=self.registry
+        )
         self.np_state = NodePoolHealthState()
         self.lifecycle = LifecycleController(
             self.store, self.cluster, self.cloud_provider, self.clock,
@@ -159,6 +166,8 @@ class Environment:
         self.nodepool_validation.reconcile()
         self.nodepool_registration_health.reconcile()
         self.nodepool_readiness.reconcile()
+        self.static_provisioning.reconcile()
+        self.static_deprovisioning.reconcile()
         self.provisioner.reconcile(force=provision_force)
         self.lifecycle.reconcile_all()
         if hasattr(self.cloud_provider, "flush_pending"):

@@ -11,6 +11,8 @@ per-driver limits (volumeusage.go:187-226).
 
 from __future__ import annotations
 
+import weakref
+
 BIND_COMPLETED_ANNOTATION = "pv.kubernetes.io/bind-completed"
 
 Volumes = dict  # driver name -> set[str] of "namespace/name" PVC ids
@@ -56,6 +58,11 @@ def get_persistent_volume_claim(store, pod, volume: dict):
 
 DEFAULT_STORAGE_CLASS_ANNOTATION = "storageclass.kubernetes.io/is-default-class"
 
+# default-StorageClass lookup cache, invalidated by store revision: the scan
+# runs on hot paths (every pod event / PodData build), and Store.list deep-
+# copies every object it returns
+_default_sc_cache = weakref.WeakKeyDictionary()
+
 
 def effective_storage_class_name(store, pvc) -> str | None:
     """The PVC's storageClassName with default-class semantics: None means
@@ -63,10 +70,17 @@ def effective_storage_class_name(store, pvc) -> str | None:
     is disabled (volumeusage.go:131-139 handles only the latter)."""
     if pvc.storage_class_name is not None:
         return pvc.storage_class_name or None
+    rv = getattr(store, "_rv", None)
+    cached = _default_sc_cache.get(store)
+    if cached is not None and cached[0] == rv:
+        return cached[1]
+    name = None
     for sc in store.list("StorageClass"):
         if sc.metadata.annotations.get(DEFAULT_STORAGE_CLASS_ANNOTATION) == "true":
-            return sc.metadata.name
-    return None
+            name = sc.metadata.name
+            break
+    _default_sc_cache[store] = (rv, name)
+    return name
 
 
 def resolve_driver(store, pvc, storage_class_name: str | None = None) -> str:

@@ -1,0 +1,147 @@
+"""Static capacity behavior specs (reference: test/suites/regression static
+specs + static/{provisioning,deprovisioning} controller tests)."""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import COND_DRIFTED
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_env(**kw):
+    return Environment(options=Options(**kw))
+
+
+def static_pool(replicas, name="static-pool", **kw):
+    return make_nodepool(name=name, requirements=LINUX_AMD64, replicas=replicas, **kw)
+
+
+class TestStaticProvisioning:
+    def test_scales_to_replica_count(self):
+        env = make_env()
+        env.store.create(static_pool(3))
+        env.settle()
+        assert env.store.count("NodeClaim") == 3
+        assert env.store.count("Node") == 3
+        for nc in env.store.list("NodeClaim"):
+            assert nc.metadata.labels[wk.NODEPOOL_LABEL_KEY] == "static-pool"
+            assert nc.is_registered()
+
+    def test_replica_increase_scales_up(self):
+        env = make_env()
+        env.store.create(static_pool(1))
+        env.settle()
+        assert env.store.count("NodeClaim") == 1
+
+        def bump(np):
+            np.spec.replicas = 4
+
+        env.store.patch("NodePool", "static-pool", bump)
+        env.settle()
+        assert env.store.count("NodeClaim") == 4
+
+    def test_node_limit_caps_fleet(self):
+        env = make_env()
+        env.store.create(static_pool(5, limits={"nodes": "2"}))
+        env.settle()
+        assert env.store.count("NodeClaim") == 2
+
+    def test_static_pool_ignores_pending_pods(self):
+        # static pools never grow beyond replicas for demand; a huge pending
+        # pod must not trigger extra static capacity
+        env = make_env()
+        env.store.create(static_pool(1))
+        env.store.create(make_pod(cpu="1000"))
+        env.settle()
+        assert env.store.count("NodeClaim") == 1
+
+    def test_deleted_claim_is_replaced(self):
+        env = make_env()
+        env.store.create(static_pool(2))
+        env.settle()
+        victim = env.store.list("NodeClaim")[0]
+        env.store.delete("NodeClaim", victim.metadata.name)
+        env.settle(rounds=15)
+        live = [nc for nc in env.store.list("NodeClaim") if nc.metadata.deletion_timestamp is None]
+        assert len(live) == 2
+
+
+class TestStaticDeprovisioning:
+    def test_replica_decrease_scales_down(self):
+        env = make_env()
+        env.store.create(static_pool(4))
+        env.settle()
+        assert env.store.count("NodeClaim") == 4
+
+        def shrink(np):
+            np.spec.replicas = 2
+
+        env.store.patch("NodePool", "static-pool", shrink)
+        env.settle(rounds=15)
+        live = [nc for nc in env.store.list("NodeClaim") if nc.metadata.deletion_timestamp is None]
+        assert len(live) == 2
+        assert env.store.count("Node") == 2
+
+    def test_empty_nodes_picked_before_loaded(self):
+        env = make_env()
+        env.store.create(static_pool(2))
+        env.settle()
+        nodes = env.store.list("Node")
+        # pin a pod to the first node so it's "loaded"
+        loaded = nodes[0].metadata.name
+        pod = make_pod(cpu="100m", node_name=loaded)
+        pod.status.phase = "Running"
+        env.store.create(pod)
+        env.settle(rounds=3)
+
+        def shrink(np):
+            np.spec.replicas = 1
+
+        env.store.patch("NodePool", "static-pool", shrink)
+        env.settle(rounds=15)
+        remaining = [n.metadata.name for n in env.store.list("Node")]
+        assert remaining == [loaded]
+
+    def test_zero_replicas_drains_fleet(self):
+        env = make_env()
+        env.store.create(static_pool(2))
+        env.settle()
+
+        def zero(np):
+            np.spec.replicas = 0
+
+        env.store.patch("NodePool", "static-pool", zero)
+        env.settle(rounds=20)
+        assert env.store.count("Node") == 0
+        live = [nc for nc in env.store.list("NodeClaim") if nc.metadata.deletion_timestamp is None]
+        assert not live
+
+
+class TestStaticDrift:
+    def test_drifted_static_claims_replaced_one_for_one(self):
+        env = make_env()
+        env.store.create(static_pool(2))
+        env.settle()
+        before = {nc.metadata.name for nc in env.store.list("NodeClaim")}
+
+        def relabel(np):
+            np.spec.template.labels = {"fleet-gen": "v2"}  # changes static hash
+
+        env.store.patch("NodePool", "static-pool", relabel)
+        env.settle(rounds=40, step_seconds=15.0)
+        live = [nc for nc in env.store.list("NodeClaim") if nc.metadata.deletion_timestamp is None]
+        assert len(live) == 2
+        assert not (before & {nc.metadata.name for nc in live})
+        assert all(nc.metadata.labels.get("fleet-gen") == "v2" for nc in live)
+
+    def test_static_nodes_never_consolidated(self):
+        # two empty static nodes stay: emptiness/consolidation must skip them
+        env = make_env()
+        env.store.create(static_pool(2))
+        env.settle(rounds=20, step_seconds=30.0)
+        assert env.store.count("Node") == 2
