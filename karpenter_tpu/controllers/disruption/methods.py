@@ -35,6 +35,10 @@ class Emptiness:
             return False
         if not candidate.node_claim.status.conditions.is_true(COND_CONSOLIDATABLE):
             return False
+        # a node hosting virtual buffer pods is not empty: the provisioner put
+        # headroom there on purpose (emptiness.go:51-57)
+        if self.ctx.cluster.has_buffer_pods(candidate.state_node.provider_id()):
+            return False
         return len(candidate.reschedulable_pods) == 0
 
     def compute_commands(self, candidates, budgets) -> list[Command]:

@@ -24,6 +24,7 @@ class ProvisionerOptions:
     min_values_policy: str = "Strict"
     batch_idle_seconds: float = 1.0
     batch_max_seconds: float = 10.0
+    capacity_buffer_enabled: bool = False  # CapacityBuffer feature gate
 
 
 class Provisioner:
@@ -62,7 +63,21 @@ class Provisioner:
         for existing in results.existing_nodes:
             if existing.pods:
                 self.cluster.nominate_node(existing.name())
+        if self.options.capacity_buffer_enabled:
+            self._record_buffer_pod_counts(results)
         return results
+
+    def _record_buffer_pod_counts(self, results: Results) -> None:
+        """Which nodes host virtual buffer pods this round — emptiness must
+        not reclaim them (provisioner.go:156, cluster.go:299-307)."""
+        from ...apis.capacitybuffer import is_virtual_pod
+
+        counts: dict[str, int] = {}
+        for existing in results.existing_nodes:
+            n = sum(1 for p in existing.pods if is_virtual_pod(p))
+            if n:
+                counts[existing.state_node.provider_id()] = n
+        self.cluster.update_buffer_pod_counts(counts)
 
     def get_pending_pods(self) -> list:
         """Provisionable pods (provisioner.go:192-221); pods referencing
@@ -81,7 +96,26 @@ class Provisioner:
                     self.recorder.publish(pod, "FailedScheduling", f"ignoring pod, {verr}", type_="Warning")
                 continue
             out.append(pod)
+        # CapacityBuffer virtual pods join AFTER validation so they skip PVC
+        # checks and never round-trip through the store (buffers.go:37-87)
+        if self.options.capacity_buffer_enabled:
+            out = self._append_virtual_pods(out)
         return out
+
+    def _append_virtual_pods(self, pods: list) -> list:
+        from ...apis.capacitybuffer import COND_READY_FOR_PROVISIONING
+        from ..capacitybuffer.controller import build_virtual_pods, resolve_buffer_pod_spec
+
+        for cb in self.store.list("CapacityBuffer"):
+            if not cb.status.conditions.is_true(COND_READY_FOR_PROVISIONING):
+                continue
+            if not cb.status.replicas or cb.status.replicas <= 0:
+                continue
+            spec, template_labels = resolve_buffer_pod_spec(self.store, cb)
+            if spec is None:
+                continue
+            pods = pods + build_virtual_pods(cb, spec, template_labels)
+        return pods
 
     def schedule(self, pods: list) -> Results:
         if not pods:
@@ -104,7 +138,13 @@ class Provisioner:
         t0 = _time.perf_counter()
         results = self.solver.solve(snapshot)
         self.metrics.histogram(m.SCHEDULER_SCHEDULING_DURATION).observe(_time.perf_counter() - t0)
-        self.metrics.gauge(m.SCHEDULER_UNSCHEDULABLE_PODS).set(len(results.pod_errors))
+        # unschedulable virtual buffer pods are headroom shortfall, not real
+        # demand failures (buffers.go filterVirtualPodErrors)
+        from ...apis.capacitybuffer import is_virtual_pod
+
+        virtual_keys = {p.key() for p in pods if is_virtual_pod(p)}
+        real_errors = {k: v for k, v in results.pod_errors.items() if k not in virtual_keys}
+        self.metrics.gauge(m.SCHEDULER_UNSCHEDULABLE_PODS).set(len(real_errors))
         return results
 
     def make_snapshot(self, pods: list, state_nodes=None, exclude_deleting: bool = True) -> SolverSnapshot:
@@ -162,8 +202,11 @@ class Provisioner:
             if err is not None:
                 return None
         created = self.store.create(nc)
-        # immediately mirror into cluster state so the next solve sees it
+        # immediately mirror into cluster state so the next solve sees it, and
+        # nominate it so emptiness doesn't reclaim capacity (e.g. a node built
+        # purely for buffer headroom) before the next pass records its pods
         self.cluster.update_node_claim(created)
+        self.cluster.nominate_claim(created.metadata.name)
         if self.metrics is not None:
             from ... import metrics as m
 

@@ -13,6 +13,7 @@ from .objects import (  # noqa: F401
     Container,
     CSINode,
     CSINodeDriver,
+    Deployment,
     NodeAffinity,
     Node,
     NodeSpec,
@@ -24,6 +25,7 @@ from .objects import (  # noqa: F401
     PodAffinityTerm,
     PodSpec,
     PodStatus,
+    PodTemplate,
     PreferredSchedulingTerm,
     StorageClass,
     TopologySpreadConstraint,

@@ -213,6 +213,29 @@ class DaemonSet:
 
 
 @dataclass
+class PodTemplate:
+    """The pod-shape object a CapacityBuffer's podTemplateRef points at."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    template_spec: PodSpec = field(default_factory=PodSpec)
+    template_metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    kind: str = "PodTemplate"
+
+
+@dataclass
+class Deployment:
+    """Minimal scalable workload: replicas + a pod template. Stands in for
+    Deployment/ReplicaSet/StatefulSet as a CapacityBuffer scalableRef target."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    replicas: int = 1
+    template_spec: PodSpec = field(default_factory=PodSpec)
+    template_metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: dict | None = None
+    kind: str = "Deployment"
+
+
+@dataclass
 class PersistentVolumeClaim:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     # spec

@@ -34,6 +34,7 @@ from ..controllers.nodepool import (
 )
 from ..controllers.nodeoverlay import InstanceTypeStore, NodeOverlayController
 from ..controllers.provisioning.provisioner import Provisioner, ProvisionerOptions
+from ..controllers.capacitybuffer import CapacityBufferController
 from ..controllers.static import StaticDeprovisioningController, StaticProvisioningController
 from ..controllers.metrics import (
     NodeMetricsController,
@@ -102,8 +103,10 @@ class Environment:
                 min_values_policy=self.options.min_values_policy,
                 batch_idle_seconds=self.options.batch_idle_duration,
                 batch_max_seconds=self.options.batch_max_duration,
+                capacity_buffer_enabled=self.options.feature_gates.capacity_buffer,
             ),
         )
+        self.capacity_buffer = CapacityBufferController(self.store, self.clock, provisioner=self.provisioner)
         self.static_provisioning = StaticProvisioningController(
             self.store, self.cluster, self.cloud_provider, self.provisioner, self.clock, metrics=self.registry
         )
@@ -166,6 +169,8 @@ class Environment:
         self.nodepool_validation.reconcile()
         self.nodepool_registration_health.reconcile()
         self.nodepool_readiness.reconcile()
+        if self.options.feature_gates.capacity_buffer:
+            self.capacity_buffer.reconcile()
         self.static_provisioning.reconcile()
         self.static_deprovisioning.reconcile()
         self.provisioner.reconcile(force=provision_force)

@@ -37,6 +37,7 @@ class Cluster:
         self._pod_scheduling_decisions: dict[str, float] = {}
         self._pod_to_node_claim: dict[str, str] = {}
         self._consolidated_at: float = 0.0
+        self._buffer_pod_counts: dict[str, int] = {}  # provider id -> virtual pod count
         self._unsynced_start: Optional[float] = None
         self.generation = 0  # bumped on every mutation (solver cache key)
         self._on_change: list[Callable[[], None]] = []
@@ -168,8 +169,12 @@ class Cluster:
             # migrated once the provider id appears
             pid = nc.status.provider_id or f"nodeclaim://{nc.metadata.name}"
             old_pid = self._nodeclaim_name_to_provider_id.get(nc.metadata.name)
-            if old_pid is not None and old_pid != pid and old_pid in self._nodes:
-                del self._nodes[old_pid]
+            if old_pid is not None and old_pid != pid:
+                # claim gained its provider id: migrate the StateNode so
+                # nomination and usage tracking survive the key change
+                stale = self._nodes.pop(old_pid, None)
+                if stale is not None and pid not in self._nodes:
+                    self._nodes[pid] = stale
             self._nodeclaim_name_to_provider_id[nc.metadata.name] = pid
             existing = self._nodes.get(pid)
             if existing is None:
@@ -192,6 +197,17 @@ class Cluster:
                 else:
                     del self._nodes[pid]
             self._bump()
+
+    def update_buffer_pod_counts(self, counts: dict[str, int]) -> None:
+        """Replace the whole mapping each provisioning pass; nodes absent from
+        it host no buffer capacity (cluster.go:299-315). Emptiness consults it;
+        consolidation doesn't need to — its simulation re-places virtual pods."""
+        with self._lock:
+            self._buffer_pod_counts = dict(counts)
+
+    def has_buffer_pods(self, provider_id: str) -> bool:
+        with self._lock:
+            return self._buffer_pod_counts.get(provider_id, 0) > 0
 
     def apply_csi_node(self, csi) -> None:
         """CSINode events arrive after node registration in practice; refresh
@@ -305,6 +321,16 @@ class Cluster:
     def nominate_node(self, node_name: str) -> None:
         with self._lock:
             sn = self._state_node_for(node_name)
+            if sn is not None:
+                sn.nominate(self.clock.now())
+
+    def nominate_claim(self, claim_name: str) -> None:
+        """Nominate an in-flight NodeClaim's StateNode so disruption leaves
+        the just-provisioned capacity alone until its pods land (the
+        reference's RecordPodNomination on CreateNodeClaims)."""
+        with self._lock:
+            pid = self._nodeclaim_name_to_provider_id.get(claim_name)
+            sn = self._nodes.get(pid) if pid else None
             if sn is not None:
                 sn.nominate(self.clock.now())
 

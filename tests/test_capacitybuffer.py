@@ -1,0 +1,200 @@
+"""CapacityBuffer behavior specs (reference: capacitybuffer suite_test.go +
+regression/capacitybuffer_test.go:39-725)."""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.capacitybuffer import (
+    COND_READY_FOR_PROVISIONING,
+    CapacityBuffer,
+    CapacityBufferSpec,
+    ScalableRef,
+    is_virtual_pod,
+)
+from karpenter_tpu.controllers.capacitybuffer.controller import build_virtual_pods
+from karpenter_tpu.kube import Container, Deployment, ObjectMeta, PodSpec, PodTemplate
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import FeatureGates, Options
+from karpenter_tpu.utils.resources import parse_resource_list
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_env():
+    env = Environment(options=Options(feature_gates=FeatureGates(capacity_buffer=True)))
+    env.store.create(make_nodepool(requirements=LINUX_AMD64))
+    return env
+
+
+def pod_template(name="chunk", cpu="2", memory="4Gi"):
+    return PodTemplate(
+        metadata=ObjectMeta(name=name),
+        template_spec=PodSpec(containers=[Container(resources={"requests": parse_resource_list({"cpu": cpu, "memory": memory})})]),
+    )
+
+
+def buffer(name="buf", template="chunk", replicas=None, limits=None, scalable=None, percentage=None):
+    spec = CapacityBufferSpec(replicas=replicas, percentage=percentage)
+    if scalable is not None:
+        spec.scalable_ref = scalable
+    else:
+        spec.pod_template_ref = template
+    if limits:
+        spec.limits = parse_resource_list(limits)
+    return CapacityBuffer(metadata=ObjectMeta(name=name), spec=spec)
+
+
+class TestBufferController:
+    def test_resolves_pod_template_and_replicas(self):
+        env = make_env()
+        env.store.create(pod_template())
+        env.store.create(buffer(replicas=3))
+        env.capacity_buffer.reconcile()
+        cb = env.store.list("CapacityBuffer")[0]
+        assert cb.status.conditions.is_true(COND_READY_FOR_PROVISIONING)
+        assert cb.status.replicas == 3
+        assert cb.status.pod_template_ref == "chunk"
+
+    def test_missing_template_not_ready(self):
+        env = make_env()
+        env.store.create(buffer(template="ghost", replicas=2))
+        env.capacity_buffer.reconcile()
+        cb = env.store.list("CapacityBuffer")[0]
+        assert cb.status.conditions.is_false(COND_READY_FOR_PROVISIONING)
+
+    def test_limits_bound_replicas(self):
+        # chunk = 2 cpu; limit 5 cpu -> floor(5/2) = 2 even though replicas=10
+        env = make_env()
+        env.store.create(pod_template(cpu="2"))
+        env.store.create(buffer(replicas=10, limits={"cpu": "5"}))
+        env.capacity_buffer.reconcile()
+        assert env.store.list("CapacityBuffer")[0].status.replicas == 2
+
+    def test_limits_alone_size_buffer(self):
+        env = make_env()
+        env.store.create(pod_template(cpu="1", memory="1Gi"))
+        env.store.create(buffer(limits={"cpu": "4"}))
+        env.capacity_buffer.reconcile()
+        assert env.store.list("CapacityBuffer")[0].status.replicas == 4
+
+    def test_percentage_of_scalable(self):
+        env = make_env()
+        env.store.create(Deployment(metadata=ObjectMeta(name="web"), replicas=10))
+        env.store.create(buffer(scalable=ScalableRef(kind="Deployment", name="web"), percentage=20))
+        env.capacity_buffer.reconcile()
+        assert env.store.list("CapacityBuffer")[0].status.replicas == 2
+
+    def test_percentage_floors_at_one(self):
+        env = make_env()
+        env.store.create(Deployment(metadata=ObjectMeta(name="web"), replicas=3))
+        env.store.create(buffer(scalable=ScalableRef(kind="Deployment", name="web"), percentage=10))
+        env.capacity_buffer.reconcile()
+        assert env.store.list("CapacityBuffer")[0].status.replicas == 1
+
+    def test_replicas_and_percentage_take_max(self):
+        env = make_env()
+        env.store.create(Deployment(metadata=ObjectMeta(name="web"), replicas=10))
+        env.store.create(buffer(scalable=ScalableRef(kind="Deployment", name="web"), percentage=50, replicas=2))
+        env.capacity_buffer.reconcile()
+        assert env.store.list("CapacityBuffer")[0].status.replicas == 5
+
+    def test_both_refs_invalid(self):
+        env = make_env()
+        env.store.create(pod_template())
+        cb = buffer(replicas=1)
+        cb.spec.scalable_ref = ScalableRef(kind="Deployment", name="web")
+        env.store.create(cb)
+        env.capacity_buffer.reconcile()
+        assert env.store.list("CapacityBuffer")[0].status.conditions.is_false(COND_READY_FOR_PROVISIONING)
+
+
+class TestVirtualPods:
+    def test_build_strips_pvcs_and_pins_priority(self):
+        cb = buffer(replicas=2)
+        cb.status.replicas = 2
+        spec = PodSpec(
+            containers=[Container(resources={"requests": parse_resource_list({"cpu": "1"})})],
+            volumes=[{"name": "d", "persistentVolumeClaim": {"claimName": "x"}}, {"name": "cfg", "configMap": {}}],
+        )
+        pods = build_virtual_pods(cb, spec)
+        assert len(pods) == 2
+        for p in pods:
+            assert is_virtual_pod(p)
+            assert p.spec.priority < -(2**30)
+            assert [v["name"] for v in p.spec.volumes] == ["cfg"]
+
+
+class TestVirtualPodLabels:
+    def test_template_labels_shape_headroom(self):
+        # a template whose TSC selects its own labels must spread the virtual
+        # pods — template labels have to ride into the placeholder pods
+        from helpers import zone_spread
+
+        env = make_env()
+        sel = {"matchLabels": {"app": "web"}}
+        pt = pod_template(cpu="1")
+        pt.template_metadata.labels = {"app": "web"}
+        pt.template_spec.topology_spread_constraints = [zone_spread(selector=sel)]
+        env.store.create(pt)
+        env.store.create(buffer(replicas=4))
+        env.capacity_buffer.reconcile()
+        cb = env.store.list("CapacityBuffer")[0]
+        from karpenter_tpu.controllers.capacitybuffer.controller import resolve_buffer_pod_spec
+
+        spec, labels = resolve_buffer_pod_spec(env.store, cb)
+        pods = build_virtual_pods(cb, spec, labels)
+        assert all(p.metadata.labels["app"] == "web" for p in pods)
+        results = env.provisioner.schedule(pods)
+        assert results.all_pods_scheduled()
+        zones = set()
+        for nc in results.new_node_claims:
+            zones.add(nc.requirements.get(wk.ZONE_LABEL_KEY).any())
+        assert len(zones) >= 2  # headroom spread across zones, not one box
+
+
+class TestBufferProvisioning:
+    def test_buffer_provisions_headroom(self):
+        env = make_env()
+        env.store.create(pod_template(cpu="2", memory="4Gi"))
+        env.store.create(buffer(replicas=3))
+        env.settle()
+        # headroom nodes exist with zero real pods
+        assert env.store.count("Node") >= 1
+        total_cpu = sum(n.status.allocatable["cpu"].milli for n in env.store.list("Node"))
+        assert total_cpu >= 6000
+
+    def test_real_pods_use_buffer_capacity(self):
+        env = make_env()
+        env.store.create(pod_template(cpu="2", memory="4Gi"))
+        env.store.create(buffer(replicas=2))
+        env.settle()
+        nodes_before = env.store.count("Node")
+        # a real pod fitting the headroom binds without growing the cluster...
+        env.store.create(make_pod(cpu="1", memory="1Gi", name="real"))
+        env.settle(rounds=4)
+        assert env.store.get("Pod", "real").spec.node_name != ""
+        # ...and the next pass tops the headroom back up (may add a node)
+        assert env.store.count("Node") >= nodes_before
+
+    def test_emptiness_spares_buffer_nodes(self):
+        env = make_env()
+        env.store.create(pod_template(cpu="2", memory="4Gi"))
+        env.store.create(buffer(replicas=2))
+        env.settle()
+        n_nodes = env.store.count("Node")
+        assert n_nodes >= 1
+        # long quiet period: emptiness would normally reclaim idle nodes
+        env.settle(rounds=20, step_seconds=60.0)
+        assert env.store.count("Node") == n_nodes
+
+    def test_buffer_deletion_releases_headroom(self):
+        env = make_env()
+        env.store.create(pod_template(cpu="2", memory="4Gi"))
+        env.store.create(buffer(replicas=2))
+        env.settle()
+        assert env.store.count("Node") >= 1
+        env.store.delete("CapacityBuffer", "buf")
+        env.settle(rounds=25, step_seconds=60.0)
+        assert env.store.count("Node") == 0
