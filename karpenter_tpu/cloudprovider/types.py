@@ -86,6 +86,9 @@ class InstanceType:
     capacity: dict[str, Quantity] = field(default_factory=dict)
     overhead: InstanceTypeOverhead = field(default_factory=InstanceTypeOverhead)
     capacity_overlaid: bool = False
+    # DRA template devices this instance type ships when launched
+    # (reference types.go:133-135 DynamicResources); [kube.objects.Device]
+    dynamic_resources: list = field(default_factory=list)
 
     _allocatable: Optional[dict[str, Quantity]] = field(default=None, repr=False, compare=False)
 

@@ -25,6 +25,7 @@ class ProvisionerOptions:
     batch_idle_seconds: float = 1.0
     batch_max_seconds: float = 10.0
     capacity_buffer_enabled: bool = False  # CapacityBuffer feature gate
+    dynamic_resources_enabled: bool = False  # DynamicResources feature gate
 
 
 class Provisioner:
@@ -183,6 +184,7 @@ class Provisioner:
             clock=self.clock,
             preference_policy=self.options.preference_policy,
             min_values_policy=self.options.min_values_policy,
+            dra_enabled=self.options.dynamic_resources_enabled,
         )
 
     def create_node_claim(self, scheduling_claim, reason: str = "provisioning") -> str | None:

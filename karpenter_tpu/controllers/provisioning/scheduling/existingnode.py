@@ -16,12 +16,14 @@ from ....utils.quantity import Quantity
 
 
 class ExistingNode:
-    def __init__(self, state_node, topology, taints, daemon_resources: dict[str, Quantity], is_under_consolidate_after: bool = False):
+    def __init__(self, state_node, topology, taints, daemon_resources: dict[str, Quantity], is_under_consolidate_after: bool = False, allocator=None):
         self.state_node = state_node
         self.topology = topology
         self.taints = taints
         self.pods: list = []
         self.is_under_consolidate_after = is_under_consolidate_after
+        self.allocator = allocator  # DRA; None when the gate is off
+        self._pending_dra = None
 
         # remaining = allocatable - committed pods - headroom for daemons that
         # haven't scheduled yet (existingnode.go:45-60)
@@ -64,11 +66,22 @@ class ExistingNode:
         # try each volume topology alternative; the selected constraints shape
         # the topology checks (existingnode.go:108-137)
         last_err = None
+        self._pending_dra = None
         for vol_reqs in pod_data.volume_requirements or [None]:
             reqs, err = self._try_volume_alternative(pod, pod_data, base, vol_reqs)
             if err is not None:
                 last_err = err
                 continue
+            # simulate DRA allocation against this node's published devices;
+            # committed on Add (existingnode.go:122-135)
+            if (pod_data.resource_claims or pod_data.resource_claim_err) and self.allocator is not None:
+                if pod_data.resource_claim_err is not None:
+                    return None, pod_data.resource_claim_err
+                result, derr = self.allocator.allocate_for_node(self.name(), pod_data.resource_claims)
+                if derr is not None:
+                    last_err = f"allocating dynamic resources, {derr}"
+                    continue
+                self._pending_dra = result
             return reqs, None
         return None, last_err
 
@@ -98,4 +111,7 @@ class ExistingNode:
         self.remaining_resources = res.subtract(self.remaining_resources, pod_data.requests)
         self.host_port_usage.add(pod.key(), pod_host_ports(pod))
         self.volume_usage.add(pod.key(), pod_data.volumes)
+        if self._pending_dra is not None and self.allocator is not None:
+            self.allocator.commit_for_node(self.name(), self._pending_dra)
+            self._pending_dra = None
         self.topology.record(pod, self.taints, self.requirements)

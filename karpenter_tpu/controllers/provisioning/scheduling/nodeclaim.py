@@ -69,12 +69,15 @@ class SchedulingNodeClaim:
     """A NodeClaim being built up during a single Solve
     (scheduling/nodeclaim.go:52-120)."""
 
-    def __init__(self, template: NodeClaimTemplate, topology, daemon_overhead_groups: list[DaemonOverheadGroup], instance_types: list[InstanceType]):
+    def __init__(self, template: NodeClaimTemplate, topology, daemon_overhead_groups: list[DaemonOverheadGroup], instance_types: list[InstanceType], allocator=None):
         self.template = template
         self.topology = topology
         self.daemon_overhead_groups = [g.copy() for g in daemon_overhead_groups]
         self.pods: list = []
         self.instance_type_options = instance_types
+        self.allocator = allocator  # DRA; None when the gate is off
+        self.dra_trackers: dict = {}  # instance type name -> AllocationTracker
+        self._pending_dra = None  # {it name: AllocationResult} awaiting add()
         self.requirements = Requirements()
         self.requirements.add(*template.requirements.values())
         self.hostname = f"hostname-placeholder-{next(_hostname_seq):05d}"
@@ -103,6 +106,7 @@ class SchedulingNodeClaim:
         # try each volume topology alternative; the selected constraints affect
         # downstream topology and instance-type checks (nodeclaim.go:138-157)
         last_err = None
+        self._pending_dra = None
         for vol_reqs in pod_data.volume_requirements or [None]:
             reqs, its, err = self._try_volume_alternative(pod, pod_data, base, vol_reqs, relax_min_values)
             if err is not None:
@@ -147,6 +151,30 @@ class SchedulingNodeClaim:
                 claim_reqs.replace(relaxed)
         if ferr is not None:
             return None, None, ferr
+
+        # DRA: keep only instance types whose template devices satisfy the
+        # pod's claims; the reference allocates before the filter and prunes
+        # unsupported types after (nodeclaim.go:177-194,225-229)
+        if (pod_data.resource_claims or pod_data.resource_claim_err) and self.allocator is not None:
+            if pod_data.resource_claim_err is not None:
+                return None, None, pod_data.resource_claim_err
+            surviving, per_it = [], {}
+            for it in remaining:
+                tracker = self.dra_trackers.get(it.name)
+                if tracker is None:
+                    from ....scheduling.dynamicresources.allocator import AllocationTracker
+
+                    tracker = AllocationTracker()
+                result, derr = self.allocator.allocate(
+                    self.hostname, self.allocator.template_devices(it), pod_data.resource_claims, tracker
+                )
+                if derr is None:
+                    surviving.append(it)
+                    per_it[it.name] = (tracker, result)
+            if not surviving:
+                return None, None, "no instance type can allocate the pod's dynamic resources"
+            remaining = surviving
+            self._pending_dra = per_it
         return claim_reqs, remaining, None
 
     def add(self, pod, pod_data, updated_requirements: Requirements, updated_instance_types: list[InstanceType]) -> None:
@@ -154,6 +182,13 @@ class SchedulingNodeClaim:
         self.requirements = updated_requirements
         self.instance_type_options = updated_instance_types
         self.spec_requests = res.merge(self.spec_requests, pod_data.requests)
+        if self._pending_dra is not None and self.allocator is not None:
+            # commit per-instance-type device picks so later pods on this
+            # in-flight node see the consumed template budget
+            for it_name, (tracker, result) in self._pending_dra.items():
+                self.dra_trackers[it_name] = tracker
+                self.allocator.commit(self.hostname, result, tracker)
+            self._pending_dra = None
         # track host ports per daemon group so future pods see conflicts
         ports = pod_host_ports(pod)
         for g in self.daemon_overhead_groups:

@@ -35,6 +35,10 @@ class PodData:
     # pod's PVC volumes grouped by driver for limit tracking (scheduler.go:623)
     volume_requirements: list = field(default_factory=list)
     volumes: dict = field(default_factory=dict)
+    # DRA: the pod's resolved ResourceClaims (scheduler.go PodData
+    # ResourceClaims/HasResourceClaimRequests/ResourceClaimErr)
+    resource_claims: list = field(default_factory=list)
+    resource_claim_err: str | None = None
 
 
 @dataclass
@@ -78,6 +82,7 @@ class Scheduler:
         enforce_consolidate_after: bool = False,
         deleting_node_names: set[str] | None = None,
         timeout_seconds: float = 60.0,
+        dra_enabled: bool = False,
     ):
         self.store = store
         self.cluster = cluster
@@ -89,6 +94,12 @@ class Scheduler:
         self.preferences = Preferences(tolerate_prefer_no_schedule=(preference_policy == "Ignore"))
         self.cached_pod_data: dict[str, PodData] = {}
         self.volume_topology = VolumeTopology(store)
+        # one DRA allocator per solve, shared by every candidate (provisioner.go:333-344)
+        self.allocator = None
+        if dra_enabled:
+            from ....scheduling.dynamicresources import Allocator
+
+            self.allocator = Allocator(store, clock)
 
         # NodePools ordered by weight desc (provisioner.go:268-289)
         pools = sorted(node_pools, key=lambda np: (-np.spec.weight, np.metadata.name))
@@ -139,7 +150,7 @@ class Scheduler:
                 np = nodepool_map.get(sn.nodepool_name())
                 under_ca = _is_under_consolidate_after(np, sn.node_claim, clock)
             self.existing_nodes.append(
-                ExistingNode(sn, self.topology, taints, res.requests_for_pods(daemons), under_ca)
+                ExistingNode(sn, self.topology, taints, res.requests_for_pods(daemons), under_ca, allocator=self.allocator)
             )
             self._update_remaining_resources(sn)
 
@@ -202,12 +213,20 @@ class Scheduler:
         aff = pod.spec.affinity.node_affinity if pod.spec.affinity else None
         if aff is not None and aff.preferred:
             strict = Requirements.from_pod(pod, strict=True)
+        claims, claim_err = [], None
+        if self.allocator is not None and pod.spec.resource_claims:
+            from ....scheduling.dynamicresources import resolve_pod_claims
+
+            claims, claim_err = resolve_pod_claims(self.store, pod)
+            claims = claims or []  # claim_err is carried separately and fails CanAdd
         self.cached_pod_data[pod.metadata.uid] = PodData(
             requests=res.pod_requests(pod),
             requirements=requirements,
             strict_requirements=strict,
             volume_requirements=self.volume_topology.get_requirements(pod),
             volumes=get_volumes(self.store, pod),
+            resource_claims=claims,
+            resource_claim_err=claim_err,
         )
 
     def _try_schedule(self, pod) -> str | None:
@@ -269,7 +288,7 @@ class Scheduler:
                 if not its:
                     errs.append(f"all available instance types exceed limits for nodepool {t.nodepool_name}")
                     continue
-            nc = SchedulingNodeClaim(t, self.topology, self.daemon_overhead_groups[id(t)], its)
+            nc = SchedulingNodeClaim(t, self.topology, self.daemon_overhead_groups[id(t)], its, allocator=self.allocator)
             reqs, rem_its, err = nc.can_add(pod, pod_data, relax_min_values=(self.min_values_policy == "BestEffort"))
             if err is not None:
                 errs.append(f"{t.nodepool_name}: {err}")

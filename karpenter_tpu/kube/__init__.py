@@ -14,6 +14,8 @@ from .objects import (  # noqa: F401
     CSINode,
     CSINodeDriver,
     Deployment,
+    Device,
+    DeviceClass,
     NodeAffinity,
     Node,
     NodeSpec,
@@ -27,6 +29,9 @@ from .objects import (  # noqa: F401
     PodStatus,
     PodTemplate,
     PreferredSchedulingTerm,
+    ResourceClaim,
+    ResourceClaimTemplate,
+    ResourceSlice,
     StorageClass,
     TopologySpreadConstraint,
     WeightedPodAffinityTerm,

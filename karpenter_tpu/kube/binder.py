@@ -16,10 +16,12 @@ from ..utils import resources as res
 
 
 class Binder:
-    def __init__(self, store, cluster, clock):
+    def __init__(self, store, cluster, clock, dra_enabled: bool = False):
         self.store = store
         self.cluster = cluster
         self.clock = clock
+        self.dra_enabled = dra_enabled
+        self._dra_allocator = None
 
     def bind_all(self) -> int:
         """One scheduling pass; returns number of pods bound."""
@@ -27,6 +29,7 @@ class Binder:
         nodes = sorted(self.store.list("Node"), key=lambda n: n.metadata.name)
         node_reqs = {n.metadata.name: Requirements.from_labels(n.metadata.labels) for n in nodes}
         all_pods = self.store.list("Pod")
+        self._dra_allocator = None  # fresh per pass
         for pod in all_pods:
             if not pod_utils.is_provisionable(pod):
                 continue
@@ -36,6 +39,26 @@ class Binder:
                 pod.spec.node_name = node.metadata.name  # keep local view current for spread counting
                 bound += 1
         return bound
+
+    def _dra_ok(self, pod, node) -> bool:
+        """Claim-bearing pods bind only where their claims are allocated (or
+        allocatable) — the kube-scheduler's DRA plugin behavior. With the
+        feature gate off the whole control plane ignores claims, so the binder
+        must too or scheduled pods could never bind."""
+        if not self.dra_enabled or not pod.spec.resource_claims:
+            return True
+        from ..scheduling.dynamicresources import Allocator, resolve_pod_claims
+
+        claims, err = resolve_pod_claims(self.store, pod)
+        if err is not None:
+            return False
+        if self._dra_allocator is None:
+            self._dra_allocator = Allocator(self.store, self.clock)
+        result, aerr = self._dra_allocator.allocate_for_node(node.metadata.name, claims)
+        if aerr is not None:
+            return False
+        self._dra_allocator.commit_for_node(node.metadata.name, result)
+        return True
 
     def _find_node(self, pod, nodes, node_reqs_cache, all_pods):
         reqs = Requirements.from_pod(pod, strict=True)
@@ -54,6 +77,8 @@ class Binder:
             if not res.fits(requests, available):
                 continue
             if not self._topology_ok(pod, node, nodes, all_pods):
+                continue
+            if not self._dra_ok(pod, node):
                 continue
             return node
         return None

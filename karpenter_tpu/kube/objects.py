@@ -288,6 +288,74 @@ class CSINode:
 
 
 @dataclass
+class Device:
+    """One allocatable device in a ResourceSlice (resourcev1.Device)."""
+
+    name: str = ""
+    # qualified attribute name ("driver/attr" or plain) -> str | int | bool
+    attributes: dict[str, Any] = field(default_factory=dict)
+    capacity: dict[str, Quantity] = field(default_factory=dict)
+    # multi-allocatable (consumable-capacity) devices can serve several claims
+    # until their capacity is exhausted
+    allow_multiple_allocations: bool = False
+
+
+@dataclass
+class ResourceSlice:
+    """A driver's published pool chunk of devices on a node (resourcev1)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    driver: str = ""
+    pool_name: str = ""
+    pool_generation: int = 1
+    node_name: str = ""  # "" + all_nodes=False means selector-scoped
+    all_nodes: bool = False
+    node_selector: list[list[dict]] = field(default_factory=list)  # OR'd terms
+    devices: list[Device] = field(default_factory=list)
+    kind: str = "ResourceSlice"
+
+
+@dataclass
+class DeviceClass:
+    """Selector bundle a claim request references by class name."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selectors: list[dict] = field(default_factory=list)
+    kind: str = "DeviceClass"
+
+
+@dataclass
+class ResourceClaimStatus:
+    # {"devices": [{request, driver, pool, device, consumedCapacity?}],
+    #  "nodeName": str} once allocated
+    allocation: Optional[dict] = None
+    reserved_for: list[str] = field(default_factory=list)  # pod uids
+
+
+@dataclass
+class ResourceClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    # requests: [{name, deviceClassName?, selectors?, count?, allocationMode?,
+    #             capacity?}]
+    requests: list[dict] = field(default_factory=list)
+    # constraints: [{"matchAttribute": "driver/attr", "requests": [names]?}]
+    constraints: list[dict] = field(default_factory=list)
+    status: ResourceClaimStatus = field(default_factory=ResourceClaimStatus)
+    kind: str = "ResourceClaim"
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+@dataclass
+class ResourceClaimTemplate:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    requests: list[dict] = field(default_factory=list)
+    constraints: list[dict] = field(default_factory=list)
+    kind: str = "ResourceClaimTemplate"
+
+
+@dataclass
 class PodDisruptionBudget:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: dict | None = None  # metav1 label selector

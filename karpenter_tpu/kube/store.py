@@ -40,6 +40,7 @@ CLUSTER_SCOPED = {
     "CSINode",
     "ResourceSlice",
     "DeviceClass",
+    "DRAConfig",
 }
 
 WatchFn = Callable[[str, object], None]  # (event_type, obj)

@@ -35,6 +35,7 @@ from ..controllers.nodepool import (
 from ..controllers.nodeoverlay import InstanceTypeStore, NodeOverlayController
 from ..controllers.provisioning.provisioner import Provisioner, ProvisionerOptions
 from ..controllers.capacitybuffer import CapacityBufferController
+from ..controllers.dynamicresources import DeviceAllocationController, DRAKwokDriver
 from ..controllers.static import StaticDeprovisioningController, StaticProvisioningController
 from ..controllers.metrics import (
     NodeMetricsController,
@@ -104,8 +105,11 @@ class Environment:
                 batch_idle_seconds=self.options.batch_idle_duration,
                 batch_max_seconds=self.options.batch_max_duration,
                 capacity_buffer_enabled=self.options.feature_gates.capacity_buffer,
+                dynamic_resources_enabled=self.options.feature_gates.dynamic_resources,
             ),
         )
+        self.device_allocation = DeviceAllocationController(self.store, self.cluster, self.clock)
+        self.dra_kwok_driver = DRAKwokDriver(self.store)
         self.capacity_buffer = CapacityBufferController(self.store, self.clock, provisioner=self.provisioner)
         self.static_provisioning = StaticProvisioningController(
             self.store, self.cluster, self.cloud_provider, self.provisioner, self.clock, metrics=self.registry
@@ -119,7 +123,7 @@ class Environment:
             recorder=self.recorder, np_state=self.np_state, metrics=self.registry,
         )
         self.gc = GarbageCollectionController(self.store, self.cluster, self.cloud_provider, self.clock)
-        self.binder = Binder(self.store, self.cluster, self.clock)
+        self.binder = Binder(self.store, self.cluster, self.clock, dra_enabled=self.options.feature_gates.dynamic_resources)
         self.termination = TerminationController(
             self.store, self.cluster, self.cloud_provider, self.clock,
             recorder=self.recorder, metrics=self.registry,
@@ -149,8 +153,12 @@ class Environment:
         self.nodepool_metrics = NodePoolMetricsController(self.store, self.registry, cluster_cost=self.cluster_cost)
         self.extra_controllers: list = []  # later controllers appended as built
 
-        # pod watch triggers the provisioner batcher (state informer §3.5)
+        # pod and node watches trigger the provisioner batcher (the reference's
+        # provisioning pod/node trigger controllers, state informer §3.5); the
+        # node trigger also closes the gap between a headroom node registering
+        # and the pass that records its buffer pods
         self.store.watch("Pod", lambda e, p: self.provisioner.trigger(p.metadata.uid) if e != "DELETED" else None)
+        self.store.watch("Node", lambda e, n: self.provisioner.trigger(n.metadata.uid) if e != "DELETED" else None)
 
     def _make_solver(self):
         if self.options.solver_backend == "tpu":
@@ -181,7 +189,11 @@ class Environment:
         self.termination.reconcile()
         self.lifecycle.reconcile_all()  # claims whose node finished draining release
         self.gc.reconcile()
+        if self.options.feature_gates.dynamic_resources:
+            self.dra_kwok_driver.reconcile()
         self.binder.bind_all()
+        if self.options.feature_gates.dynamic_resources:
+            self.device_allocation.reconcile()
         self.nodepool_counter.reconcile()
         self.hydration.reconcile()
         self.consistency.reconcile()

@@ -1,0 +1,429 @@
+"""DRA device allocator: DFS assignment of devices to claim requests.
+
+Reference: pkg/scheduling/dynamicresources/{allocator,pool,request,constraint,
+allocationtracker}.go — the reference walks a decision tree over (request x
+candidate device) choices under a 5s/pod budget (allocator.go:41-43), tracking
+already-allocated devices and enforcing matchAttribute constraints, against
+two device sources: ResourceSlices published in-cluster (existing nodes) and
+*template* devices an instance type would ship if launched
+(cloudprovider.InstanceType.DynamicResources, types.go:133-135).
+
+TPU-native redesign notes: the CEL selector language is replaced by structured
+selector dicts ({attribute|capacity, operator, values}) evaluated host-side —
+device selection is control-plane work and stays off the device; the tensor
+solver falls back to FFD for claim-bearing pods (encode.py). Partitionable
+devices/counter sets and per-instance-type requirement superposition
+(allocator.go:90-134) are not modeled; template allocation instead filters the
+instance-type set directly, which preserves the observable behavior (claims
+only land on instance types that can satisfy them).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field
+
+from ...utils.quantity import Quantity
+
+ALLOCATE_TIMEOUT_SECONDS = 5.0  # allocator.go:43
+
+
+# -- selectors ---------------------------------------------------------------
+def _attr_value(device, name):
+    if name in device.attributes:
+        return device.attributes[name]
+    # allow unqualified lookup of "driver/attr" names
+    for k, v in device.attributes.items():
+        if k.split("/")[-1] == name:
+            return v
+    return None
+
+
+def device_matches_selectors(device, selectors: list[dict]) -> bool:
+    """Structured replacement for the reference's CEL device selectors
+    (request.go Selectors): every selector must match."""
+    for sel in selectors or []:
+        if "attribute" in sel:
+            val = _attr_value(device, sel["attribute"])
+            op = sel.get("operator", "Exists")
+            values = sel.get("values", [])
+            if op == "Exists":
+                if val is None:
+                    return False
+            elif op == "DoesNotExist":
+                if val is not None:
+                    return False
+            elif op == "In":
+                if val is None or str(val) not in [str(v) for v in values]:
+                    return False
+            elif op == "NotIn":
+                if val is not None and str(val) in [str(v) for v in values]:
+                    return False
+            elif op in ("Gt", "Lt", "Gte", "Lte"):
+                if val is None:
+                    return False
+                try:
+                    v, bound = float(val), float(values[0])
+                except (TypeError, ValueError, IndexError):
+                    return False
+                if op == "Gt" and not v > bound:
+                    return False
+                if op == "Lt" and not v < bound:
+                    return False
+                if op == "Gte" and not v >= bound:
+                    return False
+                if op == "Lte" and not v <= bound:
+                    return False
+            else:
+                return False
+        elif "capacity" in sel:
+            cap = device.capacity.get(sel["capacity"])
+            if cap is None:
+                return False
+            bound = Quantity.parse(sel.get("value", "0"))
+            op = sel.get("operator", "Gte")
+            if op == "Gte" and not cap.milli >= bound.milli:
+                return False
+            if op == "Lte" and not cap.milli <= bound.milli:
+                return False
+        else:
+            return False
+    return True
+
+
+# -- claims ------------------------------------------------------------------
+def resolve_pod_claims(store, pod):
+    """The pod's ResourceClaims, materializing template-backed ones with the
+    kube naming convention <pod>-<claim entry name> when the object already
+    exists, else a synthetic claim from the template (utils/resourceclaim).
+    Returns (claims, err)."""
+    from ...kube.objects import ObjectMeta, ResourceClaim
+
+    claims = []
+    for entry in pod.spec.resource_claims:
+        if entry.get("resourceClaimName"):
+            rc = store.try_get("ResourceClaim", entry["resourceClaimName"], pod.metadata.namespace)
+            if rc is None:
+                return None, f"resourceclaim {entry['resourceClaimName']} not found"
+            claims.append(rc)
+        elif entry.get("resourceClaimTemplateName"):
+            name = f"{pod.metadata.name}-{entry.get('name', '')}"
+            rc = store.try_get("ResourceClaim", name, pod.metadata.namespace)
+            if rc is not None:
+                claims.append(rc)
+                continue
+            tmpl = store.try_get("ResourceClaimTemplate", entry["resourceClaimTemplateName"], pod.metadata.namespace)
+            if tmpl is None:
+                return None, f"resourceclaimtemplate {entry['resourceClaimTemplateName']} not found"
+            claims.append(
+                ResourceClaim(
+                    metadata=ObjectMeta(name=name, namespace=pod.metadata.namespace),
+                    requests=copy.deepcopy(tmpl.requests),
+                    constraints=copy.deepcopy(tmpl.constraints),
+                )
+            )
+    return claims, None
+
+
+@dataclass
+class _DeviceRef:
+    """A concrete candidate device with its identity for tracking."""
+
+    device: object
+    driver: str
+    pool: str
+    device_id: tuple  # (scope, driver, pool, name); scope=node name or "template"
+
+
+@dataclass
+class AllocationResult:
+    """Successful allocation: per-claim device picks (allocator.go:182-191)."""
+
+    # claim key -> [(request name, _DeviceRef, consumed capacity | None)]
+    picks: dict = field(default_factory=dict)
+
+
+class _MatchAttributeConstraint:
+    """All devices for the constrained requests share the attribute's value
+    (constraint.go:41-146)."""
+
+    def __init__(self, attribute: str, requests: list[str] | None):
+        self.attribute = attribute
+        self.requests = set(requests) if requests else None  # None = all
+        self.value = None
+        self.count = 0
+
+    def applies(self, request_name: str) -> bool:
+        return self.requests is None or request_name in self.requests
+
+    def add(self, request_name: str, device) -> bool:
+        if not self.applies(request_name):
+            return True
+        val = _attr_value(device, self.attribute)
+        if val is None:
+            return False
+        if self.count == 0:
+            self.value = val
+            self.count = 1
+            return True
+        if val != self.value:
+            return False
+        self.count += 1
+        return True
+
+    def remove(self, request_name: str) -> None:
+        if not self.applies(request_name):
+            return
+        self.count -= 1
+        if self.count == 0:
+            self.value = None
+
+
+class AllocationTracker:
+    """Devices already spoken for: exclusive allocations and consumed capacity
+    of multi-allocatable devices (allocationtracker.go)."""
+
+    def __init__(self):
+        self.exclusive: set = set()  # device ids
+        self.consumed: dict = {}  # device id -> {capacity name: Quantity}
+
+    def copy(self) -> "AllocationTracker":
+        c = AllocationTracker()
+        c.exclusive = set(self.exclusive)
+        c.consumed = {k: dict(v) for k, v in self.consumed.items()}
+        return c
+
+    def available(self, ref: _DeviceRef, want_capacity: dict) -> bool:
+        if ref.device_id in self.exclusive:
+            return False
+        if not ref.device.allow_multiple_allocations:
+            return True
+        used = self.consumed.get(ref.device_id, {})
+        for name, want in (want_capacity or {}).items():
+            have = ref.device.capacity.get(name)
+            if have is None:
+                return False
+            already = used.get(name, Quantity(0))
+            if already.milli + want.milli > have.milli:
+                return False
+        return True
+
+    def take(self, ref: _DeviceRef, want_capacity: dict) -> None:
+        if ref.device.allow_multiple_allocations:
+            used = self.consumed.setdefault(ref.device_id, {})
+            for name, want in (want_capacity or {}).items():
+                used[name] = used.get(name, Quantity(0)) + want
+        else:
+            self.exclusive.add(ref.device_id)
+
+    def release(self, ref: _DeviceRef, want_capacity: dict) -> None:
+        if ref.device.allow_multiple_allocations:
+            used = self.consumed.get(ref.device_id, {})
+            for name, want in (want_capacity or {}).items():
+                if name in used:
+                    used[name] = used[name] - want
+        else:
+            self.exclusive.discard(ref.device_id)
+
+
+class Allocator:
+    """One scheduling loop's allocator: shared read-mostly state plus
+    per-candidate trackers (allocator.go:45-67)."""
+
+    def __init__(self, store, clock=None):
+        self.store = store
+        self.class_selectors: dict[str, list[dict]] = {
+            dc.metadata.name: dc.selectors for dc in store.list("DeviceClass")
+        }
+        # node name -> [_DeviceRef] from in-cluster ResourceSlices
+        self.node_devices: dict[str, list[_DeviceRef]] = {}
+        for sl in store.list("ResourceSlice"):
+            if not sl.node_name:
+                continue  # selector-scoped slices not modeled; see module doc
+            refs = self.node_devices.setdefault(sl.node_name, [])
+            for d in sl.devices:
+                refs.append(
+                    _DeviceRef(device=d, driver=sl.driver, pool=sl.pool_name,
+                               device_id=(sl.node_name, sl.driver, sl.pool_name, d.name))
+                )
+        # seed allocated-device state from in-cluster claim statuses
+        self.base_tracker = AllocationTracker()
+        self.allocated_claims: dict[str, dict] = {}  # claim key -> allocation
+        for rc in store.list("ResourceClaim"):
+            alloc = rc.status.allocation
+            if not alloc:
+                continue
+            self.allocated_claims[rc.key()] = alloc
+            node = alloc.get("nodeName", "")
+            for dev in alloc.get("devices", []):
+                did = (node, dev.get("driver", ""), dev.get("pool", ""), dev.get("device", ""))
+                consumed = dev.get("consumedCapacity")
+                if consumed:
+                    used = self.base_tracker.consumed.setdefault(did, {})
+                    for name, q in consumed.items():
+                        q = q if isinstance(q, Quantity) else Quantity.parse(q)
+                        used[name] = used.get(name, Quantity(0)) + q
+                else:
+                    self.base_tracker.exclusive.add(did)
+        # in-loop committed picks layered on top of the base state
+        self.loop_tracker = self.base_tracker.copy()
+        # claim key -> node/claim target committed this loop (shared claims
+        # must co-locate all their pods)
+        self.claim_targets: dict[str, str] = {}
+
+    # -- allocation ----------------------------------------------------------
+    def allocate(self, target_id: str, devices: list[_DeviceRef], claims: list, tracker: AllocationTracker):
+        """Try to satisfy every unallocated claim from `devices` given the
+        tracker state. Returns (AllocationResult, None) or (None, err). Pure:
+        the tracker is copied, not mutated; commit applies the picks."""
+        result = AllocationResult()
+        work = tracker.copy()
+        deadline = time.monotonic() + ALLOCATE_TIMEOUT_SECONDS
+        for rc in claims:
+            if rc.status.allocation:
+                # allocated in-cluster: pod must land where the claim lives
+                node = rc.status.allocation.get("nodeName", "")
+                if node and node != target_id:
+                    return None, f"resourceclaim {rc.key()} is allocated on {node}"
+                continue
+            prior = self.claim_targets.get(rc.key())
+            if prior is not None:
+                if prior != target_id:
+                    return None, f"resourceclaim {rc.key()} is held by {prior}"
+                continue  # already allocated this loop on this very target
+            picks = self._allocate_claim(rc, devices, work, deadline)
+            if picks is None:
+                return None, f"cannot allocate devices for resourceclaim {rc.key()}"
+            for _, ref, cap in picks:
+                work.take(ref, cap)
+            result.picks[rc.key()] = picks
+        return result, None
+
+    def commit(self, target_id: str, result: AllocationResult, tracker: AllocationTracker) -> None:
+        """Apply a successful allocation to the given tracker and pin the
+        claims to the target (allocation.Commit, allocator.go:193-220)."""
+        for claim_key, picks in result.picks.items():
+            for _, ref, cap in picks:
+                tracker.take(ref, cap)
+            self.claim_targets[claim_key] = target_id
+
+    def _allocate_claim(self, rc, devices: list[_DeviceRef], tracker: AllocationTracker, deadline: float):
+        """DFS over (request x candidate device) choices (allocator.go DFS)."""
+        constraints = [
+            _MatchAttributeConstraint(c["matchAttribute"], c.get("requests"))
+            for c in rc.constraints
+            if c.get("matchAttribute")
+        ]
+        requests = rc.requests
+        picks: list = []
+
+        def eligible(req, ref):
+            sels = list(req.get("selectors") or [])
+            cls = req.get("deviceClassName")
+            if cls is not None:
+                if cls not in self.class_selectors:
+                    return False
+                sels = list(self.class_selectors[cls]) + sels
+            return device_matches_selectors(ref.device, sels)
+
+        def dfs(req_idx: int) -> bool:
+            if time.monotonic() > deadline:
+                return False
+            if req_idx == len(requests):
+                return True
+            req = requests[req_idx]
+            name = req.get("name", f"request-{req_idx}")
+            want_cap = {k: (v if isinstance(v, Quantity) else Quantity.parse(v)) for k, v in (req.get("capacity") or {}).items()}
+            mode = req.get("allocationMode", "ExactCount")
+            count = int(req.get("count", 1))
+            candidates = [r for r in devices if eligible(req, r)]
+            if mode == "All":
+                # take every candidate or none: unwind exactly what was taken
+                # (including per-constraint add/remove pairing) on any failure
+                chosen: list = []  # (ref, [constraints whose add() succeeded])
+                ok = True
+                for ref in candidates:
+                    if not tracker.available(ref, want_cap):
+                        ok = False
+                        break
+                    added = []
+                    for c in constraints:
+                        if c.add(name, ref.device):
+                            added.append(c)
+                        else:
+                            ok = False
+                            break
+                    if not ok:
+                        for c in added:
+                            c.remove(name)
+                        break
+                    tracker.take(ref, want_cap)
+                    chosen.append((ref, added))
+                    picks.append((name, ref, want_cap or None))
+                if ok and dfs(req_idx + 1):
+                    return True
+                for ref, added in reversed(chosen):
+                    tracker.release(ref, want_cap)
+                    for c in added:
+                        c.remove(name)
+                    picks.pop()
+                return False
+
+            def choose(k: int, start: int) -> bool:
+                if k == 0:
+                    return dfs(req_idx + 1)
+                if time.monotonic() > deadline:
+                    return False
+                for i in range(start, len(candidates)):
+                    ref = candidates[i]
+                    taken = (name, ref, want_cap or None)
+                    if taken in picks or not tracker.available(ref, want_cap):
+                        continue
+                    ok = True
+                    added = []
+                    for c in constraints:
+                        if c.add(name, ref.device):
+                            added.append(c)
+                        else:
+                            ok = False
+                            break
+                    if not ok:
+                        for c in added:
+                            c.remove(name)
+                        continue
+                    tracker.take(ref, want_cap)
+                    picks.append(taken)
+                    if choose(k - 1, i + 1):
+                        return True
+                    picks.pop()
+                    tracker.release(ref, want_cap)
+                    for c in added:
+                        c.remove(name)
+                return False
+
+            return choose(count, 0)
+
+        return picks if dfs(0) else None
+
+    # -- candidate views ------------------------------------------------------
+    def allocate_for_node(self, node_name: str, claims: list):
+        """Existing node: allocate from its published slices
+        (existingnode.go:125-134 draExistingNode)."""
+        devices = self.node_devices.get(node_name, [])
+        return self.allocate(node_name, devices, claims, self.loop_tracker)
+
+    def commit_for_node(self, node_name: str, result: AllocationResult) -> None:
+        self.commit(node_name, result, self.loop_tracker)
+
+    @staticmethod
+    def template_devices(instance_type) -> list[_DeviceRef]:
+        """Devices an instance type would ship when launched
+        (cloudprovider types.go:133-135 DynamicResources)."""
+        out = []
+        for d in getattr(instance_type, "dynamic_resources", None) or []:
+            out.append(
+                _DeviceRef(device=d, driver="template", pool=instance_type.name,
+                           device_id=("template", instance_type.name, "pool", d.name))
+            )
+        return out

@@ -175,6 +175,10 @@ def check_capability(snap) -> list[str]:
                 # PVC topology alternatives + per-driver limits stay host-side
                 reasons.append(f"{pod.key()}: PVC-backed volumes")
                 break
+            if pod.spec.resource_claims:
+                # DRA's DFS decision tree stays host-side (SURVEY.md §7 stage 9)
+                reasons.append(f"{pod.key()}: dynamic resource claims")
+                break
             continue
         break
     # inverse anti-affinity from already-running pods isn't tensorized

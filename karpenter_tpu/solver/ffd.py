@@ -22,5 +22,6 @@ class FFDSolver:
             min_values_policy=snap.min_values_policy,
             enforce_consolidate_after=snap.enforce_consolidate_after,
             deleting_node_names=snap.deleting_node_names,
+            dra_enabled=snap.dra_enabled,
         )
         return scheduler.solve(snap.pods)

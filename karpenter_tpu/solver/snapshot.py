@@ -23,3 +23,4 @@ class SolverSnapshot:
     min_values_policy: str = "Strict"
     enforce_consolidate_after: bool = False
     deleting_node_names: set = field(default_factory=set)
+    dra_enabled: bool = False

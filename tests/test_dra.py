@@ -1,0 +1,292 @@
+"""DRA behavior specs, modeled on the reference's
+scheduling/dynamicresources allocator_test.go core cases and the dra e2e
+suite."""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.cloudprovider.types import InstanceType, Offering
+from karpenter_tpu.controllers.dynamicresources import DRAConfig
+from karpenter_tpu.controllers.provisioning.scheduling import Scheduler
+from karpenter_tpu.kube import (
+    Device,
+    DeviceClass,
+    ObjectMeta,
+    ResourceClaim,
+    ResourceClaimTemplate,
+    ResourceSlice,
+    Store,
+)
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import FeatureGates, Options
+from karpenter_tpu.scheduling.dynamicresources import Allocator, device_matches_selectors
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.quantity import Quantity
+from karpenter_tpu.utils.resources import parse_resource_list
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def gpu(name, model="a100", memory="40Gi", multi=False):
+    return Device(
+        name=name,
+        attributes={"gpu.example.com/model": model},
+        capacity=parse_resource_list({"memory": memory}),
+        allow_multiple_allocations=multi,
+    )
+
+
+def gpu_claim(name, count=1, model=None, ns="default", constraints=None, capacity=None):
+    sel = [{"attribute": "model", "operator": "In", "values": [model]}] if model else []
+    req = {"name": "gpus", "deviceClassName": "gpu-class", "count": count}
+    if sel:
+        req["selectors"] = sel
+    if capacity:
+        req["capacity"] = parse_resource_list(capacity)
+    return ResourceClaim(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        requests=[req],
+        constraints=constraints or [],
+    )
+
+
+def claim_pod(name, *claim_names, **kw):
+    pod = make_pod(name=name, **kw)
+    pod.spec.resource_claims = [{"name": f"c{i}", "resourceClaimName": c} for i, c in enumerate(claim_names)]
+    return pod
+
+
+def build_store():
+    store, clock = Store(), FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    store.create(DeviceClass(metadata=ObjectMeta(name="gpu-class"), selectors=[{"attribute": "model", "operator": "Exists"}]))
+    return store, clock, cluster
+
+
+class TestSelectors:
+    def test_attribute_ops(self):
+        d = gpu("g0", model="h100")
+        assert device_matches_selectors(d, [{"attribute": "model", "operator": "In", "values": ["h100"]}])
+        assert not device_matches_selectors(d, [{"attribute": "model", "operator": "In", "values": ["a100"]}])
+        assert device_matches_selectors(d, [{"attribute": "gpu.example.com/model", "operator": "Exists"}])
+        assert device_matches_selectors(d, [{"attribute": "missing", "operator": "DoesNotExist"}])
+
+    def test_capacity_selector(self):
+        d = gpu("g0", memory="80Gi")
+        assert device_matches_selectors(d, [{"capacity": "memory", "operator": "Gte", "value": "40Gi"}])
+        assert not device_matches_selectors(d, [{"capacity": "memory", "operator": "Lte", "value": "40Gi"}])
+
+
+class TestAllocator:
+    def _with_node_slice(self, devices):
+        store, clock, cluster = build_store()
+        store.create(ResourceSlice(metadata=ObjectMeta(name="n1-gpus"), driver="gpu", pool_name="n1", node_name="n1", devices=devices))
+        return store, clock
+
+    def test_exact_count(self):
+        store, clock = self._with_node_slice([gpu("g0"), gpu("g1")])
+        a = Allocator(store, clock)
+        result, err = a.allocate_for_node("n1", [gpu_claim("two", count=2)])
+        assert err is None
+        assert len(result.picks["default/two"]) == 2
+
+    def test_exhaustion(self):
+        store, clock = self._with_node_slice([gpu("g0")])
+        a = Allocator(store, clock)
+        r1, err = a.allocate_for_node("n1", [gpu_claim("one")])
+        assert err is None
+        a.commit_for_node("n1", r1)
+        _, err2 = a.allocate_for_node("n1", [gpu_claim("other")])
+        assert err2 is not None
+
+    def test_already_allocated_in_cluster_respected(self):
+        store, clock = self._with_node_slice([gpu("g0")])
+        taken = gpu_claim("taken")
+        taken.status.allocation = {"nodeName": "n1", "devices": [{"request": "gpus", "driver": "gpu", "pool": "n1", "device": "g0"}]}
+        store.create(taken)
+        a = Allocator(store, clock)
+        _, err = a.allocate_for_node("n1", [gpu_claim("newbie")])
+        assert err is not None
+
+    def test_match_attribute_constraint(self):
+        store, clock = self._with_node_slice([gpu("g0", model="a100"), gpu("g1", model="h100"), gpu("g2", model="h100")])
+        a = Allocator(store, clock)
+        claim = gpu_claim("pair", count=2, constraints=[{"matchAttribute": "gpu.example.com/model"}])
+        result, err = a.allocate_for_node("n1", [claim])
+        assert err is None
+        picked = {ref.device.name for _, ref, _ in result.picks["default/pair"]}
+        assert picked == {"g1", "g2"}  # only the h100s match each other
+
+    def test_multi_allocatable_capacity(self):
+        store, clock = self._with_node_slice([gpu("g0", memory="40Gi", multi=True)])
+        a = Allocator(store, clock)
+        r1, err = a.allocate_for_node("n1", [gpu_claim("a", capacity={"memory": "30Gi"})])
+        assert err is None
+        a.commit_for_node("n1", r1)
+        # 10Gi left: a 20Gi slice no longer fits, a 10Gi one does
+        _, err2 = a.allocate_for_node("n1", [gpu_claim("b", capacity={"memory": "20Gi"})])
+        assert err2 is not None
+        r3, err3 = a.allocate_for_node("n1", [gpu_claim("c", capacity={"memory": "10Gi"})])
+        assert err3 is None
+
+    def test_shared_claim_pins_target(self):
+        store, clock = self._with_node_slice([gpu("g0")])
+        store.create(ResourceSlice(metadata=ObjectMeta(name="n2-gpus"), driver="gpu", pool_name="n2", node_name="n2", devices=[gpu("g0")]))
+        a = Allocator(store, clock)
+        shared = gpu_claim("shared")
+        r1, err = a.allocate_for_node("n1", [shared])
+        assert err is None
+        a.commit_for_node("n1", r1)
+        _, err2 = a.allocate_for_node("n2", [shared])
+        assert "held by" in err2
+
+
+class TestSchedulerIntegration:
+    def _env(self, gpus_per_node=2):
+        store, clock, cluster = build_store()
+        np = make_nodepool(requirements=LINUX_AMD64)
+        store.create(np)
+        types = catalog.construct_instance_types()[:20]
+        # clone one family into a GPU-bearing variant
+        gpu_type = InstanceType(
+            name="gpu-8x-amd64-linux",
+            requirements=Requirements.from_labels({
+                wk.INSTANCE_TYPE_LABEL_KEY: "gpu-8x-amd64-linux",
+                wk.ARCH_LABEL_KEY: "amd64",
+                wk.OS_LABEL_KEY: "linux",
+            }),
+            offerings=[
+                Offering(
+                    requirements=Requirements.from_labels({
+                        wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND,
+                        wk.ZONE_LABEL_KEY: "test-zone-a",
+                    }),
+                    price=10.0,
+                )
+            ],
+            capacity=parse_resource_list({"cpu": "8", "memory": "32Gi", "pods": "110"}),
+            dynamic_resources=[gpu(f"g{i}") for i in range(gpus_per_node)],
+        )
+        types = types + [gpu_type]
+        return store, clock, cluster, [np], types
+
+    def test_claim_pod_lands_on_gpu_instance_type(self):
+        store, clock, cluster, pools, types = self._env()
+        store.create(gpu_claim("want-gpu"))
+        s = Scheduler(store, cluster, pools, {"default-pool": types}, cluster.nodes(), [], clock, dra_enabled=True)
+        results = s.solve([claim_pod("p1", "want-gpu", cpu="1")])
+        assert results.all_pods_scheduled()
+        its = {it.name for it in results.new_node_claims[0].instance_type_options}
+        assert its == {"gpu-8x-amd64-linux"}
+
+    def test_gpu_budget_splits_nodes(self):
+        # 3 single-GPU claims, 2 GPUs per node -> two nodes
+        store, clock, cluster, pools, types = self._env(gpus_per_node=2)
+        for n in ("c1", "c2", "c3"):
+            store.create(gpu_claim(n))
+        s = Scheduler(store, cluster, pools, {"default-pool": types}, cluster.nodes(), [], clock, dra_enabled=True)
+        pods = [claim_pod(f"p-{c}", c, cpu="100m") for c in ("c1", "c2", "c3")]
+        results = s.solve(pods)
+        assert results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 2
+
+    def test_no_gpu_types_unschedulable(self):
+        store, clock, cluster, pools, _ = self._env()
+        types = catalog.construct_instance_types()[:20]  # no dynamic resources
+        store.create(gpu_claim("want-gpu"))
+        s = Scheduler(store, cluster, pools, {"default-pool": types}, cluster.nodes(), [], clock, dra_enabled=True)
+        results = s.solve([claim_pod("p1", "want-gpu")])
+        assert not results.all_pods_scheduled()
+
+    def test_gate_off_ignores_claims(self):
+        store, clock, cluster, pools, types = self._env()
+        store.create(gpu_claim("want-gpu"))
+        s = Scheduler(store, cluster, pools, {"default-pool": types}, cluster.nodes(), [], clock, dra_enabled=False)
+        results = s.solve([claim_pod("p1", "want-gpu", cpu="1")])
+        assert results.all_pods_scheduled()  # claims ignored entirely
+
+
+class TestClaimErrors:
+    def test_missing_claim_blocks_pod(self):
+        # a pod referencing a nonexistent claim must NOT get capacity it can
+        # never bind to — the resolve error fails CanAdd
+        store, clock, cluster = build_store()
+        np = make_nodepool(requirements=LINUX_AMD64)
+        store.create(np)
+        types = catalog.construct_instance_types()[:20]
+        s = Scheduler(store, cluster, [np], {"default-pool": types}, cluster.nodes(), [], clock, dra_enabled=True)
+        results = s.solve([claim_pod("p1", "ghost-claim", cpu="1")])
+        assert not results.all_pods_scheduled()
+        assert "not found" in list(results.pod_errors.values())[0]
+
+
+class TestKwokDriverUpdates:
+    def test_config_edit_reaches_published_slices(self):
+        from karpenter_tpu.controllers.dynamicresources import DRAKwokDriver
+        from karpenter_tpu.kube import Node
+        from karpenter_tpu.kube.objects import NodeSpec
+
+        store, clock, cluster = build_store()
+        store.create(DRAConfig(metadata=ObjectMeta(name="cfg"), driver="gpu", devices=[gpu("g0")]))
+        node = Node(metadata=ObjectMeta(name="n1", labels={wk.NODE_REGISTERED_LABEL_KEY: "true"}), spec=NodeSpec(provider_id="kwok://n1"))
+        store.create(node)
+        drv = DRAKwokDriver(store)
+        drv.reconcile()
+        assert len(store.get("ResourceSlice", "n1-cfg").devices) == 1
+
+        def add_device(cfg):
+            cfg.devices.append(gpu("g1"))
+
+        store.patch("DRAConfig", "cfg", add_device)
+        drv.reconcile()
+        sl = store.get("ResourceSlice", "n1-cfg")
+        assert len(sl.devices) == 2 and sl.pool_generation == 2
+
+
+class TestClaimTemplates:
+    def test_template_resolves_per_pod(self):
+        store, clock, cluster = build_store()
+        store.create(ResourceClaimTemplate(metadata=ObjectMeta(name="gpu-tmpl"), requests=[{"name": "gpus", "deviceClassName": "gpu-class", "count": 1}]))
+        pod = make_pod(name="web-0")
+        pod.spec.resource_claims = [{"name": "gpu", "resourceClaimTemplateName": "gpu-tmpl"}]
+        from karpenter_tpu.scheduling.dynamicresources import resolve_pod_claims
+
+        claims, err = resolve_pod_claims(store, pod)
+        assert err is None
+        assert claims[0].metadata.name == "web-0-gpu"
+        assert claims[0].requests[0]["deviceClassName"] == "gpu-class"
+
+
+class TestEndToEnd:
+    def test_full_dra_flow(self):
+        env = Environment(options=Options(feature_gates=FeatureGates(dynamic_resources=True)))
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        env.store.create(DeviceClass(metadata=ObjectMeta(name="gpu-class"), selectors=[]))
+        env.store.create(DRAConfig(metadata=ObjectMeta(name="fake-gpus"), driver="gpu", devices=[gpu("g0"), gpu("g1")]))
+        # every instance type fakes two GPUs (driver publishes onto any node)
+        for it in env.base_cloud_provider.instance_types:
+            it.dynamic_resources = [gpu("g0"), gpu("g1")]
+        env.store.create(gpu_claim("want-gpu"))
+        env.store.create(claim_pod("p1", "want-gpu", cpu="1"))
+        env.settle()
+        pod = env.store.get("Pod", "p1")
+        assert pod.spec.node_name != ""
+        # driver published a slice for the node
+        slices = [sl for sl in env.store.list("ResourceSlice") if sl.node_name == pod.spec.node_name]
+        assert slices
+        # the claim is allocated on the pod's node and reserved for the pod
+        rc = env.store.get("ResourceClaim", "want-gpu")
+        assert rc.status.allocation and rc.status.allocation["nodeName"] == pod.spec.node_name
+        assert pod.metadata.uid in rc.status.reserved_for
+        # pod goes away -> claim released
+        env.store.delete("Pod", "p1")
+        env.settle(rounds=3)
+        rc = env.store.get("ResourceClaim", "want-gpu")
+        assert not rc.status.reserved_for and rc.status.allocation is None
