@@ -53,6 +53,7 @@ class DeviceAllocationController:
                 "pool": ref.pool,
                 "device": ref.device.name,
                 **({"consumedCapacity": cap} if cap else {}),
+                **({"multiAllocatable": True} if ref.device.allow_multiple_allocations else {}),
             }
             for name, ref, cap in result.picks.get(rc.key(), [])
         ]

@@ -73,14 +73,16 @@ class ExistingNode:
                 last_err = err
                 continue
             # simulate DRA allocation against this node's published devices;
-            # committed on Add (existingnode.go:122-135)
+            # committed on Add. The result is independent of the volume
+            # alternative (node requirements are immutable here), so a failure
+            # short-circuits instead of re-running the DFS per alternative
+            # (existingnode.go:122-135)
             if (pod_data.resource_claims or pod_data.resource_claim_err) and self.allocator is not None:
                 if pod_data.resource_claim_err is not None:
                     return None, pod_data.resource_claim_err
                 result, derr = self.allocator.allocate_for_node(self.name(), pod_data.resource_claims)
                 if derr is not None:
-                    last_err = f"allocating dynamic resources, {derr}"
-                    continue
+                    return None, f"allocating dynamic resources, {derr}"
                 self._pending_dra = result
             return reqs, None
         return None, last_err

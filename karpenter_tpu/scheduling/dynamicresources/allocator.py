@@ -233,6 +233,10 @@ class Allocator:
 
     def __init__(self, store, clock=None):
         self.store = store
+        # the DFS deadline uses the injected clock when it measures real time
+        # (production Clock); a FakeClock only advances when tests step it, so
+        # the timeout path is test-controllable (allocator.go:41-43)
+        self.clock = clock
         self.class_selectors: dict[str, list[dict]] = {
             dc.metadata.name: dc.selectors for dc in store.list("DeviceClass")
         }
@@ -264,6 +268,11 @@ class Allocator:
                     for name, q in consumed.items():
                         q = q if isinstance(q, Quantity) else Quantity.parse(q)
                         used[name] = used.get(name, Quantity(0)) + q
+                elif dev.get("multiAllocatable"):
+                    # a capacity-less allocation on a shareable device consumes
+                    # nothing — marking it exclusive would silently flip the
+                    # device to single-claim once the status persists
+                    pass
                 else:
                     self.base_tracker.exclusive.add(did)
         # in-loop committed picks layered on top of the base state
@@ -272,6 +281,9 @@ class Allocator:
         # must co-locate all their pods)
         self.claim_targets: dict[str, str] = {}
 
+    def _now(self) -> float:
+        return self.clock.now() if self.clock is not None else time.monotonic()
+
     # -- allocation ----------------------------------------------------------
     def allocate(self, target_id: str, devices: list[_DeviceRef], claims: list, tracker: AllocationTracker):
         """Try to satisfy every unallocated claim from `devices` given the
@@ -279,7 +291,7 @@ class Allocator:
         the tracker is copied, not mutated; commit applies the picks."""
         result = AllocationResult()
         work = tracker.copy()
-        deadline = time.monotonic() + ALLOCATE_TIMEOUT_SECONDS
+        deadline = self._now() + ALLOCATE_TIMEOUT_SECONDS
         for rc in claims:
             if rc.status.allocation:
                 # allocated in-cluster: pod must land where the claim lives
@@ -295,8 +307,8 @@ class Allocator:
             picks = self._allocate_claim(rc, devices, work, deadline)
             if picks is None:
                 return None, f"cannot allocate devices for resourceclaim {rc.key()}"
-            for _, ref, cap in picks:
-                work.take(ref, cap)
+            # the DFS leaves successful picks taken in `work`; re-taking here
+            # would double-charge consumable capacity across claims
             result.picks[rc.key()] = picks
         return result, None
 
@@ -328,7 +340,7 @@ class Allocator:
             return device_matches_selectors(ref.device, sels)
 
         def dfs(req_idx: int) -> bool:
-            if time.monotonic() > deadline:
+            if self._now() > deadline:
                 return False
             if req_idx == len(requests):
                 return True
@@ -373,7 +385,7 @@ class Allocator:
             def choose(k: int, start: int) -> bool:
                 if k == 0:
                     return dfs(req_idx + 1)
-                if time.monotonic() > deadline:
+                if self._now() > deadline:
                     return False
                 for i in range(start, len(candidates)):
                     ref = candidates[i]

@@ -1,0 +1,3 @@
+"""Test/e2e infrastructure (reference: test/pkg/environment/common)."""
+
+from .monitor import Monitor  # noqa: F401

@@ -1,0 +1,72 @@
+"""Chaos specs (reference: test/suites/regression/chaos_test.go) — the
+control plane must converge, not runaway, under random node kills and a
+taint/consolidation tug-of-war."""
+
+import random
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.testing import Monitor
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_env():
+    env = Environment(options=Options())
+    env.store.create(make_nodepool(requirements=LINUX_AMD64))
+    return env, Monitor(env.store, env.cluster)
+
+
+class TestChaos:
+    def test_random_node_kills_converge(self):
+        """Kill random nodes repeatedly; pods must always end up running and
+        the fleet must not grow without bound (chaos_test.go ExpectNoCrashes)."""
+        rng = random.Random(42)
+        env, monitor = make_env()
+        for i in range(60):
+            env.store.create(make_pod(cpu="1", memory="1Gi", name=f"p-{i}", labels={"app": "chaos"}))
+        env.settle()
+        assert monitor.pending_pod_count() == 0
+        max_nodes = 0
+        for round_ in range(8):
+            nodes = env.store.list("Node")
+            if nodes:
+                victim = rng.choice(nodes)
+                env.store.delete("Node", victim.metadata.name, grace=False)
+                env.cluster.delete_node(victim.metadata.name)
+            for _ in range(6):
+                env.clock.step(5.0)
+                env.tick(provision_force=True)
+            max_nodes = max(max_nodes, env.store.count("Node"))
+        env.settle(rounds=20)
+        assert monitor.pending_pod_count() == 0, "pods left stranded after chaos"
+        assert monitor.running_pod_count() == 60
+        # runaway guard: fleet never ballooned past a small multiple of needs
+        assert max_nodes <= 3 * env.store.count("Node") + 3, max_nodes
+
+    def test_tainted_nodes_replaced_not_multiplied(self):
+        """A user tainting a node NoSchedule must not trigger unbounded
+        scale-up (chaos_test.go taint scenario)."""
+        env, monitor = make_env()
+        for i in range(20):
+            env.store.create(make_pod(cpu="1", name=f"p-{i}"))
+        env.settle()
+        node = env.store.list("Node")[0]
+
+        def taint(n):
+            from karpenter_tpu.scheduling.taints import Taint
+
+            n.spec.taints.append(Taint(key="chaos", value="true", effect="NoSchedule"))
+
+        env.store.patch("Node", node.metadata.name, taint)
+        before = env.store.count("Node")
+        env.settle(rounds=15)
+        # running pods stay; fleet grows by at most a couple nodes for any
+        # evicted pods, never runs away
+        assert env.store.count("Node") <= before + 2
+        assert monitor.pending_pod_count() == 0

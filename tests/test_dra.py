@@ -136,6 +136,32 @@ class TestAllocator:
         r3, err3 = a.allocate_for_node("n1", [gpu_claim("c", capacity={"memory": "10Gi"})])
         assert err3 is None
 
+    def test_two_consumable_claims_one_call(self):
+        # both claims in ONE allocate call must not double-charge capacity:
+        # 15Gi + 15Gi on a 40Gi shareable device fits
+        store, clock = self._with_node_slice([gpu("g0", memory="40Gi", multi=True)])
+        a = Allocator(store, clock)
+        result, err = a.allocate_for_node(
+            "n1",
+            [gpu_claim("a", capacity={"memory": "15Gi"}), gpu_claim("b", capacity={"memory": "15Gi"})],
+        )
+        assert err is None
+        assert set(result.picks) == {"default/a", "default/b"}
+
+    def test_persisted_capacityless_multi_alloc_stays_shareable(self):
+        # a capacity-less allocation on a shareable device, once written to
+        # claim status, must not flip the device to exclusive
+        store, clock = self._with_node_slice([gpu("g0", multi=True)])
+        taken = gpu_claim("taken")
+        taken.status.allocation = {
+            "nodeName": "n1",
+            "devices": [{"request": "gpus", "driver": "gpu", "pool": "n1", "device": "g0", "multiAllocatable": True}],
+        }
+        store.create(taken)
+        a = Allocator(store, clock)
+        _, err = a.allocate_for_node("n1", [gpu_claim("second")])
+        assert err is None
+
     def test_shared_claim_pins_target(self):
         store, clock = self._with_node_slice([gpu("g0")])
         store.create(ResourceSlice(metadata=ObjectMeta(name="n2-gpus"), driver="gpu", pool_name="n2", node_name="n2", devices=[gpu("g0")]))

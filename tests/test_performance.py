@@ -1,0 +1,197 @@
+"""Performance suite: scale-out / consolidation / spread scenarios with
+regression thresholds.
+
+Reference: test/suites/performance/*.go — each scenario drives the full
+control plane (provision -> launch -> register -> bind -> disrupt) against the
+KWOK provider and asserts wall-clock + shape thresholds. Thresholds are
+overridable via the KARPENTER_PERF_THRESHOLDS env var (JSON mapping scenario
+-> {max_wall_seconds, ...}), mirroring thresholds.go:27-80.
+
+Wall-clock numbers here bound the in-process control plane's real compute
+(solver + controllers) — there is no apiserver latency, so they are far
+tighter than the reference's kind-cluster budgets.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from helpers import hostname_anti_affinity, make_nodepool, make_pod, zone_spread
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.testing import Monitor
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+THRESHOLDS = {
+    "basic_scale_out": {"max_wall_seconds": 60.0, "pods": 1000},
+    "basic_consolidation": {"max_wall_seconds": 120.0},
+    "wide_deployments": {"max_wall_seconds": 90.0, "deployments": 10, "pods_each": 30},
+    "hostname_spreading": {"max_wall_seconds": 90.0, "pods": 60},
+    "interference": {"max_wall_seconds": 90.0, "pods": 200},
+    "drift_replacement": {"max_wall_seconds": 120.0, "pods": 100},
+}
+_overrides = os.environ.get("KARPENTER_PERF_THRESHOLDS")
+if _overrides:
+    for k, v in json.loads(_overrides).items():
+        THRESHOLDS.setdefault(k, {}).update(v)
+
+
+def make_env(**kw):
+    env = Environment(options=Options(**kw))
+    env.store.create(make_nodepool(requirements=LINUX_AMD64))
+    return env, Monitor(env.store, env.cluster)
+
+
+def settle_until(env, pred, max_rounds=60, step=5.0):
+    for _ in range(max_rounds):
+        env.clock.step(step)
+        env.tick(provision_force=True)
+        if pred():
+            return True
+    return pred()
+
+
+class TestBasicScaleOut:
+    def test_1000_pods(self):
+        """performance/basic_test.go:36-59 — two deployments, 1000 pods."""
+        t = THRESHOLDS["basic_scale_out"]
+        env, monitor = make_env()
+        n = t["pods"]
+        for i in range(n // 2):
+            env.store.create(make_pod(cpu="500m", memory="512Mi", name=f"a-{i}", labels={"app": "a"}))
+        for i in range(n // 2):
+            env.store.create(make_pod(cpu="1", memory="1Gi", name=f"b-{i}", labels={"app": "b"}))
+        start = time.perf_counter()
+        ok = settle_until(env, lambda: monitor.pending_pod_count() == 0)
+        wall = time.perf_counter() - start
+        assert ok, f"{monitor.pending_pod_count()} pods still pending"
+        assert monitor.running_pod_count() == n
+        assert wall < t["max_wall_seconds"], f"scale-out took {wall:.1f}s"
+        # capacity should be reasonably packed, not one node per pod
+        assert monitor.avg_utilization("cpu") > 0.5, monitor.avg_utilization("cpu")
+
+    def test_basic_consolidation(self):
+        """basic_test.go:67-81 — scale down 30%, nodes shrink. Instance sizes
+        are capped so the fleet is wide enough for consolidation to matter."""
+        t = THRESHOLDS["basic_consolidation"]
+        env = Environment(options=Options())
+        env.store.create(
+            make_nodepool(
+                requirements=LINUX_AMD64
+                + [{"key": "karpenter.kwok.sh/instance-size", "operator": "In", "values": ["4x", "8x"]}]
+            )
+        )
+        monitor = Monitor(env.store, env.cluster)
+        for i in range(200):
+            env.store.create(make_pod(cpu="1", memory="1Gi", name=f"p-{i}", labels={"app": "a"}))
+        assert settle_until(env, lambda: monitor.pending_pod_count() == 0)
+        nodes_before = monitor.node_count()
+        # scale down 30%
+        for i in range(140, 200):
+            env.store.delete("Pod", f"p-{i}")
+        start = time.perf_counter()
+        settle_until(env, lambda: monitor.node_count() < nodes_before, max_rounds=40, step=20.0)
+        wall = time.perf_counter() - start
+        assert monitor.node_count() < nodes_before, "consolidation never shrank the cluster"
+        assert monitor.pending_pod_count() == 0
+        assert monitor.running_pod_count() == 140
+        assert wall < t["max_wall_seconds"], f"consolidation took {wall:.1f}s"
+
+
+class TestWideDeployments:
+    def test_many_deployments(self):
+        """wide_deployments_test.go — N deployments with distinct constraints."""
+        t = THRESHOLDS["wide_deployments"]
+        env, monitor = make_env()
+        zones = ["test-zone-a", "test-zone-b", "test-zone-c", "test-zone-d"]
+        total = 0
+        for d in range(t["deployments"]):
+            sel = {"matchLabels": {"app": f"d{d}"}}
+            for i in range(t["pods_each"]):
+                env.store.create(
+                    make_pod(
+                        cpu="500m",
+                        memory="512Mi",
+                        name=f"d{d}-{i}",
+                        labels={"app": f"d{d}"},
+                        node_selector={wk.ZONE_LABEL_KEY: zones[d % 4]} if d % 2 == 0 else None,
+                        tsc=[zone_spread(selector=sel)] if d % 2 == 1 else None,
+                    )
+                )
+                total += 1
+        start = time.perf_counter()
+        ok = settle_until(env, lambda: monitor.pending_pod_count() == 0)
+        wall = time.perf_counter() - start
+        assert ok and monitor.running_pod_count() == total
+        assert wall < t["max_wall_seconds"], f"took {wall:.1f}s"
+
+
+class TestHostnameSpreading:
+    def test_one_pod_per_node(self):
+        """host_name_spreading_test.go — anti-affinity forces 1 pod/node."""
+        t = THRESHOLDS["hostname_spreading"]
+        env, monitor = make_env()
+        sel = {"matchLabels": {"app": "spread"}}
+        for i in range(t["pods"]):
+            env.store.create(
+                make_pod(cpu="100m", name=f"s-{i}", labels={"app": "spread"}, anti_affinity=[hostname_anti_affinity(sel)])
+            )
+        start = time.perf_counter()
+        ok = settle_until(env, lambda: monitor.pending_pod_count() == 0, max_rounds=80)
+        wall = time.perf_counter() - start
+        assert ok
+        assert monitor.node_count() >= t["pods"]  # one node per pod
+        assert wall < t["max_wall_seconds"], f"took {wall:.1f}s"
+
+
+class TestInterference:
+    def test_anti_affinity_interference(self):
+        """interference_test.go — a spread workload interleaved with bulk pods."""
+        t = THRESHOLDS["interference"]
+        env, monitor = make_env()
+        sel = {"matchLabels": {"app": "aa"}}
+        for i in range(10):
+            env.store.create(make_pod(cpu="100m", name=f"aa-{i}", labels={"app": "aa"}, anti_affinity=[hostname_anti_affinity(sel)]))
+        for i in range(t["pods"]):
+            env.store.create(make_pod(cpu="500m", memory="512Mi", name=f"bulk-{i}"))
+        start = time.perf_counter()
+        ok = settle_until(env, lambda: monitor.pending_pod_count() == 0)
+        wall = time.perf_counter() - start
+        assert ok and monitor.running_pod_count() == t["pods"] + 10
+        assert wall < t["max_wall_seconds"], f"took {wall:.1f}s"
+
+
+class TestDriftReplacement:
+    def test_drift_rolls_fleet(self):
+        """drift_performance_test.go — template change replaces all capacity
+        while keeping pods running."""
+        t = THRESHOLDS["drift_replacement"]
+        env, monitor = make_env()
+        for i in range(t["pods"]):
+            env.store.create(make_pod(cpu="1", memory="1Gi", name=f"p-{i}", labels={"app": "drift"}))
+        assert settle_until(env, lambda: monitor.pending_pod_count() == 0)
+        before = {n.metadata.name for n in env.store.list("Node")}
+        np = env.store.list("NodePool")[0]
+        np.spec.template.labels = {"roll": "v2"}
+        env.store.update(np)
+        start = time.perf_counter()
+        settle_until(
+            env,
+            lambda: not ({n.metadata.name for n in env.store.list("Node")} & before)
+            and monitor.pending_pod_count() == 0,
+            max_rounds=100,
+            step=15.0,
+        )
+        wall = time.perf_counter() - start
+        after = {n.metadata.name for n in env.store.list("Node")}
+        assert not (after & before), "old nodes still present after drift roll"
+        assert monitor.pending_pod_count() == 0
+        assert monitor.running_pod_count() == t["pods"]
+        assert wall < t["max_wall_seconds"], f"drift roll took {wall:.1f}s"
