@@ -143,24 +143,35 @@ def make_tensors(enc, n_slots: int | None = None) -> SchedulerTensors:
     )
 
 
-def _compat_matrix(t: SchedulerTensors, zone_key: int):
-    """Precompute pod x row compatibility (zone key excluded; zones are
-    handled by the slot zone-set machinery): [P, Nrows] bool.
+def compat_matrix(row_labels, row_taint_class, masks, taints_ok, zone_key: int, batch_size: int = 1024):
+    """Requirement-mask x row compatibility for any batch of pods/items (zone
+    key excluded; zones are handled by the slot zone-set machinery):
+    [B, Nrows] bool. One big vectorized pass on the VPU instead of per-step
+    gathers inside the scan — scan bodies then just index a row."""
 
-    One big vectorized pass on the VPU instead of per-step gathers inside the
-    scan — the scan body then just indexes a row of this matrix.
-    """
-
-    def one_pod(args):
+    def one(args):
         mask_k_w, taint_ok_c = args
-        vids = t.row_labels  # [Nrows, K]
-        masks = jnp.broadcast_to(mask_k_w[None, :, :], (vids.shape[0],) + mask_k_w.shape)
-        ok = test_bit(masks, vids)  # [Nrows, K]
+        bmasks = jnp.broadcast_to(mask_k_w[None, :, :], (row_labels.shape[0],) + mask_k_w.shape)
+        ok = test_bit(bmasks, row_labels)  # [Nrows, K]
         if zone_key >= 0:
             ok = ok.at[:, zone_key].set(True)
-        return jnp.all(ok, axis=1) & taint_ok_c[t.row_taint_class]
+        return jnp.all(ok, axis=1) & taint_ok_c[row_taint_class]
 
-    return jax.lax.map(one_pod, (t.pod_mask, t.pod_taint_ok), batch_size=min(1024, t.pod_mask.shape[0]))
+    return jax.lax.map(one, (masks, taints_ok), batch_size=min(batch_size, masks.shape[0]))
+
+
+def row_choose_key(row_alloc, row_pool_rank, req):
+    """New-slot row preference: lowest template rank, then best bottleneck
+    headroom for the request shape. req may be [R] or [B, R] (broadcasts to
+    [B, Nrows])."""
+    req_b = req if req.ndim == 2 else req[None, :]
+    score = jnp.min(row_alloc[None, :, :] / jnp.maximum(req_b[:, None, :], 1e-6), axis=2)
+    key = row_pool_rank.astype(jnp.float32)[None, :] * jnp.float32(1e9) - jnp.minimum(score, 1e8)
+    return key if req.ndim == 2 else key[0]
+
+
+def _compat_matrix(t: SchedulerTensors, zone_key: int):
+    return compat_matrix(t.row_labels, t.row_taint_class, t.pod_mask, t.pod_taint_ok, zone_key)
 
 
 @partial(jax.jit, static_argnames=("zone_key", "n_existing", "n_slots"))
@@ -227,8 +238,7 @@ def _greedy_pack_impl(t: SchedulerTensors, zone_key: int, n_existing: int, n_slo
         fits_row &= rank_zone_ok[rank_of_row]
         # capacity score: prefer lowest rank, then the row whose allocatable
         # envelope best covers the pod's shape (max bottleneck headroom)
-        score = jnp.min(t.row_alloc / jnp.maximum(req[None, :], 1e-6), axis=1)  # [Nrows]
-        choose_key = t.row_pool_rank.astype(jnp.float32) * jnp.float32(1e9) - jnp.minimum(score, 1e8)
+        choose_key = row_choose_key(t.row_alloc, t.row_pool_rank, req)
         o_new = masked_argmin(choose_key, jnp.where(open_count < N, fits_row, False))
 
         use_slot = j_slot >= 0

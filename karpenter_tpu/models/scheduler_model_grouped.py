@@ -1,0 +1,372 @@
+"""Signature-grouped device scheduler: scan over unique pod shapes, not pods.
+
+The per-pod scan (scheduler_model.py) pays one sequential device step per pod
+— 50k pods = 50k steps regardless of how wide each step is. Real pending sets
+are dominated by deployment replicas: thousands of pods sharing one
+(requests, requirements, taints, zones, spread-membership) signature. This
+kernel scans over those signatures and places each group's `count` identical
+pods in ONE step with closed-form vector math:
+
+- first-fit over open slots becomes a prefix-sum: take_j = clip(c - cumsum of
+  capacity before j, 0, cap_j) — the exact result of c sequential first-fit
+  placements of identical pods (reference scheduler.go:614-656 lowest-index
+  wins), in one VPU pass;
+- leftover pods open ceil(L / per-node-capacity) new slots of the best
+  template row at once (the per-pod loop would pick the same argmin row
+  repeatedly — state doesn't change the choice);
+- zone-spread groups place via integer water-fill over feasible zones — the
+  closed form of "repeatedly add to the min-count feasible zone"
+  (topology.go nextDomainTopologySpread), then per-zone prefix-sum fills.
+
+Pods whose membership spans multiple zone-spread groups batch with count=1,
+where water-fill degenerates to the per-pod min-count choice. Equivalence to
+the host FFD is by the simulation contract (SURVEY.md §7: all-pods-scheduled
+parity, cost <=, constraints valid), not bit-identical placement.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .scheduler_model import (
+    KIND_HOST_ANTI,
+    KIND_HOST_SPREAD,
+    KIND_ZONE_SPREAD,
+    NEG,
+    NO_ZONE,
+    SchedulerTensors,
+    compat_matrix,
+    row_choose_key,
+)
+
+INF_I = jnp.int32(2**30)
+BIGF = jnp.float32(3.4e38)
+
+
+@dataclass
+class ItemTensors:
+    """One work item per unique pod signature."""
+
+    item_req: jnp.ndarray  # [W, R]
+    item_mask: jnp.ndarray  # [W, K, Words]
+    item_taint_ok: jnp.ndarray  # [W, C]
+    item_zone_allowed: jnp.ndarray  # [W, Z]
+    item_member: jnp.ndarray  # [W, G]
+    item_count: jnp.ndarray  # [W] i32
+
+
+jax.tree_util.register_dataclass(
+    ItemTensors,
+    data_fields=["item_req", "item_mask", "item_taint_ok", "item_zone_allowed", "item_member", "item_count"],
+    meta_fields=[],
+)
+
+
+def build_items(enc):
+    """Group pods by signature (host, numpy — fully vectorized: this runs on
+    the 50k-pod hot path every solve). Returns (ItemTensors arrays as numpy,
+    pod_indices_per_item as arrays). Pods in >1 zone-spread group stay
+    count=1 (water-fill is single-level for them)."""
+    P = enc.n_pods
+    G = enc.member.shape[1] if enc.member.size else 0
+    member = enc.member if G else np.zeros((P, 1), bool)
+    zone_groups = (enc.group_kind == KIND_ZONE_SPREAD) if G else np.zeros(1, bool)
+    multi_zone = (member & zone_groups[None, :]).sum(axis=1) > 1  # [P]
+    # unique rows over the concatenated byte view of every signature field;
+    # multi-zone pods get a distinct per-pod column so they never merge
+    uniq_col = np.where(multi_zone, np.arange(P, dtype=np.int64) + 1, 0)
+    sig = np.hstack(
+        [
+            enc.pod_req.view(np.uint8).reshape(P, -1),
+            enc.pod_mask.reshape(P, -1).view(np.uint8).reshape(P, -1),
+            enc.pod_taint_ok.reshape(P, -1).view(np.uint8).reshape(P, -1),
+            enc.pod_zone_allowed.view(np.uint8).reshape(P, -1),
+            member.view(np.uint8).reshape(P, -1),
+            uniq_col.view(np.uint8).reshape(P, -1),
+        ]
+    )
+    _, first_idx, inverse, counts = np.unique(sig, axis=0, return_index=True, return_inverse=True, return_counts=True)
+    # keep first-appearance order so FFD's big-pods-first queue order survives
+    order = np.argsort(first_idx, kind="stable")
+    rank = np.empty_like(order)
+    rank[order] = np.arange(order.size)
+    item_of_pod = rank[inverse]  # [P] item index in appearance order
+    reps = first_idx[order]
+    by_item = np.argsort(item_of_pod, kind="stable")
+    boundaries = np.cumsum(counts[order])[:-1]
+    item_pods = np.split(by_item, boundaries)
+    arrays = dict(
+        item_req=enc.pod_req[reps],
+        item_mask=enc.pod_mask[reps],
+        item_taint_ok=enc.pod_taint_ok[reps],
+        item_zone_allowed=enc.pod_zone_allowed[reps],
+        item_member=member[reps],
+        item_count=counts[order].astype(np.int32),
+    )
+    return arrays, item_pods
+
+
+def make_item_tensors(arrays) -> ItemTensors:
+    return ItemTensors(**{k: jnp.asarray(v) for k, v in arrays.items()})
+
+
+def _int_cap(rem, req):
+    """Per-slot/row integer pod capacity: min_r floor(rem/req) over requested
+    resources (req>0); unrequested resources don't bound."""
+    safe = jnp.where(req[None, :] > 0, jnp.floor(rem / jnp.maximum(req[None, :], 1e-9)), BIGF)
+    cap = jnp.min(safe, axis=1)
+    return jnp.clip(cap, 0, 2**30).astype(jnp.int32)
+
+
+def _waterfill(v, finite, c, cap):
+    """Integer water-fill: distribute c among finite entries, repeatedly
+    raising the current minimum (ties to lowest index), never exceeding the
+    per-entry cap[z]. Returns inc[Z] i32."""
+    Z = v.shape[0]
+    vf = jnp.where(finite, v.astype(jnp.float32), BIGF)
+    capf = jnp.clip(cap, 0, 2**30).astype(jnp.int32)
+
+    def body(_, carry):
+        inc, rem = carry
+        active = finite & (inc < capf)
+        cur = jnp.where(active, vf + inc.astype(jnp.float32), BIGF)
+        m = jnp.min(cur)
+        is_min = (cur == m) & active
+        kmin = jnp.sum(is_min.astype(jnp.int32))
+        nxt = jnp.min(jnp.where(cur > m, cur, BIGF))
+        gap = jnp.where(nxt < BIGF / 2, nxt - m, BIGF)
+        headroom = jnp.min(jnp.where(is_min, capf - inc, INF_I))
+        d = jnp.minimum(jnp.minimum(gap, headroom.astype(jnp.float32)), jnp.floor(rem / jnp.maximum(kmin, 1))).astype(jnp.int32)
+        d = jnp.where(kmin > 0, jnp.maximum(d, 0), 0)
+        inc = inc + jnp.where(is_min, d, 0)
+        rem = rem - d * kmin
+        return inc, rem
+
+    # each round consumes a level boundary or a cap: <= 2Z+2 events
+    inc, rem = jax.lax.fori_loop(0, 2 * Z + 2, body, (jnp.zeros((Z,), jnp.int32), c))
+    # remainder (< number of current-min zones) goes to lowest-index min zones
+    active = finite & (inc < capf)
+    cur = jnp.where(active, vf + inc.astype(jnp.float32), BIGF)
+    is_min = (cur == jnp.min(cur)) & active
+    pos = jnp.cumsum(is_min.astype(jnp.int32)) - 1
+    inc = inc + jnp.where(is_min & (pos < rem), 1, 0)
+    return jnp.where(finite, inc, 0)
+
+
+@partial(jax.jit, static_argnames=("zone_key", "n_existing", "n_slots"))
+def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key: int, n_existing: int, n_slots: int):
+    W, R = items.item_req.shape
+    N = n_slots
+    Nrows = t.row_alloc.shape[0]
+    G, Z = t.counts_zone_init.shape
+    Q = t.rank_zoneset.shape[0]
+
+    slot_basis0 = jnp.full((N,), -1, dtype=jnp.int32)
+    slot_rem0 = jnp.full((N, R), NEG)
+    slot_zoneset0 = jnp.zeros((N, Z), dtype=bool)
+    slot_rank0 = jnp.full((N,), -1, dtype=jnp.int32)
+    if n_existing:
+        idx = jnp.arange(n_existing, dtype=jnp.int32)
+        slot_basis0 = slot_basis0.at[:n_existing].set(idx)
+        slot_rem0 = slot_rem0.at[:n_existing].set(t.row_alloc[:n_existing])
+        slot_zoneset0 = slot_zoneset0.at[:n_existing].set(t.existing_zoneset[:n_existing])
+
+    is_offering_row = jnp.arange(Nrows) >= n_existing
+    zone_is_real = jnp.arange(Z) != NO_ZONE
+    rank_of_row = jnp.clip(t.row_pool_rank, 0, Q - 1)
+    slot_ids = jnp.arange(N, dtype=jnp.int32)
+
+    # item x row compatibility + row preference, one vectorized pass (W small)
+    compat_items = compat_matrix(t.row_labels, t.row_taint_class, items.item_mask, items.item_taint_ok, zone_key, batch_size=256)
+    choose_key_items = row_choose_key(t.row_alloc, t.row_pool_rank, items.item_req)
+
+    def step(state, i):
+        slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count = state
+        req = items.item_req[i]
+        za = items.item_zone_allowed[i]
+        mem = items.item_member[i]
+        c = items.item_count[i]
+        compat_rows = compat_items[i]
+        choose_key = choose_key_items[i]
+
+        zone_member_mask = mem & (t.group_kind == KIND_ZONE_SPREAD)
+        is_zm = jnp.any(zone_member_mask)
+        host_member_mask = mem & ((t.group_kind == KIND_HOST_SPREAD) | (t.group_kind == KIND_HOST_ANTI))
+
+        # per-slot host caps from member groups (anti: 1 iff untouched)
+        cap_from_group = jnp.where(
+            (t.group_kind == KIND_HOST_SPREAD)[:, None],
+            t.group_skew[:, None] - counts_host,
+            jnp.where((t.group_kind == KIND_HOST_ANTI)[:, None], (counts_host == 0).astype(jnp.int32), INF_I),
+        )  # [G, N]
+        host_cap = jnp.min(jnp.where(mem[:, None], cap_from_group, INF_I), axis=0)  # [N]
+        host_cap_new = jnp.min(
+            jnp.where(
+                mem,
+                jnp.where(t.group_kind == KIND_HOST_SPREAD, t.group_skew, jnp.where(t.group_kind == KIND_HOST_ANTI, 1, INF_I)),
+                INF_I,
+            )
+        )  # scalar: cap per freshly opened slot
+
+        slot_open = slot_basis >= 0
+        slot_compat = slot_open & compat_rows[jnp.clip(slot_basis, 0, Nrows - 1)]
+
+        fits_row = is_offering_row & compat_rows & jnp.all(req[None, :] <= t.row_alloc, axis=1)
+        row_cap = _int_cap(t.row_alloc, req)  # [Nrows]
+
+        # zone feasibility: pod-allowed, real-zone for members, per-group skew
+        zcounts = jnp.where(za[None, :] & zone_is_real[None, :], counts_zone, INF_I)
+        zmin = jnp.min(zcounts, axis=1)
+        zmin = jnp.where(zmin >= INF_I, 0, zmin)
+        per_group_zone_ok = (counts_zone + 1 - zmin[:, None]) <= t.group_skew[:, None]
+        spread_ok = jnp.all(jnp.where(zone_member_mask[:, None], per_group_zone_ok, True), axis=0)
+        zone_feasible = za & jnp.where(is_zm, zone_is_real & spread_ok, True)
+
+        # zone availability: a fitting template offers it, or a slot holds it
+        openable_z = jnp.any(fits_row[:, None] & t.rank_zoneset[rank_of_row], axis=0)  # [Z]
+
+        def place(cnt, elig_mask, za_for_new, commit_z, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count):
+            """Place `cnt` identical pods: prefix-sum first-fit over eligible
+            slots, then open new slots of the best row for the leftover.
+            commit_z >= 0 pins touched slots to that zone."""
+            cap_res = _int_cap(slot_rem, req)
+            cap_j = jnp.where(elig_mask, jnp.minimum(cap_res, host_cap), 0)
+            cap_j = jnp.clip(cap_j, 0, INF_I)
+            prefix = jnp.cumsum(cap_j) - cap_j
+            take = jnp.clip(cnt - prefix, 0, cap_j).astype(jnp.int32)
+            left = cnt - jnp.sum(take)
+
+            # leftover -> new slots of the single best row
+            rank_zone_ok = jnp.any(t.rank_zoneset & za_for_new[None, :], axis=1)
+            fr = fits_row & rank_zone_ok[rank_of_row]
+            o = jnp.argmin(jnp.where(fr, choose_key, BIGF)).astype(jnp.int32)
+            o_ok = fr[o]
+            cstar = jnp.minimum(row_cap[o], host_cap_new)
+            can_open = o_ok & (cstar >= 1)
+            m = jnp.where(can_open, -(-left // jnp.maximum(cstar, 1)), 0)
+            m = jnp.clip(m, 0, N - open_count)
+            is_new = (slot_ids >= open_count) & (slot_ids < open_count + m)
+            pos = slot_ids - open_count
+            new_take = jnp.where(is_new, jnp.clip(left - pos * cstar, 0, cstar), 0).astype(jnp.int32)
+            left = left - jnp.sum(new_take)
+
+            new_zs = t.rank_zoneset[rank_of_row[o]] & za_for_new  # [Z]
+            slot_basis = jnp.where(is_new, o, slot_basis)
+            slot_rank = jnp.where(is_new, t.row_pool_rank[o], slot_rank)
+            slot_rem = jnp.where(is_new[:, None], t.row_alloc[o][None, :], slot_rem)
+            slot_zoneset = jnp.where(is_new[:, None], new_zs[None, :], slot_zoneset)
+            open_count = open_count + m
+
+            take = take + new_take
+            touched = take > 0
+            # zone narrowing: commit to a single zone for members, intersect
+            # with the pod's allowed zones otherwise
+            commit_onehot = jnp.arange(Z) == commit_z
+            narrowed = jnp.where(commit_z >= 0, commit_onehot[None, :], za[None, :])
+            slot_zoneset = jnp.where(touched[:, None], slot_zoneset & narrowed, slot_zoneset)
+            slot_rem = slot_rem - take[:, None].astype(slot_rem.dtype) * req[None, :]
+            counts_host = counts_host + jnp.where(host_member_mask[:, None], take[None, :], 0)
+            return take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count
+
+        def simple_path(op):
+            slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count = op
+            elig = slot_compat & jnp.any(slot_zoneset & zone_feasible[None, :], axis=1)
+            take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count = place(
+                c, elig, zone_feasible, jnp.int32(-1), slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count
+            )
+            return take, left, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count)
+
+        def zone_path(op):
+            slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count = op
+            slotcap_z = jnp.any((slot_compat & (_int_cap(slot_rem, req) > 0))[:, None] & slot_zoneset, axis=0)
+            finite = zone_feasible & (openable_z | slotcap_z)
+            vsum = jnp.sum(jnp.where(zone_member_mask[:, None], counts_zone, 0), axis=0)  # [Z]
+            # skew cap: zones that are allowed but unavailable pin the global
+            # minimum, so no available zone may rise above frozen_min + skew —
+            # the per-pod feasibility check re-applied for every pod of the
+            # batch, not just the first (scheduler_model.py:199-205)
+            skew_star = jnp.min(jnp.where(zone_member_mask, t.group_skew, INF_I))
+            allowed_real = za & zone_is_real
+            frozen = allowed_real & ~finite
+            frozen_min = jnp.min(jnp.where(frozen, vsum, INF_I))
+            cap = jnp.clip(frozen_min + skew_star - vsum, 0, INF_I)
+            inc = _waterfill(vsum, finite, c, cap)
+            take_all = jnp.zeros((N,), jnp.int32)
+            pending = c - jnp.sum(inc)  # skew/availability-capped remainder
+            placed_z = jnp.zeros((Z,), jnp.int32)
+            for z in range(Z):  # Z is small and static; unrolled
+                cz = inc[z]
+                elig = slot_compat & slot_zoneset[:, z]
+                take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count = place(
+                    cz, elig, (jnp.arange(Z) == z), jnp.int32(z),
+                    slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count,
+                )
+                take_all = take_all + take
+                pending = pending + left
+                placed_z = placed_z.at[z].set(cz - left)
+            # redistribution: a zone whose slots ran dry strands its quota;
+            # offer the stranded pods to other zones with headroom, respecting
+            # the evolving skew bound (the sequential loop would have rotated
+            # them there naturally)
+            for z in range(Z):
+                vsum_u = vsum + placed_z
+                zmin_u = jnp.min(jnp.where(allowed_real, vsum_u, INF_I))
+                zmin_u = jnp.where(zmin_u >= INF_I, 0, zmin_u)
+                headroom = jnp.clip(zmin_u + skew_star - vsum_u[z], 0, INF_I)
+                cz = jnp.minimum(pending, jnp.where(finite[z], headroom, 0))
+                elig = slot_compat & slot_zoneset[:, z]
+                take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count = place(
+                    cz, elig, (jnp.arange(Z) == z), jnp.int32(z),
+                    slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count,
+                )
+                take_all = take_all + take
+                pending = pending - (cz - left)
+                placed_z = placed_z.at[z].add(cz - left)
+            counts_zone = counts_zone + jnp.where(zone_member_mask[:, None], placed_z[None, :], 0)
+            return take_all, pending, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count)
+
+        operand = (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count)
+        take, leftover, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count) = jax.lax.cond(
+            is_zm, zone_path, simple_path, operand
+        )
+
+        new_state = (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count)
+        return new_state, (take, leftover)
+
+    init = (
+        slot_basis0,
+        slot_rem0,
+        slot_zoneset0,
+        slot_rank0,
+        t.counts_zone_init,
+        t.counts_host_init,
+        jnp.int32(n_existing),
+    )
+    (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count), (takes, leftovers) = jax.lax.scan(
+        step, init, jnp.arange(W, dtype=jnp.int32)
+    )
+    return takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count
+
+
+def greedy_pack_grouped(t: SchedulerTensors, items: ItemTensors):
+    """Returns (takes [W, N], leftovers [W], slot_basis, slot_zoneset,
+    slot_rank, open_count)."""
+    return _greedy_pack_grouped_impl(t, items, t.zone_key, t.n_existing, t.n_slots)
+
+
+def assignment_from_takes(takes: np.ndarray, leftovers: np.ndarray, item_pods, n_pods: int) -> np.ndarray:
+    """Distribute each item's pods over its take vector (slot-index order);
+    leftover pods stay unassigned (-1). One vectorized repeat/assign per item
+    (items are few — unique signatures, not pods)."""
+    assignment = np.full(n_pods, -1, dtype=np.int64)
+    for w, pod_idxs in enumerate(item_pods):
+        nz = np.nonzero(takes[w])[0]
+        slots = np.repeat(nz, takes[w][nz])
+        k = min(len(slots), len(pod_idxs))
+        assignment[np.asarray(pod_idxs)[:k]] = slots[:k]
+    return assignment

@@ -19,7 +19,7 @@ from ..controllers.provisioning.scheduling.nodeclaim import (
     filter_instance_types,
 )
 from ..controllers.provisioning.scheduling.scheduler import Results
-from ..models.scheduler_model import greedy_pack, make_tensors
+from ..models.scheduler_model import make_tensors
 from ..scheduling.requirements import Operator, Requirement, Requirements
 from ..utils import resources as res
 from .encode import encode
@@ -61,15 +61,26 @@ class TPUSolver:
             self.last_backend = "ffd-fallback"
             return self.fallback.solve(snap)
 
-        # cap the slot axis for O(P * n_slots) scan cost; retry uncapped on the
-        # rare overflow (every slot opened AND pods left unplaced)
+        # signature-grouped pack: device steps scale with UNIQUE pod shapes,
+        # not pods (scheduler_model_grouped.py). Slot axis capped; retry
+        # uncapped on the rare overflow (every slot opened AND pods unplaced).
+        from ..models.scheduler_model_grouped import (
+            assignment_from_takes,
+            build_items,
+            greedy_pack_grouped,
+            make_item_tensors,
+        )
+
+        item_arrays, item_pods = build_items(enc)
+        items = make_item_tensors(item_arrays)
         cap = enc.n_existing + min(enc.n_pods, 4096)
         t = make_tensors(enc, n_slots=cap)
-        assignment, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack(t)
-        if int(open_count) == cap and bool((np.asarray(assignment) < 0).any()) and cap < enc.n_existing + enc.n_pods:
+        takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack_grouped(t, items)
+        if int(open_count) == cap and int(np.asarray(leftovers).sum()) > 0 and cap < enc.n_existing + enc.n_pods:
             t = make_tensors(enc)
-            assignment, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack(t)
-        return self._decode(snap, enc, np.asarray(assignment), np.asarray(slot_basis), np.asarray(slot_zoneset))
+            takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack_grouped(t, items)
+        assignment = assignment_from_takes(np.asarray(takes), np.asarray(leftovers), item_pods, enc.n_pods)
+        return self._decode(snap, enc, assignment, np.asarray(slot_basis), np.asarray(slot_zoneset))
 
     # -- decode ----------------------------------------------------------------
     def _decode(self, snap: SolverSnapshot, enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zoneset: np.ndarray) -> Results:

@@ -84,6 +84,50 @@ def compare_backends(pods, node_pools=None, cost_tol=1.001):
     return tpu_results, ffd_results
 
 
+class TestGroupedZonePath:
+    def test_skew_respected_when_zone_unavailable(self):
+        # templates only offer zone-a; a spread pod batch allowed in a AND b
+        # must not pile into a beyond maxSkew while b stays at zero
+        types = [catalog.make_instance_type("c", cpu, zones=["test-zone-a"]) for cpu in (4, 16)]
+        sel = {"matchLabels": {"app": "s"}}
+        pods = [
+            make_pod(
+                cpu="100m",
+                labels={"app": "s"},
+                tsc=[zone_spread(selector=sel)],
+                node_selector=None,
+            )
+            for _ in range(10)
+        ]
+        snap = make_snapshot(pods, types=types)
+        tpu = TPUSolver(force=True)
+        results = tpu.solve(snap)
+        assert tpu.last_backend == "tpu"
+        violations = validate_results(make_snapshot(pods, types=types), results)
+        assert not violations, violations
+        # FFD parity: with one zone available and maxSkew=1 relative to the
+        # other allowed-but-unavailable zones... the reference counts only
+        # domains that exist (a single known domain schedules freely)
+        ffd = FFDSolver().solve(make_snapshot(pods, types=types))
+        assert set(results.pod_errors) == set(ffd.pod_errors)
+
+    def test_stranded_zone_quota_redistributes(self):
+        # large skew: water-fill splits across zones, but only some zones can
+        # actually open nodes — the stranded share must land elsewhere
+        types = [catalog.make_instance_type("c", cpu, zones=["test-zone-b"]) for cpu in (4, 16)]
+        sel = {"matchLabels": {"app": "s"}}
+        pods = [
+            make_pod(cpu="100m", labels={"app": "s"}, tsc=[zone_spread(max_skew=50, selector=sel)])
+            for _ in range(20)
+        ]
+        snap = make_snapshot(pods, types=types)
+        tpu = TPUSolver(force=True)
+        results = tpu.solve(snap)
+        ffd = FFDSolver().solve(make_snapshot(pods, types=types))
+        assert set(results.pod_errors) == set(ffd.pod_errors), (results.pod_errors, ffd.pod_errors)
+        assert not validate_results(make_snapshot(pods, types=types), results)
+
+
 class TestTPUEquivalence:
     def test_single_pod(self):
         tpu, ffd = compare_backends([make_pod(cpu="1")])
