@@ -1,0 +1,58 @@
+"""The KWOK operator binary (reference: kwok/main.go:29-51).
+
+Boots the full control plane against the in-process store with the embedded
+KWOK instance-type catalog, serves health/metrics endpoints, and runs the
+leader-elected reconcile loop on the wall clock until interrupted:
+
+    python -m karpenter_tpu [--solver tpu] [--port 8080]
+
+Options also come from the environment (operator/options.py from_env):
+FEATURE_GATES, SOLVER_BACKEND, BATCH_*_DURATION, PREFERENCE_POLICY, ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from .operator import Environment
+from .operator.options import Options
+from .operator.server import OperatorServer
+from .utils.clock import Clock
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="karpenter-tpu")
+    parser.add_argument("--solver", choices=("ffd", "tpu"), default=None, help="solver backend (SOLVER_BACKEND)")
+    parser.add_argument("--port", type=int, default=8080, help="health + metrics port (0 = ephemeral)")
+    parser.add_argument("--bind", default="0.0.0.0", help="health + metrics bind address")
+    parser.add_argument("--tick-seconds", type=float, default=1.0, help="controller round interval")
+    parser.add_argument("--disable-leader-election", action="store_true")
+    parser.add_argument("--enable-profiling", action="store_true", help="expose /debug/profile")
+    args = parser.parse_args(argv)
+
+    options = Options.from_env()
+    if args.solver:
+        options.solver_backend = args.solver
+
+    env = Environment(options=options, clock=Clock())
+    server = OperatorServer(env, port=args.port, enable_profiling=args.enable_profiling, bind=args.bind)
+    port = server.start()
+    print(f"karpenter-tpu operator up: solver={options.solver_backend} http={args.bind}:{port}", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # not the main thread
+    try:
+        env.run(stop_event=stop, tick_seconds=args.tick_seconds, leader_election=not args.disable_leader_election)
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

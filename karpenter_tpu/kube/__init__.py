@@ -16,6 +16,7 @@ from .objects import (  # noqa: F401
     Deployment,
     Device,
     DeviceClass,
+    Lease,
     NodeAffinity,
     Node,
     NodeSpec,

@@ -365,6 +365,19 @@ class PodDisruptionBudget:
 
 
 @dataclass
+class Lease:
+    """coordinationv1.Lease — the leader-election lock object."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+    kind: str = "Lease"
+
+
+@dataclass
 class PriorityClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     value: int = 0

@@ -218,3 +218,35 @@ class Environment:
             if isinstance(self.clock, FakeClock):
                 self.clock.step(step_seconds)
             self.tick(provision_force=True)
+
+    # -- wall-clock operation (operator.Start + manager run loop) --------------
+    def run(self, stop_event=None, tick_seconds: float = 1.0, leader_election: bool = True, identity: str = "") -> None:
+        """The standby-capable run loop: informers are live from construction
+        (controller warmup, operator.go:196-201); controller rounds execute
+        only while holding the leader lease, which a background thread renews
+        every retry_period so a long reconcile round can't starve the lease
+        into a spurious takeover. Blocks until stop_event is set."""
+        import threading as _threading
+        import uuid as _uuid
+
+        from .leaderelection import LeaderElector
+
+        if isinstance(self.clock, FakeClock):
+            raise ValueError("Environment.run drives wall-clock time; construct with clock=Clock() (FakeClock never advances here)")
+        stop_event = stop_event or _threading.Event()
+        elector = None
+        renewer = None
+        if leader_election:
+            elector = LeaderElector(self.store, self.clock, identity or f"karpenter-{_uuid.uuid4().hex[:8]}")
+            renewer = _threading.Thread(target=elector.renew_loop, args=(stop_event,), daemon=True)
+            renewer.start()
+        try:
+            while not stop_event.is_set():
+                if elector is None or elector.is_leader():
+                    self.tick()
+                stop_event.wait(tick_seconds)
+        finally:
+            if elector is not None:
+                if renewer is not None:
+                    renewer.join(timeout=5)
+                elector.release()

@@ -1,0 +1,71 @@
+"""Operator HTTP surface: health probes + Prometheus metrics (+ profiles).
+
+Reference: operator.go:203-219 — metrics server on --metrics-port, healthz/
+readyz probes on --health-probe-port, pprof handlers behind
+--enable-profiling. Here one threaded stdlib server carries all routes:
+/healthz, /readyz, /metrics, and /debug/profile (a py-spy-less stand-in that
+dumps running thread stacks, the diagnostic the reference's pprof routes
+serve in e2e debugging — karpenter_profiler.go:40-56).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class OperatorServer:
+    def __init__(self, env, port: int = 8080, enable_profiling: bool = False, bind: str = "0.0.0.0"):
+        self.env = env
+        self.port = port
+        self.bind = bind  # probes/scrapes come from off-host (operator.go:180-183)
+        self.enable_profiling = enable_profiling
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> int:
+        env = self.env
+        enable_profiling = self.enable_profiling
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: str, ctype: str = "text/plain; charset=utf-8"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, "ok")
+                elif self.path == "/readyz":
+                    ready = env.cluster.synced()
+                    self._send(200 if ready else 503, "ok" if ready else "cluster state not synced")
+                elif self.path == "/metrics":
+                    self._send(200, env.registry.expose(), "text/plain; version=0.0.4")
+                elif self.path == "/debug/profile" and enable_profiling:
+                    frames = {}
+                    for tid, frame in sys._current_frames().items():
+                        frames[str(tid)] = traceback.format_stack(frame)
+                    self._send(200, json.dumps(frames, indent=1), "application/json")
+                else:
+                    self._send(404, "not found")
+
+        self._httpd = ThreadingHTTPServer((self.bind, self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,160 @@
+"""Operator runtime specs: leader election, health/metrics endpoints, run
+loop (reference: operator.go:126-252)."""
+
+import threading
+import time
+import urllib.request
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.kube import Store
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.leaderelection import LeaderElector
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.operator.server import OperatorServer
+from karpenter_tpu.utils.clock import Clock, FakeClock
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+class TestLeaderElection:
+    def test_single_instance_acquires(self):
+        store, clock = Store(), FakeClock()
+        a = LeaderElector(store, clock, "a")
+        assert a.try_acquire_or_renew()
+        assert a.is_leader()
+
+    def test_standby_waits_then_takes_over(self):
+        store, clock = Store(), FakeClock()
+        a = LeaderElector(store, clock, "a")
+        b = LeaderElector(store, clock, "b")
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()  # active/standby
+        clock.step(5)
+        assert a.try_acquire_or_renew()  # renew keeps the lease
+        assert not b.try_acquire_or_renew()
+        clock.step(16)  # a stops renewing; lease lapses
+        assert b.try_acquire_or_renew()
+        assert b.is_leader()
+        # a discovers it lost on its next renewal attempt
+        assert not a.try_acquire_or_renew()
+        lease = store.get("Lease", "karpenter-leader-election", "kube-system")
+        assert lease.holder_identity == "b"
+        assert lease.lease_transitions == 1
+
+    def test_stale_leader_stops_acting_after_renew_deadline(self):
+        # a leader whose renewals stopped must consider itself demoted before
+        # a standby could legitimately take the lapsed lease
+        store, clock = Store(), FakeClock()
+        a = LeaderElector(store, clock, "a")
+        assert a.try_acquire_or_renew() and a.is_leader()
+        clock.step(11)  # > renew_deadline (10s), < takeover not needed
+        assert not a.is_leader()
+        assert a.try_acquire_or_renew() and a.is_leader()  # renewing restores
+
+    def test_release_by_stale_loser_does_not_touch_lease(self):
+        store, clock = Store(), FakeClock()
+        a = LeaderElector(store, clock, "a")
+        b = LeaderElector(store, clock, "b")
+        assert a.try_acquire_or_renew()
+        clock.step(16)
+        assert b.try_acquire_or_renew()
+        rv_before = store.get("Lease", "karpenter-leader-election", "kube-system").metadata.resource_version
+        a.release()  # a never observed the loss; must not write
+        lease = store.get("Lease", "karpenter-leader-election", "kube-system")
+        assert lease.holder_identity == "b"
+        assert lease.metadata.resource_version == rv_before
+
+    def test_release_enables_fast_failover(self):
+        store, clock = Store(), FakeClock()
+        a = LeaderElector(store, clock, "a")
+        b = LeaderElector(store, clock, "b")
+        assert a.try_acquire_or_renew()
+        a.release()
+        clock.step(16)  # released lease reads as lapsed immediately
+        assert b.try_acquire_or_renew()
+
+
+class TestOperatorServer:
+    def _get(self, port, path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.read().decode()
+
+    def test_healthz_readyz_metrics(self):
+        env = Environment(options=Options())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        server = OperatorServer(env, port=0)
+        port = server.start()
+        try:
+            code, body = self._get(port, "/healthz")
+            assert code == 200 and body == "ok"
+            code, _ = self._get(port, "/readyz")
+            assert code == 200  # empty cluster state is synced
+            env.store.create(make_pod(cpu="1"))
+            env.settle()
+            code, body = self._get(port, "/metrics")
+            assert code == 200
+            assert "karpenter_nodeclaims_created_total" in body
+        finally:
+            server.stop()
+
+    def test_profiling_gated(self):
+        env = Environment(options=Options())
+        server = OperatorServer(env, port=0, enable_profiling=False)
+        port = server.start()
+        try:
+            import urllib.error
+
+            try:
+                code, _ = self._get(port, "/debug/profile")
+            except urllib.error.HTTPError as e:
+                code = e.code
+            assert code == 404
+        finally:
+            server.stop()
+
+
+class TestRunLoop:
+    def test_run_loop_provisions_on_wall_clock(self):
+        env = Environment(options=Options(batch_idle_duration=0.05, batch_max_duration=0.2), clock=Clock())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        env.store.create(make_pod(cpu="1"))
+        stop = threading.Event()
+        t = threading.Thread(target=env.run, kwargs={"stop_event": stop, "tick_seconds": 0.05})
+        t.start()
+        try:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                pods = env.store.list("Pod")
+                if pods and pods[0].spec.node_name:
+                    break
+                time.sleep(0.1)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert env.store.list("Pod")[0].spec.node_name != ""
+        # run() released the lease on shutdown
+        lease = env.store.get("Lease", "karpenter-leader-election", "kube-system")
+        assert lease.holder_identity == ""
+
+    def test_standby_does_not_reconcile(self):
+        env = Environment(options=Options(batch_idle_duration=0.05, batch_max_duration=0.2), clock=Clock())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        # another instance holds the lease and keeps renewing
+        holder = LeaderElector(env.store, env.clock, "other")
+        assert holder.try_acquire_or_renew()
+        env.store.create(make_pod(cpu="1"))
+        stop = threading.Event()
+        t = threading.Thread(target=env.run, kwargs={"stop_event": stop, "tick_seconds": 0.05})
+        t.start()
+        try:
+            for _ in range(8):
+                holder.try_acquire_or_renew()
+                time.sleep(0.1)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert env.store.count("NodeClaim") == 0  # standby stayed passive
