@@ -27,6 +27,35 @@ MAX_INSTANCE_TYPES = 600
 
 _hostname_seq = itertools.count(1)
 
+# native requirements-intersection tables, one per NodeClaimTemplate per solve
+# (weak-keyed so solves don't leak tables; falls back to the Python algebra
+# when the C++ kernel isn't available — karpenter_tpu/native)
+import weakref
+
+_native_tables: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+# Below this many instance types the Python set algebra's short-circuiting
+# beats the per-call ctypes query lowering (measured ~0.8x at 36 rows, ~1.0x
+# at 500 simple rows, 15x isolated on requirement-heavy tables)
+NATIVE_MIN_TABLE_ROWS = 200
+
+
+def _native_table_for(template):
+    from ....native import ReqTable, UnsupportedRequirements, available
+
+    its = template.instance_type_options
+    if len(its) < NATIVE_MIN_TABLE_ROWS or not available():
+        return None
+    cached = _native_tables.get(template)
+    if cached is None:
+        try:
+            cached = (ReqTable([it.requirements for it in its]), {id(it): i for i, it in enumerate(its)})
+        except UnsupportedRequirements:
+            cached = (None, None)  # e.g. >int64 integer values; stay on Python
+        _native_tables[template] = cached
+    return cached if cached[0] is not None else None
+
 
 @dataclass
 class DaemonOverheadGroup:
@@ -139,7 +168,8 @@ class SchedulingNodeClaim:
 
         requests = res.merge(self.spec_requests, pod_data.requests)
         remaining, unsatisfiable, ferr = filter_instance_types(
-            self.instance_type_options, claim_reqs, pod, pod_data.requests, self.daemon_overhead_groups, requests, relax_min_values
+            self.instance_type_options, claim_reqs, pod, pod_data.requests, self.daemon_overhead_groups, requests, relax_min_values,
+            native=_native_table_for(self.template),
         )
         if relax_min_values:
             for key, mv in unsatisfiable.items():
@@ -303,13 +333,26 @@ def filter_instance_types(
     daemon_overhead_groups: list[DaemonOverheadGroup],
     total_requests: dict[str, Quantity],
     relax_min_values: bool = False,
+    native=None,
 ) -> tuple[Optional[list[InstanceType]], dict[str, int], Optional[str]]:
     """compat x fits x offering filter per daemon-overhead group
-    (nodeclaim.go:541-640). Returns (remaining, unsatisfiable_min_values, err)."""
+    (nodeclaim.go:541-640). Returns (remaining, unsatisfiable_min_values, err).
+    `native` is an optional (ReqTable, rowmap) that answers the per-type
+    intersects check in one C call for the whole table."""
     remaining: list[InstanceType] = []
     ports = pod_host_ports(pod)
     eligible = {id(it) for it in instance_types}
     any_compat = any_fits = any_offering = False
+
+    native_mask = native_rows = None
+    if native is not None:
+        from ....native import UnsupportedRequirements
+
+        table, native_rows = native
+        try:
+            native_mask = table.filter(requirements)
+        except UnsupportedRequirements:
+            native_mask = None  # query carries >int64 integers; Python path
 
     for group in daemon_overhead_groups:
         if group.host_port_usage.conflicts(pod.key(), ports) is not None:
@@ -318,7 +361,10 @@ def filter_instance_types(
         for it in group.instance_types:
             if id(it) not in eligible:
                 continue
-            compat = it.requirements.intersects(requirements) is None
+            if native_mask is not None and id(it) in native_rows:
+                compat = native_mask[native_rows[id(it)]] == 1
+            else:
+                compat = it.requirements.intersects(requirements) is None
             fits, has_offering = _fits_and_offering(it, total, requirements)
             any_compat |= compat
             any_fits |= fits

@@ -1,0 +1,198 @@
+"""Native (C++) runtime kernels with build-on-import and Python fallback.
+
+The TPU owns the solve; the host control plane's hottest pure-Python loop is
+the per-(pod x instance-type) Requirements.intersects check inside
+filter_instance_types. `reqkernel.cpp` evaluates it over the whole
+instance-type table in one C call. The shared library is compiled with g++ on
+first import (cached by source hash next to the package); any failure —
+missing compiler, readonly filesystem — degrades to the Python algebra, which
+remains the semantics oracle (tests/test_native.py fuzzes parity).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "reqkernel.cpp")
+
+_lib = None
+_load_error: str | None = None
+
+NO_BOUND = -(2**63)
+
+
+def _build() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.environ.get("KARPENTER_NATIVE_CACHE") or os.path.join(tempfile.gettempdir(), "karpenter_tpu_native")
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, f"reqkernel-{digest}.so")
+    if not os.path.exists(so_path):
+        tmp = f"{so_path}.{os.getpid()}.tmp"
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, so_path)
+    return so_path
+
+
+def _load():
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return _lib
+    if os.environ.get("KARPENTER_DISABLE_NATIVE"):
+        _load_error = "disabled via KARPENTER_DISABLE_NATIVE"
+        return None
+    try:
+        lib = ctypes.CDLL(_build())
+    except Exception as e:  # g++ missing, sandboxed tmp, bad toolchain...
+        _load_error = f"{type(e).__name__}: {e}"
+        return None
+    lib.rk_new.restype = ctypes.c_void_p
+    lib.rk_free.argtypes = [ctypes.c_void_p]
+    lib.rk_add_row.argtypes = [ctypes.c_void_p]
+    lib.rk_add_row.restype = ctypes.c_int32
+    lib.rk_row_add_req.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint8, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+    ]
+    lib.rk_filter.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def load_error() -> str | None:
+    _load()
+    return _load_error
+
+
+I64_MIN, I64_MAX = -(2**63) + 1, 2**63 - 1  # NO_BOUND reserves -(2**63)
+
+
+class UnsupportedRequirements(Exception):
+    """A value or bound exceeds int64 — the kernel would silently wrap, so
+    the caller must stay on the arbitrary-precision Python algebra."""
+
+
+def _num(value: str):
+    try:
+        n = int(value)
+    except (TypeError, ValueError):
+        return 0, 0
+    if not (I64_MIN <= n <= I64_MAX):
+        raise UnsupportedRequirements(f"integer value {value} exceeds int64")
+    return n, 1
+
+
+def _bound(b):
+    if b is None:
+        return NO_BOUND
+    if not (I64_MIN <= b <= I64_MAX):
+        raise UnsupportedRequirements(f"bound {b} exceeds int64")
+    return b
+
+
+class ReqTable:
+    """An interned table of Requirements rows + one-call intersect filter."""
+
+    def __init__(self, rows):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native kernel unavailable: {_load_error}")
+        self._lib = lib
+        self._keys: dict[str, int] = {}
+        self._vals: dict[tuple[str, str], int] = {}
+        self._handle = ctypes.c_void_p(lib.rk_new())
+        self.n_rows = len(rows)
+        for reqs in rows:
+            row = lib.rk_add_row(self._handle)
+            for key, r in sorted(reqs.items(), key=lambda kv: self._key_id(kv[0])):
+                ids, nums, has = self._lower_values(key, r.values)
+                lib.rk_row_add_req(
+                    self._handle, row, self._key_id(key), 1 if r.complement else 0,
+                    _bound(r.gte), _bound(r.lte),
+                    ids, nums, has, len(r.values),
+                )
+
+    def _key_id(self, key: str) -> int:
+        kid = self._keys.get(key)
+        if kid is None:
+            kid = len(self._keys)
+            self._keys[key] = kid
+        return kid
+
+    def _lower_values(self, key: str, values):
+        entries = []
+        for v in values:
+            vid = self._vals.get((key, v))
+            if vid is None:
+                vid = len(self._vals)
+                self._vals[(key, v)] = vid
+            n, h = _num(v)
+            entries.append((vid, n, h))
+        entries.sort()
+        ids = (ctypes.c_int32 * len(entries))(*[e[0] for e in entries])
+        nums = (ctypes.c_int64 * len(entries))(*[e[1] for e in entries])
+        has = (ctypes.c_uint8 * len(entries))(*[e[2] for e in entries])
+        return ids, nums, has
+
+    def filter(self, query) -> bytes:
+        """out[row] == 1 iff rows[row].intersects(query) is None."""
+        items = sorted(query.items(), key=lambda kv: self._key_id(kv[0]))
+        nq = len(items)
+        keys = (ctypes.c_int32 * nq)()
+        comp = (ctypes.c_uint8 * nq)()
+        gte = (ctypes.c_int64 * nq)()
+        lte = (ctypes.c_int64 * nq)()
+        off = (ctypes.c_int32 * nq)()
+        vlen = (ctypes.c_int32 * nq)()
+        pool: list[tuple[int, int, int]] = []
+        for i, (key, r) in enumerate(items):
+            keys[i] = self._key_id(key)
+            comp[i] = 1 if r.complement else 0
+            gte[i] = _bound(r.gte)
+            lte[i] = _bound(r.lte)
+            off[i] = len(pool)
+            entries = []
+            for v in r.values:
+                vid = self._vals.get((key, v))
+                if vid is None:
+                    vid = len(self._vals)
+                    self._vals[(key, v)] = vid
+                n, h = _num(v)
+                entries.append((vid, n, h))
+            entries.sort()
+            pool.extend(entries)
+            vlen[i] = len(entries)
+        np_ = len(pool)
+        pool_ids = (ctypes.c_int32 * max(np_, 1))(*[e[0] for e in pool])
+        pool_nums = (ctypes.c_int64 * max(np_, 1))(*[e[1] for e in pool])
+        pool_has = (ctypes.c_uint8 * max(np_, 1))(*[e[2] for e in pool])
+        out = (ctypes.c_uint8 * max(self.n_rows, 1))()
+        self._lib.rk_filter(self._handle, keys, comp, gte, lte, off, vlen, nq, pool_ids, pool_nums, pool_has, out)
+        return bytes(out[: self.n_rows])
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.rk_free(self._handle)
+        except Exception:
+            pass

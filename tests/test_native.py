@@ -1,0 +1,125 @@
+"""Native requirements kernel: exact-parity fuzz vs the Python algebra."""
+
+import random
+
+import pytest
+
+from karpenter_tpu import native
+from karpenter_tpu.scheduling.requirements import Requirement, Requirements
+
+pytestmark = pytest.mark.skipif(not native.available(), reason=f"native kernel unavailable: {native.load_error()}")
+
+KEYS = ["zone", "arch", "size", "cpu", "custom/a", "custom/b"]
+VALUES = ["a", "b", "c", "1", "2", "16", "999", "x"]
+OPS = ["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt", "Gte", "Lte"]
+
+
+def random_requirements(rng, max_reqs=4) -> Requirements:
+    reqs = Requirements()
+    for key in rng.sample(KEYS, rng.randrange(1, max_reqs + 1)):
+        op = rng.choice(OPS)
+        if op in ("Gt", "Lt", "Gte", "Lte"):
+            vals = [str(rng.randrange(0, 50))]
+        elif op in ("Exists", "DoesNotExist"):
+            vals = []
+        else:
+            vals = rng.sample(VALUES, rng.randrange(1, 4))
+        reqs.add(Requirement(key, op, vals))
+    return reqs
+
+
+class TestParity:
+    def test_fuzz_matches_python_intersects(self):
+        rng = random.Random(1234)
+        rows = [random_requirements(rng) for _ in range(200)]
+        table = native.ReqTable(rows)
+        for _ in range(100):
+            query = random_requirements(rng)
+            mask = table.filter(query)
+            for i, row in enumerate(rows):
+                expected = row.intersects(query) is None
+                assert bool(mask[i]) == expected, (
+                    f"row {i}: native={bool(mask[i])} python={expected}\nrow={row}\nquery={query}"
+                )
+
+    def test_catalog_vs_pod_requirements(self):
+        from karpenter_tpu.apis import labels as wk
+        from karpenter_tpu.cloudprovider import catalog
+
+        its = catalog.construct_instance_types()
+        table = native.ReqTable([it.requirements for it in its])
+        query = Requirements()
+        query.add(Requirement(wk.ARCH_LABEL_KEY, "In", ["amd64"]))
+        query.add(Requirement(wk.OS_LABEL_KEY, "In", ["linux"]))
+        query.add(Requirement(catalog.INSTANCE_CPU_LABEL_KEY, "Gt", ["8"]))
+        mask = table.filter(query)
+        for i, it in enumerate(its):
+            assert bool(mask[i]) == (it.requirements.intersects(query) is None), it.name
+
+    def test_unseen_query_values(self):
+        rows = [Requirements()]
+        rows[0].add(Requirement("zone", "In", ["a", "b"]))
+        table = native.ReqTable(rows)
+        q = Requirements()
+        q.add(Requirement("zone", "In", ["never-interned"]))
+        assert table.filter(q) == b"\x00"
+        q2 = Requirements()
+        q2.add(Requirement("zone", "NotIn", ["never-interned"]))
+        assert table.filter(q2) == b"\x01"
+
+    def test_two_negatives_never_conflict(self):
+        rows = [Requirements()]
+        rows[0].add(Requirement("k", "NotIn", ["x"]))
+        # Gt MaxInt canonicalizes to an empty In (matches nothing) but is
+        # still non-negative; a DoesNotExist query against NotIn passes
+        table = native.ReqTable(rows)
+        q = Requirements()
+        q.add(Requirement("k", "DoesNotExist"))
+        assert table.filter(q) == b"\x01"
+
+
+class TestSchedulerUsesNative:
+    def test_ffd_solve_matches_with_and_without_native(self):
+        import os
+        import subprocess
+        import sys
+
+        script = r"""
+import sys; sys.path.insert(0, "/root/repo"); sys.path.insert(0, "/root/repo/tests")
+import random
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.kube import Store
+from karpenter_tpu.solver import FFDSolver, SolverSnapshot
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+LINUX = [{"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+         {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]}]
+store, clock = Store(), FakeClock()
+cluster = Cluster(store, clock); start_informers(store, cluster)
+np_ = make_nodepool(requirements=LINUX); store.create(np_)
+rng = random.Random(5)
+pods = [make_pod(cpu=rng.choice(["500m","1","2"]), memory="1Gi",
+                 node_selector={wk.ZONE_LABEL_KEY: rng.choice(catalog.ZONES)} if rng.random() < 0.3 else None)
+        for _ in range(120)]
+for i, p in enumerate(pods):
+    p.metadata.uid = f"uid-{i:04d}"  # deterministic FFD tie-breaks across processes
+from karpenter_tpu.cloudprovider.fake import instance_types_assorted
+types = instance_types_assorted(400)  # above NATIVE_MIN_TABLE_ROWS so the kernel engages
+snap = SolverSnapshot(store=store, cluster=cluster, node_pools=[np_],
+    instance_types={np_.metadata.name: types},
+    state_nodes=[], daemonset_pods=[], pods=pods, clock=clock)
+r = FFDSolver().solve(snap)
+assert r.all_pods_scheduled()
+print(len(r.new_node_claims), sorted(len(nc.pods) for nc in r.new_node_claims))
+"""
+        outs = []
+        for disable in ("", "1"):
+            env = dict(os.environ, JAX_PLATFORMS="cpu", KARPENTER_DISABLE_NATIVE=disable)
+            p = subprocess.run([sys.executable, "-c", script], env=env, capture_output=True, text=True, timeout=300)
+            assert p.returncode == 0, p.stdout + p.stderr
+            outs.append(p.stdout.strip().splitlines()[-1])
+        assert outs[0] == outs[1], f"native={outs[0]} python={outs[1]}"
