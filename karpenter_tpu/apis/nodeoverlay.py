@@ -10,6 +10,7 @@ reverse-alphabetical name tiebreak (nodeoverlay.go:126-140).
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
 
 from ..kube.objects import ObjectMeta
@@ -64,7 +65,7 @@ class NodeOverlay:
         if self.spec.price is not None and not _is_decimal(self.spec.price):
             errs.append(f"invalid price {self.spec.price!r}, must be a non-negative decimal")
         if self.spec.price_adjustment is not None:
-            adj = self.spec.price_adjustment.strip()
+            adj = self.spec.price_adjustment
             body = adj[:-1] if adj.endswith("%") else adj
             if not (body.startswith(("+", "-")) and _is_decimal(body[1:])):
                 errs.append(f"invalid priceAdjustment {self.spec.price_adjustment!r}, must be signed decimal or percentage")
@@ -88,13 +89,10 @@ class NodeOverlay:
 
 
 def _is_decimal(s: str) -> bool:
-    s = s.strip()
-    if not s or not s[0].isdigit():  # no sign prefix, matching the CRD pattern
-        return False
-    try:
-        return float(s) >= 0.0
-    except ValueError:
-        return False
+    # exact CRD CEL pattern (nodeoverlay.go:70,80): ASCII digits with an
+    # optional fractional part, no surrounding whitespace — float() parsing
+    # would admit "1e5", "1_000", "1.", " 1 ", and Unicode digits
+    return re.fullmatch(r"[0-9]+(\.[0-9]+)?", s) is not None
 
 
 def order_by_weight(overlays: list[NodeOverlay]) -> list[NodeOverlay]:

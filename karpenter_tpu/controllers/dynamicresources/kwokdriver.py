@@ -10,6 +10,7 @@ nodes are garbage-collected.
 from __future__ import annotations
 
 import copy
+import hashlib
 from dataclasses import dataclass, field
 
 from ...apis import labels as wk
@@ -40,20 +41,31 @@ class DRAKwokDriver:
             if n.metadata.labels.get(wk.NODE_REGISTERED_LABEL_KEY) == "true"
             and n.metadata.deletion_timestamp is None
         ]
-        want: dict[str, tuple] = {}
+        # key on the (node, config) PAIR, not the joined name: names built as
+        # f"{node}-{config}" collide across distinct pairs when the parts
+        # contain dashes ("a-b"+"c" vs "a"+"b-c"); the pair rides in labels
+        # and a short digest keeps the object name unique
+        want: dict[tuple[str, str], tuple] = {}
         for cfg in configs:
             for node in nodes:
                 if cfg.node_selector is not None and not match_label_selector(cfg.node_selector, node.metadata.labels):
                     continue
-                name = f"{node.metadata.name}-{cfg.metadata.name}"
-                want[name] = (cfg, node)
-        have = {sl.metadata.name: sl for sl in self.store.list("ResourceSlice") if sl.metadata.labels.get("dra.karpenter.sh/config")}
-        for name, (cfg, node) in want.items():
-            existing = have.get(name)
+                want[(node.metadata.name, cfg.metadata.name)] = (cfg, node)
+        have: dict[tuple[str, str], ResourceSlice] = {}
+        for sl in self.store.list("ResourceSlice"):
+            cfg_name = sl.metadata.labels.get("dra.karpenter.sh/config")
+            if cfg_name:
+                have[(sl.metadata.labels.get("dra.karpenter.sh/node", sl.node_name), cfg_name)] = sl
+        for (node_name, cfg_name), (cfg, node) in want.items():
+            existing = have.get((node_name, cfg_name))
             if existing is None:
+                digest = hashlib.sha1(f"{node_name}\x00{cfg_name}".encode()).hexdigest()[:8]
                 self.store.create(
                     ResourceSlice(
-                        metadata=ObjectMeta(name=name, labels={"dra.karpenter.sh/config": cfg.metadata.name}),
+                        metadata=ObjectMeta(
+                            name=f"{node_name}-{cfg_name}-{digest}",
+                            labels={"dra.karpenter.sh/config": cfg_name, "dra.karpenter.sh/node": node_name},
+                        ),
                         driver=cfg.driver,
                         pool_name=node.metadata.name,
                         node_name=node.metadata.name,
@@ -68,7 +80,7 @@ class DRAKwokDriver:
                     sl.devices = copy.deepcopy(cfg.devices)
                     sl.pool_generation += 1
 
-                self.store.patch("ResourceSlice", name, apply)
-        for name in have:
-            if name not in want:
-                self.store.try_delete("ResourceSlice", name)
+                self.store.patch("ResourceSlice", existing.metadata.name, apply)
+        for key, sl in have.items():
+            if key not in want:
+                self.store.try_delete("ResourceSlice", sl.metadata.name)

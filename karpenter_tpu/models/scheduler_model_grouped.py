@@ -198,13 +198,18 @@ def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key:
         is_zm = jnp.any(zone_member_mask)
         host_member_mask = mem & ((t.group_kind == KIND_HOST_SPREAD) | (t.group_kind == KIND_HOST_ANTI))
 
-        # per-slot host caps from member groups (anti: 1 iff untouched)
-        cap_from_group = jnp.where(
-            (t.group_kind == KIND_HOST_SPREAD)[:, None],
-            t.group_skew[:, None] - counts_host,
-            jnp.where((t.group_kind == KIND_HOST_ANTI)[:, None], (counts_host == 0).astype(jnp.int32), INF_I),
-        )  # [G, N]
-        host_cap = jnp.min(jnp.where(mem[:, None], cap_from_group, INF_I), axis=0)  # [N]
+        def member_host_cap(counts_host_now):
+            """Per-slot host caps from member groups (anti: 1 iff untouched),
+            derived from the CURRENT threaded counts — place() is called up to
+            2Z times per step and earlier calls move counts_host, so the cap
+            must be recomputed per call, not closed over at step entry."""
+            cap_from_group = jnp.where(
+                (t.group_kind == KIND_HOST_SPREAD)[:, None],
+                t.group_skew[:, None] - counts_host_now,
+                jnp.where((t.group_kind == KIND_HOST_ANTI)[:, None], (counts_host_now == 0).astype(jnp.int32), INF_I),
+            )  # [G, N]
+            return jnp.min(jnp.where(mem[:, None], cap_from_group, INF_I), axis=0)  # [N]
+
         host_cap_new = jnp.min(
             jnp.where(
                 mem,
@@ -213,8 +218,14 @@ def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key:
             )
         )  # scalar: cap per freshly opened slot
 
-        slot_open = slot_basis >= 0
-        slot_compat = slot_open & compat_rows[jnp.clip(slot_basis, 0, Nrows - 1)]
+        def slot_compat_of(slot_basis_now):
+            """Open+compatible slots derived from the CURRENT threaded basis —
+            same staleness class as member_host_cap: slots opened by an earlier
+            place() call in this step must be visible to later fill and
+            redistribution passes, or their headroom is wasted on fresh nodes."""
+            return (slot_basis_now >= 0) & compat_rows[jnp.clip(slot_basis_now, 0, Nrows - 1)]
+
+        slot_compat = slot_compat_of(slot_basis)
 
         fits_row = is_offering_row & compat_rows & jnp.all(req[None, :] <= t.row_alloc, axis=1)
         row_cap = _int_cap(t.row_alloc, req)  # [Nrows]
@@ -235,7 +246,7 @@ def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key:
             slots, then open new slots of the best row for the leftover.
             commit_z >= 0 pins touched slots to that zone."""
             cap_res = _int_cap(slot_rem, req)
-            cap_j = jnp.where(elig_mask, jnp.minimum(cap_res, host_cap), 0)
+            cap_j = jnp.where(elig_mask, jnp.minimum(cap_res, member_host_cap(counts_host)), 0)
             cap_j = jnp.clip(cap_j, 0, INF_I)
             prefix = jnp.cumsum(cap_j) - cap_j
             take = jnp.clip(cnt - prefix, 0, cap_j).astype(jnp.int32)
@@ -301,7 +312,7 @@ def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key:
             placed_z = jnp.zeros((Z,), jnp.int32)
             for z in range(Z):  # Z is small and static; unrolled
                 cz = inc[z]
-                elig = slot_compat & slot_zoneset[:, z]
+                elig = slot_compat_of(slot_basis) & slot_zoneset[:, z]
                 take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count = place(
                     cz, elig, (jnp.arange(Z) == z), jnp.int32(z),
                     slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count,
@@ -319,7 +330,7 @@ def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key:
                 zmin_u = jnp.where(zmin_u >= INF_I, 0, zmin_u)
                 headroom = jnp.clip(zmin_u + skew_star - vsum_u[z], 0, INF_I)
                 cz = jnp.minimum(pending, jnp.where(finite[z], headroom, 0))
-                elig = slot_compat & slot_zoneset[:, z]
+                elig = slot_compat_of(slot_basis) & slot_zoneset[:, z]
                 take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count = place(
                     cz, elig, (jnp.arange(Z) == z), jnp.int32(z),
                     slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count,

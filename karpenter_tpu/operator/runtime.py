@@ -246,6 +246,10 @@ class Environment:
                     self.tick()
                 stop_event.wait(tick_seconds)
         finally:
+            # stop the renew thread BEFORE releasing: a live renewer would
+            # immediately re-acquire the just-released lease (holder "" reads
+            # as lapsed), blocking standby takeover while this process lingers
+            stop_event.set()
             if elector is not None:
                 if renewer is not None:
                     renewer.join(timeout=5)

@@ -265,15 +265,48 @@ class TestKwokDriverUpdates:
         store.create(node)
         drv = DRAKwokDriver(store)
         drv.reconcile()
-        assert len(store.get("ResourceSlice", "n1-cfg").devices) == 1
+
+        def slice_for(node, cfg):
+            matches = [
+                sl
+                for sl in store.list("ResourceSlice")
+                if sl.metadata.labels.get("dra.karpenter.sh/node") == node
+                and sl.metadata.labels.get("dra.karpenter.sh/config") == cfg
+            ]
+            assert len(matches) == 1, matches
+            return matches[0]
+
+        assert len(slice_for("n1", "cfg").devices) == 1
 
         def add_device(cfg):
             cfg.devices.append(gpu("g1"))
 
         store.patch("DRAConfig", "cfg", add_device)
         drv.reconcile()
-        sl = store.get("ResourceSlice", "n1-cfg")
+        sl = slice_for("n1", "cfg")
         assert len(sl.devices) == 2 and sl.pool_generation == 2
+
+    def test_dashed_names_do_not_collide(self):
+        # distinct (node, config) pairs whose joined names coincide:
+        # node "a-b" + cfg "c"  vs  node "a" + cfg "b-c"
+        from karpenter_tpu.controllers.dynamicresources import DRAKwokDriver
+        from karpenter_tpu.kube import Node
+        from karpenter_tpu.kube.objects import NodeSpec
+
+        store, clock, cluster = build_store()
+        store.create(DRAConfig(metadata=ObjectMeta(name="c"), driver="gpu", devices=[gpu("g0")]))
+        store.create(DRAConfig(metadata=ObjectMeta(name="b-c"), driver="gpu", devices=[gpu("g0"), gpu("g1")]))
+        for n in ("a-b", "a"):
+            store.create(Node(metadata=ObjectMeta(name=n, labels={wk.NODE_REGISTERED_LABEL_KEY: "true"}), spec=NodeSpec(provider_id=f"kwok://{n}")))
+        drv = DRAKwokDriver(store)
+        drv.reconcile()
+        slices = store.list("ResourceSlice")
+        # 2 configs x 2 nodes = 4 distinct slices, no flapping between configs
+        assert len(slices) == 4
+        keys = {(sl.metadata.labels["dra.karpenter.sh/node"], sl.metadata.labels["dra.karpenter.sh/config"]) for sl in slices}
+        assert keys == {("a-b", "c"), ("a-b", "b-c"), ("a", "c"), ("a", "b-c")}
+        drv.reconcile()  # stable: second pass neither creates nor deletes
+        assert len(store.list("ResourceSlice")) == 4
 
 
 class TestClaimTemplates:
