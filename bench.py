@@ -1,10 +1,23 @@
 """Benchmark: the TPU scheduling solver vs the reference's envelope.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+
+The headline metric is END-TO-END `TPUSolver.solve()` wall-clock (encode ->
+device pack -> decode), matching how the reference measures its hot path
+(scheduler.go:440 is wall-clock); the kernel is never timed alone. The
+workload is the north-star configuration hardened per the reference's own
+benchmark (scheduling_benchmark_test.go:77-109): a heterogeneous population
+of ~400 (cpu, mem) variants plus zone-spread, zone-selector, and hostname
+anti-affinity pods — hundreds of unique signatures, not a trivially-groupable
+population.
+
+`extra` carries the secondary north-star metric: 256-node multi-node
+consolidation through the REAL path (Environment-built fleet ->
+disruption.get_candidates() -> encode_candidates + anneal on device),
+budgeted < 5 s by BASELINE.json.
 
 Baseline: the reference's asserted scheduler throughput floor of 100 pods/sec
-(scheduling_benchmark_test.go:58) on its 10k-pod-scale scenarios.
-vs_baseline = our pods/sec / 100.
+(scheduling_benchmark_test.go:58). vs_baseline = our pods/sec / 100.
 """
 
 from __future__ import annotations
@@ -19,8 +32,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
 
 
-def build_snapshot(n_pods: int, n_types: int):
-    from helpers import make_nodepool, make_pod, zone_spread
+def build_snapshot(n_pods: int, n_types: int, n_variants: int = 400):
+    from helpers import hostname_anti_affinity, make_nodepool, make_pod, zone_spread
     from karpenter_tpu.apis import labels as wk
     from karpenter_tpu.cloudprovider.fake import instance_types_assorted
     from karpenter_tpu.kube import Store
@@ -39,16 +52,32 @@ def build_snapshot(n_pods: int, n_types: int):
     start_informers(store, cluster)
     np_ = make_nodepool(requirements=LINUX)
     store.create(np_)
-    sel = {"matchLabels": {"app": "web"}}
+    # heterogeneous variant pool a la the reference's 400-variant benchmark
+    combos = [
+        (f"{rng.randrange(100, 4100, 100)}m", f"{rng.randrange(128, 4096, 64)}Mi")
+        for _ in range(n_variants)
+    ]
+    spread_sel = {"matchLabels": {"app": "web"}}
+    anti_sels = [{"matchLabels": {"app": f"db-{i}"}} for i in range(10)]
     pods = []
     for _ in range(n_pods):
         k = rng.random()
-        if k < 0.6:
-            pods.append(make_pod(cpu=rng.choice(["250m", "500m", "1", "2"]), memory=rng.choice(["512Mi", "1Gi", "2Gi"])))
-        elif k < 0.8:
-            pods.append(make_pod(cpu="1", memory="1Gi", labels={"app": "web"}, tsc=[zone_spread(selector=sel)]))
-        else:
+        if k < 0.60:  # heterogeneous plain pods
+            cpu, mem = rng.choice(combos)
+            pods.append(make_pod(cpu=cpu, memory=mem))
+        elif k < 0.80:  # zonal topology spread (4 sizes so spread != 1 item)
+            cpu = rng.choice(["250m", "500m", "1", "2"])
+            pods.append(make_pod(cpu=cpu, memory="1Gi", labels={"app": "web"}, tsc=[zone_spread(selector=spread_sel)]))
+        elif k < 0.90:  # zone node selectors
             pods.append(make_pod(cpu="1", node_selector={wk.ZONE_LABEL_KEY: rng.choice(["test-zone-a", "test-zone-b"])}))
+        elif k < 0.98:  # more heterogeneous, memory-heavy
+            cpu, mem = rng.choice(combos)
+            pods.append(make_pod(cpu=cpu, memory=mem, labels={"tier": "batch"}))
+        else:  # hostname anti-affinity groups (the north-star config)
+            i = rng.randrange(len(anti_sels))
+            pods.append(
+                make_pod(cpu="500m", memory="512Mi", labels={"app": f"db-{i}"}, anti_affinity=[hostname_anti_affinity(anti_sels[i])])
+            )
     return SolverSnapshot(
         store=store,
         cluster=cluster,
@@ -61,99 +90,121 @@ def build_snapshot(n_pods: int, n_types: int):
     )
 
 
-def bench_consolidation():
-    """256-node multi-node consolidation search (BASELINE north star: <5s)."""
-    import numpy as np
-
-    import jax
-    import jax.numpy as jnp
-
-    from karpenter_tpu.models.consolidation_model import ConsolidationTensors, anneal
-
-    rng = np.random.default_rng(0)
-    N = int(os.environ.get("BENCH_NODES", "256"))
-    util = rng.uniform(0.2, 0.8, N)
-    cap = rng.choice([4, 8, 16, 32], N).astype(np.float32)
-    used = (cap * util).astype(np.float32)
-    T = 500
-    t = ConsolidationTensors(
-        node_price=jnp.asarray(cap * 0.027),
-        node_cost=jnp.asarray(rng.uniform(0.5, 5.0, N).astype(np.float32)),
-        node_slack=jnp.asarray(np.stack([cap - used, (cap - used) * 2, np.full(N, 50.0), np.full(N, 20.0)], 1).astype(np.float32)),
-        node_used=jnp.asarray(np.stack([used, used * 2, util * 10, used * 0.1], 1).astype(np.float32)),
-        node_npods=jnp.asarray((util * 10).astype(np.float32)),
-        pod_compat=jnp.asarray((np.ones((N, N)) - np.eye(N)).astype(np.float32)),
-        row_alloc=jnp.asarray(
-            np.stack([np.tile([3.9, 7.9, 15.9, 31.9, 63.9], 100), np.tile([7.8, 15.8, 31.8, 63.8, 127.8], 100), np.full(T, 110.0), np.full(T, 20.0)], 1).astype(np.float32)
-        ),
-        row_price=jnp.asarray(np.tile([0.108, 0.217, 0.434, 0.868, 1.74], 100).astype(np.float32)),
-    )
-    key = jax.random.PRNGKey(0)
-    out = anneal(t, key, n_chains=128, n_steps=2048)
-    out[1].block_until_ready()
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        bx, bs = anneal(t, key, n_chains=128, n_steps=2048)
-        bs.block_until_ready()
-        best = min(best, time.perf_counter() - t0)
-    print(
-        json.dumps(
-            {
-                "metric": f"consolidation_{N}nodes_anneal_seconds",
-                "value": round(best, 4),
-                "unit": "s",
-                "vs_baseline": round(5.0 / best, 2),  # north-star 5s budget / actual
-            }
-        )
-    )
-
-
-def main():
-    if os.environ.get("BENCH_MODE") == "consolidation":
-        bench_consolidation()
-        return
-    from karpenter_tpu.models.scheduler_model import make_tensors
-    from karpenter_tpu.models.scheduler_model_grouped import (
-        build_items,
-        greedy_pack_grouped,
-        make_item_tensors,
-    )
+def bench_scheduler(n_pods: int, n_types: int):
+    """End-to-end TPUSolver.solve wall-clock. Returns (pods_per_sec, extra)."""
+    from karpenter_tpu.models.scheduler_model_grouped import build_items
     from karpenter_tpu.solver.encode import encode
+    from karpenter_tpu.solver.tpu import TPUSolver
 
-    # defaults = the BASELINE.json north-star scale (50k pods x 500 types < 1s)
-    n_pods = int(os.environ.get("BENCH_PODS", "50000"))
-    n_types = int(os.environ.get("BENCH_TYPES", "500"))
     snap = build_snapshot(n_pods, n_types)
     enc = encode(snap)
     assert not enc.fallback_reasons, enc.fallback_reasons
     item_arrays, _ = build_items(enc)
-    items = make_item_tensors(item_arrays)
-    t = make_tensors(enc, n_slots=enc.n_existing + min(n_pods, 4096))
+    n_items = int(item_arrays["item_count"].shape[0])
 
-    # warmup/compile
-    out = greedy_pack_grouped(t, items)
-    out[0].block_until_ready()
+    solver = TPUSolver(force=True)
+    results = solver.solve(snap)  # warmup: jit compile
+    assert not results.pod_errors, f"{len(results.pod_errors)} pods failed: {list(results.pod_errors.values())[:3]}"
 
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        out = greedy_pack_grouped(t, items)
-        out[0].block_until_ready()
+        results = solver.solve(snap)
         best = min(best, time.perf_counter() - t0)
+    assert not results.pod_errors
+    return n_pods / best, {
+        "solve_seconds": round(best, 4),
+        "n_unique_items": n_items,
+        "n_new_claims": len(results.new_node_claims),
+    }
 
-    import numpy as np
 
-    scheduled = int(np.asarray(out[0]).sum())
-    assert scheduled == n_pods, f"only {scheduled}/{n_pods} scheduled (leftovers={np.asarray(out[1]).sum()})"
-    pods_per_sec = n_pods / best
+def bench_consolidation(n_nodes: int):
+    """Multi-node consolidation through the REAL path: an Environment-built
+    fleet of underutilized nodes, disruption candidates, then the device
+    subset search (encode_candidates + anneal). Returns (seconds, extra)."""
+    from helpers import hostname_anti_affinity, make_nodepool, make_pod
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.nodepool import Budget
+    from karpenter_tpu.operator import Environment
+    from karpenter_tpu.solver.consolidation import propose_subsets
+
+    OD_ONLY = [
+        {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+        {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+        {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_ON_DEMAND]},
+    ]
+    env = Environment()
+    np_ = make_nodepool(requirements=OD_ONLY)
+    np_.spec.disruption.consolidate_after = "30s"
+    np_.spec.disruption.budgets = [Budget(nodes="100%")]
+    env.store.create(np_)
+    # one node per pod via anti-affinity, then swap to small unconstrained
+    # pods: a fleet of underutilized nodes, the consolidation north star
+    sel = {"matchLabels": {"app": "x"}}
+    pods = [
+        make_pod(cpu="500m", name=f"s{i}", labels={"app": "x"}, anti_affinity=[hostname_anti_affinity(sel)])
+        for i in range(n_nodes)
+    ]
+    for p in pods:
+        env.store.create(p)
+    env.settle()
+    assert env.store.count("Node") == n_nodes, f"fleet build failed: {env.store.count('Node')}/{n_nodes}"
+    for p in pods:
+        env.store.delete("Pod", p.metadata.name)
+    for i in range(n_nodes):
+        env.store.create(make_pod(cpu="500m", name=f"f{i}"))
+    env.settle(rounds=4)
+    env.clock.step(40)
+    env.nodeclaim_disruption.reconcile()
+    cands = env.disruption.get_candidates()
+    assert len(cands) >= n_nodes * 0.9, f"only {len(cands)} candidates"
+    its = env.cloud_provider.get_instance_types()
+
+    proposals = propose_subsets(cands, its)  # warmup: jit compile
+    assert proposals, "annealer found no profitable subsets on an idle fleet"
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        proposals = propose_subsets(cands, its)
+        best = min(best, time.perf_counter() - t0)
+    return best, {"n_candidates": len(cands), "n_proposals": len(proposals)}
+
+
+def main():
+    n_pods = int(os.environ.get("BENCH_PODS", "50000"))
+    n_types = int(os.environ.get("BENCH_TYPES", "500"))
+    n_nodes = int(os.environ.get("BENCH_NODES", "256"))
+
+    if os.environ.get("BENCH_MODE") == "consolidation":
+        secs, extra = bench_consolidation(n_nodes)
+        print(
+            json.dumps(
+                {
+                    "metric": f"consolidation_{n_nodes}nodes_e2e_seconds",
+                    "value": round(secs, 4),
+                    "unit": "s",
+                    "vs_baseline": round(5.0 / secs, 2),
+                    "extra": extra,
+                }
+            )
+        )
+        return
+
+    pods_per_sec, sched_extra = bench_scheduler(n_pods, n_types)
+    cons_secs, cons_extra = bench_consolidation(n_nodes)
+    extra = dict(sched_extra)
+    extra[f"consolidation_{n_nodes}nodes_e2e_seconds"] = round(cons_secs, 4)
+    extra["consolidation_vs_baseline"] = round(5.0 / cons_secs, 2)
+    extra.update({f"consolidation_{k}": v for k, v in cons_extra.items()})
     print(
         json.dumps(
             {
-                "metric": f"schedule_{n_pods}pods_x_{n_types}types_pods_per_sec",
+                "metric": f"schedule_{n_pods}pods_x_{n_types}types_e2e_pods_per_sec",
                 "value": round(pods_per_sec, 1),
                 "unit": "pods/sec",
                 "vs_baseline": round(pods_per_sec / 100.0, 2),
+                "extra": extra,
             }
         )
     )

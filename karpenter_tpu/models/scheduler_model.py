@@ -96,8 +96,13 @@ jax.tree_util.register_dataclass(
 )
 
 
-def make_tensors(enc, n_slots: int | None = None) -> SchedulerTensors:
-    """EncodedSnapshot (numpy) -> SchedulerTensors (device)."""
+def make_tensors(enc, n_slots: int | None = None, with_pods: bool = True) -> SchedulerTensors:
+    """EncodedSnapshot (numpy) -> SchedulerTensors (device).
+
+    with_pods=False skips uploading the per-POD tensors (req/mask/taints/
+    zones/member, all [P, ...]) — the signature-grouped kernel reads only the
+    per-ITEM tensors passed alongside, so the 50k-pod upload would be pure
+    waste on that path; size-1 placeholders keep the pytree shape."""
     P = enc.n_pods
     if n_slots is None:
         n_slots = enc.n_existing + P
@@ -108,7 +113,18 @@ def make_tensors(enc, n_slots: int | None = None) -> SchedulerTensors:
         counts_host[: enc.n_groups, : enc.n_existing] = enc.counts_host_existing[:, : enc.n_existing]
     group_kind = enc.group_kind if enc.n_groups else np.zeros(1, np.int32)
     group_skew = enc.group_skew if enc.n_groups else np.ones(1, np.int32)
-    member = enc.member if enc.n_groups else np.zeros((P, 1), bool)
+    if not with_pods:
+        pod_req = np.zeros((1, enc.row_alloc.shape[1]), np.float32)
+        pod_mask = np.zeros((1,) + enc.sig_mask.shape[1:], enc.sig_mask.dtype)
+        pod_taint_ok = np.ones((1, enc.sig_taint_ok.shape[1]), bool)
+        pod_zone_allowed = np.ones((1, Z), bool)
+        member = np.zeros((1, G), bool)
+    else:
+        pod_req = enc.pod_req
+        pod_mask = enc.pod_mask
+        pod_taint_ok = enc.pod_taint_ok
+        pod_zone_allowed = enc.pod_zone_allowed
+        member = enc.member if enc.n_groups else np.zeros((P, 1), bool)
     counts_zone = enc.counts_zone_init if enc.n_groups else np.zeros((1, Z), np.int32)
 
     n_ex = max(enc.n_existing, 1)
@@ -127,10 +143,10 @@ def make_tensors(enc, n_slots: int | None = None) -> SchedulerTensors:
         row_pool_rank=jnp.asarray(enc.row_pool_rank),
         row_taint_class=jnp.asarray(enc.row_taint_class),
         rank_zoneset=jnp.asarray(enc.rank_zoneset),
-        pod_req=jnp.asarray(enc.pod_req),
-        pod_mask=jnp.asarray(enc.pod_mask),
-        pod_taint_ok=jnp.asarray(enc.pod_taint_ok),
-        pod_zone_allowed=jnp.asarray(enc.pod_zone_allowed),
+        pod_req=jnp.asarray(pod_req),
+        pod_mask=jnp.asarray(pod_mask),
+        pod_taint_ok=jnp.asarray(pod_taint_ok),
+        pod_zone_allowed=jnp.asarray(pod_zone_allowed),
         member=jnp.asarray(member),
         group_kind=jnp.asarray(group_kind),
         group_skew=jnp.asarray(group_skew),

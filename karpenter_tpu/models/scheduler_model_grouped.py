@@ -68,44 +68,37 @@ jax.tree_util.register_dataclass(
 
 
 def build_items(enc):
-    """Group pods by signature (host, numpy — fully vectorized: this runs on
-    the 50k-pod hot path every solve). Returns (ItemTensors arrays as numpy,
+    """Group pods into work items from the encoder's signature ids (encode
+    already deduplicated pod shapes — this is pure integer index work, no
+    tensor hashing). Returns (ItemTensors arrays as numpy,
     pod_indices_per_item as arrays). Pods in >1 zone-spread group stay
     count=1 (water-fill is single-level for them)."""
     P = enc.n_pods
-    G = enc.member.shape[1] if enc.member.size else 0
-    member = enc.member if G else np.zeros((P, 1), bool)
+    S = enc.n_sigs
+    G = enc.sig_member.shape[1] if enc.sig_member.size else 0
+    sig_member = enc.sig_member if G else np.zeros((max(S, 1), 1), bool)
     zone_groups = (enc.group_kind == KIND_ZONE_SPREAD) if G else np.zeros(1, bool)
-    multi_zone = (member & zone_groups[None, :]).sum(axis=1) > 1  # [P]
-    # unique rows over the concatenated byte view of every signature field;
-    # multi-zone pods get a distinct per-pod column so they never merge
-    uniq_col = np.where(multi_zone, np.arange(P, dtype=np.int64) + 1, 0)
-    sig = np.hstack(
-        [
-            enc.pod_req.view(np.uint8).reshape(P, -1),
-            enc.pod_mask.reshape(P, -1).view(np.uint8).reshape(P, -1),
-            enc.pod_taint_ok.reshape(P, -1).view(np.uint8).reshape(P, -1),
-            enc.pod_zone_allowed.view(np.uint8).reshape(P, -1),
-            member.view(np.uint8).reshape(P, -1),
-            uniq_col.view(np.uint8).reshape(P, -1),
-        ]
-    )
-    _, first_idx, inverse, counts = np.unique(sig, axis=0, return_index=True, return_inverse=True, return_counts=True)
+    multi_zone_sig = (sig_member & zone_groups[None, :]).sum(axis=1) > 1  # [S]
+    sig = np.asarray(enc.sig_of_pod, dtype=np.int64)
+    # multi-zone pods get a distinct per-pod key so they never merge
+    key = np.where(multi_zone_sig[sig] if S else False, S + np.arange(P, dtype=np.int64), sig)
+    _, first_idx, inverse, counts = np.unique(key, return_index=True, return_inverse=True, return_counts=True)
     # keep first-appearance order so FFD's big-pods-first queue order survives
     order = np.argsort(first_idx, kind="stable")
     rank = np.empty_like(order)
     rank[order] = np.arange(order.size)
     item_of_pod = rank[inverse]  # [P] item index in appearance order
-    reps = first_idx[order]
+    reps = first_idx[order]  # representative POD index per item
+    rep_sig = sig[reps]  # signature per item
     by_item = np.argsort(item_of_pod, kind="stable")
     boundaries = np.cumsum(counts[order])[:-1]
     item_pods = np.split(by_item, boundaries)
     arrays = dict(
-        item_req=enc.pod_req[reps],
-        item_mask=enc.pod_mask[reps],
-        item_taint_ok=enc.pod_taint_ok[reps],
-        item_zone_allowed=enc.pod_zone_allowed[reps],
-        item_member=member[reps],
+        item_req=enc.sig_req[rep_sig],
+        item_mask=enc.sig_mask[rep_sig],
+        item_taint_ok=enc.sig_taint_ok[rep_sig],
+        item_zone_allowed=enc.sig_zone_allowed[rep_sig],
+        item_member=sig_member[rep_sig],
         item_count=counts[order].astype(np.int32),
     )
     return arrays, item_pods
@@ -295,17 +288,31 @@ def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key:
         def zone_path(op):
             slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count = op
             slotcap_z = jnp.any((slot_compat & (_int_cap(slot_rem, req) > 0))[:, None] & slot_zoneset, axis=0)
-            finite = zone_feasible & (openable_z | slotcap_z)
             vsum = jnp.sum(jnp.where(zone_member_mask[:, None], counts_zone, 0), axis=0)  # [Z]
-            # skew cap: zones that are allowed but unavailable pin the global
-            # minimum, so no available zone may rise above frozen_min + skew —
-            # the per-pod feasibility check re-applied for every pod of the
-            # batch, not just the first (scheduler_model.py:199-205)
             skew_star = jnp.min(jnp.where(zone_member_mask, t.group_skew, INF_I))
             allowed_real = za & zone_is_real
-            frozen = allowed_real & ~finite
+            # the water-fill domain is AVAILABILITY-based, not skew-based: a
+            # zone at the current max level is only temporarily infeasible —
+            # the sequential loop raises counts level-by-level and re-admits
+            # it once the min zones catch up, which is exactly what water-fill
+            # (pour into current-min first) reproduces. Gating on the
+            # step-entry skew check would freeze such zones and strand the
+            # batch's quota. Only allowed-but-UNAVAILABLE zones (no fitting
+            # template, no committed slot capacity) truly pin the global
+            # minimum: no available zone may rise above frozen_min + skew
+            # (per-pod check, scheduler_model.py:199-205).
+            available = allowed_real & (openable_z | slotcap_z)
+            # items in MULTIPLE zone-spread groups are count=1 by construction
+            # (build_items splits them): level-raising doesn't apply to a
+            # single pod, and the summed-across-groups vsum can't express
+            # per-group skew — gate such items on the exact per-group
+            # step-entry check (spread_ok) and give flat unit capacity
+            strict = jnp.sum(zone_member_mask) > 1
+            finite = available & jnp.where(strict, spread_ok, True)
+            frozen = allowed_real & ~available
             frozen_min = jnp.min(jnp.where(frozen, vsum, INF_I))
             cap = jnp.clip(frozen_min + skew_star - vsum, 0, INF_I)
+            cap = jnp.where(strict, jnp.where(finite, 1, 0), cap)
             inc = _waterfill(vsum, finite, c, cap)
             take_all = jnp.zeros((N,), jnp.int32)
             pending = c - jnp.sum(inc)  # skew/availability-capped remainder
@@ -368,6 +375,42 @@ def greedy_pack_grouped(t: SchedulerTensors, items: ItemTensors):
     """Returns (takes [W, N], leftovers [W], slot_basis, slot_zoneset,
     slot_rank, open_count)."""
     return _greedy_pack_grouped_impl(t, items, t.zone_key, t.n_existing, t.n_slots)
+
+
+def compress_takes(takes, n_pods: int):
+    """Device-side sparsification of the [W, N] take matrix: every nonzero
+    entry places >= 1 pod, so nnz <= n_pods — transferring (item, slot,
+    count) triples is O(pods), not O(items x slots) (the dense matrix is
+    ~64 MB at 4k items x 4k slots and dominated the solve wall-clock).
+    Returns numpy (nz_item, nz_slot, nz_count), -1-padded, row-major (per
+    item, slots ascending)."""
+    W, N = takes.shape
+    cap = int(min(n_pods, W * N))
+    nzi, nzs = jnp.nonzero(takes, size=cap, fill_value=-1)
+    nzc = jnp.where(nzi >= 0, takes[jnp.clip(nzi, 0, W - 1), jnp.clip(nzs, 0, N - 1)], 0)
+    return np.asarray(nzi), np.asarray(nzs), np.asarray(nzc)
+
+
+def assignment_from_triples(nz_item, nz_slot, nz_count, item_pods, n_pods: int) -> np.ndarray:
+    """Distribute each item's pods over its placed slots (slot-index order,
+    matching assignment_from_takes) from the sparse triples; leftover pods
+    stay unassigned (-1)."""
+    assignment = np.full(n_pods, -1, dtype=np.int64)
+    valid = nz_item >= 0
+    items_np = nz_item[valid].astype(np.int64)
+    slots_np = nz_slot[valid]
+    counts_np = nz_count[valid].astype(np.int64)
+    if items_np.size == 0:
+        return assignment
+    W = len(item_pods)
+    expanded = np.repeat(slots_np, counts_np)  # per item, slots ascending
+    placed_per_item = np.bincount(items_np, weights=counts_np, minlength=W).astype(np.int64)
+    offs = np.concatenate([[0], np.cumsum(placed_per_item)])
+    for w, pod_idxs in enumerate(item_pods):
+        k = min(int(placed_per_item[w]), len(pod_idxs))
+        if k:
+            assignment[np.asarray(pod_idxs)[:k]] = expanded[offs[w] : offs[w] + k]
+    return assignment
 
 
 def assignment_from_takes(takes: np.ndarray, leftovers: np.ndarray, item_pods, n_pods: int) -> np.ndarray:

@@ -16,15 +16,25 @@ from ..controllers.provisioning.scheduling.existingnode import ExistingNode
 from ..controllers.provisioning.scheduling.nodeclaim import (
     NodeClaimTemplate,
     SchedulingNodeClaim,
-    filter_instance_types,
 )
 from ..controllers.provisioning.scheduling.scheduler import Results
 from ..models.scheduler_model import make_tensors
 from ..scheduling.requirements import Operator, Requirement, Requirements
 from ..utils import resources as res
+from ..utils.quantity import Quantity
 from .encode import encode
 from .ffd import FFDSolver
 from .snapshot import SolverSnapshot
+
+
+def _requests_from_sigs(enc, sig_counts: dict[int, int]) -> dict:
+    """Total ResourceList for a slot from (signature -> pod count): integer
+    milli accumulation, one Quantity construction per resource."""
+    acc: dict[str, int] = {}
+    for s, n in sig_counts.items():
+        for k, q in enc.sig_requests[s].items():
+            acc[k] = acc.get(k, 0) + q.milli * n
+    return {k: Quantity(v) for k, v in acc.items()}
 
 
 class _NullTopology:
@@ -65,8 +75,9 @@ class TPUSolver:
         # not pods (scheduler_model_grouped.py). Slot axis capped; retry
         # uncapped on the rare overflow (every slot opened AND pods unplaced).
         from ..models.scheduler_model_grouped import (
-            assignment_from_takes,
+            assignment_from_triples,
             build_items,
+            compress_takes,
             greedy_pack_grouped,
             make_item_tensors,
         )
@@ -74,12 +85,13 @@ class TPUSolver:
         item_arrays, item_pods = build_items(enc)
         items = make_item_tensors(item_arrays)
         cap = enc.n_existing + min(enc.n_pods, 4096)
-        t = make_tensors(enc, n_slots=cap)
+        t = make_tensors(enc, n_slots=cap, with_pods=False)
         takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack_grouped(t, items)
         if int(open_count) == cap and int(np.asarray(leftovers).sum()) > 0 and cap < enc.n_existing + enc.n_pods:
-            t = make_tensors(enc)
+            t = make_tensors(enc, with_pods=False)
             takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack_grouped(t, items)
-        assignment = assignment_from_takes(np.asarray(takes), np.asarray(leftovers), item_pods, enc.n_pods)
+        nz_item, nz_slot, nz_count = compress_takes(takes, enc.n_pods)
+        assignment = assignment_from_triples(nz_item, nz_slot, nz_count, item_pods, enc.n_pods)
         return self._decode(snap, enc, assignment, np.asarray(slot_basis), np.asarray(slot_zoneset))
 
     # -- decode ----------------------------------------------------------------
@@ -106,15 +118,41 @@ class TPUSolver:
             existing_by_slot[j] = en
 
         overhead_groups_cache: dict[int, list] = {}
+        # per-slot work dedupes by SIGNATURE: pod requirements/requests lower
+        # once per unique shape (encode.sig_*). The expensive per-slot pass —
+        # the 500-type instance filter — splits into a requirements part
+        # (compat + offering, cached per distinct (template, req-class set,
+        # zone-set)) and a fits part (vectorized numpy compare of the slot's
+        # total request vector against the template's allocatable matrix).
+        sig_of_pod = np.asarray(enc.sig_of_pod)
+        rc_of_sig = enc.req_class_of_sig
+        mask_cache: dict[tuple, np.ndarray] = {}
+        req_cache: dict[tuple, Requirements] = {}
+        tmpl_ctx_cache: dict[int, tuple] = {}
         new_claims: list[SchedulingNodeClaim] = []
+
+        # slot total request vectors, one bincount per resource axis
+        slot_ids = assignment.copy()
+        valid = slot_ids >= 0
+        n_slots = int(slot_basis.shape[0])
+        R = enc.sig_req.shape[1]
+        total_mat = np.zeros((n_slots, R), dtype=np.float64)
+        if valid.any():
+            pr = enc.sig_req[sig_of_pod]
+            for r in range(R):
+                total_mat[:, r] = np.bincount(slot_ids[valid], weights=pr[valid, r], minlength=n_slots)
+
         for j, pod_idxs in sorted(pods_by_slot.items()):
             pods = [enc.pods[i] for i in pod_idxs]
-            requests = res.requests_for_pods(pods)
+            sig_counts: dict[int, int] = {}
+            for i in pod_idxs:
+                s = int(sig_of_pod[i])
+                sig_counts[s] = sig_counts.get(s, 0) + 1
+            requests = _requests_from_sigs(enc, sig_counts)
             if j < enc.n_existing:
                 en = existing_by_slot[j]
-                for p in pods:
-                    en.pods.append(p)
-                    en.remaining_resources = res.subtract(en.remaining_resources, res.pod_requests(p))
+                en.pods.extend(pods)
+                en.remaining_resources = res.subtract(en.remaining_resources, requests)
                 continue
 
             row = int(slot_basis[j])
@@ -127,26 +165,45 @@ class TPUSolver:
             claim.hostname = f"tpu-slot-{j}"
             claim.spec_requests = requests
 
-            reqs = Requirements()
-            reqs.add(*template.requirements.values())
-            for i in pod_idxs:
-                reqs.add(*Requirements.from_pod(enc.pods[i], strict=True).values())
             # zone: pin only when the packer committed/narrowed the slot to a
             # single zone (late committal — matches the FFD's topology narrowing)
-            zones = [enc.zone_names[z] for z in np.nonzero(slot_zoneset[j])[0] if z != 0]
-            template_zones = {z for z in enc.zone_names[1:]}
-            if zones and set(zones) != template_zones:
-                reqs.add(Requirement(wk.ZONE_LABEL_KEY, "In", zones))
-            claim.requirements = reqs
+            zone_ids = tuple(int(z) for z in np.nonzero(slot_zoneset[j])[0] if z != 0)
+            rc_key = tuple(sorted({int(rc_of_sig[s]) for s in sig_counts}))
+            rkey = (id(template), rc_key, zone_ids)
+            reqs = req_cache.get(rkey)
+            if reqs is None:
+                reqs = Requirements()
+                reqs.add(*template.requirements.values())
+                for s in sorted(sig_counts):
+                    reqs.add(*enc.sig_requirements[s].values())
+                zones = [enc.zone_names[z] for z in zone_ids]
+                template_zones = {z for z in enc.zone_names[1:]}
+                if zones and set(zones) != template_zones:
+                    reqs.add(Requirement(wk.ZONE_LABEL_KEY, "In", zones))
+                req_cache[rkey] = reqs
+            # copies: claims are mutated downstream (finalize drops hostname
+            # reqs); a shared Requirements would couple sibling slots
+            claim.requirements = reqs.copy()
 
-            remaining, _, err = filter_instance_types(
-                template.instance_type_options,
-                reqs,
-                pods[0],
-                res.pod_requests(pods[0]),
-                claim.daemon_overhead_groups,
-                requests,
-            )
+            its, alloc_mat, ginfo = self._template_ctx(template, claim.daemon_overhead_groups, enc, tmpl_ctx_cache)
+            mask = mask_cache.get(rkey)
+            if mask is None:
+                # compat x offering per instance type (nodeclaim.go:626-640)
+                mask = np.zeros(len(its), dtype=bool)
+                for i2, cand in enumerate(its):
+                    if cand.requirements.intersects(reqs) is None:
+                        for o in cand.offerings:
+                            if o.available and reqs.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None:
+                                mask[i2] = True
+                                break
+                mask_cache[rkey] = mask
+            total_vec = total_mat[j]
+            remaining = []
+            for members, ovh in ginfo:
+                if not members:
+                    continue
+                fits = np.all(alloc_mat[members] >= total_vec[None, :] + ovh[None, :], axis=1)
+                remaining.extend(its[m] for m, ok in zip(members, fits & mask[members]) if ok)
             claim.instance_type_options = remaining if remaining else [it]
             new_claims.append(claim)
 
@@ -155,6 +212,38 @@ class TPUSolver:
             existing_nodes=existing_nodes,
             pod_errors=pod_errors,
         )
+
+    @staticmethod
+    def _template_ctx(template, groups, enc, cache: dict):
+        """Per-template numpy context for the vectorized fits filter: the
+        instance-type list, its allocatable matrix in encode's scaled units,
+        and per-daemon-overhead-group (member indices, overhead vector)."""
+        key = id(template)
+        ctx = cache.get(key)
+        if ctx is None:
+            from .encode import _scale
+
+            rnames = enc.resource_names
+            ridx = {k: i for i, k in enumerate(rnames)}
+            its = template.instance_type_options
+            it_idx = {id(x): i for i, x in enumerate(its)}
+            alloc = np.zeros((len(its), len(rnames)), dtype=np.float64)
+            for i, x in enumerate(its):
+                for k, q in x.allocatable().items():
+                    r = ridx.get(k)
+                    if r is not None:
+                        alloc[i, r] = _scale(k, q)
+            ginfo = []
+            for g in groups:
+                ovh = np.zeros(len(rnames), dtype=np.float64)
+                for k, q in (g.daemon_overhead or {}).items():
+                    r = ridx.get(k)
+                    if r is not None:
+                        ovh[r] = _scale(k, q)
+                ginfo.append(([it_idx[id(x)] for x in g.instance_types if id(x) in it_idx], ovh))
+            ctx = (its, alloc, ginfo)
+            cache[key] = ctx
+        return ctx
 
     @staticmethod
     def _overhead_groups(template: NodeClaimTemplate, snap: SolverSnapshot, cache: dict) -> list:

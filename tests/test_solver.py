@@ -257,6 +257,25 @@ class TestGroupedZonePath:
         # opens a surplus second
         assert len(results.new_node_claims) == 1, [len(nc.pods) for nc in results.new_node_claims]
 
+    def test_spread_batch_at_max_level_not_frozen(self):
+        # two spread items in one group, placed in sequence: after the first
+        # item the zone counts sit imbalanced (some zones at the current max
+        # level). The second batch must still place fully — sequentially the
+        # counts rise level-by-level and max-level zones re-admit pods; a
+        # kernel that freezes zones on the step-entry skew check strands the
+        # whole batch's quota.
+        types = [catalog.make_instance_type("c", 16, zones=["test-zone-a", "test-zone-b"])]
+        sel = {"matchLabels": {"app": "s"}}
+        # item 1: three 1-cpu pods -> zones [2, 1]; item 2: ten 500m pods
+        pods = [make_pod(cpu="1", labels={"app": "s"}, tsc=[zone_spread(selector=sel)]) for _ in range(3)]
+        pods += [make_pod(cpu="500m", labels={"app": "s"}, tsc=[zone_spread(selector=sel)]) for _ in range(10)]
+        snap = make_snapshot(pods, types=types)
+        tpu = TPUSolver(force=True)
+        results = tpu.solve(snap)
+        assert tpu.last_backend == "tpu"
+        assert not results.pod_errors, results.pod_errors
+        assert not validate_results(make_snapshot(pods, types=types), results)
+
     def test_stranded_zone_quota_redistributes(self):
         # large skew: water-fill splits across zones, but only some zones can
         # actually open nodes — the stranded share must land elsewhere
@@ -347,6 +366,109 @@ class TestTPUEquivalence:
                 else:
                     pods.append(make_pod(cpu="8", memory="16Gi"))
             compare_backends(pods)
+
+
+class TestMultiGroupSpread:
+    def test_pod_in_two_zone_groups_respects_both_skews(self):
+        # group g1 has 3 scheduled pods in zone-b, group g2 has 5 in zone-a
+        # (both maxSkew=1); a pending pod member of BOTH groups has no
+        # feasible zone when templates offer only a and b. The batch kernel
+        # must not place it via the summed-counts water-fill (which would
+        # violate g1's skew in zone-b).
+        from karpenter_tpu.apis.nodeclaim import COND_INITIALIZED, COND_REGISTERED, NodeClaim
+        from karpenter_tpu.kube import Node, ObjectMeta
+        from karpenter_tpu.kube.objects import NodeSpec, NodeStatus
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        types = [catalog.make_instance_type("c", 16, zones=["test-zone-a", "test-zone-b"])]
+        sel1 = {"matchLabels": {"g1": "y"}}
+        sel2 = {"matchLabels": {"g2": "y"}}
+        pending = [
+            make_pod(
+                cpu="100m",
+                labels={"g1": "y", "g2": "y"},
+                tsc=[zone_spread(selector=sel1), zone_spread(selector=sel2)],
+            )
+        ]
+
+        def snap():
+            store = Store()
+            clock = FakeClock()
+            cluster = Cluster(store, clock)
+            start_informers(store, cluster)
+            np_ = make_nodepool(requirements=LINUX_AMD64)
+            store.create(np_)
+            for name, zone in (("na", "test-zone-a"), ("nb", "test-zone-b")):
+                nc = NodeClaim(metadata=ObjectMeta(name=f"c-{name}", labels={wk.NODEPOOL_LABEL_KEY: np_.metadata.name}))
+                nc.status.provider_id = f"kwok://{name}"
+                nc.status.conditions.set_true(COND_REGISTERED)
+                nc.status.conditions.set_true(COND_INITIALIZED)
+                store.create(nc)
+                store.create(
+                    Node(
+                        metadata=ObjectMeta(
+                            name=name,
+                            labels={
+                                wk.NODEPOOL_LABEL_KEY: np_.metadata.name,
+                                wk.HOSTNAME_LABEL_KEY: name,
+                                wk.ZONE_LABEL_KEY: zone,
+                            },
+                        ),
+                        spec=NodeSpec(provider_id=f"kwok://{name}"),
+                        status=NodeStatus(
+                            capacity=parse_resource_list({"cpu": "32", "memory": "64Gi", "pods": "110"}),
+                            allocatable=parse_resource_list({"cpu": "32", "memory": "64Gi", "pods": "110"}),
+                        ),
+                    )
+                )
+            for i in range(3):  # g1 pods bound in zone-b
+                p = make_pod(cpu="100m", name=f"g1-{i}", labels={"g1": "y"})
+                p.spec.node_name = "nb"
+                store.create(p)
+            for i in range(5):  # g2 pods bound in zone-a
+                p = make_pod(cpu="100m", name=f"g2-{i}", labels={"g2": "y"})
+                p.spec.node_name = "na"
+                store.create(p)
+            return SolverSnapshot(
+                store=store,
+                cluster=cluster,
+                node_pools=[np_],
+                instance_types={np_.metadata.name: types},
+                state_nodes=cluster.nodes(),
+                daemonset_pods=[],
+                pods=pending,
+                clock=clock,
+            )
+
+        tpu = TPUSolver(force=True)
+        results = tpu.solve(snap())
+        assert tpu.last_backend == "tpu"
+        violations = validate_results(snap(), results)
+        assert not violations, violations
+        ffd = FFDSolver().solve(snap())
+        assert set(results.pod_errors) == set(ffd.pod_errors), (results.pod_errors, ffd.pod_errors)
+        assert len(results.pod_errors) == 1  # no feasible zone: a violates g2, b violates g1
+
+
+class TestSignatureCapability:
+    def test_init_container_host_ports_split_signatures(self):
+        # capability runs on signature REPRESENTATIVES, so a spec field that
+        # changes capability (init-container hostPorts) must split signatures
+        # — otherwise pod order decides whether the fallback triggers
+        from karpenter_tpu.kube.objects import Container
+        from karpenter_tpu.solver.encode import pod_signature
+
+        plain = make_pod(cpu="1")
+        ported = make_pod(cpu="1")
+        ported.spec.init_containers = [Container(name="init", ports=[{"containerPort": 80, "hostPort": 80}])]
+        plain.spec.init_containers = [Container(name="init")]
+        assert pod_signature(plain) != pod_signature(ported)
+
+        snap = make_snapshot([plain, ported])
+        solver = TPUSolver()
+        results = solver.solve(snap)
+        assert solver.last_backend == "ffd-fallback"
+        assert "host ports" in " ".join(solver.last_fallback_reasons)
 
 
 class TestFallback:
