@@ -58,6 +58,12 @@ NODEPOOL_COST_TRACKER_ERRORS_TOTAL = "karpenter_nodepools_cost_tracker_errors_to
 CLUSTER_STATE_SYNCED = "karpenter_cluster_state_synced"
 CLUSTER_STATE_NODE_COUNT = "karpenter_cluster_state_node_count"
 
+# tensor-solver observability (no reference analogue — the FFD path *is* the
+# semantics there; the TPU backend re-derives placements so it self-checks)
+SOLVER_SOLVE_TOTAL = "karpenter_solver_solve_total"
+SOLVER_FALLBACK_TOTAL = "karpenter_solver_fallback_total"
+SOLVER_VALIDATION_FAILURES_TOTAL = "karpenter_solver_validation_failures_total"
+
 
 def make_registry() -> Registry:
     """A registry pre-populated with the reference's metric families."""
@@ -96,6 +102,9 @@ def make_registry() -> Registry:
     r.counter(NODEPOOL_COST_TRACKER_ERRORS_TOTAL, "Cost tracking errors", ("nodepool",))
     r.gauge(CLUSTER_STATE_SYNCED, "1 if cluster state is synced", ())
     r.gauge(CLUSTER_STATE_NODE_COUNT, "Nodes tracked by cluster state", ())
+    r.counter(SOLVER_SOLVE_TOTAL, "Solves by backend actually used", ("backend",))
+    r.counter(SOLVER_FALLBACK_TOTAL, "Tensor-path solves that fell back to the host FFD", ("reason",))
+    r.counter(SOLVER_VALIDATION_FAILURES_TOTAL, "Device placements rejected by the post-solve validator", ())
     return r
 
 

@@ -164,7 +164,7 @@ class Environment:
         if self.options.solver_backend == "tpu":
             from ..solver.tpu import TPUSolver
 
-            return TPUSolver()
+            return TPUSolver(registry=self.registry)
         return FFDSolver()
 
     # -- deterministic driver --------------------------------------------------

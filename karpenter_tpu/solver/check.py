@@ -1,0 +1,127 @@
+"""Vectorized post-solve validation of the device packer's raw assignment.
+
+Runs inside TPUSolver.solve on EVERY production solve, before decode: a
+device-kernel bug must never reach NodeClaim creation (the reference gets the
+equivalent guarantee for free because its FFD *is* the semantics; the tensor
+path re-derives placements, so it re-checks them). All checks are numpy
+passes over the encode-space arrays — O(pods) with small constants, a few ms
+at 50k pods against a ~0.8s solve.
+
+Checks (mirrors solver/validate.py's object-level rules in tensor space):
+- resource fit: per-slot total requests <= the basis row's allocatable;
+- requirement compatibility: every pod's label bitmask accepts its slot's
+  basis row, taints tolerated, slot zone-set intersects the pod's allowed
+  zones (requirements.go Compatible semantics via the interned vocabulary);
+- zone spread: per-group skew over final zone counts <= maxSkew, and
+  member slots committed to exactly one real zone;
+- hostname spread / anti-affinity: per-slot member counts <= maxSkew (anti:
+  <= 1), including counts from already-running pods on existing nodes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .encode import KIND_HOST_ANTI, KIND_HOST_SPREAD, KIND_ZONE_SPREAD
+
+# f32 row_alloc vs f64 totals: values are milli-CPU / MiB scaled, so 1e-3
+# absolute slack is far below one resource unit
+_EPS = 1e-3
+
+_MAX_ERRORS = 12
+
+
+def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zoneset: np.ndarray) -> list[str]:
+    """Returns a list of violations (empty = the placement is sound)."""
+    errors: list[str] = []
+    P = enc.n_pods
+    if P == 0:
+        return errors
+    sig = np.asarray(enc.sig_of_pod)
+    assignment = np.asarray(assignment)
+    slot_basis = np.asarray(slot_basis)
+    slot_zoneset = np.asarray(slot_zoneset)
+    N = slot_basis.shape[0]
+    valid = assignment >= 0
+    if not valid.any():
+        return errors
+    slots = assignment[valid].astype(np.int64)
+    psig = sig[valid]
+
+    out_of_range = (slots >= N) | (slot_basis[np.clip(slots, 0, N - 1)] < 0)
+    if out_of_range.any():
+        errors.append(f"{int(out_of_range.sum())} pods assigned to closed/out-of-range slots")
+        return errors  # downstream indexing would be garbage
+
+    rows = slot_basis[slots].astype(np.int64)  # basis row per placed pod
+
+    # -- resource fit ---------------------------------------------------------
+    R = enc.sig_req.shape[1]
+    total = np.zeros((N, R), dtype=np.float64)
+    pr = enc.sig_req[psig].astype(np.float64)
+    for r in range(R):
+        total[:, r] = np.bincount(slots, weights=pr[:, r], minlength=N)
+    used = np.unique(slots)
+    over = total[used] > enc.row_alloc[slot_basis[used].astype(np.int64)].astype(np.float64) + _EPS
+    if over.any():
+        for j in used[over.any(axis=1)][:_MAX_ERRORS]:
+            errors.append(f"slot {int(j)}: total requests exceed basis row allocatable")
+
+    # -- requirement compatibility -------------------------------------------
+    vals = enc.row_labels[rows]  # [Pv, K] value ids
+    word = (vals >> 5).astype(np.int64)
+    bit = (vals & 31).astype(np.uint32)
+    masks = enc.sig_mask[psig]  # [Pv, K, W] uint32
+    gathered = np.take_along_axis(masks, word[:, :, None], axis=2)[:, :, 0]
+    ok = ((gathered >> bit) & 1).astype(bool)  # [Pv, K]
+    if enc.zone_key_id >= 0:
+        ok[:, enc.zone_key_id] = True  # zones checked via the zone-set below
+    label_bad = ~ok.all(axis=1)
+    taint_bad = ~enc.sig_taint_ok[psig, enc.row_taint_class[rows]]
+    zone_bad = ~(slot_zoneset[slots] & enc.sig_zone_allowed[psig]).any(axis=1)
+    for name, bad in (("requirements", label_bad), ("taints", taint_bad), ("zone", zone_bad)):
+        if bad.any():
+            pidx = np.nonzero(valid)[0][bad]
+            for i in pidx[:_MAX_ERRORS]:
+                errors.append(f"pod {enc.pods[i].key()}: {name} incompatible with assigned slot")
+
+    # -- topology groups ------------------------------------------------------
+    G = enc.n_groups
+    if G:
+        member = enc.sig_member[psig]  # [Pv, G]
+        zone_groups = enc.group_kind == KIND_ZONE_SPREAD
+        host_groups = ~zone_groups
+
+        if zone_groups.any():
+            zs = slot_zoneset[slots]  # [Pv, Z]
+            n_real = zs[:, 1:].sum(axis=1)  # zone 0 = "no zone"
+            zone_of_slot = 1 + np.argmax(zs[:, 1:], axis=1)
+            zmember = member[:, zone_groups].any(axis=1)
+            uncommitted = zmember & (n_real != 1)
+            if uncommitted.any():
+                pidx = np.nonzero(valid)[0][uncommitted]
+                for i in pidx[:_MAX_ERRORS]:
+                    errors.append(f"pod {enc.pods[i].key()}: zone-spread member on slot without a committed zone")
+            Z = enc.n_zones
+            for g in np.nonzero(zone_groups)[0]:
+                sel = member[:, g] & (n_real == 1)
+                counts = enc.counts_zone_init[g].astype(np.int64) + np.bincount(zone_of_slot[sel], minlength=Z)
+                observed = counts[1:][counts[1:] > 0]
+                if observed.size and observed.max() - observed.min() > enc.group_skew[g]:
+                    errors.append(
+                        f"group {int(g)}: zone skew {int(observed.max() - observed.min())} > {int(enc.group_skew[g])}"
+                    )
+
+        if host_groups.any():
+            for g in np.nonzero(host_groups)[0]:
+                counts = np.bincount(slots[member[:, g]], minlength=N).astype(np.int64)
+                n_ex = enc.n_existing
+                if n_ex:
+                    counts[:n_ex] += enc.counts_host_existing[g, :n_ex].astype(np.int64)
+                cap = 1 if enc.group_kind[g] == KIND_HOST_ANTI else int(enc.group_skew[g])
+                bad_slots = np.nonzero(counts > cap)[0]
+                kind = "anti-affinity" if enc.group_kind[g] == KIND_HOST_ANTI else "hostname spread"
+                for j in bad_slots[:_MAX_ERRORS]:
+                    errors.append(f"group {int(g)}: {kind} violated on slot {int(j)} (count {int(counts[j])})")
+
+    return errors[:_MAX_ERRORS]

@@ -50,14 +50,36 @@ class _NullTopology:
         return Requirements()
 
 
+def _reason_family(reason: str) -> str:
+    """Stable low-cardinality label for a fallback reason (drop pod keys)."""
+    fam = reason.split(": ", 1)[-1]
+    return fam[:60]
+
+
 class TPUSolver:
     name = "tpu"
 
-    def __init__(self, fallback: FFDSolver | None = None, force: bool = False):
+    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None):
         self.fallback = fallback or FFDSolver()
         self.force = force  # raise instead of falling back (tests)
+        self.registry = registry
         self.last_backend: str = ""
         self.last_fallback_reasons: list[str] = []
+
+    def _count(self, metric: str, **labels) -> None:
+        if self.registry is not None:
+            self.registry.counter(metric).inc(**labels)
+
+    def _fall_back(self, snap: SolverSnapshot, reasons: list[str], family: str | None = None) -> Results:
+        from ..metrics import SOLVER_FALLBACK_TOTAL, SOLVER_SOLVE_TOTAL
+
+        self.last_backend = "ffd-fallback"
+        self.last_fallback_reasons = reasons
+        if family is None:
+            family = _reason_family(reasons[0]) if reasons else "empty"
+        self._count(SOLVER_FALLBACK_TOTAL, reason=family)
+        self._count(SOLVER_SOLVE_TOTAL, backend="ffd-fallback")
+        return self.fallback.solve(snap)
 
     def solve(self, snap: SolverSnapshot) -> Results:
         enc = encode(snap)
@@ -65,11 +87,9 @@ class TPUSolver:
         if enc.fallback_reasons:
             if self.force:
                 raise RuntimeError(f"tensor path unsupported: {enc.fallback_reasons}")
-            self.last_backend = "ffd-fallback"
-            return self.fallback.solve(snap)
+            return self._fall_back(snap, enc.fallback_reasons)
         if enc.n_pods == 0 or enc.n_rows == 0:
-            self.last_backend = "ffd-fallback"
-            return self.fallback.solve(snap)
+            return self._fall_back(snap, ["empty snapshot"])
 
         # signature-grouped pack: device steps scale with UNIQUE pod shapes,
         # not pods (scheduler_model_grouped.py). Slot axis capped; retry
@@ -92,7 +112,21 @@ class TPUSolver:
             takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack_grouped(t, items)
         nz_item, nz_slot, nz_count = compress_takes(takes, enc.n_pods)
         assignment = assignment_from_triples(nz_item, nz_slot, nz_count, item_pods, enc.n_pods)
-        return self._decode(snap, enc, assignment, np.asarray(slot_basis), np.asarray(slot_zoneset))
+
+        # every production solve self-checks before decode: a kernel bug must
+        # fall back to the exact host path, never reach NodeClaim creation
+        from ..metrics import SOLVER_SOLVE_TOTAL, SOLVER_VALIDATION_FAILURES_TOTAL
+        from .check import fast_validate
+
+        slot_basis_np, slot_zoneset_np = np.asarray(slot_basis), np.asarray(slot_zoneset)
+        violations = fast_validate(enc, assignment, slot_basis_np, slot_zoneset_np)
+        if violations:
+            self._count(SOLVER_VALIDATION_FAILURES_TOTAL)
+            if self.force:
+                raise RuntimeError(f"tensor placement failed validation: {violations}")
+            return self._fall_back(snap, [f"validation: {v}" for v in violations], family="validation")
+        self._count(SOLVER_SOLVE_TOTAL, backend="tpu")
+        return self._decode(snap, enc, assignment, slot_basis_np, slot_zoneset_np)
 
     # -- decode ----------------------------------------------------------------
     def _decode(self, snap: SolverSnapshot, enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zoneset: np.ndarray) -> Results:

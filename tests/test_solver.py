@@ -490,3 +490,57 @@ class TestFallback:
         results = solver.solve(snap)
         assert solver.last_backend == "ffd-fallback"
         assert results.all_pods_scheduled()
+
+
+class TestProductionValidation:
+    """A device-kernel bug must never reach NodeClaim creation: the in-solve
+    validator (solver/check.py) rejects the placement and the solve falls back
+    to the exact host FFD path."""
+
+    def _corrupting(self, original):
+        import jax.numpy as jnp
+
+        def corrupted(t, items):
+            takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = original(t, items)
+            # inject a bug: cram every pod onto slot 0 (overcommits resources)
+            bad = jnp.zeros_like(takes).at[:, 0].set(items.item_count)
+            return bad, jnp.zeros_like(leftovers), slot_basis, slot_zoneset, slot_rank, open_count
+
+        return corrupted
+
+    def test_injected_bug_falls_back_to_ffd(self, monkeypatch):
+        from karpenter_tpu.metrics import SOLVER_VALIDATION_FAILURES_TOTAL, make_registry
+        from karpenter_tpu.models import scheduler_model_grouped as smg
+
+        monkeypatch.setattr(smg, "greedy_pack_grouped", self._corrupting(smg.greedy_pack_grouped))
+        pods = [make_pod(cpu="7", memory="28Gi") for _ in range(64)]
+        registry = make_registry()
+        solver = TPUSolver(registry=registry)
+        results = solver.solve(make_snapshot(pods))
+        assert solver.last_backend == "ffd-fallback"
+        assert any("validation" in r for r in solver.last_fallback_reasons)
+        assert registry.counter(SOLVER_VALIDATION_FAILURES_TOTAL).total() == 1
+        # the fallback result is the exact host solution: everything scheduled
+        assert results.all_pods_scheduled()
+        assert not validate_results(make_snapshot(pods), results)
+
+    def test_injected_bug_raises_under_force(self, monkeypatch):
+        from karpenter_tpu.models import scheduler_model_grouped as smg
+
+        monkeypatch.setattr(smg, "greedy_pack_grouped", self._corrupting(smg.greedy_pack_grouped))
+        solver = TPUSolver(force=True)
+        with pytest.raises(RuntimeError, match="validation"):
+            solver.solve(make_snapshot([make_pod(cpu="7", memory="28Gi") for _ in range(64)]))
+
+    def test_valid_solve_passes_validator_with_registry(self):
+        from karpenter_tpu.metrics import SOLVER_SOLVE_TOTAL, SOLVER_VALIDATION_FAILURES_TOTAL, make_registry
+
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [make_pod(cpu="1", labels={"app": "w"}, tsc=[zone_spread(1, sel)]) for _ in range(9)]
+        registry = make_registry()
+        solver = TPUSolver(force=True, registry=registry)
+        results = solver.solve(make_snapshot(pods))
+        assert solver.last_backend == "tpu"
+        assert registry.counter(SOLVER_VALIDATION_FAILURES_TOTAL).total() == 0
+        assert registry.counter(SOLVER_SOLVE_TOTAL).value(backend="tpu") == 1
+        assert results.all_pods_scheduled()
