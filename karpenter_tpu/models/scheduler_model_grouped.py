@@ -151,28 +151,77 @@ def _waterfill(v, finite, c, cap):
     return jnp.where(finite, inc, 0)
 
 
-@partial(jax.jit, static_argnames=("zone_key", "n_existing", "n_slots"))
-def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key: int, n_existing: int, n_slots: int):
+def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_existing: int, n_slots: int, axis: str | None):
+    """The grouped pack scan, written once for both execution modes.
+
+    axis=None: single-device — slot arrays span the full [n_slots] axis and
+    the cross-slot reductions are plain cumsum/sum/any.
+
+    axis="...": the body is running INSIDE jax's shard_map with the slot axis
+    sharded across the mesh (parallel/sharded.py). Slot-state arrays
+    (slot_rem/basis/zoneset/rank, counts_host, takes) are LOCAL shards;
+    n_slots stays the GLOBAL count. The per-step vector work shards naturally;
+    the only cross-device communication is the first-fit prefix-sum
+    (all_gather of per-device capacity totals), the take/left totals (psum),
+    and per-zone slot availability (psum-of-any) — the TPU analogue of the
+    reference's parallelizeUntil fan-out over candidate nodes
+    (scheduler.go:939-961), riding ICI instead of goroutines."""
     W, R = items.item_req.shape
     N = n_slots
     Nrows = t.row_alloc.shape[0]
     G, Z = t.counts_zone_init.shape
     Q = t.rank_zoneset.shape[0]
 
-    slot_basis0 = jnp.full((N,), -1, dtype=jnp.int32)
-    slot_rem0 = jnp.full((N, R), NEG)
-    slot_zoneset0 = jnp.zeros((N, Z), dtype=bool)
-    slot_rank0 = jnp.full((N,), -1, dtype=jnp.int32)
+    if axis is None:
+        N_loc = N
+        slot_ids = jnp.arange(N, dtype=jnp.int32)
+
+        def gsum(v):
+            return jnp.sum(v)
+
+        def gprefix(v):
+            """Exclusive prefix-sum over the global slot axis."""
+            return jnp.cumsum(v) - v
+
+        def gany_slots(m):
+            """Any over the (global) slot axis of [N, ...]."""
+            return jnp.any(m, axis=0)
+    else:
+        N_loc = t.counts_host_init.shape[1]  # local shard width (static)
+        D = N // N_loc
+        didx = jax.lax.axis_index(axis)
+        slot_ids = (didx * N_loc + jnp.arange(N_loc)).astype(jnp.int32)  # global ids
+
+        def gsum(v):
+            return jax.lax.psum(jnp.sum(v), axis)
+
+        def gprefix(v):
+            local = jnp.cumsum(v)
+            totals = jax.lax.all_gather(local[-1], axis)  # [D]
+            offset = jnp.sum(jnp.where(jnp.arange(D) < didx, totals, 0))
+            return local - v + offset
+
+        def gany_slots(m):
+            return jax.lax.psum(jnp.any(m, axis=0).astype(jnp.int32), axis) > 0
+
+    # initial slot state from GLOBAL slot ids: ids < n_existing hold the
+    # existing nodes' remaining envelopes, the rest are closed
+    in_existing = slot_ids < n_existing
     if n_existing:
-        idx = jnp.arange(n_existing, dtype=jnp.int32)
-        slot_basis0 = slot_basis0.at[:n_existing].set(idx)
-        slot_rem0 = slot_rem0.at[:n_existing].set(t.row_alloc[:n_existing])
-        slot_zoneset0 = slot_zoneset0.at[:n_existing].set(t.existing_zoneset[:n_existing])
+        safe_row = jnp.clip(slot_ids, 0, Nrows - 1)
+        safe_ex = jnp.clip(slot_ids, 0, t.existing_zoneset.shape[0] - 1)
+        slot_basis0 = jnp.where(in_existing, slot_ids, -1).astype(jnp.int32)
+        slot_rem0 = jnp.where(in_existing[:, None], t.row_alloc[safe_row], NEG)
+        slot_zoneset0 = jnp.where(in_existing[:, None], t.existing_zoneset[safe_ex], False)
+    else:
+        slot_basis0 = jnp.full((N_loc,), -1, dtype=jnp.int32)
+        slot_rem0 = jnp.full((N_loc, R), NEG)
+        slot_zoneset0 = jnp.zeros((N_loc, Z), dtype=bool)
+    slot_rank0 = jnp.full((N_loc,), -1, dtype=jnp.int32)
 
     is_offering_row = jnp.arange(Nrows) >= n_existing
     zone_is_real = jnp.arange(Z) != NO_ZONE
     rank_of_row = jnp.clip(t.row_pool_rank, 0, Q - 1)
-    slot_ids = jnp.arange(N, dtype=jnp.int32)
 
     # item x row compatibility + row preference, one vectorized pass (W small)
     compat_items = compat_matrix(t.row_labels, t.row_taint_class, items.item_mask, items.item_taint_ok, zone_key, batch_size=256)
@@ -241,9 +290,9 @@ def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key:
             cap_res = _int_cap(slot_rem, req)
             cap_j = jnp.where(elig_mask, jnp.minimum(cap_res, member_host_cap(counts_host)), 0)
             cap_j = jnp.clip(cap_j, 0, INF_I)
-            prefix = jnp.cumsum(cap_j) - cap_j
+            prefix = gprefix(cap_j)
             take = jnp.clip(cnt - prefix, 0, cap_j).astype(jnp.int32)
-            left = cnt - jnp.sum(take)
+            left = cnt - gsum(take)
 
             # leftover -> new slots of the single best row
             rank_zone_ok = jnp.any(t.rank_zoneset & za_for_new[None, :], axis=1)
@@ -257,7 +306,7 @@ def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key:
             is_new = (slot_ids >= open_count) & (slot_ids < open_count + m)
             pos = slot_ids - open_count
             new_take = jnp.where(is_new, jnp.clip(left - pos * cstar, 0, cstar), 0).astype(jnp.int32)
-            left = left - jnp.sum(new_take)
+            left = left - gsum(new_take)
 
             new_zs = t.rank_zoneset[rank_of_row[o]] & za_for_new  # [Z]
             slot_basis = jnp.where(is_new, o, slot_basis)
@@ -287,7 +336,7 @@ def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key:
 
         def zone_path(op):
             slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count = op
-            slotcap_z = jnp.any((slot_compat & (_int_cap(slot_rem, req) > 0))[:, None] & slot_zoneset, axis=0)
+            slotcap_z = gany_slots((slot_compat & (_int_cap(slot_rem, req) > 0))[:, None] & slot_zoneset)
             vsum = jnp.sum(jnp.where(zone_member_mask[:, None], counts_zone, 0), axis=0)  # [Z]
             skew_star = jnp.min(jnp.where(zone_member_mask, t.group_skew, INF_I))
             allowed_real = za & zone_is_real
@@ -314,7 +363,7 @@ def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key:
             cap = jnp.clip(frozen_min + skew_star - vsum, 0, INF_I)
             cap = jnp.where(strict, jnp.where(finite, 1, 0), cap)
             inc = _waterfill(vsum, finite, c, cap)
-            take_all = jnp.zeros((N,), jnp.int32)
+            take_all = jnp.zeros((N_loc,), jnp.int32)
             pending = c - jnp.sum(inc)  # skew/availability-capped remainder
             placed_z = jnp.zeros((Z,), jnp.int32)
             for z in range(Z):  # Z is small and static; unrolled
@@ -369,6 +418,11 @@ def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key:
         step, init, jnp.arange(W, dtype=jnp.int32)
     )
     return takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count
+
+
+@partial(jax.jit, static_argnames=("zone_key", "n_existing", "n_slots"))
+def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key: int, n_existing: int, n_slots: int):
+    return _pack_body(t, items, zone_key=zone_key, n_existing=n_existing, n_slots=n_slots, axis=None)
 
 
 def greedy_pack_grouped(t: SchedulerTensors, items: ItemTensors):

@@ -3,39 +3,115 @@
 The provisioning solve has two parallelizable stages:
 
 1. the pod x row compatibility matrix — embarrassingly parallel over pods
-   (data-parallel axis "pods") and rows (model-parallel axis "rows");
-2. the greedy pack scan — sequential over pods, but its per-step vector work
-   (slot feasibility, row feasibility) shards over the "rows"/slot axis with
-   psum/all_gather reductions for the argmin choices.
+   (data-parallel axis); used by the per-pod scan path;
+2. the grouped greedy pack scan — sequential over work items, but its
+   per-step vector work (slot feasibility, the first-fit prefix-sum in
+   place(), per-zone slot availability) shards over the SLOT axis. This is
+   the real multi-chip execution path: `greedy_pack_grouped_sharded` runs
+   models/scheduler_model_grouped._pack_body inside jax.shard_map with the
+   slot axis partitioned across the mesh and psum/all_gather collectives for
+   the cross-slot reductions. Results are bit-identical to the single-device
+   kernel (integer prefix-sums and sums are exact under reordering), which
+   tests/test_sharded.py asserts on an 8-device CPU mesh.
 
 On one v5e chip none of this is needed (SURVEY.md §5: the solver is
 single-chip for the v0 target); this module is the ICI growth path and the
-driver's multi-chip dry-run target.
+driver's multi-chip dry-run target. Reference analogue: the goroutine fan-out
+over candidate nodes at scheduler.go:939-961 — here the fan-out is the mesh.
 """
 
 from __future__ import annotations
+
+import dataclasses
+import functools
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.scheduler_model import SchedulerTensors, greedy_pack
+from ..models.scheduler_model import SchedulerTensors, make_tensors
+from ..models.scheduler_model_grouped import (
+    ItemTensors,
+    _pack_body,
+    assignment_from_takes,
+    build_items,
+    greedy_pack_grouped,
+    make_item_tensors,
+)
 from ..ops.bitset import test_bit
 
 
-def make_mesh(devices=None, axis: str = "pods") -> Mesh:
+def make_mesh(devices=None, axis: str = "slots") -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.array(devices), (axis,))
 
 
-def sharded_compat_matrix(t: SchedulerTensors, mesh: Mesh):
-    """Pod x row compatibility, data-parallel over the pods axis.
+@functools.lru_cache(maxsize=64)
+def _sharded_pack_fn(mesh: Mesh, zone_key: int, n_existing: int, n_slots: int):
+    """The jitted shard_map'd pack kernel, cached so steady-state meshed
+    solves reuse one trace/compile per (mesh, statics) the way the
+    single-device @jax.jit kernel does (jit caches key on wrapper identity)."""
+    axis = mesh.axis_names[0]
+    meta = dict(zone_key=zone_key, n_existing=n_existing, n_slots=n_slots)
+    data = {f.name: P() for f in dataclasses.fields(SchedulerTensors) if f.name not in meta}
+    t_specs = dataclasses.replace(SchedulerTensors(**data, **meta), counts_host_init=P(None, axis))
+    item_specs = ItemTensors(**{f.name: P() for f in dataclasses.fields(ItemTensors)})
+    body = partial(_pack_body, zone_key=zone_key, n_existing=n_existing, n_slots=n_slots, axis=axis)
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(t_specs, item_specs),
+            out_specs=(P(None, axis), P(), P(axis), P(axis), P(axis), P()),
+            check_vma=False,
+        )
+    )
 
-    Pods shard across devices; row tensors are replicated. XLA inserts no
-    collectives in the forward pass (pure map); the all_gather happens only
-    if the caller requests a fully-replicated result.
+
+def greedy_pack_grouped_sharded(t: SchedulerTensors, items: ItemTensors, mesh: Mesh):
+    """The grouped pack scan with the slot axis sharded across `mesh`.
+
+    Same contract as greedy_pack_grouped: returns (takes [W, N], leftovers
+    [W], slot_basis [N], slot_zoneset [N, Z], slot_rank [N], open_count),
+    with N padded up to a multiple of the mesh size (extra slots are closed
+    and never used unless the original axis overflows).
     """
+    t = pad_slots_for_mesh(t, mesh)
+    fn = _sharded_pack_fn(mesh, t.zone_key, t.n_existing, t.n_slots)
+    return fn(t, items)
+
+
+def pad_slots_for_mesh(t: SchedulerTensors, mesh: Mesh) -> SchedulerTensors:
+    """Pad the slot axis up to a multiple of the mesh size (extra slots stay
+    closed and are only used if the original axis overflows)."""
+    N = t.n_slots
+    n_pad = (-N) % mesh.size
+    if n_pad or t.counts_host_init.shape[1] != N + n_pad:
+        ch = jnp.pad(jnp.asarray(t.counts_host_init), ((0, 0), (0, N + n_pad - t.counts_host_init.shape[1])))
+        t = dataclasses.replace(t, counts_host_init=ch, n_slots=N + n_pad)
+    return t
+
+
+def assert_sharded_equivalent(t: SchedulerTensors, items: ItemTensors, mesh: Mesh):
+    """Run the sharded AND single-device kernels on the same (padded) tensors
+    and raise unless every output is bit-identical. Returns the sharded
+    outputs. Shared by dryrun_step and tests/test_sharded.py."""
+    t_pad = pad_slots_for_mesh(t, mesh)
+    sharded = greedy_pack_grouped_sharded(t_pad, items, mesh)
+    single = greedy_pack_grouped(t_pad, items)
+    names = ("takes", "leftovers", "slot_basis", "slot_zoneset", "slot_rank", "open_count")
+    for name, a, b in zip(names, sharded, single):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            raise AssertionError(f"sharded pack diverged from single-device pack on {name}")
+    return sharded
+
+
+def sharded_compat_matrix(t: SchedulerTensors, mesh: Mesh):
+    """Pod x row compatibility, data-parallel over the pods axis (the per-pod
+    scan path's pre-pass). Pods shard across devices; row tensors are
+    replicated. XLA inserts no collectives in the forward pass (pure map)."""
     P_, K, W = t.pod_mask.shape
     axis = mesh.axis_names[0]
     pod_sharding = NamedSharding(mesh, P(axis))
@@ -67,14 +143,18 @@ def sharded_compat_matrix(t: SchedulerTensors, mesh: Mesh):
     return out[:P_]
 
 
-def dryrun_step(t: SchedulerTensors, mesh: Mesh):
-    """One full sharded solve step: sharded compat + the pack scan.
+def dryrun_step(enc, mesh: Mesh):
+    """One full SHARDED solve: the grouped pack scan under shard_map with the
+    slot axis partitioned across the mesh, checked for exact equivalence
+    against the single-device kernel on the same tensors.
 
     This is the driver's multi-chip validation entry: it must compile and
-    execute under an N-device mesh with real shardings.
+    execute under an N-device mesh with real shardings — and the thing it
+    executes is the production pack kernel, not a discarded pre-pass.
+    Returns the pod assignment derived from the sharded result.
     """
-    compat = sharded_compat_matrix(t, mesh)
-    compat.block_until_ready()
-    out = greedy_pack(t)
-    out[0].block_until_ready()
-    return out
+    item_arrays, item_pods = build_items(enc)
+    items = make_item_tensors(item_arrays)
+    t = make_tensors(enc, with_pods=False)
+    takes_s, left_s, *_ = assert_sharded_equivalent(t, items, mesh)
+    return assignment_from_takes(np.asarray(takes_s), np.asarray(left_s), item_pods, enc.n_pods)

@@ -50,21 +50,57 @@ class _NullTopology:
         return Requirements()
 
 
+# fixed enum of fallback families (encode.check_capability's reason set):
+# metric labels must be bounded, and reasons embed pod keys / topology keys
+_REASON_FAMILIES = (
+    ("validation", "validation"),
+    ("minValues", "min-values"),
+    ("pod affinity", "pod-affinity"),
+    ("non-hostname anti-affinity", "non-hostname-anti-affinity"),
+    ("preferred anti-affinity", "preferred-anti-affinity"),
+    ("relaxable node affinity", "relaxable-node-affinity"),
+    ("ScheduleAnyway", "schedule-anyway-spread"),
+    ("spread key", "non-zone-spread-key"),
+    ("spread policies", "spread-policies"),
+    ("node-filtered spread", "node-filtered-spread"),
+    ("host ports", "host-ports"),
+    ("PVC-backed volumes", "pvc-volumes"),
+    ("dynamic resource claims", "dra-claims"),
+    ("running pods with required anti-affinity", "running-anti-affinity"),
+    ("empty", "empty"),
+)
+
+
 def _reason_family(reason: str) -> str:
-    """Stable low-cardinality label for a fallback reason (drop pod keys)."""
-    fam = reason.split(": ", 1)[-1]
-    return fam[:60]
+    """Stable low-cardinality label for a fallback reason."""
+    for needle, family in _REASON_FAMILIES:
+        if needle in reason:
+            return family
+    return "other"
 
 
 class TPUSolver:
     name = "tpu"
 
-    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None):
+    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None, mesh=None):
         self.fallback = fallback or FFDSolver()
         self.force = force  # raise instead of falling back (tests)
         self.registry = registry
+        # multi-chip growth path: a jax.sharding.Mesh shards the pack scan's
+        # slot axis across devices (parallel/sharded.py); bit-identical to
+        # the single-device kernel, so everything downstream is unchanged
+        self.mesh = mesh
         self.last_backend: str = ""
         self.last_fallback_reasons: list[str] = []
+
+    def _pack(self, t, items):
+        if self.mesh is not None and self.mesh.size > 1:
+            from ..parallel.sharded import greedy_pack_grouped_sharded
+
+            return greedy_pack_grouped_sharded(t, items, self.mesh)
+        from ..models.scheduler_model_grouped import greedy_pack_grouped
+
+        return greedy_pack_grouped(t, items)
 
     def _count(self, metric: str, **labels) -> None:
         if self.registry is not None:
@@ -98,7 +134,6 @@ class TPUSolver:
             assignment_from_triples,
             build_items,
             compress_takes,
-            greedy_pack_grouped,
             make_item_tensors,
         )
 
@@ -106,10 +141,10 @@ class TPUSolver:
         items = make_item_tensors(item_arrays)
         cap = enc.n_existing + min(enc.n_pods, 4096)
         t = make_tensors(enc, n_slots=cap, with_pods=False)
-        takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack_grouped(t, items)
-        if int(open_count) == cap and int(np.asarray(leftovers).sum()) > 0 and cap < enc.n_existing + enc.n_pods:
+        takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = self._pack(t, items)
+        if int(open_count) == int(takes.shape[1]) and int(np.asarray(leftovers).sum()) > 0 and cap < enc.n_existing + enc.n_pods:
             t = make_tensors(enc, with_pods=False)
-            takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack_grouped(t, items)
+            takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = self._pack(t, items)
         nz_item, nz_slot, nz_count = compress_takes(takes, enc.n_pods)
         assignment = assignment_from_triples(nz_item, nz_slot, nz_count, item_pods, enc.n_pods)
 
