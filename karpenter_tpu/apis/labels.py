@@ -14,6 +14,8 @@ NODEPOOL_LABEL_KEY = f"{GROUP}/nodepool"
 CAPACITY_TYPE_LABEL_KEY = f"{GROUP}/capacity-type"
 NODE_REGISTERED_LABEL_KEY = f"{GROUP}/registered"
 NODE_INITIALIZED_LABEL_KEY = f"{GROUP}/initialized"
+# reference: pkg/cloudprovider/types.go ReservationIDLabel
+RESERVATION_ID_LABEL_KEY = f"{GROUP}/reservation-id"
 
 CAPACITY_TYPE_SPOT = "spot"
 CAPACITY_TYPE_ON_DEMAND = "on-demand"
@@ -74,6 +76,10 @@ LABEL_DOMAIN_EXCEPTIONS = {
 WELL_KNOWN_LABELS = {
     NODEPOOL_LABEL_KEY,
     CAPACITY_TYPE_LABEL_KEY,
+    # providers register their reservation-id label as well-known so claims
+    # without a reservation requirement stay compatible with reserved
+    # offerings (reference fake/cloudprovider.go:43-47 init)
+    RESERVATION_ID_LABEL_KEY,
     ZONE_LABEL_KEY,
     REGION_LABEL_KEY,
     INSTANCE_TYPE_LABEL_KEY,

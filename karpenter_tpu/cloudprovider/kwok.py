@@ -101,6 +101,13 @@ class KWOKCloudProvider:
     def get_supported_node_classes(self) -> list[str]:
         return ["KWOKNodeClass"]
 
+    def _reservation_used(self, rid: str) -> int:
+        """Live nodes (including registration-pending ones) holding this
+        reservation id."""
+        n = sum(1 for node in self.store.list("Node") if node.metadata.labels.get(wk.RESERVATION_ID_LABEL_KEY) == rid)
+        n += sum(1 for _, node in self._pending_nodes if node.metadata.labels.get(wk.RESERVATION_ID_LABEL_KEY) == rid)
+        return n
+
     # -- conversion ------------------------------------------------------------
     def _to_node(self, node_claim: NodeClaim) -> Node:
         reqs = Requirements.from_node_selector_terms(node_claim.spec.requirements)
@@ -115,6 +122,11 @@ class KWOKCloudProvider:
                 raise InsufficientCapacityError(f"instance type {val} not found")
             for o in it.offerings:
                 if not o.available or reqs.intersects(o.requirements) is not None:
+                    continue
+                # launch-side reservation enforcement (the real providers do
+                # this in their fleet APIs): a reserved offering whose
+                # reservation is fully consumed by live nodes is not launchable
+                if o.capacity_type() == wk.CAPACITY_TYPE_RESERVED and self._reservation_used(o.reservation_id()) >= o.reservation_capacity:
                     continue
                 if best_offering is None or o.price < best_offering.price:
                     best_it, best_offering = it, o

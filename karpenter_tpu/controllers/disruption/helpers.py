@@ -31,6 +31,9 @@ def simulate_scheduling(provisioner, cluster, candidates: list, clock):
     snapshot = provisioner.make_snapshot(pods, state_nodes=state_nodes)
     snapshot.enforce_consolidate_after = True
     snapshot.deleting_node_names = candidate_names
+    # consolidation must not fall back into reserved capacity it failed to
+    # reserve (consolidation.go:45 DisableReservedCapacityFallback)
+    snapshot.reserved_offering_mode = "strict"
     results = provisioner.solver.solve(snapshot)
     # prune claims that ended up empty
     results.new_node_claims = [nc for nc in results.new_node_claims if nc.pods]

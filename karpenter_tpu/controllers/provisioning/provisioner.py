@@ -26,6 +26,7 @@ class ProvisionerOptions:
     batch_max_seconds: float = 10.0
     capacity_buffer_enabled: bool = False  # CapacityBuffer feature gate
     dynamic_resources_enabled: bool = False  # DynamicResources feature gate
+    reserved_capacity_enabled: bool = True  # ReservedCapacity feature gate
 
 
 class Provisioner:
@@ -185,6 +186,7 @@ class Provisioner:
             preference_policy=self.options.preference_policy,
             min_values_policy=self.options.min_values_policy,
             dra_enabled=self.options.dynamic_resources_enabled,
+            reserved_capacity_enabled=self.options.reserved_capacity_enabled,
         )
 
     def create_node_claim(self, scheduling_claim, reason: str = "provisioning") -> str | None:

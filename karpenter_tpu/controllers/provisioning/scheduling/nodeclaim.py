@@ -98,7 +98,16 @@ class SchedulingNodeClaim:
     """A NodeClaim being built up during a single Solve
     (scheduling/nodeclaim.go:52-120)."""
 
-    def __init__(self, template: NodeClaimTemplate, topology, daemon_overhead_groups: list[DaemonOverheadGroup], instance_types: list[InstanceType], allocator=None):
+    def __init__(
+        self,
+        template: NodeClaimTemplate,
+        topology,
+        daemon_overhead_groups: list[DaemonOverheadGroup],
+        instance_types: list[InstanceType],
+        allocator=None,
+        reservation_manager=None,
+        reserved_offering_mode: str = "fallback",  # fallback | strict (scheduler.go:59-77)
+    ):
         self.template = template
         self.topology = topology
         self.daemon_overhead_groups = [g.copy() for g in daemon_overhead_groups]
@@ -107,6 +116,13 @@ class SchedulingNodeClaim:
         self.allocator = allocator  # DRA; None when the gate is off
         self.dra_trackers: dict = {}  # instance type name -> AllocationTracker
         self._pending_dra = None  # {it name: AllocationResult} awaiting add()
+        # reserved-offering accounting (nodeclaim.go:43-62): the claim tracks
+        # the reserved offerings it currently holds so stale ones release on
+        # later narrowing and compatible ones can re-expand across iterations
+        self.reservation_manager = reservation_manager
+        self.reserved_offering_mode = reserved_offering_mode
+        self.reserved_offerings: list = []
+        self._pending_reserved: list = []
         self.requirements = Requirements()
         self.requirements.add(*template.requirements.values())
         self.hostname = f"hostname-placeholder-{next(_hostname_seq):05d}"
@@ -136,6 +152,7 @@ class SchedulingNodeClaim:
         # downstream topology and instance-type checks (nodeclaim.go:138-157)
         last_err = None
         self._pending_dra = None
+        self._pending_reserved = []
         for vol_reqs in pod_data.volume_requirements or [None]:
             reqs, its, err = self._try_volume_alternative(pod, pod_data, base, vol_reqs, relax_min_values)
             if err is not None:
@@ -205,13 +222,54 @@ class SchedulingNodeClaim:
                 return None, None, "no instance type can allocate the pod's dynamic resources"
             remaining = surviving
             self._pending_dra = per_it
+
+        # reserved-offering reservations (nodeclaim.go:303-350): collect every
+        # compatible+available reserved offering the claim could launch into;
+        # under strict mode, fail rather than silently lose reserved capacity
+        ofs, rerr = self._offerings_to_reserve(remaining, claim_reqs)
+        if rerr is not None:
+            return None, None, rerr
+        self._pending_reserved = ofs
         return claim_reqs, remaining, None
+
+    def _offerings_to_reserve(self, instance_types: list[InstanceType], claim_reqs: Requirements):
+        """Returns (reservable offerings, err). Reservation is pessimistic:
+        any reserved offering the claim is compatible with is claimed, so two
+        claims in one solve can never oversubscribe a reservation."""
+        if self.reservation_manager is None:
+            return [], None
+        has_compatible = False
+        reservable = []
+        for it in instance_types:
+            for o in it.offerings:
+                if not o.available or o.capacity_type() != wk.CAPACITY_TYPE_RESERVED:
+                    continue
+                if claim_reqs.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is not None:
+                    continue
+                has_compatible = True
+                if self.reservation_manager.can_reserve(self.hostname, o):
+                    reservable.append(o)
+        if self.reserved_offering_mode == "strict":
+            if has_compatible and not reservable:
+                return None, "reserved offering error: compatible reserved offerings exist but could not be reserved"
+            if self.reserved_offerings and not reservable:
+                return None, "reserved offering error: updated constraints would remove all reserved offering options"
+        return reservable, None
 
     def add(self, pod, pod_data, updated_requirements: Requirements, updated_instance_types: list[InstanceType]) -> None:
         self.pods.append(pod)
         self.requirements = updated_requirements
         self.instance_type_options = updated_instance_types
         self.spec_requests = res.merge(self.spec_requests, pod_data.requests)
+        if self.reservation_manager is not None:
+            # reserve the surviving set, release what narrowing dropped
+            # (nodeclaim.go:260-262 + releaseReservedOfferings :280-295)
+            self.reservation_manager.reserve(self.hostname, *self._pending_reserved)
+            updated_ids = {o.reservation_id() for o in self._pending_reserved}
+            stale = [o for o in self.reserved_offerings if o.reservation_id() not in updated_ids]
+            self.reservation_manager.release(self.hostname, *stale)
+            self.reserved_offerings = self._pending_reserved
+            self._pending_reserved = []
         if self._pending_dra is not None and self.allocator is not None:
             # commit per-instance-type device picks so later pods on this
             # in-flight node see the consumed template budget
@@ -226,12 +284,19 @@ class SchedulingNodeClaim:
         self.topology.record(pod, self.template.taints, self.requirements)
 
     def finalize(self) -> None:
-        """Drop the hostname placeholder so the claim can land anywhere
-        (nodeclaim.go:383-409)."""
+        """Drop the hostname placeholder so the claim can land anywhere; pin
+        reserved claims to their reservation ids (nodeclaim.go:383-409)."""
         reqs = Requirements()
         for key, r in self.requirements.items():
             if key != wk.HOSTNAME_LABEL_KEY:
                 reqs.replace(r)
+        if self.reserved_offerings:
+            # tightening to reserved gives automatic drift handling when the
+            # capacity-type label is later updated by the cloud provider, and
+            # the id set prevents overlaunching into a single reservation
+            reqs.replace(Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [wk.CAPACITY_TYPE_RESERVED]))
+            rids = sorted({o.reservation_id() for o in self.reserved_offerings})
+            reqs.replace(Requirement(wk.RESERVATION_ID_LABEL_KEY, "In", rids))
         self.requirements = reqs
 
     def to_api_node_claim(self, clock=None) -> APINodeClaim:

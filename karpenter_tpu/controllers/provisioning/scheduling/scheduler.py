@@ -83,6 +83,8 @@ class Scheduler:
         deleting_node_names: set[str] | None = None,
         timeout_seconds: float = 60.0,
         dra_enabled: bool = False,
+        reserved_capacity_enabled: bool = True,
+        reserved_offering_mode: str = "fallback",
     ):
         self.store = store
         self.cluster = cluster
@@ -100,6 +102,15 @@ class Scheduler:
             from ....scheduling.dynamicresources import Allocator
 
             self.allocator = Allocator(store, clock)
+
+        # one ReservationManager per solve, shared by every claim so reserved
+        # capacity is bounded ACROSS claims (scheduler.go:186, NewScheduler)
+        self.reservation_manager = None
+        self.reserved_offering_mode = reserved_offering_mode
+        if reserved_capacity_enabled:
+            from .reservationmanager import ReservationManager
+
+            self.reservation_manager = ReservationManager(instance_types)
 
         # NodePools ordered by weight desc (provisioner.go:268-289)
         pools = sorted(node_pools, key=lambda np: (-np.spec.weight, np.metadata.name))
@@ -288,7 +299,15 @@ class Scheduler:
                 if not its:
                     errs.append(f"all available instance types exceed limits for nodepool {t.nodepool_name}")
                     continue
-            nc = SchedulingNodeClaim(t, self.topology, self.daemon_overhead_groups[id(t)], its, allocator=self.allocator)
+            nc = SchedulingNodeClaim(
+                t,
+                self.topology,
+                self.daemon_overhead_groups[id(t)],
+                its,
+                allocator=self.allocator,
+                reservation_manager=self.reservation_manager,
+                reserved_offering_mode=self.reserved_offering_mode,
+            )
             reqs, rem_its, err = nc.can_add(pod, pod_data, relax_min_values=(self.min_values_policy == "BestEffort"))
             if err is not None:
                 errs.append(f"{t.nodepool_name}: {err}")

@@ -106,6 +106,7 @@ class Environment:
                 batch_max_seconds=self.options.batch_max_duration,
                 capacity_buffer_enabled=self.options.feature_gates.capacity_buffer,
                 dynamic_resources_enabled=self.options.feature_gates.dynamic_resources,
+                reserved_capacity_enabled=self.options.feature_gates.reserved_capacity,
             ),
         )
         self.device_allocation = DeviceAllocationController(self.store, self.cluster, self.clock)

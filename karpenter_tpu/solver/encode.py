@@ -325,6 +325,20 @@ def check_capability(snap, pods=None) -> list[str]:
     # inverse anti-affinity from already-running pods isn't tensorized
     if snap.cluster.pods_with_anti_affinity():
         reasons.append("cluster has running pods with required anti-affinity")
+    # strict reserved-offering mode (consolidation sims) requires per-pod
+    # reservation failures, which only the sequential host path expresses;
+    # decode's host-side cap implements fallback mode only
+    if (
+        getattr(snap, "reserved_offering_mode", "fallback") == "strict"
+        and getattr(snap, "reserved_capacity_enabled", True)
+        and any(
+            o.available and o.capacity_type() == wk.CAPACITY_TYPE_RESERVED
+            for its in snap.instance_types.values()
+            for it in its
+            for o in it.offerings
+        )
+    ):
+        reasons.append("strict reserved-offering mode with reserved offerings")
     return reasons
 
 

@@ -23,5 +23,7 @@ class FFDSolver:
             enforce_consolidate_after=snap.enforce_consolidate_after,
             deleting_node_names=snap.deleting_node_names,
             dra_enabled=snap.dra_enabled,
+            reserved_capacity_enabled=snap.reserved_capacity_enabled,
+            reserved_offering_mode=snap.reserved_offering_mode,
         )
         return scheduler.solve(snap.pods)

@@ -24,3 +24,5 @@ class SolverSnapshot:
     enforce_consolidate_after: bool = False
     deleting_node_names: set = field(default_factory=set)
     dra_enabled: bool = False
+    reserved_capacity_enabled: bool = True  # ReservedCapacity feature gate
+    reserved_offering_mode: str = "fallback"  # strict for consolidation sims

@@ -186,6 +186,18 @@ class TPUSolver:
             existing_nodes.append(en)
             existing_by_slot[j] = en
 
+        # host-side reserved-capacity cap (SURVEY.md §7: reservations are
+        # inherently sequential — keep host-side): claims are walked in slot
+        # order and pessimistically reserve compatible reserved offerings the
+        # way the FFD's per-claim offeringsToReserve does; claims that cannot
+        # reserve are pinned away from reserved capacity so the launch can
+        # never oversubscribe a reservation
+        reservation_manager = None
+        if snap.reserved_capacity_enabled:
+            from ..controllers.provisioning.scheduling.reservationmanager import ReservationManager
+
+            reservation_manager = ReservationManager(snap.instance_types)
+
         overhead_groups_cache: dict[int, list] = {}
         # per-slot work dedupes by SIGNATURE: pod requirements/requests lower
         # once per unique shape (encode.sig_*). The expensive per-slot pass —
@@ -274,6 +286,8 @@ class TPUSolver:
                 fits = np.all(alloc_mat[members] >= total_vec[None, :] + ovh[None, :], axis=1)
                 remaining.extend(its[m] for m, ok in zip(members, fits & mask[members]) if ok)
             claim.instance_type_options = remaining if remaining else [it]
+            if reservation_manager is not None:
+                self._apply_reservations(claim, reservation_manager)
             new_claims.append(claim)
 
         return Results(
@@ -281,6 +295,40 @@ class TPUSolver:
             existing_nodes=existing_nodes,
             pod_errors=pod_errors,
         )
+
+    @staticmethod
+    def _apply_reservations(claim, reservation_manager) -> None:
+        """Reserve compatible reserved offerings for this claim and pin its
+        requirements (mirrors nodeclaim.go offeringsToReserve:303-350 +
+        FinalizeScheduling:394-404); claims beyond a reservation's capacity
+        are excluded from reserved capacity entirely."""
+        has_compatible = False
+        reservable = []
+        for cand in claim.instance_type_options:
+            for o in cand.offerings:
+                if not o.available or o.capacity_type() != wk.CAPACITY_TYPE_RESERVED:
+                    continue
+                if claim.requirements.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is not None:
+                    continue
+                has_compatible = True
+                if reservation_manager.can_reserve(claim.hostname, o):
+                    reservable.append(o)
+        if reservable:
+            reservation_manager.reserve(claim.hostname, *reservable)
+            claim.reserved_offerings = reservable
+            claim.requirements.replace(Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [wk.CAPACITY_TYPE_RESERVED]))
+            rids = sorted({o.reservation_id() for o in reservable})
+            claim.requirements.replace(Requirement(wk.RESERVATION_ID_LABEL_KEY, "In", rids))
+        elif has_compatible:
+            # reserved capacity exhausted by earlier claims in this solve:
+            # keep this claim off reserved offerings
+            cur = claim.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY)
+            if cur.operator() == Operator.IN:
+                allowed = [v for v in cur.values_list() if v != wk.CAPACITY_TYPE_RESERVED]
+                if allowed:
+                    claim.requirements.replace(Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", allowed))
+            else:
+                claim.requirements.replace(Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "NotIn", [wk.CAPACITY_TYPE_RESERVED]))
 
     @staticmethod
     def _template_ctx(template, groups, enc, cache: dict):

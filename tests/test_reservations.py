@@ -1,0 +1,217 @@
+"""Reserved-offering reservation accounting.
+
+Reference specs: scheduling/reservationmanager_test.go + the reserved paths
+of nodeclaim.go:303-350 (offeringsToReserve) and FinalizeScheduling:394-404.
+Core guarantee: two NodeClaims in ONE solve can never oversubscribe a
+reservation, on either solver backend.
+"""
+
+import pytest
+
+from helpers import make_nodepool, make_pod
+from test_solver import LINUX_AMD64, make_snapshot
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.cloudprovider.types import order_by_price
+from karpenter_tpu.controllers.provisioning.scheduling.reservationmanager import ReservationManager
+from karpenter_tpu.scheduling.requirements import Operator, Requirement, Requirements
+from karpenter_tpu.solver import FFDSolver
+from karpenter_tpu.solver.tpu import TPUSolver
+from karpenter_tpu.solver.validate import validate_results
+
+
+def reserved_types(reserved_capacity=1, cpu=16, zones=("test-zone-a",)):
+    return [catalog.make_instance_type("c", cpu, zones=list(zones), include_reserved=True, reserved_capacity=reserved_capacity)]
+
+
+def claim_capacity_types(nc):
+    r = nc.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY)
+    return r
+
+
+class TestReservationManager:
+    def test_capacity_tracks_minimum_across_duplicate_ids(self):
+        a = reserved_types(reserved_capacity=5)[0]
+        b = reserved_types(reserved_capacity=2)[0]  # same rid, smaller capacity
+        rm = ReservationManager({"p1": [a], "p2": [b]})
+        o = next(o for o in a.offerings if o.capacity_type() == wk.CAPACITY_TYPE_RESERVED)
+        assert rm.remaining_capacity(o) == 2
+
+    def test_reserve_is_idempotent_per_host(self):
+        it = reserved_types(reserved_capacity=1)[0]
+        o = next(o for o in it.offerings if o.capacity_type() == wk.CAPACITY_TYPE_RESERVED)
+        rm = ReservationManager({"p": [it]})
+        assert rm.can_reserve("h1", o)
+        rm.reserve("h1", o)
+        rm.reserve("h1", o)  # idempotent: no second unit consumed
+        assert rm.remaining_capacity(o) == 0
+        assert rm.has_reservation("h1", o)
+        # capacity exhausted for other hosts, still reservable for h1
+        assert not rm.can_reserve("h2", o)
+        assert rm.can_reserve("h1", o)
+
+    def test_release_returns_capacity(self):
+        it = reserved_types(reserved_capacity=1)[0]
+        o = next(o for o in it.offerings if o.capacity_type() == wk.CAPACITY_TYPE_RESERVED)
+        rm = ReservationManager({"p": [it]})
+        rm.reserve("h1", o)
+        rm.release("h1", o)
+        assert rm.remaining_capacity(o) == 1
+        assert rm.can_reserve("h2", o)
+        # releasing an unheld reservation is a no-op
+        rm.release("h2", o)
+        assert rm.remaining_capacity(o) == 1
+
+    def test_over_reserve_raises(self):
+        it = reserved_types(reserved_capacity=1)[0]
+        o = next(o for o in it.offerings if o.capacity_type() == wk.CAPACITY_TYPE_RESERVED)
+        rm = ReservationManager({"p": [it]})
+        rm.reserve("h1", o)
+        with pytest.raises(RuntimeError, match="over-reserve"):
+            rm.reserve("h2", o)
+
+
+class TestOrderByPrice:
+    def test_reserved_priced_under_spot_wins(self):
+        its = reserved_types(reserved_capacity=1)
+        reqs = Requirements(
+            Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [wk.CAPACITY_TYPE_RESERVED, wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND])
+        )
+        it = its[0]
+        reserved_price = next(o.price for o in it.offerings if o.capacity_type() == wk.CAPACITY_TYPE_RESERVED)
+        spot_price = next(o.price for o in it.offerings if o.capacity_type() == wk.CAPACITY_TYPE_SPOT)
+        assert reserved_price < spot_price
+        ordered = order_by_price(its, reqs)
+        assert ordered[0] is it
+        # excluding reserved raises the effective launch price to spot
+        no_reserved = Requirements(Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "NotIn", [wk.CAPACITY_TYPE_RESERVED]))
+        assert min(o.price for o in it.offerings if no_reserved.intersects(o.requirements) is None) == spot_price
+
+
+def two_big_pods_snapshot(types, **kw):
+    # each pod fills most of a 16-cpu node: two claims result
+    pods = [make_pod(cpu="12") for _ in range(2)]
+    snap = make_snapshot(pods, types=types)
+    for k, v in kw.items():
+        setattr(snap, k, v)
+    return snap
+
+
+class TestSchedulerReservations:
+    def test_two_claims_cannot_oversubscribe(self):
+        # one reservation unit; two claims — exactly one may pin reserved
+        results = FFDSolver().solve(two_big_pods_snapshot(reserved_types(reserved_capacity=1)))
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 2
+        pinned = [nc for nc in results.new_node_claims if claim_capacity_types(nc).values_list() == [wk.CAPACITY_TYPE_RESERVED]]
+        assert len(pinned) == 1, [claim_capacity_types(nc).values_list() for nc in results.new_node_claims]
+        # the reserved claim carries its reservation id requirement
+        rid_req = pinned[0].requirements.get(wk.RESERVATION_ID_LABEL_KEY)
+        assert rid_req.operator() == Operator.IN and rid_req.values_list() == ["r-c-16x-amd64-linux-test-zone-a"]
+
+    def test_capacity_two_serves_two_claims(self):
+        results = FFDSolver().solve(two_big_pods_snapshot(reserved_types(reserved_capacity=2)))
+        assert not results.pod_errors
+        pinned = [nc for nc in results.new_node_claims if claim_capacity_types(nc).values_list() == [wk.CAPACITY_TYPE_RESERVED]]
+        assert len(pinned) == 2
+
+    def test_gate_off_leaves_claims_unpinned(self):
+        snap = two_big_pods_snapshot(reserved_types(reserved_capacity=1), reserved_capacity_enabled=False)
+        results = FFDSolver().solve(snap)
+        assert not results.pod_errors
+        for nc in results.new_node_claims:
+            # no reservation accounting: claims are never pinned to reserved
+            assert claim_capacity_types(nc).values_list() != [wk.CAPACITY_TYPE_RESERVED]
+            assert not nc.reserved_offerings
+            # the API claim still narrows capacity types from offerings alone
+            api = nc.to_api_node_claim()
+            cts = next(r for r in api.spec.requirements if r["key"] == wk.CAPACITY_TYPE_LABEL_KEY)
+            assert wk.CAPACITY_TYPE_RESERVED in cts["values"]
+
+    def test_strict_mode_fails_pod_when_unreservable(self):
+        # capacity 0: compatible reserved offerings exist, none reservable
+        snap = two_big_pods_snapshot(reserved_types(reserved_capacity=0), reserved_offering_mode="strict")
+        results = FFDSolver().solve(snap)
+        assert len(results.pod_errors) == 2
+        assert all("reserved offering" in e for e in results.pod_errors.values())
+
+    def test_fallback_mode_schedules_without_reservation(self):
+        snap = two_big_pods_snapshot(reserved_types(reserved_capacity=0))
+        results = FFDSolver().solve(snap)
+        assert not results.pod_errors
+        for nc in results.new_node_claims:
+            assert claim_capacity_types(nc).values_list() != [wk.CAPACITY_TYPE_RESERVED]
+
+
+class TestTPUDecodeReservations:
+    def test_decode_caps_reservations_across_claims(self):
+        snap = two_big_pods_snapshot(reserved_types(reserved_capacity=1))
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert not results.pod_errors
+        assert len(results.new_node_claims) == 2
+        pinned, unpinned = [], []
+        for nc in results.new_node_claims:
+            r = nc.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY)
+            if r.operator() == Operator.IN and r.values_list() == [wk.CAPACITY_TYPE_RESERVED]:
+                pinned.append(nc)
+            else:
+                unpinned.append(nc)
+        assert len(pinned) == 1 and len(unpinned) == 1
+        # the unpinned claim can no longer land on reserved capacity
+        assert not unpinned[0].requirements.get(wk.CAPACITY_TYPE_LABEL_KEY).has(wk.CAPACITY_TYPE_RESERVED)
+        assert not validate_results(two_big_pods_snapshot(reserved_types(reserved_capacity=1)), results)
+
+    def test_strict_mode_falls_back_to_ffd(self):
+        snap = two_big_pods_snapshot(reserved_types(reserved_capacity=1), reserved_offering_mode="strict")
+        solver = TPUSolver()
+        results = solver.solve(snap)
+        assert solver.last_backend == "ffd-fallback"
+        assert "strict reserved-offering" in " ".join(solver.last_fallback_reasons)
+
+    def test_tpu_and_ffd_agree_on_reserved_outcome(self):
+        tpu = TPUSolver(force=True)
+        r_tpu = tpu.solve(two_big_pods_snapshot(reserved_types(reserved_capacity=1)))
+        r_ffd = FFDSolver().solve(two_big_pods_snapshot(reserved_types(reserved_capacity=1)))
+
+        def reserved_count(results):
+            n = 0
+            for nc in results.new_node_claims:
+                r = nc.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY)
+                if r.operator() == Operator.IN and r.values_list() == [wk.CAPACITY_TYPE_RESERVED]:
+                    n += 1
+            return n
+
+        assert reserved_count(r_tpu) == reserved_count(r_ffd) == 1
+
+
+class TestKWOKLaunchEnforcement:
+    def test_launch_skips_exhausted_reservations(self):
+        # launch-side guard (real providers enforce in their fleet APIs): even
+        # an unpinned claim must not launch into a consumed reservation
+        from karpenter_tpu.apis.kwoknodeclass import KWOKNodeClass
+        from karpenter_tpu.apis.nodeclaim import NodeClaim, NodeClassReference
+        from karpenter_tpu.cloudprovider.kwok import KWOKCloudProvider
+        from karpenter_tpu.kube import ObjectMeta, Store
+
+        store = Store()
+        store.create(KWOKNodeClass())
+        its = reserved_types(reserved_capacity=1)
+        cp = KWOKCloudProvider(store, its)
+
+        def claim(i):
+            nc = NodeClaim(metadata=ObjectMeta(name=f"nc-{i}"))
+            nc.spec.node_class_ref = NodeClassReference(name="default")
+            nc.spec.requirements = [
+                {"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": [its[0].name]},
+            ]
+            return nc
+
+        first = cp.create(claim(0))
+        second = cp.create(claim(1))
+        assert first.metadata.labels[wk.CAPACITY_TYPE_LABEL_KEY] == wk.CAPACITY_TYPE_RESERVED
+        # reservation consumed: the second node falls to the next-cheapest
+        # (spot) offering instead of oversubscribing
+        assert second.metadata.labels[wk.CAPACITY_TYPE_LABEL_KEY] == wk.CAPACITY_TYPE_SPOT
+        assert wk.RESERVATION_ID_LABEL_KEY not in second.metadata.labels
