@@ -29,6 +29,9 @@ class _Ctx:
     # a Balanced pool ever pay for the totals pass
     round_candidates: list | None = None
     node_pool_totals: dict | None = None
+    # live candidate rebuild for the 15s command validator (validation.go)
+    get_candidates: object = None
+    metrics: object = None
 
     def balanced_totals(self) -> dict:
         if self.node_pool_totals is None:
@@ -49,7 +52,8 @@ class DisruptionController:
         self.clock = clock
         self.options = options
         self.cluster_cost = cluster_cost
-        ctx = _Ctx(store, cluster, provisioner, clock, options, cluster_cost=cluster_cost)
+        ctx = _Ctx(store, cluster, provisioner, clock, options, cluster_cost=cluster_cost, metrics=metrics)
+        ctx.get_candidates = self.get_candidates
         self.ctx = ctx
         self.methods = [
             Emptiness(ctx),

@@ -52,7 +52,16 @@ class Emptiness:
                 allowed[pool] -= 1
         if not chosen:
             return []
-        return [Command(reason=REASON_EMPTY, candidates=chosen)]
+        cmd = Command(reason=REASON_EMPTY, candidates=chosen)
+        # 15s wait + live re-check; the command shrinks to surviving nodes
+        # (emptiness.go:101, validation.go:134-148)
+        from .validation import ValidationError, Validator
+
+        try:
+            cmd = Validator(self.ctx, self, mode="subset", metrics=self.ctx.metrics).validate(cmd)
+        except ValidationError:
+            return []
+        return [cmd]
 
 
 class StaticDrift:
@@ -241,6 +250,8 @@ class SingleNodeConsolidation(_ConsolidationBase):
     consolidation_type = "single"
 
     def compute_commands(self, candidates, budgets) -> list[Command]:
+        from .validation import ValidationError, Validator
+
         eligible = sorted((c for c in candidates if self.should_disrupt(c)), key=lambda c: c.disruption_cost)
         allowed = dict(budgets)
         for c in eligible:
@@ -249,6 +260,12 @@ class SingleNodeConsolidation(_ConsolidationBase):
                 continue
             cmd = self.compute_consolidation([c])
             if cmd.candidates and self._passes_balanced(cmd):
+                # 15s wait + re-simulation before execution
+                # (singlenodeconsolidation.go:105, validation.go:192-263)
+                try:
+                    Validator(self.ctx, self, mode="strict", metrics=self.ctx.metrics).validate(cmd)
+                except ValidationError:
+                    return []
                 return [cmd]
         return []
 
@@ -276,14 +293,24 @@ class MultiNodeConsolidation(_ConsolidationBase):
             return []
         # TPU backend: annealed subset search proposes candidate sets; each is
         # exact-validated through the same simulation before use (stage 8)
+        cmd = Command()
         if getattr(self.ctx.options, "solver_backend", "ffd") == "tpu":
             cmd = self._annealed_option(filtered)
-            if cmd.candidates and self._passes_balanced(cmd):
-                return [cmd]
-        cmd = self._first_n_consolidation_option(filtered)
-        if cmd.candidates and self._passes_balanced(cmd):
-            return [cmd]
-        return []
+            if not (cmd.candidates and self._passes_balanced(cmd)):
+                cmd = Command()
+        if not cmd.candidates:
+            cmd = self._first_n_consolidation_option(filtered)
+            if not (cmd.candidates and self._passes_balanced(cmd)):
+                return []
+        # 15s wait + re-simulation before execution
+        # (multinodeconsolidation.go:103, validation.go:192-263)
+        from .validation import ValidationError, Validator
+
+        try:
+            Validator(self.ctx, self, mode="strict", metrics=self.ctx.metrics).validate(cmd)
+        except ValidationError:
+            return []
+        return [cmd]
 
     def _annealed_option(self, candidates) -> Command:
         """Device subset search + host exact validation."""

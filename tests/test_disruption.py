@@ -204,3 +204,123 @@ class TestDrift:
         from karpenter_tpu.apis.nodeclaim import COND_DRIFTED
 
         assert nc.status.conditions.is_true(COND_DRIFTED)
+
+
+class TestCommandValidation:
+    """The 15s validator (validation.py): wait -> rebuild candidates ->
+    re-simulate -> re-check budgets before any command executes
+    (reference validation.go:116-263)."""
+
+    def test_pod_scheduled_during_window_aborts_emptiness(self):
+        from karpenter_tpu.controllers.disruption.validation import VALIDATION_DELAY_SECONDS
+        from karpenter_tpu.kube import Container, ObjectMeta, Pod, PodSpec
+        from karpenter_tpu.utils.clock import FakeClock
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        class ChurnClock(FakeClock):
+            hook = None
+
+            def sleep(self, seconds):
+                if seconds >= VALIDATION_DELAY_SECONDS - 1e-9 and self.hook is not None:
+                    hook, self.hook = self.hook, None
+                    hook()
+                self.step(seconds)
+
+        clock = ChurnClock()
+        env = Environment(options=Options(), clock=clock)
+        np = make_nodepool(requirements=LINUX_AMD64)
+        np.spec.disruption.consolidate_after = "30s"
+        env.store.create(np)
+        provision(env, [make_pod(cpu="1", name="only-pod")])
+        node_name = env.store.list("Node")[0].metadata.name
+        env.store.delete("Pod", "only-pod")
+
+        # during the validation window a new pod lands on the empty node
+        def bind_pod():
+            env.store.create(
+                Pod(
+                    metadata=ObjectMeta(name="late-pod"),
+                    spec=PodSpec(
+                        node_name=node_name,
+                        containers=[Container(resources={"requests": parse_resource_list({"cpu": "1"})})],
+                    ),
+                )
+            )
+
+        clock.hook = bind_pod
+        run_disruption(env)
+        # the command was aborted: the node survives
+        assert env.store.count("Node") == 1
+        from karpenter_tpu import metrics as m
+
+        assert env.registry.counter(m.DISRUPTION_FAILED_VALIDATIONS_TOTAL).total() >= 1
+
+    def test_emptiness_executes_without_churn(self):
+        env = make_env()
+        provision(env, [make_pod(cpu="1", name="only-pod")])
+        env.store.delete("Pod", "only-pod")
+        run_disruption(env)
+        assert env.store.count("Node") == 0
+
+    def test_nomination_during_window_aborts_consolidation(self):
+        from karpenter_tpu.controllers.disruption.validation import ValidationError, Validator
+
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        provision(env, [make_pod(cpu="1", name=f"p{i}") for i in range(2)])
+        run_disruption(env, rounds=4)
+        ctrl = env.disruption
+        candidates = ctrl.get_candidates()
+        eligible = [c for c in candidates if ctrl.methods[3].should_disrupt(c)]
+        if len(eligible) < 1:
+            pytest.skip("fixture produced no consolidation candidates")
+        from karpenter_tpu.controllers.disruption.types import Command
+
+        cmd = Command(reason="underutilized", candidates=eligible[:1])
+        env.cluster.nominate_node(eligible[0].name())
+        with pytest.raises(ValidationError) as e:
+            Validator(ctrl.ctx, ctrl.methods[3], mode="strict", metrics=env.registry).validate(cmd, delay_seconds=0)
+        # nomination filters the node at candidate rebuild (churn) or at the
+        # explicit nomination re-check — either way the command aborts
+        assert e.value.kind in ("churn", "nominated")
+
+    def test_budget_consumed_during_window_aborts(self):
+        from karpenter_tpu.controllers.disruption.validation import ValidationError, Validator
+        from karpenter_tpu.controllers.disruption.types import Command
+
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        provision(env, [make_pod(cpu="1", name=f"p{i}") for i in range(2)])
+        run_disruption(env, rounds=4)
+        ctrl = env.disruption
+        eligible = [c for c in ctrl.get_candidates() if ctrl.methods[3].should_disrupt(c)]
+        if not eligible:
+            pytest.skip("fixture produced no consolidation candidates")
+        # budgets drop to zero before validation completes
+        def zero_budget(np):
+            np.spec.disruption.budgets = [Budget(nodes="0")]
+
+        env.store.patch("NodePool", eligible[0].node_pool.metadata.name, zero_budget)
+        cmd = Command(reason="underutilized", candidates=eligible[:1])
+        with pytest.raises(ValidationError) as e:
+            Validator(ctrl.ctx, ctrl.methods[3], mode="strict", metrics=env.registry).validate(cmd, delay_seconds=0)
+        assert e.value.kind == "budget"
+
+    def test_candidate_churn_aborts_strict_validation(self):
+        from karpenter_tpu.controllers.disruption.validation import ValidationError, Validator
+        from karpenter_tpu.controllers.disruption.types import Command
+
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        provision(env, [make_pod(cpu="1", name=f"p{i}") for i in range(2)])
+        run_disruption(env, rounds=4)
+        ctrl = env.disruption
+        eligible = [c for c in ctrl.get_candidates() if ctrl.methods[3].should_disrupt(c)]
+        if not eligible:
+            pytest.skip("fixture produced no consolidation candidates")
+        # the candidate's do-not-disrupt annotation appears mid-window: churn
+        def annotate(n):
+            n.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+
+        env.store.patch("Node", eligible[0].name(), annotate)
+        cmd = Command(reason="underutilized", candidates=eligible[:1])
+        with pytest.raises(ValidationError) as e:
+            Validator(ctrl.ctx, ctrl.methods[3], mode="strict", metrics=env.registry).validate(cmd, delay_seconds=0)
+        assert e.value.kind == "churn"
