@@ -84,12 +84,27 @@ class TerminationController:
         if still and not tgp_expired:
             return
 
-        # 3. delete daemon pods with the node
+        # 3. wait for VolumeAttachments of drain-able pods to detach before
+        # the instance goes away, so PV-backed workloads can re-attach
+        # elsewhere (controller.go:235-280 awaitVolumeDetachment); an elapsed
+        # termination grace period skips the wait
+        if not tgp_expired:
+            pending = self._pending_volume_attachments(node)
+            if pending:
+                if self.recorder is not None:
+                    self.recorder.publish(
+                        node,
+                        "AwaitingVolumeDetachment",
+                        f"awaiting deletion of {len(pending)} volume attachment(s)",
+                    )
+                return
+
+        # 4. delete daemon pods with the node
         for p in self.store.list("Pod"):
             if p.spec.node_name == name:
                 self.store.try_delete("Pod", p.metadata.name, namespace=p.metadata.namespace)
 
-        # 4. cloud delete + release finalizer (controller.go + [cloud boundary])
+        # 5. cloud delete + release finalizer (controller.go + [cloud boundary])
         claim = self._claim_for(node)
         if claim is not None:
             try:
@@ -111,6 +126,29 @@ class TerminationController:
                 )
         if self.recorder is not None:
             self.recorder.publish(node, "NodeTerminated", f"node {name} drained and terminated")
+
+    def _pending_volume_attachments(self, node) -> list:
+        """VolumeAttachments that must detach before instance deletion.
+        Attachments whose PV backs a NON-drainable pod (do-not-disrupt,
+        daemon/node-owned — pods that ride the node down) don't block
+        (controller.go:309-355 filterVolumeAttachments)."""
+        name = node.metadata.name
+        vas = [va for va in self.store.list("VolumeAttachment") if va.node_name == name]
+        if not vas:
+            return []
+        undrainable_pvs: set[str] = set()
+        for p in self.store.list("Pod"):
+            if p.spec.node_name != name or not pod_utils.is_active(p):
+                continue
+            if pod_utils.is_eviction_blocked(p) or pod_utils.is_owned_by_daemonset(p) or pod_utils.is_owned_by_node(p):
+                for v in p.spec.volumes:
+                    ref = v.get("persistentVolumeClaim")
+                    if not ref:
+                        continue
+                    pvc = self.store.try_get("PersistentVolumeClaim", ref.get("claimName", ""), p.metadata.namespace)
+                    if pvc is not None and pvc.volume_name:
+                        undrainable_pvs.add(pvc.volume_name)
+        return [va for va in vas if va.persistent_volume_name not in undrainable_pvs]
 
     def _evict(self, pod) -> None:
         """Evict = reset to pending (modeling controller recreation)."""

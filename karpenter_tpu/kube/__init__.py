@@ -34,6 +34,7 @@ from .objects import (  # noqa: F401
     ResourceClaimTemplate,
     ResourceSlice,
     StorageClass,
+    VolumeAttachment,
     TopologySpreadConstraint,
     WeightedPodAffinityTerm,
 )

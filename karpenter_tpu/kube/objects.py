@@ -262,6 +262,20 @@ class PersistentVolume:
 
 
 @dataclass
+class VolumeAttachment:
+    """storagev1.VolumeAttachment: a volume attached to a node. Termination
+    waits for these to detach before deleting the instance
+    (node/termination/controller.go awaitVolumeDetachment)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    attacher: str = ""  # spec.attacher (CSI driver)
+    node_name: str = ""  # spec.nodeName
+    persistent_volume_name: str = ""  # spec.source.persistentVolumeName
+    attached: bool = True  # status.attached
+    kind: str = "VolumeAttachment"
+
+
+@dataclass
 class StorageClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     provisioner: str = ""

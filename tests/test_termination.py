@@ -1,0 +1,90 @@
+"""Node termination: drain -> VolumeAttachment detach wait -> instance delete.
+
+Reference: node/termination/controller.go awaitVolumeDetachment (:235-280) and
+filterVolumeAttachments (:309-355).
+"""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.kube import ObjectMeta, VolumeAttachment
+from karpenter_tpu.kube.objects import PersistentVolumeClaim
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def env_with_node(pod=None):
+    env = Environment(options=Options())
+    np = make_nodepool(requirements=LINUX_AMD64)
+    env.store.create(np)
+    env.store.create(pod or make_pod(cpu="1", name="w0"))
+    env.settle(rounds=6)
+    nodes = env.store.list("Node")
+    assert len(nodes) == 1 and all(p.spec.node_name for p in env.store.list("Pod"))
+    return env, nodes[0]
+
+
+def attach(env, node, pv_name="pv-1", name="va-1"):
+    env.store.create(
+        VolumeAttachment(
+            metadata=ObjectMeta(name=name),
+            attacher="csi.test",
+            node_name=node.metadata.name,
+            persistent_volume_name=pv_name,
+        )
+    )
+
+
+class TestVolumeAttachmentWait:
+    def test_lingering_attachment_delays_deletion(self):
+        env, node = env_with_node()
+        attach(env, node)
+        env.store.delete("Node", node.metadata.name)
+        for _ in range(4):
+            env.clock.step(5)
+            env.tick(provision_force=False)
+        # drained, but the instance must NOT be deleted while the attachment
+        # of a drain-able pod lingers
+        assert env.store.try_get("Node", node.metadata.name) is not None
+        # the CSI controller detaches -> deletion completes
+        env.store.delete("VolumeAttachment", "va-1", grace=False)
+        for _ in range(3):
+            env.clock.step(5)
+            env.tick(provision_force=False)
+        assert env.store.try_get("Node", node.metadata.name) is None
+
+    def test_undrainable_pod_attachment_does_not_block(self):
+        # a daemonset-owned pod rides the node down; its volume detaches with
+        # the instance and must not block termination
+        from karpenter_tpu.kube.objects import OwnerReference
+
+        daemon_pod = make_pod(cpu="100m", name="ds-pod", owner_refs=[OwnerReference(kind="DaemonSet", name="ds", uid="u-ds")])
+        daemon_pod.spec.volumes = [{"persistentVolumeClaim": {"claimName": "ds-pvc"}}]
+        env, node = env_with_node()
+        daemon_pod.spec.node_name = node.metadata.name
+        env.store.create(daemon_pod)
+        env.store.create(PersistentVolumeClaim(metadata=ObjectMeta(name="ds-pvc"), volume_name="ds-pv", phase="Bound"))
+        attach(env, node, pv_name="ds-pv", name="va-ds")
+        env.store.delete("Node", node.metadata.name)
+        for _ in range(4):
+            env.clock.step(5)
+            env.tick(provision_force=False)
+        assert env.store.try_get("Node", node.metadata.name) is None
+
+    def test_grace_period_expiry_skips_wait(self):
+        env, node = env_with_node()
+        attach(env, node)
+
+        def stamp(n):
+            n.metadata.annotations[wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY] = str(env.clock.now() + 10)
+
+        env.store.patch("Node", node.metadata.name, stamp)
+        env.store.delete("Node", node.metadata.name)
+        env.clock.step(30)  # grace period elapses
+        for _ in range(3):
+            env.tick(provision_force=False)
+        assert env.store.try_get("Node", node.metadata.name) is None
