@@ -88,7 +88,7 @@ def build_candidate(cluster, store, clock, state_node, node_pools_by_name, insta
 
     # pods that block disruption
     for pod in pods:
-        if pod_utils.has_do_not_disrupt(pod) and node_pool.spec.template.termination_grace_period is None:
+        if pod_utils.has_do_not_disrupt(pod, clock.now()) and node_pool.spec.template.termination_grace_period is None:
             return None, f"pod {pod.key()} has do-not-disrupt"
         ok, pdb = pdb_limits.can_evict(pod)
         if not ok and node_pool.spec.template.termination_grace_period is None:

@@ -62,7 +62,7 @@ class TerminationController:
             progressed = False
             for p in first:
                 if not tgp_expired:
-                    if pod_utils.is_eviction_blocked(p):
+                    if pod_utils.is_eviction_blocked(p, self.clock.now()):
                         continue  # do-not-disrupt pods wait for TGP
                     ok, _ = pdb.can_evict(p)
                     if not ok:
@@ -140,7 +140,7 @@ class TerminationController:
         for p in self.store.list("Pod"):
             if p.spec.node_name != name or not pod_utils.is_active(p):
                 continue
-            if pod_utils.is_eviction_blocked(p) or pod_utils.is_owned_by_daemonset(p) or pod_utils.is_owned_by_node(p):
+            if pod_utils.is_eviction_blocked(p, self.clock.now()) or pod_utils.is_owned_by_daemonset(p) or pod_utils.is_owned_by_node(p):
                 for v in p.spec.volumes:
                     ref = v.get("persistentVolumeClaim")
                     if not ref:

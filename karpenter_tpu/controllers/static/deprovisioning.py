@@ -77,7 +77,7 @@ class StaticDeprovisioningController:
             if sn is None or sn.marked_for_deletion:
                 continue
             pods = self._pods_on(sn.name())
-            dnd = any(pod_utils.has_do_not_disrupt(p) for p in pods)
+            dnd = any(pod_utils.has_do_not_disrupt(p, self.clock.now()) for p in pods)
             non_daemon = [p for p in pods if not pod_utils.is_owned_by_daemonset(p)]
             if not non_daemon and not dnd:
                 empties.append(nc)

@@ -425,6 +425,81 @@ def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key:
     return _pack_body(t, items, zone_key=zone_key, n_existing=n_existing, n_slots=n_slots, axis=None)
 
 
+def _sparsify_takes(takes, nnz_cap: int):
+    """Device-side sparsification of the [W, N] take matrix into -1-padded
+    row-major (item, slot, count) triples — shared by the fused single-device
+    kernel and the meshed compress_takes path."""
+    W, N = takes.shape
+    nzi, nzs = jnp.nonzero(takes, size=nnz_cap, fill_value=-1)
+    nzc = jnp.where(nzi >= 0, takes[jnp.clip(nzi, 0, W - 1), jnp.clip(nzs, 0, N - 1)], 0)
+    return nzi, nzs, nzc
+
+
+@partial(jax.jit, static_argnames=("zone_key", "n_existing", "n_slots", "nnz_cap"))
+def _pack_compressed_impl(t: SchedulerTensors, items: ItemTensors, zone_key: int, n_existing: int, n_slots: int, nnz_cap: int):
+    """Pack + on-device sparsification, fused into ONE flat int32 output.
+
+    The production deployment reaches the TPU through a tunnel whose
+    round-trip latency (~60-90ms) dwarfs its bandwidth for solver-sized
+    results: pulling takes/basis/zoneset/leftovers/open_count as separate
+    arrays pays that latency per pull. Concatenating every host-needed output
+    into one int32 vector makes the whole solve one device->host transfer."""
+    takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = _pack_body(
+        t, items, zone_key=zone_key, n_existing=n_existing, n_slots=n_slots, axis=None
+    )
+    nzi, nzs, nzc = _sparsify_takes(takes, nnz_cap)
+    return jnp.concatenate(
+        [
+            nzi.astype(jnp.int32),
+            nzs.astype(jnp.int32),
+            nzc.astype(jnp.int32),
+            slot_basis.astype(jnp.int32),
+            slot_zoneset.reshape(-1).astype(jnp.int32),
+            leftovers.astype(jnp.int32),
+            jnp.asarray(open_count, jnp.int32)[None],
+        ]
+    )
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length()
+
+
+def greedy_pack_grouped_compressed(t: SchedulerTensors, items: ItemTensors, n_pods: int):
+    """Single-transfer pack. Returns a dict with the sparse placement triples
+    (nz_item, nz_slot, nz_count; -1-padded, row-major) plus slot_basis,
+    slot_zoneset (bool [N, Z]), leftovers, open_count — all numpy."""
+    W = items.item_req.shape[0]
+    N = t.n_slots
+    Z = t.counts_zone_init.shape[1]
+    # nnz <= n_pods; round the static cap up to a power of two so solves with
+    # drifting pod counts reuse one compiled kernel instead of retracing
+    nnz_cap = int(min(_next_pow2(n_pods), W * N))
+    flat = np.asarray(_pack_compressed_impl(t, items, t.zone_key, t.n_existing, N, nnz_cap))
+    o = 0
+
+    def take(n):
+        nonlocal o
+        out = flat[o : o + n]
+        o += n
+        return out
+
+    nz_item, nz_slot, nz_count = take(nnz_cap), take(nnz_cap), take(nnz_cap)
+    slot_basis = take(N)
+    slot_zoneset = take(N * Z).reshape(N, Z).astype(bool)
+    leftovers = take(W)
+    open_count = int(take(1)[0])
+    return dict(
+        nz_item=nz_item,
+        nz_slot=nz_slot,
+        nz_count=nz_count,
+        slot_basis=slot_basis,
+        slot_zoneset=slot_zoneset,
+        leftovers=leftovers,
+        open_count=open_count,
+    )
+
+
 def greedy_pack_grouped(t: SchedulerTensors, items: ItemTensors):
     """Returns (takes [W, N], leftovers [W], slot_basis, slot_zoneset,
     slot_rank, open_count)."""
@@ -432,16 +507,13 @@ def greedy_pack_grouped(t: SchedulerTensors, items: ItemTensors):
 
 
 def compress_takes(takes, n_pods: int):
-    """Device-side sparsification of the [W, N] take matrix: every nonzero
-    entry places >= 1 pod, so nnz <= n_pods — transferring (item, slot,
-    count) triples is O(pods), not O(items x slots) (the dense matrix is
-    ~64 MB at 4k items x 4k slots and dominated the solve wall-clock).
-    Returns numpy (nz_item, nz_slot, nz_count), -1-padded, row-major (per
-    item, slots ascending)."""
+    """Device-side sparsification for the meshed path: every nonzero entry
+    places >= 1 pod, so nnz <= n_pods — transferring (item, slot, count)
+    triples is O(pods), not O(items x slots). Returns numpy (nz_item,
+    nz_slot, nz_count), -1-padded, row-major (per item, slots ascending)."""
     W, N = takes.shape
-    cap = int(min(n_pods, W * N))
-    nzi, nzs = jnp.nonzero(takes, size=cap, fill_value=-1)
-    nzc = jnp.where(nzi >= 0, takes[jnp.clip(nzi, 0, W - 1), jnp.clip(nzs, 0, N - 1)], 0)
+    cap = int(min(_next_pow2(n_pods), W * N))
+    nzi, nzs, nzc = _sparsify_takes(takes, cap)
     return np.asarray(nzi), np.asarray(nzs), np.asarray(nzc)
 
 

@@ -68,20 +68,26 @@ def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zone
             errors.append(f"slot {int(j)}: total requests exceed basis row allocatable")
 
     # -- requirement compatibility -------------------------------------------
-    vals = enc.row_labels[rows]  # [Pv, K] value ids
+    # compat depends only on the (signature, slot) pair, and placements are
+    # replica-heavy: thousands of unique pairs stand in for 50k pods
+    pair_key = psig.astype(np.int64) * N + slots
+    _, uidx = np.unique(pair_key, return_index=True)
+    usig, uslot, urow = psig[uidx], slots[uidx], rows[uidx]
+    vals = enc.row_labels[urow]  # [U, K] value ids
     word = (vals >> 5).astype(np.int64)
     bit = (vals & 31).astype(np.uint32)
-    masks = enc.sig_mask[psig]  # [Pv, K, W] uint32
+    masks = enc.sig_mask[usig]  # [U, K, W] uint32
     gathered = np.take_along_axis(masks, word[:, :, None], axis=2)[:, :, 0]
-    ok = ((gathered >> bit) & 1).astype(bool)  # [Pv, K]
+    ok = ((gathered >> bit) & 1).astype(bool)  # [U, K]
     if enc.zone_key_id >= 0:
         ok[:, enc.zone_key_id] = True  # zones checked via the zone-set below
     label_bad = ~ok.all(axis=1)
-    taint_bad = ~enc.sig_taint_ok[psig, enc.row_taint_class[rows]]
-    zone_bad = ~(slot_zoneset[slots] & enc.sig_zone_allowed[psig]).any(axis=1)
+    taint_bad = ~enc.sig_taint_ok[usig, enc.row_taint_class[urow]]
+    zone_bad = ~(slot_zoneset[uslot] & enc.sig_zone_allowed[usig]).any(axis=1)
     for name, bad in (("requirements", label_bad), ("taints", taint_bad), ("zone", zone_bad)):
         if bad.any():
-            pidx = np.nonzero(valid)[0][bad]
+            bad_keys = (usig[bad].astype(np.int64) * N + uslot[bad])[:_MAX_ERRORS]
+            pidx = np.nonzero(valid)[0][np.isin(pair_key, bad_keys)]
             for i in pidx[:_MAX_ERRORS]:
                 errors.append(f"pod {enc.pods[i].key()}: {name} incompatible with assigned slot")
 

@@ -133,6 +133,9 @@ class EncodedSnapshot:
     counts_host_existing: np.ndarray  # [G, n_existing] i32
 
     fallback_reasons: list[str] = field(default_factory=list)
+    # True when any pod carries relaxable soft constraints the pack honored
+    # tier-0; an unplaced pod then re-solves via the host relaxation loop
+    has_relaxable: bool = False
 
     @property
     def n_rows(self) -> int:
@@ -267,8 +270,16 @@ def pod_signature(pod) -> tuple:
 def check_capability(snap, pods=None) -> list[str]:
     """Reasons the snapshot cannot run on the tensor path (empty = OK).
     `pods` defaults to the snapshot's; pass signature representatives to check
-    each unique shape once."""
+    each unique shape once.
+
+    Relaxable soft constraints (preferred node affinity, node-affinity
+    OR-terms, ScheduleAnyway spreads) are IN-window under the default Respect
+    policy: the tensor pack honors them tier-0 exactly as the FFD does before
+    any relaxation (preferences.go:40-55 relaxes only on failure), and
+    TPUSolver falls back to the host relaxation loop only if a pod is left
+    unplaced with soft constraints in play."""
     reasons = []
+    respect = getattr(snap, "preference_policy", "Respect") == "Respect"
     if snap.min_values_policy != "Strict":
         pass  # relaxation happens host-side per claim decode; fine
     for np_ in snap.node_pools:
@@ -289,11 +300,13 @@ def check_capability(snap, pods=None) -> list[str]:
                 reasons.append(f"{pod.key()}: preferred anti-affinity")
                 break
             na = aff.node_affinity
-            if na is not None and (na.preferred or len(na.required) > 1):
+            if not respect and na is not None and (na.preferred or len(na.required) > 1):
+                # Ignore policy drops preferences host-side pre-solve; keep
+                # the conservative window there
                 reasons.append(f"{pod.key()}: relaxable node affinity")
                 break
         for tsc in pod.spec.topology_spread_constraints:
-            if tsc.when_unsatisfiable != "DoNotSchedule":
+            if tsc.when_unsatisfiable != "DoNotSchedule" and not respect:
                 reasons.append(f"{pod.key()}: ScheduleAnyway spread")
                 break
             if tsc.topology_key not in (wk.ZONE_LABEL_KEY, wk.HOSTNAME_LABEL_KEY):
@@ -373,8 +386,12 @@ def encode(snap) -> EncodedSnapshot:
     reasons = check_capability(snap, rep_pods)
 
     # -- per-signature heavy lowering -----------------------------------------
+    respect = getattr(snap, "preference_policy", "Respect") == "Respect"
     sig_requests = [res.pod_requests(p) for p in rep_pods]
-    sig_requirements = [Requirements.from_pod(p, strict=True) for p in rep_pods]
+    # tier-0 preference honoring: include the heaviest preferred node-affinity
+    # term exactly like the un-relaxed FFD (requirements.go:74-110); strict
+    # under the Ignore policy
+    sig_requirements = [Requirements.from_pod(p, strict=not respect) for p in rep_pods]
 
     # -- resource axis ---------------------------------------------------------
     rnames = ["cpu", "memory", "pods", "ephemeral-storage"]
@@ -673,7 +690,17 @@ def encode(snap) -> EncodedSnapshot:
         counts_zone_init=counts_zone_init,
         counts_host_existing=counts_host_existing,
         fallback_reasons=reasons,
+        has_relaxable=respect and any(_is_relaxable(p) for p in rep_pods),
     )
+
+
+def _is_relaxable(pod) -> bool:
+    """Pod carries soft constraints preferences.go would peel on failure."""
+    aff = pod.spec.affinity
+    na = aff.node_affinity if aff else None
+    if na is not None and (na.preferred or len(na.required) > 1):
+        return True
+    return any(t.when_unsatisfiable != "DoNotSchedule" for t in pod.spec.topology_spread_constraints)
 
 
 def _scale(resource: str, q: Quantity) -> float:

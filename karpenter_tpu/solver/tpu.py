@@ -54,6 +54,7 @@ class _NullTopology:
 # metric labels must be bounded, and reasons embed pod keys / topology keys
 _REASON_FAMILIES = (
     ("validation", "validation"),
+    ("relaxation required", "relaxation"),
     ("minValues", "min-values"),
     ("pod affinity", "pod-affinity"),
     ("non-hostname anti-affinity", "non-hostname-anti-affinity"),
@@ -93,14 +94,32 @@ class TPUSolver:
         self.last_backend: str = ""
         self.last_fallback_reasons: list[str] = []
 
-    def _pack(self, t, items):
+    def _pack(self, t, items, n_pods: int) -> dict:
+        """Run the pack and land every host-needed output. The single-device
+        path fuses pack + sparsification + all outputs into ONE device->host
+        transfer (tunnel round-trips dominate result bandwidth); the meshed
+        path pulls the shard_map outputs directly."""
         if self.mesh is not None and self.mesh.size > 1:
+            from ..models.scheduler_model_grouped import compress_takes
             from ..parallel.sharded import greedy_pack_grouped_sharded
 
-            return greedy_pack_grouped_sharded(t, items, self.mesh)
-        from ..models.scheduler_model_grouped import greedy_pack_grouped
+            takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack_grouped_sharded(t, items, self.mesh)
+            nz_item, nz_slot, nz_count = compress_takes(takes, n_pods)
+            return dict(
+                nz_item=nz_item,
+                nz_slot=nz_slot,
+                nz_count=nz_count,
+                slot_basis=np.asarray(slot_basis),
+                slot_zoneset=np.asarray(slot_zoneset),
+                leftovers=np.asarray(leftovers),
+                open_count=int(open_count),
+                n_slots=int(takes.shape[1]),
+            )
+        from ..models.scheduler_model_grouped import greedy_pack_grouped_compressed
 
-        return greedy_pack_grouped(t, items)
+        out = greedy_pack_grouped_compressed(t, items, n_pods)
+        out["n_slots"] = t.n_slots
+        return out
 
     def _count(self, metric: str, **labels) -> None:
         if self.registry is not None:
@@ -133,7 +152,6 @@ class TPUSolver:
         from ..models.scheduler_model_grouped import (
             assignment_from_triples,
             build_items,
-            compress_takes,
             make_item_tensors,
         )
 
@@ -141,19 +159,27 @@ class TPUSolver:
         items = make_item_tensors(item_arrays)
         cap = enc.n_existing + min(enc.n_pods, 4096)
         t = make_tensors(enc, n_slots=cap, with_pods=False)
-        takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = self._pack(t, items)
-        if int(open_count) == int(takes.shape[1]) and int(np.asarray(leftovers).sum()) > 0 and cap < enc.n_existing + enc.n_pods:
+        out = self._pack(t, items, enc.n_pods)
+        if out["open_count"] == out["n_slots"] and int(out["leftovers"].sum()) > 0 and cap < enc.n_existing + enc.n_pods:
             t = make_tensors(enc, with_pods=False)
-            takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = self._pack(t, items)
-        nz_item, nz_slot, nz_count = compress_takes(takes, enc.n_pods)
-        assignment = assignment_from_triples(nz_item, nz_slot, nz_count, item_pods, enc.n_pods)
+            out = self._pack(t, items, enc.n_pods)
+        slot_basis, slot_zoneset = out["slot_basis"], out["slot_zoneset"]
+        assignment = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
+
+        # tier-0 honored every soft constraint; an unplaced pod means the
+        # host relaxation loop (preferences.go:40-55) must take over — the
+        # tensor pack cannot peel preferences per pod
+        if enc.has_relaxable and (assignment < 0).any():
+            if self.force:
+                raise RuntimeError("tier-0 solve left relaxable pods unplaced")
+            return self._fall_back(snap, ["relaxation required: soft constraints unsatisfiable tier-0"], family="relaxation")
 
         # every production solve self-checks before decode: a kernel bug must
         # fall back to the exact host path, never reach NodeClaim creation
         from ..metrics import SOLVER_SOLVE_TOTAL, SOLVER_VALIDATION_FAILURES_TOTAL
         from .check import fast_validate
 
-        slot_basis_np, slot_zoneset_np = np.asarray(slot_basis), np.asarray(slot_zoneset)
+        slot_basis_np, slot_zoneset_np = slot_basis, slot_zoneset
         violations = fast_validate(enc, assignment, slot_basis_np, slot_zoneset_np)
         if violations:
             self._count(SOLVER_VALIDATION_FAILURES_TOTAL)

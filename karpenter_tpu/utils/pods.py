@@ -46,13 +46,36 @@ def is_owned_by_node(pod) -> bool:
     return any(ref.kind == "Node" for ref in pod.metadata.owner_references)
 
 
-def has_do_not_disrupt(pod) -> bool:
-    return pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true"
+def has_do_not_disrupt(pod, now: float | None = None) -> bool:
+    """Clock-aware do-not-disrupt check (reference pod/scheduling.go
+    IsDoNotDisruptActive:205-240): "true" blocks forever; a positive Go
+    duration ("5m", "1h") blocks until pod creation + duration; anything else
+    — including "Never", which is NOT a valid Go duration and errors in the
+    reference's time.ParseDuration — is treated as if the annotation were
+    absent. `now=None` treats duration annotations as active (callers without
+    a clock stay conservative)."""
+    value = pod.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION)
+    if value is None:
+        return False
+    if value == "true":
+        return True
+    from .durations import NEVER, parse_duration
+
+    try:
+        seconds = parse_duration(value)
+    except ValueError:
+        return False  # invalid format: treated as absent
+    if seconds is None or seconds <= 0 or seconds == NEVER:
+        return False  # "Never" parses here (consolidateAfter-ism) but is an
+        # invalid annotation duration in the reference: non-blocking
+    if now is None:
+        return True
+    return now < (pod.metadata.creation_timestamp or 0.0) + seconds
 
 
-def is_disruptable(pod) -> bool:
-    return not has_do_not_disrupt(pod)
+def is_disruptable(pod, now: float | None = None) -> bool:
+    return not has_do_not_disrupt(pod, now)
 
 
-def is_eviction_blocked(pod) -> bool:
-    return has_do_not_disrupt(pod) and is_active(pod)
+def is_eviction_blocked(pod, now: float | None = None) -> bool:
+    return has_do_not_disrupt(pod, now) and is_active(pod)

@@ -1,0 +1,336 @@
+"""Consolidation depth specs ported from the reference's consolidation_test.go
+(5,307 LoC): budgets across pools, delete-vs-replace decisions, price guards,
+spot-to-spot edges, do-not-disrupt families (boolean, duration-based,
+invalid), PDBs, ownerless pods, and savings ordering."""
+
+import pytest
+
+from helpers import hostname_anti_affinity, make_nodepool, make_pod, zone_spread
+from test_disruption import LINUX_AMD64, OD_ONLY, make_env, provision, run_disruption
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import Budget
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+
+
+def one_node_per_pod_env(n, np_kwargs=None, cpu="500m", **opt_kwargs):
+    """A fleet of n single-pod nodes via hostname anti-affinity."""
+    env = make_env(np_kwargs=np_kwargs, **opt_kwargs)
+    sel = {"matchLabels": {"app": "x"}}
+    pods = [
+        make_pod(cpu=cpu, name=f"s{i}", labels={"app": "x"}, anti_affinity=[hostname_anti_affinity(sel)])
+        for i in range(n)
+    ]
+    provision(env, pods)
+    assert env.store.count("Node") == n
+    return env
+
+
+def empty_fleet_env(n, np_kwargs=None, **opt_kwargs):
+    """n empty consolidatable nodes."""
+    env = one_node_per_pod_env(n, np_kwargs=np_kwargs, **opt_kwargs)
+    for i in range(n):
+        env.store.delete("Pod", f"s{i}")
+    return env
+
+
+class TestBudgetsDepth:
+    def test_only_three_empty_nodes_disrupted(self):
+        # consolidation_test.go:366 — budget nodes=3 caps one round's deletes
+        env = empty_fleet_env(5)
+        np = env.store.list("NodePool")[0]
+
+        def set_budget(p):
+            p.spec.disruption.budgets = [Budget(nodes="3")]
+
+        env.store.patch("NodePool", np.metadata.name, set_budget)
+        # one disruption round only (validator consumes budget per candidate)
+        env.clock.step(40)
+        env.tick(provision_force=True)
+        env.disruption.reconcile(force=True)
+        env.clock.step(5)
+        for _ in range(6):  # let terminations drain without new rounds
+            env.termination.reconcile()
+            env.tick(provision_force=False)
+        assert env.store.count("Node") == 2
+
+    def test_all_empty_nodes_disrupted_with_full_budget(self):
+        # consolidation_test.go:388
+        env = empty_fleet_env(4)
+        np = env.store.list("NodePool")[0]
+
+        def set_budget(p):
+            p.spec.disruption.budgets = [Budget(nodes="100%")]
+
+        env.store.patch("NodePool", np.metadata.name, set_budget)
+        run_disruption(env)
+        assert env.store.count("Node") == 0
+
+    def test_zero_budget_blocks_all(self):
+        # consolidation_test.go:411
+        env = empty_fleet_env(3)
+        np = env.store.list("NodePool")[0]
+
+        def set_budget(p):
+            p.spec.disruption.budgets = [Budget(nodes="0")]
+
+        env.store.patch("NodePool", np.metadata.name, set_budget)
+        run_disruption(env)
+        assert env.store.count("Node") == 3
+
+    def test_per_pool_budgets_enforced_independently(self):
+        # consolidation_test.go:522 — two pools, each budget-capped at 1/round
+        env = Environment(options=Options())
+        for name in ("pool-a", "pool-b"):
+            np = make_nodepool(name=name, requirements=LINUX_AMD64)
+            np.spec.disruption.consolidate_after = "30s"
+            np.spec.disruption.budgets = [Budget(nodes="1")]
+            env.store.create(np)
+        sel_a, sel_b = {"matchLabels": {"app": "a"}}, {"matchLabels": {"app": "b"}}
+        pods = [
+            make_pod(cpu="500m", name=f"a{i}", labels={"app": "a"}, node_selector={wk.NODEPOOL_LABEL_KEY: "pool-a"}, anti_affinity=[hostname_anti_affinity(sel_a)])
+            for i in range(2)
+        ] + [
+            make_pod(cpu="500m", name=f"b{i}", labels={"app": "b"}, node_selector={wk.NODEPOOL_LABEL_KEY: "pool-b"}, anti_affinity=[hostname_anti_affinity(sel_b)])
+            for i in range(2)
+        ]
+        provision(env, pods)
+        assert env.store.count("Node") == 4
+        for p in pods:
+            env.store.delete("Pod", p.metadata.name)
+        # one round: at most one node per pool disrupts
+        env.clock.step(40)
+        env.tick(provision_force=True)
+        env.disruption.reconcile(force=True)
+        for _ in range(6):
+            env.termination.reconcile()
+            env.tick(provision_force=False)
+        assert env.store.count("Node") == 2
+
+
+class TestDeleteDecisions:
+    def test_can_delete_nodes(self):
+        # consolidation_test.go:2421 — two underutilized nodes merge
+        env = one_node_per_pod_env(3, np_kwargs={"requirements": OD_ONLY})
+        # remove anti-affinity pressure: replace with plain pods
+        for i in range(3):
+            env.store.delete("Pod", f"s{i}")
+        for i in range(3):
+            env.store.create(make_pod(cpu="500m", name=f"f{i}"))
+        provision(env, [])
+        _full_budget(env)
+        before = env.store.count("Node")
+        run_disruption(env)
+        assert env.store.count("Node") < before
+        assert all(p.spec.node_name for p in env.store.list("Pod"))
+
+    def test_delete_considers_pdb(self):
+        # consolidation_test.go:2587 — a blocking PDB pins every node
+        env = one_node_per_pod_env(2)
+        env.store.create(_pdb("block", {"matchLabels": {"app": "x"}}, max_unavailable=0))
+        run_disruption(env)
+        assert env.store.count("Node") == 2
+
+    def test_delete_considers_node_do_not_disrupt(self):
+        # consolidation_test.go:2644
+        env = empty_fleet_env(2)
+        target = env.store.list("Node")[0].metadata.name
+
+        def annotate(n):
+            n.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+
+        env.store.patch("Node", target, annotate)
+        run_disruption(env)
+        assert env.store.count("Node") == 1
+        assert env.store.try_get("Node", target) is not None
+
+    def test_delete_considers_pod_do_not_disrupt(self):
+        # consolidation_test.go:2686
+        env = one_node_per_pod_env(2)
+        pod = env.store.get("Pod", "s0")
+
+        def annotate(p):
+            p.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+
+        env.store.patch("Pod", "s0", annotate, namespace=pod.metadata.namespace)
+        run_disruption(env)
+        # s0's node survives; the other can still be considered
+        assert env.store.get("Pod", "s0").spec.node_name
+        node_of_s0 = env.store.get("Pod", "s0").spec.node_name
+        assert env.store.try_get("Node", node_of_s0) is not None
+
+    def test_duration_do_not_disrupt_active_blocks(self):
+        # consolidation_test.go:2824 — "1h" annotation still active
+        env = one_node_per_pod_env(2)
+        pod = env.store.get("Pod", "s0")
+
+        def annotate(p):
+            p.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "1h"
+
+        env.store.patch("Pod", "s0", annotate, namespace=pod.metadata.namespace)
+        run_disruption(env, rounds=4)  # ~1 min of clock, well under 1h
+        node_of_s0 = env.store.get("Pod", "s0").spec.node_name
+        assert node_of_s0 and env.store.try_get("Node", node_of_s0) is not None
+
+    def test_duration_do_not_disrupt_expires(self):
+        # consolidation_test.go:2867 — protection lapses after the duration
+        from karpenter_tpu.utils.pods import has_do_not_disrupt
+
+        env = one_node_per_pod_env(1)
+        pod = env.store.get("Pod", "s0")
+
+        def annotate(p):
+            p.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "1m"
+
+        env.store.patch("Pod", "s0", annotate, namespace=pod.metadata.namespace)
+        p = env.store.get("Pod", "s0")
+        assert has_do_not_disrupt(p, env.clock.now())
+        env.clock.step(120)
+        assert not has_do_not_disrupt(p, env.clock.now())
+
+    def test_invalid_do_not_disrupt_not_blocking(self):
+        # consolidation_test.go:2916
+        from karpenter_tpu.utils.pods import has_do_not_disrupt
+
+        p = make_pod()
+        p.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "not-a-duration"
+        assert not has_do_not_disrupt(p, 0.0)
+        p.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "-5m"
+        assert not has_do_not_disrupt(p, 0.0)
+
+    def test_deletes_evict_ownerless_pods(self):
+        # consolidation_test.go:2956 — pods without ownerRefs still reschedule
+        env = one_node_per_pod_env(3, np_kwargs={"requirements": OD_ONLY})
+        for i in range(3):
+            env.store.delete("Pod", f"s{i}")
+        for i in range(3):
+            env.store.create(make_pod(cpu="500m", name=f"own-{i}"))  # no ownerRef
+        provision(env, [])
+        _full_budget(env)
+        before = env.store.count("Node")
+        run_disruption(env)
+        assert env.store.count("Node") < before
+        assert all(p.spec.node_name for p in env.store.list("Pod"))
+
+    def test_wont_delete_if_pod_would_go_pending(self):
+        # consolidation_test.go:3442 — pods exactly fill remaining capacity
+        env = make_env(np_kwargs={"requirements": OD_ONLY + [{"key": "karpenter.kwok.sh/instance-size", "operator": "In", "values": ["4x"]}]})
+        # each 4x node has ~3.9 cpu allocatable; two nodes of 3 cpu pods
+        provision(env, [make_pod(cpu="3", name="p0"), make_pod(cpu="3", name="p1")])
+        assert env.store.count("Node") == 2
+        run_disruption(env)
+        # no single node can host both: nothing deletes
+        assert env.store.count("Node") == 2
+        assert all(p.spec.node_name for p in env.store.list("Pod"))
+
+    def test_can_delete_while_invalid_nodepool_exists(self):
+        # consolidation_test.go:3482 — a pool with no instance types alongside
+        env = empty_fleet_env(2)
+        bad = make_nodepool(name="bad-pool", requirements=[{"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["nonexistent"]}])
+        env.store.create(bad)
+        run_disruption(env)
+        assert env.store.count("Node") == 0
+
+
+def _full_budget(env):
+    for np in env.store.list("NodePool"):
+        def set_budget(p):
+            from karpenter_tpu.apis.nodepool import Budget
+
+            p.spec.disruption.budgets = [Budget(nodes="100%")]
+
+        env.store.patch("NodePool", np.metadata.name, set_budget)
+
+
+def _pdb(name, selector, max_unavailable):
+    from karpenter_tpu.kube.objects import ObjectMeta, PodDisruptionBudget
+
+    return PodDisruptionBudget(
+        metadata=ObjectMeta(name=name),
+        selector=selector,
+        max_unavailable=max_unavailable,
+    )
+
+
+class TestReplaceDecisions:
+    def test_oversized_on_demand_replaced_with_cheaper(self):
+        # consolidation_test.go:2301 inverse — replacement happens only when
+        # strictly cheaper; a right-sized node is NOT replaced
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        provision(env, [make_pod(cpu="1", memory="1Gi", name="small")])
+        # the provisioner already picked the cheapest fitting type: no replace
+        before = {n.metadata.name for n in env.store.list("Node")}
+        run_disruption(env, rounds=6)
+        after = {n.metadata.name for n in env.store.list("Node")}
+        assert before == after
+
+    def test_replacement_maintains_zonal_spread(self):
+        # consolidation_test.go:4525 — spread pods keep their zone layout
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        sel = {"matchLabels": {"app": "z"}}
+        pods = [make_pod(cpu="500m", name=f"z{i}", labels={"app": "z"}, tsc=[zone_spread(1, sel)]) for i in range(3)]
+        provision(env, pods)
+        run_disruption(env, rounds=6)
+        zones = set()
+        for p in env.store.list("Pod"):
+            assert p.spec.node_name, "spread pod went pending during consolidation"
+            node = env.store.try_get("Node", p.spec.node_name)
+            zones.add(node.metadata.labels.get(wk.ZONE_LABEL_KEY))
+        assert len(zones) == 3, f"zonal spread collapsed to {zones}"
+
+
+class TestSpotToSpot:
+    def _spot_fleet(self, n_types_gate=True):
+        env = make_env()
+        env.options.feature_gates.spot_to_spot_consolidation = n_types_gate
+        return env
+
+    def test_spot_to_spot_disabled_gate_blocks(self):
+        # consolidation_test.go:1136 — default gate off: spot nodes are not
+        # replaced by cheaper spot
+        env = make_env()
+        assert env.options.feature_gates.spot_to_spot_consolidation is False
+        provision(env, [make_pod(cpu="1", name="w")])
+        node = env.store.list("Node")[0]
+        assert node.metadata.labels[wk.CAPACITY_TYPE_LABEL_KEY] == wk.CAPACITY_TYPE_SPOT
+        before = {n.metadata.name for n in env.store.list("Node")}
+        run_disruption(env, rounds=6)
+        assert {n.metadata.name for n in env.store.list("Node")} == before
+
+    def test_spot_to_spot_min_flexibility(self):
+        # consolidation_test.go:1061 — single-node spot replacement demands
+        # >= 15 cheaper instance types; the method returns no command below it
+        from karpenter_tpu.controllers.disruption.methods import SingleNodeConsolidation
+
+        env = make_env()
+        env.options.feature_gates.spot_to_spot_consolidation = True
+        provision(env, [make_pod(cpu="1", name="w")])
+        env.clock.step(40)
+        env.tick(provision_force=True)
+        env.nodeclaim_disruption.reconcile()
+        candidates = env.disruption.get_candidates()
+        if not candidates:
+            pytest.skip("no candidates formed")
+        method = SingleNodeConsolidation(env.disruption.ctx)
+        env.disruption.ctx.round_candidates = candidates
+        env.disruption.ctx.node_pool_totals = None
+        cmd = method.compute_consolidation(candidates[:1])
+        # provisioner already picked cheapest: replacement impossible; and
+        # the <15-flexibility rule forbids marginal spot churn regardless
+        assert not cmd.replacements
+
+
+class TestSavingsOrdering:
+    def test_lowest_disruption_cost_first(self):
+        # consolidation_test.go:4429 — fewer/lighter pods disrupt first
+        env = one_node_per_pod_env(2, np_kwargs={"requirements": OD_ONLY})
+        # s0's node hosts an extra pod: higher disruption cost than s1's
+        node0 = env.store.get("Pod", "s0").spec.node_name
+        env.store.create(make_pod(cpu="100m", name="extra", node_name=node0))
+        env.clock.step(40)
+        env.tick(provision_force=True)
+        env.nodeclaim_disruption.reconcile()
+        candidates = sorted(env.disruption.get_candidates(), key=lambda c: c.disruption_cost)
+        assert len(candidates) == 2
+        assert len(candidates[0].reschedulable_pods) == 1  # the lighter node first
+        assert len(candidates[1].reschedulable_pods) == 2

@@ -1,0 +1,278 @@
+"""Instance-selection depth specs ported from the reference's
+instance_selection_test.go (1,489 LoC): cheapest-instance picking under every
+combination of pod/pool arch, os, zone, and capacity-type constraints, plus
+resource-driven selection and minValues operator coverage."""
+
+import pytest
+
+from helpers import make_nodepool, make_pod
+from test_scheduler import LINUX_AMD64, build_env, make_scheduler
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.cloudprovider.types import order_by_price
+
+
+def solve(pods, node_pools=None, types=None, **kw):
+    env = build_env(node_pools=node_pools, types=types)
+    s = make_scheduler(*env, **kw)
+    return s.solve(pods)
+
+
+def cheapest_price(its, reqs):
+    best = float("inf")
+    for it in its:
+        for o in it.offerings:
+            if o.available and reqs.intersects(o.requirements) is None:
+                best = min(best, o.price)
+    return best
+
+
+def launch_price(nc):
+    """Cheapest launchable price for the finalized claim."""
+    return cheapest_price(nc.instance_type_options, nc.requirements)
+
+
+def assert_cheapest(results, types, within=1.0001):
+    """The claim's launch price equals the cheapest offering any compatible
+    type offers under the claim's own requirements."""
+    assert results.all_pods_scheduled()
+    assert len(results.new_node_claims) == 1
+    nc = results.new_node_claims[0]
+    best_possible = cheapest_price(nc.instance_type_options, nc.requirements)
+    assert launch_price(nc) <= best_possible * within
+    # the instance-type options are price-ordered cheapest-first in the API claim
+    api = nc.to_api_node_claim()
+    it_req = next(r for r in api.spec.requirements if r["key"] == wk.INSTANCE_TYPE_LABEL_KEY and r["operator"] == "In")
+    assert it_req["values"], "claim must carry launchable instance types"
+    return nc
+
+
+class TestCheapestInstance:
+    def test_cheapest_unconstrained(self):
+        # instance_selection_test.go:82
+        types = catalog.construct_instance_types()
+        results = solve([make_pod(cpu="500m")], types=types)
+        assert_cheapest(results, types)
+
+    @pytest.mark.parametrize("arch", ["amd64", "arm64"])
+    def test_cheapest_pod_arch(self, arch):
+        # :89/:103 — pod nodeSelector on arch
+        types = catalog.construct_instance_types()
+        np = make_nodepool(requirements=[{"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]}])
+        results = solve([make_pod(cpu="500m", node_selector={wk.ARCH_LABEL_KEY: arch})], node_pools=[np], types=types)
+        nc = assert_cheapest(results, types)
+        assert nc.requirements.get(wk.ARCH_LABEL_KEY).values_list() == [arch]
+        assert all(it.requirements.get(wk.ARCH_LABEL_KEY).has(arch) for it in nc.instance_type_options)
+
+    @pytest.mark.parametrize("arch", ["amd64", "arm64"])
+    def test_cheapest_pool_arch(self, arch):
+        # :116/:131 — pool requirement on arch
+        types = catalog.construct_instance_types()
+        np = make_nodepool(requirements=[{"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": [arch]}])
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types)
+        nc = assert_cheapest(results, types)
+        assert all(it.requirements.get(wk.ARCH_LABEL_KEY).has(arch) for it in nc.instance_type_options)
+
+    def test_cheapest_pod_zone(self):
+        # :230
+        types = catalog.construct_instance_types()
+        results = solve([make_pod(cpu="500m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"})], types=types)
+        nc = assert_cheapest(results, types)
+        assert nc.requirements.get(wk.ZONE_LABEL_KEY).values_list() == ["test-zone-b"]
+
+    def test_cheapest_pool_zone(self):
+        # :215
+        types = catalog.construct_instance_types()
+        np = make_nodepool(requirements=LINUX_AMD64 + [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}])
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types)
+        nc = assert_cheapest(results, types)
+        assert all(o.zone() == "test-zone-b" for it in nc.instance_type_options for o in it.offerings if nc.requirements.intersects(o.requirements) is None)
+
+    def test_cheapest_pod_capacity_type_spot(self):
+        # :258
+        types = catalog.construct_instance_types()
+        results = solve([make_pod(cpu="500m", node_selector={wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_SPOT})], types=types)
+        nc = assert_cheapest(results, types)
+        assert nc.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY).values_list() == [wk.CAPACITY_TYPE_SPOT]
+
+    def test_cheapest_pool_capacity_type_ondemand_zone(self):
+        # :271 — pool pins on-demand + zone-a
+        types = catalog.construct_instance_types()
+        np = make_nodepool(
+            requirements=LINUX_AMD64
+            + [
+                {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_ON_DEMAND]},
+                {"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]},
+            ]
+        )
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types)
+        nc = assert_cheapest(results, types)
+        cts = {o.capacity_type() for it in nc.instance_type_options for o in it.offerings if nc.requirements.intersects(o.requirements) is None}
+        assert cts == {wk.CAPACITY_TYPE_ON_DEMAND}
+
+    def test_cheapest_mixed_pod_and_pool_constraints(self):
+        # :310 — pool spot, pod zone-b
+        types = catalog.construct_instance_types()
+        np = make_nodepool(
+            requirements=LINUX_AMD64 + [{"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_SPOT]}]
+        )
+        results = solve([make_pod(cpu="500m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"})], node_pools=[np], types=types)
+        nc = assert_cheapest(results, types)
+        offs = [o for it in nc.instance_type_options for o in it.offerings if nc.requirements.intersects(o.requirements) is None]
+        assert offs and all(o.capacity_type() == wk.CAPACITY_TYPE_SPOT and o.zone() == "test-zone-b" for o in offs)
+
+    def test_no_match_pod_arch(self):
+        # :428 — nonexistent arch
+        results = solve([make_pod(node_selector={wk.ARCH_LABEL_KEY: "s390x"})])
+        assert len(results.pod_errors) == 1
+
+    def test_no_match_pool_arch_pod_zone_conflict(self):
+        # :477 — pool arm64, but no arm64 offering in the pod's zone
+        types = [
+            catalog.make_instance_type("c", 4, arch="arm64", zones=["test-zone-a"]),
+            catalog.make_instance_type("c", 4, arch="amd64", zones=["test-zone-b"]),
+        ]
+        np = make_nodepool(requirements=[{"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["arm64"]}])
+        results = solve([make_pod(node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"})], node_pools=[np], types=types)
+        assert len(results.pod_errors) == 1
+
+    def test_resources_drive_selection(self):
+        # :509 — a big pod skips small instance types
+        types = catalog.construct_instance_types()
+        results = solve([make_pod(cpu="11", memory="20Gi")])
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        from karpenter_tpu.utils import resources as res
+
+        total = res.requests_for_pods(nc.pods)
+        assert all(res.fits(total, it.allocatable()) for it in nc.instance_type_options)
+
+    def test_cheaper_on_demand_beats_pricier_spot_requirement_mix(self):
+        # :563 — when the claim may use both spot and OD, ordering considers
+        # the cheapest launchable offering per type
+        types = catalog.construct_instance_types()
+        results = solve([make_pod(cpu="500m")], types=types)
+        nc = results.new_node_claims[0]
+        ordered = order_by_price(nc.instance_type_options, nc.requirements)
+        prices = [cheapest_price([it], nc.requirements) for it in ordered]
+        assert prices == sorted(prices)
+
+
+class TestMinValuesOperators:
+    def _pool_with_min_values(self, key, operator, values, min_values):
+        np = make_nodepool(requirements=LINUX_AMD64)
+        np.spec.template.requirements = list(np.spec.template.requirements) + [
+            {"key": key, "operator": operator, "values": values, "minValues": min_values}
+        ]
+        return np
+
+    def test_min_values_in_operator(self):
+        # :621 — instance-type In with minValues=2: the claim keeps >= 2 types
+        types = catalog.construct_instance_types()
+        names = sorted({it.name for it in types if "amd64-linux" in it.name})[:4]
+        np = self._pool_with_min_values(wk.INSTANCE_TYPE_LABEL_KEY, "In", names, 2)
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types)
+        assert results.all_pods_scheduled()
+        api = results.new_node_claims[0].to_api_node_claim()
+        it_req = next(r for r in api.spec.requirements if r["key"] == wk.INSTANCE_TYPE_LABEL_KEY and r["operator"] == "In")
+        assert len(it_req["values"]) >= 2
+
+    def test_min_values_gt_operator(self):
+        # :693 — Gt on instance-cpu with minValues
+        types = catalog.construct_instance_types()
+        np = self._pool_with_min_values("karpenter.kwok.sh/instance-cpu", "Gt", ["2"], 2)
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types)
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        assert len({it.name for it in nc.instance_type_options}) >= 2
+        assert all(int(it.requirements.get("karpenter.kwok.sh/instance-cpu").any()) > 2 for it in nc.instance_type_options)
+
+    def test_min_values_gt_unsatisfiable_fails(self):
+        # :784 — Gt excludes everything
+        types = [catalog.make_instance_type("c", 4)]
+        np = self._pool_with_min_values("karpenter.kwok.sh/instance-cpu", "Gt", ["64"], 1)
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types)
+        assert len(results.pod_errors) == 1
+
+    def test_min_values_lt_operator(self):
+        # :870
+        types = catalog.construct_instance_types()
+        np = self._pool_with_min_values("karpenter.kwok.sh/instance-cpu", "Lt", ["16"], 2)
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types)
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        assert all(int(it.requirements.get("karpenter.kwok.sh/instance-cpu").any()) < 16 for it in nc.instance_type_options)
+
+    def test_min_values_lt_unsatisfiable_fails(self):
+        # :961
+        types = [catalog.make_instance_type("c", 4)]
+        np = self._pool_with_min_values("karpenter.kwok.sh/instance-cpu", "Lt", ["2"], 1)
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types)
+        assert len(results.pod_errors) == 1
+
+    def test_min_values_max_of_in_and_notin(self):
+        # :1029 — same key with In (minValues 2) and NotIn: the max governs
+        types = catalog.construct_instance_types()
+        names = sorted({it.name for it in types if "amd64-linux" in it.name})
+        np = make_nodepool(requirements=LINUX_AMD64)
+        np.spec.template.requirements = list(np.spec.template.requirements) + [
+            {"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": names[:6], "minValues": 2},
+            {"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "NotIn", "values": names[:1], "minValues": 3},
+        ]
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types)
+        assert results.all_pods_scheduled()
+        api = results.new_node_claims[0].to_api_node_claim()
+        it_req = next(r for r in api.spec.requirements if r["key"] == wk.INSTANCE_TYPE_LABEL_KEY and r["operator"] == "In")
+        assert len(it_req["values"]) >= 3
+        assert names[0] not in it_req["values"]
+
+    def test_min_values_unmet_count_fails(self):
+        # :1234 — minValues above the surviving type count
+        types = [catalog.make_instance_type("c", 4), catalog.make_instance_type("m", 4)]
+        np = self._pool_with_min_values(wk.INSTANCE_TYPE_LABEL_KEY, "In", [t.name for t in types], 3)
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types)
+        assert len(results.pod_errors) == 1
+
+    def test_min_values_multiple_keys(self):
+        # :1410 — minValues on two requirement keys simultaneously
+        types = catalog.construct_instance_types()
+        np = make_nodepool(requirements=LINUX_AMD64)
+        np.spec.template.requirements = list(np.spec.template.requirements) + [
+            {"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "Exists", "minValues": 2},
+            {"key": "karpenter.kwok.sh/instance-family", "operator": "Exists", "minValues": 2},
+        ]
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types)
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        fams = {it.requirements.get("karpenter.kwok.sh/instance-family").any() for it in nc.instance_type_options}
+        assert len(fams) >= 2
+        assert len({it.name for it in nc.instance_type_options}) >= 2
+
+    def test_min_values_best_effort_policy_relaxes(self):
+        # MinValuesPolicy=BestEffort (options.go) — unsatisfiable minValues
+        # relax instead of failing
+        types = [catalog.make_instance_type("c", 4), catalog.make_instance_type("m", 4)]
+        np = self._pool_with_min_values(wk.INSTANCE_TYPE_LABEL_KEY, "In", [t.name for t in types], 3)
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types, min_values_policy="BestEffort")
+        assert results.all_pods_scheduled()
+
+
+class TestOfferingAvailability:
+    def test_unavailable_offerings_skipped(self):
+        # fake provider ICE'd offerings are not launchable
+        it = catalog.make_instance_type("c", 4, zones=["test-zone-a", "test-zone-b"])
+        for o in it.offerings:
+            if o.zone() == "test-zone-a":
+                o.available = False
+        results = solve([make_pod(cpu="500m")], types=[it])
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        zones = {o.zone() for t in nc.instance_type_options for o in t.offerings if o.available and nc.requirements.intersects(o.requirements) is None}
+        assert zones == {"test-zone-b"}
+
+    def test_all_offerings_unavailable_fails(self):
+        it = catalog.make_instance_type("c", 4)
+        for o in it.offerings:
+            o.available = False
+        results = solve([make_pod(cpu="500m")], types=[it])
+        assert len(results.pod_errors) == 1

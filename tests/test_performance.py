@@ -195,3 +195,15 @@ class TestDriftReplacement:
         assert monitor.pending_pod_count() == 0
         assert monitor.running_pod_count() == t["pods"]
         assert wall < t["max_wall_seconds"], f"drift roll took {wall:.1f}s"
+
+
+class TestFFDThroughputFloor:
+    def test_ffd_1k_pods_meets_reference_floor(self):
+        """The host FFD path (the tensor solver's fallback) must clear the
+        reference's asserted scheduler floor of 100 pods/sec
+        (scheduling_benchmark_test.go:58) on the heterogeneous benchmark
+        workload."""
+        from bench import bench_ffd
+
+        pods_per_sec = bench_ffd(1000)
+        assert pods_per_sec >= 100, f"FFD at {pods_per_sec:.0f} pods/s is below the 100 pods/s floor"

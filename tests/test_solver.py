@@ -498,13 +498,19 @@ class TestProductionValidation:
     to the exact host FFD path."""
 
     def _corrupting(self, original):
-        import jax.numpy as jnp
+        import numpy as np
 
-        def corrupted(t, items):
-            takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = original(t, items)
+        def corrupted(t, items, n_pods):
+            out = original(t, items, n_pods)
             # inject a bug: cram every pod onto slot 0 (overcommits resources)
-            bad = jnp.zeros_like(takes).at[:, 0].set(items.item_count)
-            return bad, jnp.zeros_like(leftovers), slot_basis, slot_zoneset, slot_rank, open_count
+            counts = np.asarray(items.item_count)
+            W = counts.shape[0]
+            pad = out["nz_item"].shape[0] - W
+            out["nz_item"] = np.concatenate([np.arange(W), np.full(pad, -1)]).astype(out["nz_item"].dtype)
+            out["nz_slot"] = np.concatenate([np.zeros(W, np.int64), np.full(pad, -1)]).astype(out["nz_slot"].dtype)
+            out["nz_count"] = np.concatenate([counts, np.zeros(pad, counts.dtype)]).astype(out["nz_count"].dtype)
+            out["leftovers"] = np.zeros_like(out["leftovers"])
+            return out
 
         return corrupted
 
@@ -512,7 +518,7 @@ class TestProductionValidation:
         from karpenter_tpu.metrics import SOLVER_VALIDATION_FAILURES_TOTAL, make_registry
         from karpenter_tpu.models import scheduler_model_grouped as smg
 
-        monkeypatch.setattr(smg, "greedy_pack_grouped", self._corrupting(smg.greedy_pack_grouped))
+        monkeypatch.setattr(smg, "greedy_pack_grouped_compressed", self._corrupting(smg.greedy_pack_grouped_compressed))
         pods = [make_pod(cpu="7", memory="28Gi") for _ in range(64)]
         registry = make_registry()
         solver = TPUSolver(registry=registry)
@@ -527,7 +533,7 @@ class TestProductionValidation:
     def test_injected_bug_raises_under_force(self, monkeypatch):
         from karpenter_tpu.models import scheduler_model_grouped as smg
 
-        monkeypatch.setattr(smg, "greedy_pack_grouped", self._corrupting(smg.greedy_pack_grouped))
+        monkeypatch.setattr(smg, "greedy_pack_grouped_compressed", self._corrupting(smg.greedy_pack_grouped_compressed))
         solver = TPUSolver(force=True)
         with pytest.raises(RuntimeError, match="validation"):
             solver.solve(make_snapshot([make_pod(cpu="7", memory="28Gi") for _ in range(64)]))
@@ -544,3 +550,105 @@ class TestProductionValidation:
         assert registry.counter(SOLVER_VALIDATION_FAILURES_TOTAL).total() == 0
         assert registry.counter(SOLVER_SOLVE_TOTAL).value(backend="tpu") == 1
         assert results.all_pods_scheduled()
+
+
+class TestRelaxableWindow:
+    """Soft constraints are IN-window tier-0 (preferences honored exactly like
+    the un-relaxed FFD); the host relaxation loop takes over only when tier-0
+    leaves a pod unplaced."""
+
+    def test_satisfiable_preferred_affinity_stays_on_tpu(self):
+        pods = [
+            make_pod(cpu="1", preferred_affinity=[(10, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}])])
+            for _ in range(6)
+        ]
+        snap = make_snapshot(pods)
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+        for nc in results.new_node_claims:
+            zr = nc.requirements.get(wk.ZONE_LABEL_KEY)
+            assert zr.has("test-zone-b") and not zr.has("test-zone-a")
+        assert not validate_results(make_snapshot(pods), results)
+
+    def test_heaviest_preferred_term_wins(self):
+        # only the heaviest term is honored tier-0 (requirements.go:74-110)
+        pods = [
+            make_pod(
+                cpu="1",
+                preferred_affinity=[
+                    (5, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}]),
+                    (50, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-c"]}]),
+                ],
+            )
+        ]
+        solver = TPUSolver(force=True)
+        results = solver.solve(make_snapshot(pods))
+        assert solver.last_backend == "tpu"
+        zr = results.new_node_claims[0].requirements.get(wk.ZONE_LABEL_KEY)
+        assert zr.has("test-zone-c") and not zr.has("test-zone-a")
+
+    def test_unsatisfiable_preferred_falls_back_to_relaxation(self):
+        from karpenter_tpu.metrics import SOLVER_FALLBACK_TOTAL, make_registry
+
+        pods = [make_pod(preferred_affinity=[(10, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["mars"]}])])]
+        registry = make_registry()
+        solver = TPUSolver(registry=registry)
+        results = solver.solve(make_snapshot(pods))
+        assert solver.last_backend == "ffd-fallback"
+        assert "relaxation required" in " ".join(solver.last_fallback_reasons)
+        assert registry.counter(SOLVER_FALLBACK_TOTAL).value(reason="relaxation") == 1
+        # the host loop relaxed the preference and scheduled the pod
+        assert results.all_pods_scheduled()
+
+    def test_schedule_anyway_spread_stays_on_tpu(self):
+        sel = {"matchLabels": {"app": "s"}}
+        pods = [
+            make_pod(cpu="1", labels={"app": "s"}, tsc=[zone_spread(1, sel, when="ScheduleAnyway")])
+            for _ in range(9)
+        ]
+        compare_backends(pods)
+
+    def test_or_term_node_affinity_stays_on_tpu(self):
+        # two OR-terms; the first is satisfiable, so tier-0 (term[0] only)
+        # schedules everything without relaxation
+        pods = [
+            make_pod(
+                cpu="1",
+                required_affinity=[
+                    [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}],
+                    [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}],
+                ],
+            )
+            for _ in range(4)
+        ]
+        snap = make_snapshot(pods)
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+
+    def test_unsatisfiable_first_or_term_falls_back(self):
+        pods = [
+            make_pod(
+                required_affinity=[
+                    [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["mars"]}],
+                    [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}],
+                ],
+            )
+        ]
+        solver = TPUSolver()
+        results = solver.solve(make_snapshot(pods))
+        assert solver.last_backend == "ffd-fallback"
+        # the host loop dropped the first OR-term and scheduled on zone-b
+        assert results.all_pods_scheduled()
+
+    def test_ignore_policy_keeps_conservative_window(self):
+        pods = [make_pod(preferred_affinity=[(10, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}])])]
+        snap = make_snapshot(pods)
+        snap.preference_policy = "Ignore"
+        solver = TPUSolver()
+        solver.solve(snap)
+        assert solver.last_backend == "ffd-fallback"
+        assert "relaxable node affinity" in " ".join(solver.last_fallback_reasons)

@@ -1,0 +1,501 @@
+"""Topology depth specs ported from the reference's topology_test.go (3,118
+LoC): zone/hostname/capacity-type/arch spread, minDomains, skew edges,
+ScheduleAnyway, node taint/affinity policies, multi-constraint interplay, and
+pod (anti-)affinity families. Solver-level cases additionally run through the
+TPU backend where in-window (compare_backends)."""
+
+import pytest
+
+from helpers import hostname_anti_affinity, make_nodepool, make_pod, zone_spread
+from test_scheduler import LINUX_AMD64, build_env, make_scheduler
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.kube import PodAffinityTerm, TopologySpreadConstraint
+
+
+def solve(pods, node_pools=None, types=None, **kw):
+    env = build_env(node_pools=node_pools, types=types)
+    s = make_scheduler(*env, **kw)
+    return s.solve(pods)
+
+
+def spread(key, max_skew=1, selector=None, when="DoNotSchedule", min_domains=None, taints_policy="Ignore", affinity_policy="Honor"):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=key,
+        when_unsatisfiable=when,
+        label_selector=selector,
+        min_domains=min_domains,
+        node_taints_policy=taints_policy,
+        node_affinity_policy=affinity_policy,
+    )
+
+
+def zone_counts(results):
+    counts = {}
+    for nc in results.new_node_claims:
+        z = nc.requirements.get(wk.ZONE_LABEL_KEY)
+        assert len(z.values) == 1, f"zone not committed: {sorted(z.values)}"
+        counts[z.any()] = counts.get(z.any(), 0) + len(nc.pods)
+    return counts
+
+
+def domain_counts(results, key):
+    counts = {}
+    for nc in results.new_node_claims:
+        r = nc.requirements.get(key)
+        d = r.any() if len(r.values) == 1 else tuple(sorted(r.values))
+        counts[d] = counts.get(d, 0) + len(nc.pods)
+    return counts
+
+
+SEL = {"matchLabels": {"app": "web"}}
+
+
+def web_pods(n, **kw):
+    return [make_pod(labels={"app": "web"}, **kw) for _ in range(n)]
+
+
+class TestZoneSpreadDepth:
+    def test_balance_across_zones_match_labels(self):
+        # topology_test.go:108
+        results = solve(web_pods(8, tsc=[zone_spread(1, SEL)]))
+        assert results.all_pods_scheduled()
+        counts = zone_counts(results)
+        assert max(counts.values()) - min(counts.values()) <= 1
+        assert sum(counts.values()) == 8
+
+    def test_balance_across_zones_match_expressions(self):
+        # topology_test.go:121
+        sel = {"matchExpressions": [{"key": "app", "operator": "In", "values": ["web"]}]}
+        results = solve(web_pods(6, tsc=[zone_spread(1, sel)]))
+        assert results.all_pods_scheduled()
+        counts = zone_counts(results)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_respects_nodepool_zonal_constraints(self):
+        # topology_test.go:142 — pool pinned to one zone: all pods land there
+        np = make_nodepool(requirements=LINUX_AMD64 + [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}])
+        results = solve(web_pods(5, tsc=[zone_spread(1, SEL)]), node_pools=[np])
+        assert results.all_pods_scheduled()
+        assert set(zone_counts(results)) == {"test-zone-a"}
+
+    def test_respects_nodepool_zonal_subset(self):
+        # topology_test.go:157 — two zones allowed: spread is over the subset
+        np = make_nodepool(
+            requirements=LINUX_AMD64 + [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]}]
+        )
+        results = solve(web_pods(6, tsc=[zone_spread(1, SEL)]), node_pools=[np])
+        assert results.all_pods_scheduled()
+        counts = zone_counts(results)
+        assert set(counts) == {"test-zone-a", "test-zone-b"}
+        assert counts["test-zone-a"] == counts["test-zone-b"] == 3
+
+    def test_zonal_subset_with_labels(self):
+        # topology_test.go:173 — template label pins the domain
+        np = make_nodepool(requirements=LINUX_AMD64, labels={wk.ZONE_LABEL_KEY: "test-zone-b"})
+        results = solve(web_pods(4, tsc=[zone_spread(1, SEL)]), node_pools=[np])
+        assert results.all_pods_scheduled()
+        assert set(zone_counts(results)) == {"test-zone-b"}
+
+    def test_zonal_subset_across_nodepools(self):
+        # topology_test.go:204 — two single-zone pools split the spread
+        np_a = make_nodepool(name="pool-a", requirements=LINUX_AMD64, labels={wk.ZONE_LABEL_KEY: "test-zone-a"})
+        np_b = make_nodepool(name="pool-b", requirements=LINUX_AMD64, labels={wk.ZONE_LABEL_KEY: "test-zone-b"})
+        results = solve(web_pods(6, tsc=[zone_spread(1, SEL)]), node_pools=[np_a, np_b])
+        assert results.all_pods_scheduled()
+        counts = zone_counts(results)
+        assert counts.get("test-zone-a", 0) == counts.get("test-zone-b", 0) == 3
+
+    def test_max_skew_2(self):
+        results = solve(web_pods(9, tsc=[zone_spread(2, SEL)]))
+        assert results.all_pods_scheduled()
+        counts = zone_counts(results)
+        assert max(counts.values()) - min(counts.values()) <= 2
+
+    def test_do_not_schedule_never_violates_skew(self):
+        # topology_test.go:347 — single available zone + skew 1: only 1 pod
+        # can go until other domains exist; with one zone all pods CAN land
+        # there (skew vs min over available domains)
+        types = [catalog.make_instance_type("c", 16, zones=["test-zone-a"])]
+        results = solve(web_pods(5, tsc=[zone_spread(1, SEL)]), types=types)
+        assert results.all_pods_scheduled()
+        assert set(zone_counts(results)) == {"test-zone-a"}
+
+    def test_unknown_topology_key_blocks(self):
+        # the reference schedules pods with topology keys no node carries by
+        # treating the constraint as having no domains -> unschedulable until
+        # a domain exists; our host treats it as zero supported domains
+        results = solve(web_pods(2, tsc=[spread("custom.io/rack", selector=SEL)]))
+        assert len(results.pod_errors) == 2
+
+    def test_matches_all_pods_when_selector_omitted(self):
+        # topology_test.go:445 — nil selector counts nothing but still spreads
+        # the constrained pod itself
+        results = solve([make_pod(tsc=[zone_spread(1, None)]) for _ in range(3)])
+        assert results.all_pods_scheduled()
+
+    def test_interdependent_selectors(self):
+        # topology_test.go:457 — two deployments whose spreads select each other
+        sel_both = {"matchExpressions": [{"key": "app", "operator": "In", "values": ["a", "b"]}]}
+        pods = [make_pod(labels={"app": "a"}, tsc=[zone_spread(1, sel_both)]) for _ in range(3)] + [
+            make_pod(labels={"app": "b"}, tsc=[zone_spread(1, sel_both)]) for _ in range(3)
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        counts = zone_counts(results)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+
+class TestMinDomains:
+    def test_min_domains_forces_extra_zones(self):
+        # topology_test.go:482 — minDomains=3: even 2 pods must open 2 zones
+        # and a third domain must be possible; counts spread over >= minDomains
+        results = solve(web_pods(3, tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL, min_domains=3)]))
+        assert results.all_pods_scheduled()
+        assert len(zone_counts(results)) >= 3
+
+    def test_min_domains_equal_available_allows_scheduling(self):
+        # topology_test.go:502 — minDomains == available domains
+        types = [catalog.make_instance_type("c", 16, zones=["test-zone-a", "test-zone-b", "test-zone-c"])]
+        results = solve(web_pods(6, tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL, min_domains=3)]), types=types)
+        assert results.all_pods_scheduled()
+        assert len(zone_counts(results)) == 3
+
+    def test_min_domains_greater_than_available_caps_at_skew(self):
+        # k8s semantics: with fewer domains than minDomains the global minimum
+        # is treated as 0, so each zone accepts up to maxSkew pods and the
+        # rest wedge (upstream minDomains contract)
+        types = [catalog.make_instance_type("c", 16, zones=["test-zone-a", "test-zone-b"])]
+        results = solve(web_pods(3, tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL, min_domains=3)]), types=types)
+        assert len(results.pod_errors) == 1
+        counts = zone_counts(results)
+        assert counts == {"test-zone-a": 1, "test-zone-b": 1}
+
+    def test_min_domains_pvc_spread(self):
+        # topology_test.go:3060 analogue (without PVC): 3 zones, minDomains=3
+        results = solve(web_pods(9, tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL, min_domains=3)]))
+        assert results.all_pods_scheduled()
+        counts = zone_counts(results)
+        assert len(counts) >= 3 and max(counts.values()) - min(counts.values()) <= 1
+
+
+class TestHostnameSpreadDepth:
+    def test_balance_across_nodes(self):
+        # topology_test.go:545
+        results = solve(web_pods(4, cpu="100m", tsc=[spread(wk.HOSTNAME_LABEL_KEY, selector=SEL)]))
+        assert results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 4
+        assert all(len(nc.pods) == 1 for nc in results.new_node_claims)
+
+    def test_same_hostname_up_to_max_skew(self):
+        # topology_test.go:558 — maxSkew=4: up to 4 pods per fresh node
+        results = solve(web_pods(4, cpu="100m", tsc=[spread(wk.HOSTNAME_LABEL_KEY, max_skew=4, selector=SEL)]))
+        assert results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 1
+
+    def test_multiple_deployments_hostname_spread(self):
+        # topology_test.go:571 — two deployments, each spreading by hostname
+        sel_a, sel_b = {"matchLabels": {"app": "a"}}, {"matchLabels": {"app": "b"}}
+        pods = [make_pod(cpu="100m", labels={"app": "a"}, tsc=[spread(wk.HOSTNAME_LABEL_KEY, selector=sel_a)]) for _ in range(2)] + [
+            make_pod(cpu="100m", labels={"app": "b"}, tsc=[spread(wk.HOSTNAME_LABEL_KEY, selector=sel_b)]) for _ in range(2)
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        # each deployment's pods land on distinct nodes; deployments may share
+        for nc in results.new_node_claims:
+            apps = [p.metadata.labels["app"] for p in nc.pods]
+            assert len(apps) == len(set(apps))
+
+
+class TestCapacityTypeAndArchSpread:
+    def test_balance_across_capacity_types(self):
+        # topology_test.go:653
+        results = solve(web_pods(4, tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=SEL)]))
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.CAPACITY_TYPE_LABEL_KEY)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_respects_nodepool_capacity_type_constraint(self):
+        # topology_test.go:666 — OD-only pool: all pods one domain
+        np = make_nodepool(
+            requirements=LINUX_AMD64
+            + [{"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_ON_DEMAND]}]
+        )
+        results = solve(web_pods(3, tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=SEL)]), node_pools=[np])
+        assert results.all_pods_scheduled()
+        assert set(domain_counts(results, wk.CAPACITY_TYPE_LABEL_KEY)) == {wk.CAPACITY_TYPE_ON_DEMAND}
+
+    def test_balance_across_arch(self):
+        # topology_test.go:895 — no arch constraint on the pool
+        np = make_nodepool(requirements=[{"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]}])
+        results = solve(
+            [make_pod(labels={"app": "web"}, tsc=[spread(wk.ARCH_LABEL_KEY, selector=SEL)]) for _ in range(4)],
+            node_pools=[np],
+        )
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.ARCH_LABEL_KEY)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+
+def env_with_labeled_nodes(node_labels_list, node_pools, cpu="100m"):
+    """Existing tiny nodes carrying custom labels (the reference's
+    NodeInclusionPolicy specs build domains from unreachable nodes)."""
+    from karpenter_tpu.apis.nodeclaim import COND_INITIALIZED, COND_REGISTERED, NodeClaim
+    from karpenter_tpu.kube import Node, ObjectMeta, Store
+    from karpenter_tpu.kube.objects import NodeSpec, NodeStatus
+    from karpenter_tpu.state import Cluster
+    from karpenter_tpu.state.informer import start_informers
+    from karpenter_tpu.utils.clock import FakeClock
+    from karpenter_tpu.utils.resources import parse_resource_list
+
+    store, clock = Store(), FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    for np in node_pools:
+        store.create(np)
+    for i, labels in enumerate(node_labels_list):
+        nc = NodeClaim(metadata=ObjectMeta(name=f"ec-{i}"))
+        nc.status.provider_id = f"kwok://en-{i}"
+        nc.status.conditions.set_true(COND_REGISTERED)
+        nc.status.conditions.set_true(COND_INITIALIZED)
+        store.create(nc)
+        store.create(
+            Node(
+                metadata=ObjectMeta(name=f"en-{i}", labels={wk.HOSTNAME_LABEL_KEY: f"en-{i}", **labels}),
+                spec=NodeSpec(provider_id=f"kwok://en-{i}"),
+                status=NodeStatus(
+                    capacity=parse_resource_list({"cpu": cpu, "memory": "256Mi", "pods": "110"}),
+                    allocatable=parse_resource_list({"cpu": cpu, "memory": "256Mi", "pods": "110"}),
+                ),
+            )
+        )
+    return store, clock, cluster, node_pools, catalog.construct_instance_types()
+
+
+class TestSpreadPolicies:
+    def _tainted_pools(self):
+        from karpenter_tpu.scheduling.taints import Taint
+
+        tainted = make_nodepool(
+            name="tainted",
+            requirements=LINUX_AMD64 + [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-d"]}],
+            taints=[Taint(key="dedicated", value="x", effect="NoSchedule")],
+        )
+        open_np = make_nodepool(
+            name="open",
+            requirements=LINUX_AMD64 + [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]}],
+        )
+        return open_np, tainted
+
+    def test_node_taints_policy_honor_excludes_tainted_pool(self):
+        # topology_test.go:1392 — under Honor an intolerant pod doesn't count
+        # the tainted pool's zone as a domain: spread balances over a and b
+        open_np, tainted = self._tainted_pools()
+        results = solve(
+            web_pods(4, tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL, taints_policy="Honor")]),
+            node_pools=[open_np, tainted],
+        )
+        assert results.all_pods_scheduled()
+        counts = zone_counts(results)
+        assert "test-zone-d" not in counts
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_node_taints_policy_ignore_counts_tainted_domains(self):
+        # topology_test.go:1336 — under Ignore the tainted pool's zone counts
+        # as a 0-domain the pod can never reach: the spread wedges at maxSkew
+        open_np, tainted = self._tainted_pools()
+        results = solve(
+            web_pods(4, tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL, taints_policy="Ignore")]),
+            node_pools=[open_np, tainted],
+        )
+        # zone-d stuck at 0: only maxSkew pods per reachable zone (a, b)
+        assert len(results.pod_errors) == 2
+
+    def _affinity_policy_fixture(self, policy):
+        # topology_test.go:1529/1596 — two tiny existing nodes carry
+        # spread-label domains foo/bar with selector=mismatch; the pool offers
+        # baz with selector=value; pods select selector=value
+        np = make_nodepool(requirements=LINUX_AMD64, labels={"fake-label": "baz", "selector": "value"})
+        env = env_with_labeled_nodes(
+            [{"fake-label": "foo", "selector": "mismatch"}, {"fake-label": "bar", "selector": "mismatch"}],
+            [np],
+        )
+        s = make_scheduler(*env)
+        pods = web_pods(
+            5,
+            node_selector={"selector": "value"},
+            tsc=[spread("fake-label", selector=SEL, affinity_policy=policy)],
+        )
+        return s.solve(pods)
+
+    def test_node_affinity_policy_ignore_counts_filtered_domains(self):
+        # Ignore: foo/bar count although the pod can't reach them; only one
+        # pod may land on baz before skew wedges
+        results = self._affinity_policy_fixture("Ignore")
+        assert len(results.pod_errors) == 4
+        assert sum(len(nc.pods) for nc in results.new_node_claims) == 1
+
+    def test_node_affinity_policy_honor_filters_domains(self):
+        # Honor: the unreachable foo/bar nodes are filtered out; all pods
+        # schedule onto baz
+        results = self._affinity_policy_fixture("Honor")
+        assert results.all_pods_scheduled()
+
+
+class TestMultiConstraintInterplay:
+    def test_hostname_and_zone_together(self):
+        # topology_test.go:941
+        pods = web_pods(6, cpu="100m", tsc=[zone_spread(1, SEL), spread(wk.HOSTNAME_LABEL_KEY, max_skew=1, selector=SEL)])
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        counts = zone_counts(results)
+        assert max(counts.values()) - min(counts.values()) <= 1
+        assert all(len(nc.pods) == 1 for nc in results.new_node_claims)
+
+    def test_zone_and_capacity_type_together(self):
+        # topology_test.go:1049
+        pods = web_pods(8, tsc=[zone_spread(1, SEL), spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=SEL)])
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        zc = zone_counts(results)
+        cc = domain_counts(results, wk.CAPACITY_TYPE_LABEL_KEY)
+        assert max(zc.values()) - min(zc.values()) <= 1
+        assert max(cc.values()) - min(cc.values()) <= 1
+
+    def test_spread_limited_by_node_selector(self):
+        # topology_test.go:1740 — nodeSelector narrows spread domains
+        pods = web_pods(4, node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"}, tsc=[zone_spread(1, SEL)])
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert set(zone_counts(results)) == {"test-zone-b"}
+
+    def test_spread_limited_by_required_node_affinity(self):
+        # topology_test.go:1788
+        pods = web_pods(
+            6,
+            required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]}]],
+            tsc=[zone_spread(1, SEL)],
+        )
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        counts = zone_counts(results)
+        assert set(counts) <= {"test-zone-a", "test-zone-b"}
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_spread_not_limited_by_preferred_affinity(self):
+        # topology_test.go:1832 — preferences do NOT narrow spread domains
+        pods = web_pods(
+            8,
+            preferred_affinity=[(10, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}])],
+            tsc=[zone_spread(1, SEL)],
+        )
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert len(zone_counts(results)) > 1
+
+
+class TestPodAffinityDepth:
+    def test_empty_affinity_schedules(self):
+        # topology_test.go:1926
+        from karpenter_tpu.kube import Affinity
+
+        p = make_pod()
+        p.spec.affinity = Affinity()
+        results = solve([p])
+        assert results.all_pods_scheduled()
+
+    def test_pod_affinity_hostname_colocates(self):
+        # topology_test.go:1936
+        sel = {"matchLabels": {"app": "cache"}}
+        pods = [make_pod(cpu="100m", labels={"app": "cache"}, pod_affinity=[PodAffinityTerm(label_selector=sel, topology_key=wk.HOSTNAME_LABEL_KEY)]) for _ in range(3)]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 1
+
+    def test_self_affinity_zone(self):
+        # topology_test.go:2123
+        sel = {"matchLabels": {"app": "self"}}
+        pods = [make_pod(labels={"app": "self"}, pod_affinity=[PodAffinityTerm(label_selector=sel, topology_key=wk.ZONE_LABEL_KEY)]) for _ in range(4)]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert len(zone_counts(results)) == 1
+
+    def test_affinity_to_nonexistent_pod_blocks(self):
+        # topology_test.go:2710
+        sel = {"matchLabels": {"app": "ghost"}}
+        pods = [make_pod(pod_affinity=[PodAffinityTerm(label_selector=sel, topology_key=wk.ZONE_LABEL_KEY)])]
+        results = solve(pods)
+        assert len(results.pod_errors) == 1
+
+    def test_affinity_namespace_filtering_no_match(self):
+        # topology_test.go:2840 — target exists in another namespace only
+        sel = {"matchLabels": {"app": "t"}}
+        target = make_pod(ns="other", labels={"app": "t"})
+        chaser = make_pod(ns="default", pod_affinity=[PodAffinityTerm(label_selector=sel, topology_key=wk.ZONE_LABEL_KEY)])
+        results = solve([target, chaser])
+        assert chaser.key() in results.pod_errors
+
+    def test_affinity_namespace_list_matches(self):
+        # topology_test.go:2878 — hostname affinity across an explicit
+        # namespace list colocates with the target pod
+        sel = {"matchLabels": {"app": "t"}}
+        target = make_pod(ns="other", labels={"app": "t"})
+        chaser = make_pod(
+            ns="default",
+            pod_affinity=[PodAffinityTerm(label_selector=sel, topology_key=wk.HOSTNAME_LABEL_KEY, namespaces=["other"])],
+        )
+        results = solve([target, chaser])
+        assert results.all_pods_scheduled()
+        homes = [nc for nc in results.new_node_claims if nc.pods]
+        assert len(homes) == 1, "affinity must colocate the chaser with its target"
+
+    def test_two_affinity_groups_with_incompatible_selectors(self):
+        # topology_test.go:2178
+        sel_a, sel_b = {"matchLabels": {"g": "a"}}, {"matchLabels": {"g": "b"}}
+        pods = [
+            make_pod(labels={"g": "a"}, node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"}, pod_affinity=[PodAffinityTerm(label_selector=sel_a, topology_key=wk.ZONE_LABEL_KEY)]),
+            make_pod(labels={"g": "b"}, node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"}, pod_affinity=[PodAffinityTerm(label_selector=sel_b, topology_key=wk.ZONE_LABEL_KEY)]),
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert len(zone_counts(results)) == 2
+
+
+class TestPodAntiAffinityDepth:
+    def test_simple_hostname_anti_affinity_separates(self):
+        # topology_test.go:2297
+        sel = {"matchLabels": {"app": "db"}}
+        pods = [make_pod(cpu="100m", labels={"app": "db"}, anti_affinity=[hostname_anti_affinity(sel)]) for _ in range(4)]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert all(len(nc.pods) == 1 for nc in results.new_node_claims)
+
+    def test_zone_anti_affinity_not_violated(self):
+        # topology_test.go:2319 — 4 zones, 5 zone-anti pods: at most one
+        # schedules per batch (late committal blocks the rest)
+        sel = {"matchLabels": {"app": "db"}}
+        pods = [make_pod(labels={"app": "db"}, anti_affinity=[PodAffinityTerm(label_selector=sel, topology_key=wk.ZONE_LABEL_KEY)]) for _ in range(5)]
+        results = solve(pods)
+        placed = [nc for nc in results.new_node_claims if nc.pods]
+        zones = set()
+        for nc in placed:
+            zones.update(nc.requirements.get(wk.ZONE_LABEL_KEY).values)
+        # no two placed pods share a zone
+        assert len(zones) >= len(placed)
+
+    def test_anti_affinity_against_running_pod(self):
+        # topology_test.go:2530 analogue via cluster state is covered in
+        # test_solver fallback; here: the anti pod schedules when no match runs
+        sel = {"matchLabels": {"app": "lonely"}}
+        pods = [make_pod(labels={"app": "lonely"}, anti_affinity=[hostname_anti_affinity(sel)])]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+
+    def test_anti_affinity_different_selector_coexists(self):
+        sel_other = {"matchLabels": {"app": "other"}}
+        pods = [make_pod(cpu="100m", labels={"app": "db"}, anti_affinity=[hostname_anti_affinity(sel_other)]) for _ in range(3)]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        # the selector matches nothing: pods pack onto one node
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 1
