@@ -89,6 +89,11 @@ class InstanceType:
     # DRA template devices this instance type ships when launched
     # (reference types.go:133-135 DynamicResources); [kube.objects.Device]
     dynamic_resources: list = field(default_factory=list)
+    # template-pool shared counter sets the devices above consume from — each
+    # LAUNCHED node gets its own fresh budget (reference
+    # cloudprovider/dynamicresources.go ResourceSliceTemplate.SharedCounters)
+    # [{"name": str, "counters": {counter name: Quantity|str}}]
+    dynamic_resources_counters: list = field(default_factory=list)
 
     _allocatable: Optional[dict[str, Quantity]] = field(default=None, repr=False, compare=False)
 

@@ -211,7 +211,9 @@ class SchedulingNodeClaim:
                 if tracker is None:
                     from ....scheduling.dynamicresources.allocator import AllocationTracker
 
-                    tracker = AllocationTracker()
+                    # shares the allocator's pool-budget registry so template
+                    # counter sets (partitionable devices) bound this claim
+                    tracker = AllocationTracker(budgets=self.allocator.counter_budgets)
                 result, derr = self.allocator.allocate(
                     self.hostname, self.allocator.template_devices(it), pod_data.resource_claims, tracker
                 )

@@ -312,6 +312,11 @@ class Device:
     # multi-allocatable (consumable-capacity) devices can serve several claims
     # until their capacity is exhausted
     allow_multiple_allocations: bool = False
+    # partitionable devices (resourcev1 Device.ConsumesCounters): allocating
+    # this device draws from its pool's shared counter sets — e.g. MIG
+    # partitions consuming slices of one physical GPU's memory/SM budget
+    # [{"counterSet": str, "counters": {name: Quantity|str}}]
+    consumes_counters: list[dict] = field(default_factory=list)
 
 
 @dataclass
@@ -326,6 +331,10 @@ class ResourceSlice:
     all_nodes: bool = False
     node_selector: list[list[dict]] = field(default_factory=list)  # OR'd terms
     devices: list[Device] = field(default_factory=list)
+    # pool-level shared counter budgets (resourcev1 CounterSet): devices in
+    # this pool draw from these via consumes_counters
+    # [{"name": str, "counters": {counter name: Quantity|str}}]
+    shared_counters: list[dict] = field(default_factory=list)
     kind: str = "ResourceSlice"
 
 

@@ -12,10 +12,15 @@ TPU-native redesign notes: the CEL selector language is replaced by structured
 selector dicts ({attribute|capacity, operator, values}) evaluated host-side —
 device selection is control-plane work and stays off the device; the tensor
 solver falls back to FFD for claim-bearing pods (encode.py). Partitionable
-devices/counter sets and per-instance-type requirement superposition
-(allocator.go:90-134) are not modeled; template allocation instead filters the
-instance-type set directly, which preserves the observable behavior (claims
-only land on instance types that can satisfy them).
+devices are modeled via pool-level shared counter sets
+(partitionable_devices.go): devices declare consumes_counters, pools declare
+shared_counters (in-cluster slices) or dynamic_resources_counters (instance
+type templates, fresh per launched node), and the tracker draws down lazily-
+materialized per-candidate remaining budgets. Per-instance-type requirement
+superposition (allocator.go:90-134) is not modeled; template allocation
+instead filters the instance-type set directly, which preserves the
+observable behavior (claims only land on instance types that can satisfy
+them).
 """
 
 from __future__ import annotations
@@ -180,22 +185,83 @@ class _MatchAttributeConstraint:
             self.value = None
 
 
-class AllocationTracker:
-    """Devices already spoken for: exclusive allocations and consumed capacity
-    of multi-allocatable devices (allocationtracker.go)."""
+def _norm_counters(counters: dict) -> dict:
+    return {k: (v if isinstance(v, Quantity) else Quantity.parse(v)) for k, v in (counters or {}).items()}
 
-    def __init__(self):
+
+def _budget_from_sets(counter_sets: list[dict]) -> dict:
+    """[{"name", "counters"}] -> {set name: {counter name: Quantity}}."""
+    return {cs.get("name", ""): _norm_counters(cs.get("counters")) for cs in counter_sets or []}
+
+
+class AllocationTracker:
+    """Devices already spoken for: exclusive allocations, consumed capacity of
+    multi-allocatable devices, and remaining shared-counter budgets of
+    partitionable-device pools (allocationtracker.go +
+    partitionable_devices.go). `budgets` is a shared read-only registry of
+    pool counter budgets (pool key -> {set: {counter: Quantity}}); a pool's
+    remaining state materializes lazily on first touch so per-candidate
+    trackers each draw down their own copy."""
+
+    def __init__(self, budgets: dict | None = None):
         self.exclusive: set = set()  # device ids
         self.consumed: dict = {}  # device id -> {capacity name: Quantity}
+        self.budgets = budgets if budgets is not None else {}
+        self.remaining_counters: dict = {}  # pool key -> {set: {counter: Quantity}}
 
     def copy(self) -> "AllocationTracker":
-        c = AllocationTracker()
+        c = AllocationTracker(budgets=self.budgets)
         c.exclusive = set(self.exclusive)
         c.consumed = {k: dict(v) for k, v in self.consumed.items()}
+        c.remaining_counters = {pk: {cs: dict(cn) for cs, cn in sets.items()} for pk, sets in self.remaining_counters.items()}
         return c
+
+    def _remaining_for(self, pool_key: tuple) -> dict | None:
+        rem = self.remaining_counters.get(pool_key)
+        if rem is None:
+            budget = self.budgets.get(pool_key)
+            if budget is None:
+                return None  # pool declares no counter sets: unconstrained
+            rem = {cs: dict(cn) for cs, cn in budget.items()}
+            self.remaining_counters[pool_key] = rem
+        return rem
+
+    def _counters_available(self, ref: "_DeviceRef") -> bool:
+        consumption = getattr(ref.device, "consumes_counters", None)
+        if not consumption:
+            return True
+        rem = self._remaining_for(ref.device_id[:3])
+        if rem is None:
+            return True
+        for cc in consumption:
+            counter_set = rem.get(cc.get("counterSet", ""))
+            if counter_set is None:
+                return False  # consuming from an undeclared set: never fits
+            for name, want in _norm_counters(cc.get("counters")).items():
+                have = counter_set.get(name)
+                if have is None or have.milli < want.milli:
+                    return False
+        return True
+
+    def _counters_apply(self, ref: "_DeviceRef", sign: int) -> None:
+        consumption = getattr(ref.device, "consumes_counters", None)
+        if not consumption:
+            return
+        rem = self._remaining_for(ref.device_id[:3])
+        if rem is None:
+            return
+        for cc in consumption:
+            counter_set = rem.get(cc.get("counterSet", ""))
+            if counter_set is None:
+                continue
+            for name, want in _norm_counters(cc.get("counters")).items():
+                if name in counter_set:
+                    counter_set[name] = counter_set[name] + Quantity(sign * want.milli)
 
     def available(self, ref: _DeviceRef, want_capacity: dict) -> bool:
         if ref.device_id in self.exclusive:
+            return False
+        if not self._counters_available(ref):
             return False
         if not ref.device.allow_multiple_allocations:
             return True
@@ -210,6 +276,7 @@ class AllocationTracker:
         return True
 
     def take(self, ref: _DeviceRef, want_capacity: dict) -> None:
+        self._counters_apply(ref, -1)
         if ref.device.allow_multiple_allocations:
             used = self.consumed.setdefault(ref.device_id, {})
             for name, want in (want_capacity or {}).items():
@@ -218,6 +285,7 @@ class AllocationTracker:
             self.exclusive.add(ref.device_id)
 
     def release(self, ref: _DeviceRef, want_capacity: dict) -> None:
+        self._counters_apply(ref, 1)
         if ref.device.allow_multiple_allocations:
             used = self.consumed.get(ref.device_id, {})
             for name, want in (want_capacity or {}).items():
@@ -240,8 +308,10 @@ class Allocator:
         self.class_selectors: dict[str, list[dict]] = {
             dc.metadata.name: dc.selectors for dc in store.list("DeviceClass")
         }
-        # node name -> [_DeviceRef] from in-cluster ResourceSlices
+        # node name -> [_DeviceRef] from in-cluster ResourceSlices; pool
+        # counter budgets from slices' SharedCounters (partitionable devices)
         self.node_devices: dict[str, list[_DeviceRef]] = {}
+        self.counter_budgets: dict[tuple, dict] = {}  # pool key -> {set: {counter: Quantity}}
         for sl in store.list("ResourceSlice"):
             if not sl.node_name:
                 continue  # selector-scoped slices not modeled; see module doc
@@ -251,8 +321,13 @@ class Allocator:
                     _DeviceRef(device=d, driver=sl.driver, pool=sl.pool_name,
                                device_id=(sl.node_name, sl.driver, sl.pool_name, d.name))
                 )
+            if getattr(sl, "shared_counters", None):
+                pool_key = (sl.node_name, sl.driver, sl.pool_name)
+                budget = self.counter_budgets.setdefault(pool_key, {})
+                budget.update(_budget_from_sets(sl.shared_counters))
         # seed allocated-device state from in-cluster claim statuses
-        self.base_tracker = AllocationTracker()
+        self.base_tracker = AllocationTracker(budgets=self.counter_budgets)
+        _id_to_ref = {r.device_id: r for refs in self.node_devices.values() for r in refs}
         self.allocated_claims: dict[str, dict] = {}  # claim key -> allocation
         for rc in store.list("ResourceClaim"):
             alloc = rc.status.allocation
@@ -275,6 +350,11 @@ class Allocator:
                     pass
                 else:
                     self.base_tracker.exclusive.add(did)
+                # pre-allocated partitionable devices consumed their pool's
+                # counter budget (partitionable_devices.go InitRemainingCounters)
+                ref = _id_to_ref.get(did)
+                if ref is not None and getattr(ref.device, "consumes_counters", None):
+                    self.base_tracker._counters_apply(ref, -1)
         # in-loop committed picks layered on top of the base state
         self.loop_tracker = self.base_tracker.copy()
         # claim key -> node/claim target committed this loop (shared claims
@@ -428,14 +508,19 @@ class Allocator:
     def commit_for_node(self, node_name: str, result: AllocationResult) -> None:
         self.commit(node_name, result, self.loop_tracker)
 
-    @staticmethod
-    def template_devices(instance_type) -> list[_DeviceRef]:
-        """Devices an instance type would ship when launched
-        (cloudprovider types.go:133-135 DynamicResources)."""
+    def template_devices(self, instance_type) -> list[_DeviceRef]:
+        """Devices an instance type would ship when launched (cloudprovider
+        types.go:133-135 DynamicResources). Registers the template pool's
+        shared-counter budget; each candidate's tracker lazily materializes
+        its OWN remaining copy, so every launched node gets a fresh budget
+        (partitionable_devices.go template counters)."""
         out = []
         for d in getattr(instance_type, "dynamic_resources", None) or []:
             out.append(
                 _DeviceRef(device=d, driver="template", pool=instance_type.name,
                            device_id=("template", instance_type.name, "pool", d.name))
             )
+        sets = getattr(instance_type, "dynamic_resources_counters", None)
+        if sets:
+            self.counter_budgets.setdefault(("template", instance_type.name, "pool"), _budget_from_sets(sets))
         return out

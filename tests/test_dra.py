@@ -349,3 +349,166 @@ class TestEndToEnd:
         env.settle(rounds=3)
         rc = env.store.get("ResourceClaim", "want-gpu")
         assert not rc.status.reserved_for and rc.status.allocation is None
+
+
+def mig(name, memory_slices, sm_slices=None):
+    """A MIG-style partition consuming from its pool's shared GPU counters."""
+    counters = {"memory": memory_slices}
+    if sm_slices is not None:
+        counters["sm"] = sm_slices
+    return Device(
+        name=name,
+        attributes={"gpu.example.com/model": "a100", "gpu.example.com/profile": name},
+        consumes_counters=[{"counterSet": "gpu-0", "counters": counters}],
+    )
+
+
+def gpu_counters(memory="40", sm=None):
+    counters = {"memory": memory}
+    if sm is not None:
+        counters["sm"] = sm
+    return [{"name": "gpu-0", "counters": counters}]
+
+
+class TestPartitionableDevices:
+    """Counter-set accounting for partitionable devices, adapted from the
+    reference's allocator_test.go partitionable section +
+    partitionable_devices.go."""
+
+    def _slice(self, devices, counters):
+        store, clock, cluster = build_store()
+        store.create(
+            ResourceSlice(
+                metadata=ObjectMeta(name="n1-gpus"),
+                driver="gpu",
+                pool_name="n1",
+                node_name="n1",
+                devices=devices,
+                shared_counters=counters,
+            )
+        )
+        return store, clock
+
+    def test_partitions_bounded_by_shared_counters(self):
+        # three partitions exist, but the 40-unit memory counter only funds two
+        store, clock = self._slice([mig("p20a", "20"), mig("p20b", "20"), mig("p30", "30")], gpu_counters("40"))
+        a = Allocator(store, clock)
+        r1, err = a.allocate_for_node("n1", [gpu_claim("first")])
+        assert err is None
+        a.commit_for_node("n1", r1)
+        r2, err2 = a.allocate_for_node("n1", [gpu_claim("second")])
+        assert err2 is None
+        a.commit_for_node("n1", r2)
+        # 40 units consumed (20+20): the 30 partition (or any other) can't fund
+        _, err3 = a.allocate_for_node("n1", [gpu_claim("third")])
+        assert err3 is not None
+
+    def test_dfs_backtracks_over_counter_conflicts(self):
+        # one claim wants TWO partitions; picking p30 first starves the second
+        # request, so the DFS must settle on 20+20
+        store, clock = self._slice([mig("p30", "30"), mig("p20a", "20"), mig("p20b", "20")], gpu_counters("40"))
+        a = Allocator(store, clock)
+        result, err = a.allocate_for_node("n1", [gpu_claim("pair", count=2)])
+        assert err is None
+        picked = {ref.device.name for _, ref, _ in result.picks["default/pair"]}
+        assert picked == {"p20a", "p20b"}
+
+    def test_multi_counter_dimensions(self):
+        # both memory AND sm must fit (sm exhausts first here)
+        store, clock = self._slice(
+            [mig("a", "10", sm_slices="4"), mig("b", "10", sm_slices="4")], gpu_counters("40", sm="6")
+        )
+        a = Allocator(store, clock)
+        r1, err = a.allocate_for_node("n1", [gpu_claim("one")])
+        assert err is None
+        a.commit_for_node("n1", r1)
+        _, err2 = a.allocate_for_node("n1", [gpu_claim("two")])
+        assert err2 is not None, "sm counter (6) cannot fund a second 4-slice partition"
+
+    def test_undeclared_counter_set_never_fits(self):
+        d = Device(name="orphan", attributes={"gpu.example.com/model": "a100"},
+                   consumes_counters=[{"counterSet": "missing-set", "counters": {"memory": "1"}}])
+        store, clock = self._slice([d], gpu_counters("40"))
+        a = Allocator(store, clock)
+        _, err = a.allocate_for_node("n1", [gpu_claim("want")])
+        assert err is not None
+
+    def test_preallocated_partition_consumes_budget(self):
+        # an in-cluster allocation already holds p30: only 10 units remain
+        store, clock = self._slice([mig("p30", "30"), mig("p20", "20"), mig("p10", "10")], gpu_counters("40"))
+        taken = gpu_claim("taken")
+        taken.status.allocation = {"nodeName": "n1", "devices": [{"request": "gpus", "driver": "gpu", "pool": "n1", "device": "p30"}]}
+        store.create(taken)
+        a = Allocator(store, clock)
+        # p20 can't fund (10 left), p10 can
+        r, err = a.allocate_for_node("n1", [gpu_claim("want")])
+        assert err is None
+        picked = {ref.device.name for _, ref, _ in r.picks["default/want"]}
+        assert picked == {"p10"}
+
+    def test_counters_released_on_failed_probe(self):
+        # a failing multi-claim allocate must leave the loop tracker intact
+        store, clock = self._slice([mig("p20a", "20"), mig("p20b", "20")], gpu_counters("40"))
+        a = Allocator(store, clock)
+        _, err = a.allocate_for_node("n1", [gpu_claim("big", count=3)])
+        assert err is not None  # only two partitions exist
+        # the failed probe consumed nothing: both partitions still allocate
+        r, err2 = a.allocate_for_node("n1", [gpu_claim("pair", count=2)])
+        assert err2 is None
+        assert len(r.picks["default/pair"]) == 2
+
+
+class TestTemplatePartitionableDevices:
+    """Template-pool counters: every launched node gets a fresh budget."""
+
+    def _env(self):
+        store, clock, cluster = build_store()
+        np = make_nodepool(requirements=LINUX_AMD64)
+        store.create(np)
+        gpu_type = InstanceType(
+            name="mig-8x-amd64-linux",
+            requirements=Requirements.from_labels({
+                wk.INSTANCE_TYPE_LABEL_KEY: "mig-8x-amd64-linux",
+                wk.ARCH_LABEL_KEY: "amd64",
+                wk.OS_LABEL_KEY: "linux",
+            }),
+            offerings=[
+                Offering(
+                    requirements=Requirements.from_labels({
+                        wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND,
+                        wk.ZONE_LABEL_KEY: "test-zone-a",
+                    }),
+                    price=10.0,
+                )
+            ],
+            capacity=parse_resource_list({"cpu": "8", "memory": "32Gi", "pods": "110"}),
+            dynamic_resources=[mig("p20a", "20"), mig("p20b", "20"), mig("p30", "30")],
+            dynamic_resources_counters=gpu_counters("40"),
+        )
+        return store, clock, cluster, [np], [gpu_type]
+
+    def test_template_counters_bound_one_claim(self):
+        # two 1-partition pods fit one node (20+20 <= 40); a third forces a
+        # SECOND NodeClaim whose template budget is fresh
+        store, clock, cluster, pools, types = self._env()
+        for n in ("c1", "c2", "c3"):
+            store.create(gpu_claim(n))
+        s = Scheduler(store, cluster, pools, {"default-pool": types}, cluster.nodes(), [], clock, dra_enabled=True)
+        pods = [claim_pod(f"p-{c}", c, cpu="100m") for c in ("c1", "c2", "c3")]
+        results = s.solve(pods)
+        assert results.all_pods_scheduled()
+        # first-fit packs p20a+p20b (40 units) onto the first claim; the
+        # third pod exceeds the budget and must open a second node
+        assert len(results.new_node_claims) == 2
+        assert sorted(len(nc.pods) for nc in results.new_node_claims) == [1, 2]
+
+    def test_fresh_budget_per_node(self):
+        # four pods, each wanting a 20-unit partition: exactly two per node
+        store, clock, cluster, pools, types = self._env()
+        for i in range(4):
+            store.create(gpu_claim(f"c{i}"))
+        s = Scheduler(store, cluster, pools, {"default-pool": types}, cluster.nodes(), [], clock, dra_enabled=True)
+        results = s.solve([claim_pod(f"p{i}", f"c{i}", cpu="100m") for i in range(4)])
+        assert results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 2
+        assert all(len(nc.pods) == 2 for nc in results.new_node_claims)
