@@ -91,6 +91,9 @@ class TPUSolver:
         # slot axis across devices (parallel/sharded.py); bit-identical to
         # the single-device kernel, so everything downstream is unchanged
         self.mesh = mesh
+        from .encode import EncodeCache
+
+        self.encode_cache = EncodeCache()
         self.last_backend: str = ""
         self.last_fallback_reasons: list[str] = []
 
@@ -137,7 +140,7 @@ class TPUSolver:
         return self.fallback.solve(snap)
 
     def solve(self, snap: SolverSnapshot) -> Results:
-        enc = encode(snap)
+        enc = encode(snap, cache=self.encode_cache)
         self.last_fallback_reasons = enc.fallback_reasons
         if enc.fallback_reasons:
             if self.force:
@@ -223,6 +226,8 @@ class TPUSolver:
             from ..controllers.provisioning.scheduling.reservationmanager import ReservationManager
 
             reservation_manager = ReservationManager(snap.instance_types)
+            if not reservation_manager.capacity:
+                reservation_manager = None  # no reserved offerings anywhere
 
         overhead_groups_cache: dict[int, list] = {}
         # per-slot work dedupes by SIGNATURE: pod requirements/requests lower

@@ -652,3 +652,57 @@ class TestRelaxableWindow:
         solver.solve(snap)
         assert solver.last_backend == "ffd-fallback"
         assert "relaxable node affinity" in " ".join(solver.last_fallback_reasons)
+
+
+class TestEncodeCache:
+    def test_cache_hits_produce_identical_encoding(self):
+        from karpenter_tpu.solver.encode import EncodeCache, encode
+
+        pods = [make_pod(cpu="1", labels={"app": "w"}) for _ in range(6)]
+        snap = make_snapshot(pods)
+        cache = EncodeCache()
+        e1 = encode(snap, cache=cache)
+        e2 = encode(snap, cache=cache)  # all signature lookups hit
+        assert len(cache.pod_sig) == 6
+        import numpy as np
+
+        assert np.array_equal(e1.sig_of_pod, e2.sig_of_pod)
+        assert np.array_equal(e1.sig_req, e2.sig_req)
+
+    def test_pod_edit_bumps_resource_version_and_recomputes(self):
+        from karpenter_tpu.solver.encode import EncodeCache, encode
+
+        snap = make_snapshot([make_pod(cpu="1", name="w0")])
+        # route the pod through the store so updates bump resourceVersion
+        pod = snap.pods[0]
+        snap.store.create(pod)
+        stored = snap.store.get("Pod", "w0")
+        cache = EncodeCache()
+        snap.pods = [stored]
+        e1 = encode(snap, cache=cache)
+        rv1 = stored.metadata.resource_version
+
+        def grow(p):
+            from karpenter_tpu.utils.resources import parse_resource_list
+
+            p.spec.containers[0].resources = {"requests": parse_resource_list({"cpu": "3"})}
+
+        snap.store.patch("Pod", "w0", grow)
+        updated = snap.store.get("Pod", "w0")
+        assert updated.metadata.resource_version != rv1
+        snap2 = make_snapshot([updated])
+        e2 = encode(snap2, cache=cache)
+        # the changed spec re-encoded: the request vector reflects 3 cpu
+        assert float(e2.sig_req[0][0]) == 3000.0  # milli-cpu
+        assert float(e1.sig_req[0][0]) == 1000.0
+
+    def test_solver_cache_accelerates_warm_resolve(self):
+        # behavioral: repeated solves through one TPUSolver reuse signatures
+        pods = [make_pod(cpu="1") for _ in range(30)]
+        solver = TPUSolver(force=True)
+        r1 = solver.solve(make_snapshot(pods))
+        n_cached = len(solver.encode_cache.pod_sig)
+        assert n_cached == 30
+        r2 = solver.solve(make_snapshot(pods))
+        assert len(solver.encode_cache.pod_sig) == 30  # pure hits
+        assert len(r1.new_node_claims) == len(r2.new_node_claims)
