@@ -1,3 +1,5 @@
-"""Test/e2e infrastructure (reference: test/pkg/environment/common)."""
+"""Test/e2e infrastructure (reference: test/pkg/environment/common + debug)."""
 
+from .debug import ObjectChurnWatcher  # noqa: F401
+from .metrics_poller import MetricsPoller, ResourceStats, scrape_exposition  # noqa: F401
 from .monitor import Monitor  # noqa: F401

@@ -1,0 +1,116 @@
+"""E2E observability harness: metrics poller + object-churn watcher.
+
+Reference: test/pkg/environment/common/karpenter_metrics_poller.go and
+test/pkg/debug/.
+"""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu import metrics as m
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.testing import MetricsPoller, ObjectChurnWatcher, scrape_exposition
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_env():
+    env = Environment(options=Options())
+    env.store.create(make_nodepool(requirements=LINUX_AMD64))
+    return env
+
+
+class TestMetricsPoller:
+    def test_resource_stats_over_ticks(self):
+        env = make_env()
+        poller = MetricsPoller(env.registry)
+        for i in range(6):
+            env.store.create(make_pod(cpu="500m", name=f"p{i}"))
+            env.clock.step(5)
+            env.tick(provision_force=True)
+            poller.poll()
+        stats = poller.stats()
+        assert stats.sample_count == 6
+        assert stats.max_memory_mb > 0
+        assert stats.p95_memory_mb <= stats.max_memory_mb
+        assert stats.avg_memory_mb <= stats.max_memory_mb
+        assert stats.max_cpu_cores >= stats.avg_cpu_cores >= 0
+
+    def test_metric_series_tracks_registry(self):
+        env = make_env()
+        poller = MetricsPoller(env.registry, track=(m.SCHEDULER_SCHEDULING_DURATION, m.NODECLAIMS_CREATED_TOTAL))
+        poller.poll()  # before any scheduling
+        for i in range(3):
+            env.store.create(make_pod(cpu="500m", name=f"p{i}"))
+        env.clock.step(5)
+        env.tick(provision_force=True)
+        poller.poll()
+        series = poller.series[m.SCHEDULER_SCHEDULING_DURATION]
+        assert series[0] == 0 and series[-1] >= 1, series  # solves observed
+        created = poller.series[m.NODECLAIMS_CREATED_TOTAL]
+        assert created[-1] >= 1
+
+    def test_http_exposition_scrape(self):
+        from karpenter_tpu.operator.server import OperatorServer
+        import urllib.request
+
+        env = make_env()
+        env.store.create(make_pod(cpu="500m", name="w"))
+        env.clock.step(5)
+        env.tick(provision_force=True)
+        server = OperatorServer(env, port=0, bind="127.0.0.1")
+        port = server.start()
+        try:
+            body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        finally:
+            server.stop()
+        samples = scrape_exposition(body)
+        assert any(name == m.CLUSTER_STATE_NODE_COUNT for name, _ in samples)
+        count_keys = [k for k in samples if k[0] == f"{m.SCHEDULER_SCHEDULING_DURATION}_count"]
+        assert count_keys and samples[count_keys[0]] >= 1
+
+
+class TestObjectChurnWatcher:
+    def test_records_lifecycle_events(self):
+        env = make_env()
+        watcher = ObjectChurnWatcher(env.store, clock=env.clock)
+        env.store.create(make_pod(cpu="500m", name="w0"))
+        env.clock.step(5)
+        env.tick(provision_force=True)
+        counts = watcher.counts()
+        assert counts.get(("Pod", "ADDED")) == 1
+        assert counts.get(("Node", "ADDED"), 0) >= 1
+        assert counts.get(("NodeClaim", "ADDED"), 0) >= 1
+        assert counts.get(("Pod", "MODIFIED"), 0) >= 1  # the bind
+        dump = watcher.dump()
+        assert "w0" in dump and "ADDED" in dump
+
+    def test_dump_is_bounded_and_recent(self):
+        env = make_env()
+        watcher = ObjectChurnWatcher(env.store, kinds=("Pod",), clock=env.clock, max_events=10)
+        for i in range(25):
+            env.store.create(make_pod(cpu="100m", name=f"p{i}"))
+        assert len(watcher.events) <= 10
+        # the retained half is the most recent
+        assert any("p24" in e.key for e in watcher.events)
+
+    def test_context_manager_dumps_on_failure(self):
+        env = make_env()
+        captured = []
+        try:
+            with ObjectChurnWatcher(env.store, clock=env.clock, sink=captured.append):
+                env.store.create(make_pod(cpu="100m", name="doomed"))
+                raise AssertionError("spec failed")
+        except AssertionError:
+            pass
+        assert captured and "doomed" in captured[0]
+
+    def test_context_manager_silent_on_success(self):
+        env = make_env()
+        captured = []
+        with ObjectChurnWatcher(env.store, clock=env.clock, sink=captured.append):
+            env.store.create(make_pod(cpu="100m", name="fine"))
+        assert not captured
