@@ -78,6 +78,14 @@ class Store:
         with self._lock:
             self._watchers.setdefault(kind, []).append(fn)
 
+    def unwatch(self, kind: str, fn: WatchFn) -> None:
+        """Remove a previously-registered watch (no-op if absent) so
+        short-lived observers don't accumulate across a suite."""
+        with self._lock:
+            fns = self._watchers.get(kind)
+            if fns is not None and fn in fns:
+                fns.remove(fn)
+
     def _enqueue(self, event: str, obj) -> None:
         # caller must hold self._lock
         self._pending.append((event, obj))

@@ -67,6 +67,10 @@ class SchedulerTensors:
     counts_zone_init: jnp.ndarray  # [G, Z]
     counts_host_init: jnp.ndarray  # [G, N]
     existing_zoneset: jnp.ndarray  # [n_existing, Z] bool
+    # host-port usage of existing nodes (encode.py port vocabulary)
+    existing_port_any: jnp.ndarray  # [n_existing, P1] bool
+    existing_port_wild: jnp.ndarray  # [n_existing, P1] bool
+    existing_port_spec: jnp.ndarray  # [n_existing, P2] bool
     zone_key: int  # static: key id of the zone label (-1 if absent)
     n_existing: int  # static
     n_slots: int  # static
@@ -91,6 +95,9 @@ jax.tree_util.register_dataclass(
         "counts_zone_init",
         "counts_host_init",
         "existing_zoneset",
+        "existing_port_any",
+        "existing_port_wild",
+        "existing_port_spec",
     ],
     meta_fields=["zone_key", "n_existing", "n_slots"],
 )
@@ -153,6 +160,9 @@ def make_tensors(enc, n_slots: int | None = None, with_pods: bool = True) -> Sch
         counts_zone_init=jnp.asarray(counts_zone),
         counts_host_init=jnp.asarray(counts_host),
         existing_zoneset=jnp.asarray(existing_zoneset),
+        existing_port_any=jnp.asarray(enc.existing_port_any),
+        existing_port_wild=jnp.asarray(enc.existing_port_wild),
+        existing_port_spec=jnp.asarray(enc.existing_port_spec),
         zone_key=enc.zone_key_id,
         n_existing=enc.n_existing,
         n_slots=int(n_slots),
@@ -317,6 +327,11 @@ def _greedy_pack_impl(t: SchedulerTensors, zone_key: int, n_existing: int, n_slo
 
 
 def greedy_pack(t: SchedulerTensors):
-    """Run the packer. Returns (assignment[P] -> slot or -1, slot_basis[N],
-    slot_zoneset[N, Z], slot_rank[N], open_count)."""
+    """Run the per-pod packer. Returns (assignment[P] -> slot or -1,
+    slot_basis[N], slot_zoneset[N, Z], slot_rank[N], open_count).
+
+    LIMITATION: this legacy per-pod scan does NOT enforce host ports — the
+    production path is the grouped kernel (scheduler_model_grouped), which
+    does. Callers must only feed it port-free snapshots (TPUSolver never
+    routes ported pods here)."""
     return _greedy_pack_impl(t, t.zone_key, t.n_existing, t.n_slots)

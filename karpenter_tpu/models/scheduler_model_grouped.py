@@ -58,11 +58,25 @@ class ItemTensors:
     item_zone_allowed: jnp.ndarray  # [W, Z]
     item_member: jnp.ndarray  # [W, G]
     item_count: jnp.ndarray  # [W] i32
+    # host ports (encode.py port vocabulary)
+    item_port_any: jnp.ndarray  # [W, P1] bool
+    item_port_wild: jnp.ndarray  # [W, P1] bool
+    item_port_spec: jnp.ndarray  # [W, P2] bool
 
 
 jax.tree_util.register_dataclass(
     ItemTensors,
-    data_fields=["item_req", "item_mask", "item_taint_ok", "item_zone_allowed", "item_member", "item_count"],
+    data_fields=[
+        "item_req",
+        "item_mask",
+        "item_taint_ok",
+        "item_zone_allowed",
+        "item_member",
+        "item_count",
+        "item_port_any",
+        "item_port_wild",
+        "item_port_spec",
+    ],
     meta_fields=[],
 )
 
@@ -100,6 +114,9 @@ def build_items(enc):
         item_zone_allowed=enc.sig_zone_allowed[rep_sig],
         item_member=sig_member[rep_sig],
         item_count=counts[order].astype(np.int32),
+        item_port_any=enc.sig_port_any[rep_sig],
+        item_port_wild=enc.sig_port_wild[rep_sig],
+        item_port_spec=enc.sig_port_spec[rep_sig],
     )
     return arrays, item_pods
 
@@ -206,6 +223,8 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
 
     # initial slot state from GLOBAL slot ids: ids < n_existing hold the
     # existing nodes' remaining envelopes, the rest are closed
+    P1 = items.item_port_any.shape[1]
+    P2 = items.item_port_spec.shape[1]
     in_existing = slot_ids < n_existing
     if n_existing:
         safe_row = jnp.clip(slot_ids, 0, Nrows - 1)
@@ -213,10 +232,17 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
         slot_basis0 = jnp.where(in_existing, slot_ids, -1).astype(jnp.int32)
         slot_rem0 = jnp.where(in_existing[:, None], t.row_alloc[safe_row], NEG)
         slot_zoneset0 = jnp.where(in_existing[:, None], t.existing_zoneset[safe_ex], False)
+        # existing_port_* share existing_zoneset's max(n_existing, 1) rows
+        slot_pany0 = jnp.where(in_existing[:, None], t.existing_port_any[safe_ex], False)
+        slot_pwild0 = jnp.where(in_existing[:, None], t.existing_port_wild[safe_ex], False)
+        slot_pspec0 = jnp.where(in_existing[:, None], t.existing_port_spec[safe_ex], False)
     else:
         slot_basis0 = jnp.full((N_loc,), -1, dtype=jnp.int32)
         slot_rem0 = jnp.full((N_loc, R), NEG)
         slot_zoneset0 = jnp.zeros((N_loc, Z), dtype=bool)
+        slot_pany0 = jnp.zeros((N_loc, P1), dtype=bool)
+        slot_pwild0 = jnp.zeros((N_loc, P1), dtype=bool)
+        slot_pspec0 = jnp.zeros((N_loc, P2), dtype=bool)
     slot_rank0 = jnp.full((N_loc,), -1, dtype=jnp.int32)
 
     is_offering_row = jnp.arange(Nrows) >= n_existing
@@ -228,13 +254,31 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
     choose_key_items = row_choose_key(t.row_alloc, t.row_pool_rank, items.item_req)
 
     def step(state, i):
-        slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count = state
+        slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, ports = state
         req = items.item_req[i]
         za = items.item_zone_allowed[i]
         mem = items.item_member[i]
         c = items.item_count[i]
         compat_rows = compat_items[i]
         choose_key = choose_key_items[i]
+        pany = items.item_port_any[i]
+        pwild = items.item_port_wild[i]
+        pspec = items.item_port_spec[i]
+        has_ports = jnp.any(pany)
+        # two replicas sharing a host port conflict with each other: a ported
+        # item places at most ONE pod per slot (hostportusage.go matches())
+        port_cap = jnp.where(has_ports, 1, INF_I)
+
+        def port_ok_of(ports_now):
+            """Slots whose current port usage doesn't conflict with this item
+            — recomputed from the THREADED port state like member_host_cap."""
+            slot_pany, slot_pwild, slot_pspec = ports_now
+            conflict = (
+                jnp.any(slot_pany & pwild[None, :], axis=1)
+                | jnp.any(slot_pwild & pany[None, :], axis=1)
+                | jnp.any(slot_pspec & pspec[None, :], axis=1)
+            )
+            return ~conflict
 
         zone_member_mask = mem & (t.group_kind == KIND_ZONE_SPREAD)
         is_zm = jnp.any(zone_member_mask)
@@ -283,12 +327,12 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
         # zone availability: a fitting template offers it, or a slot holds it
         openable_z = jnp.any(fits_row[:, None] & t.rank_zoneset[rank_of_row], axis=0)  # [Z]
 
-        def place(cnt, elig_mask, za_for_new, commit_z, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count):
+        def place(cnt, elig_mask, za_for_new, commit_z, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports):
             """Place `cnt` identical pods: prefix-sum first-fit over eligible
             slots, then open new slots of the best row for the leftover.
             commit_z >= 0 pins touched slots to that zone."""
             cap_res = _int_cap(slot_rem, req)
-            cap_j = jnp.where(elig_mask, jnp.minimum(cap_res, member_host_cap(counts_host)), 0)
+            cap_j = jnp.where(elig_mask & port_ok_of(ports), jnp.minimum(jnp.minimum(cap_res, member_host_cap(counts_host)), port_cap), 0)
             cap_j = jnp.clip(cap_j, 0, INF_I)
             prefix = gprefix(cap_j)
             take = jnp.clip(cnt - prefix, 0, cap_j).astype(jnp.int32)
@@ -299,7 +343,7 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
             fr = fits_row & rank_zone_ok[rank_of_row]
             o = jnp.argmin(jnp.where(fr, choose_key, BIGF)).astype(jnp.int32)
             o_ok = fr[o]
-            cstar = jnp.minimum(row_cap[o], host_cap_new)
+            cstar = jnp.minimum(jnp.minimum(row_cap[o], host_cap_new), port_cap)
             can_open = o_ok & (cstar >= 1)
             m = jnp.where(can_open, -(-left // jnp.maximum(cstar, 1)), 0)
             m = jnp.clip(m, 0, N - open_count)
@@ -324,19 +368,24 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
             slot_zoneset = jnp.where(touched[:, None], slot_zoneset & narrowed, slot_zoneset)
             slot_rem = slot_rem - take[:, None].astype(slot_rem.dtype) * req[None, :]
             counts_host = counts_host + jnp.where(host_member_mask[:, None], take[None, :], 0)
-            return take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count
+            slot_pany, slot_pwild, slot_pspec = ports
+            slot_pany = jnp.where(touched[:, None], slot_pany | pany[None, :], slot_pany)
+            slot_pwild = jnp.where(touched[:, None], slot_pwild | pwild[None, :], slot_pwild)
+            slot_pspec = jnp.where(touched[:, None], slot_pspec | pspec[None, :], slot_pspec)
+            ports = (slot_pany, slot_pwild, slot_pspec)
+            return take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports
 
         def simple_path(op):
-            slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count = op
+            slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports = op
             elig = slot_compat & jnp.any(slot_zoneset & zone_feasible[None, :], axis=1)
-            take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count = place(
-                c, elig, zone_feasible, jnp.int32(-1), slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count
+            take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports = place(
+                c, elig, zone_feasible, jnp.int32(-1), slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports
             )
-            return take, left, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count)
+            return take, left, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports)
 
         def zone_path(op):
-            slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count = op
-            slotcap_z = gany_slots((slot_compat & (_int_cap(slot_rem, req) > 0))[:, None] & slot_zoneset)
+            slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports = op
+            slotcap_z = gany_slots((slot_compat & (_int_cap(slot_rem, req) > 0) & port_ok_of(ports))[:, None] & slot_zoneset)
             vsum = jnp.sum(jnp.where(zone_member_mask[:, None], counts_zone, 0), axis=0)  # [Z]
             skew_star = jnp.min(jnp.where(zone_member_mask, t.group_skew, INF_I))
             allowed_real = za & zone_is_real
@@ -369,9 +418,9 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
             for z in range(Z):  # Z is small and static; unrolled
                 cz = inc[z]
                 elig = slot_compat_of(slot_basis) & slot_zoneset[:, z]
-                take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count = place(
+                take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports = place(
                     cz, elig, (jnp.arange(Z) == z), jnp.int32(z),
-                    slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count,
+                    slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports,
                 )
                 take_all = take_all + take
                 pending = pending + left
@@ -387,22 +436,22 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
                 headroom = jnp.clip(zmin_u + skew_star - vsum_u[z], 0, INF_I)
                 cz = jnp.minimum(pending, jnp.where(finite[z], headroom, 0))
                 elig = slot_compat_of(slot_basis) & slot_zoneset[:, z]
-                take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count = place(
+                take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports = place(
                     cz, elig, (jnp.arange(Z) == z), jnp.int32(z),
-                    slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count,
+                    slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports,
                 )
                 take_all = take_all + take
                 pending = pending - (cz - left)
                 placed_z = placed_z.at[z].add(cz - left)
             counts_zone = counts_zone + jnp.where(zone_member_mask[:, None], placed_z[None, :], 0)
-            return take_all, pending, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count)
+            return take_all, pending, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports)
 
-        operand = (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count)
-        take, leftover, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count) = jax.lax.cond(
+        operand = (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports)
+        take, leftover, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports) = jax.lax.cond(
             is_zm, zone_path, simple_path, operand
         )
 
-        new_state = (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count)
+        new_state = (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, ports)
         return new_state, (take, leftover)
 
     init = (
@@ -413,8 +462,9 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
         t.counts_zone_init,
         t.counts_host_init,
         jnp.int32(n_existing),
+        (slot_pany0, slot_pwild0, slot_pspec0),
     )
-    (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count), (takes, leftovers) = jax.lax.scan(
+    (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, _ports), (takes, leftovers) = jax.lax.scan(
         step, init, jnp.arange(W, dtype=jnp.int32)
     )
     return takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count

@@ -54,6 +54,10 @@ class HostPortUsage:
     def remove(self, pod_key: str) -> None:
         self._reserved.pop(pod_key, None)
 
+    def all_ports(self) -> list[HostPort]:
+        """Every reserved port across pods (the node's current usage)."""
+        return [p for ports in self._reserved.values() for p in ports]
+
     def copy(self) -> "HostPortUsage":
         c = HostPortUsage()
         c._reserved = {k: list(v) for k, v in self._reserved.items()}

@@ -130,4 +130,26 @@ def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zone
                 for j in bad_slots[:_MAX_ERRORS]:
                     errors.append(f"group {int(g)}: {kind} violated on slot {int(j)} (count {int(counts[j])})")
 
+    # -- host ports -----------------------------------------------------------
+    if enc.sig_port_any.any():
+        pa = enc.sig_port_any[psig].astype(np.int64)  # [Pv, P1]
+        pw = enc.sig_port_wild[psig].astype(np.int64)
+        psp = enc.sig_port_spec[psig].astype(np.int64)
+        any_cnt = np.zeros((N, pa.shape[1]), np.int64)
+        wild_cnt = np.zeros((N, pw.shape[1]), np.int64)
+        spec_cnt = np.zeros((N, psp.shape[1]), np.int64)
+        np.add.at(any_cnt, slots, pa)
+        np.add.at(wild_cnt, slots, pw)
+        np.add.at(spec_cnt, slots, psp)
+        n_ex = enc.n_existing
+        if n_ex:
+            any_cnt[:n_ex] += enc.existing_port_any[:n_ex]
+            wild_cnt[:n_ex] += enc.existing_port_wild[:n_ex]
+            spec_cnt[:n_ex] += enc.existing_port_spec[:n_ex]
+        # conflict: two specific users of one (ip, port, proto), or a wildcard
+        # plus ANY other user of the (port, proto) (hostportusage.go matches)
+        bad = ((wild_cnt >= 1) & (any_cnt >= 2)).any(axis=1) | (spec_cnt >= 2).any(axis=1)
+        for j in np.nonzero(bad)[0][:_MAX_ERRORS]:
+            errors.append(f"slot {int(j)}: host port conflict")
+
     return errors[:_MAX_ERRORS]

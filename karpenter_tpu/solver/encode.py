@@ -122,6 +122,17 @@ class EncodedSnapshot:
     sig_requests: list  # [S] ResourceList (for decode)
     req_class_of_sig: np.ndarray  # [S] i32 — sigs sharing a Requirements class
 
+    # host ports (hostportusage.go, tensorized as per-slot bitmasks over an
+    # interned port vocabulary): P1 = (port, proto) keys, P2 = specific-IP
+    # (ip, port, proto) keys. Conflict(a on slot) iff slot_any & a.wild, or
+    # slot_wild & a.any, or slot_spec & a.spec.
+    sig_port_any: np.ndarray  # [S, P1] bool — all of the sig's ports
+    sig_port_wild: np.ndarray  # [S, P1] bool — wildcard-IP ports
+    sig_port_spec: np.ndarray  # [S, P2] bool — specific-IP ports
+    existing_port_any: np.ndarray  # [n_existing, P1]
+    existing_port_wild: np.ndarray  # [n_existing, P1]
+    existing_port_spec: np.ndarray  # [n_existing, P2]
+
     # topology groups
     n_zones: int
     zone_names: list[str]
@@ -320,11 +331,6 @@ def check_capability(snap, pods=None) -> list[str]:
                 reasons.append(f"{pod.key()}: node-filtered spread counting")
                 break
         else:
-            from ..scheduling.hostports import pod_host_ports
-
-            if pod_host_ports(pod):
-                reasons.append(f"{pod.key()}: host ports")
-                break
             if any(v.get("persistentVolumeClaim") or v.get("ephemeral") is not None for v in pod.spec.volumes):
                 # PVC topology alternatives + per-driver limits stay host-side
                 reasons.append(f"{pod.key()}: PVC-backed volumes")
@@ -338,6 +344,13 @@ def check_capability(snap, pods=None) -> list[str]:
     # inverse anti-affinity from already-running pods isn't tensorized
     if snap.cluster.pods_with_anti_affinity():
         reasons.append("cluster has running pods with required anti-affinity")
+    # pod host ports ARE tensorized (per-slot port bitmasks); daemons with
+    # host ports would reserve ports on every fresh node, which the slot
+    # init doesn't model — host path handles those snapshots
+    from ..scheduling.hostports import pod_host_ports
+
+    if any(pod_host_ports(d) for d in snap.daemonset_pods):
+        reasons.append("daemonset pods use host ports")
     # strict reserved-offering mode (consolidation sims) requires per-pod
     # reservation failures, which only the sequential host path expresses;
     # decode's host-side cap implements fallback mode only
@@ -610,6 +623,42 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
                 else:
                     sig_zone_allowed[s, zid] = r.has(z)
 
+    # -- host-port vocabulary + masks -----------------------------------------
+    from ..scheduling.hostports import pod_host_ports
+
+    sig_ports = [pod_host_ports(p) for p in rep_pods]
+    if any(sig_ports):
+        # the state node already tracks its bound pods' ports
+        # (statenode.py:154); read it rather than re-deriving via store walks
+        existing_ports = [sn.host_port_usage.all_ports() for sn in state_nodes]
+    else:
+        existing_ports = [[] for _ in state_nodes]
+    pk_ids: dict[tuple, int] = {}
+    ps_ids: dict[tuple, int] = {}
+    for ports in sig_ports + existing_ports:
+        for p in ports:
+            pk_ids.setdefault((p.port, p.protocol), len(pk_ids))
+            if p.ip != "0.0.0.0":
+                ps_ids.setdefault((p.ip, p.port, p.protocol), len(ps_ids))
+    P1, P2 = max(len(pk_ids), 1), max(len(ps_ids), 1)
+
+    def port_masks(port_lists, n):
+        any_ = np.zeros((n, P1), dtype=bool)
+        wild = np.zeros((n, P1), dtype=bool)
+        spec = np.zeros((n, P2), dtype=bool)
+        for i, ports in enumerate(port_lists):
+            for p in ports:
+                k = pk_ids[(p.port, p.protocol)]
+                any_[i, k] = True
+                if p.ip == "0.0.0.0":
+                    wild[i, k] = True
+                else:
+                    spec[i, ps_ids[(p.ip, p.port, p.protocol)]] = True
+        return any_, wild, spec
+
+    sig_port_any, sig_port_wild, sig_port_spec = port_masks(sig_ports, S)
+    existing_port_any, existing_port_wild, existing_port_spec = port_masks(existing_ports, max(n_existing, 1))
+
     # zones offered per template rank
     n_ranks = max(len(templates), 1)
     rank_zoneset = np.zeros((n_ranks, Z), dtype=bool)
@@ -710,6 +759,12 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         sig_requirements=sig_requirements,
         sig_requests=sig_requests,
         req_class_of_sig=req_class_of_sig,
+        sig_port_any=sig_port_any,
+        sig_port_wild=sig_port_wild,
+        sig_port_spec=sig_port_spec,
+        existing_port_any=existing_port_any,
+        existing_port_wild=existing_port_wild,
+        existing_port_spec=existing_port_spec,
         n_zones=Z,
         zone_names=zone_names,
         rank_zoneset=rank_zoneset,

@@ -46,6 +46,31 @@ def validate_results(snap, results) -> list[str]:
                 errors.append(f"existing node {en.name()}: over-committed {r}")
                 break
 
+    # host ports: per placement target, pairwise conflict check from the pod
+    # OBJECTS (independent of the tensor path's port masks)
+    from ..scheduling.hostports import HostPortUsage, pod_host_ports
+
+    for idx, nc in enumerate(results.new_node_claims):
+        usage = HostPortUsage()
+        for p in nc.pods:
+            ports = pod_host_ports(p)
+            err = usage.conflicts(p.key(), ports)
+            if err is not None:
+                errors.append(f"claim {idx}: {err}")
+                break
+            usage.add(p.key(), ports)
+    for en in results.existing_nodes:
+        if not en.pods:
+            continue
+        usage = en.state_node.host_port_usage.copy()
+        for p in en.pods:
+            ports = pod_host_ports(p)
+            err = usage.conflicts(p.key(), ports)
+            if err is not None:
+                errors.append(f"existing node {en.name()}: {err}")
+                break
+            usage.add(p.key(), ports)
+
     # topology: spread skew and anti-affinity over the final placement
     placements = []  # (pod, zone, host)
     for nc in results.new_node_claims:

@@ -37,8 +37,18 @@ class ObjectChurnWatcher:
         self.max_events = max_events
         self.sink = sink  # callable(str) on failure; default print
         self.events: list[ChurnEvent] = []
+        self._recorders: list[tuple[str, object]] = []
         for kind in kinds:
-            store.watch(kind, self._make_recorder(kind))
+            fn = self._make_recorder(kind)
+            self._recorders.append((kind, fn))
+            store.watch(kind, fn)
+
+    def close(self) -> None:
+        """Unsubscribe from the store (dead watchers must not keep paying a
+        per-event deepcopy on a long-lived suite store)."""
+        for kind, fn in self._recorders:
+            self.store.unwatch(kind, fn)
+        self._recorders.clear()
 
     def _now(self) -> float:
         return self.clock.now() if self.clock is not None else time.monotonic()
@@ -82,4 +92,5 @@ class ObjectChurnWatcher:
     def __exit__(self, exc_type, exc, tb) -> bool:
         if exc_type is not None:
             (self.sink or print)(self.dump())
+        self.close()
         return False  # never swallow the failure

@@ -167,3 +167,16 @@ class TestShardedSolverEndToEnd:
         assignment = dryrun_step(encode(snap), make_mesh())
         assert assignment.shape[0] == 16
         assert (assignment >= 0).all()
+
+
+class TestShardedPorts:
+    def test_host_ports_equivalent_sharded(self):
+        # port bitmask state is slot-sharded; results must stay bit-identical
+        def ported(name):
+            p = make_pod(cpu="100m", name=name)
+            p.spec.containers[0].ports = [{"containerPort": 8080, "hostPort": 8080}]
+            return p
+
+        pods = [ported(f"hp{i}") for i in range(5)] + [make_pod(cpu="100m") for _ in range(7)]
+        enc, sharded = assert_pack_equivalent(make_snapshot(pods), make_mesh())
+        assert int(np.asarray(sharded[1]).sum()) == 0

@@ -452,9 +452,9 @@ class TestMultiGroupSpread:
 
 class TestSignatureCapability:
     def test_init_container_host_ports_split_signatures(self):
-        # capability runs on signature REPRESENTATIVES, so a spec field that
-        # changes capability (init-container hostPorts) must split signatures
-        # — otherwise pod order decides whether the fallback triggers
+        # hostPorts change the tensor lowering (port masks), so a spec field
+        # carrying them (including init containers) must split signatures —
+        # otherwise replicas would inherit the wrong port bitmask
         from karpenter_tpu.kube.objects import Container
         from karpenter_tpu.solver.encode import pod_signature
 
@@ -464,11 +464,12 @@ class TestSignatureCapability:
         plain.spec.init_containers = [Container(name="init")]
         assert pod_signature(plain) != pod_signature(ported)
 
+        # host ports are IN-window: the tensor path handles them directly
         snap = make_snapshot([plain, ported])
-        solver = TPUSolver()
+        solver = TPUSolver(force=True)
         results = solver.solve(snap)
-        assert solver.last_backend == "ffd-fallback"
-        assert "host ports" in " ".join(solver.last_fallback_reasons)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
 
 
 class TestFallback:
@@ -706,3 +707,102 @@ class TestEncodeCache:
         r2 = solver.solve(make_snapshot(pods))
         assert len(solver.encode_cache.pod_sig) == 30  # pure hits
         assert len(r1.new_node_claims) == len(r2.new_node_claims)
+
+
+class TestHostPortsWindow:
+    """Host ports are tensorized (per-slot port bitmasks): replicas sharing a
+    hostPort must land one-per-node; distinct specific IPs coexist; wildcard
+    conflicts with everything on the (port, proto)."""
+
+    def _ported_pod(self, port=8080, ip=None, proto="TCP", cpu="100m", name=None):
+        from karpenter_tpu.kube.objects import Container
+
+        p = make_pod(cpu=cpu, name=name)
+        entry = {"containerPort": port, "hostPort": port, "protocol": proto}
+        if ip:
+            entry["hostIP"] = ip
+        p.spec.containers[0].ports = [entry]
+        return p
+
+    def test_wildcard_port_replicas_one_per_node(self):
+        pods = [self._ported_pod() for _ in range(4)]
+        tpu_results, ffd_results = compare_backends(pods)
+        assert len(tpu_results.new_node_claims) == 4
+        assert all(len(nc.pods) == 1 for nc in tpu_results.new_node_claims)
+
+    def test_distinct_specific_ips_coexist(self):
+        pods = [self._ported_pod(ip="10.0.0.1"), self._ported_pod(ip="10.0.0.2")]
+        tpu_results, _ = compare_backends(pods)
+        assert len([nc for nc in tpu_results.new_node_claims if nc.pods]) == 1
+
+    def test_wildcard_conflicts_with_specific(self):
+        pods = [self._ported_pod(ip="10.0.0.1"), self._ported_pod()]  # specific + wildcard
+        tpu_results, _ = compare_backends(pods)
+        assert len(tpu_results.new_node_claims) == 2
+
+    def test_different_protocols_coexist(self):
+        pods = [self._ported_pod(proto="TCP"), self._ported_pod(proto="UDP")]
+        tpu_results, _ = compare_backends(pods)
+        assert len([nc for nc in tpu_results.new_node_claims if nc.pods]) == 1
+
+    def test_different_ports_coexist(self):
+        pods = [self._ported_pod(port=8080), self._ported_pod(port=9090)]
+        tpu_results, _ = compare_backends(pods)
+        assert len([nc for nc in tpu_results.new_node_claims if nc.pods]) == 1
+
+    def test_existing_node_port_blocks_placement(self):
+        # an existing node whose bound pod already holds the port cannot take
+        # another ported pod — the tensor path sees the node's port usage
+        from test_sharded import existing_node_snapshot
+
+        bound = self._ported_pod(name="bound")
+        types = [catalog.make_instance_type("c", 16, zones=["test-zone-a"])]
+        snap = existing_node_snapshot([self._ported_pod(name="incoming")], types)
+        # bind the ported pod to the existing node, then refresh the state
+        # view so the node's port usage is visible to encode
+        bound.spec.node_name = "n1"
+        snap.store.create(bound)
+        snap.state_nodes = snap.cluster.nodes()
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+        # the incoming pod must NOT land on n1 (port taken): a new claim opens
+        assert len(results.new_node_claims) == 1
+        assert not any(en.pods for en in results.existing_nodes)
+
+    def test_mixed_ported_and_plain_pack_together(self):
+        pods = [self._ported_pod(name=f"ported-{i}") for i in range(3)] + [
+            make_pod(cpu="100m", name=f"plain-{i}") for i in range(6)
+        ]
+        tpu_results, ffd_results = compare_backends(pods)
+        # 3 nodes for the ported pods; plain pods share them
+        assert len(tpu_results.new_node_claims) == 3
+
+    def test_validator_catches_port_conflicts(self, monkeypatch):
+        # corrupt the pack to pile ported replicas onto one slot: the in-solve
+        # validator must reject and fall back to FFD
+        import numpy as np
+
+        from karpenter_tpu.models import scheduler_model_grouped as smg
+
+        original = smg.greedy_pack_grouped_compressed
+
+        def corrupted(t, items, n_pods):
+            out = original(t, items, n_pods)
+            counts = np.asarray(items.item_count)
+            W = counts.shape[0]
+            pad = out["nz_item"].shape[0] - W
+            out["nz_item"] = np.concatenate([np.arange(W), np.full(pad, -1)]).astype(out["nz_item"].dtype)
+            out["nz_slot"] = np.concatenate([np.zeros(W, np.int64), np.full(pad, -1)]).astype(out["nz_slot"].dtype)
+            out["nz_count"] = np.concatenate([counts, np.zeros(pad, counts.dtype)]).astype(out["nz_count"].dtype)
+            out["leftovers"] = np.zeros_like(out["leftovers"])
+            return out
+
+        monkeypatch.setattr(smg, "greedy_pack_grouped_compressed", corrupted)
+        pods = [self._ported_pod(name=f"p{i}") for i in range(3)]
+        solver = TPUSolver()
+        results = solver.solve(make_snapshot(pods))
+        assert solver.last_backend == "ffd-fallback"
+        assert any("host port conflict" in r for r in solver.last_fallback_reasons)
+        assert results.all_pods_scheduled()

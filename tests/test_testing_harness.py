@@ -114,3 +114,11 @@ class TestObjectChurnWatcher:
         with ObjectChurnWatcher(env.store, clock=env.clock, sink=captured.append):
             env.store.create(make_pod(cpu="100m", name="fine"))
         assert not captured
+
+    def test_close_unsubscribes(self):
+        env = make_env()
+        with ObjectChurnWatcher(env.store, kinds=("Pod",), clock=env.clock) as w:
+            env.store.create(make_pod(cpu="100m", name="seen"))
+        n = len(w.events)
+        env.store.create(make_pod(cpu="100m", name="unseen"))
+        assert len(w.events) == n, "closed watcher must not receive events"
