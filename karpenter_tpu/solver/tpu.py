@@ -72,6 +72,11 @@ _REASON_FAMILIES = (
 )
 
 
+class DecodeError(RuntimeError):
+    """A decoded claim failed its launchability re-check; the solve must be
+    retried on the exact host path."""
+
+
 def _reason_family(reason: str) -> str:
     """Stable low-cardinality label for a fallback reason."""
     for needle, family in _REASON_FAMILIES:
@@ -189,8 +194,15 @@ class TPUSolver:
             if self.force:
                 raise RuntimeError(f"tensor placement failed validation: {violations}")
             return self._fall_back(snap, [f"validation: {v}" for v in violations], family="validation")
+        try:
+            results = self._decode(snap, enc, assignment, slot_basis_np, slot_zoneset_np)
+        except DecodeError as e:
+            self._count(SOLVER_VALIDATION_FAILURES_TOTAL)
+            if self.force:
+                raise
+            return self._fall_back(snap, [f"validation: {e}"], family="validation")
         self._count(SOLVER_SOLVE_TOTAL, backend="tpu")
-        return self._decode(snap, enc, assignment, slot_basis_np, slot_zoneset_np)
+        return results
 
     # -- decode ----------------------------------------------------------------
     def _decode(self, snap: SolverSnapshot, enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zoneset: np.ndarray) -> Results:
@@ -316,7 +328,24 @@ class TPUSolver:
                     continue
                 fits = np.all(alloc_mat[members] >= total_vec[None, :] + ovh[None, :], axis=1)
                 remaining.extend(its[m] for m, ok in zip(members, fits & mask[members]) if ok)
-            claim.instance_type_options = remaining if remaining else [it]
+            if not remaining:
+                # the post-filter set must never be empty when the kernel is
+                # sound; before trusting the single packed row, re-check it is
+                # launchable under the claim's FINAL requirements — compat,
+                # an available offering, and the accumulated-requests fit
+                # (nodeclaim.go:541-618 semantics)
+                it_ok = (
+                    it.requirements.intersects(claim.requirements) is None
+                    and any(
+                        o.available and claim.requirements.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None
+                        for o in it.offerings
+                    )
+                    and res.fits(requests, it.allocatable())
+                )
+                if not it_ok:
+                    raise DecodeError(f"slot {j}: packed row {it.name} not launchable under final claim requirements")
+                remaining = [it]
+            claim.instance_type_options = remaining
             if reservation_manager is not None:
                 self._apply_reservations(claim, reservation_manager)
             new_claims.append(claim)
