@@ -368,21 +368,57 @@ def check_capability(snap, pods=None) -> list[str]:
     return reasons
 
 
+@dataclass
+class _RowArtifacts:
+    """Everything the row side of one encode produced — reusable while the
+    cluster generation, pools, instance types, daemons, and resource axis are
+    unchanged. The vocab/zone/taint interners are shared MUTABLY across
+    solves: pod-side interning only appends, so row value ids stay stable."""
+
+    vocab: Vocabulary
+    zone_names: list
+    zone_ids: dict
+    taint_classes: dict
+    taint_sets: list
+    templates: list
+    row_alloc: np.ndarray
+    row_price: np.ndarray
+    row_labels0: np.ndarray  # at the vocab width when rows were built
+    row_zone: np.ndarray
+    row_pool_rank: np.ndarray
+    row_taint_class: np.ndarray
+    row_meta: list
+    n_existing: int
+    rank_zoneset: np.ndarray
+    state_nodes: list
+    # vocab width at build time: pod-side interning grows the shared vocab
+    # monotonically, so reuse is bounded (see EncodeCache growth guard)
+    built_n_keys: int = 0
+    built_vmax: int = 0
+
+
 class EncodeCache:
     """Cross-solve encode memo owned by a solver instance.
 
-    Signatures are content-addressed tuples over the pod spec, so they are
-    cacheable per (uid, resourceVersion): an unchanged pod re-solving on the
-    next reconcile skips the tuple build (the dominant encode cost at 50k
-    pods — pod_signature is ~55% of encode wall-clock), while any pod edit
-    bumps resourceVersion and recomputes. SURVEY.md §7 "incremental state ->
-    device": the warm re-solve after a small delta costs the delta, not the
-    fleet."""
+    Pod side: signatures are content-addressed tuples over the pod spec, so
+    they are cacheable per (uid, resourceVersion) — an unchanged pod
+    re-solving on the next reconcile skips the tuple build (the dominant
+    encode cost at 50k pods), while any pod edit bumps resourceVersion and
+    recomputes.
+
+    Row side: the candidate-row tensors are keyed on the state/cluster.py
+    GENERATION counter (bumped on every cluster mutation) plus nodepool
+    hashes, instance-type identities, daemon versions, and the resource axis
+    — a steady-state reconcile with unchanged cluster state skips the whole
+    templates/rows build. SURVEY.md §7 "incremental state -> device": the
+    warm re-solve after a small delta costs the delta, not the fleet."""
 
     MAX_ENTRIES = 200_000
 
     def __init__(self):
         self.pod_sig: dict[tuple, tuple] = {}
+        self.row_key: tuple | None = None
+        self.rows: _RowArtifacts | None = None
 
     def signature(self, pod) -> tuple:
         key = (pod.metadata.uid, pod.metadata.resource_version)
@@ -395,67 +431,29 @@ class EncodeCache:
         return sig
 
 
-def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
+def _row_cache_key(snap, rnames: list[str]) -> tuple:
+    return (
+        # epoch is a process-unique token (id() could recycle after GC)
+        getattr(snap.cluster, "epoch", None) or id(snap.cluster),
+        snap.cluster.generation,
+        # the SNAPSHOT's node selection, not just cluster content: the
+        # disruption simulation filters candidates out of state_nodes without
+        # touching the cluster (helpers.py simulate_scheduling)
+        tuple(sorted(sn.name() for sn in snap.state_nodes)),
+        tuple(sorted((np_.metadata.name, np_.hash()) for np_ in snap.node_pools)),
+        tuple(sorted((name, tuple(id(it) for it in its)) for name, its in snap.instance_types.items())),
+        tuple(sorted((d.metadata.uid, d.metadata.resource_version) for d in snap.daemonset_pods)),
+        tuple(rnames),
+    )
+
+
+def _build_rows(snap, rnames: list[str], rl_to_vec) -> _RowArtifacts:
+    """The row side of encode: vocab/zone/taint interning, weight-ordered
+    templates with daemon-overhead groups, and one row per existing node and
+    per (template x instance type x available offering)."""
     vocab = Vocabulary()
 
-    # -- signature grouping (the hot O(P) pass: cheap tuple building only,
-    # and cache hits skip even that) -----------------------------------------
-    sig_of = cache.signature if cache is not None else pod_signature
-    sig_ids: dict[tuple, int] = {}
-    rep_pods: list = []
-    P0 = len(snap.pods)
-    sig_of_pod_raw = np.empty(P0, dtype=np.int32)
-    for i, pod in enumerate(snap.pods):
-        k = sig_of(pod)
-        sid = sig_ids.get(k)
-        if sid is None:
-            sid = len(rep_pods)
-            sig_ids[k] = sid
-            rep_pods.append(pod)
-        sig_of_pod_raw[i] = sid
-    S = len(rep_pods)
-
-    # requirement classes: signatures sharing (node_selector, affinity) lower
-    # to the same Requirements — decode caches its per-claim instance-type
-    # compat masks on these, not on full signatures (pods differing only in
-    # requests share one class)
-    req_class_ids: dict[tuple, int] = {}
-    req_class_of_sig = np.zeros(S, dtype=np.int32)
-    for key, sid in sig_ids.items():
-        cid = req_class_ids.setdefault(key[0], len(req_class_ids))
-        req_class_of_sig[sid] = cid
-
-    reasons = check_capability(snap, rep_pods)
-
-    # -- per-signature heavy lowering -----------------------------------------
-    respect = getattr(snap, "preference_policy", "Respect") == "Respect"
-    sig_requests = [res.pod_requests(p) for p in rep_pods]
-    # tier-0 preference honoring: include the heaviest preferred node-affinity
-    # term exactly like the un-relaxed FFD (requirements.go:74-110); strict
-    # under the Ignore policy
-    sig_requirements = [Requirements.from_pod(p, strict=not respect) for p in rep_pods]
-
-    # -- resource axis ---------------------------------------------------------
-    rnames = ["cpu", "memory", "pods", "ephemeral-storage"]
-    seen = set(rnames)
-    for rr in sig_requests:
-        for k in rr:
-            if k not in seen:
-                seen.add(k)
-                rnames.append(k)
-    ridx = {k: i for i, k in enumerate(rnames)}
-    R = len(rnames)
-
-    def rl_to_vec(rl: dict) -> np.ndarray:
-        v = np.zeros(R, dtype=np.float32)
-        for k, q in rl.items():
-            i = ridx.get(k)
-            if i is not None:
-                v[i] = _scale(k, q)
-        return v
-
-    # -- zone vocabulary (index 0 reserved: "row has no zone label") -----------
-    zone_names: list[str] = [""]
+    zone_names: list[str] = [""]  # index 0 reserved: "row has no zone label"
     zone_ids: dict[str, int] = {"": 0}
 
     def zone_id(z: str) -> int:
@@ -464,7 +462,6 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
             zone_names.append(z)
         return zone_ids[z]
 
-    # -- taint classes ---------------------------------------------------------
     taint_classes: dict[tuple, int] = {}
     taint_sets: list[list] = []
 
@@ -477,7 +474,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
             taint_sets.append(list(taints))
         return c
 
-    # -- templates (weight-ordered like the scheduler) -------------------------
+    # templates, weight-ordered like the scheduler
     pools = sorted(snap.node_pools, key=lambda p: (-p.spec.weight, p.metadata.name))
     templates: list[NodeClaimTemplate] = []
     for np_ in pools:
@@ -487,7 +484,6 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
             t.instance_type_options = its
             templates.append(t)
 
-    # -- rows ------------------------------------------------------------------
     row_alloc_l, row_price_l, row_labels_l, row_zone_l = [], [], [], []
     row_rank_l, row_taint_l, row_meta = [], [], []
 
@@ -548,10 +544,124 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
 
     n_rows = len(row_meta)
     K = max(vocab.n_keys, 1)
-    row_labels = np.zeros((n_rows, K), dtype=np.int32)
+    row_labels0 = np.zeros((n_rows, K), dtype=np.int32)
     for i, lbl in enumerate(row_labels_l):
         for kid, vid in lbl.items():
-            row_labels[i, kid] = vid
+            row_labels0[i, kid] = vid
+
+    # zones offered per template rank
+    Z = len(zone_names)
+    n_ranks = max(len(templates), 1)
+    rank_zoneset = np.zeros((n_ranks, Z), dtype=bool)
+    for i in range(n_existing, n_rows):
+        rank_zoneset[row_rank_l[i], row_zone_l[i]] = True
+
+    R = len(rnames)
+    return _RowArtifacts(
+        vocab=vocab,
+        zone_names=zone_names,
+        zone_ids=zone_ids,
+        taint_classes=taint_classes,
+        taint_sets=taint_sets,
+        templates=templates,
+        row_alloc=np.stack(row_alloc_l) if row_alloc_l else np.zeros((0, R), np.float32),
+        row_price=np.array(row_price_l, dtype=np.float32),
+        row_labels0=row_labels0,
+        row_zone=np.array(row_zone_l, dtype=np.int32),
+        row_pool_rank=np.array(row_rank_l, dtype=np.int32),
+        row_taint_class=np.array(row_taint_l, dtype=np.int32),
+        row_meta=row_meta,
+        n_existing=n_existing,
+        rank_zoneset=rank_zoneset,
+        state_nodes=state_nodes,
+        built_n_keys=vocab.n_keys,
+        built_vmax=vocab.max_values(),
+    )
+
+
+def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
+    # -- signature grouping (the hot O(P) pass: cheap tuple building only,
+    # and cache hits skip even that) -----------------------------------------
+    sig_of = cache.signature if cache is not None else pod_signature
+    sig_ids: dict[tuple, int] = {}
+    rep_pods: list = []
+    P0 = len(snap.pods)
+    sig_of_pod_raw = np.empty(P0, dtype=np.int32)
+    for i, pod in enumerate(snap.pods):
+        k = sig_of(pod)
+        sid = sig_ids.get(k)
+        if sid is None:
+            sid = len(rep_pods)
+            sig_ids[k] = sid
+            rep_pods.append(pod)
+        sig_of_pod_raw[i] = sid
+    S = len(rep_pods)
+
+    # requirement classes: signatures sharing (node_selector, affinity) lower
+    # to the same Requirements — decode caches its per-claim instance-type
+    # compat masks on these, not on full signatures (pods differing only in
+    # requests share one class)
+    req_class_ids: dict[tuple, int] = {}
+    req_class_of_sig = np.zeros(S, dtype=np.int32)
+    for key, sid in sig_ids.items():
+        cid = req_class_ids.setdefault(key[0], len(req_class_ids))
+        req_class_of_sig[sid] = cid
+
+    reasons = check_capability(snap, rep_pods)
+
+    # -- per-signature heavy lowering -----------------------------------------
+    respect = getattr(snap, "preference_policy", "Respect") == "Respect"
+    sig_requests = [res.pod_requests(p) for p in rep_pods]
+    # tier-0 preference honoring: include the heaviest preferred node-affinity
+    # term exactly like the un-relaxed FFD (requirements.go:74-110); strict
+    # under the Ignore policy
+    sig_requirements = [Requirements.from_pod(p, strict=not respect) for p in rep_pods]
+
+    # -- resource axis ---------------------------------------------------------
+    rnames = ["cpu", "memory", "pods", "ephemeral-storage"]
+    seen = set(rnames)
+    for rr in sig_requests:
+        for k in rr:
+            if k not in seen:
+                seen.add(k)
+                rnames.append(k)
+    ridx = {k: i for i, k in enumerate(rnames)}
+    R = len(rnames)
+
+    def rl_to_vec(rl: dict) -> np.ndarray:
+        v = np.zeros(R, dtype=np.float32)
+        for k, q in rl.items():
+            i = ridx.get(k)
+            if i is not None:
+                v[i] = _scale(k, q)
+        return v
+
+    # -- row side: cached across solves on the cluster generation -------------
+    rows: _RowArtifacts | None = None
+    row_key: tuple | None = None
+    if cache is not None:
+        row_key = _row_cache_key(snap, rnames)
+        if cache.row_key == row_key:
+            rows = cache.rows
+            # growth guard: pod-side interning widens the shared vocab; churn
+            # with ever-new requirement values would widen the S x K x Vmax
+            # masks without bound — rebuild once drift exceeds the slack
+            if rows is not None and (
+                rows.vocab.n_keys > rows.built_n_keys + 64 or rows.vocab.max_values() > rows.built_vmax + 256
+            ):
+                rows = None
+    if rows is None:
+        rows = _build_rows(snap, rnames, rl_to_vec)
+        if cache is not None:
+            cache.row_key, cache.rows = row_key, rows
+    vocab = rows.vocab
+    zone_names, zone_ids = rows.zone_names, rows.zone_ids
+    taint_sets = rows.taint_sets
+    templates = rows.templates
+    state_nodes = rows.state_nodes
+    row_meta = rows.row_meta
+    n_existing = rows.n_existing
+    row_labels = rows.row_labels0
 
     # -- pod queue order (FFD: cpu desc, mem desc, creation, uid) --------------
     # per-signature cpu/mem, broadcast to pods by index: the sort key is built
@@ -659,12 +769,6 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     sig_port_any, sig_port_wild, sig_port_spec = port_masks(sig_ports, S)
     existing_port_any, existing_port_wild, existing_port_spec = port_masks(existing_ports, max(n_existing, 1))
 
-    # zones offered per template rank
-    n_ranks = max(len(templates), 1)
-    rank_zoneset = np.zeros((n_ranks, Z), dtype=bool)
-    for i in range(n_existing, n_rows):
-        rank_zoneset[row_rank_l[i], row_zone_l[i]] = True
-
     zone_key_id = vocab.keys.get(wk.ZONE_LABEL_KEY, -1)
 
     # -- topology groups (identified from signature representatives) -----------
@@ -742,12 +846,12 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         resource_names=rnames,
         vocab=vocab,
         n_existing=n_existing,
-        row_alloc=np.stack(row_alloc_l) if row_alloc_l else np.zeros((0, R), np.float32),
-        row_price=np.array(row_price_l, dtype=np.float32),
+        row_alloc=rows.row_alloc,
+        row_price=rows.row_price,
         row_labels=row_labels,
-        row_zone=np.array(row_zone_l, dtype=np.int32),
-        row_pool_rank=np.array(row_rank_l, dtype=np.int32),
-        row_taint_class=np.array(row_taint_l, dtype=np.int32),
+        row_zone=rows.row_zone,
+        row_pool_rank=rows.row_pool_rank,
+        row_taint_class=rows.row_taint_class,
         row_meta=row_meta,
         pods=pods,
         sig_of_pod=sig_of_pod,
@@ -767,7 +871,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         existing_port_spec=existing_port_spec,
         n_zones=Z,
         zone_names=zone_names,
-        rank_zoneset=rank_zoneset,
+        rank_zoneset=rows.rank_zoneset,
         zone_key_id=zone_key_id,
         group_kind=group_kind,
         group_skew=group_skew,

@@ -334,13 +334,22 @@ class TPUSolver:
                 # launchable under the claim's FINAL requirements — compat,
                 # an available offering, and the accumulated-requests fit
                 # (nodeclaim.go:541-618 semantics)
+                it_idx = next((i2 for i2, cand in enumerate(its) if cand is it), None)
+                ovh_vec = next(
+                    (ovh for members, ovh in ginfo if it_idx is not None and it_idx in members),
+                    None,
+                )
                 it_ok = (
                     it.requirements.intersects(claim.requirements) is None
                     and any(
                         o.available and claim.requirements.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None
                         for o in it.offerings
                     )
-                    and res.fits(requests, it.allocatable())
+                    # fit INCLUDING the row's daemon-overhead group, exactly
+                    # like the vectorized filter above
+                    and it_idx is not None
+                    and ovh_vec is not None
+                    and bool(np.all(alloc_mat[it_idx] >= total_vec + ovh_vec))
                 )
                 if not it_ok:
                     raise DecodeError(f"slot {j}: packed row {it.name} not launchable under final claim requirements")

@@ -11,6 +11,7 @@ unchanged snapshots (SURVEY.md §7 stage 3).
 
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Callable, Optional
 
@@ -21,6 +22,8 @@ from ..utils import pods as pod_utils
 from ..utils import resources as res
 from ..utils.quantity import Quantity
 from .statenode import StateNode
+
+_EPOCH_COUNTER = itertools.count(1)
 
 
 class Cluster:
@@ -40,6 +43,8 @@ class Cluster:
         self._buffer_pod_counts: dict[str, int] = {}  # provider id -> virtual pod count
         self._unsynced_start: Optional[float] = None
         self.generation = 0  # bumped on every mutation (solver cache key)
+        # process-unique token for cache keys: id() can recycle after GC
+        self.epoch = next(_EPOCH_COUNTER)
         self._on_change: list[Callable[[], None]] = []
 
     # -- change hooks ----------------------------------------------------------

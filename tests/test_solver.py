@@ -861,3 +861,61 @@ class TestDecodeLaunchability:
         solver = TPUSolver(force=True)
         with pytest.raises(tpu_mod.DecodeError):
             solver.solve(make_snapshot([make_pod(cpu="1")]))
+
+    def test_row_cache_hits_and_invalidates_on_generation(self):
+        from karpenter_tpu.solver.encode import EncodeCache, encode
+
+        pods = [make_pod(cpu="1") for _ in range(5)]
+        snap = make_snapshot(pods)
+        cache = EncodeCache()
+        e1 = encode(snap, cache=cache)
+        rows1 = cache.rows
+        e2 = encode(snap, cache=cache)
+        assert cache.rows is rows1, "unchanged cluster must reuse the row artifacts"
+        import numpy as np
+
+        assert np.array_equal(e1.row_alloc, e2.row_alloc)
+        assert np.array_equal(e1.row_labels, e2.row_labels)
+        # any cluster mutation bumps the generation: rows rebuild
+        snap.cluster.generation += 1
+        encode(snap, cache=cache)
+        assert cache.rows is not rows1
+
+    def test_row_cache_invalidates_on_nodepool_change(self):
+        from karpenter_tpu.solver.encode import EncodeCache, encode
+
+        snap = make_snapshot([make_pod(cpu="1")])
+        cache = EncodeCache()
+        encode(snap, cache=cache)
+        rows1 = cache.rows
+        snap.node_pools[0].spec.template.labels = {"rolled": "v2"}
+        encode(snap, cache=cache)
+        assert cache.rows is not rows1, "nodepool hash change must rebuild rows"
+
+    def test_cached_rows_produce_equal_solves(self):
+        pods = [make_pod(cpu="1", labels={"app": "w"}, tsc=[zone_spread(1, {"matchLabels": {"app": "w"}})]) for _ in range(8)]
+        solver = TPUSolver(force=True)
+        snap = make_snapshot(pods)
+        r1 = solver.solve(snap)
+        r2 = solver.solve(snap)  # row + signature caches both hit
+        assert len(r1.new_node_claims) == len(r2.new_node_claims)
+        assert sorted(len(nc.pods) for nc in r1.new_node_claims) == sorted(len(nc.pods) for nc in r2.new_node_claims)
+        assert not validate_results(make_snapshot(pods), r2)
+
+    def test_row_cache_distinguishes_snapshot_node_selection(self):
+        # the disruption simulation filters candidate nodes out of
+        # state_nodes WITHOUT mutating the cluster: same generation, different
+        # node selection must NOT share cached rows
+        from test_sharded import existing_node_snapshot
+
+        from karpenter_tpu.solver.encode import EncodeCache, encode
+
+        types = [catalog.make_instance_type("c", 16, zones=["test-zone-a"])]
+        snap = existing_node_snapshot([make_pod(cpu="1")], types)
+        cache = EncodeCache()
+        e1 = encode(snap, cache=cache)
+        assert e1.n_existing == 1
+        # simulate: the candidate node removed from the snapshot view only
+        snap.state_nodes = []
+        e2 = encode(snap, cache=cache)
+        assert e2.n_existing == 0, "filtered-node snapshot must rebuild rows"
