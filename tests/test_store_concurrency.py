@@ -1,0 +1,140 @@
+"""kube.Store concurrency stress — the race-detector analogue.
+
+Reference: the Go suites run under `go test -race` (Makefile:104-111) and the
+state layer is mutex/atomic-based (cluster.go:60-100). Python has no race
+detector, so these specs hammer the store from many threads and assert the
+invariants the informer stack depends on: monotonic resourceVersions,
+optimistic-concurrency conflict detection, watch delivery in commit order
+(ADDED < MODIFIED < DELETED per object), and no lost updates.
+"""
+
+import threading
+
+import pytest
+
+from karpenter_tpu.kube import ObjectMeta, Pod, Store
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+N_THREADS = 8
+N_OBJECTS = 40
+
+
+class TestStoreConcurrency:
+    def test_concurrent_creates_unique_resource_versions(self):
+        store = Store()
+        errors = []
+
+        def create(worker):
+            try:
+                for i in range(N_OBJECTS):
+                    store.create(Pod(metadata=ObjectMeta(name=f"w{worker}-p{i}")))
+            except Exception as e:  # pragma: no cover - failure reporting
+                errors.append(e)
+
+        threads = [threading.Thread(target=create, args=(w,)) for w in range(N_THREADS)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert not errors
+        pods = store.list("Pod")
+        assert len(pods) == N_THREADS * N_OBJECTS
+        rvs = [p.metadata.resource_version for p in pods]
+        assert len(set(rvs)) == len(rvs), "resourceVersions must be unique per commit"
+
+    def test_concurrent_patches_lose_no_increments(self):
+        # patch() is read-modify-write under the store lock: N_THREADS x K
+        # increments on one annotation must all land
+        store = Store()
+        store.create(Pod(metadata=ObjectMeta(name="ctr", annotations={"n": "0"})))
+        K = 50
+
+        def bump():
+            for _ in range(K):
+                store.patch("Pod", "ctr", lambda p: p.metadata.annotations.update(n=str(int(p.metadata.annotations["n"]) + 1)))
+
+        threads = [threading.Thread(target=bump) for _ in range(N_THREADS)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert int(store.get("Pod", "ctr").metadata.annotations["n"]) == N_THREADS * K
+
+    def test_stale_update_conflicts(self):
+        # two writers racing update() on one snapshot: exactly one wins, the
+        # loser gets a resourceVersion conflict
+        store = Store()
+        store.create(Pod(metadata=ObjectMeta(name="race")))
+        snap_a = store.get("Pod", "race")
+        snap_b = store.get("Pod", "race")
+        snap_a.metadata.annotations["who"] = "a"
+        snap_b.metadata.annotations["who"] = "b"
+        store.update(snap_a)
+        with pytest.raises(Exception):
+            store.update(snap_b)
+
+    def test_watch_order_per_object(self):
+        # watchers must observe each object's events in commit order even with
+        # concurrent writers: ADDED first, MODIFIED rvs strictly increasing,
+        # DELETED last
+        store = Store()
+        log: dict[str, list] = {}
+        lock = threading.Lock()
+
+        def watch(event, obj):
+            with lock:
+                log.setdefault(obj.metadata.name, []).append((event, obj.metadata.resource_version))
+
+        store.watch("Pod", watch)
+
+        def churn(worker):
+            for i in range(N_OBJECTS):
+                name = f"w{worker}-p{i}"
+                store.create(Pod(metadata=ObjectMeta(name=name)))
+                store.patch("Pod", name, lambda p: p.metadata.annotations.update(x="1"))
+                store.patch("Pod", name, lambda p: p.metadata.annotations.update(x="2"))
+                store.delete("Pod", name, grace=False)
+
+        threads = [threading.Thread(target=churn, args=(w,)) for w in range(N_THREADS)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert len(log) == N_THREADS * N_OBJECTS
+        for name, events in log.items():
+            kinds = [e for e, _ in events]
+            assert kinds[0] == "ADDED", f"{name}: {kinds}"
+            assert kinds[-1] == "DELETED", f"{name}: {kinds}"
+            assert kinds.count("ADDED") == 1 and kinds.count("DELETED") == 1
+            rvs = [rv for _, rv in events]
+            assert rvs == sorted(rvs), f"{name}: out-of-order resourceVersions {rvs}"
+
+    def test_cluster_state_consistent_under_churn(self):
+        # informers driven from many threads: the cluster mirror must end
+        # exactly consistent with the store
+        store, clock = Store(), FakeClock()
+        cluster = Cluster(store, clock)
+        start_informers(store, cluster)
+        from karpenter_tpu.kube import Node
+        from karpenter_tpu.kube.objects import NodeSpec, NodeStatus
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        def churn(worker):
+            for i in range(20):
+                name = f"n{worker}-{i}"
+                store.create(
+                    Node(
+                        metadata=ObjectMeta(name=name),
+                        spec=NodeSpec(provider_id=f"kwok://{name}"),
+                        status=NodeStatus(
+                            capacity=parse_resource_list({"cpu": "4"}),
+                            allocatable=parse_resource_list({"cpu": "4"}),
+                        ),
+                    )
+                )
+                if i % 3 == 0:
+                    store.delete("Node", name, grace=False)
+
+        threads = [threading.Thread(target=churn, args=(w,)) for w in range(N_THREADS)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        live = {n.metadata.name for n in store.list("Node")}
+        mirrored = {sn.name() for sn in cluster.nodes()}
+        assert mirrored == live
+        assert cluster.generation > 0
