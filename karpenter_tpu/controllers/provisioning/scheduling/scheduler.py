@@ -364,17 +364,19 @@ def _daemon_requirement_alternatives(daemon_pod) -> list[Requirements]:
 
 
 def _daemon_compatible_with_instance_type(template: NodeClaimTemplate, it, daemon_pod) -> bool:
+    """Requirements/taints only — the reference deliberately does NOT check
+    resource fit (isDaemonPodCompatible, scheduler.go:1020-1043): an
+    oversized daemon still counts as overhead, rendering the instance type
+    unable to host anything (suite_test.go:1003)."""
     if taints_tolerate_pod(template.taints, daemon_pod) is not None:
         return False
     reqs = Requirements()
     reqs.add(*template.requirements.values())
     reqs.add(*it.requirements.values())
-    if not any(
+    return any(
         reqs.compatible(alt, allow_undefined=wk.WELL_KNOWN_LABELS) is None
         for alt in _daemon_requirement_alternatives(daemon_pod)
-    ):
-        return False
-    return res.fits(res.pod_requests(daemon_pod), it.allocatable())
+    )
 
 
 def _daemon_compatible_with_node(sn, taints, daemon_pod) -> bool:

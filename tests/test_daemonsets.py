@@ -1,0 +1,168 @@
+"""Daemonset overhead depth specs ported from the reference's provisioning
+suite_test.go (:934-1495) — which daemons count against a candidate node, how
+their overhead shapes instance selection, and taint/affinity interplay."""
+
+import pytest
+
+from helpers import make_nodepool, make_pod
+from test_scheduler import LINUX_AMD64, build_env, make_scheduler
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.scheduling.taints import Taint
+from karpenter_tpu.utils import resources as res
+
+
+def solve(pods, daemons=(), node_pools=None, types=None, **kw):
+    env = build_env(node_pools=node_pools, types=types)
+    s = make_scheduler(*env, daemons=daemons, **kw)
+    return s.solve(pods)
+
+
+def daemon(cpu="500m", memory=None, node_selector=None, tolerations=None, required_affinity=None, preferred_affinity=None):
+    return make_pod(
+        cpu=cpu,
+        memory=memory,
+        node_selector=node_selector,
+        tolerations=tolerations,
+        required_affinity=required_affinity,
+        preferred_affinity=preferred_affinity,
+    )
+
+
+def claim_fits_with(nc, extra):
+    total = res.merge(res.requests_for_pods(nc.pods), extra)
+    return [it for it in nc.instance_type_options if res.fits(total, it.allocatable())]
+
+
+class TestDaemonOverheadDepth:
+    def test_accounts_for_daemonsets(self):
+        # :934 — every surviving instance type fits pods + daemon overhead
+        d = daemon(cpu="1", memory="1Gi")
+        results = solve([make_pod(cpu="1", memory="1Gi")], daemons=[d])
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        assert claim_fits_with(nc, res.pod_requests(d)) == nc.instance_type_options
+
+    def test_too_large_daemonset_overhead_blocks(self):
+        # :1003 — a daemon bigger than every instance type
+        types = [catalog.make_instance_type("c", 4)]
+        results = solve([make_pod(cpu="1")], daemons=[daemon(cpu="16")], types=types)
+        assert len(results.pod_errors) == 1
+
+    def test_ignores_daemonsets_without_matching_tolerations(self):
+        # :1142 — tainted pool: an intolerant daemon won't run there, so its
+        # overhead must NOT shrink the candidate's capacity
+        np = make_nodepool(
+            requirements=LINUX_AMD64,
+            taints=[Taint(key="dedicated", value="x", effect="NoSchedule")],
+        )
+        tol = [{"key": "dedicated", "operator": "Equal", "value": "x", "effect": "NoSchedule"}]
+        types = [catalog.make_instance_type("c", 4)]  # ~3.9 allocatable
+        # pod of 3 cpu + daemon of 2 would NOT fit; without the daemon it does
+        results = solve(
+            [make_pod(cpu="3", tolerations=tol)],
+            daemons=[daemon(cpu="2")],  # no toleration: ignored
+            node_pools=[np],
+            types=types,
+        )
+        assert results.all_pods_scheduled()
+
+    def test_tolerating_daemonset_counts_on_tainted_pool(self):
+        np = make_nodepool(
+            requirements=LINUX_AMD64,
+            taints=[Taint(key="dedicated", value="x", effect="NoSchedule")],
+        )
+        tol = [{"key": "dedicated", "operator": "Equal", "value": "x", "effect": "NoSchedule"}]
+        types = [catalog.make_instance_type("c", 4)]
+        results = solve(
+            [make_pod(cpu="3", tolerations=tol)],
+            daemons=[daemon(cpu="2", tolerations=tol)],
+            node_pools=[np],
+            types=types,
+        )
+        # 3 + 2 > 3.9 allocatable: unschedulable on the only type
+        assert len(results.pod_errors) == 1
+
+    def test_daemon_filtered_by_instance_type_requirements(self):
+        # :1245 — a daemon pinned to arm64 doesn't burden amd64 candidates
+        np = make_nodepool(requirements=[{"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]}])
+        types = [
+            catalog.make_instance_type("c", 4, arch="amd64"),
+            catalog.make_instance_type("c", 4, arch="arm64"),
+        ]
+        results = solve(
+            [make_pod(cpu="3", node_selector={wk.ARCH_LABEL_KEY: "amd64"})],
+            daemons=[daemon(cpu="2", node_selector={wk.ARCH_LABEL_KEY: "arm64"})],
+            node_pools=[np],
+            types=types,
+        )
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        assert all(it.requirements.get(wk.ARCH_LABEL_KEY).has("amd64") for it in nc.instance_type_options)
+
+    def test_daemon_nodeselector_matching_nodepool_counts(self):
+        # :1218 — daemon selects a custom label the pool's template carries
+        np = make_nodepool(requirements=LINUX_AMD64, labels={"team": "infra"})
+        types = [catalog.make_instance_type("c", 4)]
+        results = solve(
+            [make_pod(cpu="3")],
+            daemons=[daemon(cpu="2", node_selector={"team": "infra"})],
+            node_pools=[np],
+            types=types,
+        )
+        assert len(results.pod_errors) == 1  # daemon counts: 3+2 > 3.9
+
+    def test_daemon_notin_unspecified_key_counts(self):
+        # :1275 — NotIn on a key the pool doesn't define matches (absent ok)
+        types = [catalog.make_instance_type("c", 4)]
+        results = solve(
+            [make_pod(cpu="3")],
+            daemons=[daemon(cpu="2", required_affinity=[[{"key": "special", "operator": "NotIn", "values": ["never"]}]])],
+            types=types,
+        )
+        assert len(results.pod_errors) == 1  # daemon counts
+
+    def test_daemon_with_multiple_or_terms_schedulable(self):
+        # :1370 — ANY satisfied OR-term makes the daemon count
+        types = [catalog.make_instance_type("c", 4)]
+        results = solve(
+            [make_pod(cpu="3")],
+            daemons=[
+                daemon(
+                    cpu="2",
+                    required_affinity=[
+                        [{"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["s390x"]}],
+                        [{"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]}],
+                    ],
+                )
+            ],
+            types=types,
+        )
+        assert len(results.pod_errors) == 1  # second OR-term matches: counts
+
+    def test_daemon_with_incompatible_preference_still_counts(self):
+        # :1430 — preferences never exclude a daemon
+        types = [catalog.make_instance_type("c", 4)]
+        results = solve(
+            [make_pod(cpu="3")],
+            daemons=[daemon(cpu="2", preferred_affinity=[(10, [{"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["mars"]}])])],
+            types=types,
+        )
+        assert len(results.pod_errors) == 1
+
+    def test_no_double_count_across_pods_on_one_claim(self):
+        # :1958 — overhead applies once per node, not per pod
+        types = [catalog.make_instance_type("c", 8)]  # ~7.9 allocatable
+        d = daemon(cpu="1")
+        results = solve([make_pod(cpu="3"), make_pod(cpu="3")], daemons=[d], types=types)
+        assert results.all_pods_scheduled()
+        # 3+3+1 = 7 <= 7.9: both pods share one claim
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 1
+
+    def test_api_claim_requests_include_daemon_overhead(self):
+        # :1938 — the created NodeClaim's resource requests carry the overhead
+        d = daemon(cpu="1", memory="1Gi")
+        results = solve([make_pod(cpu="1", memory="1Gi")], daemons=[d])
+        nc = results.new_node_claims[0]
+        api = nc.to_api_node_claim()
+        assert api.spec.resources.get("cpu").milli >= 2000
