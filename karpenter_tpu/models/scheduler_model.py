@@ -10,16 +10,23 @@ Key fidelity point: an in-flight claim in the reference is a FLEXIBLE node —
 it keeps every instance type that still fits its accumulated requests, and its
 price materializes only at finalize (cheapest fitting type). So a slot here
 carries an accumulated-requests envelope against a maximum-capacity basis row,
-and a zone SET (late committal, topology.go "Schrödinger" semantics) rather
-than an eagerly-priced concrete offering. Cost is computed at decode exactly
-like the reference: cheapest instance type fitting the slot's total.
+and a DOMAIN SET per topology key (late committal, topology.go "Schrödinger"
+semantics) rather than an eagerly-priced concrete offering. Cost is computed
+at decode exactly like the reference: cheapest instance type fitting the
+slot's total.
+
+The topology axis is KEYED (encode.py): domains are interned (key, value)
+pairs — zone is dom key 0, and snapshots may add more keys (capacity-type,
+custom labels) for spread/anti-affinity. Per-group registered universes and
+minDomains force-zero minimums mirror topology.py's TopologyGroup math.
 
 State per step:
   slot_basis[N]     basis row id backing the capacity envelope (-1 = closed)
   slot_rem[N, R]    basis allocatable minus accumulated requests
-  slot_zoneset[N,Z] zones the slot can still land in (existing: one-hot)
+  slot_domset[N,D]  domains the slot can still land in (existing: one-hot
+                    per key)
   slot_rank[N]      template rank (-1 = existing node)
-  counts_zone[G,Z]  per-group zone counts (spread skew)
+  counts_dom[G,D]   per-group domain counts (keyed spread / anti skew)
   counts_host[G,N]  per-group per-slot counts (hostname spread/anti-affinity)
   open_count        number of open slots
 """
@@ -39,11 +46,13 @@ from ..ops.select import BIG, first_true_index, masked_argmin
 NEG = jnp.float32(-3.4e38)
 INF_I = jnp.int32(2**30)
 
-KIND_ZONE_SPREAD = 0
+KIND_DOM_SPREAD = 0
 KIND_HOST_SPREAD = 1
 KIND_HOST_ANTI = 2
+KIND_DOM_ANTI = 3
+KIND_ZONE_SPREAD = KIND_DOM_SPREAD  # zone is dom key 0
 
-# zone id 0 is reserved for "row has no zone label" (encode.py)
+# domain id 0 is the zone key's "row has no value" sentinel (encode.py)
 NO_ZONE = 0
 
 
@@ -53,25 +62,30 @@ class SchedulerTensors:
 
     row_alloc: jnp.ndarray  # [Nrows, R]
     row_labels: jnp.ndarray  # [Nrows, K]
-    row_zone: jnp.ndarray  # [Nrows] zone id (0 = none)
     row_pool_rank: jnp.ndarray  # [Nrows]
     row_taint_class: jnp.ndarray  # [Nrows]
-    rank_zoneset: jnp.ndarray  # [Q, Z] bool — zones each template offers
+    rank_domset: jnp.ndarray  # [Q, D] bool — domains each template rank offers
+    dom_key_of: jnp.ndarray  # [D] i32 dom-key index per domain
     pod_req: jnp.ndarray  # [P, R]
     pod_mask: jnp.ndarray  # [P, K, W] uint32
     pod_taint_ok: jnp.ndarray  # [P, C] bool
-    pod_zone_allowed: jnp.ndarray  # [P, Z] bool
-    member: jnp.ndarray  # [P, G] bool
+    pod_dom_allowed: jnp.ndarray  # [P, D] bool
+    pod_restrict: jnp.ndarray  # [P, Kd] bool — pod constrains this dom key
+    member: jnp.ndarray  # [P, G] bool — counted by the group (selector match)
+    owner: jnp.ndarray  # [P, G] bool — constrained by the group (declares it)
     group_kind: jnp.ndarray  # [G]
     group_skew: jnp.ndarray  # [G]
-    counts_zone_init: jnp.ndarray  # [G, Z]
+    group_dom_key: jnp.ndarray  # [G] i32 (-1 = hostname kinds)
+    group_min_domains: jnp.ndarray  # [G] i32 (0 = unset)
+    group_registered: jnp.ndarray  # [G, D] bool — per-group domain universe
+    counts_dom_init: jnp.ndarray  # [G, D]
     counts_host_init: jnp.ndarray  # [G, N]
-    existing_zoneset: jnp.ndarray  # [n_existing, Z] bool
+    existing_domset: jnp.ndarray  # [n_existing, D] bool
     # host-port usage of existing nodes (encode.py port vocabulary)
     existing_port_any: jnp.ndarray  # [n_existing, P1] bool
     existing_port_wild: jnp.ndarray  # [n_existing, P1] bool
     existing_port_spec: jnp.ndarray  # [n_existing, P2] bool
-    zone_key: int  # static: key id of the zone label (-1 if absent)
+    dom_keys: tuple  # static: vocab key id per dom key (-1 if absent)
     n_existing: int  # static
     n_slots: int  # static
 
@@ -81,106 +95,128 @@ jax.tree_util.register_dataclass(
     data_fields=[
         "row_alloc",
         "row_labels",
-        "row_zone",
         "row_pool_rank",
         "row_taint_class",
-        "rank_zoneset",
+        "rank_domset",
+        "dom_key_of",
         "pod_req",
         "pod_mask",
         "pod_taint_ok",
-        "pod_zone_allowed",
+        "pod_dom_allowed",
+        "pod_restrict",
         "member",
+        "owner",
         "group_kind",
         "group_skew",
-        "counts_zone_init",
+        "group_dom_key",
+        "group_min_domains",
+        "group_registered",
+        "counts_dom_init",
         "counts_host_init",
-        "existing_zoneset",
+        "existing_domset",
         "existing_port_any",
         "existing_port_wild",
         "existing_port_spec",
     ],
-    meta_fields=["zone_key", "n_existing", "n_slots"],
+    meta_fields=["dom_keys", "n_existing", "n_slots"],
 )
+
+
+def sig_restrict_of(enc) -> np.ndarray:
+    """[S, Kd] bool: signature constrains dom key k (cached on the encode)."""
+    return enc.sig_restrict
 
 
 def make_tensors(enc, n_slots: int | None = None, with_pods: bool = True) -> SchedulerTensors:
     """EncodedSnapshot (numpy) -> SchedulerTensors (device).
 
     with_pods=False skips uploading the per-POD tensors (req/mask/taints/
-    zones/member, all [P, ...]) — the signature-grouped kernel reads only the
-    per-ITEM tensors passed alongside, so the 50k-pod upload would be pure
+    domains/member, all [P, ...]) — the signature-grouped kernel reads only
+    the per-ITEM tensors passed alongside, so the 50k-pod upload would be pure
     waste on that path; size-1 placeholders keep the pytree shape."""
     P = enc.n_pods
     if n_slots is None:
         n_slots = enc.n_existing + P
     G = max(enc.n_groups, 1)
-    Z = enc.n_zones
+    D = enc.n_doms
+    Kd = len(enc.dom_key_names)
     counts_host = np.zeros((G, n_slots), dtype=np.int32)
     if enc.n_groups and enc.n_existing:
         counts_host[: enc.n_groups, : enc.n_existing] = enc.counts_host_existing[:, : enc.n_existing]
     group_kind = enc.group_kind if enc.n_groups else np.zeros(1, np.int32)
     group_skew = enc.group_skew if enc.n_groups else np.ones(1, np.int32)
+    group_dom_key = enc.group_dom_key if enc.n_groups else np.full(1, -1, np.int32)
+    group_min_domains = enc.group_min_domains if enc.n_groups else np.zeros(1, np.int32)
+    group_registered = enc.group_registered if enc.n_groups else np.zeros((1, D), bool)
     if not with_pods:
         pod_req = np.zeros((1, enc.row_alloc.shape[1]), np.float32)
         pod_mask = np.zeros((1,) + enc.sig_mask.shape[1:], enc.sig_mask.dtype)
         pod_taint_ok = np.ones((1, enc.sig_taint_ok.shape[1]), bool)
-        pod_zone_allowed = np.ones((1, Z), bool)
+        pod_dom_allowed = np.ones((1, D), bool)
+        pod_restrict = np.zeros((1, Kd), bool)
         member = np.zeros((1, G), bool)
+        owner = np.zeros((1, G), bool)
     else:
         pod_req = enc.pod_req
         pod_mask = enc.pod_mask
         pod_taint_ok = enc.pod_taint_ok
-        pod_zone_allowed = enc.pod_zone_allowed
+        pod_dom_allowed = enc.pod_dom_allowed
+        pod_restrict = sig_restrict_of(enc)[enc.sig_of_pod]
         member = enc.member if enc.n_groups else np.zeros((P, 1), bool)
-    counts_zone = enc.counts_zone_init if enc.n_groups else np.zeros((1, Z), np.int32)
+        owner = enc.owner if enc.n_groups else np.zeros((P, 1), bool)
+    counts_dom = enc.counts_dom_init if enc.n_groups else np.zeros((1, D), np.int32)
 
     n_ex = max(enc.n_existing, 1)
-    existing_zoneset = np.zeros((n_ex, Z), dtype=bool)
+    existing_domset = np.zeros((n_ex, D), dtype=bool)
+    dko = np.asarray(enc.dom_key_of)
     for j in range(enc.n_existing):
-        z = enc.row_zone[j]
-        if z > 0:
-            existing_zoneset[j, z] = True
-        else:
-            existing_zoneset[j, NO_ZONE] = True
+        for k in range(Kd):
+            existing_domset[j, enc.row_dom[j, k]] = True
 
     return SchedulerTensors(
         row_alloc=jnp.asarray(enc.row_alloc),
         row_labels=jnp.asarray(enc.row_labels),
-        row_zone=jnp.asarray(enc.row_zone),
         row_pool_rank=jnp.asarray(enc.row_pool_rank),
         row_taint_class=jnp.asarray(enc.row_taint_class),
-        rank_zoneset=jnp.asarray(enc.rank_zoneset),
+        rank_domset=jnp.asarray(enc.rank_domset),
+        dom_key_of=jnp.asarray(dko),
         pod_req=jnp.asarray(pod_req),
         pod_mask=jnp.asarray(pod_mask),
         pod_taint_ok=jnp.asarray(pod_taint_ok),
-        pod_zone_allowed=jnp.asarray(pod_zone_allowed),
+        pod_dom_allowed=jnp.asarray(pod_dom_allowed),
+        pod_restrict=jnp.asarray(pod_restrict),
         member=jnp.asarray(member),
+        owner=jnp.asarray(owner),
         group_kind=jnp.asarray(group_kind),
         group_skew=jnp.asarray(group_skew),
-        counts_zone_init=jnp.asarray(counts_zone),
+        group_dom_key=jnp.asarray(group_dom_key),
+        group_min_domains=jnp.asarray(group_min_domains),
+        group_registered=jnp.asarray(group_registered),
+        counts_dom_init=jnp.asarray(counts_dom),
         counts_host_init=jnp.asarray(counts_host),
-        existing_zoneset=jnp.asarray(existing_zoneset),
+        existing_domset=jnp.asarray(existing_domset),
         existing_port_any=jnp.asarray(enc.existing_port_any),
         existing_port_wild=jnp.asarray(enc.existing_port_wild),
         existing_port_spec=jnp.asarray(enc.existing_port_spec),
-        zone_key=enc.zone_key_id,
+        dom_keys=tuple(enc.dom_vocab_keys),
         n_existing=enc.n_existing,
         n_slots=int(n_slots),
     )
 
 
-def compat_matrix(row_labels, row_taint_class, masks, taints_ok, zone_key: int, batch_size: int = 1024):
-    """Requirement-mask x row compatibility for any batch of pods/items (zone
-    key excluded; zones are handled by the slot zone-set machinery):
-    [B, Nrows] bool. One big vectorized pass on the VPU instead of per-step
-    gathers inside the scan — scan bodies then just index a row."""
+def compat_matrix(row_labels, row_taint_class, masks, taints_ok, dom_keys: tuple, batch_size: int = 1024):
+    """Requirement-mask x row compatibility for any batch of pods/items (the
+    domain keys are excluded; they are handled by the slot domain-set
+    machinery): [B, Nrows] bool. One big vectorized pass on the VPU instead
+    of per-step gathers inside the scan — scan bodies then just index a row."""
 
     def one(args):
         mask_k_w, taint_ok_c = args
         bmasks = jnp.broadcast_to(mask_k_w[None, :, :], (row_labels.shape[0],) + mask_k_w.shape)
         ok = test_bit(bmasks, row_labels)  # [Nrows, K]
-        if zone_key >= 0:
-            ok = ok.at[:, zone_key].set(True)
+        for kk in dom_keys:
+            if kk >= 0:
+                ok = ok.at[:, kk].set(True)
         return jnp.all(ok, axis=1) & taint_ok_c[row_taint_class]
 
     return jax.lax.map(one, (masks, taints_ok), batch_size=min(batch_size, masks.shape[0]))
@@ -196,72 +232,105 @@ def row_choose_key(row_alloc, row_pool_rank, req):
     return key if req.ndim == 2 else key[0]
 
 
-def _compat_matrix(t: SchedulerTensors, zone_key: int):
-    return compat_matrix(t.row_labels, t.row_taint_class, t.pod_mask, t.pod_taint_ok, zone_key)
+def group_feasibility(t: SchedulerTensors, mem):
+    """Per-step keyed-domain membership for one pod/item: returns
+    (dom_member_mask [G], is_dom_member, kmask [D]) — which groups constrain
+    the pod, whether any do, and the domains of the pod's (single, per the
+    capability window) constrained key. Feasibility per domain comes from
+    spread_ok_of."""
+    is_dom_spread_g = t.group_kind == KIND_DOM_SPREAD
+    is_dom_anti_g = t.group_kind == KIND_DOM_ANTI
+    dom_member_mask = mem & (is_dom_spread_g | is_dom_anti_g)
+    is_dom_member = jnp.any(dom_member_mask)
+    k_star = jnp.max(jnp.where(dom_member_mask, t.group_dom_key, -1))
+    kmask = t.dom_key_of == k_star
+    return dom_member_mask, is_dom_member, kmask
 
 
-@partial(jax.jit, static_argnames=("zone_key", "n_existing", "n_slots"))
-def _greedy_pack_impl(t: SchedulerTensors, zone_key: int, n_existing: int, n_slots: int):
+def spread_ok_of(t: SchedulerTensors, za, dom_member_mask, counts_dom):
+    """[D] bool from the CURRENT counts (recomputed wherever counts moved)."""
+    is_dom_anti_g = (t.group_kind == KIND_DOM_ANTI)[:, None]
+    reg = t.group_registered
+    zcounts = jnp.where(za[None, :] & reg, counts_dom, INF_I)
+    zmin = jnp.min(zcounts, axis=1)
+    zmin = jnp.where(zmin >= INF_I, 0, zmin)
+    supported = jnp.sum((za[None, :] & reg).astype(jnp.int32), axis=1)
+    zmin = jnp.where((t.group_min_domains > 0) & (supported < t.group_min_domains), 0, zmin)
+    per_group_ok = jnp.where(is_dom_anti_g, counts_dom == 0, (counts_dom + 1 - zmin[:, None]) <= t.group_skew[:, None])
+    per_group_ok = per_group_ok & reg
+    return jnp.all(jnp.where(dom_member_mask[:, None], per_group_ok, True), axis=0)
+
+
+def perkey_dom_ok(domsets, za, restrict, dom_key_of):
+    """[..., D] domain sets -> [...] bool: for every dom key the pod
+    constrains, the set retains at least one allowed domain of that key."""
+    Kd = restrict.shape[0]
+    key_onehot = dom_key_of[None, :] == jnp.arange(Kd, dtype=dom_key_of.dtype)[:, None]  # [Kd, D]
+    inter = (domsets & za[None, :]).astype(jnp.int32)
+    perkey = inter @ key_onehot.astype(jnp.int32).T  # [..., Kd]
+    return jnp.all((perkey > 0) | ~restrict[None, :], axis=-1)
+
+
+def _compat_matrix(t: SchedulerTensors, dom_keys: tuple):
+    return compat_matrix(t.row_labels, t.row_taint_class, t.pod_mask, t.pod_taint_ok, dom_keys)
+
+
+@partial(jax.jit, static_argnames=("dom_keys", "n_existing", "n_slots"))
+def _greedy_pack_impl(t: SchedulerTensors, dom_keys: tuple, n_existing: int, n_slots: int):
     P, R = t.pod_req.shape
     N = n_slots
     Nrows = t.row_alloc.shape[0]
-    G, Z = t.counts_zone_init.shape
-    Q = t.rank_zoneset.shape[0]
+    G, D = t.counts_dom_init.shape
+    Q = t.rank_domset.shape[0]
 
     slot_basis0 = jnp.full((N,), -1, dtype=jnp.int32)
     slot_rem0 = jnp.full((N, R), NEG)
-    slot_zoneset0 = jnp.zeros((N, Z), dtype=bool)
+    slot_domset0 = jnp.zeros((N, D), dtype=bool)
     slot_rank0 = jnp.full((N,), -1, dtype=jnp.int32)
     if n_existing:
         idx = jnp.arange(n_existing, dtype=jnp.int32)
         slot_basis0 = slot_basis0.at[:n_existing].set(idx)
         slot_rem0 = slot_rem0.at[:n_existing].set(t.row_alloc[:n_existing])
-        slot_zoneset0 = slot_zoneset0.at[:n_existing].set(t.existing_zoneset[:n_existing])
+        slot_domset0 = slot_domset0.at[:n_existing].set(t.existing_domset[:n_existing])
 
     is_offering_row = jnp.arange(Nrows) >= n_existing
-    zone_is_real = jnp.arange(Z) != NO_ZONE
 
-    compat_all = _compat_matrix(t, zone_key)  # [P, Nrows]
+    compat_all = _compat_matrix(t, dom_keys)  # [P, Nrows]
 
     def step(state, pod_idx):
-        slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count = state
+        slot_basis, slot_rem, slot_domset, slot_rank, counts_dom, counts_host, open_count = state
         req = t.pod_req[pod_idx]
-        zone_allowed = t.pod_zone_allowed[pod_idx]  # [Z]
+        za = t.pod_dom_allowed[pod_idx]  # [D]
+        restrict = t.pod_restrict[pod_idx]  # [Kd]
         mem = t.member[pod_idx]  # [G]
+        own = t.owner[pod_idx]  # [G]
 
         compat_rows = compat_all[pod_idx]  # [Nrows]
-        is_zone_member = jnp.any(mem & (t.group_kind == KIND_ZONE_SPREAD))
-
-        # per-zone spread feasibility for this pod: spread_ok[z] (members only)
-        zcounts = jnp.where(zone_allowed[None, :] & zone_is_real[None, :], counts_zone, INF_I)
-        zmin = jnp.min(zcounts, axis=1)  # [G]
-        zmin = jnp.where(zmin >= INF_I, 0, zmin)
-        per_group_zone_ok = (counts_zone + 1 - zmin[:, None]) <= t.group_skew[:, None]  # [G, Z]
-        zone_member_mask = mem & (t.group_kind == KIND_ZONE_SPREAD)  # [G]
-        spread_ok = jnp.all(jnp.where(zone_member_mask[:, None], per_group_zone_ok, True), axis=0)  # [Z]
-        spread_ok &= jnp.where(is_zone_member, zone_is_real, True)  # members need a real zone
-        zone_feasible = zone_allowed & spread_ok  # [Z] for this pod
+        dom_member_mask, is_dom_member, kmask = group_feasibility(t, mem)
+        spread_ok = spread_ok_of(t, za, dom_member_mask, counts_dom)
+        dom_feasible = za & jnp.where(is_dom_member, spread_ok, True)  # [D]
 
         # --- open slots ----------------------------------------------------------
         slot_open = slot_basis >= 0
         fits_res = jnp.all(req[None, :] <= slot_rem, axis=1)
         slot_compat = jnp.where(slot_open, compat_rows[jnp.clip(slot_basis, 0, Nrows - 1)], False)
-        slot_zone_ok = jnp.any(slot_zoneset & zone_feasible[None, :], axis=1)  # [N]
+        slot_dom_ok = perkey_dom_ok(slot_domset, za, restrict, t.dom_key_of)  # [N]
+        slot_dom_ok &= jnp.where(is_dom_member, jnp.any(slot_domset & dom_feasible[None, :], axis=1), True)
 
         host_spread_ok = (counts_host + 1) <= t.group_skew[:, None]
-        host_ok = jnp.where((mem & (t.group_kind == KIND_HOST_SPREAD))[:, None], host_spread_ok, True)
-        anti_ok = jnp.where((mem & (t.group_kind == KIND_HOST_ANTI))[:, None], counts_host == 0, True)
+        host_ok = jnp.where((own & (t.group_kind == KIND_HOST_SPREAD))[:, None], host_spread_ok, True)
+        anti_ok = jnp.where((own & (t.group_kind == KIND_HOST_ANTI))[:, None], counts_host == 0, True)
         host_all_ok = jnp.all(host_ok & anti_ok, axis=0)  # [N]
 
-        fits_slot = slot_open & fits_res & slot_compat & slot_zone_ok & host_all_ok
+        fits_slot = slot_open & fits_res & slot_compat & slot_dom_ok & host_all_ok
         j_slot = first_true_index(fits_slot)
 
         # --- new slot ------------------------------------------------------------
         fits_row = is_offering_row & compat_rows & jnp.all(req[None, :] <= t.row_alloc, axis=1)
         rank_of_row = jnp.clip(t.row_pool_rank, 0, Q - 1)
-        # zone existence per rank: any feasible zone the template offers
-        rank_zone_ok = jnp.any(t.rank_zoneset & zone_feasible[None, :], axis=1)  # [Q]
-        fits_row &= rank_zone_ok[rank_of_row]
+        rank_ok = perkey_dom_ok(t.rank_domset, za, restrict, t.dom_key_of)  # [Q]
+        rank_ok &= jnp.where(is_dom_member, jnp.any(t.rank_domset & dom_feasible[None, :], axis=1), True)
+        fits_row &= rank_ok[rank_of_row]
         # capacity score: prefer lowest rank, then the row whose allocatable
         # envelope best covers the pod's shape (max bottleneck headroom)
         choose_key = row_choose_key(t.row_alloc, t.row_pool_rank, req)
@@ -274,29 +343,37 @@ def _greedy_pack_impl(t: SchedulerTensors, zone_key: int, n_existing: int, n_slo
         safe_j = jnp.clip(j, 0, N - 1)
         safe_o = jnp.clip(o_new, 0, Nrows - 1)
 
-        # --- zone commitment -----------------------------------------------------
-        # zones this placement can still use
-        cur_zoneset = jnp.where(
+        # --- domain commitment ---------------------------------------------------
+        # domains this placement can still use; narrowing is per key — a
+        # member commits its spread key while other keys only intersect the
+        # pod's allowed set
+        cur_domset = jnp.where(
             use_slot,
-            slot_zoneset[safe_j],
-            t.rank_zoneset[jnp.clip(t.row_pool_rank[safe_o], 0, Q - 1)],
-        )  # [Z]
-        cur_zoneset &= zone_feasible
-        # spread members commit to the min-count feasible zone (nextDomainTopologySpread)
-        zone_cost = jnp.where(cur_zoneset, jnp.sum(jnp.where(zone_member_mask[:, None], counts_zone, 0), axis=0), INF_I)
-        z_star = jnp.argmin(zone_cost)
-        new_zoneset = jnp.where(
-            is_zone_member,
-            (jnp.arange(Z) == z_star) & cur_zoneset,
-            cur_zoneset,
+            slot_domset[safe_j],
+            t.rank_domset[jnp.clip(t.row_pool_rank[safe_o], 0, Q - 1)],
+        )  # [D]
+        cur_domset &= jnp.where(kmask & is_dom_member, dom_feasible, za)
+        # spread members commit to the min-count feasible domain
+        # (nextDomainTopologySpread); anti-only members stay UNCOMMITTED and
+        # later block every domain they could land in (topology.go Record for
+        # anti: late committal blocks the full possible set)
+        has_spread_member = jnp.any(mem & (t.group_kind == KIND_DOM_SPREAD))
+        dom_cost = jnp.where(
+            cur_domset & kmask, jnp.sum(jnp.where(dom_member_mask[:, None], counts_dom, 0), axis=0), INF_I
         )
+        z_star = jnp.argmin(dom_cost)
+        new_domset = jnp.where(
+            is_dom_member & has_spread_member,
+            jnp.where(kmask, jnp.arange(D) == z_star, cur_domset),
+            cur_domset,
+        ) & cur_domset
 
         # --- state updates -------------------------------------------------------
         basis_j = jnp.where(use_slot, slot_basis[safe_j], o_new)
         rem_j = jnp.where(use_slot, slot_rem[safe_j] - req, t.row_alloc[safe_o] - req)
         slot_basis = jnp.where(assigned, slot_basis.at[safe_j].set(basis_j), slot_basis)
         slot_rem = jnp.where(assigned, slot_rem.at[safe_j].set(rem_j), slot_rem)
-        slot_zoneset = jnp.where(assigned, slot_zoneset.at[safe_j].set(new_zoneset), slot_zoneset)
+        slot_domset = jnp.where(assigned, slot_domset.at[safe_j].set(new_domset), slot_domset)
         slot_rank = jnp.where(
             assigned,
             slot_rank.at[safe_j].set(jnp.where(use_slot, slot_rank[safe_j], t.row_pool_rank[safe_o])),
@@ -304,34 +381,37 @@ def _greedy_pack_impl(t: SchedulerTensors, zone_key: int, n_existing: int, n_slo
         )
         open_count = jnp.where(open_new, open_count + 1, open_count)
 
-        zone_inc = (zone_member_mask & assigned).astype(jnp.int32)  # [G]
-        counts_zone = counts_zone.at[:, z_star].add(jnp.where(is_zone_member, zone_inc, 0))
+        spread_inc = (mem & (t.group_kind == KIND_DOM_SPREAD) & assigned).astype(jnp.int32)  # [G]
+        counts_dom = counts_dom.at[:, z_star].add(jnp.where(is_dom_member, spread_inc, 0))
+        anti_member = mem & (t.group_kind == KIND_DOM_ANTI)
+        blocked = (new_domset & kmask & assigned).astype(jnp.int32)  # [D]
+        counts_dom = counts_dom + jnp.where(anti_member[:, None], blocked[None, :], 0)
         host_inc = (mem & ((t.group_kind == KIND_HOST_SPREAD) | (t.group_kind == KIND_HOST_ANTI)) & assigned).astype(jnp.int32)
         counts_host = counts_host.at[:, safe_j].add(host_inc)
 
-        return (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count), j.astype(jnp.int32)
+        return (slot_basis, slot_rem, slot_domset, slot_rank, counts_dom, counts_host, open_count), j.astype(jnp.int32)
 
     init = (
         slot_basis0,
         slot_rem0,
-        slot_zoneset0,
+        slot_domset0,
         slot_rank0,
-        t.counts_zone_init,
+        t.counts_dom_init,
         t.counts_host_init,
         jnp.int32(n_existing),
     )
-    (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count), assignment = jax.lax.scan(
+    (slot_basis, slot_rem, slot_domset, slot_rank, counts_dom, counts_host, open_count), assignment = jax.lax.scan(
         step, init, jnp.arange(P, dtype=jnp.int32)
     )
-    return assignment, slot_basis, slot_zoneset, slot_rank, open_count
+    return assignment, slot_basis, slot_domset, slot_rank, open_count
 
 
 def greedy_pack(t: SchedulerTensors):
     """Run the per-pod packer. Returns (assignment[P] -> slot or -1,
-    slot_basis[N], slot_zoneset[N, Z], slot_rank[N], open_count).
+    slot_basis[N], slot_domset[N, D], slot_rank[N], open_count).
 
     LIMITATION: this legacy per-pod scan does NOT enforce host ports — the
     production path is the grouped kernel (scheduler_model_grouped), which
     does. Callers must only feed it port-free snapshots (TPUSolver never
     routes ported pods here)."""
-    return _greedy_pack_impl(t, t.zone_key, t.n_existing, t.n_slots)
+    return _greedy_pack_impl(t, t.dom_keys, t.n_existing, t.n_slots)

@@ -34,14 +34,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from .scheduler_model import (
+    KIND_DOM_ANTI,
+    KIND_DOM_SPREAD,
     KIND_HOST_ANTI,
     KIND_HOST_SPREAD,
-    KIND_ZONE_SPREAD,
     NEG,
-    NO_ZONE,
     SchedulerTensors,
     compat_matrix,
+    perkey_dom_ok,
     row_choose_key,
+    sig_restrict_of,
+    spread_ok_of,
 )
 
 INF_I = jnp.int32(2**30)
@@ -55,8 +58,10 @@ class ItemTensors:
     item_req: jnp.ndarray  # [W, R]
     item_mask: jnp.ndarray  # [W, K, Words]
     item_taint_ok: jnp.ndarray  # [W, C]
-    item_zone_allowed: jnp.ndarray  # [W, Z]
-    item_member: jnp.ndarray  # [W, G]
+    item_dom_allowed: jnp.ndarray  # [W, D]
+    item_restrict: jnp.ndarray  # [W, Kd] — item constrains this dom key
+    item_member: jnp.ndarray  # [W, G] — counted by the group
+    item_owner: jnp.ndarray  # [W, G] — constrained by the group
     item_count: jnp.ndarray  # [W] i32
     # host ports (encode.py port vocabulary)
     item_port_any: jnp.ndarray  # [W, P1] bool
@@ -70,8 +75,10 @@ jax.tree_util.register_dataclass(
         "item_req",
         "item_mask",
         "item_taint_ok",
-        "item_zone_allowed",
+        "item_dom_allowed",
+        "item_restrict",
         "item_member",
+        "item_owner",
         "item_count",
         "item_port_any",
         "item_port_wild",
@@ -85,13 +92,15 @@ def build_items(enc):
     """Group pods into work items from the encoder's signature ids (encode
     already deduplicated pod shapes — this is pure integer index work, no
     tensor hashing). Returns (ItemTensors arrays as numpy,
-    pod_indices_per_item as arrays). Pods in >1 zone-spread group stay
+    pod_indices_per_item as arrays). Pods in >1 keyed-domain group stay
     count=1 (water-fill is single-level for them)."""
     P = enc.n_pods
     S = enc.n_sigs
     G = enc.sig_member.shape[1] if enc.sig_member.size else 0
     sig_member = enc.sig_member if G else np.zeros((max(S, 1), 1), bool)
-    zone_groups = (enc.group_kind == KIND_ZONE_SPREAD) if G else np.zeros(1, bool)
+    zone_groups = (
+        ((enc.group_kind == KIND_DOM_SPREAD) | (enc.group_kind == KIND_DOM_ANTI)) if G else np.zeros(1, bool)
+    )
     multi_zone_sig = (sig_member & zone_groups[None, :]).sum(axis=1) > 1  # [S]
     sig = np.asarray(enc.sig_of_pod, dtype=np.int64)
     # multi-zone pods get a distinct per-pod key so they never merge
@@ -111,8 +120,10 @@ def build_items(enc):
         item_req=enc.sig_req[rep_sig],
         item_mask=enc.sig_mask[rep_sig],
         item_taint_ok=enc.sig_taint_ok[rep_sig],
-        item_zone_allowed=enc.sig_zone_allowed[rep_sig],
+        item_dom_allowed=enc.sig_dom_allowed[rep_sig],
+        item_restrict=sig_restrict_of(enc)[rep_sig],
         item_member=sig_member[rep_sig],
+        item_owner=(enc.sig_owner if G else np.zeros((max(S, 1), 1), bool))[rep_sig],
         item_count=counts[order].astype(np.int32),
         item_port_any=enc.sig_port_any[rep_sig],
         item_port_wild=enc.sig_port_wild[rep_sig],
@@ -168,7 +179,7 @@ def _waterfill(v, finite, c, cap):
     return jnp.where(finite, inc, 0)
 
 
-def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_existing: int, n_slots: int, axis: str | None):
+def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_existing: int, n_slots: int, axis: str | None):
     """The grouped pack scan, written once for both execution modes.
 
     axis=None: single-device — slot arrays span the full [n_slots] axis and
@@ -176,18 +187,19 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
 
     axis="...": the body is running INSIDE jax's shard_map with the slot axis
     sharded across the mesh (parallel/sharded.py). Slot-state arrays
-    (slot_rem/basis/zoneset/rank, counts_host, takes) are LOCAL shards;
+    (slot_rem/basis/domset/rank, counts_host, takes) are LOCAL shards;
     n_slots stays the GLOBAL count. The per-step vector work shards naturally;
     the only cross-device communication is the first-fit prefix-sum
     (all_gather of per-device capacity totals), the take/left totals (psum),
-    and per-zone slot availability (psum-of-any) — the TPU analogue of the
+    and per-domain slot availability (psum-of-any) — the TPU analogue of the
     reference's parallelizeUntil fan-out over candidate nodes
     (scheduler.go:939-961), riding ICI instead of goroutines."""
     W, R = items.item_req.shape
     N = n_slots
     Nrows = t.row_alloc.shape[0]
-    G, Z = t.counts_zone_init.shape
-    Q = t.rank_zoneset.shape[0]
+    G, D = t.counts_dom_init.shape
+    Kd = items.item_restrict.shape[1]
+    Q = t.rank_domset.shape[0]
 
     if axis is None:
         N_loc = N
@@ -205,7 +217,7 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
             return jnp.any(m, axis=0)
     else:
         N_loc = t.counts_host_init.shape[1]  # local shard width (static)
-        D = N // N_loc
+        n_dev = N // N_loc
         didx = jax.lax.axis_index(axis)
         slot_ids = (didx * N_loc + jnp.arange(N_loc)).astype(jnp.int32)  # global ids
 
@@ -214,8 +226,8 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
 
         def gprefix(v):
             local = jnp.cumsum(v)
-            totals = jax.lax.all_gather(local[-1], axis)  # [D]
-            offset = jnp.sum(jnp.where(jnp.arange(D) < didx, totals, 0))
+            totals = jax.lax.all_gather(local[-1], axis)  # [n_dev]
+            offset = jnp.sum(jnp.where(jnp.arange(n_dev) < didx, totals, 0))
             return local - v + offset
 
         def gany_slots(m):
@@ -228,36 +240,39 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
     in_existing = slot_ids < n_existing
     if n_existing:
         safe_row = jnp.clip(slot_ids, 0, Nrows - 1)
-        safe_ex = jnp.clip(slot_ids, 0, t.existing_zoneset.shape[0] - 1)
+        safe_ex = jnp.clip(slot_ids, 0, t.existing_domset.shape[0] - 1)
         slot_basis0 = jnp.where(in_existing, slot_ids, -1).astype(jnp.int32)
         slot_rem0 = jnp.where(in_existing[:, None], t.row_alloc[safe_row], NEG)
-        slot_zoneset0 = jnp.where(in_existing[:, None], t.existing_zoneset[safe_ex], False)
-        # existing_port_* share existing_zoneset's max(n_existing, 1) rows
+        slot_zoneset0 = jnp.where(in_existing[:, None], t.existing_domset[safe_ex], False)
+        # existing_port_* share existing_domset's max(n_existing, 1) rows
         slot_pany0 = jnp.where(in_existing[:, None], t.existing_port_any[safe_ex], False)
         slot_pwild0 = jnp.where(in_existing[:, None], t.existing_port_wild[safe_ex], False)
         slot_pspec0 = jnp.where(in_existing[:, None], t.existing_port_spec[safe_ex], False)
     else:
         slot_basis0 = jnp.full((N_loc,), -1, dtype=jnp.int32)
         slot_rem0 = jnp.full((N_loc, R), NEG)
-        slot_zoneset0 = jnp.zeros((N_loc, Z), dtype=bool)
+        slot_zoneset0 = jnp.zeros((N_loc, D), dtype=bool)
         slot_pany0 = jnp.zeros((N_loc, P1), dtype=bool)
         slot_pwild0 = jnp.zeros((N_loc, P1), dtype=bool)
         slot_pspec0 = jnp.zeros((N_loc, P2), dtype=bool)
     slot_rank0 = jnp.full((N_loc,), -1, dtype=jnp.int32)
 
     is_offering_row = jnp.arange(Nrows) >= n_existing
-    zone_is_real = jnp.arange(Z) != NO_ZONE
     rank_of_row = jnp.clip(t.row_pool_rank, 0, Q - 1)
+    is_dom_spread_g = t.group_kind == KIND_DOM_SPREAD
+    is_dom_anti_g = t.group_kind == KIND_DOM_ANTI
 
     # item x row compatibility + row preference, one vectorized pass (W small)
-    compat_items = compat_matrix(t.row_labels, t.row_taint_class, items.item_mask, items.item_taint_ok, zone_key, batch_size=256)
+    compat_items = compat_matrix(t.row_labels, t.row_taint_class, items.item_mask, items.item_taint_ok, dom_keys, batch_size=256)
     choose_key_items = row_choose_key(t.row_alloc, t.row_pool_rank, items.item_req)
 
     def step(state, i):
         slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, ports = state
         req = items.item_req[i]
-        za = items.item_zone_allowed[i]
+        za = items.item_dom_allowed[i]
+        restrict = items.item_restrict[i]
         mem = items.item_member[i]
+        own = items.item_owner[i]
         c = items.item_count[i]
         compat_rows = compat_items[i]
         choose_key = choose_key_items[i]
@@ -280,9 +295,18 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
             )
             return ~conflict
 
-        zone_member_mask = mem & (t.group_kind == KIND_ZONE_SPREAD)
+        zone_member_mask = mem & (is_dom_spread_g | is_dom_anti_g)
         is_zm = jnp.any(zone_member_mask)
-        host_member_mask = mem & ((t.group_kind == KIND_HOST_SPREAD) | (t.group_kind == KIND_HOST_ANTI))
+        # the item's domain key (the window guarantees all its dom groups
+        # share one); kmask selects that key's domains
+        k_star = jnp.max(jnp.where(zone_member_mask, t.group_dom_key, -1))
+        kmask = t.dom_key_of == k_star
+        # other-key gating: every dom key the item constrains must keep an
+        # allowed value in a candidate's domain set
+        restrict_other = restrict & (jnp.arange(Kd) != k_star)
+        host_kinds = (t.group_kind == KIND_HOST_SPREAD) | (t.group_kind == KIND_HOST_ANTI)
+        host_member_mask = mem & host_kinds  # counting
+        host_owner_mask = own & host_kinds  # gating
 
         def member_host_cap(counts_host_now):
             """Per-slot host caps from member groups (anti: 1 iff untouched),
@@ -294,11 +318,11 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
                 t.group_skew[:, None] - counts_host_now,
                 jnp.where((t.group_kind == KIND_HOST_ANTI)[:, None], (counts_host_now == 0).astype(jnp.int32), INF_I),
             )  # [G, N]
-            return jnp.min(jnp.where(mem[:, None], cap_from_group, INF_I), axis=0)  # [N]
+            return jnp.min(jnp.where(host_owner_mask[:, None], cap_from_group, INF_I), axis=0)  # [N]
 
         host_cap_new = jnp.min(
             jnp.where(
-                mem,
+                host_owner_mask,
                 jnp.where(t.group_kind == KIND_HOST_SPREAD, t.group_skew, jnp.where(t.group_kind == KIND_HOST_ANTI, 1, INF_I)),
                 INF_I,
             )
@@ -316,21 +340,27 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
         fits_row = is_offering_row & compat_rows & jnp.all(req[None, :] <= t.row_alloc, axis=1)
         row_cap = _int_cap(t.row_alloc, req)  # [Nrows]
 
-        # zone feasibility: pod-allowed, real-zone for members, per-group skew
-        zcounts = jnp.where(za[None, :] & zone_is_real[None, :], counts_zone, INF_I)
-        zmin = jnp.min(zcounts, axis=1)
-        zmin = jnp.where(zmin >= INF_I, 0, zmin)
-        per_group_zone_ok = (counts_zone + 1 - zmin[:, None]) <= t.group_skew[:, None]
-        spread_ok = jnp.all(jnp.where(zone_member_mask[:, None], per_group_zone_ok, True), axis=0)
-        zone_feasible = za & jnp.where(is_zm, zone_is_real & spread_ok, True)
+        # per-group domain feasibility at step entry (used by the strict
+        # multi-group path); registered-universe, anti, and minDomains
+        # force-zero semantics live in spread_ok_of
+        spread_ok = spread_ok_of(t, za, zone_member_mask, counts_zone)
 
-        # zone availability: a fitting template offers it, or a slot holds it
-        openable_z = jnp.any(fits_row[:, None] & t.rank_zoneset[rank_of_row], axis=0)  # [Z]
+        # new-slot admission per rank: every constrained key must keep an
+        # allowed domain (the k* requirement is applied per-domain below)
+        rank_ok_all = perkey_dom_ok(t.rank_domset, za, restrict, t.dom_key_of)  # [Q]
+        rank_ok_other = perkey_dom_ok(t.rank_domset, za, restrict_other, t.dom_key_of)  # [Q]
 
-        def place(cnt, elig_mask, za_for_new, commit_z, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports):
+        # domain availability: a fitting template (satisfying the item's
+        # other keys) offers it, or a committed slot holds it
+        openable_z = jnp.any((fits_row & rank_ok_other[rank_of_row])[:, None] & t.rank_domset[rank_of_row], axis=0)  # [D]
+
+        def place(cnt, elig_mask, rank_ok, narrow, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports):
             """Place `cnt` identical pods: prefix-sum first-fit over eligible
             slots, then open new slots of the best row for the leftover.
-            commit_z >= 0 pins touched slots to that zone."""
+            `rank_ok` gates which template ranks may open; `narrow` is
+            intersected into touched slots' domain sets (the caller encodes
+            the committed k* domain plus the pod's allowed sets for every
+            other key)."""
             cap_res = _int_cap(slot_rem, req)
             cap_j = jnp.where(elig_mask & port_ok_of(ports), jnp.minimum(jnp.minimum(cap_res, member_host_cap(counts_host)), port_cap), 0)
             cap_j = jnp.clip(cap_j, 0, INF_I)
@@ -339,8 +369,7 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
             left = cnt - gsum(take)
 
             # leftover -> new slots of the single best row
-            rank_zone_ok = jnp.any(t.rank_zoneset & za_for_new[None, :], axis=1)
-            fr = fits_row & rank_zone_ok[rank_of_row]
+            fr = fits_row & rank_ok[rank_of_row]
             o = jnp.argmin(jnp.where(fr, choose_key, BIGF)).astype(jnp.int32)
             o_ok = fr[o]
             cstar = jnp.minimum(jnp.minimum(row_cap[o], host_cap_new), port_cap)
@@ -352,7 +381,7 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
             new_take = jnp.where(is_new, jnp.clip(left - pos * cstar, 0, cstar), 0).astype(jnp.int32)
             left = left - gsum(new_take)
 
-            new_zs = t.rank_zoneset[rank_of_row[o]] & za_for_new  # [Z]
+            new_zs = t.rank_domset[rank_of_row[o]] & narrow  # [D]
             slot_basis = jnp.where(is_new, o, slot_basis)
             slot_rank = jnp.where(is_new, t.row_pool_rank[o], slot_rank)
             slot_rem = jnp.where(is_new[:, None], t.row_alloc[o][None, :], slot_rem)
@@ -361,11 +390,8 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
 
             take = take + new_take
             touched = take > 0
-            # zone narrowing: commit to a single zone for members, intersect
-            # with the pod's allowed zones otherwise
-            commit_onehot = jnp.arange(Z) == commit_z
-            narrowed = jnp.where(commit_z >= 0, commit_onehot[None, :], za[None, :])
-            slot_zoneset = jnp.where(touched[:, None], slot_zoneset & narrowed, slot_zoneset)
+            # per-key narrowing of touched slots' domain sets
+            slot_zoneset = jnp.where(touched[:, None], slot_zoneset & narrow[None, :], slot_zoneset)
             slot_rem = slot_rem - take[:, None].astype(slot_rem.dtype) * req[None, :]
             counts_host = counts_host + jnp.where(host_member_mask[:, None], take[None, :], 0)
             slot_pany, slot_pwild, slot_pspec = ports
@@ -377,67 +403,86 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
 
         def simple_path(op):
             slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports = op
-            elig = slot_compat & jnp.any(slot_zoneset & zone_feasible[None, :], axis=1)
+            elig = slot_compat & perkey_dom_ok(slot_zoneset, za, restrict, t.dom_key_of)
             take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports = place(
-                c, elig, zone_feasible, jnp.int32(-1), slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports
+                c, elig, rank_ok_all, za, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports
             )
             return take, left, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports)
 
         def zone_path(op):
             slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports = op
-            slotcap_z = gany_slots((slot_compat & (_int_cap(slot_rem, req) > 0) & port_ok_of(ports))[:, None] & slot_zoneset)
-            vsum = jnp.sum(jnp.where(zone_member_mask[:, None], counts_zone, 0), axis=0)  # [Z]
-            skew_star = jnp.min(jnp.where(zone_member_mask, t.group_skew, INF_I))
-            allowed_real = za & zone_is_real
+
+            def other_ok_of(zs_now):
+                return perkey_dom_ok(zs_now, za, restrict_other, t.dom_key_of)
+
+            slotcap_z = gany_slots(
+                (slot_compat & (_int_cap(slot_rem, req) > 0) & port_ok_of(ports) & other_ok_of(slot_zoneset))[:, None]
+                & slot_zoneset
+            )
+            vsum = jnp.sum(jnp.where(zone_member_mask[:, None], counts_zone, 0), axis=0)  # [D]
+            skew_star = jnp.min(jnp.where(zone_member_mask & is_dom_spread_g, t.group_skew, INF_I))
+            # the group's registered universe (single-group path); sentinels
+            # and other keys' domains are never registered
+            reg_star = jnp.sum(jnp.where(zone_member_mask[:, None], t.group_registered, False), axis=0) > 0
+            allowed_real = za & reg_star & kmask
             # the water-fill domain is AVAILABILITY-based, not skew-based: a
-            # zone at the current max level is only temporarily infeasible —
+            # domain at the current max level is only temporarily infeasible —
             # the sequential loop raises counts level-by-level and re-admits
-            # it once the min zones catch up, which is exactly what water-fill
-            # (pour into current-min first) reproduces. Gating on the
-            # step-entry skew check would freeze such zones and strand the
-            # batch's quota. Only allowed-but-UNAVAILABLE zones (no fitting
-            # template, no committed slot capacity) truly pin the global
-            # minimum: no available zone may rise above frozen_min + skew
-            # (per-pod check, scheduler_model.py:199-205).
+            # it once the min domains catch up, which is exactly what
+            # water-fill (pour into current-min first) reproduces. Gating on
+            # the step-entry skew check would freeze such domains and strand
+            # the batch's quota. Only allowed-but-UNAVAILABLE domains (no
+            # fitting template, no committed slot capacity) truly pin the
+            # global minimum: no available domain may rise above
+            # frozen_min + skew (per-pod check, scheduler_model.py).
             available = allowed_real & (openable_z | slotcap_z)
-            # items in MULTIPLE zone-spread groups are count=1 by construction
-            # (build_items splits them): level-raising doesn't apply to a
-            # single pod, and the summed-across-groups vsum can't express
-            # per-group skew — gate such items on the exact per-group
+            # items in MULTIPLE keyed-domain groups are count=1 by
+            # construction (build_items splits them): level-raising doesn't
+            # apply to a single pod, and the summed-across-groups vsum can't
+            # express per-group skew — gate such items on the exact per-group
             # step-entry check (spread_ok) and give flat unit capacity
             strict = jnp.sum(zone_member_mask) > 1
             finite = available & jnp.where(strict, spread_ok, True)
             frozen = allowed_real & ~available
             frozen_min = jnp.min(jnp.where(frozen, vsum, INF_I))
+            # minDomains force-zero: fewer pod-supported registered domains
+            # than minDomains pins the global minimum at zero
+            md_star = jnp.max(jnp.where(zone_member_mask, t.group_min_domains, 0))
+            supported = jnp.sum((za & reg_star & kmask).astype(jnp.int32))
+            force_zero = (md_star > 0) & (supported < md_star)
+            frozen_min = jnp.where(force_zero, 0, frozen_min)
             cap = jnp.clip(frozen_min + skew_star - vsum, 0, INF_I)
             cap = jnp.where(strict, jnp.where(finite, 1, 0), cap)
             inc = _waterfill(vsum, finite, c, cap)
             take_all = jnp.zeros((N_loc,), jnp.int32)
             pending = c - jnp.sum(inc)  # skew/availability-capped remainder
-            placed_z = jnp.zeros((Z,), jnp.int32)
-            for z in range(Z):  # Z is small and static; unrolled
+            placed_z = jnp.zeros((D,), jnp.int32)
+            for z in range(D):  # D is small and static; unrolled
                 cz = inc[z]
-                elig = slot_compat_of(slot_basis) & slot_zoneset[:, z]
+                narrow_z = jnp.where(kmask, jnp.arange(D) == z, za)
+                elig = slot_compat_of(slot_basis) & slot_zoneset[:, z] & other_ok_of(slot_zoneset)
                 take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports = place(
-                    cz, elig, (jnp.arange(Z) == z), jnp.int32(z),
+                    cz, elig, t.rank_domset[:, z] & rank_ok_other, narrow_z,
                     slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports,
                 )
                 take_all = take_all + take
                 pending = pending + left
                 placed_z = placed_z.at[z].set(cz - left)
-            # redistribution: a zone whose slots ran dry strands its quota;
-            # offer the stranded pods to other zones with headroom, respecting
-            # the evolving skew bound (the sequential loop would have rotated
-            # them there naturally)
-            for z in range(Z):
+            # redistribution: a domain whose slots ran dry strands its quota;
+            # offer the stranded pods to other domains with headroom,
+            # respecting the evolving skew bound (the sequential loop would
+            # have rotated them there naturally)
+            for z in range(D):
                 vsum_u = vsum + placed_z
                 zmin_u = jnp.min(jnp.where(allowed_real, vsum_u, INF_I))
                 zmin_u = jnp.where(zmin_u >= INF_I, 0, zmin_u)
+                zmin_u = jnp.where(force_zero, 0, zmin_u)
                 headroom = jnp.clip(zmin_u + skew_star - vsum_u[z], 0, INF_I)
                 cz = jnp.minimum(pending, jnp.where(finite[z], headroom, 0))
-                elig = slot_compat_of(slot_basis) & slot_zoneset[:, z]
+                narrow_z = jnp.where(kmask, jnp.arange(D) == z, za)
+                elig = slot_compat_of(slot_basis) & slot_zoneset[:, z] & other_ok_of(slot_zoneset)
                 take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports = place(
-                    cz, elig, (jnp.arange(Z) == z), jnp.int32(z),
+                    cz, elig, t.rank_domset[:, z] & rank_ok_other, narrow_z,
                     slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports,
                 )
                 take_all = take_all + take
@@ -446,9 +491,52 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
             counts_zone = counts_zone + jnp.where(zone_member_mask[:, None], placed_z[None, :], 0)
             return take_all, pending, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports)
 
+        def anti_path(op):
+            """Keyed required anti-affinity with the reference's late-committal
+            semantics (topology.go Record for anti: "block out all possible
+            domains that the pod could land in"): each placed pod consumes the
+            ENTIRE domain set its slot could still land in, so an unpinned
+            replica set schedules one pod per solve while selector-pinned
+            replicas consume exactly their pinned domain. Sequential by
+            nature; each successful placement blocks >= 1 domain, so D+1
+            single-pod rounds saturate."""
+            slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports = op
+
+            def other_ok_of(zs_now):
+                return perkey_dom_ok(zs_now, za, restrict_other, t.dom_key_of)
+
+            reg_star = jnp.sum(jnp.where(zone_member_mask[:, None], t.group_registered, False), axis=0) > 0
+            take_all = jnp.zeros((N_loc,), jnp.int32)
+            pending = c
+            for _ in range(D + 1):
+                vsum = jnp.sum(jnp.where(zone_member_mask[:, None], counts_zone, 0), axis=0)  # [D]
+                empty = reg_star & (vsum == 0) & za & kmask
+                narrow = jnp.where(kmask, empty, za)
+                elig = (
+                    slot_compat_of(slot_basis)
+                    & other_ok_of(slot_zoneset)
+                    & jnp.any(slot_zoneset & empty[None, :], axis=1)
+                )
+                rank_ok = jnp.any(t.rank_domset & empty[None, :], axis=1) & rank_ok_other
+                cnt = jnp.minimum(pending, 1)
+                take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports = place(
+                    cnt, elig, rank_ok, narrow,
+                    slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports,
+                )
+                # block every domain the touched slot could still land in
+                blocked = gany_slots((take > 0)[:, None] & slot_zoneset) & kmask
+                counts_zone = counts_zone + jnp.where(
+                    zone_member_mask[:, None], blocked[None, :].astype(jnp.int32), 0
+                )
+                take_all = take_all + take
+                pending = pending - (cnt - left)
+            return take_all, pending, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports)
+
         operand = (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports)
-        take, leftover, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports) = jax.lax.cond(
-            is_zm, zone_path, simple_path, operand
+        is_anti_item = jnp.any(zone_member_mask & is_dom_anti_g)
+        branch = jnp.where(is_anti_item, 2, jnp.where(is_zm, 1, 0)).astype(jnp.int32)
+        take, leftover, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports) = jax.lax.switch(
+            branch, [simple_path, zone_path, anti_path], operand
         )
 
         new_state = (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, ports)
@@ -459,7 +547,7 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
         slot_rem0,
         slot_zoneset0,
         slot_rank0,
-        t.counts_zone_init,
+        t.counts_dom_init,
         t.counts_host_init,
         jnp.int32(n_existing),
         (slot_pany0, slot_pwild0, slot_pspec0),
@@ -470,9 +558,9 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, zone_key: int, n_exis
     return takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count
 
 
-@partial(jax.jit, static_argnames=("zone_key", "n_existing", "n_slots"))
-def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, zone_key: int, n_existing: int, n_slots: int):
-    return _pack_body(t, items, zone_key=zone_key, n_existing=n_existing, n_slots=n_slots, axis=None)
+@partial(jax.jit, static_argnames=("dom_keys", "n_existing", "n_slots"))
+def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, dom_keys: tuple, n_existing: int, n_slots: int):
+    return _pack_body(t, items, dom_keys=dom_keys, n_existing=n_existing, n_slots=n_slots, axis=None)
 
 
 def _sparsify_takes(takes, nnz_cap: int):
@@ -485,8 +573,8 @@ def _sparsify_takes(takes, nnz_cap: int):
     return nzi, nzs, nzc
 
 
-@partial(jax.jit, static_argnames=("zone_key", "n_existing", "n_slots", "nnz_cap"))
-def _pack_compressed_impl(t: SchedulerTensors, items: ItemTensors, zone_key: int, n_existing: int, n_slots: int, nnz_cap: int):
+@partial(jax.jit, static_argnames=("dom_keys", "n_existing", "n_slots", "nnz_cap"))
+def _pack_compressed_impl(t: SchedulerTensors, items: ItemTensors, dom_keys: tuple, n_existing: int, n_slots: int, nnz_cap: int):
     """Pack + on-device sparsification, fused into ONE flat int32 output.
 
     The production deployment reaches the TPU through a tunnel whose
@@ -495,7 +583,7 @@ def _pack_compressed_impl(t: SchedulerTensors, items: ItemTensors, zone_key: int
     arrays pays that latency per pull. Concatenating every host-needed output
     into one int32 vector makes the whole solve one device->host transfer."""
     takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = _pack_body(
-        t, items, zone_key=zone_key, n_existing=n_existing, n_slots=n_slots, axis=None
+        t, items, dom_keys=dom_keys, n_existing=n_existing, n_slots=n_slots, axis=None
     )
     nzi, nzs, nzc = _sparsify_takes(takes, nnz_cap)
     return jnp.concatenate(
@@ -521,11 +609,11 @@ def greedy_pack_grouped_compressed(t: SchedulerTensors, items: ItemTensors, n_po
     slot_zoneset (bool [N, Z]), leftovers, open_count — all numpy."""
     W = items.item_req.shape[0]
     N = t.n_slots
-    Z = t.counts_zone_init.shape[1]
+    Z = t.counts_dom_init.shape[1]
     # nnz <= n_pods; round the static cap up to a power of two so solves with
     # drifting pod counts reuse one compiled kernel instead of retracing
     nnz_cap = int(min(_next_pow2(n_pods), W * N))
-    flat = np.asarray(_pack_compressed_impl(t, items, t.zone_key, t.n_existing, N, nnz_cap))
+    flat = np.asarray(_pack_compressed_impl(t, items, t.dom_keys, t.n_existing, N, nnz_cap))
     o = 0
 
     def take(n):
@@ -553,7 +641,7 @@ def greedy_pack_grouped_compressed(t: SchedulerTensors, items: ItemTensors, n_po
 def greedy_pack_grouped(t: SchedulerTensors, items: ItemTensors):
     """Returns (takes [W, N], leftovers [W], slot_basis, slot_zoneset,
     slot_rank, open_count)."""
-    return _greedy_pack_grouped_impl(t, items, t.zone_key, t.n_existing, t.n_slots)
+    return _greedy_pack_grouped_impl(t, items, t.dom_keys, t.n_existing, t.n_slots)
 
 
 def compress_takes(takes, n_pods: int):

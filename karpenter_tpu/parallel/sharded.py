@@ -49,16 +49,16 @@ def make_mesh(devices=None, axis: str = "slots") -> Mesh:
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_pack_fn(mesh: Mesh, zone_key: int, n_existing: int, n_slots: int):
+def _sharded_pack_fn(mesh: Mesh, dom_keys: tuple, n_existing: int, n_slots: int):
     """The jitted shard_map'd pack kernel, cached so steady-state meshed
     solves reuse one trace/compile per (mesh, statics) the way the
     single-device @jax.jit kernel does (jit caches key on wrapper identity)."""
     axis = mesh.axis_names[0]
-    meta = dict(zone_key=zone_key, n_existing=n_existing, n_slots=n_slots)
+    meta = dict(dom_keys=dom_keys, n_existing=n_existing, n_slots=n_slots)
     data = {f.name: P() for f in dataclasses.fields(SchedulerTensors) if f.name not in meta}
     t_specs = dataclasses.replace(SchedulerTensors(**data, **meta), counts_host_init=P(None, axis))
     item_specs = ItemTensors(**{f.name: P() for f in dataclasses.fields(ItemTensors)})
-    body = partial(_pack_body, zone_key=zone_key, n_existing=n_existing, n_slots=n_slots, axis=axis)
+    body = partial(_pack_body, dom_keys=dom_keys, n_existing=n_existing, n_slots=n_slots, axis=axis)
     return jax.jit(
         jax.shard_map(
             body,
@@ -79,7 +79,7 @@ def greedy_pack_grouped_sharded(t: SchedulerTensors, items: ItemTensors, mesh: M
     and never used unless the original axis overflows).
     """
     t = pad_slots_for_mesh(t, mesh)
-    fn = _sharded_pack_fn(mesh, t.zone_key, t.n_existing, t.n_slots)
+    fn = _sharded_pack_fn(mesh, t.dom_keys, t.n_existing, t.n_slots)
     return fn(t, items)
 
 
@@ -125,7 +125,7 @@ def sharded_compat_matrix(t: SchedulerTensors, mesh: Mesh):
     pod_taint_ok = jax.device_put(pod_taint_ok, pod_sharding)
     row_labels = jax.device_put(t.row_labels, rep)
     row_taint_class = jax.device_put(t.row_taint_class, rep)
-    zone_key = t.zone_key
+    dom_keys = t.dom_keys
 
     @jax.jit
     def compute(pod_mask, pod_taint_ok, row_labels, row_taint_class):
@@ -133,8 +133,9 @@ def sharded_compat_matrix(t: SchedulerTensors, mesh: Mesh):
             vids = row_labels
             masks = jnp.broadcast_to(mask_k_w[None, :, :], (vids.shape[0],) + mask_k_w.shape)
             ok = test_bit(masks, vids)
-            if zone_key >= 0:
-                ok = ok.at[:, zone_key].set(True)
+            for kk in dom_keys:
+                if kk >= 0:
+                    ok = ok.at[:, kk].set(True)
             return jnp.all(ok, axis=1) & taint_ok_c[row_taint_class]
 
         return jax.vmap(one)(pod_mask, pod_taint_ok)

@@ -10,10 +10,12 @@ at 50k pods against a ~0.8s solve.
 Checks (mirrors solver/validate.py's object-level rules in tensor space):
 - resource fit: per-slot total requests <= the basis row's allocatable;
 - requirement compatibility: every pod's label bitmask accepts its slot's
-  basis row, taints tolerated, slot zone-set intersects the pod's allowed
-  zones (requirements.go Compatible semantics via the interned vocabulary);
-- zone spread: per-group skew over final zone counts <= maxSkew, and
-  member slots committed to exactly one real zone;
+  basis row, taints tolerated, and for every dom key the pod constrains the
+  slot's domain set retains an allowed value (requirements.go Compatible
+  semantics via the interned vocabulary);
+- keyed-domain spread: per-group skew over final domain counts <= maxSkew,
+  and member slots committed to exactly one real domain of the group's key;
+- keyed-domain anti-affinity: at most one member per domain;
 - hostname spread / anti-affinity: per-slot member counts <= maxSkew (anti:
   <= 1), including counts from already-running pods on existing nodes.
 """
@@ -22,7 +24,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .encode import KIND_HOST_ANTI, KIND_HOST_SPREAD, KIND_ZONE_SPREAD
+from .encode import KIND_DOM_ANTI, KIND_DOM_SPREAD, KIND_HOST_ANTI, KIND_HOST_SPREAD
 
 # f32 row_alloc vs f64 totals: values are milli-CPU / MiB scaled, so 1e-3
 # absolute slack is far below one resource unit
@@ -31,7 +33,7 @@ _EPS = 1e-3
 _MAX_ERRORS = 12
 
 
-def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zoneset: np.ndarray) -> list[str]:
+def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_domset: np.ndarray) -> list[str]:
     """Returns a list of violations (empty = the placement is sound)."""
     errors: list[str] = []
     P = enc.n_pods
@@ -40,7 +42,7 @@ def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zone
     sig = np.asarray(enc.sig_of_pod)
     assignment = np.asarray(assignment)
     slot_basis = np.asarray(slot_basis)
-    slot_zoneset = np.asarray(slot_zoneset)
+    slot_domset = np.asarray(slot_domset)
     N = slot_basis.shape[0]
     valid = assignment >= 0
     if not valid.any():
@@ -70,6 +72,9 @@ def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zone
     # -- requirement compatibility -------------------------------------------
     # compat depends only on the (signature, slot) pair, and placements are
     # replica-heavy: thousands of unique pairs stand in for 50k pods
+    D = enc.n_doms
+    Kd = len(enc.dom_key_names)
+    dko = np.asarray(enc.dom_key_of)
     pair_key = psig.astype(np.int64) * N + slots
     _, uidx = np.unique(pair_key, return_index=True)
     usig, uslot, urow = psig[uidx], slots[uidx], rows[uidx]
@@ -79,12 +84,17 @@ def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zone
     masks = enc.sig_mask[usig]  # [U, K, W] uint32
     gathered = np.take_along_axis(masks, word[:, :, None], axis=2)[:, :, 0]
     ok = ((gathered >> bit) & 1).astype(bool)  # [U, K]
-    if enc.zone_key_id >= 0:
-        ok[:, enc.zone_key_id] = True  # zones checked via the zone-set below
+    for kid in enc.dom_vocab_keys:
+        if kid >= 0:
+            ok[:, kid] = True  # dom keys checked via the domain sets below
     label_bad = ~ok.all(axis=1)
     taint_bad = ~enc.sig_taint_ok[usig, enc.row_taint_class[urow]]
-    zone_bad = ~(slot_zoneset[uslot] & enc.sig_zone_allowed[usig]).any(axis=1)
-    for name, bad in (("requirements", label_bad), ("taints", taint_bad), ("zone", zone_bad)):
+    key_onehot = (dko[None, :] == np.arange(Kd)[:, None]).astype(np.int64)  # [Kd, D]
+    sig_restrict = enc.sig_restrict
+    inter = (slot_domset[uslot] & enc.sig_dom_allowed[usig]).astype(np.int64)  # [U, D]
+    perkey = inter @ key_onehot.T  # [U, Kd]
+    dom_bad = ((perkey <= 0) & sig_restrict[usig]).any(axis=1)
+    for name, bad in (("requirements", label_bad), ("taints", taint_bad), ("domain", dom_bad)):
         if bad.any():
             bad_keys = (usig[bad].astype(np.int64) * N + uslot[bad])[:_MAX_ERRORS]
             pidx = np.nonzero(valid)[0][np.isin(pair_key, bad_keys)]
@@ -95,31 +105,64 @@ def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zone
     G = enc.n_groups
     if G:
         member = enc.sig_member[psig]  # [Pv, G]
-        zone_groups = enc.group_kind == KIND_ZONE_SPREAD
-        host_groups = ~zone_groups
+        dom_groups = (enc.group_kind == KIND_DOM_SPREAD) | (enc.group_kind == KIND_DOM_ANTI)
+        host_groups = ~dom_groups
+        dom_real = np.arange(D) >= Kd  # per-key sentinels occupy the first Kd ids
 
-        if zone_groups.any():
-            zs = slot_zoneset[slots]  # [Pv, Z]
-            n_real = zs[:, 1:].sum(axis=1)  # zone 0 = "no zone"
-            zone_of_slot = 1 + np.argmax(zs[:, 1:], axis=1)
-            zmember = member[:, zone_groups].any(axis=1)
-            uncommitted = zmember & (n_real != 1)
+        for g in np.nonzero(dom_groups)[0]:
+            k = int(enc.group_dom_key[g])
+            keydoms = (dko == k) & dom_real
+            zs = slot_domset[slots] & keydoms[None, :]  # [Pv, D]
+            n_real = zs.sum(axis=1)
+            dom_of_slot = np.argmax(zs, axis=1)
+            sel_member = member[:, g]
+            if enc.group_kind[g] == KIND_DOM_ANTI:
+                # late-committal anti: member slots need not commit to one
+                # domain, but their possible-domain sets must be pairwise
+                # disjoint, disjoint from already-counted domains, nonempty,
+                # and each slot hosts at most one member
+                mslots = slots[sel_member]
+                if (n_real[sel_member] == 0).any():
+                    pidx = np.nonzero(valid)[0][sel_member & (n_real == 0)]
+                    for i in pidx[:_MAX_ERRORS]:
+                        errors.append(f"pod {enc.pods[i].key()}: anti-affinity member on slot with no possible domain")
+                if mslots.size:
+                    uniq, cnts = np.unique(mslots, return_counts=True)
+                    for j in uniq[cnts > 1][:_MAX_ERRORS]:
+                        errors.append(f"group {int(g)}: multiple anti-affinity members on slot {int(j)}")
+                    cover = (enc.counts_dom_init[g] > 0).astype(np.int64) * keydoms
+                    cover = cover + (slot_domset[uniq] & keydoms[None, :]).sum(axis=0)
+                    for d in np.nonzero(cover > 1)[0][:_MAX_ERRORS]:
+                        errors.append(
+                            f"group {int(g)}: domain anti-affinity overlap in {enc.dom_values[int(d)]!r}"
+                        )
+                continue
+            uncommitted = sel_member & (n_real != 1)
             if uncommitted.any():
                 pidx = np.nonzero(valid)[0][uncommitted]
                 for i in pidx[:_MAX_ERRORS]:
-                    errors.append(f"pod {enc.pods[i].key()}: zone-spread member on slot without a committed zone")
-            Z = enc.n_zones
-            for g in np.nonzero(zone_groups)[0]:
-                sel = member[:, g] & (n_real == 1)
-                counts = enc.counts_zone_init[g].astype(np.int64) + np.bincount(zone_of_slot[sel], minlength=Z)
-                observed = counts[1:][counts[1:] > 0]
-                if observed.size and observed.max() - observed.min() > enc.group_skew[g]:
-                    errors.append(
-                        f"group {int(g)}: zone skew {int(observed.max() - observed.min())} > {int(enc.group_skew[g])}"
-                    )
+                    errors.append(f"pod {enc.pods[i].key()}: domain-group member on slot without a committed domain")
+            sel = sel_member & (n_real == 1)
+            counts = enc.counts_dom_init[g].astype(np.int64) + np.bincount(dom_of_slot[sel], minlength=D)
+            counts = counts * keydoms  # only this key's real domains
+            # the observed-skew bound holds under minDomains force-zero too:
+            # every placement is capped at zmin+skew with zmin >= 0, so
+            # positive-count domains can never spread wider than skew (given
+            # the initial counts respected it)
+            observed = counts[counts > 0]
+            if observed.size and observed.max() - observed.min() > enc.group_skew[g]:
+                errors.append(
+                    f"group {int(g)}: domain skew {int(observed.max() - observed.min())} > {int(enc.group_skew[g])}"
+                )
 
         if host_groups.any():
             for g in np.nonzero(host_groups)[0]:
+                # the cap binds only pods that DECLARE the constraint; groups
+                # whose selector also matches non-declaring pods may
+                # legitimately exceed it on slots those pods stack onto
+                # (host semantics: owners gate, members count)
+                if not (enc.sig_member[:, g] == enc.sig_owner[:, g]).all():
+                    continue
                 counts = np.bincount(slots[member[:, g]], minlength=N).astype(np.int64)
                 n_ex = enc.n_existing
                 if n_ex:

@@ -8,8 +8,14 @@ vocabulary. This module builds that vocabulary and lowers:
 - candidate "rows" (existing nodes + (template x instance type x offering))
   to label-value-id vectors, allocatable vectors, prices, taint classes;
 - pods to request vectors and packed requirement bitmasks;
-- the supported topology constraint families (zonal spread, hostname spread,
-  hostname anti-affinity) to group membership matrices and count tensors.
+- the supported topology constraint families (keyed topology spread over any
+  non-hostname label — zone, capacity-type, custom keys — hostname spread,
+  hostname and keyed required anti-affinity) to group membership matrices and
+  count tensors over a KEYED DOMAIN axis: each domain is an interned
+  (topology key, value) pair with a per-key "absent" sentinel, and every
+  group carries its registered-domain universe discovered from
+  NodePool x InstanceType requirements exactly like the host oracle
+  (topology.py _build_domain_groups; reference topology.go:105-143).
 
 Pods are grouped by SPEC SIGNATURE before any heavy work: real pending sets
 are deployment replicas, so the expensive per-pod lowering (Quantity
@@ -48,9 +54,13 @@ from ..utils.quantity import Quantity
 
 ABSENT = 0  # reserved value id per key: "row does not define this label"
 
-KIND_ZONE_SPREAD = 0
+KIND_DOM_SPREAD = 0  # spread over a keyed domain axis (zone, capacity-type, ...)
 KIND_HOST_SPREAD = 1
 KIND_HOST_ANTI = 2
+KIND_DOM_ANTI = 3  # required anti-affinity over a non-hostname topology key
+
+# legacy alias: zone is dom-key 0, so zone spread is the kind-0 special case
+KIND_ZONE_SPREAD = KIND_DOM_SPREAD
 
 _Q0 = Quantity(0)
 
@@ -105,7 +115,7 @@ class EncodedSnapshot:
     row_alloc: np.ndarray  # [Nrows, R] f32
     row_price: np.ndarray  # [Nrows] f32
     row_labels: np.ndarray  # [Nrows, K] i32 (value id, ABSENT=0)
-    row_zone: np.ndarray  # [Nrows] i32 zone domain id, -1 if none
+    row_dom: np.ndarray  # [Nrows, Kd] i32 domain id per dom key (sentinel if absent)
     row_pool_rank: np.ndarray  # [Nrows] i32 (0 = highest weight; existing = -1)
     row_taint_class: np.ndarray  # [Nrows] i32
     row_meta: list  # per row: ("existing", state_node) | ("offering", template, it, offering)
@@ -116,8 +126,9 @@ class EncodedSnapshot:
     sig_req: np.ndarray  # [S, R] f32
     sig_mask: np.ndarray  # [S, K, W] uint32
     sig_taint_ok: np.ndarray  # [S, C] bool
-    sig_zone_allowed: np.ndarray  # [S, Z] bool
-    sig_member: np.ndarray  # [S, G] bool
+    sig_dom_allowed: np.ndarray  # [S, D] bool
+    sig_member: np.ndarray  # [S, G] bool — COUNTED by the group (selector match)
+    sig_owner: np.ndarray  # [S, G] bool — CONSTRAINED by the group (declares it)
     sig_requirements: list  # [S] Requirements (strict, for decode)
     sig_requests: list  # [S] ResourceList (for decode)
     req_class_of_sig: np.ndarray  # [S] i32 — sigs sharing a Requirements class
@@ -133,14 +144,22 @@ class EncodedSnapshot:
     existing_port_wild: np.ndarray  # [n_existing, P1]
     existing_port_spec: np.ndarray  # [n_existing, P2]
 
+    # keyed domain axis: each domain is an interned (dom key, value) pair;
+    # dom key 0 is always the zone label; the first Kd ids are the per-key
+    # "absent" sentinels (so NO_ZONE == 0 when zone is the only key)
+    n_doms: int
+    dom_values: list[str]  # [D] value string ("" for sentinels)
+    dom_key_of: np.ndarray  # [D] i32 dom-key index
+    dom_key_names: list[str]  # [Kd] label key per dom key
+    dom_vocab_keys: tuple  # [Kd] vocab key id per dom key (-1 if never interned)
+    rank_domset: np.ndarray  # [Q, D] bool — domains a template rank can produce
     # topology groups
-    n_zones: int
-    zone_names: list[str]
-    rank_zoneset: np.ndarray  # [Q, Z] bool — zones each template offers
-    zone_key_id: int
     group_kind: np.ndarray  # [G] i32
     group_skew: np.ndarray  # [G] i32
-    counts_zone_init: np.ndarray  # [G, Z] i32
+    group_dom_key: np.ndarray  # [G] i32 dom-key index (-1 for hostname kinds)
+    group_min_domains: np.ndarray  # [G] i32 (0 = unset)
+    group_registered: np.ndarray  # [G, D] bool — the group's domain universe
+    counts_dom_init: np.ndarray  # [G, D] i32
     counts_host_existing: np.ndarray  # [G, n_existing] i32
 
     fallback_reasons: list[str] = field(default_factory=list)
@@ -178,12 +197,29 @@ class EncodedSnapshot:
         return self.sig_taint_ok[self.sig_of_pod]
 
     @property
-    def pod_zone_allowed(self) -> np.ndarray:  # [P, Z]
-        return self.sig_zone_allowed[self.sig_of_pod]
+    def pod_dom_allowed(self) -> np.ndarray:  # [P, D]
+        return self.sig_dom_allowed[self.sig_of_pod]
 
     @property
     def member(self) -> np.ndarray:  # [P, G]
         return self.sig_member[self.sig_of_pod]
+
+    @property
+    def owner(self) -> np.ndarray:  # [P, G]
+        return self.sig_owner[self.sig_of_pod]
+
+    @property
+    def sig_restrict(self) -> np.ndarray:
+        """[S, Kd] bool: signature constrains dom key k (some k-domain, incl.
+        the sentinel, is disallowed). Computed once per encode and shared by
+        make_tensors, build_items, and fast_validate."""
+        cached = getattr(self, "_sig_restrict", None)
+        if cached is None:
+            Kd = len(self.dom_key_names)
+            dko = np.asarray(self.dom_key_of)
+            cached = np.stack([~self.sig_dom_allowed[:, dko == k].all(axis=1) for k in range(Kd)], axis=1)
+            object.__setattr__(self, "_sig_restrict", cached)
+        return cached
 
 
 # -- pod spec signatures -------------------------------------------------------
@@ -298,17 +334,30 @@ def check_capability(snap, pods=None) -> list[str]:
         if reqs.has_min_values():
             reasons.append("nodepool uses minValues")
             break
-    for pod in pods if pods is not None else snap.pods:
+    rep_pods = list(pods if pods is not None else snap.pods)
+    # required anti-affinity is modeled as symmetric per-domain groups
+    # (members = pods matched by the selector); that is exact only when the
+    # declaring set and the matched set coincide (pure self-anti-affinity,
+    # the deployment-replicas case). Asymmetric terms stay host-side. The
+    # same holds for KEYED spread constraints: the host counts matched
+    # non-declaring pods without constraining them, which the domain kernel
+    # can express only when matched == declaring. (Hostname groups are exact
+    # either way via the owner/member mask split.)
+    reasons.extend(_anti_symmetry_reasons(rep_pods))
+    reasons.extend(_spread_symmetry_reasons(rep_pods))
+    if reasons:
+        return reasons
+    for pod in rep_pods:
         aff = pod.spec.affinity
         if aff is not None:
             if aff.pod_affinity_required or aff.pod_affinity_preferred:
                 reasons.append(f"{pod.key()}: pod affinity")
                 break
-            if any(t.topology_key != wk.HOSTNAME_LABEL_KEY for t in aff.pod_anti_affinity_required):
-                reasons.append(f"{pod.key()}: non-hostname anti-affinity")
-                break
             if aff.pod_anti_affinity_preferred:
                 reasons.append(f"{pod.key()}: preferred anti-affinity")
+                break
+            if any(t.namespaces or t.namespace_selector is not None for t in aff.pod_anti_affinity_required):
+                reasons.append(f"{pod.key()}: anti-affinity with explicit namespaces")
                 break
             na = aff.node_affinity
             if not respect and na is not None and (na.preferred or len(na.required) > 1):
@@ -316,18 +365,36 @@ def check_capability(snap, pods=None) -> list[str]:
                 # the conservative window there
                 reasons.append(f"{pod.key()}: relaxable node affinity")
                 break
+        used_keys = {t.topology_key for t in pod.spec.topology_spread_constraints if t.topology_key != wk.HOSTNAME_LABEL_KEY}
+        dom_anti_terms = [t for t in (aff.pod_anti_affinity_required if aff else []) if t.topology_key != wk.HOSTNAME_LABEL_KEY]
+        if aff is not None:
+            used_keys |= {t.topology_key for t in dom_anti_terms}
+        if len(used_keys) > 1:
+            # the pack scan commits one domain key per placement batch
+            reasons.append(f"{pod.key()}: topology constraints over multiple domain keys")
+            break
+        if dom_anti_terms and (
+            any(t.topology_key != wk.HOSTNAME_LABEL_KEY for t in pod.spec.topology_spread_constraints)
+            or len({(t.topology_key, _sel_key(t.label_selector)) for t in dom_anti_terms}) > 1
+        ):
+            # keyed anti-affinity uses the reference's block-all-possible-
+            # domains semantics (topology.go Record for anti), which the
+            # kernel models as a dedicated sequential path — one dom group
+            # per item there
+            reasons.append(f"{pod.key()}: combined keyed anti-affinity constraints")
+            break
         for tsc in pod.spec.topology_spread_constraints:
             if tsc.when_unsatisfiable != "DoNotSchedule" and not respect:
                 reasons.append(f"{pod.key()}: ScheduleAnyway spread")
                 break
-            if tsc.topology_key not in (wk.ZONE_LABEL_KEY, wk.HOSTNAME_LABEL_KEY):
-                reasons.append(f"{pod.key()}: spread key {tsc.topology_key}")
+            if tsc.node_taints_policy == "Honor":
+                # taint-filtered domain registration/counting stays host-side
+                reasons.append(f"{pod.key()}: spread taint policy")
                 break
-            if tsc.min_domains is not None or tsc.node_taints_policy == "Honor":
-                reasons.append(f"{pod.key()}: spread policies")
-                break
-            if tsc.node_affinity_policy == "Honor" and (pod.spec.node_selector or (aff and aff.node_affinity)):
-                # node-filtered counting not tensorized yet
+            if tsc.topology_key != wk.HOSTNAME_LABEL_KEY and _node_filter_unexpressible(pod, tsc):
+                # the kernel's per-item allowed-domain masking IS the Honor
+                # node filter when the filter only constrains the spread's own
+                # topology key; anything wider stays host-side
                 reasons.append(f"{pod.key()}: node-filtered spread counting")
                 break
         else:
@@ -368,6 +435,102 @@ def check_capability(snap, pods=None) -> list[str]:
     return reasons
 
 
+def _node_filter_unexpressible(pod, tsc) -> bool:
+    """True when the spread's effective Honor node-affinity filter
+    (topologynodefilter.go; defaults: affinity=Honor) constrains anything the
+    per-item allowed-domain mask cannot express — keys other than the
+    constraint's own topology key, or OR'd affinity terms touching it."""
+    if (tsc.node_affinity_policy or "Honor") != "Honor":
+        return False
+    key = tsc.topology_key
+    selector_keys = set(pod.spec.node_selector or ())
+    if selector_keys - {key}:
+        return True
+    aff = pod.spec.affinity
+    na = aff.node_affinity if aff is not None else None
+    if na is None or not na.required:
+        return False
+    term_keys = [{e["key"] for e in term} for term in na.required]
+    if any(ks - {key} for ks in term_keys):
+        return True
+    # several OR'd terms on the key itself: the filter is their union while
+    # the tier-0 mask follows only the first term
+    return len(na.required) > 1 and any(key in ks for ks in term_keys)
+
+
+def _anti_symmetry_reasons(rep_pods) -> list[str]:
+    """Required anti-affinity terms whose declaring set != matched set (over
+    the solve's unique pod shapes): the symmetric group model would
+    over-constrain matched-but-not-declaring pods."""
+    declared: dict[tuple, tuple[set[int], object]] = {}
+    for s, pod in enumerate(rep_pods):
+        aff = pod.spec.affinity
+        if aff is None:
+            continue
+        for term in aff.pod_anti_affinity_required:
+            ident = (term.topology_key, _sel_key(term.label_selector), pod.metadata.namespace)
+            entry = declared.get(ident)
+            if entry is None:
+                declared[ident] = ({s}, term.label_selector)
+            else:
+                entry[0].add(s)
+    reasons = []
+    for (key, _selk, ns), (declarers, selector) in declared.items():
+        matched = {
+            s
+            for s, pod in enumerate(rep_pods)
+            if pod.metadata.namespace == ns and selector is not None and match_label_selector(selector, pod.metadata.labels)
+        }
+        if matched != declarers:
+            reasons.append(f"asymmetric anti-affinity (key {key}): selector matches pods that do not declare it")
+    return reasons
+
+
+def _spread_symmetry_reasons(rep_pods) -> list[str]:
+    """Non-hostname spread constraints whose declaring set != matched set
+    (over the solve's unique pod shapes): the host counts matched
+    non-declaring pods without constraining them, which the keyed-domain
+    kernel cannot express."""
+    declared: dict[tuple, tuple[set[int], object]] = {}
+    for s, pod in enumerate(rep_pods):
+        for tsc in pod.spec.topology_spread_constraints:
+            if tsc.topology_key == wk.HOSTNAME_LABEL_KEY:
+                continue
+            ident = (tsc.topology_key, _sel_key(tsc.label_selector), pod.metadata.namespace)
+            entry = declared.get(ident)
+            if entry is None:
+                declared[ident] = ({s}, tsc.label_selector)
+            else:
+                entry[0].add(s)
+    reasons = []
+    for (key, _selk, ns), (declarers, selector) in declared.items():
+        matched = {
+            s
+            for s, pod in enumerate(rep_pods)
+            if pod.metadata.namespace == ns and selector is not None and match_label_selector(selector, pod.metadata.labels)
+        }
+        if matched != declarers:
+            reasons.append(f"asymmetric spread membership (key {key}): selector matches pods that do not declare it")
+    return reasons
+
+
+def _dom_keys_for(rep_pods) -> list[str]:
+    """The snapshot's domain keys: zone always (dom key 0), plus every
+    non-hostname topology key referenced by a spread constraint or required
+    anti-affinity term."""
+    keys: set[str] = set()
+    for pod in rep_pods:
+        for tsc in pod.spec.topology_spread_constraints:
+            if tsc.topology_key != wk.HOSTNAME_LABEL_KEY:
+                keys.add(tsc.topology_key)
+        aff = pod.spec.affinity
+        if aff is not None:
+            for term in aff.pod_anti_affinity_required:
+                if term.topology_key != wk.HOSTNAME_LABEL_KEY:
+                    keys.add(term.topology_key)
+    return [wk.ZONE_LABEL_KEY] + sorted(keys - {wk.ZONE_LABEL_KEY})
+
+
 @dataclass
 class _RowArtifacts:
     """Everything the row side of one encode produced — reusable while the
@@ -376,20 +539,24 @@ class _RowArtifacts:
     solves: pod-side interning only appends, so row value ids stay stable."""
 
     vocab: Vocabulary
-    zone_names: list
-    zone_ids: dict
+    dom_key_names: list  # [Kd] label keys (index 0 = zone)
+    dom_values: list  # [D] value strings ("" = per-key sentinel)
+    dom_key_of_l: list  # [D] dom-key index per domain
+    dom_ids: list  # [Kd] dict value -> domain id
+    dom_sentinel: list  # [Kd] sentinel domain id per key
+    universe_dom: np.ndarray  # [D] bool — NodePool x IT discovered universe
     taint_classes: dict
     taint_sets: list
     templates: list
     row_alloc: np.ndarray
     row_price: np.ndarray
     row_labels0: np.ndarray  # at the vocab width when rows were built
-    row_zone: np.ndarray
+    row_dom: np.ndarray  # [Nrows, Kd]
     row_pool_rank: np.ndarray
     row_taint_class: np.ndarray
     row_meta: list
     n_existing: int
-    rank_zoneset: np.ndarray
+    rank_domset: np.ndarray  # [Q, D]
     state_nodes: list
     # vocab width at build time: pod-side interning grows the shared vocab
     # monotonically, so reuse is bounded (see EncodeCache growth guard)
@@ -431,11 +598,12 @@ class EncodeCache:
         return sig
 
 
-def _row_cache_key(snap, rnames: list[str]) -> tuple:
+def _row_cache_key(snap, rnames: list[str], dom_keys: list[str]) -> tuple:
     return (
         # epoch is a process-unique token (id() could recycle after GC)
         getattr(snap.cluster, "epoch", None) or id(snap.cluster),
         snap.cluster.generation,
+        tuple(dom_keys),
         # the SNAPSHOT's node selection, not just cluster content: the
         # disruption simulation filters candidates out of state_nodes without
         # touching the cluster (helpers.py simulate_scheduling)
@@ -447,20 +615,38 @@ def _row_cache_key(snap, rnames: list[str]) -> tuple:
     )
 
 
-def _build_rows(snap, rnames: list[str], rl_to_vec) -> _RowArtifacts:
-    """The row side of encode: vocab/zone/taint interning, weight-ordered
+def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _RowArtifacts:
+    """The row side of encode: vocab/domain/taint interning, weight-ordered
     templates with daemon-overhead groups, and one row per existing node and
     per (template x instance type x available offering)."""
     vocab = Vocabulary()
 
-    zone_names: list[str] = [""]  # index 0 reserved: "row has no zone label"
-    zone_ids: dict[str, int] = {"": 0}
+    # keyed domain vocabulary: per-key sentinels first (key order), so the
+    # zone sentinel is id 0 (NO_ZONE) and a zone-only snapshot is laid out
+    # exactly as the single-key encoding was
+    Kd = len(dom_keys)
+    dom_values: list[str] = []
+    dom_key_of_l: list[int] = []
+    dom_ids: list[dict[str, int]] = []
+    dom_sentinel: list[int] = []
+    for k in range(Kd):
+        dom_sentinel.append(len(dom_values))
+        dom_values.append("")
+        dom_key_of_l.append(k)
+        dom_ids.append({})
+
+    def dom_id(k: int, v: str) -> int:
+        ids = dom_ids[k]
+        d = ids.get(v)
+        if d is None:
+            d = len(dom_values)
+            ids[v] = d
+            dom_values.append(v)
+            dom_key_of_l.append(k)
+        return d
 
     def zone_id(z: str) -> int:
-        if z not in zone_ids:
-            zone_ids[z] = len(zone_names)
-            zone_names.append(z)
-        return zone_ids[z]
+        return dom_id(0, z)
 
     taint_classes: dict[tuple, int] = {}
     taint_sets: list[list] = []
@@ -484,7 +670,7 @@ def _build_rows(snap, rnames: list[str], rl_to_vec) -> _RowArtifacts:
             t.instance_type_options = its
             templates.append(t)
 
-    row_alloc_l, row_price_l, row_labels_l, row_zone_l = [], [], [], []
+    row_alloc_l, row_price_l, row_labels_l, row_dom_l = [], [], [], []
     row_rank_l, row_taint_l, row_meta = [], [], []
 
     def intern_labels(labels: dict[str, str]) -> dict[int, int]:
@@ -498,16 +684,28 @@ def _build_rows(snap, rnames: list[str], rl_to_vec) -> _RowArtifacts:
         headroom = res.subtract(res.requests_for_pods(daemons), sn.total_daemon_requests())
         headroom = {k: v for k, v in headroom.items() if v.milli > 0}
         remaining = res.subtract(remaining, headroom)
+        lbls = sn.labels()
         row_alloc_l.append(rl_to_vec(remaining))
         row_price_l.append(0.0)
-        row_labels_l.append(intern_labels(sn.labels()))
-        z = sn.labels().get(wk.ZONE_LABEL_KEY)
-        row_zone_l.append(zone_id(z) if z else 0)
+        row_labels_l.append(intern_labels(lbls))
+        row_dom_l.append([dom_id(k, lbls[key]) if lbls.get(key) else dom_sentinel[k] for k, key in enumerate(dom_keys)])
         row_rank_l.append(-1)
         row_taint_l.append(taint_class(sn.taints()))
         row_meta.append(("existing", sn))
 
     n_existing = len(row_meta)
+
+    # per-rank domain sets for custom keys come from the same NodePool x IT
+    # requirement discovery the host oracle uses (_build_domain_groups);
+    # zones additionally come from the concrete offering rows below
+    n_ranks = max(len(templates), 1)
+    rank_dom_vals: list[list[set[int]]] = [[set() for _ in range(Kd)] for _ in range(n_ranks)]
+
+    def _req_in_values(reqs, key: str):
+        r = reqs.get(key) if hasattr(reqs, "get") else None
+        if r is not None and r.operator() == Operator.IN:
+            return list(r.values)
+        return []
 
     for rank, t in enumerate(templates):
         groups = _compute_daemon_overhead_groups(t, snap.daemonset_pods)
@@ -517,11 +715,26 @@ def _build_rows(snap, rnames: list[str], rl_to_vec) -> _RowArtifacts:
                 overhead_by_it[id(it)] = g.daemon_overhead
         tmpl_label_ids = intern_labels(t.labels)
         tclass = taint_class(t.taints)
+        tmpl_dom = [t.labels.get(key) for key in dom_keys]
         for it in t.instance_type_options:
             it_label_ids = dict(tmpl_label_ids)
             for key, r in it.requirements.items():
                 if r.operator() == Operator.IN and len(r.values) == 1:
                     it_label_ids[vocab.key_id(key)] = vocab.value_id(key, r.any())
+            it_dom = list(tmpl_dom)
+            if Kd > 1:
+                # template requirements NARROW instance-type domains — the
+                # host intersects base with it.requirements before reading
+                # values (buildDomainGroups: "zones from an instance type
+                # don't expand the universe of valid domains")
+                combined = t.requirements.copy()
+                combined.add(*it.requirements.values())
+                for k in range(1, Kd):
+                    vs = _req_in_values(combined, dom_keys[k])
+                    for v in vs:
+                        rank_dom_vals[rank][k].add(dom_id(k, v))
+                    if len(vs) == 1:
+                        it_dom[k] = vs[0]
             alloc = res.subtract(it.allocatable(), overhead_by_it.get(id(it), {}))
             alloc_vec = rl_to_vec({k: v for k, v in alloc.items() if v.milli > 0})
             for o in it.offerings:
@@ -533,11 +746,17 @@ def _build_rows(snap, rnames: list[str], rl_to_vec) -> _RowArtifacts:
                 for key, r in o.requirements.items():
                     if r.operator() == Operator.IN and len(r.values) == 1:
                         labels_o[vocab.key_id(key)] = vocab.value_id(key, r.any())
+                o_dom = list(it_dom)
+                z = o.zone()
+                o_dom[0] = z if z else None
+                for k in range(1, Kd):
+                    vs = _req_in_values(o.requirements, dom_keys[k])
+                    if len(vs) == 1:
+                        o_dom[k] = vs[0]
                 row_alloc_l.append(alloc_vec)
                 row_price_l.append(o.price)
                 row_labels_l.append(labels_o)
-                z = o.zone()
-                row_zone_l.append(zone_id(z) if z else 0)
+                row_dom_l.append([dom_id(k, v) if v else dom_sentinel[k] for k, v in enumerate(o_dom)])
                 row_rank_l.append(rank)
                 row_taint_l.append(tclass)
                 row_meta.append(("offering", t, it, o))
@@ -548,31 +767,76 @@ def _build_rows(snap, rnames: list[str], rl_to_vec) -> _RowArtifacts:
     for i, lbl in enumerate(row_labels_l):
         for kid, vid in lbl.items():
             row_labels0[i, kid] = vid
+    row_dom = (
+        np.array(row_dom_l, dtype=np.int32) if row_dom_l else np.zeros((0, Kd), np.int32)
+    )
 
-    # zones offered per template rank
-    Z = len(zone_names)
-    n_ranks = max(len(templates), 1)
-    rank_zoneset = np.zeros((n_ranks, Z), dtype=bool)
+    # registered-domain universe per key, mirroring the host's
+    # _build_domain_groups: per (NodePool, InstanceType) the base template
+    # requirements INTERSECT the instance type's before values register
+    # ("zones from an instance type don't expand the universe of valid
+    # domains"), plus the base-only pass; values register even when no row
+    # carries them — an empty registered domain pulls the spread minimum
+    # down host-side, and must do the same on-device
+    by_name = {p.metadata.name: p for p in snap.node_pools}
+    universe_ids: set[int] = set()
+    for np_name, its in snap.instance_types.items():
+        pool = by_name.get(np_name)
+        if pool is None:
+            continue
+        base = Requirements.from_node_selector_terms(pool.spec.template.requirements)
+        base.add(*Requirements.from_labels(pool.spec.template.labels).values())
+        for k in range(Kd):
+            for v in _req_in_values(base, dom_keys[k]):
+                universe_ids.add(dom_id(k, v))
+        for it in its:
+            combined = base.copy()
+            combined.add(*it.requirements.values())
+            for k in range(Kd):
+                for v in _req_in_values(combined, dom_keys[k]):
+                    universe_ids.add(dom_id(k, v))
+
+    # domain axis is closed now
+    D = len(dom_values)
+    universe_dom = np.zeros(D, dtype=bool)
+    for d in universe_ids:
+        universe_dom[d] = True
+
+    rank_domset = np.zeros((n_ranks, D), dtype=bool)
     for i in range(n_existing, n_rows):
-        rank_zoneset[row_rank_l[i], row_zone_l[i]] = True
+        rank_domset[row_rank_l[i], row_dom[i, 0]] = True  # zones: concrete offerings
+    for rank in range(len(templates)):
+        for k in range(1, Kd):
+            vals = rank_dom_vals[rank][k]
+            if vals:
+                for d in vals:
+                    rank_domset[rank, d] = True
+            else:
+                # template rank carries no requirement on this key: a fresh
+                # node will simply lack the label
+                rank_domset[rank, dom_sentinel[k]] = True
 
     R = len(rnames)
     return _RowArtifacts(
         vocab=vocab,
-        zone_names=zone_names,
-        zone_ids=zone_ids,
+        dom_key_names=list(dom_keys),
+        dom_values=dom_values,
+        dom_key_of_l=dom_key_of_l,
+        dom_ids=dom_ids,
+        dom_sentinel=dom_sentinel,
+        universe_dom=universe_dom,
         taint_classes=taint_classes,
         taint_sets=taint_sets,
         templates=templates,
         row_alloc=np.stack(row_alloc_l) if row_alloc_l else np.zeros((0, R), np.float32),
         row_price=np.array(row_price_l, dtype=np.float32),
         row_labels0=row_labels0,
-        row_zone=np.array(row_zone_l, dtype=np.int32),
+        row_dom=row_dom,
         row_pool_rank=np.array(row_rank_l, dtype=np.int32),
         row_taint_class=np.array(row_taint_l, dtype=np.int32),
         row_meta=row_meta,
         n_existing=n_existing,
-        rank_zoneset=rank_zoneset,
+        rank_domset=rank_domset,
         state_nodes=state_nodes,
         built_n_keys=vocab.n_keys,
         built_vmax=vocab.max_values(),
@@ -637,10 +901,11 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         return v
 
     # -- row side: cached across solves on the cluster generation -------------
+    dom_keys = _dom_keys_for(rep_pods)
     rows: _RowArtifacts | None = None
     row_key: tuple | None = None
     if cache is not None:
-        row_key = _row_cache_key(snap, rnames)
+        row_key = _row_cache_key(snap, rnames, dom_keys)
         if cache.row_key == row_key:
             rows = cache.rows
             # growth guard: pod-side interning widens the shared vocab; churn
@@ -651,11 +916,14 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
             ):
                 rows = None
     if rows is None:
-        rows = _build_rows(snap, rnames, rl_to_vec)
+        rows = _build_rows(snap, rnames, rl_to_vec, dom_keys)
         if cache is not None:
             cache.row_key, cache.rows = row_key, rows
     vocab = rows.vocab
-    zone_names, zone_ids = rows.zone_names, rows.zone_ids
+    dom_values = rows.dom_values
+    dom_ids = rows.dom_ids
+    dom_sentinel = rows.dom_sentinel
+    dom_key_of = np.array(rows.dom_key_of_l, dtype=np.int32)
     taint_sets = rows.taint_sets
     templates = rows.templates
     state_nodes = rows.state_nodes
@@ -720,18 +988,20 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         for c, taints in enumerate(taint_sets):
             sig_taint_ok[s, c] = taints_tolerate_pod(taints, pod) is None
 
-    Z = len(zone_names)
-    sig_zone_allowed = np.ones((S, Z), dtype=bool)
+    D = len(dom_values)
+    sig_dom_allowed = np.ones((S, D), dtype=bool)
     for s, reqs in enumerate(sig_requirements):
-        if reqs.has(wk.ZONE_LABEL_KEY):
-            r = reqs.get(wk.ZONE_LABEL_KEY)
-            for z, zid in zone_ids.items():
-                if zid == 0:
-                    # "no zone label": zone is well-known, so an absent label is
-                    # only acceptable for complement operators
-                    sig_zone_allowed[s, 0] = r.operator() in (Operator.NOT_IN, Operator.DOES_NOT_EXIST)
-                else:
-                    sig_zone_allowed[s, zid] = r.has(z)
+        for k, key in enumerate(rows.dom_key_names):
+            if not reqs.has(key):
+                continue
+            r = reqs.get(key)
+            # per-key sentinel ("row carries no value"): acceptable only when
+            # the operator permits absence — the domain machinery is the
+            # strict handler for these keys (they are excluded from the label
+            # bitmask compat), so no well-known-undefined allowance here
+            sig_dom_allowed[s, dom_sentinel[k]] = r.operator() in (Operator.NOT_IN, Operator.DOES_NOT_EXIST)
+            for v, did in dom_ids[k].items():
+                sig_dom_allowed[s, did] = r.has(v)
 
     # -- host-port vocabulary + masks -----------------------------------------
     from ..scheduling.hostports import pod_host_ports
@@ -769,22 +1039,39 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     sig_port_any, sig_port_wild, sig_port_spec = port_masks(sig_ports, S)
     existing_port_any, existing_port_wild, existing_port_spec = port_masks(existing_ports, max(n_existing, 1))
 
-    zone_key_id = vocab.keys.get(wk.ZONE_LABEL_KEY, -1)
+    dom_vocab_keys = tuple(vocab.keys.get(key, -1) for key in rows.dom_key_names)
+    dom_key_idx = {key: k for k, key in enumerate(rows.dom_key_names)}
 
     # -- topology groups (identified from signature representatives) -----------
-    group_defs: dict[tuple, dict] = {}  # identity -> {kind, skew}
+    group_defs: dict[tuple, dict] = {}  # identity -> {kind, dom_key, skew, ...}
     memberships: list[tuple[int, tuple]] = []  # (sig idx, identity)
     for s, pod in enumerate(rep_pods):
         for tsc in pod.spec.topology_spread_constraints:
-            kind = KIND_ZONE_SPREAD if tsc.topology_key == wk.ZONE_LABEL_KEY else KIND_HOST_SPREAD
-            ident = (kind, tsc.max_skew, _sel_key(tsc.label_selector), pod.metadata.namespace)
-            group_defs.setdefault(ident, {"kind": kind, "skew": tsc.max_skew, "selector": tsc.label_selector, "ns": pod.metadata.namespace})
+            if tsc.topology_key == wk.HOSTNAME_LABEL_KEY:
+                # hostname minDomains never forces the min to zero host-side
+                # (_domain_min_count returns 0 for hostname regardless)
+                kind, dk, md = KIND_HOST_SPREAD, -1, 0
+            else:
+                kind, dk = KIND_DOM_SPREAD, dom_key_idx[tsc.topology_key]
+                md = tsc.min_domains or 0
+            ident = (kind, dk, tsc.max_skew, md, _sel_key(tsc.label_selector), pod.metadata.namespace)
+            group_defs.setdefault(
+                ident,
+                {"kind": kind, "dom_key": dk, "skew": tsc.max_skew, "min_domains": md, "selector": tsc.label_selector, "ns": pod.metadata.namespace},
+            )
             memberships.append((s, ident))
         aff = pod.spec.affinity
         if aff is not None:
             for term in aff.pod_anti_affinity_required:
-                ident = (KIND_HOST_ANTI, 0, _sel_key(term.label_selector), pod.metadata.namespace)
-                group_defs.setdefault(ident, {"kind": KIND_HOST_ANTI, "skew": 0, "selector": term.label_selector, "ns": pod.metadata.namespace})
+                if term.topology_key == wk.HOSTNAME_LABEL_KEY:
+                    kind, dk = KIND_HOST_ANTI, -1
+                else:
+                    kind, dk = KIND_DOM_ANTI, dom_key_idx[term.topology_key]
+                ident = (kind, dk, 0, 0, _sel_key(term.label_selector), pod.metadata.namespace)
+                group_defs.setdefault(
+                    ident,
+                    {"kind": kind, "dom_key": dk, "skew": 0, "min_domains": 0, "selector": term.label_selector, "ns": pod.metadata.namespace},
+                )
                 memberships.append((s, ident))
 
     idents = list(group_defs.keys())
@@ -792,10 +1079,16 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     G = len(idents)
     group_kind = np.array([group_defs[i]["kind"] for i in idents], dtype=np.int32) if G else np.zeros(0, np.int32)
     group_skew = np.array([group_defs[i]["skew"] for i in idents], dtype=np.int32) if G else np.zeros(0, np.int32)
+    group_dom_key = np.array([group_defs[i]["dom_key"] for i in idents], dtype=np.int32) if G else np.zeros(0, np.int32)
+    group_min_domains = np.array([group_defs[i]["min_domains"] for i in idents], dtype=np.int32) if G else np.zeros(0, np.int32)
+    # membership (COUNTED: the group's selector selects the pod) vs ownership
+    # (CONSTRAINED: the pod declares the constraint) — the host constrains
+    # only owners (_matching_topologies is_owned_by) while counting every
+    # selected pod. Hostname groups keep the split exactly; keyed-domain
+    # groups are in-window only when the two sets coincide
+    # (check_capability's symmetry rules).
     sig_member = np.zeros((S, G), dtype=bool)
-    # membership = the group's selector selects the pod (counting), which for
-    # these families equals the pod that declared it; also match other pods
-    # selected by the same selector
+    sig_owner = np.zeros((S, G), dtype=bool)
     for g, ident in enumerate(idents):
         d = group_defs[ident]
         for s, pod in enumerate(rep_pods):
@@ -803,10 +1096,11 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
                 sig_member[s, g] = True
     for s, ident in memberships:
         sig_member[s, gidx[ident]] = True
+        sig_owner[s, gidx[ident]] = True
 
     # initial counts from already-scheduled cluster pods (memoized on the
     # pod's (namespace, labels) — bound deployment replicas share labels)
-    counts_zone_init = np.zeros((G, Z), dtype=np.int32)
+    counts_dom_init = np.zeros((G, D), dtype=np.int32)
     counts_host_existing = np.zeros((G, max(n_existing, 1)), dtype=np.int32)
     if G:
         node_by_name = {sn.name(): j for j, sn in enumerate(state_nodes)}
@@ -833,14 +1127,35 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
             if node is None:
                 continue
             for g in gs:
-                if group_kind[g] == KIND_ZONE_SPREAD:
-                    z = node.metadata.labels.get(wk.ZONE_LABEL_KEY)
-                    if z is not None and z in zone_ids:
-                        counts_zone_init[g, zone_ids[z]] += 1
+                dk = int(group_dom_key[g])
+                if dk >= 0:
+                    v = node.metadata.labels.get(rows.dom_key_names[dk])
+                    if v is not None and v in dom_ids[dk]:
+                        counts_dom_init[g, dom_ids[dk][v]] += 1
                 else:
                     j = node_by_name.get(p.spec.node_name)
                     if j is not None:
                         counts_host_existing[g, j] += 1
+
+    # each group's registered-domain universe: the NodePool x IT discovery,
+    # plus existing nodes' label values (topology.py _count_domains /
+    # reference countDomains "capture new domain values from existing
+    # nodes"), plus every domain that already counts pods (record()).
+    # The per-group node filter reduces to the per-item allowed-domain mask
+    # for in-window snapshots (key-only filters), so registration here is
+    # unfiltered and za does the narrowing.
+    group_registered = np.zeros((G, D), dtype=bool)
+    if G:
+        Kd = len(rows.dom_key_names)
+        existing_dom = np.zeros(D, dtype=bool)
+        if n_existing:
+            exd = rows.row_dom[:n_existing].reshape(-1)
+            existing_dom[exd[exd >= Kd]] = True  # ids < Kd are sentinels
+        for g in range(G):
+            dk = int(group_dom_key[g])
+            if dk >= 0:
+                group_registered[g] = (rows.universe_dom | existing_dom) & (dom_key_of == dk)
+        group_registered |= counts_dom_init > 0
 
     return EncodedSnapshot(
         resource_names=rnames,
@@ -849,7 +1164,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         row_alloc=rows.row_alloc,
         row_price=rows.row_price,
         row_labels=row_labels,
-        row_zone=rows.row_zone,
+        row_dom=rows.row_dom,
         row_pool_rank=rows.row_pool_rank,
         row_taint_class=rows.row_taint_class,
         row_meta=row_meta,
@@ -858,8 +1173,9 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         sig_req=sig_req,
         sig_mask=sig_mask,
         sig_taint_ok=sig_taint_ok,
-        sig_zone_allowed=sig_zone_allowed,
+        sig_dom_allowed=sig_dom_allowed,
         sig_member=sig_member,
+        sig_owner=sig_owner,
         sig_requirements=sig_requirements,
         sig_requests=sig_requests,
         req_class_of_sig=req_class_of_sig,
@@ -869,13 +1185,18 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         existing_port_any=existing_port_any,
         existing_port_wild=existing_port_wild,
         existing_port_spec=existing_port_spec,
-        n_zones=Z,
-        zone_names=zone_names,
-        rank_zoneset=rows.rank_zoneset,
-        zone_key_id=zone_key_id,
+        n_doms=D,
+        dom_values=dom_values,
+        dom_key_of=dom_key_of,
+        dom_key_names=list(rows.dom_key_names),
+        dom_vocab_keys=dom_vocab_keys,
+        rank_domset=rows.rank_domset,
         group_kind=group_kind,
         group_skew=group_skew,
-        counts_zone_init=counts_zone_init,
+        group_dom_key=group_dom_key,
+        group_min_domains=group_min_domains,
+        group_registered=group_registered,
+        counts_dom_init=counts_dom_init,
         counts_host_existing=counts_host_existing,
         fallback_reasons=reasons,
         has_relaxable=respect and any(_is_relaxable(p) for p in rep_pods),

@@ -57,12 +57,15 @@ _REASON_FAMILIES = (
     ("relaxation required", "relaxation"),
     ("minValues", "min-values"),
     ("pod affinity", "pod-affinity"),
-    ("non-hostname anti-affinity", "non-hostname-anti-affinity"),
+    ("asymmetric anti-affinity", "asymmetric-anti-affinity"),
+    ("asymmetric spread membership", "asymmetric-spread-membership"),
+    ("combined keyed anti-affinity", "combined-keyed-anti-affinity"),
+    ("anti-affinity with explicit namespaces", "anti-affinity-namespaces"),
     ("preferred anti-affinity", "preferred-anti-affinity"),
     ("relaxable node affinity", "relaxable-node-affinity"),
     ("ScheduleAnyway", "schedule-anyway-spread"),
-    ("spread key", "non-zone-spread-key"),
-    ("spread policies", "spread-policies"),
+    ("multiple domain keys", "multi-domain-keys"),
+    ("spread taint policy", "spread-taint-policy"),
     ("node-filtered spread", "node-filtered-spread"),
     ("host ports", "host-ports"),
     ("PVC-backed volumes", "pvc-volumes"),
@@ -241,6 +244,14 @@ class TPUSolver:
             if not reservation_manager.capacity:
                 reservation_manager = None  # no reserved offerings anywhere
 
+        # per-dom-key vocab views for requirement pinning (zone is key 0)
+        dko = np.asarray(enc.dom_key_of)
+        Kd = len(enc.dom_key_names)
+        D = enc.n_doms
+        key_all_vals = [
+            {enc.dom_values[d] for d in range(Kd, D) if dko[d] == k} for k in range(Kd)
+        ]
+
         overhead_groups_cache: dict[int, list] = {}
         # per-slot work dedupes by SIGNATURE: pod requirements/requests lower
         # once per unique shape (encode.sig_*). The expensive per-slot pass —
@@ -289,21 +300,22 @@ class TPUSolver:
             claim.hostname = f"tpu-slot-{j}"
             claim.spec_requests = requests
 
-            # zone: pin only when the packer committed/narrowed the slot to a
-            # single zone (late committal — matches the FFD's topology narrowing)
-            zone_ids = tuple(int(z) for z in np.nonzero(slot_zoneset[j])[0] if z != 0)
+            # domains: pin a key only when the packer committed/narrowed the
+            # slot below the key's full universe (late committal — matches
+            # the FFD's topology narrowing); zone is dom key 0
+            dom_sig = tuple(int(d) for d in np.nonzero(slot_zoneset[j])[0])
             rc_key = tuple(sorted({int(rc_of_sig[s]) for s in sig_counts}))
-            rkey = (id(template), rc_key, zone_ids)
+            rkey = (id(template), rc_key, dom_sig)
             reqs = req_cache.get(rkey)
             if reqs is None:
                 reqs = Requirements()
                 reqs.add(*template.requirements.values())
                 for s in sorted(sig_counts):
                     reqs.add(*enc.sig_requirements[s].values())
-                zones = [enc.zone_names[z] for z in zone_ids]
-                template_zones = {z for z in enc.zone_names[1:]}
-                if zones and set(zones) != template_zones:
-                    reqs.add(Requirement(wk.ZONE_LABEL_KEY, "In", zones))
+                for k in range(Kd):
+                    vals = [enc.dom_values[d] for d in dom_sig if d >= Kd and dko[d] == k]
+                    if vals and set(vals) != key_all_vals[k]:
+                        reqs.add(Requirement(enc.dom_key_names[k], "In", vals))
                 req_cache[rkey] = reqs
             # copies: claims are mutated downstream (finalize drops hostname
             # reqs); a shared Requirements would couple sibling slots

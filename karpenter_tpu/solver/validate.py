@@ -71,53 +71,68 @@ def validate_results(snap, results) -> list[str]:
                 break
             usage.add(p.key(), ports)
 
-    # topology: spread skew and anti-affinity over the final placement
-    placements = []  # (pod, zone, host)
+    # topology: spread skew and anti-affinity over the final placement, for
+    # ANY topology key — a new claim's domain for a key is the single value
+    # its requirements pin (None while uncommitted); an existing node's is
+    # its label
+    def claim_domain(nc, key):
+        r = nc.requirements.get(key)
+        return r.any() if len(r.values) == 1 else None
+
+    placements = []  # (pod, domain_lookup, host)
     for nc in results.new_node_claims:
-        zone_req = nc.requirements.get(wk.ZONE_LABEL_KEY)
-        zone = zone_req.any() if len(zone_req.values) == 1 else None
+        dom = (lambda nc_: lambda key: claim_domain(nc_, key))(nc)
         for p in nc.pods:
-            placements.append((p, zone, id(nc)))
+            placements.append((p, dom, id(nc)))
     for en in results.existing_nodes:
-        zone = en.state_node.labels().get(wk.ZONE_LABEL_KEY)
+        labels = en.state_node.labels()
+        dom = (lambda lbls: lambda key: lbls.get(key))(labels)
         for p in en.pods:
-            placements.append((p, zone, en.name()))
+            placements.append((p, dom, en.name()))
         # include already-bound pods for counting
         for key in en.state_node.pod_requests:
             ns, name = key.split("/", 1)
             pod = snap.store.try_get("Pod", name, ns)
             if pod is not None:
-                placements.append((pod, zone, en.name()))
+                placements.append((pod, dom, en.name()))
 
-    solve_keys = {p.key() for p in snap.pods}
     for pod in snap.pods:
         for tsc in pod.spec.topology_spread_constraints:
             if tsc.when_unsatisfiable != "DoNotSchedule":
                 continue
             counts = defaultdict(int)
-            for q, zone, host in placements:
+            for q, dom, host in placements:
                 if q.metadata.namespace != pod.metadata.namespace:
                     continue
                 if not match_label_selector(tsc.label_selector, q.metadata.labels):
                     continue
-                domain = zone if tsc.topology_key == wk.ZONE_LABEL_KEY else host
+                domain = host if tsc.topology_key == wk.HOSTNAME_LABEL_KEY else dom(tsc.topology_key)
                 if domain is not None:
                     counts[domain] += 1
-            if counts and tsc.topology_key == wk.ZONE_LABEL_KEY:
+            if counts and tsc.topology_key != wk.HOSTNAME_LABEL_KEY:
                 skew = max(counts.values()) - min(counts.values())
                 if skew > tsc.max_skew:
-                    errors.append(f"pod {pod.key()}: zone skew {skew} > {tsc.max_skew} ({dict(counts)})")
+                    errors.append(
+                        f"pod {pod.key()}: {tsc.topology_key} skew {skew} > {tsc.max_skew} ({dict(counts)})"
+                    )
         aff = pod.spec.affinity
         if aff is not None:
             for term in aff.pod_anti_affinity_required:
-                if term.topology_key != wk.HOSTNAME_LABEL_KEY:
-                    continue
-                my = next(((z, h) for q, z, h in placements if q.key() == pod.key()), None)
+                my = next(((dom, h) for q, dom, h in placements if q.key() == pod.key()), None)
                 if my is None:
                     continue
-                for q, zone, host in placements:
-                    if q.key() == pod.key() or host != my[1]:
+                if term.topology_key == wk.HOSTNAME_LABEL_KEY:
+                    same_domain = lambda dom, host: host == my[1]  # noqa: E731
+                else:
+                    mine = my[0](term.topology_key)
+                    same_domain = (
+                        (lambda dom, host: dom(term.topology_key) == mine) if mine is not None else (lambda dom, host: False)
+                    )
+                for q, dom, host in placements:
+                    if q.key() == pod.key() or not same_domain(dom, host):
                         continue
                     if q.metadata.namespace == pod.metadata.namespace and match_label_selector(term.label_selector, q.metadata.labels):
-                        errors.append(f"pod {pod.key()}: hostname anti-affinity violated with {q.key()}")
+                        errors.append(
+                            f"pod {pod.key()}: {term.topology_key} anti-affinity violated with {q.key()}"
+                        )
     return errors

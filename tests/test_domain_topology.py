@@ -1,0 +1,521 @@
+"""Keyed-domain topology: arbitrary spread topology keys, minDomains, and
+domain-keyed required anti-affinity on the tensor path.
+
+Reference behaviors: topology.go buildDomainGroups/countDomains (domain
+universes from NodePool x InstanceType requirements + existing nodes),
+topologygroup.go nextDomainTopologySpread (za-masked minimum, minDomains
+force-zero) and nextDomainAntiAffinity (count==0 domains only).
+"""
+
+import pytest
+
+from helpers import make_nodepool, make_pod, zone_spread
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.kube import Store, TopologySpreadConstraint
+from karpenter_tpu.kube.objects import PodAffinityTerm
+from karpenter_tpu.solver import FFDSolver, SolverSnapshot
+from karpenter_tpu.solver.encode import check_capability, encode
+from karpenter_tpu.solver.tpu import TPUSolver
+from karpenter_tpu.solver.validate import validate_results
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+RACK_KEY = "example.com/rack"
+
+
+def spread(key, max_skew=1, selector=None, min_domains=None, **kw):
+    return TopologySpreadConstraint(
+        max_skew=max_skew, topology_key=key, label_selector=selector, min_domains=min_domains, **kw
+    )
+
+
+def anti(selector, key):
+    return PodAffinityTerm(label_selector=selector, topology_key=key)
+
+
+def make_snapshot(pods, node_pools=None, types=None):
+    store = Store()
+    clock = FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    node_pools = node_pools or [make_nodepool(requirements=LINUX_AMD64)]
+    for np_ in node_pools:
+        store.create(np_)
+    types = types if types is not None else catalog.construct_instance_types()
+    return SolverSnapshot(
+        store=store,
+        cluster=cluster,
+        node_pools=node_pools,
+        instance_types={np_.metadata.name: types for np_ in node_pools},
+        state_nodes=cluster.nodes(),
+        daemonset_pods=[],
+        pods=pods,
+        clock=clock,
+    )
+
+
+def solve_both(pods, node_pools=None, types=None):
+    ffd = FFDSolver().solve(make_snapshot(pods, node_pools, types))
+    snap = make_snapshot(pods, node_pools, types)
+    tpu = TPUSolver(force=True)
+    res = tpu.solve(snap)
+    assert tpu.last_backend == "tpu"
+    assert set(res.pod_errors) == set(ffd.pod_errors), (res.pod_errors, ffd.pod_errors)
+    violations = validate_results(snap, res)
+    assert not violations, violations
+    return res, ffd
+
+
+class TestCapacityTypeSpread:
+    def test_spread_over_capacity_type_in_window(self):
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [
+            make_pod(cpu="1", labels={"app": "w"}, tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, 1, sel)])
+            for _ in range(10)
+        ]
+        snap = make_snapshot(pods)
+        assert check_capability(snap) == []
+        res, _ = solve_both(pods)
+        # every claim committed to a single capacity type, and the split is
+        # balanced within maxSkew
+        cts = {}
+        for nc in res.new_node_claims:
+            r = nc.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY)
+            assert len(r.values) == 1, "capacity-type spread member must commit its domain"
+            cts[r.any()] = cts.get(r.any(), 0) + len(nc.pods)
+        assert cts and max(cts.values()) - min(cts.values()) <= 1, cts
+
+    def test_capacity_type_spread_with_zone_selector(self):
+        # a zone selector under the DEFAULT Honor affinity policy filters
+        # which nodes count toward the capacity-type spread — that filter
+        # lives on a different key than the spread's domain axis, so the
+        # snapshot is host-only...
+        sel = {"matchLabels": {"app": "w"}}
+
+        def pods_with(policy_kw):
+            return [
+                make_pod(
+                    cpu="1",
+                    labels={"app": "w"},
+                    node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"},
+                    tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, 1, sel, **policy_kw)],
+                )
+                for _ in range(8)
+            ]
+
+        reasons = check_capability(make_snapshot(pods_with({})))
+        assert any("node-filtered spread" in r for r in reasons), reasons
+
+        # ...while an explicit Ignore policy removes the node filter and the
+        # tensor path handles it, pinning zones via the selector alone
+        pods = pods_with({"node_affinity_policy": "Ignore"})
+        snap = make_snapshot(pods)
+        assert check_capability(snap) == []
+        res, _ = solve_both(pods)
+        for nc in res.new_node_claims:
+            zr = nc.requirements.get(wk.ZONE_LABEL_KEY)
+            assert set(zr.values) <= {"test-zone-a"}
+
+
+class TestCustomKeySpread:
+    def two_rack_pools(self):
+        reqs_a = LINUX_AMD64 + [{"key": RACK_KEY, "operator": "In", "values": ["r1"]}]
+        reqs_b = LINUX_AMD64 + [{"key": RACK_KEY, "operator": "In", "values": ["r2"]}]
+        return [
+            make_nodepool(name="rack-1", requirements=reqs_a),
+            make_nodepool(name="rack-2", requirements=reqs_b),
+        ]
+
+    def test_custom_key_spread_across_pools(self):
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [make_pod(cpu="1", labels={"app": "w"}, tsc=[spread(RACK_KEY, 1, sel)]) for _ in range(9)]
+        snap = make_snapshot(pods, self.two_rack_pools())
+        assert check_capability(snap) == []
+        res, _ = solve_both(pods, self.two_rack_pools())
+        racks = {}
+        for nc in res.new_node_claims:
+            r = nc.requirements.get(RACK_KEY)
+            assert len(r.values) == 1
+            racks[r.any()] = racks.get(r.any(), 0) + len(nc.pods)
+        assert set(racks) == {"r1", "r2"}
+        assert max(racks.values()) - min(racks.values()) <= 1
+
+    def test_multi_value_template_requirement_provides_domains(self):
+        # ONE pool whose template carries rack In [r1, r2]: domains come from
+        # the template requirement (buildDomainGroups), commitment pins racks
+        reqs = LINUX_AMD64 + [{"key": RACK_KEY, "operator": "In", "values": ["r1", "r2"]}]
+        pools = [make_nodepool(requirements=reqs)]
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [make_pod(cpu="1", labels={"app": "w"}, tsc=[spread(RACK_KEY, 1, sel)]) for _ in range(6)]
+        snap = make_snapshot(pods, pools)
+        assert check_capability(snap) == []
+        res, _ = solve_both(pods, pools)
+        racks = {nc.requirements.get(RACK_KEY).any() for nc in res.new_node_claims if nc.pods}
+        assert racks == {"r1", "r2"}
+
+    def test_unconstrained_template_cannot_serve_custom_spread(self):
+        # the pool knows nothing about rack: no domains exist, members cannot
+        # schedule (host: nextDomain over an empty universe)
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [make_pod(cpu="1", labels={"app": "w"}, tsc=[spread(RACK_KEY, 1, sel)]) for _ in range(3)]
+        res, ffd = solve_both(pods)
+        assert len(res.pod_errors) == 3
+        assert set(res.pod_errors) == set(ffd.pod_errors)
+
+    def test_two_keys_on_different_deployments(self):
+        # one snapshot, two deployments spreading over DIFFERENT keys — each
+        # item commits its own key; no pod uses two keys, so all in-window
+        sel_a = {"matchLabels": {"app": "a"}}
+        sel_b = {"matchLabels": {"app": "b"}}
+        pods = [make_pod(cpu="1", labels={"app": "a"}, tsc=[zone_spread(1, sel_a)]) for _ in range(6)] + [
+            make_pod(cpu="500m", labels={"app": "b"}, tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, 1, sel_b)])
+            for _ in range(6)
+        ]
+        snap = make_snapshot(pods)
+        assert check_capability(snap) == []
+        solve_both(pods)
+
+    def test_pod_with_two_dom_keys_falls_back(self):
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [
+            make_pod(
+                cpu="1",
+                labels={"app": "w"},
+                tsc=[zone_spread(1, sel), spread(wk.CAPACITY_TYPE_LABEL_KEY, 1, sel)],
+            )
+        ]
+        reasons = check_capability(make_snapshot(pods))
+        assert any("multiple domain keys" in r for r in reasons), reasons
+
+
+class TestRegisteredUniverse:
+    def test_pool_zone_restriction_narrows_registered_universe(self):
+        # NodePool requires zone In [a]; its ITs advertise zones a-d. The
+        # pool's base requirements NARROW the instance domains
+        # (buildDomainGroups: "zones from an instance type don't expand the
+        # universe of valid domains") — phantom empty zones must not pin the
+        # spread minimum at zero
+        reqs = LINUX_AMD64 + [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}]
+        pools = [make_nodepool(requirements=reqs)]
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [make_pod(cpu="1", labels={"app": "w"}, tsc=[zone_spread(1, sel)]) for _ in range(6)]
+        res, ffd = solve_both(pods, pools)
+        assert not ffd.pod_errors
+        assert not res.pod_errors
+
+    def test_advertised_unlaunchable_zone_pins_minimum(self):
+        # the converse: the pool does NOT restrict zones, one IT advertises a
+        # zone no offering can launch in — the registered-but-empty domain
+        # pins the minimum at zero and caps every zone at maxSkew, exactly
+        # like the host (reference domainMinCount over empty domains)
+        from karpenter_tpu.cloudprovider.types import InstanceType
+
+        it = catalog.make_instance_type("c", 8, zones=["test-zone-a", "test-zone-b"])
+        from karpenter_tpu.scheduling.requirements import Requirement
+
+        it.requirements.replace(
+            Requirement(wk.ZONE_LABEL_KEY, "In", ["test-zone-a", "test-zone-b", "test-zone-ghost"])
+        )
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [make_pod(cpu="1", labels={"app": "w"}, tsc=[zone_spread(1, sel)]) for _ in range(6)]
+        ffd = FFDSolver().solve(make_snapshot(pods, types=[it]))
+        snap = make_snapshot(pods, types=[it])
+        tpu = TPUSolver(force=True)
+        res = tpu.solve(snap)
+        assert tpu.last_backend == "tpu"
+        assert not validate_results(snap, res)
+        # zones a,b take one pod each (min pinned 0 by the ghost domain); the
+        # rest stay pending. The host may additionally waste placements
+        # committing pods to the unlaunchable ghost domain (count-0
+        # tie-breaking follows domain-set iteration order, as in the
+        # reference), so it schedules AT MOST as many pods as the
+        # availability-aware kernel — anywhere from 0 to 2
+        assert len(res.pod_errors) == 4
+        assert 4 <= len(ffd.pod_errors) <= 6
+
+
+class TestMinDomains:
+    def test_min_domains_unmet_forces_zero_min(self):
+        # 4 zones available but minDomains=6: the global minimum is treated as
+        # zero, so no domain may exceed maxSkew — with maxSkew=2 and 9 pods,
+        # the FFD leaves one pod unschedulable (4 domains x 2 = 8 slots)
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [
+            make_pod(cpu="1", labels={"app": "w"}, tsc=[spread(wk.ZONE_LABEL_KEY, 2, sel, min_domains=6)])
+            for _ in range(9)
+        ]
+        snap = make_snapshot(pods)
+        assert check_capability(snap) == []
+        res, ffd = solve_both(pods)
+        assert len(ffd.pod_errors) == 1
+        assert len(res.pod_errors) == 1
+
+    def test_min_domains_met_is_plain_spread(self):
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [
+            make_pod(cpu="1", labels={"app": "w"}, tsc=[spread(wk.ZONE_LABEL_KEY, 1, sel, min_domains=3)])
+            for _ in range(8)
+        ]
+        snap = make_snapshot(pods)
+        assert check_capability(snap) == []
+        res, _ = solve_both(pods)
+        assert not res.pod_errors
+
+    def test_min_domains_hostname_is_noop(self):
+        # hostname domains are unbounded (a new claim is always a fresh
+        # domain): minDomains never forces the zero minimum (host
+        # _domain_min_count returns 0 for hostname regardless)
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [
+            make_pod(cpu="1", labels={"app": "w"}, tsc=[spread(wk.HOSTNAME_LABEL_KEY, 1, sel, min_domains=50)])
+            for _ in range(4)
+        ]
+        snap = make_snapshot(pods)
+        assert check_capability(snap) == []
+        res, _ = solve_both(pods)
+        assert not res.pod_errors
+
+
+class TestDomainAntiAffinity:
+    def test_unpinned_zone_anti_schedules_one_per_batch(self):
+        # reference late-committal semantics (topology_test.go "should support
+        # pod anti-affinity with a zone topology"): an unpinned self-anti
+        # replica set schedules exactly ONE pod per solve — the placed pod's
+        # claim could land in any zone, so it blocks them all
+        sel = {"matchLabels": {"app": "db"}}
+        pods = [
+            make_pod(cpu="1", labels={"app": "db"}, anti_affinity=[anti(sel, wk.ZONE_LABEL_KEY)])
+            for _ in range(4)
+        ]
+        snap = make_snapshot(pods)
+        assert check_capability(snap) == []
+        res, ffd = solve_both(pods)
+        assert len(res.pod_errors) == 3
+        assert sum(len(nc.pods) for nc in res.new_node_claims) == 1
+
+    def test_zone_pinned_anti_replicas_all_schedule(self):
+        # selector-pinned replicas consume exactly their pinned zone, so a
+        # full set schedules in one solve (reference "should not violate pod
+        # anti-affinity on zone" — with the declaring side symmetric)
+        sel = {"matchLabels": {"app": "db"}}
+        zones = ["test-zone-a", "test-zone-b", "test-zone-c"]
+        pods = [
+            make_pod(
+                cpu="1",
+                labels={"app": "db"},
+                node_selector={wk.ZONE_LABEL_KEY: z},
+                anti_affinity=[anti(sel, wk.ZONE_LABEL_KEY)],
+            )
+            for z in zones
+        ]
+        snap = make_snapshot(pods)
+        assert check_capability(snap) == []
+        res, _ = solve_both(pods)
+        assert not res.pod_errors
+        placed = sorted(nc.requirements.get(wk.ZONE_LABEL_KEY).any() for nc in res.new_node_claims if nc.pods)
+        assert placed == zones
+
+    def test_pinned_overflow_is_unschedulable(self):
+        # two replicas pinned to the SAME zone: the second violates and stays
+        # pending, parity with the FFD
+        sel = {"matchLabels": {"app": "db"}}
+        pods = [
+            make_pod(
+                cpu="1",
+                labels={"app": "db"},
+                node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"},
+                anti_affinity=[anti(sel, wk.ZONE_LABEL_KEY)],
+            )
+            for _ in range(2)
+        ]
+        res, ffd = solve_both(pods)
+        assert len(res.pod_errors) == 1
+        assert set(res.pod_errors) == set(ffd.pod_errors)
+
+    def test_capacity_type_anti_affinity_blocks_possible_set(self):
+        sel = {"matchLabels": {"app": "q"}}
+        pods = [
+            make_pod(cpu="1", labels={"app": "q"}, anti_affinity=[anti(sel, wk.CAPACITY_TYPE_LABEL_KEY)])
+            for _ in range(2)
+        ]
+        snap = make_snapshot(pods)
+        assert check_capability(snap) == []
+        res, ffd = solve_both(pods)
+        # the first unpinned pod blocks both capacity types
+        assert len(res.pod_errors) == 1
+
+    def test_zone_anti_respects_running_pods(self):
+        # a running matched pod occupies test-zone-a: the new replicas must
+        # avoid it (counts_dom_init feeds the domain caps)
+        from karpenter_tpu.apis.nodeclaim import COND_INITIALIZED, COND_REGISTERED, NodeClaim
+        from karpenter_tpu.kube import Node, ObjectMeta
+        from karpenter_tpu.kube.objects import NodeSpec, NodeStatus
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        sel = {"matchLabels": {"app": "db"}}
+        pods = [
+            make_pod(cpu="1", labels={"app": "db"}, anti_affinity=[anti(sel, wk.ZONE_LABEL_KEY)])
+            for _ in range(2)
+        ]
+
+        def snap():
+            store = Store()
+            clock = FakeClock()
+            cluster = Cluster(store, clock)
+            start_informers(store, cluster)
+            np_ = make_nodepool(requirements=LINUX_AMD64)
+            store.create(np_)
+            nc = NodeClaim(metadata=ObjectMeta(name="c1", labels={wk.NODEPOOL_LABEL_KEY: np_.metadata.name}))
+            nc.status.provider_id = "kwok://n1"
+            nc.status.conditions.set_true(COND_REGISTERED)
+            nc.status.conditions.set_true(COND_INITIALIZED)
+            store.create(nc)
+            store.create(
+                Node(
+                    metadata=ObjectMeta(
+                        name="n1",
+                        labels={
+                            wk.NODEPOOL_LABEL_KEY: np_.metadata.name,
+                            wk.HOSTNAME_LABEL_KEY: "n1",
+                            wk.ZONE_LABEL_KEY: "test-zone-a",
+                        },
+                    ),
+                    spec=NodeSpec(provider_id="kwok://n1"),
+                    status=NodeStatus(
+                        capacity=parse_resource_list({"cpu": "8", "memory": "32Gi", "pods": "110"}),
+                        allocatable=parse_resource_list({"cpu": "8", "memory": "32Gi", "pods": "110"}),
+                    ),
+                )
+            )
+            running = make_pod(name="r0", cpu="100m", labels={"app": "db"}, node_name="n1")
+            store.create(running)
+            return SolverSnapshot(
+                store=store,
+                cluster=cluster,
+                node_pools=[np_],
+                instance_types={np_.metadata.name: catalog.construct_instance_types()},
+                state_nodes=cluster.nodes(),
+                daemonset_pods=[],
+                pods=pods,
+                clock=clock,
+            )
+
+        ffd_res = FFDSolver().solve(snap())
+        tpu = TPUSolver(force=True)
+        res = tpu.solve(snap())
+        assert tpu.last_backend == "tpu"
+        assert set(res.pod_errors) == set(ffd_res.pod_errors)
+        # the running matched pod blocks test-zone-a; the first new pod takes
+        # (and blocks) the remaining zones, leaving the second pending
+        assert len(res.pod_errors) == 1
+        for nc in res.new_node_claims:
+            zr = nc.requirements.get(wk.ZONE_LABEL_KEY)
+            assert "test-zone-a" not in zr.values
+
+    def test_asymmetric_anti_affinity_falls_back(self):
+        # the declarer does not match its own selector: the symmetric group
+        # model would over-constrain the matched pods — host path only
+        sel = {"matchLabels": {"app": "other"}}
+        pods = [make_pod(cpu="1", labels={"app": "me"}, anti_affinity=[anti(sel, wk.ZONE_LABEL_KEY)])] + [
+            make_pod(cpu="1", labels={"app": "other"}) for _ in range(2)
+        ]
+        reasons = check_capability(make_snapshot(pods))
+        assert any("asymmetric anti-affinity" in r for r in reasons), reasons
+        # the plain solver falls back; the host handles the inverse semantics
+        # (the declarer's uncommitted claim blocks the matched pods)
+        tpu = TPUSolver()
+        res = tpu.solve(make_snapshot(pods))
+        assert tpu.last_backend == "ffd-fallback"
+
+    def test_hostname_asymmetric_also_falls_back(self):
+        sel = {"matchLabels": {"app": "other"}}
+        pods = [make_pod(cpu="1", labels={"app": "me"}, anti_affinity=[anti(sel, wk.HOSTNAME_LABEL_KEY)])] + [
+            make_pod(cpu="1", labels={"app": "other"})
+        ]
+        reasons = check_capability(make_snapshot(pods))
+        assert any("asymmetric anti-affinity" in r for r in reasons), reasons
+
+
+class TestNodeFilteredSpreadWindow:
+    def test_zone_selector_with_zone_spread_stays_in_window(self):
+        # the effective Honor filter only constrains the spread's own key:
+        # the za mask IS the filter
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [
+            make_pod(
+                cpu="1",
+                labels={"app": "w"},
+                node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"},
+                tsc=[zone_spread(1, sel)],
+            )
+            for _ in range(3)
+        ]
+        assert check_capability(make_snapshot(pods)) == []
+
+    def test_non_key_selector_with_default_honor_falls_back(self):
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [
+            make_pod(
+                cpu="1",
+                labels={"app": "w"},
+                node_selector={wk.ARCH_LABEL_KEY: "amd64"},
+                tsc=[zone_spread(1, sel)],
+            )
+        ]
+        reasons = check_capability(make_snapshot(pods))
+        assert any("node-filtered spread" in r for r in reasons), reasons
+
+    def test_non_key_selector_with_explicit_ignore_stays_in_window(self):
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [
+            make_pod(
+                cpu="1",
+                labels={"app": "w"},
+                node_selector={wk.ARCH_LABEL_KEY: "amd64"},
+                tsc=[spread(wk.ZONE_LABEL_KEY, 1, sel, node_affinity_policy="Ignore")],
+            )
+            for _ in range(3)
+        ]
+        snap = make_snapshot(pods)
+        assert check_capability(snap) == []
+        res, _ = solve_both(pods)
+        assert not res.pod_errors
+
+    def test_taint_policy_honor_falls_back(self):
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [
+            make_pod(cpu="1", labels={"app": "w"}, tsc=[spread(wk.ZONE_LABEL_KEY, 1, sel, node_taints_policy="Honor")])
+        ]
+        reasons = check_capability(make_snapshot(pods))
+        assert any("taint policy" in r for r in reasons), reasons
+
+
+class TestShardedDomainEquivalence:
+    def test_capacity_type_workload_sharded_equivalent(self):
+        import jax
+
+        from karpenter_tpu.models.scheduler_model import make_tensors
+        from karpenter_tpu.models.scheduler_model_grouped import build_items, make_item_tensors
+        from karpenter_tpu.parallel.sharded import assert_sharded_equivalent, make_mesh
+
+        sel_a = {"matchLabels": {"app": "a"}}
+        sel_b = {"matchLabels": {"app": "b"}}
+        pods = [make_pod(cpu="1", labels={"app": "a"}, tsc=[zone_spread(1, sel_a)]) for _ in range(9)] + [
+            make_pod(cpu="500m", labels={"app": "b"}, tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, 1, sel_b)])
+            for _ in range(7)
+        ]
+        snap = make_snapshot(pods)
+        enc = encode(snap)
+        assert not enc.fallback_reasons
+        t = make_tensors(enc, with_pods=False)
+        item_arrays, _ = build_items(enc)
+        items = make_item_tensors(item_arrays)
+        mesh = make_mesh(jax.devices()[:4])
+        assert_sharded_equivalent(t, items, mesh)  # raises on divergence
