@@ -49,24 +49,17 @@ UNREGISTERED_TAINT_KEY = f"{GROUP}/unregistered"
 # Finalizers
 TERMINATION_FINALIZER = f"{GROUP}/termination"
 
-# Labels a NodePool may not set directly (reference: labels.go RestrictedLabels)
+# Labels a NodePool may not set directly (reference labels.go:113-117
+# RestrictedLabels — ONLY the hostname label; plain kubernetes.io/k8s.io
+# domain labels are allowed, see suite_test.go:1578 "should label nodes with
+# labels in the kubernetes domains")
 RESTRICTED_LABELS = {
-    # kubernetes.io core namespaces that Karpenter owns or that the kubelet owns
     HOSTNAME_LABEL_KEY,
-    "kubernetes.io/assigned-node",
 }
 
+# Domains reserved by karpenter itself (labels.go:68-71 RestrictedLabelDomains)
 RESTRICTED_LABEL_DOMAINS = {
-    "kubernetes.io",
-    "k8s.io",
     GROUP,
-}
-
-LABEL_DOMAIN_EXCEPTIONS = {
-    "kops.k8s.io",
-    "node.kubernetes.io",
-    "node-restriction.kubernetes.io",
-    "node.k8s.io",
 }
 
 # Labels the scheduler may leave undefined on an InstanceType and still be
@@ -119,9 +112,7 @@ def is_restricted(key: str) -> bool:
     """True if a NodePool template may not set this label (labels.go IsRestrictedLabel)."""
     if key in WELL_KNOWN_LABELS:
         return False
-    domain = key.split("/", 1)[0] if "/" in key else ""
-    if domain in LABEL_DOMAIN_EXCEPTIONS or any(domain.endswith("." + e) for e in LABEL_DOMAIN_EXCEPTIONS):
-        return False
     if key in RESTRICTED_LABELS:
         return True
+    domain = key.split("/", 1)[0] if "/" in key else ""
     return any(domain == d or domain.endswith("." + d) for d in RESTRICTED_LABEL_DOMAINS)

@@ -166,6 +166,13 @@ class EncodedSnapshot:
     # True when any pod carries relaxable soft constraints the pack honored
     # tier-0; an unplaced pod then re-solves via the host relaxation loop
     has_relaxable: bool = False
+    # content tuple per requirement class (pod_signature key[0]) — a STABLE
+    # cross-solve cache key for decode's per-class work, unlike the
+    # solve-local integer class ids
+    req_class_keys: list = field(default_factory=list)
+    # cross-solve decode memo owned by the row artifacts (same lifetime as
+    # the template objects its keys reference)
+    decode_cache: dict = field(default_factory=dict)
 
     @property
     def n_rows(self) -> int:
@@ -562,6 +569,10 @@ class _RowArtifacts:
     # monotonically, so reuse is bounded (see EncodeCache growth guard)
     built_n_keys: int = 0
     built_vmax: int = 0
+    # decode-side memo (instance-type masks, claim Requirements, template
+    # contexts) — tied to THIS artifact's lifetime so template identities in
+    # its keys can never go stale
+    decode_cache: dict = field(default_factory=dict)
 
 
 class EncodeCache:
@@ -870,6 +881,9 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     for key, sid in sig_ids.items():
         cid = req_class_ids.setdefault(key[0], len(req_class_ids))
         req_class_of_sig[sid] = cid
+    req_class_keys: list = [None] * len(req_class_ids)
+    for key0, cid in req_class_ids.items():
+        req_class_keys[cid] = key0
 
     reasons = check_capability(snap, rep_pods)
 
@@ -932,18 +946,16 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     row_labels = rows.row_labels0
 
     # -- pod queue order (FFD: cpu desc, mem desc, creation, uid) --------------
-    # per-signature cpu/mem, broadcast to pods by index: the sort key is built
-    # once per pod as a plain tuple (no Quantity arithmetic on the O(P) path)
-    sig_cpu = [-(rr.get("cpu", _Q0).milli) for rr in sig_requests]
-    sig_mem = [-(rr.get("memory", _Q0).milli) for rr in sig_requests]
-    order_keys = [
-        (sig_cpu[s], sig_mem[s], p.metadata.creation_timestamp, p.metadata.uid, i)
-        for i, (p, s) in enumerate(zip(snap.pods, sig_of_pod_raw.tolist()))
-    ]
-    order_keys.sort()
-    order = [k[-1] for k in order_keys]
+    # per-signature cpu/mem broadcast to pods by index, then one vectorized
+    # lexsort — no 50k-tuple Python sort on the hot path
+    sig_cpu = np.fromiter((-(rr.get("cpu", _Q0).milli) for rr in sig_requests), dtype=np.int64, count=S)
+    sig_mem = np.fromiter((-(rr.get("memory", _Q0).milli) for rr in sig_requests), dtype=np.int64, count=S)
+    created = np.fromiter((p.metadata.creation_timestamp for p in snap.pods), dtype=np.float64, count=P0)
+    uid = np.array([p.metadata.uid for p in snap.pods])
+    # last lexsort key is primary
+    order = np.lexsort((uid, created, sig_mem[sig_of_pod_raw], sig_cpu[sig_of_pod_raw]))
     pods = [snap.pods[i] for i in order]
-    sig_of_pod = sig_of_pod_raw[np.asarray(order, dtype=np.int64)]
+    sig_of_pod = sig_of_pod_raw[order]
     P = P0
 
     sig_req = np.zeros((S, R), dtype=np.float32)
@@ -1200,6 +1212,8 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         counts_host_existing=counts_host_existing,
         fallback_reasons=reasons,
         has_relaxable=respect and any(_is_relaxable(p) for p in rep_pods),
+        req_class_keys=req_class_keys,
+        decode_cache=rows.decode_cache,
     )
 
 

@@ -252,18 +252,26 @@ class TPUSolver:
             {enc.dom_values[d] for d in range(Kd, D) if dko[d] == k} for k in range(Kd)
         ]
 
-        overhead_groups_cache: dict[int, list] = {}
         # per-slot work dedupes by SIGNATURE: pod requirements/requests lower
         # once per unique shape (encode.sig_*). The expensive per-slot pass —
         # the 500-type instance filter — splits into a requirements part
         # (compat + offering, cached per distinct (template, req-class set,
-        # zone-set)) and a fits part (vectorized numpy compare of the slot's
-        # total request vector against the template's allocatable matrix).
+        # domain-set)) and a fits part (vectorized numpy compare of the
+        # slot's total request vector against the template's allocatable
+        # matrix). The caches PERSIST across solves: they live on the encode
+        # row artifacts (same lifetime as the template objects their keys
+        # reference) and key requirement classes by CONTENT
+        # (enc.req_class_keys), so a steady-state warm re-solve reuses the
+        # previous solve's per-class filtering wholesale.
         sig_of_pod = np.asarray(enc.sig_of_pod)
         rc_of_sig = enc.req_class_of_sig
-        mask_cache: dict[tuple, np.ndarray] = {}
-        req_cache: dict[tuple, Requirements] = {}
-        tmpl_ctx_cache: dict[int, tuple] = {}
+        dc = enc.decode_cache
+        if len(dc.get("mask", ())) > 100_000:
+            dc.clear()  # churn guard; repopulates in one solve
+        overhead_groups_cache: dict[int, list] = dc.setdefault("ovh", {})
+        mask_cache: dict[tuple, np.ndarray] = dc.setdefault("mask", {})
+        req_cache: dict[tuple, Requirements] = dc.setdefault("req", {})
+        tmpl_ctx_cache: dict[int, tuple] = dc.setdefault("tmpl", {})
         new_claims: list[SchedulingNodeClaim] = []
 
         # slot total request vectors, one bincount per resource axis
@@ -304,8 +312,11 @@ class TPUSolver:
             # slot below the key's full universe (late committal — matches
             # the FFD's topology narrowing); zone is dom key 0
             dom_sig = tuple(int(d) for d in np.nonzero(slot_zoneset[j])[0])
-            rc_key = tuple(sorted({int(rc_of_sig[s]) for s in sig_counts}))
-            rkey = (id(template), rc_key, dom_sig)
+            # requirement classes keyed by CONTENT so the cross-solve cache
+            # can never alias solve-local integer ids; the preference policy
+            # changes how a class lowers, so it keys too
+            rc_key = frozenset(enc.req_class_keys[int(rc_of_sig[s])] for s in sig_counts)
+            rkey = (id(template), rc_key, dom_sig, getattr(snap, "preference_policy", "Respect"))
             reqs = req_cache.get(rkey)
             if reqs is None:
                 reqs = Requirements()

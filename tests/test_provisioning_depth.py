@@ -1,0 +1,214 @@
+"""Provisioning depth specs ported from the reference's provisioning
+suite_test.go: label/annotation/taint propagation onto nodes, NodeClaim
+request contents (requirements, resource requests, owner/nodeclass
+references), container/initContainer resource math, and weighted-pool
+ordering."""
+
+import pytest
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.kube import Container, ObjectMeta, Pod, PodSpec
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.scheduling.taints import Taint
+from karpenter_tpu.utils.resources import parse_resource_list
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_env(np_kwargs=None, pools=None, **opt_kwargs):
+    env = Environment(options=Options(**opt_kwargs))
+    for np in pools or [make_nodepool(**dict({"requirements": LINUX_AMD64}, **(np_kwargs or {})))]:
+        env.store.create(np)
+    return env
+
+
+def provision(env, pods, rounds=6):
+    for p in pods:
+        env.store.create(p)
+    env.settle(rounds=rounds)
+    return env
+
+
+class TestNodeMetadataPropagation:
+    def test_annotations_propagate_to_nodes(self):
+        # suite_test.go:1527 "should annotate nodes"
+        np = make_nodepool(requirements=LINUX_AMD64)
+        np.spec.template.annotations = {"custom/annotation": "myAnnotation"}
+        env = make_env(pools=[np])
+        provision(env, [make_pod(cpu="1", name="p0")])
+        node = env.store.list("Node")[0]
+        assert node.metadata.annotations.get("custom/annotation") == "myAnnotation"
+
+    def test_labels_propagate_to_nodes(self):
+        # suite_test.go:1545 "should label nodes" — template labels plus the
+        # well-known set (nodepool, instance-type, capacity-type, zone)
+        np = make_nodepool(requirements=LINUX_AMD64, labels={"custom/label": "myLabel", "other/label": "v"})
+        env = make_env(pools=[np])
+        provision(env, [make_pod(cpu="1", name="p0")])
+        node = env.store.list("Node")[0]
+        lbls = node.metadata.labels
+        assert lbls.get("custom/label") == "myLabel"
+        assert lbls.get("other/label") == "v"
+        assert lbls.get(wk.NODEPOOL_LABEL_KEY) == np.metadata.name
+        assert lbls.get(wk.INSTANCE_TYPE_LABEL_KEY)
+        assert lbls.get(wk.CAPACITY_TYPE_LABEL_KEY)
+        assert lbls.get(wk.ZONE_LABEL_KEY)
+
+    @pytest.mark.parametrize("domain", ["kubernetes.io", "k8s.io", "subdomain.kubernetes.io"])
+    def test_kubernetes_domain_labels(self, domain):
+        # suite_test.go:1578/1600 — template labels in the kubernetes domains
+        # (and their subdomains) are allowed and land on nodes; pods may
+        # select on them (reference RestrictedLabelDomains covers only the
+        # karpenter.sh group, labels.go:68-71)
+        np = make_nodepool(requirements=LINUX_AMD64, labels={f"{domain}/test": "test-value"})
+        env = make_env(pools=[np])
+        provision(env, [make_pod(cpu="1", name="p0", node_selector={f"{domain}/test": "test-value"})])
+        assert env.store.get("Pod", "p0").spec.node_name
+        node = env.store.list("Node")[0]
+        assert node.metadata.labels.get(f"{domain}/test") == "test-value"
+
+
+class TestTaintPropagation:
+    def test_pods_must_tolerate_template_taints(self):
+        # suite_test.go:1644 "should schedule pods that tolerate taints"
+        np = make_nodepool(requirements=LINUX_AMD64, taints=[Taint(key="example.com/special", value="true", effect="NoSchedule")])
+        env = make_env(pools=[np])
+        tolerating = make_pod(
+            cpu="1",
+            name="ok",
+            tolerations=[{"key": "example.com/special", "operator": "Equal", "value": "true", "effect": "NoSchedule"}],
+        )
+        intolerant = make_pod(cpu="1", name="nope")
+        provision(env, [tolerating, intolerant])
+        assert env.store.get("Pod", "ok").spec.node_name
+        assert not env.store.get("Pod", "nope").spec.node_name
+        node = env.store.list("Node")[0]
+        assert any(t.key == "example.com/special" for t in node.spec.taints)
+
+
+class TestNodeClaimRequest:
+    def test_claim_requirements_reflect_pod_and_pool(self):
+        # suite_test.go:1694/1765 — the claim's requirements restrict
+        # architecture/zone per pod selector plus pool requirements
+        env = make_env()
+        provision(env, [make_pod(cpu="1", name="p0", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"})])
+        nc = env.store.list("NodeClaim")[0]
+        from karpenter_tpu.scheduling.requirements import Requirements
+
+        reqs = Requirements.from_node_selector_terms(nc.spec.requirements)
+        assert reqs.get(wk.ZONE_LABEL_KEY).has("test-zone-b")
+        assert not reqs.get(wk.ZONE_LABEL_KEY).has("test-zone-a")
+        assert reqs.get(wk.ARCH_LABEL_KEY).has("amd64")
+
+    def test_claim_carries_resource_requests(self):
+        # suite_test.go:1912 "should create a nodeclaim with resource requests"
+        env = make_env()
+        provision(env, [make_pod(cpu="1", memory="1Gi", name="p0")])
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.spec.resources and nc.spec.resources["cpu"].milli >= 1000
+        assert nc.spec.resources["memory"].milli >= 1024**3 * 1000 // 1000
+
+    def test_claim_requests_include_daemon_overhead_once(self):
+        # suite_test.go:1938/1958 — daemon overhead counts once per claim,
+        # not once per pod
+        from karpenter_tpu.kube.objects import DaemonSet
+
+        env = make_env()
+        ds = DaemonSet(
+            metadata=ObjectMeta(name="ds"),
+            template_spec=PodSpec(containers=[Container(resources={"requests": parse_resource_list({"cpu": "1"})})]),
+        )
+        env.store.create(ds)
+        provision(env, [make_pod(cpu="1", name=f"p{i}") for i in range(2)])
+        ncs = env.store.list("NodeClaim")
+        assert len(ncs) == 1
+        # 2 pods x 1cpu + 1cpu daemon overhead = 3cpu, NOT 4
+        assert 3000 <= ncs[0].spec.resources["cpu"].milli < 4000
+
+    def test_claim_owner_and_nodeclass_reference(self):
+        # suite_test.go:1866/1884
+        env = make_env()
+        provision(env, [make_pod(cpu="1", name="p0")])
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.metadata.labels.get(wk.NODEPOOL_LABEL_KEY) == env.store.list("NodePool")[0].metadata.name
+        assert nc.spec.node_class_ref is not None and nc.spec.node_class_ref.name
+
+
+class TestContainerResourceMath:
+    def test_max_of_containers_and_init_containers(self):
+        # suite_test.go:1069 — effective request = max(sum(containers),
+        # max(initContainers)) per resource
+        env = make_env()
+        pod = Pod(
+            metadata=ObjectMeta(name="mixed"),
+            spec=PodSpec(
+                containers=[
+                    Container(resources={"requests": parse_resource_list({"cpu": "1", "memory": "1Gi"})}),
+                    Container(resources={"requests": parse_resource_list({"cpu": "1", "memory": "1Gi"})}),
+                ],
+                init_containers=[
+                    Container(resources={"requests": parse_resource_list({"cpu": "3", "memory": "1Gi"})}),
+                ],
+            ),
+        )
+        provision(env, [pod])
+        assert env.store.get("Pod", "mixed").spec.node_name
+        node = env.store.list("Node")[0]
+        # the chosen node must fit the 3-cpu init phase, not just 2 cpu
+        assert node.status.allocatable["cpu"].milli >= 3000
+
+    def test_oversized_init_container_blocks(self):
+        # suite_test.go:1118
+        env = make_env()
+        pod = Pod(
+            metadata=ObjectMeta(name="huge-init"),
+            spec=PodSpec(
+                containers=[Container(resources={"requests": parse_resource_list({"cpu": "1"})})],
+                init_containers=[Container(resources={"requests": parse_resource_list({"cpu": "10000"})})],
+            ),
+        )
+        provision(env, [pod])
+        assert not env.store.get("Pod", "huge-init").spec.node_name
+        assert env.store.count("NodeClaim") == 0
+
+    def test_requestless_pods_schedule(self):
+        # suite_test.go:1134
+        env = make_env()
+        pod = Pod(metadata=ObjectMeta(name="zero"), spec=PodSpec(containers=[Container()]))
+        provision(env, [pod])
+        assert env.store.get("Pod", "zero").spec.node_name
+
+
+class TestWeightedPools:
+    def two_pools(self, w_hi=50, w_lo=10, hi_reqs=None):
+        hi = make_nodepool(name="hi", requirements=hi_reqs or LINUX_AMD64, weight=w_hi)
+        lo = make_nodepool(name="lo", requirements=LINUX_AMD64, weight=w_lo)
+        return [hi, lo]
+
+    def test_higher_weight_pool_wins(self):
+        # suite_test.go:2813 Weighted NodePools
+        env = make_env(pools=self.two_pools())
+        provision(env, [make_pod(cpu="1", name="p0")])
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.metadata.labels.get(wk.NODEPOOL_LABEL_KEY) == "hi"
+
+    def test_falls_through_when_heavy_pool_incompatible(self):
+        arm_only = [
+            {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["arm64"]},
+            {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+        ]
+        env = make_env(pools=self.two_pools(hi_reqs=arm_only))
+        provision(env, [make_pod(cpu="1", name="p0", node_selector={wk.ARCH_LABEL_KEY: "amd64"})])
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.metadata.labels.get(wk.NODEPOOL_LABEL_KEY) == "lo"
+
+    def test_pod_nodepool_selector_pins_pool(self):
+        env = make_env(pools=self.two_pools())
+        provision(env, [make_pod(cpu="1", name="p0", node_selector={wk.NODEPOOL_LABEL_KEY: "lo"})])
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.metadata.labels.get(wk.NODEPOOL_LABEL_KEY) == "lo"
