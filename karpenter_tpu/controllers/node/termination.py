@@ -15,7 +15,7 @@ from __future__ import annotations
 
 from ...apis import labels as wk
 from ...cloudprovider.errors import NodeClaimNotFoundError
-from ...scheduling.taints import NO_SCHEDULE, Taint
+from ...scheduling.taints import NO_SCHEDULE, Taint, taints_tolerate_pod
 from ...utils import pods as pod_utils
 from ...utils.pdb import PDBLimits
 
@@ -49,9 +49,12 @@ class TerminationController:
 
             self.store.patch("Node", name, taint)
 
-        # 2. drain: evict by descending priority groups (terminator.go:96-138)
+        # 2. drain: evict by descending priority groups (terminator.go:96-138).
+        # Pods that TOLERATE the disruption taint opted into riding the node
+        # down — they are not evicted and are deleted with the instance
+        # (podutils IsWaitingEviction; suite_test.go:225-288)
         bound = [p for p in self.store.list("Pod") if p.spec.node_name == name and pod_utils.is_active(p)]
-        evictable = [p for p in bound if not pod_utils.is_owned_by_daemonset(p) and not pod_utils.is_owned_by_node(p)]
+        evictable = [p for p in bound if self._drainable(p)]
         tgp_expired = self._grace_period_expired(node)
         if evictable:
             pdb = PDBLimits(self.store)
@@ -76,11 +79,7 @@ class TerminationController:
                 return  # more groups remain; drain continues next reconcile
 
         # recheck: everything evictable gone?
-        still = [
-            p
-            for p in self.store.list("Pod")
-            if p.spec.node_name == name and pod_utils.is_active(p) and not pod_utils.is_owned_by_daemonset(p) and not pod_utils.is_owned_by_node(p)
-        ]
+        still = [p for p in self.store.list("Pod") if p.spec.node_name == name and pod_utils.is_active(p) and self._drainable(p)]
         if still and not tgp_expired:
             return
 
@@ -140,7 +139,7 @@ class TerminationController:
         for p in self.store.list("Pod"):
             if p.spec.node_name != name or not pod_utils.is_active(p):
                 continue
-            if pod_utils.is_eviction_blocked(p, self.clock.now()) or pod_utils.is_owned_by_daemonset(p) or pod_utils.is_owned_by_node(p):
+            if pod_utils.is_eviction_blocked(p, self.clock.now()) or not self._drainable(p):
                 for v in p.spec.volumes:
                     ref = v.get("persistentVolumeClaim")
                     if not ref:
@@ -149,6 +148,14 @@ class TerminationController:
                     if pvc is not None and pvc.volume_name:
                         undrainable_pvs.add(pvc.volume_name)
         return [va for va in vas if va.persistent_volume_name not in undrainable_pvs]
+
+    @staticmethod
+    def _drainable(pod) -> bool:
+        """Pods the drain evicts: not daemon/node-owned, and not tolerating
+        the karpenter disrupted taint (tolerating pods ride the node down)."""
+        if pod_utils.is_owned_by_daemonset(pod) or pod_utils.is_owned_by_node(pod):
+            return False
+        return taints_tolerate_pod([DISRUPTED_TAINT], pod) is not None
 
     def _evict(self, pod) -> None:
         """Evict = reset to pending (modeling controller recreation)."""

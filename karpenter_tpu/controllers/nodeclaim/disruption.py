@@ -18,9 +18,11 @@ class NodeClaimDisruptionController:
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.clock = clock
+        self._it_index_by_pool: dict[str, dict] = {}
 
     def reconcile(self) -> None:
         pools = {np.metadata.name: np for np in self.store.list("NodePool")}
+        self._it_index_by_pool = {}  # per-reconcile: pool -> {it.name: it}
         for nc in self.store.list("NodeClaim"):
             if nc.metadata.deletion_timestamp is not None:
                 continue
@@ -52,27 +54,71 @@ class NodeClaimDisruptionController:
             COND_CONSOLIDATABLE, "NotConsolidatable", now=self.clock.now()
         )
 
+    # the reference postpones instance-type staleness checks until an hour
+    # after claim creation (drift.go:93-96)
+    INSTANCE_TYPE_DRIFT_DELAY_SECONDS = 3600.0
+
     def _drifted(self, nc, pool) -> bool:
-        """Drift = cloud-provider drift, nodepool static-hash drift, or
-        requirement drift (drift.go:51-150)."""
+        """Drift detection in the reference's precedence (drift.go:86-113):
+        nodepool static-hash drift, then requirement drift, then stale
+        instance-type drift (delayed 1h from creation), then cloud-provider
+        drift last. An unlaunched claim CLEARS the condition (drift.go:57-62)."""
         if not nc.is_launched():
-            return False
+            return nc.status.conditions.clear(COND_DRIFTED)
         reason = ""
-        cp_reason = self.cloud_provider.is_drifted(nc)
-        if cp_reason:
-            reason = cp_reason
-        elif self._static_drift(nc, pool):
-            reason = "NodePoolStaticDrift"
+        if self._static_drift(nc, pool):
+            reason = "NodePoolDrifted"
         elif self._requirement_drift(nc, pool):
             reason = "RequirementsDrifted"
+        elif self._instance_type_not_found(nc, pool):
+            reason = "InstanceTypeNotFound"
+        else:
+            reason = self.cloud_provider.is_drifted(nc) or ""
         if reason:
             return nc.status.conditions.set_true(COND_DRIFTED, reason=reason, now=self.clock.now())
         return nc.status.conditions.clear(COND_DRIFTED)
 
+    def _instance_type_not_found(self, nc, pool) -> bool:
+        """Stale instance-type drift (drift.go:116-149): the claim's instance
+        type vanished from the provider, or no longer has an offering
+        compatible with the claim's labels. Reserved claims may be demoted to
+        on-demand post-creation, so both capacity types pass."""
+        created = nc.metadata.creation_timestamp or 0.0
+        if self.clock.now() - created < self.INSTANCE_TYPE_DRIFT_DELAY_SECONDS:
+            return False
+        it_name = nc.metadata.labels.get(wk.INSTANCE_TYPE_LABEL_KEY)
+        if not it_name:
+            return True
+        index = self._it_index_by_pool.get(pool.metadata.name)
+        if index is None:
+            index = {x.name: x for x in self.cloud_provider.get_instance_types(pool)}
+            self._it_index_by_pool[pool.metadata.name] = index
+        it = index.get(it_name)
+        if it is None:
+            return True
+        from ...scheduling.requirements import Requirement
+
+        reqs = Requirements.from_labels(nc.metadata.labels)
+        if nc.metadata.labels.get(wk.CAPACITY_TYPE_LABEL_KEY) == wk.CAPACITY_TYPE_RESERVED:
+            reqs.replace(
+                Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [wk.CAPACITY_TYPE_RESERVED, wk.CAPACITY_TYPE_ON_DEMAND])
+            )
+            reqs.remove(wk.RESERVATION_ID_LABEL_KEY)
+        return not any(reqs.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None for o in it.offerings)
+
     @staticmethod
     def _static_drift(nc, pool) -> bool:
+        """Hash drift gated on matching hash VERSIONS on both sides
+        (drift.go:154-168)."""
+        pool_hash = pool.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION_KEY, pool.hash())
+        pool_ver = pool.metadata.annotations.get(wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY)
         claim_hash = nc.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION_KEY)
-        return claim_hash is not None and claim_hash != pool.hash()
+        claim_ver = nc.metadata.annotations.get(wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY)
+        if claim_hash is None:
+            return False
+        if pool_ver is not None and claim_ver is not None and pool_ver != claim_ver:
+            return False
+        return claim_hash != pool_hash
 
     @staticmethod
     def _requirement_drift(nc, pool) -> bool:

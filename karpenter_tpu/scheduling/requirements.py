@@ -296,6 +296,10 @@ class Requirements:
         """Overwrite (not intersect) the requirement for req.key."""
         self._m[req.key] = req
 
+    def remove(self, key: str) -> None:
+        """Drop the requirement for key if present (no-op otherwise)."""
+        self._m.pop(wk.normalize_key(key), None)
+
     def get(self, key: str) -> Requirement:
         """Undefined keys behave as Exists (requirements.go:160-166).
         Lookup keys are normalized like stored keys (beta aliases resolve)."""

@@ -198,3 +198,167 @@ class TestBufferProvisioning:
         env.store.delete("CapacityBuffer", "buf")
         env.settle(rounds=25, step_seconds=60.0)
         assert env.store.count("Node") == 0
+
+
+class TestBufferDepth:
+    """Second tranche ported from regression/capacitybuffer_test.go:109-763."""
+
+    def test_status_updates_when_pod_template_updated(self):
+        # :109 — editing the PodTemplate reshapes the provisioned headroom
+        env = make_env()
+        env.store.create(pod_template(cpu="1", memory="1Gi"))
+        env.store.create(buffer(replicas=2))
+        env.settle()
+        cpu_before = sum(n.status.allocatable["cpu"].milli for n in env.store.list("Node"))
+
+        def grow(t):
+            t.template_spec.containers[0].resources["requests"] = parse_resource_list({"cpu": "4", "memory": "8Gi"})
+
+        env.store.patch("PodTemplate", "chunk", grow)
+        env.settle(rounds=8)
+        cpu_after = sum(n.status.allocatable["cpu"].milli for n in env.store.list("Node"))
+        assert cpu_after >= 8000 and cpu_after > cpu_before
+
+    def test_recovers_when_scalable_ref_created_after_buffer(self):
+        # :212 — the buffer waits NotReady until its Deployment appears
+        env = make_env()
+        env.store.create(buffer(name="late", scalable=ScalableRef(kind="Deployment", name="web"), percentage=50))
+        env.capacity_buffer.reconcile()
+        cb = env.store.get("CapacityBuffer", "late")
+        assert not cb.status.conditions.is_true(COND_READY_FOR_PROVISIONING)
+        dep = Deployment(metadata=ObjectMeta(name="web"))
+        dep.replicas = 4
+        dep.template_spec = PodSpec(containers=[Container(resources={"requests": parse_resource_list({"cpu": "1"})})])
+        env.store.create(dep)
+        env.clock.step(31)  # the controller re-resolves on a 30s cadence
+        env.capacity_buffer.reconcile()
+        cb = env.store.get("CapacityBuffer", "late")
+        assert cb.status.conditions.is_true(COND_READY_FOR_PROVISIONING)
+        assert cb.status.replicas == 2  # 50% of 4
+
+    def test_consume_then_refill_cycle(self):
+        # :239/:283 — consumers soak the headroom, the buffer refills it
+        env = make_env()
+        env.store.create(pod_template(cpu="2", memory="4Gi"))
+        env.store.create(buffer(replicas=2))
+        env.settle()
+        cpu_headroom = sum(n.status.allocatable["cpu"].milli for n in env.store.list("Node"))
+        for i in range(2):
+            env.store.create(make_pod(cpu="2", memory="4Gi", name=f"consumer-{i}"))
+        env.settle(rounds=8, step_seconds=31.0)
+        assert all(env.store.get("Pod", f"consumer-{i}").spec.node_name for i in range(2))
+        # refilled: capacity grew to cover consumers AND restored headroom
+        # (>= one extra 2-cpu replica chunk net of allocatable overhead)
+        cpu_after = sum(n.status.allocatable["cpu"].milli for n in env.store.list("Node"))
+        assert cpu_after >= cpu_headroom + 3500
+
+    def test_scales_down_when_replicas_reduced(self):
+        # :399
+        # one node per replica (200-cpu chunks can't share even the largest catalog box), so
+        # shrinking strands whole nodes that emptiness then reclaims
+        env = make_env()
+        env.store.create(pod_template(cpu="200", memory="4Gi"))
+        env.store.create(buffer(replicas=3))
+        env.settle()
+        assert env.store.count("Node") == 3
+
+        def shrink(b):
+            b.spec.replicas = 1
+
+        env.store.patch("CapacityBuffer", "buf", shrink)
+        env.settle(rounds=30, step_seconds=60.0)
+        assert env.store.count("Node") == 1
+
+    def test_percentage_follows_deployment_scale(self):
+        # :422
+        env = make_env()
+        dep = Deployment(metadata=ObjectMeta(name="web"))
+        dep.replicas = 2
+        dep.template_spec = PodSpec(containers=[Container(resources={"requests": parse_resource_list({"cpu": "1"})})])
+        env.store.create(dep)
+        env.store.create(buffer(name="pct", scalable=ScalableRef(kind="Deployment", name="web"), percentage=100))
+        env.capacity_buffer.reconcile()
+        assert env.store.get("CapacityBuffer", "pct").status.replicas == 2
+
+        def scale(d):
+            d.replicas = 6
+
+        env.store.patch("Deployment", "web", scale)
+        env.clock.step(31)  # 30s re-resolve cadence
+        env.capacity_buffer.reconcile()
+        assert env.store.get("CapacityBuffer", "pct").status.replicas == 6
+
+    def test_nodepool_limits_cap_buffer_capacity(self):
+        # :473 — buffer headroom respects NodePool CPU limits
+        env = Environment(options=Options(feature_gates=FeatureGates(capacity_buffer=True)))
+        env.store.create(make_nodepool(requirements=LINUX_AMD64, limits={"cpu": "4"}))
+        env.store.create(pod_template(cpu="2", memory="4Gi"))
+        env.store.create(buffer(replicas=10))
+        env.settle(rounds=8)
+        total_cpu = sum(n.status.allocatable["cpu"].milli for n in env.store.list("Node"))
+        assert total_cpu <= 8000  # one oversized box at most; never 10x2cpu
+
+    def test_multiple_buffers_provision_independently(self):
+        # :504
+        env = make_env()
+        env.store.create(pod_template(name="small", cpu="1", memory="1Gi"))
+        env.store.create(pod_template(name="large", cpu="4", memory="8Gi"))
+        env.store.create(buffer(name="buf-s", template="small", replicas=2))
+        env.store.create(buffer(name="buf-l", template="large", replicas=1))
+        env.settle()
+        total_cpu = sum(n.status.allocatable["cpu"].milli for n in env.store.list("Node"))
+        assert total_cpu >= 6000  # 2x1 + 1x4
+
+    def test_rapid_create_delete_does_not_leak(self):
+        # :557
+        env = make_env()
+        env.store.create(pod_template(cpu="2", memory="4Gi"))
+        env.store.create(buffer(replicas=2))
+        env.capacity_buffer.reconcile()
+        env.store.delete("CapacityBuffer", "buf")
+        env.settle(rounds=25, step_seconds=60.0)
+        assert env.store.count("Node") == 0
+        assert env.store.count("NodeClaim") == 0
+
+    def test_coexists_with_real_pods_on_same_node(self):
+        # :601 — real pods and headroom share capacity on one box
+        env = make_env()
+        env.store.create(pod_template(cpu="1", memory="1Gi"))
+        env.store.create(buffer(replicas=1))
+        env.store.create(make_pod(cpu="1", memory="1Gi", name="real"))
+        env.settle()
+        assert env.store.get("Pod", "real").spec.node_name
+        total_cpu = sum(n.status.allocatable["cpu"].milli for n in env.store.list("Node"))
+        assert total_cpu >= 2000
+
+    def test_pod_template_node_selector_respected(self):
+        # :645 — headroom lands only on nodes matching the template selector
+        env = make_env()
+        tpl = PodTemplate(
+            metadata=ObjectMeta(name="zonal"),
+            template_spec=PodSpec(
+                containers=[Container(resources={"requests": parse_resource_list({"cpu": "2"})})],
+                node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"},
+            ),
+        )
+        env.store.create(tpl)
+        env.store.create(buffer(template="zonal", replicas=2))
+        env.settle()
+        nodes = env.store.list("Node")
+        assert nodes and all(n.metadata.labels.get(wk.ZONE_LABEL_KEY) == "test-zone-b" for n in nodes)
+
+    def test_buffer_grows_when_limits_increased(self):
+        # :725 — a limits-bounded buffer grows as its limits grow
+        env = make_env()
+        env.store.create(pod_template(cpu="2", memory="4Gi"))
+        env.store.create(buffer(replicas=4, limits={"cpu": "2"}))
+        env.settle()
+        cpu_before = sum(n.status.allocatable["cpu"].milli for n in env.store.list("Node"))
+
+        def raise_limits(b):
+            b.spec.limits = parse_resource_list({"cpu": "8"})
+
+        env.store.patch("CapacityBuffer", "buf", raise_limits)
+        env.settle(rounds=8, step_seconds=31.0)
+        cpu_after = sum(n.status.allocatable["cpu"].milli for n in env.store.list("Node"))
+        assert cpu_after > cpu_before and cpu_after >= 8000

@@ -1,0 +1,195 @@
+"""Drift-detection depth specs ported from the reference's
+nodeclaim/disruption/drift_test.go: stale instance-type drift, detection
+precedence, hash-version gating, and condition lifecycle."""
+
+import pytest
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import COND_DRIFTED, COND_LAUNCHED
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+HOUR = 3600.0
+
+
+class _DriftKnob:
+    """A scriptable drift/instance-type view over the KWOK provider."""
+
+    def __init__(self, env):
+        self.env = env
+        self.kwok = env.base_cloud_provider
+        self.drifted = ""
+        self.kwok.is_drifted = lambda nc: self.drifted
+
+    @property
+    def instance_types(self):
+        return self.kwok.instance_types
+
+    @instance_types.setter
+    def instance_types(self, its):
+        self.kwok.instance_types = its
+
+
+def provisioned_env():
+    env = Environment(options=Options())
+    env.store.create(make_nodepool(requirements=LINUX_AMD64))
+    env.store.create(make_pod(cpu="1", name="w0"))
+    env.settle(rounds=6)
+    assert env.store.count("NodeClaim") == 1
+    return env, _DriftKnob(env)
+
+
+def claim(env):
+    return env.store.list("NodeClaim")[0]
+
+
+def reconcile_drift(env):
+    env.nodeclaim_disruption.reconcile()
+    return claim(env)
+
+
+class TestStaleInstanceType:
+    def test_missing_instance_type_label_drifts_after_delay(self):
+        # drift_test.go:86
+        env, cp = provisioned_env()
+
+        def strip(nc):
+            nc.metadata.labels.pop(wk.INSTANCE_TYPE_LABEL_KEY, None)
+
+        env.store.patch("NodeClaim", claim(env).metadata.name, strip)
+        # within the first hour staleness isn't evaluated
+        assert not reconcile_drift(env).status.conditions.is_true(COND_DRIFTED)
+        env.clock.step(HOUR + 1)
+        assert reconcile_drift(env).status.conditions.is_true(COND_DRIFTED)
+        assert reconcile_drift(env).status.conditions.get(COND_DRIFTED).reason == "InstanceTypeNotFound"
+
+    def test_vanished_instance_type_drifts(self):
+        # drift_test.go:95
+        env, cp = provisioned_env()
+        it_name = claim(env).metadata.labels[wk.INSTANCE_TYPE_LABEL_KEY]
+        cp.instance_types = [it for it in cp.instance_types if it.name != it_name]
+        env.clock.step(HOUR + 1)
+        nc = reconcile_drift(env)
+        assert nc.status.conditions.is_true(COND_DRIFTED)
+        assert nc.status.conditions.get(COND_DRIFTED).reason == "InstanceTypeNotFound"
+
+    def test_incompatible_offerings_drift(self):
+        # drift_test.go:116 — the claim's zone label no longer matches any
+        # offering of its instance type
+        env, cp = provisioned_env()
+
+        def move_zone(nc):
+            nc.metadata.labels[wk.ZONE_LABEL_KEY] = "test-zone-nowhere"
+
+        env.store.patch("NodeClaim", claim(env).metadata.name, move_zone)
+        env.clock.step(HOUR + 1)
+        nc = reconcile_drift(env)
+        assert nc.status.conditions.is_true(COND_DRIFTED)
+        assert nc.status.conditions.get(COND_DRIFTED).reason == "InstanceTypeNotFound"
+
+    def test_fresh_claim_not_checked_for_staleness(self):
+        env, cp = provisioned_env()
+        it_name = claim(env).metadata.labels[wk.INSTANCE_TYPE_LABEL_KEY]
+        cp.instance_types = [it for it in cp.instance_types if it.name != it_name]
+        assert not reconcile_drift(env).status.conditions.is_true(COND_DRIFTED)
+
+
+class TestDriftPrecedence:
+    def test_static_drift_beats_cloud_provider_drift(self):
+        # drift_test.go:134
+        env, cp = provisioned_env()
+        cp.drifted = "CloudProviderDrifted"
+
+        def stale_hash(nc):
+            nc.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = "stale"
+
+        env.store.patch("NodeClaim", claim(env).metadata.name, stale_hash)
+        nc = reconcile_drift(env)
+        assert nc.status.conditions.get(COND_DRIFTED).reason == "NodePoolDrifted"
+
+    def test_requirement_drift_beats_cloud_provider_drift(self):
+        # drift_test.go:151
+        env, cp = provisioned_env()
+        cp.drifted = "CloudProviderDrifted"
+        np = env.store.list("NodePool")[0]
+
+        def arm_only(p):
+            p.spec.template.requirements = [
+                {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["arm64"]},
+            ]
+
+        env.store.patch("NodePool", np.metadata.name, arm_only)
+        nc = reconcile_drift(env)
+        assert nc.status.conditions.get(COND_DRIFTED).reason == "RequirementsDrifted"
+
+    def test_cloud_provider_drift_reported_last(self):
+        env, cp = provisioned_env()
+        cp.drifted = "CloudProviderDrifted"
+        nc = reconcile_drift(env)
+        assert nc.status.conditions.get(COND_DRIFTED).reason == "CloudProviderDrifted"
+
+
+class TestDriftConditionLifecycle:
+    def test_unlaunched_claim_clears_condition(self):
+        # drift_test.go:166/:178
+        env, cp = provisioned_env()
+        cp.drifted = "CloudProviderDrifted"
+        assert reconcile_drift(env).status.conditions.is_true(COND_DRIFTED)
+
+        def unlaunch(nc):
+            nc.status.conditions.set_false(COND_LAUNCHED, "LaunchFailed", "boom")
+
+        env.store.patch("NodeClaim", claim(env).metadata.name, unlaunch)
+        nc = reconcile_drift(env)
+        assert not nc.status.conditions.is_true(COND_DRIFTED)
+
+    def test_condition_removed_when_no_longer_drifted(self):
+        # drift_test.go:198
+        env, cp = provisioned_env()
+        cp.drifted = "CloudProviderDrifted"
+        assert reconcile_drift(env).status.conditions.is_true(COND_DRIFTED)
+        cp.drifted = ""
+        assert not reconcile_drift(env).status.conditions.is_true(COND_DRIFTED)
+
+    def test_hash_version_mismatch_blocks_static_drift(self):
+        # drift_test.go:498 — differing hash VERSIONS veto hash comparison
+        env, cp = provisioned_env()
+        np = env.store.list("NodePool")[0]
+
+        def ver_pool(p):
+            p.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = "hash-a"
+            p.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = "v2"
+
+        env.store.patch("NodePool", np.metadata.name, ver_pool)
+
+        def ver_claim(nc):
+            nc.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = "hash-b"
+            nc.metadata.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = "v1"
+
+        env.store.patch("NodeClaim", claim(env).metadata.name, ver_claim)
+        nc = reconcile_drift(env)
+        assert not nc.status.conditions.is_true(COND_DRIFTED)
+
+    def test_claim_without_hash_annotation_no_static_drift(self):
+        # drift_test.go:489
+        env, cp = provisioned_env()
+        np = env.store.list("NodePool")[0]
+
+        def strip(nc):
+            nc.metadata.annotations.pop(wk.NODEPOOL_HASH_ANNOTATION_KEY, None)
+            nc.metadata.annotations.pop(wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY, None)
+
+        env.store.patch("NodeClaim", claim(env).metadata.name, strip)
+
+        def rehash(p):
+            p.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = "different"
+
+        env.store.patch("NodePool", np.metadata.name, rehash)
+        nc = reconcile_drift(env)
+        assert not nc.status.conditions.is_true(COND_DRIFTED)

@@ -88,3 +88,132 @@ class TestVolumeAttachmentWait:
         for _ in range(3):
             env.tick(provision_force=False)
         assert env.store.try_get("Node", node.metadata.name) is None
+
+
+def drain_rounds(env, rounds=10):
+    for _ in range(rounds):
+        env.termination.reconcile()
+        env.clock.step(2.0)
+
+
+class TestDrainDepth:
+    """Drain-order specs ported from node/termination/suite_test.go:112-563."""
+
+    def test_deletes_node_and_claim(self):
+        # :112/:152
+        env, node = env_with_node()
+        env.store.delete("Node", node.metadata.name)
+        drain_rounds(env)
+        env.settle(rounds=4)
+        assert env.store.try_get("Node", node.metadata.name) is None
+
+    def test_disruption_taint_toleration_equal_not_evicted(self):
+        # :225 — pods tolerating the karpenter disrupted taint (Equal) ride
+        # the node down: never reset to pending, deleted with the instance
+        pod = make_pod(
+            cpu="1",
+            name="rider",
+            tolerations=[{"key": wk.DISRUPTED_TAINT_KEY, "operator": "Equal", "value": "", "effect": "NoSchedule"}],
+        )
+        env, node = env_with_node(pod)
+        env.store.delete("Node", node.metadata.name)
+        drain_rounds(env)
+        # pod was never evicted-to-pending; it vanished with the node
+        assert env.store.try_get("Node", node.metadata.name) is None
+        assert env.store.try_get("Pod", "rider") is None
+
+    def test_disruption_taint_toleration_exists_not_evicted(self):
+        # :256 — Exists operator tolerates too
+        pod = make_pod(
+            cpu="1",
+            name="rider2",
+            tolerations=[{"key": wk.DISRUPTED_TAINT_KEY, "operator": "Exists"}],
+        )
+        env, node = env_with_node(pod)
+        env.store.delete("Node", node.metadata.name)
+        drain_rounds(env)
+        assert env.store.try_get("Node", node.metadata.name) is None
+        assert env.store.try_get("Pod", "rider2") is None
+
+    def test_unschedulable_toleration_still_evicted(self):
+        # :289 — tolerating node.kubernetes.io/unschedulable does NOT opt a
+        # pod out of drain
+        pod = make_pod(
+            cpu="1",
+            name="w1",
+            tolerations=[{"key": "node.kubernetes.io/unschedulable", "operator": "Exists", "effect": "NoSchedule"}],
+        )
+        env, node = env_with_node(pod)
+        env.store.delete("Node", node.metadata.name)
+        env.termination.reconcile()
+        p = env.store.get("Pod", "w1")
+        assert p.spec.node_name == "" and p.status.phase == "Pending"
+
+    def test_evicts_lower_priority_groups_first(self):
+        # :485 — non-critical pods drain before high-priority ones
+        env = Environment(options=Options())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        env.store.create(make_pod(cpu="500m", name="low", priority=0))
+        env.store.create(make_pod(cpu="500m", name="high", priority=1000))
+        env.settle(rounds=6)
+        nodes = env.store.list("Node")
+        assert len(nodes) == 1
+        env.store.delete("Node", nodes[0].metadata.name)
+        env.termination.reconcile()
+        low, high = env.store.get("Pod", "low"), env.store.get("Pod", "high")
+        assert low.spec.node_name == "", "low priority evicts in the first pass"
+        assert high.spec.node_name != "", "high priority drains in a later pass"
+        env.termination.reconcile()
+        assert env.store.get("Pod", "high").spec.node_name == ""
+
+    def test_static_node_owned_pods_not_evicted(self):
+        # :523 — static (node-owned) pods are never evicted; they go down
+        # with the node
+        from karpenter_tpu.kube.objects import OwnerReference
+
+        env, node = env_with_node()
+        static = make_pod(cpu="100m", name="static-pod", node_name=node.metadata.name)
+        static.metadata.owner_references = [OwnerReference(kind="Node", name=node.metadata.name, uid="u-node")]
+        env.store.create(static)
+        env.store.delete("Node", node.metadata.name)
+        drain_rounds(env)
+        assert env.store.try_get("Node", node.metadata.name) is None
+        assert env.store.try_get("Pod", "static-pod") is None  # deleted with node, never pending
+
+    def test_terminal_pods_do_not_block(self):
+        # :348 — Succeeded/Failed pods don't hold the drain open
+        env, node = env_with_node()
+
+        def finish(p):
+            p.status.phase = "Succeeded"
+
+        env.store.patch("Pod", "w0", finish)
+        env.store.delete("Node", node.metadata.name)
+        drain_rounds(env, rounds=4)
+        assert env.store.try_get("Node", node.metadata.name) is None
+
+    def test_node_survives_until_drain_completes(self):
+        # :564 — with a blocking PDB the node lingers; unblocked it goes
+        from karpenter_tpu.kube.objects import PodDisruptionBudget
+
+        sel = {"matchLabels": {"app": "guarded"}}
+        pod = make_pod(cpu="1", name="guarded", labels={"app": "guarded"})
+        env, node = env_with_node(pod)
+        env.store.create(
+            PodDisruptionBudget(metadata=ObjectMeta(name="pdb"), selector=sel, max_unavailable=0)
+        )
+        env.store.delete("Node", node.metadata.name)
+        drain_rounds(env, rounds=4)
+        assert env.store.try_get("Node", node.metadata.name) is not None, "PDB blocks the drain"
+        env.store.delete("PodDisruptionBudget", "pdb")
+        drain_rounds(env, rounds=6)
+        assert env.store.try_get("Node", node.metadata.name) is None
+
+    def test_termination_metrics_fire(self):
+        # :975/:989
+        from karpenter_tpu import metrics as m
+
+        env, node = env_with_node()
+        env.store.delete("Node", node.metadata.name)
+        drain_rounds(env)
+        assert env.registry.counter(m.NODES_TERMINATED_TOTAL).total() >= 1
