@@ -110,13 +110,15 @@ class NodeClaimDisruptionController:
     def _static_drift(nc, pool) -> bool:
         """Hash drift gated on matching hash VERSIONS on both sides
         (drift.go:154-168)."""
-        pool_hash = pool.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION_KEY, pool.hash())
+        pool_hash = pool.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION_KEY)
         pool_ver = pool.metadata.annotations.get(wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY)
         claim_hash = nc.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION_KEY)
         claim_ver = nc.metadata.annotations.get(wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY)
-        if claim_hash is None:
+        # all four annotations must exist and the versions must match before
+        # hashes are comparable — cross-version hashes are never compared
+        if pool_hash is None or pool_ver is None or claim_hash is None or claim_ver is None:
             return False
-        if pool_ver is not None and claim_ver is not None and pool_ver != claim_ver:
+        if pool_ver != claim_ver:
             return False
         return claim_hash != pool_hash
 

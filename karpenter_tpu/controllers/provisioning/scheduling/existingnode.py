@@ -16,7 +16,7 @@ from ....utils.quantity import Quantity
 
 
 class ExistingNode:
-    def __init__(self, state_node, topology, taints, daemon_resources: dict[str, Quantity], is_under_consolidate_after: bool = False, allocator=None):
+    def __init__(self, state_node, topology, taints, daemon_resources: dict[str, Quantity], is_under_consolidate_after: bool = False, allocator=None, daemon_pods: list | None = None):
         self.state_node = state_node
         self.topology = topology
         self.taints = taints
@@ -33,6 +33,15 @@ class ExistingNode:
         self.remaining_resources = res.subtract(remaining, daemon_headroom)
 
         self.host_port_usage = state_node.host_port_usage.copy()
+        # phantom daemon port headroom: this substrate has no DaemonSet
+        # controller materializing daemon pods, so compatible daemons that
+        # haven't landed yet reserve their ports here the same way their
+        # resources reserve headroom above; a port already held by a real
+        # daemon pod stays held (the conflicting add is skipped)
+        for d in daemon_pods or []:
+            ports = pod_host_ports(d)
+            if ports and self.host_port_usage.conflicts(d.key(), ports) is None:
+                self.host_port_usage.add(f"daemon-headroom/{d.key()}", ports)
         self.volume_usage = state_node.volume_usage.copy()
         self.requirements = Requirements.from_labels(state_node.labels())
         self.requirements.add(Requirement(wk.HOSTNAME_LABEL_KEY, "In", [state_node.hostname()]))

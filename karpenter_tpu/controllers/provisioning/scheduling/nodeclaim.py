@@ -83,6 +83,11 @@ class NodeClaimTemplate:
         self.labels[wk.NODEPOOL_LABEL_KEY] = node_pool.metadata.name
         self.annotations = dict(node_pool.spec.template.annotations)
         self.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = node_pool.hash()
+        # both hash AND hash-version propagate to claims (nodeclaimtemplate.go);
+        # static drift only compares hashes under matching versions
+        from ...nodepool.hash import NODEPOOL_HASH_VERSION
+
+        self.annotations[wk.NODEPOOL_HASH_VERSION_ANNOTATION_KEY] = NODEPOOL_HASH_VERSION
         self.taints = list(node_pool.spec.template.taints)
         self.startup_taints = list(node_pool.spec.template.startup_taints)
         self.instance_type_options: list[InstanceType] = []

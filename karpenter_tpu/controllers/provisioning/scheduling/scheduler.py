@@ -161,7 +161,7 @@ class Scheduler:
                 np = nodepool_map.get(sn.nodepool_name())
                 under_ca = _is_under_consolidate_after(np, sn.node_claim, clock)
             self.existing_nodes.append(
-                ExistingNode(sn, self.topology, taints, res.requests_for_pods(daemons), under_ca, allocator=self.allocator)
+                ExistingNode(sn, self.topology, taints, res.requests_for_pods(daemons), under_ca, allocator=self.allocator, daemon_pods=daemons)
             )
             self._update_remaining_resources(sn)
 
@@ -342,6 +342,13 @@ def _compute_daemon_overhead_groups(template: NodeClaimTemplate, daemonset_pods:
         if g is None:
             overhead = res.requests_for_pods(compatible)
             g = DaemonOverheadGroup(instance_types=[], daemon_overhead=overhead)
+            # daemons reserve their host ports on every fresh node of this
+            # group (suite_test.go:955 "should account for daemonset
+            # hostports": a pod sharing the port can never schedule there)
+            from ....scheduling.hostports import pod_host_ports
+
+            for d in compatible:
+                g.host_port_usage.add(d.key(), pod_host_ports(d))
             groups[key] = g
         g.instance_types.append(it)
     return list(groups.values())

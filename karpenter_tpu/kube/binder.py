@@ -9,6 +9,7 @@ node-selector/affinity, registered + schedulable.
 from __future__ import annotations
 
 from ..apis import labels as wk
+from ..scheduling.hostports import HostPortUsage, pod_host_ports
 from ..scheduling.requirements import Requirements
 from ..scheduling.taints import taints_tolerate_pod
 from ..utils import pods as pod_utils
@@ -29,6 +30,12 @@ class Binder:
         nodes = sorted(self.store.list("Node"), key=lambda n: n.metadata.name)
         node_reqs = {n.metadata.name: Requirements.from_labels(n.metadata.labels) for n in nodes}
         all_pods = self.store.list("Pod")
+        # per-node host-port usage, built once per pass from ACTIVE bound
+        # pods (terminal pods free their ports, as in Kubernetes)
+        self._port_usage = {}
+        for q in all_pods:
+            if q.spec.node_name and pod_utils.is_active(q):
+                self._port_usage.setdefault(q.spec.node_name, HostPortUsage()).add(q.key(), pod_host_ports(q))
         self._dra_allocator = None  # fresh per pass
         for pod in all_pods:
             if not pod_utils.is_provisionable(pod):
@@ -37,6 +44,7 @@ class Binder:
             if node is not None:
                 self._bind(pod, node)
                 pod.spec.node_name = node.metadata.name  # keep local view current for spread counting
+                self._port_usage.setdefault(node.metadata.name, HostPortUsage()).add(pod.key(), pod_host_ports(pod))
                 bound += 1
         return bound
 
@@ -78,10 +86,22 @@ class Binder:
                 continue
             if not self._topology_ok(pod, node, nodes, all_pods):
                 continue
+            if not self._ports_ok(pod, node, all_pods):
+                continue
             if not self._dra_ok(pod, node):
                 continue
             return node
         return None
+
+    def _ports_ok(self, pod, node, all_pods) -> bool:
+        """The kube-scheduler NodePorts plugin: a pod with host ports cannot
+        land on a node where an ACTIVE bound pod already holds a conflicting
+        port (terminal pods free theirs)."""
+        ports = pod_host_ports(pod)
+        if not ports:
+            return True
+        usage = self._port_usage.get(node.metadata.name)
+        return usage is None or usage.conflicts(pod.key(), ports) is None
 
     def _topology_ok(self, pod, node, nodes, all_pods) -> bool:
         """Honor DoNotSchedule spread constraints and required hostname

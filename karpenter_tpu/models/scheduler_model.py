@@ -85,6 +85,10 @@ class SchedulerTensors:
     existing_port_any: jnp.ndarray  # [n_existing, P1] bool
     existing_port_wild: jnp.ndarray  # [n_existing, P1] bool
     existing_port_spec: jnp.ndarray  # [n_existing, P2] bool
+    # daemon-reserved ports per row: fresh slots open holding these
+    row_port_any: jnp.ndarray  # [Nrows, P1] bool
+    row_port_wild: jnp.ndarray  # [Nrows, P1] bool
+    row_port_spec: jnp.ndarray  # [Nrows, P2] bool
     dom_keys: tuple  # static: vocab key id per dom key (-1 if absent)
     n_existing: int  # static
     n_slots: int  # static
@@ -117,6 +121,9 @@ jax.tree_util.register_dataclass(
         "existing_port_any",
         "existing_port_wild",
         "existing_port_spec",
+        "row_port_any",
+        "row_port_wild",
+        "row_port_spec",
     ],
     meta_fields=["dom_keys", "n_existing", "n_slots"],
 )
@@ -198,6 +205,9 @@ def make_tensors(enc, n_slots: int | None = None, with_pods: bool = True) -> Sch
         existing_port_any=jnp.asarray(enc.existing_port_any),
         existing_port_wild=jnp.asarray(enc.existing_port_wild),
         existing_port_spec=jnp.asarray(enc.existing_port_spec),
+        row_port_any=jnp.asarray(enc.row_port_any),
+        row_port_wild=jnp.asarray(enc.row_port_wild),
+        row_port_spec=jnp.asarray(enc.row_port_spec),
         dom_keys=tuple(enc.dom_vocab_keys),
         n_existing=enc.n_existing,
         n_slots=int(n_slots),

@@ -338,6 +338,15 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_ex
         slot_compat = slot_compat_of(slot_basis)
 
         fits_row = is_offering_row & compat_rows & jnp.all(req[None, :] <= t.row_alloc, axis=1)
+        # rows whose daemon-reserved ports conflict with this item can never
+        # host it (hostportusage.go; daemons hold their ports on every fresh
+        # node of the row)
+        row_port_conflict = (
+            jnp.any(t.row_port_any & pwild[None, :], axis=1)
+            | jnp.any(t.row_port_wild & pany[None, :], axis=1)
+            | jnp.any(t.row_port_spec & pspec[None, :], axis=1)
+        )
+        fits_row &= ~row_port_conflict
         row_cap = _int_cap(t.row_alloc, req)  # [Nrows]
 
         # per-group domain feasibility at step entry (used by the strict
@@ -395,6 +404,10 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_ex
             slot_rem = slot_rem - take[:, None].astype(slot_rem.dtype) * req[None, :]
             counts_host = counts_host + jnp.where(host_member_mask[:, None], take[None, :], 0)
             slot_pany, slot_pwild, slot_pspec = ports
+            # fresh slots open already holding their row's daemon ports
+            slot_pany = jnp.where(is_new[:, None], t.row_port_any[o][None, :], slot_pany)
+            slot_pwild = jnp.where(is_new[:, None], t.row_port_wild[o][None, :], slot_pwild)
+            slot_pspec = jnp.where(is_new[:, None], t.row_port_spec[o][None, :], slot_pspec)
             slot_pany = jnp.where(touched[:, None], slot_pany | pany[None, :], slot_pany)
             slot_pwild = jnp.where(touched[:, None], slot_pwild | pwild[None, :], slot_pwild)
             slot_pspec = jnp.where(touched[:, None], slot_pspec | pspec[None, :], slot_pspec)

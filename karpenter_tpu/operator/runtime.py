@@ -45,6 +45,7 @@ from ..controllers.metrics import (
 from ..events import Recorder
 from ..kube import Store
 from ..kube.binder import Binder
+from ..kube.daemonsets import DaemonSetRunner
 from ..metrics import make_registry
 from ..solver import FFDSolver
 from ..state import Cluster
@@ -125,6 +126,7 @@ class Environment:
         )
         self.gc = GarbageCollectionController(self.store, self.cluster, self.cloud_provider, self.clock)
         self.binder = Binder(self.store, self.cluster, self.clock, dra_enabled=self.options.feature_gates.dynamic_resources)
+        self.daemonset_runner = DaemonSetRunner(self.store, self.clock)
         self.termination = TerminationController(
             self.store, self.cluster, self.cloud_provider, self.clock,
             recorder=self.recorder, metrics=self.registry,
@@ -192,6 +194,10 @@ class Environment:
         self.gc.reconcile()
         if self.options.feature_gates.dynamic_resources:
             self.dra_kwok_driver.reconcile()
+        # the DaemonSet controller stand-in materializes daemon pods on
+        # registered nodes BEFORE the binder pass, so the binder's NodePorts
+        # and resource checks see them like the real kube-scheduler would
+        self.daemonset_runner.reconcile()
         self.binder.bind_all()
         if self.options.feature_gates.dynamic_resources:
             self.device_allocation.reconcile()

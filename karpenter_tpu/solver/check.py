@@ -189,6 +189,15 @@ def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_doms
             any_cnt[:n_ex] += enc.existing_port_any[:n_ex]
             wild_cnt[:n_ex] += enc.existing_port_wild[:n_ex]
             spec_cnt[:n_ex] += enc.existing_port_spec[:n_ex]
+        # fresh slots hold their basis row's daemon-reserved ports
+        if enc.row_port_any.any():
+            used = np.unique(slots)
+            new_used = used[used >= n_ex]
+            if new_used.size:
+                rows_used = slot_basis[new_used].astype(np.int64)
+                any_cnt[new_used] += enc.row_port_any[rows_used]
+                wild_cnt[new_used] += enc.row_port_wild[rows_used]
+                spec_cnt[new_used] += enc.row_port_spec[rows_used]
         # conflict: two specific users of one (ip, port, proto), or a wildcard
         # plus ANY other user of the (port, proto) (hostportusage.go matches)
         bad = ((wild_cnt >= 1) & (any_cnt >= 2)).any(axis=1) | (spec_cnt >= 2).any(axis=1)

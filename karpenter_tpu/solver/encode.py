@@ -143,6 +143,11 @@ class EncodedSnapshot:
     existing_port_any: np.ndarray  # [n_existing, P1]
     existing_port_wild: np.ndarray  # [n_existing, P1]
     existing_port_spec: np.ndarray  # [n_existing, P2]
+    # daemon-reserved ports per row: fresh slots open with these ports held
+    # (zeros for existing rows — their ports live in existing_port_*)
+    row_port_any: np.ndarray  # [Nrows, P1]
+    row_port_wild: np.ndarray  # [Nrows, P1]
+    row_port_spec: np.ndarray  # [Nrows, P2]
 
     # keyed domain axis: each domain is an interned (dom key, value) pair;
     # dom key 0 is always the zone label; the first Kd ids are the per-key
@@ -418,13 +423,6 @@ def check_capability(snap, pods=None) -> list[str]:
     # inverse anti-affinity from already-running pods isn't tensorized
     if snap.cluster.pods_with_anti_affinity():
         reasons.append("cluster has running pods with required anti-affinity")
-    # pod host ports ARE tensorized (per-slot port bitmasks); daemons with
-    # host ports would reserve ports on every fresh node, which the slot
-    # init doesn't model — host path handles those snapshots
-    from ..scheduling.hostports import pod_host_ports
-
-    if any(pod_host_ports(d) for d in snap.daemonset_pods):
-        reasons.append("daemonset pods use host ports")
     # strict reserved-offering mode (consolidation sims) requires per-pod
     # reservation failures, which only the sequential host path expresses;
     # decode's host-side cap implements fallback mode only
@@ -562,6 +560,11 @@ class _RowArtifacts:
     row_pool_rank: np.ndarray
     row_taint_class: np.ndarray
     row_meta: list
+    # per row: daemon-reserved host ports — offering rows carry their
+    # daemon-overhead group's ports (fresh slots open holding them); existing
+    # rows carry PHANTOM daemon headroom ports (compatible daemons that have
+    # no materialized pod yet, mirroring ExistingNode's port seeding)
+    row_daemon_ports: list
     n_existing: int
     rank_domset: np.ndarray  # [Q, D]
     state_nodes: list
@@ -687,6 +690,7 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
     def intern_labels(labels: dict[str, str]) -> dict[int, int]:
         return {vocab.key_id(k): vocab.value_id(k, v) for k, v in labels.items()}
 
+    row_daemon_ports: list = []
     # existing nodes first
     state_nodes = sorted(snap.state_nodes, key=lambda n: n.name())
     for sn in state_nodes:
@@ -696,6 +700,19 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
         headroom = {k: v for k, v in headroom.items() if v.milli > 0}
         remaining = res.subtract(remaining, headroom)
         lbls = sn.labels()
+        from ..scheduling.hostports import pod_host_ports as _php
+
+        # phantom daemon headroom ports, using the SAME wildcard-aware
+        # conflict rule ExistingNode seeding uses (a phantom that conflicts
+        # with a real pod's port is skipped — the port is held either way)
+        usage = sn.host_port_usage.copy()
+        phantom = []
+        for d in daemons:
+            hps = _php(d)
+            if hps and usage.conflicts(d.key(), hps) is None:
+                usage.add(f"daemon-headroom/{d.key()}", hps)
+                phantom.extend(hps)
+        row_daemon_ports.append(phantom)
         row_alloc_l.append(rl_to_vec(remaining))
         row_price_l.append(0.0)
         row_labels_l.append(intern_labels(lbls))
@@ -721,9 +738,12 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
     for rank, t in enumerate(templates):
         groups = _compute_daemon_overhead_groups(t, snap.daemonset_pods)
         overhead_by_it = {}
+        ports_by_it = {}
         for g in groups:
+            gports = g.host_port_usage.all_ports()
             for it in g.instance_types:
                 overhead_by_it[id(it)] = g.daemon_overhead
+                ports_by_it[id(it)] = gports
         tmpl_label_ids = intern_labels(t.labels)
         tclass = taint_class(t.taints)
         tmpl_dom = [t.labels.get(key) for key in dom_keys]
@@ -770,6 +790,7 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
                 row_dom_l.append([dom_id(k, v) if v else dom_sentinel[k] for k, v in enumerate(o_dom)])
                 row_rank_l.append(rank)
                 row_taint_l.append(tclass)
+                row_daemon_ports.append(ports_by_it.get(id(it), []))
                 row_meta.append(("offering", t, it, o))
 
     n_rows = len(row_meta)
@@ -846,6 +867,7 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
         row_pool_rank=np.array(row_rank_l, dtype=np.int32),
         row_taint_class=np.array(row_taint_l, dtype=np.int32),
         row_meta=row_meta,
+        row_daemon_ports=row_daemon_ports,
         n_existing=n_existing,
         rank_domset=rank_domset,
         state_nodes=state_nodes,
@@ -1021,13 +1043,21 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     sig_ports = [pod_host_ports(p) for p in rep_pods]
     if any(sig_ports):
         # the state node already tracks its bound pods' ports
-        # (statenode.py:154); read it rather than re-deriving via store walks
-        existing_ports = [sn.host_port_usage.all_ports() for sn in state_nodes]
+        # (statenode.py:154); add the PHANTOM daemon headroom ports computed
+        # at row build (ExistingNode seeds the same set host-side)
+        existing_ports = [
+            list(sn.host_port_usage.all_ports()) + list(rows.row_daemon_ports[j])
+            for j, sn in enumerate(state_nodes)
+        ]
+        # fresh slots of a row open with its daemon group's ports reserved
+        # (suite_test.go:955; host analogue seeds DaemonOverheadGroup usage)
+        daemon_row_ports = rows.row_daemon_ports
     else:
         existing_ports = [[] for _ in state_nodes]
+        daemon_row_ports = [[] for _ in rows.row_meta]
     pk_ids: dict[tuple, int] = {}
     ps_ids: dict[tuple, int] = {}
-    for ports in sig_ports + existing_ports:
+    for ports in sig_ports + existing_ports + list(daemon_row_ports):
         for p in ports:
             pk_ids.setdefault((p.port, p.protocol), len(pk_ids))
             if p.ip != "0.0.0.0":
@@ -1050,6 +1080,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
 
     sig_port_any, sig_port_wild, sig_port_spec = port_masks(sig_ports, S)
     existing_port_any, existing_port_wild, existing_port_spec = port_masks(existing_ports, max(n_existing, 1))
+    row_port_any, row_port_wild, row_port_spec = port_masks(daemon_row_ports, max(len(rows.row_meta), 1))
 
     dom_vocab_keys = tuple(vocab.keys.get(key, -1) for key in rows.dom_key_names)
     dom_key_idx = {key: k for k, key in enumerate(rows.dom_key_names)}
@@ -1197,6 +1228,9 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         existing_port_any=existing_port_any,
         existing_port_wild=existing_port_wild,
         existing_port_spec=existing_port_spec,
+        row_port_any=row_port_any,
+        row_port_wild=row_port_wild,
+        row_port_spec=row_port_spec,
         n_doms=D,
         dom_values=dom_values,
         dom_key_of=dom_key_of,

@@ -67,7 +67,6 @@ _REASON_FAMILIES = (
     ("multiple domain keys", "multi-domain-keys"),
     ("spread taint policy", "spread-taint-policy"),
     ("node-filtered spread", "node-filtered-spread"),
-    ("host ports", "host-ports"),
     ("PVC-backed volumes", "pvc-volumes"),
     ("dynamic resource claims", "dra-claims"),
     ("running pods with required anti-affinity", "running-anti-affinity"),

@@ -51,14 +51,31 @@ def validate_results(snap, results) -> list[str]:
     from ..scheduling.hostports import HostPortUsage, pod_host_ports
 
     for idx, nc in enumerate(results.new_node_claims):
-        usage = HostPortUsage()
-        for p in nc.pods:
-            ports = pod_host_ports(p)
-            err = usage.conflicts(p.key(), ports)
-            if err is not None:
-                errors.append(f"claim {idx}: {err}")
+        # a fresh node opens with its daemon group's reserved ports
+        # (scheduler.py _compute_daemon_overhead_groups seeding); the claim is
+        # sound if SOME group consistent with its remaining instance types
+        # accepts every pod's ports
+        groups = [
+            g
+            for g in getattr(nc, "daemon_overhead_groups", [])
+            if any(it in nc.instance_type_options for it in g.instance_types)
+        ] or [None]
+        ok_any, last_err = False, None
+        for g in groups:
+            usage = g.host_port_usage.copy() if g is not None else HostPortUsage()
+            err = None
+            for p in nc.pods:
+                ports = pod_host_ports(p)
+                err = usage.conflicts(p.key(), ports)
+                if err is not None:
+                    break
+                usage.add(p.key(), ports)
+            if err is None:
+                ok_any = True
                 break
-            usage.add(p.key(), ports)
+            last_err = err
+        if not ok_any:
+            errors.append(f"claim {idx}: {last_err}")
     for en in results.existing_nodes:
         if not en.pods:
             continue

@@ -166,3 +166,25 @@ class TestDaemonOverheadDepth:
         nc = results.new_node_claims[0]
         api = nc.to_api_node_claim()
         assert api.spec.resources.get("cpu").milli >= 2000
+
+
+class TestDaemonHostPorts:
+    def test_daemon_hostport_blocks_conflicting_pod(self):
+        # suite_test.go:955 "should account for daemonset hostports" — a pod
+        # sharing a host port with a compatible daemonset can NEVER schedule:
+        # the daemon holds the port on every fresh node
+        d = daemon(cpu="500m")
+        d.spec.containers[0].ports = [{"containerPort": 8080, "hostPort": 8080}]
+        pod = make_pod(cpu="1")
+        pod.spec.containers[0].ports = [{"containerPort": 8080, "hostPort": 8080}]
+        results = solve([pod], daemons=[d])
+        assert not results.new_node_claims
+        assert pod.key() in results.pod_errors
+
+    def test_daemon_hostport_allows_disjoint_ports(self):
+        d = daemon(cpu="500m")
+        d.spec.containers[0].ports = [{"containerPort": 8080, "hostPort": 8080}]
+        pod = make_pod(cpu="1")
+        pod.spec.containers[0].ports = [{"containerPort": 9090, "hostPort": 9090}]
+        results = solve([pod], daemons=[d])
+        assert results.all_pods_scheduled()

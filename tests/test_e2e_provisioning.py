@@ -135,3 +135,70 @@ class TestEndToEnd:
         env.settle()
         total_cpu = sum(n.status.capacity["cpu"].value for n in env.store.list("Node"))
         assert total_cpu <= 4
+
+
+class TestDaemonSetRunner:
+    """The substrate's DaemonSet controller stand-in (kube/daemonsets.py):
+    daemon pods materialize on registered matching nodes so port/resource
+    accounting matches a real cluster."""
+
+    def test_daemon_pods_materialize_and_hold_ports(self):
+        from karpenter_tpu.kube import Container, ObjectMeta, PodSpec
+        from karpenter_tpu.kube.objects import DaemonSet
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        env = Environment(options=Options(solver_backend="tpu"))
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        env.store.create(
+            DaemonSet(
+                metadata=ObjectMeta(name="proxy"),
+                template_spec=PodSpec(
+                    containers=[
+                        Container(
+                            resources={"requests": parse_resource_list({"cpu": "200m"})},
+                            ports=[{"containerPort": 8080, "hostPort": 8080}],
+                        )
+                    ]
+                ),
+            )
+        )
+        clash = make_pod(cpu="1", name="clash")
+        clash.spec.containers[0].ports = [{"containerPort": 8080, "hostPort": 8080}]
+        plain = make_pod(cpu="1", name="plain")
+        env.store.create(clash)
+        env.store.create(plain)
+        env.settle(rounds=12)
+        assert env.store.get("Pod", "plain").spec.node_name
+        # suite_test.go:955 end-to-end: the daemon owns 8080 on every node —
+        # fresh at solve time, materialized once registered
+        assert not env.store.get("Pod", "clash").spec.node_name
+        daemon_pods = [
+            p for p in env.store.list("Pod") if any(o.kind == "DaemonSet" for o in p.metadata.owner_references)
+        ]
+        assert len(daemon_pods) == env.store.count("Node") == 1
+        assert daemon_pods[0].spec.node_name
+
+    def test_daemon_pods_follow_node_lifecycle(self):
+        from karpenter_tpu.kube import Container, ObjectMeta, PodSpec
+        from karpenter_tpu.kube.objects import DaemonSet
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        env = Environment(options=Options())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        env.store.create(
+            DaemonSet(
+                metadata=ObjectMeta(name="agent"),
+                template_spec=PodSpec(
+                    containers=[Container(resources={"requests": parse_resource_list({"cpu": "100m"})})]
+                ),
+            )
+        )
+        env.store.create(make_pod(cpu="1", name="w"))
+        env.settle(rounds=10)
+        assert any(o.kind == "DaemonSet" for p in env.store.list("Pod") for o in p.metadata.owner_references)
+        # deleting the DaemonSet reaps its pods
+        env.store.delete("DaemonSet", "agent")
+        env.settle(rounds=4)
+        assert not any(
+            o.kind == "DaemonSet" for p in env.store.list("Pod") for o in p.metadata.owner_references
+        )

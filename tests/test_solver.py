@@ -919,3 +919,49 @@ class TestDecodeLaunchability:
         snap.state_nodes = []
         e2 = encode(snap, cache=cache)
         assert e2.n_existing == 0, "filtered-node snapshot must rebuild rows"
+
+
+class TestDaemonPortsWindow:
+    """Daemonset host ports are IN-window: fresh slots open with their row's
+    daemon port reservations (suite_test.go:955 semantics on the tensor
+    path)."""
+
+    def _ported(self, port, cpu="1", name=None):
+        from karpenter_tpu.kube.objects import Container
+
+        p = make_pod(cpu=cpu, name=name)
+        p.spec.containers[0].ports = [{"containerPort": port, "hostPort": port, "protocol": "TCP"}]
+        return p
+
+    def _snap_with_daemon(self, pods, daemon_port=8080):
+        snap = make_snapshot(pods)
+        d = make_pod(cpu="500m", name="daemon-tpl")
+        d.spec.containers[0].ports = [{"containerPort": daemon_port, "hostPort": daemon_port, "protocol": "TCP"}]
+        snap.daemonset_pods = [d]
+        return snap
+
+    def test_conflicting_pod_unschedulable_on_both_backends(self):
+        from karpenter_tpu.solver import FFDSolver
+
+        pod = self._ported(8080, name="clash")
+        ffd = FFDSolver().solve(self._snap_with_daemon([pod]))
+        tpu = TPUSolver(force=True)
+        res = tpu.solve(self._snap_with_daemon([pod]))
+        assert tpu.last_backend == "tpu"
+        assert set(res.pod_errors) == set(ffd.pod_errors) == {pod.key()}
+        assert not res.new_node_claims
+
+    def test_disjoint_port_schedules_on_tensor_path(self):
+        pod = self._ported(9090, name="ok")
+        tpu = TPUSolver(force=True)
+        res = tpu.solve(self._snap_with_daemon([pod]))
+        assert tpu.last_backend == "tpu"
+        assert not res.pod_errors
+        assert validate_results(self._snap_with_daemon([pod]), res) == []
+
+    def test_portless_pods_unaffected_by_daemon_ports(self):
+        pods = [make_pod(cpu="1", name=f"p{i}") for i in range(3)]
+        tpu = TPUSolver(force=True)
+        res = tpu.solve(self._snap_with_daemon(pods))
+        assert tpu.last_backend == "tpu"
+        assert not res.pod_errors
