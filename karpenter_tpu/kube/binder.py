@@ -86,14 +86,14 @@ class Binder:
                 continue
             if not self._topology_ok(pod, node, nodes, all_pods):
                 continue
-            if not self._ports_ok(pod, node, all_pods):
+            if not self._ports_ok(pod, node):
                 continue
             if not self._dra_ok(pod, node):
                 continue
             return node
         return None
 
-    def _ports_ok(self, pod, node, all_pods) -> bool:
+    def _ports_ok(self, pod, node) -> bool:
         """The kube-scheduler NodePorts plugin: a pod with host ports cannot
         land on a node where an ACTIVE bound pod already holds a conflicting
         port (terminal pods free theirs)."""

@@ -31,25 +31,28 @@ class DaemonSetRunner:
         """Converge daemon pods: create missing ones on compatible registered
         nodes, delete orphans (DS gone or node gone). Returns pods created."""
         created = 0
-        daemonsets = {ds.metadata.name: ds for ds in self.store.list("DaemonSet")}
+        daemonsets = {(ds.metadata.namespace, ds.metadata.name): ds for ds in self.store.list("DaemonSet")}
         nodes = {n.metadata.name: n for n in self.store.list("Node")}
 
-        # index existing daemon pods by (ds name, node)
-        have: dict[tuple[str, str], object] = {}
+        # index existing daemon pods by (ds namespace, ds name, node)
+        have: dict[tuple[str, str, str], object] = {}
         for p in self.store.list("Pod"):
             owner = next((o for o in p.metadata.owner_references if o.kind == "DaemonSet"), None)
             if owner is None:
                 continue
-            if owner.name not in daemonsets or (p.spec.node_name and p.spec.node_name not in nodes):
+            key = (p.metadata.namespace, owner.name)
+            if key not in daemonsets or (p.spec.node_name and p.spec.node_name not in nodes):
                 self.store.try_delete("Pod", p.metadata.name, namespace=p.metadata.namespace)
                 continue
             if p.spec.node_name:
-                have[(owner.name, p.spec.node_name)] = p
+                have[(p.metadata.namespace, owner.name, p.spec.node_name)] = p
 
-        for ds in daemonsets.values():
+        from .store import AlreadyExists
+
+        for (ns, ds_name), ds in daemonsets.items():
             template = ds.to_pod()
             for name, node in nodes.items():
-                if (ds.metadata.name, name) in have:
+                if (ns, ds_name, name) in have:
                     continue
                 if node.metadata.deletion_timestamp is not None:
                     continue
@@ -58,15 +61,17 @@ class DaemonSetRunner:
                 if not self._matches(template, node):
                     continue
                 pod = ds.to_pod()
-                pod.metadata.name = f"{ds.metadata.name}-{name}"
+                pod.metadata.name = f"{ds_name}-{name}"
                 pod.spec.node_name = name
                 pod.status.phase = "Running"
                 pod.status.start_time = self.clock.now()
                 try:
                     self.store.create(pod)
                     created += 1
-                except Exception:
-                    pass
+                except AlreadyExists:
+                    # a non-daemon pod owns the name; converges next tick if
+                    # it goes away, and the port is held meanwhile either way
+                    continue
         return created
 
     @staticmethod

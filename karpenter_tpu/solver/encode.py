@@ -143,8 +143,11 @@ class EncodedSnapshot:
     existing_port_any: np.ndarray  # [n_existing, P1]
     existing_port_wild: np.ndarray  # [n_existing, P1]
     existing_port_spec: np.ndarray  # [n_existing, P2]
-    # daemon-reserved ports per row: fresh slots open with these ports held
-    # (zeros for existing rows — their ports live in existing_port_*)
+    # daemon-reserved ports per row: fresh slots open with these ports held.
+    # Existing rows carry their PHANTOM daemon ports here too (they are also
+    # merged into existing_port_*); consumers must read exactly one of the
+    # two for existing rows — the kernel/validator index row ports only for
+    # offering rows
     row_port_any: np.ndarray  # [Nrows, P1]
     row_port_wild: np.ndarray  # [Nrows, P1]
     row_port_spec: np.ndarray  # [Nrows, P2]

@@ -27,6 +27,17 @@ from .ffd import FFDSolver
 from .snapshot import SolverSnapshot
 
 
+def _ports_fit(group_usage, pod_ports: list) -> bool:
+    """Can every (pod key, ports) land on a node whose daemon group already
+    holds group_usage? Sequential add, like the host CanAdd loop."""
+    usage = group_usage.copy()
+    for key, ports in pod_ports:
+        if usage.conflicts(key, ports) is not None:
+            return False
+        usage.add(key, ports)
+    return True
+
+
 def _requests_from_sigs(enc, sig_counts: dict[int, int]) -> dict:
     """Total ResourceList for a slot from (signature -> pod count): integer
     milli accumulation, one Quantity construction per resource."""
@@ -344,9 +355,17 @@ class TPUSolver:
                                 break
                 mask_cache[rkey] = mask
             total_vec = total_mat[j]
+            # groups whose daemon-reserved ports conflict with the slot's
+            # pods can never host them (nodeclaim.py:430 semantics)
+            from ..scheduling.hostports import pod_host_ports as _php
+
+            pod_ports = [(p.key(), _php(p)) for p in pods]
+            pod_ports = [(k, ps) for k, ps in pod_ports if ps]
             remaining = []
-            for members, ovh in ginfo:
+            for members, ovh, gusage in ginfo:
                 if not members:
+                    continue
+                if pod_ports and not _ports_fit(gusage, pod_ports):
                     continue
                 fits = np.all(alloc_mat[members] >= total_vec[None, :] + ovh[None, :], axis=1)
                 remaining.extend(its[m] for m, ok in zip(members, fits & mask[members]) if ok)
@@ -357,8 +376,8 @@ class TPUSolver:
                 # an available offering, and the accumulated-requests fit
                 # (nodeclaim.go:541-618 semantics)
                 it_idx = next((i2 for i2, cand in enumerate(its) if cand is it), None)
-                ovh_vec = next(
-                    (ovh for members, ovh in ginfo if it_idx is not None and it_idx in members),
+                entry = next(
+                    ((ovh, gusage) for members, ovh, gusage in ginfo if it_idx is not None and it_idx in members),
                     None,
                 )
                 it_ok = (
@@ -367,11 +386,12 @@ class TPUSolver:
                         o.available and claim.requirements.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None
                         for o in it.offerings
                     )
-                    # fit INCLUDING the row's daemon-overhead group, exactly
-                    # like the vectorized filter above
+                    # fit INCLUDING the row's daemon-overhead group and its
+                    # reserved ports, exactly like the vectorized filter above
                     and it_idx is not None
-                    and ovh_vec is not None
-                    and bool(np.all(alloc_mat[it_idx] >= total_vec + ovh_vec))
+                    and entry is not None
+                    and bool(np.all(alloc_mat[it_idx] >= total_vec + entry[0]))
+                    and (not pod_ports or _ports_fit(entry[1], pod_ports))
                 )
                 if not it_ok:
                     raise DecodeError(f"slot {j}: packed row {it.name} not launchable under final claim requirements")
@@ -448,7 +468,7 @@ class TPUSolver:
                     r = ridx.get(k)
                     if r is not None:
                         ovh[r] = _scale(k, q)
-                ginfo.append(([it_idx[id(x)] for x in g.instance_types if id(x) in it_idx], ovh))
+                ginfo.append(([it_idx[id(x)] for x in g.instance_types if id(x) in it_idx], ovh, g.host_port_usage))
             ctx = (its, alloc, ginfo)
             cache[key] = ctx
         return ctx

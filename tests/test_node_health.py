@@ -81,3 +81,96 @@ class TestNodeHealth:
         mark_unhealthy(env, victim, status="Unknown", age=11 * 60)
         env.settle(rounds=25)
         assert env.store.try_get("Node", victim) is None
+
+
+class TestNodeHealthDepth:
+    """Second tranche from node/health/suite_test.go:98-386."""
+
+    def test_condition_type_mismatch_no_repair(self):
+        # :112 — an unhealthy condition type outside RepairPolicies is ignored
+        env = make_env(pods=3)
+        node = env.store.list("Node")[0]
+
+        def apply(n):
+            n.status.conditions.append(
+                NodeCondition(type="CustomUnhealthy", status="False", last_transition_time=env.clock.now() - 3600)
+            )
+
+        env.store.patch("Node", node.metadata.name, apply)
+        env.clock.step(700)
+        for _ in range(4):
+            env.tick()
+        assert env.store.try_get("Node", node.metadata.name) is not None
+
+    def test_condition_status_mismatch_no_repair(self):
+        # :126 — Ready=True never matches the Ready=False/Unknown policies
+        env = make_env(pods=3)
+        node = env.store.list("Node")[0]
+        mark_unhealthy(env, node.metadata.name, status="True", age=3600)
+        env.clock.step(700)
+        for _ in range(4):
+            env.tick()
+        assert env.store.try_get("Node", node.metadata.name) is not None
+
+    def test_do_not_disrupt_ignored_by_repair(self):
+        # :273 — forced repair overrides the do-not-disrupt annotation
+        env = make_env(pods=3)
+        node = env.store.list("Node")[0]
+
+        def annotate(n):
+            n.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+
+        env.store.patch("Node", node.metadata.name, annotate)
+        mark_unhealthy(env, node.metadata.name, age=700)
+        env.clock.step(700)
+        env.settle(rounds=10, step_seconds=30)
+        assert env.store.try_get("Node", node.metadata.name) is None
+
+    def test_budgets_ignored_by_repair(self):
+        # :251 — a zero disruption budget does not block forced repair
+        from karpenter_tpu.apis.nodepool import Budget
+
+        env = make_env(pods=3)
+        np = env.store.list("NodePool")[0]
+
+        def zero(p):
+            p.spec.disruption.budgets = [Budget(nodes="0")]
+
+        env.store.patch("NodePool", np.metadata.name, zero)
+        node = env.store.list("Node")[0]
+        mark_unhealthy(env, node.metadata.name, age=700)
+        env.clock.step(700)
+        env.settle(rounds=10, step_seconds=30)
+        assert env.store.try_get("Node", node.metadata.name) is None
+
+    def test_grace_period_annotation_stamped(self):
+        # :155 — force termination stamps the termination timestamp so the
+        # drain cannot wedge on PDBs
+        env = make_env(pods=3)
+        node = env.store.list("Node")[0]
+        mark_unhealthy(env, node.metadata.name, age=700)
+        env.clock.step(700)
+        env.health.reconcile()
+        n = env.store.try_get("Node", node.metadata.name)
+        assert n is not None
+        assert wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY in n.metadata.annotations
+
+    def test_small_pool_rounds_threshold_up(self):
+        # :359 — 1 unhealthy node of 3 is within ceil(20% x 3) = 1
+        env = make_env(pods=3)
+        node = env.store.list("Node")[0]
+        mark_unhealthy(env, node.metadata.name, age=700)
+        env.clock.step(700)
+        env.settle(rounds=10, step_seconds=30)
+        assert env.store.try_get("Node", node.metadata.name) is None
+
+    def test_disrupted_metric_fired(self):
+        # :386
+        from karpenter_tpu import metrics as m
+
+        env = make_env(pods=3)
+        node = env.store.list("Node")[0]
+        mark_unhealthy(env, node.metadata.name, age=700)
+        env.clock.step(700)
+        env.settle(rounds=10, step_seconds=30)
+        assert env.registry.counter(m.NODECLAIMS_DISRUPTED_TOTAL).total() >= 1

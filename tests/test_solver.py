@@ -965,3 +965,31 @@ class TestDaemonPortsWindow:
         res = tpu.solve(self._snap_with_daemon(pods))
         assert tpu.last_backend == "tpu"
         assert not res.pod_errors
+
+    def test_claim_options_exclude_daemon_conflicted_group(self):
+        # a daemon pinned (by nodeSelector) to ONE instance type holds 8080
+        # only on that type's daemon group: a ported pod may schedule on the
+        # other groups, but the conflicted type must never reach the claim's
+        # instance_type_options (nodeclaim.py:430 group filtering at decode)
+        from karpenter_tpu.solver import FFDSolver
+
+        pinned_it = "c-4x-amd64-linux"
+        d = make_pod(cpu="100m", name="daemon-tpl", node_selector={wk.INSTANCE_TYPE_LABEL_KEY: pinned_it})
+        d.spec.containers[0].ports = [{"containerPort": 8080, "hostPort": 8080, "protocol": "TCP"}]
+
+        def snap():
+            s = make_snapshot([self._ported(8080, name="web")])
+            s.daemonset_pods = [d]
+            return s
+
+        ffd = FFDSolver().solve(snap())
+        tpu = TPUSolver(force=True)
+        res = tpu.solve(snap())
+        assert tpu.last_backend == "tpu"
+        assert not res.pod_errors and not ffd.pod_errors
+        for nc in res.new_node_claims:
+            names = {it.name for it in nc.instance_type_options}
+            assert pinned_it not in names, "conflicted daemon group leaked into claim options"
+        for nc in ffd.new_node_claims:
+            names = {it.name for it in nc.instance_type_options}
+            assert pinned_it not in names
