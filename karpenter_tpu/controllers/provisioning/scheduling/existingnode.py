@@ -53,7 +53,7 @@ class ExistingNode:
     def can_add(self, pod, pod_data):
         """Returns (updated_requirements, None) or error string
         (existingnode.go:81-139)."""
-        err = taints_tolerate_pod(self.taints, pod)
+        err = taints_tolerate_pod(self.taints, pod, include_prefer_no_schedule=True)
         if err is not None:
             return None, err
         verr = self.volume_usage.exceeds_limits(pod_data.volumes)

@@ -142,7 +142,7 @@ class SchedulingNodeClaim:
     def can_add(self, pod, pod_data, relax_min_values: bool = False):
         """Returns (updated_requirements, remaining_instance_types) or an error
         string (nodeclaim.go:124-158)."""
-        err = taints_tolerate_pod(self.template.taints, pod)
+        err = taints_tolerate_pod(self.template.taints, pod, include_prefer_no_schedule=True)
         if err is not None:
             return None, None, err
 

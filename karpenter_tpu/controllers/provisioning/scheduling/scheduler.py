@@ -93,7 +93,16 @@ class Scheduler:
         self.min_values_policy = min_values_policy
         self.deleting_node_names = deleting_node_names or set()
         self.timeout_seconds = timeout_seconds
-        self.preferences = Preferences(tolerate_prefer_no_schedule=(preference_policy == "Ignore"))
+        # the PreferNoSchedule toleration relaxation arms whenever some pool
+        # taints with that effect (scheduler.go:144-153 — policy-independent)
+        from ....scheduling.taints import PREFER_NO_SCHEDULE
+
+        tolerate_pns = any(
+            t.effect == PREFER_NO_SCHEDULE for np in node_pools for t in np.spec.template.taints
+        )
+        self.preferences = Preferences(
+            tolerate_prefer_no_schedule=tolerate_pns or (preference_policy == "Ignore")
+        )
         self.cached_pod_data: dict[str, PodData] = {}
         self.volume_topology = VolumeTopology(store)
         # one DRA allocator per solve, shared by every candidate (provisioner.go:333-344)

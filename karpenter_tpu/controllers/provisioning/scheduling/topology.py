@@ -46,7 +46,7 @@ class TopologyDomainGroup:
         least one providing NodePool's taints must be tolerated."""
         for domain, taint_sets in self._domains.items():
             if taint_policy == HONOR:
-                if not any(taints_tolerate_pod(ts, pod) is None for ts in taint_sets):
+                if not any(taints_tolerate_pod(ts, pod, include_prefer_no_schedule=True) is None for ts in taint_sets):
                     continue
             fn(domain)
 

@@ -1,7 +1,10 @@
 """Taints and tolerations (reference: pkg/scheduling/taints.go).
 
 A pod fails against a node iff some NoSchedule/NoExecute taint is untolerated.
-PreferNoSchedule taints never block placement.
+The contract is SPLIT on PreferNoSchedule: scheduler-flavored callers
+(candidate checks, topology domain reachability) treat it as blocking until
+relaxation adds a toleration (include_prefer_no_schedule=True); kubelet-
+flavored callers (binder, daemon materialization, drain) never block on it.
 """
 
 from __future__ import annotations
@@ -57,12 +60,15 @@ class Toleration:
         )
 
 
-def taints_tolerate_pod(taints: Iterable[Taint], pod) -> str | None:
-    """Error string naming the first untolerated NoSchedule/NoExecute taint,
-    or None (reference: taints.go Taints.ToleratesPod)."""
+def taints_tolerate_pod(taints: Iterable[Taint], pod, include_prefer_no_schedule: bool = False) -> str | None:
+    """Error string naming the first untolerated taint, or None (reference:
+    taints.go Taints.ToleratesPod). The SCHEDULER's candidate checks treat
+    PreferNoSchedule as blocking until relaxation adds a toleration
+    (scheduler.go:146-151 + preferences.go toleratePreferNoScheduleTaints);
+    kubelet-flavored callers (binder, daemons, drain) ignore it."""
     tolerations = [t if isinstance(t, Toleration) else Toleration.from_dict(t) for t in (pod.spec.tolerations or ())]
     for taint in taints:
-        if taint.effect == PREFER_NO_SCHEDULE:
+        if taint.effect == PREFER_NO_SCHEDULE and not include_prefer_no_schedule:
             continue
         if not any(tol.tolerates(taint) for tol in tolerations):
             return f"did not tolerate {taint.key}={taint.value}:{taint.effect}"

@@ -1023,7 +1023,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     sig_taint_ok = np.ones((S, C), dtype=bool)
     for s, pod in enumerate(rep_pods):
         for c, taints in enumerate(taint_sets):
-            sig_taint_ok[s, c] = taints_tolerate_pod(taints, pod) is None
+            sig_taint_ok[s, c] = taints_tolerate_pod(taints, pod, include_prefer_no_schedule=True) is None
 
     D = len(dom_values)
     sig_dom_allowed = np.ones((S, D), dtype=bool)
@@ -1248,7 +1248,13 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         counts_dom_init=counts_dom_init,
         counts_host_existing=counts_host_existing,
         fallback_reasons=reasons,
-        has_relaxable=respect and any(_is_relaxable(p) for p in rep_pods),
+        # PreferNoSchedule template taints block tier-0 and resolve via the
+        # host relaxation toleration, so their presence makes any unplaced
+        # pod a relaxation case (scheduler.go:146-151)
+        has_relaxable=(respect and any(_is_relaxable(p) for p in rep_pods))
+        or any(
+            t.effect == "PreferNoSchedule" for np_ in snap.node_pools for t in np_.spec.template.taints
+        ),
         req_class_keys=req_class_keys,
         decode_cache=rows.decode_cache,
     )

@@ -22,6 +22,7 @@ from ..models.scheduler_model import make_tensors
 from ..scheduling.requirements import Operator, Requirement, Requirements
 from ..utils import resources as res
 from ..utils.quantity import Quantity
+from ..scheduling.hostports import pod_host_ports as _php
 from .encode import encode
 from .ffd import FFDSolver
 from .snapshot import SolverSnapshot
@@ -357,8 +358,6 @@ class TPUSolver:
             total_vec = total_mat[j]
             # groups whose daemon-reserved ports conflict with the slot's
             # pods can never host them (nodeclaim.py:430 semantics)
-            from ..scheduling.hostports import pod_host_ports as _php
-
             pod_ports = [(p.key(), _php(p)) for p in pods]
             pod_ports = [(k, ps) for k, ps in pod_ports if ps]
             remaining = []

@@ -34,7 +34,7 @@ def validate_results(snap, results) -> list[str]:
             reqs = Requirements.from_pod(p, strict=True)
             if nc.requirements.compatible(reqs, allow_undefined=wk.WELL_KNOWN_LABELS) is not None:
                 errors.append(f"claim {idx}: pod {p.key()} incompatible with claim requirements")
-            err = taints_tolerate_pod(nc.template.taints, p)
+            err = taints_tolerate_pod(nc.template.taints, p, include_prefer_no_schedule=True)
             if err is not None:
                 errors.append(f"claim {idx}: pod {p.key()} {err}")
 

@@ -144,3 +144,108 @@ class TestHydration:
         nc = env.store.list("NodeClaim")[0]
         key = node_class_label_key(nc.spec.node_class_ref.group, nc.spec.node_class_ref.kind)
         assert nc.metadata.labels[key] == nc.spec.node_class_ref.name
+
+
+class TestGarbageCollectionDepth:
+    """GC specs from nodeclaim/garbagecollection/suite_test.go:85-201 — the
+    claim is GC'd only for (node NotReady AND instance gone); every other
+    combination belongs to liveness or is a transient cloud blip."""
+
+    def _env_with_node(self):
+        from karpenter_tpu.kube.objects import NodeCondition
+
+        env = make_env()
+        env.store.create(make_pod(cpu="1", name="w"))
+        env.settle(rounds=6)
+        node = env.store.list("Node")[0]
+        return env, node
+
+    def _gone(self, env, provider_id):
+        base = env.base_cloud_provider
+        orig = base.get
+
+        def get(pid):
+            if pid == provider_id:
+                from karpenter_tpu.cloudprovider.errors import NodeClaimNotFoundError
+
+                raise NodeClaimNotFoundError(pid)
+            return orig(pid)
+
+        base.get = get
+
+    def _set_ready(self, env, node_name, status):
+        from karpenter_tpu.kube.objects import NodeCondition
+
+        def apply(n):
+            n.status.conditions = [c for c in n.status.conditions if c.type != "Ready"]
+            n.status.conditions.append(NodeCondition(type="Ready", status=status, last_transition_time=env.clock.now()))
+
+        env.store.patch("Node", node_name, apply)
+
+    def test_not_ready_node_instance_gone_deletes_claim(self):
+        # :85
+        env, node = self._env_with_node()
+        victim = env.store.list("NodeClaim")[0].metadata.name
+        self._set_ready(env, node.metadata.name, "False")
+        self._gone(env, node.spec.provider_id)
+        env.gc.reconcile()
+        env.settle(rounds=6)
+        # the claim is gone (its workload may reprovision a FRESH claim)
+        assert env.store.try_get("NodeClaim", victim) is None
+        assert env.store.try_get("Node", node.metadata.name) is None
+
+    def test_ready_node_instance_gone_keeps_claim(self):
+        # :112 — a Ready node contradicts "instance gone" (API blip)
+        env, node = self._env_with_node()
+        self._set_ready(env, node.metadata.name, "True")
+        self._gone(env, node.spec.provider_id)
+        env.gc.reconcile()
+        assert env.store.count("NodeClaim") == 1
+
+    def test_missing_node_instance_gone_keeps_claim(self):
+        # :178 — the liveness controller owns unregistered/missing nodes
+        env, node = self._env_with_node()
+        nc = env.store.list("NodeClaim")[0]
+        pid = node.spec.provider_id
+        env.store.delete("Node", node.metadata.name, grace=False)
+        self._gone(env, pid)
+        env.gc.reconcile()
+        assert env.store.try_get("NodeClaim", nc.metadata.name) is not None
+
+    def test_missing_node_instance_present_keeps_claim(self):
+        # :201
+        env, node = self._env_with_node()
+        nc = env.store.list("NodeClaim")[0]
+        env.store.delete("Node", node.metadata.name, grace=False)
+        env.gc.reconcile()
+        assert env.store.try_get("NodeClaim", nc.metadata.name) is not None
+
+    def test_many_not_ready_nodes_collected(self):
+        # :136
+        env = make_env()
+        for i in range(3):
+            env.store.create(make_pod(cpu="8", name=f"w{i}"))
+        env.settle(rounds=8)
+        nodes = env.store.list("Node")
+        assert env.store.count("NodeClaim") == len(nodes) >= 1
+        from karpenter_tpu.kube.objects import NodeCondition
+
+        for n in nodes:
+            def apply(x):
+                x.status.conditions = [c for c in x.status.conditions if c.type != "Ready"]
+                x.status.conditions.append(NodeCondition(type="Ready", status="False", last_transition_time=env.clock.now()))
+
+            env.store.patch("Node", n.metadata.name, apply)
+        base = env.base_cloud_provider
+        orig = base.get
+
+        def get(pid):
+            from karpenter_tpu.cloudprovider.errors import NodeClaimNotFoundError
+
+            raise NodeClaimNotFoundError(pid)
+
+        base.get = get
+        victims = [nc.metadata.name for nc in env.store.list("NodeClaim")]
+        env.gc.reconcile()
+        env.settle(rounds=6)
+        assert all(env.store.try_get("NodeClaim", v) is None for v in victims)

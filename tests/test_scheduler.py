@@ -339,3 +339,150 @@ class TestInstanceSelection:
         s = make_scheduler(*env)
         results = s.solve([make_pod()])
         assert results.all_pods_scheduled()
+
+
+class TestPreferentialFallbackDepth:
+    """Relaxation-order specs from provisioning suite_test.go:2386-2560."""
+
+    def _solve(self, pod, node_pools=None, **kw):
+        env = build_env(node_pools=node_pools)
+        s = make_scheduler(*env, **kw)
+        return s.solve([pod])
+
+    def test_final_required_term_not_relaxed(self):
+        # :2388 — a single required OR-term is a hard constraint
+        pod = make_pod(required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["invalid"]}]])
+        results = self._solve(pod)
+        assert not results.all_pods_scheduled()
+
+    def test_relaxes_multiple_required_terms_in_order(self):
+        # :2409 — invalid terms peel one by one; the FIRST satisfiable term
+        # wins and later OR-terms are never reached
+        pod = make_pod(
+            required_affinity=[
+                [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["invalid"]}],
+                [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["invalid"]}],
+                [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}],
+                [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}],
+            ]
+        )
+        results = self._solve(pod)
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        zr = nc.requirements.get(wk.ZONE_LABEL_KEY)
+        assert zr.has("test-zone-a") and not zr.has("test-zone-b")
+
+    def test_relaxes_all_preferred_terms(self):
+        # :2433 — every unsatisfiable preference peels away
+        pod = make_pod(
+            preferred_affinity=[
+                (1, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["invalid"]}]),
+                (1, [{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["invalid"]}]),
+            ]
+        )
+        results = self._solve(pod)
+        assert results.all_pods_scheduled()
+
+    def test_relaxes_lighter_weights_first(self):
+        # :2452 — the highest-weight satisfiable preference survives
+        reqs = LINUX_AMD64 + [
+            {"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]}
+        ]
+        pod = make_pod(
+            preferred_affinity=[
+                (100, [{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["test-zone-c"]}]),
+                (50, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}]),
+                (1, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}]),
+            ]
+        )
+        results = self._solve(pod, node_pools=[make_nodepool(requirements=reqs)])
+        assert results.all_pods_scheduled()
+        zr = results.new_node_claims[0].requirements.get(wk.ZONE_LABEL_KEY)
+        assert zr.has("test-zone-b") and not zr.has("test-zone-a")
+
+    def test_prefer_no_schedule_tolerated_after_relaxation(self):
+        # :2486 — the PreferNoSchedule taint is tolerated only after all
+        # affinity preferences have been peeled
+        np = make_nodepool(
+            requirements=LINUX_AMD64,
+            taints=[Taint(key="soft", value="true", effect="PreferNoSchedule")],
+        )
+        pod = make_pod(
+            preferred_affinity=[
+                (1, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["invalid"]}]),
+            ]
+        )
+        results = self._solve(pod, node_pools=[np])
+        assert results.all_pods_scheduled()
+
+    def test_ignore_policy_drops_preferences_up_front(self):
+        # :2565 — preference_policy=Ignore never honors preferences at all
+        pod = make_pod(
+            preferred_affinity=[(10, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-c"]}])]
+        )
+        reqs = LINUX_AMD64 + [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}]
+        results = self._solve(pod, node_pools=[make_nodepool(requirements=reqs)], preference_policy="Ignore")
+        assert results.all_pods_scheduled()
+        zr = results.new_node_claims[0].requirements.get(wk.ZONE_LABEL_KEY)
+        assert zr.has("test-zone-a")
+
+
+class TestNodePoolSelectionDepth:
+    """Pool-selection specs from suite_test.go:2771-2845."""
+
+    def test_explicit_nodepool_selector(self):
+        # :2772
+        pools = [make_nodepool(name="a", requirements=LINUX_AMD64), make_nodepool(name="b", requirements=LINUX_AMD64)]
+        env = build_env(node_pools=pools)
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(node_selector={wk.NODEPOOL_LABEL_KEY: "b"})])
+        assert results.all_pods_scheduled()
+        assert results.new_node_claims[0].template.nodepool_name == "b"
+
+    def test_nodepool_by_template_labels(self):
+        # :2780 — pods select pools via template labels
+        pools = [
+            make_nodepool(name="a", requirements=LINUX_AMD64, labels={"team": "red"}),
+            make_nodepool(name="b", requirements=LINUX_AMD64, labels={"team": "blue"}),
+        ]
+        env = build_env(node_pools=pools)
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(node_selector={"team": "blue"})])
+        assert results.all_pods_scheduled()
+        assert results.new_node_claims[0].template.nodepool_name == "b"
+
+    def test_prefer_untainted_pool_over_prefer_no_schedule(self):
+        # :2796 — a PreferNoSchedule-tainted pool loses to a clean one
+        tainted = make_nodepool(
+            name="soft", requirements=LINUX_AMD64, weight=50,
+            taints=[Taint(key="soft", value="true", effect="PreferNoSchedule")],
+        )
+        clean = make_nodepool(name="clean", requirements=LINUX_AMD64, weight=10)
+        env = build_env(node_pools=[tainted, clean])
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(cpu="1")])
+        assert results.all_pods_scheduled()
+        assert results.new_node_claims[0].template.nodepool_name == "clean"
+
+    def test_highest_weight_pool_wins(self):
+        # :2814
+        pools = [
+            make_nodepool(name="lo", requirements=LINUX_AMD64, weight=1),
+            make_nodepool(name="hi", requirements=LINUX_AMD64, weight=80),
+        ]
+        env = build_env(node_pools=pools)
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(cpu="1")])
+        assert results.new_node_claims[0].template.nodepool_name == "hi"
+
+    def test_explicit_selection_beats_weight(self):
+        # :2830
+        pools = [
+            make_nodepool(name="lo", requirements=LINUX_AMD64, weight=1),
+            make_nodepool(name="hi", requirements=LINUX_AMD64, weight=80),
+        ]
+        env = build_env(node_pools=pools)
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(node_selector={wk.NODEPOOL_LABEL_KEY: "lo"})])
+        assert results.all_pods_scheduled()
+        assert results.new_node_claims[0].template.nodepool_name == "lo"
