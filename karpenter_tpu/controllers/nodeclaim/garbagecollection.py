@@ -21,17 +21,18 @@ class GarbageCollectionController:
         by_pid = {nc.status.provider_id: nc for nc in claims if nc.status.provider_id}
         nodes_by_pid = {n.spec.provider_id: n for n in self.store.list("Node") if n.spec.provider_id}
 
-        # claims whose instance is gone -> delete claim, but ONLY when the
-        # node exists and is NotReady (suite_test.go:85-201): a Ready node
-        # contradicts the cloud's "instance gone" (transient API error), and
-        # a MISSING node is the liveness controller's case, not GC's
+        # claims whose instance is gone -> delete claim, UNLESS the node is
+        # there and Ready (controller.go:97-100: a Ready node means the
+        # kubelet still runs, so "instance gone" is a transient cloud blip).
+        # Unregistered claims are the liveness controller's case and are
+        # filtered above, matching the registered-only scan.
         for nc in claims:
             if not nc.status.provider_id or not nc.is_registered():
                 continue
             if nc.metadata.deletion_timestamp is not None:
                 continue
             node = nodes_by_pid.get(nc.status.provider_id)
-            if node is None or _node_ready(node):
+            if node is not None and _node_ready(node):
                 continue
             try:
                 self.cloud_provider.get(nc.status.provider_id)

@@ -392,9 +392,13 @@ def _req_to_dicts(r: Requirement) -> list[dict]:
 
 
 def _rand_suffix() -> str:
+    # 10 hex chars: a 5-char suffix has ~9% birthday-collision odds by 400
+    # generated names, which intermittently failed large solves with
+    # AlreadyExists on claim create (kube generateName uses 5 chars but the
+    # apiserver retries; the store does not)
     import random
 
-    return f"{random.randrange(16**5):05x}"
+    return f"{random.randrange(16**10):010x}"
 
 
 def filter_instance_types(

@@ -14,7 +14,7 @@ from dataclasses import dataclass, field
 
 from ....apis import labels as wk
 from ....scheduling.requirements import Requirements
-from ....scheduling.taints import taints_tolerate_pod
+from ....scheduling.taints import pools_taint_prefer_no_schedule, taints_tolerate_pod
 from ....utils import resources as res
 from ....utils.quantity import Quantity
 from ....scheduling.volumeusage import get_volumes
@@ -95,13 +95,9 @@ class Scheduler:
         self.timeout_seconds = timeout_seconds
         # the PreferNoSchedule toleration relaxation arms whenever some pool
         # taints with that effect (scheduler.go:144-153 — policy-independent)
-        from ....scheduling.taints import PREFER_NO_SCHEDULE
-
-        tolerate_pns = any(
-            t.effect == PREFER_NO_SCHEDULE for np in node_pools for t in np.spec.template.taints
-        )
         self.preferences = Preferences(
-            tolerate_prefer_no_schedule=tolerate_pns or (preference_policy == "Ignore")
+            tolerate_prefer_no_schedule=pools_taint_prefer_no_schedule(node_pools)
+            or (preference_policy == "Ignore")
         )
         self.cached_pod_data: dict[str, PodData] = {}
         self.volume_topology = VolumeTopology(store)

@@ -30,6 +30,20 @@ class Binder:
         nodes = sorted(self.store.list("Node"), key=lambda n: n.metadata.name)
         node_reqs = {n.metadata.name: Requirements.from_labels(n.metadata.labels) for n in nodes}
         all_pods = self.store.list("Pod")
+        # kube PodGC stand-in: active pods bound to a node that no longer
+        # exists reset to pending (modeling controller recreation, like
+        # eviction does) so the provisioner sees them again
+        node_names = {n.metadata.name for n in nodes}
+        for q in all_pods:
+            if q.spec.node_name and q.spec.node_name not in node_names and pod_utils.is_active(q):
+                def orphan(p):
+                    p.spec.node_name = ""
+                    p.status.phase = "Pending"
+                    p.status.start_time = None
+
+                self.store.patch("Pod", q.metadata.name, orphan, namespace=q.metadata.namespace)
+                q.spec.node_name = ""
+                q.status.phase = "Pending"
         # per-node host-port usage, built once per pass from ACTIVE bound
         # pods (terminal pods free their ports, as in Kubernetes)
         self._port_usage = {}

@@ -75,6 +75,12 @@ def taints_tolerate_pod(taints: Iterable[Taint], pod, include_prefer_no_schedule
     return None
 
 
+def pools_taint_prefer_no_schedule(node_pools) -> bool:
+    """True when any pool's template carries a PreferNoSchedule taint — the
+    condition that arms the toleration relaxation (scheduler.go:144-153)."""
+    return any(t.effect == PREFER_NO_SCHEDULE for np in node_pools for t in np.spec.template.taints)
+
+
 def merge_taints(existing: list[Taint], incoming: Iterable[Taint]) -> list[Taint]:
     """Add taints absent by (key, effect)."""
     have = {(t.key, t.effect) for t in existing}

@@ -47,7 +47,7 @@ from ..controllers.provisioning.scheduling.scheduler import (
 from ..kube.objects import match_label_selector
 from ..ops.bitset import pack_bool_masks, words_for
 from ..scheduling.requirements import Operator, Requirements
-from ..scheduling.taints import taints_tolerate_pod
+from ..scheduling.taints import pools_taint_prefer_no_schedule, taints_tolerate_pod
 from ..utils import pods as pod_utils
 from ..utils import resources as res
 from ..utils.quantity import Quantity
@@ -1252,9 +1252,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         # host relaxation toleration, so their presence makes any unplaced
         # pod a relaxation case (scheduler.go:146-151)
         has_relaxable=(respect and any(_is_relaxable(p) for p in rep_pods))
-        or any(
-            t.effect == "PreferNoSchedule" for np_ in snap.node_pools for t in np_.spec.template.taints
-        ),
+        or pools_taint_prefer_no_schedule(snap.node_pools),
         req_class_keys=req_class_keys,
         decode_cache=rows.decode_cache,
     )

@@ -202,15 +202,30 @@ class TestGarbageCollectionDepth:
         env.gc.reconcile()
         assert env.store.count("NodeClaim") == 1
 
-    def test_missing_node_instance_gone_keeps_claim(self):
-        # :178 — the liveness controller owns unregistered/missing nodes
+    def test_missing_node_instance_gone_deletes_registered_claim(self):
+        # controller.go:97-100 — only a node that is there AND Ready vetoes;
+        # a REGISTERED claim with no node and no instance is collected
         env, node = self._env_with_node()
         nc = env.store.list("NodeClaim")[0]
         pid = node.spec.provider_id
         env.store.delete("Node", node.metadata.name, grace=False)
         self._gone(env, pid)
         env.gc.reconcile()
-        assert env.store.try_get("NodeClaim", nc.metadata.name) is not None
+        env.settle(rounds=6)
+        assert env.store.try_get("NodeClaim", nc.metadata.name) is None
+
+    def test_unregistered_claim_missing_node_kept(self):
+        # :178 — UNREGISTERED claims belong to the liveness controller
+        from karpenter_tpu.apis.nodeclaim import NodeClaim
+        from karpenter_tpu.kube import ObjectMeta
+
+        env = make_env()
+        nc = NodeClaim(metadata=ObjectMeta(name="orphan", labels={wk.NODEPOOL_LABEL_KEY: "default-pool"}))
+        nc.status.provider_id = "kwok://nowhere"
+        env.store.create(nc)
+        self._gone(env, "kwok://nowhere")
+        env.gc.reconcile()
+        assert env.store.try_get("NodeClaim", "orphan") is not None
 
     def test_missing_node_instance_present_keeps_claim(self):
         # :201
