@@ -32,10 +32,16 @@ class Binder:
         all_pods = self.store.list("Pod")
         # kube PodGC stand-in: active pods bound to a node that no longer
         # exists reset to pending (modeling controller recreation, like
-        # eviction does) so the provisioner sees them again
+        # eviction does) so the provisioner sees them again; node-owned
+        # (static/mirror) pods die with their node instead — they must never
+        # become pending demand
         node_names = {n.metadata.name for n in nodes}
         for q in all_pods:
             if q.spec.node_name and q.spec.node_name not in node_names and pod_utils.is_active(q):
+                if pod_utils.is_owned_by_node(q):
+                    self.store.try_delete("Pod", q.metadata.name, namespace=q.metadata.namespace)
+                    continue
+
                 def orphan(p):
                     p.spec.node_name = ""
                     p.status.phase = "Pending"

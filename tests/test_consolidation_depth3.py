@@ -267,3 +267,57 @@ class TestBufferInterplay:
         assert env.store.try_get("Node", "big-0") is None
         real = env.store.get("Pod", "real")
         assert real.spec.node_name
+
+
+class TestConsolidateAfterDestinations:
+    def test_destination_under_window_blocks_then_allows(self):
+        # consolidation_test.go:3050 — within the consolidateAfter window
+        # nothing moves (neither candidates nor destinations qualify); once
+        # it elapses, the single-pod node drains onto its sibling
+        env = Environment(options=Options())
+        np = make_nodepool(requirements=OD_ONLY)
+        np.spec.disruption.consolidate_after = "120s"
+        env.store.create(np)
+        manual_node(env, "dest", "c-16x-amd64-linux", "16")
+        manual_node(env, "src", "c-16x-amd64-linux", "16")
+        env.store.create(make_pod(cpu="500m", name="a0", node_name="dest"))
+        env.store.create(make_pod(cpu="500m", name="a1", node_name="dest"))
+        env.store.create(make_pod(cpu="500m", name="b0", node_name="src"))
+        env.settle(rounds=4)
+        # within the window: both nodes survive
+        for _ in range(3):
+            env.clock.step(20)
+            env.tick(provision_force=True)
+        assert env.store.try_get("Node", "src") is not None
+        assert env.store.try_get("Node", "dest") is not None
+        # past the window the fleet shrinks
+        run_disruption(env, rounds=16, step=60.0)
+        assert env.store.count("Node") < 2
+
+    def test_never_destination_still_accepts_consolidated_pods(self):
+        # consolidation_test.go:3121 — consolidateAfter: Never makes a node
+        # a non-candidate, but it remains a valid DESTINATION for pods from
+        # other pools' candidates
+        env = Environment(options=Options())
+        never = make_nodepool(name="keep", requirements=OD_ONLY)
+        never.spec.disruption.consolidate_after = "Never"
+        roll = make_nodepool(name="roll", requirements=OD_ONLY)
+        roll.spec.disruption.consolidate_after = "30s"
+        env.store.create(never)
+        env.store.create(roll)
+        # destination in the Never pool with headroom; candidate in the
+        # rolling pool with one small pod
+        labels_keep = {wk.NODEPOOL_LABEL_KEY: "keep"}
+        labels_roll = {wk.NODEPOOL_LABEL_KEY: "roll"}
+        manual_node(env, "dest", "c-16x-amd64-linux", "16", extra_labels=labels_keep)
+        env.store.patch("NodeClaim", "nc-dest", lambda nc: nc.metadata.labels.update(labels_keep))
+        manual_node(env, "src", "c-16x-amd64-linux", "16", extra_labels=labels_roll)
+        env.store.patch("NodeClaim", "nc-src", lambda nc: nc.metadata.labels.update(labels_roll))
+        env.store.create(make_pod(cpu="500m", name="d0", node_name="dest"))
+        env.store.create(make_pod(cpu="500m", name="s0", node_name="src"))
+        env.settle(rounds=4)
+        run_disruption(env, rounds=16, step=60.0)
+        # the rolling node consolidated away; the Never node absorbed its pod
+        assert env.store.try_get("Node", "src") is None
+        assert env.store.try_get("Node", "dest") is not None
+        assert env.store.get("Pod", "s0").spec.node_name == "dest"
