@@ -16,6 +16,10 @@ from .helpers import all_non_pending_scheduled, simulate_scheduling
 from .types import REASON_DRIFTED, REASON_EMPTY, REASON_UNDERUTILIZED, Command
 
 MULTI_NODE_CONSOLIDATION_CANDIDATE_CAP = 100  # multinodeconsolidation.go:35
+# compute caps on the deterministic clock: one slow pool must not starve the
+# 10s rounds forever (multinodeconsolidation.go:35, singlenodeconsolidation.go:33)
+MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS = 60.0
+SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS = 180.0
 
 
 class Emptiness:
@@ -242,21 +246,82 @@ class _ConsolidationBase:
 
         return evaluate_balanced_move(command, _replacement_price(command), self.ctx.balanced_totals())
 
+    def _can_pass_threshold(self, candidate) -> bool:
+        """Best-case pre-filter (balanced.go:285-299 CanPassThreshold): a full
+        DELETE is the upper bound on any move's balanced score — if even that
+        fails the 1/k threshold, skip the expensive simulation entirely.
+        Non-Balanced pools always pass."""
+        if candidate.node_pool.spec.disruption.consolidation_policy != BALANCED:
+            return True
+        from .balanced import score_move
+
+        totals = self.ctx.balanced_totals().get(candidate.node_pool.metadata.name)
+        if totals is None or totals.total_cost <= 0:
+            return True
+        return score_move(candidate.price, candidate.reschedule_disruption_cost, totals).approved()
+
+    def _count_timeout(self) -> None:
+        if self.ctx.metrics is not None:
+            from ... import metrics as m
+
+            self.ctx.metrics.counter(m.DISRUPTION_CONSOLIDATION_TIMEOUTS_TOTAL).inc(
+                consolidation_type=self.consolidation_type
+            )
+
 
 class SingleNodeConsolidation(_ConsolidationBase):
-    """Try candidates one at a time, sorted by disruption cost
-    (singlenodeconsolidation.go)."""
+    """Try candidates one at a time under a 3-minute budget, interweaving
+    candidates across NodePools so one big pool cannot starve the rest; pools
+    unseen when a round times out go first next round
+    (singlenodeconsolidation.go:33-176)."""
 
     consolidation_type = "single"
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        # cross-round fairness carry-over (PreviouslyUnseenNodePools)
+        self.previously_unseen_node_pools: set[str] = set()
+
+    def sort_candidates(self, eligible: list) -> list:
+        """Disruption-cost sort, then round-robin interweave by NodePool with
+        previously-unseen pools first (shuffleCandidates,
+        singlenodeconsolidation.go:143-176)."""
+        eligible = sorted(eligible, key=lambda c: c.disruption_cost)
+        by_pool: dict[str, list] = {}
+        for c in eligible:
+            by_pool.setdefault(c.node_pool.metadata.name, []).append(c)
+        pool_order = sorted(p for p in self.previously_unseen_node_pools if p in by_pool)
+        pool_order += [p for p in by_pool if p not in self.previously_unseen_node_pools]
+        out = []
+        width = max((len(cs) for cs in by_pool.values()), default=0)
+        for i in range(width):
+            for pool in pool_order:
+                cs = by_pool[pool]
+                if i < len(cs):
+                    out.append(cs[i])
+        return out
 
     def compute_commands(self, candidates, budgets) -> list[Command]:
         from .validation import ValidationError, Validator
 
-        eligible = sorted((c for c in candidates if self.should_disrupt(c)), key=lambda c: c.disruption_cost)
+        eligible = self.sort_candidates([c for c in candidates if self.should_disrupt(c)])
+        deadline = self.ctx.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS
+        unseen = {c.node_pool.metadata.name for c in eligible}
         allowed = dict(budgets)
         for c in eligible:
+            if self.ctx.clock.now() > deadline:
+                # abandon the round; pools not yet reached get priority next
+                # time (singlenodeconsolidation.go:61-74)
+                self._count_timeout()
+                self.previously_unseen_node_pools = unseen
+                return []
             pool = c.node_pool.metadata.name
+            unseen.discard(pool)
             if allowed.get(pool, 0) <= 0:
+                continue
+            # skip candidates that can't clear the balanced threshold even as
+            # a pure delete (singlenodeconsolidation.go:88-90)
+            if not self._can_pass_threshold(c):
                 continue
             cmd = self.compute_consolidation([c])
             if cmd.candidates and self._passes_balanced(cmd):
@@ -265,8 +330,11 @@ class SingleNodeConsolidation(_ConsolidationBase):
                 try:
                     Validator(self.ctx, self, mode="strict", metrics=self.ctx.metrics).validate(cmd)
                 except ValidationError:
+                    self.previously_unseen_node_pools = unseen
                     return []
+                self.previously_unseen_node_pools = unseen
                 return [cmd]
+        self.previously_unseen_node_pools = unseen
         return []
 
 
@@ -291,15 +359,19 @@ class MultiNodeConsolidation(_ConsolidationBase):
         filtered = filtered[:MULTI_NODE_CONSOLIDATION_CANDIDATE_CAP]
         if len(filtered) < 2:
             return []
+        # ONE 1-minute budget covers the whole multi-node compute — the
+        # annealed device search and the binary-search fallback share it, so
+        # a slow pool can't starve rounds regardless of backend
+        deadline = self.ctx.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS
         # TPU backend: annealed subset search proposes candidate sets; each is
         # exact-validated through the same simulation before use (stage 8)
         cmd = Command()
         if getattr(self.ctx.options, "solver_backend", "ffd") == "tpu":
-            cmd = self._annealed_option(filtered)
+            cmd = self._annealed_option(filtered, deadline)
             if not (cmd.candidates and self._passes_balanced(cmd)):
                 cmd = Command()
         if not cmd.candidates:
-            cmd = self._first_n_consolidation_option(filtered)
+            cmd = self._first_n_consolidation_option(filtered, deadline)
             if not (cmd.candidates and self._passes_balanced(cmd)):
                 return []
         # 15s wait + re-simulation before execution
@@ -312,8 +384,9 @@ class MultiNodeConsolidation(_ConsolidationBase):
             return []
         return [cmd]
 
-    def _annealed_option(self, candidates) -> Command:
-        """Device subset search + host exact validation."""
+    def _annealed_option(self, candidates, deadline: float) -> Command:
+        """Device subset search + host exact validation, under the shared
+        1-minute compute budget."""
         import logging
 
         from ...solver.consolidation import propose_subsets
@@ -328,6 +401,9 @@ class MultiNodeConsolidation(_ConsolidationBase):
             logging.getLogger("karpenter.disruption").warning("annealed consolidation search failed, falling back: %s", e)
             return Command()
         for subset in proposals:
+            if self.ctx.clock.now() > deadline:
+                self._count_timeout()
+                return Command()
             chosen = [candidates[i] for i in subset]
             cmd = self.compute_consolidation(chosen)
             if cmd.candidates:
@@ -345,11 +421,18 @@ class MultiNodeConsolidation(_ConsolidationBase):
         rep = _replacement_price(cmd)
         return any(abs(c.price - rep) < 1e-9 for c in cmd.candidates)
 
-    def _first_n_consolidation_option(self, candidates) -> Command:
-        """firstNConsolidationOption (multinodeconsolidation.go:117-191)."""
+    def _first_n_consolidation_option(self, candidates, deadline: float | None = None) -> Command:
+        """firstNConsolidationOption (multinodeconsolidation.go:117-191): binary
+        search on batch size under a 1-minute budget — on timeout return the
+        last valid command found (or nothing)."""
         min_n, max_n = 1, len(candidates)
         last_valid = Command()
+        if deadline is None:
+            deadline = self.ctx.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS
         while min_n <= max_n:
+            if self.ctx.clock.now() > deadline:
+                self._count_timeout()
+                return last_valid
             mid = (min_n + max_n) // 2
             cmd = self.compute_consolidation(candidates[: mid + 1])
             if not cmd.candidates:

@@ -81,7 +81,7 @@ def make_registry() -> Registry:
     r.gauge(SCHEDULER_UNSCHEDULABLE_PODS, "Pods the last solve could not place", ())
     r.counter(DISRUPTION_DECISIONS_TOTAL, "Disruption decisions", ("decision", "method", "consolidation_type"))
     r.gauge(DISRUPTION_ELIGIBLE_NODES, "Nodes eligible for disruption", ("method", "consolidation_type"))
-    r.counter(DISRUPTION_CONSOLIDATION_TIMEOUTS_TOTAL, "Consolidation probes aborted on timeout", ("method",))
+    r.counter(DISRUPTION_CONSOLIDATION_TIMEOUTS_TOTAL, "Consolidation probes aborted on timeout", ("consolidation_type",))
     r.counter(DISRUPTION_FAILED_VALIDATIONS_TOTAL, "Commands dropped by the validator", ("method",))
     r.counter(DISRUPTION_QUEUE_FAILURES_TOTAL, "Disruption commands that failed in the queue", ("method",))
     r.histogram(DISRUPTION_DECISION_EVAL_DURATION, "Time to compute a disruption decision", ("method",), DURATION_BUCKETS)

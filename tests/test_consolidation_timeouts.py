@@ -1,0 +1,191 @@
+"""Consolidation compute caps + cross-round fairness depth specs.
+
+Reference: multinodeconsolidation.go:35,117-191 (1-min binary-search budget),
+singlenodeconsolidation.go:33-176 (3-min budget, PreviouslyUnseenNodePools
+interweave carry-over, CanPassThreshold pre-filter, ConsolidationTimeoutsTotal).
+"""
+
+from types import SimpleNamespace
+
+from karpenter_tpu import metrics as m
+from karpenter_tpu.apis.nodepool import BALANCED
+from karpenter_tpu.controllers.disruption.balanced import NodePoolTotals
+from karpenter_tpu.controllers.disruption.controller import _Ctx
+from karpenter_tpu.controllers.disruption.methods import (
+    MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS,
+    SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from karpenter_tpu.controllers.disruption.types import Command
+from karpenter_tpu.metrics import make_registry
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def make_candidate(pool_name: str, cost: float = 1.0, price: float = 1.0, policy: str = "WhenEmptyOrUnderutilized"):
+    node_pool = SimpleNamespace(
+        metadata=SimpleNamespace(name=pool_name),
+        spec=SimpleNamespace(disruption=SimpleNamespace(consolidation_policy=policy)),
+    )
+    return SimpleNamespace(
+        node_pool=node_pool,
+        disruption_cost=cost,
+        reschedule_disruption_cost=1.0,
+        price=price,
+        name=lambda: pool_name,
+    )
+
+
+def make_ctx(clock=None, registry=None):
+    clock = clock or FakeClock()
+    ctx = _Ctx(
+        store=None,
+        cluster=None,
+        provisioner=None,
+        clock=clock,
+        options=SimpleNamespace(solver_backend="ffd", feature_gates=SimpleNamespace(spot_to_spot_consolidation=False)),
+        metrics=registry if registry is not None else make_registry(),
+    )
+    return ctx
+
+
+class TestSingleNodeTimeout:
+    def _method(self, ctx):
+        method = SingleNodeConsolidation(ctx)
+        method.should_disrupt = lambda c: True
+        return method
+
+    def test_timeout_aborts_and_carries_unseen_pools(self):
+        # singlenodeconsolidation.go:61-74: on timeout the round returns
+        # nothing, counts the timeout, and saves the not-yet-seen pools
+        ctx = make_ctx()
+        method = self._method(ctx)
+        # every simulation "costs" 100s on the deterministic clock
+        method.compute_consolidation = lambda cs: (ctx.clock.step(100.0), Command())[1]
+        cands = [make_candidate(p, cost=i) for p in ("pa", "pb", "pc") for i in range(2)]
+        budgets = {"pa": 10, "pb": 10, "pc": 10}
+        out = method.compute_commands(cands, budgets)
+        assert out == []
+        # interweave order is pa0, pb0, pc0, ...: after 100s+100s candidates
+        # pa0/pb0 evaluated; the pc check at t=200 > 180 aborts before pc
+        assert method.previously_unseen_node_pools == {"pc"}
+        assert (
+            ctx.metrics.counter(m.DISRUPTION_CONSOLIDATION_TIMEOUTS_TOTAL).value(consolidation_type="single") == 1
+        )
+
+    def test_unseen_pools_go_first_next_round(self):
+        # shuffleCandidates (singlenodeconsolidation.go:143-176): pools unseen
+        # after a timeout lead the next round's interweave
+        ctx = make_ctx()
+        method = self._method(ctx)
+        method.previously_unseen_node_pools = {"pc"}
+        cands = [make_candidate(p, cost=i) for p in ("pa", "pb", "pc") for i in range(2)]
+        ordered = method.sort_candidates(cands)
+        assert ordered[0].node_pool.metadata.name == "pc"
+        # round-robin across pools, unseen-first within each wave
+        wave1 = [c.node_pool.metadata.name for c in ordered[:3]]
+        assert wave1 == ["pc", "pa", "pb"]
+
+    def test_interweave_prevents_one_pool_starvation(self):
+        # the plain cost sort would put all of pool-big first; the interweave
+        # alternates pools so each wave touches every pool once
+        ctx = make_ctx()
+        method = self._method(ctx)
+        cands = [make_candidate("big", cost=i) for i in range(5)]
+        cands += [make_candidate("small", cost=100 + i) for i in range(2)]
+        ordered = method.sort_candidates(cands)
+        names = [c.node_pool.metadata.name for c in ordered]
+        assert names[:4] == ["big", "small", "big", "small"]
+
+    def test_no_timeout_clears_unseen(self):
+        ctx = make_ctx()
+        method = self._method(ctx)
+        method.previously_unseen_node_pools = {"stale"}
+        method.compute_consolidation = lambda cs: Command()
+        cands = [make_candidate("pa"), make_candidate("pb")]
+        method.compute_commands(cands, {"pa": 1, "pb": 1})
+        assert method.previously_unseen_node_pools == set()
+
+    def test_can_pass_threshold_skips_simulation(self):
+        # singlenodeconsolidation.go:88-90 + balanced.go:285-299: a Balanced
+        # candidate whose best-case (full delete) score fails 1/k is skipped
+        # without paying for the scheduling simulation
+        ctx = make_ctx()
+        ctx.node_pool_totals = {"bal": NodePoolTotals(total_cost=1e9, total_disruption_cost=1.0)}
+        method = self._method(ctx)
+        calls = []
+        method.compute_consolidation = lambda cs: (calls.append(cs), Command())[1]
+        bad = make_candidate("bal", price=1.0, policy=BALANCED)
+        method.compute_commands([bad], {"bal": 1})
+        assert calls == []  # pre-filter rejected before simulation
+
+    def test_can_pass_threshold_lets_good_candidates_through(self):
+        ctx = make_ctx()
+        ctx.node_pool_totals = {"bal": NodePoolTotals(total_cost=10.0, total_disruption_cost=100.0)}
+        method = self._method(ctx)
+        calls = []
+        method.compute_consolidation = lambda cs: (calls.append(cs), Command())[1]
+        good = make_candidate("bal", price=5.0, policy=BALANCED)  # delete score >> 1/k
+        method.compute_commands([good], {"bal": 1})
+        assert len(calls) == 1
+
+    def test_non_balanced_pools_always_pass_prefilter(self):
+        ctx = make_ctx()
+        method = self._method(ctx)
+        calls = []
+        method.compute_consolidation = lambda cs: (calls.append(cs), Command())[1]
+        method.compute_commands([make_candidate("plain", price=0.0)], {"plain": 1})
+        assert len(calls) == 1
+
+
+class TestMultiNodeTimeout:
+    def test_timeout_returns_last_valid_command(self):
+        # multinodeconsolidation.go:139-152: binary search aborts on deadline
+        # and returns the last batch that validated
+        ctx = make_ctx()
+        method = MultiNodeConsolidation(ctx)
+        cands = [make_candidate(f"p{i}") for i in range(8)]
+        saved = Command(reason="underutilized", candidates=cands[:4])
+
+        def slow_probe(cs):
+            ctx.clock.step(70.0)  # one probe blows the 60s budget
+            return saved
+
+        method.compute_consolidation = slow_probe
+        out = method._first_n_consolidation_option(cands)
+        assert out is saved
+        assert (
+            ctx.metrics.counter(m.DISRUPTION_CONSOLIDATION_TIMEOUTS_TOTAL).value(consolidation_type="multi") == 1
+        )
+
+    def test_timeout_with_no_valid_command_returns_empty(self):
+        ctx = make_ctx()
+        method = MultiNodeConsolidation(ctx)
+        cands = [make_candidate(f"p{i}") for i in range(8)]
+
+        def slow_failing_probe(cs):
+            ctx.clock.step(70.0)
+            return Command()
+
+        method.compute_consolidation = slow_failing_probe
+        out = method._first_n_consolidation_option(cands)
+        assert not out.candidates
+
+    def test_fast_search_unaffected_by_budget(self):
+        ctx = make_ctx()
+        method = MultiNodeConsolidation(ctx)
+        cands = [make_candidate(f"p{i}") for i in range(8)]
+        probes = []
+
+        def fast_probe(cs):
+            probes.append(len(cs))
+            return Command(reason="underutilized", candidates=list(cs))
+
+        method.compute_consolidation = fast_probe
+        out = method._first_n_consolidation_option(cands)
+        assert len(out.candidates) == 8  # full batch found
+        assert ctx.metrics.counter(m.DISRUPTION_CONSOLIDATION_TIMEOUTS_TOTAL).total() == 0
+
+    def test_budget_constants_match_reference(self):
+        assert MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS == 60.0  # multinodeconsolidation.go:35
+        assert SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS == 180.0  # singlenodeconsolidation.go:33
