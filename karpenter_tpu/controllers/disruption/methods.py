@@ -370,7 +370,9 @@ class MultiNodeConsolidation(_ConsolidationBase):
             cmd = self._annealed_option(filtered, deadline)
             if not (cmd.candidates and self._passes_balanced(cmd)):
                 cmd = Command()
-        if not cmd.candidates:
+        if not cmd.candidates and self.ctx.clock.now() <= deadline:
+            # the annealed stage consuming the whole budget already counted
+            # its timeout — don't start (and re-count) the binary search
             cmd = self._first_n_consolidation_option(filtered, deadline)
             if not (cmd.candidates and self._passes_balanced(cmd)):
                 return []

@@ -50,6 +50,8 @@ KIND_DOM_SPREAD = 0
 KIND_HOST_SPREAD = 1
 KIND_HOST_ANTI = 2
 KIND_DOM_ANTI = 3
+KIND_DOM_AFF = 4  # required pod affinity over a non-hostname topology key
+KIND_HOST_AFF = 5  # required pod affinity over hostname (co-location)
 KIND_ZONE_SPREAD = KIND_DOM_SPREAD  # zone is dom key 0
 
 # domain id 0 is the zone key's "row has no value" sentinel (encode.py)

@@ -34,8 +34,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from .scheduler_model import (
+    KIND_DOM_AFF,
     KIND_DOM_ANTI,
     KIND_DOM_SPREAD,
+    KIND_HOST_AFF,
     KIND_HOST_ANTI,
     KIND_HOST_SPREAD,
     NEG,
@@ -67,6 +69,9 @@ class ItemTensors:
     item_port_any: jnp.ndarray  # [W, P1] bool
     item_port_wild: jnp.ndarray  # [W, P1] bool
     item_port_spec: jnp.ndarray  # [W, P2] bool
+    # inverse anti-affinity from running pods: existing nodes this item may
+    # never land on (encode.sig_host_blocked)
+    item_host_blocked: jnp.ndarray  # [W, max(n_existing, 1)] bool
 
 
 jax.tree_util.register_dataclass(
@@ -83,6 +88,7 @@ jax.tree_util.register_dataclass(
         "item_port_any",
         "item_port_wild",
         "item_port_spec",
+        "item_host_blocked",
     ],
     meta_fields=[],
 )
@@ -99,7 +105,9 @@ def build_items(enc):
     G = enc.sig_member.shape[1] if enc.sig_member.size else 0
     sig_member = enc.sig_member if G else np.zeros((max(S, 1), 1), bool)
     zone_groups = (
-        ((enc.group_kind == KIND_DOM_SPREAD) | (enc.group_kind == KIND_DOM_ANTI)) if G else np.zeros(1, bool)
+        ((enc.group_kind == KIND_DOM_SPREAD) | (enc.group_kind == KIND_DOM_ANTI) | (enc.group_kind == KIND_DOM_AFF))
+        if G
+        else np.zeros(1, bool)
     )
     multi_zone_sig = (sig_member & zone_groups[None, :]).sum(axis=1) > 1  # [S]
     sig = np.asarray(enc.sig_of_pod, dtype=np.int64)
@@ -128,6 +136,7 @@ def build_items(enc):
         item_port_any=enc.sig_port_any[rep_sig],
         item_port_wild=enc.sig_port_wild[rep_sig],
         item_port_spec=enc.sig_port_spec[rep_sig],
+        item_host_blocked=enc.sig_host_blocked[rep_sig],
     )
     return arrays, item_pods
 
@@ -261,6 +270,9 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_ex
     rank_of_row = jnp.clip(t.row_pool_rank, 0, Q - 1)
     is_dom_spread_g = t.group_kind == KIND_DOM_SPREAD
     is_dom_anti_g = t.group_kind == KIND_DOM_ANTI
+    is_dom_aff_g = t.group_kind == KIND_DOM_AFF
+    is_host_aff_g = t.group_kind == KIND_HOST_AFF
+    hb_width = items.item_host_blocked.shape[1]
 
     # item x row compatibility + row preference, one vectorized pass (W small)
     compat_items = compat_matrix(t.row_labels, t.row_taint_class, items.item_mask, items.item_taint_ok, dom_keys, batch_size=256)
@@ -295,7 +307,9 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_ex
             )
             return ~conflict
 
-        zone_member_mask = mem & (is_dom_spread_g | is_dom_anti_g)
+        # keyed-domain membership spans spread, anti, AND affinity groups for
+        # the key choice; the branch dispatch below keeps their semantics apart
+        zone_member_mask = mem & (is_dom_spread_g | is_dom_anti_g | is_dom_aff_g)
         is_zm = jnp.any(zone_member_mask)
         # the item's domain key (the window guarantees all its dom groups
         # share one); kmask selects that key's domains
@@ -304,9 +318,12 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_ex
         # other-key gating: every dom key the item constrains must keep an
         # allowed value in a candidate's domain set
         restrict_other = restrict & (jnp.arange(Kd) != k_star)
-        host_kinds = (t.group_kind == KIND_HOST_SPREAD) | (t.group_kind == KIND_HOST_ANTI)
-        host_member_mask = mem & host_kinds  # counting
-        host_owner_mask = own & host_kinds  # gating
+        host_gate_kinds = (t.group_kind == KIND_HOST_SPREAD) | (t.group_kind == KIND_HOST_ANTI)
+        host_count_kinds = host_gate_kinds | is_host_aff_g  # affinity records, never caps
+        host_member_mask = mem & host_count_kinds  # counting
+        host_owner_mask = own & host_gate_kinds  # gating
+        # inverse anti-affinity: existing nodes this item may never land on
+        blocked_slots = in_existing & items.item_host_blocked[i][jnp.clip(slot_ids, 0, hb_width - 1)]
 
         def member_host_cap(counts_host_now):
             """Per-slot host caps from member groups (anti: 1 iff untouched),
@@ -332,8 +349,9 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_ex
             """Open+compatible slots derived from the CURRENT threaded basis —
             same staleness class as member_host_cap: slots opened by an earlier
             place() call in this step must be visible to later fill and
-            redistribution passes, or their headroom is wasted on fresh nodes."""
-            return (slot_basis_now >= 0) & compat_rows[jnp.clip(slot_basis_now, 0, Nrows - 1)]
+            redistribution passes, or their headroom is wasted on fresh nodes.
+            Inverse-anti blocked existing nodes are never compatible."""
+            return (slot_basis_now >= 0) & compat_rows[jnp.clip(slot_basis_now, 0, Nrows - 1)] & ~blocked_slots
 
         slot_compat = slot_compat_of(slot_basis)
 
@@ -545,11 +563,91 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_ex
                 pending = pending - (cnt - left)
             return take_all, pending, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports)
 
+        def dom_aff_path(op):
+            """Required pod affinity over a domain key, symmetric case
+            (_next_domain_affinity, topology.go:246-282): members may land in
+            any reachable RECORDED domain (count > 0); with none reachable,
+            the first successful placement bootstraps ONE registered domain
+            and the rest of the batch co-locates there — exactly the host's
+            late-committal record() (claims pin one domain, so the second pod
+            sees count > 0 only in the bootstrap domain)."""
+            slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports = op
+            aff_mask = zone_member_mask & is_dom_aff_g  # [G]
+
+            def other_ok_of(zs_now):
+                return perkey_dom_ok(zs_now, za, restrict_other, t.dom_key_of)
+
+            vsum = jnp.sum(jnp.where(aff_mask[:, None], counts_zone, 0), axis=0)  # [D]
+            reg_star = jnp.sum(jnp.where(aff_mask[:, None], t.group_registered, False), axis=0) > 0
+            allowed_rec = za & kmask & reg_star & (vsum > 0)
+            any_rec = jnp.any(allowed_rec)
+            bootstrapable = za & kmask & reg_star
+            take_all = jnp.zeros((N_loc,), jnp.int32)
+            pending = c
+            placed_z = jnp.zeros((D,), jnp.int32)
+            boot = jnp.int32(-1)
+            for z in range(D):  # D is small and static; unrolled
+                active = jnp.where(any_rec, allowed_rec[z], jnp.where(boot >= 0, boot == z, bootstrapable[z]))
+                cnt = jnp.where(active, pending, 0)
+                narrow_z = jnp.where(kmask, jnp.arange(D) == z, za)
+                elig = slot_compat_of(slot_basis) & slot_zoneset[:, z] & other_ok_of(slot_zoneset)
+                take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports = place(
+                    cnt, elig, t.rank_domset[:, z] & rank_ok_other, narrow_z,
+                    slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports,
+                )
+                placed = cnt - left
+                take_all = take_all + take
+                pending = pending - placed
+                placed_z = placed_z.at[z].add(placed)
+                boot = jnp.where((~any_rec) & (boot < 0) & (placed > 0), z, boot)
+            counts_zone = counts_zone + jnp.where(aff_mask[:, None], placed_z[None, :], 0)
+            return take_all, pending, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports)
+
+        def host_aff_path(op):
+            """Required hostname pod affinity (co-location): members land on
+            hosts already counting the group; with none recorded, ONE pod
+            bootstraps a host (existing or fresh, like the host oracle's
+            first-fit) and the rest pile onto it. place() records members into
+            counts_host via host_member_mask, so the second pass's recorded
+            set sees the bootstrap."""
+            slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports = op
+            aff_g = own & is_host_aff_g  # [G]
+
+            def rec_ok_of(counts_host_now):
+                return jnp.all(jnp.where(aff_g[:, None], counts_host_now > 0, True), axis=0)  # [N_loc]
+
+            def dom_ok_of(zs_now):
+                return perkey_dom_ok(zs_now, za, restrict, t.dom_key_of)
+
+            # recorded hosts exist at all (capacity or not): bootstrap is only
+            # legal when the recorded set is empty/unreachable -> approximated
+            # by set-empty; an unreachable recorded host leaves the batch
+            # unplaced, which decode surfaces exactly like the host oracle
+            any_rec = gsum(rec_ok_of(counts_host).astype(jnp.int32)) > 0
+            boot_cnt = jnp.where(any_rec, 0, jnp.minimum(c, 1))
+            elig_all = slot_compat_of(slot_basis) & dom_ok_of(slot_zoneset)
+            take1, left1, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports = place(
+                boot_cnt, elig_all, rank_ok_all, za,
+                slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports,
+            )
+            rest = c - (boot_cnt - left1)
+            no_open = jnp.zeros((Q,), dtype=bool)
+            elig_rec = slot_compat_of(slot_basis) & dom_ok_of(slot_zoneset) & rec_ok_of(counts_host)
+            take2, left2, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports = place(
+                rest, elig_rec, no_open, za,
+                slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports,
+            )
+            return take1 + take2, left2, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports)
+
         operand = (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports)
         is_anti_item = jnp.any(zone_member_mask & is_dom_anti_g)
-        branch = jnp.where(is_anti_item, 2, jnp.where(is_zm, 1, 0)).astype(jnp.int32)
+        is_domaff_item = jnp.any(zone_member_mask & is_dom_aff_g)
+        is_hostaff_item = jnp.any(mem & is_host_aff_g)
+        branch = jnp.where(
+            is_hostaff_item, 4, jnp.where(is_domaff_item, 3, jnp.where(is_anti_item, 2, jnp.where(is_zm, 1, 0)))
+        ).astype(jnp.int32)
         take, leftover, (slot_rem, slot_zoneset, slot_basis, slot_rank, counts_zone, counts_host, open_count, ports) = jax.lax.switch(
-            branch, [simple_path, zone_path, anti_path], operand
+            branch, [simple_path, zone_path, anti_path, dom_aff_path, host_aff_path], operand
         )
 
         new_state = (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, ports)

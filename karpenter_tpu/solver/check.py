@@ -24,7 +24,14 @@ from __future__ import annotations
 
 import numpy as np
 
-from .encode import KIND_DOM_ANTI, KIND_DOM_SPREAD, KIND_HOST_ANTI, KIND_HOST_SPREAD
+from .encode import (
+    KIND_DOM_AFF,
+    KIND_DOM_ANTI,
+    KIND_DOM_SPREAD,
+    KIND_HOST_AFF,
+    KIND_HOST_ANTI,
+    KIND_HOST_SPREAD,
+)
 
 # f32 row_alloc vs f64 totals: values are milli-CPU / MiB scaled, so 1e-3
 # absolute slack is far below one resource unit
@@ -106,7 +113,7 @@ def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_doms
     if G:
         member = enc.sig_member[psig]  # [Pv, G]
         dom_groups = (enc.group_kind == KIND_DOM_SPREAD) | (enc.group_kind == KIND_DOM_ANTI)
-        host_groups = ~dom_groups
+        host_groups = (enc.group_kind == KIND_HOST_SPREAD) | (enc.group_kind == KIND_HOST_ANTI)
         dom_real = np.arange(D) >= Kd  # per-key sentinels occupy the first Kd ids
 
         for g in np.nonzero(dom_groups)[0]:
@@ -155,6 +162,58 @@ def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_doms
                     f"group {int(g)}: domain skew {int(observed.max() - observed.min())} > {int(enc.group_skew[g])}"
                 )
 
+        # -- required pod affinity (domain key): members commit to one real
+        # domain, and every placed domain is either already recorded
+        # (counts_dom_init > 0) or an unreachability-driven bootstrap
+        # (topology.go:246-282 _next_domain_affinity semantics)
+        for g in np.nonzero(enc.group_kind == KIND_DOM_AFF)[0]:
+            k = int(enc.group_dom_key[g])
+            keydoms = (dko == k) & dom_real
+            sel_member = member[:, g]
+            if not sel_member.any():
+                continue
+            zs = slot_domset[slots] & keydoms[None, :]
+            n_real = zs.sum(axis=1)
+            uncommitted = sel_member & (n_real != 1)
+            if uncommitted.any():
+                pidx = np.nonzero(valid)[0][uncommitted]
+                for i in pidx[:_MAX_ERRORS]:
+                    errors.append(f"pod {enc.pods[i].key()}: affinity member on slot without a committed domain")
+            sel = sel_member & (n_real == 1)
+            if not sel.any():
+                continue
+            dom_of_slot = np.argmax(zs, axis=1)
+            placed_doms = set(int(d) for d in np.unique(dom_of_slot[sel]))
+            init_doms = set(int(d) for d in np.nonzero((enc.counts_dom_init[g] > 0) & keydoms)[0])
+            for e in sorted(placed_doms - init_doms):
+                others = sorted((init_doms | placed_doms) - {e})
+                if not others:
+                    continue  # the single bootstrap domain
+                sigs_in_e = np.unique(psig[sel & (dom_of_slot == e)])
+                if all(not enc.sig_dom_allowed[s, others].any() for s in sigs_in_e):
+                    continue  # bootstrap forced by unreachable recorded domains
+                errors.append(
+                    f"group {int(g)}: affinity placed {enc.dom_values[e]!r} alongside reachable recorded domains"
+                )
+
+        # -- required pod affinity (hostname): co-location — members only on
+        # recorded hosts, or all on one bootstrap host when none recorded
+        for g in np.nonzero(enc.group_kind == KIND_HOST_AFF)[0]:
+            if not (enc.sig_member[:, g] == enc.sig_owner[:, g]).all():
+                continue  # asymmetric (out-of-window) — host semantics differ
+            sel_member = member[:, g]
+            if not sel_member.any():
+                continue
+            n_ex = enc.n_existing
+            init_slots = set(int(j) for j in np.nonzero(enc.counts_host_existing[g, :n_ex] > 0)[0]) if n_ex else set()
+            placed_slots = set(int(j) for j in np.unique(slots[sel_member]))
+            extras = placed_slots - init_slots
+            if init_slots:
+                if extras:
+                    errors.append(f"group {int(g)}: hostname affinity members off the recorded hosts")
+            elif len(placed_slots) > 1:
+                errors.append(f"group {int(g)}: hostname affinity bootstrapped multiple hosts")
+
         if host_groups.any():
             for g in np.nonzero(host_groups)[0]:
                 # the cap binds only pods that DECLARE the constraint; groups
@@ -172,6 +231,18 @@ def fast_validate(enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_doms
                 kind = "anti-affinity" if enc.group_kind[g] == KIND_HOST_ANTI else "hostname spread"
                 for j in bad_slots[:_MAX_ERRORS]:
                     errors.append(f"group {int(g)}: {kind} violated on slot {int(j)} (count {int(counts[j])})")
+
+    # -- inverse anti-affinity (hostname): running pods' nodes are off-limits
+    # to the signatures their selectors match
+    if enc.sig_host_blocked.any() and enc.n_existing:
+        on_existing = slots < enc.n_existing
+        blocked = np.zeros(slots.shape[0], dtype=bool)
+        if on_existing.any():
+            blocked[on_existing] = enc.sig_host_blocked[psig[on_existing], slots[on_existing]]
+        if blocked.any():
+            pidx = np.nonzero(valid)[0][blocked]
+            for i in pidx[:_MAX_ERRORS]:
+                errors.append(f"pod {enc.pods[i].key()}: placed on a node blocked by running anti-affinity")
 
     # -- host ports -----------------------------------------------------------
     if enc.sig_port_any.any():

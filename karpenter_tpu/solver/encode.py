@@ -58,6 +58,8 @@ KIND_DOM_SPREAD = 0  # spread over a keyed domain axis (zone, capacity-type, ...
 KIND_HOST_SPREAD = 1
 KIND_HOST_ANTI = 2
 KIND_DOM_ANTI = 3  # required anti-affinity over a non-hostname topology key
+KIND_DOM_AFF = 4  # required pod affinity over a non-hostname topology key
+KIND_HOST_AFF = 5  # required pod affinity over hostname (co-location)
 
 # legacy alias: zone is dom-key 0, so zone spread is the kind-0 special case
 KIND_ZONE_SPREAD = KIND_DOM_SPREAD
@@ -132,6 +134,9 @@ class EncodedSnapshot:
     sig_requirements: list  # [S] Requirements (strict, for decode)
     sig_requests: list  # [S] ResourceList (for decode)
     req_class_of_sig: np.ndarray  # [S] i32 — sigs sharing a Requirements class
+    # inverse anti-affinity from running pods (hostname terms): signature may
+    # never land on these existing nodes (topology.go:476-508)
+    sig_host_blocked: np.ndarray  # [S, max(n_existing, 1)] bool
 
     # host ports (hostportusage.go, tensorized as per-slot bitmasks over an
     # interned port vocabulary): P1 = (port, proto) keys, P2 = specific-IP
@@ -354,20 +359,43 @@ def check_capability(snap, pods=None) -> list[str]:
     # (members = pods matched by the selector); that is exact only when the
     # declaring set and the matched set coincide (pure self-anti-affinity,
     # the deployment-replicas case). Asymmetric terms stay host-side. The
-    # same holds for KEYED spread constraints: the host counts matched
-    # non-declaring pods without constraining them, which the domain kernel
-    # can express only when matched == declaring. (Hostname groups are exact
-    # either way via the owner/member mask split.)
+    # same holds for KEYED spread constraints AND required pod affinity: the
+    # host counts matched non-declaring pods without constraining them, which
+    # the domain kernel can express only when matched == declaring. (Hostname
+    # spread/anti groups are exact either way via the owner/member mask
+    # split; hostname affinity keeps the symmetric window because its
+    # bootstrap rule reads self-selection.)
     reasons.extend(_anti_symmetry_reasons(rep_pods))
     reasons.extend(_spread_symmetry_reasons(rep_pods))
+    reasons.extend(_affinity_symmetry_reasons(rep_pods))
     if reasons:
         return reasons
     for pod in rep_pods:
         aff = pod.spec.affinity
         if aff is not None:
-            if aff.pod_affinity_required or aff.pod_affinity_preferred:
-                reasons.append(f"{pod.key()}: pod affinity")
+            if aff.pod_affinity_preferred:
+                # soft constraint: the host relaxation loop owns it
+                reasons.append(f"{pod.key()}: preferred pod affinity")
                 break
+            if aff.pod_affinity_required:
+                # required affinity is in-window (KIND_DOM_AFF/KIND_HOST_AFF:
+                # members co-locate in recorded domains, bootstrapping one
+                # when none is reachable — topology.go:246-282) for the
+                # single-term, selector-symmetric, uncombined case
+                if len(aff.pod_affinity_required) > 1:
+                    reasons.append(f"{pod.key()}: multiple pod affinity terms")
+                    break
+                term = aff.pod_affinity_required[0]
+                if term.namespaces or term.namespace_selector is not None:
+                    reasons.append(f"{pod.key()}: pod affinity with explicit namespaces")
+                    break
+                if (
+                    pod.spec.topology_spread_constraints
+                    or aff.pod_anti_affinity_required
+                    or aff.pod_anti_affinity_preferred
+                ):
+                    reasons.append(f"{pod.key()}: pod affinity combined with other topology constraints")
+                    break
             if aff.pod_anti_affinity_preferred:
                 reasons.append(f"{pod.key()}: preferred anti-affinity")
                 break
@@ -423,9 +451,11 @@ def check_capability(snap, pods=None) -> list[str]:
                 break
             continue
         break
-    # inverse anti-affinity from already-running pods isn't tensorized
-    if snap.cluster.pods_with_anti_affinity():
-        reasons.append("cluster has running pods with required anti-affinity")
+    # inverse anti-affinity from already-running pods IS tensorized: the
+    # running pods' recorded domains cannot change during a solve, so their
+    # inverse groups (topology.go:476-508) lower to STATIC per-signature
+    # blocked-domain / blocked-host masks (_apply_inverse_anti_blocks) —
+    # no capability restriction needed
     # strict reserved-offering mode (consolidation sims) requires per-pod
     # reservation failures, which only the sequential host path expresses;
     # decode's host-side cap implements fallback mode only
@@ -522,18 +552,152 @@ def _spread_symmetry_reasons(rep_pods) -> list[str]:
     return reasons
 
 
-def _dom_keys_for(rep_pods) -> list[str]:
+def _affinity_symmetry_reasons(rep_pods) -> list[str]:
+    """Required pod-affinity terms whose declaring set != matched set (over
+    the solve's unique pod shapes): the symmetric group model counts exactly
+    the pods it constrains, so matched-but-not-declaring pods would wrongly
+    bootstrap/commit domains for the group."""
+    declared: dict[tuple, tuple[set[int], object]] = {}
+    for s, pod in enumerate(rep_pods):
+        aff = pod.spec.affinity
+        if aff is None:
+            continue
+        for term in aff.pod_affinity_required:
+            ident = (term.topology_key, _sel_key(term.label_selector), pod.metadata.namespace)
+            entry = declared.get(ident)
+            if entry is None:
+                declared[ident] = ({s}, term.label_selector)
+            else:
+                entry[0].add(s)
+    reasons = []
+    for (key, _selk, ns), (declarers, selector) in declared.items():
+        matched = {
+            s
+            for s, pod in enumerate(rep_pods)
+            if pod.metadata.namespace == ns and selector is not None and match_label_selector(selector, pod.metadata.labels)
+        }
+        if matched != declarers:
+            reasons.append(f"asymmetric pod affinity (key {key}): selector matches pods that do not declare it")
+    return reasons
+
+
+def _term_namespaces(store, pod, term) -> set[str]:
+    """Namespaces a pod-(anti-)affinity term spans (topology.py
+    _namespaces_for_term semantics)."""
+    if term.namespaces:
+        return set(term.namespaces)
+    if term.namespace_selector is not None:
+        if not term.namespace_selector:
+            return {p.metadata.namespace for p in store.list("Pod")} | {pod.metadata.namespace}
+        return {pod.metadata.namespace}
+    return {pod.metadata.namespace}
+
+
+def _inverse_anti_entries(snap, solve_uids: set) -> list[dict]:
+    """Running pods with required anti-affinity -> static blocking entries.
+
+    The host tracks these as inverse topology groups (topology.go:476-508,
+    topology.py _update_inverse_affinities): an incoming pod their selector
+    matches may only land in REGISTERED domains of the term's key that do not
+    already hold the running pod. Running pods cannot move during a solve, so
+    the whole mechanism lowers to per-signature static masks."""
+    entries: list[dict] = []
+    cluster = getattr(snap, "cluster", None)
+    if cluster is None:
+        return entries
+    for pod in cluster.pods_with_anti_affinity():
+        if pod.metadata.uid in solve_uids:
+            continue
+        aff = pod.spec.affinity
+        if aff is None:
+            continue
+        node = snap.store.try_get("Node", pod.spec.node_name) if pod.spec.node_name else None
+        node_labels = node.metadata.labels if node is not None else {}
+        for term in aff.pod_anti_affinity_required:
+            entries.append(
+                dict(
+                    key=term.topology_key,
+                    selector=term.label_selector,
+                    namespaces=_term_namespaces(snap.store, pod, term),
+                    # recorded only when the node carries the label, exactly
+                    # like _update_inverse_anti_affinity (no hostname-name
+                    # fallback there, unlike _count_domains)
+                    recorded=node_labels.get(term.topology_key),
+                    node_name=pod.spec.node_name,
+                )
+            )
+    return entries
+
+
+def _apply_inverse_anti_blocks(entries, rep_pods, rows, sig_dom_allowed, n_existing: int, state_nodes) -> np.ndarray:
+    """Lower inverse anti-affinity entries into sig_dom_allowed (in place) and
+    a per-(signature, existing node) blocked matrix.
+
+    Host semantics per matching inverse group (_next_domain_anti_affinity):
+    the pod's viable domains for the term's key are the group's REGISTERED
+    domains (NodePool x IT universe — inverse groups never count existing
+    nodes into their registry) minus the recorded (running-pod) domains; a
+    row carrying no value for the key remains viable iff that set is
+    nonempty (Requirements.get of an absent key is Exists)."""
+    S = len(rep_pods)
+    sig_host_blocked = np.zeros((S, max(n_existing, 1)), dtype=bool)
+    if not entries:
+        return sig_host_blocked
+    key_idx = {k: i for i, k in enumerate(rows.dom_key_names)}
+    node_idx = {sn.name(): j for j, sn in enumerate(state_nodes)}
+    dko = np.asarray(rows.dom_key_of_l)
+    matched_keys: set[tuple[int, int]] = set()  # (sig, dom key) pairs touched
+    for e in entries:
+        sel = e["selector"]
+        matched = [
+            s
+            for s, pod in enumerate(rep_pods)
+            if pod.metadata.namespace in e["namespaces"] and sel is not None and match_label_selector(sel, pod.metadata.labels)
+        ]
+        if not matched:
+            continue
+        if e["key"] == wk.HOSTNAME_LABEL_KEY:
+            j = node_idx.get(e["node_name"] or "")
+            if j is not None:
+                for s in matched:
+                    sig_host_blocked[s, j] = True
+            continue
+        k = key_idx[e["key"]]
+        keydoms = dko == k
+        keydoms[rows.dom_sentinel[k]] = False  # real domains of the key
+        allowed = rows.universe_dom & keydoms
+        rec = e["recorded"]
+        if rec is not None:
+            d = rows.dom_ids[k].get(rec)
+            if d is not None:
+                allowed = allowed.copy()
+                allowed[d] = False
+        blocked = keydoms & ~allowed
+        for s in matched:
+            sig_dom_allowed[s, blocked] = False
+            matched_keys.add((s, k))
+    # per-key sentinel: viable only while some registered real domain of the
+    # key survives the pod's own requirements and every entry's blocking
+    for s, k in matched_keys:
+        keydoms = dko == k
+        keydoms[rows.dom_sentinel[k]] = False
+        if not (sig_dom_allowed[s] & keydoms).any():
+            sig_dom_allowed[s, rows.dom_sentinel[k]] = False
+    return sig_host_blocked
+
+
+def _dom_keys_for(rep_pods, extra_keys=()) -> list[str]:
     """The snapshot's domain keys: zone always (dom key 0), plus every
-    non-hostname topology key referenced by a spread constraint or required
-    anti-affinity term."""
-    keys: set[str] = set()
+    non-hostname topology key referenced by a spread constraint, required
+    (anti-)affinity term, or running-pod inverse anti-affinity term."""
+    keys: set[str] = set(k for k in extra_keys if k != wk.HOSTNAME_LABEL_KEY)
     for pod in rep_pods:
         for tsc in pod.spec.topology_spread_constraints:
             if tsc.topology_key != wk.HOSTNAME_LABEL_KEY:
                 keys.add(tsc.topology_key)
         aff = pod.spec.affinity
         if aff is not None:
-            for term in aff.pod_anti_affinity_required:
+            for term in list(aff.pod_anti_affinity_required) + list(aff.pod_affinity_required):
                 if term.topology_key != wk.HOSTNAME_LABEL_KEY:
                     keys.add(term.topology_key)
     return [wk.ZONE_LABEL_KEY] + sorted(keys - {wk.ZONE_LABEL_KEY})
@@ -940,7 +1104,9 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         return v
 
     # -- row side: cached across solves on the cluster generation -------------
-    dom_keys = _dom_keys_for(rep_pods)
+    solve_uids = {p.metadata.uid for p in snap.pods}
+    inverse_entries = _inverse_anti_entries(snap, solve_uids)
+    dom_keys = _dom_keys_for(rep_pods, extra_keys=[e["key"] for e in inverse_entries])
     rows: _RowArtifacts | None = None
     row_key: tuple | None = None
     if cache is not None:
@@ -1040,6 +1206,13 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
             for v, did in dom_ids[k].items():
                 sig_dom_allowed[s, did] = r.has(v)
 
+    # inverse anti-affinity from running pods: selected signatures may only
+    # land in registered-but-unrecorded domains of each matching term's key
+    # (and never on the running pod's own node for hostname terms)
+    sig_host_blocked = _apply_inverse_anti_blocks(
+        inverse_entries, rep_pods, rows, sig_dom_allowed, n_existing, state_nodes
+    )
+
     # -- host-port vocabulary + masks -----------------------------------------
     from ..scheduling.hostports import pod_host_ports
 
@@ -1113,6 +1286,20 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
                     kind, dk = KIND_HOST_ANTI, -1
                 else:
                     kind, dk = KIND_DOM_ANTI, dom_key_idx[term.topology_key]
+                ident = (kind, dk, 0, 0, _sel_key(term.label_selector), pod.metadata.namespace)
+                group_defs.setdefault(
+                    ident,
+                    {"kind": kind, "dom_key": dk, "skew": 0, "min_domains": 0, "selector": term.label_selector, "ns": pod.metadata.namespace},
+                )
+                memberships.append((s, ident))
+            for term in aff.pod_affinity_required:
+                # required pod affinity (topology.go:246-282): members
+                # co-locate in recorded domains, bootstrapping one when none
+                # is reachable
+                if term.topology_key == wk.HOSTNAME_LABEL_KEY:
+                    kind, dk = KIND_HOST_AFF, -1
+                else:
+                    kind, dk = KIND_DOM_AFF, dom_key_idx[term.topology_key]
                 ident = (kind, dk, 0, 0, _sel_key(term.label_selector), pod.metadata.namespace)
                 group_defs.setdefault(
                     ident,
@@ -1225,6 +1412,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         sig_requirements=sig_requirements,
         sig_requests=sig_requests,
         req_class_of_sig=req_class_of_sig,
+        sig_host_blocked=sig_host_blocked,
         sig_port_any=sig_port_any,
         sig_port_wild=sig_port_wild,
         sig_port_spec=sig_port_spec,

@@ -497,6 +497,7 @@ class TestNodeFilteredSpreadWindow:
         assert any("taint policy" in r for r in reasons), reasons
 
 
+@pytest.mark.heavy
 class TestShardedDomainEquivalence:
     def test_capacity_type_workload_sharded_equivalent(self):
         import jax

@@ -24,6 +24,10 @@ from karpenter_tpu.operator import Environment
 from karpenter_tpu.operator.options import Options
 from karpenter_tpu.testing import Monitor
 
+import pytest
+
+pytestmark = pytest.mark.heavy
+
 LINUX_AMD64 = [
     {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
     {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},

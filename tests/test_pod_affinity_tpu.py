@@ -1,0 +1,378 @@
+"""Required pod affinity + inverse anti-affinity on the TPU tensor path.
+
+Parity specs ported from the reference's topology_test.go affinity sections
+(topology.go:54-58,246-355 semantics): self pod affinity on hostname/zone
+(co-location + single-domain bootstrap), capacity-bounded co-location,
+recorded-domain attraction from running pods, inverse anti-affinity blocking
+from running pods, and the capability window (asymmetric / preferred /
+combined terms stay on the host FFD oracle).
+"""
+
+import numpy as np
+import pytest
+
+from helpers import make_nodepool, make_pod, parse_resource_list, zone_spread
+from test_solver import LINUX_AMD64, make_snapshot
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import COND_INITIALIZED, COND_REGISTERED, NodeClaim
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.kube import Store
+from karpenter_tpu.kube.objects import Node, NodeSpec, NodeStatus, ObjectMeta, PodAffinityTerm
+from karpenter_tpu.solver.encode import check_capability, encode
+from karpenter_tpu.solver.ffd import FFDSolver
+from karpenter_tpu.solver.snapshot import SolverSnapshot
+from karpenter_tpu.solver.tpu import TPUSolver
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+AFF_LABELS = {"security": "s2"}
+
+
+def self_aff(key, labels=AFF_LABELS):
+    return PodAffinityTerm(label_selector={"matchLabels": dict(labels)}, topology_key=key)
+
+
+def aff_pods(n, key, cpu="500m", labels=AFF_LABELS, **kw):
+    return [
+        make_pod(cpu=cpu, name=f"aff-{key.split('/')[-1]}-{i}", labels=dict(labels), pod_affinity=[self_aff(key, labels)], **kw)
+        for i in range(n)
+    ]
+
+
+def existing_cluster(nodes=(("na", "test-zone-a"), ("nb", "test-zone-b")), node_cpu="32"):
+    """Store + cluster with registered/initialized existing nodes."""
+    store = Store()
+    clock = FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    np_ = make_nodepool(requirements=LINUX_AMD64)
+    store.create(np_)
+    for name, zone in nodes:
+        nc = NodeClaim(metadata=ObjectMeta(name=f"c-{name}", labels={wk.NODEPOOL_LABEL_KEY: np_.metadata.name}))
+        nc.status.provider_id = f"kwok://{name}"
+        nc.status.conditions.set_true(COND_REGISTERED)
+        nc.status.conditions.set_true(COND_INITIALIZED)
+        store.create(nc)
+        store.create(
+            Node(
+                metadata=ObjectMeta(
+                    name=name,
+                    labels={
+                        wk.NODEPOOL_LABEL_KEY: np_.metadata.name,
+                        wk.HOSTNAME_LABEL_KEY: name,
+                        wk.ZONE_LABEL_KEY: zone,
+                    },
+                ),
+                spec=NodeSpec(provider_id=f"kwok://{name}"),
+                status=NodeStatus(
+                    capacity=parse_resource_list({"cpu": node_cpu, "memory": "64Gi", "pods": "110"}),
+                    allocatable=parse_resource_list({"cpu": node_cpu, "memory": "64Gi", "pods": "110"}),
+                ),
+            )
+        )
+    return store, clock, cluster, np_
+
+
+def snapshot_of(store, clock, cluster, np_, pending, types=None):
+    types = types if types is not None else catalog.construct_instance_types()
+    return SolverSnapshot(
+        store=store,
+        cluster=cluster,
+        node_pools=[np_],
+        instance_types={np_.metadata.name: types},
+        state_nodes=cluster.nodes(),
+        daemonset_pods=[],
+        pods=pending,
+        clock=clock,
+    )
+
+
+class TestSelfAffinityTensorPath:
+    def test_hostname_self_affinity_one_node(self):
+        # topology_test.go:2013 "should respect self pod affinity (hostname)"
+        snap = make_snapshot(aff_pods(3, wk.HOSTNAME_LABEL_KEY))
+        assert check_capability(snap) == []
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+        placed = [nc for nc in results.new_node_claims if nc.pods]
+        assert len(placed) == 1 and len(placed[0].pods) == 3
+
+    def test_hostname_self_affinity_capacity_bound(self):
+        # topology_test.go:2037 "first empty topology domain only": once one
+        # host is bootstrapped, overflow pods do NOT open a second node
+        types = [catalog.make_instance_type("c", 4, zones=["test-zone-a"])]
+        snap = make_snapshot(aff_pods(10, wk.HOSTNAME_LABEL_KEY, cpu="1"), types=types)
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        placed = [nc for nc in results.new_node_claims if nc.pods]
+        assert len(placed) == 1, "exactly one co-location node"
+        n_placed = len(placed[0].pods)
+        assert 1 <= n_placed < 10
+        assert len(results.pod_errors) == 10 - n_placed
+
+    def test_zone_self_affinity_one_zone(self):
+        # topology_test.go:2123 "should respect self pod affinity (zone)"
+        snap = make_snapshot(aff_pods(12, wk.ZONE_LABEL_KEY, cpu="4"))
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+        zones = set()
+        for nc in results.new_node_claims:
+            if not nc.pods:
+                continue
+            zr = nc.requirements.get(wk.ZONE_LABEL_KEY)
+            assert len(zr.values) == 1, "claims must pin exactly one zone"
+            zones |= set(zr.values)
+        assert len(zones) == 1, f"all claims in one zone, got {zones}"
+
+    def test_zone_self_affinity_with_constraint(self):
+        # topology_test.go:2147 "(zone w/ constraint)": the pod's own zone
+        # selector narrows the bootstrap choice
+        pods = aff_pods(3, wk.ZONE_LABEL_KEY, node_selector={wk.ZONE_LABEL_KEY: "test-zone-c"})
+        snap = make_snapshot(pods)
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+        for nc in results.new_node_claims:
+            if nc.pods:
+                zr = nc.requirements.get(wk.ZONE_LABEL_KEY)
+                assert list(zr.values) == ["test-zone-c"]
+
+    def test_zone_affinity_attracted_to_recorded_domain(self):
+        # a running pod matching the selector pins the recorded domain: all
+        # solve pods co-locate with it instead of bootstrapping elsewhere
+        # (_next_domain_affinity: recorded domains win over bootstrap)
+        store, clock, cluster, np_ = existing_cluster()
+        runner = make_pod(cpu="100m", name="runner", labels=dict(AFF_LABELS))
+        runner.spec.node_name = "nb"  # zone-b
+        store.create(runner)
+        snap = snapshot_of(store, clock, cluster, np_, aff_pods(6, wk.ZONE_LABEL_KEY, cpu="2"))
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+        used = {en.state_node.name() for en in results.existing_nodes if en.pods}
+        assert used <= {"nb"}
+        for nc in results.new_node_claims:
+            if nc.pods:
+                assert list(nc.requirements.get(wk.ZONE_LABEL_KEY).values) == ["test-zone-b"]
+
+    def test_hostname_affinity_attracted_to_recorded_host(self):
+        store, clock, cluster, np_ = existing_cluster()
+        runner = make_pod(cpu="100m", name="runner", labels=dict(AFF_LABELS))
+        runner.spec.node_name = "na"
+        store.create(runner)
+        snap = snapshot_of(store, clock, cluster, np_, aff_pods(4, wk.HOSTNAME_LABEL_KEY, cpu="1"))
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+        used = {en.state_node.name() for en in results.existing_nodes if en.pods}
+        assert used == {"na"}
+        assert not [nc for nc in results.new_node_claims if nc.pods]
+
+    def test_mixed_affinity_and_plain_workload_equivalence(self):
+        # affinity deployments alongside plain + zone-spread pods: the tensor
+        # result must match the host oracle on the simulation contract
+        pods = aff_pods(8, wk.ZONE_LABEL_KEY, cpu="2")
+        pods += aff_pods(5, wk.HOSTNAME_LABEL_KEY, cpu="500m", labels={"app": "co"})
+        pods += [make_pod(cpu="1", name=f"plain-{i}") for i in range(20)]
+        sel = {"matchLabels": {"spread": "y"}}
+        pods += [
+            make_pod(cpu="1", name=f"sp-{i}", labels={"spread": "y"}, tsc=[zone_spread(selector=sel)])
+            for i in range(9)
+        ]
+        snap = make_snapshot(pods)
+        assert check_capability(snap) == []
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        ffd_results = FFDSolver().solve(make_snapshot(pods))
+        assert results.all_pods_scheduled() == ffd_results.all_pods_scheduled()
+        assert results.all_pods_scheduled()
+
+
+class TestInverseAntiAffinityTensorPath:
+    def _snap(self, key, pending_n=6, node_cpu="32"):
+        store, clock, cluster, np_ = existing_cluster(node_cpu=node_cpu)
+        runner = make_pod(
+            cpu="100m",
+            name="runner",
+            labels={"sentinel": "y"},
+            anti_affinity=[PodAffinityTerm(label_selector={"matchLabels": {"app": "web"}}, topology_key=key)],
+        )
+        runner.spec.node_name = "na"
+        store.create(runner)
+        pending = [make_pod(cpu="100m", name=f"w{i}", labels={"app": "web"}) for i in range(pending_n)]
+        return snapshot_of(store, clock, cluster, np_, pending)
+
+    def test_running_anti_affinity_is_in_window(self):
+        snap = self._snap(wk.ZONE_LABEL_KEY)
+        assert check_capability(snap) == []
+
+    def test_zone_inverse_blocks_existing_node_and_zone(self):
+        # topology_test.go:2463 "should not violate pod anti-affinity on zone
+        # (inverse)" — matched incoming pods avoid the running pod's zone
+        snap = self._snap(wk.ZONE_LABEL_KEY)
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+        used = {en.state_node.name() for en in results.existing_nodes if en.pods}
+        assert "na" not in used
+        for nc in results.new_node_claims:
+            if nc.pods:
+                assert not nc.requirements.get(wk.ZONE_LABEL_KEY).has("test-zone-a")
+
+    def test_zone_inverse_new_claims_avoid_blocked_zone(self):
+        # existing nodes too small -> new claims open, still out of zone-a
+        snap = self._snap(wk.ZONE_LABEL_KEY, pending_n=40, node_cpu="1")
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+        for nc in results.new_node_claims:
+            if nc.pods:
+                assert not nc.requirements.get(wk.ZONE_LABEL_KEY).has("test-zone-a")
+
+    def test_hostname_inverse_blocks_only_that_node(self):
+        # topology_test.go:2530 "(inverse w/existing nodes)" hostname flavor:
+        # only the runner's node is off-limits
+        snap = self._snap(wk.HOSTNAME_LABEL_KEY)
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+        used = {en.state_node.name() for en in results.existing_nodes if en.pods}
+        assert "na" not in used and "nb" in used
+
+    def test_unmatched_pods_unaffected(self):
+        store, clock, cluster, np_ = existing_cluster()
+        runner = make_pod(
+            cpu="100m",
+            name="runner",
+            labels={"sentinel": "y"},
+            anti_affinity=[
+                PodAffinityTerm(label_selector={"matchLabels": {"app": "web"}}, topology_key=wk.ZONE_LABEL_KEY)
+            ],
+        )
+        runner.spec.node_name = "na"
+        store.create(runner)
+        pending = [make_pod(cpu="100m", name=f"o{i}", labels={"app": "other"}) for i in range(4)]
+        snap = snapshot_of(store, clock, cluster, np_, pending)
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+        used = {en.state_node.name() for en in results.existing_nodes if en.pods}
+        assert "na" in used  # first-fit picks the first node: no blocking
+
+    def test_ffd_oracle_agreement(self):
+        for key in (wk.ZONE_LABEL_KEY, wk.HOSTNAME_LABEL_KEY):
+            snap = self._snap(key)
+            tpu = TPUSolver(force=True).solve(snap)
+            ffd = FFDSolver().solve(self._snap(key))
+            t_used = {en.state_node.name() for en in tpu.existing_nodes if en.pods}
+            f_used = {en.state_node.name() for en in ffd.existing_nodes if en.pods}
+            assert t_used == f_used
+            assert tpu.all_pods_scheduled() == ffd.all_pods_scheduled()
+
+
+class TestAffinityCapabilityWindow:
+    def test_asymmetric_affinity_falls_back(self):
+        # topology_test.go:2710 "affinity to a non-existent pod": the pod does
+        # not select itself -> asymmetric -> host oracle (which leaves it
+        # unschedulable, no co-location target existing)
+        pods = [
+            make_pod(
+                cpu="1",
+                name="a0",
+                labels={"app": "seeker"},
+                pod_affinity=[PodAffinityTerm(label_selector={"matchLabels": {"app": "target"}}, topology_key=wk.ZONE_LABEL_KEY)],
+            )
+        ]
+        snap = make_snapshot(pods)
+        reasons = check_capability(snap)
+        assert any("asymmetric pod affinity" in r for r in reasons)
+        solver = TPUSolver()
+        results = solver.solve(snap)
+        assert solver.last_backend == "ffd-fallback"
+        assert len(results.pod_errors) == 1  # no target pod anywhere
+
+    def test_preferred_affinity_falls_back(self):
+        p = make_pod(cpu="1", name="p0", labels=dict(AFF_LABELS))
+        p.spec.affinity = type(p.spec.affinity)() if p.spec.affinity else None
+        from karpenter_tpu.kube.objects import Affinity, WeightedPodAffinityTerm
+
+        p.spec.affinity = Affinity(
+            pod_affinity_preferred=[WeightedPodAffinityTerm(weight=1, term=self_aff(wk.ZONE_LABEL_KEY))]
+        )
+        snap = make_snapshot([p])
+        assert any("preferred pod affinity" in r for r in check_capability(snap))
+
+    def test_combined_affinity_and_spread_falls_back(self):
+        sel = {"matchLabels": dict(AFF_LABELS)}
+        p = make_pod(
+            cpu="1",
+            name="c0",
+            labels=dict(AFF_LABELS),
+            pod_affinity=[self_aff(wk.ZONE_LABEL_KEY)],
+            tsc=[zone_spread(selector=sel)],
+        )
+        snap = make_snapshot([p])
+        assert any("combined with other topology constraints" in r for r in check_capability(snap))
+
+    def test_explicit_namespaces_fall_back(self):
+        term = PodAffinityTerm(
+            label_selector={"matchLabels": dict(AFF_LABELS)},
+            topology_key=wk.ZONE_LABEL_KEY,
+            namespaces=["other-ns"],
+        )
+        p = make_pod(cpu="1", name="n0", labels=dict(AFF_LABELS), pod_affinity=[term])
+        snap = make_snapshot([p])
+        assert any("explicit namespaces" in r for r in check_capability(snap))
+
+
+class TestAffinityValidation:
+    def test_fast_validate_rejects_split_affinity(self):
+        # hand-corrupt a placement: affinity members across two hosts with no
+        # recorded host must fail fast_validate (host-affinity co-location)
+        from karpenter_tpu.solver.check import fast_validate
+        from karpenter_tpu.models.scheduler_model import make_tensors
+        from karpenter_tpu.models.scheduler_model_grouped import (
+            assignment_from_triples,
+            build_items,
+            make_item_tensors,
+        )
+        from karpenter_tpu.models.scheduler_model_grouped import greedy_pack_grouped_compressed
+
+        snap = make_snapshot(aff_pods(4, wk.HOSTNAME_LABEL_KEY, cpu="1"))
+        enc = encode(snap)
+        assert enc.fallback_reasons == []
+        item_arrays, item_pods = build_items(enc)
+        items = make_item_tensors(item_arrays)
+        t = make_tensors(enc, n_slots=enc.n_existing + min(enc.n_pods, 4096), with_pods=False)
+        out = greedy_pack_grouped_compressed(t, items, enc.n_pods)
+        assignment = assignment_from_triples(
+            out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods
+        )
+        ok = fast_validate(enc, assignment, out["slot_basis"], out["slot_zoneset"])
+        assert ok == []
+        # corrupt: open a second slot on the same basis row and move one pod
+        # there — co-location is broken, the validator must catch it
+        bad = assignment.copy()
+        src = int(bad[0])
+        other = src + 1
+        slot_basis = np.asarray(out["slot_basis"]).copy()
+        slot_basis[other] = slot_basis[src]
+        slot_zoneset = np.asarray(out["slot_zoneset"]).copy()
+        slot_zoneset[other] = slot_zoneset[src]
+        bad[0] = other
+        violations = fast_validate(enc, bad, slot_basis, slot_zoneset)
+        assert any("affinity" in v for v in violations)

@@ -21,6 +21,8 @@ from karpenter_tpu.parallel.sharded import (
     dryrun_step,
     make_mesh,
 )
+
+pytestmark = pytest.mark.heavy
 from karpenter_tpu.solver.encode import encode
 from karpenter_tpu.solver.tpu import TPUSolver
 from karpenter_tpu.solver.validate import validate_results
