@@ -370,9 +370,12 @@ class MultiNodeConsolidation(_ConsolidationBase):
             cmd = self._annealed_option(filtered, deadline)
             if not (cmd.candidates and self._passes_balanced(cmd)):
                 cmd = Command()
-        if not cmd.candidates and self.ctx.clock.now() <= deadline:
-            # the annealed stage consuming the whole budget already counted
-            # its timeout — don't start (and re-count) the binary search
+        if not cmd.candidates:
+            if self.ctx.clock.now() > deadline:
+                # the annealed stage consumed the whole budget (and counted
+                # its timeout) — don't start the binary search, and never
+                # hand an empty command to the 15s validator
+                return []
             cmd = self._first_n_consolidation_option(filtered, deadline)
             if not (cmd.candidates and self._passes_balanced(cmd)):
                 return []

@@ -60,13 +60,27 @@ NO_ZONE = 0
 
 @dataclass
 class SchedulerTensors:
-    """Device-ready arrays (registered as a pytree below)."""
+    """Device-ready arrays (registered as a pytree below).
 
+    Every workload-shape axis (rows, resources, label keys, mask words,
+    taint classes, groups, ports, items) is PADDED to a bucket multiple by
+    make_tensors/build_items so that workload drift — a new deployment
+    shape, a new label value, one more topology group — reuses the compiled
+    kernel instead of paying a full XLA retrace (tens of seconds). Pad rows
+    are inert: n_rows_real masks them out of fits_row, pad groups have
+    kind=-1, pad resources/ports are zero, pad taint classes tolerate all."""
+
+    n_rows_real: jnp.ndarray  # i32 scalar — rows beyond this are padding
     row_alloc: jnp.ndarray  # [Nrows, R]
     row_labels: jnp.ndarray  # [Nrows, K]
     row_pool_rank: jnp.ndarray  # [Nrows]
     row_taint_class: jnp.ndarray  # [Nrows]
     rank_domset: jnp.ndarray  # [Q, D] bool — domains each template rank offers
+    # max allocatable among the rank's rows that offer each domain (NEG when
+    # the rank has no row there): placements and slot narrowing are capacity-
+    # bounded per DOMAIN, not just by the rank's global max-capacity envelope
+    # (a zone-b 128x row must not back a zone-a slot beyond zone-a's types)
+    rank_dom_cap: jnp.ndarray  # [Q, D, R] f32
     dom_key_of: jnp.ndarray  # [D] i32 dom-key index per domain
     pod_req: jnp.ndarray  # [P, R]
     pod_mask: jnp.ndarray  # [P, K, W] uint32
@@ -99,11 +113,13 @@ class SchedulerTensors:
 jax.tree_util.register_dataclass(
     SchedulerTensors,
     data_fields=[
+        "n_rows_real",
         "row_alloc",
         "row_labels",
         "row_pool_rank",
         "row_taint_class",
         "rank_domset",
+        "rank_dom_cap",
         "dom_key_of",
         "pod_req",
         "pod_mask",
@@ -136,44 +152,153 @@ def sig_restrict_of(enc) -> np.ndarray:
     return enc.sig_restrict
 
 
+def bucket(n: int, m: int) -> int:
+    """Round n up to a multiple of m (minimum m): the shape-stability ladder."""
+    return -(-max(n, 1) // m) * m
+
+
+# bucket granularity per axis: small enough to keep padding waste low, large
+# enough that steady workload drift stays inside one compiled shape
+ROWS_BUCKET = 64
+RES_BUCKET = 4
+KEYS_BUCKET = 8
+WORDS_BUCKET = 2
+TAINT_BUCKET = 4
+GROUP_BUCKET = 8
+PORT_BUCKET = 4
+RANK_BUCKET = 4
+ITEM_BUCKET = 64
+SLOTS_BUCKET = 512
+
+
+def _pad_axis(a: np.ndarray, axis: int, target: int, fill=0) -> np.ndarray:
+    n = a.shape[axis]
+    if n >= target:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, target - n)
+    return np.pad(a, widths, constant_values=fill)
+
+
+BIG_ALLOC = np.float32(1e30)  # pad-resource allocatable: never the bottleneck
+
+
+def _rank_dom_cap_of(enc) -> np.ndarray:
+    """[Q, D, R]: per (template rank, domain) the max allocatable among the
+    rank's offering rows that can produce the domain; NEG where the rank has
+    no such row. This is the capacity truth the per-domain placement bound
+    uses — the rank's global envelope can exceed a specific domain's types."""
+    Q = enc.rank_domset.shape[0]
+    D = enc.n_doms
+    R = enc.row_alloc.shape[1]
+    cap = np.full((Q, D, R), np.float32(-3.4e38), dtype=np.float32)
+    ranks = np.asarray(enc.row_pool_rank)
+    off = np.nonzero(ranks >= 0)[0]
+    if off.size:
+        rd = _row_domset_of(enc)[off]  # [n_off, D]
+        ri, di = np.nonzero(rd)
+        np.maximum.at(cap, (ranks[off][ri], di), enc.row_alloc[off][ri])
+    return cap
+
+
+def _row_domset_of(enc) -> np.ndarray:
+    """[Nrows, D]: domains each candidate row can produce. Per dom key: the
+    row's pinned value when its offering/labels pin one; otherwise the
+    template rank's value set for that key (a claim may still pin any of
+    them). Existing rows carry their one-hot label values (sentinel when the
+    node lacks the key). Sentinel ids are 0..Kd-1 by construction."""
+    Nrows = enc.row_dom.shape[0]
+    Kd = enc.row_dom.shape[1]
+    D = enc.n_doms
+    dko = np.asarray(enc.dom_key_of)
+    ranks = np.asarray(enc.row_pool_rank)
+    Q = enc.rank_domset.shape[0]
+    rd = np.zeros((Nrows, D), dtype=bool)
+    for k in range(Kd):
+        col = enc.row_dom[:, k]
+        pinned = col != k  # the per-key sentinel id IS k
+        rd[np.nonzero(pinned)[0], col[pinned]] = True
+        un_off = ~pinned & (ranks >= 0)
+        if un_off.any():
+            keymask = dko == k
+            rd[un_off] |= enc.rank_domset[np.clip(ranks[un_off], 0, Q - 1)] & keymask[None, :]
+        rd[~pinned & (ranks < 0), k] = True  # existing node without the label
+    return rd
+
+
 def make_tensors(enc, n_slots: int | None = None, with_pods: bool = True) -> SchedulerTensors:
     """EncodedSnapshot (numpy) -> SchedulerTensors (device).
 
     with_pods=False skips uploading the per-POD tensors (req/mask/taints/
     domains/member, all [P, ...]) — the signature-grouped kernel reads only
     the per-ITEM tensors passed alongside, so the 50k-pod upload would be pure
-    waste on that path; size-1 placeholders keep the pytree shape."""
+    waste on that path; size-1 placeholders keep the pytree shape.
+
+    Every workload-shape axis is padded to its bucket (see the axis bucket
+    constants) so workload drift reuses compiled kernels; pad entries are
+    inert (see SchedulerTensors docstring)."""
     P = enc.n_pods
     if n_slots is None:
         n_slots = enc.n_existing + P
+    # the slot axis drifts with every pod-count change — bucket it so warm
+    # solves with drifting fleets reuse the compiled kernel
+    n_slots = bucket(int(n_slots), SLOTS_BUCKET)
     G = max(enc.n_groups, 1)
     D = enc.n_doms
     Kd = len(enc.dom_key_names)
-    counts_host = np.zeros((G, n_slots), dtype=np.int32)
+
+    # -- bucketed axis targets -------------------------------------------------
+    Nrows = enc.row_alloc.shape[0]
+    Nrows_p = bucket(Nrows, ROWS_BUCKET)
+    R_p = bucket(enc.row_alloc.shape[1], RES_BUCKET)
+    K_p = bucket(enc.sig_mask.shape[1], KEYS_BUCKET)
+    W_p = bucket(enc.sig_mask.shape[2], WORDS_BUCKET)
+    C_p = bucket(enc.sig_taint_ok.shape[1], TAINT_BUCKET)
+    G_p = bucket(G, GROUP_BUCKET)
+    P1_p = bucket(enc.row_port_any.shape[1], PORT_BUCKET)
+    P2_p = bucket(enc.row_port_spec.shape[1], PORT_BUCKET)
+
+    # rows: pad resource axis with huge allocatable (never the bottleneck),
+    # then pad rows with NEG (never fit); n_rows_real masks them everywhere
+    row_alloc = _pad_axis(enc.row_alloc.astype(np.float32), 1, R_p, fill=BIG_ALLOC)
+    row_alloc = _pad_axis(row_alloc, 0, Nrows_p, fill=np.float32(NEG))
+    row_labels = _pad_axis(_pad_axis(enc.row_labels, 1, K_p), 0, Nrows_p)
+    row_pool_rank = _pad_axis(enc.row_pool_rank, 0, Nrows_p)
+    row_taint_class = _pad_axis(enc.row_taint_class, 0, Nrows_p)
+    Q_p = bucket(enc.rank_domset.shape[0], RANK_BUCKET)
+    rank_domset = _pad_axis(enc.rank_domset, 0, Q_p, fill=False)
+    rank_dom_cap = _pad_axis(_rank_dom_cap_of(enc), 2, R_p, fill=BIG_ALLOC)
+    rank_dom_cap = _pad_axis(rank_dom_cap, 0, Q_p, fill=np.float32(NEG))
+    row_port_any = _pad_axis(_pad_axis(enc.row_port_any, 1, P1_p, fill=False), 0, Nrows_p, fill=False)
+    row_port_wild = _pad_axis(_pad_axis(enc.row_port_wild, 1, P1_p, fill=False), 0, Nrows_p, fill=False)
+    row_port_spec = _pad_axis(_pad_axis(enc.row_port_spec, 1, P2_p, fill=False), 0, Nrows_p, fill=False)
+
+    counts_host = np.zeros((G_p, n_slots), dtype=np.int32)
     if enc.n_groups and enc.n_existing:
         counts_host[: enc.n_groups, : enc.n_existing] = enc.counts_host_existing[:, : enc.n_existing]
-    group_kind = enc.group_kind if enc.n_groups else np.zeros(1, np.int32)
-    group_skew = enc.group_skew if enc.n_groups else np.ones(1, np.int32)
-    group_dom_key = enc.group_dom_key if enc.n_groups else np.full(1, -1, np.int32)
-    group_min_domains = enc.group_min_domains if enc.n_groups else np.zeros(1, np.int32)
-    group_registered = enc.group_registered if enc.n_groups else np.zeros((1, D), bool)
+    group_kind = _pad_axis(enc.group_kind if enc.n_groups else np.zeros(1, np.int32), 0, G_p, fill=-1)
+    group_skew = _pad_axis(enc.group_skew if enc.n_groups else np.ones(1, np.int32), 0, G_p, fill=1)
+    group_dom_key = _pad_axis(enc.group_dom_key if enc.n_groups else np.full(1, -1, np.int32), 0, G_p, fill=-1)
+    group_min_domains = _pad_axis(enc.group_min_domains if enc.n_groups else np.zeros(1, np.int32), 0, G_p)
+    group_registered = _pad_axis(enc.group_registered if enc.n_groups else np.zeros((1, D), bool), 0, G_p, fill=False)
+    counts_dom = _pad_axis(enc.counts_dom_init if enc.n_groups else np.zeros((1, D), np.int32), 0, G_p)
+
     if not with_pods:
-        pod_req = np.zeros((1, enc.row_alloc.shape[1]), np.float32)
-        pod_mask = np.zeros((1,) + enc.sig_mask.shape[1:], enc.sig_mask.dtype)
-        pod_taint_ok = np.ones((1, enc.sig_taint_ok.shape[1]), bool)
+        pod_req = np.zeros((1, R_p), np.float32)
+        pod_mask = np.zeros((1, K_p, W_p), enc.sig_mask.dtype)
+        pod_taint_ok = np.ones((1, C_p), bool)
         pod_dom_allowed = np.ones((1, D), bool)
         pod_restrict = np.zeros((1, Kd), bool)
-        member = np.zeros((1, G), bool)
-        owner = np.zeros((1, G), bool)
+        member = np.zeros((1, G_p), bool)
+        owner = np.zeros((1, G_p), bool)
     else:
-        pod_req = enc.pod_req
-        pod_mask = enc.pod_mask
-        pod_taint_ok = enc.pod_taint_ok
+        pod_req = _pad_axis(enc.pod_req, 1, R_p)
+        pod_mask = pad_mask_axes(enc.pod_mask, K_p, W_p)
+        pod_taint_ok = _pad_axis(enc.pod_taint_ok, 1, C_p, fill=True)
         pod_dom_allowed = enc.pod_dom_allowed
         pod_restrict = sig_restrict_of(enc)[enc.sig_of_pod]
-        member = enc.member if enc.n_groups else np.zeros((P, 1), bool)
-        owner = enc.owner if enc.n_groups else np.zeros((P, 1), bool)
-    counts_dom = enc.counts_dom_init if enc.n_groups else np.zeros((1, D), np.int32)
+        member = _pad_axis(enc.member if enc.n_groups else np.zeros((P, 1), bool), 1, G_p, fill=False)
+        owner = _pad_axis(enc.owner if enc.n_groups else np.zeros((P, 1), bool), 1, G_p, fill=False)
 
     n_ex = max(enc.n_existing, 1)
     existing_domset = np.zeros((n_ex, D), dtype=bool)
@@ -183,11 +308,13 @@ def make_tensors(enc, n_slots: int | None = None, with_pods: bool = True) -> Sch
             existing_domset[j, enc.row_dom[j, k]] = True
 
     return SchedulerTensors(
-        row_alloc=jnp.asarray(enc.row_alloc),
-        row_labels=jnp.asarray(enc.row_labels),
-        row_pool_rank=jnp.asarray(enc.row_pool_rank),
-        row_taint_class=jnp.asarray(enc.row_taint_class),
-        rank_domset=jnp.asarray(enc.rank_domset),
+        n_rows_real=jnp.int32(Nrows),
+        row_alloc=jnp.asarray(row_alloc),
+        row_labels=jnp.asarray(row_labels),
+        row_pool_rank=jnp.asarray(row_pool_rank),
+        row_taint_class=jnp.asarray(row_taint_class),
+        rank_domset=jnp.asarray(rank_domset),
+        rank_dom_cap=jnp.asarray(rank_dom_cap),
         dom_key_of=jnp.asarray(dko),
         pod_req=jnp.asarray(pod_req),
         pod_mask=jnp.asarray(pod_mask),
@@ -204,16 +331,24 @@ def make_tensors(enc, n_slots: int | None = None, with_pods: bool = True) -> Sch
         counts_dom_init=jnp.asarray(counts_dom),
         counts_host_init=jnp.asarray(counts_host),
         existing_domset=jnp.asarray(existing_domset),
-        existing_port_any=jnp.asarray(enc.existing_port_any),
-        existing_port_wild=jnp.asarray(enc.existing_port_wild),
-        existing_port_spec=jnp.asarray(enc.existing_port_spec),
-        row_port_any=jnp.asarray(enc.row_port_any),
-        row_port_wild=jnp.asarray(enc.row_port_wild),
-        row_port_spec=jnp.asarray(enc.row_port_spec),
+        existing_port_any=jnp.asarray(_pad_axis(enc.existing_port_any, 1, P1_p, fill=False)),
+        existing_port_wild=jnp.asarray(_pad_axis(enc.existing_port_wild, 1, P1_p, fill=False)),
+        existing_port_spec=jnp.asarray(_pad_axis(enc.existing_port_spec, 1, P2_p, fill=False)),
+        row_port_any=jnp.asarray(row_port_any),
+        row_port_wild=jnp.asarray(row_port_wild),
+        row_port_spec=jnp.asarray(row_port_spec),
         dom_keys=tuple(enc.dom_vocab_keys),
         n_existing=enc.n_existing,
         n_slots=int(n_slots),
     )
+
+
+def pad_mask_axes(mask: np.ndarray, K_p: int, W_p: int) -> np.ndarray:
+    """Pad a [.., K, Words] requirement bitmask: pad WORDS disallow (their
+    value ids never occur on rows), pad KEYS allow-all (rows carry the
+    absent id 0 there)."""
+    mask = _pad_axis(mask, mask.ndim - 1, W_p, fill=0)
+    return _pad_axis(mask, mask.ndim - 2, K_p, fill=np.uint32(0xFFFFFFFF))
 
 
 def compat_matrix(row_labels, row_taint_class, masks, taints_ok, dom_keys: tuple, batch_size: int = 1024):
@@ -293,7 +428,6 @@ def _greedy_pack_impl(t: SchedulerTensors, dom_keys: tuple, n_existing: int, n_s
     N = n_slots
     Nrows = t.row_alloc.shape[0]
     G, D = t.counts_dom_init.shape
-    Q = t.rank_domset.shape[0]
 
     slot_basis0 = jnp.full((N,), -1, dtype=jnp.int32)
     slot_rem0 = jnp.full((N, R), NEG)
@@ -338,11 +472,10 @@ def _greedy_pack_impl(t: SchedulerTensors, dom_keys: tuple, n_existing: int, n_s
         j_slot = first_true_index(fits_slot)
 
         # --- new slot ------------------------------------------------------------
-        fits_row = is_offering_row & compat_rows & jnp.all(req[None, :] <= t.row_alloc, axis=1)
-        rank_of_row = jnp.clip(t.row_pool_rank, 0, Q - 1)
+        fits_row = is_offering_row & compat_rows & jnp.all(req[None, :] <= t.row_alloc, axis=1) & (jnp.arange(Nrows) < t.n_rows_real)
         rank_ok = perkey_dom_ok(t.rank_domset, za, restrict, t.dom_key_of)  # [Q]
         rank_ok &= jnp.where(is_dom_member, jnp.any(t.rank_domset & dom_feasible[None, :], axis=1), True)
-        fits_row &= rank_ok[rank_of_row]
+        fits_row &= rank_ok[jnp.clip(t.row_pool_rank, 0, t.rank_domset.shape[0] - 1)]
         # capacity score: prefer lowest rank, then the row whose allocatable
         # envelope best covers the pod's shape (max bottleneck headroom)
         choose_key = row_choose_key(t.row_alloc, t.row_pool_rank, req)
@@ -362,7 +495,7 @@ def _greedy_pack_impl(t: SchedulerTensors, dom_keys: tuple, n_existing: int, n_s
         cur_domset = jnp.where(
             use_slot,
             slot_domset[safe_j],
-            t.rank_domset[jnp.clip(t.row_pool_rank[safe_o], 0, Q - 1)],
+            t.rank_domset[jnp.clip(t.row_pool_rank[safe_o], 0, t.rank_domset.shape[0] - 1)],
         )  # [D]
         cur_domset &= jnp.where(kmask & is_dom_member, dom_feasible, za)
         # spread members commit to the min-count feasible domain

@@ -34,6 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .scheduler_model import (
+    GROUP_BUCKET,
+    KEYS_BUCKET,
     KIND_DOM_AFF,
     KIND_DOM_ANTI,
     KIND_DOM_SPREAD,
@@ -41,8 +43,15 @@ from .scheduler_model import (
     KIND_HOST_ANTI,
     KIND_HOST_SPREAD,
     NEG,
+    PORT_BUCKET,
+    RES_BUCKET,
+    TAINT_BUCKET,
+    WORDS_BUCKET,
     SchedulerTensors,
+    _pad_axis,
+    bucket,
     compat_matrix,
+    pad_mask_axes,
     perkey_dom_ok,
     row_choose_key,
     sig_restrict_of,
@@ -138,7 +147,33 @@ def build_items(enc):
         item_port_spec=enc.sig_port_spec[rep_sig],
         item_host_blocked=enc.sig_host_blocked[rep_sig],
     )
+    arrays = pad_item_arrays(arrays, ITEM_AXIS_BUCKET)
+    item_pods += [np.zeros(0, np.int64)] * (len(arrays["item_count"]) - len(item_pods))
     return arrays, item_pods
+
+
+ITEM_AXIS_BUCKET = 64  # full-solve item axis bucket (DELTA_ITEM_BUCKET for deltas)
+
+
+def pad_item_arrays(arrays: dict, item_bucket: int) -> dict:
+    """Pad item arrays to the SAME axis buckets make_tensors applies to the
+    row/group tensors (shapes must agree inside the kernel), plus the item
+    axis itself; pad items have count 0 and allow-nothing masks — inert."""
+    a = dict(arrays)
+    a["item_req"] = _pad_axis(a["item_req"], 1, bucket(a["item_req"].shape[1], RES_BUCKET))
+    a["item_mask"] = pad_mask_axes(
+        a["item_mask"], bucket(a["item_mask"].shape[1], KEYS_BUCKET), bucket(a["item_mask"].shape[2], WORDS_BUCKET)
+    )
+    a["item_taint_ok"] = _pad_axis(a["item_taint_ok"], 1, bucket(a["item_taint_ok"].shape[1], TAINT_BUCKET), fill=True)
+    a["item_member"] = _pad_axis(a["item_member"], 1, bucket(a["item_member"].shape[1], GROUP_BUCKET), fill=False)
+    a["item_owner"] = _pad_axis(a["item_owner"], 1, bucket(a["item_owner"].shape[1], GROUP_BUCKET), fill=False)
+    a["item_port_any"] = _pad_axis(a["item_port_any"], 1, bucket(a["item_port_any"].shape[1], PORT_BUCKET), fill=False)
+    a["item_port_wild"] = _pad_axis(a["item_port_wild"], 1, bucket(a["item_port_wild"].shape[1], PORT_BUCKET), fill=False)
+    a["item_port_spec"] = _pad_axis(a["item_port_spec"], 1, bucket(a["item_port_spec"].shape[1], PORT_BUCKET), fill=False)
+    W_p = bucket(a["item_count"].shape[0], item_bucket)
+    for k in a:
+        a[k] = _pad_axis(a[k], 0, W_p, fill=0 if a[k].dtype != bool else False)
+    return a
 
 
 def make_item_tensors(arrays) -> ItemTensors:
@@ -150,6 +185,14 @@ def _int_cap(rem, req):
     resources (req>0); unrequested resources don't bound."""
     safe = jnp.where(req[None, :] > 0, jnp.floor(rem / jnp.maximum(req[None, :], 1e-9)), BIGF)
     cap = jnp.min(safe, axis=1)
+    return jnp.clip(cap, 0, 2**30).astype(jnp.int32)
+
+
+def _int_cap_nd(rem, req):
+    """[..., D, R] remaining -> [..., D] integer pod capacity (broadcast req
+    over the trailing resource axis)."""
+    safe = jnp.where(req > 0, jnp.floor(rem / jnp.maximum(req, 1e-9)), BIGF)
+    cap = jnp.min(safe, axis=-1)
     return jnp.clip(cap, 0, 2**30).astype(jnp.int32)
 
 
@@ -188,7 +231,17 @@ def _waterfill(v, finite, c, cap):
     return jnp.where(finite, inc, 0)
 
 
-def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_existing: int, n_slots: int, axis: str | None):
+def _pack_body(
+    t: SchedulerTensors,
+    items: ItemTensors,
+    *,
+    dom_keys: tuple,
+    n_existing: int,
+    n_slots: int,
+    axis: str | None,
+    init_state=None,
+    return_state: bool = False,
+):
     """The grouped pack scan, written once for both execution modes.
 
     axis=None: single-device — slot arrays span the full [n_slots] axis and
@@ -208,7 +261,6 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_ex
     Nrows = t.row_alloc.shape[0]
     G, D = t.counts_dom_init.shape
     Kd = items.item_restrict.shape[1]
-    Q = t.rank_domset.shape[0]
 
     if axis is None:
         N_loc = N
@@ -266,7 +318,9 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_ex
         slot_pspec0 = jnp.zeros((N_loc, P2), dtype=bool)
     slot_rank0 = jnp.full((N_loc,), -1, dtype=jnp.int32)
 
-    is_offering_row = jnp.arange(Nrows) >= n_existing
+    Q = t.rank_domset.shape[0]
+    # rows beyond n_rows_real are shape-bucket padding: never fit, never open
+    is_offering_row = (jnp.arange(Nrows) >= n_existing) & (jnp.arange(Nrows) < t.n_rows_real)
     rank_of_row = jnp.clip(t.row_pool_rank, 0, Q - 1)
     is_dom_spread_g = t.group_kind == KIND_DOM_SPREAD
     is_dom_anti_g = t.group_kind == KIND_DOM_ANTI
@@ -376,30 +430,54 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_ex
         # allowed domain (the k* requirement is applied per-domain below)
         rank_ok_all = perkey_dom_ok(t.rank_domset, za, restrict, t.dom_key_of)  # [Q]
         rank_ok_other = perkey_dom_ok(t.rank_domset, za, restrict_other, t.dom_key_of)  # [Q]
+        # per-domain integer capacity of one fresh node per rank for THIS
+        # request shape, and whether the rank can host >= 1 such pod there
+        open_cap_d = _int_cap_nd(t.rank_dom_cap, req)  # [Q, D]
+        rank_fits_d = open_cap_d >= 1  # [Q, D]
 
         # domain availability: a fitting template (satisfying the item's
         # other keys) offers it, or a committed slot holds it
-        openable_z = jnp.any((fits_row & rank_ok_other[rank_of_row])[:, None] & t.rank_domset[rank_of_row], axis=0)  # [D]
+        openable_z = jnp.any((fits_row & rank_ok_other[rank_of_row])[:, None] & (t.rank_domset & rank_fits_d)[rank_of_row], axis=0)  # [D]
 
         def place(cnt, elig_mask, rank_ok, narrow, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports):
             """Place `cnt` identical pods: prefix-sum first-fit over eligible
             slots, then open new slots of the best row for the leftover.
-            `rank_ok` gates which template ranks may open; `narrow` is
+            `rank_ok` [Q] gates which template ranks may open; `narrow` is
             intersected into touched slots' domain sets (the caller encodes
             the committed k* domain plus the pod's allowed sets for every
             other key)."""
             cap_res = _int_cap(slot_rem, req)
-            cap_j = jnp.where(elig_mask & port_ok_of(ports), jnp.minimum(jnp.minimum(cap_res, member_host_cap(counts_host)), port_cap), 0)
+            # per-DOMAIN capacity bound: among the domains this placement
+            # leaves the slot (domset & narrow), some rank row must still fit
+            # the slot's new total — the basis envelope alone can overshoot a
+            # domain whose types are smaller (per-resource per-domain caps;
+            # cross-key combinations are checked per key, decode re-verifies)
+            total = t.row_alloc[jnp.clip(slot_basis, 0, Nrows - 1)] - slot_rem  # [N, R]
+            rem_nd = t.rank_dom_cap[jnp.clip(slot_rank, 0, Q - 1)] - total[:, None, :]  # [N, D, R]
+            cap_nd = _int_cap_nd(rem_nd, req)  # [N, D]
+            target = slot_zoneset & narrow[None, :]
+            cap_dom = jnp.max(jnp.where(target, cap_nd, 0), axis=1)  # [N]
+            cap_dom = jnp.where(slot_rank < 0, INF_I, cap_dom)  # existing: own basis is exact
+            cap_j = jnp.where(
+                elig_mask & port_ok_of(ports),
+                jnp.minimum(jnp.minimum(jnp.minimum(cap_res, cap_dom), member_host_cap(counts_host)), port_cap),
+                0,
+            )
             cap_j = jnp.clip(cap_j, 0, INF_I)
             prefix = gprefix(cap_j)
             take = jnp.clip(cnt - prefix, 0, cap_j).astype(jnp.int32)
             left = cnt - gsum(take)
 
-            # leftover -> new slots of the single best row
-            fr = fits_row & rank_ok[rank_of_row]
+            # leftover -> new slots of the single best row; the rank must have
+            # per-domain capacity for >= 1 pod in some narrow domain
+            rank_cap_ok = jnp.any(t.rank_domset & narrow[None, :] & rank_fits_d, axis=1)  # [Q]
+            fr = fits_row & (rank_ok & rank_cap_ok)[rank_of_row]
             o = jnp.argmin(jnp.where(fr, choose_key, BIGF)).astype(jnp.int32)
             o_ok = fr[o]
-            cstar = jnp.minimum(jnp.minimum(row_cap[o], host_cap_new), port_cap)
+            # fresh-slot capacity: bounded by the best narrow-domain capacity
+            # of the opened rank, not just the opened row's own envelope
+            cap_open = jnp.max(jnp.where(t.rank_domset[rank_of_row[o]] & narrow, open_cap_d[rank_of_row[o]], 0))
+            cstar = jnp.minimum(jnp.minimum(jnp.minimum(row_cap[o], cap_open), host_cap_new), port_cap)
             can_open = o_ok & (cstar >= 1)
             m = jnp.where(can_open, -(-left // jnp.maximum(cstar, 1)), 0)
             m = jnp.clip(m, 0, N - open_count)
@@ -548,10 +626,10 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_ex
                     & other_ok_of(slot_zoneset)
                     & jnp.any(slot_zoneset & empty[None, :], axis=1)
                 )
-                rank_ok = jnp.any(t.rank_domset & empty[None, :], axis=1) & rank_ok_other
+                row_gate = jnp.any(t.rank_domset & empty[None, :], axis=1) & rank_ok_other
                 cnt = jnp.minimum(pending, 1)
                 take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports = place(
-                    cnt, elig, rank_ok, narrow,
+                    cnt, elig, row_gate, narrow,
                     slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports,
                 )
                 # block every domain the touched slot could still land in
@@ -653,19 +731,27 @@ def _pack_body(t: SchedulerTensors, items: ItemTensors, *, dom_keys: tuple, n_ex
         new_state = (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, ports)
         return new_state, (take, leftover)
 
-    init = (
-        slot_basis0,
-        slot_rem0,
-        slot_zoneset0,
-        slot_rank0,
-        t.counts_dom_init,
-        t.counts_host_init,
-        jnp.int32(n_existing),
-        (slot_pany0, slot_pwild0, slot_pspec0),
-    )
-    (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, _ports), (takes, leftovers) = jax.lax.scan(
-        step, init, jnp.arange(W, dtype=jnp.int32)
-    )
+    if init_state is not None:
+        # incremental re-solve: continue the scan from a previous pack's
+        # final state (device-resident) — the delta items are late arrivals,
+        # exactly how the reference schedules newly-pending pods against the
+        # current cluster state without repacking bound ones
+        init = init_state
+    else:
+        init = (
+            slot_basis0,
+            slot_rem0,
+            slot_zoneset0,
+            slot_rank0,
+            t.counts_dom_init,
+            t.counts_host_init,
+            jnp.int32(n_existing),
+            (slot_pany0, slot_pwild0, slot_pspec0),
+        )
+    final_state, (takes, leftovers) = jax.lax.scan(step, init, jnp.arange(W, dtype=jnp.int32))
+    (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, _ports) = final_state
+    if return_state:
+        return takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count, final_state
     return takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count
 
 
@@ -684,18 +770,7 @@ def _sparsify_takes(takes, nnz_cap: int):
     return nzi, nzs, nzc
 
 
-@partial(jax.jit, static_argnames=("dom_keys", "n_existing", "n_slots", "nnz_cap"))
-def _pack_compressed_impl(t: SchedulerTensors, items: ItemTensors, dom_keys: tuple, n_existing: int, n_slots: int, nnz_cap: int):
-    """Pack + on-device sparsification, fused into ONE flat int32 output.
-
-    The production deployment reaches the TPU through a tunnel whose
-    round-trip latency (~60-90ms) dwarfs its bandwidth for solver-sized
-    results: pulling takes/basis/zoneset/leftovers/open_count as separate
-    arrays pays that latency per pull. Concatenating every host-needed output
-    into one int32 vector makes the whole solve one device->host transfer."""
-    takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = _pack_body(
-        t, items, dom_keys=dom_keys, n_existing=n_existing, n_slots=n_slots, axis=None
-    )
+def _flat_outputs(takes, leftovers, slot_basis, slot_zoneset, open_count, nnz_cap: int):
     nzi, nzs, nzc = _sparsify_takes(takes, nnz_cap)
     return jnp.concatenate(
         [
@@ -710,21 +785,41 @@ def _pack_compressed_impl(t: SchedulerTensors, items: ItemTensors, dom_keys: tup
     )
 
 
+@partial(jax.jit, static_argnames=("dom_keys", "n_existing", "n_slots", "nnz_cap"))
+def _pack_compressed_impl(t: SchedulerTensors, items: ItemTensors, dom_keys: tuple, n_existing: int, n_slots: int, nnz_cap: int):
+    """Pack + on-device sparsification, fused into ONE flat int32 output.
+
+    The production deployment reaches the TPU through a tunnel whose
+    round-trip latency (~60-90ms) dwarfs its bandwidth for solver-sized
+    results: pulling takes/basis/zoneset/leftovers/open_count as separate
+    arrays pays that latency per pull. Concatenating every host-needed output
+    into one int32 vector makes the whole solve one device->host transfer.
+
+    Also returns the scan's FINAL STATE — left device-resident by the caller
+    so a later 1-pod delta can continue the pack instead of redoing it."""
+    takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count, state = _pack_body(
+        t, items, dom_keys=dom_keys, n_existing=n_existing, n_slots=n_slots, axis=None, return_state=True
+    )
+    return _flat_outputs(takes, leftovers, slot_basis, slot_zoneset, open_count, nnz_cap), state
+
+
+@partial(jax.jit, static_argnames=("dom_keys", "n_existing", "n_slots", "nnz_cap"))
+def _pack_delta_compressed_impl(state, t: SchedulerTensors, items: ItemTensors, dom_keys: tuple, n_existing: int, n_slots: int, nnz_cap: int):
+    """Incremental pack: scan ONLY the delta items, continuing from a prior
+    pack's device-resident final state. Output layout matches
+    _pack_compressed_impl (takes span just the delta items)."""
+    takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count, state2 = _pack_body(
+        t, items, dom_keys=dom_keys, n_existing=n_existing, n_slots=n_slots, axis=None,
+        init_state=state, return_state=True,
+    )
+    return _flat_outputs(takes, leftovers, slot_basis, slot_zoneset, open_count, nnz_cap), state2
+
+
 def _next_pow2(n: int) -> int:
     return 1 << max(n - 1, 1).bit_length()
 
 
-def greedy_pack_grouped_compressed(t: SchedulerTensors, items: ItemTensors, n_pods: int):
-    """Single-transfer pack. Returns a dict with the sparse placement triples
-    (nz_item, nz_slot, nz_count; -1-padded, row-major) plus slot_basis,
-    slot_zoneset (bool [N, Z]), leftovers, open_count — all numpy."""
-    W = items.item_req.shape[0]
-    N = t.n_slots
-    Z = t.counts_dom_init.shape[1]
-    # nnz <= n_pods; round the static cap up to a power of two so solves with
-    # drifting pod counts reuse one compiled kernel instead of retracing
-    nnz_cap = int(min(_next_pow2(n_pods), W * N))
-    flat = np.asarray(_pack_compressed_impl(t, items, t.dom_keys, t.n_existing, N, nnz_cap))
+def _parse_flat(flat: np.ndarray, nnz_cap: int, N: int, Z: int, W: int) -> dict:
     o = 0
 
     def take(n):
@@ -747,6 +842,45 @@ def greedy_pack_grouped_compressed(t: SchedulerTensors, items: ItemTensors, n_po
         leftovers=leftovers,
         open_count=open_count,
     )
+
+
+def greedy_pack_grouped_compressed(t: SchedulerTensors, items: ItemTensors, n_pods: int):
+    """Single-transfer pack. Returns a dict with the sparse placement triples
+    (nz_item, nz_slot, nz_count; -1-padded, row-major) plus slot_basis,
+    slot_zoneset (bool [N, Z]), leftovers, open_count — all numpy — and
+    `state`, the scan's final carry left DEVICE-RESIDENT for incremental
+    re-solves (greedy_pack_delta_compressed)."""
+    W = items.item_req.shape[0]
+    N = t.n_slots
+    Z = t.counts_dom_init.shape[1]
+    # nnz <= n_pods; round the static cap up to a power of two so solves with
+    # drifting pod counts reuse one compiled kernel instead of retracing
+    nnz_cap = int(min(_next_pow2(n_pods), W * N))
+    flat_dev, state = _pack_compressed_impl(t, items, t.dom_keys, t.n_existing, N, nnz_cap)
+    out = _parse_flat(np.asarray(flat_dev), nnz_cap, N, Z, W)
+    out["state"] = state
+    out["nnz_cap"] = nnz_cap
+    return out
+
+
+DELTA_ITEM_BUCKET = 16  # delta item axis pads to this so deltas share one compile
+
+
+def greedy_pack_delta_compressed(state, t: SchedulerTensors, items: ItemTensors, n_added: int):
+    """Incremental pack over only the delta items, continuing from `state`
+    (a prior pack's device-resident final carry). Items must be padded to a
+    DELTA_ITEM_BUCKET multiple (pad entries have item_count=0). Returns the
+    same dict shape as greedy_pack_grouped_compressed; takes/leftovers span
+    the (padded) delta items."""
+    W = items.item_req.shape[0]
+    N = t.n_slots
+    Z = t.counts_dom_init.shape[1]
+    nnz_cap = int(_next_pow2(max(n_added, 2)))
+    flat_dev, state2 = _pack_delta_compressed_impl(state, t, items, t.dom_keys, t.n_existing, N, nnz_cap)
+    out = _parse_flat(np.asarray(flat_dev), nnz_cap, N, Z, W)
+    out["state"] = state2
+    out["nnz_cap"] = nnz_cap
+    return out
 
 
 def greedy_pack_grouped(t: SchedulerTensors, items: ItemTensors):

@@ -750,16 +750,22 @@ class EncodeCache:
 
     Pod side: signatures are content-addressed tuples over the pod spec, so
     they are cacheable per (uid, resourceVersion) — an unchanged pod
-    re-solving on the next reconcile skips the tuple build (the dominant
-    encode cost at 50k pods), while any pod edit bumps resourceVersion and
-    recomputes.
+    re-solving on the next reconcile skips the tuple build, while any pod
+    edit bumps resourceVersion and recomputes.
 
     Row side: the candidate-row tensors are keyed on the state/cluster.py
     GENERATION counter (bumped on every cluster mutation) plus nodepool
     hashes, instance-type identities, daemon versions, and the resource axis
     — a steady-state reconcile with unchanged cluster state skips the whole
-    templates/rows build. SURVEY.md §7 "incremental state -> device": the
-    warm re-solve after a small delta costs the delta, not the fleet."""
+    templates/rows build.
+
+    Whole-encode delta (SURVEY.md §7 "incremental state -> device"): when
+    the rows are cache-valid and the pod set is the previous solve's plus a
+    few appended pods of ALREADY-SEEN signatures (deployment scale-up, the
+    steady-state reconcile shape), the previous EncodedSnapshot is reused
+    wholesale — per-signature tensors untouched, the added pods appended to
+    the pod axis. The result carries `delta_base`/`delta_added` so the
+    solver can also run the device pack incrementally."""
 
     MAX_ENTRIES = 200_000
 
@@ -767,6 +773,11 @@ class EncodeCache:
         self.pod_sig: dict[tuple, tuple] = {}
         self.row_key: tuple | None = None
         self.rows: _RowArtifacts | None = None
+        # whole-encode delta state
+        self.last_enc = None  # EncodedSnapshot
+        self.last_row_key: tuple | None = None
+        self.last_raw_pods: list | None = None  # snap.pods by reference
+        self.last_sig_ids: dict[tuple, int] | None = None
 
     def signature(self, pod) -> tuple:
         key = (pod.metadata.uid, pod.metadata.resource_version)
@@ -777,6 +788,63 @@ class EncodeCache:
                 self.pod_sig.clear()  # bound memory; repopulates in one solve
             self.pod_sig[key] = sig
         return sig
+
+
+def _try_delta_encode(snap, cache: EncodeCache):
+    """Append-only pod-delta fast path: returns an EncodedSnapshot reusing the
+    previous encode's tensors wholesale, or None when a full encode is needed.
+
+    Conditions: the pod list is the previous solve's (checked by identity —
+    one O(P) pointer-compare pass) plus a small tail of appended pods whose
+    signatures the previous encode already interned, and the row-side cache
+    key (cluster generation, pools, instance types, daemons) is unchanged.
+    The added pods are appended to the POD AXIS only; every per-signature
+    tensor is reused untouched. Reference analogue: event-driven state
+    updates instead of rebuild-per-solve (cluster.go:945-964)."""
+    base = cache.last_enc
+    prev_raw = cache.last_raw_pods
+    if base is None or prev_raw is None or cache.last_sig_ids is None:
+        return None
+    cur = snap.pods
+    n_prev = len(prev_raw)
+    if len(cur) < n_prev:
+        return None
+    for a, b in zip(prev_raw, cur):
+        if a is not b:
+            return None
+    added = cur[n_prev:]
+    if len(added) > max(64, n_prev // 20):
+        return None  # large deltas: the full encode amortizes better
+    added_sigs = []
+    for p in added:
+        sid = cache.last_sig_ids.get(cache.signature(p))
+        if sid is None:
+            return None  # unseen pod shape: per-signature tensors must grow
+        added_sigs.append(sid)
+    row_key = _row_cache_key(snap, base.resource_names, list(base.dom_key_names))
+    if row_key != cache.last_row_key:
+        return None
+    if not added:
+        return base
+    import dataclasses as _dc
+
+    enc = _dc.replace(
+        base,
+        # base.pods is FFD-sorted; appended pods process after the batch,
+        # which is exactly how the reference treats late arrivals — and
+        # build_items merges them into their signature's existing work item,
+        # so a full pack on this snapshot is count-identical to a fresh one
+        pods=list(base.pods) + list(added),
+        sig_of_pod=np.concatenate([base.sig_of_pod, np.asarray(added_sigs, np.int32)]),
+    )
+    enc.delta_base = base
+    enc.delta_added_sigs = np.asarray(added_sigs, np.int32)
+    cached_restrict = getattr(base, "_sig_restrict", None)
+    if cached_restrict is not None:
+        enc._sig_restrict = cached_restrict
+    cache.last_enc = enc
+    cache.last_raw_pods = list(cur)
+    return enc
 
 
 def _row_cache_key(snap, rnames: list[str], dom_keys: list[str]) -> tuple:
@@ -1044,6 +1112,12 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
 
 
 def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
+    # -- whole-encode delta: previous pod set + appended known shapes ---------
+    if cache is not None:
+        delta = _try_delta_encode(snap, cache)
+        if delta is not None:
+            return delta
+
     # -- signature grouping (the hot O(P) pass: cheap tuple building only,
     # and cache hits skip even that) -----------------------------------------
     sig_of = cache.signature if cache is not None else pod_signature
@@ -1390,7 +1464,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
                 group_registered[g] = (rows.universe_dom | existing_dom) & (dom_key_of == dk)
         group_registered |= counts_dom_init > 0
 
-    return EncodedSnapshot(
+    enc_out = EncodedSnapshot(
         resource_names=rnames,
         vocab=vocab,
         n_existing=n_existing,
@@ -1444,6 +1518,12 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         req_class_keys=req_class_keys,
         decode_cache=rows.decode_cache,
     )
+    if cache is not None:
+        cache.last_enc = enc_out
+        cache.last_row_key = row_key if row_key is not None else _row_cache_key(snap, rnames, dom_keys)
+        cache.last_raw_pods = list(snap.pods)
+        cache.last_sig_ids = dict(sig_ids)
+    return enc_out
 
 
 def _is_relaxable(pod) -> bool:

@@ -115,6 +115,12 @@ class TPUSolver:
         self.encode_cache = EncodeCache()
         self.last_backend: str = ""
         self.last_fallback_reasons: list[str] = []
+        # device-resident incremental state: the previous solve's tensors,
+        # final pack carry (on device), and assignment — a small pod delta
+        # re-packs ONLY the delta items from this state (SURVEY.md §7
+        # "incremental state -> device")
+        self._resident: dict | None = None
+        self.last_solve_mode: str = ""  # "full" | "delta" (observability)
 
     def _pack(self, t, items, n_pods: int) -> dict:
         """Run the pack and land every host-needed output. The single-device
@@ -168,15 +174,23 @@ class TPUSolver:
         if enc.n_pods == 0 or enc.n_rows == 0:
             return self._fall_back(snap, ["empty snapshot"])
 
-        # signature-grouped pack: device steps scale with UNIQUE pod shapes,
-        # not pods (scheduler_model_grouped.py). Slot axis capped; retry
-        # uncapped on the rare overflow (every slot opened AND pods unplaced).
         from ..models.scheduler_model_grouped import (
             assignment_from_triples,
             build_items,
             make_item_tensors,
         )
 
+        # incremental re-solve: the encoder recognized this snapshot as the
+        # previous one plus appended known-shape pods, and the previous
+        # pack's final carry is still device-resident — scan ONLY the delta
+        self.last_solve_mode = "full"
+        delta = self._solve_delta(snap, enc)
+        if delta is not None:
+            return delta
+
+        # signature-grouped pack: device steps scale with UNIQUE pod shapes,
+        # not pods (scheduler_model_grouped.py). Slot axis capped; retry
+        # uncapped on the rare overflow (every slot opened AND pods unplaced).
         item_arrays, item_pods = build_items(enc)
         items = make_item_tensors(item_arrays)
         cap = enc.n_existing + min(enc.n_pods, 4096)
@@ -185,13 +199,17 @@ class TPUSolver:
         if out["open_count"] == out["n_slots"] and int(out["leftovers"].sum()) > 0 and cap < enc.n_existing + enc.n_pods:
             t = make_tensors(enc, with_pods=False)
             out = self._pack(t, items, enc.n_pods)
-        slot_basis, slot_zoneset = out["slot_basis"], out["slot_zoneset"]
         assignment = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
+        return self._finish(snap, enc, assignment, out["slot_basis"], out["slot_zoneset"], t, out)
 
+    def _finish(self, snap, enc, assignment, slot_basis, slot_zoneset, t, out) -> Results:
+        """The shared solve tail (full AND delta paths): relaxation check,
+        fast_validate self-check, decode, resident-state save, metrics — so
+        the two paths can never drift apart."""
         # tier-0 honored every soft constraint; an unplaced pod means the
         # host relaxation loop (preferences.go:40-55) must take over — the
         # tensor pack cannot peel preferences per pod
-        if enc.has_relaxable and (assignment < 0).any():
+        if enc.has_relaxable and (np.asarray(assignment) < 0).any():
             if self.force:
                 raise RuntimeError("tier-0 solve left relaxable pods unplaced")
             return self._fall_back(snap, ["relaxation required: soft constraints unsatisfiable tier-0"], family="relaxation")
@@ -201,36 +219,97 @@ class TPUSolver:
         from ..metrics import SOLVER_SOLVE_TOTAL, SOLVER_VALIDATION_FAILURES_TOTAL
         from .check import fast_validate
 
-        slot_basis_np, slot_zoneset_np = slot_basis, slot_zoneset
-        violations = fast_validate(enc, assignment, slot_basis_np, slot_zoneset_np)
+        violations = fast_validate(enc, assignment, slot_basis, slot_zoneset)
         if violations:
             self._count(SOLVER_VALIDATION_FAILURES_TOTAL)
             if self.force:
                 raise RuntimeError(f"tensor placement failed validation: {violations}")
             return self._fall_back(snap, [f"validation: {v}" for v in violations], family="validation")
         try:
-            results = self._decode(snap, enc, assignment, slot_basis_np, slot_zoneset_np)
+            results = self._decode(snap, enc, assignment, slot_basis, slot_zoneset)
         except DecodeError as e:
             self._count(SOLVER_VALIDATION_FAILURES_TOTAL)
             if self.force:
                 raise
             return self._fall_back(snap, [f"validation: {e}"], family="validation")
+        if self.mesh is None and out.get("state") is not None:
+            self._resident = dict(enc=enc, t=t, state=out["state"], assignment=np.asarray(assignment))
         self._count(SOLVER_SOLVE_TOTAL, backend="tpu")
         return results
+
+    def _solve_delta(self, snap: SolverSnapshot, enc) -> Results | None:
+        """Incremental solve for an append-only pod delta: scan only the
+        delta items from the previous pack's device-resident final carry,
+        merge with the previous assignment, re-validate the WHOLE placement,
+        and decode. Returns None when the full path must run."""
+        base = getattr(enc, "delta_base", None)
+        res = self._resident
+        if base is None or res is None or res["enc"] is not base or self.mesh is not None:
+            return None
+        from ..models.scheduler_model_grouped import (
+            DELTA_ITEM_BUCKET,
+            assignment_from_triples,
+            greedy_pack_delta_compressed,
+            make_item_tensors,
+            pad_item_arrays,
+        )
+
+        added_sigs = enc.delta_added_sigs
+        n_added = int(added_sigs.shape[0])
+        n_prev = len(base.pods)
+        sigs_u, inv = np.unique(added_sigs, return_inverse=True)
+        W_real = int(sigs_u.shape[0])
+        arrays = pad_item_arrays(
+            dict(
+                item_req=enc.sig_req[sigs_u],
+                item_mask=enc.sig_mask[sigs_u],
+                item_taint_ok=enc.sig_taint_ok[sigs_u],
+                item_dom_allowed=enc.sig_dom_allowed[sigs_u],
+                item_restrict=enc.sig_restrict[sigs_u],
+                item_member=enc.sig_member[sigs_u],
+                item_owner=enc.sig_owner[sigs_u],
+                item_count=np.bincount(inv, minlength=W_real).astype(np.int32),
+                item_port_any=enc.sig_port_any[sigs_u],
+                item_port_wild=enc.sig_port_wild[sigs_u],
+                item_port_spec=enc.sig_port_spec[sigs_u],
+                item_host_blocked=enc.sig_host_blocked[sigs_u],
+            ),
+            DELTA_ITEM_BUCKET,
+        )
+        items = make_item_tensors(arrays)
+        W_pad = arrays["item_count"].shape[0]
+        # delta item -> absolute pod indices (appended tail of enc.pods)
+        item_pods = [np.nonzero(inv == w)[0] + n_prev for w in range(W_real)]
+        item_pods += [np.zeros(0, np.int64)] * (W_pad - W_real)
+        t = res["t"]
+        out = greedy_pack_delta_compressed(res["state"], t, items, n_added)
+        if out["open_count"] == t.n_slots and int(out["leftovers"][:W_real].sum()) > 0:
+            return None  # slot axis exhausted: retry via the full (uncapped) path
+        d = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
+        assignment = np.concatenate([res["assignment"], np.full(enc.n_pods - n_prev, -1, dtype=np.int64)])
+        assignment[d >= 0] = d[d >= 0]
+        self.last_solve_mode = "delta"
+        return self._finish(snap, enc, assignment, out["slot_basis"], out["slot_zoneset"], t, out)
 
     # -- decode ----------------------------------------------------------------
     def _decode(self, snap: SolverSnapshot, enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zoneset: np.ndarray) -> Results:
         self.last_backend = "tpu"
         null_topo = _NullTopology()
 
-        # group pods by slot
-        pods_by_slot: dict[int, list[int]] = {}
+        # group pods by slot — one vectorized argsort/unique pass instead of
+        # an O(pods) Python loop (this was ~40% of decode at 50k pods)
+        assignment = np.asarray(assignment)
         pod_errors: dict[str, str] = {}
-        for i, j in enumerate(assignment):
-            if j < 0:
-                pod_errors[enc.pods[i].key()] = "no feasible placement found by tensor solver"
-            else:
-                pods_by_slot.setdefault(int(j), []).append(i)
+        for i in np.nonzero(assignment < 0)[0]:
+            pod_errors[enc.pods[i].key()] = "no feasible placement found by tensor solver"
+        valid_idx = np.nonzero(assignment >= 0)[0]
+        order = valid_idx[np.argsort(assignment[valid_idx], kind="stable")]
+        slots_sorted = assignment[order]
+        uniq_slots, starts = np.unique(slots_sorted, return_index=True)
+        bounds = np.append(starts[1:], len(order))
+        pods_by_slot: dict[int, np.ndarray] = {
+            int(s): order[a:b] for s, a, b in zip(uniq_slots, starts, bounds)
+        }
 
         existing_nodes: list[ExistingNode] = []
         existing_by_slot: dict[int, ExistingNode] = {}
@@ -298,10 +377,8 @@ class TPUSolver:
 
         for j, pod_idxs in sorted(pods_by_slot.items()):
             pods = [enc.pods[i] for i in pod_idxs]
-            sig_counts: dict[int, int] = {}
-            for i in pod_idxs:
-                s = int(sig_of_pod[i])
-                sig_counts[s] = sig_counts.get(s, 0) + 1
+            usigs, ucounts = np.unique(sig_of_pod[pod_idxs], return_counts=True)
+            sig_counts = {int(s): int(n) for s, n in zip(usigs, ucounts)}
             requests = _requests_from_sigs(enc, sig_counts)
             if j < enc.n_existing:
                 en = existing_by_slot[j]
@@ -357,9 +434,15 @@ class TPUSolver:
                 mask_cache[rkey] = mask
             total_vec = total_mat[j]
             # groups whose daemon-reserved ports conflict with the slot's
-            # pods can never host them (nodeclaim.py:430 semantics)
-            pod_ports = [(p.key(), _php(p)) for p in pods]
-            pod_ports = [(k, ps) for k, ps in pod_ports if ps]
+            # pods can never host them (nodeclaim.py:430 semantics); the
+            # per-signature port masks tell us for free whether ANY of the
+            # slot's pods carries host ports — skip the O(pods) extraction
+            # for the (dominant) port-free case
+            if enc.sig_port_any[usigs].any():
+                pod_ports = [(p.key(), _php(p)) for p in pods]
+                pod_ports = [(k, ps) for k, ps in pod_ports if ps]
+            else:
+                pod_ports = []
             remaining = []
             for members, ovh, gusage in ginfo:
                 if not members:

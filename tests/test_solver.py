@@ -799,13 +799,16 @@ class TestHostPortsWindow:
         original = smg.greedy_pack_grouped_compressed
 
         def corrupted(t, items, n_pods):
+            # pile every REAL item (pads have count 0) onto slot 0
             out = original(t, items, n_pods)
             counts = np.asarray(items.item_count)
-            W = counts.shape[0]
-            pad = out["nz_item"].shape[0] - W
-            out["nz_item"] = np.concatenate([np.arange(W), np.full(pad, -1)]).astype(out["nz_item"].dtype)
-            out["nz_slot"] = np.concatenate([np.zeros(W, np.int64), np.full(pad, -1)]).astype(out["nz_slot"].dtype)
-            out["nz_count"] = np.concatenate([counts, np.zeros(pad, counts.dtype)]).astype(out["nz_count"].dtype)
+            real = np.nonzero(counts > 0)[0]
+            cap = out["nz_item"].shape[0]
+            k = min(len(real), cap)
+            for key, vals in (("nz_item", real[:k]), ("nz_slot", np.zeros(k, np.int64)), ("nz_count", counts[real[:k]])):
+                arr = np.full(cap, -1 if key != "nz_count" else 0, dtype=out[key].dtype)
+                arr[:k] = vals
+                out[key] = arr
             out["leftovers"] = np.zeros_like(out["leftovers"])
             return out
 
