@@ -121,6 +121,8 @@ class SchedulingNodeClaim:
         self.allocator = allocator  # DRA; None when the gate is off
         self.dra_trackers: dict = {}  # instance type name -> AllocationTracker
         self._pending_dra = None  # {it name: AllocationResult} awaiting add()
+        self._pending_dra_meta = None  # {claim key: ClaimAllocationMetadata}
+        self._dra_claim_keys: set = set()  # claims committed on this node
         # reserved-offering accounting (nodeclaim.go:43-62): the claim tracks
         # the reserved offerings it currently holds so stale ones release on
         # later narrowing and compatible ones can re-expand across iterations
@@ -157,6 +159,7 @@ class SchedulingNodeClaim:
         # downstream topology and instance-type checks (nodeclaim.go:138-157)
         last_err = None
         self._pending_dra = None
+        self._pending_dra_meta = None
         self._pending_reserved = []
         for vol_reqs in pod_data.volume_requirements or [None]:
             reqs, its, err = self._try_volume_alternative(pod, pod_data, base, vol_reqs, relax_min_values)
@@ -206,11 +209,15 @@ class SchedulingNodeClaim:
 
         # DRA: keep only instance types whose template devices satisfy the
         # pod's claims; the reference allocates before the filter and prunes
-        # unsupported types after (nodeclaim.go:177-194,225-229)
+        # unsupported types after (nodeclaim.go:177-194,225-229). Per-IT
+        # device choices then SUPERPOSE their contributed requirements: a
+        # claim's topology is the intersection across surviving types, and
+        # types that would collapse it to empty are pruned
+        # (allocator.go:90-134)
         if (pod_data.resource_claims or pod_data.resource_claim_err) and self.allocator is not None:
             if pod_data.resource_claim_err is not None:
                 return None, None, pod_data.resource_claim_err
-            surviving, per_it = [], {}
+            per_it = {}
             for it in remaining:
                 tracker = self.dra_trackers.get(it.name)
                 if tracker is None:
@@ -223,12 +230,14 @@ class SchedulingNodeClaim:
                     self.hostname, self.allocator.template_devices(it), pod_data.resource_claims, tracker
                 )
                 if derr is None:
-                    surviving.append(it)
                     per_it[it.name] = (tracker, result)
+            kept, metas = self.allocator.superpose_template_allocation(self.hostname, per_it)
+            surviving = [it for it in remaining if it.name in kept]
             if not surviving:
                 return None, None, "no instance type can allocate the pod's dynamic resources"
             remaining = surviving
-            self._pending_dra = per_it
+            self._pending_dra = kept
+            self._pending_dra_meta = metas
 
         # reserved-offering reservations (nodeclaim.go:303-350): collect every
         # compatible+available reserved offering the claim could launch into;
@@ -266,6 +275,15 @@ class SchedulingNodeClaim:
     def add(self, pod, pod_data, updated_requirements: Requirements, updated_instance_types: list[InstanceType]) -> None:
         self.pods.append(pod)
         self.requirements = updated_requirements
+        # instance types dropped by this pod's narrowing release their
+        # superposition contributions, relaxing committed claims' pessimistic
+        # topology intersections (allocator.go "totalRequirements are updated
+        # each time instance types are released")
+        if self.allocator is not None and self._dra_claim_keys:
+            removed = {it.name for it in self.instance_type_options} - {it.name for it in updated_instance_types}
+            if removed:
+                for ck in self._dra_claim_keys:
+                    self.allocator.release_instance_types(ck, removed)
         self.instance_type_options = updated_instance_types
         self.spec_requests = res.merge(self.spec_requests, pod_data.requests)
         if self.reservation_manager is not None:
@@ -283,7 +301,11 @@ class SchedulingNodeClaim:
             for it_name, (tracker, result) in self._pending_dra.items():
                 self.dra_trackers[it_name] = tracker
                 self.allocator.commit(self.hostname, result, tracker)
+            if self._pending_dra_meta:
+                self.allocator.commit_template_metadata(self._pending_dra_meta)
+                self._dra_claim_keys.update(self._pending_dra_meta)
             self._pending_dra = None
+            self._pending_dra_meta = None
         # track host ports per daemon group so future pods see conflicts
         ports = pod_host_ports(pod)
         for g in self.daemon_overhead_groups:

@@ -317,6 +317,12 @@ class Device:
     # partitions consuming slices of one physical GPU's memory/SM budget
     # [{"counterSet": str, "counters": {name: Quantity|str}}]
     consumes_counters: list[dict] = field(default_factory=list)
+    # node requirements selecting this device pins (template devices only):
+    # the topology the launched node must satisfy when the device is chosen —
+    # feeds per-instance-type requirement superposition
+    # (allocator.go:90-134 ContributedRequirements)
+    # [{"key", "operator", "values"}]
+    requirements: list[dict] = field(default_factory=list)
 
 
 @dataclass

@@ -86,10 +86,11 @@ def _objective(t: ConsolidationTensors, x):
     return score, feasible
 
 
-@partial(jax.jit, static_argnames=("n_chains", "n_steps"))
-def anneal(t: ConsolidationTensors, key, n_chains: int = 64, n_steps: int = 512):
-    """Parallel annealing chains; returns (best_x [n_chains, N], best_score
-    [n_chains]) — the host picks, dedups and exact-validates the top subsets."""
+@partial(jax.jit, static_argnames=("n_steps",))
+def anneal_chains(t: ConsolidationTensors, keys, n_steps: int = 512):
+    """The vmapped chain body over an EXPLICIT key batch: chains are fully
+    independent, so this is also the unit the mesh path shards (each device
+    runs its key shard; no collectives — parallel/sharded.anneal_sharded)."""
     N = t.node_price.shape[0]
 
     def chain(key):
@@ -116,5 +117,12 @@ def anneal(t: ConsolidationTensors, key, n_chains: int = 64, n_steps: int = 512)
         x, s, best_x, best_s, _ = jax.lax.fori_loop(0, n_steps, step, (x0, s0, x0, s0, k_loop))
         return best_x, best_s
 
-    keys = jax.random.split(key, n_chains)
     return jax.vmap(chain)(keys)
+
+
+def anneal(t: ConsolidationTensors, key, n_chains: int = 64, n_steps: int = 512):
+    """Parallel annealing chains; returns (best_x [n_chains, N], best_score
+    [n_chains]) — the host picks, dedups and exact-validates the top subsets."""
+    import jax.random as jr
+
+    return anneal_chains(t, jr.split(key, n_chains), n_steps)

@@ -106,7 +106,9 @@ class SchedulerTensors:
     row_port_wild: jnp.ndarray  # [Nrows, P1] bool
     row_port_spec: jnp.ndarray  # [Nrows, P2] bool
     dom_keys: tuple  # static: vocab key id per dom key (-1 if absent)
-    n_existing: int  # static
+    # DYNAMIC (traced) count of existing-node slots: fleet-size changes must
+    # NOT recompile the kernel — only the existing-axis BUCKET boundary does
+    n_existing: int  # pytree leaf (traced scalar under jit)
     n_slots: int  # static
 
 
@@ -142,8 +144,9 @@ jax.tree_util.register_dataclass(
         "row_port_any",
         "row_port_wild",
         "row_port_spec",
+        "n_existing",
     ],
-    meta_fields=["dom_keys", "n_existing", "n_slots"],
+    meta_fields=["dom_keys", "n_slots"],
 )
 
 
@@ -167,6 +170,7 @@ TAINT_BUCKET = 4
 GROUP_BUCKET = 8
 PORT_BUCKET = 4
 RANK_BUCKET = 4
+EXIST_BUCKET = 32
 ITEM_BUCKET = 64
 SLOTS_BUCKET = 512
 
@@ -300,7 +304,7 @@ def make_tensors(enc, n_slots: int | None = None, with_pods: bool = True) -> Sch
         member = _pad_axis(enc.member if enc.n_groups else np.zeros((P, 1), bool), 1, G_p, fill=False)
         owner = _pad_axis(enc.owner if enc.n_groups else np.zeros((P, 1), bool), 1, G_p, fill=False)
 
-    n_ex = max(enc.n_existing, 1)
+    n_ex = bucket(enc.n_existing, EXIST_BUCKET)
     existing_domset = np.zeros((n_ex, D), dtype=bool)
     dko = np.asarray(enc.dom_key_of)
     for j in range(enc.n_existing):
@@ -331,9 +335,9 @@ def make_tensors(enc, n_slots: int | None = None, with_pods: bool = True) -> Sch
         counts_dom_init=jnp.asarray(counts_dom),
         counts_host_init=jnp.asarray(counts_host),
         existing_domset=jnp.asarray(existing_domset),
-        existing_port_any=jnp.asarray(_pad_axis(enc.existing_port_any, 1, P1_p, fill=False)),
-        existing_port_wild=jnp.asarray(_pad_axis(enc.existing_port_wild, 1, P1_p, fill=False)),
-        existing_port_spec=jnp.asarray(_pad_axis(enc.existing_port_spec, 1, P2_p, fill=False)),
+        existing_port_any=jnp.asarray(_pad_axis(_pad_axis(enc.existing_port_any, 1, P1_p, fill=False), 0, n_ex, fill=False)),
+        existing_port_wild=jnp.asarray(_pad_axis(_pad_axis(enc.existing_port_wild, 1, P1_p, fill=False), 0, n_ex, fill=False)),
+        existing_port_spec=jnp.asarray(_pad_axis(_pad_axis(enc.existing_port_spec, 1, P2_p, fill=False), 0, n_ex, fill=False)),
         row_port_any=jnp.asarray(row_port_any),
         row_port_wild=jnp.asarray(row_port_wild),
         row_port_spec=jnp.asarray(row_port_spec),
@@ -422,8 +426,9 @@ def _compat_matrix(t: SchedulerTensors, dom_keys: tuple):
     return compat_matrix(t.row_labels, t.row_taint_class, t.pod_mask, t.pod_taint_ok, dom_keys)
 
 
-@partial(jax.jit, static_argnames=("dom_keys", "n_existing", "n_slots"))
-def _greedy_pack_impl(t: SchedulerTensors, dom_keys: tuple, n_existing: int, n_slots: int):
+@partial(jax.jit, static_argnames=("dom_keys", "n_slots"))
+def _greedy_pack_impl(t: SchedulerTensors, dom_keys: tuple, n_slots: int):
+    n_existing = t.n_existing
     P, R = t.pod_req.shape
     N = n_slots
     Nrows = t.row_alloc.shape[0]
@@ -433,11 +438,13 @@ def _greedy_pack_impl(t: SchedulerTensors, dom_keys: tuple, n_existing: int, n_s
     slot_rem0 = jnp.full((N, R), NEG)
     slot_domset0 = jnp.zeros((N, D), dtype=bool)
     slot_rank0 = jnp.full((N,), -1, dtype=jnp.int32)
-    if n_existing:
-        idx = jnp.arange(n_existing, dtype=jnp.int32)
-        slot_basis0 = slot_basis0.at[:n_existing].set(idx)
-        slot_rem0 = slot_rem0.at[:n_existing].set(t.row_alloc[:n_existing])
-        slot_domset0 = slot_domset0.at[:n_existing].set(t.existing_domset[:n_existing])
+    slot_ids0 = jnp.arange(N, dtype=jnp.int32)
+    in_ex0 = slot_ids0 < n_existing
+    safe_row0 = jnp.clip(slot_ids0, 0, Nrows - 1)
+    safe_ex0 = jnp.clip(slot_ids0, 0, t.existing_domset.shape[0] - 1)
+    slot_basis0 = jnp.where(in_ex0, slot_ids0, -1).astype(jnp.int32)
+    slot_rem0 = jnp.where(in_ex0[:, None], t.row_alloc[safe_row0], slot_rem0)
+    slot_domset0 = jnp.where(in_ex0[:, None], t.existing_domset[safe_ex0], slot_domset0)
 
     is_offering_row = jnp.arange(Nrows) >= n_existing
 
@@ -543,7 +550,7 @@ def _greedy_pack_impl(t: SchedulerTensors, dom_keys: tuple, n_existing: int, n_s
         slot_rank0,
         t.counts_dom_init,
         t.counts_host_init,
-        jnp.int32(n_existing),
+        jnp.asarray(n_existing, jnp.int32),
     )
     (slot_basis, slot_rem, slot_domset, slot_rank, counts_dom, counts_host, open_count), assignment = jax.lax.scan(
         step, init, jnp.arange(P, dtype=jnp.int32)
@@ -559,4 +566,4 @@ def greedy_pack(t: SchedulerTensors):
     production path is the grouped kernel (scheduler_model_grouped), which
     does. Callers must only feed it port-free snapshots (TPUSolver never
     routes ported pods here)."""
-    return _greedy_pack_impl(t, t.dom_keys, t.n_existing, t.n_slots)
+    return _greedy_pack_impl(t, t.dom_keys, t.n_slots)

@@ -170,6 +170,9 @@ def pad_item_arrays(arrays: dict, item_bucket: int) -> dict:
     a["item_port_any"] = _pad_axis(a["item_port_any"], 1, bucket(a["item_port_any"].shape[1], PORT_BUCKET), fill=False)
     a["item_port_wild"] = _pad_axis(a["item_port_wild"], 1, bucket(a["item_port_wild"].shape[1], PORT_BUCKET), fill=False)
     a["item_port_spec"] = _pad_axis(a["item_port_spec"], 1, bucket(a["item_port_spec"].shape[1], PORT_BUCKET), fill=False)
+    from .scheduler_model import EXIST_BUCKET
+
+    a["item_host_blocked"] = _pad_axis(a["item_host_blocked"], 1, bucket(a["item_host_blocked"].shape[1], EXIST_BUCKET), fill=False)
     W_p = bucket(a["item_count"].shape[0], item_bucket)
     for k in a:
         a[k] = _pad_axis(a[k], 0, W_p, fill=0 if a[k].dtype != bool else False)
@@ -236,7 +239,6 @@ def _pack_body(
     items: ItemTensors,
     *,
     dom_keys: tuple,
-    n_existing: int,
     n_slots: int,
     axis: str | None,
     init_state=None,
@@ -256,6 +258,7 @@ def _pack_body(
     and per-domain slot availability (psum-of-any) — the TPU analogue of the
     reference's parallelizeUntil fan-out over candidate nodes
     (scheduler.go:939-961), riding ICI instead of goroutines."""
+    n_existing = t.n_existing  # traced: fleet-size drift never recompiles
     W, R = items.item_req.shape
     N = n_slots
     Nrows = t.row_alloc.shape[0]
@@ -298,24 +301,15 @@ def _pack_body(
     # existing nodes' remaining envelopes, the rest are closed
     P1 = items.item_port_any.shape[1]
     P2 = items.item_port_spec.shape[1]
-    in_existing = slot_ids < n_existing
-    if n_existing:
-        safe_row = jnp.clip(slot_ids, 0, Nrows - 1)
-        safe_ex = jnp.clip(slot_ids, 0, t.existing_domset.shape[0] - 1)
-        slot_basis0 = jnp.where(in_existing, slot_ids, -1).astype(jnp.int32)
-        slot_rem0 = jnp.where(in_existing[:, None], t.row_alloc[safe_row], NEG)
-        slot_zoneset0 = jnp.where(in_existing[:, None], t.existing_domset[safe_ex], False)
-        # existing_port_* share existing_domset's max(n_existing, 1) rows
-        slot_pany0 = jnp.where(in_existing[:, None], t.existing_port_any[safe_ex], False)
-        slot_pwild0 = jnp.where(in_existing[:, None], t.existing_port_wild[safe_ex], False)
-        slot_pspec0 = jnp.where(in_existing[:, None], t.existing_port_spec[safe_ex], False)
-    else:
-        slot_basis0 = jnp.full((N_loc,), -1, dtype=jnp.int32)
-        slot_rem0 = jnp.full((N_loc, R), NEG)
-        slot_zoneset0 = jnp.zeros((N_loc, D), dtype=bool)
-        slot_pany0 = jnp.zeros((N_loc, P1), dtype=bool)
-        slot_pwild0 = jnp.zeros((N_loc, P1), dtype=bool)
-        slot_pspec0 = jnp.zeros((N_loc, P2), dtype=bool)
+    in_existing = slot_ids < n_existing  # traced: no per-fleet-size retrace
+    safe_row = jnp.clip(slot_ids, 0, Nrows - 1)
+    safe_ex = jnp.clip(slot_ids, 0, t.existing_domset.shape[0] - 1)
+    slot_basis0 = jnp.where(in_existing, slot_ids, -1).astype(jnp.int32)
+    slot_rem0 = jnp.where(in_existing[:, None], t.row_alloc[safe_row], NEG)
+    slot_zoneset0 = jnp.where(in_existing[:, None], t.existing_domset[safe_ex], False)
+    slot_pany0 = jnp.where(in_existing[:, None], t.existing_port_any[safe_ex], False)
+    slot_pwild0 = jnp.where(in_existing[:, None], t.existing_port_wild[safe_ex], False)
+    slot_pspec0 = jnp.where(in_existing[:, None], t.existing_port_spec[safe_ex], False)
     slot_rank0 = jnp.full((N_loc,), -1, dtype=jnp.int32)
 
     Q = t.rank_domset.shape[0]
@@ -745,7 +739,7 @@ def _pack_body(
             slot_rank0,
             t.counts_dom_init,
             t.counts_host_init,
-            jnp.int32(n_existing),
+            jnp.asarray(n_existing, jnp.int32),
             (slot_pany0, slot_pwild0, slot_pspec0),
         )
     final_state, (takes, leftovers) = jax.lax.scan(step, init, jnp.arange(W, dtype=jnp.int32))
@@ -755,9 +749,9 @@ def _pack_body(
     return takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count
 
 
-@partial(jax.jit, static_argnames=("dom_keys", "n_existing", "n_slots"))
-def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, dom_keys: tuple, n_existing: int, n_slots: int):
-    return _pack_body(t, items, dom_keys=dom_keys, n_existing=n_existing, n_slots=n_slots, axis=None)
+@partial(jax.jit, static_argnames=("dom_keys", "n_slots"))
+def _greedy_pack_grouped_impl(t: SchedulerTensors, items: ItemTensors, dom_keys: tuple, n_slots: int):
+    return _pack_body(t, items, dom_keys=dom_keys, n_slots=n_slots, axis=None)
 
 
 def _sparsify_takes(takes, nnz_cap: int):
@@ -785,8 +779,8 @@ def _flat_outputs(takes, leftovers, slot_basis, slot_zoneset, open_count, nnz_ca
     )
 
 
-@partial(jax.jit, static_argnames=("dom_keys", "n_existing", "n_slots", "nnz_cap"))
-def _pack_compressed_impl(t: SchedulerTensors, items: ItemTensors, dom_keys: tuple, n_existing: int, n_slots: int, nnz_cap: int):
+@partial(jax.jit, static_argnames=("dom_keys", "n_slots", "nnz_cap"))
+def _pack_compressed_impl(t: SchedulerTensors, items: ItemTensors, dom_keys: tuple, n_slots: int, nnz_cap: int):
     """Pack + on-device sparsification, fused into ONE flat int32 output.
 
     The production deployment reaches the TPU through a tunnel whose
@@ -798,18 +792,18 @@ def _pack_compressed_impl(t: SchedulerTensors, items: ItemTensors, dom_keys: tup
     Also returns the scan's FINAL STATE — left device-resident by the caller
     so a later 1-pod delta can continue the pack instead of redoing it."""
     takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count, state = _pack_body(
-        t, items, dom_keys=dom_keys, n_existing=n_existing, n_slots=n_slots, axis=None, return_state=True
+        t, items, dom_keys=dom_keys, n_slots=n_slots, axis=None, return_state=True
     )
     return _flat_outputs(takes, leftovers, slot_basis, slot_zoneset, open_count, nnz_cap), state
 
 
-@partial(jax.jit, static_argnames=("dom_keys", "n_existing", "n_slots", "nnz_cap"))
-def _pack_delta_compressed_impl(state, t: SchedulerTensors, items: ItemTensors, dom_keys: tuple, n_existing: int, n_slots: int, nnz_cap: int):
+@partial(jax.jit, static_argnames=("dom_keys", "n_slots", "nnz_cap"))
+def _pack_delta_compressed_impl(state, t: SchedulerTensors, items: ItemTensors, dom_keys: tuple, n_slots: int, nnz_cap: int):
     """Incremental pack: scan ONLY the delta items, continuing from a prior
     pack's device-resident final state. Output layout matches
     _pack_compressed_impl (takes span just the delta items)."""
     takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count, state2 = _pack_body(
-        t, items, dom_keys=dom_keys, n_existing=n_existing, n_slots=n_slots, axis=None,
+        t, items, dom_keys=dom_keys, n_slots=n_slots, axis=None,
         init_state=state, return_state=True,
     )
     return _flat_outputs(takes, leftovers, slot_basis, slot_zoneset, open_count, nnz_cap), state2
@@ -856,7 +850,7 @@ def greedy_pack_grouped_compressed(t: SchedulerTensors, items: ItemTensors, n_po
     # nnz <= n_pods; round the static cap up to a power of two so solves with
     # drifting pod counts reuse one compiled kernel instead of retracing
     nnz_cap = int(min(_next_pow2(n_pods), W * N))
-    flat_dev, state = _pack_compressed_impl(t, items, t.dom_keys, t.n_existing, N, nnz_cap)
+    flat_dev, state = _pack_compressed_impl(t, items, t.dom_keys, N, nnz_cap)
     out = _parse_flat(np.asarray(flat_dev), nnz_cap, N, Z, W)
     out["state"] = state
     out["nnz_cap"] = nnz_cap
@@ -876,7 +870,7 @@ def greedy_pack_delta_compressed(state, t: SchedulerTensors, items: ItemTensors,
     N = t.n_slots
     Z = t.counts_dom_init.shape[1]
     nnz_cap = int(_next_pow2(max(n_added, 2)))
-    flat_dev, state2 = _pack_delta_compressed_impl(state, t, items, t.dom_keys, t.n_existing, N, nnz_cap)
+    flat_dev, state2 = _pack_delta_compressed_impl(state, t, items, t.dom_keys, N, nnz_cap)
     out = _parse_flat(np.asarray(flat_dev), nnz_cap, N, Z, W)
     out["state"] = state2
     out["nnz_cap"] = nnz_cap
@@ -886,7 +880,7 @@ def greedy_pack_delta_compressed(state, t: SchedulerTensors, items: ItemTensors,
 def greedy_pack_grouped(t: SchedulerTensors, items: ItemTensors):
     """Returns (takes [W, N], leftovers [W], slot_basis, slot_zoneset,
     slot_rank, open_count)."""
-    return _greedy_pack_grouped_impl(t, items, t.dom_keys, t.n_existing, t.n_slots)
+    return _greedy_pack_grouped_impl(t, items, t.dom_keys, t.n_slots)
 
 
 def compress_takes(takes, n_pods: int):

@@ -49,16 +49,17 @@ def make_mesh(devices=None, axis: str = "slots") -> Mesh:
 
 
 @functools.lru_cache(maxsize=64)
-def _sharded_pack_fn(mesh: Mesh, dom_keys: tuple, n_existing: int, n_slots: int):
+def _sharded_pack_fn(mesh: Mesh, dom_keys: tuple, n_slots: int):
     """The jitted shard_map'd pack kernel, cached so steady-state meshed
     solves reuse one trace/compile per (mesh, statics) the way the
-    single-device @jax.jit kernel does (jit caches key on wrapper identity)."""
+    single-device @jax.jit kernel does (jit caches key on wrapper identity);
+    n_existing is a traced scalar, so fleet-size drift reuses the compile."""
     axis = mesh.axis_names[0]
-    meta = dict(dom_keys=dom_keys, n_existing=n_existing, n_slots=n_slots)
+    meta = dict(dom_keys=dom_keys, n_slots=n_slots)
     data = {f.name: P() for f in dataclasses.fields(SchedulerTensors) if f.name not in meta}
     t_specs = dataclasses.replace(SchedulerTensors(**data, **meta), counts_host_init=P(None, axis))
     item_specs = ItemTensors(**{f.name: P() for f in dataclasses.fields(ItemTensors)})
-    body = partial(_pack_body, dom_keys=dom_keys, n_existing=n_existing, n_slots=n_slots, axis=axis)
+    body = partial(_pack_body, dom_keys=dom_keys, n_slots=n_slots, axis=axis)
     return jax.jit(
         jax.shard_map(
             body,
@@ -79,7 +80,7 @@ def greedy_pack_grouped_sharded(t: SchedulerTensors, items: ItemTensors, mesh: M
     and never used unless the original axis overflows).
     """
     t = pad_slots_for_mesh(t, mesh)
-    fn = _sharded_pack_fn(mesh, t.dom_keys, t.n_existing, t.n_slots)
+    fn = _sharded_pack_fn(mesh, t.dom_keys, t.n_slots)
     return fn(t, items)
 
 
@@ -106,6 +107,30 @@ def assert_sharded_equivalent(t: SchedulerTensors, items: ItemTensors, mesh: Mes
         if not np.array_equal(np.asarray(a), np.asarray(b)):
             raise AssertionError(f"sharded pack diverged from single-device pack on {name}")
     return sharded
+
+
+def anneal_sharded(t, key, mesh: Mesh, n_chains: int = 64, n_steps: int = 512):
+    """The consolidation annealer with its CHAINS axis sharded across the
+    mesh: chains are independent searches (models/consolidation_model.py), so
+    each device runs its shard of the key batch with NO collectives — the
+    embarrassingly-parallel half of the consolidation pipeline. Chain count
+    rounds up to a mesh multiple; results are bit-identical per chain to the
+    single-device run on the same keys."""
+    from ..models.consolidation_model import anneal_chains
+
+    axis = mesh.axis_names[0]
+    per = -(-n_chains // mesh.size)
+    keys = jax.random.split(key, per * mesh.size)
+    fn = jax.jit(
+        jax.shard_map(
+            partial(anneal_chains, n_steps=n_steps),
+            mesh=mesh,
+            in_specs=(P(), P(axis)),
+            out_specs=(P(axis), P(axis)),
+            check_vma=False,
+        )
+    )
+    return fn(t, keys)
 
 
 def sharded_compat_matrix(t: SchedulerTensors, mesh: Mesh):

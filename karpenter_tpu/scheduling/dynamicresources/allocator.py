@@ -17,10 +17,12 @@ devices are modeled via pool-level shared counter sets
 shared_counters (in-cluster slices) or dynamic_resources_counters (instance
 type templates, fresh per launched node), and the tracker draws down lazily-
 materialized per-candidate remaining budgets. Per-instance-type requirement
-superposition (allocator.go:90-134) is not modeled; template allocation
-instead filters the instance-type set directly, which preserves the
-observable behavior (claims only land on instance types that can satisfy
-them).
+SUPERPOSITION (allocator.go:90-134) is modeled by
+superpose_template_allocation: each instance type's device choice contributes
+the node requirements its devices pin (Device.requirements), a claim's
+topology is pessimistically the intersection across surviving types
+(ClaimAllocationMetadata.total), and types that would collapse any claim's
+intersection to the empty set are pruned.
 """
 
 from __future__ import annotations
@@ -147,6 +149,61 @@ class AllocationResult:
 
     # claim key -> [(request name, _DeviceRef, consumed capacity | None)]
     picks: dict = field(default_factory=dict)
+
+
+@dataclass
+class ClaimAllocationMetadata:
+    """Per-claim allocation state for template-device allocations
+    (allocator.go:90-134 ResourceClaimAllocationMetadata): the NodeClaim the
+    claim is transitively bound to, the requirements each instance type's
+    device choice CONTRIBUTES, and their pessimistic intersection — the
+    topology the claim is treated as pinned to while the NodeClaim stays
+    superposed across instance types."""
+
+    node_claim_id: str = ""
+    used_template_devices: bool = False
+    contributed: dict = field(default_factory=dict)  # it name -> Requirements
+    devices: dict = field(default_factory=dict)  # it name -> picks
+    total: object = None  # Requirements — intersection of contributed
+
+    def recompute_total(self):
+        from ...scheduling.requirements import Requirements
+
+        total = Requirements()
+        for reqs in self.contributed.values():
+            total.add(*reqs.values())
+        self.total = total
+        return total
+
+
+def requirements_from_picks(picks) -> "Requirements":
+    """The node requirements a device selection pins: every chosen device's
+    `requirements` land on ONE node, so they intersect (Requirements.add)."""
+    from ...scheduling.requirements import Requirement, Requirements
+
+    out = Requirements()
+    for _name, ref, _cap in picks:
+        for r in getattr(ref.device, "requirements", None) or []:
+            out.add(Requirement(r["key"], r.get("operator", "In"), r.get("values", [])))
+    return out
+
+
+def _requirements_satisfiable(reqs) -> bool:
+    """False when any requirement's allowed set is empty (the intersection
+    collapsed — allocator.go prunes such instance types). Device
+    contributions are In/NotIn value sets over labels a launched node
+    carries, so an intersection that renders as DOES_NOT_EXIST (empty
+    allowed set) is a contradiction, not a real absence requirement."""
+    from ...scheduling.requirements import Operator
+
+    for r in reqs.values():
+        if r.operator() in (Operator.IN, Operator.DOES_NOT_EXIST) and not r.complement and not r.values:
+            return False
+        # numeric-bound collapse: Gt/Lt contributions whose intersection
+        # leaves gte > lte match nothing
+        if r.gte is not None and r.lte is not None and r.gte > r.lte:
+            return False
+    return True
 
 
 class _MatchAttributeConstraint:
@@ -360,6 +417,75 @@ class Allocator:
         # claim key -> node/claim target committed this loop (shared claims
         # must co-locate all their pods)
         self.claim_targets: dict[str, str] = {}
+        # claim key -> ClaimAllocationMetadata for template-device allocations
+        # (allocator.go:84-86 ResourceClaimAllocationMetadata accessor)
+        self.claim_allocation_metadata: dict[str, ClaimAllocationMetadata] = {}
+
+    def superpose_template_allocation(self, node_claim_id: str, per_it: dict) -> tuple[dict, dict]:
+        """Per-instance-type requirement superposition (allocator.go:90-134).
+
+        `per_it` maps instance type name -> (tracker, AllocationResult) for
+        ONE NodeClaim's template-device allocations, in evaluation order.
+        Each IT's device choice CONTRIBUTES the requirements its devices pin;
+        a claim's topology is pessimistically the INTERSECTION of contributed
+        requirements across the ITs the NodeClaim stays superposed over. An
+        IT whose contribution would collapse any claim's intersection to the
+        empty set is PRUNED (the NodeClaim model cannot express "type A in
+        zone A OR type B in zone B").
+
+        Returns (surviving per_it entries, metadata by claim key). Commit the
+        metadata via commit_template_metadata once the NodeClaim is kept."""
+        metas: dict[str, ClaimAllocationMetadata] = {}
+        kept: dict = {}
+        for it_name, entry in per_it.items():
+            _tracker, result = entry
+            trial: dict[str, object] = {}
+            ok = True
+            for claim_key, picks in result.picks.items():
+                from ...scheduling.requirements import Requirements
+
+                reqs = requirements_from_picks(picks)
+                meta = metas.setdefault(
+                    claim_key, ClaimAllocationMetadata(node_claim_id=node_claim_id, used_template_devices=True)
+                )
+                total = Requirements()
+                for prev in meta.contributed.values():
+                    total.add(*prev.values())
+                total.add(*reqs.values())
+                if not _requirements_satisfiable(total):
+                    ok = False
+                    break
+                trial[claim_key] = reqs
+            if not ok:
+                continue
+            kept[it_name] = entry
+            for claim_key, reqs in trial.items():
+                metas[claim_key].contributed[it_name] = reqs
+                metas[claim_key].devices[it_name] = result.picks[claim_key]
+        for meta in metas.values():
+            meta.recompute_total()
+        return kept, metas
+
+    def commit_template_metadata(self, metas: dict) -> None:
+        self.claim_allocation_metadata.update(metas)
+
+    def resource_claim_allocation_metadata(self) -> dict:
+        """Copy of the allocator's per-claim template-allocation metadata
+        (allocator.go ResourceClaimAllocationMetadata)."""
+        return dict(self.claim_allocation_metadata)
+
+    def release_instance_types(self, claim_key: str, removed_it_names) -> None:
+        """The NodeClaim released instance types (price filtering, finalize):
+        drop their contributions and relax the pessimistic intersection
+        (allocator.go totalRequirements 'updated each time instance types are
+        released')."""
+        meta = self.claim_allocation_metadata.get(claim_key)
+        if meta is None:
+            return
+        for name in removed_it_names:
+            meta.contributed.pop(name, None)
+            meta.devices.pop(name, None)
+        meta.recompute_total()
 
     def _now(self) -> float:
         return self.clock.now() if self.clock is not None else time.monotonic()

@@ -166,6 +166,12 @@ class TPUSolver:
 
     def solve(self, snap: SolverSnapshot) -> Results:
         enc = encode(snap, cache=self.encode_cache)
+        # consume + clear the delta link IMMEDIATELY (even on the fallback
+        # returns below): each link retains O(P) state, so an unbroken chain
+        # across consecutive delta encodes would leak
+        delta_base = getattr(enc, "delta_base", None)
+        if delta_base is not None:
+            enc.delta_base = None
         self.last_fallback_reasons = enc.fallback_reasons
         if enc.fallback_reasons:
             if self.force:
@@ -184,7 +190,7 @@ class TPUSolver:
         # previous one plus appended known-shape pods, and the previous
         # pack's final carry is still device-resident — scan ONLY the delta
         self.last_solve_mode = "full"
-        delta = self._solve_delta(snap, enc)
+        delta = self._solve_delta(snap, enc, delta_base)
         if delta is not None:
             return delta
 
@@ -237,12 +243,12 @@ class TPUSolver:
         self._count(SOLVER_SOLVE_TOTAL, backend="tpu")
         return results
 
-    def _solve_delta(self, snap: SolverSnapshot, enc) -> Results | None:
+    def _solve_delta(self, snap: SolverSnapshot, enc, base) -> Results | None:
         """Incremental solve for an append-only pod delta: scan only the
         delta items from the previous pack's device-resident final carry,
         merge with the previous assignment, re-validate the WHOLE placement,
-        and decode. Returns None when the full path must run."""
-        base = getattr(enc, "delta_base", None)
+        and decode. `base` is the consumed delta_base link (cleared by the
+        caller). Returns None when the full path must run."""
         res = self._resident
         if base is None or res is None or res["enc"] is not base or self.mesh is not None:
             return None

@@ -1,0 +1,366 @@
+"""DRA per-instance-type requirement superposition + allocator depth specs.
+
+Reference: allocator.go:90-134 (ResourceClaimAllocationMetadata /
+ContributedRequirements / pruning of intersection-emptying instance types)
+and allocator_test.go's constraint-interaction, rollback, and exhaustion
+families."""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.cloudprovider.types import InstanceType, Offering
+from karpenter_tpu.controllers.provisioning.scheduling import Scheduler
+from karpenter_tpu.kube import Device, DeviceClass, ObjectMeta, ResourceClaim, ResourceSlice, Store
+from karpenter_tpu.scheduling.dynamicresources import Allocator
+from karpenter_tpu.scheduling.dynamicresources.allocator import (
+    AllocationTracker,
+    ClaimAllocationMetadata,
+    requirements_from_picks,
+)
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.resources import parse_resource_list
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def zoned_gpu(name, zones, model="a100"):
+    """A template device only available in the given zones: selecting it pins
+    the launched node's zone (the superposition contribution)."""
+    return Device(
+        name=name,
+        attributes={"gpu.example.com/model": model},
+        capacity=parse_resource_list({"memory": "40Gi"}),
+        requirements=[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": list(zones)}],
+    )
+
+
+def gpu_it(name, devices, zones=("test-zone-a", "test-zone-b"), price=10.0):
+    return InstanceType(
+        name=name,
+        requirements=Requirements.from_labels({
+            wk.INSTANCE_TYPE_LABEL_KEY: name,
+            wk.ARCH_LABEL_KEY: "amd64",
+            wk.OS_LABEL_KEY: "linux",
+        }),
+        offerings=[
+            Offering(
+                requirements=Requirements.from_labels({
+                    wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND,
+                    wk.ZONE_LABEL_KEY: z,
+                }),
+                price=price,
+            )
+            for z in zones
+        ],
+        capacity=parse_resource_list({"cpu": "8", "memory": "32Gi", "pods": "110"}),
+        dynamic_resources=devices,
+    )
+
+
+def gpu_claim(name, count=1, model=None, ns="default", constraints=None):
+    sel = [{"attribute": "model", "operator": "In", "values": [model]}] if model else []
+    req = {"name": "gpus", "deviceClassName": "gpu-class", "count": count}
+    if sel:
+        req["selectors"] = sel
+    return ResourceClaim(metadata=ObjectMeta(name=name, namespace=ns), requests=[req], constraints=constraints or [])
+
+
+def claim_pod(name, *claim_names, **kw):
+    pod = make_pod(name=name, **kw)
+    pod.spec.resource_claims = [{"name": f"c{i}", "resourceClaimName": c} for i, c in enumerate(claim_names)]
+    return pod
+
+
+def build_store():
+    store, clock = Store(), FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    store.create(DeviceClass(metadata=ObjectMeta(name="gpu-class"), selectors=[{"attribute": "model", "operator": "Exists"}]))
+    return store, clock, cluster
+
+
+def scheduler_for(store, cluster, clock, types):
+    np = make_nodepool(requirements=LINUX_AMD64)
+    store.create(np)
+    return Scheduler(store, cluster, [np], {"default-pool": types}, cluster.nodes(), [], clock, dra_enabled=True)
+
+
+class TestRequirementsFromPicks:
+    def test_device_requirements_intersect(self):
+        from karpenter_tpu.scheduling.dynamicresources.allocator import _DeviceRef
+
+        d1 = zoned_gpu("g1", ["test-zone-a", "test-zone-b"])
+        d2 = zoned_gpu("g2", ["test-zone-b", "test-zone-c"])
+        picks = [
+            ("gpus", _DeviceRef(device=d1, driver="t", pool="p", device_id=("template", "it", "p", "g1")), None),
+            ("gpus", _DeviceRef(device=d2, driver="t", pool="p", device_id=("template", "it", "p", "g2")), None),
+        ]
+        reqs = requirements_from_picks(picks)
+        zr = reqs.get(wk.ZONE_LABEL_KEY)
+        assert set(zr.values) == {"test-zone-b"}, "both devices land on ONE node: zones intersect"
+
+    def test_unconstrained_devices_contribute_nothing(self):
+        from karpenter_tpu.scheduling.dynamicresources.allocator import _DeviceRef
+
+        d = Device(name="g", attributes={"gpu.example.com/model": "a100"}, capacity={})
+        picks = [("gpus", _DeviceRef(device=d, driver="t", pool="p", device_id=("template", "it", "p", "g")), None)]
+        assert len(requirements_from_picks(picks).values()) == 0
+
+
+class TestSuperposition:
+    def _alloc(self, store, clock):
+        return Allocator(store, clock)
+
+    def test_contributions_recorded_per_instance_type(self):
+        store, clock, cluster = build_store()
+        alloc = self._alloc(store, clock)
+        rc = gpu_claim("c1")
+        store.create(rc)
+        per_it = {}
+        for it in (gpu_it("it-a", [zoned_gpu("g", ["test-zone-a"])]),
+                   gpu_it("it-b", [zoned_gpu("g", ["test-zone-a", "test-zone-b"])])):
+            tracker = AllocationTracker(budgets=alloc.counter_budgets)
+            result, err = alloc.allocate("nc-1", alloc.template_devices(it), [rc], tracker)
+            assert err is None
+            per_it[it.name] = (tracker, result)
+        kept, metas = alloc.superpose_template_allocation("nc-1", per_it)
+        assert set(kept) == {"it-a", "it-b"}
+        meta = metas[rc.key()]
+        assert meta.used_template_devices and meta.node_claim_id == "nc-1"
+        assert set(meta.contributed) == {"it-a", "it-b"}
+        # pessimistic intersection: zone-a only (allocator.go's zone example)
+        assert set(meta.total.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-a"}
+
+    def test_intersection_emptying_type_is_pruned(self):
+        # allocator.go:118-124: it-a contributes zone IN a; it-b would
+        # contribute zone IN b -> empty intersection -> it-b pruned
+        store, clock, cluster = build_store()
+        alloc = self._alloc(store, clock)
+        rc = gpu_claim("c1")
+        store.create(rc)
+        per_it = {}
+        for it in (gpu_it("it-a", [zoned_gpu("g", ["test-zone-a"])]),
+                   gpu_it("it-b", [zoned_gpu("g", ["test-zone-b"])])):
+            tracker = AllocationTracker(budgets=alloc.counter_budgets)
+            result, err = alloc.allocate("nc-1", alloc.template_devices(it), [rc], tracker)
+            assert err is None
+            per_it[it.name] = (tracker, result)
+        kept, metas = alloc.superpose_template_allocation("nc-1", per_it)
+        assert set(kept) == {"it-a"}, "evaluation order wins; the emptier prunes"
+        assert set(metas[rc.key()].contributed) == {"it-a"}
+
+    def test_pruning_is_order_dependent_like_reference(self):
+        store, clock, cluster = build_store()
+        alloc = self._alloc(store, clock)
+        rc = gpu_claim("c1")
+        store.create(rc)
+        per_it = {}
+        for it in (gpu_it("it-b", [zoned_gpu("g", ["test-zone-b"])]),
+                   gpu_it("it-a", [zoned_gpu("g", ["test-zone-a"])])):
+            tracker = AllocationTracker(budgets=alloc.counter_budgets)
+            result, err = alloc.allocate("nc-1", alloc.template_devices(it), [rc], tracker)
+            assert err is None
+            per_it[it.name] = (tracker, result)
+        kept, _ = alloc.superpose_template_allocation("nc-1", per_it)
+        assert set(kept) == {"it-b"}, "first-evaluated type anchors the intersection"
+
+    def test_multiple_claims_must_all_stay_satisfiable(self):
+        # a type is pruned when ANY claim's intersection would empty
+        store, clock, cluster = build_store()
+        alloc = self._alloc(store, clock)
+        rc1, rc2 = gpu_claim("c1", model="a100"), gpu_claim("c2", model="h100")
+        store.create(rc1)
+        store.create(rc2)
+        it_a = gpu_it("it-a", [zoned_gpu("g1", ["test-zone-a"], model="a100"), zoned_gpu("g2", ["test-zone-a"], model="h100")])
+        it_b = gpu_it("it-b", [zoned_gpu("g1", ["test-zone-a"], model="a100"), zoned_gpu("g2", ["test-zone-b"], model="h100")])
+        per_it = {}
+        for it in (it_a, it_b):
+            tracker = AllocationTracker(budgets=alloc.counter_budgets)
+            result, err = alloc.allocate("nc-1", alloc.template_devices(it), [rc1, rc2], tracker)
+            assert err is None
+            per_it[it.name] = (tracker, result)
+        kept, metas = alloc.superpose_template_allocation("nc-1", per_it)
+        # it-b's h100 sits in zone-b: rc2's intersection with it-a's zone-a empties
+        assert set(kept) == {"it-a"}
+        assert set(metas[rc2.key()].total.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-a"}
+
+    def test_release_instance_types_relaxes_total(self):
+        # allocator.go: totalRequirements updates when types are released
+        store, clock, cluster = build_store()
+        alloc = self._alloc(store, clock)
+        rc = gpu_claim("c1")
+        store.create(rc)
+        per_it = {}
+        for it in (gpu_it("it-a", [zoned_gpu("g", ["test-zone-a"])]),
+                   gpu_it("it-ab", [zoned_gpu("g", ["test-zone-a", "test-zone-b"])])):
+            tracker = AllocationTracker(budgets=alloc.counter_budgets)
+            result, err = alloc.allocate("nc-1", alloc.template_devices(it), [rc], tracker)
+            assert err is None
+            per_it[it.name] = (tracker, result)
+        kept, metas = alloc.superpose_template_allocation("nc-1", per_it)
+        alloc.commit_template_metadata(metas)
+        meta = alloc.resource_claim_allocation_metadata()[rc.key()]
+        assert set(meta.total.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-a"}
+        alloc.release_instance_types(rc.key(), ["it-a"])
+        assert set(meta.total.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-a", "test-zone-b"}
+        assert "it-a" not in meta.contributed
+
+    def test_scheduler_prunes_superposed_types_end_to_end(self):
+        # through the real Scheduler: both GPU types fit the claim, but their
+        # zone contributions conflict -> the claim's NodeClaim keeps only the
+        # first and the claim metadata records the pinned zone
+        store, clock, cluster = build_store()
+        rc = gpu_claim("c1")
+        store.create(rc)
+        types = [
+            gpu_it("gpu-a", [zoned_gpu("g", ["test-zone-a"])], price=10.0),
+            gpu_it("gpu-b", [zoned_gpu("g", ["test-zone-b"])], price=20.0),
+        ]
+        s = scheduler_for(store, cluster, clock, types)
+        results = s.solve([claim_pod("p1", "c1", cpu="1")])
+        assert results.all_pods_scheduled()
+        its = {it.name for it in results.new_node_claims[0].instance_type_options}
+        assert len(its) == 1, f"conflicting contributions must prune to one type, got {its}"
+        metas = s.allocator.resource_claim_allocation_metadata()
+        meta = metas[rc.key()]
+        zone_vals = set(meta.total.get(wk.ZONE_LABEL_KEY).values)
+        assert len(zone_vals) == 1
+
+    def test_compatible_contributions_keep_both_types(self):
+        store, clock, cluster = build_store()
+        rc = gpu_claim("c1")
+        store.create(rc)
+        types = [
+            gpu_it("gpu-a", [zoned_gpu("g", ["test-zone-a", "test-zone-b"])]),
+            gpu_it("gpu-b", [zoned_gpu("g", ["test-zone-b", "test-zone-c"])]),
+        ]
+        s = scheduler_for(store, cluster, clock, types)
+        results = s.solve([claim_pod("p1", "c1", cpu="1")])
+        assert results.all_pods_scheduled()
+        its = {it.name for it in results.new_node_claims[0].instance_type_options}
+        assert its == {"gpu-a", "gpu-b"}
+        meta = s.allocator.resource_claim_allocation_metadata()[rc.key()]
+        assert set(meta.total.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-b"}
+
+    def test_no_metadata_for_in_cluster_allocations(self):
+        # claims allocated from a node's published slices are not template
+        # allocations: no superposition metadata (allocator.go:80-82)
+        store, clock, cluster = build_store()
+        store.create(ResourceSlice(
+            metadata=ObjectMeta(name="sl"), node_name="n1", driver="gpu", pool_name="pool",
+            devices=[zoned_gpu("g0", ["test-zone-a"])],
+        ))
+        alloc = Allocator(store, clock)
+        rc = gpu_claim("c1")
+        store.create(rc)
+        result, err = alloc.allocate_for_node("n1", [rc])
+        assert err is None
+        alloc.commit_for_node("n1", result)
+        assert rc.key() not in alloc.resource_claim_allocation_metadata()
+
+
+class TestAllocatorDepth:
+    def test_match_attribute_constraint_spans_requests(self):
+        # constraint.go: all devices for the constrained requests share the
+        # attribute value — a mixed-model candidate set must pick same-model
+        store, clock, cluster = build_store()
+        devices = [
+            zoned_gpu("a0", ["test-zone-a"], model="a100"),
+            zoned_gpu("h0", ["test-zone-a"], model="h100"),
+            zoned_gpu("h1", ["test-zone-a"], model="h100"),
+        ]
+        it = gpu_it("it", devices)
+        alloc = Allocator(store, clock)
+        rc = gpu_claim("c1", count=2, constraints=[{"matchAttribute": "gpu.example.com/model"}])
+        store.create(rc)
+        tracker = AllocationTracker(budgets=alloc.counter_budgets)
+        result, err = alloc.allocate("nc", alloc.template_devices(it), [rc], tracker)
+        assert err is None
+        picked = {ref.device.name for _, ref, _ in result.picks[rc.key()]}
+        assert picked == {"h0", "h1"}, "matchAttribute forces the same-model pair"
+
+    def test_dfs_rollback_releases_taken_devices(self):
+        # allocationtracker.go rollback: a failing second request must release
+        # the first request's tentatively-taken device
+        store, clock, cluster = build_store()
+        it = gpu_it("it", [zoned_gpu("g0", ["test-zone-a"], model="a100")])
+        alloc = Allocator(store, clock)
+        good = gpu_claim("good", model="a100")
+        impossible = gpu_claim("impossible", model="h100")
+        store.create(good)
+        store.create(impossible)
+        tracker = AllocationTracker(budgets=alloc.counter_budgets)
+        result, err = alloc.allocate("nc", alloc.template_devices(it), [good, impossible], tracker)
+        assert err is not None
+        # the tracker must be clean: the same device allocates for a retry
+        tracker2 = AllocationTracker(budgets=alloc.counter_budgets)
+        result2, err2 = alloc.allocate("nc", alloc.template_devices(it), [good], tracker2)
+        assert err2 is None and len(result2.picks[good.key()]) == 1
+
+    def test_two_claims_cannot_share_exclusive_device(self):
+        store, clock, cluster = build_store()
+        it = gpu_it("it", [zoned_gpu("g0", ["test-zone-a"])])
+        alloc = Allocator(store, clock)
+        c1, c2 = gpu_claim("c1"), gpu_claim("c2")
+        store.create(c1)
+        store.create(c2)
+        tracker = AllocationTracker(budgets=alloc.counter_budgets)
+        _, err = alloc.allocate("nc", alloc.template_devices(it), [c1, c2], tracker)
+        assert err is not None and "c2" in err
+
+    def test_partitionable_exhaustion_rolls_back_cleanly(self):
+        # partitionable_devices.go: two 30-unit partitions exceed the 40-unit
+        # shared counter; after failure the budget must be fully restored
+        from karpenter_tpu.utils.quantity import Quantity
+
+        store, clock, cluster = build_store()
+        mig = lambda n: Device(
+            name=n,
+            attributes={"gpu.example.com/model": "mig"},
+            capacity={},
+            consumes_counters=[{"counterSet": "gpu0", "counters": {"mem": "30"}}],
+        )
+        it = gpu_it("it", [mig("p0"), mig("p1")])
+        it.dynamic_resources_counters = [{"name": "gpu0", "counters": {"mem": "40"}}]
+        alloc = Allocator(store, clock)
+        c1, c2 = gpu_claim("c1"), gpu_claim("c2")
+        store.create(c1)
+        store.create(c2)
+        tracker = AllocationTracker(budgets=alloc.counter_budgets)
+        _, err = alloc.allocate("nc", alloc.template_devices(it), [c1, c2], tracker)
+        assert err is not None
+        tracker2 = AllocationTracker(budgets=alloc.counter_budgets)
+        result, err2 = alloc.allocate("nc", alloc.template_devices(it), [c1], tracker2)
+        assert err2 is None
+        # allocate() is pure; the draw-down lands at commit: exactly one
+        # 30-unit draw against the fresh budget
+        alloc.commit("nc", result, tracker2)
+        pool_key = ("template", "it", "pool")
+        rem = tracker2.remaining_counters[pool_key]["gpu0"]["mem"]
+        assert rem == Quantity.parse("10")
+
+    def test_allocation_timeout_aborts_dfs(self):
+        # allocator.go:41-43: the DFS gives up at the 5s budget on the
+        # injected clock
+        store, clock, cluster = build_store()
+
+        class SteppingClock(FakeClock):
+            def now(self):
+                t = super().now()
+                self.step(3.0)  # every deadline check costs 3 virtual seconds
+                return t
+
+        stepping = SteppingClock()
+        it = gpu_it("it", [zoned_gpu(f"g{i}", ["test-zone-a"]) for i in range(4)])
+        alloc = Allocator(store, stepping)
+        rc = gpu_claim("c1", count=2)
+        store.create(rc)
+        tracker = AllocationTracker(budgets=alloc.counter_budgets)
+        _, err = alloc.allocate("nc", alloc.template_devices(it), [rc], tracker)
+        assert err is not None, "virtual-time deadline must abort the DFS"
