@@ -31,6 +31,14 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
 
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    # honor a CPU request at the config level BEFORE backend init: the
+    # image's sitecustomize force-registers the TPU platform, and when its
+    # tunnel is down that registration hangs
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 
 def build_snapshot(n_pods: int, n_types: int, n_variants: int = 400, affinity_frac: float = 0.0, fallback_frac: float = 0.0):
     from helpers import hostname_anti_affinity, make_nodepool, make_pod, zone_spread

@@ -434,7 +434,6 @@ def _greedy_pack_impl(t: SchedulerTensors, dom_keys: tuple, n_slots: int):
     Nrows = t.row_alloc.shape[0]
     G, D = t.counts_dom_init.shape
 
-    slot_basis0 = jnp.full((N,), -1, dtype=jnp.int32)
     slot_rem0 = jnp.full((N, R), NEG)
     slot_domset0 = jnp.zeros((N, D), dtype=bool)
     slot_rank0 = jnp.full((N,), -1, dtype=jnp.int32)

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .scheduler_model import (
+    EXIST_BUCKET,
     GROUP_BUCKET,
     KEYS_BUCKET,
     KIND_DOM_AFF,
@@ -170,8 +171,6 @@ def pad_item_arrays(arrays: dict, item_bucket: int) -> dict:
     a["item_port_any"] = _pad_axis(a["item_port_any"], 1, bucket(a["item_port_any"].shape[1], PORT_BUCKET), fill=False)
     a["item_port_wild"] = _pad_axis(a["item_port_wild"], 1, bucket(a["item_port_wild"].shape[1], PORT_BUCKET), fill=False)
     a["item_port_spec"] = _pad_axis(a["item_port_spec"], 1, bucket(a["item_port_spec"].shape[1], PORT_BUCKET), fill=False)
-    from .scheduler_model import EXIST_BUCKET
-
     a["item_host_blocked"] = _pad_axis(a["item_host_blocked"], 1, bucket(a["item_host_blocked"].shape[1], EXIST_BUCKET), fill=False)
     W_p = bucket(a["item_count"].shape[0], item_bucket)
     for k in a:

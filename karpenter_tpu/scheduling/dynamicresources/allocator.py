@@ -149,6 +149,8 @@ class AllocationResult:
 
     # claim key -> [(request name, _DeviceRef, consumed capacity | None)]
     picks: dict = field(default_factory=dict)
+    # the claims this allocation served (for superposition re-allocation)
+    claims: list = field(default_factory=list)
 
 
 @dataclass
@@ -176,15 +178,25 @@ class ClaimAllocationMetadata:
         return total
 
 
+_SUPPORTED_DEVICE_REQ_OPS = {"In", "NotIn", "Gt", "Lt", "Exists"}
+
+
 def requirements_from_picks(picks) -> "Requirements":
     """The node requirements a device selection pins: every chosen device's
-    `requirements` land on ONE node, so they intersect (Requirements.add)."""
+    `requirements` land on ONE node, so they intersect (Requirements.add).
+    Only value/bound operators are supported — an absence operator
+    (DoesNotExist) on a device requirement is ignored at ingestion, because
+    a collapsed intersection also renders as DoesNotExist and the two would
+    be indistinguishable to the pruning check."""
     from ...scheduling.requirements import Requirement, Requirements
 
     out = Requirements()
     for _name, ref, _cap in picks:
         for r in getattr(ref.device, "requirements", None) or []:
-            out.add(Requirement(r["key"], r.get("operator", "In"), r.get("values", [])))
+            op = r.get("operator", "In")
+            if op not in _SUPPORTED_DEVICE_REQ_OPS:
+                continue
+            out.add(Requirement(r["key"], op, r.get("values", [])))
     return out
 
 
@@ -420,6 +432,8 @@ class Allocator:
         # claim key -> ClaimAllocationMetadata for template-device allocations
         # (allocator.go:84-86 ResourceClaimAllocationMetadata accessor)
         self.claim_allocation_metadata: dict[str, ClaimAllocationMetadata] = {}
+        # instance types seen via template_devices, for superposition retries
+        self._template_it_by_name: dict[str, object] = {}
 
     def superpose_template_allocation(self, node_claim_id: str, per_it: dict) -> tuple[dict, dict]:
         """Per-instance-type requirement superposition (allocator.go:90-134).
@@ -435,36 +449,76 @@ class Allocator:
 
         Returns (surviving per_it entries, metadata by claim key). Commit the
         metadata via commit_template_metadata once the NodeClaim is kept."""
-        metas: dict[str, ClaimAllocationMetadata] = {}
-        kept: dict = {}
-        for it_name, entry in per_it.items():
-            _tracker, result = entry
-            trial: dict[str, object] = {}
-            ok = True
-            for claim_key, picks in result.picks.items():
-                from ...scheduling.requirements import Requirements
+        from ...scheduling.requirements import Requirements
 
+        metas: dict[str, ClaimAllocationMetadata] = {}
+        running: dict[str, Requirements] = {}  # claim key -> intersection so far
+        kept: dict = {}
+
+        def trial_of(entry):
+            """(trial contributions by claim, ok) against the running totals —
+            O(claims) per instance type, not O(kept ITs x claims)."""
+            _tracker, result = entry
+            trial: dict[str, Requirements] = {}
+            for claim_key, picks in result.picks.items():
                 reqs = requirements_from_picks(picks)
+                total = running.get(claim_key)
+                total = total.copy() if total is not None else Requirements()
+                total.add(*reqs.values())
+                if not _requirements_satisfiable(total):
+                    return None
+                trial[claim_key] = reqs
+            return trial
+
+        for it_name, entry in per_it.items():
+            trial = trial_of(entry)
+            if trial is None and entry[1].picks:
+                # the DFS picked devices blind to superposition; retry the
+                # allocation excluding devices whose own requirements already
+                # conflict with the running intersections, so an alternative
+                # same-type device combination can keep the type alive
+                entry = self._reallocate_compatible(node_claim_id, it_name, entry, running)
+                trial = trial_of(entry) if entry is not None else None
+            if trial is None or entry is None:
+                continue
+            kept[it_name] = entry
+            _tracker, result = entry
+            for claim_key, reqs in trial.items():
                 meta = metas.setdefault(
                     claim_key, ClaimAllocationMetadata(node_claim_id=node_claim_id, used_template_devices=True)
                 )
-                total = Requirements()
-                for prev in meta.contributed.values():
-                    total.add(*prev.values())
+                meta.contributed[it_name] = reqs
+                meta.devices[it_name] = result.picks[claim_key]
+                total = running.setdefault(claim_key, Requirements())
                 total.add(*reqs.values())
-                if not _requirements_satisfiable(total):
-                    ok = False
-                    break
-                trial[claim_key] = reqs
-            if not ok:
-                continue
-            kept[it_name] = entry
-            for claim_key, reqs in trial.items():
-                metas[claim_key].contributed[it_name] = reqs
-                metas[claim_key].devices[it_name] = result.picks[claim_key]
         for meta in metas.values():
             meta.recompute_total()
         return kept, metas
+
+    def _reallocate_compatible(self, node_claim_id: str, it_name: str, entry, running: dict):
+        """Retry one instance type's template allocation with devices that
+        conflict with the running intersections filtered out; returns a fresh
+        (tracker, result) entry or None."""
+
+        def compatible(dev) -> bool:
+            for claim_key, total in running.items():
+                trial = total.copy()
+                trial.add(*requirements_from_picks([("", _DeviceRef(device=dev, driver="", pool="", device_id=()), None)]).values())
+                if not _requirements_satisfiable(trial):
+                    return False
+            return True
+
+        _old_tracker, old_result = entry
+        claims = list(old_result.claims)
+        if not claims:
+            return None
+        tracker = AllocationTracker(budgets=self.counter_budgets)
+        it = self._template_it_by_name.get(it_name)
+        if it is None:
+            return None
+        devices = [d for d in self.template_devices(it) if compatible(d.device)]
+        result, err = self.allocate(node_claim_id, devices, claims, tracker)
+        return (tracker, result) if err is None else None
 
     def commit_template_metadata(self, metas: dict) -> None:
         self.claim_allocation_metadata.update(metas)
@@ -495,7 +549,7 @@ class Allocator:
         """Try to satisfy every unallocated claim from `devices` given the
         tracker state. Returns (AllocationResult, None) or (None, err). Pure:
         the tracker is copied, not mutated; commit applies the picks."""
-        result = AllocationResult()
+        result = AllocationResult(claims=list(claims))
         work = tracker.copy()
         deadline = self._now() + ALLOCATE_TIMEOUT_SECONDS
         for rc in claims:
@@ -640,6 +694,7 @@ class Allocator:
         shared-counter budget; each candidate's tracker lazily materializes
         its OWN remaining copy, so every launched node gets a fresh budget
         (partitionable_devices.go template counters)."""
+        self._template_it_by_name[instance_type.name] = instance_type
         out = []
         for d in getattr(instance_type, "dynamic_resources", None) or []:
             out.append(

@@ -190,6 +190,29 @@ class TestSuperposition:
         assert set(kept) == {"it-a"}
         assert set(metas[rc2.key()].total.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-a"}
 
+    def test_collapse_retries_alternative_device_combination(self):
+        # the DFS picks devices blind to superposition; when its pick would
+        # collapse a claim's intersection, the allocator retries with
+        # conflicting devices filtered so an alternative same-type device
+        # keeps the instance type alive
+        store, clock, cluster = build_store()
+        alloc = self._alloc(store, clock)
+        rc = gpu_claim("c1")
+        store.create(rc)
+        it_a = gpu_it("it-a", [zoned_gpu("g", ["test-zone-b"])])
+        # it-flex ships one zone-a device and one zone-b device; a zone-a
+        # pick would collapse vs it-a's zone-b contribution
+        it_flex = gpu_it("it-flex", [zoned_gpu("ga", ["test-zone-a"]), zoned_gpu("gb", ["test-zone-b"])])
+        per_it = {}
+        for it in (it_a, it_flex):
+            tracker = AllocationTracker(budgets=alloc.counter_budgets)
+            result, err = alloc.allocate("nc-1", alloc.template_devices(it), [rc], tracker)
+            assert err is None
+            per_it[it.name] = (tracker, result)
+        kept, metas = alloc.superpose_template_allocation("nc-1", per_it)
+        assert set(kept) == {"it-a", "it-flex"}, "the zone-b alternative must keep it-flex alive"
+        assert set(metas[rc.key()].total.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-b"}
+
     def test_release_instance_types_relaxes_total(self):
         # allocator.go: totalRequirements updates when types are released
         store, clock, cluster = build_store()
