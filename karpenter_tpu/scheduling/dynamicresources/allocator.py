@@ -456,8 +456,9 @@ class Allocator:
         kept: dict = {}
 
         def trial_of(entry):
-            """(trial contributions by claim, ok) against the running totals —
-            O(claims) per instance type, not O(kept ITs x claims)."""
+            """Trial contributions by claim key against the running totals
+            (None when any claim's intersection would collapse) — O(claims)
+            per instance type, not O(kept ITs x claims)."""
             _tracker, result = entry
             trial: dict[str, Requirements] = {}
             for claim_key, picks in result.picks.items():
@@ -497,8 +498,13 @@ class Allocator:
 
     def _reallocate_compatible(self, node_claim_id: str, it_name: str, entry, running: dict):
         """Retry one instance type's template allocation with devices that
-        conflict with the running intersections filtered out; returns a fresh
-        (tracker, result) entry or None."""
+        conflict with the running intersections filtered out, against the
+        SAME baseline tracker (which carries earlier pods' consumption on
+        this in-flight NodeClaim). One-shot repair: devices are filtered
+        individually, so a mutually-conflicting combination among surviving
+        devices can still collapse the trial and prune the type — the full
+        fix would be a superposition-aware DFS. Returns a (tracker, result)
+        entry or None."""
 
         def compatible(dev) -> bool:
             for claim_key, total in running.items():
@@ -508,17 +514,19 @@ class Allocator:
                     return False
             return True
 
-        _old_tracker, old_result = entry
+        old_tracker, old_result = entry
         claims = list(old_result.claims)
         if not claims:
             return None
-        tracker = AllocationTracker(budgets=self.counter_budgets)
         it = self._template_it_by_name.get(it_name)
         if it is None:
             return None
         devices = [d for d in self.template_devices(it) if compatible(d.device)]
-        result, err = self.allocate(node_claim_id, devices, claims, tracker)
-        return (tracker, result) if err is None else None
+        # allocate() is pure w.r.t. the tracker, so reusing the entry's
+        # baseline preserves earlier pods' device/counter consumption on this
+        # NodeClaim (commit later applies the new picks against it)
+        result, err = self.allocate(node_claim_id, devices, claims, old_tracker)
+        return (old_tracker, result) if err is None else None
 
     def commit_template_metadata(self, metas: dict) -> None:
         self.claim_allocation_metadata.update(metas)

@@ -512,3 +512,172 @@ class TestTemplatePartitionableDevices:
         assert results.all_pods_scheduled()
         assert len(results.new_node_claims) == 2
         assert all(len(nc.pods) == 2 for nc in results.new_node_claims)
+
+
+class TestAllocatorDepth2:
+    """Further allocator_test.go-family depth: All allocation mode,
+    request-scoped constraints, multi-request claims, shared-claim
+    co-location, and the orphan-release / reservedFor writeback paths."""
+
+    def _with_node_slice(self, devices):
+        store, clock, cluster = build_store()
+        store.create(ResourceSlice(metadata=ObjectMeta(name="n1-gpus"), driver="gpu", pool_name="n1", node_name="n1", devices=devices))
+        return store, clock, cluster
+
+    def test_all_mode_takes_every_matching_device(self):
+        store, clock, _ = self._with_node_slice([gpu("g0"), gpu("g1"), gpu("g2", model="h100")])
+        a = Allocator(store, clock)
+        rc = ResourceClaim(
+            metadata=ObjectMeta(name="everything"),
+            requests=[{
+                "name": "gpus", "deviceClassName": "gpu-class", "allocationMode": "All",
+                "selectors": [{"attribute": "model", "operator": "In", "values": ["a100"]}],
+            }],
+        )
+        store.create(rc)
+        result, err = a.allocate_for_node("n1", [rc])
+        assert err is None
+        picked = {ref.device.name for _, ref, _ in result.picks["default/everything"]}
+        assert picked == {"g0", "g1"}  # every a100, not the h100
+
+    def test_all_mode_fails_when_any_candidate_taken(self):
+        # All-or-nothing: a single already-taken candidate fails the request
+        store, clock, _ = self._with_node_slice([gpu("g0"), gpu("g1")])
+        a = Allocator(store, clock)
+        r1, err = a.allocate_for_node("n1", [gpu_claim("one")])
+        assert err is None
+        a.commit_for_node("n1", r1)
+        rc = ResourceClaim(
+            metadata=ObjectMeta(name="all"),
+            requests=[{"name": "gpus", "deviceClassName": "gpu-class", "allocationMode": "All"}],
+        )
+        store.create(rc)
+        _, err2 = a.allocate_for_node("n1", [rc])
+        assert err2 is not None
+
+    def test_match_attribute_scoped_to_named_requests(self):
+        # constraint.go: a constraint listing `requests` binds only those
+        # requests — the unscoped request may pick any model
+        store, clock, _ = self._with_node_slice(
+            [gpu("g0", model="a100"), gpu("g1", model="h100"), gpu("g2", model="h100")]
+        )
+        a = Allocator(store, clock)
+        rc = ResourceClaim(
+            metadata=ObjectMeta(name="mixed"),
+            requests=[
+                {"name": "pair", "deviceClassName": "gpu-class", "count": 2},
+                {"name": "solo", "deviceClassName": "gpu-class", "count": 1},
+            ],
+            constraints=[{"matchAttribute": "gpu.example.com/model", "requests": ["pair"]}],
+        )
+        store.create(rc)
+        result, err = a.allocate_for_node("n1", [rc])
+        assert err is None
+        by_req = {}
+        for name, ref, _ in result.picks["default/mixed"]:
+            by_req.setdefault(name, set()).add(ref.device.attributes["gpu.example.com/model"])
+        assert len(by_req["pair"]) == 1, "scoped requests share one model"
+        assert len(result.picks["default/mixed"]) == 3
+
+    def test_multi_request_claim_allocates_both(self):
+        store, clock, _ = self._with_node_slice([gpu("g0"), gpu("g1"), gpu("g2")])
+        a = Allocator(store, clock)
+        rc = ResourceClaim(
+            metadata=ObjectMeta(name="two-reqs"),
+            requests=[
+                {"name": "first", "deviceClassName": "gpu-class", "count": 2},
+                {"name": "second", "deviceClassName": "gpu-class", "count": 1},
+            ],
+        )
+        store.create(rc)
+        result, err = a.allocate_for_node("n1", [rc])
+        assert err is None
+        names = [n for n, _, _ in result.picks["default/two-reqs"]]
+        assert sorted(names) == ["first", "first", "second"]
+
+    def test_count_exceeding_pool_fails_whole_claim(self):
+        store, clock, _ = self._with_node_slice([gpu("g0"), gpu("g1")])
+        a = Allocator(store, clock)
+        _, err = a.allocate_for_node("n1", [gpu_claim("three", count=3)])
+        assert err is not None
+
+    def test_unknown_device_class_ineligible(self):
+        store, clock, _ = self._with_node_slice([gpu("g0")])
+        a = Allocator(store, clock)
+        rc = ResourceClaim(
+            metadata=ObjectMeta(name="wrong-class"),
+            requests=[{"name": "gpus", "deviceClassName": "fpga-class", "count": 1}],
+        )
+        store.create(rc)
+        _, err = a.allocate_for_node("n1", [rc])
+        assert err is not None
+
+    def test_shared_claim_second_pod_same_target_ok(self):
+        # two pods sharing one claim co-locate: the second allocate on the
+        # SAME target passes without re-allocating devices
+        store, clock, _ = self._with_node_slice([gpu("g0")])
+        a = Allocator(store, clock)
+        shared = gpu_claim("shared")
+        store.create(shared)
+        r1, err = a.allocate_for_node("n1", [shared])
+        assert err is None
+        a.commit_for_node("n1", r1)
+        r2, err2 = a.allocate_for_node("n1", [shared])
+        assert err2 is None
+        assert r2.picks.get("default/shared") is None  # no double allocation
+
+    def test_capacity_selector_lte(self):
+        small = gpu("small", memory="16Gi")
+        big = gpu("big", memory="80Gi")
+        store, clock, _ = self._with_node_slice([small, big])
+        a = Allocator(store, clock)
+        rc = ResourceClaim(
+            metadata=ObjectMeta(name="small-only"),
+            requests=[{
+                "name": "gpus", "deviceClassName": "gpu-class", "count": 1,
+                "selectors": [{"capacity": "memory", "operator": "Lte", "value": "32Gi"}],
+            }],
+        )
+        store.create(rc)
+        result, err = a.allocate_for_node("n1", [rc])
+        assert err is None
+        assert result.picks["default/small-only"][0][1].device.name == "small"
+
+
+class TestDeviceAllocationControllerDepth:
+    def _env(self):
+        env = Environment(options=Options(feature_gates=FeatureGates(dynamic_resources=True)))
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        env.store.create(DeviceClass(metadata=ObjectMeta(name="gpu-class"), selectors=[]))
+        env.store.create(DRAConfig(metadata=ObjectMeta(name="fake-gpus"), driver="gpu", devices=[gpu("g0"), gpu("g1")]))
+        for it in env.base_cloud_provider.instance_types:
+            it.dynamic_resources = [gpu("g0"), gpu("g1")]
+        return env
+
+    def test_reserved_for_tracks_sharing_pods(self):
+        # deviceallocation controller: every bound pod referencing the claim
+        # lands in status.reservedFor (controller.go reservedFor semantics)
+        env = self._env()
+        env.store.create(gpu_claim("shared"))
+        p1, p2 = claim_pod("p1", "shared", cpu="100m"), claim_pod("p2", "shared", cpu="100m")
+        env.store.create(p1)
+        env.store.create(p2)
+        env.settle(rounds=8)
+        rc = env.store.get("ResourceClaim", "shared")
+        pods = [env.store.get("Pod", n) for n in ("p1", "p2")]
+        assert all(p.spec.node_name for p in pods)
+        assert rc.status.allocation
+        assert {p.metadata.uid for p in pods} <= set(rc.status.reserved_for)
+
+    def test_orphaned_claim_released_when_pods_gone(self):
+        env = self._env()
+        env.store.create(gpu_claim("orphan"))
+        p = claim_pod("p1", "orphan", cpu="100m")
+        env.store.create(p)
+        env.settle(rounds=8)
+        rc = env.store.get("ResourceClaim", "orphan")
+        assert rc.status.allocation
+        env.store.delete("Pod", "p1")
+        env.settle(rounds=8)
+        rc = env.store.get("ResourceClaim", "orphan")
+        assert not rc.status.allocation, "released allocation frees the devices"
