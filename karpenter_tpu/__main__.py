@@ -25,19 +25,25 @@ from .utils.clock import Clock
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="karpenter-tpu")
     parser.add_argument("--solver", choices=("ffd", "tpu"), default=None, help="solver backend (SOLVER_BACKEND)")
-    parser.add_argument("--port", type=int, default=8080, help="health + metrics port (0 = ephemeral)")
+    parser.add_argument("--port", type=int, default=None, help="health + metrics port (0 = ephemeral; default --metrics-port)")
     parser.add_argument("--bind", default="0.0.0.0", help="health + metrics bind address")
     parser.add_argument("--tick-seconds", type=float, default=1.0, help="controller round interval")
-    parser.add_argument("--disable-leader-election", action="store_true")
-    parser.add_argument("--enable-profiling", action="store_true", help="expose /debug/profile")
-    args = parser.parse_args(argv)
+    # every reference flag (options.go AddFlags: --metrics-port,
+    # --kube-client-qps, --log-level, --disable-leader-election,
+    # --enable-profiling, --feature-gates, ...) parses via Options.from_args
+    args, rest = parser.parse_known_args(argv)
 
-    options = Options.from_env()
+    options = Options.from_args(rest)
     if args.solver:
         options.solver_backend = args.solver
+    port = args.port if args.port is not None else options.metrics_port
+
+    import logging
+
+    logging.basicConfig(level={"debug": logging.DEBUG, "info": logging.INFO, "error": logging.ERROR}[options.log_level])
 
     env = Environment(options=options, clock=Clock())
-    server = OperatorServer(env, port=args.port, enable_profiling=args.enable_profiling, bind=args.bind)
+    server = OperatorServer(env, port=port, enable_profiling=options.enable_profiling, bind=args.bind)
     port = server.start()
     print(f"karpenter-tpu operator up: solver={options.solver_backend} http={args.bind}:{port}", flush=True)
 
@@ -48,7 +54,11 @@ def main(argv=None) -> int:
         except ValueError:
             pass  # not the main thread
     try:
-        env.run(stop_event=stop, tick_seconds=args.tick_seconds, leader_election=not args.disable_leader_election)
+        env.run(
+            stop_event=stop,
+            tick_seconds=args.tick_seconds,
+            leader_election=not options.disable_leader_election,
+        )
     finally:
         server.stop()
     return 0

@@ -158,3 +158,120 @@ class TestRunLoop:
             stop.set()
             t.join(timeout=10)
         assert env.store.count("NodeClaim") == 0  # standby stayed passive
+
+
+class TestOperationalOptions:
+    """The reference's full operational flag surface (options.go:68-135)."""
+
+    def test_defaults_match_reference(self):
+        from karpenter_tpu.operator.options import Options
+
+        o = Options()
+        assert (o.metrics_port, o.health_probe_port) == (8080, 8081)
+        assert (o.kube_client_qps, o.kube_client_burst) == (200, 300)
+        assert o.disable_controller_warmup is True  # options.go default
+        assert o.disable_leader_election is False
+        assert o.leader_election_name == "karpenter-leader-election"
+        assert (o.log_level, o.log_output_paths, o.log_error_output_paths) == ("info", "stdout", "stderr")
+        assert o.cpu_requests == 1000 and o.memory_limit == -1
+        assert o.ignore_dra_requests is True
+
+    def test_from_args_reference_flag_names(self):
+        from karpenter_tpu.operator.options import Options
+
+        o = Options.from_args([
+            "--metrics-port", "9090",
+            "--health-probe-port=9091",
+            "--kube-client-qps", "50",
+            "--enable-profiling", "true",
+            "--disable-leader-election=true",
+            "--log-level", "debug",
+            "--batch-max-duration", "30s",
+            "--batch-idle-duration", "2",
+            "--preference-policy", "Ignore",
+            "--feature-gates", "NodeRepair=true,SpotToSpotConsolidation=true",
+        ])
+        assert o.metrics_port == 9090 and o.health_probe_port == 9091
+        assert o.kube_client_qps == 50
+        assert o.enable_profiling and o.disable_leader_election
+        assert o.log_level == "debug"
+        assert o.batch_max_duration == 30.0 and o.batch_idle_duration == 2.0
+        assert o.preference_policy == "Ignore"
+        assert o.feature_gates.node_repair and o.feature_gates.spot_to_spot_consolidation
+
+    def test_env_fallbacks(self, monkeypatch):
+        from karpenter_tpu.operator.options import Options
+
+        monkeypatch.setenv("METRICS_PORT", "7000")
+        monkeypatch.setenv("LOG_LEVEL", "error")
+        monkeypatch.setenv("DISABLE_LEADER_ELECTION", "true")
+        monkeypatch.setenv("KUBE_CLIENT_BURST", "500")
+        o = Options.from_env()
+        assert o.metrics_port == 7000
+        assert o.log_level == "error"
+        assert o.disable_leader_election is True
+        assert o.kube_client_burst == 500
+
+    def test_flags_win_over_env(self, monkeypatch):
+        from karpenter_tpu.operator.options import Options
+
+        monkeypatch.setenv("METRICS_PORT", "7000")
+        o = Options.from_args(["--metrics-port", "7001"])
+        assert o.metrics_port == 7001
+
+    def test_validation_fails_closed(self):
+        import pytest as _pytest
+
+        from karpenter_tpu.operator.options import Options
+
+        with _pytest.raises(ValueError, match="log-level"):
+            Options.from_args(["--log-level", "verbose"])
+        with _pytest.raises(ValueError, match="preference-policy"):
+            Options.from_args(["--preference-policy", "Sometimes"])
+        with _pytest.raises(ValueError, match="not a valid value"):
+            Options.from_args(["--enable-profiling", "yes"])
+
+    def test_unknown_flags_pass_through(self):
+        from karpenter_tpu.operator.options import Options
+
+        o = Options.from_args(["--provider-specific-flag", "x", "--metrics-port", "1234"])
+        assert o.metrics_port == 1234
+
+    def test_bare_bool_flags_like_go(self):
+        # Go flag semantics: bare --flag means true, and a following flag is
+        # NOT consumed as its value
+        from karpenter_tpu.operator.options import Options
+
+        o = Options.from_args(["--enable-profiling", "--feature-gates", "NodeRepair=true"])
+        assert o.enable_profiling is True
+        assert o.feature_gates.node_repair is True
+        o2 = Options.from_args(["--disable-leader-election"])
+        assert o2.disable_leader_election is True
+
+    def test_unknown_valueless_flag_does_not_swallow_next(self):
+        from karpenter_tpu.operator.options import Options
+
+        o = Options.from_args(["--some-provider-toggle", "--metrics-port", "1234"])
+        assert o.metrics_port == 1234
+
+    def test_env_bool_go_parsebool_values(self, monkeypatch):
+        import pytest as _pytest
+
+        from karpenter_tpu.operator.options import Options
+
+        monkeypatch.setenv("DISABLE_LEADER_ELECTION", "1")
+        assert Options.from_env().disable_leader_election is True
+        monkeypatch.setenv("DISABLE_LEADER_ELECTION", "definitely")
+        with _pytest.raises(ValueError, match="DISABLE_LEADER_ELECTION"):
+            Options.from_env()
+        monkeypatch.setenv("DISABLE_LEADER_ELECTION", "f")
+        assert Options.from_env().disable_leader_election is False
+
+    def test_env_int_named_error(self, monkeypatch):
+        import pytest as _pytest
+
+        from karpenter_tpu.operator.options import Options
+
+        monkeypatch.setenv("METRICS_PORT", "abc")
+        with _pytest.raises(ValueError, match="METRICS_PORT"):
+            Options.from_env()
