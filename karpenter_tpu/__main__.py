@@ -39,12 +39,33 @@ def main(argv=None) -> int:
     port = args.port if args.port is not None else options.metrics_port
 
     import logging
+    import sys as _sys
 
-    logging.basicConfig(level={"debug": logging.DEBUG, "info": logging.INFO, "error": logging.ERROR}[options.log_level])
+    handlers = []
+    for path in options.log_output_paths.split(","):
+        path = path.strip()
+        if path in ("stdout", "stderr"):
+            handlers.append(logging.StreamHandler(getattr(_sys, path)))
+        elif path:
+            handlers.append(logging.FileHandler(path))
+    logging.basicConfig(
+        level={"debug": logging.DEBUG, "info": logging.INFO, "error": logging.ERROR}[options.log_level],
+        handlers=handlers or None,
+    )
 
     env = Environment(options=options, clock=Clock())
     server = OperatorServer(env, port=port, enable_profiling=options.enable_profiling, bind=args.bind)
     port = server.start()
+    # dedicated health-probe listener (options.go --health-probe-port) when it
+    # differs from the metrics port, so k8s probes pointed at the flag work
+    health_server = None
+    if options.health_probe_port not in (port, 0):
+        health_server = OperatorServer(env, port=options.health_probe_port, enable_profiling=False, bind=args.bind)
+        try:
+            health_server.start()
+        except OSError as e:
+            print(f"health-probe port {options.health_probe_port} unavailable: {e}", flush=True)
+            health_server = None
     print(f"karpenter-tpu operator up: solver={options.solver_backend} http={args.bind}:{port}", flush=True)
 
     stop = threading.Event()
@@ -61,6 +82,8 @@ def main(argv=None) -> int:
         )
     finally:
         server.stop()
+        if health_server is not None:
+            health_server.stop()
     return 0
 
 

@@ -105,7 +105,9 @@ class Options:
         """Parse the reference's flag names (options.go AddFlags) on top of the
         environment fallbacks; flags win over env, env wins over defaults.
         Bool flags accept Go's bare form (`--enable-profiling`) and explicit
-        values; unknown flags pass through (provider injectables)."""
+        values; unknown flags FAIL CLOSED with a message, like the
+        reference's flag.FlagSet (provider injectables register their flags
+        on the same parser in the reference, they don't bypass it)."""
         import argparse
 
         o = cls.from_env()
@@ -117,7 +119,10 @@ class Options:
             else:
                 parser.add_argument("--" + flag, default=None)
         parser.add_argument("--feature-gates", default=None)
-        ns, _unknown = parser.parse_known_args(argv)
+        ns, unknown = parser.parse_known_args(argv)
+        bad = [a for a in unknown if a.startswith("--")]
+        if bad:
+            raise ValueError(f"unknown flags: {', '.join(bad)}")
         for flag, (attr, conv) in _FLAG_TABLE.items():
             value = getattr(ns, flag.replace("-", "_"))
             if value is None:
@@ -163,9 +168,13 @@ def _env_int(name: str, default: int) -> int:
 
 
 def _parse_bool(v: str) -> bool:
-    if v.strip().lower() not in ("true", "false"):
-        raise ValueError(f"{v!r} is not a valid value, must be true or false")
-    return v.strip().lower() == "true"
+    """Go strconv.ParseBool forms (1/t/true, 0/f/false)."""
+    lv = v.strip().lower()
+    if lv in _TRUE_WORDS:
+        return True
+    if lv in _FALSE_WORDS:
+        return False
+    raise ValueError(f"{v!r} is not a valid value, must be a boolean")
 
 
 def _parse_seconds(v: str) -> float:

@@ -77,9 +77,23 @@ class StateNode:
             out.update(self.node.metadata.annotations)
         return out
 
+    # taints expected to clear during node startup (scheduling/taints.go
+    # KnownEphemeralTaints): rejected from managed-but-uninitialized nodes so
+    # the scheduler assumes pods can land once they lift
+    KNOWN_EPHEMERAL_TAINT_KEYS = frozenset(
+        {
+            "node.kubernetes.io/not-ready",
+            "node.kubernetes.io/unreachable",
+            "node.cloudprovider.kubernetes.io/uninitialized",
+        }
+    )
+
     def taints(self) -> list[Taint]:
         """Node taints, filtering the transient karpenter lifecycle taints that
-        scheduling must ignore (statenode.go:311-339)."""
+        scheduling must ignore (statenode.go:311-339): the unregistered/
+        disrupted taints always, plus — while a MANAGED node is uninitialized —
+        the known ephemeral startup-phase taints and the claim's own
+        startupTaints (both are expected to lift before initialization)."""
         source = []
         if self.node is not None and self.registered():
             source = self.node.spec.taints
@@ -88,7 +102,17 @@ class StateNode:
         elif self.node is not None:
             source = self.node.spec.taints
         ephemeral = {wk.UNREGISTERED_TAINT_KEY, wk.DISRUPTED_TAINT_KEY}
-        return [t for t in source if t.key not in ephemeral]
+        out = [t for t in source if t.key not in ephemeral]
+        if self.node_claim is not None and not self.initialized():
+            # MatchTaint semantics: key + effect (the applying agent may set a
+            # different value than the claim declared)
+            startup = {(t.key, t.effect) for t in self.node_claim.spec.startup_taints}
+            out = [
+                t
+                for t in out
+                if t.key not in self.KNOWN_EPHEMERAL_TAINT_KEYS and (t.key, t.effect) not in startup
+            ]
+        return out
 
     def registered(self) -> bool:
         if self.node_claim is not None:

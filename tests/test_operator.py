@@ -231,11 +231,15 @@ class TestOperationalOptions:
         with _pytest.raises(ValueError, match="not a valid value"):
             Options.from_args(["--enable-profiling", "yes"])
 
-    def test_unknown_flags_pass_through(self):
+    def test_unknown_flags_fail_closed(self):
+        # the reference's flag.FlagSet errors on undeclared flags; typos must
+        # not silently run the operator with default config
+        import pytest as _pytest
+
         from karpenter_tpu.operator.options import Options
 
-        o = Options.from_args(["--provider-specific-flag", "x", "--metrics-port", "1234"])
-        assert o.metrics_port == 1234
+        with _pytest.raises(ValueError, match="unknown flags"):
+            Options.from_args(["--metrics-prot", "9999"])
 
     def test_bare_bool_flags_like_go(self):
         # Go flag semantics: bare --flag means true, and a following flag is
@@ -248,11 +252,11 @@ class TestOperationalOptions:
         o2 = Options.from_args(["--disable-leader-election"])
         assert o2.disable_leader_election is True
 
-    def test_unknown_valueless_flag_does_not_swallow_next(self):
+    def test_go_parsebool_forms_on_flags(self):
         from karpenter_tpu.operator.options import Options
 
-        o = Options.from_args(["--some-provider-toggle", "--metrics-port", "1234"])
-        assert o.metrics_port == 1234
+        o = Options.from_args(["--disable-leader-election=1", "--enable-profiling", "t"])
+        assert o.disable_leader_election is True and o.enable_profiling is True
 
     def test_env_bool_go_parsebool_values(self, monkeypatch):
         import pytest as _pytest

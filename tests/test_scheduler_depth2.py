@@ -1,0 +1,164 @@
+"""Scheduler depth, batch 2: unowned existing nodes, deleting-node
+rescheduling, in-flight balancing, and startup-taint assumptions — ported
+from suite_test.go's existing/in-flight node families."""
+
+from helpers import make_nodepool, make_pod, parse_resource_list, zone_spread
+from test_solver import LINUX_AMD64
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.kube.objects import Node, NodeSpec, NodeStatus, ObjectMeta
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.scheduling.taints import Taint
+
+
+def make_env(np_kwargs=None):
+    env = Environment(options=Options())
+    np_kwargs = dict(np_kwargs or {})
+    np_kwargs.setdefault("requirements", LINUX_AMD64)
+    env.store.create(make_nodepool(**np_kwargs))
+    return env
+
+
+def unowned_node(name="byo-1", zone="test-zone-a", cpu="16"):
+    """A bring-your-own Node with no NodeClaim (suite_test.go 'existing node
+    unowned by Karpenter')."""
+    return Node(
+        metadata=ObjectMeta(
+            name=name,
+            labels={wk.HOSTNAME_LABEL_KEY: name, wk.ZONE_LABEL_KEY: zone},
+        ),
+        spec=NodeSpec(provider_id=f"byo://{name}"),
+        status=NodeStatus(
+            capacity=parse_resource_list({"cpu": cpu, "memory": "32Gi", "pods": "110"}),
+            allocatable=parse_resource_list({"cpu": cpu, "memory": "32Gi", "pods": "110"}),
+        ),
+    )
+
+
+class TestUnownedExistingNodes:
+    def test_pod_schedules_to_unowned_node(self):
+        # suite_test.go "should schedule a pod to an existing node unowned by
+        # Karpenter": no NodeClaim exists, yet the node's capacity is used
+        env = make_env()
+        env.store.create(unowned_node())
+        env.store.create(make_pod(cpu="1", name="p0"))
+        env.settle(rounds=6)
+        pod = env.store.get("Pod", "p0")
+        assert pod.spec.node_name == "byo-1"
+        assert env.store.count("NodeClaim") == 0, "no new capacity launched"
+
+    def test_multiple_pods_schedule_to_unowned_node(self):
+        env = make_env()
+        env.store.create(unowned_node(cpu="32"))
+        for i in range(5):
+            env.store.create(make_pod(cpu="2", name=f"p{i}"))
+        env.settle(rounds=6)
+        assert all(p.spec.node_name == "byo-1" for p in env.store.list("Pod"))
+        assert env.store.count("NodeClaim") == 0
+
+    def test_overflow_beyond_unowned_capacity_launches(self):
+        env = make_env()
+        env.store.create(unowned_node(cpu="2"))
+        for i in range(4):
+            env.store.create(make_pod(cpu="1500m", name=f"p{i}"))
+        env.settle(rounds=8)
+        assert all(p.spec.node_name for p in env.store.list("Pod"))
+        assert env.store.count("NodeClaim") >= 1
+
+
+class TestDeletingNodeRescheduling:
+    def test_pods_reschedule_from_marked_for_deletion_node(self):
+        # suite_test.go "should re-schedule pods from a deleting node when
+        # pods are active": a node being drained counts its reschedulable
+        # pods as pending demand so replacement capacity launches BEFORE the
+        # pods are actually evicted
+        env = make_env()
+        env.store.create(make_pod(cpu="2", name="p0"))
+        env.settle(rounds=6)
+        node = env.store.list("Node")[0]
+        env.store.delete("Node", node.metadata.name)  # finalizer drain begins
+        env.settle(rounds=15)
+        pod = env.store.get("Pod", "p0")
+        assert pod.spec.node_name and pod.spec.node_name != node.metadata.name
+        assert env.store.try_get("Node", node.metadata.name) is None
+
+
+class TestInflightBalancing:
+    def test_zone_spread_balances_across_inflight_nodes(self):
+        # suite_test.go "should balance pods across zones with in-flight
+        # nodes": the second batch sees the first batch's in-flight claims'
+        # committed zones and keeps the spread balanced
+        env = make_env()
+        sel = {"matchLabels": {"app": "web"}}
+        for i in range(6):
+            env.store.create(
+                make_pod(cpu="4", name=f"a{i}", labels={"app": "web"}, tsc=[zone_spread(selector=sel)])
+            )
+        env.settle(rounds=6)
+        for i in range(6):
+            env.store.create(
+                make_pod(cpu="4", name=f"b{i}", labels={"app": "web"}, tsc=[zone_spread(selector=sel)])
+            )
+        env.settle(rounds=8)
+        counts = {}
+        for p in env.store.list("Pod"):
+            assert p.spec.node_name
+            node = env.store.get("Node", p.spec.node_name)
+            z = node.metadata.labels.get(wk.ZONE_LABEL_KEY)
+            counts[z] = counts.get(z, 0) + 1
+        assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+
+class TestStartupTaintAssumptions:
+    def test_pod_assumed_onto_node_with_startup_taint_before_init(self):
+        # suite_test.go "should assume pod will schedule to a tainted node
+        # with a custom startup taint": the SCHEDULER's assumption holds (no
+        # duplicate capacity launches while the startup taint is present);
+        # the taint's owner (e.g. a CNI daemon) clears it when ready, and
+        # only then does the pod bind — initialization waits for the clear
+        env = make_env(np_kwargs={"taints": None})
+        np = env.store.list("NodePool")[0]
+
+        def add_startup(p):
+            p.spec.template.startup_taints = [Taint(key="custom/startup", value="true", effect="NoSchedule")]
+
+        env.store.patch("NodePool", np.metadata.name, add_startup)
+        env.store.create(make_pod(cpu="1", name="p0"))
+        env.settle(rounds=8)
+        # the assumption: exactly one claim, no duplicate despite the taint
+        assert env.store.count("NodeClaim") == 1
+        assert not env.store.get("Pod", "p0").spec.node_name
+
+        # the taint owner clears its startup taint once its daemon is ready
+        for n in env.store.list("Node"):
+
+            def clear(x):
+                x.spec.taints = [t for t in x.spec.taints if t.key != "custom/startup"]
+
+            env.store.patch("Node", n.metadata.name, clear)
+        env.settle(rounds=8)
+        assert env.store.get("Pod", "p0").spec.node_name, "pod binds after the startup taint clears"
+        assert env.store.count("NodeClaim") == 1
+
+    def test_regular_template_taint_blocks_intolerant_pod(self):
+        env = make_env(np_kwargs={"taints": [Taint(key="dedicated", value="gpu", effect="NoSchedule")]})
+        env.store.create(make_pod(cpu="1", name="p0"))
+        env.settle(rounds=6)
+        assert not env.store.get("Pod", "p0").spec.node_name
+
+    def test_not_ready_ephemeral_taint_does_not_block_assumption(self):
+        # the node.kubernetes.io/not-ready:NoExecute taint on an
+        # uninitialized node is ephemeral — pods still schedule against it
+        env = make_env()
+        env.store.create(make_pod(cpu="1", name="p0"))
+        env.settle(rounds=3)
+        nodes = env.store.list("Node")
+        if nodes:
+
+            def taint(n):
+                n.spec.taints.append(Taint(key="node.kubernetes.io/not-ready", value="", effect="NoExecute"))
+
+            env.store.patch("Node", nodes[0].metadata.name, taint)
+        env.store.create(make_pod(cpu="1", name="p1"))
+        env.settle(rounds=8)
+        assert env.store.get("Pod", "p1").spec.node_name
