@@ -63,7 +63,7 @@ def main(argv=None) -> int:
         health_server = OperatorServer(env, port=options.health_probe_port, enable_profiling=False, bind=args.bind)
         try:
             health_server.start()
-        except OSError as e:
+        except (OSError, OverflowError) as e:
             print(f"health-probe port {options.health_probe_port} unavailable: {e}", flush=True)
             health_server = None
     print(f"karpenter-tpu operator up: solver={options.solver_backend} http={args.bind}:{port}", flush=True)

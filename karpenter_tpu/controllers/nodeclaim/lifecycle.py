@@ -126,10 +126,10 @@ class LifecycleController:
             return False
         if not _node_ready(node):
             return False
-        # startup taints must have cleared — matched by full identity, so a
-        # permanent taint sharing a key doesn't wedge initialization
-        startup = {(t.key, t.value, t.effect) for t in nc.spec.startup_taints}
-        if any((t.key, t.value, t.effect) in startup for t in node.spec.taints):
+        # startup taints must have cleared — MatchTaint (key + effect)
+        # semantics, consistent with StateNode.taints()' scheduling filter
+        startup = {(t.key, t.effect) for t in nc.spec.startup_taints}
+        if any((t.key, t.effect) in startup for t in node.spec.taints):
             return False
         # all claim-known resources must be registered on the node
         for name, q in nc.status.allocatable.items():

@@ -68,6 +68,9 @@ class Options:
             errs.append(f"solver-backend must be ffd or tpu, got {self.solver_backend!r}")
         if self.batch_idle_duration < 0 or self.batch_max_duration < 0:
             errs.append("batch windows must be non-negative")
+        for name, port in (("metrics-port", self.metrics_port), ("health-probe-port", self.health_probe_port)):
+            if not 0 <= port <= 65535:
+                errs.append(f"{name} must be 0-65535, got {port}")
         return errs
 
     @classmethod
@@ -111,6 +114,8 @@ class Options:
         import argparse
 
         o = cls.from_env()
+        # Go's flag package accepts single-dash flags; normalize to two
+        argv = ["-" + a if a.startswith("-") and not a.startswith("--") and len(a) > 2 else a for a in argv]
         parser = argparse.ArgumentParser(add_help=False, allow_abbrev=False)
         for flag, (attr, conv) in _FLAG_TABLE.items():
             if conv is _parse_bool:

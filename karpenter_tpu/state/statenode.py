@@ -77,14 +77,16 @@ class StateNode:
             out.update(self.node.metadata.annotations)
         return out
 
-    # taints expected to clear during node startup (scheduling/taints.go
-    # KnownEphemeralTaints): rejected from managed-but-uninitialized nodes so
-    # the scheduler assumes pods can land once they lift
-    KNOWN_EPHEMERAL_TAINT_KEYS = frozenset(
+    # taints expected to clear during node startup (scheduling/taints.go:38-44
+    # KnownEphemeralTaints, matched MatchTaint-style by key + effect):
+    # rejected from managed-but-uninitialized nodes so the scheduler assumes
+    # pods can land once they lift
+    KNOWN_EPHEMERAL_TAINTS = frozenset(
         {
-            "node.kubernetes.io/not-ready",
-            "node.kubernetes.io/unreachable",
-            "node.cloudprovider.kubernetes.io/uninitialized",
+            ("node.kubernetes.io/not-ready", "NoSchedule"),
+            ("node.kubernetes.io/not-ready", "NoExecute"),
+            ("node.kubernetes.io/unreachable", "NoSchedule"),
+            ("node.cloudprovider.kubernetes.io/uninitialized", "NoSchedule"),
         }
     )
 
@@ -110,7 +112,7 @@ class StateNode:
             out = [
                 t
                 for t in out
-                if t.key not in self.KNOWN_EPHEMERAL_TAINT_KEYS and (t.key, t.effect) not in startup
+                if (t.key, t.effect) not in self.KNOWN_EPHEMERAL_TAINTS and (t.key, t.effect) not in startup
             ]
         return out
 

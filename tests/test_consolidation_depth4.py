@@ -1,0 +1,165 @@
+"""Consolidation depth, batch 4: method priority order, candidate filtering
+(nominated/terminating/unowned/orphaned nodes), and the same-instance-type
+churn guard — ported from consolidation_test.go + controller.go families."""
+
+from helpers import hostname_anti_affinity, make_nodepool, make_pod
+from test_disruption import OD_ONLY, make_env, provision, run_disruption
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import Budget
+
+
+def one_pod_per_node(env, n, cpu="500m", labels=None, prefix="s"):
+    sel = {"matchLabels": {"app": "x"}}
+    base = {"app": "x"}
+    if labels:
+        base.update(labels)
+    pods = [
+        make_pod(cpu=cpu, name=f"{prefix}{i}", labels=dict(base), anti_affinity=[hostname_anti_affinity(sel)])
+        for i in range(n)
+    ]
+    provision(env, pods)
+    return pods
+
+
+class TestMethodPriority:
+    def test_emptiness_deletes_before_consolidation_replaces(self):
+        # controller.go:101-115 — methods run in priority order and the first
+        # method producing commands wins the round: empty nodes delete
+        # without any scheduling simulation before consolidation is tried
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        np = env.store.list("NodePool")[0]
+
+        def full_budget(p):
+            p.spec.disruption.budgets = [Budget(nodes="100%")]
+
+        env.store.patch("NodePool", np.metadata.name, full_budget)
+        pods = one_pod_per_node(env, 4)
+        n0 = env.store.count("Node")
+        assert n0 == 4
+        # empty two nodes; keep two underutilized
+        env.store.delete("Pod", "s0")
+        env.store.delete("Pod", "s1")
+        env.clock.step(40)
+        env.tick(provision_force=True)
+        env.disruption.reconcile(force=True)
+        # the first round's commands are emptiness deletes (no replacements)
+        deleting = [
+            sn for sn in env.cluster.nodes() if sn.marked_for_deletion
+        ]
+        assert len(deleting) >= 1
+        assert env.store.count("NodeClaim") == 4, "emptiness never creates replacements"
+
+    def test_drift_has_priority_over_consolidation(self):
+        # a drifted underutilized node is handled by Drift (1:1 replace), not
+        # merged by consolidation, because Drift runs first
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        one_pod_per_node(env, 2)
+        np = env.store.list("NodePool")[0]
+
+        def relabel(p):
+            p.spec.template.labels["roll"] = "v2"
+
+        env.store.patch("NodePool", np.metadata.name, relabel)
+        env.clock.step(40)
+        env.tick(provision_force=True)
+        env.nodeclaim_disruption.reconcile()
+        drifted = [
+            nc for nc in env.store.list("NodeClaim")
+            if nc.status.conditions.is_true("Drifted")
+        ]
+        assert drifted, "hash change must mark claims drifted"
+        env.disruption.reconcile(force=True)
+        env.settle(rounds=25)
+        # the roll replaced nodes 1:1 with the new template label
+        for nc in env.store.list("NodeClaim"):
+            assert nc.metadata.labels.get("roll") == "v2"
+        assert env.store.count("Pod") == 2
+
+
+class TestCandidateFiltering:
+    def test_nominated_node_not_a_candidate(self):
+        # a node nominated for incoming pods is protected from disruption
+        # (statenode.go Nominated / ValidateNodeDisruptable)
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        one_pod_per_node(env, 2)
+        for sn in env.cluster.nodes():
+            env.cluster.nominate_node(sn.name())
+        cands = env.disruption.get_candidates()
+        assert cands == []
+
+    def test_marked_for_deletion_node_not_a_candidate(self):
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        one_pod_per_node(env, 2)
+        sns = env.cluster.nodes()
+        env.cluster.mark_for_deletion([sns[0].provider_id()])
+        cands = env.disruption.get_candidates()
+        assert len(cands) == 1
+
+    def test_node_without_nodepool_label_not_a_candidate(self):
+        # bring-your-own nodes are never voluntarily disrupted
+        # (candidate build requires the nodepool label, types.go:160-211)
+        from helpers import parse_resource_list
+        from karpenter_tpu.kube.objects import Node, NodeSpec, NodeStatus, ObjectMeta
+
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        env.store.create(
+            Node(
+                metadata=ObjectMeta(name="byo", labels={wk.HOSTNAME_LABEL_KEY: "byo"}),
+                spec=NodeSpec(provider_id="byo://x"),
+                status=NodeStatus(
+                    capacity=parse_resource_list({"cpu": "8", "memory": "16Gi", "pods": "110"}),
+                    allocatable=parse_resource_list({"cpu": "8", "memory": "16Gi", "pods": "110"}),
+                ),
+            )
+        )
+        env.settle(rounds=3)
+        cands = env.disruption.get_candidates()
+        assert all(c.state_node.name() != "byo" for c in cands)
+
+    def test_orphaned_pool_node_not_a_candidate(self):
+        # candidate build needs the owning NodePool object; nodes of a
+        # deleted pool are left alone (helpers.go candidate filtering)
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        one_pod_per_node(env, 2)
+        np = env.store.list("NodePool")[0]
+        env.store.delete("NodePool", np.metadata.name)
+        env.settle(rounds=2)
+        cands = env.disruption.get_candidates()
+        assert cands == []
+
+
+class TestSameTypeChurnGuard:
+    def test_wont_replace_fleet_with_type_already_present(self):
+        # multinodeconsolidation.go filterOutSameInstanceType scenario:
+        # merging a fleet whose replacement would be the same instance type as
+        # a member is churn, not savings — the command must be rejected or
+        # choose a strictly cheaper type
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        np = env.store.list("NodePool")[0]
+
+        def full_budget(p):
+            p.spec.disruption.budgets = [Budget(nodes="100%")]
+            p.spec.disruption.consolidate_after = "30s"
+
+        env.store.patch("NodePool", np.metadata.name, full_budget)
+        one_pod_per_node(env, 3, cpu="400m")
+        # release the anti-affinity so pods can co-locate
+        for i in range(3):
+            env.store.delete("Pod", f"s{i}")
+        for i in range(3):
+            env.store.create(make_pod(cpu="400m", name=f"f{i}"))
+        env.settle(rounds=4)
+        prices_before = sorted(
+            c.price for c in (env.disruption.get_candidates() or [])
+        )
+        run_disruption(env, rounds=25)
+        # fleet consolidated: strictly fewer nodes, pods intact
+        assert env.store.count("Node") < 3
+        assert env.store.count("Pod") == 3
+        assert prices_before, "setup: candidates existed pre-consolidation"
+        # anti-churn: the consolidated state is STABLE — further rounds never
+        # replace the survivor with a same-priced node (pointless churn guard,
+        # multinodeconsolidation.go:150-170)
+        survivors = {n.metadata.name for n in env.store.list("Node")}
+        run_disruption(env, rounds=12)
+        assert {n.metadata.name for n in env.store.list("Node")} == survivors

@@ -151,14 +151,14 @@ class TestStartupTaintAssumptions:
         # uninitialized node is ephemeral — pods still schedule against it
         env = make_env()
         env.store.create(make_pod(cpu="1", name="p0"))
-        env.settle(rounds=3)
+        env.settle(rounds=6)
         nodes = env.store.list("Node")
-        if nodes:
+        assert nodes, "setup: the first pod must have provisioned a node"
 
-            def taint(n):
-                n.spec.taints.append(Taint(key="node.kubernetes.io/not-ready", value="", effect="NoExecute"))
+        def taint(n):
+            n.spec.taints.append(Taint(key="node.kubernetes.io/not-ready", value="", effect="NoExecute"))
 
-            env.store.patch("Node", nodes[0].metadata.name, taint)
+        env.store.patch("Node", nodes[0].metadata.name, taint)
         env.store.create(make_pod(cpu="1", name="p1"))
         env.settle(rounds=8)
         assert env.store.get("Pod", "p1").spec.node_name
