@@ -302,6 +302,23 @@ class TopologyGroup:
         return options
 
 
+def effective_spread_selector(pod, tsc) -> Optional[dict]:
+    """The spread constraint's selector with the pod's values for every
+    matchLabelKeys entry merged in as In-expressions (topology.go:467-475);
+    keys absent from the pod's labels are ignored."""
+    sel = tsc.label_selector
+    keys = [k for k in (getattr(tsc, "match_label_keys", None) or []) if k in pod.metadata.labels]
+    if not keys:
+        return sel
+    merged = {
+        "matchLabels": dict((sel or {}).get("matchLabels") or {}),
+        "matchExpressions": list((sel or {}).get("matchExpressions") or []),
+    }
+    for k in keys:
+        merged["matchExpressions"].append({"key": k, "operator": "In", "values": [pod.metadata.labels[k]]})
+    return merged
+
+
 def _selector_key(selector: Optional[dict]):
     if selector is None:
         return None
@@ -412,7 +429,7 @@ class Topology:
                     tsc.topology_key,
                     pod,
                     {pod.metadata.namespace},
-                    tsc.label_selector,
+                    effective_spread_selector(pod, tsc),
                     tsc.max_skew,
                     tsc.min_domains,
                     tsc.node_taints_policy,

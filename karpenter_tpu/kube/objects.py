@@ -103,6 +103,10 @@ class TopologySpreadConstraint:
     min_domains: int | None = None
     node_affinity_policy: str = "Honor"  # Honor | Ignore
     node_taints_policy: str = "Ignore"  # Honor | Ignore
+    # pod label keys whose VALUES merge into the selector (k8s >= 1.27
+    # matchLabelKeys; topology.go:467-475) — e.g. pod-template-hash for
+    # per-revision spread
+    match_label_keys: list[str] = field(default_factory=list)
 
 
 @dataclass

@@ -319,7 +319,16 @@ def pod_signature(pod) -> tuple:
         if spec.tolerations
         else (),
         tuple(
-            (t.max_skew, t.topology_key, t.when_unsatisfiable, _sel_key(t.label_selector), t.min_domains, t.node_affinity_policy, t.node_taints_policy)
+            (
+                t.max_skew,
+                t.topology_key,
+                t.when_unsatisfiable,
+                _sel_key(t.label_selector),
+                t.min_domains,
+                t.node_affinity_policy,
+                t.node_taints_policy,
+                tuple(getattr(t, "match_label_keys", None) or ()),
+            )
             for t in spec.topology_spread_constraints
         )
         if spec.topology_spread_constraints
@@ -529,15 +538,18 @@ def _spread_symmetry_reasons(rep_pods) -> list[str]:
     (over the solve's unique pod shapes): the host counts matched
     non-declaring pods without constraining them, which the keyed-domain
     kernel cannot express."""
+    from ..controllers.provisioning.scheduling.topology import effective_spread_selector
+
     declared: dict[tuple, tuple[set[int], object]] = {}
     for s, pod in enumerate(rep_pods):
         for tsc in pod.spec.topology_spread_constraints:
             if tsc.topology_key == wk.HOSTNAME_LABEL_KEY:
                 continue
-            ident = (tsc.topology_key, _sel_key(tsc.label_selector), pod.metadata.namespace)
+            eff_sel = effective_spread_selector(pod, tsc)
+            ident = (tsc.topology_key, _sel_key(eff_sel), pod.metadata.namespace)
             entry = declared.get(ident)
             if entry is None:
-                declared[ident] = ({s}, tsc.label_selector)
+                declared[ident] = ({s}, eff_sel)
             else:
                 entry[0].add(s)
     reasons = []
@@ -1338,6 +1350,8 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     # -- topology groups (identified from signature representatives) -----------
     group_defs: dict[tuple, dict] = {}  # identity -> {kind, dom_key, skew, ...}
     memberships: list[tuple[int, tuple]] = []  # (sig idx, identity)
+    from ..controllers.provisioning.scheduling.topology import effective_spread_selector
+
     for s, pod in enumerate(rep_pods):
         for tsc in pod.spec.topology_spread_constraints:
             if tsc.topology_key == wk.HOSTNAME_LABEL_KEY:
@@ -1347,10 +1361,13 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
             else:
                 kind, dk = KIND_DOM_SPREAD, dom_key_idx[tsc.topology_key]
                 md = tsc.min_domains or 0
-            ident = (kind, dk, tsc.max_skew, md, _sel_key(tsc.label_selector), pod.metadata.namespace)
+            # matchLabelKeys values merge into the selector, so pods of
+            # different sub-deployments form DISTINCT spread groups
+            eff_sel = effective_spread_selector(pod, tsc)
+            ident = (kind, dk, tsc.max_skew, md, _sel_key(eff_sel), pod.metadata.namespace)
             group_defs.setdefault(
                 ident,
-                {"kind": kind, "dom_key": dk, "skew": tsc.max_skew, "min_domains": md, "selector": tsc.label_selector, "ns": pod.metadata.namespace},
+                {"kind": kind, "dom_key": dk, "skew": tsc.max_skew, "min_domains": md, "selector": eff_sel, "ns": pod.metadata.namespace},
             )
             memberships.append((s, ident))
         aff = pod.spec.affinity

@@ -86,7 +86,9 @@ class TestHostnameSpreadXL:
         dt = time.perf_counter() - t0
         bound = sum(1 for p in env.store.list("Pod") if p.spec.node_name)
         assert bound == 400, f"{bound}/400 bound after {dt:.1f}s"
-        assert dt < 300.0, f"e2e hostname-spread took {dt:.1f}s"
+        # generous budget: CI boxes run suites concurrently (reference
+        # budget for the full-scale variant is 35 MINUTES)
+        assert dt < 600.0, f"e2e hostname-spread took {dt:.1f}s"
 
 
 class TestGroupedDegenerateCrossover:

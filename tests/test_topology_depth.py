@@ -499,3 +499,97 @@ class TestPodAntiAffinityDepth:
         assert results.all_pods_scheduled()
         # the selector matches nothing: pods pack onto one node
         assert len([nc for nc in results.new_node_claims if nc.pods]) == 1
+
+
+class TestMatchLabelKeys:
+    """topology_test.go MatchLabelKeys context (k8s >= 1.27): the pod's
+    values for the listed label keys merge into the spread selector, giving
+    per-revision spread groups (topology.go:467-475)."""
+
+    def test_match_label_keys_split_spread_groups(self):
+        # two "revisions" of one deployment: hostname spread with
+        # matchLabelKeys=[rev] must spread WITHIN each revision, not across —
+        # 2+2 pods land as skew (2, 2), not (1, 1, 1, 1)
+        from karpenter_tpu.kube import TopologySpreadConstraint
+
+        sel = {"matchLabels": {"app": "web"}}
+        tsc = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wk.HOSTNAME_LABEL_KEY,
+            label_selector=sel,
+            match_label_keys=["rev"],
+        )
+        pods = [
+            make_pod(cpu="1", name=f"a{i}", labels={"app": "web", "rev": "value-a"}, tsc=[tsc])
+            for i in range(2)
+        ]
+        pods += [
+            make_pod(cpu="1", name=f"b{i}", labels={"app": "web", "rev": "value-b"}, tsc=[tsc])
+            for i in range(2)
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        placed = [nc for nc in results.new_node_claims if nc.pods]
+        # per-revision spread: each node hosts one a-pod and one b-pod, so 2
+        # nodes with 2 pods each (without matchLabelKeys: 4 nodes of 1)
+        assert sorted(len(nc.pods) for nc in placed) == [2, 2]
+        for nc in placed:
+            revs = {p.metadata.labels["rev"] for p in nc.pods}
+            assert revs == {"value-a", "value-b"}
+
+    def test_unknown_match_label_key_ignored(self):
+        # topology_test.go "should ignore unknown labels specified in
+        # matchLabelKeys": pods lacking the key use the plain selector
+        from karpenter_tpu.kube import TopologySpreadConstraint
+
+        sel = {"matchLabels": {"app": "web"}}
+        tsc = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wk.HOSTNAME_LABEL_KEY,
+            label_selector=sel,
+            match_label_keys=["missing-label"],
+        )
+        pods = [make_pod(cpu="1", name=f"p{i}", labels={"app": "web"}, tsc=[tsc]) for i in range(4)]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        placed = [nc for nc in results.new_node_claims if nc.pods]
+        assert sorted(len(nc.pods) for nc in placed) == [1, 1, 1, 1]
+
+    def test_match_label_keys_zone_spread_tensor_path(self):
+        # the keyed-domain kernel sees per-revision groups too: each revision
+        # spreads over zones independently on the TPU path
+        from karpenter_tpu.kube import TopologySpreadConstraint
+        from karpenter_tpu.solver.encode import check_capability
+        from karpenter_tpu.solver.tpu import TPUSolver
+        from test_solver import make_snapshot
+
+        sel = {"matchLabels": {"app": "web"}}
+        tsc = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wk.ZONE_LABEL_KEY,
+            label_selector=sel,
+            match_label_keys=["rev"],
+        )
+        pods = []
+        for rev in ("r1", "r2"):
+            pods += [
+                make_pod(cpu="2", name=f"{rev}-{i}", labels={"app": "web", "rev": rev}, tsc=[tsc])
+                for i in range(8)
+            ]
+        snap = make_snapshot(pods)
+        assert check_capability(snap) == []
+        solver = TPUSolver(force=True)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert results.all_pods_scheduled()
+        # per-revision zone balance
+        from collections import Counter
+
+        for rev in ("r1", "r2"):
+            zone_counts = Counter()
+            for nc in results.new_node_claims:
+                z = next(iter(nc.requirements.get(wk.ZONE_LABEL_KEY).values), None)
+                n = sum(1 for p in nc.pods if p.metadata.labels.get("rev") == rev)
+                if n:
+                    zone_counts[z] += n
+            assert max(zone_counts.values()) - min(zone_counts.values()) <= 1, (rev, zone_counts)
