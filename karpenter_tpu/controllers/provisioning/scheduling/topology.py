@@ -307,6 +307,8 @@ def effective_spread_selector(pod, tsc) -> Optional[dict]:
     matchLabelKeys entry merged in as In-expressions (topology.go:467-475);
     keys absent from the pod's labels are ignored."""
     sel = tsc.label_selector
+    if sel is None:
+        return None  # nil selector matches nothing; matchLabelKeys can't revive it
     keys = [k for k in (getattr(tsc, "match_label_keys", None) or []) if k in pod.metadata.labels]
     if not keys:
         return sel

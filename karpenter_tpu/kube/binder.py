@@ -127,11 +127,13 @@ class Binder:
         """Honor DoNotSchedule spread constraints and required hostname
         anti-affinity — the kube-scheduler behaviors the e2e flows rely on."""
         from .objects import match_label_selector
+        from ..controllers.provisioning.scheduling.topology import effective_spread_selector
 
         node_domain = {n.metadata.name: n.metadata.labels for n in nodes}
         for tsc in pod.spec.topology_spread_constraints:
             if tsc.when_unsatisfiable != "DoNotSchedule":
                 continue
+            eff_sel = effective_spread_selector(pod, tsc)
             counts: dict[str, int] = {}
             for n in nodes:
                 d = n.metadata.labels.get(tsc.topology_key)
@@ -140,7 +142,7 @@ class Binder:
             for q in all_pods:
                 if not q.spec.node_name or q.metadata.namespace != pod.metadata.namespace:
                     continue
-                if not match_label_selector(tsc.label_selector, q.metadata.labels):
+                if not match_label_selector(eff_sel, q.metadata.labels):
                     continue
                 d = node_domain.get(q.spec.node_name, {}).get(tsc.topology_key)
                 if d is not None:

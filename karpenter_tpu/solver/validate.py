@@ -113,15 +113,18 @@ def validate_results(snap, results) -> list[str]:
             if pod is not None:
                 placements.append((pod, dom, en.name()))
 
+    from ..controllers.provisioning.scheduling.topology import effective_spread_selector
+
     for pod in snap.pods:
         for tsc in pod.spec.topology_spread_constraints:
             if tsc.when_unsatisfiable != "DoNotSchedule":
                 continue
+            eff_sel = effective_spread_selector(pod, tsc)
             counts = defaultdict(int)
             for q, dom, host in placements:
                 if q.metadata.namespace != pod.metadata.namespace:
                     continue
-                if not match_label_selector(tsc.label_selector, q.metadata.labels):
+                if not match_label_selector(eff_sel, q.metadata.labels):
                     continue
                 domain = host if tsc.topology_key == wk.HOSTNAME_LABEL_KEY else dom(tsc.topology_key)
                 if domain is not None:

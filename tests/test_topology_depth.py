@@ -593,3 +593,33 @@ class TestMatchLabelKeys:
                 if n:
                     zone_counts[z] += n
             assert max(zone_counts.values()) - min(zone_counts.values()) <= 1, (rev, zone_counts)
+
+    def test_match_label_keys_end_to_end_binding(self):
+        # the binder (kube-scheduler stand-in) must honor per-revision
+        # semantics too: a second revision binds even when combined-selector
+        # skew would forbid it
+        from karpenter_tpu.kube import TopologySpreadConstraint
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+
+        env = Environment(options=Options())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        sel = {"matchLabels": {"app": "web"}}
+        tsc = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wk.HOSTNAME_LABEL_KEY,
+            label_selector=sel,
+            match_label_keys=["rev"],
+        )
+        for rev in ("r1", "r2"):
+            for i in range(2):
+                env.store.create(
+                    make_pod(cpu="1", name=f"{rev}-{i}", labels={"app": "web", "rev": rev}, tsc=[tsc])
+                )
+        env.settle(rounds=8)
+        pods = env.store.list("Pod")
+        assert all(p.spec.node_name for p in pods), "all revisions must bind"
+        # per-revision spread: each revision's pods on distinct nodes
+        for rev in ("r1", "r2"):
+            nodes = {p.spec.node_name for p in pods if p.metadata.labels["rev"] == rev}
+            assert len(nodes) == 2
