@@ -25,7 +25,10 @@ from __future__ import annotations
 import json
 import os
 import random
+import signal
+import subprocess
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -40,11 +43,111 @@ if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
     jax.config.update("jax_platforms", "cpu")
 
 
-def build_snapshot(n_pods: int, n_types: int, n_variants: int = 400, affinity_frac: float = 0.0, fallback_frac: float = 0.0):
+# ---------------------------------------------------------------------------
+# Flap resistance (VERDICT r4 #1). Round 4's artifact was EMPTY because the
+# TPU tunnel was down at first dispatch and the whole process died rc=1.
+# Three layers of defense:
+#   1. probe_backend(): a tiny jit in a SUBPROCESS (a downed tunnel hangs
+#      backend registration on import, so the probe must be killable) with
+#      retries + backoff. On persistent failure the run degrades to CPU at
+#      reduced scale and says so in extra.backend — a labeled degraded run,
+#      never an empty artifact.
+#   2. every scenario runs under _run_scenario(): an exception in one
+#      scenario records <name>_error and moves on; completed numbers emit.
+#   3. a wall-clock watchdog + SIGTERM/SIGINT handlers print the JSON line
+#      with everything collected so far, so even a hang or a driver kill
+#      produces the artifact.
+# ---------------------------------------------------------------------------
+
+_RESULT: dict = {"metric": "bench_incomplete", "value": 0.0, "unit": "s", "vs_baseline": 0.0, "extra": {}}
+_EMITTED = False
+# RLock: the SIGTERM handler runs on the main thread and may interrupt an
+# in-progress _emit_result — a plain Lock would self-deadlock there
+_EMIT_LOCK = threading.RLock()
+
+
+def _emit_result() -> None:
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return
+        _EMITTED = True
+        print(json.dumps(_RESULT))
+        sys.stdout.flush()
+
+
+def _install_guards(deadline_s: float) -> None:
+    def _on_signal(signum, frame):
+        _RESULT["extra"]["aborted"] = f"signal {signum}"
+        _emit_result()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    def _watchdog():
+        _RESULT["extra"]["aborted"] = f"deadline {deadline_s:.0f}s"
+        _emit_result()
+        os._exit(0)
+
+    t = threading.Timer(deadline_s, _watchdog)
+    t.daemon = True
+    t.start()
+
+
+def probe_backend(attempts: int = 3, timeout_s: float = 240.0) -> str | None:
+    """Dispatch a tiny computation in a subprocess; return the backend name
+    ('tpu'/'cpu'/...) or None if every attempt fails or hangs."""
+    code = (
+        "import jax; x = jax.numpy.ones((8, 8)) @ jax.numpy.ones((8, 8)); "
+        "x.block_until_ready(); print('BACKEND=' + jax.default_backend())"
+    )
+    for i in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s, env=os.environ.copy(),
+            )
+            for line in out.stdout.splitlines():
+                if line.startswith("BACKEND="):
+                    return line.split("=", 1)[1].strip()
+            print(f"backend probe attempt {i + 1} rc={out.returncode}: {out.stderr[-300:]}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"backend probe attempt {i + 1} timed out after {timeout_s:.0f}s", file=sys.stderr)
+        if i < attempts - 1:
+            time.sleep(min(30.0, 5.0 * (2**i)))
+    return None
+
+
+def _run_scenario(name: str, fn, *args, **kwargs):
+    """Run one bench scenario; on failure record <name>_error and return None
+    so completed numbers still emit (VERDICT r4 weak #2)."""
+    t0 = time.perf_counter()
+    try:
+        out = fn(*args, **kwargs)
+        print(f"scenario {name}: done in {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+        return out
+    except BaseException as e:  # noqa: BLE001 — device errors subclass odd bases
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            raise
+        _RESULT["extra"][f"{name}_error"] = f"{type(e).__name__}: {e}"[:300]
+        print(f"scenario {name}: FAILED after {time.perf_counter() - t0:.1f}s: {e}", file=sys.stderr)
+        return None
+
+
+def build_snapshot(
+    n_pods: int,
+    n_types: int,
+    n_variants: int = 400,
+    affinity_frac: float = 0.0,
+    fallback_frac: float = 0.0,
+    pvc_frac: float = 0.0,
+):
     from helpers import hostname_anti_affinity, make_nodepool, make_pod, zone_spread
     from karpenter_tpu.apis import labels as wk
     from karpenter_tpu.cloudprovider.fake import instance_types_assorted
     from karpenter_tpu.kube import Store
+    from karpenter_tpu.kube.objects import ObjectMeta as ObjectMeta_
     from karpenter_tpu.solver.snapshot import SolverSnapshot
     from karpenter_tpu.state import Cluster
     from karpenter_tpu.state.informer import start_informers
@@ -78,9 +181,33 @@ def build_snapshot(n_pods: int, n_types: int, n_variants: int = 400, affinity_fr
         )
         for i in range(40)
     ]
+    if pvc_frac:
+        # common-case dynamic provisioning: WaitForFirstConsumer StorageClasses,
+        # one unconstrained + one with a single zonal topology term, plus
+        # per-driver CSI attach limits (volumetopology.go + scheduler.go:623)
+        from karpenter_tpu.kube.objects import PersistentVolumeClaim, StorageClass
+
+        store.create(StorageClass(
+            metadata=ObjectMeta_(name="fast-sc"), provisioner="csi.test.fast",
+            volume_binding_mode="WaitForFirstConsumer",
+        ))
+        store.create(StorageClass(
+            metadata=ObjectMeta_(name="zonal-sc"), provisioner="csi.test.zonal",
+            volume_binding_mode="WaitForFirstConsumer",
+            allowed_topologies=[[{"key": wk.ZONE_LABEL_KEY, "values": ["test-zone-a", "test-zone-b"]}]],
+        ))
+    pvc_seq = 0
     pods = []
     for _ in range(n_pods):
         k = rng.random()
+        if pvc_seq < n_pods * pvc_frac and rng.random() < pvc_frac * 1.5:
+            sc = "zonal-sc" if pvc_seq % 2 else "fast-sc"
+            claim = f"data-{pvc_seq}"
+            store.create(PersistentVolumeClaim(metadata=ObjectMeta_(name=claim), storage_class_name=sc))
+            cpu, mem = rng.choice(combos)
+            pods.append(make_pod(cpu=cpu, memory=mem, volumes=[{"name": "data", "persistentVolumeClaim": {"claimName": claim}}]))
+            pvc_seq += 1
+            continue
         if k < affinity_frac:  # required zone pod-affinity deployments
             labels, term = rng.choice(aff_groups)
             cpu = rng.choice(["250m", "500m", "1"])
@@ -191,25 +318,72 @@ def bench_scheduler(n_pods: int, n_types: int):
     }
 
 
-def bench_affinity(n_pods: int, n_types: int) -> float:
-    """The SAME 50k x 500 workload with 15% of pods in required pod-affinity
-    co-location deployments — must stay on the tensor path (VERDICT r3 #1)
-    and inside the <1s north star. Returns median warm solve seconds."""
+def _median_warm_solve(snap, runs: int = 3, require_tensor: bool = False) -> float:
+    """Warm a forced tensor solve on the snapshot, assert success, return the
+    median wall-clock of `runs` timed solves."""
     import statistics
 
     from karpenter_tpu.solver.tpu import TPUSolver
 
-    snap = build_snapshot(n_pods, n_types, affinity_frac=0.15)
     solver = TPUSolver(force=True)
-    results = solver.solve(snap)  # warm
-    assert solver.last_backend == "tpu", solver.last_fallback_reasons
-    assert not results.pod_errors
+    results = solver.solve(snap)  # warm: jit compile
+    if require_tensor:
+        assert solver.last_backend == "tpu", solver.last_fallback_reasons
+    assert not results.pod_errors, list(results.pod_errors.values())[:3]
     times = []
-    for _ in range(3):
+    for _ in range(runs):
         t0 = time.perf_counter()
         solver.solve(snap)
         times.append(time.perf_counter() - t0)
     return statistics.median(times)
+
+
+def bench_removal_delta(n_pods: int, n_types: int) -> dict:
+    """Steady-state churn in the REMOVAL direction (VERDICT r4 #4): warm the
+    solver on the full set, then ONE pending pod leaves (it bound) — the
+    dominant steady-state event. Returns the re-solve wall-clock + mode."""
+    from karpenter_tpu.solver.tpu import TPUSolver
+
+    snap = build_snapshot(n_pods, n_types)
+    solver = TPUSolver(force=True)
+    solver.solve(snap)  # warm + pack-state carry
+    snap.pods.pop()
+    solver.solve(snap)  # compiles the removal-delta kernel once
+    snap.pods.pop()
+    t0 = time.perf_counter()
+    results = solver.solve(snap)
+    dt = time.perf_counter() - t0
+    assert not results.pod_errors
+    out = {
+        "warm_resolve_1pod_removal_seconds": round(dt, 4),
+        "warm_resolve_removal_mode": solver.last_solve_mode,
+    }
+    # mixed churn: one pod leaves AND one arrives in the same reconcile
+    from helpers import make_pod
+
+    snap.pods.pop()
+    snap.pods.append(make_pod(cpu="500m", memory="512Mi"))
+    t0 = time.perf_counter()
+    results = solver.solve(snap)
+    out["warm_resolve_mixed_churn_seconds"] = round(time.perf_counter() - t0, 4)
+    out["warm_resolve_mixed_churn_mode"] = solver.last_solve_mode
+    assert not results.pod_errors
+    return out
+
+
+def bench_pvc(n_pods: int, n_types: int) -> float:
+    """The 50k workload with 20% of pods carrying a dynamically-provisioned
+    PVC (single WaitForFirstConsumer topology alternative + per-driver CSI
+    attach limits) — must stay on the tensor path (VERDICT r4 #3) and inside
+    the <1 s north star. Returns median warm solve seconds."""
+    return _median_warm_solve(build_snapshot(n_pods, n_types, pvc_frac=0.20), require_tensor=True)
+
+
+def bench_affinity(n_pods: int, n_types: int) -> float:
+    """The SAME 50k x 500 workload with 15% of pods in required pod-affinity
+    co-location deployments — must stay on the tensor path (VERDICT r3 #1)
+    and inside the <1s north star. Returns median warm solve seconds."""
+    return _median_warm_solve(build_snapshot(n_pods, n_types, affinity_frac=0.15), require_tensor=True)
 
 
 def bench_fallback_path(n_pods: int, n_types: int) -> float:
@@ -235,13 +409,10 @@ def bench_hostname_spread_xl() -> float:
     plain pods (3500m/28Gi) — ~2,000 open slots with no grouping win for the
     spread half. Reference budget: 35 MINUTES e2e. Returns median warm solve
     seconds through TPUSolver."""
-    import statistics
-
     from helpers import make_nodepool, make_pod
     from karpenter_tpu.apis import labels as wk
     from karpenter_tpu.kube import Store, TopologySpreadConstraint
     from karpenter_tpu.solver.snapshot import SolverSnapshot
-    from karpenter_tpu.solver.tpu import TPUSolver
     from karpenter_tpu.state import Cluster
     from karpenter_tpu.state.informer import start_informers
     from karpenter_tpu.utils.clock import FakeClock
@@ -268,15 +439,7 @@ def bench_hostname_spread_xl() -> float:
         instance_types={np_.metadata.name: instance_types_assorted(200)},
         state_nodes=[], daemonset_pods=[], pods=pods, clock=clock,
     )
-    solver = TPUSolver(force=True)
-    results = solver.solve(snap)  # warm
-    assert not results.pod_errors, list(results.pod_errors.values())[:3]
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        solver.solve(snap)
-        times.append(time.perf_counter() - t0)
-    return statistics.median(times)
+    return _median_warm_solve(snap)
 
 
 def bench_sharded_cpu(n_pods: int = 50000, n_types: int = 500, n_dev: int = 8) -> float | None:
@@ -439,64 +602,111 @@ def _command_savings(cmd) -> float:
 
 
 def main():
+    _install_guards(float(os.environ.get("BENCH_DEADLINE_SECONDS", "3300")))
+
+    # --- backend probe + degrade (before this process touches jax) ---
+    backend = "cpu" if "cpu" in os.environ.get("JAX_PLATFORMS", "") else None
+    if backend is None and os.environ.get("BENCH_SKIP_PROBE") != "1":
+        backend = probe_backend()
+        if backend is None:
+            # tunnel down (hangs/dies on first dispatch): force CPU in-process
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            backend = "cpu-degraded"
+        elif backend != "tpu":
+            # a softer failure: jax itself fell back to a non-TPU backend
+            backend = f"{backend}-degraded"
+    elif backend is None:
+        backend = "tpu"
+    if backend != "tpu":
+        # any non-TPU run uses reduced scale unless the caller pinned the
+        # scale explicitly — a full 50k CPU run would blow the deadline and
+        # produce the empty artifact this path exists to prevent
+        os.environ.setdefault("BENCH_PODS", "5000")
+        os.environ.setdefault("BENCH_TYPES", "100")
+        os.environ.setdefault("BENCH_NODES", "24")
+        os.environ.setdefault("BENCH_SKIP_XL", "1")
+        os.environ.setdefault("BENCH_SKIP_SHARDED", "1")
+        os.environ.setdefault("BENCH_WORST_TARGET", "1e9")
+        print(f"backend={backend}: non-TPU run at reduced scale", file=sys.stderr)
+
     n_pods = int(os.environ.get("BENCH_PODS", "50000"))
     n_types = int(os.environ.get("BENCH_TYPES", "500"))
     n_nodes = int(os.environ.get("BENCH_NODES", "256"))
+    extra = _RESULT["extra"]
+    extra["backend"] = backend
 
     if os.environ.get("BENCH_MODE") == "consolidation":
-        secs, extra = bench_consolidation(n_nodes)
-        print(
-            json.dumps(
-                {
-                    "metric": f"consolidation_{n_nodes}nodes_e2e_seconds",
-                    "value": round(secs, 4),
-                    "unit": "s",
-                    "vs_baseline": round(5.0 / secs, 2),
-                    "extra": extra,
-                }
+        out = _run_scenario("consolidation", bench_consolidation, n_nodes)
+        if out is not None:
+            secs, cons_extra = out
+            extra.update(cons_extra)
+            _RESULT.update(
+                metric=f"consolidation_{n_nodes}nodes_e2e_seconds",
+                value=round(secs, 4), unit="s", vs_baseline=round(5.0 / secs, 2),
             )
-        )
+        _emit_result()
         return
 
-    pods_per_sec, sched_extra = bench_scheduler(n_pods, n_types)
-    cons_secs, cons_extra = bench_consolidation(n_nodes)
-    extra = dict(sched_extra)
+    sched = _run_scenario("scheduler", bench_scheduler, n_pods, n_types)
+    if sched is not None:
+        pods_per_sec, sched_extra = sched
+        extra.update(sched_extra)
+        _RESULT.update(
+            metric=f"schedule_{n_pods}pods_x_{n_types}types_e2e_pods_per_sec",
+            value=round(pods_per_sec, 1), unit="pods/sec",
+            vs_baseline=round(pods_per_sec / 100.0, 2),
+        )
+    cons = _run_scenario("consolidation", bench_consolidation, n_nodes)
     # the same scale with 15% required-pod-affinity pods, still on-device
-    extra["affinity_50k_solve_seconds"] = round(bench_affinity(n_pods, n_types), 4)
+    aff = _run_scenario("affinity", bench_affinity, n_pods, n_types)
+    if aff is not None:
+        extra["affinity_50k_solve_seconds"] = round(aff, 4)
+    # steady-state churn: one pod REMOVED from the warm set (delta path, r5)
+    rem = _run_scenario("removal_delta", bench_removal_delta, n_pods, n_types)
+    if rem is not None:
+        extra.update(rem)
+    # 20% of pods carry a dynamically-provisioned PVC (tensor path, r5)
+    pvc = _run_scenario("pvc", bench_pvc, n_pods, n_types)
+    if pvc is not None:
+        extra["pvc_50k_solve_seconds"] = round(pvc, 4)
     # the reference's hardest packing case: hostname-spread XL (35-min budget)
-    extra["hostname_spread_xl_2000pods_seconds"] = round(bench_hostname_spread_xl(), 4)
+    xl = _run_scenario("hostname_xl", bench_hostname_spread_xl)
+    if xl is not None:
+        extra["hostname_spread_xl_2000pods_seconds"] = round(xl, 4)
     # the out-of-window cost at scale (host FFD fallback, measured not
     # hidden). Capped at 10k pods: the fallback is O(minutes) at 50k, which
     # is exactly the point — extrapolate linearly-or-worse from this line.
     if os.environ.get("BENCH_SKIP_FALLBACK") != "1":
         n_fb = min(n_pods, int(os.environ.get("BENCH_FALLBACK_PODS", "10000")))
-        extra[f"fallback_{n_fb}pods_seconds"] = round(bench_fallback_path(n_fb, n_types), 4)
+        fb = _run_scenario("fallback", bench_fallback_path, n_fb, n_types)
+        if fb is not None:
+            extra[f"fallback_{n_fb}pods_seconds"] = round(fb, 4)
     # the host FFD fallback path vs the reference's 100 pods/sec floor
-    extra["ffd_1000pods_per_sec"] = round(bench_ffd(1000), 1)
+    ffd = _run_scenario("ffd", bench_ffd, 1000)
+    if ffd is not None:
+        extra["ffd_1000pods_per_sec"] = round(ffd, 1)
     if os.environ.get("BENCH_FFD_XL"):
-        extra["ffd_10000pods_per_sec"] = round(bench_ffd(10000), 1)
+        ffd_xl = _run_scenario("ffd_xl", bench_ffd, 10000)
+        if ffd_xl is not None:
+            extra["ffd_10000pods_per_sec"] = round(ffd_xl, 1)
     # scaling: one warm 100k-pod run (2x the north-star count)
     if os.environ.get("BENCH_SKIP_XL") != "1":
-        extra["schedule_100000pods_seconds"] = round(bench_scaling_point(100000, n_types), 4)
+        sp = _run_scenario("scaling_100k", bench_scaling_point, 100000, n_types)
+        if sp is not None:
+            extra["schedule_100000pods_seconds"] = round(sp, 4)
     # sharded growth-path evidence: the 50k pack on an 8-virtual-CPU mesh
     if os.environ.get("BENCH_SKIP_SHARDED") != "1":
-        sh = bench_sharded_cpu(n_pods, n_types)
+        sh = _run_scenario("sharded_cpu", bench_sharded_cpu, n_pods, n_types)
         if sh is not None:
             extra["sharded_50k_cpu_seconds"] = round(sh, 4)
-    extra[f"consolidation_{n_nodes}nodes_e2e_seconds"] = round(cons_secs, 4)
-    extra["consolidation_vs_baseline"] = round(5.0 / cons_secs, 2)
-    extra.update({f"consolidation_{k}": v for k, v in cons_extra.items()})
-    print(
-        json.dumps(
-            {
-                "metric": f"schedule_{n_pods}pods_x_{n_types}types_e2e_pods_per_sec",
-                "value": round(pods_per_sec, 1),
-                "unit": "pods/sec",
-                "vs_baseline": round(pods_per_sec / 100.0, 2),
-                "extra": extra,
-            }
-        )
-    )
+    if cons is not None:
+        cons_secs, cons_extra = cons
+        extra[f"consolidation_{n_nodes}nodes_e2e_seconds"] = round(cons_secs, 4)
+        extra["consolidation_vs_baseline"] = round(5.0 / cons_secs, 2)
+        extra.update({f"consolidation_{k}": v for k, v in cons_extra.items()})
+    _emit_result()
 
 
 if __name__ == "__main__":

@@ -39,6 +39,7 @@ def make_pod(
     priority=None,
     annotations=None,
     owner_refs=None,
+    volumes=None,  # list of volume dicts (persistentVolumeClaim / ephemeral / ...)
 ):
     name = name or f"pod-{next(_seq)}"
     requests = {"cpu": cpu}
@@ -66,6 +67,7 @@ def make_pod(
             tolerations=tolerations or [],
             topology_spread_constraints=tsc or [],
             priority=priority,
+            volumes=volumes or [],
         ),
     )
     if owner_refs:
