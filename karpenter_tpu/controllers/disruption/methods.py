@@ -327,12 +327,13 @@ class SingleNodeConsolidation(_ConsolidationBase):
             if cmd.candidates and self._passes_balanced(cmd):
                 # 15s wait + re-simulation before execution
                 # (singlenodeconsolidation.go:105, validation.go:192-263)
+                # the reference persists unseen pools only on timeout and on a
+                # full pass; a command or validation failure leaves the prior
+                # set untouched (singlenodeconsolidation.go:61-74,105-115)
                 try:
                     Validator(self.ctx, self, mode="strict", metrics=self.ctx.metrics).validate(cmd)
                 except ValidationError:
-                    self.previously_unseen_node_pools = unseen
                     return []
-                self.previously_unseen_node_pools = unseen
                 return [cmd]
         self.previously_unseen_node_pools = unseen
         return []

@@ -275,15 +275,9 @@ class SchedulingNodeClaim:
     def add(self, pod, pod_data, updated_requirements: Requirements, updated_instance_types: list[InstanceType]) -> None:
         self.pods.append(pod)
         self.requirements = updated_requirements
-        # instance types dropped by this pod's narrowing release their
-        # superposition contributions, relaxing committed claims' pessimistic
-        # topology intersections (allocator.go "totalRequirements are updated
-        # each time instance types are released")
-        if self.allocator is not None and self._dra_claim_keys:
+        removed = set()
+        if self.allocator is not None and (self._dra_claim_keys or self._pending_dra_meta):
             removed = {it.name for it in self.instance_type_options} - {it.name for it in updated_instance_types}
-            if removed:
-                for ck in self._dra_claim_keys:
-                    self.allocator.release_instance_types(ck, removed)
         self.instance_type_options = updated_instance_types
         self.spec_requests = res.merge(self.spec_requests, pod_data.requests)
         if self.reservation_manager is not None:
@@ -306,6 +300,14 @@ class SchedulingNodeClaim:
                 self._dra_claim_keys.update(self._pending_dra_meta)
             self._pending_dra = None
             self._pending_dra_meta = None
+        # single release site: instance types dropped by this pod's narrowing
+        # (the pre-add option set is a superset of every claim's superposition
+        # filter set, so `removed` covers prior AND just-committed claims)
+        # relax committed claims' pessimistic contributions (allocator.go
+        # "totalRequirements are updated each time instance types are released")
+        if self.allocator is not None and self._dra_claim_keys and removed:
+            for ck in self._dra_claim_keys:
+                self.allocator.release_instance_types(ck, removed)
         # track host ports per daemon group so future pods see conflicts
         ports = pod_host_ports(pod)
         for g in self.daemon_overhead_groups:

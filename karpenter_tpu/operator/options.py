@@ -115,7 +115,13 @@ class Options:
 
         o = cls.from_env()
         # Go's flag package accepts single-dash flags; normalize to two
-        argv = ["-" + a if a.startswith("-") and not a.startswith("--") and len(a) > 2 else a for a in argv]
+        # (only tokens that look like flags — a negative value such as
+        # `--memory-limit -100` must pass through untouched, as Go's flag
+        # package accepts the space-separated form)
+        argv = [
+            "-" + a if a.startswith("-") and not a.startswith("--") and len(a) > 2 and a[1].isalpha() else a
+            for a in argv
+        ]
         parser = argparse.ArgumentParser(add_help=False, allow_abbrev=False)
         for flag, (attr, conv) in _FLAG_TABLE.items():
             if conv is _parse_bool:
@@ -125,7 +131,9 @@ class Options:
                 parser.add_argument("--" + flag, default=None)
         parser.add_argument("--feature-gates", default=None)
         ns, unknown = parser.parse_known_args(argv)
-        bad = [a for a in unknown if a.startswith("--")]
+        # fail closed on any stray dash token (including `-100` whose flag was
+        # forgotten — Go errors with 'flag provided but not defined')
+        bad = [a for a in unknown if a.startswith("-")]
         if bad:
             raise ValueError(f"unknown flags: {', '.join(bad)}")
         for flag, (attr, conv) in _FLAG_TABLE.items():

@@ -89,6 +89,10 @@ class StateNode:
             ("node.cloudprovider.kubernetes.io/uninitialized", "NoSchedule"),
         }
     )
+    # key-prefix families treated the same way regardless of effect
+    # (taints.go KnownEphemeralTaintKeyPrefixes): readiness gates published by
+    # readiness controllers lift once the node warms up
+    KNOWN_EPHEMERAL_TAINT_KEY_PREFIXES = ("readiness.k8s.io/",)
 
     def taints(self) -> list[Taint]:
         """Node taints, filtering the transient karpenter lifecycle taints that
@@ -112,7 +116,9 @@ class StateNode:
             out = [
                 t
                 for t in out
-                if (t.key, t.effect) not in self.KNOWN_EPHEMERAL_TAINTS and (t.key, t.effect) not in startup
+                if (t.key, t.effect) not in self.KNOWN_EPHEMERAL_TAINTS
+                and (t.key, t.effect) not in startup
+                and not t.key.startswith(self.KNOWN_EPHEMERAL_TAINT_KEY_PREFIXES)
             ]
         return out
 

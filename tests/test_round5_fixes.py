@@ -1,0 +1,171 @@
+"""Pins for the round-5 advisor fixes.
+
+References: singlenodeconsolidation.go:61-115 (unseen-pool persistence),
+scheduling/taints.go KnownEphemeralTaintKeyPrefixes, Go stdlib flag parsing
+(space-separated negative values), dra allocator totalRequirements release.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import COND_REGISTERED, NodeClaim
+from karpenter_tpu.controllers.disruption.types import Command
+from karpenter_tpu.kube import Node, ObjectMeta
+from karpenter_tpu.kube.objects import NodeSpec
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.scheduling.taints import Taint
+from karpenter_tpu.state.statenode import StateNode
+
+from test_consolidation_timeouts import make_candidate, make_ctx
+
+
+class TestUnseenPoolPersistence:
+    """SingleNodeConsolidation persists unseenNodePools only on timeout and on
+    full-pass completion; returning a command or failing validation leaves the
+    previous round's set untouched (singlenodeconsolidation.go:61-74)."""
+
+    def _method(self, ctx):
+        from karpenter_tpu.controllers.disruption.methods import SingleNodeConsolidation
+
+        method = SingleNodeConsolidation(ctx)
+        method.should_disrupt = lambda c: True
+        return method
+
+    def test_command_return_leaves_unseen_untouched(self, monkeypatch):
+        import karpenter_tpu.controllers.disruption.validation as validation
+
+        ctx = make_ctx()
+        method = self._method(ctx)
+        method.previously_unseen_node_pools = {"carried"}
+        cmd = Command()
+        cmd.candidates = [make_candidate("pa")]
+        method.compute_consolidation = lambda cs: cmd
+        method._passes_balanced = lambda c: True
+        monkeypatch.setattr(
+            validation, "Validator", lambda *a, **k: SimpleNamespace(validate=lambda c: None)
+        )
+        out = method.compute_commands([make_candidate("pa"), make_candidate("pb")], {"pa": 1, "pb": 1})
+        assert out == [cmd]
+        # pb was never reached, but a successful command is not a timeout:
+        # the carried set stays exactly as the previous round left it
+        assert method.previously_unseen_node_pools == {"carried"}
+
+    def test_validation_failure_leaves_unseen_untouched(self, monkeypatch):
+        import karpenter_tpu.controllers.disruption.validation as validation
+
+        ctx = make_ctx()
+        method = self._method(ctx)
+        method.previously_unseen_node_pools = {"carried"}
+        cmd = Command()
+        cmd.candidates = [make_candidate("pa")]
+        method.compute_consolidation = lambda cs: cmd
+        method._passes_balanced = lambda c: True
+
+        def _raise(c):
+            raise validation.ValidationError("churn", "changed")
+
+        monkeypatch.setattr(validation, "Validator", lambda *a, **k: SimpleNamespace(validate=_raise))
+        out = method.compute_commands([make_candidate("pa")], {"pa": 1})
+        assert out == []
+        assert method.previously_unseen_node_pools == {"carried"}
+
+
+class TestReadinessPrefixTaints:
+    """readiness.k8s.io/-prefixed taints on managed-but-uninitialized nodes are
+    ephemeral (taints.go KnownEphemeralTaintKeyPrefixes): scheduling must
+    assume they lift, or startup readiness gates cause over-provisioning."""
+
+    def _node_with(self, *taints):
+        node = Node(
+            metadata=ObjectMeta(name="n1", labels={wk.HOSTNAME_LABEL_KEY: "n1"}),
+            spec=NodeSpec(taints=list(taints)),
+        )
+        claim = NodeClaim(metadata=ObjectMeta(name="c1"))
+        claim.status.conditions.set_true(COND_REGISTERED)
+        return StateNode(node=node, node_claim=claim)
+
+    def test_prefix_filtered_while_uninitialized(self):
+        sn = self._node_with(
+            Taint(key="readiness.k8s.io/some-gate", value="", effect="NoSchedule"),
+            Taint(key="user.example.com/dedicated", value="x", effect="NoSchedule"),
+        )
+        keys = [t.key for t in sn.taints()]
+        assert "readiness.k8s.io/some-gate" not in keys
+        assert "user.example.com/dedicated" in keys
+
+    def test_prefix_kept_once_initialized(self):
+        from karpenter_tpu.apis.nodeclaim import COND_INITIALIZED
+
+        sn = self._node_with(Taint(key="readiness.k8s.io/some-gate", value="", effect="NoSchedule"))
+        sn.node_claim.status.conditions.set_true(COND_INITIALIZED)
+        assert [t.key for t in sn.taints()] == ["readiness.k8s.io/some-gate"]
+
+
+class TestNegativeFlagValues:
+    """Go's flag package accepts `--flag -100` (space-separated negative
+    value); the single-dash normalization must not rewrite the value token."""
+
+    def test_space_separated_negative_value(self):
+        o = Options.from_args(["--cpu-requests", "-100"])
+        assert o.cpu_requests == -100
+
+    def test_single_dash_flags_still_normalized(self):
+        o = Options.from_args(["-metrics-port", "7001"])
+        assert o.metrics_port == 7001
+
+    def test_stray_dash_digit_token_fails_closed(self):
+        # a value whose flag was forgotten must not be silently dropped
+        # (Go: 'flag provided but not defined: -100')
+        with pytest.raises(ValueError):
+            Options.from_args(["-100"])
+        with pytest.raises(ValueError):
+            Options.from_args(["--metrics-port", "7001", "-100"])
+
+
+class TestSuperpositionReleaseOnCommit:
+    """Instance types pruned between the DRA superposition filter and the
+    final updated_instance_types of the same can_add must release their
+    contributions for the just-committed claims too (allocator.go
+    totalRequirements 'updated each time instance types are released')."""
+
+    def test_commit_releases_pruned_instance_types(self):
+        from karpenter_tpu.scheduling.dynamicresources.allocator import Allocator
+
+        alloc = Allocator.__new__(Allocator)
+        alloc.claim_allocation_metadata = {}
+        released = []
+        alloc.release_instance_types = lambda ck, names: released.append((ck, set(names)))
+        alloc.commit_template_metadata = lambda metas: alloc.claim_allocation_metadata.update(metas)
+
+        from karpenter_tpu.controllers.provisioning.scheduling import nodeclaim as nc_mod
+
+        claim = nc_mod.SchedulingNodeClaim.__new__(nc_mod.SchedulingNodeClaim)
+        claim.pods = []
+        claim.allocator = alloc
+        claim._dra_claim_keys = set()
+        claim.dra_trackers = {}
+        claim._pending_dra = {}
+        meta = SimpleNamespace(contributed={"it-a": 1, "it-b": 1}, devices={}, recompute_total=lambda: None)
+        claim._pending_dra_meta = {"ns/claim": meta}
+        claim.reservation_manager = None
+        claim.instance_type_options = [SimpleNamespace(name="it-a"), SimpleNamespace(name="it-b")]
+        claim.spec_requests = {}
+        claim.daemon_overhead_groups = []
+        claim.topology = SimpleNamespace(record=lambda *a, **k: None)
+        claim.template = SimpleNamespace(taints=[])
+        claim.requirements = None
+
+        pod = SimpleNamespace(
+            key=lambda: "default/p",
+            spec=SimpleNamespace(containers=[], init_containers=[], host_network=False),
+        )
+        pod_data = SimpleNamespace(requests={})
+        kept = [SimpleNamespace(name="it-a")]
+        claim.add(pod, pod_data, updated_requirements=None, updated_instance_types=kept)
+        assert released == [("ns/claim", {"it-b"})]
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))
