@@ -127,7 +127,7 @@ class DisruptionController:
         pdb = PDBLimits(self.store)
         disrupting = self.queue.disrupting_names()
         out = []
-        for sn in self.cluster.nodes():
+        for sn in self.cluster.nodes_view():
             if sn.name() in disrupting:
                 continue
             candidate, err = build_candidate(

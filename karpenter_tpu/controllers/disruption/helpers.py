@@ -12,14 +12,15 @@ def simulate_scheduling(provisioner, cluster, candidates: list, clock):
     pending set, and Solve (helpers.go:53-154). The Solver plugin (FFD or TPU)
     is reused for free — the simulation IS a solve on a modified snapshot."""
     candidate_names = {c.name() for c in candidates}
+    all_nodes = cluster.nodes_view()
     state_nodes = [
         n
-        for n in cluster.nodes()
+        for n in all_nodes
         if n.name() not in candidate_names and not n.marked_for_deletion and not n.deleted()
     ]
     pending = provisioner.get_pending_pods()
     deleting_pods = []
-    for n in cluster.nodes():
+    for n in all_nodes:
         if (n.marked_for_deletion or n.deleted()) and n.name() not in candidate_names:
             for key in n.pod_requests:
                 ns, name = key.split("/", 1)
@@ -53,7 +54,7 @@ def build_disruption_budget_mapping(store, cluster, clock, reason: str) -> dict[
     mapping: dict[str, int] = {}
     deleting: dict[str, int] = {}
     counts: dict[str, int] = {}
-    for n in cluster.nodes():
+    for n in cluster.nodes_view():
         pool = n.nodepool_name()
         if pool is None:
             continue

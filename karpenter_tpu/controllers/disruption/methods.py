@@ -304,12 +304,18 @@ class SingleNodeConsolidation(_ConsolidationBase):
     def compute_commands(self, candidates, budgets) -> list[Command]:
         from .validation import ValidationError, Validator
 
+        import time as _time
+
         eligible = self.sort_candidates([c for c in candidates if self.should_disrupt(c)])
         deadline = self.ctx.clock.now() + SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS
+        # the reference's 3-minute budget is measured on a REAL clock; the
+        # injected deterministic clock doesn't advance during compute, so the
+        # wall bound must also apply or a large fleet makes one round unbounded
+        wall_deadline = _time.monotonic() + SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS
         unseen = {c.node_pool.metadata.name for c in eligible}
         allowed = dict(budgets)
         for c in eligible:
-            if self.ctx.clock.now() > deadline:
+            if self.ctx.clock.now() > deadline or _time.monotonic() > wall_deadline:
                 # abandon the round; pools not yet reached get priority next
                 # time (singlenodeconsolidation.go:61-74)
                 self._count_timeout()

@@ -33,7 +33,7 @@ class LifecycleController:
         self.metrics = metrics
 
     def reconcile_all(self) -> None:
-        for nc in self.store.list("NodeClaim"):
+        for nc in self.store.borrow_list("NodeClaim"):
             self.reconcile(nc.metadata.name)
 
     def reconcile(self, name: str) -> None:
@@ -233,9 +233,11 @@ class LifecycleController:
         self.store.remove_finalizer("NodeClaim", nc.metadata.name, wk.TERMINATION_FINALIZER)
 
     def _node_for(self, nc: NodeClaim):
-        for node in self.store.list("Node"):
+        # borrowed scan to find the match, clone only the hit (callers mutate
+        # the returned node and write it back)
+        for node in self.store.borrow_list("Node"):
             if node.spec.provider_id == nc.status.provider_id:
-                return node
+                return self.store.get("Node", node.metadata.name)
         return None
 
 

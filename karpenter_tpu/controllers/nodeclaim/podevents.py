@@ -54,11 +54,12 @@ class PodEventsController:
         self._stamp(pod.spec.node_name)
 
     def _stamp(self, node_name: str) -> None:
-        node = self.store.try_get("Node", node_name)
+        node = self.store.borrow_get("Node", node_name)
         if node is None:
             return
+        provider_id = node.spec.provider_id
         nc = next(
-            (c for c in self.store.list("NodeClaim") if c.status.node_name == node_name or c.status.provider_id == node.spec.provider_id),
+            (c for c in self.store.borrow_list("NodeClaim") if c.status.node_name == node_name or c.status.provider_id == provider_id),
             None,
         )
         if nc is None:

@@ -87,9 +87,14 @@ class Provisioner:
         (provisioner.go:556-566)."""
         from .scheduling.volumetopology import VolumeTopology
 
+        from ...kube.clone import fast_deepcopy
+
         vt = VolumeTopology(self.store)
         out = []
-        for pod in self.store.list("Pod"):
+        # filter over the borrowed cache view (most pods are bound — cloning
+        # the full list per call dominated at reference scale), then clone
+        # only the survivors: callers may mutate them (preference relaxation)
+        for pod in self.store.borrow_list("Pod"):
             if not pod_utils.is_provisionable(pod):
                 continue
             verr = vt.validate_persistent_volume_claims(pod)
@@ -97,7 +102,7 @@ class Provisioner:
                 if self.recorder is not None:
                     self.recorder.publish(pod, "FailedScheduling", f"ignoring pod, {verr}", type_="Warning")
                 continue
-            out.append(pod)
+            out.append(fast_deepcopy(pod))
         # CapacityBuffer virtual pods join AFTER validation so they skip PVC
         # checks and never round-trip through the store (buffers.go:37-87)
         if self.options.capacity_buffer_enabled:

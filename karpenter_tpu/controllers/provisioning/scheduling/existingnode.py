@@ -43,7 +43,7 @@ class ExistingNode:
             if ports and self.host_port_usage.conflicts(d.key(), ports) is None:
                 self.host_port_usage.add(f"daemon-headroom/{d.key()}", ports)
         self.volume_usage = state_node.volume_usage.copy()
-        self.requirements = Requirements.from_labels(state_node.labels())
+        self.requirements = Requirements.from_labels_view(state_node.labels()).copy_shallow()
         self.requirements.add(Requirement(wk.HOSTNAME_LABEL_KEY, "In", [state_node.hostname()]))
         topology.register(wk.HOSTNAME_LABEL_KEY, state_node.hostname())
 

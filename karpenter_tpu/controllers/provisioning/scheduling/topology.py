@@ -334,6 +334,61 @@ def _selector_key(selector: Optional[dict]):
     return (ml, me)
 
 
+# domain-group construction iterates every (NodePool x InstanceType x
+# requirement key) — ~1e3 Requirements builds — yet its inputs change only
+# when a NodePool template or the instance-type catalog does. Cached across
+# Scheduler builds (each consolidation simulation builds one) keyed on
+# template content + catalog list identity; groups are read-only after build
+# (insert happens only inside _build_domain_groups).
+_DOMAIN_GROUPS_CACHE: dict = {}
+
+# whether a node participates in a spread topology depends only on the group's
+# filter identity and the node's content — memoized across the many Topology
+# instances the consolidation loop builds per round (one per simulation)
+_NODE_MATCH_CACHE: dict = {}
+
+
+def _node_filter_matches_cached(tg, tg_hash: tuple, node, scope) -> bool:
+    # `scope` is the owning Cluster's process-unique epoch: (name, rv) pairs
+    # repeat across Environments in one process, so verdicts must not leak
+    # between stores
+    key = (scope, tg_hash, node.metadata.name, node.metadata.resource_version)
+    hit = _NODE_MATCH_CACHE.get(key)
+    if hit is None:
+        if len(_NODE_MATCH_CACHE) > 200_000:
+            _NODE_MATCH_CACHE.clear()
+        hit = _NODE_MATCH_CACHE[key] = tg.node_filter.matches(
+            node.spec.taints, Requirements.from_labels_view(node.metadata.labels)
+        )
+    return hit
+
+
+def _nodepool_template_fingerprint(np) -> tuple:
+    t = np.spec.template
+    return (
+        np.metadata.name,
+        repr(t.requirements),
+        repr(t.labels),
+        tuple(t.taints),
+    )
+
+
+def _domain_groups_cached(node_pools, instance_types: dict[str, list]) -> dict:
+    key = tuple(sorted(_nodepool_template_fingerprint(np) for np in node_pools))
+    entry = _DOMAIN_GROUPS_CACHE.get(key)
+    if entry is not None:
+        cached_its, groups = entry
+        if len(cached_its) == len(instance_types) and all(
+            cached_its.get(name) is its for name, its in instance_types.items()
+        ):
+            return groups
+    groups = Topology._build_domain_groups(node_pools, instance_types)
+    if len(_DOMAIN_GROUPS_CACHE) > 8:
+        _DOMAIN_GROUPS_CACHE.clear()
+    _DOMAIN_GROUPS_CACHE[key] = (dict(instance_types), groups)
+    return groups
+
+
 class Topology:
     """The per-solve topology state (topology.go:47-103)."""
 
@@ -353,7 +408,7 @@ class Topology:
         self.preference_policy = preference_policy
         self.topology_groups: dict[tuple, TopologyGroup] = {}
         self.inverse_topology_groups: dict[tuple, TopologyGroup] = {}
-        self.domain_groups = self._build_domain_groups(node_pools, instance_types)
+        self.domain_groups = _domain_groups_cached(node_pools, instance_types)
         self.excluded_pods: set[str] = set()
         self._prepared = False
         if pods:
@@ -488,7 +543,7 @@ class Topology:
         for pod in self.cluster.pods_with_anti_affinity():
             if pod.metadata.uid in self.excluded_pods:
                 continue
-            node = self.store.try_get("Node", pod.spec.node_name) if pod.spec.node_name else None
+            node = self.store.borrow_get("Node", pod.spec.node_name) if pod.spec.node_name else None
             self._update_inverse_anti_affinity(pod, node.metadata.labels if node else None)
 
     def _update_inverse_anti_affinity(self, pod, node_labels: Optional[dict]) -> None:
@@ -520,10 +575,12 @@ class Topology:
 
     def _count_domains(self, tg: TopologyGroup) -> None:
         """Initialize counts from existing scheduled pods (topology.go:361-459)."""
+        tg_hash = tg.hash_key()
+        scope = getattr(self.cluster, "epoch", None) or id(self.store)
         for n in self.state_nodes:
             if n.node is None:
                 continue
-            if not tg.node_filter.matches(n.node.spec.taints, Requirements.from_labels(n.node.metadata.labels)):
+            if not _node_filter_matches_cached(tg, tg_hash, n.node, scope):
                 continue
             domain = n.labels().get(tg.key)
             if domain is not None:
@@ -534,14 +591,15 @@ class Topology:
             # domains above are still registered
         node_cache: dict[str, object] = {}
         for ns in tg.namespaces:
-            for pod in self.store.list("Pod", namespace=ns, label_selector=tg.selector):
+            # borrowed reads: pure counting over the informer-cache view
+            for pod in self.store.borrow_list("Pod", namespace=ns, label_selector=tg.selector):
                 if not pod.spec.node_name or pod.metadata.uid in self.excluded_pods:
                     continue
                 if ignored_for_topology(pod):
                     continue
                 node = node_cache.get(pod.spec.node_name)
                 if node is None:
-                    node = self.store.try_get("Node", pod.spec.node_name)
+                    node = self.store.borrow_get("Node", pod.spec.node_name)
                     if node is None:
                         continue
                     node_cache[pod.spec.node_name] = node
@@ -550,7 +608,7 @@ class Topology:
                     domain = node.metadata.name
                 if domain is None:
                     continue
-                if not tg.node_filter.matches(node.spec.taints, Requirements.from_labels(node.metadata.labels)):
+                if not _node_filter_matches_cached(tg, tg_hash, node, scope):
                     continue
                 tg.record(domain)
 

@@ -53,10 +53,16 @@ class Binder:
         # per-node host-port usage, built once per pass from ACTIVE bound
         # pods (terminal pods free their ports, as in Kubernetes)
         self._port_usage = {}
+        # bound-pod index by node, maintained as the pass binds: required
+        # hostname anti-affinity only ever inspects the candidate node's own
+        # pods, so the check must not rescan the whole pod list per node
+        self._pods_by_node = {}
         for q in all_pods:
             if q.spec.node_name and pod_utils.is_active(q):
                 self._port_usage.setdefault(q.spec.node_name, HostPortUsage()).add(q.key(), pod_host_ports(q))
+                self._pods_by_node.setdefault(q.spec.node_name, []).append(q)
         self._dra_allocator = None  # fresh per pass
+        self._node_domain = None  # lazy per-pass node->labels map for spreads
         for pod in all_pods:
             if not pod_utils.is_provisionable(pod):
                 continue
@@ -65,6 +71,7 @@ class Binder:
                 self._bind(pod, node)
                 pod.spec.node_name = node.metadata.name  # keep local view current for spread counting
                 self._port_usage.setdefault(node.metadata.name, HostPortUsage()).add(pod.key(), pod_host_ports(pod))
+                self._pods_by_node.setdefault(node.metadata.name, []).append(pod)
                 bound += 1
         return bound
 
@@ -129,10 +136,12 @@ class Binder:
         from .objects import match_label_selector
         from ..controllers.provisioning.scheduling.topology import effective_spread_selector
 
-        node_domain = {n.metadata.name: n.metadata.labels for n in nodes}
         for tsc in pod.spec.topology_spread_constraints:
             if tsc.when_unsatisfiable != "DoNotSchedule":
                 continue
+            node_domain = self._node_domain
+            if node_domain is None:
+                node_domain = self._node_domain = {n.metadata.name: n.metadata.labels for n in nodes}
             eff_sel = effective_spread_selector(pod, tsc)
             counts: dict[str, int] = {}
             for n in nodes:
@@ -158,10 +167,11 @@ class Binder:
             for term in aff.pod_anti_affinity_required:
                 if term.topology_key != wk.HOSTNAME_LABEL_KEY:
                     continue
-                for q in all_pods:
-                    if q.spec.node_name == node.metadata.name and q.metadata.namespace == pod.metadata.namespace:
-                        if match_label_selector(term.label_selector, q.metadata.labels):
-                            return False
+                for q in self._pods_by_node.get(node.metadata.name, ()):
+                    if q.metadata.namespace == pod.metadata.namespace and match_label_selector(
+                        term.label_selector, q.metadata.labels
+                    ):
+                        return False
         return True
 
     def _bind(self, pod, node) -> None:

@@ -10,8 +10,9 @@ to informers.
 
 from __future__ import annotations
 
-import copy
 import threading
+
+from .clone import fast_deepcopy
 from typing import Callable, Iterable, Optional
 
 
@@ -99,7 +100,7 @@ class Store:
                     event, obj = self._pending.pop(0)
                     watchers = list(self._watchers.get(obj.kind, ()))
                 for fn in watchers:
-                    fn(event, copy.deepcopy(obj))
+                    fn(event, fast_deepcopy(obj))
 
     # -- CRUD ------------------------------------------------------------------
     def create(self, obj):
@@ -109,14 +110,14 @@ class Store:
             if key in kind_map:
                 raise AlreadyExists(f"{obj.kind} {key} already exists")
             self._rv += 1
-            obj = copy.deepcopy(obj)
+            obj = fast_deepcopy(obj)
             obj.metadata.resource_version = self._rv
             if not obj.metadata.creation_timestamp:
                 obj.metadata.creation_timestamp = self._now()
             kind_map[key] = obj
             self._enqueue("ADDED", obj)
         self._drain()
-        return copy.deepcopy(obj)
+        return fast_deepcopy(obj)
 
     def get(self, kind: str, name: str, namespace: str = "default"):
         with self._lock:
@@ -124,7 +125,7 @@ class Store:
             obj = self._objects.get(kind, {}).get(key)
             if obj is None:
                 raise NotFound(f"{kind} {key} not found")
-            return copy.deepcopy(obj)
+            return fast_deepcopy(obj)
 
     def try_get(self, kind: str, name: str, namespace: str = "default"):
         try:
@@ -135,6 +136,19 @@ class Store:
     def list(self, kind: str, namespace: Optional[str] = None, label_selector: Optional[dict] = None) -> list:
         """label_selector accepts either the flat {key: value} form or the
         metav1 {matchLabels, matchExpressions} form."""
+        # cloning outside the lock is safe: stored objects are replaced on
+        # update, never mutated in place
+        return [fast_deepcopy(o) for o in self.borrow_list(kind, namespace, label_selector)]
+
+    # -- borrowed reads --------------------------------------------------------
+    # client-go's shared informer cache hands controllers pointers into the
+    # cache with a MUST-NOT-MUTATE contract — that is what makes the
+    # reference's read paths cheap. These are the same primitive: the returned
+    # objects are the stored ones; callers may only read them, never mutate or
+    # retain them across writes. Hot read-only scans (topology domain counting,
+    # provisionable-pod filtering, monitors) use these; anything that mutates
+    # goes through get/list, which clone.
+    def borrow_list(self, kind: str, namespace: Optional[str] = None, label_selector: Optional[dict] = None) -> list:
         with self._lock:
             out = []
             for obj in self._objects.get(kind, {}).values():
@@ -142,8 +156,13 @@ class Store:
                     continue
                 if label_selector is not None and not _selector_matches(label_selector, obj.metadata.labels):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(obj)
             return out
+
+    def borrow_get(self, kind: str, name: str, namespace: str = "default"):
+        with self._lock:
+            key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
+            return self._objects.get(kind, {}).get(key)
 
     def update(self, obj):
         """Optimistic-concurrency full update; raises Conflict on stale RV."""
@@ -158,7 +177,7 @@ class Store:
                     f"{obj.kind} {key}: resourceVersion {obj.metadata.resource_version} != {current.metadata.resource_version}"
                 )
             self._rv += 1
-            obj = copy.deepcopy(obj)
+            obj = fast_deepcopy(obj)
             # deletionTimestamp is set only by delete(); preserve server-side value
             obj.metadata.deletion_timestamp = current.metadata.deletion_timestamp
             obj.metadata.resource_version = self._rv
@@ -173,7 +192,7 @@ class Store:
                 kind_map[key] = obj
                 self._enqueue("MODIFIED", obj)
         self._drain()
-        return copy.deepcopy(obj)
+        return fast_deepcopy(obj)
 
     def patch(self, kind: str, name: str, fn: Callable[[object], None], namespace: str = "default", retries: int = 10):
         """Read-modify-write with retry — the common controller patch idiom."""
@@ -189,7 +208,7 @@ class Store:
     def update_status(self, obj):
         """Status-subresource style update: spec/labels on the server win."""
         def apply(cur):
-            cur.status = copy.deepcopy(obj.status)
+            cur.status = fast_deepcopy(obj.status)
         ns = getattr(obj.metadata, "namespace", "default")
         return self.patch(obj.kind, obj.metadata.name, apply, namespace=ns)
 
@@ -207,7 +226,7 @@ class Store:
             if obj.metadata.finalizers and grace:
                 if obj.metadata.deletion_timestamp is None:
                     obj.metadata.deletion_timestamp = self._now()
-                self._enqueue("MODIFIED", copy.deepcopy(obj))
+                self._enqueue("MODIFIED", fast_deepcopy(obj))
             else:
                 del kind_map[key]
                 self._enqueue("DELETED", obj)

@@ -240,6 +240,9 @@ class IncompatibleError(Exception):
     """Raised (or returned) when two Requirements sets cannot intersect."""
 
 
+_LABELS_VIEW_CACHE: dict = {}
+
+
 class Requirements:
     """A set of Requirements keyed by label, where Add() intersects
     (requirements.go:131-140). Not a dict subclass so we control mutation.
@@ -258,6 +261,20 @@ class Requirements:
         for k, v in (labels or {}).items():
             r.add(Requirement(k, Operator.IN, [v]))
         return r
+
+    @classmethod
+    def from_labels_view(cls, labels: Mapping[str, str] | None) -> "Requirements":
+        """Memoized from_labels for hot read-only call sites (topology domain
+        counting runs it per node per group per solve). The returned object is
+        SHARED — callers must only read (`matches`, `get`, `compatible`),
+        never `add` into it."""
+        key = tuple(sorted((labels or {}).items()))
+        out = _LABELS_VIEW_CACHE.get(key)
+        if out is None:
+            if len(_LABELS_VIEW_CACHE) > 16384:
+                _LABELS_VIEW_CACHE.clear()
+            out = _LABELS_VIEW_CACHE.setdefault(key, cls.from_labels(labels))
+        return out
 
     @classmethod
     def from_node_selector_terms(cls, terms: Iterable[Mapping] | None) -> "Requirements":
@@ -324,6 +341,15 @@ class Requirements:
     def copy(self) -> "Requirements":
         r = Requirements()
         r._m = {k: v.copy() for k, v in self._m.items()}
+        return r
+
+    def copy_shallow(self) -> "Requirements":
+        """Copy sharing the Requirement entries. Safe because entries are
+        immutable by convention — every in-place mutation site copies the
+        entry first (see the minValues copy-on-write in nodeclaim.py) and
+        add() rebinds keys to new intersection objects."""
+        r = Requirements()
+        r._m = dict(self._m)
         return r
 
     def __len__(self) -> int:

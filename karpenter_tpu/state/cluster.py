@@ -66,10 +66,10 @@ class Cluster:
         with self._lock:
             claim_ids = {
                 nc.status.provider_id
-                for nc in self.store.list("NodeClaim")
+                for nc in self.store.borrow_list("NodeClaim")
                 if nc.status.provider_id and nc.metadata.deletion_timestamp is None
             }
-            node_ids = {n.spec.provider_id for n in self.store.list("Node") if n.spec.provider_id}
+            node_ids = {n.spec.provider_id for n in self.store.borrow_list("Node") if n.spec.provider_id}
             known = set(self._nodes.keys())
             return claim_ids.issubset(known) and node_ids.issubset(known)
 
@@ -77,6 +77,16 @@ class Cluster:
     def nodes(self) -> list[StateNode]:
         with self._lock:
             return [n.shallow_copy() for n in self._nodes.values()]
+
+    def nodes_view(self) -> list[StateNode]:
+        """Borrowed views of the live StateNodes (read-only contract, like
+        Store.borrow_list). The consolidation loop builds one scheduling
+        simulation per candidate; shallow-copying every StateNode per
+        simulation dominated at reference scale. The scheduler and candidate
+        builders only read (ExistingNode copies usage/derived state into its
+        own fields before mutating)."""
+        with self._lock:
+            return list(self._nodes.values())
 
     def node_for_name(self, name: str) -> Optional[StateNode]:
         with self._lock:
@@ -284,7 +294,9 @@ class Cluster:
         sn = self._state_node_for(node_name)
         if sn is None:
             return
-        for pod in self.store.list("Pod"):
+        # borrowed scan: update_for_pod derives requests/ports and retains
+        # nothing from the pod object
+        for pod in self.store.borrow_list("Pod"):
             if pod.spec.node_name == node_name and pod_utils.is_active(pod):
                 self._bindings[pod.key()] = node_name
                 sn.update_for_pod(pod, volumes=get_volumes(self.store, pod))
@@ -297,11 +309,12 @@ class Cluster:
                 sn.node_claim.status.last_pod_event_time = now
 
     def pods_with_anti_affinity(self) -> list:
+        """Borrowed views — consumers (inverse-affinity counting) only read."""
         with self._lock:
             out = []
             for key in self._anti_affinity_pods:
                 ns, name = key.split("/", 1)
-                pod = self.store.try_get("Pod", name, ns)
+                pod = self.store.borrow_get("Pod", name, ns)
                 if pod is not None:
                     out.append(pod)
             return out

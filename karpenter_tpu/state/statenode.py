@@ -38,6 +38,8 @@ class StateNode:
         self.volume_usage = VolumeUsage()
         self.marked_for_deletion = False
         self.nominated_until = 0.0
+        self._total_pod_requests: Optional[dict[str, Quantity]] = None
+        self._total_daemon_requests: Optional[dict[str, Quantity]] = None
 
     # -- identity --------------------------------------------------------------
     def name(self) -> str:
@@ -152,10 +154,17 @@ class StateNode:
         return self.node.status.allocatable if self.node is not None else {}
 
     def total_pod_requests(self) -> dict[str, Quantity]:
-        return res.merge(*self.pod_requests.values())
+        # memoized: every consolidation simulation rebuilds an ExistingNode
+        # from this; the merge over all pods is invalidated only when the
+        # pod set changes (update_for_pod/cleanup_for_pod)
+        if self._total_pod_requests is None:
+            self._total_pod_requests = res.merge(*self.pod_requests.values())
+        return self._total_pod_requests
 
     def total_daemon_requests(self) -> dict[str, Quantity]:
-        return res.merge(*self.daemonset_requests.values())
+        if self._total_daemon_requests is None:
+            self._total_daemon_requests = res.merge(*self.daemonset_requests.values())
+        return self._total_daemon_requests
 
     def available(self) -> dict[str, Quantity]:
         """allocatable - all pod requests (statenode.go:395)."""
@@ -168,6 +177,8 @@ class StateNode:
 
     # -- pod tracking ----------------------------------------------------------
     def update_for_pod(self, pod, volumes: dict | None = None) -> None:
+        self._total_pod_requests = None
+        self._total_daemon_requests = None
         key = pod.key()
         requests = res.pod_requests(pod)
         self.pod_requests[key] = requests
@@ -188,6 +199,8 @@ class StateNode:
             self.volume_usage.add(key, volumes)
 
     def cleanup_for_pod(self, key: str) -> None:
+        self._total_pod_requests = None
+        self._total_daemon_requests = None
         self.pod_requests.pop(key, None)
         self.pod_limits.pop(key, None)
         self.pod_disruption_costs.pop(key, None)

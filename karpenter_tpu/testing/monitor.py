@@ -21,7 +21,7 @@ class Monitor:
 
     def reset(self) -> None:
         """Record the baseline for created/deleted deltas (monitor.go Reset)."""
-        self._base_nodes = {n.metadata.name for n in self.store.list("Node")}
+        self._base_nodes = {n.metadata.name for n in self.store.borrow_list("Node")}
         self._base_node_count = len(self._base_nodes)
 
     # -- nodes -----------------------------------------------------------------
@@ -35,7 +35,7 @@ class Monitor:
         return len(self.created_nodes())
 
     def deleted_node_count(self) -> int:
-        current = {n.metadata.name for n in self.store.list("Node")}
+        current = {n.metadata.name for n in self.store.borrow_list("Node")}
         return len(self._base_nodes - current)
 
     # -- pods ------------------------------------------------------------------
@@ -43,7 +43,7 @@ class Monitor:
         from ..kube.objects import match_label_selector
 
         n = 0
-        for p in self.store.list("Pod"):
+        for p in self.store.borrow_list("Pod"):
             if not p.spec.node_name or not pod_utils.is_active(p):
                 continue
             if selector is not None and not match_label_selector(selector, p.metadata.labels):
@@ -52,7 +52,7 @@ class Monitor:
         return n
 
     def pending_pod_count(self) -> int:
-        return sum(1 for p in self.store.list("Pod") if pod_utils.is_provisionable(p))
+        return sum(1 for p in self.store.borrow_list("Pod") if pod_utils.is_provisionable(p))
 
     # -- utilization (monitor.go:176-219) --------------------------------------
     def avg_utilization(self, resource: str = "cpu") -> float:
@@ -66,13 +66,13 @@ class Monitor:
 
     def node_utilizations(self, resource: str = "cpu") -> list[float]:
         requested: dict[str, float] = {}
-        for p in self.store.list("Pod"):
+        for p in self.store.borrow_list("Pod"):
             if p.spec.node_name and pod_utils.is_active(p):
                 q = res.pod_requests(p).get(resource)
                 if q is not None:
                     requested[p.spec.node_name] = requested.get(p.spec.node_name, 0.0) + q.milli
         out = []
-        for n in self.store.list("Node"):
+        for n in self.store.borrow_list("Node"):
             alloc = n.status.allocatable.get(resource)
             if alloc is None or alloc.milli == 0:
                 continue
@@ -80,4 +80,4 @@ class Monitor:
         return out
 
     def node_pool_node_count(self, pool: str) -> int:
-        return sum(1 for n in self.store.list("Node") if n.metadata.labels.get(wk.NODEPOOL_LABEL_KEY) == pool)
+        return sum(1 for n in self.store.borrow_list("Node") if n.metadata.labels.get(wk.NODEPOOL_LABEL_KEY) == pool)

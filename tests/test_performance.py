@@ -33,13 +33,18 @@ LINUX_AMD64 = [
     {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
 ]
 
+# Reference scales AND reference wall budgets (the reference's are kind+KWOK
+# budgets with a real apiserver; this in-process substrate has no network, so
+# staying inside them is the *minimum* bar — the per-scenario numbers printed
+# on pass are the real regression signal).
 THRESHOLDS = {
-    "basic_scale_out": {"max_wall_seconds": 60.0, "pods": 1000},
-    "basic_consolidation": {"max_wall_seconds": 120.0},
-    "wide_deployments": {"max_wall_seconds": 90.0, "deployments": 10, "pods_each": 30},
-    "hostname_spreading": {"max_wall_seconds": 90.0, "pods": 60},
-    "interference": {"max_wall_seconds": 90.0, "pods": 200},
-    "drift_replacement": {"max_wall_seconds": 120.0, "pods": 100},
+    "basic_scale_out": {"max_wall_seconds": 120.0, "pods": 1000},  # basic_test.go:36-59 (<2 min)
+    "basic_consolidation": {"max_wall_seconds": 1200.0, "pods": 1000, "scale_to": 700},  # basic_test.go:67-81 (<20 min)
+    "wide_deployments": {"max_wall_seconds": 300.0, "deployments": 30, "pods_each": 30},  # wide_deployments_test.go:177-185 (<5 min)
+    "hostname_spreading": {"max_wall_seconds": 300.0, "pods": 1000},  # host_name_spreading_test.go:59-67 (<5 min)
+    "hostname_spreading_xl": {"max_wall_seconds": 2100.0, "pods": 2000},  # host_name_spreading_xl_test.go:40-67 (<35 min)
+    "interference": {"max_wall_seconds": 300.0, "pods": 1000},  # interference_test.go:58-66 (<5 min)
+    "drift_replacement": {"max_wall_seconds": 3000.0, "pods": 600},  # drift_performance_test.go:61-96 (<50 min)
 }
 _overrides = os.environ.get("KARPENTER_PERF_THRESHOLDS")
 if _overrides:
@@ -78,6 +83,7 @@ class TestBasicScaleOut:
         assert ok, f"{monitor.pending_pod_count()} pods still pending"
         assert monitor.running_pod_count() == n
         assert wall < t["max_wall_seconds"], f"scale-out took {wall:.1f}s"
+        print(f"\nbasic_scale_out({n}): {wall:.1f}s")
         # capacity should be reasonably packed, not one node per pod
         assert monitor.avg_utilization("cpu") > 0.5, monitor.avg_utilization("cpu")
 
@@ -85,6 +91,7 @@ class TestBasicScaleOut:
         """basic_test.go:67-81 — scale down 30%, nodes shrink. Instance sizes
         are capped so the fleet is wide enough for consolidation to matter."""
         t = THRESHOLDS["basic_consolidation"]
+        n, keep = t["pods"], t["scale_to"]
         env = Environment(options=Options())
         env.store.create(
             make_nodepool(
@@ -93,20 +100,21 @@ class TestBasicScaleOut:
             )
         )
         monitor = Monitor(env.store, env.cluster)
-        for i in range(200):
+        for i in range(n):
             env.store.create(make_pod(cpu="1", memory="1Gi", name=f"p-{i}", labels={"app": "a"}))
         assert settle_until(env, lambda: monitor.pending_pod_count() == 0)
         nodes_before = monitor.node_count()
-        # scale down 30%
-        for i in range(140, 200):
+        # scale down 30% (basic_test.go:67-81)
+        for i in range(keep, n):
             env.store.delete("Pod", f"p-{i}")
         start = time.perf_counter()
         settle_until(env, lambda: monitor.node_count() < nodes_before, max_rounds=40, step=20.0)
         wall = time.perf_counter() - start
         assert monitor.node_count() < nodes_before, "consolidation never shrank the cluster"
         assert monitor.pending_pod_count() == 0
-        assert monitor.running_pod_count() == 140
+        assert monitor.running_pod_count() == keep
         assert wall < t["max_wall_seconds"], f"consolidation took {wall:.1f}s"
+        print(f"\nbasic_consolidation: {wall:.1f}s ({nodes_before}->{monitor.node_count()} nodes)")
 
 
 class TestWideDeployments:
@@ -135,6 +143,7 @@ class TestWideDeployments:
         wall = time.perf_counter() - start
         assert ok and monitor.running_pod_count() == total
         assert wall < t["max_wall_seconds"], f"took {wall:.1f}s"
+        print(f"\nwide_deployments({total}): {wall:.1f}s")
 
 
 class TestHostnameSpreading:
@@ -153,6 +162,26 @@ class TestHostnameSpreading:
         assert ok
         assert monitor.node_count() >= t["pods"]  # one node per pod
         assert wall < t["max_wall_seconds"], f"took {wall:.1f}s"
+        print(f"\nhostname_spreading({t['pods']}): {wall:.1f}s")
+
+    def test_one_pod_per_node_xl(self):
+        """host_name_spreading_xl_test.go:40-67 — 2,000 anti-affinity pods
+        through the FULL control plane (provision -> launch -> register ->
+        bind), one node per pod, inside the reference's 35-minute budget."""
+        t = THRESHOLDS["hostname_spreading_xl"]
+        env, monitor = make_env()
+        sel = {"matchLabels": {"app": "spread-xl"}}
+        for i in range(t["pods"]):
+            env.store.create(
+                make_pod(cpu="100m", name=f"x-{i}", labels={"app": "spread-xl"}, anti_affinity=[hostname_anti_affinity(sel)])
+            )
+        start = time.perf_counter()
+        ok = settle_until(env, lambda: monitor.pending_pod_count() == 0, max_rounds=120)
+        wall = time.perf_counter() - start
+        assert ok, f"{monitor.pending_pod_count()} pods still pending after {wall:.1f}s"
+        assert monitor.node_count() >= t["pods"]
+        assert wall < t["max_wall_seconds"], f"took {wall:.1f}s"
+        print(f"\nhostname_spreading_xl({t['pods']}): {wall:.1f}s")
 
 
 class TestInterference:
@@ -170,6 +199,7 @@ class TestInterference:
         wall = time.perf_counter() - start
         assert ok and monitor.running_pod_count() == t["pods"] + 10
         assert wall < t["max_wall_seconds"], f"took {wall:.1f}s"
+        print(f"\ninterference({t['pods']}): {wall:.1f}s")
 
 
 class TestDriftReplacement:
@@ -190,7 +220,7 @@ class TestDriftReplacement:
             env,
             lambda: not ({n.metadata.name for n in env.store.list("Node")} & before)
             and monitor.pending_pod_count() == 0,
-            max_rounds=100,
+            max_rounds=250,
             step=15.0,
         )
         wall = time.perf_counter() - start
@@ -199,6 +229,7 @@ class TestDriftReplacement:
         assert monitor.pending_pod_count() == 0
         assert monitor.running_pod_count() == t["pods"]
         assert wall < t["max_wall_seconds"], f"drift roll took {wall:.1f}s"
+        print(f"\ndrift_replacement({t['pods']}): {wall:.1f}s")
 
 
 class TestFFDThroughputFloor:
