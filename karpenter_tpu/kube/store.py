@@ -70,6 +70,15 @@ class Store:
         # concurrent writers.
         self._pending: list[tuple[str, object]] = []
         self._deliver_lock = threading.RLock()
+        # per-kind revision: the rv of the last write touching the kind.
+        # Caches that depend on one kind's content (e.g. the solver's volume
+        # fold on StorageClass/PV/PVC) key on this instead of the global rv,
+        # so unrelated writes don't invalidate them.
+        self._kind_rv: dict[str, int] = {}
+
+    def kind_revision(self, kind: str) -> int:
+        with self._lock:
+            return self._kind_rv.get(kind, 0)
 
     def _now(self) -> float:
         return self._clock.now() if self._clock else 0.0
@@ -112,6 +121,7 @@ class Store:
             self._rv += 1
             obj = fast_deepcopy(obj)
             obj.metadata.resource_version = self._rv
+            self._kind_rv[obj.kind] = self._rv
             if not obj.metadata.creation_timestamp:
                 obj.metadata.creation_timestamp = self._now()
             kind_map[key] = obj
@@ -181,6 +191,7 @@ class Store:
             # deletionTimestamp is set only by delete(); preserve server-side value
             obj.metadata.deletion_timestamp = current.metadata.deletion_timestamp
             obj.metadata.resource_version = self._rv
+            self._kind_rv[obj.kind] = self._rv
             # apiserver semantics: generation increments on spec change only
             obj.metadata.generation = current.metadata.generation
             if getattr(obj, "spec", None) != getattr(current, "spec", None):
@@ -222,11 +233,17 @@ class Store:
             if obj is None:
                 raise NotFound(f"{kind} {key} not found")
             self._rv += 1
+            # clone-and-replace, like update(): stored objects are NEVER
+            # mutated in place — borrowed readers and out-of-lock list()
+            # cloning depend on that invariant
+            obj = fast_deepcopy(obj)
             obj.metadata.resource_version = self._rv
+            self._kind_rv[obj.kind] = self._rv
             if obj.metadata.finalizers and grace:
                 if obj.metadata.deletion_timestamp is None:
                     obj.metadata.deletion_timestamp = self._now()
-                self._enqueue("MODIFIED", fast_deepcopy(obj))
+                kind_map[key] = obj
+                self._enqueue("MODIFIED", obj)
             else:
                 del kind_map[key]
                 self._enqueue("DELETED", obj)

@@ -25,22 +25,25 @@ def volumes_union(a: Volumes, b: Volumes) -> Volumes:
     return out
 
 
-def get_persistent_volume_claim(store, pod, volume: dict):
+def get_persistent_volume_claim(store, pod, volume: dict, get=None):
     """Resolve a pod volume to its PVC, handling generic ephemeral volumes
     (utils/volume: ephemeral PVC is named <pod>-<volume>). For an ephemeral
     volume whose PVC the ephemeral controller hasn't created yet, a synthetic
     claim is derived from the volumeClaimTemplate so its StorageClass topology
     still constrains scheduling. Returns (pvc | None, err | None); a deleted
     PVC yields (None, None) so state tracking never wedges on it
-    (volumeusage.go:88-94)."""
+    (volumeusage.go:88-94). `get` overrides the store lookup (e.g.
+    store.borrow_get for read-only hot paths)."""
+    if get is None:
+        get = store.try_get
     if volume.get("persistentVolumeClaim"):
         name = volume["persistentVolumeClaim"].get("claimName")
         if not name:
             return None, None
-        return store.try_get("PersistentVolumeClaim", name, pod.metadata.namespace), None
+        return get("PersistentVolumeClaim", name, pod.metadata.namespace), None
     if volume.get("ephemeral") is not None:
         name = f"{pod.metadata.name}-{volume.get('name', '')}"
-        pvc = store.try_get("PersistentVolumeClaim", name, pod.metadata.namespace)
+        pvc = get("PersistentVolumeClaim", name, pod.metadata.namespace)
         if pvc is not None:
             return pvc, None
         from ..kube.objects import ObjectMeta, PersistentVolumeClaim

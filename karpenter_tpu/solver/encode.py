@@ -364,6 +364,7 @@ def check_capability(snap, pods=None) -> list[str]:
             reasons.append("nodepool uses minValues")
             break
     rep_pods = list(pods if pods is not None else snap.pods)
+    _vol_lowering = None  # one lowering for all reps (per-solve SC/PV memos)
     # required anti-affinity is modeled as symmetric per-domain groups
     # (members = pods matched by the selector); that is exact only when the
     # declaring set and the matched set coincide (pure self-anti-affinity,
@@ -451,9 +452,21 @@ def check_capability(snap, pods=None) -> list[str]:
                 break
         else:
             if any(v.get("persistentVolumeClaim") or v.get("ephemeral") is not None for v in pod.spec.volumes):
-                # PVC topology alternatives + per-driver limits stay host-side
-                reasons.append(f"{pod.key()}: PVC-backed volumes")
-                break
+                # the common case (single topology alternative, per-driver
+                # attach limits) is tensorized (solver/volumes.py); only
+                # resolution-level gates remain here — encode() adds the
+                # cross-pod gates (shared claims) it alone can see
+                from .volumes import VolumeLowering, window_reasons
+
+                if getattr(snap, "store", None) is None:
+                    reasons.append(f"{pod.key()}: PVC-backed volumes (no store)")
+                    break
+                if _vol_lowering is None:
+                    _vol_lowering = VolumeLowering(snap.store)
+                vol_rs = window_reasons(_vol_lowering.component(pod), pod)
+                if vol_rs:
+                    reasons.extend(vol_rs)
+                    break
             if pod.spec.resource_claims:
                 # DRA's DFS decision tree stays host-side (SURVEY.md §7 stage 9)
                 reasons.append(f"{pod.key()}: dynamic resource claims")
@@ -790,6 +803,7 @@ class EncodeCache:
         self.last_row_key: tuple | None = None
         self.last_raw_pods: list | None = None  # snap.pods by reference
         self.last_sig_ids: dict[tuple, int] | None = None
+        self.last_vol_rv: tuple | None = None  # SC/PV/PVC kind revisions
 
     def signature(self, pod) -> tuple:
         key = (pod.metadata.uid, pod.metadata.resource_version)
@@ -816,6 +830,11 @@ def _try_delta_encode(snap, cache: EncodeCache):
     base = cache.last_enc
     prev_raw = cache.last_raw_pods
     if base is None or prev_raw is None or cache.last_sig_ids is None:
+        return None
+    # the base's folded volume requirements are only valid while the
+    # SC/PV/PVC content they resolved against is unchanged (the row key
+    # can't see those kinds)
+    if _volume_kind_revisions(snap) != cache.last_vol_rv:
         return None
     cur = snap.pods
     n_prev = len(prev_raw)
@@ -857,6 +876,17 @@ def _try_delta_encode(snap, cache: EncodeCache):
     cache.last_enc = enc
     cache.last_raw_pods = list(cur)
     return enc
+
+
+def _volume_kind_revisions(snap) -> tuple:
+    store = getattr(snap, "store", None)
+    if store is None or not hasattr(store, "kind_revision"):
+        return (0, 0, 0)
+    return (
+        store.kind_revision("StorageClass"),
+        store.kind_revision("PersistentVolume"),
+        store.kind_revision("PersistentVolumeClaim"),
+    )
 
 
 def _row_cache_key(snap, rnames: list[str], dom_keys: list[str]) -> tuple:
@@ -937,6 +967,15 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
     def intern_labels(labels: dict[str, str]) -> dict[int, int]:
         return {vocab.key_id(k): vocab.value_id(k, v) for k, v in labels.items()}
 
+    # per-driver CSI attach axes: raw slot counts; existing nodes carry
+    # (limit - attached), new-claim rows are unbounded (the host oracle
+    # enforces limits only on existing nodes — ExistingNode.can_add)
+    from .volumes import CSI_AXIS_BIG, CSI_AXIS_PREFIX, existing_row_axis_value
+
+    csi_axes = [
+        (i, name[len(CSI_AXIS_PREFIX):]) for i, name in enumerate(rnames) if name.startswith(CSI_AXIS_PREFIX)
+    ]
+
     row_daemon_ports: list = []
     # existing nodes first
     state_nodes = sorted(snap.state_nodes, key=lambda n: n.name())
@@ -960,7 +999,10 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
                 usage.add(f"daemon-headroom/{d.key()}", hps)
                 phantom.extend(hps)
         row_daemon_ports.append(phantom)
-        row_alloc_l.append(rl_to_vec(remaining))
+        vec = rl_to_vec(remaining)
+        for i, driver in csi_axes:
+            vec[i] = existing_row_axis_value(sn, driver)
+        row_alloc_l.append(vec)
         row_price_l.append(0.0)
         row_labels_l.append(intern_labels(lbls))
         row_dom_l.append([dom_id(k, lbls[key]) if lbls.get(key) else dom_sentinel[k] for k, key in enumerate(dom_keys)])
@@ -1015,6 +1057,8 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
                         it_dom[k] = vs[0]
             alloc = res.subtract(it.allocatable(), overhead_by_it.get(id(it), {}))
             alloc_vec = rl_to_vec({k: v for k, v in alloc.items() if v.milli > 0})
+            for i, _driver in csi_axes:
+                alloc_vec[i] = CSI_AXIS_BIG
             for o in it.offerings:
                 if not o.available:
                     continue
@@ -1137,15 +1181,54 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     rep_pods: list = []
     P0 = len(snap.pods)
     sig_of_pod_raw = np.empty(P0, dtype=np.int32)
+    # PVC-backed volumes (solver/volumes.py): pods with resolvable single-
+    # alternative volume constraints stay in-window; the resolved component
+    # extends the signature key (same claims-shape pods group together) and
+    # later folds into the signature's requirements + synthetic attach axes
+    from .volumes import VolumeLowering, has_pvc_volumes, window_reasons
+
+    lowering: VolumeLowering | None = None
+    vol_comp_of_sig: list = []  # parallel to rep_pods
+    vol_reasons: list[str] = []
+    pvc_owner: dict[str, str] = {}  # pvc id -> pod key (shared-claim gate)
     for i, pod in enumerate(snap.pods):
         k = sig_of(pod)
+        comp = None
+        if has_pvc_volumes(pod):
+            if getattr(snap, "store", None) is None:
+                vol_reasons.append(f"{pod.key()}: PVC-backed volumes (no store)")
+            else:
+                if lowering is None:
+                    lowering = VolumeLowering(snap.store)
+                comp = lowering.component(pod)
+            if comp is not None:
+                k = (k, ("vol", comp.fingerprint))
+                # the attach axes are additive per pod; the host counts
+                # DISTINCT claim ids, so a claim shared between solve pods
+                # (or k *new* references to one) must stay host-side
+                for pid in comp.pvc_ids:
+                    other = pvc_owner.setdefault(pid, pod.key())
+                    if other != pod.key():
+                        vol_reasons.append(f"{pod.key()}: pvc {pid} shared with {other}")
         sid = sig_ids.get(k)
         if sid is None:
             sid = len(rep_pods)
             sig_ids[k] = sid
             rep_pods.append(pod)
+            vol_comp_of_sig.append(comp)
+            if comp is not None:
+                vol_reasons.extend(window_reasons(comp, pod))
         sig_of_pod_raw[i] = sid
     S = len(rep_pods)
+    if pvc_owner:
+        # a solve pod's claim already attached on a node would double-count
+        # against the node's axis (the host dedupes by id — volumeusage.go)
+        for sn in snap.state_nodes:
+            for vols in sn.volume_usage._volumes.values():
+                hit = vols & pvc_owner.keys()
+                if hit:
+                    vol_reasons.append(f"pvc {next(iter(hit))} already attached on {sn.name()}")
+                    break
 
     # requirement classes: signatures sharing (node_selector, affinity) lower
     # to the same Requirements — decode caches its per-claim instance-type
@@ -1154,13 +1237,21 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     req_class_ids: dict[tuple, int] = {}
     req_class_of_sig = np.zeros(S, dtype=np.int32)
     for key, sid in sig_ids.items():
-        cid = req_class_ids.setdefault(key[0], len(req_class_ids))
+        # volume-extended keys are (base_sig, ("vol", fp)): the requirement
+        # class must include the volume fingerprint — folded volume reqs make
+        # otherwise-identical selectors lower differently
+        if vol_comp_of_sig[sid] is not None:
+            class_key = (key[0][0], key[1])
+        else:
+            class_key = key[0]
+        cid = req_class_ids.setdefault(class_key, len(req_class_ids))
         req_class_of_sig[sid] = cid
     req_class_keys: list = [None] * len(req_class_ids)
     for key0, cid in req_class_ids.items():
         req_class_keys[cid] = key0
 
     reasons = check_capability(snap, rep_pods)
+    reasons.extend(r for r in vol_reasons if r not in reasons)
 
     # -- per-signature heavy lowering -----------------------------------------
     respect = getattr(snap, "preference_policy", "Respect") == "Respect"
@@ -1169,8 +1260,16 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     # term exactly like the un-relaxed FFD (requirements.go:74-110); strict
     # under the Ignore policy
     sig_requirements = [Requirements.from_pod(p, strict=not respect) for p in rep_pods]
+    # fold each signature's single volume-topology alternative into its
+    # requirement mask (host: _try_volume_alternative with one entry attaches
+    # it to claim/node requirements; with no branching the two are equal)
+    for s, comp in enumerate(vol_comp_of_sig):
+        if comp is not None and comp.requirements is not None:
+            sig_requirements[s].add(*comp.requirements.values())
 
     # -- resource axis ---------------------------------------------------------
+    from .volumes import CSI_AXIS_PREFIX
+
     rnames = ["cpu", "memory", "pods", "ephemeral-storage"]
     seen = set(rnames)
     for rr in sig_requests:
@@ -1178,6 +1277,14 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
             if k not in seen:
                 seen.add(k)
                 rnames.append(k)
+    # per-driver attach axes, in raw slot counts (not Quantity-scaled)
+    for comp in vol_comp_of_sig:
+        if comp is not None:
+            for driver, _n in comp.drivers:
+                name = CSI_AXIS_PREFIX + driver
+                if name not in seen:
+                    seen.add(name)
+                    rnames.append(name)
     ridx = {k: i for i, k in enumerate(rnames)}
     R = len(rnames)
 
@@ -1238,6 +1345,10 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     sig_req = np.zeros((S, R), dtype=np.float32)
     for s, rr in enumerate(sig_requests):
         sig_req[s] = rl_to_vec(rr)
+        comp = vol_comp_of_sig[s]
+        if comp is not None:
+            for driver, n in comp.drivers:
+                sig_req[s, ridx[CSI_AXIS_PREFIX + driver]] = float(n)
 
     # vocabulary must be closed before masks are sized; pod requirement values
     # not present on any row still need ids (they simply never match)
@@ -1540,6 +1651,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         cache.last_row_key = row_key if row_key is not None else _row_cache_key(snap, rnames, dom_keys)
         cache.last_raw_pods = list(snap.pods)
         cache.last_sig_ids = dict(sig_ids)
+        cache.last_vol_rv = _volume_kind_revisions(snap)
     return enc_out
 
 

@@ -549,6 +549,13 @@ class TPUSolver:
                     r = ridx.get(k)
                     if r is not None:
                         alloc[i, r] = _scale(k, q)
+            # CSI attach axes: new claims are unbounded (limits are an
+            # existing-node property — see solver/volumes.py)
+            from .volumes import CSI_AXIS_BIG, CSI_AXIS_PREFIX
+
+            for r, name in enumerate(rnames):
+                if name.startswith(CSI_AXIS_PREFIX):
+                    alloc[:, r] = CSI_AXIS_BIG
             ginfo = []
             for g in groups:
                 ovh = np.zeros(len(rnames), dtype=np.float64)

@@ -1,0 +1,298 @@
+"""PVC-backed volumes on the tensor path: parity vs the host FFD oracle.
+
+Reference: volumetopology.go (topology alternatives), volumeusage.go +
+scheduler.go:623 (per-driver CSI attach limits). The common case (single
+topology alternative, distinct claims, per-driver limits) runs in-window
+(solver/volumes.py); everything else must fall back to the host FFD.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from helpers import make_nodepool, make_pod, zone_spread
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import COND_INITIALIZED, COND_REGISTERED, NodeClaim
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.kube import Node, ObjectMeta, Store
+from karpenter_tpu.kube.objects import (
+    CSINode,
+    CSINodeDriver,
+    NodeSpec,
+    NodeStatus,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+)
+from karpenter_tpu.scheduling.volumeusage import BIND_COMPLETED_ANNOTATION
+from karpenter_tpu.solver import FFDSolver, SolverSnapshot
+from karpenter_tpu.solver.encode import check_capability
+from karpenter_tpu.solver.tpu import TPUSolver
+from karpenter_tpu.solver.validate import validate_results
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.resources import parse_resource_list
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+CSI = "ebs.csi.example.com"
+
+
+def pvc_volume(claim: str) -> dict:
+    return {"name": f"v-{claim}", "persistentVolumeClaim": {"claimName": claim}}
+
+
+def make_snapshot(pods, prepare=None, types=None, with_node=False, node_limit=None):
+    """Fresh store/cluster; `prepare(store)` seeds PVC/SC/PV objects; with
+    with_node, one registered+initialized 8-cpu existing node joins (its
+    CSINode carries node_limit attach slots for the test driver)."""
+    store = Store()
+    clock = FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    np_ = make_nodepool(requirements=LINUX_AMD64)
+    store.create(np_)
+    if with_node:
+        if node_limit is not None:
+            store.create(
+                CSINode(metadata=ObjectMeta(name="n1"), drivers=[CSINodeDriver(name=CSI, allocatable_count=node_limit)])
+            )
+        nc = NodeClaim(metadata=ObjectMeta(name="c1", labels={wk.NODEPOOL_LABEL_KEY: np_.metadata.name}))
+        nc.status.provider_id = "kwok://n1"
+        nc.status.conditions.set_true(COND_REGISTERED)
+        nc.status.conditions.set_true(COND_INITIALIZED)
+        store.create(nc)
+        store.create(
+            Node(
+                metadata=ObjectMeta(
+                    name="n1",
+                    labels={
+                        wk.NODEPOOL_LABEL_KEY: np_.metadata.name,
+                        wk.HOSTNAME_LABEL_KEY: "n1",
+                        wk.ZONE_LABEL_KEY: "test-zone-b",
+                        wk.ARCH_LABEL_KEY: "amd64",
+                        wk.OS_LABEL_KEY: "linux",
+                    },
+                ),
+                spec=NodeSpec(provider_id="kwok://n1"),
+                status=NodeStatus(
+                    capacity=parse_resource_list({"cpu": "8", "memory": "16Gi", "pods": "110"}),
+                    allocatable=parse_resource_list({"cpu": "8", "memory": "16Gi", "pods": "110"}),
+                ),
+            )
+        )
+    if prepare is not None:
+        prepare(store)
+    types = types if types is not None else catalog.construct_instance_types()
+    return SolverSnapshot(
+        store=store,
+        cluster=cluster,
+        node_pools=[np_],
+        instance_types={np_.metadata.name: types},
+        state_nodes=cluster.nodes(),
+        daemonset_pods=[],
+        pods=pods,
+        clock=clock,
+    )
+
+
+def seed_wffc(store, zone="test-zone-b", claims=("c0",), topologies=True):
+    store.create(
+        StorageClass(
+            metadata=ObjectMeta(name="wffc"),
+            provisioner=CSI,
+            volume_binding_mode="WaitForFirstConsumer",
+            allowed_topologies=[[{"key": wk.ZONE_LABEL_KEY, "values": [zone]}]] if topologies else [],
+        )
+    )
+    for c in claims:
+        store.create(PersistentVolumeClaim(metadata=ObjectMeta(name=c), storage_class_name="wffc"))
+
+
+def compare(pods, prepare, **snap_kw):
+    """Both backends on identical snapshots: tensor path must engage, the
+    scheduled set must match, and the placement must validate exactly."""
+    ffd = FFDSolver().solve(make_snapshot(pods, prepare, **snap_kw))
+    snap2 = make_snapshot(pods, prepare, **snap_kw)
+    tpu = TPUSolver(force=True)
+    tr = tpu.solve(snap2)
+    assert tpu.last_backend == "tpu", tpu.last_fallback_reasons
+    assert set(tr.pod_errors) == set(ffd.pod_errors), (tr.pod_errors, ffd.pod_errors)
+    violations = validate_results(make_snapshot(pods, prepare, **snap_kw), tr)
+    assert not violations, violations
+    return tr, ffd
+
+
+class TestCommonCaseInWindow:
+    def test_check_capability_clear_for_wffc(self):
+        pods = [make_pod(cpu="1", volumes=[pvc_volume("c0")])]
+        snap = make_snapshot(pods, lambda s: seed_wffc(s))
+        assert check_capability(snap) == []
+
+    def test_wffc_zone_folds_into_placement(self):
+        # allowed topology pins zone-b; every claim must only keep zone-b
+        # offerings (volumetopology.go:172-189 -> requirement fold)
+        pods = [make_pod(cpu="1", name=f"p{i}", volumes=[pvc_volume(f"c{i}")]) for i in range(4)]
+
+        def prep(s):
+            seed_wffc(s, claims=[f"c{i}" for i in range(4)])
+
+        tr, _ = compare(pods, prep)
+        for nc in tr.new_node_claims:
+            zone_req = nc.requirements.get(wk.ZONE_LABEL_KEY)
+            assert zone_req is not None and set(zone_req.values) == {"test-zone-b"}
+
+    def test_bound_pv_single_term_folds(self):
+        def prep(s):
+            s.create(
+                PersistentVolume(
+                    metadata=ObjectMeta(name="pv0"),
+                    csi_driver=CSI,
+                    node_affinity_required=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-c"]}]],
+                )
+            )
+            s.create(
+                PersistentVolumeClaim(
+                    metadata=ObjectMeta(name="c0", annotations={BIND_COMPLETED_ANNOTATION: "yes"}),
+                    volume_name="pv0",
+                    phase="Bound",
+                )
+            )
+
+        pods = [make_pod(cpu="1", volumes=[pvc_volume("c0")])]
+        tr, _ = compare(pods, prep)
+        nc = tr.new_node_claims[0]
+        assert set(nc.requirements.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-c"}
+
+    def test_attach_limit_on_existing_node(self):
+        # node has 2 attach slots for the driver; 4 one-claim pods -> at most
+        # 2 land on the node, the rest go to new claims (ExistingNode
+        # exceeds_limits parity through the synthetic axis)
+        pods = [make_pod(cpu="100m", name=f"p{i}", volumes=[pvc_volume(f"c{i}")]) for i in range(4)]
+
+        def prep(s):
+            seed_wffc(s, claims=[f"c{i}" for i in range(4)], topologies=False)
+
+        tr, ffd = compare(pods, prep, with_node=True, node_limit=2)
+        on_node = [en for en in tr.existing_nodes if en.pods]
+        tpu_on_node = sum(len(en.pods) for en in on_node)
+        assert tpu_on_node <= 2
+        assert tr.new_node_claims, "overflow pods must go to new claims"
+        ffd_on_node = sum(len(en.pods) for en in ffd.existing_nodes if en.pods)
+        assert ffd_on_node <= 2
+
+    def test_no_limit_no_constraint(self):
+        # without a CSINode limit, the axis is unbounded and all pods pack
+        # onto the existing node like volume-less pods would
+        pods = [make_pod(cpu="100m", name=f"p{i}", volumes=[pvc_volume(f"c{i}")]) for i in range(4)]
+
+        def prep(s):
+            seed_wffc(s, claims=[f"c{i}" for i in range(4)], topologies=False)
+
+        tr, _ = compare(pods, prep, with_node=True)
+        assert sum(len(en.pods) for en in tr.existing_nodes) == 4
+        assert not tr.new_node_claims
+
+
+class TestWindowGates:
+    def _fallback_reasons(self, pods, prepare, **snap_kw):
+        snap = make_snapshot(pods, prepare, **snap_kw)
+        tpu = TPUSolver()
+        tpu.solve(snap)
+        assert tpu.last_backend == "ffd-fallback", "expected host fallback"
+        return tpu.last_fallback_reasons
+
+    def test_shared_claim_falls_back(self):
+        pods = [
+            make_pod(cpu="1", name="p0", volumes=[pvc_volume("shared")]),
+            make_pod(cpu="1", name="p1", volumes=[pvc_volume("shared")]),
+        ]
+        reasons = self._fallback_reasons(pods, lambda s: seed_wffc(s, claims=["shared"], topologies=False))
+        assert any("shared" in r for r in reasons), reasons
+
+    def test_multi_alternative_topology_falls_back(self):
+        def prep(s):
+            s.create(
+                StorageClass(
+                    metadata=ObjectMeta(name="wffc"),
+                    provisioner=CSI,
+                    volume_binding_mode="WaitForFirstConsumer",
+                    allowed_topologies=[
+                        [{"key": wk.ZONE_LABEL_KEY, "values": ["test-zone-a"]}],
+                        [{"key": wk.ZONE_LABEL_KEY, "values": ["test-zone-b"]}],
+                    ],
+                )
+            )
+            s.create(PersistentVolumeClaim(metadata=ObjectMeta(name="c0"), storage_class_name="wffc"))
+
+        reasons = self._fallback_reasons([make_pod(cpu="1", volumes=[pvc_volume("c0")])], prep)
+        assert any("multi-alternative" in r for r in reasons), reasons
+
+    def test_volume_key_overlapping_spread_falls_back(self):
+        # volume constrains zone AND the pod zone-spreads: the host attaches
+        # volume reqs to the node only, never to spread counting
+        # (volumetopology.go:62-64) — out of window
+        sel = {"matchLabels": {"app": "z"}}
+        pods = [
+            make_pod(cpu="1", labels={"app": "z"}, tsc=[zone_spread(selector=sel)], volumes=[pvc_volume("c0")])
+        ]
+        reasons = self._fallback_reasons(pods, lambda s: seed_wffc(s))
+        assert any("overlaps spread" in r for r in reasons), reasons
+
+    def test_claim_attached_on_node_falls_back(self):
+        # the pending pod's claim is already attached on the node (another
+        # bound pod holds it): the additive axis would double-count where the
+        # host dedupes by claim id
+        def prep(s):
+            seed_wffc(s, claims=["c0"], topologies=False)
+            bound = make_pod(cpu="100m", name="holder", node_name="n1", volumes=[pvc_volume("c0")])
+            bound.status.phase = "Running"
+            s.create(bound)
+
+        pods = [make_pod(cpu="100m", name="pending", volumes=[pvc_volume("c0")])]
+        reasons = self._fallback_reasons(pods, prep, with_node=True, node_limit=2)
+        assert any("already attached" in r for r in reasons), reasons
+
+
+class TestContentFingerprints:
+    def test_recreated_storage_class_never_serves_stale_fold(self):
+        # the decode caches key on the volume fingerprint across solves; a
+        # StorageClass recreated with a different zone must produce fresh
+        # claim requirements, not the cached zone-a fold
+        pods = [make_pod(cpu="1", name="p0", volumes=[pvc_volume("c0")])]
+        snap = make_snapshot(pods, lambda s: seed_wffc(s, zone="test-zone-a"))
+        tpu = TPUSolver(force=True)
+        r1 = tpu.solve(snap)
+        assert set(r1.new_node_claims[0].requirements.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-a"}
+        snap.store.delete("StorageClass", "wffc")
+        snap.store.create(
+            StorageClass(
+                metadata=ObjectMeta(name="wffc"),
+                provisioner=CSI,
+                volume_binding_mode="WaitForFirstConsumer",
+                allowed_topologies=[[{"key": wk.ZONE_LABEL_KEY, "values": ["test-zone-b"]}]],
+            )
+        )
+        r2 = tpu.solve(snap)
+        assert tpu.last_backend == "tpu"
+        assert set(r2.new_node_claims[0].requirements.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-b"}
+
+
+class TestSignatureGrouping:
+    def test_distinct_claims_same_shape_share_signature(self):
+        # StatefulSet shape: distinct claims, same storage class -> one
+        # signature (the grouped kernel depends on this at 50k pods)
+        from karpenter_tpu.solver.encode import encode
+
+        pods = [make_pod(cpu="1", name=f"p{i}", volumes=[pvc_volume(f"c{i}")]) for i in range(6)]
+        snap = make_snapshot(pods, lambda s: seed_wffc(s, claims=[f"c{i}" for i in range(6)]))
+        enc = encode(snap)
+        assert not enc.fallback_reasons
+        assert enc.n_sigs == 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))
