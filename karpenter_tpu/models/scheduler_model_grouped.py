@@ -857,6 +857,55 @@ def greedy_pack_grouped_compressed(t: SchedulerTensors, items: ItemTensors, n_po
 
 
 DELTA_ITEM_BUCKET = 16  # delta item axis pads to this so deltas share one compile
+REMOVAL_BUCKET = 16  # removal axis pads to this so removals share one compile
+
+
+@jax.jit
+def _recredit_impl(state, t: SchedulerTensors, slot_idx, req, zmem, hmem):
+    """Reverse removed pods' takes in a pack carry (solver/tpu.py removal
+    delta): per removed pod k placed on slot_idx[k] — capacity is re-credited
+    and spread/host counts decremented; the slot's domain NARROWING and port
+    masks are deliberately left in place (conservative: the remaining
+    placement stays valid, future delta adds just see slightly tighter
+    constraints). Pods whose take is not cleanly reversible (anti-affinity
+    domain blocking, affinity recording, host ports) are gated OFF this path
+    by the caller. Padding entries carry slot_idx = -1.
+
+    zmem/hmem are [K, G] member masks PRE-FILTERED by the caller to the
+    reversible kinds: zmem = spread-domain members (KIND_DOM_SPREAD), hmem =
+    hostname-counted members (KIND_HOST_SPREAD | KIND_HOST_ANTI)."""
+    (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, ports) = state
+    N = slot_rem.shape[0]
+    valid = slot_idx >= 0
+    j = jnp.clip(slot_idx, 0, N - 1)
+    slot_rem = slot_rem.at[j].add(jnp.where(valid[:, None], req, 0.0).astype(slot_rem.dtype))
+    hm = (hmem & valid[:, None]).astype(counts_host.dtype)  # [K, G]
+    counts_host = counts_host.at[:, j].add(-hm.T)
+    # spread counts were recorded at the slot's committed domain in the pod's
+    # k* key (zone_path narrows kmask to one domain per placement) — for ALL
+    # member groups, matching zone_path's counts_zone += placed_z update
+    zm = zmem & valid[:, None]  # [K, G]
+    kstar = jnp.max(jnp.where(zm, t.group_dom_key[None, :], -1), axis=1)  # [K]
+    dsel = slot_zoneset[j] & (t.dom_key_of[None, :] == kstar[:, None])  # [K, D]
+    dec = jnp.einsum(
+        "kg,kd->gd", zm.astype(counts_zone.dtype), dsel.astype(counts_zone.dtype)
+    )
+    counts_zone = counts_zone - dec
+    return (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, ports)
+
+
+def recredit_removals(state, t: SchedulerTensors, slot_idx, req, zmem, hmem):
+    """Host wrapper for _recredit_impl: pads the removal axis to a
+    REMOVAL_BUCKET multiple so drifting removal counts share one compile."""
+    K = int(slot_idx.shape[0])
+    K_pad = -(-max(K, 1) // REMOVAL_BUCKET) * REMOVAL_BUCKET
+    if K_pad != K:
+        pad = K_pad - K
+        slot_idx = np.concatenate([slot_idx, np.full(pad, -1, slot_idx.dtype)])
+        req = np.concatenate([req, np.zeros((pad, req.shape[1]), req.dtype)])
+        zmem = np.concatenate([zmem, np.zeros((pad, zmem.shape[1]), bool)])
+        hmem = np.concatenate([hmem, np.zeros((pad, hmem.shape[1]), bool)])
+    return _recredit_impl(state, t, jnp.asarray(slot_idx), jnp.asarray(req), jnp.asarray(zmem), jnp.asarray(hmem))
 
 
 def greedy_pack_delta_compressed(state, t: SchedulerTensors, items: ItemTensors, n_added: int):

@@ -149,6 +149,20 @@ class VolumeUsage:
             for vols in self._pod_volumes.values():
                 self._volumes = volumes_union(self._volumes, vols)
 
+    def remaining(self, storage_driver: str) -> int | None:
+        """Attach slots left for a driver; None = no limit registered."""
+        limit = self._limits.get(storage_driver)
+        if limit is None:
+            return None
+        return max(0, limit - len(self._volumes.get(storage_driver, ())))
+
+    def attached_ids(self) -> set[str]:
+        """All distinct attached claim ids across drivers."""
+        out: set[str] = set()
+        for vols in self._volumes.values():
+            out |= vols
+        return out
+
     def copy(self) -> "VolumeUsage":
         c = VolumeUsage()
         c._volumes = {k: set(v) for k, v in self._volumes.items()}

@@ -343,10 +343,12 @@ def pod_signature(pod) -> tuple:
     )
 
 
-def check_capability(snap, pods=None) -> list[str]:
+def check_capability(snap, pods=None, vol_comps=None) -> list[str]:
     """Reasons the snapshot cannot run on the tensor path (empty = OK).
     `pods` defaults to the snapshot's; pass signature representatives to check
-    each unique shape once.
+    each unique shape once. `vol_comps` (parallel to `pods`) supplies
+    already-resolved volume components so the encode's signature loop and
+    this check never resolve the same claims twice.
 
     Relaxable soft constraints (preferred node affinity, node-affinity
     OR-terms, ScheduleAnyway spreads) are IN-window under the default Respect
@@ -380,7 +382,7 @@ def check_capability(snap, pods=None) -> list[str]:
     reasons.extend(_affinity_symmetry_reasons(rep_pods))
     if reasons:
         return reasons
-    for pod in rep_pods:
+    for idx, pod in enumerate(rep_pods):
         aff = pod.spec.affinity
         if aff is not None:
             if aff.pod_affinity_preferred:
@@ -451,19 +453,24 @@ def check_capability(snap, pods=None) -> list[str]:
                 reasons.append(f"{pod.key()}: node-filtered spread counting")
                 break
         else:
-            if any(v.get("persistentVolumeClaim") or v.get("ephemeral") is not None for v in pod.spec.volumes):
+            from .volumes import VolumeLowering, has_pvc_volumes, window_reasons
+
+            if has_pvc_volumes(pod):
                 # the common case (single topology alternative, per-driver
                 # attach limits) is tensorized (solver/volumes.py); only
                 # resolution-level gates remain here — encode() adds the
                 # cross-pod gates (shared claims) it alone can see
-                from .volumes import VolumeLowering, window_reasons
 
                 if getattr(snap, "store", None) is None:
                     reasons.append(f"{pod.key()}: PVC-backed volumes (no store)")
                     break
-                if _vol_lowering is None:
-                    _vol_lowering = VolumeLowering(snap.store)
-                vol_rs = window_reasons(_vol_lowering.component(pod), pod)
+                if vol_comps is not None:
+                    comp = vol_comps[idx]
+                else:
+                    if _vol_lowering is None:
+                        _vol_lowering = VolumeLowering(snap.store)
+                    comp = _vol_lowering.component(pod)
+                vol_rs = window_reasons(comp, pod)
                 if vol_rs:
                     reasons.extend(vol_rs)
                     break
@@ -817,16 +824,20 @@ class EncodeCache:
 
 
 def _try_delta_encode(snap, cache: EncodeCache):
-    """Append-only pod-delta fast path: returns an EncodedSnapshot reusing the
-    previous encode's tensors wholesale, or None when a full encode is needed.
+    """Pod-delta fast path: returns an EncodedSnapshot reusing the previous
+    encode's tensors wholesale, or None when a full encode is needed.
 
-    Conditions: the pod list is the previous solve's (checked by identity —
-    one O(P) pointer-compare pass) plus a small tail of appended pods whose
-    signatures the previous encode already interned, and the row-side cache
-    key (cluster generation, pools, instance types, daemons) is unchanged.
-    The added pods are appended to the POD AXIS only; every per-signature
-    tensor is reused untouched. Reference analogue: event-driven state
-    updates instead of rebuild-per-solve (cluster.go:945-964)."""
+    Conditions: the pod list is the previous solve's with a small number of
+    pods REMOVED (they bound or were deleted — relative order of survivors
+    preserved, one O(P) two-pointer identity walk) and/or a small tail of
+    APPENDED pods whose signatures the previous encode already interned, and
+    the row-side cache key (cluster generation, pools, instance types,
+    daemons) is unchanged. Survivors and additions live on the POD AXIS only;
+    every per-signature tensor is reused untouched. The result carries
+    `delta_base`/`delta_added_sigs`/`delta_removed_enc` so the solver can run
+    the device pack incrementally in both directions. Reference analogue:
+    event-driven state updates instead of rebuild-per-solve
+    (cluster.go:945-964)."""
     base = cache.last_enc
     prev_raw = cache.last_raw_pods
     if base is None or prev_raw is None or cache.last_sig_ids is None:
@@ -838,16 +849,40 @@ def _try_delta_encode(snap, cache: EncodeCache):
         return None
     cur = snap.pods
     n_prev = len(prev_raw)
-    if len(cur) < n_prev:
-        return None
-    for a, b in zip(prev_raw, cur):
-        if a is not b:
-            return None
-    added = cur[n_prev:]
-    if len(added) > max(64, n_prev // 20):
+    cap = max(64, n_prev // 20)
+    if len(cur) > n_prev + cap or len(cur) < n_prev - cap:
         return None  # large deltas: the full encode amortizes better
+    # two-pointer identity walk: prev pods missing from cur (in order) are
+    # the removals; whatever cur holds past the walk is the appended tail
+    removed_raw: list[int] = []
+    j = 0
+    n_cur = len(cur)
+    for i, p in enumerate(prev_raw):
+        if j < n_cur and cur[j] is p:
+            j += 1
+        else:
+            removed_raw.append(i)
+            if len(removed_raw) > cap:
+                return None
+    added = list(cur[j:])
+    if len(removed_raw) + len(added) > cap:
+        return None
+    if removed_raw and added:
+        # a previous pod appearing in the tail means cur is NOT
+        # (subsequence + appended-new): reordering/insertion — full encode
+        removed_ids = {id(prev_raw[i]) for i in removed_raw}
+        if any(id(p) in removed_ids for p in added):
+            return None
+    from .volumes import has_pvc_volumes
+
     added_sigs = []
     for p in added:
+        # PVC-backed pods extend their interned key with the RESOLVED volume
+        # component (claims/SC/PV content), which the bare signature cannot
+        # see — a bare-key hit could alias a comp-less signature and drop the
+        # pod's volume constraints; only the full encode resolves components
+        if has_pvc_volumes(p):
+            return None
         sid = cache.last_sig_ids.get(cache.signature(p))
         if sid is None:
             return None  # unseen pod shape: per-signature tensors must grow
@@ -855,9 +890,28 @@ def _try_delta_encode(snap, cache: EncodeCache):
     row_key = _row_cache_key(snap, base.resource_names, list(base.dom_key_names))
     if row_key != cache.last_row_key:
         return None
-    if not added:
+    if not added and not removed_raw:
         return base
     import dataclasses as _dc
+
+    if removed_raw:
+        # map removed raw-order pods to base-enc (FFD-sorted) indices; the
+        # base pod list is always a permutation of the raw list it encoded
+        enc_idx_of = {id(p): k for k, p in enumerate(base.pods)}
+        try:
+            removed_enc = np.array(
+                sorted(enc_idx_of[id(prev_raw[i])] for i in removed_raw), np.int64
+            )
+        except KeyError:
+            return None  # raw/enc pod lists diverged (shouldn't happen)
+        keep = np.ones(len(base.pods), dtype=bool)
+        keep[removed_enc] = False
+        kept_pods = [p for k, p in enumerate(base.pods) if keep[k]]
+        kept_sigs = base.sig_of_pod[keep]
+    else:
+        removed_enc = np.zeros(0, np.int64)
+        kept_pods = list(base.pods)
+        kept_sigs = base.sig_of_pod
 
     enc = _dc.replace(
         base,
@@ -865,11 +919,12 @@ def _try_delta_encode(snap, cache: EncodeCache):
         # which is exactly how the reference treats late arrivals — and
         # build_items merges them into their signature's existing work item,
         # so a full pack on this snapshot is count-identical to a fresh one
-        pods=list(base.pods) + list(added),
-        sig_of_pod=np.concatenate([base.sig_of_pod, np.asarray(added_sigs, np.int32)]),
+        pods=kept_pods + added,
+        sig_of_pod=np.concatenate([kept_sigs, np.asarray(added_sigs, np.int32)]),
     )
     enc.delta_base = base
     enc.delta_added_sigs = np.asarray(added_sigs, np.int32)
+    enc.delta_removed_enc = removed_enc
     cached_restrict = getattr(base, "_sig_restrict", None)
     if cached_restrict is not None:
         enc._sig_restrict = cached_restrict
@@ -1224,11 +1279,9 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         # a solve pod's claim already attached on a node would double-count
         # against the node's axis (the host dedupes by id — volumeusage.go)
         for sn in snap.state_nodes:
-            for vols in sn.volume_usage._volumes.values():
-                hit = vols & pvc_owner.keys()
-                if hit:
-                    vol_reasons.append(f"pvc {next(iter(hit))} already attached on {sn.name()}")
-                    break
+            hit = sn.volume_usage.attached_ids() & pvc_owner.keys()
+            if hit:
+                vol_reasons.append(f"pvc {next(iter(hit))} already attached on {sn.name()}")
 
     # requirement classes: signatures sharing (node_selector, affinity) lower
     # to the same Requirements — decode caches its per-claim instance-type
@@ -1250,7 +1303,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     for key0, cid in req_class_ids.items():
         req_class_keys[cid] = key0
 
-    reasons = check_capability(snap, rep_pods)
+    reasons = check_capability(snap, rep_pods, vol_comps=vol_comp_of_sig)
     reasons.extend(r for r in vol_reasons if r not in reasons)
 
     # -- per-signature heavy lowering -----------------------------------------

@@ -79,6 +79,10 @@ _REASON_FAMILIES = (
     ("multiple domain keys", "multi-domain-keys"),
     ("spread taint policy", "spread-taint-policy"),
     ("node-filtered spread", "node-filtered-spread"),
+    ("pvc multi-alternative topology", "pvc-multi-alternative"),
+    ("volume topology overlaps spread key", "pvc-spread-overlap"),
+    ("shared with", "pvc-shared-claim"),
+    ("already attached", "pvc-already-attached"),
     ("PVC-backed volumes", "pvc-volumes"),
     ("dynamic resource claims", "dra-claims"),
     ("running pods with required anti-affinity", "running-anti-affinity"),
@@ -187,8 +191,9 @@ class TPUSolver:
         )
 
         # incremental re-solve: the encoder recognized this snapshot as the
-        # previous one plus appended known-shape pods, and the previous
-        # pack's final carry is still device-resident — scan ONLY the delta
+        # previous one plus/minus a few known-shape pods, and the previous
+        # pack's final carry is still device-resident — re-credit removals
+        # into it and scan ONLY the added delta
         self.last_solve_mode = "full"
         delta = self._solve_delta(snap, enc, delta_base)
         if delta is not None:
@@ -208,10 +213,12 @@ class TPUSolver:
         assignment = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
         return self._finish(snap, enc, assignment, out["slot_basis"], out["slot_zoneset"], t, out)
 
-    def _finish(self, snap, enc, assignment, slot_basis, slot_zoneset, t, out) -> Results:
+    def _finish(self, snap, enc, assignment, slot_basis, slot_zoneset, t, out, validated: bool = False) -> Results:
         """The shared solve tail (full AND delta paths): relaxation check,
         fast_validate self-check, decode, resident-state save, metrics — so
-        the two paths can never drift apart."""
+        the two paths can never drift apart. `validated=True` skips the
+        fast_validate re-run (the delta path validates BEFORE committing so a
+        stale carry retries the full pack instead of falling to FFD)."""
         # tier-0 honored every soft constraint; an unplaced pod means the
         # host relaxation loop (preferences.go:40-55) must take over — the
         # tensor pack cannot peel preferences per pod
@@ -225,7 +232,7 @@ class TPUSolver:
         from ..metrics import SOLVER_SOLVE_TOTAL, SOLVER_VALIDATION_FAILURES_TOTAL
         from .check import fast_validate
 
-        violations = fast_validate(enc, assignment, slot_basis, slot_zoneset)
+        violations = [] if validated else fast_validate(enc, assignment, slot_basis, slot_zoneset)
         if violations:
             self._count(SOLVER_VALIDATION_FAILURES_TOTAL)
             if self.force:
@@ -239,63 +246,135 @@ class TPUSolver:
                 raise
             return self._fall_back(snap, [f"validation: {e}"], family="validation")
         if self.mesh is None and out.get("state") is not None:
-            self._resident = dict(enc=enc, t=t, state=out["state"], assignment=np.asarray(assignment))
+            self._resident = dict(
+                enc=enc,
+                t=t,
+                state=out["state"],
+                assignment=np.asarray(assignment),
+                slot_basis=np.asarray(slot_basis),
+                slot_zoneset=np.asarray(slot_zoneset),
+            )
         self._count(SOLVER_SOLVE_TOTAL, backend="tpu")
         return results
 
     def _solve_delta(self, snap: SolverSnapshot, enc, base) -> Results | None:
-        """Incremental solve for an append-only pod delta: scan only the
-        delta items from the previous pack's device-resident final carry,
-        merge with the previous assignment, re-validate the WHOLE placement,
-        and decode. `base` is the consumed delta_base link (cleared by the
-        caller). Returns None when the full path must run."""
+        """Incremental solve for a small pod delta in EITHER direction:
+        removed pods' takes are re-credited into the previous pack's
+        device-resident final carry, added pods' items are scanned from it,
+        the surviving assignment is merged, the WHOLE placement re-validated,
+        and decoded. `base` is the consumed delta_base link (cleared by the
+        caller). Returns None when the full path must run — including when a
+        removal leaves the kept placement outside the constraint envelope
+        (e.g. spread skew raised by vacating a min domain): such snapshots
+        retry on the full TENSOR pack, never the FFD fallback."""
         res = self._resident
         if base is None or res is None or res["enc"] is not base or self.mesh is not None:
             return None
+        from ..models.scheduler_model import (
+            KIND_DOM_AFF,
+            KIND_DOM_ANTI,
+            KIND_DOM_SPREAD,
+            KIND_HOST_AFF,
+            KIND_HOST_ANTI,
+            KIND_HOST_SPREAD,
+        )
         from ..models.scheduler_model_grouped import (
             DELTA_ITEM_BUCKET,
             assignment_from_triples,
             greedy_pack_delta_compressed,
             make_item_tensors,
             pad_item_arrays,
+            recredit_removals,
         )
+
+        t = res["t"]
+        state = res["state"]
+        prev_assignment = res["assignment"]
+        slot_basis = res["slot_basis"]
+        slot_zoneset = res["slot_zoneset"]
+
+        removed = getattr(enc, "delta_removed_enc", None)
+        if removed is not None and removed.size:
+            rsig = base.sig_of_pod[removed]
+            rslot = prev_assignment[removed]
+            placed = rslot >= 0
+            if placed.any():
+                ps = rsig[placed]
+                # reversibility gate: port-mask unions, anti-affinity domain
+                # blocking, and affinity recording cannot be cleanly undone —
+                # those snapshots take the full pack
+                if enc.sig_port_any[ps].any():
+                    return None
+                kinds = np.asarray(enc.group_kind)
+                irrev = (kinds == KIND_DOM_ANTI) | (kinds == KIND_DOM_AFF) | (kinds == KIND_HOST_AFF)
+                if ((enc.sig_member[ps] | enc.sig_owner[ps]) & irrev[None, :]).any():
+                    return None
+                spread_g = kinds == KIND_DOM_SPREAD
+                host_g = (kinds == KIND_HOST_SPREAD) | (kinds == KIND_HOST_ANTI)
+                # pad member masks to the tensors' (bucketed) group axis
+                G_pad = int(t.group_kind.shape[0])
+                zmem = np.zeros((int(ps.shape[0]), G_pad), dtype=bool)
+                hmem = np.zeros((int(ps.shape[0]), G_pad), dtype=bool)
+                G = kinds.shape[0]
+                zmem[:, :G] = enc.sig_member[ps] & spread_g[None, :]
+                hmem[:, :G] = enc.sig_member[ps] & host_g[None, :]
+                state = recredit_removals(
+                    state, t, rslot[placed].astype(np.int32), enc.sig_req[ps], zmem, hmem
+                )
+            keep = np.ones(prev_assignment.shape[0], dtype=bool)
+            keep[removed] = False
+            prev_assignment = prev_assignment[keep]
 
         added_sigs = enc.delta_added_sigs
         n_added = int(added_sigs.shape[0])
-        n_prev = len(base.pods)
-        sigs_u, inv = np.unique(added_sigs, return_inverse=True)
-        W_real = int(sigs_u.shape[0])
-        arrays = pad_item_arrays(
-            dict(
-                item_req=enc.sig_req[sigs_u],
-                item_mask=enc.sig_mask[sigs_u],
-                item_taint_ok=enc.sig_taint_ok[sigs_u],
-                item_dom_allowed=enc.sig_dom_allowed[sigs_u],
-                item_restrict=enc.sig_restrict[sigs_u],
-                item_member=enc.sig_member[sigs_u],
-                item_owner=enc.sig_owner[sigs_u],
-                item_count=np.bincount(inv, minlength=W_real).astype(np.int32),
-                item_port_any=enc.sig_port_any[sigs_u],
-                item_port_wild=enc.sig_port_wild[sigs_u],
-                item_port_spec=enc.sig_port_spec[sigs_u],
-                item_host_blocked=enc.sig_host_blocked[sigs_u],
-            ),
-            DELTA_ITEM_BUCKET,
-        )
-        items = make_item_tensors(arrays)
-        W_pad = arrays["item_count"].shape[0]
-        # delta item -> absolute pod indices (appended tail of enc.pods)
-        item_pods = [np.nonzero(inv == w)[0] + n_prev for w in range(W_real)]
-        item_pods += [np.zeros(0, np.int64)] * (W_pad - W_real)
-        t = res["t"]
-        out = greedy_pack_delta_compressed(res["state"], t, items, n_added)
-        if out["open_count"] == t.n_slots and int(out["leftovers"][:W_real].sum()) > 0:
-            return None  # slot axis exhausted: retry via the full (uncapped) path
-        d = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
-        assignment = np.concatenate([res["assignment"], np.full(enc.n_pods - n_prev, -1, dtype=np.int64)])
-        assignment[d >= 0] = d[d >= 0]
+        n_prev = int(prev_assignment.shape[0])  # == enc.n_pods - n_added
+        out = dict(state=state)
+        if n_added:
+            sigs_u, inv = np.unique(added_sigs, return_inverse=True)
+            W_real = int(sigs_u.shape[0])
+            arrays = pad_item_arrays(
+                dict(
+                    item_req=enc.sig_req[sigs_u],
+                    item_mask=enc.sig_mask[sigs_u],
+                    item_taint_ok=enc.sig_taint_ok[sigs_u],
+                    item_dom_allowed=enc.sig_dom_allowed[sigs_u],
+                    item_restrict=enc.sig_restrict[sigs_u],
+                    item_member=enc.sig_member[sigs_u],
+                    item_owner=enc.sig_owner[sigs_u],
+                    item_count=np.bincount(inv, minlength=W_real).astype(np.int32),
+                    item_port_any=enc.sig_port_any[sigs_u],
+                    item_port_wild=enc.sig_port_wild[sigs_u],
+                    item_port_spec=enc.sig_port_spec[sigs_u],
+                    item_host_blocked=enc.sig_host_blocked[sigs_u],
+                ),
+                DELTA_ITEM_BUCKET,
+            )
+            items = make_item_tensors(arrays)
+            W_pad = arrays["item_count"].shape[0]
+            # delta item -> absolute pod indices (appended tail of enc.pods)
+            item_pods = [np.nonzero(inv == w)[0] + n_prev for w in range(W_real)]
+            item_pods += [np.zeros(0, np.int64)] * (W_pad - W_real)
+            out = greedy_pack_delta_compressed(state, t, items, n_added)
+            if out["open_count"] == t.n_slots and int(out["leftovers"][:W_real].sum()) > 0:
+                return None  # slot axis exhausted: retry via the full (uncapped) path
+            d = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
+            assignment = np.concatenate([prev_assignment, np.full(n_added, -1, dtype=np.int64)])
+            assignment[d >= 0] = d[d >= 0]
+            slot_basis = out["slot_basis"]
+            slot_zoneset = out["slot_zoneset"]
+        else:
+            assignment = prev_assignment
+
+        # stale-carry guard BEFORE committing to this path: a failed check
+        # means the full pack should try fresh, not the FFD fallback
+        if enc.has_relaxable and (assignment < 0).any():
+            return None
+        from .check import fast_validate
+
+        if fast_validate(enc, assignment, slot_basis, slot_zoneset):
+            return None
         self.last_solve_mode = "delta"
-        return self._finish(snap, enc, assignment, out["slot_basis"], out["slot_zoneset"], t, out)
+        return self._finish(snap, enc, assignment, slot_basis, slot_zoneset, t, out, validated=True)
 
     # -- decode ----------------------------------------------------------------
     def _decode(self, snap: SolverSnapshot, enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zoneset: np.ndarray) -> Results:

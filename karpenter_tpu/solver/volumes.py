@@ -151,9 +151,13 @@ class VolumeLowering:
             terms = pv.node_affinity_required
             if pv.local or pv.host_path:
                 # hostname terms on local volumes never constrain replacements
-                # (volumetopology.go:191-222)
-                terms = [[e for e in t if e.get("key") != wk.HOSTNAME_LABEL_KEY] for t in terms]
-                terms = [t for t in terms if t] or ([] if not pv.node_affinity_required else [[]])
+                # (volumetopology.go:191-222); a term that filters to EMPTY is
+                # an UNCONSTRAINED alternative in the host oracle
+                # (volumetopology.py _persistent_volume_requirements) — since
+                # alternatives are OR'd, one unconstrained alternative means
+                # the volume never constrains the pod at all
+                filtered = [[e for e in t if e.get("key") != wk.HOSTNAME_LABEL_KEY] for t in terms]
+                terms = [] if any(not t for t in filtered) else filtered
             if len(terms) > 1:
                 out = (fp, None, driver, "pvc multi-alternative topology")
             elif terms and terms[0]:
@@ -193,9 +197,5 @@ def window_reasons(comp: VolComponent | None, pod) -> list[str]:
 def existing_row_axis_value(sn, driver: str) -> float:
     """Remaining attach slots for `driver` on an existing node, in axis units
     (ExistingNode semantics: exceeds_limits against CSINode allocatable)."""
-    vu = sn.volume_usage
-    limit = vu._limits.get(driver)
-    if limit is None:
-        return CSI_AXIS_BIG
-    used = len(vu._volumes.get(driver, ()))
-    return float(max(0, limit - used))
+    remaining = sn.volume_usage.remaining(driver)
+    return CSI_AXIS_BIG if remaining is None else float(remaining)

@@ -167,6 +167,38 @@ class TestCommonCaseInWindow:
         nc = tr.new_node_claims[0]
         assert set(nc.requirements.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-c"}
 
+    def test_local_pv_mixed_hostname_and_zone_terms_never_constrains(self):
+        # local PV with [[zone-c], [hostname-only]]: the hostname-only term
+        # becomes an UNCONSTRAINED alternative in the host oracle
+        # (volumetopology.py _persistent_volume_requirements), and OR'd
+        # alternatives with one unconstrained member never constrain — the
+        # tensor path must not pin the pod to zone-c
+        def prep(s):
+            s.create(
+                PersistentVolume(
+                    metadata=ObjectMeta(name="pv-mixed"),
+                    csi_driver=CSI,
+                    local=True,
+                    node_affinity_required=[
+                        [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-c"]}],
+                        [{"key": wk.HOSTNAME_LABEL_KEY, "operator": "In", "values": ["old-node"]}],
+                    ],
+                )
+            )
+            s.create(
+                PersistentVolumeClaim(
+                    metadata=ObjectMeta(name="c0", annotations={BIND_COMPLETED_ANNOTATION: "yes"}),
+                    volume_name="pv-mixed",
+                    phase="Bound",
+                )
+            )
+
+        pods = [make_pod(cpu="1", volumes=[pvc_volume("c0")])]
+        tr, _ = compare(pods, prep)
+        nc = tr.new_node_claims[0]
+        zone_req = nc.requirements.get(wk.ZONE_LABEL_KEY)
+        assert zone_req is None or set(zone_req.values) != {"test-zone-c"}
+
     def test_attach_limit_on_existing_node(self):
         # node has 2 attach slots for the driver; 4 one-claim pods -> at most
         # 2 land on the node, the rest go to new claims (ExistingNode
