@@ -37,7 +37,14 @@ class TerminationController:
                 continue
             if wk.TERMINATION_FINALIZER not in node.metadata.finalizers:
                 continue
-            self._terminate(node)
+            # per-item error isolation (controller-runtime semantics): a
+            # transient cloud delete failure keeps the finalizer and retries
+            # next round; it never kills the rest of the drain fleet
+            try:
+                self._terminate(node)
+            except Exception as e:  # noqa: BLE001
+                if self.recorder is not None:
+                    self.recorder.publish(node, "TerminationError", str(e), type_="Warning")
 
     def _terminate(self, node) -> None:
         name = node.metadata.name

@@ -34,7 +34,14 @@ class LifecycleController:
 
     def reconcile_all(self) -> None:
         for nc in self.store.borrow_list("NodeClaim"):
-            self.reconcile(nc.metadata.name)
+            # per-item error isolation (controller-runtime semantics: a
+            # reconcile error requeues THAT item; it never kills the manager)
+            # — a cloud-provider outage on one claim must not stall the fleet
+            try:
+                self.reconcile(nc.metadata.name)
+            except Exception as e:  # noqa: BLE001
+                if self.recorder is not None:
+                    self.recorder.publish(nc, "ReconcileError", str(e), type_="Warning")
 
     def reconcile(self, name: str) -> None:
         try:

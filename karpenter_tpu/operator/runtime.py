@@ -59,12 +59,16 @@ from .options import Options
 class Environment:
     """A fully wired in-process cluster + Karpenter control plane."""
 
-    def __init__(self, options: Options | None = None, clock=None, cloud_provider=None, instance_types=None):
+    def __init__(self, options: Options | None = None, clock=None, cloud_provider=None, instance_types=None, store=None):
+        """`store` lets a second Environment attach to an existing cluster
+        (active/standby takeover tests): informers seed the fresh in-memory
+        mirror from the shared store's current content, exactly like a new
+        leader warming its caches (operator.go:196-201)."""
         self.options = options or Options()
         self.clock = clock or FakeClock()
         self.registry = make_registry()
         self.recorder = Recorder(self.clock)
-        self.store = Store(clock=self.clock)
+        self.store = store if store is not None else Store(clock=self.clock)
         self.cluster = Cluster(self.store, self.clock)
         start_informers(self.store, self.cluster)
 
@@ -72,7 +76,8 @@ class Environment:
             base_cloud_provider = cloud_provider
         else:
             its = instance_types if instance_types is not None else catalog.construct_instance_types()
-            self.store.create(KWOKNodeClass())
+            if self.store.try_get("KWOKNodeClass", KWOKNodeClass().metadata.name) is None:
+                self.store.create(KWOKNodeClass())
             base_cloud_provider = KWOKCloudProvider(self.store, its, clock=self.clock)
         # decorator stack (kwok/main.go:36-37 + cloudprovider/metrics): the
         # overlay controller reads the undecorated provider; everyone else the

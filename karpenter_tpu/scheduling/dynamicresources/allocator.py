@@ -181,22 +181,31 @@ class ClaimAllocationMetadata:
 _SUPPORTED_DEVICE_REQ_OPS = {"In", "NotIn", "Gt", "Lt", "Exists"}
 
 
-def requirements_from_picks(picks) -> "Requirements":
-    """The node requirements a device selection pins: every chosen device's
-    `requirements` land on ONE node, so they intersect (Requirements.add).
+def _device_requirements(device) -> list:
+    """The node requirements one device pins, as Requirement objects.
     Only value/bound operators are supported — an absence operator
     (DoesNotExist) on a device requirement is ignored at ingestion, because
     a collapsed intersection also renders as DoesNotExist and the two would
     be indistinguishable to the pruning check."""
-    from ...scheduling.requirements import Requirement, Requirements
+    from ...scheduling.requirements import Requirement
+
+    out = []
+    for r in getattr(device, "requirements", None) or []:
+        op = r.get("operator", "In")
+        if op not in _SUPPORTED_DEVICE_REQ_OPS:
+            continue
+        out.append(Requirement(r["key"], op, r.get("values", [])))
+    return out
+
+
+def requirements_from_picks(picks) -> "Requirements":
+    """The node requirements a device selection pins: every chosen device's
+    `requirements` land on ONE node, so they intersect (Requirements.add)."""
+    from ...scheduling.requirements import Requirements
 
     out = Requirements()
     for _name, ref, _cap in picks:
-        for r in getattr(ref.device, "requirements", None) or []:
-            op = r.get("operator", "In")
-            if op not in _SUPPORTED_DEVICE_REQ_OPS:
-                continue
-            out.add(Requirement(r["key"], op, r.get("values", [])))
+        out.add(*_device_requirements(ref.device))
     return out
 
 
@@ -474,10 +483,10 @@ class Allocator:
         for it_name, entry in per_it.items():
             trial = trial_of(entry)
             if trial is None and entry[1].picks:
-                # the DFS picked devices blind to superposition; retry the
-                # allocation excluding devices whose own requirements already
-                # conflict with the running intersections, so an alternative
-                # same-type device combination can keep the type alive
+                # the first DFS ran without the cross-type intersections;
+                # retry with them seeded as per-claim bounds so the
+                # requirements-aware search finds an alternative same-type
+                # device combination wherever one exists
                 entry = self._reallocate_compatible(node_claim_id, it_name, entry, running)
                 trial = trial_of(entry) if entry is not None else None
             if trial is None or entry is None:
@@ -497,23 +506,14 @@ class Allocator:
         return kept, metas
 
     def _reallocate_compatible(self, node_claim_id: str, it_name: str, entry, running: dict):
-        """Retry one instance type's template allocation with devices that
-        conflict with the running intersections filtered out, against the
-        SAME baseline tracker (which carries earlier pods' consumption on
-        this in-flight NodeClaim). One-shot repair: devices are filtered
-        individually, so a mutually-conflicting combination among surviving
-        devices can still collapse the trial and prune the type — the full
-        fix would be a superposition-aware DFS. Returns a (tracker, result)
-        entry or None."""
-
-        def compatible(dev) -> bool:
-            for claim_key, total in running.items():
-                trial = total.copy()
-                trial.add(*requirements_from_picks([("", _DeviceRef(device=dev, driver="", pool="", device_id=()), None)]).values())
-                if not _requirements_satisfiable(trial):
-                    return False
-            return True
-
+        """Retry one instance type's template allocation under the running
+        cross-instance-type intersections, against the SAME baseline tracker
+        (which carries earlier pods' consumption on this in-flight NodeClaim).
+        The running totals seed the requirements-aware DFS as per-claim
+        bounds, so the search explores around BOTH cross-type conflicts and
+        mutually-conflicting same-type device combinations — an alternative
+        combination keeps the type alive wherever one exists. Returns a
+        (tracker, result) entry or None."""
         old_tracker, old_result = entry
         claims = list(old_result.claims)
         if not claims:
@@ -521,11 +521,10 @@ class Allocator:
         it = self._template_it_by_name.get(it_name)
         if it is None:
             return None
-        devices = [d for d in self.template_devices(it) if compatible(d.device)]
         # allocate() is pure w.r.t. the tracker, so reusing the entry's
         # baseline preserves earlier pods' device/counter consumption on this
         # NodeClaim (commit later applies the new picks against it)
-        result, err = self.allocate(node_claim_id, devices, claims, old_tracker)
+        result, err = self.allocate(node_claim_id, self.template_devices(it), claims, old_tracker, req_bounds=running)
         return (old_tracker, result) if err is None else None
 
     def commit_template_metadata(self, metas: dict) -> None:
@@ -553,13 +552,32 @@ class Allocator:
         return self.clock.now() if self.clock is not None else time.monotonic()
 
     # -- allocation ----------------------------------------------------------
-    def allocate(self, target_id: str, devices: list[_DeviceRef], claims: list, tracker: AllocationTracker):
+    def allocate(self, target_id: str, devices: list[_DeviceRef], claims: list, tracker: AllocationTracker, req_bounds: dict | None = None):
         """Try to satisfy every unallocated claim from `devices` given the
         tracker state. Returns (AllocationResult, None) or (None, err). Pure:
-        the tracker is copied, not mutated; commit applies the picks."""
+        the tracker is copied, not mutated; commit applies the picks.
+
+        The DFS is REQUIREMENTS-AWARE (allocator_test.go "Topology requirement
+        narrowing"): every picked device's node requirements accumulate into
+        the search state, a device whose requirements would collapse the
+        intersection is skipped, and backtracking restores the accumulation —
+        so mutually-conflicting device combinations are explored around, not
+        failed on. `req_bounds` (claim key -> Requirements) seeds a claim's
+        accumulation with externally-pinned topology (the superposition retry
+        passes the cross-instance-type running intersections).
+
+        The search tree spans ALL claims (the reference's decision tree
+        covers every claim's requests together): all picks land on one node,
+        so requirements tighten across claims, and a later claim's failure
+        backtracks into earlier claims' device choices (allocator_test.go
+        "should tighten baseline requirements for subsequent unallocated
+        claims", "Multi-claim competition")."""
+        from ...scheduling.requirements import Requirements
+
         result = AllocationResult(claims=list(claims))
         work = tracker.copy()
         deadline = self._now() + ALLOCATE_TIMEOUT_SECONDS
+        jobs = []  # (rc, externally-pinned extra bound | None)
         for rc in claims:
             if rc.status.allocation:
                 # allocated in-cluster: pod must land where the claim lives
@@ -572,12 +590,29 @@ class Allocator:
                 if prior != target_id:
                     return None, f"resourceclaim {rc.key()} is held by {prior}"
                 continue  # already allocated this loop on this very target
-            picks = self._allocate_claim(rc, devices, work, deadline)
-            if picks is None:
-                return None, f"cannot allocate devices for resourceclaim {rc.key()}"
-            # the DFS leaves successful picks taken in `work`; re-taking here
-            # would double-charge consumable capacity across claims
-            result.picks[rc.key()] = picks
+            extra = req_bounds.get(rc.key()) if req_bounds is not None else None
+            jobs.append((rc, extra))
+
+        shared_reqs = [Requirements()]  # node-level accumulation, all claims
+        picks_by_claim: dict[str, list] = {}
+        failed: list = [None]  # deepest claim that could not be satisfied
+
+        def run(j: int) -> bool:
+            if j == len(jobs):
+                return True
+            rc, extra = jobs[j]
+            ok = self._allocate_claim(
+                rc, devices, work, deadline, shared_reqs, extra, picks_by_claim, lambda: run(j + 1)
+            )
+            if not ok and failed[0] is None:
+                failed[0] = rc
+            return ok
+
+        if not run(0):
+            rc = failed[0]
+            return None, f"cannot allocate devices for resourceclaim {rc.key() if rc else '?'}"
+        for rc, _extra in jobs:
+            result.picks[rc.key()] = picks_by_claim.get(rc.key(), [])
         return result, None
 
     def commit(self, target_id: str, result: AllocationResult, tracker: AllocationTracker) -> None:
@@ -588,8 +623,15 @@ class Allocator:
                 tracker.take(ref, cap)
             self.claim_targets[claim_key] = target_id
 
-    def _allocate_claim(self, rc, devices: list[_DeviceRef], tracker: AllocationTracker, deadline: float):
-        """DFS over (request x candidate device) choices (allocator.go DFS)."""
+    def _allocate_claim(self, rc, devices: list[_DeviceRef], tracker: AllocationTracker, deadline: float, cur_reqs: list, extra_bound, picks_by_claim: dict, cont):
+        """DFS over (request x candidate device) choices (allocator.go DFS).
+        `cur_reqs` is the single-cell node-level requirements accumulation
+        SHARED across all claims of one allocate() call: devices whose own
+        requirements would collapse it (or this claim's `extra_bound`) are
+        skipped, successful picks tighten it, and backtracking restores it.
+        `cont` runs the rest of the claim chain once this claim is fully
+        assigned; its False return backtracks into THIS claim's choices."""
+
         constraints = [
             _MatchAttributeConstraint(c["matchAttribute"], c.get("requests"))
             for c in rc.constraints
@@ -597,6 +639,39 @@ class Allocator:
         ]
         requests = rc.requests
         picks: list = []
+        picks_by_claim[rc.key()] = picks  # live; final contents on success
+
+        def bound_ok(reqs) -> bool:
+            if not _requirements_satisfiable(reqs):
+                return False
+            if extra_bound is not None:
+                trial = reqs.copy()
+                trial.add(*extra_bound.values())
+                if not _requirements_satisfiable(trial):
+                    return False
+            return True
+
+        # fail fast on a collapsed seed: the shared node requirements already
+        # contradict this claim's externally-pinned topology — backtrack into
+        # earlier claims' choices rather than "succeeding" on an impossible
+        # node (review finding: requirement-free devices would otherwise
+        # carry the collapsed bound through unchecked)
+        if not bound_ok(cur_reqs[0]):
+            picks_by_claim.pop(rc.key(), None)
+            return False
+
+        def try_tighten(ref):
+            """The accumulated requirements with `ref`'s added, or None when
+            the intersection collapses (device topologically incompatible
+            with the path or with this claim's external bound)."""
+            dreqs = _device_requirements(ref.device)
+            if not dreqs:
+                return cur_reqs[0]  # unconstrained device: state unchanged
+            trial = cur_reqs[0].copy()
+            trial.add(*dreqs)
+            if not bound_ok(trial):
+                return None
+            return trial
 
         def eligible(req, ref):
             sels = list(req.get("selectors") or [])
@@ -611,7 +686,10 @@ class Allocator:
             if self._now() > deadline:
                 return False
             if req_idx == len(requests):
-                return True
+                # claim fully assigned: run the rest of the claim chain; a
+                # False return resumes THIS claim's search (cross-claim
+                # backtracking)
+                return cont()
             req = requests[req_idx]
             name = req.get("name", f"request-{req_idx}")
             want_cap = {k: (v if isinstance(v, Quantity) else Quantity.parse(v)) for k, v in (req.get("capacity") or {}).items()}
@@ -620,11 +698,21 @@ class Allocator:
             candidates = [r for r in devices if eligible(req, r)]
             if mode == "All":
                 # take every candidate or none: unwind exactly what was taken
-                # (including per-constraint add/remove pairing) on any failure
+                # (including per-constraint add/remove pairing and the
+                # requirements accumulation) on any failure. Zero matching
+                # candidates fails the request (allocator_test.go: "should
+                # fail when an All-mode request matches zero devices")
+                if not candidates:
+                    return False
+                saved_reqs = cur_reqs[0]
                 chosen: list = []  # (ref, [constraints whose add() succeeded])
                 ok = True
                 for ref in candidates:
                     if not tracker.available(ref, want_cap):
+                        ok = False
+                        break
+                    tightened = try_tighten(ref)
+                    if tightened is None:
                         ok = False
                         break
                     added = []
@@ -638,6 +726,7 @@ class Allocator:
                         for c in added:
                             c.remove(name)
                         break
+                    cur_reqs[0] = tightened
                     tracker.take(ref, want_cap)
                     chosen.append((ref, added))
                     picks.append((name, ref, want_cap or None))
@@ -648,6 +737,7 @@ class Allocator:
                     for c in added:
                         c.remove(name)
                     picks.pop()
+                cur_reqs[0] = saved_reqs
                 return False
 
             def choose(k: int, start: int) -> bool:
@@ -660,6 +750,9 @@ class Allocator:
                     taken = (name, ref, want_cap or None)
                     if taken in picks or not tracker.available(ref, want_cap):
                         continue
+                    tightened = try_tighten(ref)
+                    if tightened is None:
+                        continue  # topologically incompatible with the path
                     ok = True
                     added = []
                     for c in constraints:
@@ -672,19 +765,25 @@ class Allocator:
                         for c in added:
                             c.remove(name)
                         continue
+                    saved = cur_reqs[0]
+                    cur_reqs[0] = tightened
                     tracker.take(ref, want_cap)
                     picks.append(taken)
                     if choose(k - 1, i + 1):
                         return True
                     picks.pop()
                     tracker.release(ref, want_cap)
+                    cur_reqs[0] = saved
                     for c in added:
                         c.remove(name)
                 return False
 
             return choose(count, 0)
 
-        return picks if dfs(0) else None
+        ok = dfs(0)
+        if not ok:
+            picks_by_claim.pop(rc.key(), None)
+        return ok
 
     # -- candidate views ------------------------------------------------------
     def allocate_for_node(self, node_name: str, claims: list):

@@ -892,6 +892,12 @@ def _try_delta_encode(snap, cache: EncodeCache):
         return None
     if not added and not removed_raw:
         return base
+    # a fallback-pinned base must not chain through removals: the removed pod
+    # may have been the sole reason the snapshot was out-of-window, and
+    # dc.replace would carry the stale reason forever (appends are safe — all
+    # base pods remain, and appended pods reuse interned in-window shapes)
+    if removed_raw and base.fallback_reasons:
+        return None
     import dataclasses as _dc
 
     if removed_raw:

@@ -247,6 +247,35 @@ class TestRemovalDeltaGates:
         assert not results.pod_errors
 
 
+class TestFallbackUnpinning:
+    def test_removing_the_out_of_window_pod_reengages_tensor_path(self):
+        # review finding: with the offending pod removed, a removal delta
+        # must NOT chain the base's stale fallback reason — the full encode
+        # re-derives and the tensor path re-engages
+        from karpenter_tpu.apis import labels as wk
+        from karpenter_tpu.kube.objects import Affinity, PodAffinityTerm, WeightedPodAffinityTerm
+
+        plain = [make_pod(cpu="500m") for _ in range(6)]
+        # preferred pod affinity is out-of-window (host relaxation owns it)
+        odd = make_pod(cpu="500m")
+        odd.spec.affinity = Affinity(
+            pod_affinity_preferred=[
+                WeightedPodAffinityTerm(
+                    weight=1,
+                    term=PodAffinityTerm(label_selector={"x": "y"}, topology_key=wk.ZONE_LABEL_KEY),
+                )
+            ]
+        )
+        snap = make_snapshot(plain + [odd])
+        solver = TPUSolver()  # fallback allowed
+        solver.solve(snap)
+        assert solver.last_backend == "ffd-fallback"
+        snap.pods.remove(odd)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu", solver.last_fallback_reasons
+        assert not results.pod_errors
+
+
 class TestDeltaEquivalence:
     def test_churned_delta_matches_fresh_full_solve(self):
         # after a removal+add churn sequence, the delta placement must be
