@@ -529,15 +529,14 @@ class Topology:
         return out
 
     def _namespaces_for_term(self, pod, term) -> set[str]:
-        if term.namespaces:
-            return set(term.namespaces)
-        if term.namespace_selector is not None:
-            # empty selector matches all namespaces; we approximate with the
-            # namespaces of current pods plus the pod's own
-            if not term.namespace_selector:
-                return {p.metadata.namespace for p in self.store.list("Pod")} | {pod.metadata.namespace}
-            return {pod.metadata.namespace}
-        return {pod.metadata.namespace}
+        from ....utils.pods import term_namespaces
+
+        # empty selector matches all namespaces; approximated with the
+        # namespaces of current pods plus the pod's own (shared helper keeps
+        # the Binder's term scoping identical)
+        return term_namespaces(
+            pod, term, lambda: (p.metadata.namespace for p in self.store.borrow_list("Pod"))
+        )
 
     def _update_inverse_affinities(self) -> None:
         for pod in self.cluster.pods_with_anti_affinity():

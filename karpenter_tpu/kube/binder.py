@@ -36,9 +36,12 @@ class Binder:
         # (static/mirror) pods die with their node instead — they must never
         # become pending demand
         node_names = {n.metadata.name for n in nodes}
+        kept_pods = []
         for q in all_pods:
             if q.spec.node_name and q.spec.node_name not in node_names and pod_utils.is_active(q):
                 if pod_utils.is_owned_by_node(q):
+                    # dies with the node: drop from this pass's view too, or
+                    # the stale entry would count into affinity matching
                     self.store.try_delete("Pod", q.metadata.name, namespace=q.metadata.namespace)
                     continue
 
@@ -50,6 +53,8 @@ class Binder:
                 self.store.patch("Pod", q.metadata.name, orphan, namespace=q.metadata.namespace)
                 q.spec.node_name = ""
                 q.status.phase = "Pending"
+            kept_pods.append(q)
+        all_pods = kept_pods
         # per-node host-port usage, built once per pass from ACTIVE bound
         # pods (terminal pods free their ports, as in Kubernetes)
         self._port_usage = {}
@@ -62,7 +67,17 @@ class Binder:
                 self._port_usage.setdefault(q.spec.node_name, HostPortUsage()).add(q.key(), pod_host_ports(q))
                 self._pods_by_node.setdefault(q.spec.node_name, []).append(q)
         self._dra_allocator = None  # fresh per pass
-        self._node_domain = None  # lazy per-pass node->labels map for spreads
+        self._node_domain = {n.metadata.name: n.metadata.labels for n in nodes}
+        # symmetric anti-affinity (the kube-scheduler's InterPodAffinity
+        # plugin): ACTIVE BOUND pods carrying required anti terms repel
+        # matching candidates from their domains; maintained incrementally so
+        # a pod binding mid-pass repels later candidates in the same pass
+        self._anti_holders = [
+            (q, term, self._term_namespaces(q, term, all_pods))
+            for q in all_pods
+            if q.spec.node_name and pod_utils.is_active(q) and q.spec.affinity is not None
+            for term in q.spec.affinity.pod_anti_affinity_required
+        ]
         for pod in all_pods:
             if not pod_utils.is_provisionable(pod):
                 continue
@@ -72,8 +87,15 @@ class Binder:
                 pod.spec.node_name = node.metadata.name  # keep local view current for spread counting
                 self._port_usage.setdefault(node.metadata.name, HostPortUsage()).add(pod.key(), pod_host_ports(pod))
                 self._pods_by_node.setdefault(node.metadata.name, []).append(pod)
+                if pod.spec.affinity is not None:
+                    for term in pod.spec.affinity.pod_anti_affinity_required:
+                        self._anti_holders.append((pod, term, self._term_namespaces(pod, term, all_pods)))
                 bound += 1
         return bound
+
+    @staticmethod
+    def _term_namespaces(pod, term, all_pods) -> set:
+        return pod_utils.term_namespaces(pod, term, lambda: (p.metadata.namespace for p in all_pods))
 
     def _dra_ok(self, pod, node) -> bool:
         """Claim-bearing pods bind only where their claims are allocated (or
@@ -95,9 +117,66 @@ class Binder:
         self._dra_allocator.commit_for_node(node.metadata.name, result)
         return True
 
+    def _affinity_context(self, pod, all_pods):
+        """Per-PENDING-POD precompute for the inter-pod affinity checks: the
+        matching pods' occupied domains are node-independent, so one O(pods)
+        pass here replaces an O(pods) rescan per candidate node. Reflects
+        every bind made earlier in this pass (local node_name updates)."""
+        from .objects import match_label_selector
+
+        aff = pod.spec.affinity
+        anti_blocked: set = set()  # (key, domain) the pod's own anti terms forbid
+        aff_terms: list = []  # (key, allowed domains, found_any, self_match)
+        if aff is not None:
+            for term in aff.pod_anti_affinity_required:
+                key = term.topology_key
+                nss = self._term_namespaces(pod, term, all_pods)
+                for q in all_pods:
+                    if not q.spec.node_name or not pod_utils.is_active(q):
+                        continue
+                    if q.metadata.namespace not in nss:
+                        continue
+                    if not match_label_selector(term.label_selector, q.metadata.labels):
+                        continue
+                    d = self._node_domain.get(q.spec.node_name, {}).get(key)
+                    if d is not None:
+                        anti_blocked.add((key, d))
+            for term in aff.pod_affinity_required:
+                key = term.topology_key
+                nss = self._term_namespaces(pod, term, all_pods)
+                allowed: set = set()
+                found_any = False
+                for q in all_pods:
+                    if not q.spec.node_name or not pod_utils.is_active(q):
+                        continue
+                    if q.metadata.namespace not in nss:
+                        continue
+                    if not match_label_selector(term.label_selector, q.metadata.labels):
+                        continue
+                    found_any = True
+                    d = self._node_domain.get(q.spec.node_name, {}).get(key)
+                    if d is not None:
+                        allowed.add(d)
+                self_match = pod.metadata.namespace in nss and match_label_selector(
+                    term.label_selector, pod.metadata.labels
+                )
+                aff_terms.append((key, allowed, found_any, self_match))
+        # symmetric enforcement: domains whose holders' anti terms match THIS pod
+        holder_blocked: set = set()
+        for q, term, q_ns in self._anti_holders:
+            if pod.metadata.namespace not in q_ns:
+                continue
+            if not match_label_selector(term.label_selector, pod.metadata.labels):
+                continue
+            d = self._node_domain.get(q.spec.node_name, {}).get(term.topology_key)
+            if d is not None:
+                holder_blocked.add((term.topology_key, d))
+        return anti_blocked, aff_terms, holder_blocked
+
     def _find_node(self, pod, nodes, node_reqs_cache, all_pods):
         reqs = Requirements.from_pod(pod, strict=True)
         requests = res.pod_requests(pod)
+        aff_ctx = self._affinity_context(pod, all_pods)
         for node in nodes:
             if node.spec.unschedulable or node.metadata.deletion_timestamp is not None:
                 continue
@@ -111,7 +190,7 @@ class Binder:
             available = sn.available() if sn is not None else node.status.allocatable
             if not res.fits(requests, available):
                 continue
-            if not self._topology_ok(pod, node, nodes, all_pods):
+            if not self._topology_ok(pod, node, nodes, all_pods, aff_ctx):
                 continue
             if not self._ports_ok(pod, node):
                 continue
@@ -130,9 +209,11 @@ class Binder:
         usage = self._port_usage.get(node.metadata.name)
         return usage is None or usage.conflicts(pod.key(), ports) is None
 
-    def _topology_ok(self, pod, node, nodes, all_pods) -> bool:
-        """Honor DoNotSchedule spread constraints and required hostname
-        anti-affinity — the kube-scheduler behaviors the e2e flows rely on."""
+    def _topology_ok(self, pod, node, nodes, all_pods, aff_ctx) -> bool:
+        """Honor DoNotSchedule spread constraints and inter-pod
+        (anti-)affinity — the kube-scheduler behaviors the e2e flows rely on.
+        `aff_ctx` is the pod's precomputed (anti_blocked, aff_terms,
+        holder_blocked) from _affinity_context."""
         from .objects import match_label_selector
         from ..controllers.provisioning.scheduling.topology import effective_spread_selector
 
@@ -140,8 +221,6 @@ class Binder:
             if tsc.when_unsatisfiable != "DoNotSchedule":
                 continue
             node_domain = self._node_domain
-            if node_domain is None:
-                node_domain = self._node_domain = {n.metadata.name: n.metadata.labels for n in nodes}
             eff_sel = effective_spread_selector(pod, tsc)
             counts: dict[str, int] = {}
             for n in nodes:
@@ -162,16 +241,28 @@ class Binder:
             if counts:
                 if counts.get(my_domain, 0) + 1 - min(counts.values()) > tsc.max_skew:
                     return False
-        aff = pod.spec.affinity
-        if aff is not None:
-            for term in aff.pod_anti_affinity_required:
-                if term.topology_key != wk.HOSTNAME_LABEL_KEY:
-                    continue
-                for q in self._pods_by_node.get(node.metadata.name, ()):
-                    if q.metadata.namespace == pod.metadata.namespace and match_label_selector(
-                        term.label_selector, q.metadata.labels
-                    ):
-                        return False
+        # inter-pod (anti-)affinity, kube-scheduler InterPodAffinity
+        # semantics over ANY topology key (a node missing the key offers no
+        # domain: anti terms cannot be violated there, affinity terms cannot
+        # be satisfied there) — set lookups against the precomputed context
+        node_labels = node.metadata.labels
+        anti_blocked, aff_terms, holder_blocked = aff_ctx
+        for key, d in anti_blocked:
+            if node_labels.get(key) == d:
+                return False
+        for key, d in holder_blocked:
+            if node_labels.get(key) == d:
+                return False
+        for key, allowed, found_any, self_match in aff_terms:
+            my_d = node_labels.get(key)
+            if my_d is None:
+                return False
+            if my_d in allowed:
+                continue
+            # bootstrap rule: with NO matching pod anywhere, a pod matching
+            # its own term may found the domain
+            if found_any or not self_match:
+                return False
         return True
 
     def _bind(self, pod, node) -> None:

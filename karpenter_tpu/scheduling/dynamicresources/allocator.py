@@ -596,13 +596,16 @@ class Allocator:
         shared_reqs = [Requirements()]  # node-level accumulation, all claims
         picks_by_claim: dict[str, list] = {}
         failed: list = [None]  # deepest claim that could not be satisfied
+        # the devices list is fixed for the whole call; parse each device's
+        # requirements ONCE instead of on every DFS visit/backtrack
+        dev_reqs = {id(ref.device): _device_requirements(ref.device) for ref in devices}
 
         def run(j: int) -> bool:
             if j == len(jobs):
                 return True
             rc, extra = jobs[j]
             ok = self._allocate_claim(
-                rc, devices, work, deadline, shared_reqs, extra, picks_by_claim, lambda: run(j + 1)
+                rc, devices, work, deadline, shared_reqs, extra, picks_by_claim, lambda: run(j + 1), dev_reqs
             )
             if not ok and failed[0] is None:
                 failed[0] = rc
@@ -623,7 +626,7 @@ class Allocator:
                 tracker.take(ref, cap)
             self.claim_targets[claim_key] = target_id
 
-    def _allocate_claim(self, rc, devices: list[_DeviceRef], tracker: AllocationTracker, deadline: float, cur_reqs: list, extra_bound, picks_by_claim: dict, cont):
+    def _allocate_claim(self, rc, devices: list[_DeviceRef], tracker: AllocationTracker, deadline: float, cur_reqs: list, extra_bound, picks_by_claim: dict, cont, dev_reqs: dict | None = None):
         """DFS over (request x candidate device) choices (allocator.go DFS).
         `cur_reqs` is the single-cell node-level requirements accumulation
         SHARED across all claims of one allocate() call: devices whose own
@@ -664,7 +667,12 @@ class Allocator:
             """The accumulated requirements with `ref`'s added, or None when
             the intersection collapses (device topologically incompatible
             with the path or with this claim's external bound)."""
-            dreqs = _device_requirements(ref.device)
+            if dev_reqs is not None:
+                dreqs = dev_reqs.get(id(ref.device))
+                if dreqs is None:
+                    dreqs = dev_reqs[id(ref.device)] = _device_requirements(ref.device)
+            else:
+                dreqs = _device_requirements(ref.device)
             if not dreqs:
                 return cur_reqs[0]  # unconstrained device: state unchanged
             trial = cur_reqs[0].copy()

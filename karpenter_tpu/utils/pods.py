@@ -79,3 +79,18 @@ def is_disruptable(pod, now: float | None = None) -> bool:
 
 def is_eviction_blocked(pod, now: float | None = None) -> bool:
     return has_do_not_disrupt(pod, now) and is_active(pod)
+
+
+def term_namespaces(pod, term, all_namespaces) -> set:
+    """The namespaces a PodAffinityTerm selects: explicit list > selector
+    (empty selector = ALL namespaces, approximated by `all_namespaces()`, a
+    callable yielding every currently-known namespace; non-empty selectors
+    approximate to the pod's own) > the pod's own namespace. Shared by the
+    host topology tracker and the Binder so their term scoping can't drift."""
+    if term.namespaces:
+        return set(term.namespaces)
+    if term.namespace_selector is not None:
+        if not term.namespace_selector:
+            return set(all_namespaces()) | {pod.metadata.namespace}
+        return {pod.metadata.namespace}
+    return {pod.metadata.namespace}

@@ -1,0 +1,923 @@
+"""Scheduler behavior depth batch 3, ported from the reference's
+provisioning/scheduling/suite_test.go (5,743 LoC): the node-selector /
+requirements operator matrix over custom AND well-known labels, preference
+relaxation order, and instance-type exclusion families. Each spec cites its
+reference It() by line."""
+
+import pytest
+
+from helpers import make_nodepool, make_pod
+from test_scheduler import LINUX_AMD64, build_env, make_scheduler
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+
+
+def solve(pods, node_pools=None, types=None, **kw):
+    env = build_env(node_pools=node_pools, types=types)
+    s = make_scheduler(*env, **kw)
+    return s.solve(pods)
+
+
+CUSTOM = "company.com/team"
+
+
+def custom_pool(values=("infra", "web"), extra=None):
+    reqs = LINUX_AMD64 + [{"key": CUSTOM, "operator": "In", "values": list(values)}]
+    if extra:
+        reqs = reqs + extra
+    return make_nodepool(requirements=reqs)
+
+
+def committed(results, key):
+    """The single committed value of `key` on every claim."""
+    out = []
+    for nc in results.new_node_claims:
+        r = nc.requirements.get(key)
+        assert r is not None and len(r.values) == 1
+        out.append(r.any())
+    return out
+
+
+class TestCustomLabelSelectors:
+    """suite_test.go Context("Custom Labels") :153-664."""
+
+    def test_unconstrained_pod_schedules(self):
+        # :153 "should schedule unconstrained pods that don't have matching
+        # node selectors"
+        results = solve([make_pod(cpu="1")], node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+
+    def test_conflicting_node_selector_fails(self):
+        # :161 — selector value outside the pool's set
+        results = solve([make_pod(cpu="1", node_selector={CUSTOM: "other"})], node_pools=[custom_pool()])
+        assert not results.all_pods_scheduled()
+
+    def test_undefined_selector_key_fails(self):
+        # :170 — key no pool defines
+        results = solve([make_pod(cpu="1", node_selector={"undefined.com/key": "x"})], node_pools=[custom_pool()])
+        assert not results.all_pods_scheduled()
+
+    def test_matching_requirements_schedule(self):
+        # :178
+        pod = make_pod(cpu="1", required_affinity=[[{"key": CUSTOM, "operator": "In", "values": ["web"]}]])
+        results = solve([pod], node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+        assert committed(results, CUSTOM) == ["web"]
+
+    def test_conflicting_requirements_fail(self):
+        # :190
+        pod = make_pod(cpu="1", required_affinity=[[{"key": CUSTOM, "operator": "In", "values": ["nope"]}]])
+        results = solve([pod], node_pools=[custom_pool()])
+        assert not results.all_pods_scheduled()
+
+    def test_nodepool_constraints_flow_to_claims(self):
+        # :203 "should use NodePool constraints"
+        results = solve([make_pod(cpu="1")], node_pools=[custom_pool(values=("infra",))])
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        assert set(nc.requirements.get(CUSTOM).values) == {"infra"}
+
+    def test_node_selector_narrows_pool_set(self):
+        # :212 "should use node selectors"
+        results = solve([make_pod(cpu="1", node_selector={CUSTOM: "web"})], node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+        assert committed(results, CUSTOM) == ["web"]
+
+    def test_hostname_selector_never_matches_new_nodes(self):
+        # :223 "should not schedule nodes with a hostname selector"
+        pod = make_pod(cpu="1", node_selector={wk.HOSTNAME_LABEL_KEY: "some-existing-host"})
+        results = solve([pod])
+        assert not results.all_pods_scheduled()
+
+    def test_selector_outside_pool_constraints_fails(self):
+        # :241
+        pod = make_pod(cpu="1", node_selector={CUSTOM: "batch"})
+        results = solve([pod], node_pools=[custom_pool(values=("infra", "web"))])
+        assert not results.all_pods_scheduled()
+
+    def test_operator_in_compatible(self):
+        # :251
+        pod = make_pod(cpu="1", required_affinity=[[{"key": CUSTOM, "operator": "In", "values": ["web", "infra"]}]])
+        results = solve([pod], node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+
+    def test_operator_gt_compatible(self):
+        # :262 — pool pins an integer label; Gt below it matches
+        np = make_nodepool(requirements=LINUX_AMD64 + [{"key": CUSTOM, "operator": "In", "values": ["16"]}])
+        pod = make_pod(cpu="1", required_affinity=[[{"key": CUSTOM, "operator": "Gt", "values": ["8"]}]])
+        results = solve([pod], node_pools=[np])
+        assert results.all_pods_scheduled()
+
+    def test_operator_gt_incompatible(self):
+        np = make_nodepool(requirements=LINUX_AMD64 + [{"key": CUSTOM, "operator": "In", "values": ["16"]}])
+        pod = make_pod(cpu="1", required_affinity=[[{"key": CUSTOM, "operator": "Gt", "values": ["20"]}]])
+        results = solve([pod], node_pools=[np])
+        assert not results.all_pods_scheduled()
+
+    def test_operator_lt_compatible(self):
+        # :271
+        np = make_nodepool(requirements=LINUX_AMD64 + [{"key": CUSTOM, "operator": "In", "values": ["16"]}])
+        pod = make_pod(cpu="1", required_affinity=[[{"key": CUSTOM, "operator": "Lt", "values": ["20"]}]])
+        results = solve([pod], node_pools=[np])
+        assert results.all_pods_scheduled()
+
+    def test_operator_gte_compatible(self):
+        # :280 — inclusive bound admits equality
+        np = make_nodepool(requirements=LINUX_AMD64 + [{"key": CUSTOM, "operator": "In", "values": ["16"]}])
+        pod = make_pod(cpu="1", required_affinity=[[{"key": CUSTOM, "operator": "Gte", "values": ["16"]}]])
+        results = solve([pod], node_pools=[np])
+        assert results.all_pods_scheduled()
+
+    def test_operator_lte_compatible(self):
+        # :289
+        np = make_nodepool(requirements=LINUX_AMD64 + [{"key": CUSTOM, "operator": "In", "values": ["16"]}])
+        pod = make_pod(cpu="1", required_affinity=[[{"key": CUSTOM, "operator": "Lte", "values": ["16"]}]])
+        results = solve([pod], node_pools=[np])
+        assert results.all_pods_scheduled()
+
+    def test_operator_notin_compatible(self):
+        # :308
+        pod = make_pod(cpu="1", required_affinity=[[{"key": CUSTOM, "operator": "NotIn", "values": ["infra"]}]])
+        results = solve([pod], node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+        assert committed(results, CUSTOM) == ["web"]
+
+    def test_operator_notin_excluding_all_fails(self):
+        pod = make_pod(cpu="1", required_affinity=[[{"key": CUSTOM, "operator": "NotIn", "values": ["infra", "web"]}]])
+        results = solve([pod], node_pools=[custom_pool()])
+        assert not results.all_pods_scheduled()
+
+    def test_incompatible_preference_with_requirement_schedules(self):
+        # :298/:344 "should schedule incompatible preferences and
+        # requirements with Operator=In" — the preference relaxes away
+        pod = make_pod(
+            cpu="1",
+            required_affinity=[[{"key": CUSTOM, "operator": "In", "values": ["web"]}]],
+            preferred_affinity=[(1, [{"key": CUSTOM, "operator": "In", "values": ["nope"]}])],
+        )
+        results = solve([pod], node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+        assert committed(results, CUSTOM) == ["web"]
+
+    def test_compatible_preference_and_requirement(self):
+        # :330 — both hold: the preference narrows
+        pod = make_pod(
+            cpu="1",
+            required_affinity=[[{"key": CUSTOM, "operator": "In", "values": ["web", "infra"]}]],
+            preferred_affinity=[(1, [{"key": CUSTOM, "operator": "In", "values": ["web"]}])],
+        )
+        results = solve([pod], node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+        assert committed(results, CUSTOM) == ["web"]
+
+    def test_incompatible_preference_notin_schedules(self):
+        # :371 — NotIn preference conflicting with the requirement relaxes
+        pod = make_pod(
+            cpu="1",
+            required_affinity=[[{"key": CUSTOM, "operator": "In", "values": ["web"]}]],
+            preferred_affinity=[(1, [{"key": CUSTOM, "operator": "NotIn", "values": ["web"]}])],
+        )
+        results = solve([pod], node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+        assert committed(results, CUSTOM) == ["web"]
+
+    def test_combine_selector_preference_and_requirement(self):
+        # :384/:399 — node selector + requirement + preference all combine
+        pod = make_pod(
+            cpu="1",
+            node_selector={CUSTOM: "web"},
+            required_affinity=[[{"key": CUSTOM, "operator": "NotIn", "values": ["infra"]}]],
+            preferred_affinity=[(1, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}])],
+        )
+        results = solve([pod], node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+        assert committed(results, CUSTOM) == ["web"]
+        assert committed(results, wk.ZONE_LABEL_KEY) == ["test-zone-b"]
+
+    def test_restricted_label_selector_fails(self):
+        # :424 "should not schedule pods that have node selectors with
+        # restricted labels"
+        # restricted domain: kubernetes.io outside the allowed subdomains
+        pod = make_pod(cpu="1", node_selector={"kubernetes.io/forbidden": "x"})
+        results = solve([pod])
+        assert not results.all_pods_scheduled()
+
+    def test_label_in_kubernetes_domain_exceptions_schedules(self):
+        # :451 — allowed kubernetes.io subdomain labels pass through
+        np = make_nodepool(requirements=LINUX_AMD64 + [{"key": "node.kubernetes.io/instance-type", "operator": "Exists"}])
+        pod = make_pod(cpu="1", required_affinity=[[{"key": "node.kubernetes.io/instance-type", "operator": "Exists"}]])
+        results = solve([pod], node_pools=[np])
+        assert results.all_pods_scheduled()
+
+    def test_in_operator_undefined_key_fails(self):
+        # :507
+        pod = make_pod(cpu="1", required_affinity=[[{"key": "undefined/key", "operator": "In", "values": ["x"]}]])
+        results = solve([pod], node_pools=[custom_pool()])
+        assert not results.all_pods_scheduled()
+
+    def test_notin_operator_undefined_key_schedules(self):
+        # :516 — NotIn over an undefined key is vacuously satisfied
+        pod = make_pod(cpu="1", required_affinity=[[{"key": "undefined/key", "operator": "NotIn", "values": ["x"]}]])
+        results = solve([pod], node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+
+    def test_exists_operator_undefined_key_fails(self):
+        # :526
+        pod = make_pod(cpu="1", required_affinity=[[{"key": "undefined/key", "operator": "Exists"}]])
+        results = solve([pod], node_pools=[custom_pool()])
+        assert not results.all_pods_scheduled()
+
+    def test_does_not_exist_operator_undefined_key_schedules(self):
+        # :535
+        pod = make_pod(cpu="1", required_affinity=[[{"key": "undefined/key", "operator": "DoesNotExist"}]])
+        results = solve([pod], node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+
+    def test_exists_operator_defined_key_schedules(self):
+        # :577
+        pod = make_pod(cpu="1", required_affinity=[[{"key": CUSTOM, "operator": "Exists"}]])
+        results = solve([pod], node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+
+    def test_does_not_exist_operator_defined_key_fails(self):
+        # :589
+        pod = make_pod(cpu="1", required_affinity=[[{"key": CUSTOM, "operator": "DoesNotExist"}]])
+        results = solve([pod], node_pools=[custom_pool()])
+        assert not results.all_pods_scheduled()
+
+    def test_compatible_pods_share_a_node(self):
+        # :624 — non-conflicting selectors co-locate on one claim
+        pods = [
+            make_pod(cpu="100m", node_selector={CUSTOM: "web"}),
+            make_pod(cpu="100m", required_affinity=[[{"key": CUSTOM, "operator": "In", "values": ["web", "infra"]}]]),
+        ]
+        results = solve(pods, node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 1
+
+    def test_incompatible_pods_get_separate_nodes(self):
+        # :644
+        pods = [
+            make_pod(cpu="100m", node_selector={CUSTOM: "web"}),
+            make_pod(cpu="100m", node_selector={CUSTOM: "infra"}),
+        ]
+        results = solve(pods, node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 2
+
+    def test_exists_does_not_overwrite_existing_value(self):
+        # :664 "Exists operator should not overwrite the existing value" —
+        # a second pod's Exists must co-exist with the first pod's pin
+        pods = [
+            make_pod(cpu="100m", node_selector={CUSTOM: "web"}),
+            make_pod(cpu="100m", required_affinity=[[{"key": CUSTOM, "operator": "Exists"}]]),
+        ]
+        results = solve(pods, node_pools=[custom_pool()])
+        assert results.all_pods_scheduled()
+        # the pinned claim still commits "web"
+        assert "web" in {
+            nc.requirements.get(CUSTOM).any()
+            for nc in results.new_node_claims
+            if nc.pods and len(nc.requirements.get(CUSTOM).values) == 1
+        }
+
+
+class TestWellKnownLabelSelectors:
+    """suite_test.go Context("Well Known Labels") :677-1109 — the same
+    operator matrix against zone/instance-type labels."""
+
+    def test_zone_selector_schedules(self):
+        # :998
+        results = solve([make_pod(cpu="1", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"})])
+        assert results.all_pods_scheduled()
+        assert committed(results, wk.ZONE_LABEL_KEY) == ["test-zone-b"]
+
+    def test_zone_selector_unknown_value_fails(self):
+        # :705
+        results = solve([make_pod(cpu="1", node_selector={wk.ZONE_LABEL_KEY: "unknown-zone"})])
+        assert not results.all_pods_scheduled()
+
+    def test_zone_notin_matching_value_fails(self):
+        # :1010 — NotIn excluding every available zone
+        pod = make_pod(
+            cpu="1",
+            required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "NotIn",
+                                 "values": ["test-zone-a", "test-zone-b", "test-zone-c", "test-zone-d"]}]],
+        )
+        results = solve([pod])
+        assert not results.all_pods_scheduled()
+
+    def test_zone_notin_leaves_other_zones(self):
+        # :1056
+        pod = make_pod(cpu="1", required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "NotIn", "values": ["test-zone-a"]}]])
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+        assert committed(results, wk.ZONE_LABEL_KEY)[0] != "test-zone-a"
+
+    def test_zone_exists_schedules(self):
+        # :1021
+        pod = make_pod(cpu="1", required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "Exists"}]])
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+
+    def test_zone_does_not_exist_fails(self):
+        # :1033 — every node carries a zone
+        pod = make_pod(cpu="1", required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "DoesNotExist"}]])
+        results = solve([pod])
+        assert not results.all_pods_scheduled()
+
+    def test_instance_type_selector_schedules(self):
+        # :686 — pin one catalog instance type by label
+        it = catalog.construct_instance_types()[0]
+        results = solve([make_pod(cpu="100m", node_selector={wk.INSTANCE_TYPE_LABEL_KEY: it.name})])
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        assert [x.name for x in nc.instance_type_options] == [it.name]
+
+    def test_incompatible_zone_pods_different_nodes(self):
+        # :1088
+        pods = [
+            make_pod(cpu="100m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"}),
+            make_pod(cpu="100m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"}),
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 2
+
+    def test_compatible_zone_pods_share_node(self):
+        # :1068
+        pods = [
+            make_pod(cpu="100m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"}),
+            make_pod(cpu="100m", required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b", "test-zone-c"]}]]),
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 1
+
+
+class TestPreferenceRelaxation:
+    """suite_test.go Describe("Preferential Fallback") :1126-1233."""
+
+    def test_does_not_relax_the_final_term(self):
+        # :1126 — a single unsatisfiable preference term... the LAST term is
+        # never relaxed when it is all that's left of a required OR-set
+        pod = make_pod(cpu="1")
+        pod.spec.affinity = None
+        pod = make_pod(
+            cpu="1",
+            required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["invalid-zone"]}]],
+        )
+        results = solve([pod])
+        assert not results.all_pods_scheduled()
+
+    def test_relaxes_multiple_preferred_terms(self):
+        # :1142 — unsatisfiable preferences peel off one at a time until the
+        # pod schedules
+        pod = make_pod(
+            cpu="1",
+            preferred_affinity=[
+                (10, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["invalid-zone"]}]),
+                (5, [{"key": CUSTOM, "operator": "In", "values": ["undefined"]}]),
+            ],
+        )
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+
+    def test_relaxes_all_terms_when_nothing_fits(self):
+        # :1166
+        pod = make_pod(
+            cpu="1",
+            preferred_affinity=[
+                (10, [{"key": "nope/a", "operator": "In", "values": ["x"]}]),
+                (10, [{"key": "nope/b", "operator": "In", "values": ["y"]}]),
+            ],
+        )
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+
+    def test_relaxes_lighter_weights_first(self):
+        # :1185 "should relax to use lighter weights" — the heavier
+        # satisfiable preference survives relaxation of the lighter one
+        pod = make_pod(
+            cpu="1",
+            preferred_affinity=[
+                (100, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}]),
+                (1, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["invalid-zone"]}]),
+            ],
+        )
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+        assert committed(results, wk.ZONE_LABEL_KEY) == ["test-zone-b"]
+
+    def test_preference_conflicting_with_requirement_schedules(self):
+        # :1212
+        pod = make_pod(
+            cpu="1",
+            required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}]],
+            preferred_affinity=[(1, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}])],
+        )
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+        assert committed(results, wk.ZONE_LABEL_KEY) == ["test-zone-a"]
+
+    def test_conflicting_preference_terms_schedule(self):
+        # :1233 "should schedule even if preference requirements are
+        # conflicting"
+        pod = make_pod(
+            cpu="1",
+            preferred_affinity=[
+                (1, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}]),
+                (1, [{"key": wk.ZONE_LABEL_KEY, "operator": "NotIn", "values": ["test-zone-a"]}]),
+            ],
+        )
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+
+
+class TestInstanceTypeSelection:
+    """suite_test.go Describe("Instance Type Compatibility") :1246-1505."""
+
+    def test_oversized_request_fails(self):
+        # :1246 "should not schedule if requesting more resources than any
+        # instance type has"
+        results = solve([make_pod(cpu="10000")])
+        assert not results.all_pods_scheduled()
+
+    def test_different_archs_different_instances(self):
+        # :1257
+        np = make_nodepool(
+            requirements=[
+                {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+                {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64", "arm64"]},
+            ]
+        )
+        pods = [
+            make_pod(cpu="100m", node_selector={wk.ARCH_LABEL_KEY: "amd64"}),
+            make_pod(cpu="100m", node_selector={wk.ARCH_LABEL_KEY: "arm64"}),
+        ]
+        results = solve(pods, node_pools=[np])
+        assert results.all_pods_scheduled()
+        claims = [nc for nc in results.new_node_claims if nc.pods]
+        assert len(claims) == 2
+        archs = {nc.requirements.get(wk.ARCH_LABEL_KEY).any() for nc in claims}
+        assert archs == {"amd64", "arm64"}
+
+    def test_affinity_excludes_instance_types(self):
+        # :1282 — NotIn over the instance-type label drops those options
+        its = catalog.construct_instance_types()
+        banned = its[0].name
+        pod = make_pod(cpu="100m", required_affinity=[[{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "NotIn", "values": [banned]}]])
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+        for nc in results.new_node_claims:
+            assert banned not in [x.name for x in nc.instance_type_options]
+
+    def test_os_affinity_excludes_instance_types(self):
+        # :1303 — an OS constraint no catalog type offers fails; a satisfied
+        # one filters every surviving option down to that OS
+        np = make_nodepool(
+            requirements=[
+                {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux", "windows"]},
+                {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+            ]
+        )
+        its = catalog.construct_instance_types()
+        offered = {it.requirements.get(wk.OS_LABEL_KEY).any() for it in its if it.requirements.get(wk.OS_LABEL_KEY)}
+        missing_os = next((o for o in ("windows",) if o not in offered), None)
+        if missing_os is not None:
+            pod = make_pod(cpu="100m", node_selector={wk.OS_LABEL_KEY: missing_os})
+            assert not solve([pod], node_pools=[np]).all_pods_scheduled()
+        pod = make_pod(cpu="100m", node_selector={wk.OS_LABEL_KEY: "linux"})
+        results = solve([pod], node_pools=[np])
+        assert results.all_pods_scheduled()
+        for nc in results.new_node_claims:
+            for it in nc.instance_type_options:
+                os_req = it.requirements.get(wk.OS_LABEL_KEY)
+                assert os_req is None or "linux" in os_req.values
+
+    def test_zone_selectors_split_instances(self):
+        # :1390
+        pods = [
+            make_pod(cpu="100m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"}),
+            make_pod(cpu="100m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"}),
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert sorted(committed(results, wk.ZONE_LABEL_KEY)) == ["test-zone-a", "test-zone-b"]
+
+    def test_resources_not_on_single_instance_split(self):
+        # :1415 "should launch pods with resources that aren't on any single
+        # instance type on different instances" — approximated with two pods
+        # each filling the largest type's cpu
+        biggest = max(catalog.construct_instance_types(), key=lambda it: it.capacity["cpu"].milli)
+        half = biggest.capacity["cpu"].milli * 6 // 10
+        pods = [make_pod(cpu=f"{half}m"), make_pod(cpu=f"{half}m")]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 2
+
+
+class TestBinpacking:
+    """suite_test.go Describe("Binpacking") :1520-1761."""
+
+    def _cheapest_price(self, nc):
+        return min(
+            o.price
+            for it in nc.instance_type_options
+            for o in it.offerings
+            if o.available and nc.requirements.intersects(o.requirements) is None
+        )
+
+    def test_small_pod_smallest_instance(self):
+        # :1520/:1532 — a tiny pod's claim must keep (and price toward) the
+        # smallest fitting type, not a huge one
+        results = solve([make_pod(cpu="100m", memory="100Mi")])
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        # cheapest among the pool-compatible (linux/amd64) universe
+        fleet_cheapest = min(
+            o.price
+            for it in catalog.construct_instance_types()
+            if it.requirements.get(wk.ARCH_LABEL_KEY) and "amd64" in it.requirements.get(wk.ARCH_LABEL_KEY).values
+            for o in it.offerings
+            if o.available
+        )
+        assert self._cheapest_price(nc) == fleet_cheapest
+
+    def test_multiple_small_pods_smallest_possible_type(self):
+        # :1572 — many tiny pods still prefer few cheap nodes
+        results = solve([make_pod(cpu="10m", memory="10Mi") for _ in range(5)])
+        assert results.all_pods_scheduled()
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 1
+
+    def test_new_node_when_at_capacity(self):
+        # :1591
+        biggest = max(catalog.construct_instance_types(), key=lambda it: it.capacity["cpu"].milli)
+        per_pod = biggest.capacity["cpu"].milli * 8 // 10
+        results = solve([make_pod(cpu=f"{per_pod}m") for _ in range(3)])
+        assert results.all_pods_scheduled()
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 3
+
+    def test_pack_small_and_large_pods_together(self):
+        # :1611
+        results = solve([make_pod(cpu="4"), make_pod(cpu="100m"), make_pod(cpu="100m")])
+        assert results.all_pods_scheduled()
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 1
+
+    def test_pack_nodes_tightly(self):
+        # :1643 — a near-full large pod and a small pod get DIFFERENT sizes
+        biggest = max(catalog.construct_instance_types(), key=lambda it: it.capacity["cpu"].milli)
+        big_req = biggest.capacity["cpu"].milli * 95 // 100
+        small_req = biggest.capacity["cpu"].milli * 6 // 100  # sum > any node
+        results = solve([make_pod(cpu=f"{big_req}m"), make_pod(cpu=f"{small_req}m")])
+        assert results.all_pods_scheduled()
+        claims = [nc for nc in results.new_node_claims if nc.pods]
+        assert len(claims) == 2
+        prices = sorted(self._cheapest_price(nc) for nc in claims)
+        assert prices[0] < prices[1], "the small pod must get a cheaper node"
+
+    def test_zero_quantity_requests(self):
+        # :1669
+        pod = make_pod(cpu="0")
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+
+    def test_pods_per_node_limit_forces_new_nodes(self):
+        # :1692 — the pods resource axis caps claims even with cpu headroom
+        types = catalog.construct_instance_types()
+        from karpenter_tpu.utils.quantity import Quantity
+        import copy
+
+        limited = []
+        for it in types[:3]:
+            it2 = copy.deepcopy(it)
+            it2.capacity = dict(it2.capacity)
+            it2.capacity["pods"] = Quantity.parse("2")
+            limited.append(it2)
+        results = solve([make_pod(cpu="10m") for _ in range(5)], types=limited)
+        assert results.all_pods_scheduled()
+        claims = [nc for nc in results.new_node_claims if nc.pods]
+        assert len(claims) >= 3
+        assert all(len(nc.pods) <= 2 for nc in claims)
+
+
+class TestInflightAndExistingNodes:
+    """suite_test.go Describe("In-Flight Nodes") :1828-2172 + existing-node
+    ordering :2490-2727 (solver-level analogues live in test_scheduler*.py;
+    these run the full Environment like the reference's envtest)."""
+
+    def _env(self):
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+
+        env = Environment(options=Options())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        return env
+
+    def test_no_second_node_for_compatible_selector_pod(self):
+        # :1845 — in-flight node satisfies the second pod's selector
+        env = self._env()
+        env.store.create(make_pod(cpu="100m", name="p0", node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"}))
+        env.settle(rounds=4)
+        assert env.store.count("Node") == 1
+        env.store.create(make_pod(cpu="100m", name="p1", node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"}))
+        env.settle(rounds=6)
+        assert env.store.count("Node") == 1
+        assert env.store.get("Pod", "p1").spec.node_name
+
+    def test_second_node_for_incompatible_selector_pod(self):
+        # :1913
+        env = self._env()
+        env.store.create(make_pod(cpu="100m", name="p0", node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"}))
+        env.settle(rounds=4)
+        env.store.create(make_pod(cpu="100m", name="p1", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"}))
+        env.settle(rounds=6)
+        assert env.store.count("Node") == 2
+
+    def test_second_node_when_pod_does_not_fit(self):
+        # :1894
+        env = self._env()
+        env.store.create(make_pod(cpu="100m", name="p0"))
+        env.settle(rounds=4)
+        first_node = env.store.list("Node")[0]
+        free = first_node.status.allocatable["cpu"].milli
+        env.store.create(make_pod(cpu=f"{free}m", name="big"))
+        env.settle(rounds=6)
+        assert env.store.count("Node") == 2
+
+    def test_scheduler_does_not_bind_pods(self):
+        # :2786 "should not bind pods to nodes" — the provisioner only
+        # creates capacity; binding is the kube-scheduler's (Binder's) job
+        from test_scheduler import build_env, make_scheduler
+
+        env = build_env()
+        s = make_scheduler(*env)
+        pod = make_pod(cpu="100m")
+        results = s.solve([pod])
+        assert results.all_pods_scheduled()
+        assert pod.spec.node_name == "", "Solve must never set node_name"
+
+    def test_reschedules_active_pods_from_deleting_node(self):
+        # :4059 — marking a node deleting makes its active pods provisionable
+        # demand again; a replacement launches
+        env = self._env()
+        env.store.create(make_pod(cpu="100m", name="p0"))
+        env.settle(rounds=4)
+        node = env.store.list("Node")[0]
+        env.store.delete("Node", node.metadata.name)  # graceful: drain path
+        env.settle(rounds=10)
+        p = env.store.get("Pod", "p0")
+        assert p.spec.node_name and p.spec.node_name != node.metadata.name
+        assert env.store.try_get("Node", node.metadata.name) is None
+
+    def test_does_not_reschedule_daemonset_pods_from_deleting_node(self):
+        # :4112 — DS-owned pods die with the node, never become demand
+        from karpenter_tpu.kube.objects import OwnerReference
+
+        env = self._env()
+        env.store.create(make_pod(cpu="100m", name="p0"))
+        env.settle(rounds=4)
+        node = env.store.list("Node")[0]
+        ds_pod = make_pod(cpu="10m", name="ds-pod", node_name=node.metadata.name)
+        ds_pod.metadata.owner_references = [OwnerReference(kind="DaemonSet", name="ds", uid="ds-uid")]
+        env.store.create(ds_pod)
+        env.store.delete("Node", node.metadata.name)
+        env.settle(rounds=10)
+        # the app pod rescheduled; the DS pod did not become pending demand
+        assert env.store.get("Pod", "p0").spec.node_name
+        ds = env.store.try_get("Pod", "ds-pod")
+        assert ds is None or ds.spec.node_name != "", "DS pod must never go pending"
+
+
+class TestSchedulingErrorSurface:
+    """suite_test.go :5195-5300 — pod errors when requirements eliminate
+    every instance type."""
+
+    def test_error_when_no_instance_types_exist(self):
+        # :5195
+        np = make_nodepool(
+            requirements=LINUX_AMD64
+            + [{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["non-existent-type"]}]
+        )
+        pod = make_pod(cpu="1")
+        results = solve([pod], node_pools=[np])
+        assert pod.key() in results.pod_errors
+
+    def test_multiple_pods_all_types_filtered(self):
+        # :5240
+        np = make_nodepool(
+            requirements=LINUX_AMD64
+            + [{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["non-existent-type"]}]
+        )
+        pods = [make_pod(cpu="1") for _ in range(3)]
+        results = solve(pods, node_pools=[np])
+        assert len(results.pod_errors) == 3
+
+    def test_conflicting_requirements_eliminate_all_types(self):
+        # :5271 — the pod's own requirements self-contradict
+        pod = make_pod(
+            cpu="1",
+            required_affinity=[[
+                {"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]},
+                {"key": wk.ZONE_LABEL_KEY, "operator": "NotIn", "values": ["test-zone-a"]},
+            ]],
+        )
+        results = solve([pod])
+        assert pod.key() in results.pod_errors
+
+    def test_zone_requirement_filters_all_types(self):
+        # :5300
+        pod = make_pod(cpu="1", node_selector={wk.ZONE_LABEL_KEY: "mars-central-1"})
+        results = solve([pod])
+        assert pod.key() in results.pod_errors
+
+
+class TestWellKnownOperatorMatrix:
+    """suite_test.go Context("Well Known Labels") :725-1109 — the operator
+    matrix over well-known keys (the custom-label mirror lives above)."""
+
+    def test_zone_in_compatible(self):
+        # :725 — the claim keeps the In-set (no constraint forces narrowing)
+        pod = make_pod(cpu="1", required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]}]])
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+        zr = results.new_node_claims[0].requirements.get(wk.ZONE_LABEL_KEY)
+        assert set(zr.values) <= {"test-zone-a", "test-zone-b"}
+
+    def test_capacity_type_in_compatible(self):
+        pod = make_pod(cpu="1", required_affinity=[[{"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": ["spot"]}]])
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+        assert committed(results, wk.CAPACITY_TYPE_LABEL_KEY) == ["spot"]
+
+    def test_incompatible_pref_with_requirement_wellknown(self):
+        # :754 — conflicting preference over zone relaxes away
+        pod = make_pod(
+            cpu="1",
+            required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}]],
+            preferred_affinity=[(1, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}])],
+        )
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+        assert committed(results, wk.ZONE_LABEL_KEY) == ["test-zone-a"]
+
+    def test_compatible_pref_and_requirement_wellknown(self):
+        # :786
+        pod = make_pod(
+            cpu="1",
+            required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]}]],
+            preferred_affinity=[(1, [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}])],
+        )
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+        assert committed(results, wk.ZONE_LABEL_KEY) == ["test-zone-b"]
+
+    def test_notin_pref_with_requirement_wellknown(self):
+        # :813 — compatible NotIn preference narrows
+        pod = make_pod(
+            cpu="1",
+            required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]}]],
+            preferred_affinity=[(1, [{"key": wk.ZONE_LABEL_KEY, "operator": "NotIn", "values": ["test-zone-a"]}])],
+        )
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+        assert committed(results, wk.ZONE_LABEL_KEY) == ["test-zone-b"]
+
+    def test_restricted_domain_labels_rejected(self):
+        # :891 "should not schedule pods that have node selectors with
+        # restricted domains"
+        pod = make_pod(cpu="1", node_selector={"karpenter.sh/custom": "x"})
+        results = solve([pod])
+        assert not results.all_pods_scheduled()
+
+    def test_wellknown_list_labels_schedule(self):
+        # :930 — well-known keys (os) pass validation and schedule
+        pod = make_pod(cpu="1", node_selector={wk.OS_LABEL_KEY: "linux"})
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+
+    def test_wellknown_notin_undefined_key_schedules(self):
+        # :960 — NotIn over a never-defined well-known-ish key
+        pod = make_pod(cpu="1", required_affinity=[[{"key": "node.kubernetes.io/windows-build", "operator": "NotIn", "values": ["x"]}]])
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+
+    def test_capacity_type_notin_commits_remaining(self):
+        # :764 mirror — NotIn spot leaves on-demand (and reserved, if any)
+        pod = make_pod(cpu="1", required_affinity=[[{"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "NotIn", "values": ["spot"]}]])
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        # no launchable offering may be spot under the claim's requirements
+        for it in nc.instance_type_options:
+            for o in it.offerings:
+                if o.available and nc.requirements.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None:
+                    assert o.capacity_type() != "spot"
+
+    def test_wellknown_doesnotexist_undefined_key_schedules(self):
+        # :979
+        pod = make_pod(cpu="1", required_affinity=[[{"key": "node.kubernetes.io/windows-build", "operator": "DoesNotExist"}]])
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+
+
+class TestVolumeLaunchBlocking:
+    """suite_test.go :3682-:3747 — deleting/lost volume objects block node
+    launch (validate_persistent_volume_claims parity)."""
+
+    def _snap_env(self, prepare):
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+
+        env = Environment(options=Options())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        prepare(env.store)
+        return env
+
+    def test_deleting_pvc_blocks_launch(self):
+        # :3682 "should not launch nodes for pod with deleting
+        # persistentVolumeClaim"
+        from karpenter_tpu.kube.objects import PersistentVolumeClaim, ObjectMeta
+
+        def prep(store):
+            pvc = PersistentVolumeClaim(metadata=ObjectMeta(name="dying"), phase="Pending")
+            store.create(pvc)
+            store.delete("PersistentVolumeClaim", "dying")  # graceful: deletion timestamp
+
+        env = self._snap_env(prep)
+        pod = make_pod(cpu="1", name="p0", volumes=[{"name": "v", "persistentVolumeClaim": {"claimName": "dying"}}])
+        env.store.create(pod)
+        env.settle(rounds=5)
+        assert env.store.count("Node") == 0
+        assert not env.store.get("Pod", "p0").spec.node_name
+
+    def test_pv_marked_for_deletion_blocks_launch(self):
+        # :3705 "should not launch nodes for pod with bound persistentVolume
+        # that is marked for deletion"
+        from karpenter_tpu.kube.objects import PersistentVolume, PersistentVolumeClaim, ObjectMeta
+        from karpenter_tpu.scheduling.volumeusage import BIND_COMPLETED_ANNOTATION
+
+        def prep(store):
+            store.create(PersistentVolume(metadata=ObjectMeta(name="pv0"), csi_driver="csi.example.com"))
+            store.delete("PersistentVolume", "pv0")
+            store.create(
+                PersistentVolumeClaim(
+                    metadata=ObjectMeta(name="c0", annotations={BIND_COMPLETED_ANNOTATION: "yes"}),
+                    volume_name="pv0",
+                    phase="Bound",
+                )
+            )
+
+        env = self._snap_env(prep)
+        pod = make_pod(cpu="1", name="p0", volumes=[{"name": "v", "persistentVolumeClaim": {"claimName": "c0"}}])
+        env.store.create(pod)
+        env.settle(rounds=5)
+        assert env.store.count("Node") == 0
+        assert not env.store.get("Pod", "p0").spec.node_name
+
+
+class TestDaemonSetAccounting:
+    """suite_test.go DaemonSet families :2201-:2362, :2727."""
+
+    def _env_with_daemonset(self, ds_cpu="500m", ds_selector=None):
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.kube.objects import DaemonSet, ObjectMeta, PodSpec, Container
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        env = Environment(options=Options())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        spec = PodSpec(
+            containers=[Container(resources={"requests": parse_resource_list({"cpu": ds_cpu})})],
+            node_selector=ds_selector or {},
+        )
+        env.store.create(DaemonSet(metadata=ObjectMeta(name="ds"), template_spec=spec))
+        return env
+
+    def test_daemonset_usage_tracked_separately(self):
+        # :2201 — the claim reserves DS overhead beyond the app pod's needs
+        env = self._env_with_daemonset(ds_cpu="1")
+        env.store.create(make_pod(cpu="1", name="app"))
+        env.settle(rounds=6)
+        assert env.store.get("Pod", "app").spec.node_name
+        node = env.store.list("Node")[0]
+        # the daemon pod materialized and bound onto the node too
+        ds_pods = [p for p in env.store.list("Pod") if p.metadata.name != "app"]
+        assert any(p.spec.node_name == node.metadata.name for p in ds_pods)
+        # capacity accounted: cpu allocatable covers app + daemon
+        assert node.status.allocatable["cpu"].milli >= 2000
+
+    def test_incompatible_daemonset_overhead_not_subtracted(self):
+        # :2727 "should not subtract daemonset overhead that is not strictly
+        # compatible with an existing node" — a DS pinned to zone-b never
+        # runs on a zone-a node, so its overhead must not shrink that node
+        env = self._env_with_daemonset(ds_cpu="4", ds_selector={wk.ZONE_LABEL_KEY: "test-zone-b"})
+        env.store.create(make_pod(cpu="1", name="app", node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"}))
+        env.settle(rounds=6)
+        assert env.store.get("Pod", "app").spec.node_name
+        node = env.store.list("Node")[0]
+        assert node.metadata.labels[wk.ZONE_LABEL_KEY] == "test-zone-a"
+        # no daemon pod on the zone-a node
+        assert not any(
+            p.spec.node_name == node.metadata.name and p.metadata.name != "app" for p in env.store.list("Pod")
+        )

@@ -1,0 +1,768 @@
+"""Topology depth batch 2, ported from the reference's topology_test.go
+specs not yet pinned by test_topology_depth.py / test_domain_topology.py:
+multi-phase skew recovery through the full Environment, capacity-type and
+arch spread edges, spread-option limiting, preferred pod (anti-)affinity
+violation rules, inverse anti-affinity variants, dependent affinity chains,
+and NodePool taint generation. Each spec cites its reference It() line."""
+
+import pytest
+
+from helpers import make_nodepool, make_pod, zone_spread
+from test_scheduler import LINUX_AMD64, build_env, make_scheduler
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.kube import PodAffinityTerm, TopologySpreadConstraint
+from karpenter_tpu.kube.objects import Affinity, WeightedPodAffinityTerm
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.scheduling.taints import Taint
+
+
+def solve(pods, node_pools=None, types=None, **kw):
+    env = build_env(node_pools=node_pools, types=types)
+    s = make_scheduler(*env, **kw)
+    return s.solve(pods)
+
+
+def make_env(node_pools=None, freeze_disruption=False):
+    """`freeze_disruption` sets the pool budgets to 0 nodes — the reference
+    provisioning suite runs no disruption controllers, so multi-phase specs
+    that edit pool requirements must not fight drift replacement here."""
+    from karpenter_tpu.apis.nodepool import Budget
+
+    env = Environment(options=Options())
+    for np in node_pools or [make_nodepool(requirements=LINUX_AMD64)]:
+        if freeze_disruption:
+            np.spec.disruption.budgets = [Budget(nodes="0")]
+        env.store.create(np)
+    return env
+
+
+def skew_counts(env, sel_labels, key=wk.ZONE_LABEL_KEY):
+    """Bound selector-matched pods per domain value — ExpectSkew analogue."""
+    counts = {}
+    for p in env.store.list("Pod"):
+        if not p.spec.node_name:
+            continue
+        if any(p.metadata.labels.get(k) != v for k, v in sel_labels.items()):
+            continue
+        node = env.store.try_get("Node", p.spec.node_name)
+        if node is None:
+            continue
+        d = node.metadata.labels.get(key)
+        if d is None:
+            continue
+        counts[d] = counts.get(d, 0) + 1
+    return counts
+
+
+def spread(key, max_skew=1, selector=None, when="DoNotSchedule", min_domains=None):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=key,
+        when_unsatisfiable=when,
+        label_selector=selector,
+        min_domains=min_domains,
+    )
+
+
+SEL = {"matchLabels": {"app": "web"}}
+WEB = {"app": "web"}
+
+
+def domain_counts(results, key):
+    counts = {}
+    for nc in results.new_node_claims:
+        r = nc.requirements.get(key)
+        d = r.any() if len(r.values) == 1 else tuple(sorted(r.values))
+        counts[d] = counts.get(d, 0) + len(nc.pods)
+    return counts
+
+
+class TestSpreadGuards:
+    def test_unknown_topology_key_ignored(self):
+        # topology_test.go:58 "should ignore unknown topology keys" — the
+        # reference leaves such pods pending (it cannot discover domains)
+        pod = make_pod(cpu="1", labels=WEB, tsc=[spread("unknown.com/key", selector=SEL)])
+        results = solve([pod])
+        assert not results.all_pods_scheduled()
+
+    def test_invalid_label_selector_not_spread(self):
+        # :76 "should not spread an invalid label selector" — an invalid
+        # selector matches nothing, so the pods are NOT spread (the reference
+        # asserts skew ConsistOf(2): both pods pack together); must not panic
+        # (admission denies such selectors on k8s >= 1.27 — the reference
+        # SKIPS there; we pin only the must-not-panic / must-schedule part)
+        bad = {"matchExpressions": [{"key": "app", "operator": "Bogus", "values": []}]}
+        pods = [make_pod(cpu="500m", labels=WEB, tsc=[spread(wk.ZONE_LABEL_KEY, selector=bad)]) for _ in range(2)]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+
+    def test_nil_label_selector_matches_nothing_but_schedules(self):
+        # :92 "should not spread when a nil label selector is defined"
+        pod = make_pod(cpu="1", labels=WEB, tsc=[spread(wk.ZONE_LABEL_KEY, selector=None)])
+        results = solve([pod])
+        assert results.all_pods_scheduled()
+
+
+class TestMultiPhaseSkew:
+    def test_non_minimum_domain_when_its_all_thats_available(self):
+        # :266 "should schedule to the non-minimum domain if its all that's
+        # available" — maxSkew 5; phases force zones 1, 2, then only 3: ten
+        # pods land 6 in zone-3 (bounded by min 1 + skew 5), rest pend
+        env = make_env(freeze_disruption=True)
+        np_name = env.store.list("NodePool")[0].metadata.name
+        tsc = [spread(wk.ZONE_LABEL_KEY, max_skew=5, selector=SEL)]
+
+        def pin(zone):
+            def patch(np):
+                np.spec.template.requirements = LINUX_AMD64 + [
+                    {"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": [zone]}
+                ]
+
+            env.store.patch("NodePool", np_name, patch)
+
+        pin("test-zone-a")
+        env.store.create(make_pod(cpu="1100m", name="a0", labels=WEB, tsc=tsc))
+        env.settle(rounds=6)
+        assert skew_counts(env, WEB) == {"test-zone-a": 1}
+        pin("test-zone-b")
+        env.store.create(make_pod(cpu="1100m", name="b0", labels=WEB, tsc=tsc))
+        env.settle(rounds=6)
+        assert skew_counts(env, WEB) == {"test-zone-a": 1, "test-zone-b": 1}
+        pin("test-zone-c")
+        for i in range(10):
+            env.store.create(make_pod(cpu="1100m", name=f"c{i}", labels=WEB, tsc=tsc))
+        env.settle(rounds=10)
+        counts = skew_counts(env, WEB)
+        assert counts == {"test-zone-a": 1, "test-zone-b": 1, "test-zone-c": 6}, counts
+
+    def test_only_minimum_domains_when_already_violating_skew(self):
+        # :308 "should only schedule to minimum domains if already violating
+        # max skew" — delete two zones' pods, then new pods rebalance toward
+        # the vacated zones
+        three_zones = make_nodepool(
+            requirements=LINUX_AMD64
+            + [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b", "test-zone-c"]}]
+        )
+        env = make_env(node_pools=[three_zones], freeze_disruption=True)
+        tsc = [spread(wk.ZONE_LABEL_KEY, max_skew=1, selector=SEL)]
+        for i in range(9):
+            env.store.create(make_pod(cpu="1100m", name=f"p{i}", labels=WEB, tsc=tsc))
+        env.settle(rounds=10)
+        counts = skew_counts(env, WEB)
+        assert sorted(counts.values()) == [3, 3, 3], counts
+        keep_zone = sorted(counts)[0]
+        for p in env.store.list("Pod"):
+            node = env.store.try_get("Node", p.spec.node_name)
+            if node is not None and node.metadata.labels.get(wk.ZONE_LABEL_KEY) != keep_zone:
+                env.store.try_delete("Pod", p.metadata.name)
+        env.settle(rounds=4)
+        assert list(skew_counts(env, WEB).values()) == [3]
+        for i in range(3):
+            env.store.create(make_pod(cpu="1100m", name=f"r{i}", labels=WEB, tsc=tsc))
+        env.settle(rounds=10)
+        counts = skew_counts(env, WEB)
+        # the three new pods go to the two vacated zones (skew recovery)
+        assert counts[keep_zone] == 3
+        assert sum(counts.values()) == 6
+        assert len(counts) == 3, counts
+
+    def test_zonal_constraint_with_existing_pod(self):
+        # :232 "should respect NodePool zonal constraints (existing pod)" —
+        # a running pod's zone counts into the spread even when the pool can
+        # no longer produce that zone
+        env = make_env(freeze_disruption=True)
+        np_name = env.store.list("NodePool")[0].metadata.name
+        tsc = [spread(wk.ZONE_LABEL_KEY, max_skew=1, selector=SEL)]
+
+        def pin(zones):
+            def patch(np):
+                np.spec.template.requirements = LINUX_AMD64 + [
+                    {"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": zones}
+                ]
+
+            env.store.patch("NodePool", np_name, patch)
+
+        pin(["test-zone-a"])
+        env.store.create(make_pod(cpu="1", name="seed", labels=WEB, tsc=tsc))
+        env.settle(rounds=6)
+        assert skew_counts(env, WEB) == {"test-zone-a": 1}
+        pin(["test-zone-a", "test-zone-b"])
+        for i in range(5):
+            env.store.create(make_pod(cpu="1", name=f"p{i}", labels=WEB, tsc=tsc))
+        env.settle(rounds=8)
+        counts = skew_counts(env, WEB)
+        assert sum(counts.values()) == 6
+        assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+    def test_only_matching_pods_on_domain_nodes_count(self):
+        # :412 — selector-matched pods on nodes WITHOUT the topology label
+        # must not count into the spread
+        from karpenter_tpu.kube import Node, ObjectMeta
+        from karpenter_tpu.kube.objects import NodeSpec, NodeStatus
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        env = make_env()
+        # an unmanaged zone-less node hosting a matching pod
+        env.store.create(
+            Node(
+                metadata=ObjectMeta(name="legacy", labels={wk.HOSTNAME_LABEL_KEY: "legacy"}),
+                spec=NodeSpec(provider_id="legacy://1"),
+                status=NodeStatus(
+                    capacity=parse_resource_list({"cpu": "8", "memory": "16Gi", "pods": "110"}),
+                    allocatable=parse_resource_list({"cpu": "8", "memory": "16Gi", "pods": "110"}),
+                ),
+            )
+        )
+        env.store.create(make_pod(cpu="100m", name="legacy-pod", labels=WEB, node_name="legacy"))
+        tsc = [spread(wk.ZONE_LABEL_KEY, max_skew=1, selector=SEL)]
+        for i in range(6):
+            env.store.create(make_pod(cpu="1", name=f"p{i}", labels=WEB, tsc=tsc))
+        env.settle(rounds=8)
+        counts = skew_counts(env, WEB)
+        assert sum(counts.values()) == 6  # the legacy pod has no zone: uncounted
+        assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+
+class TestCapacityTypeAndArchSpread:
+    def test_capacity_type_do_not_schedule_respects_skew(self):
+        # :681 — capacity-type spread with DoNotSchedule never violates skew
+        results = solve(
+            [make_pod(cpu="1", labels=WEB, tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=SEL)]) for _ in range(6)]
+        )
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.CAPACITY_TYPE_LABEL_KEY)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_capacity_type_schedule_anyway_may_violate(self):
+        # :716 "should violate max-skew when unsat = schedule anyway" — the
+        # pool is pinned to one capacity type; ScheduleAnyway pods all land
+        np = make_nodepool(
+            requirements=LINUX_AMD64 + [{"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": ["on-demand"]}]
+        )
+        pods = [
+            make_pod(cpu="1", labels=WEB, tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=SEL, when="ScheduleAnyway")])
+            for _ in range(4)
+        ]
+        results = solve(pods, node_pools=[np])
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.CAPACITY_TYPE_LABEL_KEY)
+        assert counts == {"on-demand": 4}
+
+    def test_capacity_type_spread_with_node_affinity_constraint(self):
+        # :815 "(node required affinity constrained)" — affinity restricts to
+        # both capacity types explicitly; spread balances across them
+        pods = [
+            make_pod(
+                cpu="1",
+                labels=WEB,
+                required_affinity=[[{"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": ["spot", "on-demand"]}]],
+                tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=SEL)],
+            )
+            for _ in range(6)
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.CAPACITY_TYPE_LABEL_KEY)
+        assert set(counts) == {"spot", "on-demand"}
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_capacity_type_spread_unconstrained(self):
+        # :852 "(no constraints)"
+        pods = [make_pod(cpu="1", labels=WEB, tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=SEL)]) for _ in range(4)]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.CAPACITY_TYPE_LABEL_KEY)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_hostname_spread_varying_arch(self):
+        # :607 "balance multiple deployments with hostname topology spread &
+        # varying arch" — two deployments, one per arch, each hostname-spread
+        np = make_nodepool(
+            requirements=[
+                {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+                {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64", "arm64"]},
+            ]
+        )
+        sel_a = {"matchLabels": {"app": "amd"}}
+        sel_b = {"matchLabels": {"app": "arm"}}
+        pods = [
+            make_pod(
+                cpu="1", labels={"app": "amd"}, node_selector={wk.ARCH_LABEL_KEY: "amd64"},
+                tsc=[spread(wk.HOSTNAME_LABEL_KEY, selector=sel_a)],
+            )
+            for _ in range(3)
+        ] + [
+            make_pod(
+                cpu="1", labels={"app": "arm"}, node_selector={wk.ARCH_LABEL_KEY: "arm64"},
+                tsc=[spread(wk.HOSTNAME_LABEL_KEY, selector=sel_b)],
+            )
+            for _ in range(3)
+        ]
+        results = solve(pods, node_pools=[np])
+        assert results.all_pods_scheduled()
+        # hostname spread with skew 1: one pod per claim within a deployment
+        for nc in results.new_node_claims:
+            apps = {p.metadata.labels.get("app") for p in nc.pods}
+            assert len(nc.pods) <= len(apps), "same-deployment pods must not share a host"
+
+
+class TestSpreadOptionLimiting:
+    def test_node_requirements_limit_spread_options(self):
+        # :1766 "should limit spread options by node requirements" — pods
+        # restricted to two zones spread across exactly those
+        pods = [
+            make_pod(
+                cpu="1",
+                labels=WEB,
+                required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]}]],
+                tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL)],
+            )
+            for _ in range(6)
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.ZONE_LABEL_KEY)
+        assert set(counts) == {"test-zone-a", "test-zone-b"}
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_node_selector_limits_spread_options_capacity_type(self):
+        # :1857/:1881 — a capacity-type selector pins the whole spread there
+        pods = [
+            make_pod(
+                cpu="1", labels=WEB, node_selector={wk.CAPACITY_TYPE_LABEL_KEY: "spot"},
+                tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=SEL)],
+            )
+            for _ in range(4)
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert domain_counts(results, wk.CAPACITY_TYPE_LABEL_KEY) == {"spot": 4}
+
+
+class TestPreferredPodAffinityViolation:
+    def test_preferred_pod_affinity_violable(self):
+        # :2231 "should allow violation of preferred pod affinity" — the
+        # affinity target doesn't exist; the pod schedules anyway
+        pref = Affinity(
+            pod_affinity_preferred=[
+                WeightedPodAffinityTerm(
+                    weight=50,
+                    term=PodAffinityTerm(label_selector={"matchLabels": {"security": "s2"}}, topology_key=wk.HOSTNAME_LABEL_KEY),
+                )
+            ]
+        )
+        aff_pod = make_pod(cpu="1")
+        aff_pod.spec.affinity = pref
+        pods = [make_pod(cpu="1", labels=WEB, tsc=[spread(wk.HOSTNAME_LABEL_KEY, selector=SEL)]) for _ in range(10)]
+        results = solve(pods + [aff_pod])
+        assert results.all_pods_scheduled()
+
+    def test_preferred_pod_anti_affinity_violable(self):
+        # :2264 "should allow violation of preferred pod anti-affinity" —
+        # preferred anti between spread pods still lets everything schedule
+        anti_pref = Affinity(
+            pod_anti_affinity_preferred=[
+                WeightedPodAffinityTerm(
+                    weight=50,
+                    term=PodAffinityTerm(label_selector={"matchLabels": WEB}, topology_key=wk.ZONE_LABEL_KEY),
+                )
+            ]
+        )
+        pods = []
+        for _ in range(6):
+            p = make_pod(cpu="1", labels=WEB, tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL)])
+            p.spec.affinity = anti_pref
+            pods.append(p)
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+
+    def test_affinity_preference_with_conflicting_required_constraint(self):
+        # :2630 "should allow violation of a pod affinity preference with a
+        # conflicting required constraint" — required zone In a; preferred
+        # affinity to a pod pinned in zone b; the preference loses
+        target = make_pod(cpu="1", labels={"security": "s2"}, node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"})
+        pod = make_pod(
+            cpu="1",
+            required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}]],
+        )
+        pod.spec.affinity.pod_affinity_preferred = [
+            WeightedPodAffinityTerm(
+                weight=50,
+                term=PodAffinityTerm(label_selector={"matchLabels": {"security": "s2"}}, topology_key=wk.ZONE_LABEL_KEY),
+            )
+        ]
+        results = solve([target, pod])
+        assert results.all_pods_scheduled()
+        zones = {nc.requirements.get(wk.ZONE_LABEL_KEY).any() for nc in results.new_node_claims if nc.pods}
+        assert zones == {"test-zone-a", "test-zone-b"}
+
+
+class TestAntiAffinityDepth:
+    def test_anti_affinity_arch(self):
+        # :2380 "should not violate pod anti-affinity (arch)" — anti over the
+        # arch key separates the two pods onto different arches
+        np = make_nodepool(
+            requirements=[
+                {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+                {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64", "arm64"]},
+            ]
+        )
+        sel = {"app": "arch-anti"}
+        term = PodAffinityTerm(label_selector={"matchLabels": sel}, topology_key=wk.ARCH_LABEL_KEY)
+        pods = [make_pod(cpu="1", labels=sel, anti_affinity=[term]) for _ in range(2)]
+        results = solve(pods, node_pools=[np])
+        scheduled = [nc for nc in results.new_node_claims if nc.pods]
+        archs = [nc.requirements.get(wk.ARCH_LABEL_KEY).any() for nc in scheduled]
+        # late-committal may leave the second replica pending this round; the
+        # scheduled ones must occupy distinct arches
+        assert len(archs) == len(set(archs))
+
+    def test_schroedinger_anti_affinity_target_blocks_then_commits(self):
+        # :2499 "(Schrödinger)" — an anti-affinity pod whose zone is
+        # uncommitted blocks the matching pod in round 1; once the node EXISTS
+        # (zone committed), round 2 schedules the matching pod elsewhere
+        env = make_env()
+        sel = {"security": "s2"}
+        anywhere = make_pod(cpu="2", name="anywhere", anti_affinity=[
+            PodAffinityTerm(label_selector={"matchLabels": sel}, topology_key=wk.ZONE_LABEL_KEY)
+        ])
+        target = make_pod(cpu="1", name="target", labels=sel)
+        env.store.create(anywhere)
+        env.store.create(target)
+        env.settle(rounds=8)
+        a = env.store.get("Pod", "anywhere")
+        t = env.store.get("Pod", "target")
+        assert a.spec.node_name, "anti-affinity pod schedules first (FFD order)"
+        assert t.spec.node_name, "target schedules once the zone is committed"
+        za = env.store.get("Node", a.spec.node_name).metadata.labels[wk.ZONE_LABEL_KEY]
+        zt = env.store.get("Node", t.spec.node_name).metadata.labels[wk.ZONE_LABEL_KEY]
+        assert za != zt
+
+    def test_anti_affinity_zone_other_schedules_first(self):
+        # :2358 "(other schedules first)" — the plain pod lands first; the
+        # anti pod avoids its zone
+        env = make_env()
+        sel = {"app": "first"}
+        env.store.create(make_pod(cpu="1", name="plain", labels=sel))
+        env.settle(rounds=6)
+        anti = make_pod(cpu="1", name="anti", anti_affinity=[
+            PodAffinityTerm(label_selector={"matchLabels": sel}, topology_key=wk.ZONE_LABEL_KEY)
+        ])
+        env.store.create(anti)
+        env.settle(rounds=8)
+        p1 = env.store.get("Pod", "plain")
+        p2 = env.store.get("Pod", "anti")
+        assert p1.spec.node_name and p2.spec.node_name
+        z1 = env.store.get("Node", p1.spec.node_name).metadata.labels[wk.ZONE_LABEL_KEY]
+        z2 = env.store.get("Node", p2.spec.node_name).metadata.labels[wk.ZONE_LABEL_KEY]
+        assert z1 != z2
+
+    def test_preferred_inverse_anti_affinity_violable(self):
+        # :2423 "should violate preferred pod anti-affinity on zone
+        # (inverse)" — a running pod's PREFERRED anti-affinity never blocks
+        # new pods into its zone
+        env = make_env()
+        sel = {"app": "victim"}
+        holder = make_pod(cpu="1", name="holder")
+        holder.spec.affinity = Affinity(
+            pod_anti_affinity_preferred=[
+                WeightedPodAffinityTerm(
+                    weight=50, term=PodAffinityTerm(label_selector={"matchLabels": sel}, topology_key=wk.ZONE_LABEL_KEY)
+                )
+            ]
+        )
+        env.store.create(holder)
+        env.settle(rounds=6)
+        for i in range(4):
+            env.store.create(make_pod(cpu="1", name=f"v{i}", labels=sel))
+        env.settle(rounds=8)
+        assert all(env.store.get("Pod", f"v{i}").spec.node_name for i in range(4))
+
+
+class TestPodAffinityDepth:
+    def test_pod_affinity_zone_unconstrained_target(self):
+        # :2727 "should support pod affinity with zone topology
+        # (unconstrained target)" — the target floats; both co-locate
+        env = make_env()
+        sel = {"security": "s2"}
+        env.store.create(make_pod(cpu="1", name="target", labels=sel))
+        env.store.create(
+            make_pod(cpu="1", name="follower", pod_affinity=[
+                PodAffinityTerm(label_selector={"matchLabels": sel}, topology_key=wk.ZONE_LABEL_KEY)
+            ])
+        )
+        env.settle(rounds=8)
+        t = env.store.get("Pod", "target")
+        f = env.store.get("Pod", "follower")
+        assert t.spec.node_name and f.spec.node_name
+        zt = env.store.get("Node", t.spec.node_name).metadata.labels[wk.ZONE_LABEL_KEY]
+        zf = env.store.get("Node", f.spec.node_name).metadata.labels[wk.ZONE_LABEL_KEY]
+        assert zt == zf
+
+    def test_pod_affinity_zone_constrained_target(self):
+        # :2760 "(constrained target)" — the target is pinned; the follower
+        # must land in the target's zone
+        env = make_env()
+        sel = {"security": "s2"}
+        env.store.create(make_pod(cpu="1", name="target", labels=sel, node_selector={wk.ZONE_LABEL_KEY: "test-zone-c"}))
+        env.store.create(
+            make_pod(cpu="1", name="follower", pod_affinity=[
+                PodAffinityTerm(label_selector={"matchLabels": sel}, topology_key=wk.ZONE_LABEL_KEY)
+            ])
+        )
+        env.settle(rounds=8)
+        f = env.store.get("Pod", "follower")
+        assert f.spec.node_name
+        assert env.store.get("Node", f.spec.node_name).metadata.labels[wk.ZONE_LABEL_KEY] == "test-zone-c"
+
+    def test_multiple_dependent_affinities_chain(self):
+        # :2789 "should handle multiple dependent affinities" — a -> b -> c
+        # chain of hostname affinities lands together over rounds
+        env = make_env()
+        env.store.create(make_pod(cpu="100m", name="a", labels={"d": "a"}))
+        env.store.create(
+            make_pod(cpu="100m", name="b", labels={"d": "b"}, pod_affinity=[
+                PodAffinityTerm(label_selector={"matchLabels": {"d": "a"}}, topology_key=wk.HOSTNAME_LABEL_KEY)
+            ])
+        )
+        env.store.create(
+            make_pod(cpu="100m", name="c", labels={"d": "c"}, pod_affinity=[
+                PodAffinityTerm(label_selector={"matchLabels": {"d": "b"}}, topology_key=wk.HOSTNAME_LABEL_KEY)
+            ])
+        )
+        env.settle(rounds=10)
+        hosts = {env.store.get("Pod", n).spec.node_name for n in ("a", "b", "c")}
+        assert all(hosts)
+        assert len(hosts) == 1, hosts
+
+    def test_unsatisfiable_dependency_fails(self):
+        # :2824 "should fail to schedule pods with unsatisfiable
+        # dependencies" — affinity to a selector no pod ever carries
+        env = make_env()
+        env.store.create(
+            make_pod(cpu="100m", name="orphan", pod_affinity=[
+                PodAffinityTerm(label_selector={"matchLabels": {"never": "exists"}}, topology_key=wk.HOSTNAME_LABEL_KEY)
+            ])
+        )
+        env.settle(rounds=6)
+        assert not env.store.get("Pod", "orphan").spec.node_name
+
+    def test_empty_namespace_selector_limits_to_own_namespace(self):
+        # :2917 "should filter pod affinity topologies by namespace, empty
+        # namespace selector" — {} namespaceSelector means ALL namespaces in
+        # k8s semantics; the reference treats an empty selector object as
+        # all-namespaces for affinity counting
+        env = make_env()
+        sel = {"security": "s2"}
+        env.store.create(make_pod(cpu="1", name="target", ns="other", labels=sel))
+        follower = make_pod(cpu="1", name="follower", pod_affinity=[
+            PodAffinityTerm(label_selector={"matchLabels": sel}, topology_key=wk.ZONE_LABEL_KEY, namespace_selector={})
+        ])
+        env.store.create(follower)
+        env.settle(rounds=8)
+        f = env.store.get("Pod", "follower")
+        t = env.store.get("Pod", "target", namespace="other")
+        assert f.spec.node_name and t.spec.node_name
+        zf = env.store.get("Node", f.spec.node_name).metadata.labels[wk.ZONE_LABEL_KEY]
+        zt = env.store.get("Node", t.spec.node_name).metadata.labels[wk.ZONE_LABEL_KEY]
+        assert zf == zt
+
+
+class TestNodePoolTaints:
+    def test_nodes_carry_nodepool_taints(self):
+        # :2981 "should taint nodes with NodePool taints"
+        np = make_nodepool(requirements=LINUX_AMD64, taints=[Taint(key="dedicated", value="infra", effect="NoSchedule")])
+        env = make_env(node_pools=[np])
+        env.store.create(
+            make_pod(cpu="1", name="tolerant", tolerations=[{"key": "dedicated", "operator": "Exists"}])
+        )
+        env.settle(rounds=8)
+        nodes = env.store.list("Node")
+        assert nodes
+        assert any(t.key == "dedicated" and t.value == "infra" for t in nodes[0].spec.taints)
+
+    def test_intolerant_pods_never_schedule_to_tainted_pool(self):
+        # :2991 inverse — a pod without the toleration stays pending
+        np = make_nodepool(requirements=LINUX_AMD64, taints=[Taint(key="dedicated", value="infra", effect="NoSchedule")])
+        env = make_env(node_pools=[np])
+        env.store.create(make_pod(cpu="1", name="plain"))
+        env.settle(rounds=6)
+        assert not env.store.get("Pod", "plain").spec.node_name
+
+
+class TestSpreadDiscoveryAndPolicies:
+    def test_zonal_subset_with_requirements_and_labels(self):
+        # topology_test.go:188 "(subset) with requirements and labels" — the
+        # pod's own selector AND the pool's zone subset both narrow the
+        # spread universe
+        np = make_nodepool(
+            requirements=LINUX_AMD64
+            + [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]}]
+        )
+        pods = [
+            make_pod(
+                cpu="1", labels=WEB,
+                node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"},
+                tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL)],
+            )
+            for _ in range(3)
+        ]
+        results = solve(pods, node_pools=[np])
+        assert results.all_pods_scheduled()
+        assert domain_counts(results, wk.ZONE_LABEL_KEY) == {"test-zone-a": 3}
+
+    def test_do_not_schedule_discovers_domains_from_pool(self):
+        # :380 "(discover domains)" — the spread universe comes from the
+        # POOL's producible zones, not from existing nodes
+        np = make_nodepool(
+            requirements=LINUX_AMD64
+            + [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]}]
+        )
+        pods = [make_pod(cpu="1", labels=WEB, tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL)]) for _ in range(6)]
+        results = solve(pods, node_pools=[np])
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.ZONE_LABEL_KEY)
+        assert set(counts) == {"test-zone-a", "test-zone-b"}
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_min_domains_greater_than_minimum_allows_scheduling(self):
+        # :522 "satisfied minDomains constraints (greater than minimum)"
+        pods = [
+            make_pod(cpu="1", labels=WEB, tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL, min_domains=2)])
+            for _ in range(6)
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.ZONE_LABEL_KEY)
+        assert len(counts) >= 2
+
+    def test_balance_across_nodepool_requirements(self):
+        # :981 "should balance pods across NodePool requirements" — two pools
+        # producing DISJOINT zone sets; the spread spans their union
+        np_a = make_nodepool(
+            name="pool-a",
+            requirements=LINUX_AMD64 + [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]}],
+        )
+        np_b = make_nodepool(
+            name="pool-b",
+            requirements=LINUX_AMD64 + [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}],
+        )
+        pods = [make_pod(cpu="1", labels=WEB, tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL)]) for _ in range(6)]
+        results = solve(pods, node_pools=[np_a, np_b])
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.ZONE_LABEL_KEY)
+        assert counts == {"test-zone-a": 3, "test-zone-b": 3}
+
+    def test_zone_and_hostname_constraints_together(self):
+        # :1090 "should spread pods while respecting both constraints" —
+        # zone skew 1 AND hostname skew 1 simultaneously
+        pods = [
+            make_pod(
+                cpu="1", labels=WEB,
+                tsc=[spread(wk.ZONE_LABEL_KEY, selector=SEL), spread(wk.HOSTNAME_LABEL_KEY, selector=SEL)],
+            )
+            for _ in range(4)
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        zc = domain_counts(results, wk.ZONE_LABEL_KEY)
+        assert max(zc.values()) - min(zc.values()) <= 1
+        for nc in results.new_node_claims:
+            assert len(nc.pods) <= 1, "hostname skew 1: one pod per node"
+
+    def test_unknown_match_label_keys_ignored(self):
+        # :1168 "should ignore unknown labels specified in matchLabelKeys" —
+        # a matchLabelKeys entry absent from the pod's labels is skipped
+        tsc = spread(wk.ZONE_LABEL_KEY, selector=SEL)
+        tsc.match_label_keys = ["pod-template-hash"]  # pods don't carry it
+        pods = [make_pod(cpu="1", labels=WEB, tsc=[tsc]) for _ in range(6)]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.ZONE_LABEL_KEY)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_taints_policy_honor_with_mutually_exclusive_pools(self):
+        # :1448 "mutually exclusive NodePools (by taints) share domains
+        # (NodeTaintsPolicy=honor)" — the tolerating pods count domains of
+        # both pools; intolerant spread pods count only the untainted pool's
+        np_plain = make_nodepool(name="plain", requirements=LINUX_AMD64)
+        np_tainted = make_nodepool(
+            name="tainted",
+            requirements=LINUX_AMD64,
+            taints=[Taint(key="dedicated", value="x", effect="NoSchedule")],
+        )
+        tsc = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wk.ZONE_LABEL_KEY,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=SEL,
+            node_taints_policy="Honor",
+        )
+        pods = [make_pod(cpu="1", labels=WEB, tsc=[tsc]) for _ in range(4)]
+        results = solve(pods, node_pools=[np_plain, np_tainted])
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.ZONE_LABEL_KEY)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_affinity_policy_honor_limits_to_affine_domains(self):
+        # :1596 "(NodeAffinityPolicy=honor)" — with Honor, the pod's node
+        # affinity narrows the spread universe to its allowed zones
+        tsc = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wk.ZONE_LABEL_KEY,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=SEL,
+            node_affinity_policy="Honor",
+        )
+        pods = [
+            make_pod(
+                cpu="1", labels=WEB,
+                required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]}]],
+                tsc=[tsc],
+            )
+            for _ in range(4)
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.ZONE_LABEL_KEY)
+        assert set(counts) <= {"test-zone-a", "test-zone-b"}
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+
+class TestCapacityTypeCounting:
+    """topology_test.go :747-:792 — the capacity-type mirror of the zone
+    counting family."""
+
+    def test_only_matching_pods_count_capacity_type(self):
+        # :747 — non-matching pods in a capacity-type domain don't count
+        decoy = make_pod(cpu="1", labels={"app": "other"}, node_selector={wk.CAPACITY_TYPE_LABEL_KEY: "spot"})
+        pods = [make_pod(cpu="1", labels=WEB, tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=SEL)]) for _ in range(4)]
+        results = solve([decoy] + pods)
+        assert results.all_pods_scheduled()
+        web_counts = {}
+        for nc in results.new_node_claims:
+            ct = nc.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY)
+            d = ct.any() if len(ct.values) == 1 else tuple(sorted(ct.values))
+            n = sum(1 for p in nc.pods if p.metadata.labels.get("app") == "web")
+            if n:
+                web_counts[d] = web_counts.get(d, 0) + n
+        assert max(web_counts.values()) - min(web_counts.values()) <= 1
+
+    def test_no_selector_matches_all_pods_capacity_type(self):
+        # :780 "should match all pods when labelSelector is not specified"
+        pods = [make_pod(cpu="1", tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector={"matchLabels": {}})]) for _ in range(4)]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, wk.CAPACITY_TYPE_LABEL_KEY)
+        assert max(counts.values()) - min(counts.values()) <= 1
+
+    def test_interdependent_selectors_capacity_type(self):
+        # :792 "should handle interdependent selectors" — two deployments
+        # each spreading on the OTHER's label set still all schedule
+        sel_a = {"matchLabels": {"app": "a"}}
+        sel_b = {"matchLabels": {"app": "b"}}
+        pods = [make_pod(cpu="1", labels={"app": "a"}, tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=sel_b)]) for _ in range(3)]
+        pods += [make_pod(cpu="1", labels={"app": "b"}, tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=sel_a)]) for _ in range(3)]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
