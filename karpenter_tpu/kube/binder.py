@@ -58,14 +58,9 @@ class Binder:
         # per-node host-port usage, built once per pass from ACTIVE bound
         # pods (terminal pods free their ports, as in Kubernetes)
         self._port_usage = {}
-        # bound-pod index by node, maintained as the pass binds: required
-        # hostname anti-affinity only ever inspects the candidate node's own
-        # pods, so the check must not rescan the whole pod list per node
-        self._pods_by_node = {}
         for q in all_pods:
             if q.spec.node_name and pod_utils.is_active(q):
                 self._port_usage.setdefault(q.spec.node_name, HostPortUsage()).add(q.key(), pod_host_ports(q))
-                self._pods_by_node.setdefault(q.spec.node_name, []).append(q)
         self._dra_allocator = None  # fresh per pass
         self._node_domain = {n.metadata.name: n.metadata.labels for n in nodes}
         # symmetric anti-affinity (the kube-scheduler's InterPodAffinity
@@ -86,7 +81,6 @@ class Binder:
                 self._bind(pod, node)
                 pod.spec.node_name = node.metadata.name  # keep local view current for spread counting
                 self._port_usage.setdefault(node.metadata.name, HostPortUsage()).add(pod.key(), pod_host_ports(pod))
-                self._pods_by_node.setdefault(node.metadata.name, []).append(pod)
                 if pod.spec.affinity is not None:
                     for term in pod.spec.affinity.pod_anti_affinity_required:
                         self._anti_holders.append((pod, term, self._term_namespaces(pod, term, all_pods)))
@@ -228,7 +222,11 @@ class Binder:
                 if d is not None:
                     counts.setdefault(d, 0)
             for q in all_pods:
-                if not q.spec.node_name or q.metadata.namespace != pod.metadata.namespace:
+                # terminal pods vacate their domain (kube-scheduler semantics;
+                # mirrors the solver's ignored_for_topology)
+                if not q.spec.node_name or not pod_utils.is_active(q):
+                    continue
+                if q.metadata.namespace != pod.metadata.namespace:
                     continue
                 if not match_label_selector(eff_sel, q.metadata.labels):
                     continue

@@ -921,3 +921,186 @@ class TestDaemonSetAccounting:
         assert not any(
             p.spec.node_name == node.metadata.name and p.metadata.name != "app" for p in env.store.list("Pod")
         )
+
+
+class TestInflightDepth2:
+    """suite_test.go :1988, :2172, :2816, :2858, :4085 + instance-type label
+    filtering :1463-:1476."""
+
+    def _env(self):
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+
+        env = Environment(options=Options())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        return env
+
+    def test_hostname_spread_balances_with_inflight_nodes(self):
+        # :1988 "should balance pods across hostnames with in-flight nodes"
+        from helpers import zone_spread
+        from karpenter_tpu.kube import TopologySpreadConstraint
+
+        env = self._env()
+        sel = {"matchLabels": {"app": "hs"}}
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.HOSTNAME_LABEL_KEY, when_unsatisfiable="DoNotSchedule", label_selector=sel
+        )
+        for i in range(2):
+            env.store.create(make_pod(cpu="100m", name=f"a{i}", labels={"app": "hs"}, tsc=[tsc]))
+        env.settle(rounds=5)
+        assert env.store.count("Node") == 2
+        for i in range(2):
+            env.store.create(make_pod(cpu="100m", name=f"b{i}", labels={"app": "hs"}, tsc=[tsc]))
+        env.settle(rounds=6)
+        # 4 pods, skew 1 on hostname: 4 hosts, one pod each
+        assert env.store.count("Node") == 4
+        per_node = {}
+        for p in env.store.list("Pod"):
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert all(v == 1 for v in per_node.values())
+
+    def test_not_ready_tainted_node_counts_as_inflight(self):
+        # :2172 "should consider a tainted NotReady node as in-flight even if
+        # initialized" — no duplicate capacity launches while the ephemeral
+        # taint lingers
+        from karpenter_tpu.scheduling.taints import Taint
+
+        env = self._env()
+        env.store.create(make_pod(cpu="100m", name="p0"))
+        env.settle(rounds=4)
+        node = env.store.list("Node")[0]
+
+        def taint(n):
+            n.spec.taints.append(Taint(key="node.kubernetes.io/not-ready", value="", effect="NoExecute"))
+
+        env.store.patch("Node", node.metadata.name, taint)
+        env.store.create(make_pod(cpu="100m", name="p1"))
+        env.settle(rounds=5)
+        assert env.store.count("NodeClaim") == 1, "NotReady node is still in-flight capacity"
+
+    def test_kubelet_zeroed_extended_resource_uses_claim_capacity(self):
+        # :2816 "should handle resource zeroing of extended resources by
+        # kubelet" — a zero-quantity node value defers to the claim's
+        # registered capacity (statenode.go:359-374)
+        from karpenter_tpu.state.statenode import StateNode
+        from karpenter_tpu.apis.nodeclaim import NodeClaim
+        from karpenter_tpu.kube import Node, ObjectMeta
+        from karpenter_tpu.kube.objects import NodeSpec, NodeStatus
+        from karpenter_tpu.utils.quantity import Quantity
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        nc = NodeClaim(metadata=ObjectMeta(name="c1"))
+        nc.status.provider_id = "kwok://n1"
+        nc.status.capacity = parse_resource_list({"cpu": "4", "example.com/gpu": "2"})
+        nc.status.allocatable = parse_resource_list({"cpu": "4", "example.com/gpu": "2"})
+        node = Node(
+            metadata=ObjectMeta(name="n1"),
+            spec=NodeSpec(provider_id="kwok://n1"),
+            status=NodeStatus(
+                capacity=parse_resource_list({"cpu": "4", "example.com/gpu": "0"}),
+                allocatable=parse_resource_list({"cpu": "4", "example.com/gpu": "0"}),
+            ),
+        )
+        sn = StateNode(node=node, node_claim=nc)
+        assert sn.capacity().get("example.com/gpu", Quantity(0)).milli == 2000
+
+    def test_self_affinity_zone_without_binding(self):
+        # :2858 "should respect self pod affinity without pod binding (zone)"
+        # — pure solver pass: pods co-locate in one zone, nothing binds
+        from karpenter_tpu.kube import PodAffinityTerm
+
+        sel = {"app": "self"}
+        pods = [
+            make_pod(
+                cpu="100m", labels=sel,
+                pod_affinity=[PodAffinityTerm(label_selector={"matchLabels": sel}, topology_key=wk.ZONE_LABEL_KEY)],
+            )
+            for _ in range(3)
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        zones = {nc.requirements.get(wk.ZONE_LABEL_KEY).any() for nc in results.new_node_claims if nc.pods}
+        assert len(zones) == 1
+        assert all(p.spec.node_name == "" for nc in results.new_node_claims for p in nc.pods)
+
+    def test_inactive_pods_not_rescheduled_from_deleting_node(self):
+        # :4085 "should not re-schedule pods from a deleting node when pods
+        # are not active" — terminal pods are not demand
+        env = self._env()
+        env.store.create(make_pod(cpu="100m", name="p0"))
+        env.settle(rounds=4)
+        node = env.store.list("Node")[0]
+
+        def finish(p):
+            p.status.phase = "Succeeded"
+
+        env.store.patch("Pod", "p0", finish)
+        env.store.delete("Node", node.metadata.name)
+        env.settle(rounds=8)
+        # the terminal pod never re-pends and no replacement launches for it
+        assert env.store.count("NodeClaim") == 0
+        assert env.store.count("Node") == 0
+
+    def test_instance_types_filtered_by_matching_labels(self):
+        # :1463 "should filter instance types that match labels" — only types
+        # whose own requirements carry the label survive the pod's selector
+        from karpenter_tpu.cloudprovider.types import InstanceType, Offering
+        from karpenter_tpu.scheduling.requirements import Requirements
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        def typ(name, size):
+            return InstanceType(
+                name=name,
+                requirements=Requirements.from_labels({
+                    wk.INSTANCE_TYPE_LABEL_KEY: name,
+                    wk.ARCH_LABEL_KEY: "amd64",
+                    wk.OS_LABEL_KEY: "linux",
+                    "size": size,
+                }),
+                offerings=[
+                    Offering(
+                        requirements=Requirements.from_labels({
+                            wk.CAPACITY_TYPE_LABEL_KEY: "on-demand", wk.ZONE_LABEL_KEY: "test-zone-a",
+                        }),
+                        price=1.0,
+                    )
+                ],
+                capacity=parse_resource_list({"cpu": "4", "memory": "8Gi", "pods": "110"}),
+            )
+
+        np = make_nodepool(requirements=LINUX_AMD64 + [{"key": "size", "operator": "Exists"}])
+        types = [typ("small-type", "small"), typ("big-type", "big")]
+        pod = make_pod(cpu="1", node_selector={"size": "big"})
+        results = solve([pod], node_pools=[np], types=types)
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        assert [it.name for it in nc.instance_type_options] == ["big-type"]
+
+    def test_incompatible_instance_labels_fail(self):
+        # :1476 "should not schedule with incompatible labels"
+        from karpenter_tpu.cloudprovider.types import InstanceType, Offering
+        from karpenter_tpu.scheduling.requirements import Requirements
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        it = InstanceType(
+            name="only-type",
+            requirements=Requirements.from_labels({
+                wk.INSTANCE_TYPE_LABEL_KEY: "only-type",
+                wk.ARCH_LABEL_KEY: "amd64",
+                wk.OS_LABEL_KEY: "linux",
+                "size": "small",
+            }),
+            offerings=[
+                Offering(
+                    requirements=Requirements.from_labels({
+                        wk.CAPACITY_TYPE_LABEL_KEY: "on-demand", wk.ZONE_LABEL_KEY: "test-zone-a",
+                    }),
+                    price=1.0,
+                )
+            ],
+            capacity=parse_resource_list({"cpu": "4", "memory": "8Gi", "pods": "110"}),
+        )
+        np = make_nodepool(requirements=LINUX_AMD64 + [{"key": "size", "operator": "Exists"}])
+        pod = make_pod(cpu="1", node_selector={"size": "big"})
+        results = solve([pod], node_pools=[np], types=[it])
+        assert not results.all_pods_scheduled()

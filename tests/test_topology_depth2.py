@@ -766,3 +766,119 @@ class TestCapacityTypeCounting:
         pods += [make_pod(cpu="1", labels={"app": "b"}, tsc=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=sel_a)]) for _ in range(3)]
         results = solve(pods)
         assert results.all_pods_scheduled()
+
+
+class TestTaintsPolicyBalance:
+    CUSTOM = "company.com/tier"
+
+    def _pools(self):
+        np_a = make_nodepool(
+            name="tier-a", requirements=LINUX_AMD64 + [{"key": self.CUSTOM, "operator": "In", "values": ["a"]}]
+        )
+        np_b = make_nodepool(
+            name="tier-b",
+            requirements=LINUX_AMD64 + [{"key": self.CUSTOM, "operator": "In", "values": ["b"]}],
+            taints=[Taint(key="dedicated", value="x", effect="NoSchedule")],
+        )
+        return [np_a, np_b]
+
+    def test_taints_policy_ignore_balances_tolerant_pods(self):
+        # topology_test.go:1196 "(NodeTaintsPolicy=ignore)" — tolerant pods
+        # count both pools' domains and balance across them
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=self.CUSTOM, when_unsatisfiable="DoNotSchedule",
+            label_selector=SEL, node_taints_policy="Ignore",
+        )
+        pods = [
+            make_pod(cpu="1", labels=WEB, tsc=[tsc], tolerations=[{"key": "dedicated", "operator": "Exists"}])
+            for _ in range(4)
+        ]
+        results = solve(pods, node_pools=self._pools())
+        assert results.all_pods_scheduled()
+        counts = domain_counts(results, self.CUSTOM)
+        assert counts == {"a": 2, "b": 2}
+
+    def test_taints_policy_honor_restricts_intolerant_pods(self):
+        # :1267 "(NodeTaintsPolicy=honor)" — intolerant pods' spread universe
+        # excludes the tainted pool's domain; everything lands in pool a
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=self.CUSTOM, when_unsatisfiable="DoNotSchedule",
+            label_selector=SEL, node_taints_policy="Honor",
+        )
+        pods = [make_pod(cpu="1", labels=WEB, tsc=[tsc]) for _ in range(4)]
+        results = solve(pods, node_pools=self._pools())
+        assert results.all_pods_scheduled()
+        assert domain_counts(results, self.CUSTOM) == {"a": 4}
+
+
+class TestMultiConstraintInterplay:
+    def test_zone_and_custom_key_spread_together(self):
+        # topology_test.go:1662 "should spread pods while respecting both
+        # constraints" — zone skew 1 AND a custom-key skew 1 simultaneously
+        custom = "company.com/shard"
+        np_1 = make_nodepool(
+            name="shard-1", requirements=LINUX_AMD64 + [{"key": custom, "operator": "In", "values": ["s1"]}]
+        )
+        np_2 = make_nodepool(
+            name="shard-2", requirements=LINUX_AMD64 + [{"key": custom, "operator": "In", "values": ["s2"]}]
+        )
+        pods = [
+            make_pod(
+                cpu="1", labels=WEB,
+                tsc=[
+                    spread(wk.ZONE_LABEL_KEY, selector=SEL),
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=custom, when_unsatisfiable="DoNotSchedule", label_selector=SEL
+                    ),
+                ],
+            )
+            for _ in range(4)
+        ]
+        results = solve(pods, node_pools=[np_1, np_2])
+        assert results.all_pods_scheduled()
+        zc = domain_counts(results, wk.ZONE_LABEL_KEY)
+        cc = domain_counts(results, custom)
+        assert max(zc.values()) - min(zc.values()) <= 1
+        assert cc == {"s1": 2, "s2": 2}
+
+    def test_zone_hostname_capacity_type_all_respected(self):
+        # :1702 "should spread pods while respecting all constraints"
+        pods = [
+            make_pod(
+                cpu="1", labels=WEB,
+                tsc=[
+                    spread(wk.ZONE_LABEL_KEY, selector=SEL),
+                    spread(wk.HOSTNAME_LABEL_KEY, max_skew=2, selector=SEL),
+                    spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=SEL),
+                ],
+            )
+            for _ in range(4)
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        zc = domain_counts(results, wk.ZONE_LABEL_KEY)
+        ctc = domain_counts(results, wk.CAPACITY_TYPE_LABEL_KEY)
+        assert max(zc.values()) - min(zc.values()) <= 1
+        assert max(ctc.values()) - min(ctc.values()) <= 1
+        for nc in results.new_node_claims:
+            assert len(nc.pods) <= 2
+
+    def test_self_affinity_constrained_zones_single_domain(self):
+        # :2079 "should respect self pod affinity for first empty topology
+        # domain only (hostname/constrained zones)" — hostname self-affinity
+        # pods whose zone set is constrained co-locate on ONE host in an
+        # allowed zone
+        sel = {"app": "huddle"}
+        pods = [
+            make_pod(
+                cpu="100m", labels=sel,
+                required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}]],
+                pod_affinity=[PodAffinityTerm(label_selector={"matchLabels": sel}, topology_key=wk.HOSTNAME_LABEL_KEY)],
+            )
+            for _ in range(3)
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        claims = [nc for nc in results.new_node_claims if nc.pods]
+        assert len(claims) == 1 and len(claims[0].pods) == 3
+        assert set(claims[0].requirements.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-b"}
