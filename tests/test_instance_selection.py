@@ -118,7 +118,7 @@ class TestCheapestInstance:
         )
         results = solve([make_pod(cpu="500m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"})], node_pools=[np], types=types)
         nc = assert_cheapest(results, types)
-        offs = [o for it in nc.instance_type_options for o in it.offerings if nc.requirements.intersects(o.requirements) is None]
+        offs = compatible_offerings(nc)
         assert offs and all(o.capacity_type() == wk.CAPACITY_TYPE_SPOT and o.zone() == "test-zone-b" for o in offs)
 
     def test_no_match_pod_arch(self):
@@ -276,3 +276,106 @@ class TestOfferingAvailability:
             o.available = False
         results = solve([make_pod(cpu="500m")], types=[it])
         assert len(results.pod_errors) == 1
+
+
+
+
+def compatible_offerings(nc):
+    """AVAILABLE offerings launchable under the claim's final requirements."""
+    return [
+        o
+        for it in nc.instance_type_options
+        for o in it.offerings
+        if o.available and nc.requirements.intersects(o.requirements) is None
+    ]
+
+class TestCheapestFourWayCombos:
+    """instance_selection_test.go :291-:396 — the remaining pod/pool
+    constraint combinations over arch/os/zone/capacity-type."""
+
+    def test_cheapest_pod_ct_spot_pod_zone(self):
+        # :291 "(pod ct = spot, pod zone = test-zone-1)"
+        types = catalog.construct_instance_types()
+        pod = make_pod(
+            cpu="500m",
+            node_selector={wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_SPOT, wk.ZONE_LABEL_KEY: "test-zone-a"},
+        )
+        results = solve([pod], types=types)
+        nc = assert_cheapest(results, types)
+        offs = compatible_offerings(nc)
+        assert offs and all(o.capacity_type() == wk.CAPACITY_TYPE_SPOT and o.zone() == "test-zone-a" for o in offs)
+
+    def test_cheapest_pool_four_way_pin(self):
+        # :330 "(prov ct = ondemand/test-zone-1/arm64/linux)" — the pool pins
+        # every dimension; the claim's launchable set respects all four
+        types = catalog.construct_instance_types()
+        np = make_nodepool(
+            requirements=[
+                {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+                {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["arm64"]},
+                {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_ON_DEMAND]},
+                {"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a"]},
+            ]
+        )
+        results = solve([make_pod(cpu="500m")], node_pools=[np], types=types)
+        nc = assert_cheapest(results, types)
+        assert set(nc.requirements.get(wk.ARCH_LABEL_KEY).values) == {"arm64"}
+        offs = compatible_offerings(nc)
+        assert offs and all(o.capacity_type() == wk.CAPACITY_TYPE_ON_DEMAND and o.zone() == "test-zone-a" for o in offs)
+
+    def test_cheapest_pool_and_pod_split_dimensions(self):
+        # :362 "(prov = spot/test-zone-2, pod = amd64/linux)"
+        types = catalog.construct_instance_types()
+        np = make_nodepool(
+            requirements=[
+                {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+                {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64", "arm64"]},
+                {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_SPOT]},
+                {"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]},
+            ]
+        )
+        pod = make_pod(cpu="500m", node_selector={wk.ARCH_LABEL_KEY: "amd64", wk.OS_LABEL_KEY: "linux"})
+        results = solve([pod], node_pools=[np], types=types)
+        nc = assert_cheapest(results, types)
+        assert set(nc.requirements.get(wk.ARCH_LABEL_KEY).values) == {"amd64"}
+
+    def test_cheapest_pod_four_way_pin(self):
+        # :396 "(pod ct = spot/test-zone-2/amd64/linux)"
+        types = catalog.construct_instance_types()
+        pod = make_pod(
+            cpu="500m",
+            node_selector={
+                wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_SPOT,
+                wk.ZONE_LABEL_KEY: "test-zone-b",
+                wk.ARCH_LABEL_KEY: "amd64",
+                wk.OS_LABEL_KEY: "linux",
+            },
+        )
+        results = solve([pod], types=types)
+        nc = assert_cheapest(results, types)
+        offs = compatible_offerings(nc)
+        assert offs and all(o.capacity_type() == wk.CAPACITY_TYPE_SPOT and o.zone() == "test-zone-b" for o in offs)
+
+    def test_no_match_pod_arch_and_zone(self):
+        # :448 "(pod arch = arm zone=test-zone-2)" — arm types exist but not
+        # in the requested zone
+        types = [
+            catalog.make_instance_type("c", 4, arch="arm64", zones=["test-zone-a"]),
+            catalog.make_instance_type("m", 4, arch="amd64", zones=["test-zone-b"]),
+        ]
+        np = make_nodepool(requirements=[{"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]}])
+        pod = make_pod(node_selector={wk.ARCH_LABEL_KEY: "arm64", wk.ZONE_LABEL_KEY: "test-zone-b"})
+        results = solve([pod], node_pools=[np], types=types)
+        assert len(results.pod_errors) == 1
+
+    def test_enough_resources_picks_bigger_type(self):
+        # :509 "should schedule on an instance with enough resources" — the
+        # request outgrows small types; the claim's fit set excludes them
+        types = [
+            catalog.make_instance_type("c", 2),
+            catalog.make_instance_type("c", 16),
+        ]
+        results = solve([make_pod(cpu="8")], types=types)
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        assert all(it.capacity["cpu"].milli >= 8000 for it in nc.instance_type_options)

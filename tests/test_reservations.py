@@ -215,3 +215,61 @@ class TestKWOKLaunchEnforcement:
         # (spot) offering instead of oversubscribing
         assert second.metadata.labels[wk.CAPACITY_TYPE_LABEL_KEY] == wk.CAPACITY_TYPE_SPOT
         assert wk.RESERVATION_ID_LABEL_KEY not in second.metadata.labels
+
+
+class TestReservedOfferingDepth:
+    """suite_test.go reserved-offering provisioning behaviors :4713-:5195."""
+
+    def _snap(self, pods, types, node_pools=None, **kw):
+        snap = make_snapshot(pods, types=types, node_pools=node_pools)
+        snap.reserved_capacity_enabled = True
+        for k, v in kw.items():
+            setattr(snap, k, v)
+        return snap
+
+    def test_no_fallback_while_reserved_capacity_remains(self):
+        # :4713 "shouldn't fallback to on-demand or spot when compatible
+        # reserved offerings are available" — claims within reservation
+        # capacity pin to reserved; the overflow claim is EXCLUDED from
+        # reserved, falling to spot/on-demand
+        types = reserved_types(reserved_capacity=2)
+        pods = [make_pod(cpu="12") for _ in range(3)]
+        snap = self._snap(pods, types)
+        results = FFDSolver().solve(snap)
+        assert results.all_pods_scheduled()
+        kinds = []
+        for nc in results.new_node_claims:
+            r = claim_capacity_types(nc)
+            kinds.append(tuple(sorted(r.values)) if r.operator() == Operator.IN else ("non-reserved",))
+        reserved_claims = [k for k in kinds if k == (wk.CAPACITY_TYPE_RESERVED,)]
+        assert len(reserved_claims) == 2, kinds
+
+    def test_higher_weight_pool_with_reservation_not_abandoned(self):
+        # :4974 "shouldn't fallback to a lower weight NodePool if a reserved
+        # offering is available" — the heavy pool's reserved offering wins
+        # even though the light pool could also host the pod
+        heavy = make_nodepool(name="np-primary", requirements=LINUX_AMD64, weight=100)
+        light = make_nodepool(name="np-fallback", requirements=LINUX_AMD64, weight=50)
+        types = reserved_types(reserved_capacity=1)
+        pod = make_pod(cpu="12")
+        snap = self._snap([pod], types, node_pools=[heavy, light])
+        results = FFDSolver().solve(snap)
+        assert results.all_pods_scheduled()
+        nc = results.new_node_claims[0]
+        pool_req = nc.requirements.get(wk.NODEPOOL_LABEL_KEY)
+        assert pool_req is not None and set(pool_req.values) == {"np-primary"}
+        r = claim_capacity_types(nc)
+        assert r.operator() == Operator.IN and set(r.values) == {wk.CAPACITY_TYPE_RESERVED}
+
+    def test_multiple_pods_share_reserved_node(self):
+        # :5140 "should handle multiple pods on reserved nodes" — two small
+        # co-locating pods consume ONE reservation unit, not two
+        types = reserved_types(reserved_capacity=1)
+        pods = [make_pod(cpu="4") for _ in range(2)]
+        snap = self._snap(pods, types)
+        results = FFDSolver().solve(snap)
+        assert results.all_pods_scheduled()
+        claims = [nc for nc in results.new_node_claims if nc.pods]
+        assert len(claims) == 1 and len(claims[0].pods) == 2
+        r = claim_capacity_types(claims[0])
+        assert set(r.values) == {wk.CAPACITY_TYPE_RESERVED}

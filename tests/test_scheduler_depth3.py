@@ -1104,3 +1104,127 @@ class TestInflightDepth2:
         pod = make_pod(cpu="1", node_selector={"size": "big"})
         results = solve([pod], node_pools=[np], types=[it])
         assert not results.all_pods_scheduled()
+
+
+class TestTaintAssumptionsAndPoolGates:
+    """suite_test.go :2076, :2141 (taint assumptions) + :500 (NodePool
+    readiness gate) + pool-deletion gating (provisioner.go:272-281)."""
+
+    def _env(self, freeze_disruption=False):
+        from karpenter_tpu.apis.nodepool import Budget
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+
+        env = Environment(options=Options())
+        np = make_nodepool(requirements=LINUX_AMD64)
+        if freeze_disruption:
+            # consolidation would legitimately shrink the fleet mid-spec;
+            # the reference provisioning suite runs no disruption controllers
+            np.spec.disruption.budgets = [Budget(nodes="0")]
+        env.store.create(np)
+        return env
+
+    def test_does_not_assume_pod_schedules_to_custom_tainted_node(self):
+        # :2076 "should not assume pod will schedule to a tainted node" — a
+        # custom (non-startup, non-ephemeral) taint on an existing node makes
+        # it unusable capacity for intolerant pods: a second node launches
+        from karpenter_tpu.scheduling.taints import Taint
+
+        env = self._env(freeze_disruption=True)
+        env.store.create(make_pod(cpu="100m", name="p0"))
+        env.settle(rounds=4)
+        node = env.store.list("Node")[0]
+
+        def taint(n):
+            n.spec.taints.append(Taint(key="example.com/custom", value="", effect="NoSchedule"))
+
+        env.store.patch("Node", node.metadata.name, taint)
+        env.store.create(make_pod(cpu="100m", name="p1"))
+        env.settle(rounds=6)
+        assert env.store.count("Node") == 2
+        p1 = env.store.get("Pod", "p1")
+        assert p1.spec.node_name and p1.spec.node_name != node.metadata.name
+
+    def test_does_not_assume_startup_tainted_node_after_initialization(self):
+        # :2141 "should not assume pod will schedule to a node with startup
+        # taints after initialization" — a startup taint LINGERING past
+        # initialization is a real taint; new pods get new capacity
+        from karpenter_tpu.scheduling.taints import Taint
+
+        env = self._env(freeze_disruption=True)
+        np = env.store.list("NodePool")[0]
+
+        def add_startup(p):
+            p.spec.template.startup_taints = [Taint(key="custom/startup", value="true", effect="NoSchedule")]
+
+        env.store.patch("NodePool", np.metadata.name, add_startup)
+        env.store.create(make_pod(cpu="100m", name="p0"))
+        env.settle(rounds=6)
+        assert env.store.count("Node") == 1
+        # force-initialize despite the lingering taint (the reference's
+        # ExpectMakeNodesInitialized fake-kubelet helper): initialization
+        # normally waits for startup taints to clear
+        from karpenter_tpu.apis.nodeclaim import COND_INITIALIZED
+
+        claim = env.store.list("NodeClaim")[0]
+
+        def init(c):
+            c.status.conditions.set_true(COND_INITIALIZED, now=env.clock.now())
+
+        env.store.patch("NodeClaim", claim.metadata.name, init)
+        # the node is initialized but its owner never cleared the startup
+        # taint; a NEW pod must not be assumed onto it
+        env.store.create(make_pod(cpu="100m", name="p1"))
+        env.settle(rounds=6)
+        assert env.store.count("Node") == 2
+
+    def test_not_ready_nodepool_not_used(self):
+        # :500 "should not schedule pods with nodePool which is not ready"
+        env = self._env()
+        np = env.store.list("NodePool")[0]
+
+        # route through the readiness CONTROLLER (it recomputes conditions
+        # every tick): a missing NodeClass marks the pool not ready
+        def missing_class(p):
+            ref = p.spec.template.node_class_ref
+            if isinstance(ref, dict):
+                ref["name"] = "does-not-exist"
+            else:
+                ref.name = "does-not-exist"
+
+        env.store.patch("NodePool", np.metadata.name, missing_class)
+        env.store.create(make_pod(cpu="100m", name="p0"))
+        env.settle(rounds=5)
+        assert env.store.count("NodeClaim") == 0
+        assert not env.store.get("Pod", "p0").spec.node_name
+
+    def test_deleting_nodepool_not_used(self):
+        # provisioner.go:272-281 — a pool with a deletion timestamp is out;
+        # a finalizer holds the object in Terminating so the gate (not mere
+        # absence) is what's exercised
+        env = self._env()
+        np = env.store.list("NodePool")[0]
+
+        def hold(p):
+            p.metadata.finalizers.append("test.karpenter.sh/hold")
+
+        env.store.patch("NodePool", np.metadata.name, hold)
+        env.store.delete("NodePool", np.metadata.name)
+        terminating = env.store.try_get("NodePool", np.metadata.name)
+        assert terminating is not None and terminating.metadata.deletion_timestamp is not None
+        env.store.create(make_pod(cpu="100m", name="p0"))
+        env.settle(rounds=5)
+        assert env.store.count("NodeClaim") == 0
+
+    def test_exists_operator_preserves_wellknown_pin(self):
+        # :1109 "Exists operator should not overwrite the existing value"
+        # (well-known mirror): zone-pinned pod + zone-Exists pod co-exist
+        pods = [
+            make_pod(cpu="100m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"}),
+            make_pod(cpu="100m", required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "Exists"}]]),
+        ]
+        results = solve(pods)
+        assert results.all_pods_scheduled()
+        assert len([nc for nc in results.new_node_claims if nc.pods]) == 1
+        nc = next(nc for nc in results.new_node_claims if nc.pods)
+        assert set(nc.requirements.get(wk.ZONE_LABEL_KEY).values) == {"test-zone-b"}
