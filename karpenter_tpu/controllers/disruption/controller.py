@@ -44,6 +44,8 @@ class _Ctx:
 
 
 class DisruptionController:
+    _budget_blocked = False  # set per disrupt() round
+
     def __init__(self, store, cluster, provisioner, cloud_provider, clock, options, recorder=None, metrics=None, cluster_cost=None):
         self.store = store
         self.cluster = cluster
@@ -78,14 +80,21 @@ class DisruptionController:
             return
         self._cleanup_leftover_taints()
         executed = self.disrupt()
-        if not executed:
+        if not executed and not self._budget_blocked:
+            # a round that found nothing AND was not budget-limited marks the
+            # cluster consolidated; budget-blocked candidates must keep the
+            # poll alive — cron budget windows open without any object edit
+            # (consolidation_test.go:714-934 "should not mark ... consolidated
+            # if the candidates can't be disrupted due to budgets")
             self.cluster.mark_consolidated()
 
     def disrupt(self) -> bool:
         """Run methods in priority order; execute the first command batch
-        (controller.go:166-179)."""
+        (controller.go:166-179). Sets `_budget_blocked` when any pool with
+        live candidates had its disruption budget exhausted this round."""
         import time as _time
 
+        self._budget_blocked = False
         for method in self.methods:
             ctype = getattr(method, "consolidation_type", "")
             mname = type(method).__name__
@@ -99,6 +108,17 @@ class DisruptionController:
             self.ctx.round_candidates = candidates
             self.ctx.node_pool_totals = None
             budgets = build_disruption_budget_mapping(self.store, self.cluster, self.clock, method.reason)
+            # budget-blocked only counts pools whose candidates THIS method
+            # would actually disrupt (the reference ties the signal to the
+            # method's own filtered set) — a reason-scoped zero budget for a
+            # method with nothing to do must not suppress consolidated pacing
+            pools_blocked = {
+                c.node_pool.metadata.name
+                for c in candidates
+                if c.node_pool is not None and method.should_disrupt(c)
+            }
+            if any(budgets.get(pool, 0) <= 0 for pool in pools_blocked):
+                self._budget_blocked = True
             t0 = _time.perf_counter()
             commands = method.compute_commands(candidates, budgets)
             started = False
