@@ -44,8 +44,6 @@ class _Ctx:
 
 
 class DisruptionController:
-    _budget_blocked = False  # set per disrupt() round
-
     def __init__(self, store, cluster, provisioner, cloud_provider, clock, options, recorder=None, metrics=None, cluster_cost=None):
         self.store = store
         self.cluster = cluster
@@ -79,8 +77,8 @@ class DisruptionController:
         if self.cluster.consolidated():
             return
         self._cleanup_leftover_taints()
-        executed = self.disrupt()
-        if not executed and not self._budget_blocked:
+        executed, budget_blocked = self.disrupt()
+        if not executed and not budget_blocked:
             # a round that found nothing AND was not budget-limited marks the
             # cluster consolidated; budget-blocked candidates must keep the
             # poll alive — cron budget windows open without any object edit
@@ -88,13 +86,14 @@ class DisruptionController:
             # if the candidates can't be disrupted due to budgets")
             self.cluster.mark_consolidated()
 
-    def disrupt(self) -> bool:
+    def disrupt(self) -> tuple[bool, bool]:
         """Run methods in priority order; execute the first command batch
-        (controller.go:166-179). Sets `_budget_blocked` when any pool with
-        live candidates had its disruption budget exhausted this round."""
+        (controller.go:166-179). Returns (executed, budget_blocked) where
+        budget_blocked means a pool with candidates a method would disrupt
+        had its budget exhausted this round."""
         import time as _time
 
-        self._budget_blocked = False
+        budget_blocked = False
         for method in self.methods:
             ctype = getattr(method, "consolidation_type", "")
             mname = type(method).__name__
@@ -104,21 +103,25 @@ class DisruptionController:
 
                 self.metrics.gauge(m.DISRUPTION_ELIGIBLE_NODES).set(len(candidates), method=mname, consolidation_type=ctype)
             if not candidates:
-                return False
+                return False, budget_blocked
             self.ctx.round_candidates = candidates
             self.ctx.node_pool_totals = None
             budgets = build_disruption_budget_mapping(self.store, self.cluster, self.clock, method.reason)
             # budget-blocked only counts pools whose candidates THIS method
             # would actually disrupt (the reference ties the signal to the
             # method's own filtered set) — a reason-scoped zero budget for a
-            # method with nothing to do must not suppress consolidated pacing
-            pools_blocked = {
-                c.node_pool.metadata.name
-                for c in candidates
-                if c.node_pool is not None and method.should_disrupt(c)
-            }
-            if any(budgets.get(pool, 0) <= 0 for pool in pools_blocked):
-                self._budget_blocked = True
+            # method with nothing to do must not suppress consolidated
+            # pacing; the should_disrupt sweep runs only when some budget is
+            # actually at zero (rare), never on the common all-positive path
+            if not budget_blocked and any(v <= 0 for v in budgets.values()):
+                zero_pools = {pool for pool, v in budgets.items() if v <= 0}
+                if any(
+                    c.node_pool is not None
+                    and c.node_pool.metadata.name in zero_pools
+                    and method.should_disrupt(c)
+                    for c in candidates
+                ):
+                    budget_blocked = True
             t0 = _time.perf_counter()
             commands = method.compute_commands(candidates, budgets)
             started = False
@@ -136,8 +139,8 @@ class DisruptionController:
                             decision=decision, method=mname, consolidation_type=ctype
                         )
             if started:
-                return True
-        return False
+                return True, budget_blocked
+        return False, budget_blocked
 
     def get_candidates(self) -> list:
         node_pools = {np.metadata.name: np for np in self.store.list("NodePool")}

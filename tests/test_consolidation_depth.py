@@ -497,3 +497,67 @@ class TestConsolidationDestinations:
         run_disruption(env)
         assert env.store.count("Node") == 0
         assert not env.store.get("Pod", "impossible").spec.node_name
+
+
+class TestValidationWindowChurn:
+    """consolidation_test.go :3785-:3895 — commands invalidated by state that
+    appears DURING the 15 s validation window: do-not-disrupt pods and
+    blocking PDBs landing on a candidate."""
+
+    def _candidate_cmd(self, env):
+        from karpenter_tpu.controllers.disruption.methods import MultiNodeConsolidation
+        from karpenter_tpu.controllers.disruption.types import REASON_UNDERUTILIZED, Command
+
+        ctrl = env.disruption
+        method = next(m for m in ctrl.methods if isinstance(m, MultiNodeConsolidation))
+        eligible = [c for c in ctrl.get_candidates() if method.should_disrupt(c)]
+        assert eligible, "fixture must produce a consolidation candidate"
+        return ctrl, method, Command(reason=REASON_UNDERUTILIZED, candidates=eligible[:1])
+
+    def test_do_not_disrupt_pod_scheduling_mid_window_invalidates(self):
+        # :3857 "should not delete node if pods schedule with
+        # karpenter.sh/do-not-disrupt set to true during the TTL wait"
+        import pytest as _pytest
+
+        from test_disruption import OD_ONLY
+        from karpenter_tpu.controllers.disruption.validation import ValidationError, Validator
+
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        provision(env, [make_pod(cpu="1", name=f"p{i}") for i in range(2)])
+        run_disruption(env, rounds=4)
+        ctrl, method, cmd = self._candidate_cmd(env)
+        # a do-not-disrupt pod binds onto the candidate mid-window
+        blocker = make_pod(
+            cpu="100m", name="blocker",
+            annotations={wk.DO_NOT_DISRUPT_ANNOTATION_KEY: "true"},
+            node_name=cmd.candidates[0].name(),
+        )
+        env.store.create(blocker)
+        env.settle(rounds=2)
+        with _pytest.raises(ValidationError):
+            Validator(ctrl.ctx, method, mode="strict", metrics=env.registry).validate(cmd, delay_seconds=0)
+
+    def test_blocking_pdb_appearing_mid_window_invalidates(self):
+        # :3895 "should not delete node if pods schedule with a blocking PDB
+        # during the TTL wait"
+        import pytest as _pytest
+
+        from test_disruption import OD_ONLY
+        from karpenter_tpu.controllers.disruption.validation import ValidationError, Validator
+        from karpenter_tpu.kube import ObjectMeta
+        from karpenter_tpu.kube.objects import PodDisruptionBudget
+
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        provision(env, [make_pod(cpu="1", name=f"p{i}", labels={"app": "guarded"}) for i in range(2)])
+        run_disruption(env, rounds=4)
+        ctrl, method, cmd = self._candidate_cmd(env)
+        env.store.create(
+            PodDisruptionBudget(
+                metadata=ObjectMeta(name="pdb"),
+                selector={"matchLabels": {"app": "guarded"}},
+                max_unavailable=0,
+            )
+        )
+        env.settle(rounds=2)
+        with _pytest.raises(ValidationError):
+            Validator(ctrl.ctx, ctrl.methods[3], mode="strict", metrics=env.registry).validate(cmd, delay_seconds=0)
