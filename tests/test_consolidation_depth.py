@@ -34,6 +34,17 @@ def empty_fleet_env(n, np_kwargs=None, **opt_kwargs):
     return env
 
 
+def zero_budgets(env, *pool_names):
+    """Patch the named pools (default: all) down to a zero disruption budget."""
+    names = pool_names or [np.metadata.name for np in env.store.list("NodePool")]
+
+    def zero(p):
+        p.spec.disruption.budgets = [Budget(nodes="0")]
+
+    for name in names:
+        env.store.patch("NodePool", name, zero)
+
+
 class TestBudgetsDepth:
     def test_only_three_empty_nodes_disrupted(self):
         # consolidation_test.go:366 — budget nodes=3 caps one round's deletes
@@ -561,3 +572,40 @@ class TestValidationWindowChurn:
         env.settle(rounds=2)
         with _pytest.raises(ValidationError):
             Validator(ctrl.ctx, ctrl.methods[3], mode="strict", metrics=env.registry).validate(cmd, delay_seconds=0)
+
+
+class TestBudgetBlockedVariants:
+    """consolidation_test.go :802-:934 — the budget-blocked-is-not-consolidated
+    contract holds for NON-EMPTY (multi/single-node) consolidation too."""
+
+    def test_multinode_budget_blocked_not_consolidated(self):
+        # :802 — underutilized fleet, zero budget: no deletes AND the
+        # cluster must not be marked consolidated
+        env = one_node_per_pod_env(3, cpu="100m")
+        zero_budgets(env)
+        env.clock.step(40)
+        env.tick(provision_force=True)
+        env.disruption.reconcile(force=True)
+        assert env.store.count("Node") == 3
+        assert not env.cluster.consolidated()
+
+    def test_multinode_budget_blocked_many_pools(self):
+        # :833 — ALL pools' candidates blocked across two pools
+        env = make_env()
+        np_b = make_nodepool(name="pool-b", requirements=LINUX_AMD64)
+        np_b.spec.disruption.consolidate_after = "30s"
+        env.store.create(np_b)
+        sel = {"matchLabels": {"app": "x"}}
+        pods = [
+            make_pod(cpu="100m", name=f"a{i}", labels={"app": "x"},
+                     node_selector={wk.NODEPOOL_LABEL_KEY: pool},
+                     anti_affinity=[hostname_anti_affinity(sel)])
+            for i, pool in enumerate(["default-pool", "pool-b"])
+        ]
+        provision(env, pods)
+        zero_budgets(env, "default-pool", "pool-b")
+        env.clock.step(40)
+        env.tick(provision_force=True)
+        env.disruption.reconcile(force=True)
+        assert env.store.count("Node") == 2
+        assert not env.cluster.consolidated()
