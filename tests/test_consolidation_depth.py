@@ -609,3 +609,86 @@ class TestBudgetBlockedVariants:
         env.disruption.reconcile(force=True)
         assert env.store.count("Node") == 2
         assert not env.cluster.consolidated()
+
+
+class TestSpotToSpotTruncation:
+    """consolidation_test.go :1177/:1247 — the 15-cheapest truncation rules
+    for single-node spot-to-spot: per-offering prices let the candidate's own
+    TYPE rank among the replacement options."""
+
+    def _spot_type(self, name, price_by_zone):
+        from karpenter_tpu.cloudprovider.types import InstanceType, Offering
+        from karpenter_tpu.scheduling.requirements import Requirement, Requirements
+        from karpenter_tpu.utils.resources import parse_resource_list
+
+        return InstanceType(
+            name=name,
+            requirements=Requirements.from_labels({
+                wk.INSTANCE_TYPE_LABEL_KEY: name, wk.ARCH_LABEL_KEY: "amd64", wk.OS_LABEL_KEY: "linux",
+            }),
+            offerings=[
+                Offering(
+                    requirements=Requirements(
+                        Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [wk.CAPACITY_TYPE_SPOT]),
+                        Requirement(wk.ZONE_LABEL_KEY, "In", [zone]),
+                    ),
+                    price=price,
+                )
+                for zone, price in price_by_zone.items()
+            ],
+            capacity=parse_resource_list({"cpu": "4", "memory": "8Gi", "pods": "110"}),
+        )
+
+    def _env_with_spot_node(self, cand_cheap_price):
+        """A spot node on type 'cand' priced 100 in its zone; 17 cheaper spot
+        types exist, and cand's OTHER-zone offering prices at
+        cand_cheap_price — controlling where cand ranks among replacements."""
+        from test_consolidation_depth3 import manual_node
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+
+        types = [self._spot_type(f"st-{i:02d}", {"test-zone-a": 1.0 + i * 0.1}) for i in range(17)]
+        types.append(self._spot_type("cand", {"test-zone-b": 100.0, "test-zone-a": cand_cheap_price}))
+        env = Environment(options=Options(), instance_types=types)
+        env.options.feature_gates.spot_to_spot_consolidation = True
+        np = make_nodepool(requirements=LINUX_AMD64)
+        np.spec.disruption.consolidate_after = "30s"
+        env.store.create(np)
+        manual_node(env, "n1", "cand", "4", ct=wk.CAPACITY_TYPE_SPOT, zone="test-zone-b")
+        env.store.create(make_pod(cpu="100m", name="w", node_name="n1"))
+        env.settle(rounds=3)
+        env.clock.step(40)
+        env.nodeclaim_disruption.reconcile()  # consolidatable after the
+        # window; deliberately NO disruption tick: the method drives below
+        return env
+
+    def _single_node_cmd(self, env):
+        from karpenter_tpu.controllers.disruption.methods import SingleNodeConsolidation
+
+        ctrl = env.disruption
+        method = SingleNodeConsolidation(ctrl.ctx)
+        eligible = [c for c in ctrl.get_candidates() if method.should_disrupt(c)]
+        assert len(eligible) == 1, "fixture must yield exactly the spot node"
+        ctrl.ctx.round_candidates = eligible
+        ctrl.ctx.node_pool_totals = None
+        return method.compute_consolidation(eligible[:1])
+
+    def test_candidate_among_15_cheapest_blocks_churn(self):
+        # :1177 "cannot replace spot with spot if it is part of the 15
+        # cheapest instance types" — cand's other-zone offering is the
+        # cheapest overall, so replacing would be pointless churn
+        env = self._env_with_spot_node(cand_cheap_price=0.5)
+        cmd = self._single_node_cmd(env)
+        assert not cmd.candidates and not cmd.replacements, "blocked, not a delete"
+
+    def test_truncates_to_15_cheapest_excluding_candidate(self):
+        # :1247 "spot to spot consolidation should order the instance types
+        # by price before enforcing minimum flexibility" — cand ranks 18th,
+        # so the command proceeds with exactly the 15 cheapest options
+        env = self._env_with_spot_node(cand_cheap_price=2.9)
+        cmd = self._single_node_cmd(env)
+        assert cmd.replacements
+        names = [it.name for it in cmd.replacements[0].instance_type_options]
+        assert len(names) == 15
+        assert "cand" not in names
+        assert names == sorted(names), "options stay price-ordered (st-00..st-14)"
