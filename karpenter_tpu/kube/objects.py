@@ -313,6 +313,11 @@ class Device:
     # qualified attribute name ("driver/attr" or plain) -> str | int | bool
     attributes: dict[str, Any] = field(default_factory=dict)
     capacity: dict[str, Quantity] = field(default_factory=dict)
+    # DRA driver name; on slice-published devices the ResourceSlice's driver
+    # wins, but instance-type template devices (cloudprovider
+    # dynamicresources.go:41-44 ResourceSliceTemplate.Driver) declare theirs
+    # here so CEL `device.driver` selectors see it pre-launch
+    driver: str = ""
     # multi-allocatable (consumable-capacity) devices can serve several claims
     # until their capacity is exhausted
     allow_multiple_allocations: bool = False

@@ -32,6 +32,7 @@ import time
 from dataclasses import dataclass, field
 
 from ...utils.quantity import Quantity
+from .cel import matches_device as _cel_matches
 
 ALLOCATE_TIMEOUT_SECONDS = 5.0  # allocator.go:43
 
@@ -47,11 +48,18 @@ def _attr_value(device, name):
     return None
 
 
-def device_matches_selectors(device, selectors: list[dict]) -> bool:
+def device_matches_selectors(device, selectors: list[dict], driver: str = "") -> bool:
     """Structured replacement for the reference's CEL device selectors
-    (request.go Selectors): every selector must match."""
+    (request.go Selectors): every selector must match. A selector may also be
+    a CEL expression `{"cel": "<expr>"}` evaluated by the subset interpreter
+    in cel.py, so reference ResourceClaims port over verbatim
+    (allocator_test.go exactRequestWithSelector corpus); `driver` feeds
+    `device.driver` there."""
     for sel in selectors or []:
-        if "attribute" in sel:
+        if "cel" in sel:
+            if not _cel_matches(sel["cel"], device, driver):
+                return False
+        elif "attribute" in sel:
             val = _attr_value(device, sel["attribute"])
             op = sel.get("operator", "Exists")
             values = sel.get("values", [])
@@ -688,7 +696,7 @@ class Allocator:
                 if cls not in self.class_selectors:
                     return False
                 sels = list(self.class_selectors[cls]) + sels
-            return device_matches_selectors(ref.device, sels)
+            return device_matches_selectors(ref.device, sels, driver=ref.driver)
 
         def dfs(req_idx: int) -> bool:
             if self._now() > deadline:
@@ -813,7 +821,10 @@ class Allocator:
         out = []
         for d in getattr(instance_type, "dynamic_resources", None) or []:
             out.append(
-                _DeviceRef(device=d, driver="template", pool=instance_type.name,
+                # device_id keeps the "template" scope sentinel; the ref's
+                # driver prefers the device's declared DRA driver so CEL
+                # `device.driver` selectors work pre-launch
+                _DeviceRef(device=d, driver=d.driver or "template", pool=instance_type.name,
                            device_id=("template", instance_type.name, "pool", d.name))
             )
         sets = getattr(instance_type, "dynamic_resources_counters", None)

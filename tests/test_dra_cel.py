@@ -1,0 +1,366 @@
+"""CEL device-selector subset: the reference evaluates DRA selectors as CEL
+(resourcev1.CELDeviceSelector; allocator_test.go exactRequestWithSelector
+corpus). These specs pin the subset interpreter in
+scheduling/dynamicresources/cel.py against the corpus expressions and the
+upstream error semantics (errors mean no-match, compile errors are sticky)."""
+
+import pytest
+
+from karpenter_tpu.kube import Device
+from karpenter_tpu.scheduling.dynamicresources import device_matches_selectors
+from karpenter_tpu.scheduling.dynamicresources.cel import (
+    CelError,
+    evaluate,
+    matches_device,
+)
+from karpenter_tpu.utils.quantity import Quantity
+from karpenter_tpu.utils.resources import parse_resource_list
+
+
+def gpu(model="h100", name="g0", driver_attrs=None, **caps):
+    attrs = {"gpu.example.com/model": model, "gpu.example.com/type": "compute"}
+    attrs.update(driver_attrs or {})
+    return Device(
+        name=name,
+        attributes=attrs,
+        capacity=parse_resource_list(caps or {"memory": "40Gi"}),
+    )
+
+
+class TestCorpusExpressions:
+    """Every distinct expression family in allocator_test.go's CEL corpus."""
+
+    def test_driver_equality(self):
+        # allocator_test.go:267 `device.driver == "gpu.example.com"`
+        d = gpu()
+        assert matches_device('device.driver == "gpu.example.com"', d, "gpu.example.com")
+        assert not matches_device('device.driver == "gpu.example.com"', d, "nic.example.com")
+
+    def test_attribute_equality(self):
+        # allocator_test.go:487 `device.attributes["gpu.example.com"].model == "H100"`
+        d = gpu(model="H100")
+        assert matches_device('device.attributes["gpu.example.com"].model == "H100"', d)
+        assert not matches_device('device.attributes["gpu.example.com"].model == "A100"', d)
+
+    def test_attribute_type_discrimination(self):
+        # allocator_test.go:2675/2683 compute vs network `type` split
+        d = gpu()
+        assert matches_device('device.attributes["gpu.example.com"].type == "compute"', d)
+        assert not matches_device('device.attributes["gpu.example.com"].type == "network"', d)
+
+    def test_single_quoted_strings(self):
+        d = gpu(model="A")
+        assert matches_device("device.attributes['gpu.example.com'].model == 'A'", d)
+
+    def test_missing_attribute_means_no_match(self):
+        # upstream cel.Device.Matches: evaluation error -> (false, err)
+        d = gpu()
+        assert not matches_device('device.attributes["gpu.example.com"].missing == "x"', d)
+        assert not matches_device('device.attributes["other.example.com"].model == "x"', d)
+
+    def test_unqualified_driver_domain_attribute(self):
+        # attributes published bare resolve under the slice's own driver domain
+        d = Device(name="n", attributes={"speed": "fast"})
+        assert matches_device('device.attributes["nic.example.com"].speed == "fast"', d, "nic.example.com")
+        assert not matches_device('device.attributes["nic.example.com"].speed == "fast"', d, "gpu.example.com")
+
+
+class TestOperatorsAndLogic:
+    def test_inequality(self):
+        d = gpu(model="h100")
+        assert matches_device('device.attributes["gpu.example.com"].model != "a100"', d)
+        assert not matches_device('device.attributes["gpu.example.com"].model != "h100"', d)
+
+    def test_numeric_comparisons(self):
+        d = Device(name="n", attributes={"nic.example.com/ports": 8})
+        assert matches_device('device.attributes["nic.example.com"].ports >= 8', d)
+        assert matches_device('device.attributes["nic.example.com"].ports > 4', d)
+        assert not matches_device('device.attributes["nic.example.com"].ports < 8', d)
+        assert matches_device('device.attributes["nic.example.com"].ports <= 8', d)
+
+    def test_numeric_string_attribute_coerces(self):
+        # flat attribute storage often stringifies ints
+        d = Device(name="n", attributes={"nic.example.com/ports": "8"})
+        assert matches_device('device.attributes["nic.example.com"].ports >= 8', d)
+
+    def test_boolean_attribute(self):
+        d = Device(name="n", attributes={"gpu.example.com/ecc": True})
+        assert matches_device('device.attributes["gpu.example.com"].ecc == true', d)
+        assert not matches_device('device.attributes["gpu.example.com"].ecc == false', d)
+
+    def test_bool_int_not_equal(self):
+        # CEL never equates bool with number
+        d = Device(name="n", attributes={"gpu.example.com/ecc": True})
+        assert not matches_device('device.attributes["gpu.example.com"].ecc == 1', d)
+
+    def test_and_or_not_parens(self):
+        d = gpu(model="h100")
+        e = ('device.attributes["gpu.example.com"].model == "h100" && '
+             'device.attributes["gpu.example.com"].type == "compute"')
+        assert matches_device(e, d)
+        e2 = ('device.attributes["gpu.example.com"].model == "a100" || '
+              'device.attributes["gpu.example.com"].type == "compute"')
+        assert matches_device(e2, d)
+        assert matches_device('!(device.attributes["gpu.example.com"].model == "a100")', d)
+        e3 = ('(device.attributes["gpu.example.com"].model == "a100" || '
+              'device.attributes["gpu.example.com"].model == "h100") && '
+              'device.attributes["gpu.example.com"].type == "compute"')
+        assert matches_device(e3, d)
+
+    def test_in_list(self):
+        d = gpu(model="h100")
+        assert matches_device('device.attributes["gpu.example.com"].model in ["a100", "h100"]', d)
+        assert not matches_device('device.attributes["gpu.example.com"].model in ["a100", "b200"]', d)
+
+    def test_commutative_and_false_absorbs_error(self):
+        # CEL && is commutative: false && <error> == false
+        d = gpu(model="h100")
+        e = ('device.attributes["gpu.example.com"].model == "a100" && '
+             'device.attributes["gpu.example.com"].missing == "x"')
+        assert not matches_device(e, d)
+        e_rev = ('device.attributes["gpu.example.com"].missing == "x" && '
+                 'device.attributes["gpu.example.com"].model == "a100"')
+        assert not matches_device(e_rev, d)
+
+    def test_commutative_or_true_absorbs_error(self):
+        d = gpu(model="h100")
+        e = ('device.attributes["gpu.example.com"].missing == "x" || '
+             'device.attributes["gpu.example.com"].model == "h100"')
+        assert matches_device(e, d)
+        # but error || false is still an error -> no match
+        e2 = ('device.attributes["gpu.example.com"].missing == "x" || '
+              'device.attributes["gpu.example.com"].model == "a100"')
+        assert not matches_device(e2, d)
+
+
+class TestMacrosAndFunctions:
+    def test_has_probe(self):
+        d = gpu()
+        assert matches_device('has(device.attributes["gpu.example.com"].model)', d)
+        assert not matches_device('has(device.attributes["gpu.example.com"].missing)', d)
+        assert matches_device('!has(device.attributes["gpu.example.com"].missing)', d)
+
+    def test_quantity_capacity_comparison(self):
+        d = gpu(memory="40Gi")
+        assert matches_device('device.capacity["gpu.example.com"].memory >= quantity("40Gi")', d, "gpu.example.com")
+        assert not matches_device('device.capacity["gpu.example.com"].memory >= quantity("80Gi")', d, "gpu.example.com")
+
+    def test_capacity_missing_means_no_match(self):
+        d = gpu(memory="40Gi")
+        assert not matches_device('device.capacity["gpu.example.com"].vram >= quantity("1Gi")', d)
+
+    def test_string_methods(self):
+        d = gpu(model="h100-sxm")
+        assert matches_device('device.attributes["gpu.example.com"].model.startsWith("h100")', d)
+        assert matches_device('device.attributes["gpu.example.com"].model.endsWith("sxm")', d)
+        assert matches_device('device.attributes["gpu.example.com"].model.contains("100")', d)
+        assert matches_device('device.attributes["gpu.example.com"].model.matches("h[0-9]+")', d)
+        assert not matches_device('device.attributes["gpu.example.com"].model.matches("^x")', d)
+
+    def test_case_fold_methods(self):
+        d = gpu(model="H100")
+        assert matches_device('device.attributes["gpu.example.com"].model.lowerAscii() == "h100"', d)
+        assert matches_device('device.attributes["gpu.example.com"].model.upperAscii() == "H100"', d)
+
+    def test_size(self):
+        d = gpu(model="h100")
+        assert matches_device('size(device.attributes["gpu.example.com"].model) == 4', d)
+
+
+class TestErrorSemantics:
+    def test_parse_error_no_match(self):
+        d = gpu()
+        assert not matches_device('device.attributes[".broken', d)
+        assert not matches_device("device.driver === 'x'", d)
+        assert not matches_device("", d)
+
+    def test_parse_error_is_sticky(self):
+        d = gpu()
+        assert not matches_device("device.driver ==", d)
+        assert not matches_device("device.driver ==", d)  # cached CelError path
+
+    def test_non_boolean_result_errors(self):
+        d = gpu()
+        with pytest.raises(CelError):
+            evaluate('device.attributes["gpu.example.com"].model', d)
+        assert not matches_device('device.attributes["gpu.example.com"].model', d)
+
+    def test_type_confusion_errors(self):
+        d = gpu(model="h100")
+        # ordering a string against an int is an error, not False
+        with pytest.raises(CelError):
+            evaluate('device.attributes["gpu.example.com"].model < 5', d)
+
+    def test_trailing_garbage_rejected(self):
+        d = gpu()
+        assert not matches_device('device.driver == "x" extra', d)
+
+    def test_unparseable_quantity_comparand_is_no_match_not_crash(self):
+        # Quantity.parse failures must surface as CelError (no-match), never
+        # escape matches_device and crash the allocator DFS
+        d = gpu(memory="40Gi")
+        assert not matches_device(
+            'device.capacity["gpu.example.com"].memory >= "lots"', d, "gpu.example.com"
+        )
+        assert not matches_device(
+            'device.capacity["gpu.example.com"].memory >= true', d, "gpu.example.com"
+        )
+
+    def test_bare_capacity_gated_on_driver_domain(self):
+        # bare "memory" resolves only under the publishing driver's domain,
+        # like the attributes branch
+        d = gpu(memory="40Gi")
+        expr = 'device.capacity["other.example.com"].memory >= quantity("1Gi")'
+        assert not matches_device(expr, d, "gpu.example.com")
+        ok = 'device.capacity["gpu.example.com"].memory >= quantity("1Gi")'
+        assert matches_device(ok, d, "gpu.example.com")
+
+    def test_commutative_or_absorbs_type_errors(self):
+        # upstream CEL: true || <any error> == true, not just missing-attr
+        d = gpu(model="h100")
+        e = ('device.attributes["gpu.example.com"].model < 5 || '
+             'device.attributes["gpu.example.com"].model == "h100"')
+        assert matches_device(e, d)
+        e_and = ('device.attributes["gpu.example.com"].model < 5 && '
+                 'device.attributes["gpu.example.com"].model == "x"')
+        assert not matches_device(e_and, d)
+
+    def test_bool_ordering_is_type_error(self):
+        # upstream CEL has no ordering overload for booleans
+        d = Device(name="n", attributes={"gpu.example.com/ecc": True})
+        assert not matches_device('device.attributes["gpu.example.com"].ecc > 0', d)
+
+    def test_string_escapes_decode(self):
+        d = Device(name="n", attributes={"d/sep": "\n"})
+        assert matches_device('device.attributes["d"].sep == "\\n"', d)
+        assert not matches_device('device.attributes["d"].sep == "n"', d)
+
+    def test_negative_numeric_literals(self):
+        d = Device(name="n", attributes={"nic.example.com/temp": -3})
+        assert matches_device('device.attributes["nic.example.com"].temp > -5', d)
+        assert not matches_device('device.attributes["nic.example.com"].temp > -1', d)
+        assert matches_device('device.attributes["nic.example.com"].temp == -3', d)
+
+
+class TestSelectorIntegration:
+    def test_cel_selector_dict(self):
+        d = gpu(model="H100")
+        assert device_matches_selectors(
+            d, [{"cel": 'device.attributes["gpu.example.com"].model == "H100"'}]
+        )
+        assert not device_matches_selectors(
+            d, [{"cel": 'device.attributes["gpu.example.com"].model == "A100"'}]
+        )
+
+    def test_cel_and_structured_mix(self):
+        d = gpu(model="H100")
+        sels = [
+            {"cel": 'device.attributes["gpu.example.com"].type == "compute"'},
+            {"attribute": "gpu.example.com/model", "operator": "In", "values": ["H100"]},
+        ]
+        assert device_matches_selectors(d, sels)
+
+    def test_driver_threading(self):
+        d = gpu()
+        assert device_matches_selectors(
+            d, [{"cel": 'device.driver == "gpu.example.com"'}], driver="gpu.example.com"
+        )
+        assert not device_matches_selectors(
+            d, [{"cel": 'device.driver == "gpu.example.com"'}], driver="fpga.example.com"
+        )
+
+    def test_quantity_value_equivalence(self):
+        assert Quantity.parse("40Gi").milli == 40 * 1024**3 * 1000
+
+
+class TestAllocatorEndToEnd:
+    """CEL selectors flowing through the DFS allocator, mirroring
+    allocator_test.go:267 (class-level driver filter) and :7470-7474
+    (request-level model split)."""
+
+    def _build(self, devices_by_driver):
+        from karpenter_tpu.kube import DeviceClass, ObjectMeta, ResourceSlice, Store
+        from karpenter_tpu.scheduling.dynamicresources import Allocator
+        from karpenter_tpu.state import Cluster
+        from karpenter_tpu.state.informer import start_informers
+        from karpenter_tpu.utils.clock import FakeClock
+
+        store, clock = Store(), FakeClock()
+        cluster = Cluster(store, clock)
+        start_informers(store, cluster)
+        store.create(
+            DeviceClass(
+                metadata=ObjectMeta(name="gpu-class"),
+                selectors=[{"cel": 'device.driver == "gpu.example.com"'}],
+            )
+        )
+        for i, (driver, devs) in enumerate(devices_by_driver.items()):
+            store.create(
+                ResourceSlice(
+                    metadata=ObjectMeta(name=f"sl-{i}"),
+                    driver=driver,
+                    pool_name=f"pool-{i}",
+                    node_name="node-a",
+                    devices=devs,
+                )
+            )
+        return store, Allocator(store)
+
+    def test_class_cel_filters_wrong_driver(self):
+        from karpenter_tpu.kube import ObjectMeta, ResourceClaim
+
+        store, alloc = self._build(
+            {
+                "gpu.example.com": [gpu(model="H100")],
+                "nic.example.com": [Device(name="nic0", attributes={"nic.example.com/speed": "100G"})],
+            }
+        )
+        claim = ResourceClaim(
+            metadata=ObjectMeta(name="c1", namespace="default"),
+            requests=[{"name": "r", "deviceClassName": "gpu-class", "count": 1}],
+        )
+        result, err = alloc.allocate_for_node("node-a", [claim])
+        assert err is None and result is not None
+        picks = next(iter(result.picks.values()))
+        assert picks[0][1].driver == "gpu.example.com"
+
+    def test_request_cel_model_split(self):
+        # two GPUs, one claim demanding the H100 via request-level CEL
+        from karpenter_tpu.kube import ObjectMeta, ResourceClaim
+
+        store, alloc = self._build(
+            {"gpu.example.com": [gpu(model="A100", name="g0"), gpu(model="H100", name="g1")]}
+        )
+        claim = ResourceClaim(
+            metadata=ObjectMeta(name="c1", namespace="default"),
+            requests=[
+                {
+                    "name": "r",
+                    "deviceClassName": "gpu-class",
+                    "count": 1,
+                    "selectors": [{"cel": 'device.attributes["gpu.example.com"].model == "H100"'}],
+                }
+            ],
+        )
+        result, err = alloc.allocate_for_node("node-a", [claim])
+        assert err is None and result is not None
+        picks = next(iter(result.picks.values()))
+        assert picks[0][1].device.attributes["gpu.example.com/model"] == "H100"
+
+    def test_unsatisfiable_cel_fails_allocation(self):
+        from karpenter_tpu.kube import ObjectMeta, ResourceClaim
+
+        store, alloc = self._build({"gpu.example.com": [gpu(model="A100")]})
+        claim = ResourceClaim(
+            metadata=ObjectMeta(name="c1", namespace="default"),
+            requests=[
+                {
+                    "name": "r",
+                    "deviceClassName": "gpu-class",
+                    "count": 1,
+                    "selectors": [{"cel": 'device.attributes["gpu.example.com"].model == "B200"'}],
+                }
+            ],
+        )
+        result, err = alloc.allocate_for_node("node-a", [claim])
+        assert result is None
