@@ -154,8 +154,26 @@ class KWOKCloudProvider:
             ),
             spec=NodeSpec(provider_id=KWOK_PROVIDER_PREFIX + name, taints=[UNREGISTERED_TAINT]),
             status=NodeStatus(
-                capacity=dict(best_it.capacity),
-                allocatable=res.merge({}, best_it.allocatable()),
+                # the claim's resource requests seed both vectors and the
+                # instance type's numbers override shared keys
+                # (kwok/cloudprovider.go:231-232): extended resources the
+                # scheduler packed against — override-offering capacity, DRA
+                # requests — survive on the launched node so pods can bind;
+                # the chosen offering's capacity/overhead overrides
+                # (types.go AllocatableOfferings) shape the real numbers
+                capacity={
+                    **node_claim.spec.resources,
+                    **best_it.capacity,
+                    **(best_offering.capacity_override or {}),
+                },
+                allocatable={
+                    # assign, not sum (lo.Assign): instance-type numbers win
+                    # on shared keys, request-only keys survive
+                    **node_claim.spec.resources,
+                    **best_it.compute_allocatable(
+                        best_offering.capacity_override, best_offering.overhead_override
+                    ),
+                },
             ),
         )
 
@@ -168,6 +186,13 @@ class KWOKCloudProvider:
             annotations=dict(node.metadata.annotations),
         )
         nc.status.provider_id = node.spec.provider_id
-        nc.status.capacity = dict(it.capacity) if it else dict(node.status.capacity)
-        nc.status.allocatable = dict(it.allocatable()) if it else dict(node.status.allocatable)
+        # the node was stamped with its offering's override-aware
+        # capacity/allocatable at launch — prefer that record over the base
+        # instance-type numbers
+        if node.status.capacity or node.status.allocatable:
+            nc.status.capacity = dict(node.status.capacity)
+            nc.status.allocatable = dict(node.status.allocatable)
+        else:
+            nc.status.capacity = dict(it.capacity) if it else {}
+            nc.status.allocatable = dict(it.allocatable()) if it else {}
         return nc

@@ -96,13 +96,74 @@ class InstanceType:
     dynamic_resources_counters: list = field(default_factory=list)
 
     _allocatable: Optional[dict[str, Quantity]] = field(default=None, repr=False, compare=False)
+    _alloc_groups: Optional[list] = field(default=None, repr=False, compare=False)
+
+    def compute_allocatable(
+        self,
+        capacity_override: Optional[dict[str, Quantity]] = None,
+        overhead_override: Optional["InstanceTypeOverhead"] = None,
+    ) -> dict[str, Quantity]:
+        """(capacity ⊕ override) − (overhead ⊕ override), hugepage
+        reservations subtracted from memory, floored at zero
+        (types.go:261-295 computeAllocatable)."""
+        capacity = self.capacity
+        if capacity_override:
+            capacity = {**self.capacity, **capacity_override}
+        overhead = self.overhead.total()
+        if overhead_override is not None:
+            overhead = {**overhead, **overhead_override.total()}
+        out = res.subtract(capacity, overhead)
+        out = {k: (v if v.milli > 0 else Quantity(0)) for k, v in out.items()}
+        huge = sum(q.milli for k, q in capacity.items() if k.startswith("hugepages-"))
+        if huge:
+            mem = out.get("memory", Quantity(0)).milli - huge
+            out["memory"] = Quantity(max(mem, 0))
+        return out
 
     def allocatable(self) -> dict[str, Quantity]:
-        """capacity - overhead, floored at zero (types.go:271-295)."""
+        """Base allocatable: no offering overrides (types.go:330-334)."""
         if self._allocatable is None:
-            out = res.subtract(self.capacity, self.overhead.total())
-            self._allocatable = {k: (v if v.milli > 0 else Quantity(0)) for k, v in out.items()}
+            self._allocatable = self.compute_allocatable()
         return self._allocatable
+
+    def allocatable_offerings_list(self) -> list[tuple[dict[str, Quantity], list[Offering]]]:
+        """Groups of (allocatable, available offerings producing it); the
+        first entry is always the base allocatable, override offerings are
+        grouped by identical override content (types.go:202-257 precompute +
+        groupOfferingsByOverride). Availability is read live: tests and
+        overlays flip o.available in place, so the cache keys on the
+        availability vector and rebuilds when it changes."""
+        avail_key = tuple(o.available for o in self.offerings)
+        if self._alloc_groups is not None and self._alloc_groups[0] != avail_key:
+            self._alloc_groups = None
+        if self._alloc_groups is None:
+            base: list[Offering] = []
+            order: list[tuple] = []
+            by_key: dict[tuple, list[Offering]] = {}
+            for o in self.offerings:
+                if not o.available:
+                    continue
+                if not o.capacity_override and o.overhead_override is None:
+                    base.append(o)
+                    continue
+                key = (
+                    tuple(sorted((k, v.milli) for k, v in (o.capacity_override or {}).items())),
+                    repr(o.overhead_override),
+                )
+                if key not in by_key:
+                    by_key[key] = []
+                    order.append(key)
+                by_key[key].append(o)
+            groups: list[tuple[dict[str, Quantity], list[Offering]]] = [
+                (self.allocatable(), base)
+            ]
+            for key in order:
+                offs = by_key[key]
+                groups.append(
+                    (self.compute_allocatable(offs[0].capacity_override, offs[0].overhead_override), offs)
+                )
+            self._alloc_groups = (avail_key, groups)
+        return self._alloc_groups[1]
 
     def apply_capacity_overlay(self, updated: dict[str, Quantity]) -> None:
         self.capacity = res.merge(self.capacity, updated)  # overlay adds/overrides
@@ -110,6 +171,7 @@ class InstanceType:
             self.capacity[k] = v
         self.capacity_overlaid = True
         self._allocatable = None
+        self._alloc_groups = None
 
     def offering_price(self, zone: str, capacity_type: str) -> Optional[float]:
         for o in self.offerings:

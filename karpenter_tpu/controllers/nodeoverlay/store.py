@@ -154,6 +154,11 @@ class InternalInstanceTypeStore:
                     price=o.price,
                     available=o.available,
                     reservation_capacity=o.reservation_capacity,
+                    # preserve allocatable-group identity (types.go
+                    # AllocatableOfferings): dropping these would silently
+                    # move the copy into the base group
+                    capacity_override=o.capacity_override,
+                    overhead_override=o.overhead_override,
                 )
                 copied.apply_price_overlay(pu.update, pu.absolute)
                 offerings.append(copied)

@@ -500,12 +500,18 @@ def filter_instance_types(
 
 
 def _fits_and_offering(it: InstanceType, requests: dict[str, Quantity], requirements: Requirements) -> tuple[bool, bool]:
-    """(fits, has_offering) against allocatable and compatible+available offerings
-    (nodeclaim.go:626-640)."""
-    fits = res.fits(requests, it.allocatable())
+    """(fits, has_offering) per allocatable-offerings group: offerings with
+    capacity/overhead overrides form groups with their OWN allocatable, so an
+    instance type fits iff some group both fits the requests and holds a
+    compatible offering (nodeclaim.go:624-640 fits +
+    types.go:202-257 AllocatableOfferingsList)."""
     has_offering = False
-    for o in it.offerings:
-        if o.available and requirements.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None:
-            has_offering = True
-            break
-    return fits, has_offering
+    for alloc, offerings in it.allocatable_offerings_list():
+        resource_fit = res.fits(requests, alloc)
+        for o in offerings:
+            if requirements.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None:
+                has_offering = True
+                if resource_fit:
+                    return True, True
+                break
+    return False, has_offering

@@ -131,8 +131,9 @@ class _Call:  # method call: recv.method(args) or bare fn(args)
 
 
 class _Parser:
-    """Recursive descent over the precedence ladder || → && → ! → cmp/in →
-    postfix (method call) → primary."""
+    """Recursive descent over CEL's precedence ladder || → && → cmp/in →
+    unary(!,-) → postfix (method call) → primary; unary binds TIGHTER than
+    comparison, so `!x == 5` is `(!x) == 5` as upstream parses it."""
 
     def __init__(self, toks: list[tuple[str, str]]):
         self.toks = toks
@@ -165,28 +166,32 @@ class _Parser:
         return node
 
     def parse_and(self):
-        node = self.parse_not()
+        node = self.parse_cmp()
         while self.peek()[1] == "&&":
             self.next()
-            node = _Binary("&&", node, self.parse_not())
+            node = _Binary("&&", node, self.parse_cmp())
         return node
 
-    def parse_not(self):
-        if self.peek()[1] == "!":
-            self.next()
-            return _Unary("!", self.parse_not())
-        return self.parse_cmp()
-
     def parse_cmp(self):
-        node = self.parse_postfix()
+        node = self.parse_unary()
         kind, tok = self.peek()
         if tok in ("==", "!=", "<", "<=", ">", ">="):
             self.next()
-            return _Binary(tok, node, self.parse_postfix())
+            return _Binary(tok, node, self.parse_unary())
         if kind == "ident" and tok == "in":
             self.next()
-            return _Binary("in", node, self.parse_postfix())
+            return _Binary("in", node, self.parse_unary())
         return node
+
+    def parse_unary(self):
+        tok = self.peek()[1]
+        if tok in ("!", "-"):
+            self.next()
+            operand = self.parse_unary()
+            if tok == "-" and isinstance(operand, _Lit) and isinstance(operand.value, (int, float)) and not isinstance(operand.value, bool):
+                return _Lit(-operand.value)
+            return _Unary(tok, operand)
+        return self.parse_postfix()
 
     def parse_postfix(self):
         node = self.parse_primary()
@@ -208,11 +213,6 @@ class _Parser:
 
     def parse_primary(self):
         kind, tok = self.next()
-        if tok == "-":
-            operand = self.parse_primary()
-            if isinstance(operand, _Lit) and isinstance(operand.value, (int, float)):
-                return _Lit(-operand.value)
-            return _Unary("-", operand)
         if tok == "(":
             node = self.parse_or()
             self.expect(")")

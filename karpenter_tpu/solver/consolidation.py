@@ -71,12 +71,15 @@ def encode_candidates(candidates, instance_types):
 
     rows_alloc, rows_price = [], []
     for it in instance_types:
-        alloc = vec(it.allocatable())
-        for o in it.offerings:
-            if not o.available:
-                continue
-            rows_alloc.append(alloc)
-            rows_price.append(o.price)
+        # per-offering overrides give a replacement row its own allocatable,
+        # matching the provisioning path (types.go AllocatableOfferings) —
+        # otherwise consolidation proposes commands the re-simulation would
+        # reject; groups are cached and deduplicated on the instance type
+        for galloc, goffs in it.allocatable_offerings_list():
+            alloc = vec(galloc)
+            for o in goffs:
+                rows_alloc.append(alloc)
+                rows_price.append(o.price)
     if not rows_alloc:
         rows_alloc = [np.zeros(R, dtype=np.float32)]
         rows_price = [np.float32(3.4e38)]

@@ -1120,11 +1120,26 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
             alloc_vec = rl_to_vec({k: v for k, v in alloc.items() if v.milli > 0})
             for i, _driver in csi_axes:
                 alloc_vec[i] = CSI_AXIS_BIG
+            # override offerings share their group's (cached, deduplicated)
+            # allocatable instead of recomputing per offering
+            ov_vec_of = {}
+            for galloc, goffs in it.allocatable_offerings_list()[1:]:
+                galloc = res.subtract(galloc, overhead_by_it.get(id(it), {}))
+                gvec = rl_to_vec({k: v for k, v in galloc.items() if v.milli > 0})
+                for i, _driver in csi_axes:
+                    gvec[i] = CSI_AXIS_BIG
+                for o in goffs:
+                    ov_vec_of[id(o)] = gvec
             for o in it.offerings:
                 if not o.available:
                     continue
                 if t.requirements.intersects(o.requirements) is not None:
                     continue
+                # offering-level overrides give this ROW its own allocatable
+                # (nodeclaim.go:624-640 fits iterates AllocatableOfferingsList;
+                # here each offering already has its own row, so the override
+                # group's vector folds in directly)
+                o_alloc_vec = ov_vec_of.get(id(o), alloc_vec)
                 labels_o = dict(it_label_ids)
                 for key, r in o.requirements.items():
                     if r.operator() == Operator.IN and len(r.values) == 1:
@@ -1136,7 +1151,7 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
                     vs = _req_in_values(o.requirements, dom_keys[k])
                     if len(vs) == 1:
                         o_dom[k] = vs[0]
-                row_alloc_l.append(alloc_vec)
+                row_alloc_l.append(o_alloc_vec)
                 row_price_l.append(o.price)
                 row_labels_l.append(labels_o)
                 row_dom_l.append([dom_id(k, v) if v else dom_sentinel[k] for k, v in enumerate(o_dom)])

@@ -39,6 +39,21 @@ def _ports_fit(group_usage, pod_ports: list) -> bool:
     return True
 
 
+def _group_fits(groups: list, need_vec, reqs) -> bool:
+    """Exact allocatable-offerings-group fits for ITs with override
+    offerings: a group counts iff its OWN allocatable covers the need AND it
+    holds an offering compatible with the claim requirements
+    (nodeclaim.go:624-640 fits over AllocatableOfferingsList)."""
+    for gvec, goffs in groups:
+        rfit = bool(np.all(gvec >= need_vec))
+        for o in goffs:
+            if reqs.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None:
+                if rfit:
+                    return True
+                break
+    return False
+
+
 def _requests_from_sigs(enc, sig_counts: dict[int, int]) -> dict:
     """Total ResourceList for a slot from (signature -> pod count): integer
     milli accumulation, one Quantity construction per resource."""
@@ -505,7 +520,7 @@ class TPUSolver:
             # reqs); a shared Requirements would couple sibling slots
             claim.requirements = reqs.copy()
 
-            its, alloc_mat, ginfo = self._template_ctx(template, claim.daemon_overhead_groups, enc, tmpl_ctx_cache)
+            its, alloc_mat, ginfo, ov_groups = self._template_ctx(template, claim.daemon_overhead_groups, enc, tmpl_ctx_cache)
             mask = mask_cache.get(rkey)
             if mask is None:
                 # compat x offering per instance type (nodeclaim.go:626-640)
@@ -535,7 +550,15 @@ class TPUSolver:
                 if pod_ports and not _ports_fit(gusage, pod_ports):
                     continue
                 fits = np.all(alloc_mat[members] >= total_vec[None, :] + ovh[None, :], axis=1)
-                remaining.extend(its[m] for m, ok in zip(members, fits & mask[members]) if ok)
+                surv = fits & mask[members]
+                if ov_groups:
+                    # ITs with override offerings use the exact group-wise
+                    # fits (a group's own allocatable × a compatible offering
+                    # in THAT group — nodeclaim.go:624-640)
+                    for pos, m in enumerate(members):
+                        if m in ov_groups and its[m].requirements.intersects(reqs) is None:
+                            surv[pos] = _group_fits(ov_groups[m], total_vec + ovh, reqs)
+                remaining.extend(its[m] for m, ok in zip(members, surv) if ok)
             if not remaining:
                 # the post-filter set must never be empty when the kernel is
                 # sound; before trusting the single packed row, re-check it is
@@ -547,6 +570,16 @@ class TPUSolver:
                     ((ovh, gusage) for members, ovh, gusage in ginfo if it_idx is not None and it_idx in members),
                     None,
                 )
+                if it_idx is not None and it_idx in ov_groups:
+                    it_fit = entry is not None and _group_fits(
+                        ov_groups[it_idx], total_vec + entry[0], claim.requirements
+                    )
+                else:
+                    it_fit = (
+                        it_idx is not None
+                        and entry is not None
+                        and bool(np.all(alloc_mat[it_idx] >= total_vec + entry[0]))
+                    )
                 it_ok = (
                     it.requirements.intersects(claim.requirements) is None
                     and any(
@@ -555,10 +588,8 @@ class TPUSolver:
                     )
                     # fit INCLUDING the row's daemon-overhead group and its
                     # reserved ports, exactly like the vectorized filter above
-                    and it_idx is not None
-                    and entry is not None
-                    and bool(np.all(alloc_mat[it_idx] >= total_vec + entry[0]))
-                    and (not pod_ports or _ports_fit(entry[1], pod_ports))
+                    and it_fit
+                    and (entry is not None and (not pod_ports or _ports_fit(entry[1], pod_ports)))
                 )
                 if not it_ok:
                     raise DecodeError(f"slot {j}: packed row {it.name} not launchable under final claim requirements")
@@ -614,7 +645,15 @@ class TPUSolver:
         instance-type list, its allocatable matrix in encode's scaled units,
         and per-daemon-overhead-group (member indices, overhead vector)."""
         key = id(template)
-        ctx = cache.get(key)
+        # offering availability is flipped in place between solves (tests,
+        # overlays, reservation exhaustion) while this cache outlives one
+        # decode — key the entry on the live availability vector so stale
+        # override groups can never overrule a freshly computed mask
+        avail_sig = tuple(
+            o.available for x in template.instance_type_options for o in x.offerings
+        )
+        entry = cache.get(key)
+        ctx = entry[1] if entry is not None and entry[0] == avail_sig else None
         if ctx is None:
             from .encode import _scale
 
@@ -632,9 +671,27 @@ class TPUSolver:
             # existing-node property — see solver/volumes.py)
             from .volumes import CSI_AXIS_BIG, CSI_AXIS_PREFIX
 
-            for r, name in enumerate(rnames):
-                if name.startswith(CSI_AXIS_PREFIX):
-                    alloc[:, r] = CSI_AXIS_BIG
+            csi_cols = [r for r, name in enumerate(rnames) if name.startswith(CSI_AXIS_PREFIX)]
+            for r in csi_cols:
+                alloc[:, r] = CSI_AXIS_BIG
+            # instance types with override offerings carry ALL their
+            # allocatable groups for the exact group-wise fits check
+            # (types.go AllocatableOfferingsList; most ITs have none)
+            ov_groups: dict[int, list] = {}
+            for i, x in enumerate(its):
+                groups_l = x.allocatable_offerings_list()
+                if len(groups_l) > 1:
+                    entries = []
+                    for galloc, goffs in groups_l:
+                        gvec = np.zeros(len(rnames), dtype=np.float64)
+                        for k, q in galloc.items():
+                            r = ridx.get(k)
+                            if r is not None:
+                                gvec[r] = _scale(k, q)
+                        for r in csi_cols:
+                            gvec[r] = CSI_AXIS_BIG
+                        entries.append((gvec, goffs))
+                    ov_groups[i] = entries
             ginfo = []
             for g in groups:
                 ovh = np.zeros(len(rnames), dtype=np.float64)
@@ -643,8 +700,8 @@ class TPUSolver:
                     if r is not None:
                         ovh[r] = _scale(k, q)
                 ginfo.append(([it_idx[id(x)] for x in g.instance_types if id(x) in it_idx], ovh, g.host_port_usage))
-            ctx = (its, alloc, ginfo)
-            cache[key] = ctx
+            ctx = (its, alloc, ginfo, ov_groups)
+            cache[key] = (avail_sig, ctx)
         return ctx
 
     @staticmethod

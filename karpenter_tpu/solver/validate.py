@@ -27,7 +27,13 @@ def validate_results(snap, results) -> list[str]:
         if not nc.instance_type_options:
             errors.append(f"claim {idx}: no instance types")
             continue
-        fits_any = any(res.fits(total, it.allocatable()) for it in nc.instance_type_options)
+        # override offerings give a group its own allocatable — a claim may
+        # be launchable ONLY via such a group (types.go AllocatableOfferings)
+        fits_any = any(
+            offs and res.fits(total, alloc)
+            for it in nc.instance_type_options
+            for alloc, offs in it.allocatable_offerings_list()
+        )
         if not fits_any:
             errors.append(f"claim {idx}: pods exceed every instance type allocatable")
         for p in nc.pods:

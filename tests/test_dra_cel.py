@@ -241,6 +241,16 @@ class TestErrorSemantics:
         assert not matches_device('device.attributes["nic.example.com"].temp > -1', d)
         assert matches_device('device.attributes["nic.example.com"].temp == -3', d)
 
+    def test_unary_not_binds_tighter_than_comparison(self):
+        # upstream CEL parses `!x == 5` as `(!x) == 5` — a type error on a
+        # non-boolean x, hence no-match; `!(x == 5)` is the boolean negation
+        d = Device(name="n", attributes={"d/count": 3})
+        assert not matches_device('!device.attributes["d"].count == 5', d)
+        assert matches_device('!(device.attributes["d"].count == 5)', d)
+        db = Device(name="n", attributes={"d/flag": False})
+        # (!flag) == true  →  true == true
+        assert matches_device('!device.attributes["d"].flag == true', db)
+
 
 class TestSelectorIntegration:
     def test_cel_selector_dict(self):

@@ -1,0 +1,325 @@
+"""Offering capacity/overhead overrides: offerings can carry their own
+capacity or overhead deltas, grouping an instance type's offerings into
+allocatable sets (reference types.go:195-257 AllocatableOfferings +
+nodeclaim.go:624-640 fits; suite_test.go:5521-5601 "Offering Overrides").
+Covers the grouping math, the host scheduler path, and the tensor path."""
+
+from helpers import make_nodepool, make_pod
+from test_scheduler import LINUX_AMD64, build_env, make_scheduler
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.cloudprovider.types import InstanceType, InstanceTypeOverhead, Offering
+from karpenter_tpu.scheduling.requirements import Requirements
+from karpenter_tpu.utils.quantity import Quantity
+from karpenter_tpu.utils.resources import parse_resource_list
+
+EXT = "test.com/extended-slots"
+
+
+def ext_pod(name=None, ext="1", cpu="100m"):
+    """Pod requesting the override-only extended resource."""
+    p = make_pod(name=name, cpu=cpu)
+    p.spec.containers[0].resources["requests"][EXT] = Quantity.parse(ext)
+    return p
+
+
+def _requests_of(p):
+    return p.spec.containers[0].resources.get("requests", {})
+
+
+def _offering(zone="test-zone-a", ct=wk.CAPACITY_TYPE_ON_DEMAND, price=1.0,
+              available=True, capacity_override=None, overhead_override=None):
+    return Offering(
+        requirements=Requirements.from_labels({
+            wk.CAPACITY_TYPE_LABEL_KEY: ct,
+            wk.ZONE_LABEL_KEY: zone,
+        }),
+        price=price,
+        available=available,
+        capacity_override=capacity_override,
+        overhead_override=overhead_override,
+    )
+
+
+def _it(name, offerings, cpu="4", memory="8Gi", capacity_extra=None):
+    cap = {"cpu": cpu, "memory": memory, "pods": "110"}
+    cap.update(capacity_extra or {})
+    return InstanceType(
+        name=name,
+        requirements=Requirements.from_labels({
+            wk.INSTANCE_TYPE_LABEL_KEY: name,
+            wk.ARCH_LABEL_KEY: "amd64",
+            wk.OS_LABEL_KEY: "linux",
+        }),
+        offerings=offerings,
+        capacity=parse_resource_list(cap),
+    )
+
+
+def override_capable(name="override-capable", available=True):
+    """Instance type with base offerings plus override clones declaring the
+    extended resource and an extra 1Gi memory system-reserve
+    (suite_test.go:5525-5543)."""
+    base = [_offering(zone=z) for z in ("test-zone-a", "test-zone-b")]
+    overrides = [
+        _offering(
+            zone=z,
+            available=available,
+            capacity_override=parse_resource_list({EXT: "4"}),
+            overhead_override=InstanceTypeOverhead(
+                system_reserved=parse_resource_list({"memory": "1Gi"})
+            ),
+        )
+        for z in ("test-zone-a", "test-zone-b")
+    ]
+    return _it(name, base + overrides)
+
+
+class TestAllocatableGrouping:
+    def test_base_group_first_and_override_grouped(self):
+        it = override_capable()
+        groups = it.allocatable_offerings_list()
+        # base group + one override group (identical override content merges)
+        assert len(groups) == 2
+        base_alloc, base_offs = groups[0]
+        ov_alloc, ov_offs = groups[1]
+        assert len(base_offs) == 2 and len(ov_offs) == 2
+        assert EXT not in base_alloc
+        assert ov_alloc[EXT] == Quantity.parse("4")
+        # overhead override subtracts 1Gi memory from the override group only
+        assert ov_alloc["memory"].milli == base_alloc["memory"].milli - Quantity.parse("1Gi").milli
+
+    def test_unavailable_offerings_excluded_from_groups(self):
+        it = override_capable(available=False)
+        groups = it.allocatable_offerings_list()
+        assert len(groups) == 1  # only the base group remains
+        assert len(groups[0][1]) == 2
+
+    def test_no_override_fast_path(self):
+        it = _it("plain", [_offering()])
+        groups = it.allocatable_offerings_list()
+        assert len(groups) == 1
+        assert groups[0][0] == it.allocatable()
+
+    def test_distinct_override_contents_form_distinct_groups(self):
+        offs = [
+            _offering(),
+            _offering(capacity_override=parse_resource_list({EXT: "4"})),
+            _offering(capacity_override=parse_resource_list({EXT: "8"})),
+            _offering(capacity_override=parse_resource_list({EXT: "4"})),
+        ]
+        it = _it("multi", offs)
+        groups = it.allocatable_offerings_list()
+        assert len(groups) == 3
+        assert len(groups[1][1]) == 2  # the two EXT=4 offerings merged
+
+    def test_capacity_overlay_invalidates_group_cache(self):
+        it = override_capable()
+        before = it.allocatable_offerings_list()[0][0]["cpu"]
+        it.apply_capacity_overlay(parse_resource_list({"cpu": "16"}))
+        after = it.allocatable_offerings_list()[0][0]["cpu"]
+        assert after.milli > before.milli
+
+
+class TestGroupCacheLiveAvailability:
+    def test_in_place_availability_flip_rebuilds_groups(self):
+        # tests/overlays flip o.available in place; the cached groups must
+        # follow the live availability like every other call site does
+        it = override_capable()
+        assert len(it.allocatable_offerings_list()) == 2
+        for o in it.offerings:
+            if o.capacity_override:
+                o.available = False
+        assert len(it.allocatable_offerings_list()) == 1
+
+
+class TestDownstreamConsumers:
+    def test_price_overlay_copy_preserves_overrides(self):
+        # nodeoverlay copy-on-write must not drop an offering's overrides —
+        # that would silently move the copy into the base allocatable group
+        from karpenter_tpu.apis.nodeoverlay import NodeOverlay, NodeOverlaySpec
+        from karpenter_tpu.controllers.nodeoverlay.store import InternalInstanceTypeStore
+        from karpenter_tpu.kube import ObjectMeta
+
+        it = override_capable()
+        store = InternalInstanceTypeStore()
+        store.evaluated_node_pools.add("default-pool")
+        ov = NodeOverlay(metadata=ObjectMeta(name="p"), spec=NodeOverlaySpec(price_adjustment="+10%"))
+        store.update_instance_type_offering("default-pool", it.name, ov, it.offerings)
+        out = store.apply("default-pool", it)
+        assert out is not it
+        groups = out.allocatable_offerings_list()
+        assert len(groups) == 2
+        assert all(o.capacity_override for o in groups[1][1])
+        assert all(o.price_overlaid for o in out.offerings)
+
+    def test_kwok_launch_stamps_override_allocatable(self):
+        # a node launched via an override offering must carry the override
+        # group's capacity/allocatable or pods packed against it cannot bind
+        from karpenter_tpu.cloudprovider.kwok import KWOKCloudProvider
+        from karpenter_tpu.kube import Store
+
+        only_override = _it(
+            "ov-only",
+            [_offering(
+                capacity_override=parse_resource_list({EXT: "4"}),
+                overhead_override=InstanceTypeOverhead(
+                    system_reserved=parse_resource_list({"memory": "1Gi"})
+                ),
+            )],
+        )
+        store = Store()
+        cp = KWOKCloudProvider(store, instance_types=[only_override])
+        from karpenter_tpu.apis.nodeclaim import NodeClaim
+
+        nc = NodeClaim()
+        nc.metadata.name = "nc-ov"
+        nc.spec.requirements = [
+            {"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": ["ov-only"]},
+        ]
+        node = cp._to_node(nc)  # noqa: SLF001 — launch conversion under test
+        assert node.status.capacity[EXT] == Quantity.parse("4")
+        assert node.status.allocatable[EXT] == Quantity.parse("4")
+        expected_mem = only_override.allocatable()["memory"].milli - Quantity.parse("1Gi").milli
+        assert node.status.allocatable["memory"].milli == expected_mem
+
+
+class TestFullControlPlane:
+    def test_override_pod_provisions_launches_and_binds(self):
+        # the whole slice: provisioner packs the pod against the override
+        # group, the KWOK launch seeds the node's vectors with the claim's
+        # requests and the chosen offering's overrides
+        # (kwok/cloudprovider.go:231-232 lo.Assign semantics), the pod binds
+        # on the FIRST claim — no runaway relaunches
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+
+        env = Environment(options=Options(), instance_types=[override_capable()])
+        env.store.create(make_nodepool())
+        env.store.create(ext_pod(name="want-ext"))
+        env.settle(rounds=6)
+        cur = env.store.get("Pod", "want-ext", namespace="default")
+        assert cur.spec.node_name, f"{env.store.count('NodeClaim')} claims, pod unbound"
+        node = env.store.get("Node", cur.spec.node_name)
+        assert node.status.allocatable.get(EXT) is not None
+        assert env.store.count("NodeClaim") == 1
+
+
+class TestComputeAllocatable:
+    def test_hugepages_reduce_memory(self):
+        # types.go:283-294 — hugepage reservations come out of memory
+        it = _it("huge", [_offering()], memory="8Gi", capacity_extra={"hugepages-2Mi": "2Gi"})
+        alloc = it.allocatable()
+        assert alloc["memory"].milli == Quantity.parse("6Gi").milli
+
+    def test_overhead_override_merges_not_replaces(self):
+        it = _it("ovh", [_offering()])
+        out = it.compute_allocatable(
+            overhead_override=InstanceTypeOverhead(system_reserved=parse_resource_list({"memory": "1Gi"}))
+        )
+        # cpu untouched, memory down 1Gi
+        assert out["cpu"] == it.allocatable()["cpu"]
+        assert out["memory"].milli == it.allocatable()["memory"].milli - Quantity.parse("1Gi").milli
+
+
+class TestHostSchedulerPath:
+    def test_only_override_capable_selected_for_override_resource(self):
+        # suite_test.go:5522 — pod requesting the extended resource must land
+        # on the override-capable type and exclude the normal one
+        types = [override_capable(), _it("normal", [_offering(), _offering(zone="test-zone-b")])]
+        env = build_env(node_pools=[make_nodepool(requirements=LINUX_AMD64)], types=types)
+        s = make_scheduler(*env)
+        results = s.solve([ext_pod()])
+        assert results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 1
+        names = {it.name for it in results.new_node_claims[0].instance_type_options}
+        assert "override-capable" in names
+        assert "normal" not in names
+
+    def test_unavailable_override_offerings_reject_instance_type(self):
+        # suite_test.go:5566 — the override allocatable fits but all its
+        # offerings are unavailable: no NodeClaim may launch
+        types = [override_capable(available=False)]
+        env = build_env(node_pools=[make_nodepool(requirements=LINUX_AMD64)], types=types)
+        s = make_scheduler(*env)
+        results = s.solve([ext_pod()])
+        assert not results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 0
+
+    def test_base_workload_unaffected_by_override_groups(self):
+        types = [override_capable()]
+        env = build_env(node_pools=[make_nodepool(requirements=LINUX_AMD64)], types=types)
+        s = make_scheduler(*env)
+        results = s.solve([make_pod(cpu="1")])
+        assert results.all_pods_scheduled()
+
+    def test_shrinking_override_rejected_when_only_override_compatible(self):
+        # an IT whose ONLY spot offerings are override ones with a smaller
+        # allocatable must NOT pass on the base group's headroom
+        small_override = [
+            _offering(ct=wk.CAPACITY_TYPE_ON_DEMAND),  # base: on-demand only
+            _offering(
+                ct=wk.CAPACITY_TYPE_SPOT,
+                overhead_override=InstanceTypeOverhead(
+                    system_reserved=parse_resource_list({"memory": "7Gi"})
+                ),
+            ),
+        ]
+        types = [_it("shrinks-on-spot", small_override, memory="8Gi")]
+        np_spot = make_nodepool(requirements=LINUX_AMD64 + [
+            {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_SPOT]},
+        ])
+        env = build_env(node_pools=[np_spot], types=types)
+        s = make_scheduler(*env)
+        # 4Gi fits base (8Gi) but not the spot override group (1Gi)
+        results = s.solve([make_pod(cpu="100m", memory="4Gi")])
+        assert not results.all_pods_scheduled()
+
+
+class TestTensorPath:
+    def _solve_tpu(self, types, pods, node_pools=None):
+        from karpenter_tpu.solver.snapshot import SolverSnapshot
+        from karpenter_tpu.solver.tpu import TPUSolver
+
+        env = build_env(node_pools=node_pools or [make_nodepool(requirements=LINUX_AMD64)], types=types)
+        store, clock, cluster, pools, _ = env
+        snap = SolverSnapshot(
+            store=store,
+            cluster=cluster,
+            node_pools=pools,
+            instance_types={np.metadata.name: types for np in pools},
+            state_nodes=cluster.nodes(),
+            daemonset_pods=[],
+            pods=pods,
+            clock=clock,
+        )
+        solver = TPUSolver(force=True)
+        return solver.solve(snap)
+
+    def test_tensor_rows_use_override_allocatable(self):
+        types = [override_capable(), _it("normal", [_offering(), _offering(zone="test-zone-b")])]
+        results = self._solve_tpu(types, [ext_pod()])
+        assert results.all_pods_scheduled()
+        assert len(results.new_node_claims) == 1
+        names = {it.name for it in results.new_node_claims[0].instance_type_options}
+        assert "override-capable" in names
+        assert "normal" not in names
+
+    def test_tensor_unavailable_override_no_launch(self):
+        types = [override_capable(available=False)]
+        results = self._solve_tpu(types, [ext_pod()])
+        assert not results.all_pods_scheduled()
+
+    def test_tensor_parity_with_host_for_mixed_workload(self):
+        types = [override_capable(), _it("normal", [_offering(), _offering(zone="test-zone-b")])]
+        pods = [ext_pod(name=f"p{i}") for i in range(3)]
+        pods += [make_pod(name=f"q{i}", cpu="500m") for i in range(3)]
+        tpu_results = self._solve_tpu(types, pods)
+        env = build_env(node_pools=[make_nodepool(requirements=LINUX_AMD64)], types=types)
+        host_results = make_scheduler(*env).solve(pods)
+        assert tpu_results.all_pods_scheduled() == host_results.all_pods_scheduled() is True
+        # every claim holding an EXT pod launches only override-capable types
+        for res in (tpu_results, host_results):
+            for nc in res.new_node_claims:
+                if any(EXT in _requests_of(p) for p in nc.pods):
+                    assert all(it.name == "override-capable" for it in nc.instance_type_options)

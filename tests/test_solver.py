@@ -831,8 +831,8 @@ class TestDecodeLaunchability:
         original = TPUSolver._template_ctx
 
         def broken_ctx(template, groups, enc, cache):
-            its, alloc, ginfo = original(template, groups, enc, cache)
-            return its, np.zeros_like(alloc), ginfo
+            its, alloc, ginfo, ov_groups = original(template, groups, enc, cache)
+            return its, np.zeros_like(alloc), ginfo, ov_groups
 
         monkeypatch.setattr(TPUSolver, "_template_ctx", staticmethod(broken_ctx))
         pods = [make_pod(cpu="1") for _ in range(4)]
@@ -853,8 +853,8 @@ class TestDecodeLaunchability:
         original = TPUSolver._template_ctx
 
         def broken_ctx(template, groups, enc, cache):
-            its, alloc, ginfo = original(template, groups, enc, cache)
-            return its, np.zeros_like(alloc), ginfo
+            its, alloc, ginfo, ov_groups = original(template, groups, enc, cache)
+            return its, np.zeros_like(alloc), ginfo, ov_groups
 
         monkeypatch.setattr(TPUSolver, "_template_ctx", staticmethod(broken_ctx))
         # also make every offering unavailable post-encode so the packed-row
