@@ -131,6 +131,7 @@ class Provisioner:
 
                 self.metrics.gauge(m.SCHEDULER_QUEUE_DEPTH).set(0)
                 self.metrics.gauge(m.SCHEDULER_UNSCHEDULABLE_PODS).set(0)
+                self.metrics.gauge(m.SCHEDULER_PENDING_PODS_BY_EFFECTIVE_ZONE).reset()
             return Results()
         snapshot = self.make_snapshot(pods)
         if not snapshot.node_pools:
@@ -152,6 +153,14 @@ class Provisioner:
         virtual_keys = {p.key() for p in pods if is_virtual_pod(p)}
         real_errors = {k: v for k, v in results.pod_errors.items() if k not in virtual_keys}
         self.metrics.gauge(m.SCHEDULER_UNSCHEDULABLE_PODS).set(len(real_errors))
+        # effective-zone demand gauge (scheduler.go:450,495-501): stale zone
+        # labels are dropped each solve, then the batch's counts published; a
+        # backend that does not compute the counts (TPU decode) clears the
+        # gauge too, so it never reports a previous batch
+        g = self.metrics.gauge(m.SCHEDULER_PENDING_PODS_BY_EFFECTIVE_ZONE)
+        g.reset()
+        for zone, count in (results.pending_pods_by_effective_zone or {}).items():
+            g.set(count, zone=zone)
         return results
 
     def make_snapshot(self, pods: list, state_nodes=None, exclude_deleting: bool = True) -> SolverSnapshot:

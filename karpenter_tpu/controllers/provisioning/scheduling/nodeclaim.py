@@ -504,7 +504,11 @@ def _fits_and_offering(it: InstanceType, requests: dict[str, Quantity], requirem
     capacity/overhead overrides form groups with their OWN allocatable, so an
     instance type fits iff some group both fits the requests and holds a
     compatible offering (nodeclaim.go:624-640 fits +
-    types.go:202-257 AllocatableOfferingsList)."""
+    types.go:202-257 AllocatableOfferingsList). Deliberately
+    reference-exact: fits=False even when resources fit but no group holds a
+    compatible offering — the reference's error for that case likewise merges
+    both criteria ("no instance type had enough resources or had a required
+    offering", nodeclaim.go:505-507)."""
     has_offering = False
     for alloc, offerings in it.allocatable_offerings_list():
         resource_fit = res.fits(requests, alloc)

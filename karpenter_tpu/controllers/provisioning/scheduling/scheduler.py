@@ -13,7 +13,7 @@ import math
 from dataclasses import dataclass, field
 
 from ....apis import labels as wk
-from ....scheduling.requirements import Requirements
+from ....scheduling.requirements import Operator, Requirement, Requirements
 from ....scheduling.taints import pools_taint_prefer_no_schedule, taints_tolerate_pod
 from ....utils import resources as res
 from ....utils.quantity import Quantity
@@ -49,6 +49,9 @@ class Results:
     existing_nodes: list[ExistingNode] = field(default_factory=list)
     pod_errors: dict = field(default_factory=dict)  # pod key -> error string
     timed_out: bool = False
+    # effective-zone label -> pending pod count (scheduler.go:453-459,495-501);
+    # None = the producing backend did not compute it
+    pending_pods_by_effective_zone: dict | None = None
 
     def all_pods_scheduled(self) -> bool:
         return not self.pod_errors
@@ -178,13 +181,53 @@ class Scheduler:
             self.remaining_resources[pool] = res.subtract(self.remaining_resources[pool], sn.capacity())
 
     # -- the solve loop (scheduler.go:440-494) ---------------------------------
+    def compute_effective_zone_from_pod(self, pod) -> str:
+        """The pod's effective zone constraint: the intersection of its
+        node-selector zone signals, volume zone requirements, and zone
+        topology-spread valid domains — a concrete zone name when exactly one
+        survives, "flexible" for several, "none" for an empty intersection
+        (scheduler.go:860-908 computeEffectiveZoneFromPod)."""
+        pod_data = self.cached_pod_data[pod.metadata.uid]
+        tsc_zones, satisfiable = self.topology.get_topology_zone_constraints(pod, pod_data.requirements)
+        if not satisfiable:
+            return "none"
+        zone_req = pod_data.strict_requirements.get(wk.ZONE_LABEL_KEY)
+        vol_zone_req = _volume_zone_req(pod_data.volume_requirements)
+        if zone_req.operator() == Operator.IN:
+            zonal_values = zone_req.values_list()
+        elif vol_zone_req is not None:
+            zonal_values = vol_zone_req.values_list()
+        elif tsc_zones is not None:
+            zonal_values = sorted(tsc_zones)
+        else:
+            return "flexible"
+        matched = [
+            z
+            for z in zonal_values
+            if zone_req.has(z)
+            and (vol_zone_req is None or vol_zone_req.has(z))
+            and (tsc_zones is None or z in tsc_zones)
+        ]
+        if len(matched) == 1:
+            return matched[0]
+        return "flexible" if len(matched) > 1 else "none"
+
     def solve(self, pods: list) -> Results:
         import copy
 
         pod_errors: dict[str, tuple] = {}  # uid -> (pod, error)
         self.topology.prepare(pods)
+        from ....apis.capacitybuffer import is_virtual_pod
+
+        pods_by_zone: dict[str, int] = {}
         for p in pods:
             self._update_cached_pod_data(p)
+            # buffer virtual pods are headroom, not demand — the reference's
+            # count excludes them via the phase guard (virtual pods carry no
+            # phase there, buffers.go:140-148; scheduler.go:455-459)
+            if p.status.phase in ("", "Pending") and not is_virtual_pod(p):
+                zone = self.compute_effective_zone_from_pod(p)
+                pods_by_zone[zone] = pods_by_zone.get(zone, 0) + 1
 
         q = Queue(pods, self.cached_pod_data)
         start = self.clock.now()
@@ -218,6 +261,7 @@ class Scheduler:
             existing_nodes=list(self.existing_nodes),
             pod_errors={p.key(): e for p, e in pod_errors.values()},
             timed_out=timed_out,
+            pending_pods_by_effective_zone=pods_by_zone,
         )
 
     def _update_cached_pod_data(self, pod) -> None:
@@ -323,6 +367,32 @@ class Scheduler:
                 self.remaining_resources[t.nodepool_name] = _subtract_max(remaining, nc.instance_type_options)
             return None
         return "; ".join(errs) if errs else "no nodepool matched pod"
+
+
+def _volume_zone_req(volume_reqs: list) -> Requirement | None:
+    """Union of zone constraints across the pod's volume requirement
+    alternatives, or None when volumes don't constrain zones — any
+    unconstrained alternative unconstrains the whole pod
+    (scheduler.go:910-936 volumeZoneReq)."""
+    if not volume_reqs:
+        return None
+    merged: Requirement | None = None
+    for vol in volume_reqs:
+        if vol is None:
+            return None
+        req = vol.get(wk.ZONE_LABEL_KEY)
+        if req.operator() != Operator.IN:
+            return None
+        if len(volume_reqs) == 1:
+            return req
+        if merged is None:
+            merged = Requirement(wk.ZONE_LABEL_KEY, Operator.IN, list(req.values_list()))
+        else:
+            merged = Requirement(
+                wk.ZONE_LABEL_KEY, Operator.IN,
+                sorted(set(merged.values_list()) | set(req.values_list())),
+            )
+    return merged
 
 
 def _template_compatible(template: NodeClaimTemplate, it) -> bool:

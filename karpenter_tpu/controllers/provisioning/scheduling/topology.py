@@ -425,6 +425,25 @@ class Topology:
         for p in pods:
             self.update(p)
 
+    def get_topology_zone_constraints(self, pod, pod_requirements: Requirements) -> tuple[set | None, bool]:
+        """Valid zones intersected across every zone-keyed topology group
+        owning the pod, plus whether they are satisfiable; None means no zone
+        topology constrains the pod (topology.go:250-281
+        GetTopologyZoneConstraints)."""
+        result: set | None = None
+        for tg in self.topology_groups.values():
+            if not tg.is_owned_by(pod.metadata.uid) or tg.key != wk.ZONE_LABEL_KEY:
+                continue
+            pod_domains = Requirement(tg.key, Operator.EXISTS)
+            if pod_requirements.has(tg.key):
+                pod_domains = pod_requirements.get(tg.key)
+            node_domains = Requirement(tg.key, Operator.EXISTS)
+            _, valid = tg.get(pod, pod_domains, node_domains)
+            if not valid:
+                return None, False
+            result = set(valid) if result is None else result & valid
+        return result, True
+
     # -- construction ----------------------------------------------------------
     @staticmethod
     def _build_domain_groups(node_pools, instance_types: dict[str, list]) -> dict[str, TopologyDomainGroup]:

@@ -29,6 +29,7 @@ SCHEDULER_QUEUE_DEPTH = "karpenter_scheduler_queue_depth"
 SCHEDULER_UNFINISHED_WORK = "karpenter_scheduler_unfinished_work_seconds"
 SCHEDULER_IGNORED_PODS = "karpenter_scheduler_ignored_pods_count"
 SCHEDULER_UNSCHEDULABLE_PODS = "karpenter_scheduler_unschedulable_pods_count"
+SCHEDULER_PENDING_PODS_BY_EFFECTIVE_ZONE = "karpenter_scheduler_pending_pods_by_effective_zone_count"
 
 DISRUPTION_DECISIONS_TOTAL = "karpenter_voluntary_disruption_decisions_total"
 DISRUPTION_ELIGIBLE_NODES = "karpenter_voluntary_disruption_eligible_nodes"
@@ -79,6 +80,11 @@ def make_registry() -> Registry:
     r.gauge(SCHEDULER_UNFINISHED_WORK, "Seconds the in-flight solve has been running", ())
     r.gauge(SCHEDULER_IGNORED_PODS, "Pods ignored by the scheduler", ())
     r.gauge(SCHEDULER_UNSCHEDULABLE_PODS, "Pods the last solve could not place", ())
+    r.gauge(
+        SCHEDULER_PENDING_PODS_BY_EFFECTIVE_ZONE,
+        "Pending pods by effective zone constraint (a zone name, 'flexible', or 'none')",
+        ("zone",),
+    )
     r.counter(DISRUPTION_DECISIONS_TOTAL, "Disruption decisions", ("decision", "method", "consolidation_type"))
     r.gauge(DISRUPTION_ELIGIBLE_NODES, "Nodes eligible for disruption", ("method", "consolidation_type"))
     r.counter(DISRUPTION_CONSOLIDATION_TIMEOUTS_TOTAL, "Consolidation probes aborted on timeout", ("consolidation_type",))

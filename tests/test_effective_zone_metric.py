@@ -1,0 +1,191 @@
+"""Pending-pods-by-effective-zone metric: each pending pod's zone signals
+(node selectors, volume zone requirements, zone topology-spread valid
+domains) intersect to a concrete zone, "flexible", or "none", published as
+karpenter_scheduler_pending_pods_by_effective_zone_count
+(scheduler.go:860-936 computeEffectiveZoneFromPod/volumeZoneReq +
+suite_test.go:4444-4540 "Pending Pods by Effective Zone Metric")."""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.controllers.provisioning.scheduling import Scheduler
+from karpenter_tpu.kube import ObjectMeta, PersistentVolumeClaim, StorageClass, Store
+from karpenter_tpu.kube.objects import TopologySpreadConstraint
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+CSI = "csi.test.io"
+
+
+def build_env():
+    store = Store()
+    clock = FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    np = make_nodepool(requirements=LINUX_AMD64)
+    store.create(np)
+    return store, clock, cluster, [np], catalog.construct_instance_types()
+
+
+def make_scheduler(store, clock, cluster, pools, types):
+    return Scheduler(store, cluster, pools, {p.metadata.name: types for p in pools}, cluster.nodes(), [], clock)
+
+
+def wffc_sc(store, name, zones):
+    store.create(
+        StorageClass(
+            metadata=ObjectMeta(name=name),
+            provisioner=CSI,
+            volume_binding_mode="WaitForFirstConsumer",
+            allowed_topologies=[
+                [{"key": wk.ZONE_LABEL_KEY, "values": [z]}] for z in zones
+            ],
+        )
+    )
+
+
+def pvc_pod(store, name="vol-pod", sc="zone-sc", node_selector=None):
+    store.create(PersistentVolumeClaim(metadata=ObjectMeta(name="pvc-name"), storage_class_name=sc))
+    p = make_pod(name=name, cpu="100m", node_selector=node_selector)
+    p.spec.volumes = [{"name": "v", "persistentVolumeClaim": {"claimName": "pvc-name"}}]
+    return p
+
+
+class TestVolumeConstraints:
+    """suite_test.go:4453-4496 DescribeTable 'volume constraints'."""
+
+    def test_pvc_multi_zone_is_flexible(self):
+        # PVC does not restrict the pod to a single zone → "flexible"
+        store, clock, cluster, pools, types = build_env()
+        wffc_sc(store, "zone-sc", ["test-zone-a", "test-zone-b"])
+        pod = pvc_pod(store)
+        r = make_scheduler(store, clock, cluster, pools, types).solve([pod])
+        assert r.pending_pods_by_effective_zone == {"flexible": 1}
+
+    def test_pvc_single_zone_pins(self):
+        # PVC restricts the pod to one zone → that zone
+        store, clock, cluster, pools, types = build_env()
+        wffc_sc(store, "zone-sc", ["test-zone-a"])
+        pod = pvc_pod(store)
+        r = make_scheduler(store, clock, cluster, pools, types).solve([pod])
+        assert r.pending_pods_by_effective_zone == {"test-zone-a": 1}
+
+    def test_pvc_zone_conflicts_with_selector_none(self):
+        # PVC allows only zone-b while the selector pins zone-a → "none"
+        store, clock, cluster, pools, types = build_env()
+        wffc_sc(store, "zone-sc", ["test-zone-b"])
+        pod = pvc_pod(store, node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"})
+        r = make_scheduler(store, clock, cluster, pools, types).solve([pod])
+        assert r.pending_pods_by_effective_zone == {"none": 1}
+
+
+class TestZoneOfPods:
+    """suite_test.go:4497-4540 DescribeTable 'zone of pods'."""
+
+    def test_unconstrained_pod_is_flexible(self):
+        store, clock, cluster, pools, types = build_env()
+        r = make_scheduler(store, clock, cluster, pools, types).solve([make_pod(cpu="100m")])
+        assert r.pending_pods_by_effective_zone == {"flexible": 1}
+
+    def test_zone_selector_pins(self):
+        store, clock, cluster, pools, types = build_env()
+        pod = make_pod(cpu="100m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"})
+        r = make_scheduler(store, clock, cluster, pools, types).solve([pod])
+        assert r.pending_pods_by_effective_zone == {"test-zone-b": 1}
+
+    def test_mixed_batch_counts_by_zone(self):
+        store, clock, cluster, pools, types = build_env()
+        pods = [
+            make_pod(name="a1", cpu="100m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"}),
+            make_pod(name="a2", cpu="100m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"}),
+            make_pod(name="b1", cpu="100m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-b"}),
+            make_pod(name="free", cpu="100m"),
+        ]
+        r = make_scheduler(store, clock, cluster, pools, types).solve(pods)
+        assert r.pending_pods_by_effective_zone == {"test-zone-a": 2, "test-zone-b": 1, "flexible": 1}
+
+    def test_multi_zone_selector_is_flexible(self):
+        store, clock, cluster, pools, types = build_env()
+        pod = make_pod(cpu="100m", required_affinity=[[
+            {"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]},
+        ]])
+        r = make_scheduler(store, clock, cluster, pools, types).solve([pod])
+        assert r.pending_pods_by_effective_zone == {"flexible": 1}
+
+    def test_tsc_alone_stays_flexible_when_all_zones_valid(self):
+        store, clock, cluster, pools, types = build_env()
+        pod = make_pod(cpu="100m", labels={"app": "x"}, tsc=[TopologySpreadConstraint(
+            topology_key=wk.ZONE_LABEL_KEY,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector={"matchLabels": {"app": "x"}},
+            max_skew=1,
+        )])
+        r = make_scheduler(store, clock, cluster, pools, types).solve([pod])
+        assert r.pending_pods_by_effective_zone == {"flexible": 1}
+
+    def test_selector_and_volume_intersect_to_one_zone(self):
+        # selector allows a+b, PVC allows b+c → exactly b survives
+        store, clock, cluster, pools, types = build_env()
+        wffc_sc(store, "zone-sc", ["test-zone-b", "test-zone-c"])
+        pod = pvc_pod(store)
+        pod.spec.affinity = make_pod(cpu="100m", required_affinity=[[
+            {"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-a", "test-zone-b"]},
+        ]]).spec.affinity
+        r = make_scheduler(store, clock, cluster, pools, types).solve([pod])
+        assert r.pending_pods_by_effective_zone == {"test-zone-b": 1}
+
+
+class TestVirtualPodsExcluded:
+    def test_buffer_virtual_pods_not_counted(self):
+        # the reference's phase guard excludes virtual buffer pods from the
+        # count (buffers.go:140-148 set no phase); headroom is not demand
+        from karpenter_tpu.apis.capacitybuffer import FAKE_POD_ANNOTATION_KEY, FAKE_POD_ANNOTATION_VALUE
+
+        store, clock, cluster, pools, types = build_env()
+        virtual = make_pod(name="virt", cpu="100m",
+                           annotations={FAKE_POD_ANNOTATION_KEY: FAKE_POD_ANNOTATION_VALUE})
+        real = make_pod(name="real", cpu="100m")
+        r = make_scheduler(store, clock, cluster, pools, types).solve([virtual, real])
+        assert r.pending_pods_by_effective_zone == {"flexible": 1}
+
+
+class TestGaugePublication:
+    def test_gauge_published_through_provisioner(self):
+        # a pod pinned to an unoffered zone stays pending, so the gauge
+        # reports its effective zone on every solve; once the pod is deleted
+        # the empty batch clears the gauge (no stale labels)
+        from karpenter_tpu import metrics as m
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+
+        env = Environment(options=Options())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        stuck = make_pod(name="stuck", cpu="100m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-nowhere"})
+        env.store.create(stuck)
+        env.settle(rounds=3)
+        g = env.registry.gauge(m.SCHEDULER_PENDING_PODS_BY_EFFECTIVE_ZONE)
+        assert g.value(zone="test-zone-nowhere") == 1.0
+        env.store.delete("Pod", "stuck", namespace="default")
+        env.settle(rounds=3)
+        assert g.value(zone="test-zone-nowhere") == 0.0
+
+    def test_gauge_cleared_after_pods_bind(self):
+        # a schedulable pod binds during settle; the final (empty) solve must
+        # leave no stale per-zone counts behind
+        from karpenter_tpu import metrics as m
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+
+        env = Environment(options=Options())
+        env.store.create(make_nodepool(requirements=LINUX_AMD64))
+        env.store.create(make_pod(name="pinned", cpu="100m", node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"}))
+        env.settle(rounds=3)
+        cur = env.store.get("Pod", "pinned", namespace="default")
+        assert cur.spec.node_name  # bound
+        g = env.registry.gauge(m.SCHEDULER_PENDING_PODS_BY_EFFECTIVE_ZONE)
+        assert g.value(zone="test-zone-a") == 0.0
