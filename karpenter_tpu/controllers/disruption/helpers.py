@@ -35,6 +35,7 @@ def simulate_scheduling(provisioner, cluster, candidates: list, clock):
     # consolidation must not fall back into reserved capacity it failed to
     # reserve (consolidation.go:45 DisableReservedCapacityFallback)
     snapshot.reserved_offering_mode = "strict"
+    snapshot.collect_zone_metrics = False
     results = provisioner.solver.solve(snapshot)
     # prune claims that ended up empty
     results.new_node_claims = [nc for nc in results.new_node_claims if nc.pods]

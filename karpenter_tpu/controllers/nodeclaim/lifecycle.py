@@ -138,11 +138,15 @@ class LifecycleController:
         startup = {(t.key, t.effect) for t in nc.spec.startup_taints}
         if any((t.key, t.effect) in startup for t in node.spec.taints):
             return False
-        # all claim-known resources must be registered on the node
-        for name, q in nc.status.allocatable.items():
-            if name == "pods":
+        # every non-zero requested resource must be REGISTERED on the node:
+        # kubelet zeroes extended resources at startup, so a zero allocatable
+        # for a requested resource means the device plugin hasn't published
+        # yet (initialization.go:131-146 RequestedResourcesRegistered)
+        for name, q in nc.spec.resources.items():
+            if name == "pods" or q.milli == 0:
                 continue
-            if node.status.allocatable.get(name) is None:
+            have = node.status.allocatable.get(name)
+            if have is None or have.milli == 0:
                 return False
 
         def apply(n):

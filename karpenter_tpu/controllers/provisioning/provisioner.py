@@ -135,6 +135,12 @@ class Provisioner:
             return Results()
         snapshot = self.make_snapshot(pods)
         if not snapshot.node_pools:
+            if self.metrics is not None:
+                from ... import metrics as m
+
+                # no solve runs, so the per-zone gauge would otherwise keep
+                # reporting the previous batch forever
+                self.metrics.gauge(m.SCHEDULER_PENDING_PODS_BY_EFFECTIVE_ZONE).reset()
             return Results(pod_errors={p.key(): "no ready nodepools" for p in pods})
         if self.metrics is None:
             return self.solver.solve(snapshot)

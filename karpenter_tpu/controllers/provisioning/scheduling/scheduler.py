@@ -88,11 +88,13 @@ class Scheduler:
         dra_enabled: bool = False,
         reserved_capacity_enabled: bool = True,
         reserved_offering_mode: str = "fallback",
+        collect_zone_metrics: bool = True,
     ):
         self.store = store
         self.cluster = cluster
         self.clock = clock
         self.preference_policy = preference_policy
+        self.collect_zone_metrics = collect_zone_metrics
         self.min_values_policy = min_values_policy
         self.deleting_node_names = deleting_node_names or set()
         self.timeout_seconds = timeout_seconds
@@ -219,13 +221,20 @@ class Scheduler:
         self.topology.prepare(pods)
         from ....apis.capacitybuffer import is_virtual_pod
 
-        pods_by_zone: dict[str, int] = {}
+        pods_by_zone: dict[str, int] | None = None
+        if self.collect_zone_metrics:
+            pods_by_zone = {}
         for p in pods:
             self._update_cached_pod_data(p)
             # buffer virtual pods are headroom, not demand — the reference's
             # count excludes them via the phase guard (virtual pods carry no
-            # phase there, buffers.go:140-148; scheduler.go:455-459)
-            if p.status.phase in ("", "Pending") and not is_virtual_pod(p):
+            # phase there, buffers.go:140-148; scheduler.go:455-459);
+            # consolidation simulations skip the computation entirely
+            if (
+                pods_by_zone is not None
+                and p.status.phase in ("", "Pending")
+                and not is_virtual_pod(p)
+            ):
                 zone = self.compute_effective_zone_from_pod(p)
                 pods_by_zone[zone] = pods_by_zone.get(zone, 0) + 1
 
@@ -372,27 +381,18 @@ class Scheduler:
 def _volume_zone_req(volume_reqs: list) -> Requirement | None:
     """Union of zone constraints across the pod's volume requirement
     alternatives, or None when volumes don't constrain zones — any
-    unconstrained alternative unconstrains the whole pod
-    (scheduler.go:910-936 volumeZoneReq)."""
+    zone-unconstrained alternative (operator != In, since
+    VolumeTopology.get_requirements normalizes alternatives to Requirements)
+    unconstrains the whole pod (scheduler.go:910-936 volumeZoneReq)."""
     if not volume_reqs:
         return None
-    merged: Requirement | None = None
+    values: set[str] = set()
     for vol in volume_reqs:
-        if vol is None:
-            return None
         req = vol.get(wk.ZONE_LABEL_KEY)
         if req.operator() != Operator.IN:
             return None
-        if len(volume_reqs) == 1:
-            return req
-        if merged is None:
-            merged = Requirement(wk.ZONE_LABEL_KEY, Operator.IN, list(req.values_list()))
-        else:
-            merged = Requirement(
-                wk.ZONE_LABEL_KEY, Operator.IN,
-                sorted(set(merged.values_list()) | set(req.values_list())),
-            )
-    return merged
+        values |= set(req.values_list())
+    return Requirement(wk.ZONE_LABEL_KEY, Operator.IN, sorted(values))
 
 
 def _template_compatible(template: NodeClaimTemplate, it) -> bool:

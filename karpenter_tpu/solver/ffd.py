@@ -25,5 +25,6 @@ class FFDSolver:
             dra_enabled=snap.dra_enabled,
             reserved_capacity_enabled=snap.reserved_capacity_enabled,
             reserved_offering_mode=snap.reserved_offering_mode,
+            collect_zone_metrics=snap.collect_zone_metrics,
         )
         return scheduler.solve(snap.pods)

@@ -26,3 +26,6 @@ class SolverSnapshot:
     dra_enabled: bool = False
     reserved_capacity_enabled: bool = True  # ReservedCapacity feature gate
     reserved_offering_mode: str = "fallback"  # strict for consolidation sims
+    # skip the effective-zone metric computation (consolidation simulations
+    # discard it; scheduler.go computes it only on the provisioner path)
+    collect_zone_metrics: bool = True

@@ -137,21 +137,34 @@ class StateNode:
     # -- resources -------------------------------------------------------------
     def capacity(self) -> dict[str, Quantity]:
         """Node capacity plus the synthetic nodes:1 resource used for
-        node-count limits (statenode.go:359-374)."""
-        if self.node is not None and self.registered() and self.node.status.capacity:
-            base = self.node.status.capacity
-        elif self.node_claim is not None and self.node_claim.status.capacity:
-            base = self.node_claim.status.capacity
-        else:
-            base = self.node.status.capacity if self.node is not None else {}
+        node-count limits. Until the node initializes, zero/absent node
+        values are overridden per resource by the claim's — kubelet zeroes
+        extended resources at startup (statenode.go:358-375)."""
+        base = self._merged_status_vec("capacity")
         return {**base, "nodes": Quantity.parse(1)}
 
     def allocatable(self) -> dict[str, Quantity]:
-        if self.node is not None and self.initialized() and self.node.status.allocatable:
-            return self.node.status.allocatable
-        if self.node_claim is not None and self.node_claim.status.allocatable:
-            return self.node_claim.status.allocatable
-        return self.node.status.allocatable if self.node is not None else {}
+        """statenode.go:377-392 Allocatable: same per-resource zero-override
+        merge as capacity()."""
+        return self._merged_status_vec("allocatable")
+
+    def _merged_status_vec(self, field: str) -> dict[str, Quantity]:
+        node_vec = getattr(self.node.status, field) if self.node is not None else None
+        claim_vec = getattr(self.node_claim.status, field) if self.node_claim is not None else None
+        # a claim whose Node object is gone (terminating window,
+        # cluster.delete_node) still reports the claim's numbers regardless
+        # of the Initialized condition — the reference's initialized() is
+        # false there because it reads a NODE label (statenode.go:349-356)
+        if claim_vec is not None and (not self.initialized() or self.node is None):
+            if self.node is not None:
+                out = dict(node_vec or {})
+                for name, q in claim_vec.items():
+                    cur = out.get(name)
+                    if cur is None or cur.milli == 0:
+                        out[name] = q
+                return out
+            return claim_vec
+        return node_vec if node_vec is not None else {}
 
     def total_pod_requests(self) -> dict[str, Quantity]:
         # memoized: every consolidation simulation rebuilds an ExistingNode

@@ -1,0 +1,143 @@
+"""No Pre-Binding specs: provisioning must converge WITHOUT the binder ever
+assigning pods to nodes — in-flight capacity is reused through cluster state
+and nomination alone (suite_test.go:2785-2888 "No Pre-Binding"; pods stay
+unscheduled in the store the whole time)."""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.utils.quantity import Quantity
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def make_env(**kw):
+    env = Environment(options=Options(**kw))
+    env.store.create(make_nodepool(requirements=LINUX_AMD64))
+    return env
+
+
+def provision_no_bind(env, rounds=3):
+    """Provision → launch → register → initialize, but never run the binder
+    (ExpectProvisionedNoBinding, expectations.go:342)."""
+    for _ in range(rounds):
+        env.nodepool_hash.reconcile()
+        env.nodepool_readiness.reconcile()
+        env.provisioner.reconcile(force=True)
+        env.lifecycle.reconcile_all()
+        if hasattr(env.cloud_provider, "flush_pending"):
+            env.cloud_provider.flush_pending()
+        env.lifecycle.reconcile_all()
+        env.clock.step(1.0)
+
+
+class TestNoPreBinding:
+    def test_should_not_bind_pods_to_nodes(self):
+        # suite_test.go:2786 — first pod launches one node; the second pod
+        # reuses it via cluster state without either pod ever binding
+        env = make_env()
+        env.store.create(make_pod(name="p1", cpu="10m"))
+        provision_no_bind(env)
+        assert env.store.count("Node") == 1
+        assert env.store.get("Pod", "p1", namespace="default").spec.node_name == ""
+
+        env.store.create(make_pod(name="p2", cpu="10m"))
+        provision_no_bind(env)
+        # no second node: both pending pods fit the in-flight node's capacity
+        assert env.store.count("Node") == 1
+        for name in ("p1", "p2"):
+            assert env.store.get("Pod", name, namespace="default").spec.node_name == ""
+
+    def test_kubelet_zeroing_of_extended_resources(self):
+        # suite_test.go:2818 (issue #1459) — the node registers with its
+        # extended resources zeroed out by kubelet; scheduling must keep
+        # using the claim's capacity until initialization, so the second
+        # GPU pod reuses the node instead of launching another
+        gpu_res = "vendor-a.com/gpu"
+        from karpenter_tpu.cloudprovider import catalog
+
+        base = catalog.construct_instance_types()[:10]
+        gpu_it = None
+        for it in base:
+            if it.capacity.get("cpu", Quantity(0)).milli >= 4000:
+                import copy as _copy
+
+                gpu_it = _copy.deepcopy(it)
+                gpu_it.name = "gpu-" + it.name
+                from karpenter_tpu.scheduling.requirements import Requirement
+
+                gpu_it.requirements.replace(Requirement(wk.INSTANCE_TYPE_LABEL_KEY, "In", [gpu_it.name]))
+                gpu_it.capacity[gpu_res] = Quantity.parse("2")
+                gpu_it._allocatable = None
+                gpu_it._alloc_groups = None
+                break
+        assert gpu_it is not None
+        env2 = Environment(options=Options(), instance_types=base + [gpu_it])
+        env2.store.create(make_nodepool(requirements=LINUX_AMD64))
+
+        # a registration delay holds the node back so the test can zero its
+        # resources the moment it appears — before any lifecycle pass sees it
+        nodeclass = env2.store.get("KWOKNodeClass", "default")
+        nodeclass.spec.node_registration_delay = 2.0
+        env2.store.update(nodeclass)
+
+        p1 = make_pod(name="g1", cpu="10m")
+        p1.spec.containers[0].resources["requests"][gpu_res] = Quantity.parse("1")
+        env2.store.create(p1)
+        env2.nodepool_hash.reconcile()
+        env2.nodepool_readiness.reconcile()
+        env2.provisioner.reconcile(force=True)
+        env2.lifecycle.reconcile_all()  # launch; node held by the delay
+        assert env2.store.count("Node") == 0
+        env2.clock.step(3.0)
+        env2.cloud_provider.flush_pending()  # node object created, unregistered
+        assert env2.store.count("Node") == 1
+        node = env2.store.list("Node")[0]
+
+        def zero(n):
+            n.status.capacity = {**n.status.capacity, gpu_res: Quantity(0)}
+            n.status.allocatable = {**n.status.allocatable, gpu_res: Quantity(0)}
+
+        env2.store.patch("Node", node.metadata.name, zero)
+        env2.lifecycle.reconcile_all()  # registers; init must WAIT on the GPU
+        nc = env2.store.list("NodeClaim")[0]
+        assert nc.is_registered() and not nc.is_initialized()
+
+        p2 = make_pod(name="g2", cpu="10m")
+        p2.spec.containers[0].resources["requests"][gpu_res] = Quantity.parse("1")
+        env2.store.create(p2)
+        provision_no_bind(env2, rounds=2)
+        # the uninitialized node's zeroed GPU falls back to the claim's
+        # capacity (statenode.go:358-392), so the pod fits the same node
+        assert env2.store.count("Node") == 1
+
+    def test_self_pod_affinity_zone_without_binding(self):
+        # suite_test.go:2861 (issue #1975) — two pods with zone self-affinity:
+        # the second must fulfill affinity against the IN-FLIGHT node's
+        # domain (unbound pods), landing on one node total
+        from karpenter_tpu.kube.objects import PodAffinityTerm
+
+        env = make_env()
+        labels = {"security": "s2"}
+        pods = [
+            make_pod(
+                name=f"aff-{i}",
+                cpu="10m",
+                labels=labels,
+                pod_affinity=[PodAffinityTerm(
+                    label_selector={"matchLabels": labels},
+                    topology_key=wk.ZONE_LABEL_KEY,
+                )],
+            )
+            for i in range(2)
+        ]
+        env.store.create(pods[0])
+        provision_no_bind(env)
+        n1 = env.store.count("Node")
+        env.store.create(pods[1])
+        provision_no_bind(env)
+        assert env.store.count("Node") == n1 == 1
