@@ -134,12 +134,16 @@ class Provisioner:
                 self.metrics.gauge(m.SCHEDULER_PENDING_PODS_BY_EFFECTIVE_ZONE).reset()
             return Results()
         snapshot = self.make_snapshot(pods)
+        # computing effective zones is pointless when nobody publishes them
+        snapshot.collect_zone_metrics = self.metrics is not None
         if not snapshot.node_pools:
             if self.metrics is not None:
                 from ... import metrics as m
 
-                # no solve runs, so the per-zone gauge would otherwise keep
-                # reporting the previous batch forever
+                # no solve runs, so every solve-scoped gauge would otherwise
+                # keep reporting the previous batch forever
+                self.metrics.gauge(m.SCHEDULER_QUEUE_DEPTH).set(len(pods))
+                self.metrics.gauge(m.SCHEDULER_UNSCHEDULABLE_PODS).set(len(pods))
                 self.metrics.gauge(m.SCHEDULER_PENDING_PODS_BY_EFFECTIVE_ZONE).reset()
             return Results(pod_errors={p.key(): "no ready nodepools" for p in pods})
         if self.metrics is None:

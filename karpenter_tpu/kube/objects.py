@@ -257,6 +257,10 @@ class PersistentVolumeClaim:
 class PersistentVolume:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     csi_driver: str = ""  # spec.csi.driver ("" = non-CSI)
+    # legacy in-tree volume source plugin name (e.g. "kubernetes.io/aws-ebs"
+    # for spec.awsElasticBlockStore); CSI-migrated for limit tracking
+    # (volumeusage.go:169-181 driverFromVolume)
+    in_tree_source: str = ""
     # spec.nodeAffinity.required.nodeSelectorTerms: OR'd terms, each a list of
     # AND'd {key, operator, values} dicts
     node_affinity_required: list[list[dict]] = field(default_factory=list)

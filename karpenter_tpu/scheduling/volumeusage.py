@@ -86,14 +86,38 @@ def effective_storage_class_name(store, pvc) -> str | None:
     return name
 
 
+# CSI migration: legacy in-tree plugin names resolve to their CSI driver so
+# limit tracking keys on one name regardless of which API surface declared
+# the volume (csi-translation-lib GetCSINameFromInTreeName, used at
+# volumeusage.go:163)
+IN_TREE_TO_CSI = {
+    "kubernetes.io/aws-ebs": "ebs.csi.aws.com",
+    "kubernetes.io/gce-pd": "pd.csi.storage.gke.io",
+    "kubernetes.io/azure-disk": "disk.csi.azure.com",
+    "kubernetes.io/azure-file": "file.csi.azure.com",
+    "kubernetes.io/cinder": "cinder.csi.openstack.org",
+    "kubernetes.io/vsphere-volume": "csi.vsphere.vmware.com",
+    "kubernetes.io/portworx-volume": "pxd.portworx.com",
+}
+
+
+def csi_driver_name(provisioner: str) -> str:
+    """CSI-migrate a legacy in-tree plugin name; non-in-tree names pass
+    through unchanged (csi-translation-lib GetCSINameFromInTreeName)."""
+    return IN_TREE_TO_CSI.get(provisioner, provisioner)
+
+
 def resolve_driver(store, pvc, storage_class_name: str | None = None) -> str:
-    """Storage driver name for a PVC: bound PV's CSI driver first, else the
-    StorageClass provisioner (volumeusage.go:116-154). "" = untracked."""
+    """Storage driver name for a PVC: bound PV's CSI driver first (with
+    in-tree sources CSI-migrated), else the StorageClass provisioner
+    (migrated too) (volumeusage.go:116-181). "" = untracked."""
     if pvc.volume_name:
         pv = store.try_get("PersistentVolume", pvc.volume_name)
-        if pv is None or not pv.csi_driver:
+        if pv is None:
             return ""
-        return pv.csi_driver
+        if pv.csi_driver:
+            return pv.csi_driver
+        return IN_TREE_TO_CSI.get(pv.in_tree_source, "")
     if storage_class_name is None:
         storage_class_name = effective_storage_class_name(store, pvc)
     if not storage_class_name:
@@ -101,7 +125,7 @@ def resolve_driver(store, pvc, storage_class_name: str | None = None) -> str:
     sc = store.try_get("StorageClass", storage_class_name)
     if sc is None:
         return ""
-    return sc.provisioner
+    return csi_driver_name(sc.provisioner)
 
 
 def get_volumes(store, pod) -> Volumes:

@@ -33,7 +33,7 @@ from dataclasses import dataclass, field
 
 from ..apis import labels as wk
 from ..scheduling.requirements import Requirements
-from ..scheduling.volumeusage import effective_storage_class_name
+from ..scheduling.volumeusage import IN_TREE_TO_CSI, csi_driver_name as _csi_name, effective_storage_class_name
 
 CSI_AXIS_PREFIX = "csi-att:"
 CSI_AXIS_BIG = 1e9  # "no limit" capacity on the scaled resource axis
@@ -129,12 +129,12 @@ class VolumeLowering:
             fp = ("sc", sc_name, sc.metadata.resource_version)
             terms = [t for t in sc.allowed_topologies if t]
             if len(terms) > 1:
-                out = (fp, None, sc.provisioner, "pvc multi-alternative topology")
+                out = (fp, None, _csi_name(sc.provisioner), "pvc multi-alternative topology")
             elif terms:
                 exprs = [{"key": e["key"], "operator": "In", "values": e.get("values", [])} for e in terms[0]]
-                out = (fp, Requirements.from_node_selector_terms(exprs), sc.provisioner, None)
+                out = (fp, Requirements.from_node_selector_terms(exprs), _csi_name(sc.provisioner), None)
             else:
-                out = (fp, None, sc.provisioner, None)
+                out = (fp, None, _csi_name(sc.provisioner), None)
         self._sc_alts[sc_name] = out
         return out
 
@@ -147,7 +147,7 @@ class VolumeLowering:
             out = (("pv", volume_name, -1), None, "", None)
         else:
             fp = ("pv", volume_name, pv.metadata.resource_version)
-            driver = pv.csi_driver or ""
+            driver = pv.csi_driver or IN_TREE_TO_CSI.get(pv.in_tree_source, "")
             terms = pv.node_affinity_required
             if pv.local or pv.host_path:
                 # hostname terms on local volumes never constrain replacements

@@ -342,3 +342,121 @@ class TestVolumeLimits:
         store.create(CSINode(metadata=ObjectMeta(name="n1"), drivers=[CSINodeDriver(name=CSI, allocatable_count=1)]))
         sn = cluster.node_for_name("n1")
         assert sn.volume_usage.exceeds_limits({CSI: {"default/a", "default/b"}}) is not None
+
+
+class TestCSIMigration:
+    """In-tree volume plugins resolve to their CSI driver names for limit
+    tracking (suite_test.go:3896-4058 "CSIMigration";
+    volumeusage.go:155-181 driverFromSC/driverFromVolume via
+    csi-translation-lib)."""
+
+    EBS_IN_TREE = "kubernetes.io/aws-ebs"
+    EBS_CSI = "ebs.csi.aws.com"
+
+    def _node_with_limit(self, store, limit=1):
+        store.create(CSINode(metadata=ObjectMeta(name="n1"), drivers=[CSINodeDriver(name=self.EBS_CSI, allocatable_count=limit)]))
+        store.create(
+            Node(
+                metadata=ObjectMeta(
+                    name="n1",
+                    labels={
+                        wk.NODEPOOL_LABEL_KEY: "default-pool",
+                        wk.HOSTNAME_LABEL_KEY: "n1",
+                        wk.ZONE_LABEL_KEY: "test-zone-a",
+                        wk.ARCH_LABEL_KEY: "amd64",
+                        wk.OS_LABEL_KEY: "linux",
+                    },
+                ),
+                spec=NodeSpec(provider_id="kwok://n1"),
+                status=NodeStatus(
+                    capacity=parse_resource_list({"cpu": "16", "memory": "32Gi", "pods": "110"}),
+                    allocatable=parse_resource_list({"cpu": "16", "memory": "32Gi", "pods": "110"}),
+                ),
+            )
+        )
+
+    def _in_tree_pvc(self, store, name, ns="default"):
+        """PVC bound to a legacy in-tree EBS PV (no spec.csi)."""
+        pv = PersistentVolume(metadata=ObjectMeta(name=f"pv-{name}"), in_tree_source=self.EBS_IN_TREE)
+        store.create(pv)
+        store.create(
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name=name, namespace=ns, annotations={BIND_COMPLETED_ANNOTATION: "yes"}),
+                volume_name=f"pv-{name}",
+            )
+        )
+
+    def test_resolve_driver_translates_in_tree_pv(self):
+        store, *_ = build_env()
+        self._in_tree_pvc(store, "legacy")
+        pvc = store.get("PersistentVolumeClaim", "legacy", namespace="default")
+        from karpenter_tpu.scheduling.volumeusage import resolve_driver
+
+        assert resolve_driver(store, pvc) == self.EBS_CSI
+
+    def test_resolve_driver_translates_in_tree_sc_provisioner(self):
+        store, *_ = build_env()
+        store.create(StorageClass(metadata=ObjectMeta(name="in-tree-sc"), provisioner=self.EBS_IN_TREE, volume_binding_mode="WaitForFirstConsumer"))
+        store.create(PersistentVolumeClaim(metadata=ObjectMeta(name="unbound"), storage_class_name="in-tree-sc"))
+        pvc = store.get("PersistentVolumeClaim", "unbound", namespace="default")
+        from karpenter_tpu.scheduling.volumeusage import resolve_driver
+
+        assert resolve_driver(store, pvc) == self.EBS_CSI
+
+    def test_migrated_pv_counts_against_csi_limit(self):
+        # suite_test.go:3897 — in-tree PVC/PV volumes count against the CSI
+        # driver's CSINode limit, so the second pod launches a new node
+        store, clock, cluster, pools, types = build_env()
+        self._in_tree_pvc(store, "c1")
+        self._in_tree_pvc(store, "c2")
+        self._node_with_limit(store, limit=1)
+        s = make_scheduler(store, clock, cluster, pools, types)
+        results = s.solve([pod_with_pvcs("c1", name="pod-1", cpu="100m"), pod_with_pvcs("c2", name="pod-2", cpu="100m")])
+        assert results.all_pods_scheduled()
+        assert results.node_pod_count().get("n1") == 1
+        assert len(results.new_node_claims) == 1
+
+    def test_migrated_sc_ephemeral_counts_against_csi_limit(self):
+        # suite_test.go:3958 — ephemeral volumes through an in-tree SC count
+        # against the same CSI limit
+        store, clock, cluster, pools, types = build_env()
+        store.create(StorageClass(metadata=ObjectMeta(name="in-tree-sc"), provisioner=self.EBS_IN_TREE, volume_binding_mode="WaitForFirstConsumer"))
+        self._node_with_limit(store, limit=1)
+        pods = []
+        for i in range(2):
+            p = make_pod(name=f"eph-{i}", cpu="100m")
+            p.spec.volumes = [{"name": "v0", "ephemeral": {"volumeClaimTemplate": {"spec": {"storageClassName": "in-tree-sc"}}}}]
+            pods.append(p)
+        s = make_scheduler(store, clock, cluster, pools, types)
+        results = s.solve(pods)
+        assert results.all_pods_scheduled()
+        assert results.node_pod_count().get("n1") == 1
+        assert len(results.new_node_claims) == 1
+
+    def test_mixed_in_tree_and_csi_share_one_limit(self):
+        # one in-tree volume + one native CSI volume on the same driver name
+        # consume the same budget
+        store, clock, cluster, pools, types = build_env()
+        self._in_tree_pvc(store, "legacy")
+        pv = PersistentVolume(metadata=ObjectMeta(name="pv-native"), csi_driver=self.EBS_CSI)
+        store.create(pv)
+        store.create(
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name="native", namespace="default", annotations={BIND_COMPLETED_ANNOTATION: "yes"}),
+                volume_name="pv-native",
+            )
+        )
+        self._in_tree_pvc(store, "legacy2")
+        self._node_with_limit(store, limit=2)
+        s = make_scheduler(store, clock, cluster, pools, types)
+        results = s.solve([
+            pod_with_pvcs("legacy", name="pod-l", cpu="100m"),
+            pod_with_pvcs("native", name="pod-n", cpu="100m"),
+            # the third volume-bearing pod exceeds the SHARED limit of 2 —
+            # if in-tree and native CSI were tracked under separate driver
+            # keys it would fit on n1 and this assertion would fail
+            pod_with_pvcs("legacy2", name="pod-l2", cpu="100m"),
+        ])
+        assert results.all_pods_scheduled()
+        assert results.node_pod_count().get("n1") == 2
+        assert len(results.new_node_claims) == 1
