@@ -79,15 +79,23 @@ def build_candidate(cluster, store, clock, state_node, node_pools_by_name, insta
         p = instance_type.offering_price(zone, capacity_type)
         price = p if p is not None else 0.0
 
+    # the candidate's pod set is every pod still tracked on the node —
+    # terminating pods included (types.go:188-199 + statenode.go:244-264
+    # ValidatePodsDisruptable reads the live bindings); is_reschedulable
+    # below decides which of them reserve replacement capacity
     pods = []
     for key in state_node.pod_requests:
         ns, name = key.split("/", 1)
         pod = store.try_get("Pod", name, ns)
-        if pod is not None and pod_utils.is_active(pod):
+        if pod is not None and not pod_utils.is_terminal(pod):
             pods.append(pod)
 
-    # pods that block disruption
+    # pods that block disruption; do-not-disrupt only blocks for ACTIVE pods
+    # (scheduling.go:115-117 IsDisruptable: a terminating pod cannot hold its
+    # node hostage)
     for pod in pods:
+        if not pod_utils.is_active(pod):
+            continue
         if pod_utils.has_do_not_disrupt(pod, clock.now()) and node_pool.spec.template.termination_grace_period is None:
             return None, f"pod {pod.key()} has do-not-disrupt"
         ok, pdb = pdb_limits.can_evict(pod)

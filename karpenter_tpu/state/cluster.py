@@ -242,12 +242,20 @@ class Cluster:
     def update_pod(self, pod) -> None:
         with self._lock:
             key = pod.key()
-            if pod_utils.is_terminal(pod) or pod.metadata.deletion_timestamp is not None:
+            terminating = pod.metadata.deletion_timestamp is not None
+            if pod_utils.is_terminal(pod):
+                # only TERMINAL pods release usage (cluster.go:433-436): a
+                # terminating pod still occupies its node until it is gone
+                # (delete_pod handles that), and candidates must keep seeing
+                # it — e.g. terminating StatefulSet pods reserve capacity
                 bound_node = self._bindings.get(key)
                 self._remove_pod_usage(key)
                 if bound_node is not None and not pod_utils.is_owned_by_daemonset(pod):
                     self._record_pod_event_on_claim(bound_node)
             elif pod.spec.node_name:
+                # bound pods — terminating ones included, so a pod first
+                # observed mid-termination (informer replay after restart)
+                # still records its binding and usage
                 old_node = self._bindings.get(key)
                 newly_bound = old_node != pod.spec.node_name
                 if old_node is not None and newly_bound:
@@ -257,11 +265,12 @@ class Cluster:
                 if sn is not None:
                     sn.update_for_pod(pod, volumes=get_volumes(self.store, pod))
                 self._pod_acks.pop(key, None)
-                # lastPodEventTime: only on genuine bind transitions, never for
-                # DaemonSet pods, deduped at 10s (podevents/controller.go:110-121)
-                if newly_bound and not pod_utils.is_owned_by_daemonset(pod):
+                # lastPodEventTime: genuine bind transitions and termination
+                # starts, never for DaemonSet pods, deduped at 10s
+                # (podevents/controller.go:110-121)
+                if (newly_bound or terminating) and not pod_utils.is_owned_by_daemonset(pod):
                     self._record_pod_event_on_claim(pod.spec.node_name)
-            else:
+            elif not terminating:
                 self._pod_acks.setdefault(key, self.clock.now())
             if _has_required_anti_affinity(pod):
                 if pod_utils.is_active(pod):
@@ -297,7 +306,9 @@ class Cluster:
         # borrowed scan: update_for_pod derives requests/ports and retains
         # nothing from the pod object
         for pod in self.store.borrow_list("Pod"):
-            if pod.spec.node_name == node_name and pod_utils.is_active(pod):
+            # terminating (non-terminal) pods still occupy the node — same
+            # rule as update_pod (cluster.go:433-436)
+            if pod.spec.node_name == node_name and not pod_utils.is_terminal(pod):
                 self._bindings[pod.key()] = node_name
                 sn.update_for_pod(pod, volumes=get_volumes(self.store, pod))
 

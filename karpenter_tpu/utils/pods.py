@@ -33,9 +33,20 @@ def is_active(pod) -> bool:
 
 
 def is_reschedulable(pod) -> bool:
-    """Pods that must fit elsewhere if their node is disrupted: active and not
-    owned by the node itself (static/mirror pods) or a DaemonSet."""
-    return is_active(pod) and not is_owned_by_daemonset(pod) and not is_owned_by_node(pod)
+    """Pods that must fit elsewhere if their node is disrupted: active — or
+    TERMINATING but owned by a StatefulSet, whose replacement is recreated
+    with the same identity only after deletion, so reserving capacity for it
+    raises availability (pod/scheduling.go:40-51) — and not owned by the
+    node itself (static/mirror pods) or a DaemonSet."""
+    return (
+        (is_active(pod) or (is_owned_by_statefulset(pod) and is_terminating(pod)))
+        and not is_owned_by_daemonset(pod)
+        and not is_owned_by_node(pod)
+    )
+
+
+def is_owned_by_statefulset(pod) -> bool:
+    return any(ref.kind == "StatefulSet" for ref in pod.metadata.owner_references)
 
 
 def is_owned_by_daemonset(pod) -> bool:

@@ -324,3 +324,85 @@ class TestCommandValidation:
         with pytest.raises(ValidationError) as e:
             Validator(ctrl.ctx, ctrl.methods[3], mode="strict", metrics=env.registry).validate(cmd, delay_seconds=0)
         assert e.value.kind == "churn"
+
+
+class TestReschedulabilityOwnerKinds:
+    """suite_test.go:4169/:4213 + pod/scheduling.go:40-51 IsReschedulable:
+    terminating StatefulSet pods reserve replacement capacity (their
+    successor is recreated with the same identity only after deletion);
+    terminating ReplicaSet pods do not."""
+
+    def _terminating_pod(self, env, owner_kind):
+        from karpenter_tpu.kube.objects import OwnerReference
+
+        pod = make_pod(cpu="1", name="owned")
+        pod.metadata.owner_references = [OwnerReference(kind=owner_kind, name="own", uid="own-uid")]
+        pod.metadata.finalizers = ["test/hold"]  # two-phase delete → terminating
+        provision(env, [pod])
+        env.store.delete("Pod", "owned", namespace="default")
+        terminating = env.store.get("Pod", "owned", namespace="default")
+        assert terminating.metadata.deletion_timestamp is not None
+        return env.store.list("Node")[0]
+
+    def _candidate_for(self, env, node):
+        from karpenter_tpu.controllers.disruption.types import build_candidate
+        from karpenter_tpu.utils.pdb import PDBLimits
+
+        sn = env.cluster.node_for_name(node.metadata.name)
+        pools = {np.metadata.name: np for np in env.store.list("NodePool")}
+        its = {name: env.cloud_provider.get_instance_types(np) for name, np in pools.items()}
+        return build_candidate(
+            env.cluster, env.store, env.clock, sn, pools, its, PDBLimits(env.store)
+        )
+
+    def test_unit_predicates(self):
+        from karpenter_tpu.kube.objects import OwnerReference
+
+        sts = make_pod(name="s")
+        sts.metadata.owner_references = [OwnerReference(kind="StatefulSet", name="s", uid="u1")]
+        sts.metadata.deletion_timestamp = 1.0
+        assert pod_utils.is_reschedulable(sts)
+        rs = make_pod(name="r")
+        rs.metadata.owner_references = [OwnerReference(kind="ReplicaSet", name="r", uid="u2")]
+        rs.metadata.deletion_timestamp = 1.0
+        assert not pod_utils.is_reschedulable(rs)
+
+    def test_terminating_statefulset_pod_reserves_capacity(self):
+        env = make_env()
+        node = self._terminating_pod(env, "StatefulSet")
+        cand, err = self._candidate_for(env, node)
+        assert err is None and cand is not None
+        assert [p.metadata.name for p in cand.reschedulable_pods] == ["owned"]
+
+    def test_terminating_replicaset_pod_does_not(self):
+        env = make_env()
+        node = self._terminating_pod(env, "ReplicaSet")
+        cand, err = self._candidate_for(env, node)
+        assert err is None and cand is not None
+        assert cand.reschedulable_pods == []
+
+    def test_terminating_sts_pod_survives_state_rebuild(self):
+        # review finding: a pod FIRST OBSERVED mid-termination (informer
+        # replay after a restart / leader takeover) must still record its
+        # binding and usage, or the node reads empty and gets consolidated
+        env = make_env()
+        node = self._terminating_pod(env, "StatefulSet")
+        # a fresh Environment attaches to the same store — new leader warming
+        # its caches from current content, pod already terminating
+        takeover = Environment(options=Options(), store=env.store)
+        sn = takeover.cluster.node_for_name(node.metadata.name)
+        assert sn is not None and "default/owned" in sn.pod_requests
+        cand, err = self._candidate_for_env(takeover, env, node)
+        assert err is None and cand is not None
+        assert [p.metadata.name for p in cand.reschedulable_pods] == ["owned"]
+
+    def _candidate_for_env(self, takeover, orig_env, node):
+        from karpenter_tpu.controllers.disruption.types import build_candidate
+        from karpenter_tpu.utils.pdb import PDBLimits
+
+        sn = takeover.cluster.node_for_name(node.metadata.name)
+        pools = {np.metadata.name: np for np in takeover.store.list("NodePool")}
+        its = {name: orig_env.cloud_provider.get_instance_types(np) for name, np in pools.items()}
+        return build_candidate(
+            takeover.cluster, takeover.store, takeover.clock, sn, pools, its, PDBLimits(takeover.store)
+        )
