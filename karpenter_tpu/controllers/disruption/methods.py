@@ -351,10 +351,15 @@ class MultiNodeConsolidation(_ConsolidationBase):
 
     consolidation_type = "multi"
 
+    def sort_candidates(self, eligible: list) -> list:
+        """Highest savings per unit disruption first: budget-limited rounds
+        spend their batch on the most impactful moves, and the prefix binary
+        search windows over the most valuable nodes (consolidation.go:140-154
+        sortCandidates by SavingsRatio desc)."""
+        return sorted(eligible, key=lambda c: c.savings_ratio(), reverse=True)
+
     def compute_commands(self, candidates, budgets) -> list[Command]:
-        eligible = [c for c in candidates if self.should_disrupt(c)]
-        # disrupt lowest-cost nodes first
-        eligible.sort(key=lambda c: c.disruption_cost)
+        eligible = self.sort_candidates([c for c in candidates if self.should_disrupt(c)])
         # budget filter up-front: take at most allowed per pool
         allowed = dict(budgets)
         filtered = []

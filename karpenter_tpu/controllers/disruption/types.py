@@ -31,6 +31,11 @@ class Candidate:
     # unit of balanced scoring (types.go:85-89 RescheduleDisruptionCost)
     reschedule_disruption_cost: float = 1.0
 
+    def savings_ratio(self) -> float:
+        """Cost per unit disruption; higher = prefer to disrupt
+        (types.go:144-145)."""
+        return self.price / self.reschedule_disruption_cost
+
     def name(self) -> str:
         return self.state_node.name()
 

@@ -406,3 +406,50 @@ class TestReschedulabilityOwnerKinds:
         return build_candidate(
             takeover.cluster, takeover.store, takeover.clock, sn, pools, its, PDBLimits(takeover.store)
         )
+
+
+class TestSavingsRatio:
+    """balanced_scoring_test.go:422-439 Candidate.SavingsRatio + the
+    multi-node candidate ordering it drives (consolidation.go:140-154
+    sortCandidates: highest savings per unit disruption first)."""
+
+    def _candidate(self, price, n_pods):
+        from karpenter_tpu.controllers.disruption.types import Candidate
+
+        pods = [make_pod(name=f"p{i}", cpu="100m") for i in range(n_pods)]
+        return Candidate(
+            state_node=None, node_claim=None, node_pool=None, instance_type=None,
+            capacity_type="on-demand", zone="test-zone-a", price=price,
+            reschedulable_pods=pods, disruption_cost=1.0,
+            reschedule_disruption_cost=1.0 + float(n_pods),
+        )
+
+    def test_ratio_no_pods(self):
+        # ratio = price / 1.0 (per-node base only)
+        assert abs(self._candidate(4.84, 0).savings_ratio() - 4.84) < 0.01
+
+    def test_ratio_with_pods(self):
+        # 1.0 base + 3 × 1.0 eviction cost → 4.84 / 4.0
+        assert abs(self._candidate(4.84, 3).savings_ratio() - 1.21) < 0.01
+
+    def test_ratio_zero_price(self):
+        # unknown instance type → price 0 → ratio 0
+        assert self._candidate(0.0, 3).savings_ratio() == 0.0
+
+    def test_multinode_orders_by_ratio_not_cost(self):
+        # an expensive many-pod node (high absolute disruption cost, higher
+        # RATIO) must sort before a cheap low-cost node — the old
+        # cost-ascending order would invert this; exercises the PRODUCTION
+        # MultiNodeConsolidation.sort_candidates
+        from types import SimpleNamespace
+
+        from karpenter_tpu.controllers.disruption.methods import MultiNodeConsolidation
+
+        rich = self._candidate(10.0, 1)   # ratio 5.0, higher disruption cost
+        rich.disruption_cost = 5.0
+        poor = self._candidate(1.0, 0)    # ratio 1.0, lower disruption cost
+        poor.disruption_cost = 0.5
+        m = MultiNodeConsolidation.__new__(MultiNodeConsolidation)
+        m.ctx = SimpleNamespace()
+        ordered = m.sort_candidates([poor, rich])
+        assert ordered[0] is rich
