@@ -170,6 +170,14 @@ class _ConsolidationBase:
     def __init__(self, ctx):
         self.ctx = ctx
 
+    def sort_candidates(self, eligible: list) -> list:
+        """Shared consolidation order: highest savings per unit disruption
+        first, so budget- and timeout-limited rounds spend themselves on the
+        most impactful moves (consolidation.go:140-154 sortCandidates by
+        SavingsRatio desc). Single-node layers its NodePool interweave on
+        top; multi-node's prefix binary search windows over this order."""
+        return sorted(eligible, key=lambda c: c.savings_ratio(), reverse=True)
+
     def should_disrupt(self, candidate) -> bool:
         if candidate.node_claim is None or candidate.owned_by_static_node_pool():
             return False
@@ -283,10 +291,11 @@ class SingleNodeConsolidation(_ConsolidationBase):
         self.previously_unseen_node_pools: set[str] = set()
 
     def sort_candidates(self, eligible: list) -> list:
-        """Disruption-cost sort, then round-robin interweave by NodePool with
-        previously-unseen pools first (shuffleCandidates,
-        singlenodeconsolidation.go:143-176)."""
-        eligible = sorted(eligible, key=lambda c: c.disruption_cost)
+        """The shared SavingsRatio sort, then round-robin interweave by
+        NodePool with previously-unseen pools first
+        (singlenodeconsolidation.go:141-176 SortCandidates calls the shared
+        sortCandidates before shuffleCandidates)."""
+        eligible = super().sort_candidates(eligible)
         by_pool: dict[str, list] = {}
         for c in eligible:
             by_pool.setdefault(c.node_pool.metadata.name, []).append(c)
@@ -350,13 +359,6 @@ class MultiNodeConsolidation(_ConsolidationBase):
     scheduling simulation (multinodeconsolidation.go:52-191)."""
 
     consolidation_type = "multi"
-
-    def sort_candidates(self, eligible: list) -> list:
-        """Highest savings per unit disruption first: budget-limited rounds
-        spend their batch on the most impactful moves, and the prefix binary
-        search windows over the most valuable nodes (consolidation.go:140-154
-        sortCandidates by SavingsRatio desc)."""
-        return sorted(eligible, key=lambda c: c.savings_ratio(), reverse=True)
 
     def compute_commands(self, candidates, budgets) -> list[Command]:
         eligible = self.sort_candidates([c for c in candidates if self.should_disrupt(c)])

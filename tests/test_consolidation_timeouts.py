@@ -27,13 +27,15 @@ def make_candidate(pool_name: str, cost: float = 1.0, price: float = 1.0, policy
         metadata=SimpleNamespace(name=pool_name),
         spec=SimpleNamespace(disruption=SimpleNamespace(consolidation_policy=policy)),
     )
-    return SimpleNamespace(
+    c = SimpleNamespace(
         node_pool=node_pool,
         disruption_cost=cost,
         reschedule_disruption_cost=1.0,
         price=price,
         name=lambda: pool_name,
     )
+    c.savings_ratio = lambda: c.price / c.reschedule_disruption_cost
+    return c
 
 
 def make_ctx(clock=None, registry=None):

@@ -453,3 +453,40 @@ class TestSavingsRatio:
         m.ctx = SimpleNamespace()
         ordered = m.sort_candidates([poor, rich])
         assert ordered[0] is rich
+
+
+class TestParallelization:
+    """consolidation_test.go:4659-4705 'Parallelization': demand arriving
+    while a consolidation command is in flight reuses the in-flight
+    replacement capacity instead of launching extra nodes."""
+
+    def test_pending_pod_during_consolidation_adds_no_extra_node(self):
+        env = make_env(np_kwargs={"requirements": OD_ONLY})
+        provision(env, [make_pod(cpu="14", name="big"), make_pod(cpu="500m", name="small")])
+        env.store.delete("Pod", "big")
+        # replacement launches but never registers — the command stays in
+        # flight and the old node stays up (replacement-first ordering)
+        nodeclass = env.store.get("KWOKNodeClass", "default")
+        nodeclass.spec.node_registration_delay = 10**9
+        env.store.update(nodeclass)
+        for _ in range(6):
+            env.clock.step(15)
+            env.tick(provision_force=True)
+        claims_mid = env.store.count("NodeClaim")
+        assert claims_mid == 2  # old node + exactly one in-flight replacement
+        assert env.store.count("Node") == 1  # old node still serving
+        # new demand arrives mid-command: it must fit existing/in-flight
+        # capacity, not grow the fleet beyond the replacement
+        env.store.create(make_pod(cpu="500m", name="late"))
+        for _ in range(4):
+            env.clock.step(5)
+            env.tick(provision_force=True)
+        assert env.store.count("NodeClaim") <= max(claims_mid, 2)
+        # un-wedge registration: claims already launched keep their huge
+        # delay, so ride past the liveness TTL — they get killed and
+        # replaced by claims that register immediately, then all pods run
+        nodeclass = env.store.get("KWOKNodeClass", "default")
+        nodeclass.spec.node_registration_delay = 0.0
+        env.store.update(nodeclass)
+        run_disruption(env, rounds=12, step=120.0)
+        assert all(p.spec.node_name for p in env.store.list("Pod"))
