@@ -45,6 +45,9 @@ NODECLAIM_MIN_VALUES_RELAXED_ANNOTATION_KEY = f"{GROUP}/nodeclaim-min-values-rel
 # Taints
 DISRUPTED_TAINT_KEY = f"{GROUP}/disrupted"
 UNREGISTERED_TAINT_KEY = f"{GROUP}/unregistered"
+# node label a provider sets when IT manages taints: registration skips
+# syncing claim taints/startupTaints (labels.go:44, registration.go:211-217)
+NODE_DO_NOT_SYNC_TAINTS_LABEL_KEY = f"{GROUP}/do-not-sync-taints"
 
 # Finalizers
 TERMINATION_FINALIZER = f"{GROUP}/termination"

@@ -23,7 +23,7 @@ REGISTRATION_TTL_SECONDS = 15 * 60  # liveness.go:39 registrationTTL
 
 
 class LifecycleController:
-    def __init__(self, store, cluster, cloud_provider, clock, recorder=None, np_state=None, metrics=None):
+    def __init__(self, store, cluster, cloud_provider, clock, recorder=None, np_state=None, metrics=None, registration_hooks=None):
         self.store = store
         self.cluster = cluster
         self.cloud_provider = cloud_provider
@@ -31,6 +31,11 @@ class LifecycleController:
         self.recorder = recorder
         self.np_state = np_state  # nodepoolhealth.NodePoolHealthState
         self.metrics = metrics
+        # provider-supplied registration gates: each hook has .name and
+        # .registered(node_claim) -> bool; ALL must pass before the
+        # unregistered taint drops (cloudprovider types.go:111-118
+        # NodeLifecycleHook, controllers.go:78-84 WithRegistrationHook)
+        self.registration_hooks = list(registration_hooks or [])
 
     def reconcile_all(self) -> None:
         for nc in self.store.borrow_list("NodeClaim"):
@@ -95,23 +100,43 @@ class LifecycleController:
         node = self._node_for(nc)
         if node is None:
             return False
-        # sync labels/taints/annotations from the claim onto the node and drop
-        # the unregistered taint
+        # every registration hook must pass before the unregistered taint
+        # drops; until then the sync still runs (labels/annotations/taints)
+        # but the node stays unschedulable (registration.go:93-116)
+        pending_hooks = [h.name for h in self.registration_hooks if not h.registered(nc)]
+
+        # sync labels/taints/annotations from the claim onto the node; drop
+        # the unregistered taint only once the hooks clear
         def apply(n):
             for k, v in nc.metadata.labels.items():
                 n.metadata.labels.setdefault(k, v)
-            n.metadata.labels[wk.NODE_REGISTERED_LABEL_KEY] = "true"
             for k, v in nc.metadata.annotations.items():
                 n.metadata.annotations.setdefault(k, v)
-            existing = {(t.key, t.effect) for t in n.spec.taints}
-            for t in list(nc.spec.taints) + list(nc.spec.startup_taints):
-                if (t.key, t.effect) not in existing:
-                    n.spec.taints.append(t)
-            n.spec.taints = [t for t in n.spec.taints if t.key != wk.UNREGISTERED_TAINT_KEY]
+            # a provider that manages taints itself sets do-not-sync-taints;
+            # the unregistered taint is still ours to remove
+            # (registration.go:211-217)
+            if n.metadata.labels.get(wk.NODE_DO_NOT_SYNC_TAINTS_LABEL_KEY) != "true":
+                existing = {(t.key, t.effect) for t in n.spec.taints}
+                for t in list(nc.spec.taints) + list(nc.spec.startup_taints):
+                    if (t.key, t.effect) not in existing:
+                        n.spec.taints.append(t)
+            if not pending_hooks:
+                n.metadata.labels[wk.NODE_REGISTERED_LABEL_KEY] = "true"
+                n.spec.taints = [t for t in n.spec.taints if t.key != wk.UNREGISTERED_TAINT_KEY]
             if wk.TERMINATION_FINALIZER not in n.metadata.finalizers:
                 n.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
 
         self.store.patch("Node", node.metadata.name, apply)
+        if pending_hooks:
+            # report claim changes only on a genuine condition transition —
+            # a hook that stays unready must not turn every reconcile round
+            # into a store write
+            return nc.status.conditions.set_false(
+                COND_REGISTERED,
+                "RegistrationHooksPending",
+                f"waiting on registration hooks: {', '.join(sorted(pending_hooks))}",
+                now=self.clock.now(),
+            )
         nc.status.node_name = node.metadata.name
         nc.status.conditions.set_true(COND_REGISTERED, now=self.clock.now())
         self._record_registration_outcome(nc, success=True)

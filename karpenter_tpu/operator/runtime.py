@@ -59,7 +59,7 @@ from .options import Options
 class Environment:
     """A fully wired in-process cluster + Karpenter control plane."""
 
-    def __init__(self, options: Options | None = None, clock=None, cloud_provider=None, instance_types=None, store=None):
+    def __init__(self, options: Options | None = None, clock=None, cloud_provider=None, instance_types=None, store=None, registration_hooks=None):
         """`store` lets a second Environment attach to an existing cluster
         (active/standby takeover tests): informers seed the fresh in-memory
         mirror from the shared store's current content, exactly like a new
@@ -128,6 +128,7 @@ class Environment:
         self.lifecycle = LifecycleController(
             self.store, self.cluster, self.cloud_provider, self.clock,
             recorder=self.recorder, np_state=self.np_state, metrics=self.registry,
+            registration_hooks=registration_hooks,
         )
         self.gc = GarbageCollectionController(self.store, self.cluster, self.cloud_provider, self.clock)
         self.binder = Binder(self.store, self.cluster, self.clock, dra_enabled=self.options.feature_gates.dynamic_resources)

@@ -1,0 +1,145 @@
+"""Registration gating: provider-supplied lifecycle hooks and the
+do-not-sync-taints node label (registration.go:93-116 hook gating +
+:211-217 taint-sync skip; registration_test.go:299-494 taint-sync corpus,
+suite hooks contexts :668-790)."""
+
+from helpers import make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.scheduling.taints import Taint
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+class Hook:
+    def __init__(self, name, ready=False):
+        self.name = name
+        self.ready = ready
+        self.calls = 0
+
+    def registered(self, nc):
+        self.calls += 1
+        return self.ready
+
+
+def make_env(hooks=None, taints=None, startup_taints=None):
+    env = Environment(options=Options(), registration_hooks=hooks)
+    np = make_nodepool(requirements=LINUX_AMD64)
+    if taints:
+        np.spec.template.taints = taints
+    if startup_taints:
+        np.spec.template.startup_taints = startup_taints
+    env.store.create(np)
+    return env
+
+
+class TestRegistrationHooks:
+    def test_single_passing_hook_completes_registration(self):
+        # suite :668 — a ready hook lets registration complete normally
+        hook = Hook("h1", ready=True)
+        env = make_env(hooks=[hook])
+        env.store.create(make_pod(cpu="100m", name="p"))
+        env.settle(rounds=4)
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.is_registered()
+        assert hook.calls >= 1
+        assert env.store.get("Pod", "p", namespace="default").spec.node_name
+
+    def test_unready_hook_defers_registration(self):
+        # suite :697 — hook returns false: unregistered taint stays, the
+        # Registered condition reports the pending hook
+        hook = Hook("slow-hook", ready=False)
+        env = make_env(hooks=[hook])
+        env.store.create(make_pod(cpu="100m", name="p"))
+        env.settle(rounds=4)
+        nc = env.store.list("NodeClaim")[0]
+        assert not nc.is_registered()
+        node = env.store.list("Node")[0]
+        assert any(t.key == wk.UNREGISTERED_TAINT_KEY for t in node.spec.taints)
+        # labels/annotations still synced while deferred (registration.go:92)
+        assert node.metadata.labels.get(wk.NODEPOOL_LABEL_KEY)
+
+    def test_second_hook_unready_defers_with_multiple_hooks(self):
+        # suite :762 — ALL hooks must pass
+        h1, h2 = Hook("ready", ready=True), Hook("not-ready", ready=False)
+        env = make_env(hooks=[h1, h2])
+        env.store.create(make_pod(cpu="100m", name="p"))
+        env.settle(rounds=4)
+        assert not env.store.list("NodeClaim")[0].is_registered()
+
+    def test_hook_becoming_ready_completes_registration(self):
+        hook = Hook("late", ready=False)
+        env = make_env(hooks=[hook])
+        env.store.create(make_pod(cpu="100m", name="p"))
+        env.settle(rounds=4)
+        assert not env.store.list("NodeClaim")[0].is_registered()
+        hook.ready = True
+        env.settle(rounds=4)
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.is_registered()
+        node = env.store.get("Node", nc.status.node_name)
+        assert not any(t.key == wk.UNREGISTERED_TAINT_KEY for t in node.spec.taints)
+        assert env.store.get("Pod", "p", namespace="default").spec.node_name
+
+
+class TestDoNotSyncTaints:
+    """The provider (not the template — karpenter.sh/* template labels are
+    restricted) stamps the label on the NODE, exactly like the reference
+    tests set node.Labels[NodeDoNotSyncTaintsLabelKey] directly."""
+
+    def _launch_with_node_label(self, env, value):
+        """Provision with a registration delay, stamp the label on the node
+        the moment it appears (pre-registration), then let lifecycle run."""
+        nodeclass = env.store.get("KWOKNodeClass", "default")
+        nodeclass.spec.node_registration_delay = 2.0
+        env.store.update(nodeclass)
+        env.provisioner.reconcile(force=True)
+        env.lifecycle.reconcile_all()  # launch
+        env.clock.step(3.0)
+        env.cloud_provider.flush_pending()  # node created, unregistered
+        node = env.store.list("Node")[0]
+
+        def stamp(n):
+            n.metadata.labels[wk.NODE_DO_NOT_SYNC_TAINTS_LABEL_KEY] = value
+
+        env.store.patch("Node", node.metadata.name, stamp)
+        env.settle(rounds=4)
+        return env.store.list("NodeClaim")[0]
+
+    def test_taints_not_synced_with_label(self):
+        # registration_test.go:347 — provider-managed taints: claim taints
+        # are NOT copied, but the unregistered taint is still removed
+        taint = Taint(key="custom/taint", value="v", effect="NoSchedule")
+        env = make_env(taints=[taint])
+        env.store.create(make_pod(cpu="100m", name="p", tolerations=[{"operator": "Exists"}]))
+        nc = self._launch_with_node_label(env, "true")
+        assert nc.is_registered()
+        node = env.store.get("Node", nc.status.node_name)
+        assert not any(t.key == "custom/taint" for t in node.spec.taints)
+        assert not any(t.key == wk.UNREGISTERED_TAINT_KEY for t in node.spec.taints)
+
+    def test_taints_synced_when_label_not_true(self):
+        # registration_test.go:320 — label present but != "true" syncs
+        taint = Taint(key="custom/taint", value="v", effect="NoSchedule")
+        env = make_env(taints=[taint])
+        env.store.create(make_pod(cpu="100m", name="p", tolerations=[{"operator": "Exists"}]))
+        nc = self._launch_with_node_label(env, "false")
+        assert nc.is_registered()
+        node = env.store.get("Node", nc.status.node_name)
+        assert any(t.key == "custom/taint" for t in node.spec.taints)
+
+    def test_startup_taints_not_synced_with_label(self):
+        # registration_test.go:377 — startupTaints skipped too; without the
+        # startup taint ever appearing, initialization proceeds
+        st = Taint(key="startup/gate", value="", effect="NoSchedule")
+        env = make_env(startup_taints=[st])
+        env.store.create(make_pod(cpu="100m", name="p"))
+        nc = self._launch_with_node_label(env, "true")
+        assert nc.is_registered()
+        node = env.store.get("Node", nc.status.node_name)
+        assert not any(t.key == "startup/gate" for t in node.spec.taints)
+        assert nc.is_initialized()
