@@ -20,6 +20,7 @@ from ...kube.store import NotFound
 from ...utils import resources as res
 
 REGISTRATION_TTL_SECONDS = 15 * 60  # liveness.go:39 registrationTTL
+LAUNCH_TIMEOUT_SECONDS = 5 * 60  # liveness.go:57-59 LaunchTimeout
 
 
 class LifecycleController:
@@ -99,7 +100,13 @@ class LifecycleController:
             return False
         node = self._node_for(nc)
         if node is None:
-            return False
+            # anchor the registration-timeout window at the condition's
+            # transition time, not the claim's creation (registration.go:68
+            # SetUnknownWithReason; liveness_test.go:264)
+            return nc.status.conditions.set(
+                COND_REGISTERED, "Unknown", "NodeNotFound",
+                "Node not registered with cluster", now=self.clock.now(),
+            )
         # every registration hook must pass before the unregistered taint
         # drops; until then the sync still runs (labels/annotations/taints)
         # but the node stays unschedulable (registration.go:93-116)
@@ -128,12 +135,16 @@ class LifecycleController:
 
         self.store.patch("Node", node.metadata.name, apply)
         if pending_hooks:
-            # report claim changes only on a genuine condition transition —
-            # a hook that stays unready must not turn every reconcile round
-            # into a store write
-            return nc.status.conditions.set_false(
+            # UNKNOWN like the node-missing state (registration.go:171
+            # SetUnknownWithReason): flipping to False here would bounce the
+            # Registered status Unknown↔False as nodes come and go, resetting
+            # the liveness anchor each time and letting a never-registering
+            # claim evade the TTL. Transition-only return keeps a steadily
+            # unready hook from writing the claim every round.
+            return nc.status.conditions.set(
                 COND_REGISTERED,
-                "RegistrationHooksPending",
+                "Unknown",
+                "RegistrationHookPending",
                 f"waiting on registration hooks: {', '.join(sorted(pending_hooks))}",
                 now=self.clock.now(),
             )
@@ -185,8 +196,27 @@ class LifecycleController:
     def _liveness(self, nc: NodeClaim) -> None:
         if nc.is_registered():
             return
-        age = self.clock.now() - nc.metadata.creation_timestamp
-        if age > REGISTRATION_TTL_SECONDS:
+        now = self.clock.now()
+        launched = nc.status.conditions.get(COND_LAUNCHED)
+        # a claim stuck UNLAUNCHED dies on the (shorter) launch timeout,
+        # measured from the Launched condition's transition — not the
+        # claim's creation (liveness.go:66-88, liveness_test.go:224)
+        if launched is not None and launched.status != "True":
+            if now - launched.last_transition_time > LAUNCH_TIMEOUT_SECONDS:
+                self._record_registration_outcome(nc, success=False)
+                self.store.try_delete("NodeClaim", nc.metadata.name)
+            return
+        registered = nc.status.conditions.get(COND_REGISTERED)
+        # registration timeout anchors at the Registered condition's
+        # transition (set Unknown when the node hasn't joined); claims
+        # predating that anchor fall back to creation time
+        # (liveness.go:90-103, liveness_test.go:264)
+        anchor = (
+            registered.last_transition_time
+            if registered is not None
+            else nc.metadata.creation_timestamp
+        )
+        if now - anchor > REGISTRATION_TTL_SECONDS:
             self._record_registration_outcome(nc, success=False)
             self.store.try_delete("NodeClaim", nc.metadata.name)
 

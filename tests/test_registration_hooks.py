@@ -143,3 +143,80 @@ class TestDoNotSyncTaints:
         node = env.store.get("Node", nc.status.node_name)
         assert not any(t.key == "startup/gate" for t in node.spec.taints)
         assert nc.is_initialized()
+
+
+class TestLivenessTimeouts:
+    """liveness.go:57-103 — an unlaunched claim dies on the 5-minute launch
+    timeout; an unregistered one on the 15-minute registration timeout, each
+    anchored at its CONDITION's transition time, never the claim's creation
+    (liveness_test.go:130,:224,:264)."""
+
+    def test_unlaunched_claim_killed_on_launch_timeout(self):
+        from karpenter_tpu.controllers.nodeclaim.lifecycle import LAUNCH_TIMEOUT_SECONDS
+
+        env = make_env()
+        # the nodeclass is never ready → Launched=False, claim stuck
+        nodeclass = env.store.get("KWOKNodeClass", "default")
+        nodeclass.status.conditions.set_false("Ready", "NotReady", now=env.clock.now())
+        env.store.update(nodeclass)
+        env.store.create(make_pod(cpu="100m", name="p"))
+        env.provisioner.reconcile(force=True)
+        env.lifecycle.reconcile_all()
+        assert env.store.count("NodeClaim") == 1
+        nc = env.store.list("NodeClaim")[0]
+        assert not nc.is_launched()
+        # inside the launch window: survives
+        env.clock.step(LAUNCH_TIMEOUT_SECONDS - 30)
+        env.lifecycle.reconcile_all()
+        assert env.store.count("NodeClaim") == 1
+        # past it: killed (second pass finalizes the two-phase delete)
+        env.clock.step(60)
+        env.lifecycle.reconcile_all()
+        env.lifecycle.reconcile_all()
+        assert env.store.count("NodeClaim") == 0
+
+    def test_registration_timeout_anchors_at_condition_transition(self):
+        from karpenter_tpu.controllers.nodeclaim.lifecycle import (
+            LAUNCH_TIMEOUT_SECONDS,
+            REGISTRATION_TTL_SECONDS,
+        )
+
+        env = make_env()
+        nodeclass = env.store.get("KWOKNodeClass", "default")
+        nodeclass.spec.node_registration_delay = 10**9  # never registers
+        env.store.update(nodeclass)
+        env.store.create(make_pod(cpu="100m", name="p"))
+        # age the world a bit BEFORE the claim launches: the timeout must
+        # count from the Registered=Unknown transition, not claim creation
+        env.provisioner.reconcile(force=True)
+        env.clock.step(120)
+        env.lifecycle.reconcile_all()  # launch + Registered=Unknown anchor
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.is_launched() and not nc.is_registered()
+        # at creation + TTL the claim is still inside the condition-anchored
+        # window (anchor is 120s after creation)
+        env.clock.step(REGISTRATION_TTL_SECONDS - 60)
+        env.lifecycle.reconcile_all()
+        assert env.store.count("NodeClaim") == 1
+        env.clock.step(120)
+        env.lifecycle.reconcile_all()
+        env.lifecycle.reconcile_all()
+        assert env.store.count("NodeClaim") == 0
+
+    def test_anchor_does_not_reset_on_node_flap_with_pending_hooks(self):
+        # review finding: the Registered status must stay Unknown whether the
+        # node is missing OR hooks are pending — an Unknown↔False oscillation
+        # would reset the liveness anchor and let the claim evade the TTL
+        hook = Hook("never-ready", ready=False)
+        env = make_env(hooks=[hook])
+        env.store.create(make_pod(cpu="100m", name="p"))
+        env.settle(rounds=3)
+        nc = env.store.list("NodeClaim")[0]
+        cond = nc.status.conditions.get("Registered")
+        assert cond is not None and cond.status == "Unknown"
+        anchor = cond.last_transition_time
+        # more rounds with the node present + hook pending: no transition
+        env.clock.step(60)
+        env.lifecycle.reconcile_all()
+        nc = env.store.list("NodeClaim")[0]
+        assert nc.status.conditions.get("Registered").last_transition_time == anchor
