@@ -264,21 +264,35 @@ class LifecycleController:
     def _terminate(self, nc: NodeClaim) -> None:
         from ...cloudprovider.errors import NodeClaimNotFoundError
 
-        node = None
-        if nc.status.node_name:
-            node = self.store.try_get("Node", nc.status.node_name)
-        if node is None and nc.status.provider_id:
-            node = next(
-                (n for n in self.store.list("Node") if n.spec.provider_id == nc.status.provider_id), None
-            )
-        if node is not None:
-            if node.metadata.deletion_timestamp is None:
-                # stamp the forced-drain deadline so terminationGracePeriod can
-                # override blocked PDBs / do-not-disrupt (termination.go TGP)
+        # only REGISTERED claims drain through their Node objects — an
+        # unregistered node has no synced kubelet state worth draining and
+        # deleting it risks leaked leases, so the instance is terminated
+        # directly and the node is garbage collected (controller.go:210-232)
+        if nc.is_registered():
+            nodes = []
+            if nc.status.node_name:
+                n = self.store.try_get("Node", nc.status.node_name)
+                if n is not None:
+                    nodes.append(n)
+            if nc.status.provider_id:
+                # EVERY node mapping to the claim goes (duplicate-node
+                # invariant violations, termination_test.go:233); borrowed
+                # scan — only names/timestamps are read, patches go by name
+                for n in self.store.borrow_list("Node"):
+                    if n.spec.provider_id == nc.status.provider_id and all(
+                        n.metadata.name != m.metadata.name for m in nodes
+                    ):
+                        nodes.append(n)
+            for node in nodes:
+                if node.metadata.deletion_timestamp is not None:
+                    continue  # already terminating; don't re-delete
+                # stamp the forced-drain deadline so terminationGracePeriod
+                # can override blocked PDBs / do-not-disrupt
+                # (termination.go TGP)
                 if nc.spec.termination_grace_period is not None:
                     deadline = self.clock.now() + nc.spec.termination_grace_period
-                    # an earlier deadline already stamped (e.g. by node repair's
-                    # force-drain) wins; never extend it
+                    # an earlier deadline already stamped (e.g. by node
+                    # repair's force-drain) wins; never extend it
                     existing = nc.metadata.annotations.get(wk.NODECLAIM_TERMINATION_TIMESTAMP_ANNOTATION_KEY)
                     if existing is not None:
                         deadline = min(deadline, float(existing))
@@ -290,7 +304,8 @@ class LifecycleController:
 
                     self.store.patch("Node", node.metadata.name, stamp)
                 self.store.try_delete("Node", node.metadata.name)  # graceful: drain runs
-            return  # wait for the termination controller to finish the drain
+            if nodes:
+                return  # wait until ALL nodes finish draining (controller.go:228-231)
         if nc.status.provider_id:
             try:
                 self.cloud_provider.delete(nc)

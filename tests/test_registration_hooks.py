@@ -220,3 +220,76 @@ class TestLivenessTimeouts:
         env.lifecycle.reconcile_all()
         nc = env.store.list("NodeClaim")[0]
         assert nc.status.conditions.get("Registered").last_transition_time == anchor
+
+
+class TestClaimTermination:
+    """nodeclaim lifecycle finalize guards (controller.go:198-260;
+    termination_test.go:233,:270,:297,:400)."""
+
+    def _provisioned(self):
+        env = make_env()
+        env.store.create(make_pod(cpu="100m", name="p"))
+        env.settle(rounds=4)
+        return env
+
+    def test_all_duplicate_nodes_deleted(self):
+        # :233/:270 — every node mapping to the claim is deleted, and the
+        # claim waits for all of them
+        from karpenter_tpu.kube import Node, ObjectMeta
+        from karpenter_tpu.kube.objects import NodeSpec, NodeStatus
+
+        env = self._provisioned()
+        nc = env.store.list("NodeClaim")[0]
+        dup = Node(
+            metadata=ObjectMeta(name="dup-node", labels={wk.NODE_REGISTERED_LABEL_KEY: "true"}),
+            spec=NodeSpec(provider_id=nc.status.provider_id),
+            status=NodeStatus(),
+        )
+        env.store.create(dup)
+        env.store.delete("Pod", "p", namespace="default")  # no re-provision noise
+        env.store.delete("NodeClaim", nc.metadata.name)
+        env.settle(rounds=8)
+        assert env.store.count("Node") == 0
+        assert env.store.count("NodeClaim") == 0
+
+    def test_unregistered_claim_does_not_delete_nodes(self):
+        # :400 — deleting an unregistered claim terminates the instance
+        # directly; no graceful node-drain cycle is started for a node the
+        # claim never registered against
+        env = make_env()
+        nodeclass = env.store.get("KWOKNodeClass", "default")
+        nodeclass.spec.node_registration_delay = 2.0
+        env.store.update(nodeclass)
+        env.store.create(make_pod(cpu="100m", name="p"))
+        env.provisioner.reconcile(force=True)
+        env.lifecycle.reconcile_all()  # launch; node held back
+        env.clock.step(3.0)
+        env.cloud_provider.flush_pending()  # node exists, unregistered
+        nc = env.store.list("NodeClaim")[0]
+        assert not nc.is_registered()
+        env.store.delete("NodeClaim", nc.metadata.name)
+        env.lifecycle.reconcile_all()
+        env.lifecycle.reconcile_all()
+        # instance (and with it the KWOK node) is gone without a drain cycle
+        assert env.store.count("NodeClaim") == 0
+        assert env.store.count("Node") == 0
+
+    def test_unlaunched_claim_skips_cloud_delete(self):
+        # :297 — no providerID: the finalizer falls off without touching the
+        # cloud provider
+        env = make_env()
+        nodeclass = env.store.get("KWOKNodeClass", "default")
+        nodeclass.status.conditions.set_false("Ready", "NotReady", now=env.clock.now())
+        env.store.update(nodeclass)
+        env.store.create(make_pod(cpu="100m", name="p"))
+        env.provisioner.reconcile(force=True)
+        env.lifecycle.reconcile_all()
+        nc = env.store.list("NodeClaim")[0]
+        assert not nc.status.provider_id
+        calls = []
+        real_delete = env.cloud_provider.delete
+        env.cloud_provider.delete = lambda claim: (calls.append(claim.metadata.name), real_delete(claim))
+        env.store.delete("NodeClaim", nc.metadata.name)
+        env.lifecycle.reconcile_all()
+        assert env.store.count("NodeClaim") == 0
+        assert calls == [], "cloud provider must not be touched for an unlaunched claim" 
