@@ -174,6 +174,13 @@ class LifecycleController:
         startup = {(t.key, t.effect) for t in nc.spec.startup_taints}
         if any((t.key, t.effect) in startup for t in node.spec.taints):
             return False
+        # known EPHEMERAL taints must have lifted too: not-ready/unreachable/
+        # cloud-provider-uninitialized and readiness.k8s.io/ controller gates
+        # (initialization.go:78-79,104-112 KnownEphemeralTaintsRemoved)
+        from ...scheduling.taints import is_known_ephemeral_taint
+
+        if any(is_known_ephemeral_taint(t) for t in node.spec.taints):
+            return False
         # every non-zero requested resource must be REGISTERED on the node:
         # kubelet zeroes extended resources at startup, so a zero allocatable
         # for a requested resource means the device plugin hasn't published

@@ -60,6 +60,28 @@ class Toleration:
         )
 
 
+# taints expected while a node initializes; lifted by kubelet / readiness
+# controllers, so scheduling and initialization both treat them as transient
+# (reference scheduling/taints.go:35-52 KnownEphemeralTaints + key prefixes)
+KNOWN_EPHEMERAL_TAINTS = frozenset(
+    {
+        ("node.kubernetes.io/not-ready", "NoSchedule"),
+        ("node.kubernetes.io/not-ready", "NoExecute"),
+        ("node.kubernetes.io/unreachable", "NoSchedule"),
+        ("node.cloudprovider.kubernetes.io/uninitialized", "NoSchedule"),
+    }
+)
+KNOWN_EPHEMERAL_TAINT_KEY_PREFIXES = ("readiness.k8s.io/",)
+
+
+def is_known_ephemeral_taint(taint: "Taint") -> bool:
+    """taints.go IsKnownEphemeralTaint: exact (key, effect) families plus
+    controller-suffixed key-prefix families, any effect."""
+    return (taint.key, taint.effect) in KNOWN_EPHEMERAL_TAINTS or taint.key.startswith(
+        KNOWN_EPHEMERAL_TAINT_KEY_PREFIXES
+    )
+
+
 def taints_tolerate_pod(taints: Iterable[Taint], pod, include_prefer_no_schedule: bool = False) -> str | None:
     """Error string naming the first untolerated taint, or None (reference:
     taints.go Taints.ToleratesPod). The SCHEDULER's candidate checks treat

@@ -83,18 +83,12 @@ class StateNode:
     # KnownEphemeralTaints, matched MatchTaint-style by key + effect):
     # rejected from managed-but-uninitialized nodes so the scheduler assumes
     # pods can land once they lift
-    KNOWN_EPHEMERAL_TAINTS = frozenset(
-        {
-            ("node.kubernetes.io/not-ready", "NoSchedule"),
-            ("node.kubernetes.io/not-ready", "NoExecute"),
-            ("node.kubernetes.io/unreachable", "NoSchedule"),
-            ("node.cloudprovider.kubernetes.io/uninitialized", "NoSchedule"),
-        }
+    # shared with the initialization gate (scheduling/taints.py); kept as
+    # class aliases for existing consumers
+    from ..scheduling.taints import (
+        KNOWN_EPHEMERAL_TAINT_KEY_PREFIXES,
+        KNOWN_EPHEMERAL_TAINTS,
     )
-    # key-prefix families treated the same way regardless of effect
-    # (taints.go KnownEphemeralTaintKeyPrefixes): readiness gates published by
-    # readiness controllers lift once the node warms up
-    KNOWN_EPHEMERAL_TAINT_KEY_PREFIXES = ("readiness.k8s.io/",)
 
     def taints(self) -> list[Taint]:
         """Node taints, filtering the transient karpenter lifecycle taints that
@@ -115,12 +109,12 @@ class StateNode:
             # MatchTaint semantics: key + effect (the applying agent may set a
             # different value than the claim declared)
             startup = {(t.key, t.effect) for t in self.node_claim.spec.startup_taints}
+            from ..scheduling.taints import is_known_ephemeral_taint
+
             out = [
                 t
                 for t in out
-                if (t.key, t.effect) not in self.KNOWN_EPHEMERAL_TAINTS
-                and (t.key, t.effect) not in startup
-                and not t.key.startswith(self.KNOWN_EPHEMERAL_TAINT_KEY_PREFIXES)
+                if not is_known_ephemeral_taint(t) and (t.key, t.effect) not in startup
             ]
         return out
 

@@ -293,3 +293,58 @@ class TestClaimTermination:
         env.lifecycle.reconcile_all()
         assert env.store.count("NodeClaim") == 0
         assert calls == [], "cloud provider must not be touched for an unlaunched claim" 
+
+
+class TestEphemeralTaintInitialization:
+    """initialization_test.go:508-658 — known ephemeral taints
+    (not-ready/unreachable/cloud-provider-uninitialized and readiness.k8s.io/
+    prefixed gates) block initialization until they lift."""
+
+    def _registered_env(self):
+        env = make_env()
+        env.store.create(make_pod(cpu="100m", name="p"))
+        nodeclass = env.store.get("KWOKNodeClass", "default")
+        nodeclass.spec.node_registration_delay = 2.0
+        env.store.update(nodeclass)
+        env.provisioner.reconcile(force=True)
+        env.lifecycle.reconcile_all()
+        env.clock.step(3.0)
+        env.cloud_provider.flush_pending()
+        return env, env.store.list("Node")[0]
+
+    def _with_taint(self, key, effect="NoSchedule"):
+        env, node = self._registered_env()
+
+        def taint(n):
+            n.spec.taints.append(Taint(key=key, value="", effect=effect))
+
+        env.store.patch("Node", node.metadata.name, taint)
+        env.settle(rounds=3)
+        nc = env.store.list("NodeClaim")[0]
+        return env, node, nc
+
+    def test_not_ready_taint_blocks_until_removed(self):
+        env, node, nc = self._with_taint("node.kubernetes.io/not-ready")
+        assert nc.is_registered() and not nc.is_initialized()
+
+        def lift(n):
+            n.spec.taints = [t for t in n.spec.taints if t.key != "node.kubernetes.io/not-ready"]
+
+        env.store.patch("Node", node.metadata.name, lift)
+        env.settle(rounds=3)
+        assert env.store.list("NodeClaim")[0].is_initialized()
+
+    def test_readiness_prefix_taint_blocks_until_removed(self):
+        env, node, nc = self._with_taint("readiness.k8s.io/kube-proxy")
+        assert nc.is_registered() and not nc.is_initialized()
+
+        def lift(n):
+            n.spec.taints = [t for t in n.spec.taints if not t.key.startswith("readiness.k8s.io/")]
+
+        env.store.patch("Node", node.metadata.name, lift)
+        env.settle(rounds=3)
+        assert env.store.list("NodeClaim")[0].is_initialized()
+
+    def test_unrelated_taint_does_not_block(self):
+        env, node, nc = self._with_taint("custom/fine")
+        assert nc.is_initialized()
