@@ -20,6 +20,9 @@ from ...utils import pods as pod_utils
 from ...utils.pdb import PDBLimits
 
 DISRUPTED_TAINT = Taint(key=wk.DISRUPTED_TAINT_KEY, effect=NO_SCHEDULE)
+# well-known k8s label: service controllers drop labeled nodes from external
+# load-balancer target groups (corev1.LabelNodeExcludeBalancers)
+EXCLUDE_BALANCERS_LABEL_KEY = "node.kubernetes.io/exclude-from-external-load-balancers"
 
 
 class TerminationController:
@@ -48,11 +51,17 @@ class TerminationController:
 
     def _terminate(self, node) -> None:
         name = node.metadata.name
-        # 1. taint so nothing new schedules (terminator.go:55)
-        if not any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints):
+        # 1. taint so nothing new schedules, and pull the node out of
+        # load-balancer target groups BEFORE draining starts — connections
+        # must stop arriving before the instance disappears
+        # (terminator.go:55-75; aws/karpenter#2518)
+        needs_taint = not any(t.key == wk.DISRUPTED_TAINT_KEY for t in node.spec.taints)
+        needs_lb_label = node.metadata.labels.get(EXCLUDE_BALANCERS_LABEL_KEY) != "karpenter"
+        if needs_taint or needs_lb_label:
             def taint(n):
                 if not any(t.key == wk.DISRUPTED_TAINT_KEY for t in n.spec.taints):
                     n.spec.taints.append(DISRUPTED_TAINT)
+                n.metadata.labels[EXCLUDE_BALANCERS_LABEL_KEY] = "karpenter"
 
             self.store.patch("Node", name, taint)
 

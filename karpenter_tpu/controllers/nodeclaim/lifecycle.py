@@ -17,6 +17,7 @@ from ...apis.nodeclaim import (
 )
 from ...cloudprovider.errors import InsufficientCapacityError, NodeClassNotReadyError
 from ...kube.store import NotFound
+from ...scheduling.taints import is_known_ephemeral_taint
 from ...utils import resources as res
 
 REGISTRATION_TTL_SECONDS = 15 * 60  # liveness.go:39 registrationTTL
@@ -177,8 +178,6 @@ class LifecycleController:
         # known EPHEMERAL taints must have lifted too: not-ready/unreachable/
         # cloud-provider-uninitialized and readiness.k8s.io/ controller gates
         # (initialization.go:78-79,104-112 KnownEphemeralTaintsRemoved)
-        from ...scheduling.taints import is_known_ephemeral_taint
-
         if any(is_known_ephemeral_taint(t) for t in node.spec.taints):
             return False
         # every non-zero requested resource must be REGISTERED on the node:

@@ -17,7 +17,12 @@ from ..apis.nodeclaim import (
 )
 from ..scheduling.hostports import HostPortUsage, pod_host_ports
 from ..scheduling.volumeusage import VolumeUsage
-from ..scheduling.taints import Taint
+from ..scheduling.taints import (
+    KNOWN_EPHEMERAL_TAINT_KEY_PREFIXES,
+    KNOWN_EPHEMERAL_TAINTS,
+    Taint,
+    is_known_ephemeral_taint,
+)
 from ..utils import disruption as disruption_utils
 from ..utils import pods as pod_utils
 from ..utils import resources as res
@@ -79,16 +84,11 @@ class StateNode:
             out.update(self.node.metadata.annotations)
         return out
 
-    # taints expected to clear during node startup (scheduling/taints.go:38-44
-    # KnownEphemeralTaints, matched MatchTaint-style by key + effect):
-    # rejected from managed-but-uninitialized nodes so the scheduler assumes
-    # pods can land once they lift
-    # shared with the initialization gate (scheduling/taints.py); kept as
-    # class aliases for existing consumers
-    from ..scheduling.taints import (
-        KNOWN_EPHEMERAL_TAINT_KEY_PREFIXES,
-        KNOWN_EPHEMERAL_TAINTS,
-    )
+    # taints expected to clear during node startup (scheduling/taints.py,
+    # mirroring scheduling/taints.go:38-52): kept as class aliases for
+    # existing consumers; shared with the initialization gate
+    KNOWN_EPHEMERAL_TAINTS = KNOWN_EPHEMERAL_TAINTS
+    KNOWN_EPHEMERAL_TAINT_KEY_PREFIXES = KNOWN_EPHEMERAL_TAINT_KEY_PREFIXES
 
     def taints(self) -> list[Taint]:
         """Node taints, filtering the transient karpenter lifecycle taints that
@@ -109,8 +109,6 @@ class StateNode:
             # MatchTaint semantics: key + effect (the applying agent may set a
             # different value than the claim declared)
             startup = {(t.key, t.effect) for t in self.node_claim.spec.startup_taints}
-            from ..scheduling.taints import is_known_ephemeral_taint
-
             out = [
                 t
                 for t in out

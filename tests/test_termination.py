@@ -217,3 +217,25 @@ class TestDrainDepth:
         env.store.delete("Node", node.metadata.name)
         drain_rounds(env)
         assert env.registry.counter(m.NODES_TERMINATED_TOTAL).total() >= 1
+
+
+class TestLoadBalancerExclusion:
+    def test_terminating_node_labeled_out_of_load_balancers(self):
+        # suite_test.go:202-224 — the exclusion label lands with the taint,
+        # BEFORE draining, so connections stop before the instance dies
+        from karpenter_tpu.controllers.node.termination import EXCLUDE_BALANCERS_LABEL_KEY
+        from karpenter_tpu.kube.objects import PodDisruptionBudget
+
+        pod = make_pod(cpu="100m", name="held", labels={"app": "held"})
+        env, node = env_with_node(pod)
+        # fully blocking PDB keeps the node alive long enough to observe
+        env.store.create(PodDisruptionBudget(
+            metadata=ObjectMeta(name="block", namespace="default"),
+            selector={"matchLabels": {"app": "held"}},
+            max_unavailable=0,
+        ))
+        env.store.delete("Node", node.metadata.name)
+        drain_rounds(env, rounds=1)
+        cur = env.store.try_get("Node", node.metadata.name)
+        assert cur is not None
+        assert cur.metadata.labels.get(EXCLUDE_BALANCERS_LABEL_KEY) == "karpenter"
