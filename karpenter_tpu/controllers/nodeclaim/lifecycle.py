@@ -16,6 +16,7 @@ from ...apis.nodeclaim import (
     NodeClaim,
 )
 from ...cloudprovider.errors import InsufficientCapacityError, NodeClassNotReadyError
+from ...kube.objects import OwnerReference
 from ...kube.store import NotFound
 from ...scheduling.taints import is_known_ephemeral_taint
 from ...utils import resources as res
@@ -116,6 +117,22 @@ class LifecycleController:
         # sync labels/taints/annotations from the claim onto the node; drop
         # the unregistered taint only once the hooks clear
         def apply(n):
+            # the claim owns its node (nodeclaim.go:271-287
+            # UpdateNodeOwnerReferences; registration_test.go:142-196) —
+            # added once, keyed on the claim's uid
+            if not any(
+                ref.kind == "NodeClaim" and ref.uid == nc.metadata.uid
+                for ref in n.metadata.owner_references
+            ):
+                n.metadata.owner_references.append(
+                    OwnerReference(
+                        kind="NodeClaim",
+                        name=nc.metadata.name,
+                        uid=nc.metadata.uid,
+                        api_version="karpenter.sh/v1",
+                        block_owner_deletion=True,
+                    )
+                )
             for k, v in nc.metadata.labels.items():
                 n.metadata.labels.setdefault(k, v)
             for k, v in nc.metadata.annotations.items():

@@ -348,3 +348,20 @@ class TestEphemeralTaintInitialization:
     def test_unrelated_taint_does_not_block(self):
         env, node, nc = self._with_taint("custom/fine")
         assert nc.is_initialized()
+
+
+class TestNodeOwnerReference:
+    def test_owner_reference_added_once(self):
+        # registration_test.go:142-196 — the claim owns its node; re-syncs
+        # must not duplicate the reference
+        env = make_env()
+        env.store.create(make_pod(cpu="100m", name="p"))
+        env.settle(rounds=4)
+        nc = env.store.list("NodeClaim")[0]
+        node = env.store.get("Node", nc.status.node_name)
+        owners = [r for r in node.metadata.owner_references if r.kind == "NodeClaim"]
+        assert len(owners) == 1
+        assert owners[0].uid == nc.metadata.uid and owners[0].block_owner_deletion
+        env.settle(rounds=2)  # extra reconciles: still exactly one
+        node = env.store.get("Node", nc.status.node_name)
+        assert len([r for r in node.metadata.owner_references if r.kind == "NodeClaim"]) == 1
