@@ -387,18 +387,39 @@ def bench_affinity(n_pods: int, n_types: int) -> float:
 
 
 def bench_fallback_path(n_pods: int, n_types: int) -> float:
-    """An OUT-of-window 50k workload (5% preferred-affinity pods) through the
-    production solver — measures the true cost of the host FFD fallback at
-    scale so it is tracked round-over-round instead of hidden (VERDICT r3
-    weak #2). Returns e2e seconds of one solve."""
+    """An OUT-of-window workload (5% preferred-affinity pods) through the
+    production solver with the hybrid partitioner DISABLED — the legacy
+    whole-snapshot host-FFD cliff, measured so the hybrid win stays visible
+    round-over-round (VERDICT r3 weak #2). Returns e2e seconds of one solve."""
     from karpenter_tpu.solver.tpu import TPUSolver
 
     snap = build_snapshot(n_pods, n_types, fallback_frac=0.05)
-    solver = TPUSolver()
+    solver = TPUSolver(hybrid=False)
     t0 = time.perf_counter()
     results = solver.solve(snap)
     dt = time.perf_counter() - t0
     assert solver.last_backend == "ffd-fallback"
+    assert not results.pod_errors
+    return dt
+
+
+def bench_hybrid_path(n_pods: int, n_types: int) -> float:
+    """The SAME out-of-window workload through the hybrid partitioned solver:
+    the 95% in-window majority packs on the tensor path and only the 5%
+    preferred-affinity residual runs the exact host FFD against the tensor
+    result's node state. Warm (the tensor kernel compiles on the first call);
+    returns e2e seconds of one solve, asserting the merged placement is
+    complete and really came from the hybrid backend."""
+    from karpenter_tpu.solver.tpu import TPUSolver
+
+    snap = build_snapshot(n_pods, n_types, fallback_frac=0.05)
+    solver = TPUSolver()
+    results = solver.solve(snap)  # warm: jit compile on this shape
+    assert solver.last_backend == "hybrid", (solver.last_backend, solver.last_fallback_reasons[:3])
+    t0 = time.perf_counter()
+    results = solver.solve(snap)
+    dt = time.perf_counter() - t0
+    assert solver.last_backend == "hybrid"
     assert not results.pod_errors
     return dt
 
@@ -683,6 +704,12 @@ def main():
         fb = _run_scenario("fallback", bench_fallback_path, n_fb, n_types)
         if fb is not None:
             extra[f"fallback_{n_fb}pods_seconds"] = round(fb, 4)
+        # the same snapshot through the hybrid partitioned solver: tensor
+        # majority + host residual (the order-of-magnitude win over the line
+        # above — ISSUE 1 acceptance: <= 5s where whole-snapshot FFD took 41s)
+        hy = _run_scenario("hybrid", bench_hybrid_path, n_fb, n_types)
+        if hy is not None:
+            extra[f"hybrid_{n_fb}pods_seconds"] = round(hy, 4)
     # the host FFD fallback path vs the reference's 100 pods/sec floor
     ffd = _run_scenario("ffd", bench_ffd, 1000)
     if ffd is not None:

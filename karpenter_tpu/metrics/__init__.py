@@ -64,6 +64,7 @@ CLUSTER_STATE_NODE_COUNT = "karpenter_cluster_state_node_count"
 SOLVER_SOLVE_TOTAL = "karpenter_solver_solve_total"
 SOLVER_FALLBACK_TOTAL = "karpenter_solver_fallback_total"
 SOLVER_VALIDATION_FAILURES_TOTAL = "karpenter_solver_validation_failures_total"
+SOLVER_HYBRID_RESIDUAL_TOTAL = "karpenter_solver_hybrid_residual_total"
 
 
 def make_registry() -> Registry:
@@ -111,6 +112,11 @@ def make_registry() -> Registry:
     r.counter(SOLVER_SOLVE_TOTAL, "Solves by backend actually used", ("backend",))
     r.counter(SOLVER_FALLBACK_TOTAL, "Tensor-path solves that fell back to the host FFD", ("reason",))
     r.counter(SOLVER_VALIDATION_FAILURES_TOTAL, "Device placements rejected by the post-solve validator", ())
+    r.counter(
+        SOLVER_HYBRID_RESIDUAL_TOTAL,
+        "Hybrid partitioned solves that routed a pod-local residual to the host FFD, by reason family",
+        ("reason",),
+    )
     return r
 
 

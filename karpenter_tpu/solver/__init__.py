@@ -6,8 +6,11 @@ provisioning controller. Two implementations:
 - `ffd.FFDSolver` — the exact host scheduler (default, correctness oracle)
 - `tpu.TPUSolver` — batched tensor solver on TPU via JAX; handles the common
   constraint families (resources, requirements/taints compatibility, zonal
-  topology spread, hostname spread/anti-affinity) and falls back to FFD when a
-  pod uses constraints outside the tensor subset.
+  topology spread, hostname spread/anti-affinity). Snapshots with POD-LOCAL
+  out-of-window constraints take the HYBRID partitioned path (tensor
+  majority + host FFD residual against the tensor node state); snapshot-
+  global reasons fall back to FFD wholesale (see README "Solver backend
+  decision tree" and solver/fallback.py).
 """
 
 from .ffd import FFDSolver  # noqa: F401

@@ -176,6 +176,12 @@ class EncodedSnapshot:
     counts_host_existing: np.ndarray  # [G, n_existing] i32
 
     fallback_reasons: list[str] = field(default_factory=list)
+    # hybrid-partition attribution (solver/fallback.py tiers): signature ids
+    # flagged by POD-LOCAL reasons, and whether any snapshot-GLOBAL reason
+    # fired. A snapshot with reasons, no global flag, and a proper subset of
+    # signatures flagged is a hybrid candidate (hybrid_partition).
+    fallback_sig_local: frozenset = frozenset()
+    fallback_has_global: bool = False
     # True when any pod carries relaxable soft constraints the pack honored
     # tier-0; an unplaced pod then re-solves via the host relaxation loop
     has_relaxable: bool = False
@@ -343,12 +349,51 @@ def pod_signature(pod) -> tuple:
     )
 
 
+class CapabilityReport:
+    """Attributed capability findings: the bounded reason list (deduped by
+    family — at most `MAX_REASONS_PER_FAMILY` examples each, so metrics and
+    logs stay low-cardinality while still seeing every FAMILY in play), the
+    signature indices flagged by pod-local reasons, and whether any
+    snapshot-global reason fired. `sig_local` indexes into the `pods`
+    sequence handed to `capability_report` (signature ids when the encode's
+    representatives are passed)."""
+
+    MAX_REASONS_PER_FAMILY = 3
+
+    def __init__(self):
+        self.reasons: list[str] = []
+        self.sig_local: set[int] = set()
+        self.has_global: bool = False
+        self._fam_counts: dict[str, int] = {}
+
+    def add(self, reason: str, sig: int | None = None) -> None:
+        from .fallback import is_pod_local, reason_family
+
+        fam = reason_family(reason)
+        n = self._fam_counts.get(fam, 0)
+        if n < self.MAX_REASONS_PER_FAMILY and reason not in self.reasons:
+            self.reasons.append(reason)
+            self._fam_counts[fam] = n + 1
+        if sig is not None and is_pod_local(fam):
+            self.sig_local.add(sig)
+        else:
+            self.has_global = True
+
+
 def check_capability(snap, pods=None, vol_comps=None) -> list[str]:
     """Reasons the snapshot cannot run on the tensor path (empty = OK).
     `pods` defaults to the snapshot's; pass signature representatives to check
     each unique shape once. `vol_comps` (parallel to `pods`) supplies
     already-resolved volume components so the encode's signature loop and
-    this check never resolve the same claims twice.
+    this check never resolve the same claims twice."""
+    return capability_report(snap, pods, vol_comps).reasons
+
+
+def capability_report(snap, pods=None, vol_comps=None) -> CapabilityReport:
+    """Attributed variant of `check_capability`: EVERY offending pod shape is
+    scanned (no first-reason short-circuit across pods), reasons are
+    collected bounded and deduped by family, and pod-local reasons carry the
+    signature index they belong to — the hybrid partitioner's input.
 
     Relaxable soft constraints (preferred node affinity, node-affinity
     OR-terms, ScheduleAnyway spreads) are IN-window under the default Respect
@@ -356,17 +401,16 @@ def check_capability(snap, pods=None, vol_comps=None) -> list[str]:
     any relaxation (preferences.go:40-55 relaxes only on failure), and
     TPUSolver falls back to the host relaxation loop only if a pod is left
     unplaced with soft constraints in play."""
-    reasons = []
+    report = CapabilityReport()
     respect = getattr(snap, "preference_policy", "Respect") == "Respect"
     if snap.min_values_policy != "Strict":
         pass  # relaxation happens host-side per claim decode; fine
     for np_ in snap.node_pools:
         reqs = Requirements.from_node_selector_terms(np_.spec.template.requirements)
         if reqs.has_min_values():
-            reasons.append("nodepool uses minValues")
+            report.add("nodepool uses minValues")
             break
     rep_pods = list(pods if pods is not None else snap.pods)
-    _vol_lowering = None  # one lowering for all reps (per-solve SC/PV memos)
     # required anti-affinity is modeled as symmetric per-domain groups
     # (members = pods matched by the selector); that is exact only when the
     # declaring set and the matched set coincide (pure self-anti-affinity,
@@ -377,109 +421,25 @@ def check_capability(snap, pods=None, vol_comps=None) -> list[str]:
     # spread/anti groups are exact either way via the owner/member mask
     # split; hostname affinity keeps the symmetric window because its
     # bootstrap rule reads self-selection.)
-    reasons.extend(_anti_symmetry_reasons(rep_pods))
-    reasons.extend(_spread_symmetry_reasons(rep_pods))
-    reasons.extend(_affinity_symmetry_reasons(rep_pods))
-    if reasons:
-        return reasons
+    for r in _anti_symmetry_reasons(rep_pods) + _spread_symmetry_reasons(rep_pods) + _affinity_symmetry_reasons(rep_pods):
+        report.add(r)
+    if report.reasons:
+        return report
+    _vol_lowering = None  # one lowering for all reps (per-solve SC/PV memos)
+
+    def resolve_comp(idx, pod):
+        nonlocal _vol_lowering
+        if vol_comps is not None:
+            return vol_comps[idx]
+        from .volumes import VolumeLowering
+
+        if _vol_lowering is None:
+            _vol_lowering = VolumeLowering(snap.store)
+        return _vol_lowering.component(pod)
+
     for idx, pod in enumerate(rep_pods):
-        aff = pod.spec.affinity
-        if aff is not None:
-            if aff.pod_affinity_preferred:
-                # soft constraint: the host relaxation loop owns it
-                reasons.append(f"{pod.key()}: preferred pod affinity")
-                break
-            if aff.pod_affinity_required:
-                # required affinity is in-window (KIND_DOM_AFF/KIND_HOST_AFF:
-                # members co-locate in recorded domains, bootstrapping one
-                # when none is reachable — topology.go:246-282) for the
-                # single-term, selector-symmetric, uncombined case
-                if len(aff.pod_affinity_required) > 1:
-                    reasons.append(f"{pod.key()}: multiple pod affinity terms")
-                    break
-                term = aff.pod_affinity_required[0]
-                if term.namespaces or term.namespace_selector is not None:
-                    reasons.append(f"{pod.key()}: pod affinity with explicit namespaces")
-                    break
-                if (
-                    pod.spec.topology_spread_constraints
-                    or aff.pod_anti_affinity_required
-                    or aff.pod_anti_affinity_preferred
-                ):
-                    reasons.append(f"{pod.key()}: pod affinity combined with other topology constraints")
-                    break
-            if aff.pod_anti_affinity_preferred:
-                reasons.append(f"{pod.key()}: preferred anti-affinity")
-                break
-            if any(t.namespaces or t.namespace_selector is not None for t in aff.pod_anti_affinity_required):
-                reasons.append(f"{pod.key()}: anti-affinity with explicit namespaces")
-                break
-            na = aff.node_affinity
-            if not respect and na is not None and (na.preferred or len(na.required) > 1):
-                # Ignore policy drops preferences host-side pre-solve; keep
-                # the conservative window there
-                reasons.append(f"{pod.key()}: relaxable node affinity")
-                break
-        used_keys = {t.topology_key for t in pod.spec.topology_spread_constraints if t.topology_key != wk.HOSTNAME_LABEL_KEY}
-        dom_anti_terms = [t for t in (aff.pod_anti_affinity_required if aff else []) if t.topology_key != wk.HOSTNAME_LABEL_KEY]
-        if aff is not None:
-            used_keys |= {t.topology_key for t in dom_anti_terms}
-        if len(used_keys) > 1:
-            # the pack scan commits one domain key per placement batch
-            reasons.append(f"{pod.key()}: topology constraints over multiple domain keys")
-            break
-        if dom_anti_terms and (
-            any(t.topology_key != wk.HOSTNAME_LABEL_KEY for t in pod.spec.topology_spread_constraints)
-            or len({(t.topology_key, _sel_key(t.label_selector)) for t in dom_anti_terms}) > 1
-        ):
-            # keyed anti-affinity uses the reference's block-all-possible-
-            # domains semantics (topology.go Record for anti), which the
-            # kernel models as a dedicated sequential path — one dom group
-            # per item there
-            reasons.append(f"{pod.key()}: combined keyed anti-affinity constraints")
-            break
-        for tsc in pod.spec.topology_spread_constraints:
-            if tsc.when_unsatisfiable != "DoNotSchedule" and not respect:
-                reasons.append(f"{pod.key()}: ScheduleAnyway spread")
-                break
-            if tsc.node_taints_policy == "Honor":
-                # taint-filtered domain registration/counting stays host-side
-                reasons.append(f"{pod.key()}: spread taint policy")
-                break
-            if tsc.topology_key != wk.HOSTNAME_LABEL_KEY and _node_filter_unexpressible(pod, tsc):
-                # the kernel's per-item allowed-domain masking IS the Honor
-                # node filter when the filter only constrains the spread's own
-                # topology key; anything wider stays host-side
-                reasons.append(f"{pod.key()}: node-filtered spread counting")
-                break
-        else:
-            from .volumes import VolumeLowering, has_pvc_volumes, window_reasons
-
-            if has_pvc_volumes(pod):
-                # the common case (single topology alternative, per-driver
-                # attach limits) is tensorized (solver/volumes.py); only
-                # resolution-level gates remain here — encode() adds the
-                # cross-pod gates (shared claims) it alone can see
-
-                if getattr(snap, "store", None) is None:
-                    reasons.append(f"{pod.key()}: PVC-backed volumes (no store)")
-                    break
-                if vol_comps is not None:
-                    comp = vol_comps[idx]
-                else:
-                    if _vol_lowering is None:
-                        _vol_lowering = VolumeLowering(snap.store)
-                    comp = _vol_lowering.component(pod)
-                vol_rs = window_reasons(comp, pod)
-                if vol_rs:
-                    reasons.extend(vol_rs)
-                    break
-            if pod.spec.resource_claims:
-                # DRA's DFS decision tree stays host-side (SURVEY.md §7 stage 9)
-                reasons.append(f"{pod.key()}: dynamic resource claims")
-                break
-            continue
-        break
+        for r in _pod_window_reasons(snap, pod, respect, lambda p, i=idx: resolve_comp(i, p)):
+            report.add(r, sig=idx)
     # inverse anti-affinity from already-running pods IS tensorized: the
     # running pods' recorded domains cannot change during a solve, so their
     # inverse groups (topology.go:476-508) lower to STATIC per-signature
@@ -498,8 +458,146 @@ def check_capability(snap, pods=None, vol_comps=None) -> list[str]:
             for o in it.offerings
         )
     ):
-        reasons.append("strict reserved-offering mode with reserved offerings")
-    return reasons
+        report.add("strict reserved-offering mode with reserved offerings")
+    return report
+
+
+def _pod_window_reasons(snap, pod, respect: bool, resolve_comp) -> list[str]:
+    """The in-window gate for ONE pod shape: returns its fallback reasons
+    (empty = in-window). Checks short-circuit at the pod level — the first
+    offending constraint family describes the pod — but the caller scans
+    every representative, so the snapshot-wide picture is complete."""
+    aff = pod.spec.affinity
+    if aff is not None:
+        if aff.pod_affinity_preferred:
+            # soft constraint: the host relaxation loop owns it
+            return [f"{pod.key()}: preferred pod affinity"]
+        if aff.pod_affinity_required:
+            # required affinity is in-window (KIND_DOM_AFF/KIND_HOST_AFF:
+            # members co-locate in recorded domains, bootstrapping one
+            # when none is reachable — topology.go:246-282) for the
+            # single-term, selector-symmetric, uncombined case
+            if len(aff.pod_affinity_required) > 1:
+                return [f"{pod.key()}: multiple pod affinity terms"]
+            term = aff.pod_affinity_required[0]
+            if term.namespaces or term.namespace_selector is not None:
+                return [f"{pod.key()}: pod affinity with explicit namespaces"]
+            if (
+                pod.spec.topology_spread_constraints
+                or aff.pod_anti_affinity_required
+                or aff.pod_anti_affinity_preferred
+            ):
+                return [f"{pod.key()}: pod affinity combined with other topology constraints"]
+        if aff.pod_anti_affinity_preferred:
+            return [f"{pod.key()}: preferred anti-affinity"]
+        if any(t.namespaces or t.namespace_selector is not None for t in aff.pod_anti_affinity_required):
+            return [f"{pod.key()}: anti-affinity with explicit namespaces"]
+        na = aff.node_affinity
+        if not respect and na is not None and (na.preferred or len(na.required) > 1):
+            # Ignore policy drops preferences host-side pre-solve; keep
+            # the conservative window there
+            return [f"{pod.key()}: relaxable node affinity"]
+    used_keys = {t.topology_key for t in pod.spec.topology_spread_constraints if t.topology_key != wk.HOSTNAME_LABEL_KEY}
+    dom_anti_terms = [t for t in (aff.pod_anti_affinity_required if aff else []) if t.topology_key != wk.HOSTNAME_LABEL_KEY]
+    if aff is not None:
+        used_keys |= {t.topology_key for t in dom_anti_terms}
+    if len(used_keys) > 1:
+        # the pack scan commits one domain key per placement batch
+        return [f"{pod.key()}: topology constraints over multiple domain keys"]
+    if dom_anti_terms and (
+        any(t.topology_key != wk.HOSTNAME_LABEL_KEY for t in pod.spec.topology_spread_constraints)
+        or len({(t.topology_key, _sel_key(t.label_selector)) for t in dom_anti_terms}) > 1
+    ):
+        # keyed anti-affinity uses the reference's block-all-possible-
+        # domains semantics (topology.go Record for anti), which the
+        # kernel models as a dedicated sequential path — one dom group
+        # per item there
+        return [f"{pod.key()}: combined keyed anti-affinity constraints"]
+    for tsc in pod.spec.topology_spread_constraints:
+        if tsc.when_unsatisfiable != "DoNotSchedule" and not respect:
+            return [f"{pod.key()}: ScheduleAnyway spread"]
+        if tsc.node_taints_policy == "Honor":
+            # taint-filtered domain registration/counting stays host-side
+            return [f"{pod.key()}: spread taint policy"]
+        if tsc.topology_key != wk.HOSTNAME_LABEL_KEY and _node_filter_unexpressible(pod, tsc):
+            # the kernel's per-item allowed-domain masking IS the Honor
+            # node filter when the filter only constrains the spread's own
+            # topology key; anything wider stays host-side
+            return [f"{pod.key()}: node-filtered spread counting"]
+    from .volumes import has_pvc_volumes, window_reasons
+
+    if has_pvc_volumes(pod):
+        # the common case (single topology alternative, per-driver
+        # attach limits) is tensorized (solver/volumes.py); only
+        # resolution-level gates remain here — encode() adds the
+        # cross-pod gates (shared claims) it alone can see
+        if getattr(snap, "store", None) is None:
+            return [f"{pod.key()}: PVC-backed volumes (no store)"]
+        vol_rs = window_reasons(resolve_comp(pod), pod)
+        if vol_rs:
+            return vol_rs
+    if pod.spec.resource_claims:
+        # DRA's DFS decision tree stays host-side (SURVEY.md §7 stage 9)
+        return [f"{pod.key()}: dynamic resource claims"]
+    return []
+
+
+def hybrid_partition(snap, enc) -> tuple[list, list] | None:
+    """Split an out-of-window snapshot into (tensor_pods, residual_pods), or
+    None when the whole snapshot must take the host FFD.
+
+    Eligible iff every fallback reason is POD-LOCAL (fallback.py tiers) and
+    the two halves are CONSTRAINT-INDEPENDENT: no topology group counts or
+    constrains signatures on both sides (a shared group would need joint
+    spread/affinity accounting the split cannot provide), and no flagged
+    pod's explicit-namespace (anti-)affinity term selects a tensor-side pod
+    across namespaces — the one coupling channel the same-namespace
+    `sig_member` matrix cannot see. Preferred (soft) terms are exempt from
+    the coupling gate: the host relaxation loop peels them on failure, so
+    they can never make the combined placement infeasible."""
+    if not enc.fallback_reasons or enc.fallback_has_global:
+        return None
+    sig_local = enc.fallback_sig_local
+    if not sig_local:
+        return None
+    S = enc.n_sigs
+    flagged = np.zeros(S, dtype=bool)
+    flagged[list(sig_local)] = True
+    if flagged.all():
+        return None
+    # group coupling over the full-snapshot encode: `sig_member` marks every
+    # signature a group SELECTS, `sig_owner` every signature that DECLARES it
+    if enc.n_groups:
+        touches = enc.sig_member | enc.sig_owner
+        if (touches[flagged].any(axis=0) & touches[~flagged].any(axis=0)).any():
+            return None
+    # explicit-namespace required terms of flagged pods vs tensor-side reps
+    reps: dict[int, object] = {}
+    for i, p in enumerate(enc.pods):
+        reps.setdefault(int(enc.sig_of_pod[i]), p)
+    tensor_reps = [reps[s] for s in range(S) if not flagged[s] and s in reps]
+    for s in sig_local:
+        pod = reps.get(s)
+        aff = pod.spec.affinity if pod is not None else None
+        if aff is None:
+            continue
+        for term in list(aff.pod_affinity_required) + list(aff.pod_anti_affinity_required):
+            if not term.namespaces and term.namespace_selector is None:
+                continue
+            if getattr(snap, "store", None) is None:
+                return None  # cannot resolve the term's namespace span
+            nss = _term_namespaces(snap.store, pod, term)
+            for q in tensor_reps:
+                if (
+                    q.metadata.namespace in nss
+                    and term.label_selector is not None
+                    and match_label_selector(term.label_selector, q.metadata.labels)
+                ):
+                    return None
+    pod_flagged = flagged[enc.sig_of_pod]
+    tensor_pods = [p for i, p in enumerate(enc.pods) if not pod_flagged[i]]
+    residual_pods = [p for i, p in enumerate(enc.pods) if pod_flagged[i]]
+    return tensor_pods, residual_pods
 
 
 def _node_filter_unexpressible(pod, tsc) -> bool:
@@ -1265,27 +1363,24 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
 
     lowering: VolumeLowering | None = None
     vol_comp_of_sig: list = []  # parallel to rep_pods
-    vol_reasons: list[str] = []
-    pvc_owner: dict[str, str] = {}  # pvc id -> pod key (shared-claim gate)
+    # (sig id | None, reason): sig-attributed issues feed the hybrid
+    # partitioner; None marks snapshot-global ones (fallback.py decides tier)
+    vol_issues: list[tuple[int | None, str]] = []
+    pvc_owner: dict[str, tuple[str, int | None]] = {}  # pvc id -> (pod key, sig)
     for i, pod in enumerate(snap.pods):
         k = sig_of(pod)
         comp = None
+        pod_pvc_ids = ()
         if has_pvc_volumes(pod):
             if getattr(snap, "store", None) is None:
-                vol_reasons.append(f"{pod.key()}: PVC-backed volumes (no store)")
+                vol_issues.append((None, f"{pod.key()}: PVC-backed volumes (no store)"))
             else:
                 if lowering is None:
                     lowering = VolumeLowering(snap.store)
                 comp = lowering.component(pod)
             if comp is not None:
                 k = (k, ("vol", comp.fingerprint))
-                # the attach axes are additive per pod; the host counts
-                # DISTINCT claim ids, so a claim shared between solve pods
-                # (or k *new* references to one) must stay host-side
-                for pid in comp.pvc_ids:
-                    other = pvc_owner.setdefault(pid, pod.key())
-                    if other != pod.key():
-                        vol_reasons.append(f"{pod.key()}: pvc {pid} shared with {other}")
+                pod_pvc_ids = comp.pvc_ids
         sid = sig_ids.get(k)
         if sid is None:
             sid = len(rep_pods)
@@ -1293,7 +1388,16 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
             rep_pods.append(pod)
             vol_comp_of_sig.append(comp)
             if comp is not None:
-                vol_reasons.extend(window_reasons(comp, pod))
+                vol_issues.extend((sid, r) for r in window_reasons(comp, pod))
+        # the attach axes are additive per pod; the host counts DISTINCT
+        # claim ids, so a claim shared between solve pods (or k *new*
+        # references to one) must stay host-side — both holders' signatures
+        # are flagged so the host path sees every reference
+        for pid in pod_pvc_ids:
+            other_key, other_sid = pvc_owner.setdefault(pid, (pod.key(), sid))
+            if other_key != pod.key():
+                vol_issues.append((sid, f"{pod.key()}: pvc {pid} shared with {other_key}"))
+                vol_issues.append((other_sid, f"{other_key}: pvc {pid} shared with {pod.key()}"))
         sig_of_pod_raw[i] = sid
     S = len(rep_pods)
     if pvc_owner:
@@ -1301,8 +1405,9 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         # against the node's axis (the host dedupes by id — volumeusage.go)
         for sn in snap.state_nodes:
             hit = sn.volume_usage.attached_ids() & pvc_owner.keys()
-            if hit:
-                vol_reasons.append(f"pvc {next(iter(hit))} already attached on {sn.name()}")
+            for pid in hit:
+                owner_key, owner_sid = pvc_owner[pid]
+                vol_issues.append((owner_sid, f"{owner_key}: pvc {pid} already attached on {sn.name()}"))
 
     # requirement classes: signatures sharing (node_selector, affinity) lower
     # to the same Requirements — decode caches its per-claim instance-type
@@ -1324,8 +1429,10 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     for key0, cid in req_class_ids.items():
         req_class_keys[cid] = key0
 
-    reasons = check_capability(snap, rep_pods, vol_comps=vol_comp_of_sig)
-    reasons.extend(r for r in vol_reasons if r not in reasons)
+    report = capability_report(snap, rep_pods, vol_comps=vol_comp_of_sig)
+    for sid, r in vol_issues:
+        report.add(r, sig=sid)
+    reasons = report.reasons
 
     # -- per-signature heavy lowering -----------------------------------------
     respect = getattr(snap, "preference_policy", "Respect") == "Respect"
@@ -1712,6 +1819,8 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         counts_dom_init=counts_dom_init,
         counts_host_existing=counts_host_existing,
         fallback_reasons=reasons,
+        fallback_sig_local=frozenset(report.sig_local),
+        fallback_has_global=report.has_global,
         # PreferNoSchedule template taints block tier-0 and resolve via the
         # host relaxation toleration, so their presence makes any unplaced
         # pod a relaxation case (scheduler.go:146-151)

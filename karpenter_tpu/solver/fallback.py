@@ -1,0 +1,103 @@
+"""Fallback-reason families and their hybrid-solve tiers.
+
+Every reason string `check_capability` (and the solver's own validation /
+relaxation exits) can emit maps to exactly one FAMILY — a stable,
+low-cardinality label for metrics — and every family maps to a TIER that
+tells `TPUSolver.solve` how much of the snapshot the reason poisons:
+
+- ``pod-local``: the constraint is attributable to the offending pod's spec
+  signature alone (preferred affinity, multi-term affinity, explicit
+  namespaces, multi-domain-key spreads, ...). The snapshot can be
+  PARTITIONED: the tensor pack handles the majority and the exact host FFD
+  solves just the flagged residual against the tensor result's node state.
+- ``global``: the reason invalidates tensor semantics for the whole snapshot
+  (minValues, asymmetric selector memberships, kernel validation failures,
+  shared PVC claims, ...) — the entire solve runs on the host FFD.
+
+This module is import-cycle-free on purpose: both the encode layer (which
+attributes reasons to signatures) and the solver core (which partitions and
+labels metrics) read it.
+"""
+
+from __future__ import annotations
+
+POD_LOCAL = "pod-local"
+GLOBAL = "global"
+
+# fixed enum of fallback families: metric labels must be bounded, and reasons
+# embed pod keys / topology keys. Needles are matched IN ORDER — keep the
+# more specific needle ("asymmetric pod affinity") before its substring
+# family ("pod affinity").
+REASON_FAMILIES = (
+    ("validation", "validation"),
+    ("relaxation required", "relaxation"),
+    ("minValues", "min-values"),
+    ("asymmetric pod affinity", "asymmetric-pod-affinity"),
+    ("asymmetric anti-affinity", "asymmetric-anti-affinity"),
+    ("asymmetric spread membership", "asymmetric-spread-membership"),
+    ("pod affinity", "pod-affinity"),
+    ("combined keyed anti-affinity", "combined-keyed-anti-affinity"),
+    ("anti-affinity with explicit namespaces", "anti-affinity-namespaces"),
+    ("preferred anti-affinity", "preferred-anti-affinity"),
+    ("relaxable node affinity", "relaxable-node-affinity"),
+    ("ScheduleAnyway", "schedule-anyway-spread"),
+    ("multiple domain keys", "multi-domain-keys"),
+    ("spread taint policy", "spread-taint-policy"),
+    ("node-filtered spread", "node-filtered-spread"),
+    ("pvc multi-alternative topology", "pvc-multi-alternative"),
+    ("volume topology overlaps spread key", "pvc-spread-overlap"),
+    ("shared with", "pvc-shared-claim"),
+    ("already attached", "pvc-already-attached"),
+    ("PVC-backed volumes", "pvc-volumes"),
+    ("dynamic resource claims", "dra-claims"),
+    ("running pods with required anti-affinity", "running-anti-affinity"),
+    ("strict reserved-offering", "strict-reserved-offering"),
+    ("empty", "empty"),
+)
+
+# tier per family. "other" (an unrecognized reason) is deliberately GLOBAL:
+# an unattributable reason must take the conservative whole-snapshot path.
+FAMILY_TIERS: dict[str, str] = {
+    "validation": GLOBAL,
+    "relaxation": GLOBAL,
+    "min-values": GLOBAL,
+    "asymmetric-pod-affinity": GLOBAL,
+    "asymmetric-anti-affinity": GLOBAL,
+    "asymmetric-spread-membership": GLOBAL,
+    "pod-affinity": POD_LOCAL,
+    "combined-keyed-anti-affinity": POD_LOCAL,
+    "anti-affinity-namespaces": POD_LOCAL,
+    "preferred-anti-affinity": POD_LOCAL,
+    "relaxable-node-affinity": POD_LOCAL,
+    "schedule-anyway-spread": POD_LOCAL,
+    "multi-domain-keys": POD_LOCAL,
+    "spread-taint-policy": POD_LOCAL,
+    "node-filtered-spread": POD_LOCAL,
+    "pvc-multi-alternative": POD_LOCAL,
+    "pvc-spread-overlap": POD_LOCAL,
+    # cross-pod claim sharing / attachment dedupe needs the host's
+    # count-distinct semantics for EVERY holder of the claim; the encode
+    # attributes the reason to every holder's signature, so routing those
+    # signatures (all of them) to the host residual is sound
+    "pvc-shared-claim": POD_LOCAL,
+    "pvc-already-attached": POD_LOCAL,
+    # no store: the snapshot cannot resolve any volume component
+    "pvc-volumes": GLOBAL,
+    "dra-claims": POD_LOCAL,
+    "running-anti-affinity": GLOBAL,
+    "strict-reserved-offering": GLOBAL,
+    "empty": GLOBAL,
+    "other": GLOBAL,
+}
+
+
+def reason_family(reason: str) -> str:
+    """Stable low-cardinality label for a fallback reason."""
+    for needle, family in REASON_FAMILIES:
+        if needle in reason:
+            return family
+    return "other"
+
+
+def is_pod_local(family: str) -> bool:
+    return FAMILY_TIERS.get(family, GLOBAL) == POD_LOCAL

@@ -1,30 +1,119 @@
-"""FFDSolver: the exact host scheduler behind the Solver interface."""
+"""FFDSolver: the exact host scheduler behind the Solver interface, plus the
+hybrid residual path — the same Scheduler run against a node state
+pre-seeded with a tensor solve's placements."""
 
 from __future__ import annotations
 
+from ..apis import labels as wk
 from ..controllers.provisioning.scheduling import Results, Scheduler
+from ..controllers.provisioning.scheduling.scheduler import _subtract_max
+from ..scheduling.hostports import pod_host_ports
+from ..scheduling.requirements import Requirement
+from ..scheduling.volumeusage import get_volumes
+from ..utils import resources as res
 from .snapshot import SolverSnapshot
+
+
+def build_scheduler(snap: SolverSnapshot) -> Scheduler:
+    """One host Scheduler configured exactly from a SolverSnapshot."""
+    return Scheduler(
+        snap.store,
+        snap.cluster,
+        snap.node_pools,
+        snap.instance_types,
+        snap.state_nodes,
+        snap.daemonset_pods,
+        snap.clock,
+        preference_policy=snap.preference_policy,
+        min_values_policy=snap.min_values_policy,
+        enforce_consolidate_after=snap.enforce_consolidate_after,
+        deleting_node_names=snap.deleting_node_names,
+        dra_enabled=snap.dra_enabled,
+        reserved_capacity_enabled=snap.reserved_capacity_enabled,
+        reserved_offering_mode=snap.reserved_offering_mode,
+        collect_zone_metrics=snap.collect_zone_metrics,
+    )
 
 
 class FFDSolver:
     name = "ffd"
 
     def solve(self, snap: SolverSnapshot) -> Results:
-        scheduler = Scheduler(
-            snap.store,
-            snap.cluster,
-            snap.node_pools,
-            snap.instance_types,
-            snap.state_nodes,
-            snap.daemonset_pods,
-            snap.clock,
-            preference_policy=snap.preference_policy,
-            min_values_policy=snap.min_values_policy,
-            enforce_consolidate_after=snap.enforce_consolidate_after,
-            deleting_node_names=snap.deleting_node_names,
-            dra_enabled=snap.dra_enabled,
-            reserved_capacity_enabled=snap.reserved_capacity_enabled,
-            reserved_offering_mode=snap.reserved_offering_mode,
-            collect_zone_metrics=snap.collect_zone_metrics,
-        )
-        return scheduler.solve(snap.pods)
+        return build_scheduler(snap).solve(snap.pods)
+
+
+def solve_residual(snap: SolverSnapshot, residual_pods: list, tensor_results: Results) -> Results:
+    """The hybrid tail: run the exact host Scheduler on `residual_pods`
+    against the tensor result's node state — existing StateNodes pre-loaded
+    with the tensor-placed pods, and the freshly decoded NodeClaims adopted
+    as in-flight nodes the residual can schedule INTO (no
+    double-provisioning). Returns the MERGED Results: the tensor claims
+    (possibly holding residual pods now) plus any claims the residual opened,
+    every existing node with both halves' pods, and the union of pod errors.
+    """
+    scheduler = build_scheduler(snap)
+    _adopt_tensor_state(scheduler, snap, tensor_results)
+    results = scheduler.solve(residual_pods)
+    results.pod_errors.update(tensor_results.pod_errors)
+    # the zone metric would cover only the residual half — None marks it
+    # uncomputed rather than misreported (Results contract)
+    results.pending_pods_by_effective_zone = None
+    return results
+
+
+def _adopt_tensor_state(scheduler: Scheduler, snap: SolverSnapshot, tensor_results: Results) -> None:
+    """Fold a tensor solve's placements into a fresh Scheduler's state."""
+    # tensor-placed pods are pending (never bound in the store), but exclude
+    # them from topology counting anyway: the partition guarantees no
+    # residual group selects them, and counting them would double-book if
+    # that invariant is ever loosened
+    placed = [p for en in tensor_results.existing_nodes for p in en.pods]
+    placed += [p for nc in tensor_results.new_node_claims for p in nc.pods]
+    scheduler.topology.excluded_pods.update(p.metadata.uid for p in placed)
+
+    en_by_name = {en.name(): en for en in scheduler.existing_nodes}
+    for ten in tensor_results.existing_nodes:
+        if not ten.pods:
+            continue
+        en = en_by_name[ten.name()]
+        en.pods.extend(ten.pods)
+        en.remaining_resources = res.subtract(en.remaining_resources, res.requests_for_pods(ten.pods))
+        for pod in ten.pods:
+            en.host_port_usage.add(pod.key(), pod_host_ports(pod))
+            if snap.store is not None:
+                en.volume_usage.add(pod.key(), get_volumes(snap.store, pod))
+
+    for claim in tensor_results.new_node_claims:
+        _adopt_claim(scheduler, claim)
+        scheduler.new_node_claims.append(claim)
+
+
+def _adopt_claim(scheduler: Scheduler, claim) -> None:
+    """Rehydrate a decode-produced SchedulingNodeClaim into a live in-flight
+    claim (the decode builds claims with `__new__` — no topology, DRA, or
+    reservation plumbing — because the device result fully determines them),
+    then book its placements into this solve's shared state."""
+    claim.rehydrate(
+        scheduler.topology,
+        allocator=scheduler.allocator,
+        reservation_manager=scheduler.reservation_manager,
+        reserved_offering_mode=scheduler.reserved_offering_mode,
+    )
+    for pod in claim.pods:
+        ports = pod_host_ports(pod)
+        if ports:
+            for g in claim.daemon_overhead_groups:
+                g.host_port_usage.add(pod.key(), ports)
+    if claim.reserved_offerings and scheduler.reservation_manager is not None:
+        # carry the decode-time reservations into this solve's manager so
+        # residual claims can never oversubscribe them
+        scheduler.reservation_manager.reserve(claim.hostname, *claim.reserved_offerings)
+    # the in-flight hostname placeholder (dropped again by finalize());
+    # registering it lets residual hostname-keyed groups see the open slot
+    if not claim.requirements.has(wk.HOSTNAME_LABEL_KEY):
+        claim.requirements.add(Requirement(wk.HOSTNAME_LABEL_KEY, "In", [claim.hostname]))
+    scheduler.topology.register(wk.HOSTNAME_LABEL_KEY, claim.hostname)
+    # nodepool limit accounting, exactly like _add_to_new_node_claim
+    remaining = scheduler.remaining_resources.get(claim.nodepool_name)
+    if remaining is not None:
+        scheduler.remaining_resources[claim.nodepool_name] = _subtract_max(remaining, claim.instance_type_options)

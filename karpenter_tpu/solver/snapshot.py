@@ -29,3 +29,10 @@ class SolverSnapshot:
     # skip the effective-zone metric computation (consolidation simulations
     # discard it; scheduler.go computes it only on the provisioner path)
     collect_zone_metrics: bool = True
+
+    def with_pods(self, pods: list) -> "SolverSnapshot":
+        """The same solve context over a different pod set — the hybrid
+        partitioned solver's sub-snapshot constructor."""
+        import dataclasses
+
+        return dataclasses.replace(self, pods=pods)

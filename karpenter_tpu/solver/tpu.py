@@ -77,32 +77,9 @@ class _NullTopology:
         return Requirements()
 
 
-# fixed enum of fallback families (encode.check_capability's reason set):
-# metric labels must be bounded, and reasons embed pod keys / topology keys
-_REASON_FAMILIES = (
-    ("validation", "validation"),
-    ("relaxation required", "relaxation"),
-    ("minValues", "min-values"),
-    ("pod affinity", "pod-affinity"),
-    ("asymmetric anti-affinity", "asymmetric-anti-affinity"),
-    ("asymmetric spread membership", "asymmetric-spread-membership"),
-    ("combined keyed anti-affinity", "combined-keyed-anti-affinity"),
-    ("anti-affinity with explicit namespaces", "anti-affinity-namespaces"),
-    ("preferred anti-affinity", "preferred-anti-affinity"),
-    ("relaxable node affinity", "relaxable-node-affinity"),
-    ("ScheduleAnyway", "schedule-anyway-spread"),
-    ("multiple domain keys", "multi-domain-keys"),
-    ("spread taint policy", "spread-taint-policy"),
-    ("node-filtered spread", "node-filtered-spread"),
-    ("pvc multi-alternative topology", "pvc-multi-alternative"),
-    ("volume topology overlaps spread key", "pvc-spread-overlap"),
-    ("shared with", "pvc-shared-claim"),
-    ("already attached", "pvc-already-attached"),
-    ("PVC-backed volumes", "pvc-volumes"),
-    ("dynamic resource claims", "dra-claims"),
-    ("running pods with required anti-affinity", "running-anti-affinity"),
-    ("empty", "empty"),
-)
+# fallback families + hybrid tiers live in solver/fallback.py (shared with
+# the encode layer)
+from .fallback import reason_family as _reason_family
 
 
 class DecodeError(RuntimeError):
@@ -110,20 +87,29 @@ class DecodeError(RuntimeError):
     retried on the exact host path."""
 
 
-def _reason_family(reason: str) -> str:
-    """Stable low-cardinality label for a fallback reason."""
-    for needle, family in _REASON_FAMILIES:
-        if needle in reason:
-            return family
-    return "other"
+class _TensorFallback(Exception):
+    """Internal control flow: the tensor pack cannot stand behind this
+    placement (relaxation needed, validation failed, decode failed). The
+    production solve converts it into the host FFD fallback; the hybrid
+    orchestrator converts it into abandoning the partition."""
+
+    def __init__(self, reasons: list[str], family: str | None = None):
+        super().__init__("; ".join(reasons))
+        self.reasons = reasons
+        self.family = family
 
 
 class TPUSolver:
     name = "tpu"
 
-    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None, mesh=None):
+    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None, mesh=None, hybrid: bool = True):
         self.fallback = fallback or FFDSolver()
         self.force = force  # raise instead of falling back (tests)
+        # hybrid partitioned solve: when every fallback reason is pod-local,
+        # pack the in-window majority on the tensor path and run the exact
+        # host FFD only on the flagged residual (False = legacy whole-snapshot
+        # fallback, kept for benchmarking the cliff this removes)
+        self.hybrid = hybrid
         self.registry = registry
         # multi-chip growth path: a jax.sharding.Mesh shards the pack scan's
         # slot axis across devices (parallel/sharded.py); bit-identical to
@@ -139,7 +125,8 @@ class TPUSolver:
         # re-packs ONLY the delta items from this state (SURVEY.md §7
         # "incremental state -> device")
         self._resident: dict | None = None
-        self.last_solve_mode: str = ""  # "full" | "delta" (observability)
+        # set on EVERY exit path: "full" | "delta" | "hybrid" | "fallback"
+        self.last_solve_mode: str = ""
 
     def _pack(self, t, items, n_pods: int) -> dict:
         """Run the pack and land every host-needed output. The single-device
@@ -176,6 +163,7 @@ class TPUSolver:
         from ..metrics import SOLVER_FALLBACK_TOTAL, SOLVER_SOLVE_TOTAL
 
         self.last_backend = "ffd-fallback"
+        self.last_solve_mode = "fallback"
         self.last_fallback_reasons = reasons
         if family is None:
             family = _reason_family(reasons[0]) if reasons else "empty"
@@ -195,24 +183,35 @@ class TPUSolver:
         if enc.fallback_reasons:
             if self.force:
                 raise RuntimeError(f"tensor path unsupported: {enc.fallback_reasons}")
+            if self.hybrid:
+                hybrid = self._try_hybrid(snap, enc)
+                if hybrid is not None:
+                    return hybrid
             return self._fall_back(snap, enc.fallback_reasons)
         if enc.n_pods == 0 or enc.n_rows == 0:
             return self._fall_back(snap, ["empty snapshot"])
 
+        try:
+            # incremental re-solve: the encoder recognized this snapshot as
+            # the previous one plus/minus a few known-shape pods, and the
+            # previous pack's final carry is still device-resident —
+            # re-credit removals into it and scan ONLY the added delta
+            self.last_solve_mode = "full"
+            delta = self._solve_delta(snap, enc, delta_base)
+            if delta is not None:
+                return delta
+            return self._solve_full(snap, enc)
+        except _TensorFallback as e:
+            return self._fall_back(snap, e.reasons, family=e.family)
+
+    def _solve_full(self, snap: SolverSnapshot, enc, count: bool = True) -> Results:
+        """One full (non-delta) tensor pack + decode. Raises _TensorFallback
+        when the tensor path cannot stand behind the placement."""
         from ..models.scheduler_model_grouped import (
             assignment_from_triples,
             build_items,
             make_item_tensors,
         )
-
-        # incremental re-solve: the encoder recognized this snapshot as the
-        # previous one plus/minus a few known-shape pods, and the previous
-        # pack's final carry is still device-resident — re-credit removals
-        # into it and scan ONLY the added delta
-        self.last_solve_mode = "full"
-        delta = self._solve_delta(snap, enc, delta_base)
-        if delta is not None:
-            return delta
 
         # signature-grouped pack: device steps scale with UNIQUE pod shapes,
         # not pods (scheduler_model_grouped.py). Slot axis capped; retry
@@ -226,21 +225,59 @@ class TPUSolver:
             t = make_tensors(enc, with_pods=False)
             out = self._pack(t, items, enc.n_pods)
         assignment = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
-        return self._finish(snap, enc, assignment, out["slot_basis"], out["slot_zoneset"], t, out)
+        return self._finish(snap, enc, assignment, out["slot_basis"], out["slot_zoneset"], t, out, count=count)
 
-    def _finish(self, snap, enc, assignment, slot_basis, slot_zoneset, t, out, validated: bool = False) -> Results:
+    def _try_hybrid(self, snap: SolverSnapshot, enc) -> Results | None:
+        """Hybrid partitioned solve: when every fallback reason is POD-LOCAL
+        and the flagged residual is constraint-independent of the rest
+        (encode.hybrid_partition), pack the in-window majority on the tensor
+        path and run the exact host FFD on the residual ONLY — against the
+        tensor result's node state, so residual pods schedule into the
+        freshly proposed claims instead of double-provisioning. Returns the
+        merged Results, or None when the whole snapshot must fall back."""
+        from .encode import hybrid_partition
+
+        part = hybrid_partition(snap, enc)
+        if part is None:
+            return None
+        tensor_pods, residual_pods = part
+        sub_snap = snap.with_pods(tensor_pods)
+        sub_enc = encode(sub_snap, cache=self.encode_cache)
+        if getattr(sub_enc, "delta_base", None) is not None:
+            sub_enc.delta_base = None
+        if sub_enc.fallback_reasons or sub_enc.n_pods == 0 or sub_enc.n_rows == 0:
+            return None
+        try:
+            tensor_results = self._solve_full(sub_snap, sub_enc, count=False)
+        except _TensorFallback:
+            return None  # tensor majority couldn't stand: whole-snapshot FFD
+        from ..metrics import SOLVER_HYBRID_RESIDUAL_TOTAL, SOLVER_SOLVE_TOTAL
+        from .ffd import solve_residual
+
+        results = solve_residual(snap, residual_pods, tensor_results)
+        self.last_backend = "hybrid"
+        self.last_solve_mode = "hybrid"
+        self.last_fallback_reasons = enc.fallback_reasons
+        for family in sorted({_reason_family(r) for r in enc.fallback_reasons}):
+            self._count(SOLVER_HYBRID_RESIDUAL_TOTAL, reason=family)
+        self._count(SOLVER_SOLVE_TOTAL, backend="hybrid")
+        return results
+
+    def _finish(self, snap, enc, assignment, slot_basis, slot_zoneset, t, out, validated: bool = False, count: bool = True) -> Results:
         """The shared solve tail (full AND delta paths): relaxation check,
         fast_validate self-check, decode, resident-state save, metrics — so
         the two paths can never drift apart. `validated=True` skips the
         fast_validate re-run (the delta path validates BEFORE committing so a
-        stale carry retries the full pack instead of falling to FFD)."""
+        stale carry retries the full pack instead of falling to FFD).
+        `count=False` suppresses the per-backend solve counter (the hybrid
+        orchestrator counts the merged solve once, as backend="hybrid")."""
         # tier-0 honored every soft constraint; an unplaced pod means the
         # host relaxation loop (preferences.go:40-55) must take over — the
         # tensor pack cannot peel preferences per pod
         if enc.has_relaxable and (np.asarray(assignment) < 0).any():
             if self.force:
                 raise RuntimeError("tier-0 solve left relaxable pods unplaced")
-            return self._fall_back(snap, ["relaxation required: soft constraints unsatisfiable tier-0"], family="relaxation")
+            raise _TensorFallback(["relaxation required: soft constraints unsatisfiable tier-0"], family="relaxation")
 
         # every production solve self-checks before decode: a kernel bug must
         # fall back to the exact host path, never reach NodeClaim creation
@@ -252,14 +289,14 @@ class TPUSolver:
             self._count(SOLVER_VALIDATION_FAILURES_TOTAL)
             if self.force:
                 raise RuntimeError(f"tensor placement failed validation: {violations}")
-            return self._fall_back(snap, [f"validation: {v}" for v in violations], family="validation")
+            raise _TensorFallback([f"validation: {v}" for v in violations], family="validation")
         try:
             results = self._decode(snap, enc, assignment, slot_basis, slot_zoneset)
         except DecodeError as e:
             self._count(SOLVER_VALIDATION_FAILURES_TOTAL)
             if self.force:
                 raise
-            return self._fall_back(snap, [f"validation: {e}"], family="validation")
+            raise _TensorFallback([f"validation: {e}"], family="validation")
         if self.mesh is None and out.get("state") is not None:
             self._resident = dict(
                 enc=enc,
@@ -269,7 +306,8 @@ class TPUSolver:
                 slot_basis=np.asarray(slot_basis),
                 slot_zoneset=np.asarray(slot_zoneset),
             )
-        self._count(SOLVER_SOLVE_TOTAL, backend="tpu")
+        if count:
+            self._count(SOLVER_SOLVE_TOTAL, backend="tpu")
         return results
 
     def _solve_delta(self, snap: SolverSnapshot, enc, base) -> Results | None:

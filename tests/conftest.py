@@ -29,3 +29,17 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_collection_modifyitems(config, items):
+    """`heavy` implies `slow`: the two-tier design keeps multi-minute suites
+    out of the default/tier-1 run. The tier-1 harness selects `-m 'not
+    slow'` (which OVERRIDES the addopts marker expression rather than
+    composing with it), so without this hook every heavy suite would ride
+    into the fast tier and blow its time budget. `-m heavy` still selects
+    the heavy tier explicitly."""
+    import pytest
+
+    for item in items:
+        if "heavy" in item.keywords and "slow" not in item.keywords:
+            item.add_marker(pytest.mark.slow)

@@ -267,9 +267,35 @@ class TestFallbackUnpinning:
             ]
         )
         snap = make_snapshot(plain + [odd])
-        solver = TPUSolver()  # fallback allowed
+        solver = TPUSolver(hybrid=False)  # legacy whole-snapshot fallback
         solver.solve(snap)
         assert solver.last_backend == "ffd-fallback"
+        snap.pods.remove(odd)
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu", solver.last_fallback_reasons
+        assert not results.pod_errors
+
+    def test_removing_the_out_of_window_pod_after_hybrid_reengages_tensor_path(self):
+        # same shape through the DEFAULT (hybrid) solver: the pod-local
+        # reason routes to the hybrid partition first, and the pure tensor
+        # path re-engages once the offending pod leaves
+        from karpenter_tpu.apis import labels as wk
+        from karpenter_tpu.kube.objects import Affinity, PodAffinityTerm, WeightedPodAffinityTerm
+
+        plain = [make_pod(cpu="500m") for _ in range(6)]
+        odd = make_pod(cpu="500m")
+        odd.spec.affinity = Affinity(
+            pod_affinity_preferred=[
+                WeightedPodAffinityTerm(
+                    weight=1,
+                    term=PodAffinityTerm(label_selector={"x": "y"}, topology_key=wk.ZONE_LABEL_KEY),
+                )
+            ]
+        )
+        snap = make_snapshot(plain + [odd])
+        solver = TPUSolver()
+        solver.solve(snap)
+        assert solver.last_backend == "hybrid"
         snap.pods.remove(odd)
         results = solver.solve(snap)
         assert solver.last_backend == "tpu", solver.last_fallback_reasons
