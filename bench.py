@@ -403,25 +403,90 @@ def bench_fallback_path(n_pods: int, n_types: int) -> float:
     return dt
 
 
-def bench_hybrid_path(n_pods: int, n_types: int) -> float:
+def bench_hybrid_path(n_pods: int, n_types: int) -> dict:
     """The SAME out-of-window workload through the hybrid partitioned solver:
     the 95% in-window majority packs on the tensor path and only the 5%
     preferred-affinity residual runs the exact host FFD against the tensor
-    result's node state. Warm (the tensor kernel compiles on the first call);
-    returns e2e seconds of one solve, asserting the merged placement is
-    complete and really came from the hybrid backend."""
+    result's node state.
+
+    Returns a dict: `total` e2e seconds of one COLD hybrid solve (kernels
+    warm, no retained carry) with its encode/pack/residual phase split, the
+    from-scratch vs masked sub-encode comparison (the double-encode this PR
+    removed), and `warm_hybrid_resolve_1pod_seconds` — the steady-state
+    provisioner loop (one pod arrives, re-solve) through the hybrid-delta
+    path against the retained masked carry."""
+    import copy
+
+    from karpenter_tpu.solver.encode import encode, hybrid_partition, mask_encode
     from karpenter_tpu.solver.tpu import TPUSolver
 
     snap = build_snapshot(n_pods, n_types, fallback_frac=0.05)
     solver = TPUSolver()
     results = solver.solve(snap)  # warm: jit compile on this shape
     assert solver.last_backend == "hybrid", (solver.last_backend, solver.last_fallback_reasons[:3])
+
+    # cold hybrid, kernels warm: a FRESH solver (shared jit cache) so the
+    # hybrid-delta resubmit path cannot shortcut the measurement
+    cold_solver = TPUSolver()
     t0 = time.perf_counter()
-    results = solver.solve(snap)
-    dt = time.perf_counter() - t0
-    assert solver.last_backend == "hybrid"
+    results = cold_solver.solve(snap)
+    cold = time.perf_counter() - t0
+    assert cold_solver.last_backend == "hybrid" and cold_solver.last_solve_mode == "hybrid"
     assert not results.pod_errors
-    return dt
+    phases = dict(cold_solver.last_phase_seconds)
+
+    # the double-encode baseline the masked sub-encode replaces: full encode
+    # + from-scratch sub-encode vs full encode + mask_encode
+    enc = encode(snap)
+    tensor_pods, _resid = hybrid_partition(snap, enc)
+    t0 = time.perf_counter()
+    encode(snap.with_pods(tensor_pods))
+    sub_scratch = time.perf_counter() - t0
+    keep = [s for s in range(enc.n_sigs) if s not in enc.fallback_sig_local]
+    t0 = time.perf_counter()
+    mask_encode(enc, keep)
+    sub_masked = time.perf_counter() - t0
+
+    # steady-state loop: one new in-window pod per reconcile. First append
+    # compiles the delta-item shape; the second is the measured re-solve.
+    def one_more(s, i):
+        donor = next(
+            p
+            for p in s.pods
+            if p.spec.affinity is None
+            and not p.spec.topology_spread_constraints
+            and not p.metadata.labels
+            and not p.spec.node_selector
+            and not p.spec.volumes
+        )
+        extra = copy.deepcopy(donor)
+        extra.metadata.name = f"hybrid-delta-extra-{i}"
+        extra.metadata.uid = f"hybrid-delta-extra-uid-{i}"
+        return s.with_pods(list(s.pods) + [extra])
+
+    import statistics
+
+    s = one_more(snap, 0)
+    cold_solver.solve(s)  # compile the delta shape
+    assert cold_solver.last_solve_mode == "hybrid-delta", cold_solver.last_solve_mode
+    warm_times = []
+    for i in range(1, 4):
+        s = one_more(s, i)
+        t0 = time.perf_counter()
+        r = cold_solver.solve(s)
+        warm_times.append(time.perf_counter() - t0)
+        assert cold_solver.last_solve_mode == "hybrid-delta"
+        assert not r.pod_errors
+    warm_1pod = statistics.median(warm_times)
+    return {
+        "total": cold,
+        "encode_seconds": phases.get("encode", 0.0),
+        "pack_seconds": phases.get("pack", 0.0),
+        "residual_seconds": phases.get("residual", 0.0),
+        "sub_encode_scratch_seconds": sub_scratch,
+        "sub_encode_masked_seconds": sub_masked,
+        "warm_hybrid_resolve_1pod_seconds": warm_1pod,
+    }
 
 
 def bench_hostname_spread_xl() -> float:
@@ -707,9 +772,25 @@ def main():
         # the same snapshot through the hybrid partitioned solver: tensor
         # majority + host residual (the order-of-magnitude win over the line
         # above — ISSUE 1 acceptance: <= 5s where whole-snapshot FFD took 41s)
+        def _hybrid_extras(prefix: str, h: dict) -> None:
+            extra[f"{prefix}encode_seconds"] = round(h["encode_seconds"], 4)
+            extra[f"{prefix}pack_seconds"] = round(h["pack_seconds"], 4)
+            extra[f"{prefix}residual_seconds"] = round(h["residual_seconds"], 4)
+            extra[f"{prefix}sub_encode_scratch_seconds"] = round(h["sub_encode_scratch_seconds"], 4)
+            extra[f"{prefix}sub_encode_masked_seconds"] = round(h["sub_encode_masked_seconds"], 4)
+
         hy = _run_scenario("hybrid", bench_hybrid_path, n_fb, n_types)
         if hy is not None:
-            extra[f"hybrid_{n_fb}pods_seconds"] = round(hy, 4)
+            extra[f"hybrid_{n_fb}pods_seconds"] = round(hy["total"], 4)
+            _hybrid_extras("hybrid_", hy)
+            extra["warm_hybrid_resolve_1pod_seconds"] = round(hy["warm_hybrid_resolve_1pod_seconds"], 4)
+        # the ISSUE-2 acceptance scale: masked sub-encode + hybrid-delta at 2k
+        if n_fb != 2000:
+            hy2 = _run_scenario("hybrid_2k", bench_hybrid_path, 2000, n_types)
+            if hy2 is not None:
+                extra["hybrid_2000pods_seconds"] = round(hy2["total"], 4)
+                _hybrid_extras("hybrid_2k_", hy2)
+                extra["warm_hybrid_resolve_1pod_2k_seconds"] = round(hy2["warm_hybrid_resolve_1pod_seconds"], 4)
     # the host FFD fallback path vs the reference's 100 pods/sec floor
     ffd = _run_scenario("ffd", bench_ffd, 1000)
     if ffd is not None:

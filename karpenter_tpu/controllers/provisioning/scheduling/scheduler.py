@@ -121,6 +121,10 @@ class Scheduler:
             from .reservationmanager import ReservationManager
 
             self.reservation_manager = ReservationManager(instance_types)
+            if not self.reservation_manager.capacity:
+                # no reserved offerings anywhere: skip the per-can_add
+                # offering scan entirely (same guard the TPU decode applies)
+                self.reservation_manager = None
 
         # NodePools ordered by weight desc (provisioner.go:268-289)
         pools = sorted(node_pools, key=lambda np: (-np.spec.weight, np.metadata.name))
@@ -253,7 +257,7 @@ class Scheduler:
                 for rest in q.list():
                     pod_errors.setdefault(rest.metadata.uid, (rest, "scheduling simulation timed out"))
                 break
-            err = self._try_schedule(copy.deepcopy(pod))
+            err = self._try_schedule(pod)
             if err is not None:
                 pod_errors[pod.metadata.uid] = (pod, err)
                 self.topology.update(pod)
@@ -299,11 +303,20 @@ class Scheduler:
         )
 
     def _try_schedule(self, pod) -> str | None:
-        """Relaxation loop (scheduler.go:521-552)."""
+        """Relaxation loop (scheduler.go:521-552). The pod is copied lazily —
+        only right before the first relaxation mutates its spec — so the
+        dominant first-attempt success never pays the deepcopy, and the
+        caller's original stays pristine either way."""
+        import copy
+
+        relaxed = False
         while True:
             err = self._add(pod)
             if err is None:
                 return None
+            if not relaxed:
+                pod = copy.deepcopy(pod)
+                relaxed = True
             if not self.preferences.relax(pod):
                 return err
             self.topology.update(pod)

@@ -65,6 +65,7 @@ SOLVER_SOLVE_TOTAL = "karpenter_solver_solve_total"
 SOLVER_FALLBACK_TOTAL = "karpenter_solver_fallback_total"
 SOLVER_VALIDATION_FAILURES_TOTAL = "karpenter_solver_validation_failures_total"
 SOLVER_HYBRID_RESIDUAL_TOTAL = "karpenter_solver_hybrid_residual_total"
+SOLVER_ENCODE_SECONDS = "karpenter_solver_encode_seconds"
 
 
 def make_registry() -> Registry:
@@ -116,6 +117,14 @@ def make_registry() -> Registry:
         SOLVER_HYBRID_RESIDUAL_TOTAL,
         "Hybrid partitioned solves that routed a pod-local residual to the host FFD, by reason family",
         ("reason",),
+    )
+    # backend label values for SOLVER_SOLVE_TOTAL include "hybrid-delta":
+    # a warm hybrid re-solve that re-packed only the pod delta against the
+    # retained masked carry
+    r.histogram(
+        SOLVER_ENCODE_SECONDS,
+        "Host-side snapshot-encode duration, by mode (full | masked sub-encode | pod delta)",
+        ("mode",),
     )
     return r
 

@@ -386,13 +386,15 @@ class Requirements:
         (requirements.go:252-286). NotIn/DoesNotExist incoming operators are
         given a more specific 'conflicting' message like the reference.
         """
-        small, large = (self._m, incoming._m) if len(self._m) <= len(incoming._m) else (incoming._m, self._m)
+        sm, im = self._m, incoming._m
+        small, large = (sm, im) if len(sm) <= len(im) else (im, sm)
         negative = (Operator.NOT_IN, Operator.DOES_NOT_EXIST)
         for key in small:
             if key not in large:
                 continue
-            existing = self.get(key)
-            inc = incoming.get(key)
+            # stored keys are already normalized: skip the get() round-trip
+            existing = sm[key]
+            inc = im[key]
             if not existing.has_intersection(inc):
                 # Two negative requirements (NotIn/DoesNotExist) on the same key
                 # never conflict (requirements.go:258-265).

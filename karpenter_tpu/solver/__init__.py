@@ -8,9 +8,11 @@ provisioning controller. Two implementations:
   constraint families (resources, requirements/taints compatibility, zonal
   topology spread, hostname spread/anti-affinity). Snapshots with POD-LOCAL
   out-of-window constraints take the HYBRID partitioned path (tensor
-  majority + host FFD residual against the tensor node state); snapshot-
-  global reasons fall back to FFD wholesale (see README "Solver backend
-  decision tree" and solver/fallback.py).
+  majority from a MASKED sub-encode + host FFD residual against the tensor
+  node state; small pod deltas of the same hybrid snapshot re-pack
+  incrementally as "hybrid-delta"); snapshot-global reasons fall back to
+  FFD wholesale (see README "Solver backend decision tree" and
+  solver/fallback.py).
 """
 
 from .ffd import FFDSolver  # noqa: F401

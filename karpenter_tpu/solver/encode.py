@@ -192,6 +192,11 @@ class EncodedSnapshot:
     # cross-solve decode memo owned by the row artifacts (same lifetime as
     # the template objects its keys reference)
     decode_cache: dict = field(default_factory=dict)
+    # per-signature relaxability (already AND'ed with the Respect policy) and
+    # the pool-level PreferNoSchedule flag — kept split so `mask_encode` can
+    # recompute `has_relaxable` for a pod subset without re-reading pod specs
+    sig_relaxable: np.ndarray | None = None  # [S] bool
+    pools_prefer: bool = False
 
     @property
     def n_rows(self) -> int:
@@ -600,6 +605,117 @@ def hybrid_partition(snap, enc) -> tuple[list, list] | None:
     return tensor_pods, residual_pods
 
 
+def mask_encode(enc: EncodedSnapshot, keep_sig_ids) -> EncodedSnapshot:
+    """Derive the encode of a pod-subset snapshot by SLICING the full
+    encode's per-signature arrays instead of re-encoding from scratch — the
+    hybrid solver's sub-encode, at a fraction of the host cost.
+
+    `keep_sig_ids` selects signatures of `enc`; the result holds exactly the
+    pods of those signatures (same FFD order — sorting a subsequence by the
+    same keys preserves relative order), with signature ids renumbered
+    densely in ascending original order. The row/offering side, vocabulary,
+    domain axis, port vocabularies, and the cross-solve decode cache are
+    shared BY REFERENCE; only the host-side structures that genuinely depend
+    on the pod subset are rebuilt: the topology-group axis (groups survive
+    iff a kept signature DECLARES them — exactly the groups a from-scratch
+    sub-encode would discover), the requirement-class table, and the
+    relaxation flag. Axes may keep entries only dropped signatures
+    referenced (label values, domains, ports); kept signatures never match
+    them, so placement decisions are identical to
+    ``encode(snap.with_pods(kept_pods))``.
+
+    The kept signatures must be free of fallback attribution: masking a
+    snapshot-global encode, or keeping a flagged signature, would silently
+    drop constraints the host path was meant to handle."""
+    import dataclasses as _dc
+
+    S = enc.n_sigs
+    ids = np.asarray(sorted({int(s) for s in keep_sig_ids}), dtype=np.int64)
+    if ids.size and (ids[0] < 0 or ids[-1] >= S):
+        raise ValueError(f"keep_sig_ids out of range for {S} signatures")
+    if enc.fallback_has_global:
+        raise ValueError("cannot mask a snapshot-global encode")
+    flagged = enc.fallback_sig_local
+    if flagged and any(int(s) in flagged for s in ids):
+        raise ValueError("cannot keep a fallback-flagged signature")
+    keep = np.zeros(max(S, 1), dtype=bool)
+    keep[ids] = True
+    remap = np.full(max(S, 1), -1, dtype=np.int32)
+    remap[ids] = np.arange(ids.size, dtype=np.int32)
+
+    sig_of_pod = np.asarray(enc.sig_of_pod)
+    pod_keep = keep[sig_of_pod] if sig_of_pod.size else np.zeros(0, bool)
+    pods = [p for p, k in zip(enc.pods, pod_keep) if k]
+    new_sig_of_pod = remap[sig_of_pod[pod_keep]].astype(np.int32)
+
+    # groups survive iff a kept signature DECLARES them (the from-scratch
+    # sub-encode builds groups from declarations only; selector-matched
+    # non-declaring pods never create one)
+    G = enc.n_groups
+    if G and ids.size:
+        gidx = np.nonzero(enc.sig_owner[ids].any(axis=0))[0]
+    else:
+        gidx = np.zeros(0, np.int64)
+
+    # requirement classes renumber by first appearance over kept signatures;
+    # the CONTENT keys (req_class_keys) ride along so decode's cross-solve
+    # cache keys stay stable across the renumbering
+    new_rc = np.zeros(ids.size, dtype=np.int32)
+    cls_map: dict[int, int] = {}
+    new_keys: list = []
+    for i, s in enumerate(ids):
+        cid = int(enc.req_class_of_sig[int(s)])
+        nc = cls_map.get(cid)
+        if nc is None:
+            nc = len(new_keys)
+            cls_map[cid] = nc
+            new_keys.append(enc.req_class_keys[cid])
+        new_rc[i] = nc
+
+    sr = enc.sig_relaxable
+    masked = _dc.replace(
+        enc,
+        pods=pods,
+        sig_of_pod=new_sig_of_pod,
+        sig_req=enc.sig_req[ids],
+        sig_mask=enc.sig_mask[ids],
+        sig_taint_ok=enc.sig_taint_ok[ids],
+        sig_dom_allowed=enc.sig_dom_allowed[ids],
+        sig_member=enc.sig_member[np.ix_(ids, gidx)],
+        sig_owner=enc.sig_owner[np.ix_(ids, gidx)],
+        sig_requirements=[enc.sig_requirements[int(s)] for s in ids],
+        sig_requests=[enc.sig_requests[int(s)] for s in ids],
+        req_class_of_sig=new_rc,
+        req_class_keys=new_keys,
+        sig_host_blocked=enc.sig_host_blocked[ids],
+        sig_port_any=enc.sig_port_any[ids],
+        sig_port_wild=enc.sig_port_wild[ids],
+        sig_port_spec=enc.sig_port_spec[ids],
+        group_kind=enc.group_kind[gidx],
+        group_skew=enc.group_skew[gidx],
+        group_dom_key=enc.group_dom_key[gidx],
+        group_min_domains=enc.group_min_domains[gidx],
+        group_registered=enc.group_registered[gidx],
+        counts_dom_init=enc.counts_dom_init[gidx],
+        counts_host_existing=enc.counts_host_existing[gidx],
+        fallback_reasons=[],
+        fallback_sig_local=frozenset(),
+        fallback_has_global=False,
+        has_relaxable=bool(
+            enc.pools_prefer
+            or (sr[ids].any() if sr is not None and ids.size else False)
+            or (sr is None and enc.has_relaxable)
+        ),
+        sig_relaxable=sr[ids] if sr is not None else None,
+    )
+    # the [S, Kd] restriction cache slices exactly (it is a pure row-wise
+    # function of sig_dom_allowed)
+    cached = getattr(enc, "_sig_restrict", None)
+    if cached is not None:
+        masked._sig_restrict = cached[ids]
+    return masked
+
+
 def _node_filter_unexpressible(pod, tsc) -> bool:
     """True when the spread's effective Honor node-affinity filter
     (topologynodefilter.go; defaults: affinity=Honor) constrains anything the
@@ -989,13 +1105,13 @@ def _try_delta_encode(snap, cache: EncodeCache):
     if row_key != cache.last_row_key:
         return None
     if not added and not removed_raw:
+        # identical resubmit: the solver may treat this enc as its own delta
+        # base, so the delta arrays stamped when IT was created must not
+        # survive to be replayed against the already-merged carry
+        base.encode_mode = "delta"
+        base.delta_added_sigs = np.zeros(0, np.int32)
+        base.delta_removed_enc = np.zeros(0, np.int64)
         return base
-    # a fallback-pinned base must not chain through removals: the removed pod
-    # may have been the sole reason the snapshot was out-of-window, and
-    # dc.replace would carry the stale reason forever (appends are safe — all
-    # base pods remain, and appended pods reuse interned in-window shapes)
-    if removed_raw and base.fallback_reasons:
-        return None
     import dataclasses as _dc
 
     if removed_raw:
@@ -1017,6 +1133,24 @@ def _try_delta_encode(snap, cache: EncodeCache):
         kept_pods = list(base.pods)
         kept_sigs = base.sig_of_pod
 
+    # a fallback-pinned base chains through removals only when the encode's
+    # per-signature ATTRIBUTION can prove what the reasons become: with
+    # snapshot-global reasons the delta cannot re-derive them; with pod-local
+    # reasons, vacating EVERY flagged signature makes the snapshot clean,
+    # while vacating only some could not keep the right reason strings —
+    # those snapshots take the full encode (appends alone are always safe:
+    # all base pods remain and appended pods reuse interned shapes)
+    fb_fields: dict = {}
+    if removed_raw and base.fallback_reasons:
+        if base.fallback_has_global:
+            return None
+        occupied = {int(s) for s in np.unique(kept_sigs)} | {int(s) for s in added_sigs}
+        still = {s for s in base.fallback_sig_local if s in occupied}
+        if not still:
+            fb_fields = dict(fallback_reasons=[], fallback_sig_local=frozenset())
+        elif still != set(base.fallback_sig_local):
+            return None
+
     enc = _dc.replace(
         base,
         # base.pods is FFD-sorted; appended pods process after the batch,
@@ -1025,7 +1159,9 @@ def _try_delta_encode(snap, cache: EncodeCache):
         # so a full pack on this snapshot is count-identical to a fresh one
         pods=kept_pods + added,
         sig_of_pod=np.concatenate([kept_sigs, np.asarray(added_sigs, np.int32)]),
+        **fb_fields,
     )
+    enc.encode_mode = "delta"
     enc.delta_base = base
     enc.delta_added_sigs = np.asarray(added_sigs, np.int32)
     enc.delta_removed_enc = removed_enc
@@ -1773,6 +1909,8 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
                 group_registered[g] = (rows.universe_dom | existing_dom) & (dom_key_of == dk)
         group_registered |= counts_dom_init > 0
 
+    sig_relaxable = np.fromiter((respect and _is_relaxable(p) for p in rep_pods), dtype=bool, count=S)
+    pools_prefer = bool(pools_taint_prefer_no_schedule(snap.node_pools))
     enc_out = EncodedSnapshot(
         resource_names=rnames,
         vocab=vocab,
@@ -1824,10 +1962,11 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         # PreferNoSchedule template taints block tier-0 and resolve via the
         # host relaxation toleration, so their presence makes any unplaced
         # pod a relaxation case (scheduler.go:146-151)
-        has_relaxable=(respect and any(_is_relaxable(p) for p in rep_pods))
-        or pools_taint_prefer_no_schedule(snap.node_pools),
+        has_relaxable=bool(sig_relaxable.any()) or pools_prefer,
         req_class_keys=req_class_keys,
         decode_cache=rows.decode_cache,
+        sig_relaxable=sig_relaxable,
+        pools_prefer=pools_prefer,
     )
     if cache is not None:
         cache.last_enc = enc_out

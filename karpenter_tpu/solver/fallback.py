@@ -10,6 +10,10 @@ tells `TPUSolver.solve` how much of the snapshot the reason poisons:
   namespaces, multi-domain-key spreads, ...). The snapshot can be
   PARTITIONED: the tensor pack handles the majority and the exact host FFD
   solves just the flagged residual against the tensor result's node state.
+  The per-signature attribution also powers the hybrid-delta mode (the
+  tensor side is `encode.mask_encode` over the unflagged signatures, and a
+  removal delta that vacates every flagged signature re-derives the reason
+  set as empty) — see TPUSolver._solve_masked_delta.
 - ``global``: the reason invalidates tensor semantics for the whole snapshot
   (minValues, asymmetric selector memberships, kernel validation failures,
   shared PVC claims, ...) — the entire solve runs on the host FFD.

@@ -14,7 +14,7 @@ from ..utils import resources as res
 from .snapshot import SolverSnapshot
 
 
-def build_scheduler(snap: SolverSnapshot) -> Scheduler:
+def build_scheduler(snap: SolverSnapshot, collect_zone_metrics: bool | None = None) -> Scheduler:
     """One host Scheduler configured exactly from a SolverSnapshot."""
     return Scheduler(
         snap.store,
@@ -31,7 +31,7 @@ def build_scheduler(snap: SolverSnapshot) -> Scheduler:
         dra_enabled=snap.dra_enabled,
         reserved_capacity_enabled=snap.reserved_capacity_enabled,
         reserved_offering_mode=snap.reserved_offering_mode,
-        collect_zone_metrics=snap.collect_zone_metrics,
+        collect_zone_metrics=snap.collect_zone_metrics if collect_zone_metrics is None else collect_zone_metrics,
     )
 
 
@@ -51,12 +51,12 @@ def solve_residual(snap: SolverSnapshot, residual_pods: list, tensor_results: Re
     (possibly holding residual pods now) plus any claims the residual opened,
     every existing node with both halves' pods, and the union of pod errors.
     """
-    scheduler = build_scheduler(snap)
+    # the zone metric would cover only the residual half — skip computing it
+    # and mark it uncomputed rather than misreported (Results contract)
+    scheduler = build_scheduler(snap, collect_zone_metrics=False)
     _adopt_tensor_state(scheduler, snap, tensor_results)
     results = scheduler.solve(residual_pods)
     results.pod_errors.update(tensor_results.pod_errors)
-    # the zone metric would cover only the residual half — None marks it
-    # uncomputed rather than misreported (Results contract)
     results.pending_pods_by_effective_zone = None
     return results
 

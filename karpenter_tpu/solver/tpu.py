@@ -9,6 +9,8 @@ BASELINE.json ("the Go FFD path stays the default").
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from ..apis import labels as wk
@@ -125,8 +127,17 @@ class TPUSolver:
         # re-packs ONLY the delta items from this state (SURVEY.md §7
         # "incremental state -> device")
         self._resident: dict | None = None
-        # set on EVERY exit path: "full" | "delta" | "hybrid" | "fallback"
+        # hybrid-delta carry: the previous hybrid solve's FULL encode, its
+        # MASKED (tensor-side) encode, and the signature partition — a small
+        # pod delta of the same hybrid snapshot then re-packs only the delta
+        # against the masked device-resident state instead of re-encoding
+        # and re-packing the whole tensor majority
+        self._hybrid_state: dict | None = None
+        # set on EVERY exit path:
+        # "full" | "delta" | "hybrid" | "hybrid-delta" | "fallback"
         self.last_solve_mode: str = ""
+        # host-side wall-clock split of the last solve, for bench/observability
+        self.last_phase_seconds: dict[str, float] = {"encode": 0.0, "pack": 0.0, "residual": 0.0}
 
     def _pack(self, t, items, n_pods: int) -> dict:
         """Run the pack and land every host-needed output. The single-device
@@ -159,9 +170,17 @@ class TPUSolver:
         if self.registry is not None:
             self.registry.counter(metric).inc(**labels)
 
+    def _observe(self, metric: str, value: float, **labels) -> None:
+        if self.registry is not None:
+            self.registry.histogram(metric, labels=tuple(sorted(labels))).observe(value, **labels)
+
+    def _phase(self, name: str, dt: float) -> None:
+        self.last_phase_seconds[name] = self.last_phase_seconds.get(name, 0.0) + dt
+
     def _fall_back(self, snap: SolverSnapshot, reasons: list[str], family: str | None = None) -> Results:
         from ..metrics import SOLVER_FALLBACK_TOTAL, SOLVER_SOLVE_TOTAL
 
+        self._hybrid_state = None  # the host result supersedes any hybrid carry
         self.last_backend = "ffd-fallback"
         self.last_solve_mode = "fallback"
         self.last_fallback_reasons = reasons
@@ -172,7 +191,14 @@ class TPUSolver:
         return self.fallback.solve(snap)
 
     def solve(self, snap: SolverSnapshot) -> Results:
+        from ..metrics import SOLVER_ENCODE_SECONDS
+
+        self.last_phase_seconds = {"encode": 0.0, "pack": 0.0, "residual": 0.0}
+        t0 = time.perf_counter()
         enc = encode(snap, cache=self.encode_cache)
+        enc_dt = time.perf_counter() - t0
+        self._phase("encode", enc_dt)
+        self._observe(SOLVER_ENCODE_SECONDS, enc_dt, mode=getattr(enc, "encode_mode", "full"))
         # consume + clear the delta link IMMEDIATELY (even on the fallback
         # returns below): each link retains O(P) state, so an unbroken chain
         # across consecutive delta encodes would leak
@@ -184,7 +210,7 @@ class TPUSolver:
             if self.force:
                 raise RuntimeError(f"tensor path unsupported: {enc.fallback_reasons}")
             if self.hybrid:
-                hybrid = self._try_hybrid(snap, enc)
+                hybrid = self._try_hybrid(snap, enc, delta_base)
                 if hybrid is not None:
                     return hybrid
             return self._fall_back(snap, enc.fallback_reasons)
@@ -195,12 +221,16 @@ class TPUSolver:
             # incremental re-solve: the encoder recognized this snapshot as
             # the previous one plus/minus a few known-shape pods, and the
             # previous pack's final carry is still device-resident —
-            # re-credit removals into it and scan ONLY the added delta
+            # re-credit removals into it and scan ONLY the added delta (an
+            # identical resubmit carries no link but IS its own base: the
+            # empty delta revalidates and decodes straight from the carry)
             self.last_solve_mode = "full"
-            delta = self._solve_delta(snap, enc, delta_base)
+            delta = self._solve_delta(snap, enc, delta_base if delta_base is not None else enc)
             if delta is not None:
                 return delta
-            return self._solve_full(snap, enc)
+            results = self._solve_full(snap, enc)
+            self._hybrid_state = None  # a full pack supersedes any hybrid carry
+            return results
         except _TensorFallback as e:
             return self._fall_back(snap, e.reasons, family=e.family)
 
@@ -216,51 +246,161 @@ class TPUSolver:
         # signature-grouped pack: device steps scale with UNIQUE pod shapes,
         # not pods (scheduler_model_grouped.py). Slot axis capped; retry
         # uncapped on the rare overflow (every slot opened AND pods unplaced).
-        item_arrays, item_pods = build_items(enc)
-        items = make_item_tensors(item_arrays)
-        cap = enc.n_existing + min(enc.n_pods, 4096)
-        t = make_tensors(enc, n_slots=cap, with_pods=False)
-        out = self._pack(t, items, enc.n_pods)
-        if out["open_count"] == out["n_slots"] and int(out["leftovers"].sum()) > 0 and cap < enc.n_existing + enc.n_pods:
-            t = make_tensors(enc, with_pods=False)
+        t_start = time.perf_counter()
+        try:
+            item_arrays, item_pods = build_items(enc)
+            items = make_item_tensors(item_arrays)
+            cap = enc.n_existing + min(enc.n_pods, 4096)
+            t = make_tensors(enc, n_slots=cap, with_pods=False)
             out = self._pack(t, items, enc.n_pods)
-        assignment = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
-        return self._finish(snap, enc, assignment, out["slot_basis"], out["slot_zoneset"], t, out, count=count)
+            if out["open_count"] == out["n_slots"] and int(out["leftovers"].sum()) > 0 and cap < enc.n_existing + enc.n_pods:
+                t = make_tensors(enc, with_pods=False)
+                out = self._pack(t, items, enc.n_pods)
+            assignment = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
+            return self._finish(snap, enc, assignment, out["slot_basis"], out["slot_zoneset"], t, out, count=count)
+        finally:
+            self._phase("pack", time.perf_counter() - t_start)
 
-    def _try_hybrid(self, snap: SolverSnapshot, enc) -> Results | None:
+    def _try_hybrid(self, snap: SolverSnapshot, enc, delta_base=None) -> Results | None:
         """Hybrid partitioned solve: when every fallback reason is POD-LOCAL
         and the flagged residual is constraint-independent of the rest
         (encode.hybrid_partition), pack the in-window majority on the tensor
         path and run the exact host FFD on the residual ONLY — against the
         tensor result's node state, so residual pods schedule into the
-        freshly proposed claims instead of double-provisioning. Returns the
-        merged Results, or None when the whole snapshot must fall back."""
-        from .encode import hybrid_partition
+        freshly proposed claims instead of double-provisioning.
+
+        The sub-encode is derived by MASKING the full encode
+        (encode.mask_encode) — no second encode, and the full-snapshot
+        EncodeCache slot stays untouched. When the snapshot is a small pod
+        delta of the previous hybrid solve, the warm path re-packs only the
+        delta against the retained masked carry (`_solve_masked_delta`,
+        last_solve_mode="hybrid-delta"). Returns the merged Results, or None
+        when the whole snapshot must fall back."""
+        from ..metrics import SOLVER_ENCODE_SECONDS, SOLVER_HYBRID_RESIDUAL_TOTAL, SOLVER_SOLVE_TOTAL
+        from .encode import hybrid_partition, mask_encode
+        from .ffd import solve_residual
+
+        # warm path: a pod delta of the previous hybrid snapshot (an
+        # identical resubmit carries no link but IS its own base)
+        hs = self._hybrid_state
+        base = delta_base if delta_base is not None else (enc if hs is not None and hs["full_enc"] is enc else None)
+        if base is not None:
+            warm = self._solve_masked_delta(snap, enc, base)
+            if warm is not None:
+                return warm
 
         part = hybrid_partition(snap, enc)
         if part is None:
+            self._hybrid_state = None
             return None
-        tensor_pods, residual_pods = part
-        sub_snap = snap.with_pods(tensor_pods)
-        sub_enc = encode(sub_snap, cache=self.encode_cache)
-        if getattr(sub_enc, "delta_base", None) is not None:
-            sub_enc.delta_base = None
-        if sub_enc.fallback_reasons or sub_enc.n_pods == 0 or sub_enc.n_rows == 0:
+        _tensor_pods, residual_pods = part
+        keep = np.ones(enc.n_sigs, dtype=bool)
+        keep[[int(s) for s in enc.fallback_sig_local]] = False
+        t0 = time.perf_counter()
+        masked = mask_encode(enc, np.nonzero(keep)[0])
+        dt = time.perf_counter() - t0
+        self._phase("encode", dt)
+        self._observe(SOLVER_ENCODE_SECONDS, dt, mode="masked")
+        if masked.n_pods == 0 or masked.n_rows == 0:
+            self._hybrid_state = None
             return None
+        sub_snap = snap.with_pods(masked.pods)
         try:
-            tensor_results = self._solve_full(sub_snap, sub_enc, count=False)
+            tensor_results = self._solve_full(sub_snap, masked, count=False)
         except _TensorFallback:
+            self._hybrid_state = None
             return None  # tensor majority couldn't stand: whole-snapshot FFD
-        from ..metrics import SOLVER_HYBRID_RESIDUAL_TOTAL, SOLVER_SOLVE_TOTAL
-        from .ffd import solve_residual
-
+        remap = np.full(enc.n_sigs, -1, dtype=np.int32)
+        remap[keep] = np.arange(int(keep.sum()), dtype=np.int32)
+        self._hybrid_state = dict(full_enc=enc, masked_enc=masked, keep=keep, remap=remap)
+        t1 = time.perf_counter()
         results = solve_residual(snap, residual_pods, tensor_results)
+        self._phase("residual", time.perf_counter() - t1)
         self.last_backend = "hybrid"
         self.last_solve_mode = "hybrid"
         self.last_fallback_reasons = enc.fallback_reasons
         for family in sorted({_reason_family(r) for r in enc.fallback_reasons}):
             self._count(SOLVER_HYBRID_RESIDUAL_TOTAL, reason=family)
         self._count(SOLVER_SOLVE_TOTAL, backend="hybrid")
+        return results
+
+    def _solve_masked_delta(self, snap: SolverSnapshot, enc, base) -> Results | None:
+        """Hybrid-delta: `enc` is a pod-delta of `base` — the previous HYBRID
+        solve's full encode — and the resident carry is that solve's MASKED
+        (tensor-side) pack. Translate the delta into masked coordinates:
+        tensor-side removals re-credit and tensor-side additions re-pack
+        against the retained device state, while the (small) residual
+        re-solves on the exact host path against the fresh tensor results.
+        Returns the merged Results (last_solve_mode="hybrid-delta"), the pure
+        tensor Results when the residual emptied out ("delta"), or None when
+        the cold path must run."""
+        from ..metrics import SOLVER_ENCODE_SECONDS, SOLVER_HYBRID_RESIDUAL_TOTAL, SOLVER_SOLVE_TOTAL
+        from .encode import mask_encode
+        from .ffd import solve_residual
+
+        hs = self._hybrid_state
+        res = self._resident
+        if hs is None or res is None or base is None or self.mesh is not None:
+            return None
+        if hs["full_enc"] is not base or res["enc"] is not hs["masked_enc"]:
+            return None
+        keep = hs["keep"]  # bool [S] over the full encode's signature axis
+        if enc.n_sigs != keep.shape[0] or enc.fallback_has_global:
+            return None
+        # the delta's attribution must stay inside the retained partition: a
+        # newly-flagged tensor-side signature would invalidate the split
+        if any(keep[int(s)] for s in enc.fallback_sig_local):
+            return None
+        masked_base = hs["masked_enc"]
+        remap = hs["remap"]
+
+        removed = getattr(enc, "delta_removed_enc", None)
+        if removed is not None and removed.size:
+            base_keep_pod = keep[np.asarray(base.sig_of_pod)]
+            masked_pos = np.cumsum(base_keep_pod) - 1
+            tensor_removed = removed[base_keep_pod[removed]]
+            masked_removed = masked_pos[tensor_removed].astype(np.int64)
+        else:
+            masked_removed = np.zeros(0, np.int64)
+        added_sigs = getattr(enc, "delta_added_sigs", None)
+        if added_sigs is None or not added_sigs.size:
+            masked_added = np.zeros(0, np.int32)
+        else:
+            masked_added = remap[added_sigs[keep[added_sigs]]].astype(np.int32)
+
+        t0 = time.perf_counter()
+        masked_new = mask_encode(enc, np.nonzero(keep)[0])
+        dt = time.perf_counter() - t0
+        self._phase("encode", dt)
+        self._observe(SOLVER_ENCODE_SECONDS, dt, mode="masked")
+        if masked_new.n_pods == 0:
+            return None
+        masked_new.delta_removed_enc = masked_removed
+        masked_new.delta_added_sigs = masked_added
+        sub_snap = snap.with_pods(masked_new.pods)
+        try:
+            tensor_results = self._solve_delta(sub_snap, masked_new, masked_base, count=False)
+        except _TensorFallback:
+            return None  # the cold hybrid (or whole-snapshot fallback) takes over
+        if tensor_results is None:
+            return None
+        pod_flagged = ~keep[np.asarray(enc.sig_of_pod)]
+        residual_pods = [p for p, f in zip(enc.pods, pod_flagged) if f]
+        self._hybrid_state = dict(full_enc=enc, masked_enc=masked_new, keep=keep, remap=remap)
+        if not residual_pods:
+            # the out-of-window pods left the snapshot: a pure tensor delta
+            self.last_solve_mode = "delta"
+            self._count(SOLVER_SOLVE_TOTAL, backend="tpu")
+            return tensor_results
+        t1 = time.perf_counter()
+        results = solve_residual(snap, residual_pods, tensor_results)
+        self._phase("residual", time.perf_counter() - t1)
+        self.last_backend = "hybrid"
+        self.last_solve_mode = "hybrid-delta"
+        self.last_fallback_reasons = enc.fallback_reasons
+        for family in sorted({_reason_family(r) for r in enc.fallback_reasons}):
+            self._count(SOLVER_HYBRID_RESIDUAL_TOTAL, reason=family)
+        self._count(SOLVER_SOLVE_TOTAL, backend="hybrid-delta")
         return results
 
     def _finish(self, snap, enc, assignment, slot_basis, slot_zoneset, t, out, validated: bool = False, count: bool = True) -> Results:
@@ -310,7 +450,7 @@ class TPUSolver:
             self._count(SOLVER_SOLVE_TOTAL, backend="tpu")
         return results
 
-    def _solve_delta(self, snap: SolverSnapshot, enc, base) -> Results | None:
+    def _solve_delta(self, snap: SolverSnapshot, enc, base, count: bool = True) -> Results | None:
         """Incremental solve for a small pod delta in EITHER direction:
         removed pods' takes are re-credited into the previous pack's
         device-resident final carry, added pods' items are scanned from it,
@@ -321,8 +461,20 @@ class TPUSolver:
         (e.g. spread skew raised by vacating a min domain): such snapshots
         retry on the full TENSOR pack, never the FFD fallback."""
         res = self._resident
-        if base is None or res is None or res["enc"] is not base or self.mesh is not None:
+        if base is None or res is None or self.mesh is not None:
             return None
+        if res["enc"] is not base:
+            # the carry may be the MASKED pack of a previous hybrid solve
+            # whose full encode is `base` — translate the delta into masked
+            # coordinates and continue there
+            return self._solve_masked_delta(snap, enc, base)
+        t_start = time.perf_counter()
+        try:
+            return self._solve_delta_inner(snap, enc, base, count)
+        finally:
+            self._phase("pack", time.perf_counter() - t_start)
+
+    def _solve_delta_inner(self, snap: SolverSnapshot, enc, base, count: bool) -> Results | None:
         from ..models.scheduler_model import (
             KIND_DOM_AFF,
             KIND_DOM_ANTI,
@@ -340,6 +492,7 @@ class TPUSolver:
             recredit_removals,
         )
 
+        res = self._resident
         t = res["t"]
         state = res["state"]
         prev_assignment = res["assignment"]
@@ -378,7 +531,9 @@ class TPUSolver:
             keep[removed] = False
             prev_assignment = prev_assignment[keep]
 
-        added_sigs = enc.delta_added_sigs
+        added_sigs = getattr(enc, "delta_added_sigs", None)
+        if added_sigs is None:  # identical resubmit: an empty delta
+            added_sigs = np.zeros(0, np.int32)
         n_added = int(added_sigs.shape[0])
         n_prev = int(prev_assignment.shape[0])  # == enc.n_pods - n_added
         out = dict(state=state)
@@ -427,7 +582,7 @@ class TPUSolver:
         if fast_validate(enc, assignment, slot_basis, slot_zoneset):
             return None
         self.last_solve_mode = "delta"
-        return self._finish(snap, enc, assignment, slot_basis, slot_zoneset, t, out, validated=True)
+        return self._finish(snap, enc, assignment, slot_basis, slot_zoneset, t, out, validated=True, count=count)
 
     # -- decode ----------------------------------------------------------------
     def _decode(self, snap: SolverSnapshot, enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zoneset: np.ndarray) -> Results:

@@ -1,0 +1,263 @@
+"""Hybrid-delta: the steady-state provisioner loop over hybrid snapshots.
+
+PR 1's hybrid partitioned solve encoded every hybrid snapshot twice and
+poisoned the EncodeCache delta base with the sub-encode. Now the sub-encode
+is a MASK of the full encode (no second encode, cache untouched) and hybrid
+is a first-class mode of the delta machinery: a small pod delta of the
+previous hybrid snapshot re-packs only the delta against the retained masked
+carry (last_solve_mode == "hybrid-delta"), and the full-snapshot delta base
+survives a hybrid solve intact (full -> hybrid -> full-plus-one-pod resolves
+as "delta").
+"""
+
+import pytest
+
+from helpers import make_pod, zone_spread
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.kube.objects import (
+    Affinity,
+    PodAffinityTerm,
+    WeightedPodAffinityTerm,
+)
+from karpenter_tpu.metrics import (
+    SOLVER_ENCODE_SECONDS,
+    SOLVER_HYBRID_RESIDUAL_TOTAL,
+    SOLVER_SOLVE_TOTAL,
+    make_registry,
+)
+from karpenter_tpu.solver import FFDSolver
+from karpenter_tpu.solver.tpu import TPUSolver
+from test_solver import make_snapshot
+
+
+def odd_pod(name="odd", cpu="500m"):
+    """Pod-local out-of-window: preferred pod affinity."""
+    p = make_pod(cpu=cpu, name=name)
+    p.spec.affinity = Affinity(
+        pod_affinity_preferred=[
+            WeightedPodAffinityTerm(
+                weight=1,
+                term=PodAffinityTerm(label_selector={"matchLabels": {"x": "y"}}, topology_key=wk.ZONE_LABEL_KEY),
+            )
+        ]
+    )
+    return p
+
+
+def _placed_names(results):
+    names = set()
+    for nc in results.new_node_claims:
+        names.update(p.metadata.name for p in nc.pods)
+    for en in results.existing_nodes:
+        names.update(p.metadata.name for p in en.pods)
+    return names
+
+
+def _hybrid_snap(n_plain=6):
+    pods = [make_pod(cpu="500m", name=f"p{i}") for i in range(n_plain)] + [odd_pod()]
+    return make_snapshot(pods)
+
+
+class TestHybridDelta:
+    def test_identical_resubmit_takes_hybrid_delta(self):
+        snap = _hybrid_snap()
+        solver = TPUSolver()
+        r1 = solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid"
+        r2 = solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid-delta"
+        assert solver.last_backend == "hybrid"
+        assert not r2.pod_errors
+        assert _placed_names(r1) == _placed_names(r2)
+
+    def test_appended_pod_takes_hybrid_delta(self):
+        snap = _hybrid_snap()
+        solver = TPUSolver()
+        solver.solve(snap)
+        solver.solve(snap)  # land the hybrid carry + resubmit path
+        newcomer = make_pod(cpu="500m", name="newcomer")
+        snap.pods.append(newcomer)
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid-delta"
+        assert not r.pod_errors
+        assert newcomer.metadata.name in _placed_names(r)
+        assert len(_placed_names(r)) == 8
+
+    def test_appended_flagged_pod_grows_residual(self):
+        snap = _hybrid_snap()
+        solver = TPUSolver()
+        solver.solve(snap)
+        snap.pods.append(odd_pod(name="odd2"))
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid-delta"
+        assert not r.pod_errors
+        assert {"odd", "odd2"} <= _placed_names(r)
+
+    def test_removed_tensor_pod_recredits(self):
+        snap = _hybrid_snap()
+        solver = TPUSolver()
+        solver.solve(snap)
+        gone = snap.pods.pop(0)  # a plain (tensor-side) pod
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid-delta"
+        assert not r.pod_errors
+        assert gone.metadata.name not in _placed_names(r)
+        assert len(_placed_names(r)) == 6
+
+    def test_chained_hybrid_deltas(self):
+        snap = _hybrid_snap()
+        solver = TPUSolver()
+        solver.solve(snap)
+        for i in range(3):
+            snap.pods.append(make_pod(cpu="500m", name=f"n{i}"))
+            r = solver.solve(snap)
+            assert solver.last_solve_mode == "hybrid-delta"
+            assert not r.pod_errors
+        assert len(_placed_names(r)) == 10
+
+    def test_resubmit_after_delta_does_not_replay_stale_delta(self):
+        # review regression: full -> append (delta) -> IDENTICAL resubmit
+        # used to replay the consumed delta arrays against the merged carry
+        # (IndexError in assignment_from_triples) — pure tensor path
+        pods = [make_pod(cpu="500m", name=f"p{i}") for i in range(5)]
+        snap = make_snapshot(list(pods))
+        solver = TPUSolver()
+        solver.solve(snap)
+        snap.pods.append(make_pod(cpu="500m", name="p5"))
+        solver.solve(snap)
+        assert solver.last_solve_mode == "delta"
+        r = solver.solve(snap)  # identical resubmit
+        assert solver.last_solve_mode == "delta"
+        assert not r.pod_errors
+        assert len(_placed_names(r)) == 6
+
+    def test_resubmit_after_hybrid_delta_does_not_replay_stale_delta(self):
+        # same regression through the hybrid path: hybrid -> hybrid-delta
+        # (append) -> identical resubmit
+        snap = _hybrid_snap()
+        solver = TPUSolver()
+        solver.solve(snap)
+        snap.pods.append(make_pod(cpu="500m", name="pp"))
+        solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid-delta"
+        r = solver.solve(snap)  # identical resubmit
+        assert solver.last_solve_mode == "hybrid-delta"
+        assert not r.pod_errors
+        assert len(_placed_names(r)) == 8
+
+    def test_hybrid_delta_parity_with_pure_ffd(self):
+        snap = _hybrid_snap()
+        solver = TPUSolver()
+        solver.solve(snap)
+        snap.pods.append(make_pod(cpu="500m", name="extra"))
+        hybrid_results = solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid-delta"
+        ffd_results = FFDSolver().solve(make_snapshot(list(snap.pods)))
+        assert set(hybrid_results.pod_errors) == set(ffd_results.pod_errors) == set()
+        assert _placed_names(hybrid_results) == _placed_names(ffd_results)
+
+    def test_unseen_shape_falls_back_to_cold_hybrid(self):
+        snap = _hybrid_snap()
+        solver = TPUSolver()
+        solver.solve(snap)
+        # an unseen signature cannot ride the delta encode: cold hybrid re-runs
+        snap.pods.append(make_pod(cpu="333m", memory="333Mi", name="strange"))
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid"
+        assert solver.last_backend == "hybrid"
+        assert not r.pod_errors
+
+
+class TestEncodeCachePreserved:
+    def test_full_hybrid_full_plus_one_resolves_as_delta(self):
+        """The satellite regression: a hybrid solve's sub-encode must not
+        overwrite the full-snapshot cache slot — after full -> hybrid, the
+        next full-shape snapshot (odd pod gone, one known-shape pod added)
+        still rides the delta machinery."""
+        plain = [make_pod(cpu="500m", name=f"p{i}") for i in range(6)]
+        snap = make_snapshot(list(plain))
+        solver = TPUSolver()
+        solver.solve(snap)
+        assert solver.last_solve_mode == "full"
+        odd = odd_pod()
+        snap.pods.append(odd)
+        solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid"
+        # the cache slot holds the FULL hybrid-snapshot encode, not the
+        # tensor-side sub-encode
+        cached = solver.encode_cache.last_enc
+        assert cached.n_pods == 7 and cached.fallback_reasons
+        snap.pods.remove(odd)
+        snap.pods.append(make_pod(cpu="500m", name="p-new"))
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "delta", (solver.last_solve_mode, solver.last_fallback_reasons)
+        assert solver.last_backend == "tpu"
+        assert not r.pod_errors
+        assert len(_placed_names(r)) == 7
+
+    def test_removing_flagged_pod_clears_reasons_via_attribution(self):
+        snap = _hybrid_snap()
+        solver = TPUSolver()
+        solver.solve(snap)
+        snap.pods = [p for p in snap.pods if p.metadata.name != "odd"]
+        r = solver.solve(snap)
+        # reasons re-derived empty by per-signature attribution; the solve
+        # rides the tensor path (delta against the masked carry)
+        assert solver.last_solve_mode == "delta"
+        assert solver.last_backend == "tpu"
+        assert not solver.last_fallback_reasons
+        assert not r.pod_errors
+
+
+class TestPartitionInvalidation:
+    def test_nodepool_edit_invalidates_retained_partition(self):
+        """README decision-tree note: nodepool edits break the row cache key,
+        so the next hybrid solve re-encodes in full (cold hybrid, not
+        hybrid-delta)."""
+        snap = _hybrid_snap()
+        solver = TPUSolver()
+        solver.solve(snap)
+        solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid-delta"
+        snap.node_pools[0].spec.template.labels["edited"] = "1"  # hash-visible nodepool edit
+        snap.pods.append(make_pod(cpu="500m", name="after-edit"))
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid"
+        assert not r.pod_errors
+
+    def test_group_membership_change_invalidates_partition(self):
+        # a new pod shape declaring a topology group is an unseen signature:
+        # the delta encode cannot extend the sig axis, so cold hybrid re-runs
+        snap = _hybrid_snap()
+        solver = TPUSolver()
+        solver.solve(snap)
+        sel = {"matchLabels": {"app": "w"}}
+        snap.pods.append(make_pod(cpu="500m", name="grouped", labels={"app": "w"}, tsc=[zone_spread(selector=sel)]))
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid"
+        assert not r.pod_errors
+
+
+class TestMetrics:
+    def test_encode_histogram_and_hybrid_delta_counter(self):
+        reg = make_registry()
+        snap = _hybrid_snap()
+        solver = TPUSolver(registry=reg)
+        solver.solve(snap)
+        h = reg.histogram(SOLVER_ENCODE_SECONDS)
+        assert h.count(mode="full") >= 1
+        assert h.count(mode="masked") >= 1
+        assert reg.counter(SOLVER_SOLVE_TOTAL).value(backend="hybrid") == 1
+        solver.solve(snap)
+        assert solver.last_solve_mode == "hybrid-delta"
+        assert reg.counter(SOLVER_SOLVE_TOTAL).value(backend="hybrid-delta") == 1
+        assert h.count(mode="delta") >= 1
+        assert reg.counter(SOLVER_HYBRID_RESIDUAL_TOTAL).value(reason="pod-affinity") >= 2
+
+    def test_phase_seconds_populated(self):
+        snap = _hybrid_snap()
+        solver = TPUSolver()
+        solver.solve(snap)
+        ph = solver.last_phase_seconds
+        assert set(ph) == {"encode", "pack", "residual"}
+        assert ph["encode"] > 0 and ph["pack"] > 0 and ph["residual"] > 0

@@ -1,0 +1,363 @@
+"""mask_encode parity: slicing the full encode == re-encoding the subset.
+
+The hybrid solver derives its tensor-side sub-encode by MASKING the full
+encode's per-signature arrays (encode.mask_encode) instead of encoding the
+sub-snapshot from scratch. The two encodes may lay out their axes differently
+(the masked one keeps vocabulary/domain/port entries only dropped signatures
+referenced), so parity is asserted on the CANONICAL semantics every consumer
+reads: per-pod requests/requirements, the pod x row compatibility matrix
+(label bitmask + taints + domain allowance + inverse-anti host blocks), port
+conflict relations, the topology-group structure, FFD queue order, and the
+relaxation flag — across randomized snapshots with ports, taints, topology
+groups, and host-blocked signatures.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from helpers import hostname_anti_affinity, make_nodepool, make_pod, zone_spread
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.kube.objects import TopologySpreadConstraint
+from karpenter_tpu.solver.encode import encode, mask_encode
+from test_solver import make_snapshot
+
+
+# -- canonical projections ----------------------------------------------------
+
+
+def _compat_matrix(enc) -> np.ndarray:
+    """[P, Nrows] bool: the host-side truth every kernel/validator consumer
+    reads — label bitmask compat (domain keys excluded, they are the domain
+    machinery's), taint tolerance, per-key domain allowance against the row's
+    recorded domains, and inverse-anti host blocks."""
+    S, N = enc.n_sigs, enc.n_rows
+    K = enc.row_labels.shape[1]
+    ok = np.ones((S, N), dtype=bool)
+    dom_cols = {int(kid) for kid in enc.dom_vocab_keys if int(kid) >= 0}
+    for k in range(K):
+        if k in dom_cols:
+            continue
+        vids = enc.row_labels[:, k].astype(np.int64)  # [N]
+        words = enc.sig_mask[:, k, :][:, vids // 32]  # [S, N]
+        ok &= ((words >> (vids % 32).astype(np.uint32)) & 1).astype(bool)
+    ok &= enc.sig_taint_ok[:, enc.row_taint_class]
+    for kd in range(len(enc.dom_key_names)):
+        ok &= enc.sig_dom_allowed[:, enc.row_dom[:, kd].astype(np.int64)]
+    if enc.n_existing:
+        ok[:, : enc.n_existing] &= ~enc.sig_host_blocked[:, : enc.n_existing]
+    return ok[enc.sig_of_pod]
+
+
+def _port_conflicts(enc):
+    """Pod x existing-node and pod x row(daemon-port) conflict relations via
+    the kernel's wildcard-aware rule."""
+
+    def conf(a, w, s, oa, ow, os_):
+        return (
+            a.astype(np.int64) @ ow.T.astype(np.int64)
+            + w.astype(np.int64) @ oa.T.astype(np.int64)
+            + s.astype(np.int64) @ os_.T.astype(np.int64)
+        ) > 0
+
+    ex = conf(
+        enc.sig_port_any, enc.sig_port_wild, enc.sig_port_spec,
+        enc.existing_port_any, enc.existing_port_wild, enc.existing_port_spec,
+    )[:, : max(enc.n_existing, 1)]
+    row = conf(
+        enc.sig_port_any, enc.sig_port_wild, enc.sig_port_spec,
+        enc.row_port_any, enc.row_port_wild, enc.row_port_spec,
+    )
+    sig = enc.sig_of_pod
+    return ex[sig], row[sig]
+
+
+def _canon_groups(enc):
+    """Order-free group structure keyed by content: (kind, dom key name,
+    skew, minDomains, member pod set, owner pod set, registered (key, value)
+    set, initial domain counts, initial host counts)."""
+    sig = np.asarray(enc.sig_of_pod)
+    P = enc.n_pods
+    dko = np.asarray(enc.dom_key_of)
+    out = []
+    for g in range(enc.n_groups):
+        members = frozenset(int(i) for i in range(P) if enc.sig_member[sig[i], g])
+        owners = frozenset(int(i) for i in range(P) if enc.sig_owner[sig[i], g])
+        dk = int(enc.group_dom_key[g])
+        reg = frozenset(
+            (enc.dom_key_names[int(dko[d])], enc.dom_values[int(d)])
+            for d in np.nonzero(enc.group_registered[g])[0]
+        )
+        cd = tuple(
+            sorted(
+                ((enc.dom_key_names[int(dko[d])], enc.dom_values[int(d)]), int(enc.counts_dom_init[g, d]))
+                for d in np.nonzero(enc.counts_dom_init[g])[0]
+            )
+        )
+        ch = (
+            tuple(int(c) for c in enc.counts_host_existing[g, : enc.n_existing])
+            if enc.n_existing
+            else ()
+        )
+        out.append(
+            (
+                int(enc.group_kind[g]),
+                enc.dom_key_names[dk] if dk >= 0 else None,
+                int(enc.group_skew[g]),
+                int(enc.group_min_domains[g]),
+                members,
+                owners,
+                reg,
+                cd,
+                ch,
+            )
+        )
+    return sorted(out, key=repr)
+
+
+def _canon_requirements(reqs):
+    return tuple(
+        sorted(
+            (r.key, r.complement, tuple(sorted(r.values)), r.gte, r.lte, r.min_values)
+            for r in reqs.values()
+        )
+    )
+
+
+def assert_encode_equivalent(masked, scratch):
+    # same pods, same objects, same FFD order
+    assert len(masked.pods) == len(scratch.pods)
+    assert all(a is b for a, b in zip(masked.pods, scratch.pods))
+    # signature grouping is a bijection
+    pairs = set(zip(masked.sig_of_pod.tolist(), scratch.sig_of_pod.tolist()))
+    assert len(pairs) == len({m for m, _ in pairs}) == len({s for _, s in pairs})
+    assert masked.n_sigs == scratch.n_sigs
+    # per-pod requests / requirements / relaxability
+    for i in range(len(masked.pods)):
+        ms, ss = int(masked.sig_of_pod[i]), int(scratch.sig_of_pod[i])
+        mreq = {k: q.milli for k, q in masked.sig_requests[ms].items()}
+        sreq = {k: q.milli for k, q in scratch.sig_requests[ss].items()}
+        assert mreq == sreq, f"pod {i} requests differ"
+        assert _canon_requirements(masked.sig_requirements[ms]) == _canon_requirements(
+            scratch.sig_requirements[ss]
+        ), f"pod {i} requirements differ"
+        assert bool(masked.sig_relaxable[ms]) == bool(scratch.sig_relaxable[ss])
+    assert masked.has_relaxable == scratch.has_relaxable
+    assert masked.fallback_reasons == scratch.fallback_reasons == []
+    # row side is identical work (same snapshot context)
+    assert masked.n_existing == scratch.n_existing
+    assert masked.n_rows == scratch.n_rows
+    assert [m[0] for m in masked.row_meta] == [m[0] for m in scratch.row_meta]
+    # the consumers' truth: pod x row compatibility, bit for bit
+    np.testing.assert_array_equal(_compat_matrix(masked), _compat_matrix(scratch))
+    m_ex, m_row = _port_conflicts(masked)
+    s_ex, s_row = _port_conflicts(scratch)
+    np.testing.assert_array_equal(m_ex, s_ex)
+    np.testing.assert_array_equal(m_row, s_row)
+    # topology-group structure
+    assert _canon_groups(masked) == _canon_groups(scratch)
+
+
+# -- randomized snapshot factory ----------------------------------------------
+
+
+def _random_pods(rng: random.Random, n: int) -> list:
+    spread_sel = {"matchLabels": {"app": "web"}}
+    anti_sel = {"matchLabels": {"app": "db"}}
+    host_spread_sel = {"matchLabels": {"app": "hs"}}
+    rack_sel = {"matchLabels": {"grp": "rack"}}
+    pods = []
+    for i in range(n):
+        k = rng.random()
+        cpu = rng.choice(["250m", "500m", "1", "2"])
+        if k < 0.30:
+            pods.append(make_pod(cpu=cpu, name=f"plain-{i}"))
+        elif k < 0.45:
+            pods.append(
+                make_pod(cpu=cpu, name=f"spread-{i}", labels={"app": "web"}, tsc=[zone_spread(selector=spread_sel)])
+            )
+        elif k < 0.55:
+            pods.append(
+                make_pod(cpu="500m", name=f"anti-{i}", labels={"app": "db"}, anti_affinity=[hostname_anti_affinity(anti_sel)])
+            )
+        elif k < 0.63:
+            pods.append(
+                make_pod(
+                    cpu="500m",
+                    name=f"hspread-{i}",
+                    labels={"app": "hs"},
+                    tsc=[
+                        TopologySpreadConstraint(
+                            max_skew=1, topology_key=wk.HOSTNAME_LABEL_KEY, label_selector=host_spread_sel
+                        )
+                    ],
+                )
+            )
+        elif k < 0.72:
+            # custom-key spread: a second domain key beyond zone
+            pods.append(
+                make_pod(
+                    cpu="1",
+                    name=f"rack-{i}",
+                    labels={"grp": "rack"},
+                    tsc=[TopologySpreadConstraint(max_skew=1, topology_key="rack", label_selector=rack_sel)],
+                )
+            )
+        elif k < 0.82:
+            pods.append(
+                make_pod(cpu=cpu, name=f"zsel-{i}", node_selector={wk.ZONE_LABEL_KEY: rng.choice(["test-zone-a", "test-zone-b"])})
+            )
+        elif k < 0.92:
+            p = make_pod(cpu="500m", name=f"port-{i}")
+            p.spec.containers[0].ports = [
+                {"containerPort": 8080, "hostPort": 8080 + (i % 3), "protocol": "TCP"},
+                {"containerPort": 9090, "hostPort": 9090, "hostIP": "10.0.0.1", "protocol": "TCP"},
+            ]
+            pods.append(p)
+        else:
+            pods.append(
+                make_pod(
+                    cpu=cpu,
+                    name=f"tol-{i}",
+                    tolerations=[{"key": "dedicated", "operator": "Equal", "value": "batch", "effect": "NoSchedule"}],
+                )
+            )
+    return pods
+
+
+def _keep_subset(enc, rng: random.Random):
+    """A random proper subset of signatures that keeps at least one pod."""
+    S = enc.n_sigs
+    if S < 2:
+        return None
+    n_drop = rng.randrange(1, S)
+    dropped = set(rng.sample(range(S), n_drop))
+    keep = [s for s in range(S) if s not in dropped]
+    if not keep:
+        return None
+    return keep
+
+
+class TestMaskEncodeParity:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5])
+    def test_randomized_parity(self, seed):
+        rng = random.Random(seed)
+        pods = _random_pods(rng, rng.randrange(14, 30))
+        from karpenter_tpu.scheduling.taints import Taint
+
+        tainted = make_nodepool(
+            name="tainted-pool",
+            taints=[Taint(key="dedicated", value="batch", effect="NoSchedule")],
+        )
+        snap = make_snapshot(pods, node_pools=[make_nodepool(), tainted])
+        enc = encode(snap)
+        assert not enc.fallback_reasons, enc.fallback_reasons
+        keep = _keep_subset(enc, rng)
+        if keep is None:
+            pytest.skip("degenerate single-signature draw")
+        masked = mask_encode(enc, keep)
+        scratch = encode(snap.with_pods(list(masked.pods)))
+        assert not scratch.fallback_reasons, scratch.fallback_reasons
+        assert_encode_equivalent(masked, scratch)
+
+    def test_parity_with_existing_node_and_inverse_anti(self):
+        # host-blocked signatures: a RUNNING pod with hostname anti-affinity
+        # statically blocks matching solve pods from its node
+        from test_sharded import existing_node_snapshot
+
+        types = [catalog.make_instance_type("c", 8, zones=["test-zone-a", "test-zone-b"])]
+        pods = [make_pod(cpu="500m", name=f"p{i}") for i in range(4)]
+        pods += [make_pod(cpu="500m", name=f"blk-{i}", labels={"app": "blocked"}) for i in range(3)]
+        pods += [make_pod(cpu="1", name="odd-size")]
+        snap = existing_node_snapshot(pods, types)
+        running = make_pod(
+            cpu="100m",
+            name="runner",
+            labels={"app": "runner"},
+            node_name="n1",
+            anti_affinity=[hostname_anti_affinity({"matchLabels": {"app": "blocked"}})],
+        )
+        running.status.phase = "Running"
+        snap.store.create(running)
+        snap = snap.with_pods(pods)  # same pod list, refreshed context
+
+        enc = encode(snap)
+        assert not enc.fallback_reasons, enc.fallback_reasons
+        assert enc.sig_host_blocked.any(), "inverse anti-affinity should block a signature"
+        # drop the odd-size signature, keep the blocked one
+        drop = {int(enc.sig_of_pod[[p.metadata.name for p in enc.pods].index("odd-size")])}
+        keep = [s for s in range(enc.n_sigs) if s not in drop]
+        masked = mask_encode(enc, keep)
+        scratch = encode(snap.with_pods(list(masked.pods)))
+        assert masked.sig_host_blocked.any() and scratch.sig_host_blocked.any()
+        assert_encode_equivalent(masked, scratch)
+
+    def test_masked_placements_bit_identical(self):
+        # the acceptance bar: the masked sub-encode packs to the SAME
+        # placements as the from-scratch sub-encode
+        from karpenter_tpu.solver.tpu import TPUSolver
+
+        rng = random.Random(7)
+        pods = _random_pods(rng, 18)
+        snap = make_snapshot(pods)
+        enc = encode(snap)
+        assert not enc.fallback_reasons
+        keep = [s for s in range(enc.n_sigs) if s % 3 != 1] or list(range(enc.n_sigs))
+        masked = mask_encode(enc, keep)
+        if not masked.pods:
+            pytest.skip("degenerate draw")
+        sub_snap = snap.with_pods(list(masked.pods))
+        scratch = encode(sub_snap)
+
+        def placements(e):
+            r = TPUSolver(force=True)._solve_full(sub_snap, e)
+            out = {}
+            for nc in r.new_node_claims:
+                for p in nc.pods:
+                    out[p.metadata.name] = (nc.hostname, frozenset(it.name for it in nc.instance_type_options))
+            for en in r.existing_nodes:
+                for p in en.pods:
+                    out[p.metadata.name] = ("existing", en.name())
+            return out
+
+        assert placements(masked) == placements(scratch)
+
+    def test_mask_rejects_flagged_and_global(self):
+        from karpenter_tpu.kube.objects import Affinity, PodAffinityTerm, WeightedPodAffinityTerm
+
+        odd = make_pod(cpu="500m", name="odd")
+        odd.spec.affinity = Affinity(
+            pod_affinity_preferred=[
+                WeightedPodAffinityTerm(
+                    weight=1,
+                    term=PodAffinityTerm(label_selector={"matchLabels": {"x": "y"}}, topology_key=wk.ZONE_LABEL_KEY),
+                )
+            ]
+        )
+        pods = [make_pod(cpu="500m", name="a"), odd]
+        enc = encode(make_snapshot(pods))
+        assert enc.fallback_sig_local
+        flagged = next(iter(enc.fallback_sig_local))
+        with pytest.raises(ValueError):
+            mask_encode(enc, [flagged])
+        # keeping only the clean signature is fine
+        clean = [s for s in range(enc.n_sigs) if s not in enc.fallback_sig_local]
+        masked = mask_encode(enc, clean)
+        assert [p.metadata.name for p in masked.pods] == ["a"]
+        assert not masked.fallback_reasons and not masked.has_relaxable
+
+    def test_mask_full_set_is_identity_semantics(self):
+        pods = _random_pods(random.Random(11), 12)
+        snap = make_snapshot(pods)
+        enc = encode(snap)
+        masked = mask_encode(enc, range(enc.n_sigs))
+        assert all(a is b for a, b in zip(masked.pods, enc.pods))
+        np.testing.assert_array_equal(masked.sig_of_pod, enc.sig_of_pod)
+        np.testing.assert_array_equal(_compat_matrix(masked), _compat_matrix(enc))
+        assert _canon_groups(masked) == _canon_groups(enc)
+        # the row side is shared by reference, not copied
+        assert masked.row_alloc is enc.row_alloc
+        assert masked.row_meta is enc.row_meta
+        assert masked.decode_cache is enc.decode_cache

@@ -1,0 +1,117 @@
+"""Mechanical enforcement of the PR-1 invariant: every TPUSolver.solve exit
+path sets `last_solve_mode` AND `last_backend`, and the pair is always one of
+the known combinations. One scenario per exit path:
+
+  full         -> ("full", "tpu")
+  delta        -> ("delta", "tpu")
+  hybrid       -> ("hybrid", "hybrid")
+  hybrid-delta -> ("hybrid-delta", "hybrid")
+  fallback     -> ("fallback", "ffd-fallback")
+"""
+
+import pytest
+
+from helpers import make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.kube.objects import (
+    Affinity,
+    PodAffinityTerm,
+    WeightedPodAffinityTerm,
+)
+from karpenter_tpu.solver.tpu import TPUSolver
+from test_solver import make_snapshot
+
+VALID_PAIRS = {
+    ("full", "tpu"),
+    ("delta", "tpu"),
+    ("hybrid", "hybrid"),
+    ("hybrid-delta", "hybrid"),
+    ("fallback", "ffd-fallback"),
+}
+
+
+def _odd_pod(name="odd"):
+    p = make_pod(cpu="500m", name=name)
+    p.spec.affinity = Affinity(
+        pod_affinity_preferred=[
+            WeightedPodAffinityTerm(
+                weight=1,
+                term=PodAffinityTerm(label_selector={"matchLabels": {"x": "y"}}, topology_key=wk.ZONE_LABEL_KEY),
+            )
+        ]
+    )
+    return p
+
+
+def _global_pod(name="asym"):
+    # asymmetric anti-affinity (selector matches non-declaring pods): global
+    sel = {"matchLabels": {"app": "other"}}
+    return make_pod(
+        cpu="1",
+        name=name,
+        labels={"app": "me"},
+        anti_affinity=[PodAffinityTerm(label_selector=sel, topology_key=wk.ZONE_LABEL_KEY)],
+    )
+
+
+def _check(solver):
+    assert (solver.last_solve_mode, solver.last_backend) in VALID_PAIRS, (
+        solver.last_solve_mode,
+        solver.last_backend,
+    )
+
+
+def _exit_path_walk():
+    """Yields (expected_mode, results, solver) per scenario, checking the
+    mode/backend pair after every solve."""
+    # full
+    solver = TPUSolver()
+    snap = make_snapshot([make_pod(cpu="500m", name=f"p{i}") for i in range(5)])
+    yield "full", solver.solve(snap), solver
+
+    # delta: append a known shape
+    snap.pods.append(make_pod(cpu="500m", name="p5"))
+    yield "delta", solver.solve(snap), solver
+
+    # hybrid: one pod-local out-of-window pod
+    snap.pods.append(_odd_pod())
+    yield "hybrid", solver.solve(snap), solver
+
+    # hybrid-delta: one more known-shape pod on the retained hybrid carry
+    snap.pods.append(make_pod(cpu="500m", name="p6"))
+    yield "hybrid-delta", solver.solve(snap), solver
+
+    # fallback: a snapshot-global reason
+    snap2 = make_snapshot([_global_pod()] + [make_pod(cpu="1", labels={"app": "other"}, name=f"o{i}") for i in range(2)])
+    yield "fallback", solver.solve(snap2), solver
+
+
+class TestSolveModeInvariant:
+    def test_every_exit_path_sets_mode_and_backend(self):
+        seen = []
+        for expected, results, solver in _exit_path_walk():
+            _check(solver)
+            assert solver.last_solve_mode == expected, (expected, solver.last_solve_mode, solver.last_fallback_reasons)
+            if expected != "fallback":  # the fallback scenario's placement may legitimately error
+                assert not results.pod_errors
+            seen.append(expected)
+        assert seen == ["full", "delta", "hybrid", "hybrid-delta", "fallback"]
+
+    def test_empty_snapshot_sets_fallback(self):
+        solver = TPUSolver()
+        solver.solve(make_snapshot([]))
+        assert (solver.last_solve_mode, solver.last_backend) == ("fallback", "ffd-fallback")
+
+    def test_hybrid_disabled_sets_fallback(self):
+        solver = TPUSolver(hybrid=False)
+        solver.solve(make_snapshot([make_pod(cpu="500m"), _odd_pod()]))
+        assert (solver.last_solve_mode, solver.last_backend) == ("fallback", "ffd-fallback")
+
+    def test_mode_reset_between_solves(self):
+        # a hybrid solve must not leak its mode into a later clean solve
+        solver = TPUSolver()
+        solver.solve(make_snapshot([make_pod(cpu="500m"), _odd_pod()]))
+        assert solver.last_solve_mode == "hybrid"
+        solver.solve(make_snapshot([make_pod(cpu="500m", name="fresh")]))
+        _check(solver)
+        assert solver.last_solve_mode == "full"
