@@ -142,6 +142,8 @@ def build_snapshot(
     affinity_frac: float = 0.0,
     fallback_frac: float = 0.0,
     pvc_frac: float = 0.0,
+    coupled_frac: float = 0.0,
+    min_values: int | None = None,
 ):
     from helpers import hostname_anti_affinity, make_nodepool, make_pod, zone_spread
     from karpenter_tpu.apis import labels as wk
@@ -161,7 +163,12 @@ def build_snapshot(
     store, clock = Store(), FakeClock()
     cluster = Cluster(store, clock)
     start_informers(store, cluster)
-    np_ = make_nodepool(requirements=LINUX)
+    reqs = list(LINUX)
+    if min_values is not None:
+        # NodePool-level instance-type flexibility floor: rides the tensor
+        # path end-to-end via the decode-time relaxation (PR 3)
+        reqs.append({"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "Exists", "minValues": min_values})
+    np_ = make_nodepool(requirements=reqs)
     store.create(np_)
     # heterogeneous variant pool a la the reference's 400-variant benchmark
     combos = [
@@ -217,6 +224,16 @@ def build_snapshot(
         if k < affinity_frac + fallback_frac:  # PREFERRED affinity: out-of-window
             labels, term = rng.choice(aff_groups)
             p = make_pod(cpu="500m", memory="512Mi", labels=dict(labels))
+            p.spec.affinity = Affinity(pod_affinity_preferred=[WeightedPodAffinityTerm(weight=1, term=term)])
+            pods.append(p)
+            continue
+        if k < affinity_frac + fallback_frac + coupled_frac:
+            # COUPLED spread: a flagged (preferred-affinity) pod that DECLARES
+            # the same zone-spread group as the in-window "app: web" majority —
+            # the group spans the hybrid seam, exercising the exported
+            # tensor-side occupancy (tpu._seam_records)
+            _labels, term = rng.choice(aff_groups)
+            p = make_pod(cpu="500m", memory="1Gi", labels={"app": "web"}, tsc=[zone_spread(selector=spread_sel)])
             p.spec.affinity = Affinity(pod_affinity_preferred=[WeightedPodAffinityTerm(weight=1, term=term)])
             pods.append(p)
             continue
@@ -489,6 +506,118 @@ def bench_hybrid_path(n_pods: int, n_types: int) -> dict:
     }
 
 
+def _family_solve(snap, expect_backend: str, allow_errors: bool = False) -> dict:
+    """One warm solve of a per-family demotion scenario: returns seconds,
+    the backend/mode that actually served it, and the residual share (pods
+    attributed to pod-local fallback signatures — 0.0 on the pure tensor
+    path). The backend entry is the round-over-round demotion guard: a
+    regression back to whole-snapshot FFD shows up as
+    backend="ffd-fallback"."""
+    import numpy as np
+
+    from karpenter_tpu.solver.tpu import TPUSolver
+
+    warm = TPUSolver()
+    warm.solve(snap)  # jit compile on this shape (shared cache)
+    solver = TPUSolver()  # fresh: no delta/hybrid carry can shortcut it
+    t0 = time.perf_counter()
+    results = solver.solve(snap)
+    dt = time.perf_counter() - t0
+    if not allow_errors:
+        assert not results.pod_errors, list(results.pod_errors.values())[:3]
+    assert solver.last_backend == expect_backend, (solver.last_backend, solver.last_fallback_reasons[:3])
+    enc = solver.encode_cache.last_enc
+    share = 0.0
+    if enc is not None and enc.fallback_sig_local:
+        share = float(np.isin(np.asarray(enc.sig_of_pod), list(enc.fallback_sig_local)).mean())
+    return {
+        "seconds": dt,
+        "backend": solver.last_backend,
+        "mode": solver.last_solve_mode,
+        "residual_share": round(share, 4),
+        "n_pod_errors": len(results.pod_errors),
+        "n_new_claims": len(results.new_node_claims),
+        "results": results,  # the TIMED solve's placement (popped before emit)
+    }
+
+
+def bench_minvalues(n_pods: int, n_types: int) -> dict:
+    """NodePool minValues (instance-type flexibility floor) — previously a
+    snapshot-GLOBAL fallback family (whole-snapshot FFD at ~41s/10k pods),
+    now fully tensorized via the decode-time relaxation. Must ride the
+    tensor path and still satisfy every bound."""
+    from karpenter_tpu.cloudprovider.types import satisfies_min_values
+    from karpenter_tpu.solver.tpu import TPUSolver
+
+    snap = build_snapshot(n_pods, n_types, min_values=3)
+    # tight type sets can make SOME pods genuinely unsatisfiable under the
+    # bound (the host errors them too — via per-pod claim-open, where the
+    # tensor path may still co-pack them into a flexible-enough claim);
+    # errors are recorded, and every claim of the TIMED solve must satisfy
+    # its bounds. n_new_claims keeps the per-zone envelope's conservatism
+    # (tighter bins than the host on zone-starved catalogs) visible.
+    out = _family_solve(snap, expect_backend="tpu", allow_errors=True)
+    for nc in out["results"].new_node_claims:
+        _, unsat = satisfies_min_values(nc.instance_type_options, nc.requirements)
+        assert not unsat, f"minValues violated on a produced claim: {unsat}"
+    return out
+
+
+def bench_coupled_spread(n_pods: int, n_types: int) -> dict:
+    """5% flagged pods DECLARING the majority's zone-spread group — the
+    spread spans the hybrid seam. Previously the shared-group gate forced
+    whole-snapshot FFD; now the tensor side's occupancy is exported into the
+    residual Topology and the snapshot splits."""
+    snap = build_snapshot(n_pods, n_types, coupled_frac=0.05)
+    return _family_solve(snap, expect_backend="hybrid")
+
+
+def bench_strict_reserved(n_pods: int, n_types: int) -> dict:
+    """Strict reserved-offering mode with reserved offerings present —
+    previously snapshot-GLOBAL. 95% of pods pin the capacity type away from
+    reserved and ride the tensor path; the 5% reserved-reachable residual
+    runs the sequential host reservation accounting."""
+    from helpers import make_nodepool, make_pod
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.cloudprovider import catalog
+    from karpenter_tpu.cloudprovider.fake import instance_types_assorted
+    from karpenter_tpu.kube import Store
+    from karpenter_tpu.solver.snapshot import SolverSnapshot
+    from karpenter_tpu.state import Cluster
+    from karpenter_tpu.state.informer import start_informers
+    from karpenter_tpu.utils.clock import FakeClock
+
+    LINUX = [
+        {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+        {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+    ]
+    rng = random.Random(0)
+    store, clock = Store(), FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    np_ = make_nodepool(requirements=LINUX)
+    store.create(np_)
+    types = instance_types_assorted(max(n_types - 2, 1))
+    types += [catalog.make_instance_type("c", 16, include_reserved=True, reserved_capacity=4)]
+    combos = [(f"{rng.randrange(100, 4100, 100)}m", f"{rng.randrange(128, 4096, 64)}Mi") for _ in range(200)]
+    pods = []
+    for i in range(n_pods):
+        cpu, mem = rng.choice(combos)
+        if i % 20 == 0:  # 5%: unconstrained — can reach reserved capacity
+            pods.append(make_pod(cpu="500m", memory="512Mi"))
+        else:
+            pods.append(
+                make_pod(cpu=cpu, memory=mem, node_selector={wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND})
+            )
+    snap = SolverSnapshot(
+        store=store, cluster=cluster, node_pools=[np_],
+        instance_types={np_.metadata.name: types},
+        state_nodes=[], daemonset_pods=[], pods=pods, clock=clock,
+        reserved_offering_mode="strict",
+    )
+    return _family_solve(snap, expect_backend="hybrid")
+
+
 def bench_hostname_spread_xl() -> float:
     """The reference's hardest packing case (host_name_spreading_xl_test.go:
     40-67): 1,000 hostname-spread pods (900m/3100Mi, maxSkew 1) + 1,000 large
@@ -688,6 +817,18 @@ def _command_savings(cmd) -> float:
 
 
 def main():
+    # --smoke: every scenario at ~1/20 scale so CI catches scenario bit-rot
+    # without the full multi-minute run (explicit BENCH_* env still wins)
+    if "--smoke" in sys.argv:
+        os.environ.setdefault("BENCH_PODS", "2500")
+        os.environ.setdefault("BENCH_TYPES", "25")
+        os.environ.setdefault("BENCH_NODES", "12")
+        os.environ.setdefault("BENCH_FALLBACK_PODS", "500")
+        os.environ.setdefault("BENCH_SKIP_XL", "1")
+        os.environ.setdefault("BENCH_SKIP_SHARDED", "1")
+        os.environ.setdefault("BENCH_WORST_TARGET", "1e9")
+        os.environ.setdefault("BENCH_DEADLINE_SECONDS", "900")
+        _RESULT["extra"]["smoke"] = True
     _install_guards(float(os.environ.get("BENCH_DEADLINE_SECONDS", "3300")))
 
     # --- backend probe + degrade (before this process touches jax) ---
@@ -784,6 +925,22 @@ def main():
             extra[f"hybrid_{n_fb}pods_seconds"] = round(hy["total"], 4)
             _hybrid_extras("hybrid_", hy)
             extra["warm_hybrid_resolve_1pod_seconds"] = round(hy["warm_hybrid_resolve_1pod_seconds"], 4)
+        # per-family demoted-fallback scenarios (PR 3): each family that used
+        # to force whole-snapshot FFD now rides the tensor/hybrid path; the
+        # backend entry keeps the demotion visible round-over-round, and the
+        # ratio against fallback_<n>pods_seconds above is the ISSUE-3
+        # acceptance (>= 10x at 10k pods)
+        for fam, fn in (
+            ("minvalues", bench_minvalues),
+            ("coupled_spread", bench_coupled_spread),
+            ("strict_reserved", bench_strict_reserved),
+        ):
+            out = _run_scenario(fam, fn, n_fb, n_types)
+            if out is not None:
+                extra[f"{fam}_{n_fb}pods_seconds"] = round(out["seconds"], 4)
+                extra[f"{fam}_backend"] = out["backend"]
+                extra[f"{fam}_residual_share"] = out["residual_share"]
+                extra[f"{fam}_n_new_claims"] = out["n_new_claims"]
         # the ISSUE-2 acceptance scale: masked sub-encode + hybrid-delta at 2k
         if n_fb != 2000:
             hy2 = _run_scenario("hybrid_2k", bench_hybrid_path, 2000, n_types)

@@ -112,9 +112,11 @@ class SchedulingNodeClaim:
         allocator=None,
         reservation_manager=None,
         reserved_offering_mode: str = "fallback",  # fallback | strict (scheduler.go:59-77)
+        filter_cache: Optional[dict] = None,  # solve-scoped filter_instance_types memo
     ):
         self.template = template
         self.topology = topology
+        self.filter_cache = filter_cache
         self.daemon_overhead_groups = [g.copy() for g in daemon_overhead_groups]
         self.pods: list = []
         self.instance_type_options = instance_types
@@ -141,7 +143,7 @@ class SchedulingNodeClaim:
     def nodepool_name(self) -> str:
         return self.template.nodepool_name
 
-    def rehydrate(self, topology, allocator=None, reservation_manager=None, reserved_offering_mode: str = "fallback") -> None:
+    def rehydrate(self, topology, allocator=None, reservation_manager=None, reserved_offering_mode: str = "fallback", filter_cache: Optional[dict] = None) -> None:
         """Re-wire the solve-scoped plumbing `__init__` normally provides, for
         claims built OUTSIDE a Scheduler: the tensor decode constructs claims
         with `__new__` (the device result fully determines them), and the
@@ -149,6 +151,7 @@ class SchedulingNodeClaim:
         field list lives here, next to `__init__`, so new per-solve state
         cannot be missed on the adoption path (solver/ffd.py _adopt_claim)."""
         self.topology = topology
+        self.filter_cache = filter_cache
         # decode shares one group list per template across claims (and across
         # solves via its cache); Add() mutates group port usage, so a live
         # claim needs its own copies — exactly like __init__
@@ -214,7 +217,8 @@ class SchedulingNodeClaim:
         claim_reqs.add(*topo.values())
 
         requests = res.merge(self.spec_requests, pod_data.requests)
-        remaining, unsatisfiable, ferr = filter_instance_types(
+        remaining, unsatisfiable, ferr = filter_instance_types_cached(
+            getattr(self, "filter_cache", None),
             self.instance_type_options, claim_reqs, pod, pod_data.requests, self.daemon_overhead_groups, requests, relax_min_values,
             native=_native_table_for(self.template),
         )
@@ -445,6 +449,81 @@ def _rand_suffix() -> str:
     import random
 
     return f"{random.randrange(16**10):010x}"
+
+
+def _reqs_content_key(reqs: Requirements) -> tuple:
+    """Content identity of a Requirements set — equal keys for equal
+    filtering behavior. The per-claim HOSTNAME placeholder is excluded: no
+    instance type or offering constrains hostname, so it cannot change the
+    filter result, and including it would make every claim's key unique
+    (zero hits). Entries are keyed-unique, so sorting by label key alone
+    gives a canonical order (frozensets have no total order)."""
+    return tuple(
+        sorted(
+            (
+                (r.key, r.complement, frozenset(r.values), r.gte, r.lte, r.min_values)
+                for r in reqs.values()
+                if r.key != wk.HOSTNAME_LABEL_KEY
+            ),
+            key=lambda t: t[0],
+        )
+    )
+
+
+_FILTER_CACHE_MAX = 50_000
+
+
+def filter_instance_types_cached(
+    cache: Optional[dict],
+    instance_types: list[InstanceType],
+    requirements: Requirements,
+    pod,
+    pod_requests: dict[str, Quantity],
+    daemon_overhead_groups: list[DaemonOverheadGroup],
+    total_requests: dict[str, Quantity],
+    relax_min_values: bool = False,
+    native=None,
+) -> tuple[Optional[list[InstanceType]], dict[str, int], Optional[str]]:
+    """Solve-scoped memo around `filter_instance_types` (ROADMAP: the
+    residual host FFD is ~0.6 ms/pod dominated by this call). The filter is
+    a pure function of (type set, requirement CONTENT, accumulated requests,
+    daemon groups, relax flag) — identical pod signatures probing the same
+    claim state must not re-scan the full 500-type list. Host-port-carrying
+    pods bypass the memo: their group conflict check reads mutable
+    `host_port_usage` state the key cannot see (portless pods — the dominant
+    shape — never conflict)."""
+    if cache is None or pod_host_ports(pod):
+        return filter_instance_types(
+            instance_types, requirements, pod, pod_requests, daemon_overhead_groups,
+            total_requests, relax_min_values, native=native,
+        )
+    key = (
+        # list identity + length, verified against the stored reference on
+        # hit (a solve-scoped cache may see a recycled id after GC): claims
+        # REPLACE their option list on every narrowing, so identity tracks
+        # content exactly
+        (id(instance_types), len(instance_types)),
+        _reqs_content_key(requirements),
+        tuple(sorted((k, q.milli) for k, q in total_requests.items())),
+        # group copies share their instance_types/daemon_overhead objects
+        # with the template's originals, so claims of one template hit
+        tuple((id(g.instance_types), id(g.daemon_overhead)) for g in daemon_overhead_groups),
+        relax_min_values,
+    )
+    hit = cache.get(key)
+    if hit is None or hit[0] is not instance_types:
+        if len(cache) >= _FILTER_CACHE_MAX:
+            cache.clear()  # bound memory; repopulates within the solve
+        hit = cache[key] = (
+            instance_types,
+            *filter_instance_types(
+                instance_types, requirements, pod, pod_requests, daemon_overhead_groups,
+                total_requests, relax_min_values, native=native,
+            ),
+        )
+    _its_ref, remaining, unsat, err = hit
+    # callers assign/narrow the list downstream — never hand out the cached one
+    return (list(remaining) if remaining is not None else None, dict(unsat), err)
 
 
 def filter_instance_types(

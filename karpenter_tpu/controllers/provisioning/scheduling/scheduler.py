@@ -105,6 +105,10 @@ class Scheduler:
             or (preference_policy == "Ignore")
         )
         self.cached_pod_data: dict[str, PodData] = {}
+        # solve-scoped filter_instance_types memo shared by every claim
+        # (nodeclaim.filter_instance_types_cached): identical pod signatures
+        # probing the same claim state skip the full-catalog scan
+        self.filter_cache: dict = {}
         self.volume_topology = VolumeTopology(store)
         # one DRA allocator per solve, shared by every candidate (provisioner.go:333-344)
         self.allocator = None
@@ -378,6 +382,7 @@ class Scheduler:
                 allocator=self.allocator,
                 reservation_manager=self.reservation_manager,
                 reserved_offering_mode=self.reserved_offering_mode,
+                filter_cache=self.filter_cache,
             )
             reqs, rem_its, err = nc.can_add(pod, pod_data, relax_min_values=(self.min_values_policy == "BestEffort"))
             if err is not None:

@@ -65,6 +65,7 @@ SOLVER_SOLVE_TOTAL = "karpenter_solver_solve_total"
 SOLVER_FALLBACK_TOTAL = "karpenter_solver_fallback_total"
 SOLVER_VALIDATION_FAILURES_TOTAL = "karpenter_solver_validation_failures_total"
 SOLVER_HYBRID_RESIDUAL_TOTAL = "karpenter_solver_hybrid_residual_total"
+SOLVER_DECODE_REPAIR_TOTAL = "karpenter_solver_decode_repair_total"
 SOLVER_ENCODE_SECONDS = "karpenter_solver_encode_seconds"
 
 
@@ -116,6 +117,11 @@ def make_registry() -> Registry:
     r.counter(
         SOLVER_HYBRID_RESIDUAL_TOTAL,
         "Hybrid partitioned solves that routed a pod-local residual to the host FFD, by reason family",
+        ("reason",),
+    )
+    r.counter(
+        SOLVER_DECODE_REPAIR_TOTAL,
+        "Tensor decodes that routed part of the placement through the bounded host repair, by reason family",
         ("reason",),
     )
     # backend label values for SOLVER_SOLVE_TOTAL include "hybrid-delta":

@@ -408,27 +408,32 @@ def capability_report(snap, pods=None, vol_comps=None) -> CapabilityReport:
     unplaced with soft constraints in play."""
     report = CapabilityReport()
     respect = getattr(snap, "preference_policy", "Respect") == "Respect"
-    if snap.min_values_policy != "Strict":
-        pass  # relaxation happens host-side per claim decode; fine
-    for np_ in snap.node_pools:
-        reqs = Requirements.from_node_selector_terms(np_.spec.template.requirements)
-        if reqs.has_min_values():
-            report.add("nodepool uses minValues")
-            break
+    # NodePool minValues is fully tensorized: the pack runs unconstrained and
+    # decode enforces satisfies_min_values per produced claim — widening
+    # decode pins, relaxing under BestEffort, or routing irreparable claims
+    # through a bounded host repair (TPUSolver._enforce_min_values). No
+    # capability reason is emitted for it anymore.
     rep_pods = list(pods if pods is not None else snap.pods)
     # required anti-affinity is modeled as symmetric per-domain groups
     # (members = pods matched by the selector); that is exact only when the
     # declaring set and the matched set coincide (pure self-anti-affinity,
     # the deployment-replicas case). Asymmetric terms stay host-side. The
-    # same holds for KEYED spread constraints AND required pod affinity: the
-    # host counts matched non-declaring pods without constraining them, which
-    # the domain kernel can express only when matched == declaring. (Hostname
-    # spread/anti groups are exact either way via the owner/member mask
-    # split; hostname affinity keeps the symmetric window because its
-    # bootstrap rule reads self-selection.)
-    for r in _anti_symmetry_reasons(rep_pods) + _spread_symmetry_reasons(rep_pods) + _affinity_symmetry_reasons(rep_pods):
+    # same holds for required pod affinity: the host counts matched
+    # non-declaring pods without constraining them, which the domain kernel
+    # can express only when matched == declaring. (Hostname spread/anti
+    # groups are exact either way via the owner/member mask split; hostname
+    # affinity keeps the symmetric window because its bootstrap rule reads
+    # self-selection.)
+    for r in _anti_symmetry_reasons(rep_pods) + _affinity_symmetry_reasons(rep_pods):
         report.add(r)
-    if report.reasons:
+    # asymmetric KEYED spread membership is POD-LOCAL: flagging BOTH the
+    # declaring and the matched signatures routes the entire coupled
+    # membership to the host residual, where the count-without-constrain
+    # semantics are native (fallback.py tier rationale)
+    for r, sigs in _spread_symmetry_reasons(rep_pods):
+        for s in sigs:
+            report.add(r, sig=s)
+    if report.has_global:
         return report
     _vol_lowering = None  # one lowering for all reps (per-solve SC/PV memos)
 
@@ -452,7 +457,11 @@ def capability_report(snap, pods=None, vol_comps=None) -> CapabilityReport:
     # no capability restriction needed
     # strict reserved-offering mode (consolidation sims) requires per-pod
     # reservation failures, which only the sequential host path expresses;
-    # decode's host-side cap implements fallback mode only
+    # decode's host-side cap implements fallback mode only. POD-LOCAL: only
+    # the signatures whose requirements can REACH reserved capacity carry
+    # the demand — a claim whose every pod excludes the reserved capacity
+    # type can never enter _offerings_to_reserve's strict branch, so those
+    # signatures ride the tensor path untouched.
     if (
         getattr(snap, "reserved_offering_mode", "fallback") == "strict"
         and getattr(snap, "reserved_capacity_enabled", True)
@@ -463,8 +472,25 @@ def capability_report(snap, pods=None, vol_comps=None) -> CapabilityReport:
             for o in it.offerings
         )
     ):
-        report.add("strict reserved-offering mode with reserved offerings")
+        for idx, pod in enumerate(rep_pods):
+            if _sig_demands_reserved(pod):
+                report.add(f"{pod.key()}: strict reserved-offering demand", sig=idx)
     return report
+
+
+def _sig_demands_reserved(pod) -> bool:
+    """Can a claim holding this pod shape reach reserved capacity? True
+    unless the pod's own stable requirements pin the capacity type away from
+    reserved. Relaxable shapes (multiple OR'd node-affinity terms, or a
+    preferred term re-allowing reserved) stay flagged: the relaxation loop
+    could re-widen what the first term excluded."""
+    if Requirements.from_pod(pod, strict=True).get(wk.CAPACITY_TYPE_LABEL_KEY).has(wk.CAPACITY_TYPE_RESERVED):
+        return True
+    # preferred terms only ever NARROW the strict set (Add intersects), so an
+    # exclusion in nodeSelector/required[0] survives preference peeling; but
+    # further OR terms can re-allow reserved once relaxation drops the first
+    na = pod.spec.affinity.node_affinity if pod.spec.affinity else None
+    return na is not None and len(na.required) > 1
 
 
 def _pod_window_reasons(snap, pod, respect: bool, resolve_comp) -> list[str]:
@@ -552,14 +578,19 @@ def hybrid_partition(snap, enc) -> tuple[list, list] | None:
     None when the whole snapshot must take the host FFD.
 
     Eligible iff every fallback reason is POD-LOCAL (fallback.py tiers) and
-    the two halves are CONSTRAINT-INDEPENDENT: no topology group counts or
-    constrains signatures on both sides (a shared group would need joint
-    spread/affinity accounting the split cannot provide), and no flagged
-    pod's explicit-namespace (anti-)affinity term selects a tensor-side pod
-    across namespaces — the one coupling channel the same-namespace
-    `sig_member` matrix cannot see. Preferred (soft) terms are exempt from
-    the coupling gate: the host relaxation loop peels them on failure, so
-    they can never make the combined placement infeasible."""
+    the two halves are CONSTRAINT-INDEPENDENT: no AFFINITY or ANTI-AFFINITY
+    topology group counts or constrains signatures on both sides (a shared
+    group of those kinds would need joint blocking/bootstrap accounting the
+    split cannot provide), and no flagged pod's explicit-namespace
+    (anti-)affinity term selects a tensor-side pod across namespaces — the
+    one coupling channel the same-namespace `sig_member` matrix cannot see.
+    SPREAD groups (keyed and hostname) may span the seam: the solver exports
+    the tensor side's per-(key, domain) occupancy into the residual
+    scheduler's Topology (tpu._seam_records + ffd.solve_residual), so the
+    residual's per-placement skew rule runs against the true combined
+    counts. Preferred (soft) terms are exempt from the coupling gate: the
+    host relaxation loop peels them on failure, so they can never make the
+    combined placement infeasible."""
     if not enc.fallback_reasons or enc.fallback_has_global:
         return None
     sig_local = enc.fallback_sig_local
@@ -571,10 +602,15 @@ def hybrid_partition(snap, enc) -> tuple[list, list] | None:
     if flagged.all():
         return None
     # group coupling over the full-snapshot encode: `sig_member` marks every
-    # signature a group SELECTS, `sig_owner` every signature that DECLARES it
+    # signature a group SELECTS, `sig_owner` every signature that DECLARES
+    # it. Spread kinds are exempt — their tensor-side occupancy is exported
+    # to the residual, so joint accounting holds across the seam.
     if enc.n_groups:
         touches = enc.sig_member | enc.sig_owner
-        if (touches[flagged].any(axis=0) & touches[~flagged].any(axis=0)).any():
+        kinds = np.asarray(enc.group_kind)
+        coupled = ~((kinds == KIND_DOM_SPREAD) | (kinds == KIND_HOST_SPREAD))
+        cross = touches[flagged].any(axis=0) & touches[~flagged].any(axis=0)
+        if (cross & coupled).any():
             return None
     # explicit-namespace required terms of flagged pods vs tensor-side reps
     reps: dict[int, object] = {}
@@ -767,11 +803,13 @@ def _anti_symmetry_reasons(rep_pods) -> list[str]:
     return reasons
 
 
-def _spread_symmetry_reasons(rep_pods) -> list[str]:
+def _spread_symmetry_reasons(rep_pods) -> list[tuple[str, frozenset]]:
     """Non-hostname spread constraints whose declaring set != matched set
     (over the solve's unique pod shapes): the host counts matched
     non-declaring pods without constraining them, which the keyed-domain
-    kernel cannot express."""
+    kernel cannot express. Returns (reason, flagged signature set) pairs —
+    the flagged set is declarers UNION matched, so the hybrid partitioner
+    routes the entire coupled membership to the host residual together."""
     from ..controllers.provisioning.scheduling.topology import effective_spread_selector
 
     declared: dict[tuple, tuple[set[int], object]] = {}
@@ -794,7 +832,12 @@ def _spread_symmetry_reasons(rep_pods) -> list[str]:
             if pod.metadata.namespace == ns and selector is not None and match_label_selector(selector, pod.metadata.labels)
         }
         if matched != declarers:
-            reasons.append(f"asymmetric spread membership (key {key}): selector matches pods that do not declare it")
+            reasons.append(
+                (
+                    f"asymmetric spread membership (key {key}): selector matches pods that do not declare it",
+                    frozenset(declarers | matched),
+                )
+            )
     return reasons
 
 
@@ -1262,6 +1305,67 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
     def intern_labels(labels: dict[str, str]) -> dict[int, int]:
         return {vocab.key_id(k): vocab.value_id(k, v) for k, v in labels.items()}
 
+    def min_values_cap(t, zone: str | None, overhead_by_it: dict) -> np.ndarray | None:
+        """Per-(template, zone) allocatable CAP enforcing the minValues
+        envelope on the pack itself: a slot filled past this vector could
+        produce a claim that fewer than `min_values` distinct key values can
+        hold — which the host prevents per pod (filter_instance_types
+        refuses the add) and the minValues-blind pack would otherwise
+        discover only at decode, repairing most of the snapshot host-side.
+        The cap is the elementwise MIN over the smallest prefix of LARGEST
+        types (cpu, then memory — catalog families scale ~proportionally)
+        spanning the bound: totals within it fit every prefix type, so the
+        decode's post-filter set keeps >= min_values distinct values
+        (modulo requirement narrowing, which the widen pass and the bounded
+        repair absorb). ZONE-aware because decode pins committed zones into
+        claim requirements: a row in a type-poor zone must cap at what THAT
+        zone's types can span, not the global envelope. None when the
+        template carries no minValues or a bound the (zone's) catalog
+        cannot span (decode's repair reproduces the host error)."""
+        mv_reqs = [(key, r.min_values) for key, r in t.requirements.items() if r.min_values is not None]
+        if not mv_reqs:
+            return None
+        cands = [
+            it
+            for it in t.instance_type_options
+            if zone is None or any(o.available and o.zone() == zone for o in it.offerings)
+        ]
+        # NET of daemon overhead, mirroring the row vectors AND the decode
+        # fit check (survivors compares gross alloc >= total + ovh): a cap
+        # from gross allocatable would let slots fill past what the
+        # overhead-burdened prefix types can actually hold
+        vecs = {
+            id(it): rl_to_vec(
+                {k: v for k, v in res.subtract(it.allocatable(), overhead_by_it.get(id(it), {})).items() if v.milli > 0}
+            )
+            for it in cands
+        }
+        order = sorted(cands, key=lambda it: (-vecs[id(it)][0], -vecs[id(it)][1]))
+        cap = None
+        for key, m in mv_reqs:
+            tr = t.requirements.get(key)
+            seen: set[str] = set()
+            cur = None
+            for it in order:
+                cur = vecs[id(it)] if cur is None else np.minimum(cur, vecs[id(it)])
+                r = it.requirements.get(key)
+                if r.operator() == Operator.IN:
+                    seen.update(v for v in r.values if tr.has(v))
+                if len(seen) >= m:
+                    break
+            if len(seen) < m:
+                continue  # unsatisfiable bound: leave rows unclamped
+            cap = cur if cap is None else np.minimum(cap, cur)
+        if cap is not None:
+            # attach-limit axes must stay unbounded (the per-offering clamp
+            # runs after the CSI columns are set)
+            from .volumes import CSI_AXIS_BIG as _BIG
+
+            cap = cap.copy()
+            for i, _driver in csi_axes:
+                cap[i] = _BIG
+        return cap
+
     # per-driver CSI attach axes: raw slot counts; existing nodes carry
     # (limit - attached), new-claim rows are unbounded (the host oracle
     # enforces limits only on existing nodes — ExistingNode.can_add)
@@ -1320,6 +1424,8 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
         return []
 
     for rank, t in enumerate(templates):
+        has_mv = any(r.min_values is not None for r in t.requirements.values())
+        mv_caps: dict = {}  # zone -> cap vector | None, lazily per template
         groups = _compute_daemon_overhead_groups(t, snap.daemonset_pods)
         overhead_by_it = {}
         ports_by_it = {}
@@ -1385,6 +1491,23 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
                     vs = _req_in_values(o.requirements, dom_keys[k])
                     if len(vs) == 1:
                         o_dom[k] = vs[0]
+                if has_mv:
+                    # minValues envelope, per the row's zone (decode pins the
+                    # committed zone into claim requirements, so the row must
+                    # not fill past what ITS zone's types can span). This is
+                    # what the host binds for zone-constrained claims; for
+                    # unconstrained claims the host's bound is the GLOBAL
+                    # envelope, so on zone-starved catalogs the tensor pack
+                    # bins tighter than the host and opens more claims — a
+                    # deliberate conservatism (bench_minvalues emits
+                    # n_new_claims so the cost stays visible) traded for a
+                    # repair-free pack on every committed zone.
+                    zkey = z if z else None
+                    if zkey not in mv_caps:
+                        mv_caps[zkey] = min_values_cap(t, zkey, overhead_by_it)
+                    cap = mv_caps[zkey]
+                    if cap is not None:
+                        o_alloc_vec = np.minimum(o_alloc_vec, cap)
                 row_alloc_l.append(o_alloc_vec)
                 row_price_l.append(o.price)
                 row_labels_l.append(labels_o)

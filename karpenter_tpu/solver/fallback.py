@@ -15,8 +15,29 @@ tells `TPUSolver.solve` how much of the snapshot the reason poisons:
   removal delta that vacates every flagged signature re-derives the reason
   set as empty) — see TPUSolver._solve_masked_delta.
 - ``global``: the reason invalidates tensor semantics for the whole snapshot
-  (minValues, asymmetric selector memberships, kernel validation failures,
-  shared PVC claims, ...) — the entire solve runs on the host FFD.
+  (kernel validation failures, asymmetric (anti-)affinity memberships,
+  relaxation exits, store-less PVC snapshots, ...) — the entire solve runs
+  on the host FFD.
+
+Families that used to be global and are now pod-local-or-better:
+
+- ``min-values`` no longer demotes anything: NodePool minValues is fully
+  tensorized as a DECODE-TIME relaxation (TPUSolver._enforce_min_values) —
+  the pack runs unconstrained, each produced NodeClaim re-checks
+  ``satisfies_min_values`` over its post-filter instance types, widens
+  decode-pinned domain keys when that restores flexibility, relaxes under
+  the BestEffort policy, and routes the (rare) irreparable claims' pods
+  through a bounded host repair (ffd.solve_residual).
+- ``asymmetric-spread-membership`` carries per-signature attribution: the
+  encode flags every signature the asymmetric selector matches OR declares,
+  so the whole coupled membership set routes to the host residual together.
+- ``strict-reserved-offering`` flags only the signatures whose requirements
+  can reach reserved capacity; signatures pinned away from it ride the
+  tensor path (decode's reservation cap never touches them).
+- in-window topology SPREAD groups may span the hybrid seam: the solver
+  exports the tensor side's per-(key, domain) occupancy into the residual
+  scheduler's Topology (tpu._seam_records), so coupled spreads split
+  cleanly instead of forcing the whole-snapshot FFD.
 
 This module is import-cycle-free on purpose: both the encode layer (which
 attributes reasons to signatures) and the solver core (which partitions and
@@ -61,13 +82,23 @@ REASON_FAMILIES = (
 
 # tier per family. "other" (an unrecognized reason) is deliberately GLOBAL:
 # an unattributable reason must take the conservative whole-snapshot path.
+# Every GLOBAL entry carries a one-line justification (enforced by
+# tests/test_solve_modes.py's mechanical walker).
 FAMILY_TIERS: dict[str, str] = {
+    # a failed kernel self-check taints the whole device placement
     "validation": GLOBAL,
+    # relaxation peels constraints pod-by-pod in a stateful host loop
     "relaxation": GLOBAL,
-    "min-values": GLOBAL,
+    # tensorized: decode-time relaxation + bounded host repair
+    # (TPUSolver._enforce_min_values) — no reason is emitted anymore
+    "min-values": POD_LOCAL,
+    # an uncommitted declarer blocks matched pods via inverse semantics the
+    # per-signature masks cannot express mid-solve
     "asymmetric-pod-affinity": GLOBAL,
     "asymmetric-anti-affinity": GLOBAL,
-    "asymmetric-spread-membership": GLOBAL,
+    # attribution flags the full matched+declaring membership set, so the
+    # host residual sees every coupled pod
+    "asymmetric-spread-membership": POD_LOCAL,
     "pod-affinity": POD_LOCAL,
     "combined-keyed-anti-affinity": POD_LOCAL,
     "anti-affinity-namespaces": POD_LOCAL,
@@ -88,9 +119,15 @@ FAMILY_TIERS: dict[str, str] = {
     # no store: the snapshot cannot resolve any volume component
     "pvc-volumes": GLOBAL,
     "dra-claims": POD_LOCAL,
+    # running-pod anti-affinity reported as a REASON means the static
+    # blocked-mask lowering could not express it for the whole snapshot
     "running-anti-affinity": GLOBAL,
-    "strict-reserved-offering": GLOBAL,
+    # flags only signatures whose requirements can reach reserved capacity;
+    # the sequential reservation accounting runs host-side on those alone
+    "strict-reserved-offering": POD_LOCAL,
+    # nothing to partition in an empty snapshot
     "empty": GLOBAL,
+    # an unattributable reason must take the conservative whole-snapshot path
     "other": GLOBAL,
 }
 

@@ -42,7 +42,7 @@ class FFDSolver:
         return build_scheduler(snap).solve(snap.pods)
 
 
-def solve_residual(snap: SolverSnapshot, residual_pods: list, tensor_results: Results) -> Results:
+def solve_residual(snap: SolverSnapshot, residual_pods: list, tensor_results: Results, seam_records=()) -> Results:
     """The hybrid tail: run the exact host Scheduler on `residual_pods`
     against the tensor result's node state — existing StateNodes pre-loaded
     with the tensor-placed pods, and the freshly decoded NodeClaims adopted
@@ -50,11 +50,26 @@ def solve_residual(snap: SolverSnapshot, residual_pods: list, tensor_results: Re
     double-provisioning). Returns the MERGED Results: the tensor claims
     (possibly holding residual pods now) plus any claims the residual opened,
     every existing node with both halves' pods, and the union of pod errors.
-    """
+
+    `seam_records` exports the tensor side's topology occupancy across the
+    partition seam: each record is one tensor-placed pod with its
+    placement's (taints, concrete requirements), recorded into the residual
+    Topology through the host's own counting rule — so a SPREAD group whose
+    selector spans both halves sees the true combined per-domain counts
+    (tpu._seam_records builds the list; encode.hybrid_partition relies on
+    this to let coupled spreads split)."""
     # the zone metric would cover only the residual half — skip computing it
     # and mark it uncomputed rather than misreported (Results contract)
     scheduler = build_scheduler(snap, collect_zone_metrics=False)
     _adopt_tensor_state(scheduler, snap, tensor_results)
+    if seam_records:
+        # build the residual pods' topology groups now so the records land in
+        # them (prepare() is idempotent — scheduler.solve re-entering it only
+        # re-registers owners). Adoption above already added hostname
+        # requirements to the tensor claims, so hostname-keyed groups count.
+        scheduler.topology.prepare(residual_pods)
+        for pod, taints, reqs in seam_records:
+            scheduler.topology.record(pod, taints, reqs)
     results = scheduler.solve(residual_pods)
     results.pod_errors.update(tensor_results.pod_errors)
     results.pending_pods_by_effective_zone = None
@@ -63,10 +78,10 @@ def solve_residual(snap: SolverSnapshot, residual_pods: list, tensor_results: Re
 
 def _adopt_tensor_state(scheduler: Scheduler, snap: SolverSnapshot, tensor_results: Results) -> None:
     """Fold a tensor solve's placements into a fresh Scheduler's state."""
-    # tensor-placed pods are pending (never bound in the store), but exclude
-    # them from topology counting anyway: the partition guarantees no
-    # residual group selects them, and counting them would double-book if
-    # that invariant is ever loosened
+    # tensor-placed pods are pending (never bound in the store); exclude them
+    # from store-side topology counting so they can never double-book — the
+    # seam path counts them explicitly via `seam_records` instead, with the
+    # placement's concrete requirements rather than a store lookup
     placed = [p for en in tensor_results.existing_nodes for p in en.pods]
     placed += [p for nc in tensor_results.new_node_claims for p in nc.pods]
     scheduler.topology.excluded_pods.update(p.metadata.uid for p in placed)
@@ -98,6 +113,7 @@ def _adopt_claim(scheduler: Scheduler, claim) -> None:
         allocator=scheduler.allocator,
         reservation_manager=scheduler.reservation_manager,
         reserved_offering_mode=scheduler.reserved_offering_mode,
+        filter_cache=scheduler.filter_cache,
     )
     for pod in claim.pods:
         ports = pod_host_ports(pod)

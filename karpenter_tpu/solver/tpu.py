@@ -56,6 +56,20 @@ def _group_fits(groups: list, need_vec, reqs) -> bool:
     return False
 
 
+def _compat_offering_mask(its: list, reqs) -> np.ndarray:
+    """[len(its)] bool: requirement compat x an available compatible offering
+    per instance type (nodeclaim.go:626-640) — the one rule both the decode
+    filter and the minValues widening re-filter must share."""
+    mask = np.zeros(len(its), dtype=bool)
+    for i2, cand in enumerate(its):
+        if cand.requirements.intersects(reqs) is None:
+            for o in cand.offerings:
+                if o.available and reqs.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None:
+                    mask[i2] = True
+                    break
+    return mask
+
+
 def _requests_from_sigs(enc, sig_counts: dict[int, int]) -> dict:
     """Total ResourceList for a slot from (signature -> pod count): integer
     milli accumulation, one Quantity construction per resource."""
@@ -314,7 +328,9 @@ class TPUSolver:
         remap[keep] = np.arange(int(keep.sum()), dtype=np.int32)
         self._hybrid_state = dict(full_enc=enc, masked_enc=masked, keep=keep, remap=remap)
         t1 = time.perf_counter()
-        results = solve_residual(snap, residual_pods, tensor_results)
+        results = solve_residual(
+            snap, residual_pods, tensor_results, seam_records=self._seam_records(enc, keep, tensor_results)
+        )
         self._phase("residual", time.perf_counter() - t1)
         self.last_backend = "hybrid"
         self.last_solve_mode = "hybrid"
@@ -393,7 +409,9 @@ class TPUSolver:
             self._count(SOLVER_SOLVE_TOTAL, backend="tpu")
             return tensor_results
         t1 = time.perf_counter()
-        results = solve_residual(snap, residual_pods, tensor_results)
+        results = solve_residual(
+            snap, residual_pods, tensor_results, seam_records=self._seam_records(enc, keep, tensor_results)
+        )
         self._phase("residual", time.perf_counter() - t1)
         self.last_backend = "hybrid"
         self.last_solve_mode = "hybrid-delta"
@@ -402,6 +420,64 @@ class TPUSolver:
             self._count(SOLVER_HYBRID_RESIDUAL_TOTAL, reason=family)
         self._count(SOLVER_SOLVE_TOTAL, backend="hybrid-delta")
         return results
+
+    @staticmethod
+    def _seam_records(enc, keep: np.ndarray, tensor_results: Results, require_cross: bool = True, all_kinds: bool = False) -> list:
+        """Exported topology group counts: (pod, taints, requirements) per
+        tensor-placed pod that a group spanning the residual seam counts,
+        for `ffd.solve_residual` to record into the residual Topology.
+
+        `hybrid_partition` lets SPREAD groups span the partition because of
+        this export: the residual scheduler's per-placement skew rule must
+        run against the true combined per-domain occupancy, and tensor-placed
+        pods are pending (invisible to store-side counting). Each record
+        carries the placement's CONCRETE requirements — the claim's (with its
+        committed domain pin and adopted hostname) or the existing node's
+        label view — so the host's own counting rule (selector + node filter
+        + single-value domain) applies unchanged. Empty whenever no group
+        touches both sides, which keeps the common case free.
+
+        The minValues REPAIR path passes `require_cross=False, all_kinds=True`:
+        a repair splits CLAIMS (not whole signatures), so a group touching
+        only the repaired signatures still has surviving placements the
+        repair must see, and repaired pods can belong to any group kind —
+        `Topology.record` applies the host counting semantics per kind."""
+        from .encode import KIND_DOM_SPREAD, KIND_HOST_SPREAD
+
+        if not enc.n_groups:
+            return []
+        kinds = np.asarray(enc.group_kind)
+        sel = np.ones(kinds.shape[0], dtype=bool) if all_kinds else ((kinds == KIND_DOM_SPREAD) | (kinds == KIND_HOST_SPREAD))
+        if not sel.any():
+            return []
+        touches = enc.sig_member | enc.sig_owner
+        cross = sel & touches[~keep].any(axis=0)
+        if require_cross:
+            cross &= touches[keep].any(axis=0)
+        if not cross.any():
+            return []
+        # record EVERY placed pod the seam groups count (not just kept-sig
+        # pods): a repair can split one signature across the seam
+        seam_sig = touches[:, cross].any(axis=1)
+        if not seam_sig.any():
+            return []
+        sig_of = {id(p): int(s) for p, s in zip(enc.pods, np.asarray(enc.sig_of_pod))}
+        records: list = []
+        for en in tensor_results.existing_nodes:
+            for pod in en.pods:
+                s = sig_of.get(id(pod))
+                if s is not None and seam_sig[s]:
+                    # decode-built ExistingNode requirements are the node's
+                    # label view + hostname — exactly what record() needs
+                    records.append((pod, en.taints, en.requirements))
+        for nc in tensor_results.new_node_claims:
+            for pod in nc.pods:
+                s = sig_of.get(id(pod))
+                if s is not None and seam_sig[s]:
+                    # captured by reference: _adopt_claim adds the in-flight
+                    # hostname requirement in place before the records replay
+                    records.append((pod, nc.template.taints, nc.requirements))
+        return records
 
     def _finish(self, snap, enc, assignment, slot_basis, slot_zoneset, t, out, validated: bool = False, count: bool = True) -> Results:
         """The shared solve tail (full AND delta paths): relaxation check,
@@ -437,7 +513,13 @@ class TPUSolver:
             if self.force:
                 raise
             raise _TensorFallback([f"validation: {e}"], family="validation")
-        if self.mesh is None and out.get("state") is not None:
+        if getattr(self, "_decode_repaired", False):
+            # a minValues host repair re-solved part of the placement off the
+            # carry: the device state no longer matches the Results — drop it
+            # so the next solve takes the cold path instead of replaying a
+            # divergent assignment
+            self._resident = None
+        elif self.mesh is None and out.get("state") is not None:
             self._resident = dict(
                 enc=enc,
                 t=t,
@@ -587,6 +669,9 @@ class TPUSolver:
     # -- decode ----------------------------------------------------------------
     def _decode(self, snap: SolverSnapshot, enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zoneset: np.ndarray) -> Results:
         self.last_backend = "tpu"
+        self._decode_repaired = False
+        repair_pods: list = []  # minValues host repair (bounded, rare)
+        repair_sigs: set[int] = set()
         null_topo = _NullTopology()
 
         # group pods by slot — one vectorized argsort/unique pass instead of
@@ -716,15 +801,7 @@ class TPUSolver:
             its, alloc_mat, ginfo, ov_groups = self._template_ctx(template, claim.daemon_overhead_groups, enc, tmpl_ctx_cache)
             mask = mask_cache.get(rkey)
             if mask is None:
-                # compat x offering per instance type (nodeclaim.go:626-640)
-                mask = np.zeros(len(its), dtype=bool)
-                for i2, cand in enumerate(its):
-                    if cand.requirements.intersects(reqs) is None:
-                        for o in cand.offerings:
-                            if o.available and reqs.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None:
-                                mask[i2] = True
-                                break
-                mask_cache[rkey] = mask
+                mask = mask_cache[rkey] = _compat_offering_mask(its, reqs)
             total_vec = total_mat[j]
             # groups whose daemon-reserved ports conflict with the slot's
             # pods can never host them (nodeclaim.py:430 semantics); the
@@ -736,22 +813,27 @@ class TPUSolver:
                 pod_ports = [(k, ps) for k, ps in pod_ports if ps]
             else:
                 pod_ports = []
-            remaining = []
-            for members, ovh, gusage in ginfo:
-                if not members:
-                    continue
-                if pod_ports and not _ports_fit(gusage, pod_ports):
-                    continue
-                fits = np.all(alloc_mat[members] >= total_vec[None, :] + ovh[None, :], axis=1)
-                surv = fits & mask[members]
-                if ov_groups:
-                    # ITs with override offerings use the exact group-wise
-                    # fits (a group's own allocatable × a compatible offering
-                    # in THAT group — nodeclaim.go:624-640)
-                    for pos, m in enumerate(members):
-                        if m in ov_groups and its[m].requirements.intersects(reqs) is None:
-                            surv[pos] = _group_fits(ov_groups[m], total_vec + ovh, reqs)
-                remaining.extend(its[m] for m, ok in zip(members, surv) if ok)
+
+            def survivors(reqs_x, mask_x, ginfo=ginfo, its=its, alloc_mat=alloc_mat, ov_groups=ov_groups, total_vec=total_vec, pod_ports=pod_ports):
+                out_l = []
+                for members, ovh, gusage in ginfo:
+                    if not members:
+                        continue
+                    if pod_ports and not _ports_fit(gusage, pod_ports):
+                        continue
+                    fits = np.all(alloc_mat[members] >= total_vec[None, :] + ovh[None, :], axis=1)
+                    surv = fits & mask_x[members]
+                    if ov_groups:
+                        # ITs with override offerings use the exact group-wise
+                        # fits (a group's own allocatable × a compatible offering
+                        # in THAT group — nodeclaim.go:624-640)
+                        for pos, m in enumerate(members):
+                            if m in ov_groups and its[m].requirements.intersects(reqs_x) is None:
+                                surv[pos] = _group_fits(ov_groups[m], total_vec + ovh, reqs_x)
+                    out_l.extend(its[m] for m, ok in zip(members, surv) if ok)
+                return out_l
+
+            remaining = survivors(reqs, mask)
             if not remaining:
                 # the post-filter set must never be empty when the kernel is
                 # sound; before trusting the single packed row, re-check it is
@@ -787,16 +869,123 @@ class TPUSolver:
                 if not it_ok:
                     raise DecodeError(f"slot {j}: packed row {it.name} not launchable under final claim requirements")
                 remaining = [it]
+            if claim.requirements.has_min_values():
+                # tensorized minValues: the pack ran unconstrained; enforce
+                # the per-claim flexibility bound now — widening decode pins,
+                # relaxing under BestEffort, or handing the claim's pods to
+                # the bounded host repair below
+                remaining = self._enforce_min_values(
+                    snap, enc, claim, remaining, sig_counts, dom_sig, key_all_vals, its, survivors
+                )
+                if remaining is None:
+                    repair_pods.extend(pods)
+                    repair_sigs.update(int(s) for s in usigs)
+                    continue
             claim.instance_type_options = remaining
             if reservation_manager is not None:
                 self._apply_reservations(claim, reservation_manager)
             new_claims.append(claim)
 
-        return Results(
+        results = Results(
             new_node_claims=new_claims,
             existing_nodes=existing_nodes,
             pod_errors=pod_errors,
         )
+        if repair_pods:
+            # bounded host repair: pods of the claims whose minValues could
+            # not be met tensor-side re-solve on the exact host path against
+            # the rest of this placement (same machinery as the hybrid
+            # residual) — host Strict semantics restored per pod. The
+            # surviving placements' topology occupancy is exported so the
+            # repair cannot violate a group the repaired pods share with
+            # them (repairs split CLAIMS, so one signature can sit on both
+            # sides — hence require_cross=False, all_kinds=True).
+            from ..metrics import SOLVER_DECODE_REPAIR_TOTAL
+            from .ffd import solve_residual
+
+            self._decode_repaired = True
+            self._count(SOLVER_DECODE_REPAIR_TOTAL, reason="min-values")
+            keep = np.ones(enc.n_sigs, dtype=bool)
+            keep[list(repair_sigs)] = False
+            results = solve_residual(
+                snap, repair_pods, results,
+                seam_records=self._seam_records(enc, keep, results, require_cross=False, all_kinds=True),
+            )
+        return results
+
+    def _enforce_min_values(self, snap, enc, claim, remaining, sig_counts, dom_sig, key_all_vals, its, survivors):
+        """Per-claim decode-time minValues relaxation (replaces the old
+        snapshot-GLOBAL fallback). Mirrors the host's claim-open behavior
+        (nodeclaim.py filter_instance_types + can_add relax_min_values):
+
+        1. `satisfies_min_values` over the post-filter instance types — the
+           common case passes untouched.
+        2. WIDEN: drop every decode-added domain pin that nothing
+           load-bearing depends on (no topology group constrains the key
+           for this slot's pods, and neither pod requirements nor inverse
+           anti-affinity narrow their domain masks) and re-filter on the
+           widened set. The host never narrowed those keys in the first
+           place — and a pin on ANY domain key (typically zone) starves
+           instance-type diversity indirectly through the offering-compat
+           filter, so widening is not limited to the unsatisfied keys.
+        3. Under the BestEffort policy, relax the bound to the observed
+           count exactly like `can_add(relax_min_values=True)`.
+        4. Otherwise return None: the claim's pods take the bounded host
+           repair (ffd.solve_residual), which reproduces the host's Strict
+           per-pod errors.
+        """
+        from ..cloudprovider.types import satisfies_min_values
+
+        _, unsat = satisfies_min_values(remaining, claim.requirements)
+        if not unsat:
+            return remaining
+        Kd = len(enc.dom_key_names)
+        dko = np.asarray(enc.dom_key_of)
+        sigs = sorted(sig_counts)
+        gd = np.asarray(enc.group_dom_key)
+        widen: set[int] = set()
+        for k in range(Kd):
+            vals = [enc.dom_values[d] for d in dom_sig if d >= Kd and dko[d] == k]
+            if not (vals and set(vals) != key_all_vals[k]):
+                continue  # decode added no pin for this key
+            gmask = gd == k
+            if gmask.any() and (enc.sig_member[sigs][:, gmask] | enc.sig_owner[sigs][:, gmask]).any():
+                continue  # a topology group rides the commitment: load-bearing
+            keydoms = (dko == k) & (np.arange(enc.n_doms) >= Kd)
+            if not all(enc.sig_dom_allowed[s, keydoms].all() for s in sigs):
+                continue  # pod reqs / inverse anti-affinity narrow the key
+            widen.add(k)
+        if widen:
+            reqs_w = Requirements()
+            reqs_w.add(*claim.template.requirements.values())
+            for s in sigs:
+                reqs_w.add(*enc.sig_requirements[s].values())
+            for k in range(Kd):
+                if k in widen:
+                    continue
+                vals = [enc.dom_values[d] for d in dom_sig if d >= Kd and dko[d] == k]
+                if vals and set(vals) != key_all_vals[k]:
+                    reqs_w.add(Requirement(enc.dom_key_names[k], "In", vals))
+            claim.requirements = reqs_w
+            remaining = survivors(reqs_w, _compat_offering_mask(its, reqs_w))
+            _, unsat = satisfies_min_values(remaining, claim.requirements)
+            if not unsat:
+                return remaining
+        if not remaining:
+            # the widened filter can come back empty when the original
+            # survivors set was empty and decode fell back to the single
+            # packed row — a claim with no instance types is unlaunchable
+            # under ANY policy, so route its pods to the host repair
+            return None
+        if getattr(snap, "min_values_policy", "Strict") == "BestEffort":
+            # copy-on-write like the host: entries may alias template-owned
+            # Requirement objects
+            for key, mv in unsat.items():
+                relaxed = claim.requirements.get(key).copy()
+                relaxed.min_values = mv
+                claim.requirements.replace(relaxed)
+            return remaining
+        return None
 
     @staticmethod
     def _apply_reservations(claim, reservation_manager) -> None:

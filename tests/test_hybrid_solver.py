@@ -74,26 +74,76 @@ class TestPartition:
         enc = encode(snap)
         assert hybrid_partition(snap, enc) is None
 
-    def test_shared_topology_group_blocks_partition(self):
-        # the flagged pod declares the SAME zone spread as the tensor-side
-        # pods (plus an out-of-window second domain key): splitting would
-        # break the joint skew accounting
+    def test_shared_spread_group_partitions_with_seam_export(self):
+        # the flagged pod (preferred pod affinity — pod-local) declares the
+        # SAME zone spread as the tensor-side pods. PR 3: spread groups may
+        # span the seam — the solver exports the tensor side's zone
+        # occupancy into the residual Topology, so the split preserves the
+        # joint skew accounting instead of forcing whole-snapshot FFD.
         sel = {"matchLabels": {"app": "w"}}
         spread = zone_spread(selector=sel)
-        # the second spread self-selects (symmetric) but rides a second
-        # domain key — a pod-local reason on a pod whose FIRST spread is
-        # shared with the tensor side
-        other_key_spread = TopologySpreadConstraint(
-            max_skew=1, topology_key="rack", label_selector={"matchLabels": {"grp": "m"}}
-        )
         pods = [make_pod(cpu="1", labels={"app": "w"}, tsc=[spread]) for _ in range(4)]
-        pods.append(make_pod(cpu="1", name="multi", labels={"app": "w", "grp": "m"}, tsc=[spread, other_key_spread]))
+        multi = make_pod(cpu="1", name="multi", labels={"app": "w"}, tsc=[spread])
+        multi.spec.affinity = Affinity(
+            pod_affinity_preferred=[
+                WeightedPodAffinityTerm(
+                    weight=1,
+                    term=PodAffinityTerm(label_selector={"matchLabels": {"x": "y"}}, topology_key=wk.ZONE_LABEL_KEY),
+                )
+            ]
+        )
+        pods.append(multi)
         snap = make_snapshot(pods)
         enc = encode(snap)
-        assert any("multiple domain keys" in r for r in enc.fallback_reasons)
+        assert any("preferred pod affinity" in r for r in enc.fallback_reasons)
         assert not enc.fallback_has_global
+        part = hybrid_partition(snap, enc)
+        assert part is not None
+        _tensor, residual = part
+        assert [p.metadata.name for p in residual] == ["multi"]
+        # the solver runs hybrid and the COMBINED zone skew stays <= 1
+        solver = TPUSolver()
+        results = solver.solve(make_snapshot(pods))
+        assert solver.last_backend == "hybrid"
+        assert not results.pod_errors
+        zone_counts: dict[str, int] = {}
+        for nc in results.new_node_claims:
+            zr = nc.requirements.get(wk.ZONE_LABEL_KEY)
+            members = [p for p in nc.pods if p.metadata.labels.get("app") == "w"]
+            if members:
+                assert len(zr.values) == 1, "spread member claim must commit to one zone"
+                z = next(iter(zr.values))
+                zone_counts[z] = zone_counts.get(z, 0) + len(members)
+        observed = [c for c in zone_counts.values() if c > 0]
+        assert observed and max(observed) - min(observed) <= 1, zone_counts
+
+    def test_shared_affinity_group_still_blocks_partition(self):
+        # AFFINITY kinds keep the coupling gate: bootstrap/blocking semantics
+        # cannot split. The flagged pod shares a required zone pod-affinity
+        # group with the tensor side (symmetric selector), plus a pod-local
+        # reason on the same pod ("pod affinity combined with other topology
+        # constraints": a self-selecting hostname spread rides along).
+        sel = {"matchLabels": {"grp": "co"}}
+        aff_term = PodAffinityTerm(label_selector=sel, topology_key=wk.ZONE_LABEL_KEY)
+        pods = [make_pod(cpu="1", labels={"grp": "co"}, pod_affinity=[aff_term]) for _ in range(3)]
+        flagged = make_pod(
+            cpu="1",
+            name="flagged",
+            labels={"grp": "co", "f": "x"},
+            pod_affinity=[aff_term],
+            tsc=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=wk.HOSTNAME_LABEL_KEY,
+                    label_selector={"matchLabels": {"f": "x"}},
+                )
+            ],
+        )
+        pods.append(flagged)
+        snap = make_snapshot(pods)
+        enc = encode(snap)
+        assert enc.fallback_reasons and not enc.fallback_has_global, enc.fallback_reasons
         assert hybrid_partition(snap, enc) is None
-        # and the solver takes the whole-snapshot fallback
         solver = TPUSolver()
         solver.solve(make_snapshot(pods))
         assert solver.last_backend == "ffd-fallback"

@@ -1,0 +1,279 @@
+"""Tensorized minValues + coupled-spread seam parity (ISSUE 3 tentpole).
+
+NodePool minValues no longer demotes the snapshot to the host FFD: the pack
+runs unconstrained and decode enforces `satisfies_min_values` per produced
+claim (TPUSolver._enforce_min_values) — widening decode-added domain pins,
+relaxing under the BestEffort policy, and routing irreparable claims through
+the bounded host repair. This suite proves, over randomized snapshots, that
+every produced claim satisfies every minValues bound, that the bound
+propagates into the API NodeClaim, and that node counts match the host FFD.
+
+The coupled-spread half proves the other tentpole leg: a spread group whose
+selector spans the hybrid seam splits cleanly because the residual scheduler
+sees the tensor side's per-domain occupancy (tpu._seam_records) — no
+spread-constraint violation across the partition seam.
+"""
+
+import random
+
+import pytest
+
+from helpers import make_nodepool, make_pod, zone_spread
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.cloudprovider.types import satisfies_min_values
+from karpenter_tpu.kube.objects import Affinity, PodAffinityTerm, WeightedPodAffinityTerm
+from karpenter_tpu.solver import FFDSolver
+from karpenter_tpu.solver.encode import check_capability
+from karpenter_tpu.solver.tpu import TPUSolver
+from karpenter_tpu.solver.validate import validate_results
+from test_solver import LINUX_AMD64, make_snapshot
+
+MV_KEY = wk.INSTANCE_TYPE_LABEL_KEY
+
+
+def minvalues_pool(key=MV_KEY, operator="Exists", values=(), mv=2):
+    return make_nodepool(
+        requirements=LINUX_AMD64 + [{"key": key, "operator": operator, "values": list(values), "minValues": mv}]
+    )
+
+
+def random_pods(rng, n):
+    pods = []
+    for i in range(n):
+        k = rng.random()
+        cpu = rng.choice(["250m", "500m", "1", "2", "4"])
+        mem = rng.choice(["256Mi", "512Mi", "1Gi", "4Gi"])
+        if k < 0.15:
+            pods.append(
+                make_pod(cpu=cpu, memory=mem, name=f"z{i}", node_selector={wk.ZONE_LABEL_KEY: rng.choice(["test-zone-a", "test-zone-b"])})
+            )
+        elif k < 0.3:
+            pods.append(make_pod(cpu=cpu, memory=mem, name=f"l{i}", labels={"tier": rng.choice(["a", "b"])}))
+        else:
+            pods.append(make_pod(cpu=cpu, memory=mem, name=f"p{i}"))
+    return pods
+
+
+def assert_claims_satisfy_min_values(results):
+    for nc in results.new_node_claims:
+        assert nc.requirements.has_min_values(), "template minValues must survive to the claim"
+        _, unsat = satisfies_min_values(nc.instance_type_options, nc.requirements)
+        assert not unsat, f"claim violates minValues: {unsat}"
+
+
+class TestMinValuesTensorized:
+    def test_min_values_is_not_a_capability_reason(self):
+        snap = make_snapshot([make_pod(cpu="1")], node_pools=[minvalues_pool(mv=3)])
+        assert check_capability(snap) == []
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_randomized_parity_with_host_ffd(self, seed):
+        rng = random.Random(seed)
+        mv = rng.choice([2, 3, 4])
+        n = rng.randrange(20, 60)
+
+        def snap():
+            return make_snapshot(random_pods(random.Random(seed), n), node_pools=[minvalues_pool(mv=mv)])
+
+        solver = TPUSolver()
+        results = solver.solve(snap())
+        assert solver.last_backend == "tpu", solver.last_fallback_reasons
+        assert not results.pod_errors, list(results.pod_errors.values())[:3]
+        assert_claims_satisfy_min_values(results)
+        assert not validate_results(snap(), results)
+
+        ffd_results = FFDSolver().solve(snap())
+        assert not ffd_results.pod_errors
+        assert len(results.new_node_claims) == len(ffd_results.new_node_claims)
+
+    def test_min_values_propagates_to_api_node_claim(self):
+        snap = make_snapshot(random_pods(random.Random(7), 12), node_pools=[minvalues_pool(mv=3)])
+        solver = TPUSolver()
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu" and not results.pod_errors
+        for nc in results.new_node_claims:
+            api = nc.to_api_node_claim()
+            it_reqs = [d for d in api.spec.requirements if d["key"] == MV_KEY and d["operator"] == "In"]
+            assert it_reqs and it_reqs[0].get("minValues") == 3
+            assert len(set(it_reqs[0]["values"])) >= 3
+
+    def test_zone_min_values_widens_decode_pin(self):
+        # minValues on the ZONE key: the decode's row-commitment pin would
+        # observe a single zone; with no zone topology group and no pod zone
+        # constraints the pin is widened and the bound met tensor-side
+        pool = minvalues_pool(key=wk.ZONE_LABEL_KEY, operator="Exists", mv=2)
+        pods = [make_pod(cpu="1", name=f"p{i}") for i in range(8)]
+        snap = make_snapshot(pods, node_pools=[pool])
+        solver = TPUSolver()
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert not results.pod_errors, list(results.pod_errors.values())[:3]
+        for nc in results.new_node_claims:
+            _, unsat = satisfies_min_values(nc.instance_type_options, nc.requirements)
+            assert not unsat
+            zr = nc.requirements.get(wk.ZONE_LABEL_KEY)
+            assert len({z for it in nc.instance_type_options for o in it.offerings if o.available and zr.has(o.zone()) for z in [o.zone()]}) >= 2
+
+    def test_zone_min_values_with_spread_keeps_pin_and_repairs(self):
+        # the slot's pods DECLARE a zone spread: the commitment is
+        # load-bearing, widening is refused, and the claims route through
+        # the bounded host repair, which reproduces the host outcome exactly
+        pool = minvalues_pool(key=wk.ZONE_LABEL_KEY, operator="Exists", mv=2)
+        sel = {"matchLabels": {"app": "w"}}
+        pods = [make_pod(cpu="1", name=f"s{i}", labels={"app": "w"}, tsc=[zone_spread(selector=sel)]) for i in range(6)]
+        snap = make_snapshot(pods, node_pools=[pool])
+        solver = TPUSolver()
+        results = solver.solve(snap)
+        ffd_results = FFDSolver().solve(
+            make_snapshot(
+                [make_pod(cpu="1", name=f"s{i}", labels={"app": "w"}, tsc=[zone_spread(selector=sel)]) for i in range(6)],
+                node_pools=[minvalues_pool(key=wk.ZONE_LABEL_KEY, operator="Exists", mv=2)],
+            )
+        )
+        # parity on the OUTCOME: same scheduled/failed pod partition
+        assert {k for k in results.pod_errors} == {k for k in ffd_results.pod_errors}
+        for nc in results.new_node_claims:
+            _, unsat = satisfies_min_values(nc.instance_type_options, nc.requirements)
+            assert not unsat
+
+    def test_best_effort_relaxes_like_host(self):
+        n_types = len(catalog.construct_instance_types())
+        pool = minvalues_pool(mv=n_types + 50)  # more flexibility than exists
+        pods = [make_pod(cpu="1", name=f"p{i}") for i in range(6)]
+        snap = make_snapshot(pods, node_pools=[pool])
+        snap.min_values_policy = "BestEffort"
+        solver = TPUSolver()
+        results = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        assert not results.pod_errors, list(results.pod_errors.values())[:3]
+        for nc in results.new_node_claims:
+            relaxed = nc.requirements.get(MV_KEY).min_values
+            assert relaxed is not None and relaxed <= len(nc.instance_type_options)
+            _, unsat = satisfies_min_values(nc.instance_type_options, nc.requirements)
+            assert not unsat
+
+        ffd_snap = make_snapshot(
+            [make_pod(cpu="1", name=f"p{i}") for i in range(6)], node_pools=[minvalues_pool(mv=n_types + 50)]
+        )
+        ffd_snap.min_values_policy = "BestEffort"
+        ffd_results = FFDSolver().solve(ffd_snap)
+        # both backends schedule everything; claim COUNTS legitimately differ
+        # (the host's in-flight no-relax rule splinters claims, the tensor
+        # path relaxes once over the co-packed claim)
+        assert not ffd_results.pod_errors
+        assert len(results.new_node_claims) <= len(ffd_results.new_node_claims)
+
+    def test_strict_unsatisfiable_repairs_to_host_errors(self):
+        n_types = len(catalog.construct_instance_types())
+        pool = minvalues_pool(mv=n_types + 50)
+        pods = [make_pod(cpu="1", name=f"p{i}") for i in range(4)]
+        solver = TPUSolver()
+        results = solver.solve(make_snapshot(pods, node_pools=[pool]))
+        ffd_results = FFDSolver().solve(
+            make_snapshot([make_pod(cpu="1", name=f"p{i}") for i in range(4)], node_pools=[minvalues_pool(mv=n_types + 50)])
+        )
+        # both paths fail every pod, with the host's minValues message
+        assert set(results.pod_errors) == set(ffd_results.pod_errors)
+        assert all("minValues" in e for e in results.pod_errors.values())
+
+    def test_repair_clears_resident_carry(self):
+        # a repaired solve must not leave a divergent device carry behind
+        n_types = len(catalog.construct_instance_types())
+        pool = minvalues_pool(mv=n_types + 50)
+        pods = [make_pod(cpu="1", name=f"p{i}") for i in range(4)]
+        solver = TPUSolver()
+        solver.solve(make_snapshot(pods, node_pools=[pool]))
+        assert solver._resident is None
+        # the next (clean) solve takes the full path and succeeds
+        results = solver.solve(make_snapshot([make_pod(cpu="1", name="ok")]))
+        assert solver.last_solve_mode == "full" and not results.pod_errors
+
+    def test_decode_repair_metric_counts(self):
+        from karpenter_tpu.metrics import SOLVER_DECODE_REPAIR_TOTAL, make_registry
+
+        registry = make_registry()
+        n_types = len(catalog.construct_instance_types())
+        pool = minvalues_pool(mv=n_types + 50)
+        solver = TPUSolver(registry=registry)
+        solver.solve(make_snapshot([make_pod(cpu="1")], node_pools=[pool]))
+        assert registry.counter(SOLVER_DECODE_REPAIR_TOTAL).value(reason="min-values") >= 1
+
+
+class TestCoupledSpreadSeam:
+    """The residual must respect tensor-side domain occupancy: a spread
+    group spanning the hybrid seam keeps its combined skew bound."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_no_skew_violation_across_seam(self, seed):
+        rng = random.Random(seed)
+        sel = {"matchLabels": {"app": "web"}}
+        n_clean = rng.randrange(6, 14)
+        n_flagged = rng.randrange(1, 4)
+
+        def flagged(i):
+            p = make_pod(cpu="500m", name=f"f{i}", labels={"app": "web"}, tsc=[zone_spread(selector=sel)])
+            p.spec.affinity = Affinity(
+                pod_affinity_preferred=[
+                    WeightedPodAffinityTerm(
+                        weight=1,
+                        term=PodAffinityTerm(label_selector={"matchLabels": {"x": "y"}}, topology_key=wk.ZONE_LABEL_KEY),
+                    )
+                ]
+            )
+            return p
+
+        pods = [make_pod(cpu="500m", name=f"w{i}", labels={"app": "web"}, tsc=[zone_spread(selector=sel)]) for i in range(n_clean)]
+        pods += [flagged(i) for i in range(n_flagged)]
+        pods += [make_pod(cpu=rng.choice(["1", "2"]), name=f"x{i}") for i in range(rng.randrange(0, 6))]
+        snap = make_snapshot(pods)
+        solver = TPUSolver()
+        results = solver.solve(snap)
+        assert solver.last_backend == "hybrid", (solver.last_backend, solver.last_fallback_reasons[:2])
+        assert not results.pod_errors, list(results.pod_errors.values())[:3]
+
+        zone_counts: dict[str, int] = {}
+        for nc in results.new_node_claims:
+            members = [p for p in nc.pods if p.metadata.labels.get("app") == "web"]
+            if not members:
+                continue
+            zr = nc.requirements.get(wk.ZONE_LABEL_KEY)
+            assert len(zr.values) == 1, "spread-member claim must commit to one zone"
+            z = next(iter(zr.values))
+            zone_counts[z] = zone_counts.get(z, 0) + len(members)
+        for en in results.existing_nodes:
+            members = [p for p in en.pods if p.metadata.labels.get("app") == "web"]
+            if members:
+                z = en.state_node.labels().get(wk.ZONE_LABEL_KEY)
+                zone_counts[z] = zone_counts.get(z, 0) + len(members)
+        observed = [c for c in zone_counts.values() if c > 0]
+        assert observed and max(observed) - min(observed) <= 1, zone_counts
+
+    def test_seam_records_cover_only_cross_seam_members(self):
+        # no cross-seam spread -> empty export (the common case stays free)
+        import numpy as np
+
+        from karpenter_tpu.solver.encode import encode
+
+        pods = [make_pod(cpu="500m", name=f"p{i}") for i in range(4)]
+        odd = make_pod(cpu="500m", name="odd")
+        odd.spec.affinity = Affinity(
+            pod_affinity_preferred=[
+                WeightedPodAffinityTerm(
+                    weight=1,
+                    term=PodAffinityTerm(label_selector={"matchLabels": {"x": "y"}}, topology_key=wk.ZONE_LABEL_KEY),
+                )
+            ]
+        )
+        snap = make_snapshot(pods + [odd])
+        solver = TPUSolver()
+        results = solver.solve(snap)
+        assert solver.last_backend == "hybrid"
+        enc = solver.encode_cache.last_enc
+        keep = np.ones(enc.n_sigs, dtype=bool)
+        keep[list(enc.fallback_sig_local)] = False
+        assert TPUSolver._seam_records(enc, keep, results) == []
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -115,3 +115,84 @@ class TestSolveModeInvariant:
         solver.solve(make_snapshot([make_pod(cpu="500m", name="fresh")]))
         _check(solver)
         assert solver.last_solve_mode == "full"
+
+
+class TestReasonFamilyEnum:
+    """Mechanical walker over the fallback-family enum (ISSUE 3): every
+    family routes to a defined tier, every GLOBAL family justifies itself in
+    a comment, and solver metrics can only ever carry enum labels."""
+
+    def test_every_family_routes_to_a_defined_tier(self):
+        from karpenter_tpu.solver.fallback import FAMILY_TIERS, GLOBAL, POD_LOCAL, REASON_FAMILIES
+
+        for _needle, family in REASON_FAMILIES:
+            assert family in FAMILY_TIERS, f"family {family!r} has no tier"
+            assert FAMILY_TIERS[family] in (GLOBAL, POD_LOCAL)
+        # demotions this PR made are pinned here so a revert is loud
+        assert FAMILY_TIERS["min-values"] == POD_LOCAL
+        assert FAMILY_TIERS["asymmetric-spread-membership"] == POD_LOCAL
+        assert FAMILY_TIERS["strict-reserved-offering"] == POD_LOCAL
+        assert FAMILY_TIERS["other"] == GLOBAL
+
+    def test_every_global_family_carries_a_justification_comment(self):
+        import inspect
+        import re
+
+        from karpenter_tpu.solver import fallback
+
+        src = inspect.getsource(fallback).splitlines()
+        entry_re = re.compile(r'^\s*"([a-z0-9-]+)":\s*(GLOBAL|POD_LOCAL),')
+        for i, line in enumerate(src):
+            m = entry_re.match(line)
+            if m is None or m.group(2) != "GLOBAL":
+                continue
+            if "#" in line.split(",", 1)[1]:
+                continue  # trailing justification on the entry itself
+            # a comment block may justify a CONTIGUOUS run of GLOBAL entries
+            j = i - 1
+            while j >= 0:
+                mm = entry_re.match(src[j])
+                if mm is not None and mm.group(2) == "GLOBAL":
+                    j -= 1
+                    continue
+                break
+            assert j >= 0 and src[j].lstrip().startswith("#"), (
+                f"GLOBAL family {m.group(1)!r} lacks a one-line justification comment"
+            )
+
+    def test_reason_family_total_on_arbitrary_strings(self):
+        import random
+
+        from karpenter_tpu.solver.fallback import FAMILY_TIERS, REASON_FAMILIES, reason_family
+
+        enum = {fam for _n, fam in REASON_FAMILIES} | {"other"}
+        rng = random.Random(0)
+        probes = ["", "garbage", "pod xyz: exploded"] + [
+            "".join(rng.choice("abcdef -:/") for _ in range(rng.randrange(1, 40))) for _ in range(200)
+        ] + [needle for needle, _f in REASON_FAMILIES]
+        for s in probes:
+            fam = reason_family(s)
+            assert fam in enum and fam in FAMILY_TIERS, (s, fam)
+
+    def test_residual_metric_cardinality_bounded_by_enum(self):
+        from karpenter_tpu.metrics import (
+            SOLVER_DECODE_REPAIR_TOTAL,
+            SOLVER_FALLBACK_TOTAL,
+            SOLVER_HYBRID_RESIDUAL_TOTAL,
+            make_registry,
+        )
+        from karpenter_tpu.solver.fallback import REASON_FAMILIES
+
+        registry = make_registry()
+        solver = TPUSolver(registry=registry)
+        # one hybrid solve + one fallback solve + a clean solve
+        solver.solve(make_snapshot([make_pod(cpu="500m"), _odd_pod()]))
+        assert solver.last_solve_mode == "hybrid"
+        solver.solve(make_snapshot([_global_pod()] + [make_pod(cpu="1", labels={"app": "other"}, name="o")]))
+        assert solver.last_solve_mode == "fallback"
+        solver.solve(make_snapshot([make_pod(cpu="500m", name="clean")]))
+
+        enum = {fam for _n, fam in REASON_FAMILIES} | {"other"}
+        for metric in (SOLVER_HYBRID_RESIDUAL_TOTAL, SOLVER_FALLBACK_TOTAL, SOLVER_DECODE_REPAIR_TOTAL):
+            for labels, _v in registry.counter(metric).collect():
+                assert labels.get("reason") in enum, (metric, labels)
