@@ -1,0 +1,33 @@
+"""solverlint: repo-specific static analysis for the tensor solver.
+
+The tensor path's speed rests on invariants no general-purpose linter knows
+about: `mask_encode`/`_try_delta_encode` share encode arrays BY REFERENCE
+(one in-place write corrupts the cached delta base), the pack must never
+host-sync mid-kernel or loop Python-side over the pod axis, every fallback
+reason family must carry a hybrid tier (GLOBAL ones justified), and solver
+metric labels must stay enum-bounded. This package machine-checks those
+invariants as ~5 AST rules over the modules `[tool.solverlint]` names in
+pyproject.toml:
+
+    python -m karpenter_tpu.analysis              # nonzero exit on findings
+    python -m karpenter_tpu.analysis --self-test  # rule-discovery sanity gate
+
+A finding is suppressed only by a justified pragma on (or directly above)
+the offending line:
+
+    # solverlint: ok(<rule-name>): <why this is sound>
+
+Runtime counterpart: `karpenter_tpu/solver/contracts.py` enforces the
+encode-space shape/dtype contracts under KARPENTER_SOLVER_TYPECHECK=1 (the
+tier-1 test run enables it), and `mask_encode` freezes reference-shared
+arrays so mutations the linter misses raise instead of corrupting caches.
+
+Everything here is stdlib-only (ast + tomllib/tomli): the gate runs in a
+few seconds (the cardinality rule parses the whole package) and never
+imports jax/numpy.
+"""
+
+from .core import Finding, run_analysis, run_self_test  # noqa: F401
+from .rules import RULES  # noqa: F401
+
+__all__ = ["Finding", "run_analysis", "run_self_test", "RULES"]

@@ -1,0 +1,119 @@
+"""solverlint configuration: `[tool.solverlint]` in pyproject.toml.
+
+Defaults below ARE the repo's configuration; pyproject entries override them
+key-by-key (kebab-case keys map to the dataclass fields). The shared-field
+registry is extracted from `solver/encode.py` by AST — the analyzer never
+imports solver code, so the gate stays jax-free and fast.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+
+
+class ConfigError(RuntimeError):
+    """Configuration or registry extraction failed: the gate must fail
+    loudly rather than pass vacuously."""
+
+
+@dataclasses.dataclass
+class Config:
+    # modules on the tensor hot path: shared-array / host-sync / pod-loop
+    # rules run only here
+    tensor_modules: tuple[str, ...] = (
+        "karpenter_tpu/solver/encode.py",
+        "karpenter_tpu/solver/tpu.py",
+        "karpenter_tpu/solver/check.py",
+    )
+    # "<file>:<constant>" — the frozenset of EncodedSnapshot field names that
+    # derived encodes share by reference
+    shared_field_registry: str = "karpenter_tpu/solver/encode.py:SHARED_ENCODE_FIELDS"
+    # the fallback-family registry module (reason-family-tiers rule)
+    fallback_module: str = "karpenter_tpu/solver/fallback.py"
+    # metric-label-cardinality scans every package module
+    metrics_modules: tuple[str, ...] = ("karpenter_tpu/**/*.py",)
+    # identifiers that mark an iterable as pod/offering-scaled (exact match
+    # against bare names and attribute tails)
+    pod_axis_names: tuple[str, ...] = ("pods", "n_pods")
+    # callees whose results live on device: coercing them is a host sync.
+    # fnmatch patterns over the dotted callee (and its last segment).
+    device_producers: tuple[str, ...] = (
+        "greedy_pack_grouped_sharded",
+        "recredit_removals",
+        "make_tensors",
+        "make_item_tensors",
+        "jnp.*",
+        "jax.*",
+        "lax.*",
+    )
+    # label keys that must be statically enumerable at counter/histogram
+    # call sites (identity labels like nodepool/node_name are exempt)
+    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision")
+    # callees whose return value is enum-bounded by construction
+    bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family")
+    # wrapper methods whose OWN bodies forward **labels to the registry
+    metric_wrappers: tuple[str, ...] = ("_count", "_observe")
+    # cap on distinct literal values per bounded label key, repo-wide
+    max_label_values: int = 16
+    # direct override for tests/self-test; when None the registry file is
+    # parsed on first use
+    shared_fields: frozenset | None = None
+
+    def resolve_shared_fields(self, root: Path) -> frozenset:
+        if self.shared_fields is not None:
+            return self.shared_fields
+        try:
+            rel, _, attr = self.shared_field_registry.partition(":")
+            src = (root / rel).read_text()
+            tree = ast.parse(src)
+        except OSError as e:
+            raise ConfigError(f"shared-field registry unreadable: {e}") from e
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == attr for t in node.targets):
+                continue
+            names = frozenset(
+                c.value for c in ast.walk(node.value) if isinstance(c, ast.Constant) and isinstance(c.value, str)
+            )
+            if names:
+                self.shared_fields = names
+                return names
+        raise ConfigError(f"shared-field registry {self.shared_field_registry!r} not found or empty")
+
+
+_KEYMAP = {f.name.replace("_", "-"): f.name for f in dataclasses.fields(Config)}
+
+
+def load_config(root: Path) -> Config:
+    """Config from `[tool.solverlint]`, falling back to the baked defaults."""
+    cfg = Config()
+    pyproject = root / "pyproject.toml"
+    if not pyproject.exists():
+        return cfg
+    try:
+        import tomllib
+    except ModuleNotFoundError:  # py310: same API under its backport name
+        import tomli as tomllib
+
+    try:
+        table = tomllib.loads(pyproject.read_text()).get("tool", {}).get("solverlint", {})
+    except tomllib.TOMLDecodeError as e:
+        raise ConfigError(f"pyproject.toml unparseable: {e}") from e
+    for key, value in table.items():
+        field = _KEYMAP.get(key)
+        if field is None:
+            raise ConfigError(f"[tool.solverlint] unknown key {key!r}")
+        default = getattr(cfg, field)
+        # type-check against the default so a mistyped entry is a loud
+        # ConfigError (exit 2), not a mid-run TypeError read as "findings"
+        if isinstance(default, tuple):
+            if not isinstance(value, list) or not all(isinstance(v, str) for v in value):
+                raise ConfigError(f"[tool.solverlint] {key} must be a list of strings")
+            value = tuple(value)
+        elif not isinstance(value, type(default)) or isinstance(value, bool) != isinstance(default, bool):
+            raise ConfigError(f"[tool.solverlint] {key} must be {type(default).__name__}, got {type(value).__name__}")
+        setattr(cfg, field, value)
+    return cfg

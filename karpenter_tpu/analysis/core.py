@@ -1,0 +1,216 @@
+"""solverlint driver: module parsing, pragma suppression, rule running.
+
+Rules (see rules.py) are pure AST passes producing `Finding`s. Suppression
+is line-anchored: a finding survives unless a justified pragma
+
+    # solverlint: ok(<rule>): <why>
+
+sits on one of the finding's own source lines or the line directly above it.
+A pragma with no `<why>` text is itself a finding (unsuppressible) — every
+suppression must carry its justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from fnmatch import fnmatch
+from pathlib import Path
+
+from .config import Config, ConfigError, load_config
+
+PRAGMA_RE = re.compile(r"#\s*solverlint:\s*ok\(([A-Za-z0-9_-]+)\)(?::\s*(\S.*))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative
+    line: int
+    message: str
+    # source lines a pragma may sit on to suppress this finding (the line
+    # above is added by the driver)
+    span: tuple[int, int] = (0, 0)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class ParsedModule:
+    """One source file: text, AST, and its solverlint pragmas by line."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        # line (1-based) -> [(rule, why)] — pragmas live in real COMMENT
+        # tokens only (docstrings describing the syntax never count)
+        self.pragmas: dict[int, list[tuple[str, str]]] = {}
+        self.malformed: list[Finding] = []
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+        except tokenize.TokenError:
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT or "solverlint:" not in tok.string:
+                continue
+            i = tok.start[0]
+            m = PRAGMA_RE.search(tok.string)
+            if m is None:
+                self.malformed.append(Finding("solverlint-pragma", relpath, i, "unparseable solverlint pragma"))
+                continue
+            rule, why = m.group(1), (m.group(2) or "").strip()
+            if not why:
+                self.malformed.append(
+                    Finding(
+                        "solverlint-pragma",
+                        relpath,
+                        i,
+                        f"pragma for {rule!r} carries no justification — write the ok(...) form with a <why>",
+                    )
+                )
+                continue
+            self.pragmas.setdefault(i, []).append((rule, why))
+
+    def suppressed(self, finding: Finding) -> bool:
+        lo, hi = finding.span if finding.span != (0, 0) else (finding.line, finding.line)
+        for line in range(lo - 1, hi + 1):
+            for rule, _why in self.pragmas.get(line, ()):
+                if rule == finding.rule:
+                    return True
+        return False
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def _match_globs(root: Path, globs) -> list[Path]:
+    out: list[Path] = []
+    seen = set()
+    for pattern in globs:
+        for p in sorted(root.glob(pattern)):
+            if p.suffix == ".py" and p.is_file() and p not in seen:
+                seen.add(p)
+                out.append(p)
+    return out
+
+
+def run_analysis(
+    root: Path | None = None,
+    config: Config | None = None,
+    rules: list[str] | None = None,
+    paths: list[Path] | None = None,
+) -> list[Finding]:
+    """Run the selected rules (default: all) and return surviving findings.
+
+    Three modes: no `paths` — each rule scans the module set its config
+    globs name; `paths` + explicit `rules` — run exactly those rules over
+    exactly those files (fixture mode, globs bypassed); `paths` alone — the
+    normal scan restricted to those files, so each rule still sees only
+    files its globs cover (a non-tensor module passed on the CLI is not
+    suddenly held to tensor-module rules).
+    """
+    from .rules import RULES
+
+    root = root or repo_root()
+    config = config or load_config(root)
+    selected = list(RULES) if rules is None else list(rules)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise ConfigError(f"unknown rules requested: {unknown} (have {sorted(RULES)})")
+
+    # path -> module, or None once it failed to parse (the parse finding is
+    # emitted exactly once, not once per rule that scans the file)
+    cache: dict[Path, ParsedModule | None] = {}
+    findings: list[Finding] = []
+    scanned: set[Path] = set()
+
+    def parsed(path: Path) -> ParsedModule | None:
+        if path in cache:
+            return cache[path]
+        try:
+            mod = ParsedModule(str(path.relative_to(root)) if path.is_relative_to(root) else str(path), path.read_text())
+        except SyntaxError as e:
+            findings.append(Finding("solverlint-parse", str(path), e.lineno or 0, f"syntax error: {e.msg}"))
+            mod = None
+        except OSError as e:
+            raise ConfigError(f"cannot read {path}: {e}") from e
+        cache[path] = mod
+        return mod
+
+    for name in selected:
+        rule = RULES[name]()  # fresh instance: rules may aggregate across files
+        if paths is not None and rules is not None:
+            files = paths
+        elif paths is not None:
+            globbed = {g.resolve() for g in _match_globs(root, rule.globs(config))}
+            files = [p for p in paths if Path(p).resolve() in globbed]
+        else:
+            files = _match_globs(root, rule.globs(config))
+            if not files:
+                findings.append(
+                    Finding(name, str(root), 0, f"rule {name!r} matched no files — check [tool.solverlint] globs")
+                )
+                continue
+        for path in files:
+            mod = parsed(Path(path))
+            if mod is None:
+                continue
+            scanned.add(Path(path))
+            for f in rule.check(mod, config, root):
+                if not mod.suppressed(f):
+                    findings.append(f)
+        findings.extend(rule.finalize(config))
+
+    for path in scanned:
+        mod = cache.get(path)
+        if mod is not None:
+            findings.extend(mod.malformed)
+    return findings
+
+
+def run_self_test(config: Config | None = None) -> list[str]:
+    """Prove every registered rule still detects its own seeded violation and
+    that the pragma form suppresses it. Returns a list of failures (empty =
+    healthy); the CLI gate turns any failure into exit 2 so a broken rule
+    can never pass vacuously."""
+    from .rules import RULES
+
+    failures: list[str] = []
+    if len(RULES) < 5:
+        failures.append(f"rule registry shrank to {len(RULES)} rules (expected >= 5)")
+    for name, cls in RULES.items():
+        cfg = dataclasses.replace(config or Config(), shared_fields=cls.SELF_TEST_SHARED_FIELDS)
+        for label, src, expect_hit in (("bad", cls.SELF_TEST_BAD, True), ("ok", cls.SELF_TEST_OK, False)):
+            rule = cls()
+            mod = ParsedModule(f"<self-test:{name}:{label}>", src)
+            hits = [f for f in rule.check(mod, cfg, repo_root()) if not mod.suppressed(f)]
+            hits.extend(rule.finalize(cfg))
+            if expect_hit and not hits:
+                failures.append(f"rule {name!r} missed its seeded self-test violation")
+            if not expect_hit and hits:
+                failures.append(f"rule {name!r} flagged its suppressed/clean self-test snippet: {hits[0]}")
+    return failures
+
+
+def dotted_name(node: ast.AST) -> str:
+    """`a.b.c` for Name/Attribute chains, "" for anything else."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def callee_matches(func: ast.AST, patterns) -> bool:
+    name = dotted_name(func)
+    if not name:
+        return False
+    tail = name.rsplit(".", 1)[-1]
+    return any(fnmatch(name, p) or fnmatch(tail, p) for p in patterns)

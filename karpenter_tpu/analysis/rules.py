@@ -1,0 +1,605 @@
+"""The solverlint rules — this repo's real hazard classes, as AST passes.
+
+1. shared-array-mutation     in-place writes to encode fields the registry
+                             (encode.SHARED_ENCODE_FIELDS) declares shared by
+                             reference between a base encode and its derived
+                             masked/delta encodes.
+2. host-sync-in-hot-path     `.item()` / `float()`/`int()`/`bool()` /
+                             `np.asarray` on values produced by device
+                             kernels inside the tensor-path modules.
+3. python-loop-over-pod-axis `for` statements iterating pod-scaled
+                             collections in tensor modules (per-signature
+                             loops and comprehensions doing O(1) attribute
+                             reads are the sanctioned cheap pass).
+4. reason-family-tiers       every fallback family carries a tier, GLOBAL
+                             families justify themselves, no stale entries
+                             (absorbed from tests/test_solve_modes.py).
+5. metric-label-cardinality  label values for bounded label keys at
+                             counter/histogram call sites must be statically
+                             enumerable, and the repo-wide literal set per
+                             key stays under a cap.
+
+Every rule ships SELF_TEST_BAD/SELF_TEST_OK snippets; `--self-test` proves
+each rule still detects its seeded violation and that the pragma suppresses
+it, so the gate fails loudly if rule discovery breaks.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .config import Config
+from .core import Finding, ParsedModule, callee_matches, dotted_name
+
+# lambdas are NOT a scope boundary here: they cannot contain assignments, so
+# their bodies read the enclosing scope's names — scanning them in place is
+# what lets the rules see a mutation/sync tucked into a sort key or callback
+_SCOPE_KINDS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _walk_scope(node: ast.AST):
+    """All nodes of one scope, not descending into nested functions."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _SCOPE_KINDS):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _scopes(tree: ast.Module):
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _flat_targets(target: ast.AST):
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _flat_targets(elt)
+    else:
+        yield target
+
+
+def _span(node: ast.AST) -> tuple[int, int]:
+    return (node.lineno, getattr(node, "end_lineno", node.lineno) or node.lineno)
+
+
+class Rule:
+    name = ""
+    description = ""
+    SELF_TEST_BAD = ""
+    SELF_TEST_OK = ""
+    SELF_TEST_SHARED_FIELDS: frozenset | None = None
+
+    def globs(self, config: Config) -> tuple[str, ...]:
+        return config.tensor_modules
+
+    def check(self, mod: ParsedModule, config: Config, root) -> list[Finding]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def finalize(self, config: Config) -> list[Finding]:
+        return []
+
+    def _finding(self, mod: ParsedModule, node: ast.AST, message: str) -> Finding:
+        return Finding(self.name, mod.relpath, node.lineno, message, span=_span(node))
+
+
+class SharedArrayMutationRule(Rule):
+    name = "shared-array-mutation"
+    description = "in-place write to an encode field shared by reference with derived encodes"
+    # ndarray methods that mutate in place
+    MUTATOR_METHODS = frozenset({"fill", "sort", "resize", "itemset", "partition", "byteswap"})
+    # numpy free functions (last dotted segment) whose first argument is written
+    MUTATOR_FUNCS = frozenset({"put", "copyto", "place", "putmask", "at"})
+
+    SELF_TEST_SHARED_FIELDS = frozenset({"sig_req"})
+    SELF_TEST_BAD = "def f(enc):\n    enc.sig_req[0] = 1.0\n"
+    SELF_TEST_OK = (
+        "def f(enc):\n"
+        "    enc.sig_req[0] = 1.0  # solverlint: ok(shared-array-mutation): self-test snippet, never imported\n"
+    )
+
+    def check(self, mod, config, root):
+        fields = config.resolve_shared_fields(root)
+        findings: list[Finding] = []
+        for scope in _scopes(mod.tree):
+            # flow-insensitive alias pass: a bare name stands in for a shared
+            # field only when EVERY simple assignment to it reads one
+            kinds: dict[str, set[str]] = {}
+            alias_field: dict[str, str] = {}
+            for n in _walk_scope(scope):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
+                    if isinstance(n.value, ast.Attribute) and n.value.attr in fields:
+                        kinds.setdefault(n.targets[0].id, set()).add("reg")
+                        alias_field[n.targets[0].id] = n.value.attr
+                    else:
+                        kinds.setdefault(n.targets[0].id, set()).add("other")
+                elif isinstance(n, (ast.Assign, ast.AnnAssign, ast.For, ast.AugAssign)):
+                    targets = n.targets if isinstance(n, ast.Assign) else [getattr(n, "target", None)]
+                    for t in targets:
+                        if t is not None:
+                            for leaf in _flat_targets(t):
+                                if isinstance(leaf, ast.Name):
+                                    kinds.setdefault(leaf.id, set()).add("other")
+            aliases = {name for name, ks in kinds.items() if ks == {"reg"}}
+
+            def shared(node) -> str | None:
+                if isinstance(node, ast.Attribute) and node.attr in fields:
+                    return node.attr
+                if isinstance(node, ast.Name) and node.id in aliases:
+                    return alias_field[node.id]
+                return None
+
+            for n in _walk_scope(scope):
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        for leaf in _flat_targets(t):
+                            if isinstance(leaf, ast.Subscript) and (f := shared(leaf.value)):
+                                findings.append(
+                                    self._finding(mod, n, f"in-place write to shared encode array {f!r}")
+                                )
+                elif isinstance(n, ast.AugAssign):
+                    target = n.target.value if isinstance(n.target, ast.Subscript) else n.target
+                    if f := shared(target):
+                        findings.append(
+                            self._finding(mod, n, f"augmented in-place write to shared encode array {f!r}")
+                        )
+                elif isinstance(n, ast.Call):
+                    func = n.func
+                    if (
+                        isinstance(func, ast.Attribute)
+                        and func.attr in self.MUTATOR_METHODS
+                        and (f := shared(func.value))
+                    ):
+                        findings.append(
+                            self._finding(mod, n, f".{func.attr}() mutates shared encode array {f!r}")
+                        )
+                    elif (
+                        dotted_name(func).rsplit(".", 1)[-1] in self.MUTATOR_FUNCS
+                        and n.args
+                        and (f := shared(n.args[0]))
+                    ):
+                        findings.append(
+                            self._finding(mod, n, f"{dotted_name(func)}() writes into shared encode array {f!r}")
+                        )
+        return findings
+
+
+class HostSyncRule(Rule):
+    name = "host-sync-in-hot-path"
+    description = "host coercion of a device-kernel result inside a tensor-path module"
+    COERCERS = frozenset({"float", "int", "bool"})
+    ARRAYERS = frozenset({"np.asarray", "np.array", "numpy.asarray", "numpy.array"})
+    # shape/metadata reads are static, never a device sync
+    EXEMPT_ATTRS = frozenset({"shape", "size", "ndim", "dtype"})
+
+    SELF_TEST_BAD = (
+        "def f(t, items):\n"
+        "    takes = greedy_pack_grouped_sharded(t, items)\n"
+        "    return float(takes)\n"
+    )
+    SELF_TEST_OK = (
+        "def f(t, items):\n"
+        "    takes = greedy_pack_grouped_sharded(t, items)\n"
+        "    return float(takes)  # solverlint: ok(host-sync-in-hot-path): self-test snippet, never imported\n"
+    )
+
+    def check(self, mod, config, root):
+        findings: list[Finding] = []
+        for scope in _scopes(mod.tree):
+            tainted: set[str] = set()
+            # any-assignment taint + one fixed-point pass for name-to-name copies
+            copies: list[tuple[str, str]] = []
+            for n in _walk_scope(scope):
+                if not isinstance(n, ast.Assign):
+                    continue
+                if isinstance(n.value, ast.Call) and callee_matches(n.value.func, config.device_producers):
+                    for t in n.targets:
+                        for leaf in _flat_targets(t):
+                            if isinstance(leaf, ast.Name):
+                                tainted.add(leaf.id)
+                elif isinstance(n.value, ast.Name) and len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
+                    copies.append((n.targets[0].id, n.value.id))
+            changed = True
+            while changed:
+                changed = False
+                for dst, src in copies:
+                    if src in tainted and dst not in tainted:
+                        tainted.add(dst)
+                        changed = True
+
+            def device_expr(node) -> bool:
+                # path-sensitive: a `.shape`/`.size`/... access prunes ONLY
+                # its own subtree (a static metadata read), never the rest of
+                # the expression — `float(takes.sum() / takes.shape[0])` is
+                # still a sync on `takes.sum()`
+                if isinstance(node, ast.Attribute) and node.attr in self.EXEMPT_ATTRS:
+                    return False
+                if isinstance(node, ast.Name):
+                    return node.id in tainted
+                if isinstance(node, ast.Call) and callee_matches(node.func, config.device_producers):
+                    return True
+                return any(device_expr(child) for child in ast.iter_child_nodes(node))
+
+            for n in _walk_scope(scope):
+                if not isinstance(n, ast.Call):
+                    continue
+                func = n.func
+                if isinstance(func, ast.Attribute) and func.attr == "item" and not n.args and device_expr(func.value):
+                    findings.append(self._finding(mod, n, ".item() host-syncs a device value"))
+                elif (
+                    isinstance(func, ast.Name)
+                    and func.id in self.COERCERS
+                    and len(n.args) == 1
+                    and device_expr(n.args[0])
+                ):
+                    findings.append(
+                        self._finding(mod, n, f"{func.id}() coerces a device value to host (blocking sync)")
+                    )
+                elif dotted_name(func) in self.ARRAYERS and n.args and device_expr(n.args[0]):
+                    findings.append(
+                        self._finding(mod, n, f"{dotted_name(func)}() lands a device array on host")
+                    )
+        return findings
+
+
+class PodAxisLoopRule(Rule):
+    name = "python-loop-over-pod-axis"
+    description = "Python-level `for` statement iterating a pod-scaled collection in a tensor module"
+
+    SELF_TEST_BAD = "def f(enc):\n    for p in enc.pods:\n        p.key()\n"
+    SELF_TEST_OK = (
+        "def f(enc):\n"
+        "    for p in enc.pods:  # solverlint: ok(python-loop-over-pod-axis): self-test snippet, never imported\n"
+        "        p.key()\n"
+    )
+
+    def check(self, mod, config, root):
+        names = set(config.pod_axis_names)
+        findings: list[Finding] = []
+        for n in ast.walk(mod.tree):
+            if not isinstance(n, (ast.For, ast.AsyncFor)):
+                continue
+            hit = None
+            for sub in ast.walk(n.iter):
+                if isinstance(sub, ast.Name) and sub.id in names:
+                    hit = sub.id
+                elif isinstance(sub, ast.Attribute) and sub.attr in names:
+                    hit = dotted_name(sub) or sub.attr
+                if hit:
+                    break
+            if hit:
+                findings.append(
+                    Finding(
+                        self.name,
+                        mod.relpath,
+                        n.lineno,
+                        f"Python loop over pod-scaled {hit!r} — vectorize, or justify with a pragma",
+                        span=(n.lineno, n.iter.end_lineno or n.lineno),
+                    )
+                )
+        return findings
+
+
+class ReasonFamilyTiersRule(Rule):
+    name = "reason-family-tiers"
+    description = "fallback families must carry tiers; GLOBAL families must justify themselves"
+
+    SELF_TEST_BAD = (
+        'GLOBAL = "global"\n'
+        'POD_LOCAL = "pod-local"\n'
+        'REASON_FAMILIES = (("needle a", "fam-a"), ("needle b", "fam-b"))\n'
+        "FAMILY_TIERS = {\n"
+        '    "fam-a": GLOBAL,\n'
+        '    "other": GLOBAL,\n'
+        "}\n"
+    )
+    SELF_TEST_OK = (
+        'GLOBAL = "global"\n'
+        'POD_LOCAL = "pod-local"\n'
+        'REASON_FAMILIES = (("needle a", "fam-a"), ("needle b", "fam-b"))\n'
+        "FAMILY_TIERS = {\n"
+        "    # the kernel cannot express this family's semantics\n"
+        '    "fam-a": GLOBAL,\n'
+        '    "fam-b": POD_LOCAL,\n'
+        '    "other": GLOBAL,  # unattributable reasons take the conservative path\n'
+        "}\n"
+    )
+
+    def globs(self, config):
+        return (config.fallback_module,)
+
+    def check(self, mod, config, root):
+        findings: list[Finding] = []
+        families: list[tuple[str, int]] | None = None
+        tiers: ast.Dict | None = None
+        for n in mod.tree.body:
+            target = None
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
+                target = n.targets[0].id
+            elif isinstance(n, ast.AnnAssign) and isinstance(n.target, ast.Name):
+                target = n.target.id
+            if target == "REASON_FAMILIES" and isinstance(n.value, (ast.Tuple, ast.List)):
+                families = []
+                for elt in n.value.elts:
+                    if (
+                        isinstance(elt, (ast.Tuple, ast.List))
+                        and len(elt.elts) == 2
+                        and isinstance(elt.elts[1], ast.Constant)
+                    ):
+                        families.append((elt.elts[1].value, elt.lineno))
+                    else:
+                        findings.append(self._finding(mod, elt, "REASON_FAMILIES entry is not a (needle, family) pair"))
+            elif target == "FAMILY_TIERS" and isinstance(n.value, ast.Dict):
+                tiers = n.value
+        if families is None or tiers is None:
+            findings.append(
+                Finding(self.name, mod.relpath, 1, "REASON_FAMILIES / FAMILY_TIERS registry not found in module")
+            )
+            return findings
+
+        entries: list[tuple[str, int, ast.AST]] = []
+        for key, value in zip(tiers.keys, tiers.values):
+            if not isinstance(key, ast.Constant) or not isinstance(key.value, str):
+                findings.append(self._finding(mod, key or tiers, "FAMILY_TIERS key is not a string literal"))
+                continue
+            entries.append((key.value, key.lineno, value))
+            if not (isinstance(value, ast.Name) and value.id in ("GLOBAL", "POD_LOCAL")):
+                findings.append(
+                    self._finding(mod, value, f"tier for {key.value!r} must be the GLOBAL or POD_LOCAL constant")
+                )
+        keys = {k for k, _l, _v in entries}
+        enum = {fam for fam, _l in families}
+        for fam, line in families:
+            if fam not in keys:
+                findings.append(Finding(self.name, mod.relpath, line, f"family {fam!r} has no tier in FAMILY_TIERS"))
+        if "other" not in keys:
+            findings.append(
+                Finding(self.name, mod.relpath, tiers.lineno, 'FAMILY_TIERS lacks the "other" conservative entry')
+            )
+        for key, line, _v in entries:
+            if key not in enum and key != "other":
+                findings.append(
+                    Finding(self.name, mod.relpath, line, f"stale tier entry {key!r}: no such family in REASON_FAMILIES")
+                )
+
+        # every GLOBAL entry justifies itself: a trailing comment on the
+        # entry, or a comment block heading its contiguous GLOBAL run
+        global_lines = {
+            line for _k, line, v in entries if isinstance(v, ast.Name) and v.id == "GLOBAL"
+        }
+        for key, line, value in entries:
+            if not (isinstance(value, ast.Name) and value.id == "GLOBAL"):
+                continue
+            text = mod.lines[line - 1] if line - 1 < len(mod.lines) else ""
+            tail = text[value.end_col_offset:] if value.end_lineno == line else ""
+            if "#" in tail:
+                continue
+            j = line - 2  # 0-based index of the line above
+            while j >= 0 and (j + 1) in global_lines:
+                j -= 1
+            if j >= 0 and mod.lines[j].lstrip().startswith("#"):
+                continue
+            findings.append(
+                Finding(
+                    self.name,
+                    mod.relpath,
+                    line,
+                    f"GLOBAL family {key!r} lacks a one-line justification comment",
+                )
+            )
+        return findings
+
+
+class MetricLabelCardinalityRule(Rule):
+    name = "metric-label-cardinality"
+    description = "bounded metric labels must carry statically enumerable values"
+    _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
+
+    SELF_TEST_BAD = (
+        "def record(registry, pod):\n"
+        '    registry.counter("m").inc(reason=f"pod {pod}")\n'
+    )
+    SELF_TEST_OK = (
+        "def record(registry, pod):\n"
+        '    registry.counter("m").inc(reason="bounded-value")\n'
+    )
+
+    def __init__(self):
+        # label -> value -> first (path, line) seen, for the repo-wide cap
+        self._literals: dict[str, dict[str, tuple[str, int]]] = {}
+
+    def globs(self, config):
+        return config.metrics_modules
+
+    def check(self, mod, config, root):
+        findings: list[Finding] = []
+        bounded_labels = set(config.bounded_labels)
+        wrappers = set(config.metric_wrappers)
+
+        # (call, enclosing scope, enclosing function name)
+        stack: list[tuple[ast.AST, ast.AST, str]] = [(mod.tree, mod.tree, "")]
+        calls: list[tuple[ast.Call, ast.AST, str]] = []
+        while stack:
+            node, scope, fname = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    stack.append((child, child, child.name))
+                else:
+                    if isinstance(child, ast.Call):
+                        calls.append((child, scope, fname))
+                    stack.append((child, scope, fname))
+
+        bindings_cache: dict[int, dict[str, list]] = {}
+
+        def bindings(scope) -> dict[str, list]:
+            cached = bindings_cache.get(id(scope))
+            if cached is not None:
+                return cached
+            b: dict[str, list] = {}
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = scope.args
+                for arg in [*a.posonlyargs, *a.args, *a.kwonlyargs, a.vararg, a.kwarg]:
+                    if arg is not None:
+                        b.setdefault(arg.arg, []).append(("opaque", None))
+            for n in _walk_scope(scope):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
+                    b.setdefault(n.targets[0].id, []).append(("expr", n.value))
+                elif isinstance(n, ast.AnnAssign) and isinstance(n.target, ast.Name) and n.value is not None:
+                    b.setdefault(n.target.id, []).append(("expr", n.value))
+                elif isinstance(n, (ast.For, ast.AsyncFor)):
+                    for leaf in _flat_targets(n.target):
+                        if isinstance(leaf, ast.Name):
+                            b.setdefault(leaf.id, []).append(("for", n.iter))
+                elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                    targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                    for t in targets:
+                        for leaf in _flat_targets(t):
+                            if isinstance(leaf, ast.Name):
+                                b.setdefault(leaf.id, []).append(("opaque", None))
+            bindings_cache[id(scope)] = b
+            return b
+
+        def bounded(expr, scope, depth=0) -> tuple[bool, list[str]]:
+            if depth > 6:
+                return False, []
+            if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+                return True, [expr.value]
+            if isinstance(expr, ast.IfExp):
+                ok1, l1 = bounded(expr.body, scope, depth + 1)
+                ok2, l2 = bounded(expr.orelse, scope, depth + 1)
+                return ok1 and ok2, l1 + l2
+            if isinstance(expr, ast.BoolOp):
+                lits: list[str] = []
+                for v in expr.values:
+                    ok, ls = bounded(v, scope, depth + 1)
+                    if not ok:
+                        return False, []
+                    lits += ls
+                return True, lits
+            if isinstance(expr, ast.Call) and callee_matches(expr.func, config.bounded_label_producers):
+                return True, []
+            if isinstance(expr, ast.Name):
+                entries = bindings(scope).get(expr.id)
+                if not entries:
+                    return False, []
+                lits = []
+                for kind, val in entries:
+                    if kind == "expr":
+                        ok, ls = bounded(val, scope, depth + 1)
+                    elif kind == "for":
+                        ok, ls = bounded_iter(val, scope, depth + 1)
+                    else:
+                        ok, ls = False, []
+                    if not ok:
+                        return False, []
+                    lits += ls
+                return True, lits
+            return False, []
+
+        def bounded_iter(expr, scope, depth=0) -> tuple[bool, list[str]]:
+            if depth > 6:
+                return False, []
+            if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) and expr.func.id in self._ITER_WRAPPERS:
+                return bounded_iter(expr.args[0], scope, depth + 1) if expr.args else (False, [])
+            if isinstance(expr, (ast.SetComp, ast.ListComp, ast.GeneratorExp)):
+                return bounded(expr.elt, scope, depth + 1)
+            if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+                lits = []
+                for elt in expr.elts:
+                    ok, ls = bounded(elt, scope, depth + 1)
+                    if not ok:
+                        return False, []
+                    lits += ls
+                return True, lits
+            return False, []
+
+        def record(label: str, literals: list[str], node):
+            for v in literals:
+                self._literals.setdefault(label, {}).setdefault(v, (mod.relpath, node.lineno))
+
+        def check_kw(label: str, value, scope, node):
+            ok, literals = bounded(value, scope)
+            if ok:
+                record(label, literals, node)
+            else:
+                findings.append(
+                    self._finding(
+                        mod,
+                        node,
+                        f"label {label!r} value is not statically enumerable — pass a literal, an enum-bounded producer result, or justify with a pragma",
+                    )
+                )
+
+        def dict_labels(expr) -> list[tuple[str, ast.AST]] | None:
+            if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) and expr.func.id == "dict" and not expr.args:
+                return [(kw.arg, kw.value) for kw in expr.keywords if kw.arg is not None]
+            if isinstance(expr, ast.Dict) and all(
+                isinstance(k, ast.Constant) and isinstance(k.value, str) for k in expr.keys
+            ):
+                return [(k.value, v) for k, v in zip(expr.keys, expr.values)]
+            return None
+
+        for call, scope, fname in calls:
+            func = call.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in ("inc", "observe") and func.attr not in wrappers:
+                continue
+            if fname in wrappers:
+                continue  # the wrapper's own **labels forwarding
+            for kw in call.keywords:
+                if kw.arg is not None:
+                    if kw.arg in bounded_labels:
+                        check_kw(kw.arg, kw.value, scope, call)
+                    continue
+                # **splat: resolve a locally-built dict literal
+                resolved = None
+                if isinstance(kw.value, ast.Name):
+                    entries = bindings(scope).get(kw.value.id, [])
+                    if len(entries) == 1 and entries[0][0] == "expr":
+                        resolved = dict_labels(entries[0][1])
+                else:
+                    resolved = dict_labels(kw.value)
+                if resolved is None:
+                    findings.append(
+                        self._finding(mod, call, "cannot statically bound **labels splat at metric call site")
+                    )
+                    continue
+                for label, value in resolved:
+                    if label in bounded_labels:
+                        check_kw(label, value, scope, call)
+        return findings
+
+    def finalize(self, config):
+        findings = []
+        for label, values in self._literals.items():
+            if len(values) > config.max_label_values:
+                path, line = next(iter(values.values()))
+                sample = ", ".join(sorted(values)[:6])
+                findings.append(
+                    Finding(
+                        self.name,
+                        path,
+                        line,
+                        f"label {label!r} carries {len(values)} distinct literal values repo-wide "
+                        f"(cap {config.max_label_values}): {sample}, ... — an aggregate finding no "
+                        f"line pragma can suppress; shrink the value set or raise max-label-values "
+                        f"in [tool.solverlint]",
+                    )
+                )
+        return findings
+
+
+RULES: dict[str, type[Rule]] = {
+    cls.name: cls
+    for cls in (
+        SharedArrayMutationRule,
+        HostSyncRule,
+        PodAxisLoopRule,
+        ReasonFamilyTiersRule,
+        MetricLabelCardinalityRule,
+    )
+}

@@ -51,8 +51,65 @@ from ..scheduling.taints import pools_taint_prefer_no_schedule, taints_tolerate_
 from ..utils import pods as pod_utils
 from ..utils import resources as res
 from ..utils.quantity import Quantity
+from .contracts import maybe_check_encoded
 
 ABSENT = 0  # reserved value id per key: "row does not define this label"
+
+# EncodedSnapshot array fields that derived encodes share BY REFERENCE with
+# their base: `mask_encode` passes the whole row/offering side through
+# untouched, and `_try_delta_encode` reuses every per-signature tensor of the
+# EncodeCache's previous encode wholesale. An in-place write to any of these
+# after construction silently corrupts the cached base (the hybrid masked
+# carry and the delta slot alike). This registry is the single source of
+# truth for that contract: solverlint's shared-array-mutation rule flags
+# writes to these names statically (python -m karpenter_tpu.analysis), and
+# `mask_encode` freezes the reference-shared ones (setflags(write=False)) so
+# a mutation the linter misses raises at runtime instead. Fields built and
+# mutated DURING encode (local names before EncodedSnapshot construction)
+# are exempt by construction — the rule keys on attribute access.
+SHARED_ENCODE_FIELDS = frozenset(
+    {
+        # row/offering side (shared by mask_encode AND across solves via
+        # _RowArtifacts; `row_labels0` is the artifact-side name of row_labels)
+        "row_alloc",
+        "row_price",
+        "row_labels",
+        "row_labels0",
+        "row_dom",
+        "row_pool_rank",
+        "row_taint_class",
+        "rank_domset",
+        "dom_key_of",
+        "universe_dom",
+        "existing_port_any",
+        "existing_port_wild",
+        "existing_port_spec",
+        "row_port_any",
+        "row_port_wild",
+        "row_port_spec",
+        # per-signature side (shared by _try_delta_encode's wholesale reuse)
+        "sig_req",
+        "sig_mask",
+        "sig_taint_ok",
+        "sig_dom_allowed",
+        "sig_member",
+        "sig_owner",
+        "sig_host_blocked",
+        "sig_port_any",
+        "sig_port_wild",
+        "sig_port_spec",
+        "sig_relaxable",
+        "req_class_of_sig",
+        # topology-group side (delta reuse; mask_encode slices copies)
+        "group_kind",
+        "group_skew",
+        "group_dom_key",
+        "group_min_domains",
+        "group_registered",
+        "counts_dom_init",
+        "counts_host_existing",
+    }
+)
 
 KIND_DOM_SPREAD = 0  # spread over a keyed domain axis (zone, capacity-type, ...)
 KIND_HOST_SPREAD = 1
@@ -612,10 +669,12 @@ def hybrid_partition(snap, enc) -> tuple[list, list] | None:
         cross = touches[flagged].any(axis=0) & touches[~flagged].any(axis=0)
         if (cross & coupled).any():
             return None
-    # explicit-namespace required terms of flagged pods vs tensor-side reps
-    reps: dict[int, object] = {}
-    for i, p in enumerate(enc.pods):
-        reps.setdefault(int(enc.sig_of_pod[i]), p)
+    # explicit-namespace required terms of flagged pods vs tensor-side reps;
+    # one representative per signature via a vectorized first-occurrence scan
+    # (the old per-pod Python walk here ran O(P) on every hybrid solve)
+    sig_arr = np.asarray(enc.sig_of_pod)
+    _, first_idx = np.unique(sig_arr, return_index=True)
+    reps: dict[int, object] = {int(sig_arr[i]): enc.pods[i] for i in first_idx}
     tensor_reps = [reps[s] for s in range(S) if not flagged[s] and s in reps]
     for s in sig_local:
         pod = reps.get(s)
@@ -749,7 +808,22 @@ def mask_encode(enc: EncodedSnapshot, keep_sig_ids) -> EncodedSnapshot:
     cached = getattr(enc, "_sig_restrict", None)
     if cached is not None:
         masked._sig_restrict = cached[ids]
+    _freeze_shared(masked, enc)
+    maybe_check_encoded(masked, where="mask_encode")
     return masked
+
+
+def _freeze_shared(derived: EncodedSnapshot, base: EncodedSnapshot) -> None:
+    """Runtime arm of the SHARED_ENCODE_FIELDS contract: mark every numpy
+    array the derived encode shares BY REFERENCE with its base read-only, so
+    an in-place write the shared-array-mutation lint misses raises
+    (`ValueError: assignment destination is read-only`) in tests instead of
+    silently corrupting the EncodeCache delta base / hybrid masked carry.
+    Identity-gated: sliced copies (fancy indexing) stay writable."""
+    for f in SHARED_ENCODE_FIELDS:
+        arr = getattr(derived, f, None)
+        if isinstance(arr, np.ndarray) and arr is getattr(base, f, None):
+            arr.setflags(write=False)
 
 
 def _node_filter_unexpressible(pod, tsc) -> bool:
@@ -1213,6 +1287,8 @@ def _try_delta_encode(snap, cache: EncodeCache):
         enc._sig_restrict = cached_restrict
     cache.last_enc = enc
     cache.last_raw_pods = list(cur)
+    _freeze_shared(enc, base)
+    maybe_check_encoded(enc, where="delta-encode")
     return enc
 
 
@@ -1626,7 +1702,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     # partitioner; None marks snapshot-global ones (fallback.py decides tier)
     vol_issues: list[tuple[int | None, str]] = []
     pvc_owner: dict[str, tuple[str, int | None]] = {}  # pvc id -> (pod key, sig)
-    for i, pod in enumerate(snap.pods):
+    for i, pod in enumerate(snap.pods):  # solverlint: ok(python-loop-over-pod-axis): THE one sanctioned O(P) pass — cheap signature-tuple interning only; every heavy lowering below runs per unique signature
         k = sig_of(pod)
         comp = None
         pod_pvc_ids = ()
@@ -2097,6 +2173,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         cache.last_raw_pods = list(snap.pods)
         cache.last_sig_ids = dict(sig_ids)
         cache.last_vol_rv = _volume_kind_revisions(snap)
+    maybe_check_encoded(enc_out, where="encode")
     return enc_out
 
 

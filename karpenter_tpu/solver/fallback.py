@@ -42,6 +42,12 @@ Families that used to be global and are now pod-local-or-better:
 This module is import-cycle-free on purpose: both the encode layer (which
 attributes reasons to signatures) and the solver core (which partitions and
 labels metrics) read it.
+
+Registry integrity — every family tiered, every GLOBAL entry justified by a
+comment, no stale entries — is machine-checked by solverlint's
+``reason-family-tiers`` rule (``python -m karpenter_tpu.analysis``, gated in
+tier-1 by tests/test_solverlint.py; tests/test_solve_modes.py keeps only the
+behavior pins). Edit this table and the analyzer tells you what you forgot.
 """
 
 from __future__ import annotations

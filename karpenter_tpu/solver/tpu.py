@@ -25,6 +25,7 @@ from ..scheduling.requirements import Operator, Requirement, Requirements
 from ..utils import resources as res
 from ..utils.quantity import Quantity
 from ..scheduling.hostports import pod_host_ports as _php
+from .contracts import maybe_check_encoded
 from .encode import encode
 from .ffd import FFDSolver
 from .snapshot import SolverSnapshot
@@ -164,14 +165,15 @@ class TPUSolver:
 
             takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack_grouped_sharded(t, items, self.mesh)
             nz_item, nz_slot, nz_count = compress_takes(takes, n_pods)
+            slot_basis, slot_zoneset, leftovers, open_count = np.asarray(slot_basis), np.asarray(slot_zoneset), np.asarray(leftovers), int(open_count)  # solverlint: ok(host-sync-in-hot-path): the meshed pack's single deliberate device->host landing — everything downstream is host numpy
             return dict(
                 nz_item=nz_item,
                 nz_slot=nz_slot,
                 nz_count=nz_count,
-                slot_basis=np.asarray(slot_basis),
-                slot_zoneset=np.asarray(slot_zoneset),
-                leftovers=np.asarray(leftovers),
-                open_count=int(open_count),
+                slot_basis=slot_basis,
+                slot_zoneset=slot_zoneset,
+                leftovers=leftovers,
+                open_count=open_count,
                 n_slots=int(takes.shape[1]),
             )
         from ..models.scheduler_model_grouped import greedy_pack_grouped_compressed
@@ -200,7 +202,7 @@ class TPUSolver:
         self.last_fallback_reasons = reasons
         if family is None:
             family = _reason_family(reasons[0]) if reasons else "empty"
-        self._count(SOLVER_FALLBACK_TOTAL, reason=family)
+        self._count(SOLVER_FALLBACK_TOTAL, reason=family)  # solverlint: ok(metric-label-cardinality): family is always a reason_family() output or a _TensorFallback literal ("validation"/"relaxation") — enum-bounded at every call site
         self._count(SOLVER_SOLVE_TOTAL, backend="ffd-fallback")
         return self.fallback.solve(snap)
 
@@ -212,7 +214,10 @@ class TPUSolver:
         enc = encode(snap, cache=self.encode_cache)
         enc_dt = time.perf_counter() - t0
         self._phase("encode", enc_dt)
-        self._observe(SOLVER_ENCODE_SECONDS, enc_dt, mode=getattr(enc, "encode_mode", "full"))
+        # clamp to the two-value encode-mode enum by construction (the label
+        # must stay bounded even if encode_mode ever carries a stray value)
+        enc_mode = "delta" if getattr(enc, "encode_mode", "full") == "delta" else "full"
+        self._observe(SOLVER_ENCODE_SECONDS, enc_dt, mode=enc_mode)
         # consume + clear the delta link IMMEDIATELY (even on the fallback
         # returns below): each link retains O(P) state, so an unbroken chain
         # across consecutive delta encodes would leak
@@ -257,6 +262,10 @@ class TPUSolver:
             make_item_tensors,
         )
 
+        # KARPENTER_SOLVER_TYPECHECK=1: the pack entry re-validates the
+        # encode's shape/dtype contracts (a drift surfaces here, not as a
+        # wrong placement after decode)
+        maybe_check_encoded(enc, where="pack-full")
         # signature-grouped pack: device steps scale with UNIQUE pod shapes,
         # not pods (scheduler_model_grouped.py). Slot axis capped; retry
         # uncapped on the rare overflow (every slot opened AND pods unplaced).
@@ -464,14 +473,14 @@ class TPUSolver:
         sig_of = {id(p): int(s) for p, s in zip(enc.pods, np.asarray(enc.sig_of_pod))}
         records: list = []
         for en in tensor_results.existing_nodes:
-            for pod in en.pods:
+            for pod in en.pods:  # solverlint: ok(python-loop-over-pod-axis): gated — reached only when a topology group spans the hybrid seam (early-returns above keep the common case free), and record-building is irreducibly per-pod
                 s = sig_of.get(id(pod))
                 if s is not None and seam_sig[s]:
                     # decode-built ExistingNode requirements are the node's
                     # label view + hostname — exactly what record() needs
                     records.append((pod, en.taints, en.requirements))
         for nc in tensor_results.new_node_claims:
-            for pod in nc.pods:
+            for pod in nc.pods:  # solverlint: ok(python-loop-over-pod-axis): gated — same seam-export bound as the existing-node walk above
                 s = sig_of.get(id(pod))
                 if s is not None and seam_sig[s]:
                     # captured by reference: _adopt_claim adds the in-flight
@@ -550,6 +559,7 @@ class TPUSolver:
             # whose full encode is `base` — translate the delta into masked
             # coordinates and continue there
             return self._solve_masked_delta(snap, enc, base)
+        maybe_check_encoded(enc, where="pack-delta")
         t_start = time.perf_counter()
         try:
             return self._solve_delta_inner(snap, enc, base, count)

@@ -9,6 +9,11 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# tier-1 runs with the encode-space shape/dtype contracts ON
+# (solver/contracts.py): every encode/mask/delta construction and pack entry
+# re-validates its arrays, and mask_encode's read-only freeze turns any
+# shared-array mutation into a hard error instead of silent cache corruption
+os.environ.setdefault("KARPENTER_SOLVER_TYPECHECK", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,23 @@
+"""solverlint fixture: reason-family-tiers. Never imported — parsed only.
+
+Seeds three violations: `fam-untiered` lacks a FAMILY_TIERS entry,
+`fam-global-bare` is GLOBAL without a justification comment, and
+`fam-stale` has a tier but no REASON_FAMILIES needle.
+"""
+
+GLOBAL = "global"
+POD_LOCAL = "pod-local"
+
+REASON_FAMILIES = (
+    ("needle one", "fam-untiered"),
+    ("needle two", "fam-global-bare"),
+    ("needle three", "fam-ok"),
+)
+
+FAMILY_TIERS = {
+    "fam-global-bare": GLOBAL,
+    # attribution covers the whole membership set
+    "fam-ok": POD_LOCAL,
+    "fam-stale": POD_LOCAL,
+    "other": GLOBAL,  # unattributable reasons take the conservative path
+}

@@ -1,0 +1,41 @@
+"""solverlint fixture: host-sync-in-hot-path. Never imported — parsed only."""
+
+import numpy as np
+
+
+def bad_float(t, items):
+    takes = greedy_pack_grouped_sharded(t, items)  # noqa: F821 — fixture, parsed only
+    return float(takes)
+
+
+def bad_item(t, items):
+    leftovers = greedy_pack_grouped_sharded(t, items)  # noqa: F821
+    return leftovers.sum().item()
+
+
+def bad_asarray(t, items):
+    out = greedy_pack_grouped_sharded(t, items)  # noqa: F821
+    return np.asarray(out)
+
+
+def ok_pragma(t, items):
+    takes = greedy_pack_grouped_sharded(t, items)  # noqa: F821
+    return float(takes)  # solverlint: ok(host-sync-in-hot-path): fixture — proves the pragma form suppresses
+
+
+def ok_shape_read(t, items):
+    takes = greedy_pack_grouped_sharded(t, items)  # noqa: F821
+    return int(takes.shape[0])  # static metadata, not a sync: must NOT be flagged
+
+
+def bad_sync_mixed_with_shape_read(t, items):
+    # the .shape read exempts only ITS subtree — takes.sum() still syncs
+    takes = greedy_pack_grouped_sharded(t, items)  # noqa: F821
+    return float(takes.sum() / takes.shape[0])
+
+
+def bad_sync_inside_lambda(t, items, xs):
+    # lambdas are not a lint blind spot: the sync in the sort key is flagged
+    takes = greedy_pack_grouped_sharded(t, items)  # noqa: F821
+    xs.sort(key=lambda x: float(takes))
+    return xs

@@ -1,0 +1,40 @@
+"""solverlint fixture: metric-label-cardinality. Never imported — parsed only."""
+
+
+def bad_fstring(registry, pod):
+    registry.counter("m").inc(reason=f"pod {pod.key()}")
+
+
+def bad_unbounded_name(registry, why):
+    registry.counter("m").inc(reason=why)
+
+
+def bad_splat(registry, labels):
+    registry.counter("m").inc(**labels)
+
+
+def ok_literal(registry):
+    registry.counter("m").inc(reason="bounded")
+
+
+def ok_producer(registry, r):
+    registry.counter("m").inc(reason=reason_family(r))  # noqa: F821 — fixture, parsed only
+
+
+def ok_ternary(registry, cmd):
+    decision = "replace" if cmd.replacements else "delete"
+    registry.counter("m").inc(decision=decision)
+
+
+def ok_local_dict_splat(registry, node):
+    labels = dict(reason="unhealthy", nodepool=node.pool)
+    registry.counter("m").inc(**labels)
+
+
+def ok_pragma(registry, why):
+    registry.counter("m").inc(reason=why)  # solverlint: ok(metric-label-cardinality): fixture — proves the pragma form suppresses
+
+
+def ok_identity_label(registry, node):
+    # nodepool is an identity label, not in bounded-labels: must NOT be flagged
+    registry.counter("m").inc(nodepool=node.pool)

@@ -1,0 +1,29 @@
+"""solverlint fixture: python-loop-over-pod-axis. Never imported — parsed only."""
+
+
+def bad_loop(enc):
+    total = 0
+    for p in enc.pods:
+        total += p.weight
+    return total
+
+
+def ok_pragma(enc):
+    total = 0
+    for p in enc.pods:  # solverlint: ok(python-loop-over-pod-axis): fixture — proves the pragma form suppresses
+        total += p.weight
+    return total
+
+
+def ok_comprehension(enc):
+    # comprehensions doing O(1) attribute reads are the sanctioned cheap
+    # pass: must NOT be flagged
+    return [p.key for p in enc.pods]
+
+
+def ok_signature_scale(rep_pods):
+    # per-signature (unique pod shape) loops are the whole point: not flagged
+    out = []
+    for pod in rep_pods:
+        out.append(pod)
+    return out

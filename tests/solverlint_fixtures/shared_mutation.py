@@ -1,0 +1,36 @@
+"""solverlint fixture: shared-array-mutation. Never imported — parsed only.
+
+`bad_*` functions each seed exactly one violation; `ok_*` functions repeat
+the violation under a justified pragma and must be suppressed.
+"""
+
+
+def bad_subscript_store(enc):
+    enc.sig_req[0] = 1.0
+
+
+def bad_augassign(enc):
+    enc.counts_dom_init += 1
+
+
+def bad_fill(enc):
+    enc.sig_dom_allowed.fill(True)
+
+
+def bad_alias_store(enc):
+    alias = enc.row_alloc
+    alias[3] = 0.0
+
+
+def ok_pragma(enc):
+    enc.sig_req[0] = 1.0  # solverlint: ok(shared-array-mutation): fixture — proves the pragma form suppresses
+
+def ok_local_copy(enc):
+    local = enc.sig_req.copy()
+    local[0] = 1.0  # a copy is not shared: must NOT be flagged
+
+
+def bad_mutation_inside_lambda(enc, xs):
+    # lambdas are not a lint blind spot either
+    xs.sort(key=lambda x: enc.group_registered.fill(False))
+    return xs

@@ -118,47 +118,30 @@ class TestSolveModeInvariant:
 
 
 class TestReasonFamilyEnum:
-    """Mechanical walker over the fallback-family enum (ISSUE 3): every
-    family routes to a defined tier, every GLOBAL family justifies itself in
-    a comment, and solver metrics can only ever carry enum labels."""
+    """Thin wrapper over solverlint's reason-family-tiers rule (ISSUE 4):
+    the mechanical walker that used to live here — every family routes to a
+    defined tier, GLOBAL families justify themselves, no stale entries —
+    moved into the analyzer (karpenter_tpu/analysis/rules.py), where
+    `python -m karpenter_tpu.analysis` enforces it repo-wide. This class
+    keeps the wiring assertion plus the behavior pins no static rule can
+    express."""
 
-    def test_every_family_routes_to_a_defined_tier(self):
+    def test_analyzer_rule_holds_on_fallback_module(self):
+        from karpenter_tpu.analysis import run_analysis
+
+        findings = run_analysis(rules=["reason-family-tiers"])
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_tier_demotions_stay_pinned(self):
         from karpenter_tpu.solver.fallback import FAMILY_TIERS, GLOBAL, POD_LOCAL, REASON_FAMILIES
 
         for _needle, family in REASON_FAMILIES:
-            assert family in FAMILY_TIERS, f"family {family!r} has no tier"
             assert FAMILY_TIERS[family] in (GLOBAL, POD_LOCAL)
-        # demotions this PR made are pinned here so a revert is loud
+        # demotions PR 3 made are pinned here so a revert is loud
         assert FAMILY_TIERS["min-values"] == POD_LOCAL
         assert FAMILY_TIERS["asymmetric-spread-membership"] == POD_LOCAL
         assert FAMILY_TIERS["strict-reserved-offering"] == POD_LOCAL
         assert FAMILY_TIERS["other"] == GLOBAL
-
-    def test_every_global_family_carries_a_justification_comment(self):
-        import inspect
-        import re
-
-        from karpenter_tpu.solver import fallback
-
-        src = inspect.getsource(fallback).splitlines()
-        entry_re = re.compile(r'^\s*"([a-z0-9-]+)":\s*(GLOBAL|POD_LOCAL),')
-        for i, line in enumerate(src):
-            m = entry_re.match(line)
-            if m is None or m.group(2) != "GLOBAL":
-                continue
-            if "#" in line.split(",", 1)[1]:
-                continue  # trailing justification on the entry itself
-            # a comment block may justify a CONTIGUOUS run of GLOBAL entries
-            j = i - 1
-            while j >= 0:
-                mm = entry_re.match(src[j])
-                if mm is not None and mm.group(2) == "GLOBAL":
-                    j -= 1
-                    continue
-                break
-            assert j >= 0 and src[j].lstrip().startswith("#"), (
-                f"GLOBAL family {m.group(1)!r} lacks a one-line justification comment"
-            )
 
     def test_reason_family_total_on_arbitrary_strings(self):
         import random
