@@ -50,7 +50,7 @@ class Config:
     )
     # label keys that must be statically enumerable at counter/histogram
     # call sites (identity labels like nodepool/node_name are exempt)
-    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision")
+    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase")
     # callees whose return value is enum-bounded by construction
     bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family")
     # wrapper methods whose OWN bodies forward **labels to the registry

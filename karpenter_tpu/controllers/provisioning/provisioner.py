@@ -211,6 +211,7 @@ class Provisioner:
             min_values_policy=self.options.min_values_policy,
             dra_enabled=self.options.dynamic_resources_enabled,
             reserved_capacity_enabled=self.options.reserved_capacity_enabled,
+            registry=self.metrics,
         )
 
     def create_node_claim(self, scheduling_claim, reason: str = "provisioning") -> str | None:

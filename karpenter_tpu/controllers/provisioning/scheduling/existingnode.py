@@ -24,6 +24,9 @@ class ExistingNode:
         self.is_under_consolidate_after = is_under_consolidate_after
         self.allocator = allocator  # DRA; None when the gate is off
         self._pending_dra = None
+        # monotone state version: bumped on every add(); the scheduler's fit
+        # memo stamps static-pass entries with it so a stale pass is recomputed
+        self._version = 0
 
         # remaining = allocatable - committed pods - headroom for daemons that
         # haven't scheduled yet (existingnode.go:45-60)
@@ -53,6 +56,19 @@ class ExistingNode:
     def can_add(self, pod, pod_data):
         """Returns (updated_requirements, None) or error string
         (existingnode.go:81-139)."""
+        base, err = self.can_add_static(pod, pod_data)
+        if err is not None:
+            return None, err
+        return self.can_add_dynamic(pod, pod_data, base)
+
+    def can_add_static(self, pod, pod_data):
+        """The MONOTONE prefix of can_add: taints, volume limits, host ports,
+        resource fit, and requirements compatibility. Within one solve this
+        node's taints and labels are fixed and its usage only grows (resources
+        shrink, requirements tighten, port/volume usage accumulates), so a
+        rejection here can never turn into an acceptance later — the
+        scheduler's fit memo caches it permanently per pod signature. Returns
+        (base_requirements, None) or (None, err)."""
         err = taints_tolerate_pod(self.taints, pod, include_prefer_no_schedule=True)
         if err is not None:
             return None, err
@@ -71,9 +87,14 @@ class ExistingNode:
         base = Requirements()
         base.add(*self.requirements.values())
         base.add(*pod_data.requirements.values())
+        return base, None
 
-        # try each volume topology alternative; the selected constraints shape
-        # the topology checks (existingnode.go:108-137)
+    def can_add_dynamic(self, pod, pod_data, base: Requirements):
+        """The NON-monotone suffix: topology (skew counts move both ways) and
+        DRA allocation. Never memoized — must re-run on every probe.
+
+        Try each volume topology alternative; the selected constraints shape
+        the topology checks (existingnode.go:108-137)."""
         last_err = None
         self._pending_dra = None
         for vol_reqs in pod_data.volume_requirements or [None]:
@@ -117,6 +138,7 @@ class ExistingNode:
         return node_reqs, None
 
     def add(self, pod, pod_data, updated_requirements: Requirements) -> None:
+        self._version += 1
         self.pods.append(pod)
         self.requirements = updated_requirements
         self.remaining_resources = res.subtract(self.remaining_resources, pod_data.requests)

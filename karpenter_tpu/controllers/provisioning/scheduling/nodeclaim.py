@@ -138,6 +138,10 @@ class SchedulingNodeClaim:
         self.requirements.add(Requirement(wk.HOSTNAME_LABEL_KEY, "In", [self.hostname]))
         topology.register(wk.HOSTNAME_LABEL_KEY, self.hostname)
         self.spec_requests: dict[str, Quantity] = {}  # accumulated pod requests
+        # monotone state version: bumped on every add(); the scheduler's fit
+        # memo stamps static-pass entries with it so a stale pass is recomputed
+        # after this claim's options narrow or its requirements tighten
+        self._version = 0
 
     @property
     def nodepool_name(self) -> str:
@@ -165,59 +169,86 @@ class SchedulingNodeClaim:
         self.reserved_offering_mode = reserved_offering_mode
         self.reserved_offerings = getattr(self, "reserved_offerings", [])
         self._pending_reserved = []
+        self._version = 0
 
     def can_add(self, pod, pod_data, relax_min_values: bool = False):
         """Returns (updated_requirements, remaining_instance_types) or an error
         string (nodeclaim.go:124-158)."""
-        err = taints_tolerate_pod(self.template.taints, pod, include_prefer_no_schedule=True)
+        base, err = self.can_add_static(pod, pod_data)
         if err is not None:
             return None, None, err
+        reqs, its, err, _permanent = self.can_add_dynamic(pod, pod_data, base, relax_min_values)
+        return reqs, its, err
+
+    def can_add_static(self, pod, pod_data):
+        """The MONOTONE prefix of can_add: template taints (fixed for the
+        whole solve) and requirements compatibility (this claim's requirements
+        only ever tighten — add() intersects). A rejection here can never turn
+        into an acceptance later, so the scheduler's fit memo caches it
+        permanently per pod signature. Returns (base_requirements, None) or
+        (None, err)."""
+        err = taints_tolerate_pod(self.template.taints, pod, include_prefer_no_schedule=True)
+        if err is not None:
+            return None, err
 
         base = Requirements()
         base.add(*self.requirements.values())
         cerr = base.compatible(pod_data.requirements, allow_undefined=wk.WELL_KNOWN_LABELS)
         if cerr is not None:
-            return None, None, f"incompatible requirements, {cerr}"
+            return None, f"incompatible requirements, {cerr}"
         base.add(*pod_data.requirements.values())
+        return base, None
 
-        # try each volume topology alternative; the selected constraints affect
-        # downstream topology and instance-type checks (nodeclaim.go:138-157)
+    def can_add_dynamic(self, pod, pod_data, base: Requirements, relax_min_values: bool = False):
+        """The suffix of can_add: volume alternatives, topology, instance-type
+        filtering, DRA, reservations. Returns (reqs, its, err, permanent) —
+        `permanent` is True when the rejection is monotone in this claim's
+        state REGARDLESS of topology/reservation churn: every instance type
+        still in the option set lacks the raw resources for the accumulated
+        requests plus this pod (options only narrow, requests only grow), so
+        the scheduler's fit memo may cache the rejection for the signature.
+
+        Try each volume topology alternative; the selected constraints affect
+        downstream topology and instance-type checks (nodeclaim.go:138-157)."""
         last_err = None
+        all_permanent = True  # a rejection is permanent only if EVERY alternative's is
         self._pending_dra = None
         self._pending_dra_meta = None
         self._pending_reserved = []
         for vol_reqs in pod_data.volume_requirements or [None]:
-            reqs, its, err = self._try_volume_alternative(pod, pod_data, base, vol_reqs, relax_min_values)
+            reqs, its, err, permanent = self._try_volume_alternative(pod, pod_data, base, vol_reqs, relax_min_values)
             if err is not None:
                 last_err = err
+                all_permanent = all_permanent and permanent
                 continue
-            return reqs, its, None
-        return None, None, last_err
+            return reqs, its, None, False
+        return None, None, last_err, all_permanent
 
     def _try_volume_alternative(self, pod, pod_data, base: Requirements, vol_reqs, relax_min_values: bool):
         """One alternative: volume reqs -> topology -> instance-type filter
         (nodeclaim.go:164-240). Volume reqs narrow the claim only, never the
-        pod's affinity, preserving TSC counting semantics."""
+        pod's affinity, preserving TSC counting semantics. Returns
+        (reqs, its, err, permanent) — see can_add_dynamic."""
         claim_reqs = Requirements()
         claim_reqs.add(*base.values())
         if vol_reqs is not None:
             cerr = claim_reqs.compatible(vol_reqs, allow_undefined=wk.WELL_KNOWN_LABELS)
             if cerr is not None:
-                return None, None, f"incompatible volume requirements, {cerr}"
+                return None, None, f"incompatible volume requirements, {cerr}", False
             claim_reqs.add(*vol_reqs.values())
 
         topo = self.topology.add_requirements(
             pod, self.template.taints, pod_data.strict_requirements, claim_reqs, allow_undefined=wk.WELL_KNOWN_LABELS
         )
         if isinstance(topo, str):
-            return None, None, topo
+            return None, None, topo, False
         cerr = claim_reqs.compatible(topo, allow_undefined=wk.WELL_KNOWN_LABELS)
         if cerr is not None:
-            return None, None, cerr
+            return None, None, cerr, False
         claim_reqs.add(*topo.values())
 
         requests = res.merge(self.spec_requests, pod_data.requests)
-        remaining, unsatisfiable, ferr = filter_instance_types_cached(
+        remaining, unsatisfiable, ferr, capacity_exhausted = filter_instance_types_cached(
             getattr(self, "filter_cache", None),
             self.instance_type_options, claim_reqs, pod, pod_data.requests, self.daemon_overhead_groups, requests, relax_min_values,
             native=_native_table_for(self.template),
@@ -231,7 +262,7 @@ class SchedulingNodeClaim:
                 relaxed.min_values = mv
                 claim_reqs.replace(relaxed)
         if ferr is not None:
-            return None, None, ferr
+            return None, None, ferr, capacity_exhausted
 
         # DRA: keep only instance types whose template devices satisfy the
         # pod's claims; the reference allocates before the filter and prunes
@@ -242,7 +273,7 @@ class SchedulingNodeClaim:
         # (allocator.go:90-134)
         if (pod_data.resource_claims or pod_data.resource_claim_err) and self.allocator is not None:
             if pod_data.resource_claim_err is not None:
-                return None, None, pod_data.resource_claim_err
+                return None, None, pod_data.resource_claim_err, False
             per_it = {}
             for it in remaining:
                 tracker = self.dra_trackers.get(it.name)
@@ -260,7 +291,7 @@ class SchedulingNodeClaim:
             kept, metas = self.allocator.superpose_template_allocation(self.hostname, per_it)
             surviving = [it for it in remaining if it.name in kept]
             if not surviving:
-                return None, None, "no instance type can allocate the pod's dynamic resources"
+                return None, None, "no instance type can allocate the pod's dynamic resources", False
             remaining = surviving
             self._pending_dra = kept
             self._pending_dra_meta = metas
@@ -270,9 +301,10 @@ class SchedulingNodeClaim:
         # under strict mode, fail rather than silently lose reserved capacity
         ofs, rerr = self._offerings_to_reserve(remaining, claim_reqs)
         if rerr is not None:
-            return None, None, rerr
+            # reservation state is NOT monotone (releases can re-open options)
+            return None, None, rerr, False
         self._pending_reserved = ofs
-        return claim_reqs, remaining, None
+        return claim_reqs, remaining, None, False
 
     def _offerings_to_reserve(self, instance_types: list[InstanceType], claim_reqs: Requirements):
         """Returns (reservable offerings, err). Reservation is pessimistic:
@@ -299,6 +331,9 @@ class SchedulingNodeClaim:
         return reservable, None
 
     def add(self, pod, pod_data, updated_requirements: Requirements, updated_instance_types: list[InstanceType]) -> None:
+        # getattr: decode builds claims with __new__ (rehydrate() re-seeds the
+        # version, but direct adds on bare claims must not require it)
+        self._version = getattr(self, "_version", 0) + 1
         self.pods.append(pod)
         self.requirements = updated_requirements
         removed = set()
@@ -483,7 +518,7 @@ def filter_instance_types_cached(
     total_requests: dict[str, Quantity],
     relax_min_values: bool = False,
     native=None,
-) -> tuple[Optional[list[InstanceType]], dict[str, int], Optional[str]]:
+) -> tuple[Optional[list[InstanceType]], dict[str, int], Optional[str], bool]:
     """Solve-scoped memo around `filter_instance_types` (ROADMAP: the
     residual host FFD is ~0.6 ms/pod dominated by this call). The filter is
     a pure function of (type set, requirement CONTENT, accumulated requests,
@@ -497,33 +532,90 @@ def filter_instance_types_cached(
             instance_types, requirements, pod, pod_requests, daemon_overhead_groups,
             total_requests, relax_min_values, native=native,
         )
+    its_key = (id(instance_types), len(instance_types))
+    reqs_key = _reqs_content_key(requirements)
+    groups_key = tuple((id(g.instance_types), id(g.daemon_overhead)) for g in daemon_overhead_groups)
     key = (
         # list identity + length, verified against the stored reference on
         # hit (a solve-scoped cache may see a recycled id after GC): claims
         # REPLACE their option list on every narrowing, so identity tracks
         # content exactly
-        (id(instance_types), len(instance_types)),
-        _reqs_content_key(requirements),
+        its_key,
+        reqs_key,
         tuple(sorted((k, q.milli) for k, q in total_requests.items())),
         # group copies share their instance_types/daemon_overhead objects
         # with the template's originals, so claims of one template hit
-        tuple((id(g.instance_types), id(g.daemon_overhead)) for g in daemon_overhead_groups),
+        groups_key,
         relax_min_values,
     )
     hit = cache.get(key)
     if hit is None or hit[0] is not instance_types:
         if len(cache) >= _FILTER_CACHE_MAX:
             cache.clear()  # bound memory; repopulates within the solve
+        # second-level cache: the requirement-dependent verdicts (type
+        # compat + per-allocatable-group offering compat) are independent of
+        # BOTH the accumulated requests and the narrowing option list, so a
+        # landing (new totals, replaced option list) re-runs only the
+        # res.fits scan over verdicts cached for the template-wide universe
+        skey = ("static", reqs_key, groups_key)
+        static = cache.get(skey)
+        if static is None:
+            static = cache[skey] = _static_group_verdicts(requirements, daemon_overhead_groups, native)
         hit = cache[key] = (
             instance_types,
             *filter_instance_types(
                 instance_types, requirements, pod, pod_requests, daemon_overhead_groups,
-                total_requests, relax_min_values, native=native,
+                total_requests, relax_min_values, native=native, static=static,
             ),
         )
-    _its_ref, remaining, unsat, err = hit
+    _its_ref, remaining, unsat, err, capacity_exhausted = hit
     # callers assign/narrow the list downstream — never hand out the cached one
-    return (list(remaining) if remaining is not None else None, dict(unsat), err)
+    return (list(remaining) if remaining is not None else None, dict(unsat), err, capacity_exhausted)
+
+
+def _static_group_verdicts(
+    requirements: Requirements,
+    daemon_overhead_groups: list[DaemonOverheadGroup],
+    native=None,
+) -> list[list]:
+    """Per daemon-overhead group, the requirement-dependent (hence totals-
+    independent) verdicts for every instance type in the TEMPLATE-wide group
+    lists: (it, compat, ((allocatable, has_compatible_offering), ...)).
+    `filter_instance_types` combines these with the claim's current
+    eligibility set and a fresh res.fits scan — the only parts that move when
+    a landing grows the accumulated requests and narrows the options. Only
+    used on the memoized (portless) path, where no daemon group can be
+    skipped by a port conflict."""
+    native_mask = native_rows = None
+    if native is not None:
+        from ....native import UnsupportedRequirements
+
+        table, native_rows = native
+        try:
+            native_mask = table.filter(requirements)
+        except UnsupportedRequirements:
+            native_mask = None
+    out: list[list] = []
+    for group in daemon_overhead_groups:
+        rows = []
+        for it in group.instance_types:
+            if native_mask is not None and id(it) in native_rows:
+                compat = native_mask[native_rows[id(it)]] == 1
+            else:
+                compat = it.requirements.intersects(requirements) is None
+            ginfo = tuple(
+                (
+                    alloc,
+                    any(
+                        requirements.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None
+                        for o in offerings
+                    ),
+                )
+                for alloc, offerings in it.allocatable_offerings_list()
+            )
+            rows.append((it, compat, ginfo))
+        out.append(rows)
+    return out
 
 
 def filter_instance_types(
@@ -535,18 +627,24 @@ def filter_instance_types(
     total_requests: dict[str, Quantity],
     relax_min_values: bool = False,
     native=None,
-) -> tuple[Optional[list[InstanceType]], dict[str, int], Optional[str]]:
+    static=None,
+) -> tuple[Optional[list[InstanceType]], dict[str, int], Optional[str], bool]:
     """compat x fits x offering filter per daemon-overhead group
-    (nodeclaim.go:541-640). Returns (remaining, unsatisfiable_min_values, err).
-    `native` is an optional (ReqTable, rowmap) that answers the per-type
-    intersects check in one C call for the whole table."""
+    (nodeclaim.go:541-640). Returns (remaining, unsatisfiable_min_values, err,
+    capacity_exhausted). `capacity_exhausted` is True iff the filter rejected
+    AND no eligible instance type has an allocatable group with the raw
+    resources for `total_requests` — a verdict independent of requirement/
+    offering compatibility, hence monotone in claim state (requests only
+    grow, the option set only narrows): the scheduler's fit memo may cache
+    such a rejection permanently. `native` is an optional (ReqTable, rowmap)
+    that answers the per-type intersects check in one C call for the whole
+    table."""
     remaining: list[InstanceType] = []
     ports = pod_host_ports(pod)
-    eligible = {id(it) for it in instance_types}
-    any_compat = any_fits = any_offering = False
+    any_compat = any_fits = any_offering = any_resource_fit = False
 
     native_mask = native_rows = None
-    if native is not None:
+    if native is not None and static is None:
         from ....native import UnsupportedRequirements
 
         table, native_rows = native
@@ -555,23 +653,65 @@ def filter_instance_types(
         except UnsupportedRequirements:
             native_mask = None  # query carries >int64 integers; Python path
 
-    for group in daemon_overhead_groups:
-        if group.host_port_usage.conflicts(pod.key(), ports) is not None:
-            continue
-        total = res.merge(total_requests, group.daemon_overhead) if group.daemon_overhead else total_requests
-        for it in group.instance_types:
-            if id(it) not in eligible:
+    any_group_skipped = False
+    if static is not None:
+        # fast path over precomputed requirement verdicts (only the memoized
+        # portless shape reaches here, so no group is ever port-skipped):
+        # just apply the current eligibility set and re-run the
+        # totals-dependent res.fits scan. The any_* failure flags feed only
+        # the rejection message, and a rejection per (signature, claim) state
+        # happens once before the fit memo pins it — compute them lazily in a
+        # second pass instead of on every landing.
+        eligible = {id(it) for it in instance_types}
+        fits_fn = res.fits
+        for rows, group in zip(static, daemon_overhead_groups):
+            total = res.merge(total_requests, group.daemon_overhead) if group.daemon_overhead else total_requests
+            for it, compat, ginfo in rows:
+                if not compat or id(it) not in eligible:
+                    continue
+                for alloc, has_compat_off in ginfo:
+                    if has_compat_off and fits_fn(total, alloc):
+                        remaining.append(it)
+                        break
+        if not remaining:
+            for rows, group in zip(static, daemon_overhead_groups):
+                total = res.merge(total_requests, group.daemon_overhead) if group.daemon_overhead else total_requests
+                for it, compat, ginfo in rows:
+                    if id(it) not in eligible:
+                        continue
+                    fits = resource_fit = has_offering = False
+                    for alloc, has_compat_off in ginfo:
+                        has_offering |= has_compat_off
+                        if fits_fn(total, alloc):
+                            resource_fit = True
+                            if has_compat_off:
+                                fits = True
+                                break
+                    any_compat |= compat
+                    any_fits |= fits
+                    any_offering |= has_offering
+                    any_resource_fit |= resource_fit
+    else:
+        eligible = {id(it) for it in instance_types}
+        for group in daemon_overhead_groups:
+            if group.host_port_usage.conflicts(pod.key(), ports) is not None:
+                any_group_skipped = True  # unevaluated types: capacity verdict incomplete
                 continue
-            if native_mask is not None and id(it) in native_rows:
-                compat = native_mask[native_rows[id(it)]] == 1
-            else:
-                compat = it.requirements.intersects(requirements) is None
-            fits, has_offering = _fits_and_offering(it, total, requirements)
-            any_compat |= compat
-            any_fits |= fits
-            any_offering |= has_offering
-            if compat and fits and has_offering:
-                remaining.append(it)
+            total = res.merge(total_requests, group.daemon_overhead) if group.daemon_overhead else total_requests
+            for it in group.instance_types:
+                if id(it) not in eligible:
+                    continue
+                if native_mask is not None and id(it) in native_rows:
+                    compat = native_mask[native_rows[id(it)]] == 1
+                else:
+                    compat = it.requirements.intersects(requirements) is None
+                fits, has_offering, resource_fit = _fits_and_offering(it, total, requirements)
+                any_compat |= compat
+                any_fits |= fits
+                any_offering |= has_offering
+                any_resource_fit |= resource_fit
+                if compat and fits and has_offering:
+                    remaining.append(it)
 
     unsatisfiable: dict[str, int] = {}
     if requirements.has_min_values():
@@ -583,7 +723,7 @@ def filter_instance_types(
                 return None, {}, (
                     f"minValues requirement is not met for {sorted(unsat)} "
                     f"(observed {unsat})"
-                )
+                ), False
             unsatisfiable = unsat
 
     if not remaining:
@@ -596,27 +736,33 @@ def filter_instance_types(
             parts.append("no instance type has a compatible offering")
         if not parts:
             parts.append("no single instance type met requirements/fits/offering simultaneously")
-        return None, unsatisfiable, "; ".join(parts)
-    return remaining, unsatisfiable, None
+        capacity_exhausted = not any_resource_fit and not any_group_skipped
+        return None, unsatisfiable, "; ".join(parts), capacity_exhausted
+    return remaining, unsatisfiable, None, False
 
 
-def _fits_and_offering(it: InstanceType, requests: dict[str, Quantity], requirements: Requirements) -> tuple[bool, bool]:
-    """(fits, has_offering) per allocatable-offerings group: offerings with
-    capacity/overhead overrides form groups with their OWN allocatable, so an
-    instance type fits iff some group both fits the requests and holds a
-    compatible offering (nodeclaim.go:624-640 fits +
+def _fits_and_offering(it: InstanceType, requests: dict[str, Quantity], requirements: Requirements) -> tuple[bool, bool, bool]:
+    """(fits, has_offering, resource_fit) per allocatable-offerings group:
+    offerings with capacity/overhead overrides form groups with their OWN
+    allocatable, so an instance type fits iff some group both fits the
+    requests and holds a compatible offering (nodeclaim.go:624-640 fits +
     types.go:202-257 AllocatableOfferingsList). Deliberately
     reference-exact: fits=False even when resources fit but no group holds a
     compatible offering — the reference's error for that case likewise merges
     both criteria ("no instance type had enough resources or had a required
-    offering", nodeclaim.go:505-507)."""
+    offering", nodeclaim.go:505-507). The third element reports the RAW
+    resource verdict (some group fits the requests, compatibility aside): a
+    requirements-independent — hence monotone — capacity signal the fit memo
+    keys permanence on."""
     has_offering = False
+    any_resource_fit = False
     for alloc, offerings in it.allocatable_offerings_list():
         resource_fit = res.fits(requests, alloc)
+        any_resource_fit |= resource_fit
         for o in offerings:
             if requirements.compatible(o.requirements, allow_undefined=wk.WELL_KNOWN_LABELS) is None:
                 has_offering = True
                 if resource_fit:
-                    return True, True
+                    return True, True, True
                 break
-    return False, has_offering
+    return False, has_offering, any_resource_fit

@@ -2,6 +2,14 @@
 
 Pods are sorted CPU-descending then memory-descending (first-fit-decreasing);
 Pop stops when the queue cycles without progress.
+
+Cycle detection keys `_last_len` by pod uid. The scheduler's relaxation loop
+(`Scheduler._try_schedule`) deep-copies the pod before mutating its spec and
+REQUEUES THE CALLER'S ORIGINAL — `copy.deepcopy` preserves `metadata.uid`, so
+either object maps to the same `_last_len` slot and a pod that exhausts every
+relaxation (twice-relaxed or more) still terminates the queue: its re-push
+records the queue length, and the next pop at an unchanged length returns
+None instead of spinning (regression: tests/test_ffd_batch.py).
 """
 
 from __future__ import annotations

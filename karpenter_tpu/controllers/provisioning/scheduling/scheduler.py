@@ -10,6 +10,8 @@ validated against it and plugs in through the same Solver interface.
 from __future__ import annotations
 
 import math
+import os
+import time
 from dataclasses import dataclass, field
 
 from ....apis import labels as wk
@@ -70,6 +72,10 @@ class Results:
         return len(self.new_node_claims)
 
 
+# fit-memo entry cap (signatures x nodes scaling; see Scheduler._fit_memo)
+_FIT_MEMO_MAX = 100_000
+
+
 class Scheduler:
     def __init__(
         self,
@@ -89,6 +95,8 @@ class Scheduler:
         reserved_capacity_enabled: bool = True,
         reserved_offering_mode: str = "fallback",
         collect_zone_metrics: bool = True,
+        registry=None,
+        ffd_batch: bool | None = None,
     ):
         self.store = store
         self.cluster = cluster
@@ -98,6 +106,35 @@ class Scheduler:
         self.min_values_policy = min_values_policy
         self.deleting_node_names = deleting_node_names or set()
         self.timeout_seconds = timeout_seconds
+        self.registry = registry
+        # KARPENTER_FFD_BATCH=1 (default): signature-batched FFD — per-solve
+        # fit memo + placement cursors + PodData template cache + incremental
+        # claim ordering. =0 is the exact-reference escape hatch; placements
+        # are bit-identical either way (tests/test_ffd_batch.py).
+        if ffd_batch is None:
+            ffd_batch = os.environ.get("KARPENTER_FFD_BATCH", "1") != "0"
+        self.batch_enabled = ffd_batch
+        # fit memo: (pod signature, id(node|claim|template)) ->
+        #   ("reject", err)          permanent monotone rejection
+        #   ("pass", version, base)  static prefix passed at that state version
+        # Unlike the per-pod-bounded caches below, entries scale with
+        # signatures x nodes — capped like the filter cache so a unique-
+        # signature flood (e.g. per-pod StatefulSet labels) can't balloon it;
+        # clearing only forgets memoized verdicts, never invalidates cursors
+        # (the underlying rejections stay permanent regardless)
+        self._fit_memo: dict = {}
+        # per-signature scan cursor over the (fixed-order) existing-node list:
+        # every node before the cursor holds a permanent rejection for the sig
+        self._existing_cursor: dict = {}
+        # signature -> shared PodData template (volume/port/DRA-free pods)
+        self._pod_data_templates: dict = {}
+        # pod uid -> signature tuple (None = pod bypasses the batched path)
+        self._sig_by_uid: dict = {}
+        # signature -> effective zone; valid ONLY during the pre-solve metric
+        # loop (no placements happen there, so topology state is frozen)
+        self._zone_by_sig: dict = {}
+        self.memo_stats = {"hit": 0, "miss": 0, "invalidate": 0}
+        self.phase_seconds = {"existing": 0.0, "inflight": 0.0, "new_claim": 0.0}
         # the PreferNoSchedule toleration relaxation arms whenever some pool
         # taints with that effect (scheduler.go:144-153 — policy-independent)
         self.preferences = Preferences(
@@ -223,12 +260,22 @@ class Scheduler:
         return "flexible" if len(matched) > 1 else "none"
 
     def solve(self, pods: list) -> Results:
-        import copy
-
         pod_errors: dict[str, tuple] = {}  # uid -> (pod, error)
         self.topology.prepare(pods)
         from ....apis.capacitybuffer import is_virtual_pod
 
+        # the zone memo is only valid while topology counts are frozen; a
+        # reused Scheduler re-enters with counts from the previous solve.
+        # Template rejections were memoized under "no topology group
+        # constrains this signature" — a new pod set can add inverse groups,
+        # so they reset per solve too (no-op for the usual one-solve life)
+        self._zone_by_sig.clear()
+        if self._fit_memo:
+            tmpl_ids = {id(t) for t in self.templates}
+            self._fit_memo = {k: v for k, v in self._fit_memo.items() if k[1] not in tmpl_ids}
+        # per-solve observability (flushed to the registry once per solve)
+        self.memo_stats = {"hit": 0, "miss": 0, "invalidate": 0}
+        self.phase_seconds = {"existing": 0.0, "inflight": 0.0, "new_claim": 0.0}
         pods_by_zone: dict[str, int] | None = None
         if self.collect_zone_metrics:
             pods_by_zone = {}
@@ -243,8 +290,22 @@ class Scheduler:
                 and p.status.phase in ("", "Pending")
                 and not is_virtual_pod(p)
             ):
-                zone = self.compute_effective_zone_from_pod(p)
+                # no placement happens until the queue loop below, so the
+                # effective zone is a pure function of the pod signature here
+                sig = self._sig_by_uid.get(p.metadata.uid)
+                zone = self._zone_by_sig.get(sig) if sig is not None else None
+                if zone is None:
+                    zone = self.compute_effective_zone_from_pod(p)
+                    if sig is not None:
+                        self._zone_by_sig[sig] = zone
                 pods_by_zone[zone] = pods_by_zone.get(zone, 0) + 1
+
+        if self.batch_enabled:
+            # establish the fewest-pods-first invariant once (adopted in-flight
+            # claims from a hybrid residual arrive unsorted); every later add
+            # repositions exactly one claim, so the reference's per-_add resort
+            # reduces to an O(shift) bubble
+            self.new_node_claims.sort(key=lambda m: len(m.pods))
 
         q = Queue(pods, self.cached_pod_data)
         start = self.clock.now()
@@ -273,6 +334,9 @@ class Scheduler:
         for nc in self.new_node_claims:
             nc.finalize()
 
+        if self.registry is not None:
+            self._flush_solve_metrics()
+
         return Results(
             new_node_claims=list(self.new_node_claims),
             existing_nodes=list(self.existing_nodes),
@@ -281,7 +345,59 @@ class Scheduler:
             pending_pods_by_effective_zone=pods_by_zone,
         )
 
+    def _flush_solve_metrics(self) -> None:
+        from .... import metrics as m
+
+        memo = self.registry.counter(m.SOLVER_FFD_MEMO_TOTAL)
+        memo.inc(self.memo_stats["hit"], kind="hit")
+        memo.inc(self.memo_stats["miss"], kind="miss")
+        memo.inc(self.memo_stats["invalidate"], kind="invalidate")
+        phases = self.registry.histogram(m.SOLVER_FFD_PHASE_SECONDS)
+        phases.observe(self.phase_seconds["existing"], phase="existing")
+        phases.observe(self.phase_seconds["inflight"], phase="inflight")
+        phases.observe(self.phase_seconds["new_claim"], phase="new_claim")
+
+    def _memo_put(self, key, entry) -> None:
+        memo = self._fit_memo
+        if len(memo) >= _FIT_MEMO_MAX:
+            memo.clear()  # bound memory; verdicts re-derive on demand
+        memo[key] = entry
+
+    def _cacheable_sig(self, pod):
+        """The pod's scheduling signature, or None when the pod must bypass
+        the batched fast path: bound pods (node_name feeds the existing-node
+        scan's consolidate-after skip), DRA pods, PVC/ephemeral-volume pods
+        (claim NAMES are not part of the signature but select distinct PVC
+        objects), and host-port pods (their conflict checks read mutable
+        usage state the signature cannot see) — the same exclusions as
+        filter_instance_types_cached."""
+        spec = pod.spec
+        if spec.node_name or spec.resource_claims:
+            return None
+        for v in spec.volumes:
+            if v.get("persistentVolumeClaim") or v.get("ephemeral") is not None:
+                return None
+        from ....scheduling.hostports import pod_host_ports
+
+        if pod_host_ports(pod):
+            return None
+        from ....solver.encode import pod_signature  # lazy: encode imports this module
+
+        return pod_signature(pod)
+
     def _update_cached_pod_data(self, pod) -> None:
+        if self.batch_enabled:
+            sig = self._cacheable_sig(pod)
+            self._sig_by_uid[pod.metadata.uid] = sig
+            if sig is not None:
+                data = self._pod_data_templates.get(sig)
+                if data is None:
+                    data = self._pod_data_templates[sig] = self._build_pod_data(pod)
+                self.cached_pod_data[pod.metadata.uid] = data
+                return
+        self.cached_pod_data[pod.metadata.uid] = self._build_pod_data(pod)
+
+    def _build_pod_data(self, pod) -> PodData:
         if self.preference_policy == "Ignore":
             requirements = Requirements.from_pod(pod, strict=True)
         else:
@@ -296,7 +412,7 @@ class Scheduler:
 
             claims, claim_err = resolve_pod_claims(self.store, pod)
             claims = claims or []  # claim_err is carried separately and fails CanAdd
-        self.cached_pod_data[pod.metadata.uid] = PodData(
+        return PodData(
             requests=res.pod_requests(pod),
             requirements=requirements,
             strict_requirements=strict,
@@ -327,44 +443,150 @@ class Scheduler:
             self._update_cached_pod_data(pod)
 
     def _add(self, pod) -> str | None:
-        if self._add_to_existing_node(pod) is None:
+        t0 = time.perf_counter()
+        err = self._add_to_existing_node(pod)
+        t1 = time.perf_counter()
+        self.phase_seconds["existing"] += t1 - t0
+        if err is None:
             return None
-        # inflight claims sorted fewest-pods-first (scheduler.go:598)
-        self.new_node_claims.sort(key=lambda m: len(m.pods))
-        if self._add_to_inflight_node(pod) is None:
+        if not self.batch_enabled:
+            # inflight claims sorted fewest-pods-first (scheduler.go:598); the
+            # batched path maintains this invariant incrementally instead
+            self.new_node_claims.sort(key=lambda m: len(m.pods))
+        err = self._add_to_inflight_node(pod)
+        t2 = time.perf_counter()
+        self.phase_seconds["inflight"] += t2 - t1
+        if err is None:
             return None
         if not self.templates:
             return "nodepool requirements filtered out all available instance types"
-        return self._add_to_new_node_claim(pod)
+        err = self._add_to_new_node_claim(pod)
+        self.phase_seconds["new_claim"] += time.perf_counter() - t2
+        return err
 
     def _add_to_existing_node(self, pod) -> str | None:
         pod_data = self.cached_pod_data[pod.metadata.uid]
         is_pending = not pod.spec.node_name
-        for node in self.existing_nodes:
+        sig = self._sig_by_uid.get(pod.metadata.uid) if self.batch_enabled else None
+        nodes = self.existing_nodes
+        landed = None
+        # placement cursor: every node before it permanently rejected this
+        # signature, so an identical pod resumes where the last one got to
+        start = self._existing_cursor.get(sig, 0) if sig is not None else 0
+        if start:
+            self.memo_stats["hit"] += start  # cursor-skipped permanent rejections
+        for i in range(start, len(nodes)):
+            node = nodes[i]
             if node.is_under_consolidate_after and not is_pending and pod.spec.node_name not in self.deleting_node_names:
                 continue
-            reqs, err = node.can_add(pod, pod_data)
+            if sig is None:
+                reqs, err = node.can_add(pod, pod_data)
+                if err is None:
+                    node.add(pod, pod_data, reqs)
+                    return None
+                continue
+            key = (sig, id(node))
+            ent = self._fit_memo.get(key)
+            if ent is not None and ent[0] == "reject":
+                self.memo_stats["hit"] += 1
+                continue
+            if ent is not None and ent[1] == node._version:
+                self.memo_stats["hit"] += 1
+                base = ent[2]
+            else:
+                if ent is not None:
+                    self.memo_stats["invalidate"] += 1
+                else:
+                    self.memo_stats["miss"] += 1
+                base, err = node.can_add_static(pod, pod_data)
+                if err is not None:
+                    # every static check is monotone within the solve
+                    # (existingnode.can_add_static): cache forever
+                    self._memo_put(key, ("reject", err))
+                    continue
+                self._memo_put(key, ("pass", node._version, base))
+            reqs, err = node.can_add_dynamic(pod, pod_data, base)
             if err is None:
                 node.add(pod, pod_data, reqs)
-                return None
+                landed = i
+                break
+        if sig is not None:
+            c = self._existing_cursor.get(sig, 0)
+            while c < len(nodes):
+                ent = self._fit_memo.get((sig, id(nodes[c])))
+                if ent is None or ent[0] != "reject":
+                    break
+                c += 1
+            self._existing_cursor[sig] = c
+        if landed is not None:
+            return None
         return "failed scheduling pod to existing nodes"
 
     def _add_to_inflight_node(self, pod) -> str | None:
+        # the in-flight "cursor" is the memo itself: claims re-order as their
+        # pod counts move (fewest-first), so a positional resume point is
+        # unsound here — instead every permanently-rejected claim costs one
+        # dict lookup and everything else resumes exactly where the last
+        # identical pod left its verdicts
         pod_data = self.cached_pod_data[pod.metadata.uid]
-        for nc in self.new_node_claims:
-            # in-flight claims never relax minValues (scheduler.go:669)
-            reqs, its, err = nc.can_add(pod, pod_data, relax_min_values=False)
+        sig = self._sig_by_uid.get(pod.metadata.uid) if self.batch_enabled else None
+        claims = self.new_node_claims
+        for i in range(len(claims)):
+            nc = claims[i]
+            if sig is None:
+                # in-flight claims never relax minValues (scheduler.go:669)
+                reqs, its, err = nc.can_add(pod, pod_data, relax_min_values=False)
+            else:
+                key = (sig, id(nc))
+                ent = self._fit_memo.get(key)
+                if ent is not None and ent[0] == "reject":
+                    self.memo_stats["hit"] += 1
+                    continue
+                if ent is not None and ent[1] == nc._version:
+                    self.memo_stats["hit"] += 1
+                    base = ent[2]
+                else:
+                    if ent is not None:
+                        self.memo_stats["invalidate"] += 1
+                    else:
+                        self.memo_stats["miss"] += 1
+                    base, serr = nc.can_add_static(pod, pod_data)
+                    if serr is not None:
+                        # taints are fixed and claim requirements only ever
+                        # tighten: a static rejection is permanent
+                        self._memo_put(key, ("reject", serr))
+                        continue
+                    self._memo_put(key, ("pass", nc._version, base))
+                reqs, its, err, permanent = nc.can_add_dynamic(pod, pod_data, base, relax_min_values=False)
+                if err is not None and permanent:
+                    # capacity-exhausted: no option of this claim has the raw
+                    # resources for its accumulated requests plus this pod —
+                    # monotone regardless of topology/reservation churn
+                    self._memo_put(key, ("reject", err))
             if err is None:
                 nc.add(pod, pod_data, reqs, its)
+                if self.batch_enabled:
+                    self._bubble_claim_right(i)
                 return None
         return "failed scheduling pod to inflight nodes"
 
     def _add_to_new_node_claim(self, pod) -> str | None:
         pod_data = self.cached_pod_data[pod.metadata.uid]
+        sig = self._sig_by_uid.get(pod.metadata.uid) if self.batch_enabled else None
         errs = []
         for t in self.templates:
             its = t.instance_type_options
             remaining = self.remaining_resources.get(t.nodepool_name)
+            # nodepool limits make the option set probe-dependent, so template
+            # rejections are only memoized for unlimited pools (the memoized
+            # error string must be exactly reproducible)
+            memo_key = (sig, id(t)) if sig is not None and remaining is None else None
+            if memo_key is not None:
+                ent = self._fit_memo.get(memo_key)
+                if ent is not None:
+                    self.memo_stats["hit"] += 1
+                    errs.append(ent[1])
+                    continue
             if remaining is not None:
                 nodes_left = remaining.get("nodes")
                 if nodes_left is not None and nodes_left.milli <= 0:
@@ -384,16 +606,61 @@ class Scheduler:
                 reserved_offering_mode=self.reserved_offering_mode,
                 filter_cache=self.filter_cache,
             )
-            reqs, rem_its, err = nc.can_add(pod, pod_data, relax_min_values=(self.min_values_policy == "BestEffort"))
+            relax = self.min_values_policy == "BestEffort"
+            if memo_key is None:
+                reqs, rem_its, err = nc.can_add(pod, pod_data, relax_min_values=relax)
+            else:
+                base, err = nc.can_add_static(pod, pod_data)
+                permanent = err is not None  # static rejections are permanent
+                if err is None:
+                    reqs, rem_its, err, permanent = nc.can_add_dynamic(pod, pod_data, base, relax_min_values=relax)
+                if err is not None and permanent and not self.topology._matching_topologies(pod, t.taints, base or nc.requirements):
+                    # a fresh claim's probe is state-independent when no
+                    # topology group constrains the pod: the exact error
+                    # string reproduces on every later probe, so memoize it
+                    # (pod_errors stay bit-identical to the unbatched path)
+                    self._memo_put(memo_key, ("reject", f"{t.nodepool_name}: {err}"))
             if err is not None:
                 errs.append(f"{t.nodepool_name}: {err}")
                 continue
             nc.add(pod, pod_data, reqs, rem_its)
             self.new_node_claims.append(nc)
+            if self.batch_enabled:
+                self._bubble_claim_left()
             if remaining is not None:
                 self.remaining_resources[t.nodepool_name] = _subtract_max(remaining, nc.instance_type_options)
             return None
         return "; ".join(errs) if errs else "no nodepool matched pod"
+
+    # -- incremental fewest-pods-first maintenance -----------------------------
+    # One add changes exactly one claim's pod count; relocating just that claim
+    # reproduces what the reference's per-_add stable sort would compute.
+
+    def _bubble_claim_right(self, i: int) -> None:
+        """Claim i gained a pod: move it right past claims with strictly fewer
+        pods (stable order among equal counts is preserved, matching
+        list.sort)."""
+        claims = self.new_node_claims
+        c = claims[i]
+        k = len(c.pods)
+        j = i
+        while j + 1 < len(claims) and len(claims[j + 1].pods) < k:
+            claims[j] = claims[j + 1]
+            j += 1
+        claims[j] = c
+
+    def _bubble_claim_left(self) -> None:
+        """A claim was appended: move it left past claims with strictly more
+        pods (it stays after equal counts, exactly where a stable sort of
+        append-then-sort would place it)."""
+        claims = self.new_node_claims
+        j = len(claims) - 1
+        c = claims[j]
+        k = len(c.pods)
+        while j > 0 and len(claims[j - 1].pods) > k:
+            claims[j] = claims[j - 1]
+            j -= 1
+        claims[j] = c
 
 
 def _volume_zone_req(volume_reqs: list) -> Requirement | None:

@@ -67,6 +67,8 @@ SOLVER_VALIDATION_FAILURES_TOTAL = "karpenter_solver_validation_failures_total"
 SOLVER_HYBRID_RESIDUAL_TOTAL = "karpenter_solver_hybrid_residual_total"
 SOLVER_DECODE_REPAIR_TOTAL = "karpenter_solver_decode_repair_total"
 SOLVER_ENCODE_SECONDS = "karpenter_solver_encode_seconds"
+SOLVER_FFD_MEMO_TOTAL = "karpenter_solver_ffd_memo_total"
+SOLVER_FFD_PHASE_SECONDS = "karpenter_solver_ffd_phase_seconds"
 
 
 def make_registry() -> Registry:
@@ -131,6 +133,17 @@ def make_registry() -> Registry:
         SOLVER_ENCODE_SECONDS,
         "Host-side snapshot-encode duration, by mode (full | masked sub-encode | pod delta)",
         ("mode",),
+    )
+    r.counter(
+        SOLVER_FFD_MEMO_TOTAL,
+        "Signature-batched host-FFD fit-memo probes, by outcome (hit | miss | invalidate)",
+        ("kind",),
+    )
+    r.histogram(
+        SOLVER_FFD_PHASE_SECONDS,
+        "Host-FFD per-solve scan time, by phase (existing | inflight | new_claim)",
+        ("phase",),
+        DURATION_BUCKETS,
     )
     return r
 

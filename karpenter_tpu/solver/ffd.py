@@ -1,6 +1,28 @@
 """FFDSolver: the exact host scheduler behind the Solver interface, plus the
 hybrid residual path — the same Scheduler run against a node state
-pre-seeded with a tensor solve's placements."""
+pre-seeded with a tensor solve's placements.
+
+Signature-batched FFD (KARPENTER_FFD_BATCH=1, default on; =0 is the exact-
+reference escape hatch). Every host-scheduler consumer — the full fallback,
+`solve_residual` (hybrid tail + decode repair), and the consolidation
+simulations (they call the Solver interface, helpers.simulate_scheduling) —
+gets the fast path through `build_scheduler`. The monotonicity argument the
+per-solve fit memo relies on:
+
+  Within one `Scheduler.solve()`, node labels/taints are fixed and node state
+  only ever TIGHTENS — remaining resources shrink, requirements narrow (add()
+  intersects), port/volume usage accumulates, in-flight instance-type options
+  narrow, accumulated requests grow. Hence a rejection of scheduling-signature
+  S by node N from the static prefix (taints / volume limits / host ports /
+  resource fit / requirements compatibility) or from raw capacity exhaustion
+  (no option has the resources for the accumulated requests plus S) can never
+  become an acceptance later: it is memoized permanently per (signature, node).
+  Only topology (skew counts move both ways as pods land) and reservation
+  state (releases re-open options) are genuinely non-monotone; those checks
+  run AFTER the memoizable prefix on every probe, and a static pass is
+  stamped with the node's state version so any tightening re-validates it.
+  Preference relaxation deep-copies and mutates the pod spec, which changes
+  its signature — relaxed pods re-key the memo naturally."""
 
 from __future__ import annotations
 
@@ -32,14 +54,27 @@ def build_scheduler(snap: SolverSnapshot, collect_zone_metrics: bool | None = No
         reserved_capacity_enabled=snap.reserved_capacity_enabled,
         reserved_offering_mode=snap.reserved_offering_mode,
         collect_zone_metrics=snap.collect_zone_metrics if collect_zone_metrics is None else collect_zone_metrics,
+        registry=getattr(snap, "registry", None),
     )
 
 
 class FFDSolver:
     name = "ffd"
 
+    def __init__(self):
+        # per-solve observability snapshots (bench + dashboards). Only the two
+        # small dicts are kept — retaining the Scheduler itself would pin the
+        # whole solve's state (memo, caches, claims) for the solver's lifetime
+        self.last_memo_stats: dict | None = None
+        self.last_phase_seconds: dict | None = None
+
     def solve(self, snap: SolverSnapshot) -> Results:
-        return build_scheduler(snap).solve(snap.pods)
+        scheduler = build_scheduler(snap)
+        try:
+            return scheduler.solve(snap.pods)
+        finally:
+            self.last_memo_stats = dict(scheduler.memo_stats)
+            self.last_phase_seconds = dict(scheduler.phase_seconds)
 
 
 def solve_residual(snap: SolverSnapshot, residual_pods: list, tensor_results: Results, seam_records=()) -> Results:

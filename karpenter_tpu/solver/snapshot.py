@@ -29,6 +29,9 @@ class SolverSnapshot:
     # skip the effective-zone metric computation (consolidation simulations
     # discard it; scheduler.go computes it only on the provisioner path)
     collect_zone_metrics: bool = True
+    # metrics Registry the host scheduler reports into (ffd-memo counters +
+    # phase histograms); None disables scheduler-side metric emission
+    registry: object = None
 
     def with_pods(self, pods: list) -> "SolverSnapshot":
         """The same solve context over a different pod set — the hybrid

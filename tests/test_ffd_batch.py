@@ -1,0 +1,366 @@
+"""Signature-batched host FFD (KARPENTER_FFD_BATCH, scheduler.py fit memo +
+placement cursors + PodData template cache + incremental claim ordering).
+
+The contract under test: placements are BIT-IDENTICAL between the batched
+(=1, default) and exact-reference (=0) paths across every scenario family —
+the memo may only skip work whose outcome is provably monotone within the
+solve. Plus targeted memo-soundness cases (capacity rejections stay
+permanent, topology skew changes are still re-evaluated) and the queue
+cycle-detection regression for twice-relaxed pods.
+"""
+
+import copy
+import random
+
+from helpers import hostname_anti_affinity, make_nodepool, make_pod, zone_spread
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.cloudprovider import catalog
+from karpenter_tpu.controllers.provisioning.scheduling import Scheduler
+from karpenter_tpu.controllers.provisioning.scheduling.nodeclaim import _reqs_content_key
+from karpenter_tpu.controllers.provisioning.scheduling.queue import Queue
+from karpenter_tpu.kube import Store
+from karpenter_tpu.kube.objects import Node, NodeSpec, NodeStatus, ObjectMeta, TopologySpreadConstraint
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.resources import parse_resource_list
+
+LINUX_AMD64 = [
+    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+]
+
+
+def build_env(node_pools=None, types=None, nodes=()):
+    store = Store()
+    clock = FakeClock()
+    cluster = Cluster(store, clock)
+    start_informers(store, cluster)
+    node_pools = node_pools if node_pools is not None else [make_nodepool(requirements=LINUX_AMD64)]
+    for np in node_pools:
+        store.create(np)
+    for n in nodes:
+        store.create(n)
+    types = types if types is not None else catalog.construct_instance_types()
+    return store, clock, cluster, node_pools, types
+
+
+def make_scheduler(store, clock, cluster, node_pools, types, ffd_batch, daemons=(), **kw):
+    return Scheduler(
+        store,
+        cluster,
+        node_pools,
+        {np.metadata.name: types for np in node_pools},
+        cluster.nodes(),
+        list(daemons),
+        clock,
+        ffd_batch=ffd_batch,
+        **kw,
+    )
+
+
+def unowned_node(name, zone="test-zone-a", cpu="16", memory="32Gi"):
+    return Node(
+        metadata=ObjectMeta(name=name, labels={wk.HOSTNAME_LABEL_KEY: name, wk.ZONE_LABEL_KEY: zone}),
+        spec=NodeSpec(provider_id=f"byo://{name}"),
+        status=NodeStatus(
+            capacity=parse_resource_list({"cpu": cpu, "memory": memory, "pods": "110"}),
+            allocatable=parse_resource_list({"cpu": cpu, "memory": memory, "pods": "110"}),
+        ),
+    )
+
+
+def placements_key(results):
+    """Everything scheduling-relevant in a Results, hostile to incidental
+    ordering but exact on placements: pod->existing-node assignment, and per
+    claim the pod set, pool, option set, and requirement CONTENT (hostname
+    placeholders and claim names are run-unique by construction)."""
+    existing = {en.name(): tuple(sorted(p.metadata.name for p in en.pods)) for en in results.existing_nodes if en.pods}
+    claims = sorted(
+        (
+            tuple(sorted(p.metadata.name for p in nc.pods)),
+            nc.nodepool_name,
+            tuple(sorted(it.name for it in nc.instance_type_options)),
+            _reqs_content_key(nc.requirements),
+        )
+        for nc in results.new_node_claims
+    )
+    return existing, claims
+
+
+def run_pair(pods, node_pools=None, types=None, nodes=(), **kw):
+    """Solve the same scenario with KARPENTER_FFD_BATCH off and on; assert
+    bit-identical Results; return (off, on, batched_scheduler)."""
+    env = build_env(node_pools, types, nodes)
+    s_off = make_scheduler(*env, ffd_batch=False, **kw)
+    r_off = s_off.solve(pods)
+    s_on = make_scheduler(*env, ffd_batch=True, **kw)
+    r_on = s_on.solve(pods)
+    assert placements_key(r_off) == placements_key(r_on)
+    assert r_off.pod_errors == r_on.pod_errors
+    assert r_off.pending_pods_by_effective_zone == r_on.pending_pods_by_effective_zone
+    assert r_off.timed_out == r_on.timed_out
+    return r_off, r_on, s_on
+
+
+ZONE_B_TERM = [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}]
+ZONE_C_TERM = [{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-c"]}]
+
+
+class TestParityFamilies:
+    def test_mixed_replicas(self):
+        pods = []
+        for shape in (("1", "1Gi"), ("2", "4Gi"), ("500m", "512Mi")):
+            pods += [make_pod(cpu=shape[0], memory=shape[1]) for _ in range(12)]
+        _, r_on, s = run_pair(pods)
+        assert r_on.all_pods_scheduled()
+        assert s.memo_stats["miss"] > 0  # replicas rode the batched path
+
+    def test_replicas_fill_claims_and_hit_memo(self):
+        # a single 16-cpu type caps each claim at two 7-cpu pods: full claims
+        # become permanent capacity rejections that later replicas skip
+        types = [catalog.make_instance_type("c", 16)]
+        pods = [make_pod(cpu="7") for _ in range(10)]
+        _, r_on, s = run_pair(pods, types=types)
+        assert r_on.all_pods_scheduled()
+        assert len(r_on.new_node_claims) == 5
+        assert s.memo_stats["hit"] > 0
+
+    def test_zone_spread_replicas(self):
+        sel = {"matchLabels": {"app": "web"}}
+        pods = [make_pod(cpu="1", memory="1Gi", labels={"app": "web"}, tsc=[zone_spread(selector=sel)]) for _ in range(18)]
+        pods += [make_pod(cpu="2", memory="2Gi") for _ in range(6)]
+        _, r_on, _ = run_pair(pods)
+        assert r_on.all_pods_scheduled()
+
+    def test_hostname_topology(self):
+        sel = {"matchLabels": {"app": "db"}}
+        host_tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.HOSTNAME_LABEL_KEY, when_unsatisfiable="DoNotSchedule", label_selector=sel
+        )
+        pods = [make_pod(cpu="1", labels={"app": "db"}, tsc=[host_tsc]) for _ in range(6)]
+        pods += [
+            make_pod(cpu="500m", labels={"app": "anti"}, anti_affinity=[hostname_anti_affinity({"matchLabels": {"app": "anti"}})])
+            for _ in range(4)
+        ]
+        _, r_on, _ = run_pair(pods)
+        assert r_on.all_pods_scheduled()
+
+    def test_host_ports_bypass(self):
+        pods = []
+        for i in range(6):
+            p = make_pod(cpu="1")
+            p.spec.containers[0].ports = [{"containerPort": 8080, "hostPort": 8080, "protocol": "TCP"}]
+            pods.append(p)
+        pods += [make_pod(cpu="1") for _ in range(6)]
+        _, r_on, s = run_pair(pods)
+        assert r_on.all_pods_scheduled()
+        # port pods bypass the memo entirely
+        assert all(s._sig_by_uid[p.metadata.uid] is None for p in pods[:6])
+
+    def test_min_values_best_effort_and_strict(self):
+        reqs = LINUX_AMD64 + [{"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "Exists", "minValues": 2}]
+        for policy in ("BestEffort", "Strict"):
+            pods = [make_pod(cpu="1", memory="1Gi") for _ in range(10)]
+            _, r_on, _ = run_pair(
+                pods, node_pools=[make_nodepool(requirements=reqs)], min_values_policy=policy
+            )
+            assert r_on.all_pods_scheduled()
+
+    def test_reserved_offerings(self):
+        types = catalog.construct_instance_types(include_reserved=True)
+        for mode in ("fallback", "strict"):
+            pods = [make_pod(cpu="1") for _ in range(8)]
+            _, r_on, _ = run_pair(pods, types=types, reserved_offering_mode=mode)
+            assert r_on.all_pods_scheduled()
+
+    def test_existing_nodes_and_cursor(self):
+        nodes = [unowned_node(f"byo-{i}", zone="test-zone-a", cpu="4") for i in range(4)]
+        pods = [make_pod(cpu="3") for _ in range(8)]  # one per node, rest overflow
+        _, r_on, s = run_pair(pods, nodes=nodes)
+        assert r_on.all_pods_scheduled()
+        landed = sum(1 for en in r_on.existing_nodes for _ in en.pods)
+        assert landed == 4
+        # the per-signature cursor advanced past the exhausted node prefix
+        assert any(c > 0 for c in s._existing_cursor.values())
+
+    def test_unschedulable_pods_error_parity(self):
+        pods = [make_pod(cpu="500") for _ in range(3)] + [make_pod(cpu="1") for _ in range(3)]
+        r_off, r_on, _ = run_pair(pods)
+        assert len(r_on.pod_errors) == 3
+        assert r_off.pod_errors == r_on.pod_errors  # exact strings, not just keys
+
+    def test_relaxation_rekeys_memo(self):
+        # preferred zone-c affinity is unsatisfiable (no zone-c offering in the
+        # catalog subset) — the pod relaxes, and the relaxed signature must be
+        # tracked separately from the strict one
+        types = [catalog.make_instance_type("c", 8, zones=["test-zone-a", "test-zone-b"])]
+        pods = [make_pod(cpu="1", preferred_affinity=[(1, ZONE_C_TERM)]) for _ in range(5)]
+        _, r_on, _ = run_pair(pods, types=types)
+        assert r_on.all_pods_scheduled()
+
+
+class TestRandomizedParity:
+    def _random_pods(self, rng, n):
+        spread_sel = {"matchLabels": {"app": "web"}}
+        anti_sel = {"matchLabels": {"app": "db"}}
+        pods = []
+        for _ in range(n):
+            k = rng.random()
+            if k < 0.30:  # replica shapes
+                cpu, mem = rng.choice([("1", "1Gi"), ("2", "2Gi"), ("500m", "512Mi")])
+                pods.append(make_pod(cpu=cpu, memory=mem))
+            elif k < 0.45:  # zone spread
+                pods.append(make_pod(cpu="1", memory="1Gi", labels={"app": "web"}, tsc=[zone_spread(selector=spread_sel)]))
+            elif k < 0.55:  # zone node selector
+                pods.append(make_pod(cpu="1", node_selector={wk.ZONE_LABEL_KEY: rng.choice(["test-zone-a", "test-zone-b"])}))
+            elif k < 0.65:  # hostname anti-affinity
+                pods.append(make_pod(cpu="500m", labels={"app": "db"}, anti_affinity=[hostname_anti_affinity(anti_sel)]))
+            elif k < 0.75:  # preferred zone affinity (relaxation candidates)
+                pods.append(make_pod(cpu="1", preferred_affinity=[(2, ZONE_B_TERM)]))
+            elif k < 0.85:  # host ports (memo bypass)
+                p = make_pod(cpu="500m")
+                p.spec.containers[0].ports = [{"containerPort": 80, "hostPort": 8000 + rng.randrange(4), "protocol": "TCP"}]
+                pods.append(p)
+            elif k < 0.93:  # heterogeneous one-offs
+                pods.append(make_pod(cpu=f"{rng.randrange(1, 7)}", memory=f"{rng.randrange(1, 8)}Gi"))
+            else:  # unschedulable
+                pods.append(make_pod(cpu="500"))
+        return pods
+
+    def test_randomized_mixes(self):
+        for seed in range(5):
+            rng = random.Random(seed)
+            pods = self._random_pods(rng, 60)
+            nodes = [unowned_node(f"byo-{seed}-{i}", zone=rng.choice(["test-zone-a", "test-zone-b"]), cpu="8") for i in range(3)]
+            reserved = seed % 2 == 1
+            types = catalog.construct_instance_types(include_reserved=reserved)
+            run_pair(
+                pods,
+                types=types,
+                nodes=nodes,
+                min_values_policy=rng.choice(["Strict", "BestEffort"]),
+                reserved_offering_mode="strict" if reserved else "fallback",
+            )
+
+
+class TestMemoSoundness:
+    def test_capacity_rejection_is_permanent_but_exact(self):
+        # 3 identical 3-cpu pods against one 4-cpu node: the first lands, the
+        # second's "exceeds node resources" is memoized, the third must skip
+        # the node via the memo — and still open claims exactly like the
+        # reference path
+        nodes = [unowned_node("small", cpu="4")]
+        pods = [make_pod(cpu="3") for _ in range(3)]
+        _, r_on, s = run_pair(pods, nodes=nodes)
+        assert r_on.all_pods_scheduled()
+        landed = [p.metadata.name for en in r_on.existing_nodes for p in en.pods]
+        assert len(landed) == 1
+        assert s.memo_stats["hit"] >= 1
+
+    def test_topology_skew_still_reevaluated(self):
+        # zone spread maxSkew=1 over two existing nodes: a node that rejects a
+        # pod for skew must ACCEPT a later identical pod once counts rebalance
+        # — a memoized topology rejection would starve node-a
+        nodes = [unowned_node("node-a", zone="test-zone-a", cpu="64"), unowned_node("node-b", zone="test-zone-b", cpu="64")]
+        sel = {"matchLabels": {"app": "web"}}
+        # restrict the offering universe to the two node zones so the spread's
+        # domain min tracks the nodes (a third empty zone would pin min at 0)
+        types = [catalog.make_instance_type("c", 16, zones=["test-zone-a", "test-zone-b"])]
+        pods = [make_pod(cpu="1", labels={"app": "web"}, tsc=[zone_spread(selector=sel)]) for _ in range(6)]
+        _, r_on, _ = run_pair(pods, nodes=nodes, types=types)
+        assert r_on.all_pods_scheduled()
+        counts = {en.name(): len(en.pods) for en in r_on.existing_nodes}
+        assert counts.get("node-a", 0) == 3 and counts.get("node-b", 0) == 3
+
+    def test_claim_version_invalidates_pass_entries(self):
+        # alternating signatures landing on the same claim force pass-entry
+        # invalidation (the claim's version moves under the memo)
+        pods = []
+        for _ in range(8):
+            pods.append(make_pod(cpu="1", memory="1Gi"))
+            pods.append(make_pod(cpu="1", memory="2Gi"))
+        _, r_on, s = run_pair(pods)
+        assert r_on.all_pods_scheduled()
+        assert s.memo_stats["invalidate"] >= 1
+
+    def test_memo_cap_clearing_preserves_parity(self, monkeypatch):
+        # a tiny cap forces mid-solve memo clears: verdicts must re-derive
+        # identically (clearing forgets, never corrupts — cursors included)
+        from karpenter_tpu.controllers.provisioning.scheduling import scheduler as sched_mod
+
+        monkeypatch.setattr(sched_mod, "_FIT_MEMO_MAX", 4)
+        types = [catalog.make_instance_type("c", 16)]
+        pods = [make_pod(cpu="7") for _ in range(10)] + [make_pod(cpu="3") for _ in range(6)]
+        nodes = [unowned_node("cap-node", cpu="4")]
+        _, r_on, s = run_pair(pods, types=types, nodes=nodes)
+        assert r_on.all_pods_scheduled()
+        assert len(s._fit_memo) <= 4
+
+    def test_pod_data_template_cache_shares_entries(self):
+        pods = [make_pod(cpu="1") for _ in range(10)]
+        _, _, s = run_pair(pods)
+        datas = {id(s.cached_pod_data[p.metadata.uid]) for p in pods}
+        assert len(datas) == 1  # one PodData template for ten replicas
+
+
+class TestObservability:
+    def test_memo_counter_and_phase_histogram(self):
+        from karpenter_tpu import metrics as m
+
+        registry = m.make_registry()
+        env = build_env()
+        pods = [make_pod(cpu="7") for _ in range(6)]
+        s = make_scheduler(*env, ffd_batch=True, registry=registry)
+        s.solve(pods)
+        memo = registry.counter(m.SOLVER_FFD_MEMO_TOTAL)
+        assert memo.value(kind="miss") == s.memo_stats["miss"] > 0
+        assert memo.value(kind="hit") == s.memo_stats["hit"]
+        assert memo.value(kind="invalidate") == s.memo_stats["invalidate"]
+        phases = registry.histogram(m.SOLVER_FFD_PHASE_SECONDS)
+        for phase in ("existing", "inflight", "new_claim"):
+            assert phases._totals[(("phase", phase),)] == 1  # one solve observed
+
+
+class TestGate:
+    def test_env_gate(self, monkeypatch):
+        env = build_env()
+        monkeypatch.setenv("KARPENTER_FFD_BATCH", "0")
+        assert make_scheduler(*env, ffd_batch=None).batch_enabled is False
+        monkeypatch.setenv("KARPENTER_FFD_BATCH", "1")
+        assert make_scheduler(*env, ffd_batch=None).batch_enabled is True
+        monkeypatch.delenv("KARPENTER_FFD_BATCH")
+        assert make_scheduler(*env, ffd_batch=None).batch_enabled is True  # default-on
+
+
+class TestQueueCycleRegression:
+    def test_uid_survives_deepcopy(self):
+        pod = make_pod(cpu="1")
+        assert copy.deepcopy(pod).metadata.uid == pod.metadata.uid
+
+    def test_twice_relaxed_pod_terminates(self):
+        # impossible node selector + two preferred affinity terms: every
+        # _try_schedule relaxes twice on a deepcopy, the ORIGINAL pod is
+        # re-queued, and the uid-keyed cycle detection must stop the queue
+        # instead of spinning (ISSUE 5 satellite)
+        pod = make_pod(
+            cpu="1",
+            node_selector={wk.ZONE_LABEL_KEY: "no-such-zone"},
+            preferred_affinity=[(2, ZONE_B_TERM), (1, ZONE_C_TERM)],
+        )
+        for batch in (False, True):
+            env = build_env()
+            s = make_scheduler(*env, ffd_batch=batch)
+            results = s.solve([pod])
+            assert pod.key() in results.pod_errors
+            assert not results.timed_out
+
+    def test_queue_stops_without_progress(self):
+        pods = [make_pod(cpu="1"), make_pod(cpu="1")]
+        data = {p.metadata.uid: type("D", (), {"requests": {}})() for p in pods}
+        q = Queue(pods, data)
+        a = q.pop()
+        q.push(a)
+        b = q.pop()
+        q.push(b)
+        assert q.pop() is None  # full cycle, no progress
