@@ -398,9 +398,13 @@ class MetricLabelCardinalityRule(Rule):
     description = "bounded metric labels must carry statically enumerable values"
     _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
 
+    # the seeded violation is a solvetrace-label one: a recompile counter
+    # whose `fn` label interpolates a runtime value — exactly the drift the
+    # sentinel's call sites must never regress into
     SELF_TEST_BAD = (
-        "def record(registry, pod):\n"
-        '    registry.counter("m").inc(reason=f"pod {pod}")\n'
+        "def record(registry, trace):\n"
+        "    for fn in trace.recompiles:\n"
+        '        registry.counter("karpenter_solver_recompile_total").inc(fn=f"jit {fn}")\n'
     )
     SELF_TEST_OK = (
         "def record(registry, pod):\n"

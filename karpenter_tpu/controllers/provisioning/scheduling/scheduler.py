@@ -336,6 +336,7 @@ class Scheduler:
 
         if self.registry is not None:
             self._flush_solve_metrics()
+        self._flush_trace()
 
         return Results(
             new_node_claims=list(self.new_node_claims),
@@ -356,6 +357,21 @@ class Scheduler:
         phases.observe(self.phase_seconds["existing"], phase="existing")
         phases.observe(self.phase_seconds["inflight"], phase="inflight")
         phases.observe(self.phase_seconds["new_claim"], phase="new_claim")
+
+    def _flush_trace(self) -> None:
+        """Attach this solve's per-phase split and fit-memo attribution to
+        the ambient SolveTrace, if one is active (a TPU fallback/residual or
+        a flight-recorded FFD solve). The per-pod phase accumulation itself
+        stays counter-based — a span per pod would be the overhead the trace
+        layer promises not to add — so the totals land as back-dated spans."""
+        from ....obs.trace import current_trace
+
+        tr = current_trace()
+        if tr is None or not tr.enabled:
+            return
+        for phase in ("existing", "inflight", "new_claim"):
+            tr.add_phase(f"ffd.{phase}", self.phase_seconds[phase])
+        tr.note(ffd_memo=dict(self.memo_stats))
 
     def _memo_put(self, key, entry) -> None:
         memo = self._fit_memo

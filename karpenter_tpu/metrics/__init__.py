@@ -69,6 +69,11 @@ SOLVER_DECODE_REPAIR_TOTAL = "karpenter_solver_decode_repair_total"
 SOLVER_ENCODE_SECONDS = "karpenter_solver_encode_seconds"
 SOLVER_FFD_MEMO_TOTAL = "karpenter_solver_ffd_memo_total"
 SOLVER_FFD_PHASE_SECONDS = "karpenter_solver_ffd_phase_seconds"
+# solvetrace surfaces (obs/trace.py): the recompile sentinel, the trace-ring
+# eviction counter, and the rolling per-(mode, phase) latency quantiles
+SOLVER_RECOMPILE_TOTAL = "karpenter_solver_recompile_total"
+SOLVER_TRACE_DROPPED_TOTAL = "karpenter_solver_trace_dropped_total"
+SOLVER_SOLVE_QUANTILE_SECONDS = "karpenter_solver_solve_quantile_seconds"
 
 
 def make_registry() -> Registry:
@@ -144,6 +149,18 @@ def make_registry() -> Registry:
         "Host-FFD per-solve scan time, by phase (existing | inflight | new_claim)",
         ("phase",),
         DURATION_BUCKETS,
+    )
+    r.counter(
+        SOLVER_RECOMPILE_TOTAL,
+        "JIT recompiles observed by the solvetrace sentinel, by jitted entry point "
+        "(the churn loop's zero-steady-state-recompiles target reads this)",
+        ("fn",),
+    )
+    r.counter(SOLVER_TRACE_DROPPED_TOTAL, "SolveTraces evicted from the bounded flight-recorder ring", ())
+    r.gauge(
+        SOLVER_SOLVE_QUANTILE_SECONDS,
+        "Rolling solve-latency quantiles (p50 | p90 | p99) over the trace ring, per (mode, phase)",
+        ("mode", "phase", "quantile"),
     )
     return r
 

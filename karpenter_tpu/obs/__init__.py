@@ -1,0 +1,32 @@
+"""karpenter_tpu.obs — the solvetrace flight recorder.
+
+`trace` holds the span API, SolveTrace, the JIT-recompile sentinel, and the
+bounded TraceRecorder ring with rolling P50/P90/P99; `export` renders traces
+as JSONL or Chrome/Perfetto trace_event JSON (`python -m karpenter_tpu.obs`);
+`stats` is the repo's one nearest-rank quantile implementation, shared with
+`testing/metrics_poller`. Importing this package never initializes jax."""
+
+from .stats import RollingQuantiles, quantile
+from .trace import (
+    JIT_WATCHLIST,
+    RecompileSentinel,
+    SolveTrace,
+    Span,
+    TraceRecorder,
+    current_trace,
+    default_recorder,
+    sentinel,
+)
+
+__all__ = [
+    "JIT_WATCHLIST",
+    "RecompileSentinel",
+    "RollingQuantiles",
+    "SolveTrace",
+    "Span",
+    "TraceRecorder",
+    "current_trace",
+    "default_recorder",
+    "quantile",
+    "sentinel",
+]

@@ -1,0 +1,117 @@
+"""SolveTrace exporters: one-line JSON per trace, and Chrome/Perfetto
+`trace_event` JSON for flamegraph-style inspection of a bench run
+(chrome://tracing / https://ui.perfetto.dev open the output directly).
+
+Both operate on trace DICTS (`SolveTrace.to_dict()` shape), so they can
+consume live recorder content, a `/debug/solves` dump, or a JSONL file a
+previous process wrote — the `python -m karpenter_tpu.obs` CLI does the
+latter."""
+
+from __future__ import annotations
+
+import json
+
+
+def _as_dict(trace) -> dict:
+    return trace if isinstance(trace, dict) else trace.to_dict()
+
+
+def to_jsonl(traces) -> str:
+    """One compact JSON object per line, one line per solve."""
+    return "\n".join(json.dumps(_as_dict(t), sort_keys=True) for t in traces)
+
+
+def _span_events(span: dict, wall_us: float, pid: int, tid: int, out: list) -> None:
+    out.append(
+        {
+            "name": span["name"],
+            "ph": "X",  # complete event: one entry carries start + duration
+            "ts": wall_us + span.get("start_s", 0.0) * 1e6,
+            "dur": max(span.get("dur_s", 0.0) * 1e6, 0.01),
+            "pid": pid,
+            "tid": tid,
+            "cat": "solve",
+            "args": span.get("attrs", {}),
+        }
+    )
+    for child in span.get("children", ()):
+        _span_events(child, wall_us, pid, tid, out)
+
+
+def to_trace_events(traces) -> dict:
+    """Chrome trace_event JSON: each solve is one top-level "solve" slice on
+    the timeline (tid = solve mode, so modes read as separate tracks), its
+    phase spans nested inside; recompiles surface as instant events."""
+    events: list = []
+    tids: dict[str, int] = {}
+    meta: list = []
+    for t in traces:
+        d = _as_dict(t)
+        mode = d.get("mode") or "none"
+        tid = tids.get(mode)
+        if tid is None:
+            tid = tids[mode] = len(tids) + 1
+            meta.append(
+                {"name": "thread_name", "ph": "M", "pid": 1, "tid": tid, "args": {"name": f"mode:{mode}"}}
+            )
+        wall_us = d.get("wall_time", 0.0) * 1e6
+        events.append(
+            {
+                "name": f"solve#{d.get('seq', 0)}",
+                "ph": "X",
+                "ts": wall_us,
+                "dur": max(d.get("duration_s", 0.0) * 1e6, 0.01),
+                "pid": 1,
+                "tid": tid,
+                "cat": "solve",
+                "args": {
+                    "backend": d.get("backend", ""),
+                    "n_pods": d.get("n_pods", 0),
+                    "cache": d.get("cache", {}),
+                    "fallback_families": d.get("fallback_families", []),
+                },
+            }
+        )
+        for span in d.get("spans", ()):
+            _span_events(span, wall_us, 1, tid, events)
+        for fn, n in sorted(d.get("recompiles", {}).items()):
+            events.append(
+                {
+                    "name": f"recompile:{fn}",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": wall_us,
+                    "pid": 1,
+                    "tid": tid,
+                    "cat": "recompile",
+                    "args": {"count": n},
+                }
+            )
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def parse_dump(text: str) -> list[dict]:
+    """Accept either a /debug/solves dump (object with "solves") or JSONL
+    (one trace object per line) and return the trace dicts."""
+    text = text.strip()
+    if not text:
+        return []
+    try:  # a single JSON document: a /debug/solves dump, a list, or one trace
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        obj = None
+    if isinstance(obj, dict):
+        return list(obj["solves"]) if "solves" in obj else [obj]
+    if isinstance(obj, list):
+        return obj
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        obj = json.loads(line)
+        if isinstance(obj, dict) and "solves" in obj:
+            out.extend(obj["solves"])
+        else:
+            out.append(obj)
+    return out

@@ -67,6 +67,12 @@ class Environment:
         self.options = options or Options()
         self.clock = clock or FakeClock()
         self.registry = make_registry()
+        # solvetrace flight recorder backing /debug/solves — the process-wide
+        # default, so every solver this environment (or a test beside it)
+        # runs is visible from the operator's debug surface
+        from ..obs.trace import default_recorder
+
+        self.trace_recorder = default_recorder()
         self.recorder = Recorder(self.clock)
         self.store = store if store is not None else Store(clock=self.clock)
         self.cluster = Cluster(self.store, self.clock)

@@ -3,9 +3,11 @@
 Reference: operator.go:203-219 — metrics server on --metrics-port, healthz/
 readyz probes on --health-probe-port, pprof handlers behind
 --enable-profiling. Here one threaded stdlib server carries all routes:
-/healthz, /readyz, /metrics, and /debug/profile (a py-spy-less stand-in that
-dumps running thread stacks, the diagnostic the reference's pprof routes
-serve in e2e debugging — karpenter_profiler.go:40-56).
+/healthz, /readyz, /metrics, /debug/solves (the solvetrace flight-recorder
+dump: recent SolveTraces + rolling per-(mode, phase) quantiles, see
+obs/trace.py; `?n=<k>` limits to the newest k solves), and /debug/profile
+(a py-spy-less stand-in that dumps running thread stacks, the diagnostic the
+reference's pprof routes serve in e2e debugging — karpenter_profiler.go:40-56).
 """
 
 from __future__ import annotations
@@ -15,6 +17,7 @@ import sys
 import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 
 class OperatorServer:
@@ -50,6 +53,21 @@ class OperatorServer:
                     self._send(200 if ready else 503, "ok" if ready else "cluster state not synced")
                 elif self.path == "/metrics":
                     self._send(200, env.registry.expose(), "text/plain; version=0.0.4")
+                elif self.path.split("?", 1)[0] == "/debug/solves":
+                    # served unconditionally (unlike /debug/profile, which the
+                    # reference gates behind --enable-profiling): the trace
+                    # dump's sensitivity class matches the unauthenticated
+                    # /metrics exposition on this same port
+                    from ..obs.trace import default_recorder
+
+                    rec = getattr(env, "trace_recorder", None) or default_recorder()
+                    qs = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = int(qs["n"][0]) if "n" in qs else None
+                    except ValueError:
+                        self._send(400, "bad ?n= value")
+                        return
+                    self._send(200, json.dumps(rec.dump(limit=limit), indent=1), "application/json")
                 elif self.path == "/debug/profile" and enable_profiling:
                     frames = {}
                     for tid, frame in sys._current_frames().items():

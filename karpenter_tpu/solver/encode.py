@@ -1279,6 +1279,7 @@ def _try_delta_encode(snap, cache: EncodeCache):
         **fb_fields,
     )
     enc.encode_mode = "delta"
+    enc.row_cache_hit = True  # a delta encode is by definition row-cache-valid
     enc.delta_base = base
     enc.delta_added_sigs = np.asarray(added_sigs, np.int32)
     enc.delta_removed_enc = removed_enc
@@ -1829,6 +1830,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
                 rows.vocab.n_keys > rows.built_n_keys + 64 or rows.vocab.max_values() > rows.built_vmax + 256
             ):
                 rows = None
+    row_cache_hit = rows is not None  # solvetrace attribution (obs/trace.py)
     if rows is None:
         rows = _build_rows(snap, rnames, rl_to_vec, dom_keys)
         if cache is not None:
@@ -2167,6 +2169,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         sig_relaxable=sig_relaxable,
         pools_prefer=pools_prefer,
     )
+    enc_out.row_cache_hit = row_cache_hit
     if cache is not None:
         cache.last_enc = enc_out
         cache.last_row_key = row_key if row_key is not None else _row_cache_key(snap, rnames, dom_keys)

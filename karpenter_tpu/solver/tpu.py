@@ -9,8 +9,6 @@ BASELINE.json ("the Go FFD path stays the default").
 
 from __future__ import annotations
 
-import time
-
 import numpy as np
 
 from ..apis import labels as wk
@@ -25,6 +23,7 @@ from ..scheduling.requirements import Operator, Requirement, Requirements
 from ..utils import resources as res
 from ..utils.quantity import Quantity
 from ..scheduling.hostports import pod_host_ports as _php
+from ..obs.trace import SolveTrace, default_recorder, sentinel
 from .contracts import maybe_check_encoded
 from .encode import encode
 from .ffd import FFDSolver
@@ -119,9 +118,19 @@ class _TensorFallback(Exception):
 class TPUSolver:
     name = "tpu"
 
-    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None, mesh=None, hybrid: bool = True):
+    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None, mesh=None, hybrid: bool = True, recorder=None):
         self.fallback = fallback or FFDSolver()
         self.force = force  # raise instead of falling back (tests)
+        # solvetrace flight recorder (obs/trace.py): every solve begins a
+        # SolveTrace on it and commits in the solve's finally — the ring,
+        # rolling quantiles, and recompile sentinel all hang off this. The
+        # process-wide default is shared so /debug/solves sees every solver;
+        # tests/bench inject private recorders (incl. a disabled one for the
+        # tracing-off overhead arm)
+        self.recorder = recorder if recorder is not None else default_recorder()
+        # pre-solve placeholder so the trace-derived compat properties
+        # (last_solve_mode / last_phase_seconds) read empty, never raise
+        self._trace = SolveTrace(enabled=False)
         # hybrid partitioned solve: when every fallback reason is pod-local,
         # pack the in-window majority on the tensor path and run the exact
         # host FFD only on the flagged residual (False = legacy whole-snapshot
@@ -148,11 +157,31 @@ class TPUSolver:
         # against the masked device-resident state instead of re-encoding
         # and re-packing the whole tensor majority
         self._hybrid_state: dict | None = None
-        # set on EVERY exit path:
-        # "full" | "delta" | "hybrid" | "hybrid-delta" | "fallback"
-        self.last_solve_mode: str = ""
-        # host-side wall-clock split of the last solve, for bench/observability
-        self.last_phase_seconds: dict[str, float] = {"encode": 0.0, "pack": 0.0, "residual": 0.0}
+        # last_solve_mode ("full" | "delta" | "hybrid" | "hybrid-delta" |
+        # "fallback") and last_phase_seconds are trace-derived properties
+        # below — the SolveTrace is the source of truth; the attributes
+        # survive as thin compat shims.
+
+    # -- solvetrace compat shims ---------------------------------------------
+    # The mode and phase split used to live in ad-hoc solver attributes; they
+    # now derive from the newest SolveTrace. Writes on the solve's exit paths
+    # forward into the live trace, so `solver.last_solve_mode` and the
+    # recorded trace can never disagree.
+    @property
+    def last_solve_mode(self) -> str:
+        return self._trace.mode
+
+    @last_solve_mode.setter
+    def last_solve_mode(self, value: str) -> None:
+        self._trace.mode = value
+
+    @property
+    def last_phase_seconds(self) -> dict[str, float]:
+        """Host-side wall-clock split of the last solve (compat view of the
+        trace's phase totals — the trace itself also carries decode/validate
+        sub-spans and the FFD per-phase split)."""
+        totals = self._trace.phase_totals
+        return {k: totals.get(k, 0.0) for k in ("encode", "pack", "residual")}
 
     def _pack(self, t, items, n_pods: int) -> dict:
         """Run the pack and land every host-needed output. The single-device
@@ -190,9 +219,6 @@ class TPUSolver:
         if self.registry is not None:
             self.registry.histogram(metric, labels=tuple(sorted(labels))).observe(value, **labels)
 
-    def _phase(self, name: str, dt: float) -> None:
-        self.last_phase_seconds[name] = self.last_phase_seconds.get(name, 0.0) + dt
-
     def _fall_back(self, snap: SolverSnapshot, reasons: list[str], family: str | None = None) -> Results:
         from ..metrics import SOLVER_FALLBACK_TOTAL, SOLVER_SOLVE_TOTAL
 
@@ -204,20 +230,47 @@ class TPUSolver:
             family = _reason_family(reasons[0]) if reasons else "empty"
         self._count(SOLVER_FALLBACK_TOTAL, reason=family)  # solverlint: ok(metric-label-cardinality): family is always a reason_family() output or a _TensorFallback literal ("validation"/"relaxation") — enum-bounded at every call site
         self._count(SOLVER_SOLVE_TOTAL, backend="ffd-fallback")
-        return self.fallback.solve(snap)
+        # the whole-snapshot host solve records its own ffd.* phase split
+        # into this span through the ambient current_trace()
+        with self._trace.span("fallback", reason=family):
+            return self.fallback.solve(snap)
 
     def solve(self, snap: SolverSnapshot) -> Results:
+        """One production solve, flight-recorded: begins a SolveTrace on the
+        recorder, stamps the JIT-recompile delta and the exit path's
+        mode/backend/attribution, and commits the trace in the finally — so
+        even a raising solve leaves a record. Recording never influences the
+        result (tests pin bit-identical placements tracing on vs off)."""
+        trace = self.recorder.begin(n_pods=len(snap.pods))
+        self._trace = trace
+        # reset the per-solve surfaces BEFORE the body runs: a solve that
+        # raises past every exit path must commit an empty record, never the
+        # previous solve's backend/reasons
+        self.last_backend = ""
+        self.last_fallback_reasons = []
+        if trace.enabled:
+            trace.jit_before = sentinel().snapshot()
+        try:
+            return self._solve_inner(snap, trace)
+        finally:
+            if trace.enabled:
+                trace.recompiles = sentinel().delta(trace.jit_before)
+            trace.backend = self.last_backend
+            trace.fallback_reasons = list(self.last_fallback_reasons)
+            self.recorder.commit(trace, registry=self.registry)
+
+    def _solve_inner(self, snap: SolverSnapshot, trace: SolveTrace) -> Results:
         from ..metrics import SOLVER_ENCODE_SECONDS
 
-        self.last_phase_seconds = {"encode": 0.0, "pack": 0.0, "residual": 0.0}
-        t0 = time.perf_counter()
-        enc = encode(snap, cache=self.encode_cache)
-        enc_dt = time.perf_counter() - t0
-        self._phase("encode", enc_dt)
+        with trace.span("encode") as sp:
+            enc = encode(snap, cache=self.encode_cache)
         # clamp to the two-value encode-mode enum by construction (the label
         # must stay bounded even if encode_mode ever carries a stray value)
         enc_mode = "delta" if getattr(enc, "encode_mode", "full") == "delta" else "full"
-        self._observe(SOLVER_ENCODE_SECONDS, enc_dt, mode=enc_mode)
+        sp.attrs["mode"] = enc_mode
+        self._observe(SOLVER_ENCODE_SECONDS, sp.dur, mode=enc_mode)
+        trace.n_sigs = int(getattr(enc, "n_sigs", 0) or 0)
+        trace.note(encode_mode=enc_mode, row_cache=bool(getattr(enc, "row_cache_hit", False)))
         # consume + clear the delta link IMMEDIATELY (even on the fallback
         # returns below): each link retains O(P) state, so an unbroken chain
         # across consecutive delta encodes would leak
@@ -269,8 +322,7 @@ class TPUSolver:
         # signature-grouped pack: device steps scale with UNIQUE pod shapes,
         # not pods (scheduler_model_grouped.py). Slot axis capped; retry
         # uncapped on the rare overflow (every slot opened AND pods unplaced).
-        t_start = time.perf_counter()
-        try:
+        with self._trace.span("pack", mode="full"):
             item_arrays, item_pods = build_items(enc)
             items = make_item_tensors(item_arrays)
             cap = enc.n_existing + min(enc.n_pods, 4096)
@@ -281,8 +333,6 @@ class TPUSolver:
                 out = self._pack(t, items, enc.n_pods)
             assignment = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
             return self._finish(snap, enc, assignment, out["slot_basis"], out["slot_zoneset"], t, out, count=count)
-        finally:
-            self._phase("pack", time.perf_counter() - t_start)
 
     def _try_hybrid(self, snap: SolverSnapshot, enc, delta_base=None) -> Results | None:
         """Hybrid partitioned solve: when every fallback reason is POD-LOCAL
@@ -319,11 +369,9 @@ class TPUSolver:
         _tensor_pods, residual_pods = part
         keep = np.ones(enc.n_sigs, dtype=bool)
         keep[[int(s) for s in enc.fallback_sig_local]] = False
-        t0 = time.perf_counter()
-        masked = mask_encode(enc, np.nonzero(keep)[0])
-        dt = time.perf_counter() - t0
-        self._phase("encode", dt)
-        self._observe(SOLVER_ENCODE_SECONDS, dt, mode="masked")
+        with self._trace.span("encode", mode="masked") as sp:
+            masked = mask_encode(enc, np.nonzero(keep)[0])
+        self._observe(SOLVER_ENCODE_SECONDS, sp.dur, mode="masked")
         if masked.n_pods == 0 or masked.n_rows == 0:
             self._hybrid_state = None
             return None
@@ -336,11 +384,11 @@ class TPUSolver:
         remap = np.full(enc.n_sigs, -1, dtype=np.int32)
         remap[keep] = np.arange(int(keep.sum()), dtype=np.int32)
         self._hybrid_state = dict(full_enc=enc, masked_enc=masked, keep=keep, remap=remap)
-        t1 = time.perf_counter()
-        results = solve_residual(
-            snap, residual_pods, tensor_results, seam_records=self._seam_records(enc, keep, tensor_results)
-        )
-        self._phase("residual", time.perf_counter() - t1)
+        self._trace.note(residual_pods=len(residual_pods))
+        with self._trace.span("residual"):
+            results = solve_residual(
+                snap, residual_pods, tensor_results, seam_records=self._seam_records(enc, keep, tensor_results)
+            )
         self.last_backend = "hybrid"
         self.last_solve_mode = "hybrid"
         self.last_fallback_reasons = enc.fallback_reasons
@@ -393,11 +441,9 @@ class TPUSolver:
         else:
             masked_added = remap[added_sigs[keep[added_sigs]]].astype(np.int32)
 
-        t0 = time.perf_counter()
-        masked_new = mask_encode(enc, np.nonzero(keep)[0])
-        dt = time.perf_counter() - t0
-        self._phase("encode", dt)
-        self._observe(SOLVER_ENCODE_SECONDS, dt, mode="masked")
+        with self._trace.span("encode", mode="masked") as sp:
+            masked_new = mask_encode(enc, np.nonzero(keep)[0])
+        self._observe(SOLVER_ENCODE_SECONDS, sp.dur, mode="masked")
         if masked_new.n_pods == 0:
             return None
         masked_new.delta_removed_enc = masked_removed
@@ -417,11 +463,11 @@ class TPUSolver:
             self.last_solve_mode = "delta"
             self._count(SOLVER_SOLVE_TOTAL, backend="tpu")
             return tensor_results
-        t1 = time.perf_counter()
-        results = solve_residual(
-            snap, residual_pods, tensor_results, seam_records=self._seam_records(enc, keep, tensor_results)
-        )
-        self._phase("residual", time.perf_counter() - t1)
+        self._trace.note(residual_pods=len(residual_pods))
+        with self._trace.span("residual"):
+            results = solve_residual(
+                snap, residual_pods, tensor_results, seam_records=self._seam_records(enc, keep, tensor_results)
+            )
         self.last_backend = "hybrid"
         self.last_solve_mode = "hybrid-delta"
         self.last_fallback_reasons = enc.fallback_reasons
@@ -509,14 +555,19 @@ class TPUSolver:
         from ..metrics import SOLVER_SOLVE_TOTAL, SOLVER_VALIDATION_FAILURES_TOTAL
         from .check import fast_validate
 
-        violations = [] if validated else fast_validate(enc, assignment, slot_basis, slot_zoneset)
+        if validated:
+            violations = []
+        else:
+            with self._trace.span("validate"):
+                violations = fast_validate(enc, assignment, slot_basis, slot_zoneset)
         if violations:
             self._count(SOLVER_VALIDATION_FAILURES_TOTAL)
             if self.force:
                 raise RuntimeError(f"tensor placement failed validation: {violations}")
             raise _TensorFallback([f"validation: {v}" for v in violations], family="validation")
         try:
-            results = self._decode(snap, enc, assignment, slot_basis, slot_zoneset)
+            with self._trace.span("decode"):
+                results = self._decode(snap, enc, assignment, slot_basis, slot_zoneset)
         except DecodeError as e:
             self._count(SOLVER_VALIDATION_FAILURES_TOTAL)
             if self.force:
@@ -560,11 +611,8 @@ class TPUSolver:
             # coordinates and continue there
             return self._solve_masked_delta(snap, enc, base)
         maybe_check_encoded(enc, where="pack-delta")
-        t_start = time.perf_counter()
-        try:
+        with self._trace.span("pack", mode="delta"):
             return self._solve_delta_inner(snap, enc, base, count)
-        finally:
-            self._phase("pack", time.perf_counter() - t_start)
 
     def _solve_delta_inner(self, snap: SolverSnapshot, enc, base, count: bool) -> Results | None:
         from ..models.scheduler_model import (
@@ -674,6 +722,7 @@ class TPUSolver:
         if fast_validate(enc, assignment, slot_basis, slot_zoneset):
             return None
         self.last_solve_mode = "delta"
+        self._trace.note(delta_added=n_added, delta_removed=int(removed.size) if removed is not None else 0)
         return self._finish(snap, enc, assignment, slot_basis, slot_zoneset, t, out, validated=True, count=count)
 
     # -- decode ----------------------------------------------------------------
@@ -915,6 +964,7 @@ class TPUSolver:
 
             self._decode_repaired = True
             self._count(SOLVER_DECODE_REPAIR_TOTAL, reason="min-values")
+            self._trace.note(repair_pods=len(repair_pods), repair_sigs=len(repair_sigs), repair_reason="min-values")
             keep = np.ones(enc.n_sigs, dtype=bool)
             keep[list(repair_sigs)] = False
             results = solve_residual(

@@ -51,11 +51,12 @@ def _cpu_seconds() -> float:
 
 
 def _p95(values: list[float]) -> float:
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    idx = min(len(ordered) - 1, int(round(0.95 * (len(ordered) - 1))))
-    return ordered[idx]
+    # the repo's one nearest-rank quantile (obs/stats.py), shared with the
+    # solvetrace rolling windows. The old round(0.95*(n-1)) rule here
+    # underestimated at small n (n=13 returned the 12th sample, not the max)
+    from ..obs.stats import quantile
+
+    return quantile(values, 0.95)
 
 
 class MetricsPoller:
