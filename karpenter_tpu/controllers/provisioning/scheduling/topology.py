@@ -411,6 +411,13 @@ class Topology:
         self.domain_groups = _domain_groups_cached(node_pools, instance_types)
         self.excluded_pods: set[str] = set()
         self._prepared = False
+        # record() memo: (namespace, labels) -> (n_groups stamp, groups whose
+        # selector selects such pods). Every add used to scan ALL topology
+        # groups per recorded pod; deployment replicas share (ns, labels), so
+        # the selector scan runs once per distinct shape. The group-count
+        # stamp invalidates entries when prepare()/update() registers new
+        # groups mid-solve (groups are never removed within a solve).
+        self._record_memo: dict[tuple, tuple[int, list]] = {}
         if pods:
             self.prepare(pods)
 
@@ -648,8 +655,21 @@ class Topology:
         return out
 
     def record(self, pod, taints, requirements: Requirements) -> None:
-        for tg in self.topology_groups.values():
-            if tg.counts(pod, taints, requirements):
+        # per-(namespace, labels) memo of the groups that SELECT this pod
+        # shape (counts() = selects() AND node_filter.matches(); the selector
+        # half is signature-stable, the node-filter half depends on the
+        # placement and re-evaluates per call)
+        md = pod.metadata
+        key = (md.namespace, tuple(sorted(md.labels.items())) if md.labels else ())
+        entry = self._record_memo.get(key)
+        if entry is None or entry[0] != len(self.topology_groups):
+            entry = (
+                len(self.topology_groups),
+                [tg for tg in self.topology_groups.values() if tg.selects(pod)],
+            )
+            self._record_memo[key] = entry
+        for tg in entry[1]:
+            if tg.node_filter.matches(taints, requirements):
                 domains = requirements.get(tg.key)
                 if tg.type == TYPE_ANTI_AFFINITY:
                     tg.record(*domains.values)

@@ -242,6 +242,7 @@ def _pack_body(
     axis: str | None,
     init_state=None,
     return_state: bool = False,
+    precomputed=None,
 ):
     """The grouped pack scan, written once for both execution modes.
 
@@ -321,9 +322,16 @@ def _pack_body(
     is_host_aff_g = t.group_kind == KIND_HOST_AFF
     hb_width = items.item_host_blocked.shape[1]
 
-    # item x row compatibility + row preference, one vectorized pass (W small)
-    compat_items = compat_matrix(t.row_labels, t.row_taint_class, items.item_mask, items.item_taint_ok, dom_keys, batch_size=256)
-    choose_key_items = row_choose_key(t.row_alloc, t.row_pool_rank, items.item_req)
+    # item x row compatibility + row preference, one vectorized pass (W small).
+    # The meshed path precomputes these OUTSIDE shard_map with the item/batch
+    # axis sharded across the mesh (parallel/sharded.py sharded_feasibility)
+    # and passes them in replicated — elementwise ops, so the result is
+    # bit-identical to the in-body computation.
+    if precomputed is not None:
+        compat_items, choose_key_items = precomputed
+    else:
+        compat_items = compat_matrix(t.row_labels, t.row_taint_class, items.item_mask, items.item_taint_ok, dom_keys, batch_size=256)
+        choose_key_items = row_choose_key(t.row_alloc, t.row_pool_rank, items.item_req)
 
     def step(state, i):
         slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, ports = state

@@ -40,10 +40,12 @@ _QUANTILE_POINTS = {"p50": 0.50, "p90": 0.90, "p99": 0.99}
 
 # The solver's jitted entry points, watched by the recompile sentinel:
 # (fn label, module, attribute). Labels are the `fn` metric label values —
-# a static enum by construction. The meshed (shard_map) kernels build their
-# jits per-mesh inside closures and are deliberately absent: the mesh path
-# is a growth-path side scenario, not the steady-state serving loop the
-# zero-recompile target binds.
+# a static enum by construction. The meshed (shard_map) kernels are now the
+# DEFAULT multi-device pack and are watched through the module-level
+# `_JitCacheProbe` objects in parallel/sharded.py: the per-(mesh, statics)
+# jits live inside lru_caches, so each probe aggregates `_cache_size()`
+# over every kernel it built — warm meshed re-solves must record zero here
+# exactly like the single-device path.
 JIT_WATCHLIST = (
     ("pack_full", "karpenter_tpu.models.scheduler_model_grouped", "_pack_compressed_impl"),
     ("pack_delta", "karpenter_tpu.models.scheduler_model_grouped", "_pack_delta_compressed_impl"),
@@ -51,6 +53,8 @@ JIT_WATCHLIST = (
     ("recredit", "karpenter_tpu.models.scheduler_model_grouped", "_recredit_impl"),
     ("pack_perpod", "karpenter_tpu.models.scheduler_model", "_greedy_pack_impl"),
     ("anneal", "karpenter_tpu.models.consolidation_model", "anneal_chains"),
+    ("pack_sharded", "karpenter_tpu.parallel.sharded", "pack_sharded_probe"),
+    ("shard_feas", "karpenter_tpu.parallel.sharded", "shard_compat_probe"),
 )
 
 

@@ -1,29 +1,47 @@
-"""Sharded solver stages over a jax.sharding.Mesh.
+"""Mesh-sharded solver stages: the default multi-device architecture.
 
-The provisioning solve has two parallelizable stages:
+Whenever more than one device is visible, `TPUSolver` constructs a
+`jax.sharding.Mesh` over all of them (see `default_mesh`; force off with
+``KARPENTER_SOLVER_MESH=0``) and runs the production pack through two sharded
+stages:
 
-1. the pod x row compatibility matrix — embarrassingly parallel over pods
-   (data-parallel axis); used by the per-pod scan path;
-2. the grouped greedy pack scan — sequential over work items, but its
-   per-step vector work (slot feasibility, the first-fit prefix-sum in
-   place(), per-zone slot availability) shards over the SLOT axis. This is
-   the real multi-chip execution path: `greedy_pack_grouped_sharded` runs
-   models/scheduler_model_grouped._pack_body inside jax.shard_map with the
-   slot axis partitioned across the mesh and psum/all_gather collectives for
-   the cross-slot reductions. Results are bit-identical to the single-device
-   kernel (integer prefix-sums and sums are exact under reordering), which
-   tests/test_sharded.py asserts on an 8-device CPU mesh.
+1. **Feasibility, sharded on the signature/batch axis.** The item x row
+   compatibility matrix and row-preference keys are embarrassingly parallel
+   over unique pod signatures: `sharded_feasibility` places the item tensors
+   with ``NamedSharding(mesh, PartitionSpec("batch"))`` (padding the axis up
+   to a mesh multiple when it is not divisible), replicates the offering/row
+   side, and asks XLA for replicated outputs — one all-gather of the
+   [W, Nrows] bool matrix and the [W, Nrows] f32 key matrix per cold pack.
 
-On one v5e chip none of this is needed (SURVEY.md §5: the solver is
-single-chip for the v0 target); this module is the ICI growth path and the
-driver's multi-chip dry-run target. Reference analogue: the goroutine fan-out
-over candidate nodes at scheduler.go:939-961 — here the fan-out is the mesh.
+2. **The greedy pack scan under `jax.shard_map`, slot axis partitioned.**
+   The scan is sequential over signatures, but each step's vector work
+   (slot feasibility, the first-fit prefix-sum in place(), per-domain slot
+   availability) shards over the SLOT axis. Cross-shard interaction is a
+   BOUNDED EXCHANGE STEP: per place() call, one `all_gather` of n_dev
+   per-device capacity totals (the exclusive prefix-sum offset) plus psum'd
+   take/left scalars, and one psum-of-any per domain-availability probe —
+   O(n_dev + D) integers per step, independent of slot count. Nothing else
+   crosses device boundaries until the final device->host landing.
+
+Results are bit-identical to the single-device kernel (integer prefix-sums
+and sums are exact under reordering), which tests/test_sharded.py and
+tests/test_mesh_default.py assert on an 8-device CPU mesh — so everything
+downstream (validate, decode, delta re-solves) is unchanged. The pack's
+final carry is returned alongside the outputs and stays device-resident:
+delta/hybrid-delta re-solves run the single-device delta kernel directly
+over the sharded carry (jit repartitions as needed; delta items are few),
+so the EncodeCache delta and hybrid residual paths compose with the mesh
+instead of being disabled by it.
+
+Reference analogue: the goroutine fan-out over candidate nodes at
+scheduler.go:939-961 — here the fan-out is the mesh, riding ICI instead of
+goroutines.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import functools
+import os
 from functools import partial
 
 import jax
@@ -31,7 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.scheduler_model import SchedulerTensors, make_tensors
+from ..models.scheduler_model import SchedulerTensors, compat_matrix, make_tensors, row_choose_key
 from ..models.scheduler_model_grouped import (
     ItemTensors,
     _pack_body,
@@ -40,35 +58,186 @@ from ..models.scheduler_model_grouped import (
     greedy_pack_grouped,
     make_item_tensors,
 )
-from ..ops.bitset import test_bit
 
 
-def make_mesh(devices=None, axis: str = "slots") -> Mesh:
+def _shard_map(body, mesh: Mesh, in_specs, out_specs):
+    """jax-version shim: newer jax exposes `jax.shard_map` (strictness flag
+    `check_vma`), older releases only `jax.experimental.shard_map.shard_map`
+    (flag `check_rep`). Replica/varying-manual-axes checking is off either
+    way: the pack body mixes per-device and replicated carries by design."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
+def make_mesh(devices=None, axis: str = "batch") -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.array(devices), (axis,))
 
 
-@functools.lru_cache(maxsize=64)
-def _sharded_pack_fn(mesh: Mesh, dom_keys: tuple, n_slots: int):
-    """The jitted shard_map'd pack kernel, cached so steady-state meshed
-    solves reuse one trace/compile per (mesh, statics) the way the
-    single-device @jax.jit kernel does (jit caches key on wrapper identity);
-    n_existing is a traced scalar, so fleet-size drift reuses the compile."""
+def default_mesh() -> Mesh | None:
+    """The production-default mesh: every visible device, engaged whenever
+    more than one exists. ``KARPENTER_SOLVER_MESH=0`` (or off/false/none)
+    forces the unsharded path; a 1-device mesh degenerates to None (the
+    caller then runs the plain single-device kernels)."""
+    v = os.environ.get("KARPENTER_SOLVER_MESH", "auto").strip().lower()
+    if v in ("0", "off", "false", "none", "disable", "disabled"):
+        return None
+    try:
+        devices = jax.devices()
+    except Exception:  # noqa: BLE001 — no backend is a valid headless state
+        return None
+    if len(devices) <= 1:
+        return None
+    return make_mesh(devices)
+
+
+class _JitCacheProbe:
+    """The per-(mesh, statics) meshed-kernel cache AND its recompile-sentinel
+    surface: no single module attribute carries the jit, so this object owns
+    the LRU of built kernels and stands in as the watchable attribute
+    (obs/trace.py JIT_WATCHLIST). `_cache_size()` is MONOTONE: when the LRU
+    evicts a kernel (releasing its compiled executables — the lru must own
+    them, or evicted XLA programs stay pinned), the evicted compile count
+    retires into a running total instead of vanishing from the sum, so the
+    sentinel can never miss a recompile behind an eviction."""
+
+    MAX_TRACKED = 64
+
+    def __init__(self):
+        from collections import OrderedDict
+
+        self._fns: "OrderedDict" = OrderedDict()
+        self._retired = 0
+
+    def get(self, key):
+        fn = self._fns.get(key)
+        if fn is not None:
+            self._fns.move_to_end(key)
+        return fn
+
+    def put(self, key, fn):
+        while len(self._fns) >= self.MAX_TRACKED:
+            _, old = self._fns.popitem(last=False)
+            self._retired += int(old._cache_size())
+        self._fns[key] = fn
+        return fn
+
+    def _cache_size(self) -> int:
+        return self._retired + sum(int(f._cache_size()) for f in self._fns.values())
+
+
+pack_sharded_probe = _JitCacheProbe()
+shard_compat_probe = _JitCacheProbe()
+
+
+def _state_specs(axis: str):
+    """PartitionSpecs for the pack scan's carry, in _pack_body state order:
+    (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host,
+    open_count, (port_any, port_wild, port_spec)) — slot-axis leaves shard,
+    group/domain counts and the open counter are device-invariant."""
+    s = P(axis)
+    return (s, s, s, s, P(), P(None, axis), P(), (s, s, s))
+
+
+def _sharded_pack_state_fn(mesh: Mesh, dom_keys: tuple, n_slots: int):
+    """The jitted shard_map'd pack kernel (state-returning), cached on the
+    probe's LRU so steady-state meshed solves reuse one trace/compile per
+    (mesh, statics) the way the single-device @jax.jit kernel does;
+    n_existing is a traced scalar, so fleet-size drift reuses the compile.
+    Feasibility arrives precomputed (sharded_feasibility) and replicated."""
+    cached = pack_sharded_probe.get((mesh, dom_keys, n_slots))
+    if cached is not None:
+        return cached
     axis = mesh.axis_names[0]
     meta = dict(dom_keys=dom_keys, n_slots=n_slots)
     data = {f.name: P() for f in dataclasses.fields(SchedulerTensors) if f.name not in meta}
     t_specs = dataclasses.replace(SchedulerTensors(**data, **meta), counts_host_init=P(None, axis))
     item_specs = ItemTensors(**{f.name: P() for f in dataclasses.fields(ItemTensors)})
-    body = partial(_pack_body, dom_keys=dom_keys, n_slots=n_slots, axis=axis)
-    return jax.jit(
-        jax.shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(t_specs, item_specs),
-            out_specs=(P(None, axis), P(), P(axis), P(axis), P(axis), P()),
-            check_vma=False,
+
+    def body(t, items, compat_items, choose_key_items):
+        return _pack_body(
+            t,
+            items,
+            dom_keys=dom_keys,
+            n_slots=n_slots,
+            axis=axis,
+            precomputed=(compat_items, choose_key_items),
+            return_state=True,
         )
+
+    return pack_sharded_probe.put(
+        (mesh, dom_keys, n_slots),
+        jax.jit(
+            _shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(t_specs, item_specs, P(), P()),
+                out_specs=(P(None, axis), P(), P(axis), P(axis), P(axis), P(), _state_specs(axis)),
+            )
+        ),
     )
+
+
+def _sharded_feas_fn(mesh: Mesh, dom_keys: tuple):
+    cached = shard_compat_probe.get((mesh, dom_keys))
+    if cached is not None:
+        return cached
+    rep = NamedSharding(mesh, P())
+
+    @partial(jax.jit, out_shardings=(rep, rep))
+    def fn(row_labels, row_taint_class, row_alloc, row_pool_rank, item_mask, item_taint_ok, item_req):
+        compat = compat_matrix(row_labels, row_taint_class, item_mask, item_taint_ok, dom_keys, batch_size=256)
+        choose = row_choose_key(row_alloc, row_pool_rank, item_req)
+        return compat, choose
+
+    return shard_compat_probe.put((mesh, dom_keys), fn)
+
+
+def sharded_feasibility(t: SchedulerTensors, items: ItemTensors, mesh: Mesh):
+    """Item x row compatibility + row-preference keys with the ITEM
+    (signature/batch) axis sharded via NamedSharding(mesh, P("batch")) and
+    the row side replicated; the axis pads up to a mesh multiple when not
+    divisible (pad items carry allow-all masks — their compat rows are
+    discarded). Outputs come back replicated (XLA inserts the one
+    all-gather), ready for the slot-sharded pack scan. Elementwise ops only,
+    so the result is bit-identical to the in-kernel computation."""
+    axis = mesh.axis_names[0]
+    W = items.item_mask.shape[0]
+    pad = (-W) % mesh.size
+    im, it_ok, ir = items.item_mask, items.item_taint_ok, items.item_req
+    if pad:
+        im = jnp.pad(im, ((0, pad), (0, 0), (0, 0)))
+        it_ok = jnp.pad(it_ok, ((0, pad), (0, 0)), constant_values=True)
+        ir = jnp.pad(ir, ((0, pad), (0, 0)))
+    batch = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    im = jax.device_put(im, batch)
+    it_ok = jax.device_put(it_ok, batch)
+    ir = jax.device_put(ir, batch)
+    args = (
+        jax.device_put(t.row_labels, rep),
+        jax.device_put(t.row_taint_class, rep),
+        jax.device_put(t.row_alloc, rep),
+        jax.device_put(t.row_pool_rank, rep),
+    )
+    compat, choose = _sharded_feas_fn(mesh, t.dom_keys)(*args, im, it_ok, ir)
+    if pad:
+        compat, choose = compat[:W], choose[:W]
+    return compat, choose
+
+
+def greedy_pack_grouped_sharded_state(t: SchedulerTensors, items: ItemTensors, mesh: Mesh):
+    """The production meshed pack: batch-sharded feasibility pre-pass, then
+    the slot-sharded scan. `t` must already be padded (pad_slots_for_mesh).
+    Returns (takes [W, N], leftovers [W], slot_basis [N], slot_zoneset
+    [N, Z], slot_rank [N], open_count, final_state) — final_state stays
+    device-resident for delta re-solves."""
+    compat, choose = sharded_feasibility(t, items, mesh)
+    fn = _sharded_pack_state_fn(mesh, t.dom_keys, t.n_slots)
+    return fn(t, items, compat, choose)
 
 
 def greedy_pack_grouped_sharded(t: SchedulerTensors, items: ItemTensors, mesh: Mesh):
@@ -80,8 +249,7 @@ def greedy_pack_grouped_sharded(t: SchedulerTensors, items: ItemTensors, mesh: M
     and never used unless the original axis overflows).
     """
     t = pad_slots_for_mesh(t, mesh)
-    fn = _sharded_pack_fn(mesh, t.dom_keys, t.n_slots)
-    return fn(t, items)
+    return greedy_pack_grouped_sharded_state(t, items, mesh)[:6]
 
 
 def pad_slots_for_mesh(t: SchedulerTensors, mesh: Mesh) -> SchedulerTensors:
@@ -122,12 +290,11 @@ def anneal_sharded(t, key, mesh: Mesh, n_chains: int = 64, n_steps: int = 512):
     per = -(-n_chains // mesh.size)
     keys = jax.random.split(key, per * mesh.size)
     fn = jax.jit(
-        jax.shard_map(
+        _shard_map(
             partial(anneal_chains, n_steps=n_steps),
             mesh=mesh,
             in_specs=(P(), P(axis)),
             out_specs=(P(axis), P(axis)),
-            check_vma=False,
         )
     )
     return fn(t, keys)
@@ -137,6 +304,8 @@ def sharded_compat_matrix(t: SchedulerTensors, mesh: Mesh):
     """Pod x row compatibility, data-parallel over the pods axis (the per-pod
     scan path's pre-pass). Pods shard across devices; row tensors are
     replicated. XLA inserts no collectives in the forward pass (pure map)."""
+    from ..ops.bitset import test_bit
+
     P_, K, W = t.pod_mask.shape
     axis = mesh.axis_names[0]
     pod_sharding = NamedSharding(mesh, P(axis))

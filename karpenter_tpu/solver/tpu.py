@@ -118,7 +118,7 @@ class _TensorFallback(Exception):
 class TPUSolver:
     name = "tpu"
 
-    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None, mesh=None, hybrid: bool = True, recorder=None):
+    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None, mesh="auto", hybrid: bool = True, recorder=None):
         self.fallback = fallback or FFDSolver()
         self.force = force  # raise instead of falling back (tests)
         # solvetrace flight recorder (obs/trace.py): every solve begins a
@@ -137,9 +137,17 @@ class TPUSolver:
         # fallback, kept for benchmarking the cliff this removes)
         self.hybrid = hybrid
         self.registry = registry
-        # multi-chip growth path: a jax.sharding.Mesh shards the pack scan's
-        # slot axis across devices (parallel/sharded.py); bit-identical to
-        # the single-device kernel, so everything downstream is unchanged
+        # multi-device DEFAULT architecture: whenever more than one device is
+        # visible, the pack runs mesh-sharded (parallel/sharded.py —
+        # batch-sharded feasibility + slot-sharded scan under shard_map),
+        # bit-identical to the single-device kernel, so everything downstream
+        # (validate/decode/delta/hybrid) is unchanged. mesh="auto" resolves
+        # through default_mesh() (None on <=1 device or
+        # KARPENTER_SOLVER_MESH=0); pass an explicit Mesh or None to override.
+        if mesh == "auto":
+            from ..parallel.sharded import default_mesh
+
+            mesh = default_mesh()
         self.mesh = mesh
         from .encode import EncodeCache
 
@@ -187,14 +195,24 @@ class TPUSolver:
         """Run the pack and land every host-needed output. The single-device
         path fuses pack + sparsification + all outputs into ONE device->host
         transfer (tunnel round-trips dominate result bandwidth); the meshed
-        path pulls the shard_map outputs directly."""
+        path runs the batch-sharded feasibility pre-pass + the slot-sharded
+        scan and pulls the shard_map outputs in one landing. Both return the
+        scan's final carry (`state`, device-resident — shard-resident under a
+        mesh) plus the tensors the carry is consistent with (`t`, slot-padded
+        to a mesh multiple on the meshed path), so delta re-solves compose
+        with either path."""
         if self.mesh is not None and self.mesh.size > 1:
             from ..models.scheduler_model_grouped import compress_takes
-            from ..parallel.sharded import greedy_pack_grouped_sharded
+            from ..parallel.sharded import greedy_pack_grouped_sharded_state, pad_slots_for_mesh
 
-            takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count = greedy_pack_grouped_sharded(t, items, self.mesh)
-            nz_item, nz_slot, nz_count = compress_takes(takes, n_pods)
-            slot_basis, slot_zoneset, leftovers, open_count = np.asarray(slot_basis), np.asarray(slot_zoneset), np.asarray(leftovers), int(open_count)  # solverlint: ok(host-sync-in-hot-path): the meshed pack's single deliberate device->host landing — everything downstream is host numpy
+            t = pad_slots_for_mesh(t, self.mesh)
+            # the shard_exchange span bounds the meshed dispatch + the one
+            # device->host landing; the cross-shard traffic inside it is the
+            # bounded exchange step (parallel/sharded.py module docstring)
+            with self._trace.span("shard_exchange", n_dev=int(self.mesh.size)):
+                takes, leftovers, slot_basis, slot_zoneset, slot_rank, open_count, state = greedy_pack_grouped_sharded_state(t, items, self.mesh)
+                nz_item, nz_slot, nz_count = compress_takes(takes, n_pods)
+                slot_basis, slot_zoneset, leftovers, open_count = np.asarray(slot_basis), np.asarray(slot_zoneset), np.asarray(leftovers), int(open_count)  # solverlint: ok(host-sync-in-hot-path): the meshed pack's single deliberate device->host landing — everything downstream is host numpy
             return dict(
                 nz_item=nz_item,
                 nz_slot=nz_slot,
@@ -203,12 +221,15 @@ class TPUSolver:
                 slot_zoneset=slot_zoneset,
                 leftovers=leftovers,
                 open_count=open_count,
+                state=state,
+                t=t,
                 n_slots=int(takes.shape[1]),
             )
         from ..models.scheduler_model_grouped import greedy_pack_grouped_compressed
 
         out = greedy_pack_grouped_compressed(t, items, n_pods)
         out["n_slots"] = t.n_slots
+        out["t"] = t
         return out
 
     def _count(self, metric: str, **labels) -> None:
@@ -331,6 +352,9 @@ class TPUSolver:
             if out["open_count"] == out["n_slots"] and int(out["leftovers"].sum()) > 0 and cap < enc.n_existing + enc.n_pods:
                 t = make_tensors(enc, with_pods=False)
                 out = self._pack(t, items, enc.n_pods)
+            # the tensors the pack (and its resident carry) are consistent
+            # with — slot-padded to a mesh multiple on the meshed path
+            t = out.get("t", t)
             assignment = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
             return self._finish(snap, enc, assignment, out["slot_basis"], out["slot_zoneset"], t, out, count=count)
 
@@ -413,7 +437,7 @@ class TPUSolver:
 
         hs = self._hybrid_state
         res = self._resident
-        if hs is None or res is None or base is None or self.mesh is not None:
+        if hs is None or res is None or base is None:
             return None
         if hs["full_enc"] is not base or res["enc"] is not hs["masked_enc"]:
             return None
@@ -579,7 +603,9 @@ class TPUSolver:
             # so the next solve takes the cold path instead of replaying a
             # divergent assignment
             self._resident = None
-        elif self.mesh is None and out.get("state") is not None:
+        elif out.get("state") is not None:
+            # under a mesh the carry's slot-axis leaves stay SHARD-resident;
+            # the delta kernels consume them directly (jit repartitions)
             self._resident = dict(
                 enc=enc,
                 t=t,
@@ -603,7 +629,7 @@ class TPUSolver:
         (e.g. spread skew raised by vacating a min domain): such snapshots
         retry on the full TENSOR pack, never the FFD fallback."""
         res = self._resident
-        if base is None or res is None or self.mesh is not None:
+        if base is None or res is None:
             return None
         if res["enc"] is not base:
             # the carry may be the MASKED pack of a previous hybrid solve
@@ -799,6 +825,13 @@ class TPUSolver:
         mask_cache: dict[tuple, np.ndarray] = dc.setdefault("mask", {})
         req_cache: dict[tuple, Requirements] = dc.setdefault("req", {})
         tmpl_ctx_cache: dict[int, tuple] = dc.setdefault("tmpl", {})
+        # per-decode layer over _template_ctx: the cross-solve entry is
+        # guarded by an availability signature over every offering (flipped
+        # in place between solves), but availability is stable WITHIN one
+        # decode — so the guard scan runs once per template here, not once
+        # per claim (at 1M pods decode produces thousands of claims over a
+        # handful of templates; the per-claim scan was the decode hot spot)
+        tmpl_solve_cache: dict[int, tuple] = {}
         new_claims: list[SchedulingNodeClaim] = []
 
         # slot total request vectors, one bincount per resource axis
@@ -857,7 +890,10 @@ class TPUSolver:
             # reqs); a shared Requirements would couple sibling slots
             claim.requirements = reqs.copy()
 
-            its, alloc_mat, ginfo, ov_groups = self._template_ctx(template, claim.daemon_overhead_groups, enc, tmpl_ctx_cache)
+            ctx = tmpl_solve_cache.get(id(template))
+            if ctx is None:
+                ctx = tmpl_solve_cache[id(template)] = self._template_ctx(template, claim.daemon_overhead_groups, enc, tmpl_ctx_cache)
+            its, alloc_mat, ginfo, ov_groups = ctx
             mask = mask_cache.get(rkey)
             if mask is None:
                 mask = mask_cache[rkey] = _compat_offering_mask(its, reqs)

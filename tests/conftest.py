@@ -17,6 +17,14 @@ os.environ.setdefault("KARPENTER_SOLVER_TYPECHECK", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# the 8 virtual devices above would make EVERY TPUSolver() in the suite
+# engage the production mesh default (parallel/sharded.py default_mesh) —
+# each distinct solve shape would then pay a shard_map compile on top of the
+# single-device one, multiplying the fast tier's wall time for no coverage
+# gain. The unit suite pins the mesh OFF; the mesh default and the sharded
+# path are covered explicitly (tests/test_mesh_default.py, tests/
+# test_sharded.py, `__graft_entry__.dryrun_multichip`, bench's mesh arm).
+os.environ.setdefault("KARPENTER_SOLVER_MESH", "0")
 
 # the image's sitecustomize force-registers the axon TPU platform regardless of
 # JAX_PLATFORMS; override at the config level so tests run hermetically on the

@@ -1,0 +1,252 @@
+"""Columnar cold-encode specs: the signature pass without per-pod bytecode.
+
+The encode's one O(P) pass is now columnar: `pod_signature` takes inlined
+fast paths for the dominant shapes, signatures are stamped ON the Pod object
+across solves (`_SigStamp`, invalidated by resourceVersion), stamped tuples
+are interned so grouping probes hash object ids, and `_columnar_group` does
+the whole grouping pass in C loops (attrgetter maps + np.unique). These
+specs pin the safety net:
+
+- BYTE-IDENTICAL signatures: the fast paths must return exactly what the
+  structure-literal reference (`_pod_signature_reference`) returns, across a
+  zoo of pod shapes;
+- stamp lifecycle: cache hit on unchanged rv, recompute on bump, and NO
+  survival across copy/deepcopy (the host relaxation loop deep-copies then
+  mutates specs in place — a stamp that survived would serve stale
+  signatures);
+- `_columnar_group` parity with the sequential loop (same sig ids in the
+  same first-appearance order), and its gates (PVC pods, unstamped pods);
+- encode + solve parity: KARPENTER_ENCODE_COLUMNAR=0 (the exact-reference
+  legacy arm) produces identical encodes and bit-identical placements.
+"""
+
+import copy
+
+import numpy as np
+
+from helpers import hostname_anti_affinity, make_pod, zone_spread
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.kube.objects import Container
+from karpenter_tpu.solver.encode import (
+    EncodeCache,
+    _columnar_group,
+    _pod_signature_reference,
+    encode,
+    pod_signature,
+    pod_signature_cached,
+)
+from karpenter_tpu.solver.tpu import TPUSolver
+from karpenter_tpu.utils.quantity import Quantity
+from test_solver import make_snapshot
+from test_solvetrace import canon
+
+
+def _zoo():
+    """One pod per encoder-visible spec shape, fast paths and fall-throughs."""
+    sel = {"matchLabels": {"app": "z"}}
+    pods = [
+        make_pod(cpu="500m"),  # the plain deployment-replica majority
+        make_pod(cpu="1", memory="2Gi", labels={"app": "z", "tier": "web"}),
+        make_pod(cpu="1", node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"}),
+        make_pod(cpu="250m", labels={"app": "z"}, tsc=[zone_spread(selector=sel)]),  # affinity-free spread
+        make_pod(cpu="1", labels={"app": "z"}, anti_affinity=[hostname_anti_affinity(sel)]),
+        make_pod(cpu="1", required_affinity=[[{"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": ["test-zone-b"]}]]),
+        make_pod(cpu="1", tolerations=[{"key": "dedicated", "operator": "Equal", "value": "gpu", "effect": "NoSchedule"}]),
+        make_pod(cpu="1", volumes=[{"name": "d", "persistentVolumeClaim": {"claimName": "c1"}}]),
+        make_pod(cpu="1", volumes=[{"name": "e", "ephemeral": {}}]),
+    ]
+    ported = make_pod(cpu="1")
+    ported.spec.containers[0].ports = [{"containerPort": 80, "hostPort": 8080}]
+    pods.append(ported)
+    init = make_pod(cpu="1")
+    init.spec.init_containers = [Container(resources={"requests": {"cpu": Quantity(200)}}, restart_policy="Always")]
+    pods.append(init)
+    ovh = make_pod(cpu="1")
+    ovh.spec.overhead = {"cpu": Quantity(100)}
+    pods.append(ovh)
+    dra = make_pod(cpu="1")
+    dra.spec.resource_claims = [{"name": "gpu", "resourceClaimName": "rc-1"}]
+    pods.append(dra)
+    multi = make_pod(cpu="1")
+    multi.spec.containers.append(Container(resources={"requests": {"memory": Quantity(512), "cpu": Quantity(100)}}))
+    pods.append(multi)
+    return pods
+
+
+class TestSignatureByteParity:
+    def test_fast_paths_match_reference(self):
+        for i, p in enumerate(_zoo()):
+            assert pod_signature(p) == _pod_signature_reference(p), f"zoo[{i}]"
+
+    def test_requirement_class_is_element_zero(self):
+        p = make_pod(cpu="1", node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"})
+        sig = pod_signature(p)
+        assert sig[0] == ((tuple(sorted(p.spec.node_selector.items())), None))
+
+
+class TestStampLifecycle:
+    def test_hit_and_invalidate_on_rv_bump(self):
+        p = make_pod(cpu="500m")
+        s1 = pod_signature_cached(p)
+        assert p._sig_stamp is not None and p._sig_stamp.sig is s1
+        stamp = p._sig_stamp
+        assert pod_signature_cached(p) is s1  # pure hit, same object
+        assert p._sig_stamp is stamp  # untouched
+        # spec change + rv bump (what the Store does on update)
+        p.spec.node_selector = {wk.ZONE_LABEL_KEY: "test-zone-b"}
+        p.metadata.resource_version += 1
+        s2 = pod_signature_cached(p)
+        assert s2 != s1 and p._sig_stamp is not stamp
+
+    def test_stamp_never_survives_deepcopy(self):
+        """preferences.py deep-copies a pod and mutates the COPY's spec in
+        place with no rv bump — a surviving stamp would serve the original's
+        signature for the relaxed pod. (A SHALLOW copy shares the spec object
+        itself, so in-place mutation is equally invisible through original
+        and copy — exactly the old (uid, rv)-keyed cache's semantics.)"""
+        p = make_pod(cpu="500m")
+        pod_signature_cached(p)
+        assert copy.deepcopy(p)._sig_stamp is None
+        dup = copy.deepcopy(p)
+        dup.spec.node_selector = {wk.ZONE_LABEL_KEY: "test-zone-a"}
+        assert pod_signature_cached(dup) != pod_signature_cached(p)
+
+    def test_interning_collapses_replicas(self):
+        a, b = make_pod(cpu="500m", ns="x"), make_pod(cpu="500m", ns="x")
+        assert pod_signature_cached(a) is pod_signature_cached(b)
+
+    def test_deepcopied_pods_group_without_crashing(self):
+        """A deep-copied previously-stamped pod carries `_sig_stamp = None`
+        (the attribute EXISTS and is None — not absent): the grouping pass
+        must take the first-contact path for the whole list, not crash on
+        the None stamp (regression: the rv read ran outside the guard)."""
+        pods = [make_pod(cpu="500m") for _ in range(4)]
+        for p in pods:
+            pod_signature_cached(p)
+        copies = [copy.deepcopy(p) for p in pods]
+        assert all(c._sig_stamp is None for c in copies)
+        grouped, _arts = _columnar_group(pods[:2] + copies)
+        assert grouped is not None
+        sig_of_pod, _, _ = grouped
+        assert sig_of_pod.tolist() == [0] * 6  # replicas, one signature
+
+
+class TestColumnarGroup:
+    def test_matches_sequential_grouping(self):
+        pods = []
+        for i in range(40):
+            if i % 3 == 0:
+                pods.append(make_pod(cpu="500m"))
+            elif i % 3 == 1:
+                pods.append(make_pod(cpu="1", memory="2Gi", labels={"app": "z"}))
+            else:
+                pods.append(make_pod(cpu="250m", labels={"app": "z"}, tsc=[zone_spread(selector={"matchLabels": {"app": "z"}})]))
+        for p in pods:
+            pod_signature_cached(p)
+        grouped, _arts = _columnar_group(pods)
+        assert grouped is not None
+        sig_of_pod, rep_idx, rep_keys = grouped
+        # sequential reference: first-appearance sid allocation
+        ids: dict = {}
+        ref = []
+        for p in pods:
+            k = pod_signature_cached(p)
+            ref.append(ids.setdefault(k, len(ids)))
+        assert sig_of_pod.tolist() == ref
+        assert [pod_signature_cached(pods[i]) for i in rep_idx.tolist()] == rep_keys
+
+    def test_stamps_on_first_contact(self):
+        pods = [make_pod(cpu="500m") for _ in range(5)]
+        assert all(getattr(p, "_sig_stamp", None) is None for p in pods)
+        grouped, _arts = _columnar_group(pods)  # first contact stamps the whole set
+        assert grouped is not None
+        assert all(p._sig_stamp is not None for p in pods)
+
+    def test_pvc_pods_gate_to_sequential_loop(self):
+        pods = [make_pod(cpu="500m"), make_pod(cpu="1", volumes=[{"name": "d", "persistentVolumeClaim": {"claimName": "c"}}])]
+        for p in pods:
+            pod_signature_cached(p)
+        assert _columnar_group(pods)[0] is None  # volume components extend keys
+
+    def test_ephemeral_volume_pods_gate_to_sequential_loop(self):
+        """Generic-ephemeral volumes are claim-backed too (volumes.py
+        has_pvc_volumes matches persistentVolumeClaim OR ephemeral): the
+        columnar gate must route them through the sequential path exactly
+        like PVC pods, or their signatures silently lose the resolved
+        volume component (regression: the gate tested only \"pvc\")."""
+        eph = make_pod(cpu="1", volumes=[{"name": "scratch", "ephemeral": {"volumeClaimTemplate": {"spec": {}}}}])
+        sig = pod_signature_cached(eph)
+        assert eph._sig_stamp.pvc, "stamp must flag ephemeral volumes as claim-backed"
+        assert _columnar_group([make_pod(cpu="500m"), eph])[0] is None
+
+    def test_group_memo_hit_and_rv_invalidation(self):
+        import karpenter_tpu.solver.encode as E
+
+        pods = [make_pod(cpu="500m") for _ in range(8)] + [make_pod(cpu="2")]
+        g1, arts1 = _columnar_group(pods)
+        g2, arts2 = _columnar_group(pods)  # unchanged ids+rvs: memo hit
+        assert g2 is g1 and arts2 is arts1
+        # rv bump on one pod invalidates the memo (content re-grouped)
+        pods[3].metadata.resource_version += 1
+        g3, arts3 = _columnar_group(pods)
+        assert g3 is not g1
+        assert g3[0].tolist() == g1[0].tolist()  # same content, same grouping
+        # different pod list misses too
+        g4, _ = _columnar_group(pods[:5])
+        assert g4 is not g3
+
+    def test_group_memo_arrays_are_frozen(self):
+        import numpy as np
+        import pytest as _pytest
+
+        pods = [make_pod(cpu="500m") for _ in range(4)]
+        grouped, _arts = _columnar_group(pods)
+        sig_of_pod, rep_idx, _ = grouped
+        with _pytest.raises(ValueError):
+            sig_of_pod[0] = 1
+        with _pytest.raises(ValueError):
+            rep_idx[0] = 1
+
+
+class TestEncodeParity:
+    def _snap(self):
+        pods = []
+        for i in range(30):
+            if i % 4 == 0:
+                pods.append(make_pod(cpu="500m", memory="512Mi", name=f"a{i}"))
+            elif i % 4 == 1:
+                pods.append(make_pod(cpu="1", memory="2Gi", name=f"b{i}"))
+            elif i % 4 == 2:
+                pods.append(make_pod(cpu="250m", name=f"c{i}", labels={"app": "w"}, tsc=[zone_spread(selector={"matchLabels": {"app": "w"}})]))
+            else:
+                pods.append(make_pod(cpu="2", name=f"d{i}", node_selector={wk.ZONE_LABEL_KEY: "test-zone-a"}))
+        return make_snapshot(pods)
+
+    def test_encode_fields_identical_vs_legacy(self, monkeypatch):
+        # the SAME snapshot through both arms: pod uids are random per
+        # construction and tiebreak the encode's lexsort, so two separately
+        # built snapshots would differ in pod order for free
+        snap = self._snap()
+        e_col = encode(snap, cache=EncodeCache())
+        monkeypatch.setenv("KARPENTER_ENCODE_COLUMNAR", "0")
+        e_ref = encode(snap, cache=EncodeCache())
+        assert e_col.n_sigs == e_ref.n_sigs
+        assert np.array_equal(e_col.sig_of_pod, e_ref.sig_of_pod)
+        assert np.array_equal(e_col.sig_req, e_ref.sig_req)
+        assert np.array_equal(e_col.sig_mask, e_ref.sig_mask)
+        assert np.array_equal(e_col.sig_dom_allowed, e_ref.sig_dom_allowed)
+        assert [p.metadata.name for p in e_col.pods] == [p.metadata.name for p in e_ref.pods]
+
+    def test_placements_bit_identical_vs_legacy(self, monkeypatch):
+        snap = self._snap()
+        r_col = TPUSolver(force=True).solve(snap)
+        monkeypatch.setenv("KARPENTER_ENCODE_COLUMNAR", "0")
+        r_ref = TPUSolver(force=True).solve(snap)
+        assert canon(r_col) == canon(r_ref)
+
+    def test_uncached_encode_never_stamps(self):
+        """encode(snap) without a cache must not stamp: in-place pod mutation
+        between uncached encodes stays visible, exactly as before."""
+        snap = self._snap()
+        encode(snap)
+        assert all(getattr(p, "_sig_stamp", None) is None for p in snap.pods)

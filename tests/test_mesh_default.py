@@ -1,0 +1,180 @@
+"""Mesh-default specs: the multi-device mesh is the production architecture.
+
+Whenever >1 device is visible, `TPUSolver()` constructs a mesh by default
+(parallel/sharded.py default_mesh) and runs the pack through the batch-sharded
+feasibility pre-pass + the slot-sharded shard_map scan. These specs pin:
+
+- the default's engage/disengage rules (n_devices>1, KARPENTER_SOLVER_MESH=0,
+  explicit mesh=None, 1-device degeneration to the unsharded kernels);
+- BIT-IDENTICAL placements/errors vs the single-device pack across full,
+  delta (add AND removal), hybrid, and hybrid-delta modes — the mesh composes
+  with the EncodeCache delta and hybrid residual paths instead of bypassing
+  them;
+- padding edge cases: pod/item and slot counts not divisible by the device
+  count, and non-power-of-two meshes;
+- the solvetrace surface: the sharded kernels are on the recompile sentinel's
+  watchlist (pack_sharded / shard_feas), warm meshed re-solves record ZERO
+  recompiles, and the meshed pack runs under a `shard_exchange` span.
+
+conftest pins KARPENTER_SOLVER_MESH=0 for the rest of the unit suite (so
+every solver test doesn't pay shard_map compiles); tests here re-enable it
+per-test via monkeypatch.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from helpers import make_pod
+from karpenter_tpu.obs import TraceRecorder
+from karpenter_tpu.obs.trace import sentinel
+from karpenter_tpu.parallel import sharded as sh
+from karpenter_tpu.solver.tpu import TPUSolver
+from test_solver import make_snapshot
+from test_solvetrace import _odd_pod, canon
+
+
+def _mixed_pods(n_small=13, n_big=5):
+    """A pod set whose item count is NOT a multiple of 8 (padding path)."""
+    pods = [make_pod(cpu="500m", memory="512Mi", name=f"p{i}") for i in range(n_small)]
+    pods += [make_pod(cpu="2", memory="3Gi", name=f"big{i}") for i in range(n_big)]
+    return pods
+
+
+class TestDefaultEngagement:
+    def test_engages_on_multi_device(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_MESH", "auto")
+        s = TPUSolver()
+        assert s.mesh is not None and s.mesh.size == len(jax.devices())
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_MESH", "0")
+        assert TPUSolver().mesh is None
+
+    def test_explicit_none_overrides_auto(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_MESH", "auto")
+        assert TPUSolver(mesh=None).mesh is None
+
+    def test_one_device_returns_none(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_MESH", "auto")
+        one = jax.devices()[:1]
+        monkeypatch.setattr(jax, "devices", lambda *a: one)
+        assert sh.default_mesh() is None
+        assert TPUSolver().mesh is None
+
+    def test_one_device_mesh_degenerates_to_unsharded(self):
+        """An explicit 1-device mesh must take the plain single-device path
+        (mesh.size > 1 gate in _pack) and still carry resident delta state."""
+        s = TPUSolver(force=True, mesh=sh.make_mesh(jax.devices()[:1]))
+        snap = make_snapshot(_mixed_pods(5, 0))
+        s.solve(snap)
+        assert s.last_solve_mode == "full"
+        assert s._resident is not None
+        snap.pods.append(make_pod(cpu="500m", memory="512Mi", name="x"))
+        r = s.solve(snap)
+        assert s.last_solve_mode == "delta"
+        assert not r.pod_errors
+
+
+class TestShardedParity:
+    """Bit-identical placements vs the single-device pack, every mode."""
+
+    def test_full_parity(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_MESH", "auto")
+        on = TPUSolver(force=True)
+        off = TPUSolver(force=True, mesh=None)
+        assert on.mesh is not None
+        r_on = on.solve(make_snapshot(_mixed_pods()))
+        r_off = off.solve(make_snapshot(_mixed_pods()))
+        assert on.last_solve_mode == "full" == off.last_solve_mode
+        assert canon(r_on) == canon(r_off)
+
+    def test_delta_parity_add_and_remove(self, monkeypatch):
+        """The EncodeCache delta path must still classify and serve a pod
+        delta under the mesh — the sharded carry feeds the delta kernels."""
+        monkeypatch.setenv("KARPENTER_SOLVER_MESH", "auto")
+        on = TPUSolver(force=True)
+        snap = make_snapshot(_mixed_pods())
+        on.solve(snap)
+        # add
+        snap.pods.append(make_pod(cpu="500m", memory="512Mi", name="extra"))
+        r_on = on.solve(snap)
+        assert on.last_solve_mode == "delta", on.last_solve_mode
+        r_off = TPUSolver(force=True, mesh=None).solve(make_snapshot(list(snap.pods)))
+        assert canon(r_on) == canon(r_off)
+        # remove (re-credit into the shard-resident carry)
+        snap.pods.pop()
+        snap.pods.pop(0)
+        r_on = on.solve(snap)
+        assert on.last_solve_mode == "delta", on.last_solve_mode
+        r_off = TPUSolver(force=True, mesh=None).solve(make_snapshot(list(snap.pods)))
+        assert canon(r_on) == canon(r_off)
+
+    def test_hybrid_and_hybrid_delta_parity(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_MESH", "auto")
+
+        def build():
+            return make_snapshot(_mixed_pods(12, 0) + [_odd_pod()])
+
+        on, off = TPUSolver(), TPUSolver(mesh=None)
+        r_on, r_off = on.solve(build()), off.solve(build())
+        assert on.last_backend == "hybrid" == off.last_backend
+        assert on.last_solve_mode == "hybrid" == off.last_solve_mode
+        assert canon(r_on) == canon(r_off)
+        # hybrid-delta: one more in-window pod against the retained masked
+        # carry, both arms driven through the same snapshot lineage
+        snap_on, snap_off = build(), build()
+        on2, off2 = TPUSolver(), TPUSolver(mesh=None)
+        on2.solve(snap_on)
+        off2.solve(snap_off)
+        for s in (snap_on, snap_off):
+            s.pods.append(make_pod(cpu="500m", memory="512Mi", name="late"))
+        r_on, r_off = on2.solve(snap_on), off2.solve(snap_off)
+        assert on2.last_solve_mode == "hybrid-delta", on2.last_solve_mode
+        assert off2.last_solve_mode == "hybrid-delta"
+        assert canon(r_on) == canon(r_off)
+
+    @pytest.mark.parametrize("n_dev,n_pods", [(3, 7), (5, 9)])
+    def test_padding_edges_non_divisible(self, n_dev, n_pods):
+        """Pod, item, and slot counts not divisible by the device count, on
+        non-power-of-two meshes: the item axis pads in sharded_feasibility,
+        the slot axis in pad_slots_for_mesh — placements stay bit-identical."""
+        mesh = sh.make_mesh(jax.devices()[:n_dev])
+        on = TPUSolver(force=True, mesh=mesh)
+        off = TPUSolver(force=True, mesh=None)
+        r_on = on.solve(make_snapshot(_mixed_pods(n_pods, 2)))
+        r_off = off.solve(make_snapshot(_mixed_pods(n_pods, 2)))
+        assert canon(r_on) == canon(r_off)
+        assert not r_on.pod_errors
+
+
+class TestShardedTraceSurface:
+    def test_watchlist_covers_sharded_kernels(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_MESH", "auto")
+        TPUSolver(force=True).solve(make_snapshot(_mixed_pods(6, 0)))
+        snap = sentinel().snapshot()
+        assert snap.get("pack_sharded", 0) >= 1
+        assert snap.get("shard_feas", 0) >= 1
+
+    def test_warm_mesh_resolve_zero_recompiles(self, monkeypatch):
+        """The steady-state contract under a mesh: an identical warm
+        re-solve reuses every per-(mesh, statics) kernel — the sentinel must
+        record zero recompiles, sharded entries included."""
+        monkeypatch.setenv("KARPENTER_SOLVER_MESH", "auto")
+        rec = TraceRecorder(enabled=True)
+        s = TPUSolver(force=True, recorder=rec)
+        snap = make_snapshot(_mixed_pods(6, 0))
+        s.solve(snap)  # cold: compiles are attributed here
+        s.solve(snap)
+        assert rec.last().recompiles == {}, rec.last().recompiles
+
+    def test_shard_exchange_span_recorded(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_MESH", "auto")
+        rec = TraceRecorder(enabled=True)
+        s = TPUSolver(force=True, recorder=rec)
+        s.solve(make_snapshot(_mixed_pods(6, 0)))
+        tr = rec.last()
+        pack = next(sp for sp in tr.spans if sp.name == "pack")
+        exch = [c for c in pack.children if c.name == "shard_exchange"]
+        assert exch and exch[0].attrs.get("n_dev") == len(jax.devices())
+        assert tr.phase_totals.get("shard_exchange", 0) > 0

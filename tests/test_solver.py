@@ -674,7 +674,8 @@ class TestEncodeCache:
         cache = EncodeCache()
         e1 = encode(snap, cache=cache)
         e2 = encode(snap, cache=cache)  # all signature lookups hit
-        assert len(cache.pod_sig) == 6
+        # signatures are stamped on the Pod objects themselves (cross-solve)
+        assert sum(1 for p in pods if getattr(p, "_sig_stamp", None) is not None) == 6
         import numpy as np
 
         assert np.array_equal(e1.sig_of_pod, e2.sig_of_pod)
@@ -712,10 +713,11 @@ class TestEncodeCache:
         pods = [make_pod(cpu="1") for _ in range(30)]
         solver = TPUSolver(force=True)
         r1 = solver.solve(make_snapshot(pods))
-        n_cached = len(solver.encode_cache.pod_sig)
-        assert n_cached == 30
+        stamps = [getattr(p, "_sig_stamp", None) for p in pods]
+        assert sum(1 for s in stamps if s is not None) == 30
         r2 = solver.solve(make_snapshot(pods))
-        assert len(solver.encode_cache.pod_sig) == 30  # pure hits
+        # pure hits: the stamp objects are untouched (no rebuild)
+        assert [getattr(p, "_sig_stamp", None) for p in pods] == stamps
         assert len(r1.new_node_claims) == len(r2.new_node_claims)
 
 
