@@ -977,10 +977,14 @@ def bench_consolidation(n_nodes: int):
         proposals = propose_subsets(cands, its)
         best = min(best, time.perf_counter() - t0)
 
-    # quality: annealed savings vs the reference's binary-search result on
-    # the SAME fleet (multinodeconsolidation.go:117-191) — both validated
-    # through the exact simulation path
+    # quality: annealed + relaxed-LP savings vs the reference's binary-search
+    # result on the SAME fleet (multinodeconsolidation.go:117-191) — all
+    # validated through the exact simulation path. The ROADMAP acceptance
+    # "LP savings/hr >= the anneal baseline" binds HERE (the dense-compat
+    # anneal only scales to this e2e-built fleet; the 5k LP scenario below
+    # gates wall time).
     from karpenter_tpu.controllers.disruption.methods import MultiNodeConsolidation
+    from karpenter_tpu.solver.consolidation import propose_subsets_lp
 
     ctx = env.disruption.ctx
     ctx.round_candidates = cands
@@ -992,6 +996,15 @@ def bench_consolidation(n_nodes: int):
         if cmd.candidates:
             accepted += 1
             best_anneal = max(best_anneal, _command_savings(cmd))
+    # symmetric with the anneal arm above: ALL proposals validated, best
+    # kept — proposals rank by the RELAXED score, so first-accepted vs
+    # best-of-accepted would compare different quantities across arms
+    best_lp = 0.0
+    lp_proposals = propose_subsets_lp(cands, its)
+    for subset in lp_proposals:
+        cmd = m.compute_consolidation([cands[i] for i in subset])
+        if cmd.candidates:
+            best_lp = max(best_lp, _command_savings(cmd))
     ordered = sorted(cands, key=lambda c: c.disruption_cost)[:100]
     baseline = _command_savings(m._first_n_consolidation_option(ordered))
     extra = {
@@ -999,22 +1012,136 @@ def bench_consolidation(n_nodes: int):
         "n_proposals": len(proposals),
         "proposal_acceptance_rate": round(accepted / len(proposals), 3) if proposals else 0.0,
         "anneal_savings_per_hour": round(best_anneal, 4),
+        "lp_savings_per_hour": round(best_lp, 4),
         "binary_search_savings_per_hour": round(baseline, 4),
         "anneal_vs_binary_search_savings": round(best_anneal / baseline, 3) if baseline > 0 else None,
+        "lp_vs_anneal_savings": round(best_lp / best_anneal, 3) if best_anneal > 0 else None,
+        "lp_savings_gate": "PASS" if best_lp >= best_anneal - 1e-9 else "FAIL",
     }
     return best, extra
 
 
-def _command_savings(cmd) -> float:
-    """Hourly price removed minus the replacement's launch price."""
-    if not cmd.candidates:
-        return 0.0
-    removed = sum(c.price for c in cmd.candidates)
-    if not cmd.replacements:
-        return removed
-    from karpenter_tpu.controllers.disruption.methods import _replacement_price
+def _build_consolidation_fleet(n_nodes: int):
+    """A bench-scale underutilized fleet WITHOUT the O(n^2) e2e build: the
+    NodeClaims are fabricated directly in the provisioner's API shape and
+    materialized through the REAL kwok provider + lifecycle/registration/
+    initialization controllers, and the workload pods are created pre-bound
+    (one 500m pod per node) so the quadratic binder pass never runs. The
+    disruption side — candidate construction, Consolidatable conditions, the
+    consolidation round itself — is the production path, untouched.
+    Mixed shapes (2 sizes x 3 zones) keep the LP's compatibility classes and
+    replacement rows non-trivial."""
+    from helpers import make_nodepool, make_pod
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.nodeclaim import NodeClaim as APINodeClaim
+    from karpenter_tpu.apis.nodeclaim import NodeClaimSpec, NodeClassReference
+    from karpenter_tpu.apis.nodepool import Budget
+    from karpenter_tpu.kube.objects import ObjectMeta
+    from karpenter_tpu.operator import Environment
+    from karpenter_tpu.operator.options import Options
 
-    return removed - _replacement_price(cmd)
+    OD_ONLY = [
+        {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+        {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+        {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_ON_DEMAND]},
+    ]
+    env = Environment(options=Options(solver_backend="tpu"))
+    np_ = make_nodepool(requirements=OD_ONLY)
+    np_.spec.disruption.consolidate_after = "30s"
+    np_.spec.disruption.budgets = [Budget(nodes="100%")]
+    env.store.create(np_)
+    zones = ["test-zone-a", "test-zone-b", "test-zone-c"]
+    sizes = ["s-2x-amd64-linux", "s-4x-amd64-linux"]
+    for i in range(n_nodes):
+        claim = APINodeClaim(
+            metadata=ObjectMeta(
+                name=f"default-pool-synth-{i}",
+                labels={wk.NODEPOOL_LABEL_KEY: "default-pool"},
+                finalizers=[wk.TERMINATION_FINALIZER],
+            ),
+            spec=NodeClaimSpec(
+                requirements=[
+                    {"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": [sizes[i % 2]]},
+                    {"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": [zones[i % 3]]},
+                    {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_ON_DEMAND]},
+                    {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+                    {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+                ],
+                node_class_ref=NodeClassReference(),
+            ),
+        )
+        env.store.create(claim)
+    env.settle(rounds=3)
+    assert env.store.count("Node") == n_nodes, f"synthetic fleet build failed: {env.store.count('Node')}/{n_nodes}"
+    nodes = sorted(env.store.list("Node"), key=lambda nd: nd.metadata.name)
+    for i, node in enumerate(nodes):
+        env.store.create(make_pod(cpu="500m", name=f"f{i}", node_name=node.metadata.name))
+    env.settle(rounds=2)
+    env.clock.step(40)
+    env.nodeclaim_disruption.reconcile()
+    return env
+
+
+def bench_consolidation_lp(n_nodes: int):
+    """The ROADMAP 5k target: ONE full multi-node consolidation DECISION —
+    relaxed-LP repack over the whole fleet, host rounding, and masked
+    sub-encode exact validation until a command is accepted — on a synthetic
+    n-node underutilized fleet, through the production
+    MultiNodeConsolidation._lp_option path. Headline metric:
+    `consolidation_<n>nodes_e2e_seconds` (best of 2 warm rounds; the cold
+    round pays the shape-bucketed jit compiles once), gated < 5s at the
+    canonical 5000-node scale, with zero warm recompiles sentinel-verified."""
+    from karpenter_tpu.controllers.disruption.methods import (
+        MultiNodeConsolidation,
+        _command_savings_per_hour,
+    )
+    from karpenter_tpu.obs.trace import sentinel
+
+    env = _build_consolidation_fleet(n_nodes)
+    cands = env.disruption.get_candidates()
+    assert len(cands) >= n_nodes * 0.9, f"only {len(cands)} candidates"
+    ctx = env.disruption.ctx
+    ctx.round_candidates = cands
+    ctx.node_pool_totals = None
+    m = MultiNodeConsolidation(ctx)
+    deadline = env.clock.now() + 1e9  # wall time is the measurement, not the budget
+    cmd = m._lp_option(cands, deadline)  # cold: jit compiles allowed
+    assert cmd.candidates, "LP found no command on an idle fleet"
+    jit_before = sentinel().snapshot()
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        cmd = m._lp_option(cands, deadline)
+        best = min(best, time.perf_counter() - t0)
+    recompiles = sentinel().delta(jit_before)
+    savings = _command_savings_per_hour(cmd)
+    rec = env.provisioner.solver.recorder
+    trace = next((t for t in reversed(rec.traces()) if t.mode == "consolidate"), None)
+    extra = {
+        "n_candidates": len(cands),
+        "command_size": len(cmd.candidates),
+        "lp_savings_per_hour": round(savings, 4),
+        "warm_recompiles": recompiles,
+        "zero_warm_recompiles": "PASS" if not recompiles else "FAIL",
+        "gate": "PASS" if best < 5.0 or n_nodes < 5000 else "FAIL",
+    }
+    if trace is not None:
+        extra["phase_split"] = {k: round(v, 4) for k, v in trace.phase_totals.items()}
+        extra["sim_masked_probes"] = trace.attribution.get("sim_masked")
+        extra["sim_scratch_probes"] = trace.attribution.get("sim_scratch")
+    if n_nodes >= 5000 and best >= 5.0:
+        print(f"CONSOLIDATION 5K GATE FAILED: {best:.2f}s >= 5s", file=sys.stderr)
+    return best, extra
+
+
+def _command_savings(cmd) -> float:
+    """Hourly price removed minus the replacement's launch price — the ONE
+    savings accounting (methods._command_savings_per_hour), so the bench's
+    LP-vs-anneal-vs-binary columns can never drift from the gauge the
+    production method publishes."""
+    from karpenter_tpu.controllers.disruption.methods import _command_savings_per_hour
+
+    return _command_savings_per_hour(cmd)
 
 
 def main():
@@ -1024,6 +1151,8 @@ def main():
         os.environ.setdefault("BENCH_PODS", "2500")
         os.environ.setdefault("BENCH_TYPES", "25")
         os.environ.setdefault("BENCH_NODES", "12")
+        # the 5k LP consolidation scenario's 1/20-scale smoke variant
+        os.environ.setdefault("BENCH_CONS_LP_NODES", "256")
         os.environ.setdefault("BENCH_FALLBACK_PODS", "500")
         os.environ.setdefault("BENCH_SKIP_XL", "1")
         os.environ.setdefault("BENCH_SKIP_SHARDED", "1")
@@ -1057,6 +1186,7 @@ def main():
         os.environ.setdefault("BENCH_PODS", "5000")
         os.environ.setdefault("BENCH_TYPES", "100")
         os.environ.setdefault("BENCH_NODES", "24")
+        os.environ.setdefault("BENCH_CONS_LP_NODES", "128")
         os.environ.setdefault("BENCH_SKIP_XL", "1")
         os.environ.setdefault("BENCH_SKIP_SHARDED", "1")
         os.environ.setdefault("BENCH_WORST_TARGET", "1e9")
@@ -1090,6 +1220,10 @@ def main():
             vs_baseline=round(pods_per_sec / 100.0, 2),
         )
     cons = _run_scenario("consolidation", bench_consolidation, n_nodes)
+    # the ROADMAP 5k consolidation target: one full LP decision round on a
+    # synthetic fleet (smoke runs the 1/20-scale 256-node variant)
+    n_lp_nodes = int(os.environ.get("BENCH_CONS_LP_NODES", "5000"))
+    cons_lp = _run_scenario("consolidation_lp", bench_consolidation_lp, n_lp_nodes)
     # the same scale with 15% required-pod-affinity pods, still on-device
     aff = _run_scenario("affinity", bench_affinity, n_pods, n_types)
     if aff is not None:
@@ -1224,6 +1358,10 @@ def main():
         extra[f"consolidation_{n_nodes}nodes_e2e_seconds"] = round(cons_secs, 4)
         extra["consolidation_vs_baseline"] = round(5.0 / cons_secs, 2)
         extra.update({f"consolidation_{k}": v for k, v in cons_extra.items()})
+    if cons_lp is not None:
+        lp_secs, lp_extra = cons_lp
+        extra[f"consolidation_{n_lp_nodes}nodes_e2e_seconds"] = round(lp_secs, 4)
+        extra.update({f"consolidation_lp_{k}": v for k, v in lp_extra.items()})
     _emit_result()
 
 

@@ -51,8 +51,9 @@ class Config:
     # label keys that must be statically enumerable at counter/histogram
     # call sites (identity labels like nodepool/node_name are exempt).
     # "fn" (recompile sentinel) and "quantile" (rolling trace stats) are the
-    # solvetrace label keys — obs/trace.py is held to the same bound
-    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile")
+    # solvetrace label keys; "proposer" is the consolidation proposer enum
+    # (lp | anneal | binary-search) — all held to the same bound
+    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer")
     # callees whose return value is enum-bounded by construction
     bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family")
     # wrapper methods whose OWN bodies forward **labels to the registry

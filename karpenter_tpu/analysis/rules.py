@@ -398,13 +398,13 @@ class MetricLabelCardinalityRule(Rule):
     description = "bounded metric labels must carry statically enumerable values"
     _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
 
-    # the seeded violation is a solvetrace-label one: a recompile counter
-    # whose `fn` label interpolates a runtime value — exactly the drift the
-    # sentinel's call sites must never regress into
+    # the seeded violation is a consolidation-label one: a proposals counter
+    # whose `proposer` label carries a runtime value instead of the
+    # {lp | anneal | binary-search} enum — exactly the drift the LP repack's
+    # call sites must never regress into
     SELF_TEST_BAD = (
-        "def record(registry, trace):\n"
-        "    for fn in trace.recompiles:\n"
-        '        registry.counter("karpenter_solver_recompile_total").inc(fn=f"jit {fn}")\n'
+        "def record(registry, proposals, source):\n"
+        '    registry.counter("karpenter_solver_consolidation_proposals_total").inc(len(proposals), proposer=source)\n'
     )
     SELF_TEST_OK = (
         "def record(registry, pod):\n"

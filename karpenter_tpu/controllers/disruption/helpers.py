@@ -7,10 +7,19 @@ from ...utils import pods as pod_utils
 from .types import REASON_DRIFTED, REASON_EMPTY, REASON_UNDERUTILIZED
 
 
-def simulate_scheduling(provisioner, cluster, candidates: list, clock):
+def simulate_scheduling(provisioner, cluster, candidates: list, clock, reuse=None):
     """Clone state minus the candidates, add their reschedulable pods to the
     pending set, and Solve (helpers.go:53-154). The Solver plugin (FFD or TPU)
-    is reused for free — the simulation IS a solve on a modified snapshot."""
+    is reused for free — the simulation IS a solve on a modified snapshot.
+
+    `reuse` (a solver.simulate.ConsolidationSimulator) serves the probe as a
+    masked sub-encode of its round-base encode when the batch sits inside the
+    simulator's correctness envelope — placement-identical, at a fraction of
+    the per-probe host cost — and falls back to this from-scratch path
+    otherwise. The 15s command Validator never passes one: executed commands
+    always re-validate against a from-scratch simulation."""
+    if reuse is not None:
+        return reuse.simulate(candidates)
     candidate_names = {c.name() for c in candidates}
     all_nodes = cluster.nodes_view()
     state_nodes = [

@@ -186,10 +186,13 @@ class _ConsolidationBase:
             return False  # only emptiness may disrupt
         return candidate.node_claim.status.conditions.is_true(COND_CONSOLIDATABLE)
 
-    def compute_consolidation(self, candidates) -> Command:
-        """The consolidation decision (consolidation.go:159-254)."""
+    def compute_consolidation(self, candidates, reuse=None) -> Command:
+        """The consolidation decision (consolidation.go:159-254). `reuse` is
+        the round's ConsolidationSimulator: proposal checks inside its
+        correctness envelope run as masked sub-encode simulations; the 15s
+        Validator never passes one."""
         ctx = self.ctx
-        results = simulate_scheduling(ctx.provisioner, ctx.cluster, candidates, ctx.clock)
+        results = simulate_scheduling(ctx.provisioner, ctx.cluster, candidates, ctx.clock, reuse=reuse)
         if not all_non_pending_scheduled(results, candidates):
             return Command()
         if len(results.new_node_claims) == 0:
@@ -355,12 +358,22 @@ class SingleNodeConsolidation(_ConsolidationBase):
 
 
 class MultiNodeConsolidation(_ConsolidationBase):
-    """Binary search over candidate-batch size; each probe is a full
-    scheduling simulation (multinodeconsolidation.go:52-191)."""
+    """Multi-node consolidation. DEFAULT (tpu backend): the relaxed-LP
+    repack proposes candidate subsets on device over the FULL eligible fleet
+    (`solver/consolidation.propose_subsets_lp`), each exact-validated through
+    the scheduling simulation — served per-round as masked sub-encodes of one
+    base encode (`solver/simulate.ConsolidationSimulator`). Escape hatches:
+    `KARPENTER_CONSOLIDATE_LP=0` restores the reference's binary search over
+    the cost-sorted prefix (multinodeconsolidation.go:52-191),
+    `KARPENTER_CONSOLIDATE_LP=anneal` the r02 annealed subset search; the
+    binary search also remains the in-band fallback whenever the device
+    proposer produces no valid command."""
 
     consolidation_type = "multi"
 
     def compute_commands(self, candidates, budgets) -> list[Command]:
+        import os
+
         eligible = self.sort_candidates([c for c in candidates if self.should_disrupt(c)])
         # budget filter up-front: take at most allowed per pool
         allowed = dict(budgets)
@@ -370,27 +383,35 @@ class MultiNodeConsolidation(_ConsolidationBase):
             if allowed.get(pool, 0) > 0:
                 filtered.append(c)
                 allowed[pool] -= 1
-        filtered = filtered[:MULTI_NODE_CONSOLIDATION_CANDIDATE_CAP]
-        if len(filtered) < 2:
+        # the binary search pays a full simulation per probe, so it windows
+        # over a 100-candidate prefix (multinodeconsolidation.go:35); the LP
+        # proposer's device solve scales past the whole fleet and sees every
+        # budget-eligible candidate
+        filtered_bs = filtered[:MULTI_NODE_CONSOLIDATION_CANDIDATE_CAP]
+        if len(filtered_bs) < 2:
             return []
         # ONE 1-minute budget covers the whole multi-node compute — the
-        # annealed device search and the binary-search fallback share it, so
-        # a slow pool can't starve rounds regardless of backend
+        # device search and the binary-search fallback share it, so a slow
+        # pool can't starve rounds regardless of backend
         deadline = self.ctx.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS
-        # TPU backend: annealed subset search proposes candidate sets; each is
+        # TPU backend: device search proposes candidate sets; each is
         # exact-validated through the same simulation before use (stage 8)
         cmd = Command()
-        if getattr(self.ctx.options, "solver_backend", "ffd") == "tpu":
-            cmd = self._annealed_option(filtered, deadline)
+        lp_mode = os.environ.get("KARPENTER_CONSOLIDATE_LP", "1").strip().lower()
+        if getattr(self.ctx.options, "solver_backend", "ffd") == "tpu" and lp_mode not in ("0", "false", "off"):
+            if lp_mode == "anneal":
+                cmd = self._annealed_option(filtered_bs, deadline)
+            else:
+                cmd = self._lp_option(filtered, deadline)
             if not (cmd.candidates and self._passes_balanced(cmd)):
                 cmd = Command()
         if not cmd.candidates:
             if self.ctx.clock.now() > deadline:
-                # the annealed stage consumed the whole budget (and counted
+                # the device stage consumed the whole budget (and counted
                 # its timeout) — don't start the binary search, and never
                 # hand an empty command to the 15s validator
                 return []
-            cmd = self._first_n_consolidation_option(filtered, deadline)
+            cmd = self._first_n_consolidation_option(filtered_bs, deadline)
             if not (cmd.candidates and self._passes_balanced(cmd)):
                 return []
         # 15s wait + re-simulation before execution
@@ -403,22 +424,92 @@ class MultiNodeConsolidation(_ConsolidationBase):
             return []
         return [cmd]
 
-    def _annealed_option(self, candidates, deadline: float) -> Command:
-        """Device subset search + host exact validation, under the shared
-        1-minute compute budget."""
-        import logging
-
-        from ...solver.consolidation import propose_subsets
-
+    def _candidate_instance_types(self, candidates) -> list:
         pools = {c.node_pool.metadata.name: c.node_pool for c in candidates}
         its = []
         for name in pools:
             its.extend(self.ctx.provisioner.cloud_provider.get_instance_types(pools[name]))
+        return its
+
+    def _lp_option(self, candidates, deadline: float) -> Command:
+        """The relaxed-LP repack proposer + per-proposal exact validation,
+        under the shared 1-minute compute budget. The whole round is flight-
+        recorded as one mode="consolidate" SolveTrace with per-phase spans
+        (encode_candidates / lp_repack / round inside propose_subsets_lp,
+        validate around the exact checks), and every proposal's simulation
+        runs through the round's ConsolidationSimulator (masked sub-encodes
+        where its envelope allows, from-scratch otherwise)."""
+        import logging
+
+        from ... import metrics as m
+        from ...obs.trace import default_recorder
+        from ...solver.consolidation import LP_SOLVE_ITERATIONS, propose_subsets_lp
+        from ...solver.simulate import ConsolidationSimulator
+
+        ctx = self.ctx
+        solver = ctx.provisioner.solver
+        recorder = getattr(solver, "recorder", None) or default_recorder()
+        trace = recorder.begin(n_pods=sum(len(c.reschedulable_pods) for c in candidates))
+        trace.mode = "consolidate"
+        trace.backend = "lp"
+        reuse = ConsolidationSimulator(ctx.provisioner, ctx.cluster, ctx.clock, candidates)
+        try:
+            its = self._candidate_instance_types(candidates)
+            try:
+                proposals = propose_subsets_lp(candidates, its, trace=trace)
+            except (ValueError, TypeError, RuntimeError) as e:
+                logging.getLogger("karpenter.disruption").warning(
+                    "LP consolidation repack failed, falling back: %s", e
+                )
+                return Command()
+            if ctx.metrics is not None and proposals:
+                ctx.metrics.counter(m.SOLVER_CONSOLIDATION_PROPOSALS_TOTAL).inc(len(proposals), proposer="lp")
+                ctx.metrics.counter(m.SOLVER_CONSOLIDATION_LP_ITERATIONS_TOTAL).inc(LP_SOLVE_ITERATIONS)
+            with trace.span("validate", proposals=len(proposals)):
+                for subset in proposals:
+                    if ctx.clock.now() > deadline:
+                        self._count_timeout()
+                        return Command()
+                    chosen = [candidates[i] for i in subset]
+                    cmd = self.compute_consolidation(chosen, reuse=reuse)
+                    accepted = bool(cmd.candidates) and not self._is_pointless_churn(cmd)
+                    if ctx.metrics is not None:
+                        ctx.metrics.counter(m.SOLVER_CONSOLIDATION_VALIDATION_TOTAL).inc(
+                            decision="accept" if accepted else "reject"
+                        )
+                    if accepted:
+                        if ctx.metrics is not None:
+                            ctx.metrics.gauge(m.SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR).set(
+                                _command_savings_per_hour(cmd), proposer="lp"
+                            )
+                        trace.note(accepted_subset=len(subset))
+                        return cmd
+            return Command()
+        finally:
+            trace.note(
+                sim_masked=reuse.masked_probes,
+                sim_scratch=reuse.scratch_probes,
+                sim_why_scratch=reuse.why_scratch,
+            )
+            recorder.commit(trace, registry=ctx.metrics)
+
+    def _annealed_option(self, candidates, deadline: float) -> Command:
+        """The r02 annealed subset search + host exact validation
+        (KARPENTER_CONSOLIDATE_LP=anneal comparison arm), under the shared
+        1-minute compute budget."""
+        import logging
+
+        from ... import metrics as m
+        from ...solver.consolidation import propose_subsets
+
+        its = self._candidate_instance_types(candidates)
         try:
             proposals = propose_subsets(candidates, its)
         except (ValueError, TypeError, RuntimeError) as e:
             logging.getLogger("karpenter.disruption").warning("annealed consolidation search failed, falling back: %s", e)
             return Command()
+        if self.ctx.metrics is not None and proposals:
+            self.ctx.metrics.counter(m.SOLVER_CONSOLIDATION_PROPOSALS_TOTAL).inc(len(proposals), proposer="anneal")
         for subset in proposals:
             if self.ctx.clock.now() > deadline:
                 self._count_timeout()
@@ -428,6 +519,10 @@ class MultiNodeConsolidation(_ConsolidationBase):
             if cmd.candidates:
                 if self._is_pointless_churn(cmd):
                     continue
+                if self.ctx.metrics is not None:
+                    self.ctx.metrics.gauge(m.SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR).set(
+                        _command_savings_per_hour(cmd), proposer="anneal"
+                    )
                 return cmd
         return Command()
 
@@ -444,6 +539,8 @@ class MultiNodeConsolidation(_ConsolidationBase):
         """firstNConsolidationOption (multinodeconsolidation.go:117-191): binary
         search on batch size under a 1-minute budget — on timeout return the
         last valid command found (or nothing)."""
+        from ... import metrics as m
+
         min_n, max_n = 1, len(candidates)
         last_valid = Command()
         if deadline is None:
@@ -453,6 +550,8 @@ class MultiNodeConsolidation(_ConsolidationBase):
                 self._count_timeout()
                 return last_valid
             mid = (min_n + max_n) // 2
+            if self.ctx.metrics is not None:
+                self.ctx.metrics.counter(m.SOLVER_CONSOLIDATION_PROPOSALS_TOTAL).inc(proposer="binary-search")
             cmd = self.compute_consolidation(candidates[: mid + 1])
             if not cmd.candidates:
                 max_n = mid - 1
@@ -462,6 +561,10 @@ class MultiNodeConsolidation(_ConsolidationBase):
                 continue
             last_valid = cmd
             min_n = mid + 1
+        if last_valid.candidates and self.ctx.metrics is not None:
+            self.ctx.metrics.gauge(m.SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR).set(
+                _command_savings_per_hour(last_valid), proposer="binary-search"
+            )
         return last_valid
 
 
@@ -484,6 +587,15 @@ def _filter_by_price(replacement, max_price: float):
         if unsat:
             return []
     return kept
+
+
+def _command_savings_per_hour(command: Command) -> float:
+    """Hourly price removed minus the replacement's cheapest launch price —
+    the `karpenter_solver_consolidation_savings_per_hour` gauge value."""
+    if not command.candidates:
+        return 0.0
+    removed = sum(c.price for c in command.candidates)
+    return removed - (_replacement_price(command) if command.replacements else 0.0)
 
 
 def _replacement_price(command: Command) -> float:

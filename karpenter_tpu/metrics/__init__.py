@@ -74,6 +74,13 @@ SOLVER_FFD_PHASE_SECONDS = "karpenter_solver_ffd_phase_seconds"
 SOLVER_RECOMPILE_TOTAL = "karpenter_solver_recompile_total"
 SOLVER_TRACE_DROPPED_TOTAL = "karpenter_solver_trace_dropped_total"
 SOLVER_SOLVE_QUANTILE_SECONDS = "karpenter_solver_solve_quantile_seconds"
+# tensor-native consolidation (the relaxed-LP repack + masked simulations):
+# proposer is the bounded {lp | anneal | binary-search} enum, decision the
+# exact-validation verdict {accept | reject}
+SOLVER_CONSOLIDATION_PROPOSALS_TOTAL = "karpenter_solver_consolidation_proposals_total"
+SOLVER_CONSOLIDATION_LP_ITERATIONS_TOTAL = "karpenter_solver_consolidation_lp_iterations_total"
+SOLVER_CONSOLIDATION_VALIDATION_TOTAL = "karpenter_solver_consolidation_validation_total"
+SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR = "karpenter_solver_consolidation_savings_per_hour"
 
 
 def make_registry() -> Registry:
@@ -161,6 +168,27 @@ def make_registry() -> Registry:
         SOLVER_SOLVE_QUANTILE_SECONDS,
         "Rolling solve-latency quantiles (p50 | p90 | p99) over the trace ring, per (mode, phase)",
         ("mode", "phase", "quantile"),
+    )
+    r.counter(
+        SOLVER_CONSOLIDATION_PROPOSALS_TOTAL,
+        "Candidate delete-sets proposed per consolidation round, by proposer "
+        "(lp | anneal | binary-search)",
+        ("proposer",),
+    )
+    r.counter(
+        SOLVER_CONSOLIDATION_LP_ITERATIONS_TOTAL,
+        "Projected-gradient iterations spent by the relaxed-LP repack (inits x steps per solve)",
+        (),
+    )
+    r.counter(
+        SOLVER_CONSOLIDATION_VALIDATION_TOTAL,
+        "Exact host validations of device-proposed consolidation subsets, by decision",
+        ("decision",),
+    )
+    r.gauge(
+        SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR,
+        "Hourly price saved by the newest accepted consolidation command, by proposer",
+        ("proposer",),
     )
     return r
 

@@ -1,8 +1,34 @@
-"""TPU-accelerated multi-node consolidation: encode candidates, run the
-annealed subset search on device, exact-validate winners on host.
+"""TPU-accelerated multi-node consolidation: encode candidates, search the
+delete-set on device, exact-validate winners on host.
 
-Plugs into MultiNodeConsolidation as the candidate-subset proposer; the
-reference's binary search stays as the fallback/default path.
+Two device proposers plug into MultiNodeConsolidation:
+
+* `propose_subsets_lp` (DEFAULT) — the relaxed-LP repack
+  (models/consolidation_model.lp_repack): fractional deletion per node +
+  fractional routing of each compatibility class's displaced pod mass onto
+  surviving nodes or replacement rows, solved by jitted projected-gradient
+  ascent, then ROUNDED on host (fractional-deletion thresholds + top-k
+  prefixes) into candidate subsets and re-scored with the discrete relaxed
+  objective. Scales to full 5k-node fleets: encode is O(N) host work over
+  per-(label-set, requirement-class) compatibility groups, the solve is a
+  fixed number of device iterations.
+* `propose_subsets` — the annealed discrete subset search (the r02 proposer),
+  kept as the quality-comparison arm and bench baseline.
+
+THE ROUNDING/VALIDATION CONTRACT: everything device-side is a RELAXATION
+(aggregate slack, class-level compatibility, fractional pods). A proposal is
+only ever a *candidate subset*; each one is re-validated exactly on the host
+through the same scheduling simulation the reference's binary search uses
+(`compute_consolidation` -> `simulate_scheduling`), and the 15s command
+Validator re-simulates from live state before execution. Relaxation can cost
+optimality, never correctness — no command is emitted that exact host
+validation did not accept.
+
+Shape discipline: node and replacement-row axes pad to power-of-two buckets
+(`_bucket`) and the compatibility-class axis to `_bucket_small`, so repeated
+consolidation rounds on a stable fleet hit the same jit signatures —
+`lp_repack`/`score_subsets`/`anneal` all sit on solvetrace's JIT_WATCHLIST
+and warm rounds must record zero recompiles.
 """
 
 from __future__ import annotations
@@ -14,12 +40,9 @@ from ..utils import resources as res
 from .encode import _scale
 
 
-def encode_candidates(candidates, instance_types):
-    """Candidates + replacement catalog -> ConsolidationTensors (numpy)."""
-    import jax.numpy as jnp
-
-    from ..models.consolidation_model import ConsolidationTensors
-
+def _candidate_vectors(candidates, instance_types):
+    """Per-candidate resource vectors + the (label-set, requirement-class)
+    grouping that makes compatibility O(L x Q) instead of O(N^2)."""
     rnames = ["cpu", "memory", "pods", "ephemeral-storage"]
     seen = set(rnames)
     for c in candidates:
@@ -29,7 +52,6 @@ def encode_candidates(candidates, instance_types):
                     seen.add(k)
                     rnames.append(k)  # extended resources (accelerators etc.)
     ridx = {k: i for i, k in enumerate(rnames)}
-    N = len(candidates)
     R = len(rnames)
 
     def vec(rl):
@@ -40,6 +62,7 @@ def encode_candidates(candidates, instance_types):
                 v[i] = _scale(k, q)
         return v
 
+    N = len(candidates)
     node_price = np.array([c.price for c in candidates], dtype=np.float32)
     node_cost = np.array([c.disruption_cost for c in candidates], dtype=np.float32)
     node_slack = np.zeros((N, R), dtype=np.float32)
@@ -51,23 +74,56 @@ def encode_candidates(candidates, instance_types):
         node_used[i] = vec(res.requests_for_pods(c.reschedulable_pods))
         node_npods[i] = len(c.reschedulable_pods)
 
-    # pod-mass compatibility between candidate nodes: node j can host node i's
-    # pods if j's labels satisfy the pods' common requirements (cheap proxy:
-    # same-pool or compatible label sets)
-    reqs_per_node = []
+    # compatibility classes: a node's displaced pod mass is characterized by
+    # the MERGED strict requirements of its reschedulable pods, and merged
+    # requirements are a pure function of the SET of per-pod requirement
+    # contents — so nodes group by that content set, and host labels group by
+    # their item set. One Python `compatible()` check per unique
+    # (label-set, class) pair replaces the old O(N^2) per-node-pair loop.
+    from .encode import pod_signature_cached
+
+    req_by_content: dict = {}  # per-pod requirement content -> Requirements
+    class_key_of_node: list = []
     for c in candidates:
-        merged = Requirements()
+        keys = []
         for p in c.reschedulable_pods:
-            merged.add(*Requirements.from_pod(p, strict=True).values())
-        reqs_per_node.append(merged)
-    compat = np.ones((N, N), dtype=np.float32)
-    for j, cj in enumerate(candidates):
-        labels_j = Requirements.from_labels(cj.state_node.labels())
-        for i in range(N):
-            if i == j:
-                compat[j, i] = 0.0  # a deleted node can't host its own pods
-                continue
-            compat[j, i] = 1.0 if labels_j.compatible(reqs_per_node[i]) is None else 0.0
+            k = pod_signature_cached(p)[0]  # the signature's requirements component
+            if k not in req_by_content:
+                req_by_content[k] = Requirements.from_pod(p, strict=True)
+            keys.append(k)
+        class_key_of_node.append(frozenset(keys))
+    class_ids: dict = {}
+    class_of_node = np.zeros(N, dtype=np.int64)
+    class_reqs: list = []
+    for i, ck in enumerate(class_key_of_node):
+        q = class_ids.get(ck)
+        if q is None:
+            q = len(class_ids)
+            class_ids[ck] = q
+            merged = Requirements()
+            for k in ck:
+                merged.add(*req_by_content[k].values())
+            class_reqs.append(merged)
+        class_of_node[i] = q
+    Q = len(class_reqs)
+
+    label_ids: dict = {}
+    label_of_node = np.zeros(N, dtype=np.int64)
+    label_reqs: list = []
+    for j, c in enumerate(candidates):
+        lbls = c.state_node.labels()
+        lk = frozenset(lbls.items())
+        li = label_ids.get(lk)
+        if li is None:
+            li = len(label_ids)
+            label_ids[lk] = li
+            label_reqs.append(Requirements.from_labels(lbls))
+        label_of_node[j] = li
+    L = len(label_reqs)
+    compat_lq = np.zeros((L, Q), dtype=np.float32)
+    for li in range(L):
+        for q in range(Q):
+            compat_lq[li, q] = 1.0 if label_reqs[li].compatible(class_reqs[q]) is None else 0.0
 
     rows_alloc, rows_price = [], []
     for it in instance_types:
@@ -83,9 +139,54 @@ def encode_candidates(candidates, instance_types):
     if not rows_alloc:
         rows_alloc = [np.zeros(R, dtype=np.float32)]
         rows_price = [np.float32(3.4e38)]
+    rows_alloc_arr = np.stack(rows_alloc)
+    rows_price_arr = np.array(rows_price, dtype=np.float32)
 
-    # pad N and T up to repeatable buckets so anneal() (jitted on shape)
-    # doesn't retrace every time the fleet size changes
+    return dict(
+        node_price=node_price,
+        node_cost=node_cost,
+        node_slack=node_slack,
+        node_used=node_used,
+        node_npods=node_npods,
+        class_of_node=class_of_node,
+        label_of_node=label_of_node,
+        compat_lq=compat_lq,
+        rows_alloc=rows_alloc_arr,
+        rows_price=rows_price_arr,
+        n_classes=Q,
+    )
+
+
+def encode_candidates(candidates, instance_types):
+    """Candidates + replacement catalog -> ConsolidationTensors (numpy), with
+    the dense [N, N] pod-compatibility matrix the ANNEAL arm consumes."""
+    t, _aux = encode_candidates_lp(candidates, instance_types, dense_compat=True)
+    return t
+
+
+def encode_candidates_lp(candidates, instance_types, dense_compat: bool = False):
+    """Like `encode_candidates`, additionally returning the LP's class
+    structures: (tensors, aux) with aux = {onehot [Np, Qp], compat_qn
+    [Qp, Np], compat_nq [Np, Qp], n, n_classes} — class axes padded to
+    `_bucket_small` so the LP jit signature is stable across rounds.
+
+    The dense [N, N] matrix is O(N^2) memory (270MB at a padded 8k fleet) and
+    only the anneal arm reads it; the LP and the discrete subset scorer use
+    the exactly-equivalent factored (label-set x class) form, so by default
+    `pod_compat` is a [1, 1] placeholder."""
+    import jax.numpy as jnp
+
+    from ..models.consolidation_model import ConsolidationTensors
+
+    v = _candidate_vectors(candidates, instance_types)
+    N = len(candidates)
+    node_price, node_cost = v["node_price"], v["node_cost"]
+    node_slack, node_used, node_npods = v["node_slack"], v["node_used"], v["node_npods"]
+
+    rows_alloc_arr, rows_price_arr = v["rows_alloc"], v["rows_price"]
+    # pad N and T up to repeatable buckets so the jitted searches (anneal and
+    # the LP, both shape-specialized) don't retrace every time the fleet
+    # size drifts
     padded_n = _bucket(N)
     if padded_n > N:
         pad = padded_n - N
@@ -94,16 +195,32 @@ def encode_candidates(candidates, instance_types):
         node_slack = np.pad(node_slack, ((0, pad), (0, 0)))
         node_used = np.pad(node_used, ((0, pad), (0, 0)))
         node_npods = np.pad(node_npods, (0, pad))
-        compat = np.pad(compat, ((0, pad), (0, pad)))
-    rows_alloc_arr = np.stack(rows_alloc)
-    rows_price_arr = np.array(rows_price, dtype=np.float32)
     padded_t = _bucket(rows_alloc_arr.shape[0])
     if padded_t > rows_alloc_arr.shape[0]:
         pad = padded_t - rows_alloc_arr.shape[0]
         rows_alloc_arr = np.pad(rows_alloc_arr, ((0, pad), (0, 0)))  # zero alloc: never fits
         rows_price_arr = np.pad(rows_price_arr, (0, pad), constant_values=3.4e38)
 
-    return ConsolidationTensors(
+    if dense_compat:
+        # pod-mass compatibility between candidate nodes, expanded from the
+        # (label-set, class) table: [j host, i deleted]
+        compat = v["compat_lq"][np.ix_(v["label_of_node"], v["class_of_node"])]
+        np.fill_diagonal(compat, 0.0)  # a deleted node can't host its own pods
+        if padded_n > N:
+            compat = np.pad(compat, ((0, padded_n - N), (0, padded_n - N)))
+    else:
+        compat = np.zeros((1, 1), dtype=np.float32)
+
+    Q = v["n_classes"]
+    Qp = _bucket_small(Q)
+    onehot = np.zeros((padded_n, Qp), dtype=np.float32)
+    onehot[np.arange(N), v["class_of_node"]] = 1.0  # pad nodes carry no class
+    compat_nq = np.zeros((padded_n, Qp), dtype=np.float32)
+    compat_nq[:N, :Q] = v["compat_lq"][v["label_of_node"]]
+    # (self-hosting needs no diagonal mask here: routing onto a node being
+    # deleted is gated by its (1 - d_j) slack term inside the LP objective)
+
+    t = ConsolidationTensors(
         node_price=jnp.asarray(node_price),
         node_cost=jnp.asarray(node_cost),
         node_slack=jnp.asarray(node_slack),
@@ -113,6 +230,15 @@ def encode_candidates(candidates, instance_types):
         row_alloc=jnp.asarray(rows_alloc_arr),
         row_price=jnp.asarray(rows_price_arr),
     )
+    compat_nq_j = jnp.asarray(compat_nq)
+    aux = dict(
+        onehot=jnp.asarray(onehot),
+        compat_qn=compat_nq_j.T,
+        compat_nq=compat_nq_j,
+        n=N,
+        n_classes=Q,
+    )
+    return t, aux
 
 
 def _bucket(n: int) -> int:
@@ -123,8 +249,17 @@ def _bucket(n: int) -> int:
     return b
 
 
+def _bucket_small(n: int) -> int:
+    """Class-axis bucket (min 4): Q is usually tiny, don't pad to 16."""
+    b = 4
+    while b < n:
+        b *= 2
+    return b
+
+
 def propose_subsets(candidates, instance_types, seed: int = 0, max_proposals: int = 8) -> list[list[int]]:
-    """Run the device search; return candidate-index subsets, best first."""
+    """Run the annealed device search; return candidate-index subsets, best
+    first (the comparison arm — `propose_subsets_lp` is the default)."""
     import jax
 
     from ..models.consolidation_model import anneal
@@ -156,4 +291,91 @@ def propose_subsets(candidates, instance_types, seed: int = 0, max_proposals: in
     full = tuple(range(n))
     if out and full not in seen:
         out.append(list(full))
+    return out
+
+
+# fractional-deletion cutoffs the host rounds at, per LP init
+_ROUND_THRESHOLDS = (0.9, 0.7, 0.5, 0.3)
+
+# LP solve shape: independent random inits x projected-gradient iterations
+# (the karpenter_solver_consolidation_lp_iterations_total increment per solve)
+LP_INITS = 8
+LP_ITERS = 300
+LP_SOLVE_ITERATIONS = LP_INITS * LP_ITERS
+
+
+def propose_subsets_lp(
+    candidates, instance_types, seed: int = 0, max_proposals: int = 8, trace=None
+) -> list[list[int]]:
+    """The relaxed-LP proposer: encode, solve the continuous repack on
+    device, round fractional deletions into candidate subsets, re-score them
+    with the discrete relaxed objective, and return index subsets best-first.
+
+    Per-phase solvetrace spans (`encode_candidates`, `lp_repack`, `round`)
+    land on `trace` when one is passed (MultiNodeConsolidation records the
+    consolidation round's flight record); `validate` is the caller's span —
+    exact host validation happens per-proposal in compute_consolidation."""
+    import jax
+
+    from ..models.consolidation_model import lp_repack, score_subsets
+    from ..obs.trace import SolveTrace
+
+    if len(candidates) < 2:
+        return []
+    tr = trace if trace is not None else SolveTrace(enabled=False)
+    n = len(candidates)
+    with tr.span("encode_candidates", n_candidates=n):
+        t, aux = encode_candidates_lp(candidates, instance_types)
+    with tr.span("lp_repack"):
+        d, lp_scores = lp_repack(
+            t, aux["onehot"], aux["compat_qn"], jax.random.PRNGKey(seed), n_inits=LP_INITS, n_iters=LP_ITERS
+        )
+        d = np.asarray(d)  # [C, Np] — one device->host landing for the round
+    with tr.span("round"):
+        N = d.shape[1]
+        rows: list[np.ndarray] = []
+        seen: set[tuple] = set()
+
+        def add(mask: np.ndarray) -> None:
+            key = tuple(np.nonzero(mask[:n])[0].tolist())
+            if key and key not in seen:
+                seen.add(key)
+                m = np.zeros(N, dtype=bool)
+                m[list(key)] = True
+                rows.append(m)
+
+        for c in range(d.shape[0]):
+            dc = d[c]
+            dc = np.where(np.arange(N) < n, dc, 0.0)
+            for tau in _ROUND_THRESHOLDS:
+                add(dc > tau)
+            # top-k prefixes along the fractional-deletion order: nested
+            # subsets the thresholds may skip on plateaued solutions
+            order = np.argsort(-dc)
+            for k in {2, max(2, n // 4), max(2, n // 2), n}:
+                m = np.zeros(N, dtype=bool)
+                m[order[:k]] = True
+                add(m)
+        if not rows:
+            return []
+        X = np.stack(rows)
+        scores, feas = score_subsets(t, aux["onehot"], aux["compat_nq"], X)
+        out: list[list[int]] = []
+        emitted: set[tuple] = set()
+        for i in np.argsort(-scores):
+            if scores[i] <= 0 or not feas[i]:
+                continue
+            subset = tuple(np.nonzero(X[i][:n])[0].tolist())
+            if subset in emitted:
+                continue
+            emitted.add(subset)
+            out.append(list(subset))
+            if len(out) >= max_proposals:
+                break
+        # like the annealer: with any profitable signal, also offer the full
+        # set (exact validation may churn-reject the LP's preferred subset)
+        full = tuple(range(n))
+        if out and full not in emitted:
+            out.append(list(full))
+        tr.note(lp_proposals=len(out), lp_rounded=len(rows))
     return out

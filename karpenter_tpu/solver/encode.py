@@ -125,6 +125,8 @@ KIND_ZONE_SPREAD = KIND_DOM_SPREAD
 _Q0 = Quantity(0)
 
 # columnar extraction: dotted attrgetters run the per-pod loop in C
+_SPEC_OF = attrgetter("spec")
+_META_OF = attrgetter("metadata")
 _UID_OF = attrgetter("metadata.uid")
 _CREATED_OF = attrgetter("metadata.creation_timestamp")
 _RV_OF = attrgetter("metadata.resource_version")
@@ -439,10 +441,13 @@ class _SigStamp:
 
     __slots__ = ("rv", "sig", "pvc")
 
-    def __init__(self, rv, sig):
+    def __init__(self, rv, sig, pvc=None):
         self.rv = rv
         self.sig = sig
-        self.pvc = _sig_has_claims(sig[8])
+        # pvc is a pure function of the (interned) signature: batch stamping
+        # computes it once per unique signature and passes it in, so replica
+        # fleets don't re-derive it per pod
+        self.pvc = _sig_has_claims(sig[8]) if pvc is None else pvc
 
     def __copy__(self):
         return None
@@ -491,15 +496,27 @@ class _GroupMemo:
 
 _GROUP_MEMO: _GroupMemo | None = None
 
+# the OUTGOING memo generation, held alive between a memo miss and the next
+# FFD lexsort: while it is referenced, none of its pod ids can recycle, so an
+# id() match against `prev.ids` proves object identity and the already-
+# materialized uid-bytes column (`arts["uid_raw"]`) can be copied instead of
+# re-extracting P Python strings (`_uid_column`). Consumed (and released) by
+# the first lexsort that runs after the miss — retention is one transient
+# generation, not the indefinite pinning the early release in
+# `_columnar_group` exists to avoid.
+_PREV_GROUP_MEMO: _GroupMemo | None = None
+
 
 def clear_encode_globals() -> None:
     """Release the process-global columnar-encode caches: the grouping memo
     (which strongly pins the last cold-encoded snapshot's pods via
-    `pods_ref`), the signature intern table, and the shared row artifacts.
-    Placement-neutral — the next cold encode just repopulates them; for
-    operators that tear a cluster down and keep the process alive."""
-    global _GROUP_MEMO
+    `pods_ref`), the uid-handoff generation, the signature intern table, and
+    the shared row artifacts. Placement-neutral — the next cold encode just
+    repopulates them; for operators that tear a cluster down and keep the
+    process alive."""
+    global _GROUP_MEMO, _PREV_GROUP_MEMO
     _GROUP_MEMO = None
+    _PREV_GROUP_MEMO = None
     _SIG_INTERN.clear()
     _ROW_GLOBAL.clear()
 
@@ -554,12 +571,14 @@ def _batch_stamp(pods: list) -> list:
     has to cover the deployment-replica majority to win)."""
     sigs: list = []
     append = sigs.append
-    sig_by_prekey: dict = {}
-    get = sig_by_prekey.get
-    intern, psig, stamp_cls = _intern_sig, pod_signature, _SigStamp
-    for p in pods:  # solverlint: ok(python-loop-over-pod-axis): THE first-contact pass — one prekey tuple + dict probe + stamp per pod, at most once per cold pod; every later encode reads stamps in C loops (_columnar_group)
-        s = p.spec
-        m = p.metadata
+    entry_by_prekey: dict = {}  # prekey -> (interned sig, has-pvc)
+    get = entry_by_prekey.get
+    intern, psig, stamp_cls, has_claims = _intern_sig, pod_signature, _SigStamp, _sig_has_claims
+    # columnar prefetch: the spec/metadata/containers attribute chains run in
+    # C map loops once, not as per-pod bytecode inside the hot loop below
+    specs = list(map(_SPEC_OF, pods))
+    metas = list(map(_META_OF, pods))
+    for p, s, m in zip(pods, specs, metas):  # solverlint: ok(python-loop-over-pod-axis): THE first-contact pass — one prekey tuple + dict probe + stamp per pod, at most once per cold pod; every later encode reads stamps in C loops (_columnar_group)
         cs = s.containers
         if (
             s.affinity is None
@@ -596,15 +615,18 @@ def _batch_stamp(pods: list) -> list:
                 if tscs
                 else None,
             )
-            sig = get(key)
-            if sig is None:
+            ent = get(key)
+            if ent is None:
                 sig = intern(psig(p))
-                sig_by_prekey[key] = sig
+                ent = (sig, has_claims(sig[8]))
+                entry_by_prekey[key] = ent
+            sig, pvc = ent
         else:
             sig = intern(psig(p))
+            pvc = has_claims(sig[8])
         append(sig)
         try:
-            p._sig_stamp = stamp_cls(m.resource_version, sig)
+            p._sig_stamp = stamp_cls(m.resource_version, sig, pvc)
         except (AttributeError, TypeError):  # frozen/slotted pod doubles
             pass
     return sigs
@@ -626,7 +648,7 @@ def _columnar_group(pods: list):
     which only the sequential path builds), and arts is the `_GroupMemo`
     artifact dict for encode() to cache FFD-order columns in (None when the
     result was not memoizable)."""
-    global _GROUP_MEMO
+    global _GROUP_MEMO, _PREV_GROUP_MEMO
     P = len(pods)
     ids = np.fromiter(map(id, pods), np.int64, count=P)
     try:
@@ -641,10 +663,13 @@ def _columnar_group(pods: list):
         and np.array_equal(memo.rvs, rv_arr)
     ):
         return memo.grouped, memo.arts
-    # miss: release the old memo NOW, not at the rebuild below — `pods_ref`
-    # strongly pins the memoized snapshot's whole pod graph, and the rebuild
-    # path may not write a replacement (rv_arr None), which would otherwise
-    # leave e.g. a shrunk-away 1M-pod snapshot reachable indefinitely
+    # miss: release the old memo from the PRIMARY slot now, not at the
+    # rebuild below — `pods_ref` strongly pins the memoized snapshot's whole
+    # pod graph, and the rebuild path may not write a replacement (rv_arr
+    # None), which would otherwise leave e.g. a shrunk-away 1M-pod snapshot
+    # reachable indefinitely. It moves to the HANDOFF slot instead: the next
+    # FFD lexsort copies uid bytes for every shared pod object, then drops it
+    _PREV_GROUP_MEMO = memo
     _GROUP_MEMO = memo = None
     try:
         stamps = list(map(_STAMP_OF, pods))
@@ -652,28 +677,35 @@ def _columnar_group(pods: list):
         # some pods were never stamped: re-read with a default so only that
         # subset pays the first-contact pass below, not the whole axis
         stamps = [getattr(p, "_sig_stamp", None) for p in pods]
-    try:
-        rv_st = list(map(_ST_RV, stamps))
-    except (AttributeError, TypeError):
-        # missing stamps — first contact, or deep-copied pods whose
-        # _sig_stamp deliberately deepcopies to None — read as the
-        # _RV_MISSING sentinel, i.e. unconditionally stale
-        rv_st = [getattr(st, "rv", _RV_MISSING) for st in stamps]
-    rv_pod = rv_arr.tolist() if rv_arr is not None else list(map(_RV_OF, pods))
-    if rv_st == rv_pod:
-        sigs = list(map(_ST_SIG, stamps))
+    if not any(stamps):
+        # whole-axis first contact (a fresh cluster, or every stamp killed by
+        # deepcopy): batch-stamp directly off its return value — the
+        # stale-subset split, the post-stamp re-read, and the rv re-compare
+        # below would all be full extra passes over an all-stale axis
+        sigs = _batch_stamp(pods)
     else:
-        # churn/first contact: restamp only the missing+stale subset
-        # (comprehension is the sanctioned cheap pass; proportional to it)
-        _batch_stamp([p for a, b, p in zip(rv_st, rv_pod, pods) if a != b])
         try:
-            stamps = list(map(_STAMP_OF, pods))
-            fresh = list(map(_ST_RV, stamps)) == rv_pod
+            rv_st = list(map(_ST_RV, stamps))
         except (AttributeError, TypeError):
-            fresh = False
-        # a pod that cannot HOLD a stamp pays the full first-contact
-        # pass every encode (rare: frozen/slotted pod doubles)
-        sigs = list(map(_ST_SIG, stamps)) if fresh else _batch_stamp(pods)
+            # missing stamps — first contact, or deep-copied pods whose
+            # _sig_stamp deliberately deepcopies to None — read as the
+            # _RV_MISSING sentinel, i.e. unconditionally stale
+            rv_st = [getattr(st, "rv", _RV_MISSING) for st in stamps]
+        rv_pod = rv_arr.tolist() if rv_arr is not None else list(map(_RV_OF, pods))
+        if rv_st == rv_pod:
+            sigs = list(map(_ST_SIG, stamps))
+        else:
+            # churn: restamp only the missing+stale subset (comprehension is
+            # the sanctioned cheap pass; proportional to it)
+            _batch_stamp([p for a, b, p in zip(rv_st, rv_pod, pods) if a != b])
+            try:
+                stamps = list(map(_STAMP_OF, pods))
+                fresh = list(map(_ST_RV, stamps)) == rv_pod
+            except (AttributeError, TypeError):
+                fresh = False
+            # a pod that cannot HOLD a stamp pays the full first-contact
+            # pass every encode (rare: frozen/slotted pod doubles)
+            sigs = list(map(_ST_SIG, stamps)) if fresh else _batch_stamp(pods)
     obj_ids = np.fromiter(map(id, sigs), np.int64, count=P)
     _, first_idx, inverse = np.unique(obj_ids, return_index=True, return_inverse=True)
     # renumber to FIRST-APPEARANCE order — bit-identical to the sequential
@@ -696,6 +728,50 @@ def _columnar_group(pods: list):
         return grouped, None
     _GROUP_MEMO = memo = _GroupMemo(ids, rv_arr, pods, grouped)
     return grouped, memo.arts
+
+
+def _uid_column(pods: list, P: int) -> np.ndarray:
+    """The FFD lexsort's uid tiebreak column for `pods` (raw order),
+    reusing the outgoing `_GroupMemo` generation's already-materialized uid
+    bytes for every pod OBJECT the two snapshots share, so warm-churn cold
+    sorts (the pod multiset changed, the objects mostly didn't — every
+    consolidation-simulation or churn-loop re-encode) skip the per-pod uid
+    string extraction entirely. Holding `_PREV_GROUP_MEMO` alive until here
+    means an `id()` match proves object identity (no recycled ids), so a
+    copied uid is exact; misses materialize individually. Ascii uids sort as
+    memcmp bytes — same order as unicode codepoints (the k8s norm)."""
+    global _PREV_GROUP_MEMO
+    prev = _PREV_GROUP_MEMO
+    _PREV_GROUP_MEMO = None
+    prev_uid = prev.arts.get("uid_raw") if prev is not None else None
+    if prev_uid is not None and prev_uid.dtype.kind == "S" and prev.ids.size:
+        ids = np.fromiter(map(id, pods), np.int64, count=P)
+        order = np.argsort(prev.ids, kind="stable")
+        sorted_prev = prev.ids[order]
+        pos = np.clip(np.searchsorted(sorted_prev, ids), 0, sorted_prev.size - 1)
+        hit = sorted_prev[pos] == ids
+        if hit.all():
+            return prev_uid[order[pos]]
+        n_miss = int((~hit).sum())
+        if n_miss <= P // 2:
+            miss_idx = np.nonzero(~hit)[0]
+            try:
+                miss = np.array([_UID_OF(pods[i]) for i in miss_idx], dtype="S")
+            except UnicodeEncodeError:
+                miss = None
+            if miss is not None:
+                w = max(prev_uid.dtype.itemsize, miss.dtype.itemsize)
+                out = np.zeros(P, dtype=f"S{w}")
+                out[hit] = prev_uid[order[pos[hit]]]
+                out[miss_idx] = miss
+                return out
+    uid_l = list(map(_UID_OF, pods))
+    try:
+        # ascii uids (the k8s norm) sort as memcmp bytes — same order as
+        # unicode codepoints, ~2x faster in the lexsort and 4x smaller
+        return np.array(uid_l, dtype="S")
+    except UnicodeEncodeError:
+        return np.array(uid_l)
 
 
 def _pod_signature_reference(pod) -> tuple:
@@ -1166,6 +1242,77 @@ def mask_encode(enc: EncodedSnapshot, keep_sig_ids) -> EncodedSnapshot:
     _freeze_shared(masked, enc)
     maybe_check_encoded(masked, where="mask_encode")
     return masked
+
+
+# capacity sentinel for consolidation-masked existing rows: hugely negative
+# remaining capacity, so NOTHING fits — not even a zero-request best-effort
+# pod (a plain zero would still admit those). Must stay finite/fp32-safe.
+SIM_ROW_BLOCKED = np.float32(-(2.0**30))
+
+
+def sim_mask_encode(enc: EncodedSnapshot, keep_pod_idx, drop_node_names) -> EncodedSnapshot:
+    """Derive a candidate-batch CONSOLIDATION SIMULATION encode from the
+    round's base encode (state_nodes = every eligible node INCLUDING all
+    candidates; pods = pending + deleting + every candidate's reschedulable
+    pods): a pod-level mask keeps exactly the probe's pod set, and the
+    candidate rows being "deleted" are capacity-blocked (`SIM_ROW_BLOCKED`)
+    instead of dropped, so the whole row side — vocabulary, domains, ports,
+    row artifacts, decode caches — is reused by reference across every probe
+    of the round.
+
+    Placement equivalence to `encode(probe_snapshot)` (from scratch) holds
+    under the `ConsolidationSimulator` guards (no topology groups, no
+    inverse anti-affinity, clean capability report): kept pods form the same
+    multiset in the same relative FFD order (a subsequence sorted by the
+    same keys); surviving rows carry identical remaining capacity, labels,
+    taints, and ports; blocked rows admit nothing (negative remaining
+    rejects even zero-request pods), which is placement-equivalent to the
+    row's absence for a fit-driven pack; and the extra vocabulary/domain
+    entries only dropped pods or blocked rows reference are never matched by
+    kept pods (the `mask_encode` argument). Claim slot indices (and thus the
+    transient `tpu-slot-N` hostnames) can differ — placements, instance-type
+    options, and pod errors cannot. The exact host path stays the authority:
+    any fallback from this encode re-solves the TRUE probe snapshot from
+    scratch."""
+    import dataclasses as _dc
+
+    keep_pod_idx = np.asarray(sorted(int(i) for i in keep_pod_idx), dtype=np.int64)
+    sig_of_pod = np.asarray(enc.sig_of_pod)
+    kept_sigs = np.unique(sig_of_pod[keep_pod_idx]) if keep_pod_idx.size else np.zeros(0, np.int64)
+    masked = mask_encode(enc, kept_sigs)
+
+    # pod-level filter inside the kept signatures: mask_encode keeps ALL
+    # pods of a kept signature; the probe keeps only the evicted + pending
+    # subset. pods/sig_of_pod are fresh (never reference-shared), so the
+    # row-wise filter is safe.
+    keep_ids = {id(enc.pods[i]) for i in keep_pod_idx.tolist()}
+    pod_keep = np.fromiter((id(p) in keep_ids for p in masked.pods), dtype=bool, count=len(masked.pods))
+    pods = [p for p, k in zip(masked.pods, pod_keep) if k]
+
+    # candidate-row capacity block: a COPY of the row side's allocatable
+    # with the dropped nodes' rows driven to SIM_ROW_BLOCKED (the shared
+    # base array is frozen; this copy is probe-private)
+    drop = set(drop_node_names)
+    blocked_rows = [
+        j
+        for j in range(enc.n_existing)
+        if enc.row_meta[j][0] == "existing" and enc.row_meta[j][1].name() in drop
+    ]
+    row_alloc = masked.row_alloc.copy()
+    row_alloc[blocked_rows, :] = SIM_ROW_BLOCKED
+
+    sim = _dc.replace(
+        masked,
+        pods=pods,
+        sig_of_pod=masked.sig_of_pod[pod_keep],
+        row_alloc=row_alloc,
+    )
+    cached = getattr(masked, "_sig_restrict", None)
+    if cached is not None:
+        sim._sig_restrict = cached
+    _freeze_shared(sim, enc)
+    maybe_check_encoded(sim, where="sim-mask-encode")
+    return sim
 
 
 def _freeze_shared(derived: EncodedSnapshot, base: EncodedSnapshot) -> None:
@@ -2317,22 +2464,21 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         order = garts["order"]
         pods = garts["pods_sorted"].copy()  # downstream owns its list
     else:
-        # columnar extraction: attrgetter-driven C loops, no per-pod bytecode
+        # columnar extraction: attrgetter-driven C loops, no per-pod bytecode;
+        # the uid tiebreak column reuses the outgoing group-memo generation's
+        # bytes for shared pod objects (_uid_column) instead of materializing
+        # P strings per cold sort
         created = np.fromiter(map(_CREATED_OF, snap.pods), dtype=np.float64, count=P0)
-        uid_l = list(map(_UID_OF, snap.pods))
-        try:
-            # ascii uids (the k8s norm) sort as memcmp bytes — same order as
-            # unicode codepoints, ~2x faster in the lexsort and 4x smaller
-            uid = np.array(uid_l, dtype="S")
-        except UnicodeEncodeError:
-            uid = np.array(uid_l)
+        uid = _uid_column(snap.pods, P0)
         # last lexsort key is primary
         order = np.lexsort((uid, created, sig_mem[sig_of_pod_raw], sig_cpu[sig_of_pod_raw]))
         pods = list(map(snap.pods.__getitem__, order.tolist()))
         if garts is not None:
             order.setflags(write=False)
+            uid.setflags(write=False)
             garts["order"] = order
             garts["pods_sorted"] = pods.copy()
+            garts["uid_raw"] = uid
     sig_of_pod = sig_of_pod_raw[order]
     P = P0
 

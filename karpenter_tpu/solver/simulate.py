@@ -1,0 +1,215 @@
+"""Consolidation simulation reuse: one base encode per round, one masked
+sub-encode per candidate batch.
+
+Every consolidation probe — the LP proposer's per-proposal exact check, the
+binary search's per-step check — is a full scheduling simulation
+(`controllers/disruption/helpers.simulate_scheduling`): clone state minus
+the candidates, add their reschedulable pods to the pending set, Solve. At
+fleet scale the dominant cost of each probe is the HOST ENCODE (the row side
+re-interns every surviving node because the row cache keys on the exact
+state-node set), paid from scratch per probe even though probes within one
+round differ only in which candidate rows vanish and which evicted pods
+appear.
+
+`ConsolidationSimulator` hoists that cost to once per round: it encodes the
+ROUND-BASE snapshot (every eligible node INCLUDING all candidates as rows;
+pending + deleting + every candidate's reschedulable pods as the solve set),
+then serves each probe as `encode.sim_mask_encode` — a pod-level mask of the
+base's per-signature tensors plus a capacity block on the batch's candidate
+rows — handed to `TPUSolver.solve_prepared`. The same trick that made
+hybrid re-solves ~free in PR 2 (mask the encode, re-pack only the delta),
+applied to the disruption controller's hot loop.
+
+CORRECTNESS ENVELOPE — the masked path engages only when it is placement-
+equivalent to the from-scratch simulation, checked once per round on the
+base encode:
+
+  * clean capability report (no fallback reasons: no flagged families whose
+    host handling could depend on the probe's node set),
+  * zero topology groups (group domain universes and bound-pod counts are
+    probe-dependent: a surviving candidate's bound pods count, a deleted
+    one's don't),
+  * zero inverse anti-affinity entries AND no required anti-affinity on any
+    candidate's reschedulable pods (a pod evicted in one probe is a RUNNING
+    blocker in another),
+  * the provisioner's solver exposes the tensor path (`solve_prepared`).
+
+Anything outside the envelope — and any probe whose masked solve falls off
+the tensor path — takes `simulate_scheduling` from scratch, which remains
+the exact authority (`last_mode` records which path served each probe). The
+15s command Validator ALWAYS re-simulates from live state without a
+simulator, so executed commands never depend on this reuse at all.
+"""
+
+from __future__ import annotations
+
+from ..utils import pods as pod_utils
+
+
+def _pending_and_deleting(provisioner, cluster, exclude_names: set) -> tuple[list, list, list]:
+    """The probe-invariant parts of simulate_scheduling's snapshot: pending
+    pods, pods on OTHER deleting nodes, and the eligible state nodes
+    (every not-deleting node, candidates included)."""
+    all_nodes = cluster.nodes_view()
+    state_nodes = [n for n in all_nodes if not n.marked_for_deletion and not n.deleted()]
+    pending = provisioner.get_pending_pods()
+    deleting_pods = []
+    for n in all_nodes:
+        if (n.marked_for_deletion or n.deleted()) and n.name() not in exclude_names:
+            for key in n.pod_requests:
+                ns, name = key.split("/", 1)
+                pod = provisioner.store.try_get("Pod", name, ns)
+                if pod is not None and pod_utils.is_reschedulable(pod):
+                    deleting_pods.append(pod)
+    return pending, deleting_pods, state_nodes
+
+
+class ConsolidationSimulator:
+    """Per-round masked-sub-encode scheduling simulations (module docstring).
+
+    Build one per consolidation round over the round's candidate set; call
+    `simulate(batch)` for each probe (batch must be a subset of the round's
+    candidates — anything else routes to the from-scratch path)."""
+
+    def __init__(self, provisioner, cluster, clock, candidates):
+        self.provisioner = provisioner
+        self.cluster = cluster
+        self.clock = clock
+        self.candidates = list(candidates)
+        self._names = {c.name() for c in self.candidates}
+        self._base = None  # lazily: dict | False (ineligible)
+        self._why = ""  # why the masked path disengaged (tests/trace)
+        self.last_mode = ""  # "masked" | "scratch" — per-probe attribution
+        self.masked_probes = 0
+        self.scratch_probes = 0
+
+    @property
+    def why_scratch(self) -> str:
+        return self._why
+
+    # -- round-base construction ----------------------------------------------
+    def _ineligible(self, why: str):
+        self._base = False
+        self._why = why
+        return False
+
+    def _build_base(self):
+        if self._base is not None:
+            return self._base
+        solver = self.provisioner.solver
+        if not hasattr(solver, "solve_prepared") or not hasattr(solver, "encode_cache"):
+            return self._ineligible("solver has no tensor path")
+        for c in self.candidates:
+            for p in c.reschedulable_pods:
+                aff = p.spec.affinity
+                if aff is not None and getattr(aff, "pod_anti_affinity_required", None):
+                    # evicted in one probe, a running inverse-anti blocker in
+                    # another — the base encode can't represent both
+                    return self._ineligible("candidate pod carries required anti-affinity")
+        pending, deleting_pods, state_nodes = _pending_and_deleting(
+            self.provisioner, self.cluster, self._names
+        )
+        evicted = [p for c in self.candidates for p in c.reschedulable_pods]
+        base_pods = pending + deleting_pods + evicted
+        if not base_pods:
+            return self._ineligible("no pods to simulate")
+        snap = self.provisioner.make_snapshot(base_pods, state_nodes=state_nodes)
+        snap.enforce_consolidate_after = True
+        snap.reserved_offering_mode = "strict"
+        snap.collect_zone_metrics = False
+        from .encode import EncodeCache, encode
+
+        try:
+            enc = encode(snap, cache=EncodeCache())  # private: never disturbs the live delta slot
+        except (ValueError, TypeError, RuntimeError) as e:
+            return self._ineligible(f"base encode failed: {e}")
+        if enc.fallback_reasons:
+            return self._ineligible(f"base encode flagged: {enc.fallback_reasons[:2]}")
+        if enc.n_groups:
+            return self._ineligible("topology groups present")
+        if enc.sig_host_blocked.any():
+            return self._ineligible("inverse anti-affinity entries present")
+        if enc.n_rows == 0 or enc.n_pods == 0:
+            return self._ineligible("empty base encode")
+        idx_of = {id(p): i for i, p in enumerate(enc.pods)}
+        if len(idx_of) != len(enc.pods):
+            return self._ineligible("duplicate pod objects in base")
+        self._base = dict(
+            snap=snap,
+            enc=enc,
+            idx_of=idx_of,
+            invariant_idx=[idx_of[id(p)] for p in pending + deleting_pods if id(p) in idx_of],
+        )
+        return self._base
+
+    # -- probes ----------------------------------------------------------------
+    def _scratch(self, batch):
+        from ..controllers.disruption.helpers import simulate_scheduling
+
+        self.last_mode = "scratch"
+        self.scratch_probes += 1
+        return simulate_scheduling(self.provisioner, self.cluster, batch, self.clock)
+
+    def simulate(self, batch):
+        base = self._build_base()
+        if not base or any(c.name() not in self._names for c in batch):
+            return self._scratch(batch)
+        enc = base["enc"]
+        idx_of = base["idx_of"]
+        keep = list(base["invariant_idx"])
+        ok = True
+        for c in batch:
+            for p in c.reschedulable_pods:
+                i = idx_of.get(id(p))
+                if i is None:
+                    ok = False
+                    break
+                keep.append(i)
+        if not ok or not keep:
+            return self._scratch(batch)
+        batch_names = {c.name() for c in batch}
+        from .encode import sim_mask_encode
+
+        try:
+            sim_enc = sim_mask_encode(enc, keep, batch_names)
+        except (ValueError, TypeError):  # flagged sig / out-of-range: exact path decides
+            return self._scratch(batch)
+
+        # the TRUE probe snapshot — identical to simulate_scheduling's; any
+        # fallback from the masked solve re-solves THIS from scratch
+        probe_nodes = [sn for sn in base["snap"].state_nodes if sn.name() not in batch_names]
+        probe_snap = base["snap"].with_pods(sim_enc.pods)
+        import dataclasses
+
+        probe_snap = dataclasses.replace(probe_snap, state_nodes=probe_nodes)
+        probe_snap.enforce_consolidate_after = True
+        probe_snap.reserved_offering_mode = "strict"
+        probe_snap.collect_zone_metrics = False
+        probe_snap.deleting_node_names = batch_names
+
+        solver = self.provisioner.solver
+        results = solver.solve_prepared(probe_snap, sim_enc)
+        if solver.last_backend != "tpu":
+            # the masked pack couldn't stand (validation/relaxation): the
+            # result IS the exact from-scratch solve of the true probe
+            # snapshot — correct, just not served from the mask. Apply the
+            # same empty-claim prune every simulate_scheduling exit applies.
+            results.new_node_claims = [nc for nc in results.new_node_claims if nc.pods]
+            self.last_mode = "scratch"
+            self.scratch_probes += 1
+            return results
+        # blocked rows must be pod-free and vanish from the results exactly
+        # like from-scratch's absent rows; a pod landing there means the
+        # block failed — distrust the whole masked solve
+        kept_existing = []
+        for en in results.existing_nodes:
+            if en.state_node.name() in batch_names:
+                if en.pods:
+                    return self._scratch(batch)
+                continue
+            kept_existing.append(en)
+        results.existing_nodes = kept_existing
+        results.new_node_claims = [nc for nc in results.new_node_claims if nc.pods]
+        self.last_mode = "masked"
+        self.masked_probes += 1
+        return results

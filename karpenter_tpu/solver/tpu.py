@@ -280,6 +280,44 @@ class TPUSolver:
             trace.fallback_reasons = list(self.last_fallback_reasons)
             self.recorder.commit(trace, registry=self.registry)
 
+    def solve_prepared(self, snap: SolverSnapshot, enc) -> Results:
+        """One flight-recorded solve over an EXTERNALLY-DERIVED encode — the
+        consolidation simulator's masked sub-encodes (encode.sim_mask_encode).
+        `snap` must be the TRUE probe snapshot (candidate nodes excluded from
+        state_nodes): the tensor path packs against `enc`, but any fallback
+        re-solves `snap` from scratch on the exact host path, so a masked
+        solve can never stand behind a placement the real snapshot wouldn't.
+
+        The EncodeCache is never touched, and the provisioning solver's
+        device-resident delta carry + hybrid state are restored afterward —
+        a consolidation round leaves the live provisioning warm path intact
+        (the old from-scratch simulations used to trash it every round)."""
+        trace = self.recorder.begin(n_pods=len(enc.pods))
+        self._trace = trace
+        self.last_backend = ""
+        self.last_fallback_reasons = []
+        if trace.enabled:
+            trace.jit_before = sentinel().snapshot()
+        resident, hybrid_state = self._resident, self._hybrid_state
+        try:
+            trace.n_sigs = int(getattr(enc, "n_sigs", 0) or 0)
+            trace.note(encode_mode="sim-masked", row_cache=True)
+            self.last_solve_mode = "sim"
+            try:
+                return self._solve_full(snap, enc)
+            except _TensorFallback as e:
+                return self._fall_back(snap, e.reasons, family=e.family)
+        finally:
+            # the sim pack's carry describes the simulation, not the live
+            # snapshot — restore the provisioning solver's warm state
+            self._resident = resident
+            self._hybrid_state = hybrid_state
+            if trace.enabled:
+                trace.recompiles = sentinel().delta(trace.jit_before)
+            trace.backend = self.last_backend
+            trace.fallback_reasons = list(self.last_fallback_reasons)
+            self.recorder.commit(trace, registry=self.registry)
+
     def _solve_inner(self, snap: SolverSnapshot, trace: SolveTrace) -> Results:
         from ..metrics import SOLVER_ENCODE_SECONDS
 

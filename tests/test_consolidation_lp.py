@@ -1,0 +1,305 @@
+"""Tensor-native consolidation parity suite (ISSUE 9).
+
+The relaxed-LP repack (`solver/consolidation.propose_subsets_lp`) and the
+masked sub-encode simulations (`solver/simulate.ConsolidationSimulator`) are
+both RELAXATIONS riding exact hosts: every contract here pins that the fast
+path can only cost optimality, never correctness —
+
+  * every LP-proposed command the method emits passed exact host validation,
+  * LP savings >= annealed savings on randomized fleets (both exact-validated),
+  * masked-simulation placements bit-identical to from-scratch
+    `simulate_scheduling` (incl. randomized batches),
+  * the correctness-envelope guards route topology/anti-affinity fleets to
+    the from-scratch path,
+  * `KARPENTER_CONSOLIDATE_LP=0` restores binary-search behavior exactly,
+  * repeated consolidation rounds record ZERO warm recompiles on the LP
+    kernels (sentinel-verified).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from helpers import make_nodepool, make_pod, hostname_anti_affinity, zone_spread
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import Budget
+from karpenter_tpu.controllers.disruption.helpers import simulate_scheduling
+from karpenter_tpu.controllers.disruption.methods import (
+    MultiNodeConsolidation,
+    _command_savings_per_hour,
+)
+from karpenter_tpu.operator import Environment
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.solver.simulate import ConsolidationSimulator
+
+from test_consolidation_tpu import OD_ONLY, build_fleet
+
+
+def canon_results(r):
+    """Placement canon: existing-node assignments, claims as (pods, types),
+    errors. Claim slot hostnames are transient (`tpu-slot-N`) and the slot
+    numbering legitimately shifts between masked and from-scratch encodes,
+    so they are deliberately NOT part of the canon."""
+    ex = sorted(
+        (en.state_node.name(), sorted(p.key() for p in en.pods))
+        for en in r.existing_nodes
+        if en.pods
+    )
+    claims = sorted(
+        (
+            tuple(sorted(p.key() for p in nc.pods)),
+            tuple(sorted(it.name for it in nc.instance_type_options)),
+        )
+        for nc in r.new_node_claims
+    )
+    return (ex, claims, dict(r.pod_errors))
+
+
+def consolidation_method(env):
+    ctx = env.disruption.ctx
+    ctx.round_candidates = env.disruption.get_candidates()
+    ctx.node_pool_totals = None
+    return MultiNodeConsolidation(ctx), ctx.round_candidates
+
+
+def flip_consolidatable(env):
+    env.clock.step(40)
+    env.nodeclaim_disruption.reconcile()
+
+
+class TestMaskedSimulationParity:
+    @pytest.fixture(scope="class")
+    def fleet(self):
+        env = build_fleet(6, solver_backend="tpu")
+        flip_consolidatable(env)
+        return env
+
+    def test_batches_bit_identical_to_scratch(self, fleet):
+        cands = fleet.disruption.get_candidates()
+        assert len(cands) == 6
+        sim = ConsolidationSimulator(fleet.provisioner, fleet.cluster, fleet.clock, cands)
+        rng = random.Random(7)
+        batches = [cands[:2], cands[:4], cands, [cands[1], cands[3], cands[5]]]
+        batches += [rng.sample(cands, rng.randrange(2, 6)) for _ in range(4)]
+        for batch in batches:
+            masked = sim.simulate(batch)
+            assert sim.last_mode == "masked", sim.why_scratch
+            scratch = simulate_scheduling(fleet.provisioner, fleet.cluster, batch, fleet.clock)
+            assert canon_results(masked) == canon_results(scratch)
+        assert sim.masked_probes == len(batches)
+
+    def test_masked_results_never_reference_deleted_nodes(self, fleet):
+        cands = fleet.disruption.get_candidates()
+        sim = ConsolidationSimulator(fleet.provisioner, fleet.cluster, fleet.clock, cands)
+        batch = cands[:3]
+        names = {c.name() for c in batch}
+        r = sim.simulate(batch)
+        assert sim.last_mode == "masked"
+        assert not any(en.state_node.name() in names for en in r.existing_nodes)
+
+    def test_provisioning_warm_state_survives_a_round(self, fleet):
+        """solve_prepared restores the resident carry + hybrid state: a
+        consolidation round must not trash the live provisioning warm path."""
+        solver = fleet.provisioner.solver
+        before_resident = solver._resident
+        cands = fleet.disruption.get_candidates()
+        sim = ConsolidationSimulator(fleet.provisioner, fleet.cluster, fleet.clock, cands)
+        r = sim.simulate(cands[:3])
+        assert sim.last_mode == "masked"
+        assert solver._resident is before_resident
+
+    def test_encodecache_untouched_by_masked_probes(self, fleet):
+        solver = fleet.provisioner.solver
+        before = (solver.encode_cache.last_enc, solver.encode_cache.row_key)
+        cands = fleet.disruption.get_candidates()
+        sim = ConsolidationSimulator(fleet.provisioner, fleet.cluster, fleet.clock, cands)
+        sim.simulate(cands[:2])
+        assert sim.last_mode == "masked"
+        assert (solver.encode_cache.last_enc, solver.encode_cache.row_key) == before
+
+
+class TestSimulatorGuards:
+    def test_spread_fleet_routes_to_scratch(self):
+        """Topology groups are probe-dependent (bound-pod counts differ per
+        surviving set) — the envelope must refuse and the from-scratch path
+        must serve the probe identically either way."""
+        env = Environment(options=Options(solver_backend="tpu"))
+        np_ = make_nodepool(requirements=OD_ONLY)
+        np_.spec.disruption.consolidate_after = "30s"
+        np_.spec.disruption.budgets = [Budget(nodes="100%")]
+        env.store.create(np_)
+        sel = {"matchLabels": {"app": "x"}}
+        for i in range(4):
+            env.store.create(
+                make_pod(cpu="500m", name=f"s{i}", labels={"app": "x"}, anti_affinity=[hostname_anti_affinity(sel)])
+            )
+        env.settle()
+        for i in range(4):
+            env.store.delete("Pod", f"s{i}")
+        spread_sel = {"matchLabels": {"app": "w"}}
+        for i in range(4):
+            env.store.create(
+                make_pod(cpu="250m", name=f"w{i}", labels={"app": "w"}, tsc=[zone_spread(selector=spread_sel)])
+            )
+        env.settle(rounds=4)
+        flip_consolidatable(env)
+        cands = env.disruption.get_candidates()
+        assert len(cands) >= 2
+        sim = ConsolidationSimulator(env.provisioner, env.cluster, env.clock, cands)
+        r = sim.simulate(cands[:2])
+        assert sim.last_mode == "scratch"
+        assert "topology" in sim.why_scratch
+        scratch = simulate_scheduling(env.provisioner, env.cluster, cands[:2], env.clock)
+        assert canon_results(r) == canon_results(scratch)
+
+    def test_anti_affinity_candidate_pods_route_to_scratch(self):
+        # keep the anti-affinity pods AS the workload (no swap): evicting one
+        # makes it a running inverse-anti blocker of another probe
+        env2 = Environment(options=Options(solver_backend="tpu"))
+        np_ = make_nodepool(requirements=OD_ONLY)
+        np_.spec.disruption.consolidate_after = "30s"
+        np_.spec.disruption.budgets = [Budget(nodes="100%")]
+        env2.store.create(np_)
+        sel = {"matchLabels": {"app": "x"}}
+        for i in range(4):
+            env2.store.create(
+                make_pod(cpu="250m", name=f"s{i}", labels={"app": "x"}, anti_affinity=[hostname_anti_affinity(sel)])
+            )
+        env2.settle()
+        flip_consolidatable(env2)
+        cands = env2.disruption.get_candidates()
+        if len(cands) < 2:
+            pytest.skip("anti-affinity fleet produced too few candidates")
+        sim = ConsolidationSimulator(env2.provisioner, env2.cluster, env2.clock, cands)
+        sim.simulate(cands[:2])
+        assert sim.last_mode == "scratch"
+        assert "anti-affinity" in sim.why_scratch
+
+    def test_ffd_backend_routes_to_scratch(self):
+        env = build_fleet(4, solver_backend="ffd")
+        flip_consolidatable(env)
+        cands = env.disruption.get_candidates()
+        sim = ConsolidationSimulator(env.provisioner, env.cluster, env.clock, cands)
+        r = sim.simulate(cands[:2])
+        assert sim.last_mode == "scratch"
+        assert "tensor path" in sim.why_scratch
+        assert r is not None
+
+
+class TestLPCommands:
+    def test_every_emitted_command_passed_exact_validation(self, monkeypatch):
+        """The method's LP arm only returns a command compute_consolidation
+        accepted — re-run the exact from-scratch simulation on the emitted
+        candidate set and require the same verdict."""
+        env = build_fleet(6, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        deadline = env.clock.now() + 60.0
+        cmd = m._lp_option(cands, deadline)
+        assert cmd.candidates, "LP found no command on an idle fleet"
+        results = simulate_scheduling(env.provisioner, env.cluster, cmd.candidates, env.clock)
+        from karpenter_tpu.controllers.disruption.helpers import all_non_pending_scheduled
+
+        assert all_non_pending_scheduled(results, cmd.candidates)
+        assert len(results.new_node_claims) <= 1
+        assert _command_savings_per_hour(cmd) > 0
+
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_lp_savings_at_least_anneal_randomized(self, seed):
+        """Randomized underutilized fleets: the LP's exact-validated best
+        command must save at least what the annealed search's does."""
+        rng = random.Random(seed)
+        n = rng.randrange(4, 8)
+        env = build_fleet(n, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        deadline = env.clock.now() + 60.0
+        lp_cmd = m._lp_option(cands, deadline)
+        anneal_cmd = m._annealed_option(cands, deadline)
+        lp_savings = _command_savings_per_hour(lp_cmd)
+        anneal_savings = _command_savings_per_hour(anneal_cmd)
+        assert lp_savings >= anneal_savings - 1e-9, (n, lp_savings, anneal_savings)
+        assert lp_savings > 0
+
+    def test_escape_hatch_binary_search_parity(self, monkeypatch):
+        """KARPENTER_CONSOLIDATE_LP=0: the method must run EXACTLY the
+        reference's binary search — no LP, no anneal — and emit its verdict
+        verbatim (on this fleet the prefix binary search legitimately finds
+        nothing where the LP finds a command: the non-monotone validity the
+        relaxation was built to escape)."""
+        env = build_fleet(5, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        # the LP arm DOES find a command on this fleet
+        assert m._lp_option(cands, env.clock.now() + 60.0).candidates
+        eligible = m.sort_candidates([c for c in cands if m.should_disrupt(c)])
+        reference = m._first_n_consolidation_option(list(eligible))
+
+        captured = {}
+        orig = MultiNodeConsolidation._first_n_consolidation_option
+
+        def spy(self, candidates, deadline=None):
+            cmd = orig(self, candidates, deadline)
+            captured["cmd"] = cmd
+            return cmd
+
+        monkeypatch.setattr(MultiNodeConsolidation, "_first_n_consolidation_option", spy)
+        monkeypatch.setattr(MultiNodeConsolidation, "_lp_option", None)  # must not be called
+        monkeypatch.setattr(MultiNodeConsolidation, "_annealed_option", None)
+        monkeypatch.setenv("KARPENTER_CONSOLIDATE_LP", "0")
+        budgets = {env.store.list("NodePool")[0].metadata.name: 100}
+        m2, cands2 = consolidation_method(env)
+        cmds = m2.compute_commands(cands2, budgets)
+        assert "cmd" in captured, "binary search did not run under the escape hatch"
+        assert captured["cmd"].candidate_names() == reference.candidate_names()
+        assert abs(_command_savings_per_hour(captured["cmd"]) - _command_savings_per_hour(reference)) < 1e-9
+        if not reference.candidates:
+            assert cmds == []
+
+    def test_consolidation_metrics_emitted(self):
+        from karpenter_tpu import metrics as mm
+
+        env = build_fleet(4, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        cmd = m._lp_option(cands, env.clock.now() + 60.0)
+        assert cmd.candidates
+        reg = env.disruption.ctx.metrics
+        assert reg.counter(mm.SOLVER_CONSOLIDATION_PROPOSALS_TOTAL).value(proposer="lp") > 0
+        assert reg.counter(mm.SOLVER_CONSOLIDATION_VALIDATION_TOTAL).total() > 0
+        assert reg.counter(mm.SOLVER_CONSOLIDATION_LP_ITERATIONS_TOTAL).total() > 0
+        assert reg.gauge(mm.SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR).value(proposer="lp") > 0
+
+
+class TestZeroWarmRecompiles:
+    def test_repeated_rounds_record_zero_lp_recompiles(self):
+        """Shape bucketing holds across rounds on a stable fleet: the second
+        LP round must not grow any watched jit cache (sentinel-verified) —
+        the churn loop's zero-steady-state-recompiles contract."""
+        from karpenter_tpu.obs.trace import sentinel
+
+        env = build_fleet(5, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        m._lp_option(cands, env.clock.now() + 60.0)  # warm: compiles allowed
+        before = sentinel().snapshot()
+        for _ in range(2):
+            cmd = m._lp_option(cands, env.clock.now() + 60.0)
+            assert cmd.candidates
+        delta = sentinel().delta(before)
+        assert not delta, f"warm consolidation rounds recompiled: {delta}"
+
+    def test_consolidate_trace_records_phases(self):
+        env = build_fleet(4, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        rec = env.provisioner.solver.recorder
+        m._lp_option(cands, env.clock.now() + 60.0)
+        traces = [t for t in rec.traces() if t.mode == "consolidate"]
+        assert traces, "no consolidation flight record"
+        t = traces[-1]
+        assert t.backend == "lp"
+        for phase in ("encode_candidates", "lp_repack", "round", "validate"):
+            assert phase in t.phase_totals, (phase, t.phase_totals)
+        assert t.attribution.get("sim_masked", 0) >= 1

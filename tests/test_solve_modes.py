@@ -7,6 +7,7 @@ the known combinations. One scenario per exit path:
   hybrid       -> ("hybrid", "hybrid")
   hybrid-delta -> ("hybrid-delta", "hybrid")
   fallback     -> ("fallback", "ffd-fallback")
+  sim          -> ("sim", "tpu")   # solve_prepared: consolidation masked sims
 """
 
 import pytest
@@ -27,6 +28,7 @@ VALID_PAIRS = {
     ("hybrid", "hybrid"),
     ("hybrid-delta", "hybrid"),
     ("fallback", "ffd-fallback"),
+    ("sim", "tpu"),
 }
 
 
