@@ -861,6 +861,50 @@ print("RESULT=" + json.dumps(dict(
     raise RuntimeError("mesh e2e subprocess produced no RESULT line")
 
 
+def bench_churn_sustained(n_base: int, iterations: int) -> dict:
+    """The steady-state churn serving loop (karpenter_tpu/serving/): a live
+    Provisioner+TPUSolver under sustained arrivals/cancellations/departures.
+    Reports throughput (pod-events/sec), P50/P99 re-solve latency (solvetrace
+    quantiles), delta-hit rate, the coalesced-trigger count from the
+    concurrent segment, and the steady-phase recompile count — which must be
+    ZERO (cold compiles land in warmup; KARPENTER_SOLVER_BUCKET high-water
+    shape bucketing pins the jitted shapes under churn).
+
+    Default scale is 1/10 of the 50k-events/sec north star (5000-pod base
+    fleet on the 2-core CPU bench box); gates scale with it via
+    BENCH_CHURN_EVENTS_GATE / BENCH_CHURN_P99_GATE."""
+    from karpenter_tpu.models.scheduler_model import reset_bucket_highwater
+    from karpenter_tpu.serving import ChurnHarness, ChurnSpec
+
+    # earlier scenarios (50k/100k solves) leave process-global high-water
+    # marks; the churn loop must establish its OWN shape ladder in warmup
+    reset_bucket_highwater()
+    scale = n_base / 5000.0
+    spec = ChurnSpec(
+        n_base_pods=n_base,
+        n_types=max(25, int(100 * scale)),
+        arrivals=max(60, int(800 * scale)),
+        cancels=max(45, int(600 * scale)),
+        departures=max(60, int(800 * scale)),
+        iterations=iterations,
+    )
+    h = ChurnHarness(spec)
+    try:
+        rep = h.run()
+    finally:
+        h.close()
+    out = rep.as_dict()
+    events_gate = float(os.environ.get("BENCH_CHURN_EVENTS_GATE", "5000"))
+    p99_gate = float(os.environ.get("BENCH_CHURN_P99_GATE", "0.25"))
+    out["throughput_gate"] = "PASS" if rep.events_per_sec >= events_gate else "FAIL"
+    out["p99_gate"] = "PASS" if rep.p99_solve_seconds < p99_gate else "FAIL"
+    out["recompile_gate"] = "PASS" if rep.steady_recompiles == 0 else "FAIL"
+    for name in ("throughput_gate", "p99_gate", "recompile_gate"):
+        if out[name] == "FAIL":
+            print(f"CHURN {name.upper()} FAILED: {out}", file=sys.stderr)
+    return out
+
+
 def bench_trace_overhead(n_pods: int, n_types: int) -> dict:
     """The solvetrace acceptance gate: tracing is ON by default, so its cost
     must be measured and bounded. The SAME warm snapshot solves with the
@@ -1160,6 +1204,11 @@ def main():
         # the smoke mesh proxy is schedule_1M's 1/20-scale variant (50k pods
         # on 8 virtual CPU devices) and pays shard_map compiles — budget it
         os.environ.setdefault("BENCH_MESH_PODS", "50000")
+        # churn_sustained's 1/20-of-north-star smoke variant (2500-pod base
+        # fleet, gates scaled with the fleet)
+        os.environ.setdefault("BENCH_CHURN_PODS", "2500")
+        os.environ.setdefault("BENCH_CHURN_ITER", "8")
+        os.environ.setdefault("BENCH_CHURN_EVENTS_GATE", "2500")
         os.environ.setdefault("BENCH_DEADLINE_SECONDS", "1800")
         _RESULT["extra"]["smoke"] = True
     _install_guards(float(os.environ.get("BENCH_DEADLINE_SECONDS", "3300")))
@@ -1232,6 +1281,21 @@ def main():
     rem = _run_scenario("removal_delta", bench_removal_delta, n_pods, n_types)
     if rem is not None:
         extra.update(rem)
+    # the churn SERVING loop: sustained arrivals/departures against a live
+    # Provisioner+TPUSolver — throughput, P50/P99 re-solve, delta-hit rate,
+    # coalescing, and the zero-steady-state-recompile gate (smoke runs the
+    # 1/20-scale variant)
+    n_churn = int(os.environ.get("BENCH_CHURN_PODS", "5000"))
+    churn_iters = int(os.environ.get("BENCH_CHURN_ITER", "32"))
+    ch = _run_scenario("churn_sustained", bench_churn_sustained, n_churn, churn_iters)
+    if ch is not None:
+        for k in (
+            "events_per_sec", "p50_solve_seconds", "p99_solve_seconds", "delta_hit_rate",
+            "solves", "events", "coalesced_triggers", "steady_recompiles",
+            "throughput_gate", "p99_gate", "recompile_gate", "pods_per_solve_p50",
+        ):
+            extra[f"churn_{k}"] = ch[k]
+        extra["churn_modes"] = ch["modes"]
     # solvetrace on/off overhead at the headline scale (<2% gate; tracing is
     # default-on, so this is the cost every number above already paid)
     tov = _run_scenario("trace_overhead", bench_trace_overhead, n_pods, n_types)

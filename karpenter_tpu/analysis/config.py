@@ -52,8 +52,9 @@ class Config:
     # call sites (identity labels like nodepool/node_name are exempt).
     # "fn" (recompile sentinel) and "quantile" (rolling trace stats) are the
     # solvetrace label keys; "proposer" is the consolidation proposer enum
-    # (lp | anneal | binary-search) — all held to the same bound
-    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer")
+    # (lp | anneal | binary-search); "event" is the churn serving loop's
+    # {arrival | departure} enum — all held to the same bound
+    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer", "event")
     # callees whose return value is enum-bounded by construction
     bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family")
     # wrapper methods whose OWN bodies forward **labels to the registry

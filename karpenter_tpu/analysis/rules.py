@@ -398,13 +398,13 @@ class MetricLabelCardinalityRule(Rule):
     description = "bounded metric labels must carry statically enumerable values"
     _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
 
-    # the seeded violation is a consolidation-label one: a proposals counter
-    # whose `proposer` label carries a runtime value instead of the
-    # {lp | anneal | binary-search} enum — exactly the drift the LP repack's
+    # the seeded violation is a churn-label one: an events counter whose
+    # `event` label carries a runtime value instead of the
+    # {arrival | departure} enum — exactly the drift the serving loop's
     # call sites must never regress into
     SELF_TEST_BAD = (
-        "def record(registry, proposals, source):\n"
-        '    registry.counter("karpenter_solver_consolidation_proposals_total").inc(len(proposals), proposer=source)\n'
+        "def record(registry, batch, kind):\n"
+        '    registry.counter("karpenter_solver_churn_events_total").inc(len(batch), event=kind)\n'
     )
     SELF_TEST_OK = (
         "def record(registry, pod):\n"

@@ -1,10 +1,29 @@
-"""Pod batcher: idle/max windows (reference: provisioning/batcher.go:33-110).
+"""Pod batcher: idle/max windows (reference: provisioning/batcher.go:33-110)
+plus in-flight-aware wake-up coalescing for the steady-state serving loop.
 
 Triggers accumulate; a batch fires after BatchIdleDuration of quiet or
 BatchMaxDuration since the first trigger (defaults 1s/10s, options.go:129-130).
+
+Coalescing (the churn serving loop's throughput lever): triggers that arrive
+WHILE a solve is in flight fold into one pending generation instead of each
+scheduling work — when the solve completes, `ready()` fires immediately
+(the in-flight solve itself WAS the batching window, so the accumulated
+generation drains as ONE batched follow-up solve with no idle-window stall).
+N triggers during a solve therefore cost exactly one follow-up solve, never
+N. The provisioner brackets its solve with `begin_solve()`/`end_solve()`;
+a batcher that never sees those calls behaves exactly like the reference's
+idle/max-window batcher.
+
+Thread-safe: triggers arrive from store watch callbacks on whatever thread
+mutated the store (the serving harness's event driver runs concurrently
+with the solve loop), so the trigger/bracket state is lock-guarded — a
+trigger racing `end_solve`'s read-and-zero must either land in the returned
+coalesced count or in the next generation, never vanish.
 """
 
 from __future__ import annotations
+
+import threading
 
 
 class Batcher:
@@ -12,21 +31,80 @@ class Batcher:
         self.clock = clock
         self.idle = idle_seconds
         self.max = max_seconds
+        self._lock = threading.Lock()
         self._first: float | None = None
         self._last: float | None = None
+        # current generation's trigger count (the solve-queue depth surface)
+        self._count = 0
+        # in-flight coalescing state
+        self._in_flight = False
+        self._during = 0  # triggers folded into the in-flight solve's window
+        self._drain = False  # a coalesced generation is waiting: fire now
 
     def trigger(self, uid: str = "") -> None:
         now = self.clock.now()
-        if self._first is None:
-            self._first = now
-        self._last = now
+        with self._lock:
+            if self._first is None:
+                self._first = now
+            self._last = now
+            self._count += 1
+            if self._in_flight:
+                self._during += 1
+
+    # -- in-flight coalescing (serving loop) -----------------------------------
+    def take_generation(self) -> int:
+        """Atomically close the current generation AND open the in-flight
+        window (reset + begin_solve in one lock hold): returns the closed
+        generation's trigger count. A concurrent trigger either lands in the
+        returned count or in the in-flight window — never in a gap between
+        the two, which would erase it from the coalescing accounting and
+        cost its follow-up solve a full idle-window stall."""
+        with self._lock:
+            n = self._count
+            self._first = None
+            self._last = None
+            self._count = 0
+            self._drain = False
+            self._in_flight = True
+            self._during = 0
+            return n
+
+    def begin_solve(self) -> None:
+        """The provisioner is entering a solve: triggers from here to
+        `end_solve()` coalesce into one pending generation."""
+        with self._lock:
+            self._in_flight = True
+            self._during = 0
+
+    def end_solve(self) -> int:
+        """The solve finished. Returns the number of triggers coalesced into
+        the pending generation and, when nonzero, arms the drain so the next
+        `ready()` fires immediately — one batched follow-up solve."""
+        with self._lock:
+            self._in_flight = False
+            n, self._during = self._during, 0
+            if n:
+                self._drain = True
+            return n
+
+    def pending(self) -> int:
+        """Triggers accumulated in the current (unfired) generation."""
+        with self._lock:
+            return self._count
 
     def ready(self) -> bool:
-        if self._first is None:
-            return False
         now = self.clock.now()
-        return (now - self._last) >= self.idle or (now - self._first) >= self.max
+        with self._lock:
+            if self._first is None:
+                return False
+            if self._drain:
+                # coalesced generation: the just-finished solve was the window
+                return True
+            return (now - self._last) >= self.idle or (now - self._first) >= self.max
 
     def reset(self) -> None:
-        self._first = None
-        self._last = None
+        with self._lock:
+            self._first = None
+            self._last = None
+            self._count = 0
+            self._drain = False

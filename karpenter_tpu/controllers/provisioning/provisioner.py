@@ -40,19 +40,44 @@ class Provisioner:
         self.options = options or ProvisionerOptions()
         self.metrics = metrics
         self.batcher = Batcher(clock, self.options.batch_idle_seconds, self.options.batch_max_seconds)
+        # serving-loop double-buffer (serving/prestage.py): when installed,
+        # get_pending_pods consumes pre-staged pod clones (already validated
+        # and signature-stamped, by the worker that overlapped the previous
+        # solve's device pack) instead of cloning inline; None = the
+        # reference clone-per-pass behavior
+        self.prestager = None
 
     # -- triggering (provisioning/controller.go) -------------------------------
     def trigger(self, uid: str = "") -> None:
         self.batcher.trigger(uid)
 
     def reconcile(self, force: bool = False) -> Results | None:
-        """One pass: fire when the batch window closes and state is synced."""
+        """One pass: fire when the batch window closes and state is synced.
+
+        The solve is bracketed with the batcher's in-flight window so trigger
+        bursts landing DURING it coalesce into exactly one batched follow-up
+        solve (see Batcher); the karpenter_solver_churn_* families record the
+        coalescing behavior per solve."""
         if not force and not self.batcher.ready():
             return None
         if not self.cluster.synced():
             return None
-        self.batcher.reset()
-        return self.provision()
+        # one atomic handoff: close the generation and open the in-flight
+        # window together, so a concurrent trigger can never fall between
+        events = self.batcher.take_generation()
+        try:
+            results = self.provision()
+        finally:
+            coalesced = self.batcher.end_solve()
+            if self.metrics is not None:
+                from ... import metrics as m
+
+                if coalesced:
+                    self.metrics.counter(m.SOLVER_CHURN_COALESCED_TOTAL).inc(coalesced)
+                self.metrics.histogram(m.SOLVER_CHURN_EVENTS_PER_SOLVE).observe(float(events))
+                # depth AFTER the solve: the coalesced generation still queued
+                self.metrics.gauge(m.SOLVER_CHURN_QUEUE_DEPTH).set(self.batcher.pending())
+        return results
 
     # -- the provisioning pass (provisioner.go:350-458) ------------------------
     def provision(self) -> Results:
@@ -90,13 +115,26 @@ class Provisioner:
         from ...kube.clone import fast_deepcopy
 
         vt = VolumeTopology(self.store)
+        prestager = self.prestager
         out = []
         # filter over the borrowed cache view (most pods are bound — cloning
         # the full list per call dominated at reference scale), then clone
-        # only the survivors: callers may mutate them (preference relaxation)
+        # only the survivors: the store may mutate them between solves. With
+        # a prestager installed (serving loop), the clone+validate work for
+        # unchanged pods was already done — typically overlapped with the
+        # PREVIOUS solve's device pack — and the SAME clone object is reused
+        # while (uid, resourceVersion) holds, which is what lets the encoder
+        # classify consecutive serving snapshots as pod deltas
         for pod in self.store.borrow_list("Pod"):
             if not pod_utils.is_provisionable(pod):
                 continue
+            if prestager is not None:
+                clone = prestager.take(pod)
+                if clone is not None:
+                    # staged pods carry no claim-backed volumes, so the PVC
+                    # validation below is a provable no-op for them
+                    out.append(clone)
+                    continue
             verr = vt.validate_persistent_volume_claims(pod)
             if verr is not None:
                 if self.recorder is not None:

@@ -23,26 +23,36 @@ class Binder:
         self.clock = clock
         self.dra_enabled = dra_enabled
         self._dra_allocator = None
+        self._bound_now: dict[str, str] = {}
 
     def bind_all(self) -> int:
-        """One scheduling pass; returns number of pods bound."""
+        """One scheduling pass; returns number of pods bound.
+
+        The pod view is BORROWED (store.borrow_list): under churn, deep-
+        cloning every pod per pass was the binder's dominant cost. Borrowed
+        objects are never mutated; binds made mid-pass are tracked in the
+        `self._bound_now` overlay, and every node-name read below goes
+        through `self._nn` so later candidates in the same pass see them —
+        exactly the visibility the old mutate-the-local-clone scheme gave."""
         bound = 0
         nodes = sorted(self.store.list("Node"), key=lambda n: n.metadata.name)
         node_reqs = {n.metadata.name: Requirements.from_labels(n.metadata.labels) for n in nodes}
-        all_pods = self.store.list("Pod")
+        self._bound_now: dict[str, str] = {}
+        all_pods = self.store.borrow_list("Pod")
         # kube PodGC stand-in: active pods bound to a node that no longer
         # exists reset to pending (modeling controller recreation, like
         # eviction does) so the provisioner sees them again; node-owned
         # (static/mirror) pods die with their node instead — they must never
         # become pending demand
         node_names = {n.metadata.name for n in nodes}
-        kept_pods = []
+        orphaned = False
         for q in all_pods:
             if q.spec.node_name and q.spec.node_name not in node_names and pod_utils.is_active(q):
                 if pod_utils.is_owned_by_node(q):
                     # dies with the node: drop from this pass's view too, or
                     # the stale entry would count into affinity matching
                     self.store.try_delete("Pod", q.metadata.name, namespace=q.metadata.namespace)
+                    orphaned = True
                     continue
 
                 def orphan(p):
@@ -51,16 +61,16 @@ class Binder:
                     p.status.start_time = None
 
                 self.store.patch("Pod", q.metadata.name, orphan, namespace=q.metadata.namespace)
-                q.spec.node_name = ""
-                q.status.phase = "Pending"
-            kept_pods.append(q)
-        all_pods = kept_pods
+                orphaned = True
+        if orphaned:
+            # rare path: re-borrow so the view reflects the deletions/orphans
+            all_pods = self.store.borrow_list("Pod")
         # per-node host-port usage, built once per pass from ACTIVE bound
         # pods (terminal pods free their ports, as in Kubernetes)
         self._port_usage = {}
         for q in all_pods:
-            if q.spec.node_name and pod_utils.is_active(q):
-                self._port_usage.setdefault(q.spec.node_name, HostPortUsage()).add(q.key(), pod_host_ports(q))
+            if self._nn(q) and pod_utils.is_active(q):
+                self._port_usage.setdefault(self._nn(q), HostPortUsage()).add(q.key(), pod_host_ports(q))
         self._dra_allocator = None  # fresh per pass
         self._node_domain = {n.metadata.name: n.metadata.labels for n in nodes}
         # symmetric anti-affinity (the kube-scheduler's InterPodAffinity
@@ -70,7 +80,7 @@ class Binder:
         self._anti_holders = [
             (q, term, self._term_namespaces(q, term, all_pods))
             for q in all_pods
-            if q.spec.node_name and pod_utils.is_active(q) and q.spec.affinity is not None
+            if self._nn(q) and pod_utils.is_active(q) and q.spec.affinity is not None
             for term in q.spec.affinity.pod_anti_affinity_required
         ]
         for pod in all_pods:
@@ -79,13 +89,22 @@ class Binder:
             node = self._find_node(pod, nodes, node_reqs, all_pods)
             if node is not None:
                 self._bind(pod, node)
-                pod.spec.node_name = node.metadata.name  # keep local view current for spread counting
+                # overlay, not mutation: keeps the pass-local view current
+                # for spread/affinity counting without touching the borrowed
+                # stored object
+                self._bound_now[pod.key()] = node.metadata.name
                 self._port_usage.setdefault(node.metadata.name, HostPortUsage()).add(pod.key(), pod_host_ports(pod))
                 if pod.spec.affinity is not None:
                     for term in pod.spec.affinity.pod_anti_affinity_required:
                         self._anti_holders.append((pod, term, self._term_namespaces(pod, term, all_pods)))
                 bound += 1
         return bound
+
+    def _nn(self, q) -> str:
+        """The pod's node name as of NOW in this pass: binds made earlier in
+        the pass (recorded in the overlay) win over the borrowed snapshot."""
+        nn = self._bound_now.get(q.key())
+        return nn if nn is not None else q.spec.node_name
 
     @staticmethod
     def _term_namespaces(pod, term, all_pods) -> set:
@@ -126,13 +145,13 @@ class Binder:
                 key = term.topology_key
                 nss = self._term_namespaces(pod, term, all_pods)
                 for q in all_pods:
-                    if not q.spec.node_name or not pod_utils.is_active(q):
+                    if not self._nn(q) or not pod_utils.is_active(q):
                         continue
                     if q.metadata.namespace not in nss:
                         continue
                     if not match_label_selector(term.label_selector, q.metadata.labels):
                         continue
-                    d = self._node_domain.get(q.spec.node_name, {}).get(key)
+                    d = self._node_domain.get(self._nn(q), {}).get(key)
                     if d is not None:
                         anti_blocked.add((key, d))
             for term in aff.pod_affinity_required:
@@ -141,14 +160,14 @@ class Binder:
                 allowed: set = set()
                 found_any = False
                 for q in all_pods:
-                    if not q.spec.node_name or not pod_utils.is_active(q):
+                    if not self._nn(q) or not pod_utils.is_active(q):
                         continue
                     if q.metadata.namespace not in nss:
                         continue
                     if not match_label_selector(term.label_selector, q.metadata.labels):
                         continue
                     found_any = True
-                    d = self._node_domain.get(q.spec.node_name, {}).get(key)
+                    d = self._node_domain.get(self._nn(q), {}).get(key)
                     if d is not None:
                         allowed.add(d)
                 self_match = pod.metadata.namespace in nss and match_label_selector(
@@ -162,7 +181,7 @@ class Binder:
                 continue
             if not match_label_selector(term.label_selector, pod.metadata.labels):
                 continue
-            d = self._node_domain.get(q.spec.node_name, {}).get(term.topology_key)
+            d = self._node_domain.get(self._nn(q), {}).get(term.topology_key)
             if d is not None:
                 holder_blocked.add((term.topology_key, d))
         return anti_blocked, aff_terms, holder_blocked
@@ -224,13 +243,13 @@ class Binder:
             for q in all_pods:
                 # terminal pods vacate their domain (kube-scheduler semantics;
                 # mirrors the solver's ignored_for_topology)
-                if not q.spec.node_name or not pod_utils.is_active(q):
+                if not self._nn(q) or not pod_utils.is_active(q):
                     continue
                 if q.metadata.namespace != pod.metadata.namespace:
                     continue
                 if not match_label_selector(eff_sel, q.metadata.labels):
                     continue
-                d = node_domain.get(q.spec.node_name, {}).get(tsc.topology_key)
+                d = node_domain.get(self._nn(q), {}).get(tsc.topology_key)
                 if d is not None:
                     counts[d] = counts.get(d, 0) + 1
             my_domain = node.metadata.labels.get(tsc.topology_key)

@@ -108,18 +108,32 @@ class Store:
                         return
                     event, obj = self._pending.pop(0)
                     watchers = list(self._watchers.get(obj.kind, ()))
+                if not watchers:
+                    continue
+                # ONE clone shared by every watcher: watchers may read and
+                # retain it (the stored object is replaced on update, never
+                # mutated, and so is this snapshot) but MUST NOT mutate —
+                # the same contract as borrow_list. Under churn the
+                # per-watcher private clones were the dominant per-event
+                # cost (5 pod watchers -> 5 deep clones per arrival).
+                c = fast_deepcopy(obj)
                 for fn in watchers:
-                    fn(event, fast_deepcopy(obj))
+                    fn(event, c)
 
     # -- CRUD ------------------------------------------------------------------
-    def create(self, obj):
+    def create(self, obj, adopt: bool = False):
+        """`adopt=True`: the caller relinquishes `obj` (must not mutate it
+        after the call) and accepts the borrow contract on the return value —
+        skips both defensive clones. For high-rate producers (the churn
+        harness's event driver) where the per-create clone pair dominates."""
         with self._lock:
             kind_map = self._objects.setdefault(obj.kind, {})
             key = obj_key(obj)
             if key in kind_map:
                 raise AlreadyExists(f"{obj.kind} {key} already exists")
             self._rv += 1
-            obj = fast_deepcopy(obj)
+            if not adopt:
+                obj = fast_deepcopy(obj)
             obj.metadata.resource_version = self._rv
             self._kind_rv[obj.kind] = self._rv
             if not obj.metadata.creation_timestamp:
@@ -127,7 +141,7 @@ class Store:
             kind_map[key] = obj
             self._enqueue("ADDED", obj)
         self._drain()
-        return fast_deepcopy(obj)
+        return obj if adopt else fast_deepcopy(obj)
 
     def get(self, kind: str, name: str, namespace: str = "default"):
         with self._lock:
@@ -174,8 +188,10 @@ class Store:
             key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
             return self._objects.get(kind, {}).get(key)
 
-    def update(self, obj):
-        """Optimistic-concurrency full update; raises Conflict on stale RV."""
+    def update(self, obj, _owned: bool = False):
+        """Optimistic-concurrency full update; raises Conflict on stale RV.
+        `_owned` (internal, patch()): the object is a patch-private clone the
+        caller never sees again — skip the defensive clone-in."""
         with self._lock:
             kind_map = self._objects.setdefault(obj.kind, {})
             key = obj_key(obj)
@@ -187,7 +203,8 @@ class Store:
                     f"{obj.kind} {key}: resourceVersion {obj.metadata.resource_version} != {current.metadata.resource_version}"
                 )
             self._rv += 1
-            obj = fast_deepcopy(obj)
+            if not _owned:
+                obj = fast_deepcopy(obj)
             # deletionTimestamp is set only by delete(); preserve server-side value
             obj.metadata.deletion_timestamp = current.metadata.deletion_timestamp
             obj.metadata.resource_version = self._rv
@@ -211,7 +228,7 @@ class Store:
             obj = self.get(kind, name, namespace)
             fn(obj)
             try:
-                return self.update(obj)
+                return self.update(obj, _owned=True)
             except Conflict:
                 continue
         raise Conflict(f"{kind} {name}: too many conflicts")

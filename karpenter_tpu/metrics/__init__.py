@@ -74,6 +74,12 @@ SOLVER_FFD_PHASE_SECONDS = "karpenter_solver_ffd_phase_seconds"
 SOLVER_RECOMPILE_TOTAL = "karpenter_solver_recompile_total"
 SOLVER_TRACE_DROPPED_TOTAL = "karpenter_solver_trace_dropped_total"
 SOLVER_SOLVE_QUANTILE_SECONDS = "karpenter_solver_solve_quantile_seconds"
+# steady-state churn serving loop (serving/loop.py + the provisioner's
+# coalescing batcher): event is the bounded {arrival | departure} enum
+SOLVER_CHURN_COALESCED_TOTAL = "karpenter_solver_churn_coalesced_triggers_total"
+SOLVER_CHURN_QUEUE_DEPTH = "karpenter_solver_churn_queue_depth"
+SOLVER_CHURN_EVENTS_PER_SOLVE = "karpenter_solver_churn_events_per_solve"
+SOLVER_CHURN_EVENTS_TOTAL = "karpenter_solver_churn_events_total"
 # tensor-native consolidation (the relaxed-LP repack + masked simulations):
 # proposer is the bounded {lp | anneal | binary-search} enum, decision the
 # exact-validation verdict {accept | reject}
@@ -168,6 +174,28 @@ def make_registry() -> Registry:
         SOLVER_SOLVE_QUANTILE_SECONDS,
         "Rolling solve-latency quantiles (p50 | p90 | p99) over the trace ring, per (mode, phase)",
         ("mode", "phase", "quantile"),
+    )
+    r.counter(
+        SOLVER_CHURN_COALESCED_TOTAL,
+        "Provisioner triggers that arrived during an in-flight solve and were "
+        "coalesced into one batched follow-up solve instead of one solve each",
+        (),
+    )
+    r.gauge(
+        SOLVER_CHURN_QUEUE_DEPTH,
+        "Triggers accumulated in the batcher's pending generation after the last solve",
+        (),
+    )
+    r.histogram(
+        SOLVER_CHURN_EVENTS_PER_SOLVE,
+        "Trigger events drained by one provisioning solve (the coalescing ratio)",
+        (),
+        (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
+    )
+    r.counter(
+        SOLVER_CHURN_EVENTS_TOTAL,
+        "Pod churn events applied by the serving loop, by kind (arrival | departure)",
+        ("event",),
     )
     r.counter(
         SOLVER_CONSOLIDATION_PROPOSALS_TOTAL,

@@ -160,6 +160,63 @@ def bucket(n: int, m: int) -> int:
     return -(-max(n, 1) // m) * m
 
 
+# -- high-water bucketing (steady-state churn JIT stability) ------------------
+# Plain bucketing keeps workload DRIFT inside one compiled shape, but a
+# workload that oscillates around a bucket boundary (a churning fleet whose
+# pod/signature/row counts cross a multiple of the bucket every few solves)
+# flip-flops between two compiled shapes and retraces on every crossing. The
+# high-water ladder makes every bucketed axis MONOTONE per process: once an
+# axis has been seen at a size, later solves pad up to that size instead of
+# shrinking back — shapes change at most O(log growth) times (cold compiles
+# paid once), and steady-state churn records ZERO recompiles
+# (obs.trace.RecompileSentinel is the gate). Padding entries stay inert by
+# the same construction plain bucketing relies on.
+#
+# KARPENTER_SOLVER_BUCKET=0 is the escape hatch back to plain bucketing
+# (pre-high-water behavior); the marks are process-global on purpose — every
+# solver in the process (provisioning, hybrid masked sub-encodes,
+# consolidation simulations) shares one shape ladder, so their kernels share
+# compiles too.
+_BUCKET_HW: dict[str, int] = {}
+
+
+def highwater_enabled() -> bool:
+    import os
+
+    return os.environ.get("KARPENTER_SOLVER_BUCKET", "1").strip().lower() not in ("0", "false", "off")
+
+
+def bucket_hw(axis: str, n: int, m: int) -> int:
+    """`bucket(n, m)`, raised to the axis' process-global high-water mark."""
+    t = -(-max(n, 1) // m) * m
+    if not highwater_enabled():
+        return t
+    hw = _BUCKET_HW.get(axis, 0)
+    if t <= hw:
+        return hw
+    _BUCKET_HW[axis] = t
+    return t
+
+
+def cap_hw(axis: str, n: int) -> int:
+    """High-water for already-laddered values (the pow2 nnz caps): returns
+    max(n, high-water) and records new maxima."""
+    if not highwater_enabled():
+        return n
+    hw = _BUCKET_HW.get(axis, 0)
+    if n <= hw:
+        return hw
+    _BUCKET_HW[axis] = n
+    return n
+
+
+def reset_bucket_highwater() -> None:
+    """Drop every recorded high-water mark (tests; operators that shrink a
+    cluster drastically and want pad waste back). Placement-neutral — the
+    next solve just re-establishes marks at its own sizes."""
+    _BUCKET_HW.clear()
+
+
 # bucket granularity per axis: small enough to keep padding waste low, large
 # enough that steady workload drift stays inside one compiled shape
 ROWS_BUCKET = 64
@@ -244,23 +301,24 @@ def make_tensors(enc, n_slots: int | None = None, with_pods: bool = True) -> Sch
     P = enc.n_pods
     if n_slots is None:
         n_slots = enc.n_existing + P
-    # the slot axis drifts with every pod-count change — bucket it so warm
-    # solves with drifting fleets reuse the compiled kernel
-    n_slots = bucket(int(n_slots), SLOTS_BUCKET)
+    # the slot axis drifts with every pod-count change — bucket it (with the
+    # high-water ladder: a fleet oscillating around a bucket boundary must
+    # not flip between compiled shapes) so churning fleets reuse the kernel
+    n_slots = bucket_hw("slots", int(n_slots), SLOTS_BUCKET)
     G = max(enc.n_groups, 1)
     D = enc.n_doms
     Kd = len(enc.dom_key_names)
 
-    # -- bucketed axis targets -------------------------------------------------
+    # -- bucketed axis targets (high-water: monotone per process) --------------
     Nrows = enc.row_alloc.shape[0]
-    Nrows_p = bucket(Nrows, ROWS_BUCKET)
-    R_p = bucket(enc.row_alloc.shape[1], RES_BUCKET)
-    K_p = bucket(enc.sig_mask.shape[1], KEYS_BUCKET)
-    W_p = bucket(enc.sig_mask.shape[2], WORDS_BUCKET)
-    C_p = bucket(enc.sig_taint_ok.shape[1], TAINT_BUCKET)
-    G_p = bucket(G, GROUP_BUCKET)
-    P1_p = bucket(enc.row_port_any.shape[1], PORT_BUCKET)
-    P2_p = bucket(enc.row_port_spec.shape[1], PORT_BUCKET)
+    Nrows_p = bucket_hw("rows", Nrows, ROWS_BUCKET)
+    R_p = bucket_hw("res", enc.row_alloc.shape[1], RES_BUCKET)
+    K_p = bucket_hw("keys", enc.sig_mask.shape[1], KEYS_BUCKET)
+    W_p = bucket_hw("words", enc.sig_mask.shape[2], WORDS_BUCKET)
+    C_p = bucket_hw("taints", enc.sig_taint_ok.shape[1], TAINT_BUCKET)
+    G_p = bucket_hw("groups", G, GROUP_BUCKET)
+    P1_p = bucket_hw("ports1", enc.row_port_any.shape[1], PORT_BUCKET)
+    P2_p = bucket_hw("ports2", enc.row_port_spec.shape[1], PORT_BUCKET)
 
     # rows: pad resource axis with huge allocatable (never the bottleneck),
     # then pad rows with NEG (never fit); n_rows_real masks them everywhere
@@ -269,7 +327,7 @@ def make_tensors(enc, n_slots: int | None = None, with_pods: bool = True) -> Sch
     row_labels = _pad_axis(_pad_axis(enc.row_labels, 1, K_p), 0, Nrows_p)
     row_pool_rank = _pad_axis(enc.row_pool_rank, 0, Nrows_p)
     row_taint_class = _pad_axis(enc.row_taint_class, 0, Nrows_p)
-    Q_p = bucket(enc.rank_domset.shape[0], RANK_BUCKET)
+    Q_p = bucket_hw("rank", enc.rank_domset.shape[0], RANK_BUCKET)
     rank_domset = _pad_axis(enc.rank_domset, 0, Q_p, fill=False)
     rank_dom_cap = _pad_axis(_rank_dom_cap_of(enc), 2, R_p, fill=BIG_ALLOC)
     rank_dom_cap = _pad_axis(rank_dom_cap, 0, Q_p, fill=np.float32(NEG))
@@ -304,7 +362,7 @@ def make_tensors(enc, n_slots: int | None = None, with_pods: bool = True) -> Sch
         member = _pad_axis(enc.member if enc.n_groups else np.zeros((P, 1), bool), 1, G_p, fill=False)
         owner = _pad_axis(enc.owner if enc.n_groups else np.zeros((P, 1), bool), 1, G_p, fill=False)
 
-    n_ex = bucket(enc.n_existing, EXIST_BUCKET)
+    n_ex = bucket_hw("exist", enc.n_existing, EXIST_BUCKET)
     existing_domset = np.zeros((n_ex, D), dtype=bool)
     dko = np.asarray(enc.dom_key_of)
     for j in range(enc.n_existing):

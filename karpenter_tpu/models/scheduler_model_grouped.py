@@ -51,6 +51,8 @@ from .scheduler_model import (
     SchedulerTensors,
     _pad_axis,
     bucket,
+    bucket_hw,
+    cap_hw,
     compat_matrix,
     pad_mask_axes,
     perkey_dom_ok,
@@ -148,7 +150,7 @@ def build_items(enc):
         item_port_spec=enc.sig_port_spec[rep_sig],
         item_host_blocked=enc.sig_host_blocked[rep_sig],
     )
-    arrays = pad_item_arrays(arrays, ITEM_AXIS_BUCKET)
+    arrays = pad_item_arrays(arrays, ITEM_AXIS_BUCKET, item_axis="items")
     item_pods += [np.zeros(0, np.int64)] * (len(arrays["item_count"]) - len(item_pods))
     return arrays, item_pods
 
@@ -156,23 +158,56 @@ def build_items(enc):
 ITEM_AXIS_BUCKET = 64  # full-solve item axis bucket (DELTA_ITEM_BUCKET for deltas)
 
 
-def pad_item_arrays(arrays: dict, item_bucket: int) -> dict:
+def item_pad_targets(t: SchedulerTensors) -> dict:
+    """Per-axis pad targets matching an EXISTING SchedulerTensors. The delta
+    path must pad its item arrays to the RESIDENT tensors' axes — the
+    process-global high-water marks may have grown since `t` was built (a
+    bigger solve in between), and re-deriving buckets then would hand the
+    delta kernel mismatched shapes."""
+    return dict(
+        res=int(t.pod_req.shape[1]),
+        keys=int(t.pod_mask.shape[1]),
+        words=int(t.pod_mask.shape[2]),
+        taints=int(t.pod_taint_ok.shape[1]),
+        groups=int(t.member.shape[1]),
+        ports1=int(t.row_port_any.shape[1]),
+        ports2=int(t.row_port_spec.shape[1]),
+        exist=int(t.existing_domset.shape[0]),
+    )
+
+
+def pad_item_arrays(arrays: dict, item_bucket: int, item_axis: str = "delta_items", targets: dict | None = None) -> dict:
     """Pad item arrays to the SAME axis buckets make_tensors applies to the
     row/group tensors (shapes must agree inside the kernel), plus the item
-    axis itself; pad items have count 0 and allow-nothing masks — inert."""
+    axis itself; pad items have count 0 and allow-nothing masks — inert.
+
+    Without `targets` the per-axis sizes come from the shared high-water
+    bucket ladder (identical to what make_tensors resolves for the same
+    encode); with `targets` (item_pad_targets of the resident tensors) the
+    arrays pad to exactly those axes. The item axis itself always rides the
+    high-water ladder under its own `item_axis` name — full solves and
+    deltas trace distinct kernels, so their item-axis marks stay separate."""
     a = dict(arrays)
-    a["item_req"] = _pad_axis(a["item_req"], 1, bucket(a["item_req"].shape[1], RES_BUCKET))
-    a["item_mask"] = pad_mask_axes(
-        a["item_mask"], bucket(a["item_mask"].shape[1], KEYS_BUCKET), bucket(a["item_mask"].shape[2], WORDS_BUCKET)
-    )
-    a["item_taint_ok"] = _pad_axis(a["item_taint_ok"], 1, bucket(a["item_taint_ok"].shape[1], TAINT_BUCKET), fill=True)
-    a["item_member"] = _pad_axis(a["item_member"], 1, bucket(a["item_member"].shape[1], GROUP_BUCKET), fill=False)
-    a["item_owner"] = _pad_axis(a["item_owner"], 1, bucket(a["item_owner"].shape[1], GROUP_BUCKET), fill=False)
-    a["item_port_any"] = _pad_axis(a["item_port_any"], 1, bucket(a["item_port_any"].shape[1], PORT_BUCKET), fill=False)
-    a["item_port_wild"] = _pad_axis(a["item_port_wild"], 1, bucket(a["item_port_wild"].shape[1], PORT_BUCKET), fill=False)
-    a["item_port_spec"] = _pad_axis(a["item_port_spec"], 1, bucket(a["item_port_spec"].shape[1], PORT_BUCKET), fill=False)
-    a["item_host_blocked"] = _pad_axis(a["item_host_blocked"], 1, bucket(a["item_host_blocked"].shape[1], EXIST_BUCKET), fill=False)
-    W_p = bucket(a["item_count"].shape[0], item_bucket)
+    tg = targets if targets is not None else {
+        "res": bucket_hw("res", a["item_req"].shape[1], RES_BUCKET),
+        "keys": bucket_hw("keys", a["item_mask"].shape[1], KEYS_BUCKET),
+        "words": bucket_hw("words", a["item_mask"].shape[2], WORDS_BUCKET),
+        "taints": bucket_hw("taints", a["item_taint_ok"].shape[1], TAINT_BUCKET),
+        "groups": bucket_hw("groups", a["item_member"].shape[1], GROUP_BUCKET),
+        "ports1": bucket_hw("ports1", a["item_port_any"].shape[1], PORT_BUCKET),
+        "ports2": bucket_hw("ports2", a["item_port_spec"].shape[1], PORT_BUCKET),
+        "exist": bucket_hw("exist", a["item_host_blocked"].shape[1], EXIST_BUCKET),
+    }
+    a["item_req"] = _pad_axis(a["item_req"], 1, tg["res"])
+    a["item_mask"] = pad_mask_axes(a["item_mask"], tg["keys"], tg["words"])
+    a["item_taint_ok"] = _pad_axis(a["item_taint_ok"], 1, tg["taints"], fill=True)
+    a["item_member"] = _pad_axis(a["item_member"], 1, tg["groups"], fill=False)
+    a["item_owner"] = _pad_axis(a["item_owner"], 1, tg["groups"], fill=False)
+    a["item_port_any"] = _pad_axis(a["item_port_any"], 1, tg["ports1"], fill=False)
+    a["item_port_wild"] = _pad_axis(a["item_port_wild"], 1, tg["ports1"], fill=False)
+    a["item_port_spec"] = _pad_axis(a["item_port_spec"], 1, tg["ports2"], fill=False)
+    a["item_host_blocked"] = _pad_axis(a["item_host_blocked"], 1, tg["exist"], fill=False)
+    W_p = bucket_hw(item_axis, a["item_count"].shape[0], item_bucket)
     for k in a:
         a[k] = _pad_axis(a[k], 0, W_p, fill=0 if a[k].dtype != bool else False)
     return a
@@ -854,9 +889,10 @@ def greedy_pack_grouped_compressed(t: SchedulerTensors, items: ItemTensors, n_po
     W = items.item_req.shape[0]
     N = t.n_slots
     Z = t.counts_dom_init.shape[1]
-    # nnz <= n_pods; round the static cap up to a power of two so solves with
-    # drifting pod counts reuse one compiled kernel instead of retracing
-    nnz_cap = int(min(_next_pow2(n_pods), W * N))
+    # nnz <= n_pods; round the static cap up to a power of two (and hold it
+    # at its high-water mark — a pod count oscillating around a pow2 boundary
+    # must not retrace) so solves with drifting pod counts reuse one kernel
+    nnz_cap = int(min(cap_hw("nnz_full", _next_pow2(n_pods)), W * N))
     flat_dev, state = _pack_compressed_impl(t, items, t.dom_keys, N, nnz_cap)
     out = _parse_flat(np.asarray(flat_dev), nnz_cap, N, Z, W)
     out["state"] = state
@@ -906,7 +942,7 @@ def recredit_removals(state, t: SchedulerTensors, slot_idx, req, zmem, hmem):
     """Host wrapper for _recredit_impl: pads the removal axis to a
     REMOVAL_BUCKET multiple so drifting removal counts share one compile."""
     K = int(slot_idx.shape[0])
-    K_pad = -(-max(K, 1) // REMOVAL_BUCKET) * REMOVAL_BUCKET
+    K_pad = bucket_hw("removals", K, REMOVAL_BUCKET)
     if K_pad != K:
         pad = K_pad - K
         slot_idx = np.concatenate([slot_idx, np.full(pad, -1, slot_idx.dtype)])
@@ -925,7 +961,7 @@ def greedy_pack_delta_compressed(state, t: SchedulerTensors, items: ItemTensors,
     W = items.item_req.shape[0]
     N = t.n_slots
     Z = t.counts_dom_init.shape[1]
-    nnz_cap = int(_next_pow2(max(n_added, 2)))
+    nnz_cap = int(cap_hw("nnz_delta", _next_pow2(max(n_added, 2))))
     flat_dev, state2 = _pack_delta_compressed_impl(state, t, items, t.dom_keys, N, nnz_cap)
     out = _parse_flat(np.asarray(flat_dev), nnz_cap, N, Z, W)
     out["state"] = state2
@@ -945,7 +981,7 @@ def compress_takes(takes, n_pods: int):
     triples is O(pods), not O(items x slots). Returns numpy (nz_item,
     nz_slot, nz_count), -1-padded, row-major (per item, slots ascending)."""
     W, N = takes.shape
-    cap = int(min(_next_pow2(n_pods), W * N))
+    cap = int(min(cap_hw("nnz_full", _next_pow2(n_pods)), W * N))
     nzi, nzs, nzc = _sparsify_takes(takes, cap)
     return np.asarray(nzi), np.asarray(nzs), np.asarray(nzc)
 

@@ -1,0 +1,28 @@
+"""Steady-state churn serving: the solver as a long-lived service.
+
+Every bench before this subsystem measured one-shot or single-warm-re-solve
+latency; a production deployment is a long-lived `Provisioner`+`TPUSolver`
+under sustained pod arrivals/departures from millions of users. This package
+makes that regime first-class:
+
+- `prestage.PendingPrestager` — the serving loop's double buffer: the NEXT
+  solve's host-side encode/classify work (pod clone, validation verdict,
+  signature stamping) runs while the CURRENT solve's device pack is in
+  flight, and clone identity is preserved across solves so the encoder can
+  classify consecutive serving snapshots as pod deltas.
+- `loop.ServingLoop` — wires a prestager into a live Provisioner and pumps
+  coalesced solves (the batcher's in-flight-aware drain: N triggers during a
+  solve cost ONE batched follow-up solve).
+- `churn.ChurnHarness` — drives sustained arrivals/departures against the
+  live stack and reports throughput (pod-events/sec), P50/P99 re-solve
+  latency, delta-hit rate, and the recompile count (the zero-steady-state
+  gate, via the solvetrace sentinel).
+
+Escape hatches: KARPENTER_SOLVER_DOUBLEBUF=0 disables the prestager (clones
+rebuilt per pass, the pre-serving-loop behavior); KARPENTER_SOLVER_BUCKET=0
+disables high-water shape bucketing (models/scheduler_model.py).
+"""
+
+from .churn import ChurnHarness, ChurnReport, ChurnSpec  # noqa: F401
+from .loop import ServingLoop, doublebuf_enabled  # noqa: F401
+from .prestage import PendingPrestager  # noqa: F401

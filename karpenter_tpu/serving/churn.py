@@ -1,0 +1,477 @@
+"""ChurnHarness: sustained pod churn against a live Provisioner+TPUSolver.
+
+The harness builds a real control plane (operator.Environment: store,
+informers, KWOK cloud provider, lifecycle, binder), provisions a base fleet,
+then drives a steady arrival/cancel/departure mix through the ServingLoop
+for many solve cycles, measuring the serving regime every earlier bench
+skipped:
+
+- throughput: pod churn events applied per wall-clock second;
+- re-solve latency: P50/P99 over every steady-phase SolveTrace duration
+  (the solvetrace ring is the source of truth — the same quantile machinery
+  /debug/solves publishes);
+- delta-hit rate: the share of solves served from device-resident state
+  (mode "delta"/"hybrid-delta") vs full re-encodes — the number that shows
+  whether the clone-identity prestager + node_generation row key actually
+  let the encoder recognize consecutive serving snapshots;
+- recompiles: the solvetrace sentinel's per-fn counts over the steady phase.
+  After warmup (which pays every cold compile at the high-water shapes) the
+  steady phase must record ZERO — the KARPENTER_SOLVER_BUCKET high-water
+  ladder is what pins the jitted shapes under churn.
+
+The event mix is deliberately shaped like a serving steady state: arrivals
+land on capacity freed by departures (claims are only created when the mix
+overshoots — creating one bumps node_generation and honestly costs a full
+re-encode), cancellations delete still-pending pods (the pure pod-axis
+removal delta), and bound-pod departures batch onto the periodic bind-flush
+iterations that already pay a row-side re-encode.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from .. import metrics as m
+from ..obs.stats import quantile
+from ..obs.trace import TraceRecorder
+from .loop import ServingLoop
+
+
+@dataclass
+class ChurnSpec:
+    # fleet / catalog scale (defaults = the 1/10-scale CPU gate)
+    n_base_pods: int = 5000
+    n_types: int = 100
+    # steady-phase event mix, per iteration. The defaults BALANCE: per cycle
+    # (bind_every iterations) net arrivals == departures, so the bound fleet
+    # and node count stay constant — growth is a real workload change and
+    # legitimately pays a (one-time, high-water) compile, but steady state
+    # must not.
+    arrivals: int = 800
+    cancels: int = 600
+    departures: int = 800  # applied on bind-flush iterations only
+    # share of cancellations that hit the NEWEST pending pods (users
+    # cancelling just-submitted work). Those typically arrive and cancel
+    # within one batching window, so the coalesced solve never sees them —
+    # the serving loop absorbs both events for free. The remainder cancels
+    # the OLDEST pending pods, i.e. already-placed ones, exercising the
+    # removal re-credit delta in steady state.
+    cancel_newest_frac: float = 0.8
+    bind_every: int = 4  # every k-th iteration flushes lifecycle+binder
+    iterations: int = 40
+    warmup_cycles: int = 3  # full bind_every-cycles before the sentinel mark
+    batch_idle_seconds: float = 0.25
+    # wall-clock seconds of the post-steady CONCURRENT segment: a driver
+    # thread applies events while the loop solves, so triggers land mid-solve
+    # and the batcher's in-flight coalescing (N triggers -> one follow-up
+    # solve) is demonstrated, not just unit-tested. 0 skips the segment.
+    concurrent_seconds: float = 1.5
+    seed: int = 0
+    double_buffer: bool | None = None  # None = env default (on)
+    # worker=False: prestage synchronously. On a CPU-only harness the pack
+    # "device" shares the host cores, so a prestage thread can only contend
+    # (GIL) — the double buffer's wins here are clone identity + staged-at-
+    # event-time prep. On real TPU hardware the pack landing blocks on the
+    # tunnel and the worker overlaps for free; set worker=True there.
+    worker: bool = False
+    trace_capacity: int = 8192
+
+
+@dataclass
+class ChurnReport:
+    events: int = 0
+    wall_seconds: float = 0.0
+    events_per_sec: float = 0.0
+    solves: int = 0
+    modes: dict = field(default_factory=dict)
+    delta_hit_rate: float = 0.0
+    p50_solve_seconds: float = 0.0
+    p99_solve_seconds: float = 0.0
+    recompiles: dict = field(default_factory=dict)
+    steady_recompiles: int = 0
+    coalesced_triggers: int = 0
+    concurrent_events: int = 0
+    concurrent_solves: int = 0
+    pods_per_solve_p50: float = 0.0
+    prestage_reused: int = 0
+    prestage_staged: int = 0
+    n_nodes: int = 0
+    n_pending_end: int = 0
+
+    def as_dict(self) -> dict:
+        return {
+            "events": self.events,
+            "wall_seconds": round(self.wall_seconds, 3),
+            "events_per_sec": round(self.events_per_sec, 1),
+            "solves": self.solves,
+            "modes": dict(self.modes),
+            "delta_hit_rate": round(self.delta_hit_rate, 4),
+            "p50_solve_seconds": round(self.p50_solve_seconds, 4),
+            "p99_solve_seconds": round(self.p99_solve_seconds, 4),
+            "recompiles": dict(self.recompiles),
+            "steady_recompiles": self.steady_recompiles,
+            "coalesced_triggers": self.coalesced_triggers,
+            "concurrent_events": self.concurrent_events,
+            "concurrent_solves": self.concurrent_solves,
+            "pods_per_solve_p50": round(self.pods_per_solve_p50, 1),
+            "prestage_reused": self.prestage_reused,
+            "prestage_staged": self.prestage_staged,
+            "n_nodes": self.n_nodes,
+            "n_pending_end": self.n_pending_end,
+        }
+
+
+# a fixed shape alphabet: churn arrivals cycle deployment-replica shapes, so
+# first contacts batch-stamp and every later encode reads stamps (the
+# signature axis stays inside its high-water bucket)
+_SHAPES = [
+    ("250m", "512Mi", None, None),
+    ("500m", "512Mi", None, None),
+    ("500m", "1Gi", None, None),
+    ("1", "1Gi", None, None),
+    ("1", "2Gi", None, None),
+    ("2", "2Gi", None, None),
+    ("250m", "1Gi", {"tier": "web"}, None),
+    ("500m", "2Gi", {"tier": "batch"}, None),
+    ("1", "512Mi", None, "test-zone-a"),
+    ("500m", "1Gi", None, "test-zone-b"),
+]
+
+
+def _make_pod(name: str, cpu: str, memory: str, labels=None, zone: str | None = None):
+    from ..apis import labels as wk
+    from ..kube.objects import Container, ObjectMeta, Pod, PodSpec
+    from ..utils.resources import parse_resource_list
+
+    sel = {wk.ZONE_LABEL_KEY: zone} if zone else {}
+    return Pod(
+        # deterministic uid: pods created in one fake-clock instant tie-break
+        # FFD order on uid, and the parity tests compare two independently
+        # built environments — random uids would make even two serial runs
+        # disagree on placement grouping
+        metadata=ObjectMeta(name=name, namespace="default", uid=f"uid-{name}", labels=dict(labels or {})),
+        spec=PodSpec(
+            containers=[Container(resources={"requests": parse_resource_list({"cpu": cpu, "memory": memory})})],
+            node_selector=sel,
+        ),
+    )
+
+
+class ChurnHarness:
+    def __init__(self, spec: ChurnSpec | None = None):
+        self.spec = spec or ChurnSpec()
+        self._seq = 0
+        self._pending: deque[str] = deque()  # created, not yet observed bound
+        self._bound: deque[str] = deque()
+        self._prebuilt: deque = deque()  # pre-constructed arrival pods
+        self.env = None
+        self.loop: ServingLoop | None = None
+        self.recorder = TraceRecorder(capacity=self.spec.trace_capacity, enabled=True)
+
+    # -- stack -----------------------------------------------------------------
+    def build(self):
+        import random
+
+        from ..apis import labels as wk
+        from ..apis.nodepool import NodePool
+        from ..cloudprovider.fake import instance_types_assorted
+        from ..kube.objects import ObjectMeta
+        from ..operator import Environment
+        from ..operator.options import Options
+        from ..solver.tpu import TPUSolver
+
+        # claim-name suffixes come from the global RNG; node iteration order
+        # sorts on them — seed so two runs of the same spec agree
+        random.seed(self.spec.seed)
+
+        env = Environment(
+            options=Options(
+                solver_backend="tpu",
+                batch_idle_duration=self.spec.batch_idle_seconds,
+                batch_max_duration=10.0,
+            ),
+            instance_types=instance_types_assorted(self.spec.n_types),
+        )
+        pool = NodePool(metadata=ObjectMeta(name="churn-pool"))
+        pool.spec.template.requirements = [
+            {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+            {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+        ]
+        env.store.create(pool)
+        # a private flight recorder: the harness wants the WHOLE run's traces
+        # (the process-default ring is 256) without perturbing other solvers
+        env.provisioner.solver = TPUSolver(registry=env.registry, recorder=self.recorder)
+        self.env = env
+        self.loop = ServingLoop(
+            env.provisioner,
+            env.store,
+            double_buffer=self.spec.double_buffer,
+            worker=self.spec.worker,
+        )
+        return self
+
+    def close(self) -> None:
+        if self.loop is not None:
+            self.loop.close()
+
+    # -- event application -----------------------------------------------------
+    def _record_events(self, n: int, event: str) -> None:
+        if n and self.env is not None:
+            if event == "arrival":
+                self.env.registry.counter(m.SOLVER_CHURN_EVENTS_TOTAL).inc(n, event="arrival")
+            else:
+                self.env.registry.counter(m.SOLVER_CHURN_EVENTS_TOTAL).inc(n, event="departure")
+
+    def _build_pod(self):
+        cpu, mem, labels, zone = _SHAPES[self._seq % len(_SHAPES)]
+        name = f"churn-{self._seq}"
+        self._seq += 1
+        return name, _make_pod(name, cpu, mem, labels, zone)
+
+    def prebuild(self, n: int) -> None:
+        """Construct n arrival pods ahead of time (a real apiserver receives
+        pods over the wire — object construction is the event SOURCE's cost,
+        not the serving loop's; the measured phase should apply events, not
+        manufacture them)."""
+        for _ in range(n):
+            self._prebuilt.append(self._build_pod())
+
+    def apply_arrivals(self, n: int) -> int:
+        store = self.env.store
+        for _ in range(n):
+            name, pod = self._prebuilt.popleft() if self._prebuilt else self._build_pod()
+            # adopt: the harness relinquishes the pod object on creation
+            store.create(pod, adopt=True)
+            self._pending.append(name)
+        self._record_events(n, "arrival")
+        return n
+
+    def apply_cancels(self, n: int) -> int:
+        done = 0
+        n_new = int(n * self.spec.cancel_newest_frac)
+        while done < n_new and self._pending:
+            name = self._pending.pop()  # newest first
+            if self.env.store.try_delete("Pod", name, namespace="default"):
+                done += 1
+        while done < n and self._pending:
+            name = self._pending.popleft()  # oldest: already-placed pods
+            pod = self.env.store.borrow_get("Pod", name, "default")
+            if pod is None:
+                continue
+            if pod.spec.node_name:
+                self._bound.append(name)  # bound since we last looked
+                continue
+            self.env.store.try_delete("Pod", name, namespace="default")
+            done += 1
+        self._record_events(done, "departure")
+        return done
+
+    def apply_departures(self, n: int) -> int:
+        done = 0
+        while done < n and self._bound:
+            name = self._bound.popleft()
+            if self.env.store.try_delete("Pod", name, namespace="default"):
+                done += 1
+        self._record_events(done, "departure")
+        return done
+
+    def bind_flush(self) -> None:
+        """Launch claims, register nodes, bind pending pods — the controller
+        work between solves. Re-files newly bound pods from pending to bound."""
+        env = self.env
+        if hasattr(env.cloud_provider, "flush_pending"):
+            env.cloud_provider.flush_pending()
+        env.lifecycle.reconcile_all()
+        if hasattr(env.cloud_provider, "flush_pending"):
+            env.cloud_provider.flush_pending()
+        env.lifecycle.reconcile_all()
+        env.binder.bind_all()
+        still = deque()
+        for name in self._pending:
+            pod = env.store.borrow_get("Pod", name, "default")
+            if pod is None:
+                continue
+            if pod.spec.node_name:
+                self._bound.append(name)
+            else:
+                still.append(name)
+        self._pending = still
+
+    def solve(self, force: bool = False):
+        """Advance the fake clock past the idle window and pump one serving
+        iteration (plus any coalesced drain generations)."""
+        self.env.clock.step(self.spec.batch_idle_seconds + 0.05)
+        out = self.loop.pump(force=force)
+        self.loop.drain()
+        return out
+
+    # -- phases ----------------------------------------------------------------
+    def provision_base_fleet(self) -> None:
+        """Create and bind the base fleet (cold compiles paid here)."""
+        step = max(1, self.spec.n_base_pods // 4)
+        created = 0
+        while created < self.spec.n_base_pods:
+            created += self.apply_arrivals(min(step, self.spec.n_base_pods - created))
+            self.solve(force=True)
+            self.bind_flush()
+        # settle stragglers
+        for _ in range(5):
+            if not self._pending:
+                break
+            self.solve(force=True)
+            self.bind_flush()
+
+    def run_cycle(self, arrivals: int | None = None, cancels: int | None = None, departures: int | None = None) -> int:
+        """One steady cycle: bind_every iterations of (arrivals + cancels +
+        solve), with departures + bind flush on the cycle boundary. Returns
+        events applied."""
+        s = self.spec
+        arrivals = s.arrivals if arrivals is None else arrivals
+        cancels = s.cancels if cancels is None else cancels
+        departures = s.departures if departures is None else departures
+        events = 0
+        for i in range(s.bind_every):
+            events += self.apply_arrivals(arrivals)
+            events += self.apply_cancels(cancels)
+            self.solve()
+            if i == s.bind_every - 1:
+                events += self.apply_departures(departures)
+                self.bind_flush()
+        return events
+
+    def run(self) -> ChurnReport:
+        """Warmup cycles (cold compiles + high-water marks), then the
+        measured steady phase."""
+        s = self.spec
+        if self.env is None:
+            self.build()
+        self.provision_base_fleet()
+        # free steady-state headroom up front: arrivals land on capacity that
+        # departures keep releasing; without this the first cycles would
+        # create claims every solve (fleet growth, not steady churn)
+        headroom = int((s.arrivals - s.cancels) * s.bind_every * 3)
+        self.apply_departures(headroom)
+        self.bind_flush()
+        # bounding cycle: every churn-varying axis (pending backlog, delta
+        # item count, removal count, nnz caps) is pushed PAST its steady-state
+        # maximum so the high-water marks — and the one-time compiles they
+        # imply — are all established before the sentinel mark; steady-state
+        # batch variance then stays strictly inside compiled shapes
+        self.run_cycle(
+            arrivals=int(s.arrivals * 1.4) + 32,
+            cancels=int(s.cancels * 1.6) + 32,
+            departures=int(s.departures * 1.4) + 32,
+        )
+        for _ in range(s.warmup_cycles):
+            self.run_cycle()
+        # -- steady phase ------------------------------------------------------
+        self.prebuild(s.arrivals * s.iterations)
+        mark = self.recorder.seq
+        coalesced0 = self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total()
+        reused0 = self.loop.prestager.reused if self.loop.prestager is not None else 0
+        staged0 = self.loop.prestager.staged if self.loop.prestager is not None else 0
+        events = 0
+        t0 = time.perf_counter()
+        done = 0
+        while done < s.iterations:
+            events += self.run_cycle()
+            done += s.bind_every
+        wall = time.perf_counter() - t0
+        rep = self._report(mark, events, wall, coalesced0, reused0, staged0)
+        if s.concurrent_seconds > 0:
+            cev, csolves = self.run_concurrent(s.concurrent_seconds)
+            rep.concurrent_events = cev
+            rep.concurrent_solves = csolves
+            rep.coalesced_triggers = int(
+                self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total() - coalesced0
+            )
+            # the zero-recompile claim covers the ENTIRE sustained run —
+            # re-tally over every post-mark trace so a compile landing in
+            # the concurrent segment (or its settle tail) fails the gate
+            # instead of hiding outside the steady window
+            recompiles: dict[str, int] = {}
+            for t in self.recorder.traces():
+                if t.seq > mark:
+                    for fn, cnt in t.recompiles.items():
+                        recompiles[fn] = recompiles.get(fn, 0) + cnt
+            rep.recompiles = recompiles
+            rep.steady_recompiles = sum(recompiles.values())
+        return rep
+
+    def run_concurrent(self, seconds: float, batch: int | None = None) -> tuple[int, int]:
+        """Wall-clock segment with a concurrent event driver: arrivals and
+        cancellations land WHILE solves are in flight, so trigger bursts
+        coalesce through the batcher's in-flight window into single
+        follow-up solves. The driver paces itself against a pending-backlog
+        cap (admission control): an unbounded flood would push the snapshot
+        past the warmup's high-water shapes and turn the segment into a
+        compile storm instead of a serving measurement. Returns (events
+        applied, solves run)."""
+        import threading
+
+        stop = threading.Event()
+        applied = [0]
+        if batch is None:
+            batch = max(20, self.spec.arrivals // 8)
+        backlog_cap = self.spec.arrivals * max(2, self.spec.bind_every - 1)
+
+        def driver():
+            while not stop.is_set():
+                if len(self._pending) < backlog_cap:
+                    applied[0] += self.apply_arrivals(batch)
+                    applied[0] += self.apply_cancels(int(batch * 0.75))
+                time.sleep(0.001)
+
+        t = threading.Thread(target=driver, name="churn-driver", daemon=True)
+        solves0 = self.loop.solves
+        t.start()
+        deadline = time.perf_counter() + seconds
+        try:
+            while time.perf_counter() < deadline:
+                self.solve()
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        # settle the backlog the driver left behind
+        for _ in range(5):
+            if not self._pending:
+                break
+            self.solve(force=True)
+            self.bind_flush()
+        return applied[0], self.loop.solves - solves0
+
+    def _report(self, mark: int, events: int, wall: float, coalesced0: float = 0.0, reused0: int = 0, staged0: int = 0) -> ChurnReport:
+        traces = [t for t in self.recorder.traces() if t.seq > mark and t.mode not in ("", "consolidate")]
+        durs = sorted(t.duration for t in traces)
+        modes: dict[str, int] = {}
+        recompiles: dict[str, int] = {}
+        for t in traces:
+            modes[t.mode] = modes.get(t.mode, 0) + 1
+            for fn, n in t.recompiles.items():
+                recompiles[fn] = recompiles.get(fn, 0) + n
+        delta = modes.get("delta", 0) + modes.get("hybrid-delta", 0)
+        eps = [t.n_pods for t in traces]
+        rep = ChurnReport(
+            events=events,
+            wall_seconds=wall,
+            events_per_sec=(events / wall) if wall > 0 else 0.0,
+            solves=len(traces),
+            modes=modes,
+            delta_hit_rate=(delta / len(traces)) if traces else 0.0,
+            p50_solve_seconds=quantile(durs, 0.50, assume_sorted=True) if durs else 0.0,
+            p99_solve_seconds=quantile(durs, 0.99, assume_sorted=True) if durs else 0.0,
+            recompiles=recompiles,
+            steady_recompiles=sum(recompiles.values()),
+            coalesced_triggers=int(self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total() - coalesced0),
+            # pending-backlog size per solve, NOT the trigger-drain ratio
+            # (that one is the karpenter_solver_churn_events_per_solve
+            # histogram, fed from the batcher generation)
+            pods_per_solve_p50=quantile(sorted(eps), 0.5, assume_sorted=True) if eps else 0.0,
+            prestage_reused=(self.loop.prestager.reused - reused0) if self.loop.prestager is not None else 0,
+            prestage_staged=(self.loop.prestager.staged - staged0) if self.loop.prestager is not None else 0,
+            n_nodes=len(self.env.cluster.nodes()),
+            n_pending_end=len(self._pending),
+        )
+        return rep

@@ -574,6 +574,17 @@ def _batch_stamp(pods: list) -> list:
     entry_by_prekey: dict = {}  # prekey -> (interned sig, has-pvc)
     get = entry_by_prekey.get
     intern, psig, stamp_cls, has_claims = _intern_sig, pod_signature, _SigStamp, _sig_has_claims
+    # previous-entry memo: first contacts arrive in replica RUNS (a
+    # deployment's pods are created back-to-back, and churn arrivals cycle a
+    # small shape alphabet), so the previous pod's raw components usually
+    # compare equal — a C-level equality chain over the dicts is several
+    # times cheaper than building + hashing the nested prekey tuple.
+    # Soundness: dict equality implies equal SORTED items (the sig's form),
+    # Quantity equality is by milli, and the spread list is reused only on
+    # object identity (or both empty) — so equal components imply an equal
+    # pod_signature output, the same contract the prekey itself carries.
+    prev_ns = prev_nsel = prev_lb = prev_rq = prev_pt = prev_tscs = None
+    prev_ent: tuple | None = None
     # columnar prefetch: the spec/metadata/containers attribute chains run in
     # C map loops once, not as per-pod bytecode inside the hot loop below
     specs = list(map(_SPEC_OF, pods))
@@ -595,6 +606,22 @@ def _batch_stamp(pods: list) -> list:
             lb = m.labels
             pt = c.ports
             tscs = s.topology_spread_constraints
+            if (
+                prev_ent is not None
+                and m.namespace == prev_ns
+                and nsel == prev_nsel
+                and lb == prev_lb
+                and rq == prev_rq
+                and pt == prev_pt
+                and (tscs is prev_tscs or (not tscs and not prev_tscs))
+            ):
+                sig, pvc = prev_ent
+                append(sig)
+                try:
+                    p._sig_stamp = stamp_cls(m.resource_version, sig, pvc)
+                except (AttributeError, TypeError):  # frozen/slotted pod doubles
+                    pass
+                continue
             # SYNC WARNING: the requests/ports components below are inlined
             # copies of _requests_key/_ports_key (this is the only per-pod
             # hot loop, so no per-container helper calls) — any field added
@@ -621,6 +648,8 @@ def _batch_stamp(pods: list) -> list:
                 ent = (sig, has_claims(sig[8]))
                 entry_by_prekey[key] = ent
             sig, pvc = ent
+            prev_ns, prev_nsel, prev_lb, prev_rq, prev_pt, prev_tscs = m.namespace, nsel, lb, rq, pt, tscs
+            prev_ent = ent
         else:
             sig = intern(psig(p))
             pvc = has_claims(sig[8])
@@ -1704,9 +1733,18 @@ def _try_delta_encode(snap, cache: EncodeCache):
         return None
     cur = snap.pods
     n_prev = len(prev_raw)
-    cap = max(64, n_prev // 20)
+    # Delta-size bound. The original 5%-of-base cap assumed the resident
+    # snapshot dwarfs its deltas (a 50k batch re-solved with a few pods
+    # moved); the churn SERVING regime inverts that — the pending backlog
+    # turns over at the same scale it holds, so appended tails and removal
+    # sweeps comparable to the base are the steady-state case. They still
+    # pay only O(delta): every per-signature tensor is reused wholesale and
+    # the delta pack scans only the added items, so up to 3x the base the
+    # delta path beats a full re-encode (unseen signatures or row changes
+    # route to the full path below regardless).
+    cap = max(64, 3 * n_prev)
     if len(cur) > n_prev + cap or len(cur) < n_prev - cap:
-        return None  # large deltas: the full encode amortizes better
+        return None  # larger swings: the full encode amortizes better
     # two-pointer identity walk: prev pods missing from cur (in order) are
     # the removals; whatever cur holds past the walk is the appended tail
     removed_raw: list[int] = []
@@ -1832,7 +1870,10 @@ def _row_cache_key(snap, rnames: list[str], dom_keys: list[str]) -> tuple:
     return (
         # epoch is a process-unique token (id() could recycle after GC)
         getattr(snap.cluster, "epoch", None) or id(snap.cluster),
-        snap.cluster.generation,
+        # node_generation, not generation: pending-pod arrivals bump only the
+        # latter, and they are the steady-state churn event the pod-delta
+        # path exists for — the row side provably cannot see them
+        getattr(snap.cluster, "node_generation", snap.cluster.generation),
         tuple(dom_keys),
         # the SNAPSHOT's node selection, not just cluster content: the
         # disruption simulation filters candidates out of state_nodes without

@@ -691,6 +691,7 @@ class TPUSolver:
             DELTA_ITEM_BUCKET,
             assignment_from_triples,
             greedy_pack_delta_compressed,
+            item_pad_targets,
             make_item_tensors,
             pad_item_arrays,
             recredit_removals,
@@ -760,6 +761,10 @@ class TPUSolver:
                     item_host_blocked=enc.sig_host_blocked[sigs_u],
                 ),
                 DELTA_ITEM_BUCKET,
+                # pad to the RESIDENT tensors' axes: the high-water marks may
+                # have grown since `t` was built, and the delta kernel needs
+                # item shapes that agree with the carry it continues from
+                targets=item_pad_targets(t),
             )
             items = make_item_tensors(arrays)
             W_pad = arrays["item_count"].shape[0]

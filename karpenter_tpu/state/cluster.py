@@ -42,7 +42,14 @@ class Cluster:
         self._consolidated_at: float = 0.0
         self._buffer_pod_counts: dict[str, int] = {}  # provider id -> virtual pod count
         self._unsynced_start: Optional[float] = None
-        self.generation = 0  # bumped on every mutation (solver cache key)
+        self.generation = 0  # bumped on every mutation (consolidation freshness)
+        # bumped only by mutations that change what the solver's ROW side can
+        # observe (nodes/claims/bindings/usage/anti-affinity membership) — the
+        # encode row-cache key. A pending-pod arrival or edit bumps only
+        # `generation`: under steady-state churn that is the dominant event,
+        # and keying the row cache on it would forbid the encoder's pod-delta
+        # path from ever serving a live provisioner.
+        self.node_generation = 0
         # process-unique token for cache keys: id() can recycle after GC
         self.epoch = next(_EPOCH_COUNTER)
         self._on_change: list[Callable[[], None]] = []
@@ -51,8 +58,13 @@ class Cluster:
     def on_change(self, fn: Callable[[], None]) -> None:
         self._on_change.append(fn)
 
-    def _bump(self) -> None:
+    def _bump(self, rows: bool = True) -> None:
+        """`rows=False` is the narrow carve-out for pod events that provably
+        touch no row-side state (a pending pod's ack): everything else —
+        including every pre-existing call site — advances both counters."""
         self.generation += 1
+        if rows:
+            self.node_generation += 1
         self.mark_unconsolidated()
         for fn in self._on_change:
             fn()
@@ -178,6 +190,13 @@ class Cluster:
             self._bump()
 
     def update_node_claim(self, nc: NodeClaim) -> None:
+        # private copy before retaining: watch events now deliver ONE clone
+        # shared by every watcher under a read-only contract (store._drain),
+        # and the cluster MUTATES its retained claim in place
+        # (_record_pod_event_on_claim stamps last_pod_event_time)
+        from ..kube.clone import fast_deepcopy
+
+        nc = fast_deepcopy(nc)
         with self._lock:
             # claims are tracked from creation (pre-launch) under a synthetic
             # key so back-to-back solves see in-flight capacity; the entry is
@@ -243,7 +262,12 @@ class Cluster:
         with self._lock:
             key = pod.key()
             terminating = pod.metadata.deletion_timestamp is not None
+            # row impact: released/recorded usage or bindings, or a change of
+            # anti-affinity membership (the encoder's inverse-anti entries
+            # read it). A pending pod's create/edit touches neither.
+            rows = False
             if pod_utils.is_terminal(pod):
+                rows = key in self._bindings
                 # only TERMINAL pods release usage (cluster.go:433-436): a
                 # terminating pod still occupies its node until it is gone
                 # (delete_pod handles that), and candidates must keep seeing
@@ -256,6 +280,7 @@ class Cluster:
                 # bound pods — terminating ones included, so a pod first
                 # observed mid-termination (informer replay after restart)
                 # still records its binding and usage
+                rows = True
                 old_node = self._bindings.get(key)
                 newly_bound = old_node != pod.spec.node_name
                 if old_node is not None and newly_bound:
@@ -273,18 +298,21 @@ class Cluster:
             elif not terminating:
                 self._pod_acks.setdefault(key, self.clock.now())
             if _has_required_anti_affinity(pod):
+                before = key in self._anti_affinity_pods
                 if pod_utils.is_active(pod):
                     self._anti_affinity_pods.add(key)
                 else:
                     self._anti_affinity_pods.discard(key)
-            self._bump()
+                rows = rows or (key in self._anti_affinity_pods) != before
+            self._bump(rows=rows)
 
     def delete_pod(self, key: str) -> None:
         with self._lock:
+            rows = key in self._bindings or key in self._anti_affinity_pods
             self._remove_pod_usage(key)
             self._anti_affinity_pods.discard(key)
             self._pod_acks.pop(key, None)
-            self._bump()
+            self._bump(rows=rows)
 
     # -- helpers ---------------------------------------------------------------
     def _state_node_for(self, node_name: str) -> Optional[StateNode]:

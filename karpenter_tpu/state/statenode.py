@@ -159,9 +159,12 @@ class StateNode:
         return node_vec if node_vec is not None else {}
 
     def total_pod_requests(self) -> dict[str, Quantity]:
-        # memoized: every consolidation simulation rebuilds an ExistingNode
-        # from this; the merge over all pods is invalidated only when the
-        # pod set changes (update_for_pod/cleanup_for_pod)
+        # memoized AND incrementally maintained: every consolidation
+        # simulation rebuilds an ExistingNode from this, and the binder's
+        # scheduling pass probes available() between consecutive binds onto
+        # the same node — update_for_pod/cleanup_for_pod patch the total in
+        # O(resource keys) instead of invalidating it, so a serving-loop
+        # bind flush costs O(binds), not O(binds x pods-per-node) re-merges
         if self._total_pod_requests is None:
             self._total_pod_requests = res.merge(*self.pod_requests.values())
         return self._total_pod_requests
@@ -181,11 +184,35 @@ class StateNode:
         return 1.0 + sum(self.pod_disruption_costs.values())
 
     # -- pod tracking ----------------------------------------------------------
+    @staticmethod
+    def _patch_total(total: dict | None, old: dict | None, new: dict | None):
+        """Apply a (remove old, add new) requests delta to a memoized total.
+        Keys reaching zero are dropped — numerically identical to a fresh
+        merge everywhere (subtract/fits treat a missing key as 0), though a
+        pod carrying an EXPLICIT zero request may leave the fresh merge with
+        a zero-valued key this patch has dropped."""
+        if total is None:
+            return None  # not materialized yet: first read merges fresh
+        out = dict(total)
+        for k, q in (old or {}).items():
+            cur = out.get(k)
+            if cur is None:
+                continue
+            v = cur.milli - q.milli
+            if v:
+                out[k] = Quantity(v)
+            else:
+                del out[k]
+        for k, q in (new or {}).items():
+            cur = out.get(k)
+            out[k] = Quantity(cur.milli + q.milli) if cur is not None else q
+        return out
+
     def update_for_pod(self, pod, volumes: dict | None = None) -> None:
-        self._total_pod_requests = None
         self._total_daemon_requests = None
         key = pod.key()
         requests = res.pod_requests(pod)
+        self._total_pod_requests = self._patch_total(self._total_pod_requests, self.pod_requests.get(key), requests)
         self.pod_requests[key] = requests
         self.pod_limits[key] = res.pod_limits(pod)
         # only non-daemon pods with positive eviction cost contribute to the
@@ -204,8 +231,10 @@ class StateNode:
             self.volume_usage.add(key, volumes)
 
     def cleanup_for_pod(self, key: str) -> None:
-        self._total_pod_requests = None
         self._total_daemon_requests = None
+        old = self.pod_requests.get(key)
+        if old is not None:
+            self._total_pod_requests = self._patch_total(self._total_pod_requests, old, None)
         self.pod_requests.pop(key, None)
         self.pod_limits.pop(key, None)
         self.pod_disruption_costs.pop(key, None)
@@ -251,4 +280,13 @@ class StateNode:
         c.volume_usage = self.volume_usage.copy()
         c.marked_for_deletion = self.marked_for_deletion
         c.nominated_until = self.nominated_until
+        # carry the memoized totals, materializing them on the LIVE node
+        # first (copies are handed out per availability probe via
+        # cluster.node_for_name and then discarded — a memo computed only on
+        # the copy would never stick, and re-merging every pod on the node
+        # per probe dominated the binder's scheduling pass under churn).
+        # Safe to share: _patch_total is copy-on-write, total_pod_requests
+        # returns the same dict a fresh merge would
+        c._total_pod_requests = self.total_pod_requests()
+        c._total_daemon_requests = self.total_daemon_requests()
         return c

@@ -25,6 +25,13 @@ if "xla_force_host_platform_device_count" not in flags:
 # path are covered explicitly (tests/test_mesh_default.py, tests/
 # test_sharded.py, `__graft_entry__.dryrun_multichip`, bench's mesh arm).
 os.environ.setdefault("KARPENTER_SOLVER_MESH", "0")
+# high-water shape bucketing (models/scheduler_model.py) is the production
+# default, but its marks are process-global: under pytest they would couple
+# unrelated suites (padded shapes depending on test ORDER, churning the
+# persistent compile cache below). The unit suite pins plain bucketing; the
+# churn-loop suite (tests/test_churn_loop.py) re-enables it explicitly —
+# zero-recompile-under-churn is pinned there, not here.
+os.environ.setdefault("KARPENTER_SOLVER_BUCKET", "0")
 
 # the image's sitecustomize force-registers the axon TPU platform regardless of
 # JAX_PLATFORMS; override at the config level so tests run hermetically on the

@@ -1,0 +1,363 @@
+"""Steady-state churn serving loop (karpenter_tpu/serving/).
+
+Pins the three serving-mode mechanisms and their contracts:
+- wake-up coalescing: N triggers during an in-flight solve cost exactly ONE
+  batched follow-up solve that sees all N pods (Batcher begin/end bracket);
+- double-buffering: the prestager's clone-identity cache changes scheduling
+  of host work, never results — placements are bit-identical to serial
+  execution with KARPENTER_SOLVER_DOUBLEBUF=0;
+- shape stability: with KARPENTER_SOLVER_BUCKET=1 (high-water bucketing) a
+  sustained churn run records ZERO recompiles after warmup, and delta solves
+  actually serve the live provisioner (the clone-identity + node_generation
+  machinery).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from helpers import make_pod
+from karpenter_tpu import metrics as m
+from karpenter_tpu.controllers.provisioning.batcher import Batcher
+from karpenter_tpu.serving import ChurnHarness, ChurnSpec, PendingPrestager
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def small_spec(**kw) -> ChurnSpec:
+    base = dict(
+        n_base_pods=160,
+        n_types=12,
+        arrivals=40,
+        cancels=30,
+        departures=40,
+        bind_every=2,
+        iterations=4,
+        warmup_cycles=1,
+        concurrent_seconds=0.0,
+    )
+    base.update(kw)
+    return ChurnSpec(**base)
+
+
+def placement_shape(env) -> list:
+    """Node-name-free placement structure: one (instance-type, zone,
+    frozenset of pod names) per node — random claim-name suffixes must not
+    enter the parity comparison."""
+    from karpenter_tpu.apis import labels as wk
+
+    nodes = {n.metadata.name: n for n in env.store.list("Node")}
+    groups: dict[str, set] = {}
+    for p in env.store.list("Pod"):
+        if p.spec.node_name:
+            groups.setdefault(p.spec.node_name, set()).add(p.metadata.name)
+    out = []
+    for name, pods in groups.items():
+        labels = nodes[name].metadata.labels if name in nodes else {}
+        out.append((labels.get(wk.INSTANCE_TYPE_LABEL_KEY), labels.get(wk.ZONE_LABEL_KEY), frozenset(pods)))
+    return sorted(out, key=lambda t: (t[0] or "", t[1] or "", sorted(t[2])))
+
+
+class TestBatcherCoalescing:
+    def test_reference_windows_without_solve_bracket(self):
+        clock = FakeClock()
+        b = Batcher(clock, idle_seconds=1.0, max_seconds=10.0)
+        assert not b.ready()
+        b.trigger("a")
+        assert not b.ready()
+        clock.step(1.5)
+        assert b.ready()
+        b.reset()
+        assert not b.ready()
+
+    def test_triggers_during_solve_arm_the_drain(self):
+        clock = FakeClock()
+        b = Batcher(clock, idle_seconds=1.0, max_seconds=10.0)
+        b.begin_solve()
+        for i in range(5):
+            b.trigger(str(i))
+        assert b.end_solve() == 5
+        # no clock advance: the in-flight solve WAS the window
+        assert b.ready()
+        assert b.pending() == 5
+        b.reset()
+        assert not b.ready()
+
+    def test_no_triggers_during_solve_means_no_drain(self):
+        clock = FakeClock()
+        b = Batcher(clock, idle_seconds=1.0, max_seconds=10.0)
+        b.begin_solve()
+        assert b.end_solve() == 0
+        b.trigger("after")
+        assert not b.ready()  # the idle window applies as before
+
+    def test_n_triggers_during_inflight_solve_one_followup_sees_all(self):
+        """The integration pin: pods created DURING a solve coalesce into
+        exactly one follow-up solve whose batch contains all of them."""
+        h = ChurnHarness(small_spec(n_base_pods=0)).build()
+        env = h.env
+        prov = env.provisioner
+        solver = prov.solver
+        seen_batches: list[int] = []
+        injected = {"done": False}
+        orig_solve = solver.solve
+
+        def spying_solve(snap):
+            seen_batches.append(len(snap.pods))
+            if not injected["done"]:
+                injected["done"] = True
+                # mid-solve burst: 7 pods arrive while this solve is in flight
+                h.apply_arrivals(7)
+            return orig_solve(snap)
+
+        solver.solve = spying_solve
+        h.apply_arrivals(3)
+        env.clock.step(1.0)
+        assert prov.reconcile() is not None  # solve #1: the 3 pre-solve pods
+        assert seen_batches == [3]
+        # the 7 in-flight triggers armed the drain: ready NOW, no idle wait
+        assert prov.batcher.ready()
+        assert prov.reconcile() is not None  # ONE follow-up
+        assert len(seen_batches) == 2
+        assert seen_batches[1] == 10  # all 7 (plus the still-pending 3)
+        assert env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total() == 7
+        assert not prov.batcher.ready()
+        h.close()
+
+
+class TestPrestager:
+    def test_clone_identity_while_rv_unchanged(self):
+        ps = PendingPrestager()
+        pod = make_pod(cpu="1")
+        c1 = ps.take(pod)
+        assert c1 is not None and c1 is not pod
+        c2 = ps.take(pod)
+        assert c2 is c1, "same (uid, rv) must hand out the SAME clone object"
+        assert ps.reused == 1
+
+    def test_rv_bump_invalidates(self):
+        ps = PendingPrestager()
+        pod = make_pod(cpu="1")
+        c1 = ps.take(pod)
+        pod.metadata.resource_version = 99
+        c2 = ps.take(pod)
+        assert c2 is not c1
+
+    def test_clone_is_stamped_and_content_equal(self):
+        from karpenter_tpu.solver.encode import pod_signature
+
+        ps = PendingPrestager()
+        pod = make_pod(cpu="500m", memory="1Gi", labels={"a": "b"})
+        clone = ps.take(pod)
+        st = getattr(clone, "_sig_stamp", None)
+        assert st is not None and st.rv == pod.metadata.resource_version
+        assert st.sig == pod_signature(pod)
+
+    def test_pvc_pods_bypass(self):
+        ps = PendingPrestager()
+        pod = make_pod(cpu="1", volumes=[{"name": "d", "persistentVolumeClaim": {"claimName": "x"}}])
+        assert ps.take(pod) is None
+
+    def test_store_events_evict(self):
+        from karpenter_tpu.kube import Store
+
+        store = Store()
+        ps = PendingPrestager()
+        ps.attach(store)
+        store.create(make_pod(cpu="1", name="ev"))
+        ps.pump()
+        assert len(ps) == 1
+        # binding makes it non-provisionable: evicted
+        store.patch("Pod", "ev", lambda p: setattr(p.spec, "node_name", "n1"))
+        ps.pump()
+        assert len(ps) == 0
+
+    def test_doublebuf_escape_hatch_disables(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_DOUBLEBUF", "0")
+        h = ChurnHarness(small_spec(n_base_pods=0)).build()
+        assert h.loop.prestager is None
+        assert h.env.provisioner.prestager is None
+        h.close()
+
+
+class TestClusterGenerationSplit:
+    def test_pending_pod_events_do_not_bump_node_generation(self):
+        from karpenter_tpu.kube import Store
+        from karpenter_tpu.state import Cluster
+        from karpenter_tpu.state.informer import start_informers
+
+        store, clock = Store(), FakeClock()
+        cluster = Cluster(store, clock)
+        start_informers(store, cluster)
+        ng0 = cluster.node_generation
+        store.create(make_pod(cpu="1", name="pend"))
+        store.try_delete("Pod", "pend")
+        assert cluster.generation > 0
+        assert cluster.node_generation == ng0, "pending-pod create/delete must be rows-neutral"
+
+    def test_bound_pod_events_bump_node_generation(self):
+        from karpenter_tpu.kube import Store
+        from karpenter_tpu.state import Cluster
+        from karpenter_tpu.state.informer import start_informers
+
+        store, clock = Store(), FakeClock()
+        cluster = Cluster(store, clock)
+        start_informers(store, cluster)
+        ng0 = cluster.node_generation
+        store.create(make_pod(cpu="1", name="bnd", node_name="node-1"))
+        assert cluster.node_generation > ng0
+
+    def test_anti_affinity_membership_bumps(self):
+        from helpers import hostname_anti_affinity
+        from karpenter_tpu.kube import Store
+        from karpenter_tpu.state import Cluster
+        from karpenter_tpu.state.informer import start_informers
+
+        store, clock = Store(), FakeClock()
+        cluster = Cluster(store, clock)
+        start_informers(store, cluster)
+        ng0 = cluster.node_generation
+        store.create(make_pod(cpu="1", name="anti", anti_affinity=[hostname_anti_affinity({"matchLabels": {"a": "b"}})]))
+        assert cluster.node_generation > ng0, "inverse-anti entries read the membership set"
+
+
+class TestHighWaterBuckets:
+    def test_monotone_and_resettable(self, monkeypatch):
+        from karpenter_tpu.models.scheduler_model import bucket_hw, cap_hw, reset_bucket_highwater
+
+        monkeypatch.setenv("KARPENTER_SOLVER_BUCKET", "1")
+        reset_bucket_highwater()
+        try:
+            assert bucket_hw("t_axis", 5, 16) == 16
+            assert bucket_hw("t_axis", 40, 16) == 48
+            # oscillating back down: the mark holds
+            assert bucket_hw("t_axis", 5, 16) == 48
+            assert cap_hw("t_nnz", 1024) == 1024
+            assert cap_hw("t_nnz", 256) == 1024
+            reset_bucket_highwater()
+            assert bucket_hw("t_axis", 5, 16) == 16
+        finally:
+            reset_bucket_highwater()
+
+    def test_escape_hatch_restores_plain_bucketing(self, monkeypatch):
+        from karpenter_tpu.models.scheduler_model import bucket, bucket_hw, reset_bucket_highwater
+
+        monkeypatch.setenv("KARPENTER_SOLVER_BUCKET", "0")
+        reset_bucket_highwater()
+        assert bucket_hw("t_axis2", 40, 16) == bucket(40, 16)
+        assert bucket_hw("t_axis2", 5, 16) == bucket(5, 16) == 16  # shrinks again
+
+    def test_delta_pads_to_resident_tensor_axes(self):
+        """item_pad_targets must mirror make_tensors' axes so a delta padded
+        against an older resident carry always shape-matches it."""
+        import numpy as np
+
+        from karpenter_tpu.models.scheduler_model import make_tensors
+        from karpenter_tpu.models.scheduler_model_grouped import item_pad_targets
+        from karpenter_tpu.solver.encode import encode
+        from test_solver import make_snapshot
+
+        snap = make_snapshot([make_pod(cpu="1") for _ in range(4)])
+        enc = encode(snap)
+        t = make_tensors(enc, with_pods=False)
+        tg = item_pad_targets(t)
+        assert tg["res"] == int(t.pod_req.shape[1])
+        assert tg["keys"] == int(t.pod_mask.shape[1])
+        assert tg["words"] == int(t.pod_mask.shape[2])
+        assert tg["groups"] == int(t.member.shape[1])
+        assert tg["exist"] == int(t.existing_domset.shape[0])
+        assert int(np.asarray(t.row_port_any).shape[1]) == tg["ports1"]
+
+
+class TestStateNodeIncrementalTotals:
+    def test_patch_total_matches_fresh_merge(self):
+        from karpenter_tpu.state.statenode import StateNode
+        from karpenter_tpu.utils import resources as res
+
+        sn = StateNode()
+        pods = [make_pod(cpu=f"{100 * (i + 1)}m", memory="256Mi", name=f"p{i}") for i in range(6)]
+        for p in pods:
+            sn.update_for_pod(p)
+        assert sn.total_pod_requests() == res.merge(*sn.pod_requests.values())
+        # removal keeps the incremental total exact
+        sn.cleanup_for_pod(pods[2].key())
+        assert sn.total_pod_requests() == res.merge(*sn.pod_requests.values())
+        # re-adding an existing pod (rebind replay) must not double-count
+        sn.update_for_pod(pods[0])
+        assert sn.total_pod_requests() == res.merge(*sn.pod_requests.values())
+        # shallow copies share (and keep) the memo without aliasing writes
+        c = sn.shallow_copy()
+        c.update_for_pod(make_pod(cpu="1", name="extra"))
+        assert sn.total_pod_requests() == res.merge(*sn.pod_requests.values())
+
+
+class TestChurnLoop:
+    def test_doublebuffer_bit_parity_vs_serial(self, monkeypatch):
+        """Identical scripted event sequences through the serving loop with
+        the double buffer ON vs the KARPENTER_SOLVER_DOUBLEBUF=0 serial arm:
+        the final placement structure must be identical — the prestager and
+        delta path change scheduling of work, never results."""
+        shapes = []
+        for arm_on in (True, False):
+            if arm_on:
+                monkeypatch.delenv("KARPENTER_SOLVER_DOUBLEBUF", raising=False)
+            else:
+                monkeypatch.setenv("KARPENTER_SOLVER_DOUBLEBUF", "0")
+            h = ChurnHarness(small_spec()).build()
+            h.provision_base_fleet()
+            h.apply_departures(40)
+            h.bind_flush()
+            for _ in range(3):
+                h.run_cycle()
+            shapes.append(placement_shape(h.env))
+            if arm_on:
+                assert h.loop.prestager is not None
+            else:
+                assert h.loop.prestager is None
+            h.close()
+        assert shapes[0] == shapes[1]
+
+    def test_zero_recompiles_under_sustained_churn(self, monkeypatch):
+        """The sentinel pin: with high-water bucketing ON, the steady phase
+        records ZERO recompiles (cold compiles land in warmup), and the
+        delta path actually serves the live provisioner."""
+        from karpenter_tpu.models.scheduler_model import reset_bucket_highwater
+
+        monkeypatch.setenv("KARPENTER_SOLVER_BUCKET", "1")
+        reset_bucket_highwater()
+        try:
+            h = ChurnHarness(small_spec(iterations=6, warmup_cycles=2))
+            rep = h.run()
+            h.close()
+        finally:
+            reset_bucket_highwater()
+        assert rep.steady_recompiles == 0, rep.recompiles
+        assert rep.solves > 0
+        assert rep.modes.get("delta", 0) + rep.modes.get("hybrid-delta", 0) > 0, rep.modes
+        assert rep.delta_hit_rate > 0.3
+        assert rep.events > 0 and rep.events_per_sec > 0
+        # re-solve latency quantiles come from the same machinery
+        assert rep.p99_solve_seconds >= rep.p50_solve_seconds > 0
+
+    def test_churn_metrics_families(self):
+        h = ChurnHarness(small_spec(iterations=2, warmup_cycles=1))
+        rep = h.run()
+        reg = h.env.registry
+        assert reg.counter(m.SOLVER_CHURN_EVENTS_TOTAL).value(event="arrival") > 0
+        assert reg.counter(m.SOLVER_CHURN_EVENTS_TOTAL).value(event="departure") > 0
+        hist = reg.histogram(m.SOLVER_CHURN_EVENTS_PER_SOLVE)
+        assert hist.count() > 0
+        # gauge exists and holds the post-solve queue depth (>= 0)
+        assert reg.gauge(m.SOLVER_CHURN_QUEUE_DEPTH).value() >= 0
+        assert rep.events > 0
+        h.close()
+
+    @pytest.mark.slow
+    def test_worker_thread_liveness_and_results(self):
+        """The threaded prestager (real-TPU mode) must stage asynchronously
+        and leave results placement-valid."""
+        h = ChurnHarness(small_spec(worker=True, iterations=2, warmup_cycles=1))
+        rep = h.run()
+        assert h.loop.prestager is not None
+        assert h.loop.prestager.staged > 0
+        assert rep.solves > 0
+        h.close()

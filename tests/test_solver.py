@@ -891,8 +891,14 @@ class TestDecodeLaunchability:
 
         assert np.array_equal(e1.row_alloc, e2.row_alloc)
         assert np.array_equal(e1.row_labels, e2.row_labels)
-        # any cluster mutation bumps the generation: rows rebuild
+        # pending-pod-only mutations bump `generation` but NOT
+        # `node_generation` — the row cache deliberately survives them
+        # (steady-state churn would otherwise forbid every delta encode)
         snap.cluster.generation += 1
+        encode(snap, cache=cache)
+        assert cache.rows is rows1, "a rows-neutral mutation must not rebuild rows"
+        # any row-side mutation bumps node_generation: rows rebuild
+        snap.cluster.node_generation += 1
         encode(snap, cache=cache)
         assert cache.rows is not rows1
 
