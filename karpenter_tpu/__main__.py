@@ -14,8 +14,8 @@ from __future__ import annotations
 
 import argparse
 import signal
-import threading
 
+from .obs.racecheck import make_event
 from .operator import Environment
 from .operator.options import Options
 from .operator.server import OperatorServer
@@ -68,7 +68,7 @@ def main(argv=None) -> int:
             health_server = None
     print(f"karpenter-tpu operator up: solver={options.solver_backend} http={args.bind}:{port}", flush=True)
 
-    stop = threading.Event()
+    stop = make_event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         try:
             signal.signal(sig, lambda *_: stop.set())

@@ -5,9 +5,11 @@ about: `mask_encode`/`_try_delta_encode` share encode arrays BY REFERENCE
 (one in-place write corrupts the cached delta base), the pack must never
 host-sync mid-kernel or loop Python-side over the pod axis, every fallback
 reason family must carry a hybrid tier (GLOBAL ones justified), and solver
-metric labels must stay enum-bounded. This package machine-checks those
-invariants as ~5 AST rules over the modules `[tool.solverlint]` names in
-pyproject.toml:
+metric labels must stay enum-bounded. The serving stack's CORRECTNESS rests
+on lock conventions the same way: guarded fields, a sanctioned lock order,
+reviewed thread seams, instrumentable primitives (racecheck, ISSUE 11).
+This package machine-checks all of it as 9 AST rules over the modules
+`[tool.solverlint]` names in pyproject.toml:
 
     python -m karpenter_tpu.analysis              # nonzero exit on findings
     python -m karpenter_tpu.analysis --self-test  # rule-discovery sanity gate
@@ -17,10 +19,13 @@ the offending line:
 
     # solverlint: ok(<rule-name>): <why this is sound>
 
-Runtime counterpart: `karpenter_tpu/solver/contracts.py` enforces the
+Runtime counterparts: `karpenter_tpu/solver/contracts.py` enforces the
 encode-space shape/dtype contracts under KARPENTER_SOLVER_TYPECHECK=1 (the
 tier-1 test run enables it), and `mask_encode` freezes reference-shared
-arrays so mutations the linter misses raise instead of corrupting caches.
+arrays so mutations the linter misses raise instead of corrupting caches;
+`karpenter_tpu/obs/racecheck.py` enforces the concurrency contracts under
+KARPENTER_SOLVER_RACECHECK=1 (also tier-1-wide) — dynamic lock-order graph
+with raise-on-inversion, guarded-field owner checks, lock-wait histogram.
 
 Everything here is stdlib-only (ast + tomllib/tomli): the gate runs in a
 few seconds (the cardinality rule parses the whole package) and never

@@ -22,6 +22,7 @@ def main(argv=None) -> int:
     parser.add_argument("--self-test", action="store_true", help="verify every rule detects its seeded violation")
     parser.add_argument("--root", type=Path, default=None, help="repo root (default: auto-detected)")
     parser.add_argument("--rule", action="append", dest="rules", help="run only this rule (repeatable)")
+    parser.add_argument("--jobs", type=int, default=1, help="run rules concurrently on N threads (parsed modules are shared either way)")
     parser.add_argument("paths", nargs="*", type=Path, help="restrict the scan to these files")
     args = parser.parse_args(argv)
 
@@ -37,7 +38,7 @@ def main(argv=None) -> int:
                 return 2
             print(f"solverlint self-test: {len(RULES)} rules healthy ({time.perf_counter() - t0:.2f}s)")
             return 0
-        if len(RULES) < 5:
+        if len(RULES) < 9:
             print(f"solverlint: rule registry shrank to {len(RULES)} rules", file=sys.stderr)
             return 2
         for p in args.paths:
@@ -46,7 +47,7 @@ def main(argv=None) -> int:
                 # "findings" (exit 1) or a raw traceback
                 print(f"solverlint: not a readable file: {p}", file=sys.stderr)
                 return 2
-        findings = run_analysis(root=root, config=config, rules=args.rules, paths=args.paths or None)
+        findings = run_analysis(root=root, config=config, rules=args.rules, paths=args.paths or None, jobs=args.jobs)
     except ConfigError as e:
         print(f"solverlint: broken configuration: {e}", file=sys.stderr)
         return 2

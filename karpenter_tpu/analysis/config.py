@@ -53,14 +53,86 @@ class Config:
     # "fn" (recompile sentinel) and "quantile" (rolling trace stats) are the
     # solvetrace label keys; "proposer" is the consolidation proposer enum
     # (lp | anneal | binary-search); "event" is the churn serving loop's
-    # {arrival | departure} enum — all held to the same bound
-    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer", "event")
+    # {arrival | departure} enum; "lock" is racecheck's static make_lock
+    # call-site enum — all held to the same bound
+    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer", "event", "lock")
     # callees whose return value is enum-bounded by construction
     bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family")
     # wrapper methods whose OWN bodies forward **labels to the registry
     metric_wrappers: tuple[str, ...] = ("_count", "_observe")
     # cap on distinct literal values per bounded label key, repo-wide
     max_label_values: int = 16
+    # -- racecheck (the concurrency rules) ------------------------------------
+    # modules on the THREADED serving path: guarded-field-access, lock-order,
+    # thread-escape and bare-thread-primitive run only here (the long-lived
+    # threads: prestager worker, churn driver, store watch delivery, operator
+    # HTTP server, leader-election renewer — plus everything their callbacks
+    # touch under a lock)
+    thread_modules: tuple[str, ...] = (
+        "karpenter_tpu/serving/*.py",
+        "karpenter_tpu/kube/store.py",
+        "karpenter_tpu/state/cluster.py",
+        "karpenter_tpu/state/informer.py",
+        "karpenter_tpu/state/cost.py",
+        "karpenter_tpu/state/nodepoolhealth.py",
+        "karpenter_tpu/metrics/registry.py",
+        "karpenter_tpu/controllers/provisioning/batcher.py",
+        "karpenter_tpu/controllers/provisioning/provisioner.py",
+        "karpenter_tpu/controllers/nodeclaim/podevents.py",
+        "karpenter_tpu/operator/*.py",
+        "karpenter_tpu/obs/trace.py",
+        "karpenter_tpu/obs/racecheck.py",
+        "karpenter_tpu/events/__init__.py",
+        "karpenter_tpu/utils/clock.py",
+        "karpenter_tpu/__main__.py",
+    )
+    # the sanctioned wrapper module: the ONLY place raw threading primitives
+    # may be constructed (bare-thread-primitive exempts it)
+    racecheck_module: str = "karpenter_tpu/obs/racecheck.py"
+    # the per-class guarded-field registry attribute (field -> guarding lock
+    # attr), read by guarded-field-access AND obs.racecheck.touch at runtime
+    guarded_registry_attr: str = "GUARDED_FIELDS"
+    # call-site patterns that construct locks (identifies which self.<attr>
+    # assignments in __init__ are locks, for both concurrency rules)
+    lock_factories: tuple[str, ...] = ("make_lock", "make_rlock", "*.Lock", "*.RLock", "Lock", "RLock")
+    # the thread-shared registry: sanctioned `threading.Thread(target=...)` /
+    # `spawn_thread(...)` entry points and store-watch callbacks, matched by
+    # fnmatch against the dotted callee, its tail, "EnclosingClass.tail",
+    # and the path-qualified "<module relpath>:<name>" forms. Every entry is
+    # a REVIEWED seam — its shared state is lock-guarded or provably
+    # confined (see the inventory in karpenter_tpu/serving/__init__.py).
+    # Generic callback names are path-qualified so a same-named function in
+    # some future module is NOT silently sanctioned.
+    thread_shared: tuple[str, ...] = (
+        "PendingPrestager._run",
+        "PendingPrestager._on_event",
+        "*.serve_forever",  # stdlib ThreadingHTTPServer worker
+        "*.renew_loop",  # LeaderElector renewer (target is a non-self attr)
+        "karpenter_tpu/serving/churn.py:_churn_driver",
+        # informer/cost watch callbacks: they only call into the
+        # lock-guarded Cluster/ClusterCost/Store surfaces
+        "karpenter_tpu/state/informer.py:on_*",
+        "karpenter_tpu/state/cost.py:on_*",
+        "Cluster.mark_unconsolidated",
+        "PodEventsController._on_pod_event",
+        "Provisioner.trigger",
+    )
+    # methods that register a store-watch callback (thread-escape checks the
+    # callback operand)
+    watch_register_methods: tuple[str, ...] = ("watch",)
+    # callee patterns that BLOCK (a solve, a device sync, watch-event
+    # delivery): calling one while holding a lock is a lock-order finding
+    lock_blocking_calls: tuple[str, ...] = ("*.solve", "solve_prepared", "_drain", "block_until_ready", "device_get")
+    # method-name tails too generic to resolve cross-class in the lock-order
+    # call graph (dict/list/set API names) — skipped to keep the static graph
+    # from manufacturing edges out of `self._cache.get(...)`
+    lock_call_blacklist: tuple[str, ...] = (
+        "get", "set", "add", "pop", "update", "clear", "remove", "insert", "append",
+        "extend", "discard", "popleft", "appendleft", "setdefault", "copy", "sort",
+        "count", "items", "keys", "values", "reset", "total", "value", "sum", "join",
+    )
+    # the human-readable thread-and-lock inventory lock-order findings point at
+    thread_inventory_doc: str = "karpenter_tpu/serving/__init__.py"
     # direct override for tests/self-test; when None the registry file is
     # parsed on first use
     shared_fields: frozenset | None = None

@@ -105,6 +105,7 @@ def run_analysis(
     config: Config | None = None,
     rules: list[str] | None = None,
     paths: list[Path] | None = None,
+    jobs: int = 1,
 ) -> list[Finding]:
     """Run the selected rules (default: all) and return surviving findings.
 
@@ -114,7 +115,14 @@ def run_analysis(
     normal scan restricted to those files, so each rule still sees only
     files its globs cover (a non-tensor module passed on the CLI is not
     suddenly held to tensor-module rules).
+
+    `jobs > 1` runs rules concurrently on a thread pool. Parsed modules are
+    cached ONCE across all rules either way (the cross-module concurrency
+    rules re-scan the same files the cardinality rule parses); findings come
+    back in deterministic rule order regardless of scheduling.
     """
+    import threading
+
     from .rules import RULES
 
     root = root or repo_root()
@@ -125,26 +133,33 @@ def run_analysis(
         raise ConfigError(f"unknown rules requested: {unknown} (have {sorted(RULES)})")
 
     # path -> module, or None once it failed to parse (the parse finding is
-    # emitted exactly once, not once per rule that scans the file)
+    # emitted exactly once, not once per rule that scans the file); shared
+    # across rules and worker threads
     cache: dict[Path, ParsedModule | None] = {}
-    findings: list[Finding] = []
+    cache_lock = threading.Lock()
+    parse_findings: list[Finding] = []
     scanned: set[Path] = set()
 
     def parsed(path: Path) -> ParsedModule | None:
-        if path in cache:
-            return cache[path]
-        try:
-            mod = ParsedModule(str(path.relative_to(root)) if path.is_relative_to(root) else str(path), path.read_text())
-        except SyntaxError as e:
-            findings.append(Finding("solverlint-parse", str(path), e.lineno or 0, f"syntax error: {e.msg}"))
-            mod = None
-        except OSError as e:
-            raise ConfigError(f"cannot read {path}: {e}") from e
-        cache[path] = mod
-        return mod
+        # parse INSIDE the lock: concurrent rules glob overlapping module
+        # sets, and the GIL means parallel ast.parse buys nothing — holding
+        # the lock is what makes "cached once across all rules" true
+        with cache_lock:
+            if path in cache:
+                return cache[path]
+            try:
+                mod = ParsedModule(str(path.relative_to(root)) if path.is_relative_to(root) else str(path), path.read_text())
+            except SyntaxError as e:
+                parse_findings.append(Finding("solverlint-parse", str(path), e.lineno or 0, f"syntax error: {e.msg}"))
+                mod = None
+            except OSError as e:
+                raise ConfigError(f"cannot read {path}: {e}") from e
+            cache[path] = mod
+            return mod
 
-    for name in selected:
+    def run_rule(name: str) -> list[Finding]:
         rule = RULES[name]()  # fresh instance: rules may aggregate across files
+        out: list[Finding] = []
         if paths is not None and rules is not None:
             files = paths
         elif paths is not None:
@@ -153,20 +168,29 @@ def run_analysis(
         else:
             files = _match_globs(root, rule.globs(config))
             if not files:
-                findings.append(
-                    Finding(name, str(root), 0, f"rule {name!r} matched no files — check [tool.solverlint] globs")
-                )
-                continue
+                return [Finding(name, str(root), 0, f"rule {name!r} matched no files — check [tool.solverlint] globs")]
         for path in files:
             mod = parsed(Path(path))
             if mod is None:
                 continue
-            scanned.add(Path(path))
+            with cache_lock:
+                scanned.add(Path(path))
             for f in rule.check(mod, config, root):
                 if not mod.suppressed(f):
-                    findings.append(f)
-        findings.extend(rule.finalize(config))
+                    out.append(f)
+        out.extend(rule.finalize(config))
+        return out
 
+    if jobs > 1 and len(selected) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(jobs, len(selected))) as ex:
+            per_rule = list(ex.map(run_rule, selected))
+    else:
+        per_rule = [run_rule(name) for name in selected]
+
+    findings: list[Finding] = [f for fs in per_rule for f in fs]
+    findings.extend(parse_findings)
     for path in scanned:
         mod = cache.get(path)
         if mod is not None:
@@ -182,10 +206,11 @@ def run_self_test(config: Config | None = None) -> list[str]:
     from .rules import RULES
 
     failures: list[str] = []
-    if len(RULES) < 5:
-        failures.append(f"rule registry shrank to {len(RULES)} rules (expected >= 5)")
+    if len(RULES) < 9:
+        failures.append(f"rule registry shrank to {len(RULES)} rules (expected >= 9)")
     for name, cls in RULES.items():
-        cfg = dataclasses.replace(config or Config(), shared_fields=cls.SELF_TEST_SHARED_FIELDS)
+        overrides = {"shared_fields": cls.SELF_TEST_SHARED_FIELDS, **cls.SELF_TEST_CONFIG}
+        cfg = dataclasses.replace(config or Config(), **overrides)
         for label, src, expect_hit in (("bad", cls.SELF_TEST_BAD, True), ("ok", cls.SELF_TEST_OK, False)):
             rule = cls()
             mod = ParsedModule(f"<self-test:{name}:{label}>", src)

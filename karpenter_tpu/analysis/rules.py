@@ -19,6 +19,36 @@
                              enumerable, and the repo-wide literal set per
                              key stays under a cap.
 
+racecheck's static arm (ISSUE 11) — the concurrency rules, scanning the
+threaded serving stack (`thread_modules`):
+
+6. guarded-field-access      classes on the threaded path declare a
+                             GUARDED_FIELDS registry (field -> guarding lock
+                             attr, like encode.SHARED_ENCODE_FIELDS); any
+                             touch of a declared field outside a
+                             `with self.<lock>` block is a finding. A
+                             caller-holds helper carries the pragma on its
+                             `def` line, which scopes the contract to the
+                             whole method.
+7. lock-order                the static lock-acquisition graph: nested
+                             `with self.<lock>` blocks plus one level of
+                             name-resolved method calls made while a lock is
+                             held; any cycle is a potential deadlock, and any
+                             blocking call (a solve, a device sync, the
+                             store's watch-delivery `_drain`) under a held
+                             lock is a finding.
+8. thread-escape             `threading.Thread(target=...)`/`spawn_thread`
+                             entry points and store-watch callbacks must be
+                             in the declared thread-shared registry
+                             (`[tool.solverlint] thread-shared`) — every
+                             object handed to another thread is a reviewed,
+                             named seam; lambdas (invisible capture) are
+                             flagged outright.
+9. bare-thread-primitive     raw threading.Lock/RLock/Event/Thread/...
+                             construction outside obs/racecheck.py — the
+                             wrapper is what lets the runtime sanitizer
+                             instrument every acquisition.
+
 Every rule ships SELF_TEST_BAD/SELF_TEST_OK snippets; `--self-test` proves
 each rule still detects its seeded violation and that the pragma suppresses
 it, so the gate fails loudly if rule discovery breaks.
@@ -72,6 +102,9 @@ class Rule:
     SELF_TEST_BAD = ""
     SELF_TEST_OK = ""
     SELF_TEST_SHARED_FIELDS: frozenset | None = None
+    # extra Config overrides applied while self-testing this rule (e.g. an
+    # emptied thread-shared registry so the seeded escape is unsanctioned)
+    SELF_TEST_CONFIG: dict = {}
 
     def globs(self, config: Config) -> tuple[str, ...]:
         return config.tensor_modules
@@ -398,13 +431,13 @@ class MetricLabelCardinalityRule(Rule):
     description = "bounded metric labels must carry statically enumerable values"
     _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
 
-    # the seeded violation is a churn-label one: an events counter whose
-    # `event` label carries a runtime value instead of the
-    # {arrival | departure} enum — exactly the drift the serving loop's
-    # call sites must never regress into
+    # the seeded violation is a racecheck lock-label one: a wait-time
+    # histogram whose `lock` label carries a runtime value instead of the
+    # static make_lock call-site enum — exactly the drift the instrumented
+    # wrapper's emission must never regress into
     SELF_TEST_BAD = (
-        "def record(registry, batch, kind):\n"
-        '    registry.counter("karpenter_solver_churn_events_total").inc(len(batch), event=kind)\n'
+        "def record(registry, lk, dt):\n"
+        '    registry.histogram("karpenter_solver_lock_wait_seconds").observe(dt, lock=repr(lk))\n'
     )
     SELF_TEST_OK = (
         "def record(registry, pod):\n"
@@ -597,6 +630,510 @@ class MetricLabelCardinalityRule(Rule):
         return findings
 
 
+# -- racecheck: the concurrency rules (ISSUE 11) ------------------------------
+
+
+def _self_lock_attr(node: ast.AST) -> str | None:
+    """`self.<attr>` -> attr, else None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _class_lock_attrs(cls: ast.ClassDef, config: Config, imports=None) -> set[str]:
+    """Attrs assigned `self.<attr> = <lock factory>(...)` anywhere in the
+    class (normally __init__). `imports` is the module's threading import
+    table so `from threading import Lock as L; self._x = L()` is still
+    recognized as a lock."""
+    mods, names = imports or (set(), {})
+    attrs: set[str] = set()
+    for n in ast.walk(cls):
+        if not (isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)):
+            continue
+        if callee_matches(n.value.func, config.lock_factories) or _threading_construct(n.value, mods, names) in ("Lock", "RLock"):
+            for t in n.targets:
+                a = _self_lock_attr(t)
+                if a is not None:
+                    attrs.add(a)
+    return attrs
+
+
+def _module_lock_attrs(tree: ast.Module, config: Config) -> dict[str, tuple[set[str], bool]]:
+    """Per class: (effective lock attrs incl. same-module bases, has an
+    out-of-module base). `Counter._lock` lives on `_Metric.__init__` — the
+    single-inheritance resolution here is what lets subclasses inherit the
+    guard declaration."""
+    imports = _threading_imports(tree)
+    classes = {n.name: n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)}
+    own = {name: _class_lock_attrs(cls, config, imports) for name, cls in classes.items()}
+    out: dict[str, tuple[set[str], bool]] = {}
+
+    def resolve(name: str, seen: frozenset) -> tuple[set[str], bool]:
+        if name in out:
+            return out[name]
+        attrs = set(own.get(name, ()))
+        unknown = False
+        for base in classes[name].bases:
+            bname = dotted_name(base).rsplit(".", 1)[-1]
+            if bname in classes and bname not in seen:
+                battrs, bunknown = resolve(bname, seen | {name})
+                attrs |= battrs
+                unknown |= bunknown
+            elif bname not in ("object",):
+                unknown = True
+        out[name] = (attrs, unknown)
+        return out[name]
+
+    for name in classes:
+        resolve(name, frozenset())
+    return out
+
+
+def _threading_imports(tree: ast.Module) -> tuple[set[str], dict[str, str]]:
+    """(aliases the threading MODULE is bound to, {local name: threading
+    attr} for from-imports) — so `import threading as t; t.Lock()` and
+    `from threading import Lock as L; L()` resolve instead of evading the
+    concurrency rules via a rename."""
+    mods: set[str] = set()
+    names: dict[str, str] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            for a in n.names:
+                if a.name == "threading":
+                    mods.add(a.asname or "threading")
+        elif isinstance(n, ast.ImportFrom) and n.module == "threading":
+            for a in n.names:
+                names[a.asname or a.name] = a.name
+    return mods, names
+
+
+def _threading_construct(call: ast.Call, mods: set[str], names: dict[str, str]) -> str | None:
+    """The threading primitive this call constructs ("Lock", "Thread", ...),
+    resolved through module aliases and from-imports; None otherwise."""
+    name = dotted_name(call.func)
+    if not name:
+        return None
+    base, _, tail = name.rpartition(".")
+    if base in mods:
+        return tail
+    if not base:
+        return names.get(tail)
+    return None
+
+
+def _has_pragma(mod: ParsedModule, rule: str, line: int) -> bool:
+    """A justified pragma for `rule` on `line` or the line directly above."""
+    for i in (line, line - 1):
+        for r, _why in mod.pragmas.get(i, ()):
+            if r == rule:
+                return True
+    return False
+
+
+class GuardedFieldAccessRule(Rule):
+    name = "guarded-field-access"
+    description = "a GUARDED_FIELDS-declared field touched outside a `with self.<lock>` block"
+
+    SELF_TEST_BAD = (
+        "class Stats:\n"
+        '    GUARDED_FIELDS = {"hits": "_lock"}\n'
+        "    def __init__(self):\n"
+        '        self._lock = make_lock("stats")\n'
+        "        self.hits = 0\n"
+        "    def bump(self):\n"
+        "        self.hits += 1\n"
+    )
+    SELF_TEST_OK = (
+        "class Stats:\n"
+        '    GUARDED_FIELDS = {"hits": "_lock"}\n'
+        "    def __init__(self):\n"
+        '        self._lock = make_lock("stats")\n'
+        "        self.hits = 0\n"
+        "    def bump(self):\n"
+        "        with self._lock:\n"
+        "            self.hits += 1\n"
+        "    def bump_unlocked(self):  # solverlint: ok(guarded-field-access): self-test snippet — caller-holds contract demo\n"
+        "        self.hits += 1\n"
+    )
+
+    def globs(self, config):
+        return config.thread_modules
+
+    @staticmethod
+    def _registry(cls: ast.ClassDef, config: Config):
+        """The class's GUARDED_FIELDS literal as {field: lock attr}, plus the
+        registry node (for malformed-registry findings)."""
+        for n in cls.body:
+            target = None
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
+                target = n.targets[0].id
+            elif isinstance(n, ast.AnnAssign) and isinstance(n.target, ast.Name):
+                target = n.target.id
+            if target != config.guarded_registry_attr:
+                continue
+            value = n.value
+            if not isinstance(value, ast.Dict):
+                return None, n
+            reg: dict[str, str] = {}
+            for k, v in zip(value.keys, value.values):
+                if not (isinstance(k, ast.Constant) and isinstance(k.value, str) and isinstance(v, ast.Constant) and isinstance(v.value, str)):
+                    return None, n
+                reg[k.value] = v.value
+            return reg, n
+        return {}, None
+
+    def check(self, mod, config, root):
+        findings: list[Finding] = []
+        lock_map = _module_lock_attrs(mod.tree, config)
+        for cls in [n for n in ast.walk(mod.tree) if isinstance(n, ast.ClassDef)]:
+            registry, reg_node = self._registry(cls, config)
+            if registry is None:
+                findings.append(
+                    self._finding(mod, reg_node, f"{config.guarded_registry_attr} must be a literal {{'field': 'lock attr'}} dict — the runtime sanitizer reads it too")
+                )
+                continue
+            if not registry:
+                continue
+            lock_attrs, unknown_base = lock_map.get(cls.name, (set(), True))
+            for field, lockattr in registry.items():
+                if lockattr not in lock_attrs and not unknown_base:
+                    findings.append(
+                        self._finding(mod, reg_node, f"guard {lockattr!r} for field {field!r} is never assigned from a lock factory in {cls.name}")
+                    )
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if meth.name == "__init__":
+                    continue  # construction happens-before thread publication
+                if _has_pragma(mod, self.name, meth.lineno):
+                    # method-level caller-holds contract: the pragma on the
+                    # `def` line declares every call site holds the lock
+                    continue
+                for child in ast.iter_child_nodes(meth):
+                    self._scan(child, registry, frozenset(), findings, mod, cls.name)
+        return findings
+
+    def _scan(self, child, registry, held, findings, mod, clsname):
+        """One node, with the set of lock attrs lexically held around it."""
+        if isinstance(child, _SCOPE_KINDS):
+            # a nested def may run on any thread later: scan it with no
+            # locks assumed held
+            for sub in ast.iter_child_nodes(child):
+                self._scan(sub, registry, frozenset(), findings, mod, clsname)
+            return
+        if isinstance(child, ast.With):
+            newly = set()
+            for item in child.items:
+                # the acquire expression itself is evaluated unlocked
+                self._scan(item.context_expr, registry, held, findings, mod, clsname)
+                a = _self_lock_attr(item.context_expr)
+                if a is not None:
+                    newly.add(a)
+            for stmt in child.body:
+                self._scan(stmt, registry, held | newly, findings, mod, clsname)
+            return
+        a = _self_lock_attr(child) if isinstance(child, ast.Attribute) else None
+        if a is not None and a in registry and registry[a] not in held:
+            findings.append(
+                self._finding(
+                    mod,
+                    child,
+                    f"field {clsname}.{a!r} is declared guarded by {registry[a]!r} but touched outside `with self.{registry[a]}`",
+                )
+            )
+            return  # the chain below is just `self`
+        for sub in ast.iter_child_nodes(child):
+            self._scan(sub, registry, held, findings, mod, clsname)
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+    description = "cycle in the static lock-acquisition graph, or a blocking call under a held lock"
+
+    SELF_TEST_BAD = (
+        "import threading\n"
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    SELF_TEST_OK = (
+        "import threading\n"
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def two(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+    )
+
+    def __init__(self):
+        # node = "ClassName.lockattr"; edge (a, b): a held while acquiring b
+        self._edges: dict[tuple[str, str], tuple[str, int]] = {}
+        # method tail name -> set of lock nodes it acquires directly
+        self._method_acquires: dict[str, set[str]] = {}
+        # calls made while holding a lock, resolved against methods in finalize
+        self._held_calls: list[tuple[str, str, str, int]] = []  # (held node, callee tail, path, line)
+
+    def globs(self, config):
+        return config.thread_modules
+
+    def check(self, mod, config, root):
+        findings: list[Finding] = []
+        lock_map = _module_lock_attrs(mod.tree, config)
+        for cls in [n for n in ast.walk(mod.tree) if isinstance(n, ast.ClassDef)]:
+            lock_attrs, _unknown = lock_map.get(cls.name, (set(), False))
+            if not lock_attrs:
+                continue
+            node_of = {a: f"{cls.name}.{a}" for a in lock_attrs}
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                acquires = self._method_acquires.setdefault(meth.name, set())
+                for child in ast.iter_child_nodes(meth):
+                    self._walk(child, node_of, [], acquires, findings, mod, config)
+        return findings
+
+    def _walk(self, child, node_of, held: list, acquires: set, findings, mod, config):
+        """One node, with the stack of lock nodes lexically held around it."""
+        if isinstance(child, _SCOPE_KINDS):
+            return  # nested defs execute later, on their own stack
+        if isinstance(child, ast.With):
+            newly = []
+            for item in child.items:
+                a = _self_lock_attr(item.context_expr)
+                if a in node_of:
+                    n = node_of[a]
+                    acquires.add(n)
+                    if not _has_pragma(mod, self.name, item.context_expr.lineno):
+                        # held + newly-so-far: `with self._a, self._b:`
+                        # acquires sequentially, so the combined form orders
+                        # a before b exactly like nested withs
+                        for h in held + newly:
+                            if h != n:
+                                self._edges.setdefault((h, n), (mod.relpath, child.lineno))
+                    newly.append(n)
+            for stmt in child.body:
+                self._walk(stmt, node_of, held + newly, acquires, findings, mod, config)
+            return
+        if isinstance(child, ast.Call) and held:
+            if callee_matches(child.func, config.lock_blocking_calls):
+                findings.append(
+                    self._finding(
+                        mod,
+                        child,
+                        f"blocking call {dotted_name(child.func) or '<call>'}() while holding {held[-1]} — "
+                        f"a solve/device-sync/watch-delivery under a lock stalls every contender "
+                        f"(see {config.thread_inventory_doc})",
+                    )
+                )
+            tail = dotted_name(child.func).rsplit(".", 1)[-1]
+            if tail and tail not in config.lock_call_blacklist and not _has_pragma(mod, self.name, child.lineno):
+                self._held_calls.append((held[-1], tail, mod.relpath, child.lineno))
+        for sub in ast.iter_child_nodes(child):
+            self._walk(sub, node_of, held, acquires, findings, mod, config)
+
+    def finalize(self, config):
+        # resolve one level of held-call edges by method name (the dynamic
+        # arm covers what name-based resolution cannot see: fn-pointer watch
+        # callbacks, cross-object calls on ambiguous names)
+        for held, tail, path, line in self._held_calls:
+            for node in self._method_acquires.get(tail, ()):
+                if node != held:
+                    self._edges.setdefault((held, node), (path, line))
+        adj: dict[str, set[str]] = {}
+        for a, b in self._edges:
+            adj.setdefault(a, set()).add(b)
+        findings: list[Finding] = []
+        seen_cycles: set[frozenset] = set()
+        for a, b in sorted(self._edges):
+            path = self._path(adj, b, a)
+            if path is None:
+                continue
+            cycle = [a, *path]  # path runs b..a, so the chain ends where it starts
+            key = frozenset(cycle)
+            if key in seen_cycles:
+                continue
+            seen_cycles.add(key)
+            src, line = self._edges[(a, b)]
+            findings.append(
+                Finding(
+                    self.name,
+                    src,
+                    line,
+                    "lock-order cycle (potential deadlock): "
+                    + " -> ".join(cycle)
+                    + f" — pick one order and record it in {config.thread_inventory_doc}",
+                )
+            )
+        return findings
+
+    @staticmethod
+    def _path(adj, src, dst):
+        """A path src..dst in the edge graph, or None."""
+        stack, prev = [src], {src: None}
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                out = []
+                while n is not None:
+                    out.append(n)
+                    n = prev[n]
+                return list(reversed(out))
+            for nxt in adj.get(n, ()):
+                if nxt not in prev:
+                    prev[nxt] = n
+                    stack.append(nxt)
+        return None
+
+
+class ThreadEscapeRule(Rule):
+    name = "thread-escape"
+    description = "a thread entry point or watch callback outside the declared thread-shared registry"
+
+    SELF_TEST_CONFIG = {"thread_shared": ()}
+    SELF_TEST_BAD = (
+        "import threading\n"
+        "class Escapee:\n"
+        "    def start(self):\n"
+        "        t = threading.Thread(target=self._run, daemon=True)\n"
+        "        t.start()\n"
+    )
+    SELF_TEST_OK = (
+        "import threading\n"
+        "class Escapee:\n"
+        "    def start(self):\n"
+        "        t = threading.Thread(target=self._run, daemon=True)  # solverlint: ok(thread-escape): self-test snippet, never imported\n"
+        "        t.start()\n"
+    )
+
+    def globs(self, config):
+        return config.thread_modules
+
+    def check(self, mod, config, root):
+        if mod.relpath == config.racecheck_module:
+            return []  # the wrapper's own Thread(...) takes its caller's target
+        findings: list[Finding] = []
+        # enclosing class per call site, for "ClassName.method" candidates
+        enclosing: dict[int, str] = {}
+
+        def mark(node, clsname):
+            for child in ast.iter_child_nodes(node):
+                name = child.name if isinstance(child, ast.ClassDef) else clsname
+                if isinstance(child, ast.Call):
+                    enclosing[id(child)] = name
+                mark(child, name)
+
+        mark(mod.tree, "")
+
+        def sanctioned(expr, call) -> bool:
+            name = dotted_name(expr)
+            if not name:
+                return False
+            tail = name.rsplit(".", 1)[-1]
+            # bare names also match path-qualified entries
+            # ("karpenter_tpu/state/informer.py:on_*"), so a generic callback
+            # name is sanctioned only in the module that was actually
+            # reviewed, not anywhere a same-named function appears later
+            candidates = {name, tail, f"{mod.relpath}:{name}", f"{mod.relpath}:{tail}"}
+            if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                cls = enclosing.get(id(call), "")
+                if cls:
+                    candidates.add(f"{cls}.{expr.attr}")
+            from fnmatch import fnmatch
+
+            return any(fnmatch(c, p) for c in candidates for p in config.thread_shared)
+
+        def flag(expr, call, what):
+            if isinstance(expr, ast.Lambda):
+                findings.append(
+                    self._finding(mod, call, f"lambda as {what}: captured state is invisible to review — register a named callback from the thread-shared registry or justify with a pragma")
+                )
+            elif not sanctioned(expr, call):
+                findings.append(
+                    self._finding(
+                        mod,
+                        call,
+                        f"{what} {dotted_name(expr) or '<expression>'} is not in the thread-shared registry "
+                        f"([tool.solverlint] thread-shared) — objects handed to another thread must be reviewed, named seams",
+                    )
+                )
+
+        mods, names = _threading_imports(mod.tree)
+        for call in [n for n in ast.walk(mod.tree) if isinstance(n, ast.Call)]:
+            func = call.func
+            tail = dotted_name(func).rsplit(".", 1)[-1]
+            if _threading_construct(call, mods, names) == "Thread":
+                target = next((kw.value for kw in call.keywords if kw.arg == "target"), None)
+                if target is not None:
+                    flag(target, call, "thread target")
+            elif tail == "spawn_thread":
+                target = call.args[0] if call.args else next((kw.value for kw in call.keywords if kw.arg == "target"), None)
+                if target is not None:
+                    flag(target, call, "thread target")
+            elif tail in config.watch_register_methods and isinstance(func, ast.Attribute):
+                cb = call.args[1] if len(call.args) >= 2 else next((kw.value for kw in call.keywords if kw.arg == "fn"), None)
+                if cb is not None:
+                    flag(cb, call, "watch callback")
+        return findings
+
+
+class BareThreadPrimitiveRule(Rule):
+    name = "bare-thread-primitive"
+    description = "raw threading primitive constructed outside the sanctioned racecheck wrapper"
+    PRIMITIVES = frozenset({"Lock", "RLock", "Event", "Thread", "Condition", "Semaphore", "BoundedSemaphore", "Barrier"})
+    # threading.local is deliberately exempt: thread-local state is the
+    # opposite of shared state, and instrumenting it buys nothing
+
+    SELF_TEST_BAD = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+    )
+    SELF_TEST_OK = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()  # solverlint: ok(bare-thread-primitive): self-test snippet, never imported\n"
+    )
+
+    def globs(self, config):
+        return config.thread_modules
+
+    def check(self, mod, config, root):
+        if mod.relpath == config.racecheck_module:
+            return []  # the wrapper itself necessarily constructs primitives
+        findings: list[Finding] = []
+        mods, names = _threading_imports(mod.tree)
+        for call in [n for n in ast.walk(mod.tree) if isinstance(n, ast.Call)]:
+            prim = _threading_construct(call, mods, names)
+            if prim in self.PRIMITIVES:
+                findings.append(
+                    self._finding(
+                        mod,
+                        call,
+                        f"bare {dotted_name(call.func)}() constructs threading.{prim} — go through obs.racecheck "
+                        f"(make_lock/make_rlock/make_event/spawn_thread) so KARPENTER_SOLVER_RACECHECK=1 can instrument it",
+                    )
+                )
+        return findings
+
+
 RULES: dict[str, type[Rule]] = {
     cls.name: cls
     for cls in (
@@ -605,5 +1142,9 @@ RULES: dict[str, type[Rule]] = {
         PodAxisLoopRule,
         ReasonFamilyTiersRule,
         MetricLabelCardinalityRule,
+        GuardedFieldAccessRule,
+        LockOrderRule,
+        ThreadEscapeRule,
+        BareThreadPrimitiveRule,
     )
 }

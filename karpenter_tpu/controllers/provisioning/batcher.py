@@ -23,15 +23,27 @@ coalesced count or in the next generation, never vanish.
 
 from __future__ import annotations
 
-import threading
+from ...obs.racecheck import make_lock
 
 
 class Batcher:
+    # racecheck guarded-field registry: the trigger/bracket state is written
+    # from watch-delivery threads and read by the serving loop — every touch
+    # goes through `_lock` (analysis: guarded-field-access enforces it)
+    GUARDED_FIELDS = {
+        "_first": "_lock",
+        "_last": "_lock",
+        "_count": "_lock",
+        "_in_flight": "_lock",
+        "_during": "_lock",
+        "_drain": "_lock",
+    }
+
     def __init__(self, clock, idle_seconds: float = 1.0, max_seconds: float = 10.0):
         self.clock = clock
         self.idle = idle_seconds
         self.max = max_seconds
-        self._lock = threading.Lock()
+        self._lock = make_lock("batcher")
         self._first: float | None = None
         self._last: float | None = None
         # current generation's trigger count (the solve-queue depth surface)

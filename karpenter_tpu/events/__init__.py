@@ -7,8 +7,9 @@ the monitor read recorder.events.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
+
+from ..obs.racecheck import make_rlock
 
 DEFAULT_DEDUPE_TIMEOUT = 120.0
 
@@ -29,11 +30,13 @@ class Event:
 
 
 class Recorder:
+    GUARDED_FIELDS = {"events": "_lock", "_seen": "_lock"}
+
     def __init__(self, clock, max_events: int = 2000):
         self.clock = clock
         self.events: list[Event] = []
         self._max = max_events
-        self._lock = threading.RLock()
+        self._lock = make_rlock("events")
         self._seen: dict[str, float] = {}  # dedupe key -> last publish time
 
     def publish(self, obj, reason: str, message: str, type_: str = "Normal", dedupe_values: tuple = (), dedupe_timeout: float = DEFAULT_DEDUPE_TIMEOUT) -> bool:

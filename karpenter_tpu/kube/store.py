@@ -10,8 +10,7 @@ to informers.
 
 from __future__ import annotations
 
-import threading
-
+from ..obs.racecheck import make_rlock
 from .clone import fast_deepcopy
 from typing import Callable, Iterable, Optional
 
@@ -58,8 +57,20 @@ class Store:
     """The in-memory 'cluster'. Thread-safe; objects are deep-copied on the
     way in and out so callers can never mutate stored state in place."""
 
+    # the racecheck guarded-field registry (analysis: guarded-field-access;
+    # runtime: obs.racecheck.touch). Sanctioned order: `_deliver_lock` may
+    # acquire `_lock` (the _drain pop), NEVER the reverse — see the
+    # serving-stack lock inventory in karpenter_tpu/serving/__init__.py.
+    GUARDED_FIELDS = {
+        "_objects": "_lock",
+        "_watchers": "_lock",
+        "_rv": "_lock",
+        "_kind_rv": "_lock",
+        "_pending": "_lock",
+    }
+
     def __init__(self, clock=None):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("store")
         self._objects: dict[str, dict[str, object]] = {}  # kind -> key -> obj
         self._watchers: dict[str, list[WatchFn]] = {}
         self._rv = 0
@@ -69,7 +80,7 @@ class Store:
         # ADDED < MODIFIED < DELETED in resourceVersion order even with
         # concurrent writers.
         self._pending: list[tuple[str, object]] = []
-        self._deliver_lock = threading.RLock()
+        self._deliver_lock = make_rlock("store-deliver")
         # per-kind revision: the rv of the last write touching the kind.
         # Caches that depend on one kind's content (e.g. the solver's volume
         # fold on StorageClass/PV/PVC) key on this instead of the global rv,
@@ -96,7 +107,7 @@ class Store:
             if fns is not None and fn in fns:
                 fns.remove(fn)
 
-    def _enqueue(self, event: str, obj) -> None:
+    def _enqueue(self, event: str, obj) -> None:  # solverlint: ok(guarded-field-access): caller-holds contract — every call site sits inside `with self._lock` (create/update/delete)
         # caller must hold self._lock
         self._pending.append((event, obj))
 

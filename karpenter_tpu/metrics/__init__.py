@@ -87,6 +87,15 @@ SOLVER_CONSOLIDATION_PROPOSALS_TOTAL = "karpenter_solver_consolidation_proposals
 SOLVER_CONSOLIDATION_LP_ITERATIONS_TOTAL = "karpenter_solver_consolidation_lp_iterations_total"
 SOLVER_CONSOLIDATION_VALIDATION_TOTAL = "karpenter_solver_consolidation_validation_total"
 SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR = "karpenter_solver_consolidation_savings_per_hour"
+# racecheck (obs/racecheck.py): lock-contention observability — wait time per
+# named serving-stack lock, emitted by the instrumented wrapper under
+# KARPENTER_SOLVER_RACECHECK=1. `lock` is the static make_lock call-site enum.
+SOLVER_LOCK_WAIT_SECONDS = "karpenter_solver_lock_wait_seconds"
+# lock waits live well under the solve buckets: sub-ms is the norm, anything
+# past 100ms is contention worth a dashboard line. Shared with the wrapper's
+# emission site so a registry that skipped make_registry still gets the
+# 10µs-resolution series, not DEFAULT_BUCKETS.
+SOLVER_LOCK_WAIT_BUCKETS = (0.000_01, 0.000_1, 0.001, 0.01, 0.1, 1.0)
 
 
 def make_registry() -> Registry:
@@ -217,6 +226,12 @@ def make_registry() -> Registry:
         SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR,
         "Hourly price saved by the newest accepted consolidation command, by proposer",
         ("proposer",),
+    )
+    r.histogram(
+        SOLVER_LOCK_WAIT_SECONDS,
+        "Time spent waiting to acquire a named serving-stack lock (racecheck wrapper)",
+        ("lock",),
+        SOLVER_LOCK_WAIT_BUCKETS,
     )
     return r
 

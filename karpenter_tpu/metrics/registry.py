@@ -10,8 +10,9 @@ so dashboards port over.
 from __future__ import annotations
 
 import math
-import threading
 from bisect import bisect_left
+
+from ..obs.racecheck import make_rlock
 
 NAMESPACE = "karpenter"
 
@@ -28,7 +29,7 @@ class _Metric:
         self.name = name
         self.help = help_
         self.label_names = label_names
-        self._lock = threading.RLock()
+        self._lock = make_rlock("metric")
 
     def _check(self, labels: dict[str, str]) -> dict[str, str]:
         extra = set(labels) - set(self.label_names)
@@ -39,6 +40,7 @@ class _Metric:
 
 class Counter(_Metric):
     TYPE = "counter"
+    GUARDED_FIELDS = {"_values": "_lock"}
 
     def __init__(self, name, help_, label_names):
         super().__init__(name, help_, label_names)
@@ -65,6 +67,7 @@ class Counter(_Metric):
 
 class Gauge(_Metric):
     TYPE = "gauge"
+    GUARDED_FIELDS = {"_values": "_lock"}
 
     def __init__(self, name, help_, label_names):
         super().__init__(name, help_, label_names)
@@ -100,6 +103,7 @@ class Gauge(_Metric):
 
 class Histogram(_Metric):
     TYPE = "histogram"
+    GUARDED_FIELDS = {"_counts": "_lock", "_sums": "_lock", "_totals": "_lock"}
 
     def __init__(self, name, help_, label_names, buckets=DEFAULT_BUCKETS):
         super().__init__(name, help_, label_names)
@@ -158,8 +162,10 @@ class Histogram(_Metric):
 class Registry:
     """get-or-create metric registry with prometheus text exposition."""
 
+    GUARDED_FIELDS = {"_metrics": "_lock"}
+
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("metric-registry")
         self._metrics: dict[str, _Metric] = {}
 
     def counter(self, name: str, help_: str = "", labels: tuple[str, ...] = ()) -> Counter:

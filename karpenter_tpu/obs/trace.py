@@ -33,6 +33,7 @@ import threading
 import time
 
 from ..utils.ringbuffer import RingBuffer
+from .racecheck import make_lock
 from .stats import RollingQuantiles, quantile
 
 QUANTILE_NAMES = ("p50", "p90", "p99")
@@ -300,6 +301,10 @@ class TraceRecorder:
     instance serves every solver unless a private one is injected (tests,
     the bench's tracing-off arm)."""
 
+    # racecheck guarded-field registry: solves commit from whatever thread
+    # ran them while /debug/solves reads from HTTP handler threads
+    GUARDED_FIELDS = {"_ring": "_lock", "_windows": "_lock", "dropped": "_lock", "seq": "_lock"}
+
     def __init__(self, capacity: int = 256, enabled: bool | None = None):
         self.enabled = _env_enabled() if enabled is None else bool(enabled)
         self.capacity = int(capacity)
@@ -307,7 +312,7 @@ class TraceRecorder:
         self._windows: dict[tuple[str, str], RollingQuantiles] = {}
         self.dropped = 0
         self.seq = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("trace")
 
     # -- lifecycle -----------------------------------------------------------
     def begin(self, n_pods: int = 0) -> SolveTrace:
@@ -412,11 +417,13 @@ class TraceRecorder:
         traces = self.traces()
         if limit is not None:
             traces = traces[-limit:] if limit > 0 else []
+        with self._lock:  # dump runs on HTTP handler threads
+            recorded, dropped = self.seq, self.dropped
         return {
             "enabled": self.enabled,
             "capacity": self.capacity,
-            "recorded": self.seq,
-            "dropped": self.dropped,
+            "recorded": recorded,
+            "dropped": dropped,
             "stats": self.stats(),
             "solves": [t.to_dict() for t in traces],
         }

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from ..kube import Lease, NotFound, ObjectMeta
 from ..kube.store import AlreadyExists, Conflict
+from ..obs.racecheck import make_lock
 
 DEFAULT_LEASE_DURATION = 15.0
 DEFAULT_RENEW_DEADLINE = 10.0
@@ -17,6 +18,12 @@ DEFAULT_RETRY_PERIOD = 2.0
 
 
 class LeaderElector:
+    # racecheck guarded-field registry: the renew loop runs on its own
+    # thread while the controller round reads is_leader() — the pair must
+    # change together or a leader can act on a renewed flag with a stale
+    # renew timestamp (or vice versa)
+    GUARDED_FIELDS = {"_leading": "_lock", "_last_renew": "_lock"}
+
     def __init__(
         self,
         store,
@@ -36,6 +43,7 @@ class LeaderElector:
         self.lease_duration = lease_duration
         self.renew_deadline = renew_deadline
         self.retry_period = retry_period
+        self._lock = make_lock("leader")
         self._last_renew = 0.0
         self._leading = False
 
@@ -43,9 +51,11 @@ class LeaderElector:
         """Leading AND renewed within the renew deadline — a leader whose
         renewals have been failing must stop acting before a standby can
         legitimately take over (client-go renewDeadline semantics)."""
-        if not self._leading:
-            return False
-        return self.clock.now() - self._last_renew <= self.renew_deadline
+        now = self.clock.now()
+        with self._lock:
+            if not self._leading:
+                return False
+            return now - self._last_renew <= self.renew_deadline
 
     def renew_loop(self, stop_event) -> None:
         """Background renewal every retry_period, decoupled from controller
@@ -71,8 +81,7 @@ class LeaderElector:
             )
             try:
                 self.store.create(lease)
-                self._leading = True
-                self._last_renew = now
+                self._set_leading(True, now)
                 return True
             except AlreadyExists:  # lost the creation race
                 return self._retry_observe()
@@ -81,7 +90,7 @@ class LeaderElector:
         if lease.holder_identity == self.identity:
             return self._renew(lease, now)
         if not expired:
-            self._leading = False
+            self._set_leading(False)
             return False
         # takeover: the previous holder's lease lapsed
         def apply(obj):
@@ -94,12 +103,17 @@ class LeaderElector:
 
         try:
             self.store.patch("Lease", self.lease_name, apply, namespace=self.namespace, retries=1)
-            self._leading = True
-            self._last_renew = now
+            self._set_leading(True, now)
             return True
         except (Conflict, NotFound):
-            self._leading = False
+            self._set_leading(False)
             return False
+
+    def _set_leading(self, leading: bool, renewed_at: float | None = None) -> None:
+        with self._lock:
+            self._leading = leading
+            if renewed_at is not None:
+                self._last_renew = renewed_at
 
     def _renew(self, lease, now: float) -> bool:
         def apply(obj):
@@ -109,25 +123,26 @@ class LeaderElector:
 
         try:
             self.store.patch("Lease", self.lease_name, apply, namespace=self.namespace, retries=1)
-            self._leading = True
-            self._last_renew = now
+            self._set_leading(True, now)
             return True
         except (Conflict, NotFound):
-            self._leading = False
+            self._set_leading(False)
             return False
 
     def _retry_observe(self) -> bool:
         lease = self.store.try_get("Lease", self.lease_name, self.namespace)
-        self._leading = lease is not None and lease.holder_identity == self.identity
-        return self._leading
+        leading = lease is not None and lease.holder_identity == self.identity
+        self._set_leading(leading)
+        return leading
 
     def release(self) -> None:
         """ReleaseOnCancel: fast failover on graceful shutdown. Writes only
         when this instance still holds the lease — a stale loser patching the
         lease could Conflict the new leader's renewal."""
-        if not self._leading:
-            return
-        self._leading = False
+        with self._lock:
+            if not self._leading:
+                return
+            self._leading = False
         current = self.store.try_get("Lease", self.lease_name, self.namespace)
         if current is None or current.holder_identity != self.identity:
             return

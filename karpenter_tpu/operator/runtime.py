@@ -172,8 +172,16 @@ class Environment:
         # provisioning pod/node trigger controllers, state informer §3.5); the
         # node trigger also closes the gap between a headroom node registering
         # and the pass that records its buffer pods
-        self.store.watch("Pod", lambda e, p: self.provisioner.trigger(p.metadata.uid) if e != "DELETED" else None)
-        self.store.watch("Node", lambda e, n: self.provisioner.trigger(n.metadata.uid) if e != "DELETED" else None)
+        self.store.watch("Pod", lambda e, p: self.provisioner.trigger(p.metadata.uid) if e != "DELETED" else None)  # solverlint: ok(thread-escape): delegates straight to Batcher.trigger, whose state is lock-guarded; captures nothing mutable of its own
+        self.store.watch("Node", lambda e, n: self.provisioner.trigger(n.metadata.uid) if e != "DELETED" else None)  # solverlint: ok(thread-escape): delegates straight to Batcher.trigger, whose state is lock-guarded; captures nothing mutable of its own
+
+        # racecheck (obs/racecheck.py): under KARPENTER_SOLVER_RACECHECK=1
+        # the instrumented locks publish their wait-time histogram to this
+        # environment's registry (one env per operator process)
+        from ..obs import racecheck
+
+        if racecheck.racecheck_enabled():
+            racecheck.set_metrics_registry(self.registry)
 
     def _make_solver(self):
         if self.options.solver_backend == "tpu":
@@ -245,20 +253,19 @@ class Environment:
         only while holding the leader lease, which a background thread renews
         every retry_period so a long reconcile round can't starve the lease
         into a spurious takeover. Blocks until stop_event is set."""
-        import threading as _threading
         import uuid as _uuid
 
+        from ..obs.racecheck import make_event, spawn_thread
         from .leaderelection import LeaderElector
 
         if isinstance(self.clock, FakeClock):
             raise ValueError("Environment.run drives wall-clock time; construct with clock=Clock() (FakeClock never advances here)")
-        stop_event = stop_event or _threading.Event()
+        stop_event = stop_event or make_event()
         elector = None
         renewer = None
         if leader_election:
             elector = LeaderElector(self.store, self.clock, identity or f"karpenter-{_uuid.uuid4().hex[:8]}")
-            renewer = _threading.Thread(target=elector.renew_loop, args=(stop_event,), daemon=True)
-            renewer.start()
+            renewer = spawn_thread(elector.renew_loop, name="karpenter-lease-renewer", args=(stop_event,))
         try:
             while not stop_event.is_set():
                 if elector is None or elector.is_leader():

@@ -14,20 +14,26 @@ from __future__ import annotations
 
 import json
 import sys
-import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from ..obs.racecheck import make_lock, spawn_thread
+
 
 class OperatorServer:
+    # racecheck guarded-field registry: start/stop may race (signal handler
+    # vs. shutdown path), so the server/thread handles are claimed under a lock
+    GUARDED_FIELDS = {"_httpd": "_lock", "_thread": "_lock"}
+
     def __init__(self, env, port: int = 8080, enable_profiling: bool = False, bind: str = "0.0.0.0"):
         self.env = env
         self.port = port
         self.bind = bind  # probes/scrapes come from off-host (operator.go:180-183)
         self.enable_profiling = enable_profiling
+        self._lock = make_lock("operator-server")
         self._httpd: ThreadingHTTPServer | None = None
-        self._thread: threading.Thread | None = None
+        self._thread = None
 
     def start(self) -> int:
         env = self.env
@@ -76,14 +82,29 @@ class OperatorServer:
                 else:
                     self._send(404, "not found")
 
-        self._httpd = ThreadingHTTPServer((self.bind, self.port), Handler)
-        self.port = self._httpd.server_address[1]  # resolve port 0
-        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
-        self._thread.start()
+        # construct AND install under one lock hold: a stop() racing the
+        # bind window must either run before any socket exists (no-op, and
+        # start proceeds as a legitimate later start) or see the installed
+        # handles — never find None while a bound listener is about to be
+        # published after it returned
+        with self._lock:
+            if self._httpd is not None:
+                return self.port  # already serving: start() is idempotent
+            httpd = ThreadingHTTPServer((self.bind, self.port), Handler)
+            self._httpd = httpd
+            self.port = httpd.server_address[1]  # resolve port 0
+            self._thread = spawn_thread(httpd.serve_forever, name="karpenter-operator-http")
         return self.port
 
     def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        """Idempotent and double-call-safe: the handles are claimed
+        atomically, so a second (or concurrent) stop() finds None and
+        returns instead of double-shutting the stdlib server."""
+        with self._lock:
+            httpd, self._httpd = self._httpd, None
+            thread, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5)

@@ -21,6 +21,65 @@ makes that regime first-class:
 Escape hatches: KARPENTER_SOLVER_DOUBLEBUF=0 disables the prestager (clones
 rebuilt per pass, the pre-serving-loop behavior); KARPENTER_SOLVER_BUCKET=0
 disables high-water shape bucketing (models/scheduler_model.py).
+
+Thread-and-lock inventory (racecheck, ISSUE 11)
+===============================================
+
+This is the inventory the `lock-order` rule and the runtime sanitizer
+(obs/racecheck.py, KARPENTER_SOLVER_RACECHECK=1) enforce. Threads first —
+the serving stack's long-lived ones, every entry a reviewed seam in the
+`[tool.solverlint] thread-shared` registry:
+
+- the SOLVE thread (whoever pumps ServingLoop / Environment.tick);
+- `karpenter-prestage` (PendingPrestager._run): drains watch events into the
+  clone cache, overlapping the device pack;
+- `churn-driver` (churn._churn_driver): the harness's concurrent event
+  source, mutating only the store and the harness's atomic deques;
+- `karpenter-operator-http` (+ per-request ThreadingHTTPServer workers):
+  /metrics, /debug/solves, probes — read-only surfaces over lock-guarded
+  state;
+- `karpenter-lease-renewer` (LeaderElector.renew_loop): renews the lease
+  through the store's optimistic concurrency;
+- watch DELIVERY runs on whatever thread committed the store write, under
+  `Store._deliver_lock` — every watch callback executes there.
+
+Locks (constructed via obs.racecheck make_lock/make_rlock; the name is the
+lock CLASS — instances share a graph node) and who guards what:
+
+==================  =======================================================
+lock name           guards
+==================  =======================================================
+store               Store._objects/_watchers/_rv/_kind_rv/_pending (RLock)
+store-deliver       watch-event FIFO delivery (RLock; reentrant for
+                    watchers that write back to the store)
+cluster             Cluster's node/binding/ack mirrors (RLock)
+batcher             Batcher trigger + in-flight bracket counters
+prestage            PendingPrestager clone cache + staged/reused/misses
+                    stats + worker thread handle
+metric / metric-    every _Metric's series maps / Registry._metrics (RLock)
+registry
+trace               TraceRecorder ring, windows, seq, dropped
+events              Recorder.events + dedupe map (RLock)
+clock               FakeClock._t
+leader              LeaderElector._leading/_last_renew
+nodepool-health     registration-health trackers (RLock)
+operator-server     OperatorServer httpd/thread handles
+==================  =======================================================
+
+SANCTIONED ORDER (acquire left before right; the dynamic graph must stay a
+DAG, and the sanitizer raises on the first acquisition that closes a
+cycle):
+
+    store-deliver  ->  { store, cluster, batcher, prestage, clock, metric* }
+    cluster        ->  { store, clock }
+    trace          ->  { metric-registry, metric }
+    events | store | batcher | prestage  ->  clock
+
+Everything else is leaf-only. Two rules keep it that way: (1) never WRITE
+to the store while holding `cluster` (a write drains watches under
+store-deliver — the reverse edge); (2) never solve, device-sync, or call
+`store._drain` while holding ANY lock (the lock-order rule flags those
+statically).
 """
 
 from .churn import ChurnHarness, ChurnReport, ChurnSpec  # noqa: F401

@@ -409,24 +409,27 @@ class ChurnHarness:
         past the warmup's high-water shapes and turn the segment into a
         compile storm instead of a serving measurement. Returns (events
         applied, solves run)."""
-        import threading
+        from ..obs.racecheck import make_event, spawn_thread
 
-        stop = threading.Event()
+        stop = make_event()
         applied = [0]
         if batch is None:
             batch = max(20, self.spec.arrivals // 8)
         backlog_cap = self.spec.arrivals * max(2, self.spec.bind_every - 1)
 
-        def driver():
+        # declared in the thread-shared registry ([tool.solverlint]
+        # thread-shared): the driver mutates only the store (lock-guarded),
+        # the harness's deques (atomic append/pop, single consumer per end),
+        # and the applied[0] cell it exclusively owns while running
+        def _churn_driver():
             while not stop.is_set():
                 if len(self._pending) < backlog_cap:
                     applied[0] += self.apply_arrivals(batch)
                     applied[0] += self.apply_cancels(int(batch * 0.75))
                 time.sleep(0.001)
 
-        t = threading.Thread(target=driver, name="churn-driver", daemon=True)
         solves0 = self.loop.solves
-        t.start()
+        t = spawn_thread(_churn_driver, name="churn-driver")
         deadline = time.perf_counter() + seconds
         try:
             while time.perf_counter() < deadline:

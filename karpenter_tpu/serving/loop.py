@@ -51,7 +51,7 @@ class ServingLoop:
     def pump(self, force: bool = False):
         """One serving iteration. Returns the solve's Results or None when
         the batcher window has not closed."""
-        if self.prestager is not None and self.prestager._thread is None:
+        if self.prestager is not None and not self.prestager.worker_running():
             self.prestager.pump()  # synchronous mode: drain before the solve
         results = self.provisioner.reconcile(force=force)
         if results is not None:

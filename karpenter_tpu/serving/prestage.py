@@ -38,10 +38,10 @@ Safety:
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 
 from ..kube.clone import fast_deepcopy
+from ..obs.racecheck import make_event, make_lock, spawn_thread, touch
 from ..utils import pods as pod_utils
 
 _MAX_ENTRIES = 500_000  # hard bound; a clear just re-stages on demand
@@ -68,14 +68,29 @@ class PendingPrestager:
     filled by a worker thread (double-buffer mode) and authoritatively on
     `take` misses, evicted by store watch events (bind/delete)."""
 
+    # racecheck guarded-field registry (analysis: guarded-field-access;
+    # runtime: obs.racecheck.touch at the stat increments). The cache AND
+    # the stat counters are written by the worker thread and the solve
+    # thread concurrently; `_queue` is deliberately absent — deque
+    # append/popleft are atomic and the queue is single-consumer.
+    GUARDED_FIELDS = {
+        "_cache": "_lock",
+        "_thread": "_lock",
+        "_stop": "_lock",
+        "staged": "_lock",
+        "reused": "_lock",
+        "misses": "_lock",
+    }
+
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("prestage")
         self._cache: dict[str, tuple[str, object]] = {}
         self._queue: deque = deque()
-        self._wake = threading.Event()
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
-        # stats (read by the churn harness/loop for attribution)
+        self._wake = make_event()
+        self._stop = make_event()
+        self._thread = None
+        # stats (read by the churn harness/loop for attribution), guarded by
+        # _lock like the cache they describe
         self.staged = 0  # clones prepared by the worker ahead of a take
         self.reused = 0  # takes served by an existing clone (delta identity)
         self.misses = 0  # takes that cloned inline (arrived un-staged)
@@ -90,21 +105,35 @@ class PendingPrestager:
 
     # -- worker ----------------------------------------------------------------
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._run, name="karpenter-prestage", daemon=True)
-        self._thread.start()
+        with self._lock:
+            if self._thread is not None:
+                return
+            # a FRESH stop event per worker generation: a start() racing the
+            # join window of a concurrent stop() must not resurrect the OLD
+            # worker by clearing the event it polls — each worker owns the
+            # event it was spawned with, so a set() stops exactly that one
+            self._stop = make_event()
+            self._thread = spawn_thread(self._run, name="karpenter-prestage", args=(self._stop,))
 
     def stop(self) -> None:
-        self._stop.set()
+        """Idempotent and double-call-safe: the thread handle is claimed
+        atomically, so two racing stop() calls join once and a stop() after
+        stop() is a no-op (the operator shutdown path can hit both)."""
+        with self._lock:
+            t, self._thread = self._thread, None
+            stop = self._stop
+        stop.set()
         self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        if t is not None:
+            t.join(timeout=5)
 
-    def _run(self) -> None:
-        while not self._stop.is_set():
+    def worker_running(self) -> bool:
+        with self._lock:
+            return self._thread is not None
+
+    def _run(self, stop) -> None:
+        # `stop` is this worker generation's own event (see start)
+        while not stop.is_set():
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             self.pump()
@@ -145,6 +174,7 @@ class PendingPrestager:
                 e2 = self._cache.get(uid)
                 if e2 is None or _rv_newer(rv, e2[0]):
                     self._cache[uid] = (rv, pod)
+                    touch(self, "staged")
                     self.staged += 1
                     n += 1
         return n
@@ -179,9 +209,14 @@ class PendingPrestager:
         rv = pod.metadata.resource_version
         with self._lock:
             e = self._cache.get(uid)
-        if e is not None and e[0] == rv:
-            self.reused += 1
-            return e[1]
+            if e is not None and e[0] == rv:
+                # stats mutate under the SAME lock as the cache: the worker
+                # thread bumps `staged` concurrently, and unlocked `+= 1`
+                # read-modify-writes lose updates under contention (the
+                # guarded-field-access rule pins these to _lock)
+                touch(self, "reused")
+                self.reused += 1
+                return e[1]
         clone = self._clone_and_stamp(pod)
         with self._lock:
             if len(self._cache) >= _MAX_ENTRIES:
@@ -192,11 +227,13 @@ class PendingPrestager:
             # clone wins and we hand IT out
             e2 = self._cache.get(uid)
             if e2 is not None and e2[0] == rv:
+                touch(self, "reused")
                 self.reused += 1
                 return e2[1]
             if e2 is None or _rv_newer(rv, e2[0]):
                 self._cache[uid] = (rv, clone)
-        self.misses += 1
+            touch(self, "misses")
+            self.misses += 1
         return clone
 
     def __len__(self) -> int:

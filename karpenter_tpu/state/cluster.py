@@ -12,10 +12,10 @@ unchanged snapshots (SURVEY.md §7 stage 3).
 from __future__ import annotations
 
 import itertools
-import threading
 from typing import Callable, Optional
 
 from ..apis import labels as wk
+from ..obs.racecheck import make_rlock
 from ..apis.nodeclaim import NodeClaim
 from ..scheduling.volumeusage import get_volumes
 from ..utils import pods as pod_utils
@@ -27,10 +27,25 @@ _EPOCH_COUNTER = itertools.count(1)
 
 
 class Cluster:
+    # racecheck guarded-field registry (analysis: guarded-field-access).
+    # Sanctioned order: `_lock` may acquire the store's lock (borrowed
+    # reads) and the clock's, never the reverse — see the serving-stack
+    # lock inventory in karpenter_tpu/serving/__init__.py.
+    GUARDED_FIELDS = {
+        "_nodes": "_lock",
+        "_node_name_to_provider_id": "_lock",
+        "_nodeclaim_name_to_provider_id": "_lock",
+        "_bindings": "_lock",
+        "_anti_affinity_pods": "_lock",
+        "_pod_acks": "_lock",
+        "_consolidated_at": "_lock",
+        "_buffer_pod_counts": "_lock",
+    }
+
     def __init__(self, store, clock):
         self.store = store
         self.clock = clock
-        self._lock = threading.RLock()
+        self._lock = make_rlock("cluster")
         self._nodes: dict[str, StateNode] = {}  # provider-id (or node name) -> StateNode
         self._node_name_to_provider_id: dict[str, str] = {}
         self._nodeclaim_name_to_provider_id: dict[str, str] = {}
@@ -143,7 +158,11 @@ class Cluster:
             self._consolidated_at = self.clock.now()
 
     def mark_unconsolidated(self) -> None:
-        self._consolidated_at = 0.0
+        # also called directly as a store watch callback (informer
+        # NodePool/DaemonSet subscriptions) on the delivery thread — the
+        # write needs the lock there; reentrant under _bump's callers
+        with self._lock:
+            self._consolidated_at = 0.0
 
     # -- updates (driven by informers; cluster.go:360-442) ---------------------
     def update_node(self, node) -> None:
@@ -315,18 +334,18 @@ class Cluster:
             self._bump(rows=rows)
 
     # -- helpers ---------------------------------------------------------------
-    def _state_node_for(self, node_name: str) -> Optional[StateNode]:
+    def _state_node_for(self, node_name: str) -> Optional[StateNode]:  # solverlint: ok(guarded-field-access): caller-holds contract — every call site sits inside `with self._lock`
         pid = self._node_name_to_provider_id.get(node_name)
         return self._nodes.get(pid) if pid else None
 
-    def _remove_pod_usage(self, key: str) -> None:
+    def _remove_pod_usage(self, key: str) -> None:  # solverlint: ok(guarded-field-access): caller-holds contract — invoked only from update_pod/delete_pod under `with self._lock`
         node_name = self._bindings.pop(key, None)
         if node_name is not None:
             sn = self._state_node_for(node_name)
             if sn is not None:
                 sn.cleanup_for_pod(key)
 
-    def _rebind_pods_for_node(self, node_name: str) -> None:
+    def _rebind_pods_for_node(self, node_name: str) -> None:  # solverlint: ok(guarded-field-access): caller-holds contract — invoked only from update_node under `with self._lock`
         """When a node (re)appears, replay bound pods onto its StateNode."""
         sn = self._state_node_for(node_name)
         if sn is None:

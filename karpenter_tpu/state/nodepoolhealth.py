@@ -7,8 +7,7 @@ window flips the pool's NodeRegistrationHealthy condition False.
 
 from __future__ import annotations
 
-import threading
-
+from ..obs.racecheck import make_rlock
 from ..utils.ringbuffer import RingBuffer
 
 BUFFER_SIZE = 4
@@ -20,8 +19,10 @@ STATUS_UNHEALTHY = "Unhealthy"
 
 
 class Tracker:
+    GUARDED_FIELDS = {"_buffer": "_lock"}
+
     def __init__(self, capacity: int = BUFFER_SIZE):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("nodepool-health")
         self._capacity = capacity
         self._buffer: RingBuffer[bool] = RingBuffer(capacity)
 
@@ -54,8 +55,10 @@ class Tracker:
 class NodePoolHealthState:
     """Map of NodePool UID -> Tracker (reference: tracker.go State)."""
 
+    GUARDED_FIELDS = {"_trackers": "_lock"}
+
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("nodepool-health")
         self._trackers: dict[str, Tracker] = {}
 
     def _tracker(self, uid: str) -> Tracker:

@@ -6,8 +6,9 @@ fake-clock discipline the reference uses throughout its suites.
 
 from __future__ import annotations
 
-import threading
 import time
+
+from ..obs.racecheck import make_lock
 
 
 class Clock:
@@ -24,9 +25,11 @@ class Clock:
 class FakeClock(Clock):
     """Deterministic clock; step() advances time manually."""
 
+    GUARDED_FIELDS = {"_t": "_lock"}
+
     def __init__(self, start: float = 1_000_000.0):
         self._t = start
-        self._lock = threading.Lock()
+        self._lock = make_lock("clock")
 
     def now(self) -> float:
         with self._lock:

@@ -14,6 +14,14 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # re-validates its arrays, and mask_encode's read-only freeze turns any
 # shared-array mutation into a hard error instead of silent cache corruption
 os.environ.setdefault("KARPENTER_SOLVER_TYPECHECK", "1")
+# ... and with the runtime concurrency sanitizer ON (obs/racecheck.py):
+# every make_lock/make_rlock in the serving stack becomes an instrumented
+# lock that records the dynamic lock-order graph (raising on any inversion),
+# enforces GUARDED_FIELDS owner-thread checks at the declared touch points,
+# and feeds the karpenter_solver_lock_wait_seconds histogram. The whole
+# suite is the sanitizer's corpus — a lock-order inversion anywhere in
+# tier-1 fails that test at the acquisition site.
+os.environ.setdefault("KARPENTER_SOLVER_RACECHECK", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

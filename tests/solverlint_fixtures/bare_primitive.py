@@ -1,0 +1,31 @@
+"""solverlint fixture: bare-thread-primitive. Never imported — parsed only.
+
+Seeds three violations (a raw Lock, a raw Event, and a from-import-aliased
+Lock — renames resolve through the import table instead of evading the
+rule); the pragma'd twin is suppressed, and `threading.local()` is
+deliberately exempt (thread-LOCAL state is the opposite of shared state).
+"""
+
+import threading
+from threading import Lock as _SneakyLock
+
+
+def bad_lock():
+    return threading.Lock()
+
+
+def bad_from_import_alias():
+    # a rename must not evade the rule: resolved through the import table
+    return _SneakyLock()
+
+
+def bad_event():
+    return threading.Event()
+
+
+def ok_pragma():
+    return threading.Lock()  # solverlint: ok(bare-thread-primitive): fixture — proves the pragma form suppresses
+
+
+def ok_thread_local():
+    return threading.local()  # exempt: must NOT be flagged

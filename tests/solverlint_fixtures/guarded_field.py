@@ -1,0 +1,35 @@
+"""solverlint fixture: guarded-field-access. Never imported — parsed only.
+
+`bad_*` methods seed violations (a write AND a read outside the declared
+lock — reads race too); `ok_*` show the three sanctioned forms: the lock
+held (including nested withs), a line pragma, and the method-level
+caller-holds contract (pragma on the `def` line).
+"""
+
+
+class FixtureStats:
+    GUARDED_FIELDS = {"hits": "_lock", "misses": "_lock"}
+
+    def __init__(self):
+        self._lock = make_lock("fixture-stats")  # noqa: F821 — fixture, parsed only
+        self.hits = 0
+        self.misses = 0
+
+    def bad_bump(self):
+        self.hits += 1
+
+    def bad_read(self):
+        return self.misses
+
+    def ok_locked(self):
+        with self._lock:
+            self.hits += 1
+            if self.hits > 10:
+                self.misses = 0  # still inside the with: must NOT be flagged
+
+    def ok_pragma(self):
+        self.hits += 1  # solverlint: ok(guarded-field-access): fixture — proves the pragma form suppresses
+
+    def _ok_caller_holds(self):  # solverlint: ok(guarded-field-access): fixture — caller-holds method contract, every call site verified
+        self.hits += 1
+        self.misses -= 1

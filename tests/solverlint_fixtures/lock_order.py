@@ -1,0 +1,35 @@
+"""solverlint fixture: lock-order. Never imported — parsed only.
+
+Seeds two violations: `forward`+`backward` acquire the same pair of locks in
+both orders (one cycle finding, reported once at finalize), and
+`bad_blocking` runs a solve while holding a lock. `ok_pragma_edge` shows the
+edge-level pragma that excludes a reviewed acquisition from the graph.
+"""
+
+import threading
+
+
+class FixtureInverted:
+    def __init__(self):
+        self._a = threading.Lock()  # solverlint: ok(bare-thread-primitive): fixture — raw locks keep this file self-contained
+        self._b = threading.Lock()  # solverlint: ok(bare-thread-primitive): fixture — raw locks keep this file self-contained
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self):
+        # the combined multi-item form acquires sequentially — it orders
+        # b before a exactly like nested withs and must close the cycle
+        with self._b, self._a:
+            pass
+
+    def bad_blocking(self, solver, snapshot):
+        with self._a:
+            return solver.solve(snapshot)
+
+    def ok_pragma_edge(self):
+        with self._b:
+            with self._a:  # solverlint: ok(lock-order): fixture — proves the edge-level pragma excludes a reviewed acquisition
+                pass

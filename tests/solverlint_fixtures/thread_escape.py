@@ -1,0 +1,29 @@
+"""solverlint fixture: thread-escape. Never imported — parsed only.
+
+Seeds three violations: an unregistered Thread target, an unregistered
+store-watch callback, and a lambda callback (invisible capture is flagged
+outright). The pragma'd twin must be suppressed.
+"""
+
+import threading
+from threading import Thread as _SneakyThread
+
+
+class FixtureEscapee:
+    def bad_from_import_thread(self):
+        # a renamed from-import must not evade the registry check
+        t = _SneakyThread(target=self._other)  # solverlint: ok(bare-thread-primitive): fixture — the escape is the point, not the construction
+        t.start()
+
+    def bad_thread(self):
+        self._t = threading.Thread(target=self._run, daemon=True)  # solverlint: ok(bare-thread-primitive): fixture — the escape is the point, not the construction
+        self._t.start()
+
+    def bad_watch(self, store):
+        store.watch("Pod", self._on_pod)
+
+    def bad_lambda(self, store):
+        store.watch("Node", lambda e, n: self.mark(n))
+
+    def ok_pragma(self, store):
+        store.watch("Pod", self._on_pod)  # solverlint: ok(thread-escape): fixture — proves the pragma form suppresses

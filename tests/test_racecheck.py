@@ -1,0 +1,343 @@
+"""racecheck: the runtime concurrency sanitizer (ISSUE 11).
+
+Pins the wrapper's contracts:
+- off-mode bit-parity: with KARPENTER_SOLVER_RACECHECK unset/0 the factories
+  return the PLAIN threading primitives (zero overhead, identical types);
+- guarded-field enforcement: touching a GUARDED_FIELDS-declared field
+  without its lock raises; with the lock held it passes;
+- lock-order: a dynamic inversion (even a transitive 3-cycle) raises at the
+  acquisition site; reentrant RLock re-acquisition records no edge;
+- observability: wait-time stats land in the named-lock histogram, long
+  holds are recorded as outliers;
+- the race fixes the static rules drove: prestager stats stay consistent
+  under a take/pump hammer, and the OperatorServer/PendingPrestager stop()
+  paths survive double and concurrent calls;
+- the threaded churn stress: `ChurnHarness.run_concurrent` under the
+  sanitizer records ZERO violations.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from karpenter_tpu.obs import racecheck
+from karpenter_tpu.obs.racecheck import (
+    InstrumentedLock,
+    RaceCheckError,
+    make_event,
+    make_lock,
+    make_rlock,
+    racecheck_enabled,
+    spawn_thread,
+    touch,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    # each test starts from an empty order graph; the suite-wide graph the
+    # other suites accumulate is not this file's subject
+    racecheck.reset()
+    yield
+    racecheck.reset()
+
+
+class TestFactoryParity:
+    def test_off_mode_returns_plain_primitives(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_RACECHECK", "0")
+        racecheck._refresh()
+        try:
+            assert isinstance(make_lock("x"), type(threading.Lock()))
+            assert isinstance(make_rlock("x"), type(threading.RLock()))
+        finally:
+            racecheck._refresh()
+
+    def test_on_mode_returns_instrumented(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_RACECHECK", "1")
+        racecheck._refresh()
+        try:
+            lk = make_lock("x")
+            assert isinstance(lk, InstrumentedLock)
+            with lk:
+                assert lk.held_by_me and lk.locked()
+            assert not lk.locked()
+        finally:
+            racecheck._refresh()
+
+    def test_event_and_thread_wrappers(self):
+        ev = make_event()
+        hits = []
+        t = spawn_thread(lambda: (ev.wait(5), hits.append(1)), name="racecheck-test")
+        ev.set()
+        t.join(timeout=5)
+        assert hits == [1]
+
+    def test_conftest_enables_sanitizer(self):
+        assert racecheck_enabled()
+
+
+class TestInstrumentedLock:
+    def test_with_and_acquire_release(self):
+        lk = InstrumentedLock("t-basic")
+        with lk:
+            assert lk.held_by_me
+        assert lk.acquire()
+        lk.release()
+
+    def test_non_reentrant_relock_raises_instead_of_deadlocking(self):
+        lk = InstrumentedLock("t-relock")
+        with lk:
+            with pytest.raises(RaceCheckError, match="re-acquired"):
+                lk.acquire()
+
+    def test_reentrant_rlock_allows_and_records_no_self_edge(self):
+        lk = InstrumentedLock("t-rlock", reentrant=True)
+        with lk:
+            with lk:
+                assert lk.held_by_me
+        assert not lk.locked()
+        assert racecheck.snapshot()["edges"] == {}
+
+    def test_foreign_release_raises(self):
+        lk = InstrumentedLock("t-foreign")
+        lk.acquire()
+        err = []
+        t = spawn_thread(lambda: err.append(isinstance(_try_release(lk), RaceCheckError)))
+        t.join(timeout=5)
+        lk.release()
+        assert err == [True]
+
+
+def _try_release(lk):
+    try:
+        lk.release()
+    except Exception as e:  # noqa: BLE001
+        return e
+    return None
+
+
+class TestLockOrder:
+    def test_direct_inversion_raises(self):
+        a, b = InstrumentedLock("t-a"), InstrumentedLock("t-b")
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(RaceCheckError, match="inversion"):
+                with a:
+                    pass
+        assert racecheck.snapshot()["violations"]
+
+    def test_transitive_cycle_raises(self):
+        # a->b, b->c, then c->a: no directly reversed edge anywhere, but the
+        # closure is a cycle — the reachability check must catch it
+        a, b, c = InstrumentedLock("t-x"), InstrumentedLock("t-y"), InstrumentedLock("t-z")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with pytest.raises(RaceCheckError, match="inversion"):
+                with a:
+                    pass
+
+    def test_consistent_order_is_clean(self):
+        a, b = InstrumentedLock("t-c1"), InstrumentedLock("t-c2")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        snap = racecheck.snapshot()
+        assert ("t-c1", "t-c2") in snap["edges"]
+        assert snap["violations"] == []
+
+    def test_same_name_nesting_records_no_edge(self):
+        # two instances of one lock CLASS share a graph node; nesting them
+        # is not an order relation (e.g. two different metric objects)
+        a, b = InstrumentedLock("t-same"), InstrumentedLock("t-same")
+        with a:
+            with b:
+                pass
+        assert racecheck.snapshot()["edges"] == {}
+
+
+class TestGuardedFields:
+    class Stats:
+        GUARDED_FIELDS = {"hits": "_lock"}
+
+        def __init__(self):
+            self._lock = InstrumentedLock("t-stats")
+            self.hits = 0
+
+    def test_touch_without_lock_raises(self):
+        s = self.Stats()
+        with pytest.raises(RaceCheckError, match="without holding"):
+            touch(s, "hits")
+
+    def test_touch_with_lock_passes(self):
+        s = self.Stats()
+        with s._lock:
+            touch(s, "hits")
+            s.hits += 1
+        assert racecheck.snapshot()["touch_checks"] >= 1
+
+    def test_undeclared_field_raises(self):
+        s = self.Stats()
+        with pytest.raises(RaceCheckError, match="not declared"):
+            touch(s, "nope")
+
+
+class TestObservability:
+    def test_wait_stats_and_histogram(self):
+        from karpenter_tpu import metrics as m
+
+        reg = m.make_registry()
+        racecheck.set_metrics_registry(reg)
+        try:
+            lk = InstrumentedLock("t-wait")
+            with lk:
+                pass
+            snap = racecheck.snapshot()
+            assert snap["wait"]["t-wait"][0] >= 1
+            assert reg.histogram(m.SOLVER_LOCK_WAIT_SECONDS).count(lock="t-wait") >= 1
+        finally:
+            racecheck.set_metrics_registry(None)
+
+    def test_hold_outlier_recorded(self, monkeypatch):
+        monkeypatch.setattr(racecheck, "_HOLD_OUTLIER_SECONDS", 0.0)
+        lk = InstrumentedLock("t-hold")
+        with lk:
+            time.sleep(0.002)
+        outliers = racecheck.snapshot()["hold_outliers"]
+        assert outliers and outliers[0][0] == "t-hold" and outliers[0][1] > 0
+
+
+class TestRaceFixes:
+    def test_prestager_stats_consistent_under_hammer(self):
+        """The PR's seed race: staged/reused/misses were bumped outside
+        _lock, so concurrent takes lost increments. Now every take lands in
+        exactly one bucket."""
+        from karpenter_tpu.kube.objects import Container, ObjectMeta, Pod, PodSpec
+        from karpenter_tpu.serving.prestage import PendingPrestager
+
+        p = PendingPrestager()
+        pods = [
+            Pod(metadata=ObjectMeta(name=f"h{i}", namespace="default", uid=f"uid-h{i}", resource_version=1),
+                spec=PodSpec(containers=[Container()]))
+            for i in range(40)
+        ]
+        n_threads, rounds = 4, 25
+        barrier = threading.Barrier(n_threads)
+
+        def hammer():
+            barrier.wait()
+            for _ in range(rounds):
+                for pod in pods:
+                    p.take(pod)
+
+        threads = [spawn_thread(hammer, name=f"hammer-{i}") for i in range(n_threads)]
+        for t in threads:
+            t.join(timeout=30)
+        total = n_threads * rounds * len(pods)
+        assert p.reused + p.misses == total, (p.reused, p.misses, total)
+        # identity contract held: each pod cloned at most once per rv
+        assert p.misses >= len(pods)
+        assert racecheck.snapshot()["violations"] == []
+
+    def test_prestager_stop_idempotent_and_concurrent(self):
+        from karpenter_tpu.serving.prestage import PendingPrestager
+
+        p = PendingPrestager()
+        p.start()
+        assert p.worker_running()
+        threads = [spawn_thread(p.stop, name=f"stop-{i}") for i in range(4)]
+        for t in threads:
+            t.join(timeout=10)
+        p.stop()  # and once more, serially
+        assert not p.worker_running()
+        p.start()  # restartable after a full stop
+        assert p.worker_running()
+        p.stop()
+
+    def test_prestager_start_during_stop_does_not_resurrect_old_worker(self):
+        """Regression: stop() used to share one _stop event with every
+        worker generation, so a start() landing in stop()'s join window
+        cleared the event the OLD worker polls — leaving two live _run
+        consumers on the single-consumer queue. Each generation now owns
+        its stop event."""
+        from karpenter_tpu.serving.prestage import PendingPrestager
+
+        p = PendingPrestager()
+        for _ in range(10):
+            p.start()
+            old = p._thread
+            stopper = spawn_thread(p.stop, name="race-stop")
+            p.start()  # may land anywhere inside stop(): claim, set, join
+            stopper.join(timeout=10)
+            old.join(timeout=5)
+            assert not old.is_alive()  # the old generation always dies
+            p.stop()
+        assert not p.worker_running()
+
+    def test_operator_server_start_is_idempotent(self):
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.server import OperatorServer
+
+        env = Environment()
+        srv = OperatorServer(env, port=0, bind="127.0.0.1")
+        port = srv.start()
+        assert srv.start() == port  # second start: same listener, no leak
+        srv.stop()
+        assert srv._httpd is None
+
+    def test_operator_server_stop_idempotent_and_concurrent(self):
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.server import OperatorServer
+
+        env = Environment()
+        srv = OperatorServer(env, port=0, bind="127.0.0.1")
+        srv.start()
+        threads = [spawn_thread(srv.stop, name=f"srvstop-{i}") for i in range(4)]
+        for t in threads:
+            t.join(timeout=10)
+        srv.stop()  # double-call after the fact is a no-op
+        assert srv._httpd is None
+
+
+class TestThreadedChurnStress:
+    def test_run_concurrent_zero_violations(self):
+        """The acceptance gate: the live serving stack (store watch delivery,
+        batcher coalescing, prestager worker, churn driver thread) under the
+        sanitizer — zero guarded-field or lock-order violations."""
+        from karpenter_tpu.serving import ChurnHarness, ChurnSpec
+
+        assert racecheck_enabled()
+        spec = ChurnSpec(
+            n_base_pods=120,
+            n_types=10,
+            arrivals=30,
+            cancels=24,
+            departures=30,
+            bind_every=2,
+            iterations=2,
+            warmup_cycles=1,
+            concurrent_seconds=0.0,
+            worker=True,  # the real prestager worker thread, overlapping takes
+        )
+        h = ChurnHarness(spec).build()
+        try:
+            h.provision_base_fleet()
+            h.run_cycle()
+            events, solves = h.run_concurrent(1.0)
+            assert events > 0 and solves > 0
+        finally:
+            h.close()
+        snap = racecheck.snapshot()
+        assert snap["violations"] == [], snap["violations"]
+        # the sanitizer demonstrably observed the serving stack's locks
+        assert {"store", "store-deliver", "batcher", "prestage", "cluster"} <= set(snap["wait"])

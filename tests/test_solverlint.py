@@ -1,7 +1,8 @@
-"""Tier-1 gate for solverlint (ISSUE 4): the repo is clean under all five
-rules, each rule catches its seeded fixture violation and honors the pragma
-suppression form, the --self-test discovery gate is healthy, and the runtime
-shape contracts (solver/contracts.py) catch seeded drifts."""
+"""Tier-1 gate for solverlint (ISSUE 4 + the ISSUE 11 concurrency rules):
+the repo is clean under all nine rules, each rule catches its seeded fixture
+violation and honors the pragma suppression form, the --self-test discovery
+gate is healthy, and the runtime shape contracts (solver/contracts.py) catch
+seeded drifts."""
 
 import os
 from pathlib import Path
@@ -43,14 +44,18 @@ class TestRepoGate:
         assert lint_main([str(tmp_path / "nope.py")]) == 2
         assert lint_main([str(tmp_path)]) == 2
 
-    def test_rule_registry_holds_at_least_five_rules(self):
-        assert len(RULES) >= 5
+    def test_rule_registry_holds_all_rules(self):
+        assert len(RULES) >= 9
         assert set(RULES) == {
             "shared-array-mutation",
             "host-sync-in-hot-path",
             "python-loop-over-pod-axis",
             "reason-family-tiers",
             "metric-label-cardinality",
+            "guarded-field-access",
+            "lock-order",
+            "thread-escape",
+            "bare-thread-primitive",
         }
 
     def test_shared_field_registry_extraction(self):
@@ -104,6 +109,108 @@ class TestRuleFixtures:
         by_msg = [f.message for f in findings]
         assert sum("not statically enumerable" in m for m in by_msg) == 2
         assert sum("splat" in m for m in by_msg) == 1
+
+    def test_guarded_field_access(self):
+        # a read AND a write outside the declared lock are both findings;
+        # nested withs, the line pragma, and the caller-holds method pragma
+        # are the sanctioned forms
+        findings = _fixture_findings("guarded-field-access", "guarded_field.py")
+        assert len(findings) == 2, findings
+        msgs = " | ".join(f.message for f in findings)
+        assert "'hits'" in msgs and "'misses'" in msgs and "_lock" in msgs
+
+    def test_lock_order(self):
+        findings = _fixture_findings("lock-order", "lock_order.py")
+        assert len(findings) == 2, findings
+        msgs = sorted(f.message for f in findings)
+        assert any("blocking call solver.solve()" in m for m in msgs)
+        # the cycle is reported ONCE (the nested forward and the COMBINED
+        # `with self._b, self._a:` backward fold into one finding) and
+        # names the full path plus the inventory doc
+        cycles = [m for m in msgs if "lock-order cycle" in m]
+        assert len(cycles) == 1
+        assert "FixtureInverted._a -> FixtureInverted._b -> FixtureInverted._a" in cycles[0]
+        assert "serving/__init__.py" in cycles[0]
+
+    def test_thread_escape(self):
+        findings = _fixture_findings("thread-escape", "thread_escape.py")
+        assert len(findings) == 4, findings
+        msgs = " | ".join(f.message for f in findings)
+        assert "thread target self._run" in msgs
+        assert "thread target self._other" in msgs  # renamed from-import resolved
+        assert "watch callback self._on_pod" in msgs
+        assert "lambda" in msgs
+
+    def test_bare_thread_primitive(self):
+        findings = _fixture_findings("bare-thread-primitive", "bare_primitive.py")
+        assert len(findings) == 3, findings
+        msgs = " | ".join(f.message for f in findings)
+        assert "threading.Lock()" in msgs and "threading.Event()" in msgs
+        # a from-import rename resolves through the import table
+        assert "_SneakyLock() constructs threading.Lock" in msgs
+        # threading.local is exempt by design
+        assert "threading.local" not in msgs
+
+    def test_lock_order_catches_seeded_store_inversion(self, tmp_path):
+        """Seeded REAL-module regressions: the store's own `_deliver_lock`
+        -> `_lock` edge (the `_drain` pop) is live in the graph, so (a) an
+        inverted nesting added anywhere in store.py closes a cycle, and (b)
+        `_drain` moved under `_lock` is both a blocking-call finding and a
+        call-graph cycle."""
+        from karpenter_tpu.analysis.core import repo_root
+
+        src = (repo_root() / "karpenter_tpu" / "kube" / "store.py").read_text()
+
+        inverted = src.replace(
+            "    def kind_revision(self, kind: str) -> int:\n"
+            "        with self._lock:\n"
+            "            return self._kind_rv.get(kind, 0)",
+            "    def kind_revision(self, kind: str) -> int:\n"
+            "        with self._lock:\n"
+            "            with self._deliver_lock:\n"
+            "                return self._kind_rv.get(kind, 0)",
+        )
+        assert inverted != src
+        p = tmp_path / "store_inverted.py"
+        p.write_text(inverted)
+        findings = run_analysis(rules=["lock-order"], paths=[p])
+        assert any("cycle" in f.message and "_deliver_lock" in f.message for f in findings), findings
+
+        drained = src.replace(
+            '            kind_map[key] = obj\n            self._enqueue("ADDED", obj)\n        self._drain()',
+            '            kind_map[key] = obj\n            self._enqueue("ADDED", obj)\n            self._drain()',
+        )
+        assert drained != src
+        p2 = tmp_path / "store_drain_under_lock.py"
+        p2.write_text(drained)
+        findings = run_analysis(rules=["lock-order"], paths=[p2])
+        assert any("blocking call self._drain()" in f.message for f in findings), findings
+        assert any("cycle" in f.message for f in findings), findings
+
+    def test_guarded_field_catches_seeded_prestage_unguard(self, tmp_path):
+        """Seeded real-module regression: the PR's original race — a
+        prestager stat bumped outside `_lock` — is a finding the moment it
+        reappears."""
+        from karpenter_tpu.analysis.core import repo_root
+
+        src = (repo_root() / "karpenter_tpu" / "serving" / "prestage.py").read_text()
+        unguarded = src.replace(
+            '            touch(self, "misses")\n            self.misses += 1\n        return clone',
+            "        self.misses += 1\n        return clone",
+        )
+        assert unguarded != src
+        p = tmp_path / "prestage_unguarded.py"
+        p.write_text(unguarded)
+        findings = run_analysis(rules=["guarded-field-access"], paths=[p])
+        assert any("'misses'" in f.message for f in findings), findings
+
+    def test_thread_shared_registry_sanctions_real_seams(self):
+        # the real serving-stack seams pass: prestage registers its worker
+        # and watch callback, the churn driver is a named reviewed function
+        from karpenter_tpu.analysis.core import repo_root
+
+        for mod in ("serving/prestage.py", "serving/churn.py", "state/informer.py"):
+            assert run_analysis(rules=["thread-escape"], paths=[repo_root() / "karpenter_tpu" / mod]) == []
 
     def test_pragma_without_justification_is_itself_a_finding(self, tmp_path):
         p = tmp_path / "naked_pragma.py"
