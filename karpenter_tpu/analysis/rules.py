@@ -431,13 +431,14 @@ class MetricLabelCardinalityRule(Rule):
     description = "bounded metric labels must carry statically enumerable values"
     _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
 
-    # the seeded violation is a racecheck lock-label one: a wait-time
-    # histogram whose `lock` label carries a runtime value instead of the
-    # static make_lock call-site enum — exactly the drift the instrumented
-    # wrapper's emission must never regress into
+    # the seeded violation is a delta-reject one: the reject counter's
+    # `reason` label fed a runtime-formatted value instead of a
+    # DELTA_REJECT_REASONS literal — exactly the drift the delta-path
+    # producers (encode._try_delta_encode / TPUSolver._note_delta_reject)
+    # must never regress into
     SELF_TEST_BAD = (
-        "def record(registry, lk, dt):\n"
-        '    registry.histogram("karpenter_solver_lock_wait_seconds").observe(dt, lock=repr(lk))\n'
+        "def record(registry, why):\n"
+        '    registry.counter("karpenter_solver_delta_reject_total").inc(reason="delta-" + str(why))\n'
     )
     SELF_TEST_OK = (
         "def record(registry, pod):\n"

@@ -66,6 +66,11 @@ SOLVER_FALLBACK_TOTAL = "karpenter_solver_fallback_total"
 SOLVER_VALIDATION_FAILURES_TOTAL = "karpenter_solver_validation_failures_total"
 SOLVER_HYBRID_RESIDUAL_TOTAL = "karpenter_solver_hybrid_residual_total"
 SOLVER_DECODE_REPAIR_TOTAL = "karpenter_solver_decode_repair_total"
+# why a delta-capable solve routed to the full path anyway; reason is the
+# bounded encode.DELTA_REJECT_REASONS enum ({unseen-sig, row-key, vol-rv,
+# pvc, cap, reorder, fallback-global, irreversible, slot-exhausted,
+# validate, no-carry}) — the churn harness's per-reason full-solve breakdown
+SOLVER_DELTA_REJECT_TOTAL = "karpenter_solver_delta_reject_total"
 SOLVER_ENCODE_SECONDS = "karpenter_solver_encode_seconds"
 SOLVER_FFD_MEMO_TOTAL = "karpenter_solver_ffd_memo_total"
 SOLVER_FFD_PHASE_SECONDS = "karpenter_solver_ffd_phase_seconds"
@@ -151,6 +156,11 @@ def make_registry() -> Registry:
     r.counter(
         SOLVER_DECODE_REPAIR_TOTAL,
         "Tensor decodes that routed part of the placement through the bounded host repair, by reason family",
+        ("reason",),
+    )
+    r.counter(
+        SOLVER_DELTA_REJECT_TOTAL,
+        "Delta-capable solves routed to the full path, by reject reason",
         ("reason",),
     )
     # backend label values for SOLVER_SOLVE_TOTAL include "hybrid-delta":

@@ -187,13 +187,23 @@ def highwater_enabled() -> bool:
 
 
 def bucket_hw(axis: str, n: int, m: int) -> int:
-    """`bucket(n, m)`, raised to the axis' process-global high-water mark."""
+    """`bucket(n, m)`, raised to the axis' process-global high-water mark.
+
+    Growth past an ESTABLISHED mark overshoots geometrically (≥ 12.5%
+    headroom, rounded to the bucket): repeated small growth — signature-
+    growing deltas, slow fleet expansion — costs O(log growth) compiles
+    instead of one per bucket crossing. BENCH_r06's 7s mixed-churn cliff was
+    exactly this: one new signature landed the item axis on a bucket
+    boundary and the solve paid a fresh multi-second pack compile; with
+    headroom the next several growths stay inside the compiled shape."""
     t = -(-max(n, 1) // m) * m
     if not highwater_enabled():
         return t
     hw = _BUCKET_HW.get(axis, 0)
     if t <= hw:
         return hw
+    if hw:
+        t = max(t, -(-(hw + max(m, hw // 8)) // m) * m)
     _BUCKET_HW[axis] = t
     return t
 

@@ -261,9 +261,14 @@ class SolveTrace:
         elif self.mode == "fallback":
             lines.append(f"  why fallback: {self.families} — whole snapshot on the host FFD")
         elif self.mode == "delta":
+            refresh = " + row refresh" if a.get("row_refresh") else ""
             lines.append(
                 f"  why delta: pod delta of the previous solve "
-                f"(+{a.get('delta_added', 0)}/-{a.get('delta_removed', 0)} pods) re-packed from device-resident state"
+                f"(+{a.get('delta_added', 0)}/-{a.get('delta_removed', 0)} pods{refresh}) re-packed from device-resident state"
+            )
+        if a.get("delta_reject"):
+            lines.append(
+                f"  why not delta: {a['delta_reject']} — the delta classifier routed this solve to the full path"
             )
         if a.get("repair_pods"):
             lines.append(

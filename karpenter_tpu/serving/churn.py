@@ -91,6 +91,11 @@ class ChurnReport:
     p99_solve_seconds: float = 0.0
     recompiles: dict = field(default_factory=dict)
     steady_recompiles: int = 0
+    # steady-phase full-solve share broken down by delta-reject reason (the
+    # karpenter_solver_delta_reject_total counter, windowed over the steady
+    # mark) — so a delta-hit regression names the reject family that caused
+    # it instead of a bare hit-rate drop
+    full_solve_reasons: dict = field(default_factory=dict)
     coalesced_triggers: int = 0
     concurrent_events: int = 0
     concurrent_solves: int = 0
@@ -112,6 +117,7 @@ class ChurnReport:
             "p99_solve_seconds": round(self.p99_solve_seconds, 4),
             "recompiles": dict(self.recompiles),
             "steady_recompiles": self.steady_recompiles,
+            "full_solve_reasons": dict(self.full_solve_reasons),
             "coalesced_triggers": self.coalesced_triggers,
             "concurrent_events": self.concurrent_events,
             "concurrent_solves": self.concurrent_solves,
@@ -369,6 +375,7 @@ class ChurnHarness:
         # -- steady phase ------------------------------------------------------
         self.prebuild(s.arrivals * s.iterations)
         mark = self.recorder.seq
+        rejects0 = self._reject_counts()
         coalesced0 = self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total()
         reused0 = self.loop.prestager.reused if self.loop.prestager is not None else 0
         staged0 = self.loop.prestager.staged if self.loop.prestager is not None else 0
@@ -380,6 +387,10 @@ class ChurnHarness:
             done += s.bind_every
         wall = time.perf_counter() - t0
         rep = self._report(mark, events, wall, coalesced0, reused0, staged0)
+        rejects1 = self._reject_counts()
+        rep.full_solve_reasons = {
+            k: int(v - rejects0.get(k, 0)) for k, v in rejects1.items() if v > rejects0.get(k, 0)
+        }
         if s.concurrent_seconds > 0:
             cev, csolves = self.run_concurrent(s.concurrent_seconds)
             rep.concurrent_events = cev
@@ -444,6 +455,13 @@ class ChurnHarness:
             self.solve(force=True)
             self.bind_flush()
         return applied[0], self.loop.solves - solves0
+
+    def _reject_counts(self) -> dict:
+        """Current delta-reject counter values by reason (cumulative)."""
+        out: dict = {}
+        for labels, v in self.env.registry.counter(m.SOLVER_DELTA_REJECT_TOTAL).collect():
+            out[labels.get("reason", "?")] = v
+        return out
 
     def _report(self, mark: int, events: int, wall: float, coalesced0: float = 0.0, reused0: int = 0, staged0: int = 0) -> ChurnReport:
         traces = [t for t in self.recorder.traces() if t.seq > mark and t.mode not in ("", "consolidate")]

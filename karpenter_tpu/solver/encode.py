@@ -269,6 +269,16 @@ class EncodedSnapshot:
     # recompute `has_relaxable` for a pod subset without re-reading pod specs
     sig_relaxable: np.ndarray | None = None  # [S] bool
     pools_prefer: bool = False
+    # encode-time metadata retained so the delta path can GROW the signature
+    # axis (`_grow_signatures`) and refresh the volatile row side
+    # (`_try_row_refresh`) without a full re-encode: the topology groups'
+    # identity/selector records (parallel to the group axis), the host-port
+    # vocabularies, and whether inverse anti-affinity blocks were applied
+    # (those lower from RUNNING pods, which no row-key component can see)
+    group_meta: list | None = None  # [G] dicts: ident/kind/dom_key/selector/ns
+    port_key_ids: dict | None = None  # (port, proto) -> P1 column
+    port_spec_ids: dict | None = None  # (ip, port, proto) -> P2 column
+    inverse_blocked: bool = False
 
     @property
     def n_rows(self) -> int:
@@ -1253,6 +1263,7 @@ def mask_encode(enc: EncodedSnapshot, keep_sig_ids) -> EncodedSnapshot:
         group_registered=enc.group_registered[gidx],
         counts_dom_init=enc.counts_dom_init[gidx],
         counts_host_existing=enc.counts_host_existing[gidx],
+        group_meta=[enc.group_meta[int(g)] for g in gidx] if enc.group_meta is not None else None,
         fallback_reasons=[],
         fallback_sig_local=frozenset(),
         fallback_has_global=False,
@@ -1647,6 +1658,28 @@ class _RowArtifacts:
     decode_cache: dict = field(default_factory=dict)
 
 
+# Why a delta-capable solve routed to the full path anyway — the bounded
+# value set of the `karpenter_solver_delta_reject_total{reason}` counter and
+# the SolveTrace's `delta_reject` attribution. Producers (encode's
+# `_try_delta_encode` and the solver's delta paths — `_solve_delta`,
+# `_solve_delta_inner`, and `_solve_masked_delta`'s carry guards) must only
+# ever emit values from this tuple; solverlint's metric-label-cardinality
+# rule holds the call sites to it.
+DELTA_REJECT_REASONS = (
+    "unseen-sig",  # appended pod shape could not be grown onto the signature axis
+    "row-key",  # row-side drift beyond what the node_generation refresh absorbs
+    "vol-rv",  # StorageClass/PV/PVC content changed under the folded volume reqs
+    "pvc",  # appended pod carries claim-backed volumes (full encode resolves them)
+    "cap",  # delta larger than the amortization bound
+    "reorder",  # pod list is not (subsequence + appended tail)
+    "fallback-global",  # fallback attribution cannot be re-derived delta-side
+    "irreversible",  # removed placed pod owns a required pod-affinity group
+    "slot-exhausted",  # delta pack ran out of slots; full (uncapped) pack retries
+    "validate",  # stale carry: merged placement failed the fast validator
+    "no-carry",  # delta encode succeeded but the device carry is gone/stale
+)
+
+
 class EncodeCache:
     """Cross-solve encode memo owned by a solver instance.
 
@@ -1678,6 +1711,10 @@ class EncodeCache:
         self.last_raw_pods: list | None = None  # snap.pods by reference
         self.last_sig_ids: dict[tuple, int] | None = None
         self.last_vol_rv: tuple | None = None  # SC/PV/PVC kind revisions
+        # why the newest _try_delta_encode returned None (DELTA_REJECT_REASONS
+        # value, or None on a hit / when there was no base to delta against) —
+        # read by the solver for trace + counter attribution
+        self.last_delta_reject: str | None = None
 
     def signature(self, pod) -> tuple:
         # the (uid, resourceVersion)-keyed dict this method used to own moved
@@ -1714,23 +1751,36 @@ def _try_delta_encode(snap, cache: EncodeCache):
     Conditions: the pod list is the previous solve's with a small number of
     pods REMOVED (they bound or were deleted — relative order of survivors
     preserved, one O(P) two-pointer identity walk) and/or a small tail of
-    APPENDED pods whose signatures the previous encode already interned, and
-    the row-side cache key (cluster generation, pools, instance types,
-    daemons) is unchanged. Survivors and additions live on the POD AXIS only;
-    every per-signature tensor is reused untouched. The result carries
-    `delta_base`/`delta_added_sigs`/`delta_removed_enc` so the solver can run
-    the device pack incrementally in both directions. Reference analogue:
-    event-driven state updates instead of rebuild-per-solve
-    (cluster.go:945-964)."""
+    APPENDED pods. Appended pods of already-interned signatures ride the base
+    tensors untouched; appended pods of UNSEEN in-window signatures GROW the
+    per-signature axis (`_grow_signatures`) — new rows appended under the
+    existing bucket envelope, so grown shapes stay JIT-stable and the grown
+    encode is itself a valid delta base. The row-side cache key must be
+    unchanged, OR differ only in `node_generation` over a stable
+    node/pool/instance-type/daemon set — the steady-state bind-flush event —
+    in which case the volatile row arrays are refreshed in place
+    (`_try_row_refresh`) and the solve carries a `delta_row_diff` for the
+    device carry. Survivors and additions live on the POD AXIS; the result
+    carries `delta_base`/`delta_added_sigs`/`delta_removed_enc` so the
+    solver can run the device pack incrementally in both directions. Every
+    None return records WHY on `cache.last_delta_reject`
+    (DELTA_REJECT_REASONS). Reference analogue: event-driven state updates
+    instead of rebuild-per-solve (cluster.go:945-964)."""
+    cache.last_delta_reject = None
     base = cache.last_enc
     prev_raw = cache.last_raw_pods
     if base is None or prev_raw is None or cache.last_sig_ids is None:
+        return None  # nothing to delta against: a cold encode, not a reject
+
+    def _reject(reason: str):
+        cache.last_delta_reject = reason
         return None
+
     # the base's folded volume requirements are only valid while the
     # SC/PV/PVC content they resolved against is unchanged (the row key
     # can't see those kinds)
     if _volume_kind_revisions(snap) != cache.last_vol_rv:
-        return None
+        return _reject("vol-rv")
     cur = snap.pods
     n_prev = len(prev_raw)
     # Delta-size bound. The original 5%-of-base cap assumed the resident
@@ -1744,7 +1794,7 @@ def _try_delta_encode(snap, cache: EncodeCache):
     # route to the full path below regardless).
     cap = max(64, 3 * n_prev)
     if len(cur) > n_prev + cap or len(cur) < n_prev - cap:
-        return None  # larger swings: the full encode amortizes better
+        return _reject("cap")  # larger swings: the full encode amortizes better
     # two-pointer identity walk: prev pods missing from cur (in order) are
     # the removals; whatever cur holds past the walk is the appended tail
     removed_raw: list[int] = []
@@ -1756,40 +1806,60 @@ def _try_delta_encode(snap, cache: EncodeCache):
         else:
             removed_raw.append(i)
             if len(removed_raw) > cap:
-                return None
+                return _reject("cap")
     added = list(cur[j:])
     if len(removed_raw) + len(added) > cap:
-        return None
+        return _reject("cap")
     if removed_raw and added:
         # a previous pod appearing in the tail means cur is NOT
         # (subsequence + appended-new): reordering/insertion — full encode
         removed_ids = {id(prev_raw[i]) for i in removed_raw}
         if any(id(p) in removed_ids for p in added):
-            return None
+            return _reject("reorder")
     from .volumes import has_pvc_volumes
 
-    added_sigs = []
+    added_sigs: list[int] = []
+    new_sig_pods: list[tuple] = []  # (key, rep pod) per UNSEEN shape, appearance order
+    new_sid_of_key: dict = {}
+    S0 = base.n_sigs
     for p in added:
         # PVC-backed pods extend their interned key with the RESOLVED volume
         # component (claims/SC/PV content), which the bare signature cannot
         # see — a bare-key hit could alias a comp-less signature and drop the
         # pod's volume constraints; only the full encode resolves components
         if has_pvc_volumes(p):
-            return None
-        sid = cache.last_sig_ids.get(cache.signature(p))
+            return _reject("pvc")
+        key = cache.signature(p)
+        sid = cache.last_sig_ids.get(key)
         if sid is None:
-            return None  # unseen pod shape: per-signature tensors must grow
+            # unseen pod shape: the per-signature tensors GROW (below) —
+            # provisional ids follow the base axis in appearance order
+            sid = new_sid_of_key.get(key)
+            if sid is None:
+                sid = S0 + len(new_sig_pods)
+                new_sid_of_key[key] = sid
+                new_sig_pods.append((key, p))
         added_sigs.append(sid)
     row_key = _row_cache_key(snap, base.resource_names, list(base.dom_key_names))
+    refresh = None  # (fields, diff, new _RowArtifacts) when the row side drifted
     if row_key != cache.last_row_key:
-        return None
-    if not added and not removed_raw:
+        refresh = _try_row_refresh(snap, cache, base, row_key)
+        if refresh is None:
+            return _reject("row-key")
+    grown = None  # replacement fields appending the new signatures
+    if new_sig_pods:
+        rows_now = refresh[2] if refresh is not None else cache.rows
+        grown = _grow_signatures(snap, base, rows_now, new_sig_pods)
+        if grown is None:
+            return _reject("unseen-sig")
+    if not added and not removed_raw and refresh is None:
         # identical resubmit: the solver may treat this enc as its own delta
         # base, so the delta arrays stamped when IT was created must not
         # survive to be replayed against the already-merged carry
         base.encode_mode = "delta"
         base.delta_added_sigs = np.zeros(0, np.int32)
         base.delta_removed_enc = np.zeros(0, np.int64)
+        base.delta_row_diff = None
         return base
     import dataclasses as _dc
 
@@ -1802,7 +1872,7 @@ def _try_delta_encode(snap, cache: EncodeCache):
                 sorted(enc_idx_of[id(prev_raw[i])] for i in removed_raw), np.int64
             )
         except KeyError:
-            return None  # raw/enc pod lists diverged (shouldn't happen)
+            return _reject("reorder")  # raw/enc pod lists diverged (shouldn't happen)
         keep = np.ones(len(base.pods), dtype=bool)
         keep[removed_enc] = False
         kept_pods = [p for k, p in enumerate(base.pods) if keep[k]]
@@ -1822,13 +1892,13 @@ def _try_delta_encode(snap, cache: EncodeCache):
     fb_fields: dict = {}
     if removed_raw and base.fallback_reasons:
         if base.fallback_has_global:
-            return None
+            return _reject("fallback-global")
         occupied = {int(s) for s in np.unique(kept_sigs)} | {int(s) for s in added_sigs}
         still = {s for s in base.fallback_sig_local if s in occupied}
         if not still:
             fb_fields = dict(fallback_reasons=[], fallback_sig_local=frozenset())
         elif still != set(base.fallback_sig_local):
-            return None
+            return _reject("fallback-global")
 
     enc = _dc.replace(
         base,
@@ -1839,17 +1909,35 @@ def _try_delta_encode(snap, cache: EncodeCache):
         pods=kept_pods + added,
         sig_of_pod=np.concatenate([kept_sigs, np.asarray(added_sigs, np.int32)]),
         **fb_fields,
+        **(refresh[0] if refresh is not None else {}),
+        **(grown if grown is not None else {}),
     )
     enc.encode_mode = "delta"
     enc.row_cache_hit = True  # a delta encode is by definition row-cache-valid
     enc.delta_base = base
     enc.delta_added_sigs = np.asarray(added_sigs, np.int32)
     enc.delta_removed_enc = removed_enc
+    enc.delta_row_diff = refresh[1] if refresh is not None else None
     cached_restrict = getattr(base, "_sig_restrict", None)
-    if cached_restrict is not None:
+    if cached_restrict is not None and grown is None:
+        # growth changes S: the [S, Kd] cache recomputes lazily on the
+        # grown encode (one cheap row-wise pass over sig_dom_allowed)
         enc._sig_restrict = cached_restrict
     cache.last_enc = enc
     cache.last_raw_pods = list(cur)
+    if grown is not None:
+        # intern the grown keys so the NEXT delta recognizes them — the
+        # grown encode is a first-class delta base (the dict describes
+        # cache.last_enc, which is now the grown encode)
+        cache.last_sig_ids.update(new_sid_of_key)
+    if refresh is not None:
+        # the refreshed row artifacts supersede the stale generation for
+        # every later consumer (and fresh solvers via the global table)
+        cache.row_key = cache.last_row_key = row_key
+        cache.rows = refresh[2]
+        if len(_ROW_GLOBAL) >= 8 and row_key not in _ROW_GLOBAL:
+            _ROW_GLOBAL.clear()
+        _ROW_GLOBAL[row_key] = refresh[2]
     _freeze_shared(enc, base)
     maybe_check_encoded(enc, where="delta-encode")
     return enc
@@ -1883,6 +1971,490 @@ def _row_cache_key(snap, rnames: list[str], dom_keys: list[str]) -> tuple:
         tuple(sorted((name, tuple(id(it) for it in its)) for name, its in snap.instance_types.items())),
         tuple(sorted((d.metadata.uid, d.metadata.resource_version) for d in snap.daemonset_pods)),
         tuple(rnames),
+    )
+
+
+def _group_scheduled_counts(snap, group_meta, group_dom_key, rows, state_nodes, solve_uids_of):
+    """Initial topology-group counts from already-SCHEDULED cluster pods
+    (memoized per (namespace, labels) — bound deployment replicas share
+    labels). Shared by the full encode and the row-refresh delta, which must
+    re-derive exactly these counts when pods bind/depart between solves."""
+    G = len(group_meta)
+    D = len(rows.dom_values)
+    n_existing = len(state_nodes)
+    counts_dom_init = np.zeros((G, D), dtype=np.int32)
+    counts_host_existing = np.zeros((G, max(n_existing, 1)), dtype=np.int32)
+    if not G:
+        return counts_dom_init, counts_host_existing
+    dom_ids = rows.dom_ids
+    node_by_name = {sn.name(): j for j, sn in enumerate(state_nodes)}
+    scheduled = [p for p in snap.store.list("Pod") if p.spec.node_name and pod_utils.is_active(p)]
+    solve_uids = solve_uids_of() if scheduled else frozenset()
+    match_memo: dict[tuple, list[int]] = {}
+    for p in scheduled:
+        if p.metadata.uid in solve_uids:
+            continue
+        mkey = (p.metadata.namespace, tuple(sorted(p.metadata.labels.items())))
+        gs = match_memo.get(mkey)
+        if gs is None:
+            gs = []
+            for g, d in enumerate(group_meta):
+                if p.metadata.namespace != d["ns"] or d["selector"] is None:
+                    continue
+                if match_label_selector(d["selector"], p.metadata.labels):
+                    gs.append(g)
+            match_memo[mkey] = gs
+        if not gs:
+            continue
+        node = snap.store.try_get("Node", p.spec.node_name)
+        if node is None:
+            continue
+        for g in gs:
+            dk = int(group_dom_key[g])
+            if dk >= 0:
+                v = node.metadata.labels.get(rows.dom_key_names[dk])
+                if v is not None and v in dom_ids[dk]:
+                    counts_dom_init[g, dom_ids[dk][v]] += 1
+            else:
+                j = node_by_name.get(p.spec.node_name)
+                if j is not None:
+                    counts_host_existing[g, j] += 1
+    return counts_dom_init, counts_host_existing
+
+
+def _group_registered_of(rows, group_dom_key, counts_dom_init, n_groups: int) -> np.ndarray:
+    """Per-group registered-domain universe (see the call site in encode()
+    for the host-semantics rationale); shared with the row-refresh delta."""
+    D = len(rows.dom_values)
+    group_registered = np.zeros((n_groups, D), dtype=bool)
+    if n_groups:
+        Kd = len(rows.dom_key_names)
+        dom_key_of = np.array(rows.dom_key_of_l, dtype=np.int32)
+        n_existing = rows.n_existing
+        existing_dom = np.zeros(D, dtype=bool)
+        if n_existing:
+            exd = rows.row_dom[:n_existing].reshape(-1)
+            existing_dom[exd[exd >= Kd]] = True  # ids < Kd are sentinels
+        for g in range(n_groups):
+            dk = int(group_dom_key[g])
+            if dk >= 0:
+                group_registered[g] = (rows.universe_dom | existing_dom) & (dom_key_of == dk)
+        group_registered |= counts_dom_init > 0
+    return group_registered
+
+
+def _existing_row_state(snap, rnames: list[str], state_nodes):
+    """Compute the VOLATILE per-existing-node row state from live cluster
+    state: remaining allocatable (net of bound pods and phantom daemon
+    headroom), phantom daemon ports, and host-port usage. THE single
+    definition — `_build_rows` consumes it for the full encode and
+    `_try_row_refresh` for the row-refresh delta, so the two can never
+    drift. Returns (alloc [E, R] f32, ports per node, phantom daemon ports
+    per node)."""
+    from ..scheduling.hostports import pod_host_ports as _php
+    from .volumes import CSI_AXIS_PREFIX, existing_row_axis_value
+
+    R = len(rnames)
+    ridx = {k: i for i, k in enumerate(rnames)}
+    csi_axes = [(i, name[len(CSI_AXIS_PREFIX):]) for i, name in enumerate(rnames) if name.startswith(CSI_AXIS_PREFIX)]
+    alloc = np.zeros((len(state_nodes), R), dtype=np.float32)
+    node_ports: list = []
+    phantom_ports: list = []
+    for j, sn in enumerate(state_nodes):
+        remaining = res.subtract(sn.allocatable(), sn.total_pod_requests())
+        daemons = [d for d in snap.daemonset_pods if _daemon_compatible_with_node(sn, sn.taints(), d)]
+        headroom = res.subtract(res.requests_for_pods(daemons), sn.total_daemon_requests())
+        headroom = {k: v for k, v in headroom.items() if v.milli > 0}
+        remaining = res.subtract(remaining, headroom)
+        usage = sn.host_port_usage.copy()
+        phantom = []
+        for d in daemons:
+            hps = _php(d)
+            if hps and usage.conflicts(d.key(), hps) is None:
+                usage.add(f"daemon-headroom/{d.key()}", hps)
+                phantom.extend(hps)
+        phantom_ports.append(phantom)
+        node_ports.append(list(sn.host_port_usage.all_ports()) + phantom)
+        vec = np.zeros(R, dtype=np.float32)
+        for k, q in remaining.items():
+            i = ridx.get(k)
+            if i is not None:
+                vec[i] = _scale(k, q)
+        for i, driver in csi_axes:
+            vec[i] = existing_row_axis_value(sn, driver)
+        alloc[j] = vec
+    return alloc, node_ports, phantom_ports
+
+
+def _port_mask_rows(port_lists, pk_ids: dict, ps_ids: dict):
+    """Lower port lists onto an EXISTING port vocabulary: returns
+    (any, wild, spec) boolean masks, or None when a port falls outside the
+    vocabulary (the delta paths must route full then — the port axes cannot
+    grow without re-encoding every mask)."""
+    n = len(port_lists)
+    P1, P2 = max(len(pk_ids), 1), max(len(ps_ids), 1)
+    any_ = np.zeros((n, P1), dtype=bool)
+    wild = np.zeros((n, P1), dtype=bool)
+    spec = np.zeros((n, P2), dtype=bool)
+    for i, ports in enumerate(port_lists):
+        for p in ports:
+            k = pk_ids.get((p.port, p.protocol))
+            if k is None:
+                return None
+            any_[i, k] = True
+            if p.ip == "0.0.0.0":
+                wild[i, k] = True
+            else:
+                s = ps_ids.get((p.ip, p.port, p.protocol))
+                if s is None:
+                    return None
+                spec[i, s] = True
+    return any_, wild, spec
+
+
+def _try_row_refresh(snap, cache: EncodeCache, base, row_key: tuple):
+    """Absorb a `node_generation`-only row-side drift — pods binding to or
+    departing from a STABLE node set, the steady-state bind-flush event —
+    into the delta path. Every STATIC row artifact (labels, taints, domain
+    pins, prices, offering rows, vocabulary) is VERIFIED unchanged and reused
+    by reference; the volatile arrays (existing-node remaining capacity,
+    initial topology counts, registered domains, host-port usage) are
+    recomputed from live state, exactly as `_build_rows` + encode() would.
+    Returns (replacement enc fields, carry diff for the solver, refreshed
+    _RowArtifacts) or None when the drift is not refresh-shaped. Reference
+    analogue: cluster.go:945-964 applies bind/delete deltas to node state
+    instead of rebuilding it per reconcile."""
+    rows = cache.rows
+    old_key = cache.last_row_key
+    if rows is None or cache.row_key != old_key or old_key is None:
+        return None
+    # identical except the node_generation component (index 1 of
+    # _row_cache_key): same cluster epoch, domain keys, node-name set,
+    # pools, instance types, daemons, and resource axis
+    if len(old_key) != len(row_key) or old_key[0] != row_key[0] or old_key[2:] != row_key[2:]:
+        return None
+    # inverse anti-affinity lowers from RUNNING pods, which no component of
+    # the row key captures — any running anti pod (now, or baked into the
+    # base's masks) forces the full encode
+    cluster = getattr(snap, "cluster", None)
+    if cluster is None or cluster.pods_with_anti_affinity():
+        return None
+    if base.inverse_blocked:
+        return None
+    if base.fallback_reasons:
+        # a hybrid base's carry is the MASKED pack: the diff would need
+        # translation onto the masked group/slot axes, and dropping it there
+        # would silently desynchronize the carry from the refreshed arrays —
+        # route full (cold hybrid re-partition) instead
+        return None
+    if base.group_meta is None and base.n_groups:
+        return None  # pre-retention base: cannot re-derive group counts
+    if base.port_key_ids is None:
+        return None
+    state_nodes = sorted(snap.state_nodes, key=lambda n: n.name())
+    n_existing = rows.n_existing
+    if len(state_nodes) != n_existing:
+        return None
+    vocab = rows.vocab
+    dom_keys = rows.dom_key_names
+    Kd = len(dom_keys)
+    K0 = rows.row_labels0.shape[1]
+    # -- static verification: the row key hashes node NAMES only; a label,
+    # taint, or domain edit bumps the same generation counter a bind does,
+    # and must route full. Lookups are non-interning so verification can
+    # never widen the shared vocabulary.
+    for j, sn in enumerate(state_nodes):
+        lbls = sn.labels()
+        expect = np.zeros(K0, dtype=np.int32)
+        for k, v in lbls.items():
+            kid = vocab.keys.get(k)
+            if kid is None or kid >= K0:
+                return None
+            vid = vocab.values[kid].get(v)
+            if vid is None:
+                return None
+            expect[kid] = vid
+        if not np.array_equal(expect, rows.row_labels0[j]):
+            return None
+        tkey = tuple(sorted((t.key, t.value, t.effect) for t in sn.taints()))
+        if rows.taint_classes.get(tkey) != int(rows.row_taint_class[j]):
+            return None
+        for k in range(Kd):
+            v = lbls.get(dom_keys[k])
+            want = rows.dom_ids[k].get(v) if v else rows.dom_sentinel[k]
+            if want is None or want != int(rows.row_dom[j, k]):
+                return None
+    # -- volatile recompute ---------------------------------------------------
+    rnames = base.resource_names
+    new_alloc, node_ports, phantom_ports = _existing_row_state(snap, rnames, state_nodes)
+    old_exist_alloc = np.asarray(base.row_alloc[:n_existing], dtype=np.float32)
+    # existing_port_* arrays are [max(E, 1), P1/P2]
+    masks = _port_mask_rows(node_ports if n_existing else [[]], base.port_key_ids, base.port_spec_ids)
+    if masks is None:
+        return None  # a bound pod introduced ports outside the vocabulary
+    new_pany, new_pwild, new_pspec = masks
+    ports_changed = not (
+        np.array_equal(new_pany, base.existing_port_any)
+        and np.array_equal(new_pwild, base.existing_port_wild)
+        and np.array_equal(new_pspec, base.existing_port_spec)
+    )
+    G = base.n_groups
+    group_meta = base.group_meta or []
+    _uids: set | None = None
+
+    def solve_uids_of() -> set:
+        nonlocal _uids
+        if _uids is None:
+            _uids = set(map(_UID_OF, snap.pods))
+        return _uids
+
+    new_cdi, new_che = _group_scheduled_counts(
+        snap, group_meta, base.group_dom_key, rows, state_nodes, solve_uids_of
+    )
+    new_registered = _group_registered_of(rows, base.group_dom_key, new_cdi, G)
+    row_alloc_full = np.asarray(base.row_alloc).copy()
+    row_alloc_full[:n_existing] = new_alloc
+    import dataclasses as _dc
+
+    new_row_meta = [("existing", sn) for sn in state_nodes] + list(rows.row_meta[n_existing:])
+    new_daemon_ports = list(phantom_ports) + list(rows.row_daemon_ports[n_existing:])
+    new_rows = _dc.replace(
+        rows,
+        row_alloc=row_alloc_full,
+        row_meta=new_row_meta,
+        row_daemon_ports=new_daemon_ports,
+        state_nodes=state_nodes,
+    )
+    fields = dict(
+        row_alloc=row_alloc_full,
+        row_meta=new_row_meta,
+        counts_dom_init=new_cdi,
+        counts_host_existing=new_che,
+        group_registered=new_registered,
+        existing_port_any=new_pany,
+        existing_port_wild=new_pwild,
+        existing_port_spec=new_pspec,
+    )
+    diff = dict(
+        n_existing=n_existing,
+        alloc=new_alloc - old_exist_alloc,  # [E, R]
+        counts_dom=(new_cdi - base.counts_dom_init) if G else None,  # [G, D]
+        counts_host=(new_che - base.counts_host_existing) if G else None,  # [G, max(E,1)]
+        ports_changed=ports_changed,
+    )
+    return fields, diff, new_rows
+
+
+def _grow_signatures(snap, base, rows, new_sig_pods):
+    """Append UNSEEN pod shapes to a delta base's per-signature tensors.
+
+    Each new signature lowers exactly as the full encode would — requirement
+    masks over the shared (append-only) vocabulary, taint tolerance against
+    the row taint classes, per-key domain masks, inverse anti-affinity
+    blocks, group membership/ownership against the RETAINED group metadata,
+    host ports against the retained port vocabulary — and the new rows are
+    appended to every [S, ...] array. Growth is refused (None) whenever the
+    shape cannot ride the base's axes: an out-of-window shape (fallback
+    attribution would change), a mask key/value outside the base's [K, W]
+    envelope, a port outside the vocabulary, a new resource-axis name, a
+    topology group the base never built (the group axis and its counts would
+    have to grow), or membership that would break the selector-symmetry
+    window. Everything refused routes to the full encode with reason
+    "unseen-sig"."""
+    if rows is None:
+        return None
+    if base.group_meta is None and base.n_groups:
+        return None
+    if base.port_key_ids is None:
+        return None
+    if getattr(snap, "reserved_offering_mode", "fallback") == "strict":
+        return None  # strict reserved mode flags demand per shape: full path
+    respect = getattr(snap, "preference_policy", "Respect") == "Respect"
+    reps = [p for _k, p in new_sig_pods]
+    for pod in reps:
+        if _pod_window_reasons(snap, pod, respect, lambda p: None):
+            return None  # out-of-window shape: the full encode re-derives attribution
+    n_new = len(reps)
+    S0 = base.n_sigs
+    vocab = rows.vocab
+    K_mask = base.sig_mask.shape[1]
+    W = base.sig_mask.shape[2]
+    Vcap = W * 32
+
+    # -- requirements + vocabulary (append-only: row value ids stay stable) --
+    sig_requirements_new = [Requirements.from_pod(p, strict=not respect) for p in reps]
+    for reqs in sig_requirements_new:
+        for key, r in reqs.items():
+            vocab.key_id(key)
+            for v in r.values:
+                vocab.value_id(key, v)
+    if vocab.n_keys > K_mask or vocab.max_values() > Vcap:
+        # the base masks' [K, W] envelope cannot hold the new ids; the full
+        # encode re-sizes (interned values stay — the encode growth guard
+        # tolerates bounded drift before a row rebuild)
+        return None
+
+    # -- resource axis (fixed): a new resource name cannot be represented ----
+    rnames = base.resource_names
+    ridx = {k: i for i, k in enumerate(rnames)}
+    sig_requests_new = [res.pod_requests(p) for p in reps]
+    if any(k not in ridx for rr in sig_requests_new for k in rr):
+        return None
+    R = len(rnames)
+    sig_req_new = np.zeros((n_new, R), dtype=np.float32)
+    for i, rr in enumerate(sig_requests_new):
+        for k, q in rr.items():
+            sig_req_new[i, ridx[k]] = _scale(k, q)
+
+    # -- requirement bitmasks at the base's exact [K, W] width ---------------
+    bool_masks = np.ones((n_new, K_mask, Vcap), dtype=bool)
+    for i, reqs in enumerate(sig_requirements_new):
+        for key, r in reqs.items():
+            kid = vocab.keys[key]
+            vals = vocab.values[kid]
+            allowed = np.zeros(Vcap, dtype=bool)
+            op = r.operator()
+            absent_ok = op in (Operator.NOT_IN, Operator.DOES_NOT_EXIST) or key in wk.WELL_KNOWN_LABELS
+            allowed[ABSENT] = absent_ok
+            for value, vid in vals.items():
+                allowed[vid] = r.has(value)
+            bool_masks[i, kid] = allowed
+    sig_mask_new = pack_bool_masks(bool_masks)
+    if sig_mask_new.shape[2] != W:  # words_for(32W) == W by construction
+        return None
+
+    # -- taint tolerance against the base's row taint classes ----------------
+    C = base.sig_taint_ok.shape[1]
+    if len(rows.taint_sets) != C:
+        return None
+    sig_taint_ok_new = np.ones((n_new, C), dtype=bool)
+    for i, pod in enumerate(reps):
+        for c, taints in enumerate(rows.taint_sets):
+            sig_taint_ok_new[i, c] = taints_tolerate_pod(taints, pod, include_prefer_no_schedule=True) is None
+
+    # -- per-key domain masks + inverse anti-affinity ------------------------
+    D = base.n_doms
+    dom_allowed_new = np.ones((n_new, D), dtype=bool)
+    for i, reqs in enumerate(sig_requirements_new):
+        for k, key in enumerate(rows.dom_key_names):
+            if not reqs.has(key):
+                continue
+            r = reqs.get(key)
+            dom_allowed_new[i, rows.dom_sentinel[k]] = r.operator() in (Operator.NOT_IN, Operator.DOES_NOT_EXIST)
+            for v, did in rows.dom_ids[k].items():
+                dom_allowed_new[i, did] = r.has(v)
+    inverse_entries = _inverse_anti_entries(snap, lambda: set(map(_UID_OF, snap.pods)))
+    host_blocked_new = _apply_inverse_anti_blocks(
+        inverse_entries, reps, rows, dom_allowed_new, base.n_existing, rows.state_nodes
+    )
+
+    # -- group membership/ownership against the retained group metadata -----
+    from ..controllers.provisioning.scheduling.topology import effective_spread_selector
+
+    group_meta = base.group_meta or []
+    ident_idx = {m["ident"]: g for g, m in enumerate(group_meta)}
+    dom_key_idx = {key: k for k, key in enumerate(rows.dom_key_names)}
+    member_new = np.zeros((n_new, base.sig_member.shape[1]), dtype=bool)
+    owner_new = np.zeros_like(member_new)
+    for i, pod in enumerate(reps):
+        declared: list[tuple] = []
+        for tsc in pod.spec.topology_spread_constraints:
+            if tsc.topology_key == wk.HOSTNAME_LABEL_KEY:
+                kind, dk, md = KIND_HOST_SPREAD, -1, 0
+            else:
+                dk = dom_key_idx.get(tsc.topology_key)
+                if dk is None:
+                    return None  # domain key the base never interned
+                kind, md = KIND_DOM_SPREAD, tsc.min_domains or 0
+            eff_sel = effective_spread_selector(pod, tsc)
+            declared.append((kind, dk, tsc.max_skew, md, _sel_key(eff_sel), pod.metadata.namespace))
+        aff = pod.spec.affinity
+        if aff is not None:
+            for term in aff.pod_anti_affinity_required:
+                if term.topology_key == wk.HOSTNAME_LABEL_KEY:
+                    kind, dk = KIND_HOST_ANTI, -1
+                else:
+                    dk = dom_key_idx.get(term.topology_key)
+                    if dk is None:
+                        return None
+                    kind = KIND_DOM_ANTI
+                declared.append((kind, dk, 0, 0, _sel_key(term.label_selector), pod.metadata.namespace))
+            for term in aff.pod_affinity_required:
+                if term.topology_key == wk.HOSTNAME_LABEL_KEY:
+                    kind, dk = KIND_HOST_AFF, -1
+                else:
+                    dk = dom_key_idx.get(term.topology_key)
+                    if dk is None:
+                        return None
+                    kind = KIND_DOM_AFF
+                declared.append((kind, dk, 0, 0, _sel_key(term.label_selector), pod.metadata.namespace))
+        for ident in declared:
+            g = ident_idx.get(ident)
+            if g is None:
+                return None  # a group the base never built: the axis must grow
+            owner_new[i, g] = True
+            member_new[i, g] = True
+        for g, m in enumerate(group_meta):
+            if (
+                pod.metadata.namespace == m["ns"]
+                and m["selector"] is not None
+                and match_label_selector(m["selector"], pod.metadata.labels)
+            ):
+                member_new[i, g] = True
+        # selector-symmetry window (capability_report's judgment, applied
+        # incrementally): for every kind except hostname SPREAD — whose
+        # member/owner split the host models exactly — a new shape that is
+        # counted-but-not-constrained (or vice versa) by an existing group
+        # would change the snapshot's symmetry attribution
+        for g, m in enumerate(group_meta):
+            if m["kind"] == KIND_HOST_SPREAD:
+                continue
+            if member_new[i, g] != owner_new[i, g]:
+                return None
+
+    # -- host ports against the retained vocabulary --------------------------
+    from ..scheduling.hostports import pod_host_ports
+
+    port_rows = _port_mask_rows([pod_host_ports(p) for p in reps], base.port_key_ids, base.port_spec_ids)
+    if port_rows is None:
+        return None
+    pany_new, pwild_new, pspec_new = port_rows
+    if pany_new.shape[1] != base.sig_port_any.shape[1] or pspec_new.shape[1] != base.sig_port_spec.shape[1]:
+        return None
+
+    # -- requirement classes --------------------------------------------------
+    rc_index = {k: i for i, k in enumerate(base.req_class_keys)}
+    req_class_keys_new = list(base.req_class_keys)
+    rc_of_new = np.zeros(n_new, dtype=np.int32)
+    for i, (key, _pod) in enumerate(new_sig_pods):
+        class_key = key[0]
+        cid = rc_index.get(class_key)
+        if cid is None:
+            cid = len(req_class_keys_new)
+            rc_index[class_key] = cid
+            req_class_keys_new.append(class_key)
+        rc_of_new[i] = cid
+
+    relax_new = np.fromiter((respect and _is_relaxable(p) for p in reps), dtype=bool, count=n_new)
+    sr = base.sig_relaxable
+    sig_relaxable = np.concatenate([sr, relax_new]) if sr is not None else None
+    return dict(
+        sig_req=np.concatenate([base.sig_req, sig_req_new]),
+        sig_mask=np.concatenate([base.sig_mask, sig_mask_new]),
+        sig_taint_ok=np.concatenate([base.sig_taint_ok, sig_taint_ok_new]),
+        sig_dom_allowed=np.concatenate([base.sig_dom_allowed, dom_allowed_new]),
+        sig_member=np.concatenate([base.sig_member, member_new]),
+        sig_owner=np.concatenate([base.sig_owner, owner_new]),
+        sig_host_blocked=np.concatenate([base.sig_host_blocked, host_blocked_new]),
+        sig_port_any=np.concatenate([base.sig_port_any, pany_new]),
+        sig_port_wild=np.concatenate([base.sig_port_wild, pwild_new]),
+        sig_port_spec=np.concatenate([base.sig_port_spec, pspec_new]),
+        sig_requirements=list(base.sig_requirements) + sig_requirements_new,
+        sig_requests=list(base.sig_requests) + sig_requests_new,
+        req_class_of_sig=np.concatenate([base.req_class_of_sig, rc_of_new]),
+        req_class_keys=req_class_keys_new,
+        sig_relaxable=sig_relaxable,
+        has_relaxable=bool(base.has_relaxable or relax_new.any() or base.pools_prefer),
     )
 
 
@@ -2009,41 +2581,24 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
         return cap
 
     # per-driver CSI attach axes: raw slot counts; existing nodes carry
-    # (limit - attached), new-claim rows are unbounded (the host oracle
-    # enforces limits only on existing nodes — ExistingNode.can_add)
-    from .volumes import CSI_AXIS_BIG, CSI_AXIS_PREFIX, existing_row_axis_value
+    # (limit - attached, set in _existing_row_state), new-claim rows are
+    # unbounded (the host oracle enforces limits only on existing nodes —
+    # ExistingNode.can_add)
+    from .volumes import CSI_AXIS_BIG, CSI_AXIS_PREFIX
 
     csi_axes = [
         (i, name[len(CSI_AXIS_PREFIX):]) for i, name in enumerate(rnames) if name.startswith(CSI_AXIS_PREFIX)
     ]
 
-    row_daemon_ports: list = []
-    # existing nodes first
+    # existing nodes first; the volatile per-node state (remaining alloc net
+    # of bound pods + phantom daemon headroom, phantom ports) comes from the
+    # ONE shared definition the row-refresh delta also recomputes from
     state_nodes = sorted(snap.state_nodes, key=lambda n: n.name())
-    for sn in state_nodes:
-        remaining = res.subtract(sn.allocatable(), sn.total_pod_requests())
-        daemons = [d for d in snap.daemonset_pods if _daemon_compatible_with_node(sn, sn.taints(), d)]
-        headroom = res.subtract(res.requests_for_pods(daemons), sn.total_daemon_requests())
-        headroom = {k: v for k, v in headroom.items() if v.milli > 0}
-        remaining = res.subtract(remaining, headroom)
+    exist_alloc, _node_ports, phantom_ports = _existing_row_state(snap, rnames, state_nodes)
+    row_daemon_ports: list = list(phantom_ports)
+    for j, sn in enumerate(state_nodes):
         lbls = sn.labels()
-        from ..scheduling.hostports import pod_host_ports as _php
-
-        # phantom daemon headroom ports, using the SAME wildcard-aware
-        # conflict rule ExistingNode seeding uses (a phantom that conflicts
-        # with a real pod's port is skipped — the port is held either way)
-        usage = sn.host_port_usage.copy()
-        phantom = []
-        for d in daemons:
-            hps = _php(d)
-            if hps and usage.conflicts(d.key(), hps) is None:
-                usage.add(f"daemon-headroom/{d.key()}", hps)
-                phantom.extend(hps)
-        row_daemon_ports.append(phantom)
-        vec = rl_to_vec(remaining)
-        for i, driver in csi_axes:
-            vec[i] = existing_row_axis_value(sn, driver)
-        row_alloc_l.append(vec)
+        row_alloc_l.append(exist_alloc[j])
         row_price_l.append(0.0)
         row_labels_l.append(intern_labels(lbls))
         row_dom_l.append([dom_id(k, lbls[key]) if lbls.get(key) else dom_sentinel[k] for k, key in enumerate(dom_keys)])
@@ -2716,42 +3271,19 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
 
     # initial counts from already-scheduled cluster pods (memoized on the
     # pod's (namespace, labels) — bound deployment replicas share labels)
-    counts_dom_init = np.zeros((G, D), dtype=np.int32)
-    counts_host_existing = np.zeros((G, max(n_existing, 1)), dtype=np.int32)
-    if G:
-        node_by_name = {sn.name(): j for j, sn in enumerate(state_nodes)}
-        scheduled = [p for p in snap.store.list("Pod") if p.spec.node_name and pod_utils.is_active(p)]
-        solve_uids = solve_uids_of() if scheduled else frozenset()
-        match_memo: dict[tuple, list[int]] = {}
-        for p in scheduled:
-            if p.metadata.uid in solve_uids:
-                continue
-            mkey = (p.metadata.namespace, tuple(sorted(p.metadata.labels.items())))
-            gs = match_memo.get(mkey)
-            if gs is None:
-                gs = []
-                for g, ident in enumerate(idents):
-                    d = group_defs[ident]
-                    if p.metadata.namespace != d["ns"] or d["selector"] is None:
-                        continue
-                    if match_label_selector(d["selector"], p.metadata.labels):
-                        gs.append(g)
-                match_memo[mkey] = gs
-            if not gs:
-                continue
-            node = snap.store.try_get("Node", p.spec.node_name)
-            if node is None:
-                continue
-            for g in gs:
-                dk = int(group_dom_key[g])
-                if dk >= 0:
-                    v = node.metadata.labels.get(rows.dom_key_names[dk])
-                    if v is not None and v in dom_ids[dk]:
-                        counts_dom_init[g, dom_ids[dk][v]] += 1
-                else:
-                    j = node_by_name.get(p.spec.node_name)
-                    if j is not None:
-                        counts_host_existing[g, j] += 1
+    group_meta = [
+        dict(
+            ident=ident,
+            kind=group_defs[ident]["kind"],
+            dom_key=group_defs[ident]["dom_key"],
+            selector=group_defs[ident]["selector"],
+            ns=group_defs[ident]["ns"],
+        )
+        for ident in idents
+    ]
+    counts_dom_init, counts_host_existing = _group_scheduled_counts(
+        snap, group_meta, group_dom_key, rows, state_nodes, solve_uids_of
+    )
 
     # each group's registered-domain universe: the NodePool x IT discovery,
     # plus existing nodes' label values (topology.py _count_domains /
@@ -2760,18 +3292,7 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
     # The per-group node filter reduces to the per-item allowed-domain mask
     # for in-window snapshots (key-only filters), so registration here is
     # unfiltered and za does the narrowing.
-    group_registered = np.zeros((G, D), dtype=bool)
-    if G:
-        Kd = len(rows.dom_key_names)
-        existing_dom = np.zeros(D, dtype=bool)
-        if n_existing:
-            exd = rows.row_dom[:n_existing].reshape(-1)
-            existing_dom[exd[exd >= Kd]] = True  # ids < Kd are sentinels
-        for g in range(G):
-            dk = int(group_dom_key[g])
-            if dk >= 0:
-                group_registered[g] = (rows.universe_dom | existing_dom) & (dom_key_of == dk)
-        group_registered |= counts_dom_init > 0
+    group_registered = _group_registered_of(rows, group_dom_key, counts_dom_init, G if G else 0)
 
     sig_relaxable = np.fromiter((respect and _is_relaxable(p) for p in rep_pods), dtype=bool, count=S)
     pools_prefer = bool(pools_taint_prefer_no_schedule(snap.node_pools))
@@ -2831,6 +3352,10 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         decode_cache=rows.decode_cache,
         sig_relaxable=sig_relaxable,
         pools_prefer=pools_prefer,
+        group_meta=group_meta,
+        port_key_ids=pk_ids,
+        port_spec_ids=ps_ids,
+        inverse_blocked=bool(inverse_entries),
     )
     enc_out.row_cache_hit = row_cache_hit
     if cache is not None:

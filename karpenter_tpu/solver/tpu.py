@@ -318,6 +318,15 @@ class TPUSolver:
             trace.fallback_reasons = list(self.last_fallback_reasons)
             self.recorder.commit(trace, registry=self.registry)
 
+    def _note_delta_reject(self, reason: str) -> None:
+        """Record WHY a delta-capable solve routed to the full path — on the
+        SolveTrace (explain() / /debug/solves) and the per-reason counter the
+        churn harness breaks its full-solve share down by."""
+        from ..metrics import SOLVER_DELTA_REJECT_TOTAL
+
+        self._trace.note(delta_reject=reason)
+        self._count(SOLVER_DELTA_REJECT_TOTAL, reason=reason)  # solverlint: ok(metric-label-cardinality): reason is always a DELTA_REJECT_REASONS literal — enum-bounded at every producer (encode._try_delta_encode and the delta solve's reject sites)
+
     def _solve_inner(self, snap: SolverSnapshot, trace: SolveTrace) -> Results:
         from ..metrics import SOLVER_ENCODE_SECONDS
 
@@ -330,6 +339,11 @@ class TPUSolver:
         self._observe(SOLVER_ENCODE_SECONDS, sp.dur, mode=enc_mode)
         trace.n_sigs = int(getattr(enc, "n_sigs", 0) or 0)
         trace.note(encode_mode=enc_mode, row_cache=bool(getattr(enc, "row_cache_hit", False)))
+        if enc_mode == "full":
+            # encode-side delta-reject attribution (None on a cold encode)
+            reject = getattr(self.encode_cache, "last_delta_reject", None)
+            if reject is not None:
+                self._note_delta_reject(reject)
         # consume + clear the delta link IMMEDIATELY (even on the fallback
         # returns below): each link retains O(P) state, so an unbroken chain
         # across consecutive delta encodes would leak
@@ -475,16 +489,30 @@ class TPUSolver:
 
         hs = self._hybrid_state
         res = self._resident
-        if hs is None or res is None or base is None:
+        if base is None:
+            return None
+        if hs is None or res is None:
+            self._note_delta_reject("no-carry")
             return None
         if hs["full_enc"] is not base or res["enc"] is not hs["masked_enc"]:
+            self._note_delta_reject("no-carry")
+            return None
+        if getattr(enc, "delta_row_diff", None) is not None:
+            # a row-refresh diff cannot be applied to the MASKED carry
+            # untranslated (encode gates this off for hybrid bases; this is
+            # the defense-in-depth for any other arrival path)
+            self._note_delta_reject("no-carry")
             return None
         keep = hs["keep"]  # bool [S] over the full encode's signature axis
         if enc.n_sigs != keep.shape[0] or enc.fallback_has_global:
+            # grown signature axis / global attribution: the retained
+            # partition no longer describes this snapshot
+            self._note_delta_reject("no-carry")
             return None
         # the delta's attribution must stay inside the retained partition: a
         # newly-flagged tensor-side signature would invalidate the split
         if any(keep[int(s)] for s in enc.fallback_sig_local):
+            self._note_delta_reject("fallback-global")
             return None
         masked_base = hs["masked_enc"]
         remap = hs["remap"]
@@ -667,7 +695,13 @@ class TPUSolver:
         (e.g. spread skew raised by vacating a min domain): such snapshots
         retry on the full TENSOR pack, never the FFD fallback."""
         res = self._resident
-        if base is None or res is None:
+        if base is None:
+            return None
+        if res is None:
+            # the delta ENCODE succeeded but the carry is gone (dropped after
+            # a decode repair / never established): the full pack re-runs on
+            # the cheap delta encode
+            self._note_delta_reject("no-carry")
             return None
         if res["enc"] is not base:
             # the carry may be the MASKED pack of a previous hybrid solve
@@ -704,22 +738,39 @@ class TPUSolver:
         slot_basis = res["slot_basis"]
         slot_zoneset = res["slot_zoneset"]
 
+        # row-refresh delta (bind-flush absorption): the encoder verified the
+        # node set is stable and recomputed the volatile row arrays; apply
+        # the diff to the device carry and the resident tensors so they
+        # describe the SAME post-bind state a fresh encode would
+        row_diff = getattr(enc, "delta_row_diff", None)
+        rebuild_ports = bool(row_diff is not None and row_diff.get("ports_changed"))
+        if row_diff is not None:
+            state, t = self._apply_row_diff(state, t, enc, row_diff)
+
         removed = getattr(enc, "delta_removed_enc", None)
+        anti_groups: np.ndarray | None = None
         if removed is not None and removed.size:
             rsig = base.sig_of_pod[removed]
             rslot = prev_assignment[removed]
             placed = rslot >= 0
             if placed.any():
                 ps = rsig[placed]
-                # reversibility gate: port-mask unions, anti-affinity domain
-                # blocking, and affinity recording cannot be cleanly undone —
-                # those snapshots take the full pack
-                if enc.sig_port_any[ps].any():
-                    return None
                 kinds = np.asarray(enc.group_kind)
-                irrev = (kinds == KIND_DOM_ANTI) | (kinds == KIND_DOM_AFF) | (kinds == KIND_HOST_AFF)
-                if ((enc.sig_member[ps] | enc.sig_owner[ps]) & irrev[None, :]).any():
+                touch = enc.sig_member[ps] | enc.sig_owner[ps]
+                # reversibility gate: required pod-affinity recording (domain
+                # bootstrap/commit, hostname co-location) is the one family a
+                # removal cannot cleanly undo — the recorded domain may only
+                # exist BECAUSE of the removed pod, and surviving members'
+                # placements depended on it. Ports and keyed anti-affinity
+                # blocks are RECOMPUTED from the surviving assignment below.
+                irrev = (kinds == KIND_DOM_AFF) | (kinds == KIND_HOST_AFF)
+                if (touch & irrev[None, :]).any():
+                    self._note_delta_reject("irreversible")
                     return None
+                rebuild_ports = rebuild_ports or bool(enc.sig_port_any[ps].any())
+                touched_anti = touch & (kinds == KIND_DOM_ANTI)[None, :]
+                if touched_anti.any():
+                    anti_groups = np.nonzero(touched_anti.any(axis=0))[0]
                 spread_g = kinds == KIND_DOM_SPREAD
                 host_g = (kinds == KIND_HOST_SPREAD) | (kinds == KIND_HOST_ANTI)
                 # pad member masks to the tensors' (bucketed) group axis
@@ -735,6 +786,20 @@ class TPUSolver:
             keep = np.ones(prev_assignment.shape[0], dtype=bool)
             keep[removed] = False
             prev_assignment = prev_assignment[keep]
+
+        n_surv = int(prev_assignment.shape[0])
+        surv_sigs = np.asarray(enc.sig_of_pod)[:n_surv]
+        if anti_groups is not None:
+            # keyed required anti-affinity: each placed member blocks the
+            # domain set its slot can still land in — recompute the touched
+            # groups' count rows absolutely from (refreshed init counts +
+            # surviving placed members) instead of punting to the full pack
+            state = self._recount_anti_groups(enc, slot_zoneset, state, anti_groups, surv_sigs, prev_assignment)
+        if rebuild_ports:
+            # port-mask unions are not subtractable, but the planes are a
+            # pure function of (slot init ports | surviving placed pods'
+            # ports) — rebuild them exactly from the surviving assignment
+            state = state[:7] + (self._rebuild_port_planes(enc, t, state, surv_sigs, prev_assignment),)
 
         added_sigs = getattr(enc, "delta_added_sigs", None)
         if added_sigs is None:  # identical resubmit: an empty delta
@@ -773,6 +838,7 @@ class TPUSolver:
             item_pods += [np.zeros(0, np.int64)] * (W_pad - W_real)
             out = greedy_pack_delta_compressed(state, t, items, n_added)
             if out["open_count"] == t.n_slots and int(out["leftovers"][:W_real].sum()) > 0:
+                self._note_delta_reject("slot-exhausted")
                 return None  # slot axis exhausted: retry via the full (uncapped) path
             d = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
             assignment = np.concatenate([prev_assignment, np.full(n_added, -1, dtype=np.int64)])
@@ -785,14 +851,149 @@ class TPUSolver:
         # stale-carry guard BEFORE committing to this path: a failed check
         # means the full pack should try fresh, not the FFD fallback
         if enc.has_relaxable and (assignment < 0).any():
+            self._note_delta_reject("validate")
             return None
         from .check import fast_validate
 
         if fast_validate(enc, assignment, slot_basis, slot_zoneset):
+            self._note_delta_reject("validate")
             return None
         self.last_solve_mode = "delta"
-        self._trace.note(delta_added=n_added, delta_removed=int(removed.size) if removed is not None else 0)
+        self._trace.note(
+            delta_added=n_added,
+            delta_removed=int(removed.size) if removed is not None else 0,
+            row_refresh=bool(row_diff is not None),
+        )
         return self._finish(snap, enc, assignment, slot_basis, slot_zoneset, t, out, validated=True, count=count)
+
+    @staticmethod
+    def _apply_row_diff(state, t, enc, diff):
+        """Apply a row-refresh delta (encode._try_row_refresh) to the
+        device-resident carry and the resident tensors: existing slots'
+        remaining capacity shifts by exactly what bound/departed, topology
+        counts shift by the store-side re-count, and the volatile row arrays
+        in `t` are replaced value-for-value (shapes unchanged — value edits
+        never retrace a jitted kernel)."""
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+
+        (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, ports) = state
+        E = int(diff["n_existing"])
+        N = int(slot_rem.shape[0])
+        R_p = int(slot_rem.shape[1])
+        alloc = diff["alloc"]
+        rem_add = np.zeros((N, R_p), dtype=np.float32)
+        if E:
+            rem_add[:E, : alloc.shape[1]] = alloc
+        slot_rem = slot_rem + jnp.asarray(rem_add)
+        if diff["counts_dom"] is not None:
+            G = diff["counts_dom"].shape[0]
+            cd = np.zeros((int(counts_zone.shape[0]), int(counts_zone.shape[1])), dtype=np.int32)
+            cd[:G] = diff["counts_dom"]
+            counts_zone = counts_zone + jnp.asarray(cd)
+            ch = np.zeros((int(counts_host.shape[0]), int(counts_host.shape[1])), dtype=np.int32)
+            if E:
+                ch[:G, :E] = diff["counts_host"][:, :E]
+            counts_host = counts_host + jnp.asarray(ch)
+        state = (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host, open_count, ports)
+
+        # resident tensors: overwrite the refreshed values inside the padded
+        # envelopes (row_alloc feeds fits/caps; counts/registered/ports feed
+        # nothing mid-delta but must agree with `enc` for the NEXT carry)
+        row_alloc = np.asarray(t.row_alloc).copy()
+        Nr, R = enc.row_alloc.shape
+        row_alloc[:Nr, :R] = enc.row_alloc
+        repl = dict(row_alloc=jnp.asarray(row_alloc))
+        if enc.n_groups:
+            cdi = np.asarray(t.counts_dom_init).copy()
+            cdi[: enc.n_groups] = enc.counts_dom_init
+            chi = np.asarray(t.counts_host_init).copy()
+            if E:
+                chi[: enc.n_groups, :E] = enc.counts_host_existing[:, :E]
+            reg = np.asarray(t.group_registered).copy()
+            reg[: enc.n_groups] = enc.group_registered
+            repl.update(
+                counts_dom_init=jnp.asarray(cdi),
+                counts_host_init=jnp.asarray(chi),
+                group_registered=jnp.asarray(reg),
+            )
+        if E and diff.get("ports_changed"):
+            P1 = enc.existing_port_any.shape[1]
+            P2 = enc.existing_port_spec.shape[1]
+            epa = np.asarray(t.existing_port_any).copy()
+            epw = np.asarray(t.existing_port_wild).copy()
+            eps = np.asarray(t.existing_port_spec).copy()
+            epa[:E, :P1] = enc.existing_port_any[:E]
+            epw[:E, :P1] = enc.existing_port_wild[:E]
+            eps[:E, :P2] = enc.existing_port_spec[:E]
+            repl.update(
+                existing_port_any=jnp.asarray(epa),
+                existing_port_wild=jnp.asarray(epw),
+                existing_port_spec=jnp.asarray(eps),
+            )
+        return state, _dc.replace(t, **repl)
+
+    @staticmethod
+    def _recount_anti_groups(enc, slot_zoneset: np.ndarray, state, anti_groups: np.ndarray, surv_sigs: np.ndarray, surv_assign: np.ndarray):
+        """Recompute the touched keyed-anti groups' count rows ABSOLUTELY
+        from (initial store-side counts + every surviving placed member's
+        blocked domain set — the slot's reachable domains in the group's
+        key), replacing the running late-committal tally the removed pods
+        contributed to. slot_zoneset is the resident host copy; removals
+        never narrow it."""
+        import jax.numpy as jnp
+
+        dko = np.asarray(enc.dom_key_of)
+        touch = enc.sig_member | enc.sig_owner
+        counts_zone = state[4]
+        for g in anti_groups:
+            g = int(g)
+            row = enc.counts_dom_init[g].astype(np.int32).copy()
+            kmask = dko == int(enc.group_dom_key[g])
+            members = np.nonzero(touch[surv_sigs, g] & (surv_assign >= 0))[0]
+            for i in members:
+                row += (slot_zoneset[int(surv_assign[i])] & kmask).astype(np.int32)
+            counts_zone = counts_zone.at[g].set(jnp.asarray(row))
+        return state[:4] + (counts_zone,) + state[5:]
+
+    @staticmethod
+    def _rebuild_port_planes(enc, t, state, surv_sigs: np.ndarray, surv_assign: np.ndarray):
+        """Rebuild every slot's host-port planes exactly from first
+        principles: slot init ports (existing-node usage incl. phantom
+        daemon headroom, or the opened row's daemon ports) OR'ed with every
+        surviving placed pod's signature port masks. Port unions are not
+        subtractable, but they ARE a pure function of the surviving
+        assignment — which makes ported-pod removals (and bind-flush port
+        drift) reversible without the full pack."""
+        import jax.numpy as jnp
+
+        basis = np.asarray(state[0])
+        N = int(basis.shape[0])
+        P1_p = int(t.row_port_any.shape[1])
+        P2_p = int(t.row_port_spec.shape[1])
+        pany = np.zeros((N, P1_p), dtype=bool)
+        pwild = np.zeros((N, P1_p), dtype=bool)
+        pspec = np.zeros((N, P2_p), dtype=bool)
+        E = enc.n_existing
+        P1 = enc.sig_port_any.shape[1]
+        P2 = enc.sig_port_spec.shape[1]
+        if E:
+            pany[:E, :P1] = enc.existing_port_any[:E]
+            pwild[:E, :P1] = enc.existing_port_wild[:E]
+            pspec[:E, :P2] = enc.existing_port_spec[:E]
+        opened = (basis >= 0) & (np.arange(N) >= E)
+        if opened.any():
+            pany[opened] = np.asarray(t.row_port_any)[basis[opened]]
+            pwild[opened] = np.asarray(t.row_port_wild)[basis[opened]]
+            pspec[opened] = np.asarray(t.row_port_spec)[basis[opened]]
+        ported = enc.sig_port_any[surv_sigs].any(axis=1) & (surv_assign >= 0)
+        for i in np.nonzero(ported)[0]:
+            j, s = int(surv_assign[i]), int(surv_sigs[i])
+            pany[j, :P1] |= enc.sig_port_any[s]
+            pwild[j, :P1] |= enc.sig_port_wild[s]
+            pspec[j, :P2] |= enc.sig_port_spec[s]
+        return (jnp.asarray(pany), jnp.asarray(pwild), jnp.asarray(pspec))
 
     # -- decode ----------------------------------------------------------------
     def _decode(self, snap: SolverSnapshot, enc, assignment: np.ndarray, slot_basis: np.ndarray, slot_zoneset: np.ndarray) -> Results:

@@ -1,0 +1,340 @@
+"""Composed delta solves: signature growth + recredit widening + row refresh.
+
+PR 12's contract: the pod-delta path serves EVERY steady-state churn
+composition — mixed add+remove batches, arrivals of never-before-seen pod
+shapes (the per-signature tensors GROW under the bucket envelope), removals
+of ported / keyed-anti pods (slot state recomputed from survivors), and
+bind-flush row drift over a stable node set (row refresh) — with placements
+equivalent to a fresh full encode and a machine-readable reject reason
+(encode.DELTA_REJECT_REASONS) whenever it genuinely cannot.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from helpers import make_pod, zone_spread
+from karpenter_tpu.solver.encode import DELTA_REJECT_REASONS
+from karpenter_tpu.solver.tpu import TPUSolver
+from test_solver import make_snapshot
+
+
+def _placed_pod_names(results):
+    names = set()
+    for nc in results.new_node_claims:
+        names.update(p.metadata.name for p in nc.pods)
+    for en in results.existing_nodes:
+        names.update(p.metadata.name for p in en.pods)
+    return names
+
+
+def _claims(results):
+    return [nc for nc in results.new_node_claims if nc.pods]
+
+
+def _warm(pods):
+    snap = make_snapshot(list(pods))
+    solver = TPUSolver(force=True)
+    results = solver.solve(snap)
+    assert solver.last_solve_mode == "full"
+    assert not results.pod_errors
+    return snap, solver
+
+
+SHAPES = [("250m", "512Mi"), ("500m", "512Mi"), ("500m", "1Gi"), ("1", "1Gi")]
+NEW_SHAPES = [("311m", "413Mi"), ("613m", "217Mi"), ("911m", "1111Mi"), ("157m", "87Mi")]
+
+
+class TestMixedChurnComposition:
+    @pytest.mark.parametrize("seed", [3, 11, 42])
+    def test_randomized_mixed_new_shape_churn_matches_fresh_full(self, seed):
+        """Randomized add/remove/new-shape sequences: every step must stay on
+        the delta path, and the final placement must match a fresh full
+        encode of the same snapshot (placed-set equality + claim-count
+        parity, the PR 2/7/10 delta standard)."""
+        rng = random.Random(seed)
+        pods = [make_pod(cpu=c, memory=m) for c, m in rng.choices(SHAPES, k=24)]
+        snap, solver = _warm(pods)
+        fresh_pool = list(NEW_SHAPES)
+        for _ in range(4):
+            for _ in range(rng.randrange(1, 4)):
+                snap.pods.pop(rng.randrange(len(snap.pods)))
+            for _ in range(rng.randrange(1, 4)):
+                if fresh_pool and rng.random() < 0.5:
+                    c, m = fresh_pool.pop()  # a never-interned shape: growth
+                else:
+                    c, m = rng.choice(SHAPES)
+                snap.pods.append(make_pod(cpu=c, memory=m))
+            results = solver.solve(snap)
+            assert solver.last_solve_mode == "delta", (
+                solver.last_solve_mode,
+                solver.encode_cache.last_delta_reject,
+            )
+            assert not results.pod_errors
+        fresh = TPUSolver(force=True)
+        full = fresh.solve(make_snapshot(list(snap.pods)))
+        assert not full.pod_errors
+        assert _placed_pod_names(results) == _placed_pod_names(full)
+        assert len(_claims(results)) <= len(_claims(full)) + 1
+
+    def test_grown_encode_chains_as_next_delta_base(self):
+        """A grown encode is a first-class delta base: the next solve deltas
+        off it, a later pod of the GROWN shape resolves as interned, and
+        parity holds at the end of the chain."""
+        snap, solver = _warm([make_pod(cpu="500m") for _ in range(10)])
+        newcomer = make_pod(cpu="313m", memory="209Mi")
+        snap.pods.append(newcomer)
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "delta"
+        assert newcomer.metadata.name in _placed_pod_names(r)
+        # chain 1: removal off the grown base
+        snap.pods.pop(0)
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "delta"
+        # chain 2: the grown shape is now interned — no second growth needed
+        again = make_pod(cpu="313m", memory="209Mi")
+        snap.pods.append(again)
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "delta"
+        assert again.metadata.name in _placed_pod_names(r)
+        full = TPUSolver(force=True).solve(make_snapshot(list(snap.pods)))
+        assert _placed_pod_names(r) == _placed_pod_names(full)
+
+    def test_grown_spread_member_joins_existing_group(self):
+        """A new shape that DECLARES an already-built spread group grows onto
+        the signature axis with correct membership: the newcomer must honor
+        the combined skew against already-placed members."""
+        sel = {"app": "web"}
+        pods = [make_pod(cpu="500m", labels=sel, tsc=[zone_spread(selector=sel)]) for _ in range(8)]
+        snap, solver = _warm(pods)
+        # same group (identical constraint + labels), NEW request shape
+        newcomer = make_pod(cpu="433m", memory="333Mi", labels=sel, tsc=[zone_spread(selector=sel)])
+        snap.pods.append(newcomer)
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "delta", solver.encode_cache.last_delta_reject
+        assert not r.pod_errors
+        assert newcomer.metadata.name in _placed_pod_names(r)
+        # parity: a fresh full encode agrees on the placed set
+        full = TPUSolver(force=True).solve(make_snapshot(list(snap.pods)))
+        assert _placed_pod_names(r) == _placed_pod_names(full)
+
+    def test_new_group_identity_routes_full_with_reason(self):
+        """A new shape declaring a group the base never built cannot grow —
+        the group axis would have to grow — and routes full with reason
+        "unseen-sig"."""
+        snap, solver = _warm([make_pod(cpu="500m") for _ in range(6)])
+        # matchLabels form so the selector matches ONLY the declaring pod
+        # (a bare dict selector is match-all, which would flag asymmetry)
+        sel = {"matchLabels": {"app": "brand-new-spread"}}
+        snap.pods.append(make_pod(cpu="500m", labels={"app": "brand-new-spread"}, tsc=[zone_spread(selector=sel)]))
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "full"
+        assert solver.encode_cache.last_delta_reject == "unseen-sig"
+        assert not r.pod_errors
+
+
+class TestRecreditWidening:
+    def test_randomized_ported_pod_churn_parity(self):
+        """Removing ported pods rebuilds the slot port planes from survivors
+        — and the resulting placements still satisfy host-port exclusivity
+        and match a fresh full encode."""
+        rng = random.Random(7)
+
+        def ported(port):
+            p = make_pod(cpu="500m")
+            p.spec.containers[0].ports = [{"containerPort": port, "hostPort": port, "protocol": "TCP"}]
+            return p
+
+        pods = [make_pod(cpu="500m") for _ in range(8)] + [ported(8080) for _ in range(3)]
+        rng.shuffle(pods)
+        snap, solver = _warm(pods)
+        # remove one ported + one plain pod, then add one ported back
+        snap.pods.remove(next(p for p in snap.pods if p.spec.containers[0].ports))
+        snap.pods.pop(0)
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "delta"
+        assert not r.pod_errors
+        snap.pods.append(ported(8080))
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "delta"
+        assert not r.pod_errors
+        # port exclusivity: no two ported pods share a node
+        holders = []
+        for nc in r.new_node_claims:
+            holders.extend(nc.hostname for p in nc.pods if p.spec.containers[0].ports)
+        for en in r.existing_nodes:
+            holders.extend(en.name for p in en.pods if p.spec.containers[0].ports)
+        assert len(holders) == len(set(holders))
+        full = TPUSolver(force=True).solve(make_snapshot(list(snap.pods)))
+        assert _placed_pod_names(r) == _placed_pod_names(full)
+
+    def test_spread_removal_then_refill_parity(self):
+        """Spread-member removals recredit the committed domain; refilling
+        with the same shape must rebalance into the vacated domains exactly
+        like a fresh full solve would."""
+        sel = {"app": "spread"}
+        pods = [make_pod(cpu="500m", labels=sel, tsc=[zone_spread(selector=sel)]) for _ in range(12)]
+        snap, solver = _warm(pods)
+        for _ in range(3):
+            snap.pods.pop(2)
+        r = solver.solve(snap)
+        assert solver.last_backend == "tpu"
+        snap.pods.extend(make_pod(cpu="500m", labels=sel, tsc=[zone_spread(selector=sel)]) for _ in range(3))
+        r = solver.solve(snap)
+        assert not r.pod_errors
+        assert len(_placed_pod_names(r)) == 12
+        full = TPUSolver(force=True).solve(make_snapshot(list(snap.pods)))
+        assert len(_placed_pod_names(full)) == 12
+
+    def test_dom_affinity_owner_removal_still_routes_full(self):
+        """Required pod-affinity recording (domain bootstrap/commit) stays
+        the one hard-irreversible removal family, with reason
+        "irreversible"."""
+        from karpenter_tpu.apis import labels as wk
+        from karpenter_tpu.kube.objects import PodAffinityTerm
+
+        sel = {"app": "aff"}
+        term = PodAffinityTerm(label_selector=sel, topology_key=wk.ZONE_LABEL_KEY)
+        pods = [make_pod(cpu="500m", labels=sel, pod_affinity=[term]) for _ in range(4)]
+        snap, solver = _warm(pods)
+        snap.pods.pop()
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "full"
+        assert not r.pod_errors
+        # the reject is attributed on the newest trace
+        assert solver._trace.attribution.get("delta_reject") == "irreversible"
+
+
+class TestDeltaRejectAttribution:
+    def test_reason_enum_is_closed(self):
+        assert set(DELTA_REJECT_REASONS) == {
+            "unseen-sig", "row-key", "vol-rv", "pvc", "cap", "reorder",
+            "fallback-global", "irreversible", "slot-exhausted", "validate",
+            "no-carry",
+        }
+
+    def test_pvc_append_reason(self):
+        snap, solver = _warm([make_pod(cpu="500m") for _ in range(4)])
+        snap.pods.append(make_pod(cpu="500m", volumes=[{"persistentVolumeClaim": {"claimName": "c1"}}]))
+        solver.solve(snap)
+        assert solver.encode_cache.last_delta_reject == "pvc"
+
+    def test_cap_reason(self):
+        snap, solver = _warm([make_pod(cpu="250m") for _ in range(4)])
+        snap.pods.extend(make_pod(cpu="250m") for _ in range(200))  # > max(64, 3*4)
+        solver.solve(snap)
+        assert solver.last_solve_mode == "full"
+        assert solver.encode_cache.last_delta_reject == "cap"
+
+    def test_reorder_reason(self):
+        snap, solver = _warm([make_pod(cpu="500m") for _ in range(6)])
+        snap.pods[0], snap.pods[3] = snap.pods[3], snap.pods[0]
+        solver.solve(snap)
+        assert solver.last_solve_mode == "full"
+        assert solver.encode_cache.last_delta_reject == "reorder"
+
+    def test_unseen_sig_reason_for_ungrowable_shape(self):
+        # a custom resource name outside the base's resource axis cannot be
+        # appended to the [S, R] tensors — growth refuses, reason unseen-sig
+        snap, solver = _warm([make_pod(cpu="500m") for _ in range(4)])
+        odd = make_pod(cpu="500m")
+        odd.spec.containers[0].resources["requests"]["vendor.example/gpu"] = __import__(
+            "karpenter_tpu.utils.quantity", fromlist=["Quantity"]
+        ).Quantity.parse("1")
+        snap.pods.append(odd)
+        solver.solve(snap)
+        assert solver.encode_cache.last_delta_reject == "unseen-sig"
+
+    def test_row_key_reason_on_pool_change(self):
+        snap, solver = _warm([make_pod(cpu="500m") for _ in range(4)])
+        snap.pods.append(make_pod(cpu="500m"))
+        # shrink the catalog: the instance-type identity tuple in the row
+        # key changes — a genuine row-side move the refresh cannot absorb
+        name = snap.node_pools[0].metadata.name
+        snap.instance_types[name] = snap.instance_types[name][:-1]
+        solver.solve(snap)
+        assert solver.last_solve_mode == "full"
+        assert solver.encode_cache.last_delta_reject == "row-key"
+
+    def test_reject_counter_emitted(self):
+        from karpenter_tpu import metrics as m
+
+        reg = m.make_registry()
+        snap = make_snapshot([make_pod(cpu="500m") for _ in range(4)])
+        solver = TPUSolver(force=True, registry=reg)
+        solver.solve(snap)
+        snap.pods[0], snap.pods[1] = snap.pods[1], snap.pods[0]
+        solver.solve(snap)
+        assert reg.counter(m.SOLVER_DELTA_REJECT_TOTAL).value(reason="reorder") == 1
+
+
+class TestGrowthBucketMonotonicity:
+    def test_growth_under_highwater_records_zero_recompiles(self, monkeypatch):
+        """With high-water bucketing ON, a signature-growth delta whose axes
+        stay inside the established marks must not retrace any jitted
+        kernel."""
+        from karpenter_tpu.models.scheduler_model import reset_bucket_highwater
+        from karpenter_tpu.obs.trace import sentinel
+
+        monkeypatch.setenv("KARPENTER_SOLVER_BUCKET", "1")
+        reset_bucket_highwater()
+        try:
+            snap, solver = _warm([make_pod(cpu=c, memory=mem) for c, mem in SHAPES * 3])
+            # warm BOTH delta directions (their cold compiles land here)
+            snap.pods.append(make_pod(cpu="500m", memory="512Mi"))
+            solver.solve(snap)
+            snap.pods.pop(0)
+            solver.solve(snap)
+            before = sentinel().snapshot()
+            # mixed churn with an UNSEEN shape: growth under the marks
+            snap.pods.pop(0)
+            snap.pods.append(make_pod(cpu="619m", memory="153Mi"))
+            r = solver.solve(snap)
+            assert solver.last_solve_mode == "delta"
+            assert not r.pod_errors
+            assert sentinel().delta(before) == {}
+        finally:
+            reset_bucket_highwater()
+
+
+class TestRowRefresh:
+    def test_bind_flush_churn_stays_on_delta_path(self):
+        """The live-store integration: with pods binding and departing on a
+        STABLE node set (the churn harness steady state), the row-refresh
+        delta absorbs the node_generation drift — steady solves stay
+        "delta" and the full-solve breakdown stays empty."""
+        from test_churn_loop import small_spec
+
+        from karpenter_tpu.serving import ChurnHarness
+
+        h = ChurnHarness(small_spec(iterations=4, warmup_cycles=1))
+        try:
+            rep = h.run()
+        finally:
+            h.close()
+        assert rep.solves > 0
+        assert rep.delta_hit_rate >= 0.9, (rep.modes, rep.full_solve_reasons)
+        # whatever little routed full must carry a known reject reason
+        assert set(rep.full_solve_reasons) <= set(DELTA_REJECT_REASONS)
+
+    def test_row_refresh_diff_applies_to_carry(self):
+        """Unit-level: a refreshed delta encode carries delta_row_diff and
+        the solver's delta path consumes it (trace attribution names the
+        refresh)."""
+        from test_churn_loop import small_spec
+
+        from karpenter_tpu.serving import ChurnHarness
+
+        h = ChurnHarness(small_spec(iterations=2, warmup_cycles=1))
+        try:
+            h.run()
+            refreshed = [
+                t
+                for t in h.recorder.traces()
+                if t.mode == "delta" and t.attribution.get("row_refresh")
+            ]
+            assert refreshed, "no solve recorded a row refresh"
+        finally:
+            h.close()

@@ -181,9 +181,11 @@ class TestRemovalDeltaGates:
         assert not r2.pod_errors
         assert len([nc for nc in r2.new_node_claims if nc.pods]) == 4
 
-    def test_zone_anti_affinity_pod_removal_takes_full_path(self):
+    def test_zone_anti_affinity_pod_removal_stays_delta(self):
         # zone-keyed anti-affinity blocks the placed pod's whole reachable
-        # domain set (late committal) — not cleanly reversible
+        # domain set (late committal); the widened recredit RECOMPUTES the
+        # touched groups' count rows from the surviving assignment, so the
+        # removal stays on the delta path and the vacated zone re-opens
         from karpenter_tpu.apis import labels as wk
         from karpenter_tpu.kube.objects import PodAffinityTerm
 
@@ -191,32 +193,53 @@ class TestRemovalDeltaGates:
         term = PodAffinityTerm(label_selector=sel, topology_key=wk.ZONE_LABEL_KEY)
         # zone-pinned replicas (unpinned zone-anti sets place one pod per
         # solve by late-committal design)
-        pods = [
-            make_pod(
+        def anti_pod(z):
+            return make_pod(
                 cpu="500m",
                 labels=sel,
                 anti_affinity=[term],
                 node_selector={wk.ZONE_LABEL_KEY: f"test-zone-{z}"},
             )
-            for z in ("a", "b", "c")
-        ]
+
+        pods = [anti_pod(z) for z in ("a", "b", "c")]
         snap, solver = _warm_solver(pods)
         snap.pods.pop()
         results = solver.solve(snap)
-        assert solver.last_solve_mode == "full"
+        assert solver.last_solve_mode == "delta"
         assert not results.pod_errors
+        assert len(_placed_pod_names(results)) == 2
+        # the vacated zone is genuinely unblocked: a replacement replica
+        # pinned there places on the SAME carry (a stale block would leave it
+        # unplaced and bounce the solve to the full pack)
+        replacement = anti_pod("c")
+        snap.pods.append(replacement)
+        results = solver.solve(snap)
+        assert solver.last_solve_mode == "delta"
+        assert not results.pod_errors
+        assert replacement.metadata.name in _placed_pod_names(results)
 
-    def test_host_port_pod_removal_takes_full_path(self):
+    def test_host_port_pod_removal_stays_delta(self):
         pods = [make_pod(cpu="500m") for _ in range(6)]
         ported = make_pod(cpu="500m")
         ported.spec.containers[0].ports = [{"containerPort": 8080, "hostPort": 8080, "protocol": "TCP"}]
         pods.append(ported)
         snap, solver = _warm_solver(pods)
-        # remove the ported pod: its port-mask union is irreversible
+        # remove the ported pod: the port planes rebuild from the surviving
+        # assignment (unions are not subtractable, but they are a pure
+        # function of the survivors), so the removal stays a delta
         snap.pods.remove(ported)
         results = solver.solve(snap)
-        assert solver.last_solve_mode == "full"
+        assert solver.last_solve_mode == "delta"
         assert not results.pod_errors
+        # the port is genuinely released: a new pod claiming the same host
+        # port places on the same carry
+        ported2 = make_pod(cpu="500m")
+        ported2.spec.containers[0].ports = [{"containerPort": 8080, "hostPort": 8080, "protocol": "TCP"}]
+        snap.pods.append(ported2)
+        results = solver.solve(snap)
+        assert solver.last_solve_mode == "delta"
+        assert not results.pod_errors
+        assert ported2.metadata.name in _placed_pod_names(results)
 
     def test_plain_pod_removal_beside_ported_pod_stays_delta(self):
         # only the REMOVED pod's reversibility matters: removing a plain pod
