@@ -941,6 +941,249 @@ def bench_churn_sustained(n_base: int, iterations: int) -> dict:
     return out
 
 
+def bench_fleet_multitenant(k: int, n_base: int, iterations: int) -> dict:
+    """The fleet front-end (serving/fleet.py): K tenant clusters multiplexed
+    by ONE solver process through the push-wake DRR loop, each at 1/40-scale
+    churn (n_base default 1250 = 50k-north-star/40). Demonstrates the two
+    fleet effects the ROADMAP names:
+
+    - COALESCING AS THROUGHPUT: while tenant A solves, tenants B..K
+      accumulate events; each tenant's turn drains a whole round's worth in
+      one batched solve, so AGGREGATE events/sec beats the single-tenant
+      baseline (gate: >= baseline x BENCH_FLEET_TPS_RATIO_GATE, default 2).
+    - SHARED JITTED KERNELS: tenant 1 pays the cold compiles; tenants 2..K
+      provision + churn entirely inside tenant 1's compiled shapes (gate:
+      cold-start compile count == 0 for every tenant past the first), and
+      the measured steady phase records ZERO recompiles fleet-wide.
+
+    Per-tenant P99 re-solve latency (each tenant's private solvetrace
+    recorder) gates < BENCH_FLEET_P99_GATE (default 250ms)."""
+    from karpenter_tpu.cloudprovider.fake import instance_types_assorted
+    from karpenter_tpu.models.scheduler_model import reset_bucket_highwater
+    from karpenter_tpu.obs import default_recorder
+    from karpenter_tpu.obs.stats import quantile
+    from karpenter_tpu.obs.trace import sentinel
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.serving import ChurnHarness, ChurnSpec
+    from karpenter_tpu.serving.fleet import FleetFrontend, reset_tenant_labels
+
+    # per-tenant shape: 1/40-scale churn RATE (the multiplexing regime is
+    # many small-traffic clusters — that is WHY one process serves many
+    # tenants) on a base fleet sized so K tenants aggregate to the CPU
+    # churn gate's 5000-pod fleet. BENCH_FLEET_CHURN_DIV tunes the rate.
+    churn_div = float(os.environ.get("BENCH_FLEET_CHURN_DIV", "40"))
+    def mkspec():
+        return ChurnSpec(
+            n_base_pods=n_base,
+            n_types=100,
+            arrivals=max(8, int(800 / churn_div)),
+            cancels=max(6, int(600 / churn_div)),
+            departures=max(8, int(800 / churn_div)),
+            iterations=iterations,
+            concurrent_seconds=0.0,
+        )
+
+    # -- single-tenant baseline (the poll-path serving loop, same scale) -------
+    reset_bucket_highwater()
+    reset_tenant_labels()
+    base_spec = mkspec()
+    h0 = ChurnHarness(base_spec)
+    try:
+        base_rep = h0.run()
+    finally:
+        h0.close()
+    baseline_eps = base_rep.events_per_sec
+
+    # -- the fleet arm ---------------------------------------------------------
+    reset_bucket_highwater()  # tenant 1 re-establishes the ladder honestly
+    fleet = FleetFrontend()
+    spec = mkspec()
+    # the multiplexing window: while other tenants are served, a tenant's
+    # batcher coalesces this many CYCLES of traffic into its next turn — the
+    # idle/max window as a coalescing bound, exactly the push-wake design
+    cycles_per_round = max(1, int(os.environ.get("BENCH_FLEET_CYCLES_PER_ROUND", "2")))
+    rounds = max(1, iterations // (spec.bind_every * cycles_per_round))
+    coldstart: dict[str, int] = {}
+    harnesses = []
+    try:
+        mark = None
+        for i in range(k):
+            tspec = mkspec()
+            sess = fleet.add_tenant(
+                f"tenant-{i}",
+                options=Options(
+                    solver_backend="tpu",
+                    batch_idle_duration=tspec.batch_idle_seconds,
+                    batch_max_duration=10.0,
+                ),
+                instance_types=instance_types_assorted(tspec.n_types),
+            )
+            h = ChurnHarness(tspec).attach(sess, fleet=fleet)
+            harnesses.append(h)
+            # per-tenant warmup: provision, free headroom, then one ROUND-
+            # sized bounding pass (the steady phase batches a whole round of
+            # events per solve) and one normal round
+            h.provision_base_fleet()
+            h.apply_departures(int((tspec.arrivals - tspec.cancels) * tspec.bind_every * 3 * cycles_per_round))
+            h.bind_flush()
+            per_round_arr = tspec.arrivals * tspec.bind_every * cycles_per_round
+            per_round_can = tspec.cancels * tspec.bind_every * cycles_per_round
+            h.apply_arrivals(int(per_round_arr * 1.3) + 32)
+            h.apply_cancels(int(per_round_can * 1.5) + 32)
+            h.solve(force=True)
+            h.apply_departures(int(tspec.departures * cycles_per_round * 1.3) + 32)
+            h.bind_flush()
+            h.apply_arrivals(per_round_arr)
+            h.apply_cancels(per_round_can)
+            h.solve()
+            h.apply_departures(tspec.departures * cycles_per_round)
+            h.bind_flush()
+            if mark is not None:
+                coldstart[f"tenant-{i}"] = sum(sentinel().delta(mark).values())
+            # tenants past this one must fit entirely inside the now-warm shapes
+            mark = sentinel().snapshot()
+        # -- steady rounds ------------------------------------------------------
+        # one round: arrivals/cancellations land push-style for every tenant
+        # FIRST (the multiplexing window: each tenant's batcher coalesces the
+        # whole round's traffic), then one DRR pump drains every tenant in
+        # one batched solve each, then the post-solve controller work
+        # (departures + bind flush) runs per tenant
+        def one_round() -> int:
+            n = 0
+            for h in harnesses:
+                for _c in range(cycles_per_round):
+                    for _i in range(h.spec.bind_every):
+                        n += h.apply_arrivals(h.spec.arrivals)
+                        n += h.apply_cancels(h.spec.cancels)
+                h.env.clock.step(h.spec.batch_idle_seconds + 0.05)
+            fleet.rearm_ready()
+            fleet.pump()
+            for h in harnesses:
+                n += h.apply_departures(h.spec.departures * cycles_per_round)
+                h.bind_flush()
+            return n
+
+        for h in harnesses:
+            # one extra round's worth: the unmeasured warmup round below
+            # drains the first batch, and the LAST measured round must not
+            # fall back to inline pod construction inside the timed window
+            h.prebuild(h.spec.arrivals * (iterations + h.spec.bind_every * cycles_per_round))
+        # round 0 is warmup: the steady-state round COMPOSITION (coalesced
+        # adds + unbind-window removals + bind-flush row drift, at the round
+        # batch shape) runs once unmeasured so its one-time compiles land
+        # before the sentinel mark, mirroring ChurnHarness.run's bounding
+        # cycle discipline
+        one_round()
+        steady_mark = sentinel().snapshot()
+        recorder_marks = [h.recorder.seq for h in harnesses]
+        events = 0
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            events += one_round()
+        wall = time.perf_counter() - t0
+        steady_recompiles = sum(sentinel().delta(steady_mark).values())
+        per_tenant = {}
+        for h, rmark in zip(harnesses, recorder_marks):
+            traces = [t for t in h.recorder.traces() if t.seq > rmark and t.mode not in ("", "consolidate")]
+            durs = sorted(t.duration for t in traces)
+            modes: dict[str, int] = {}
+            for t in traces:
+                modes[t.mode] = modes.get(t.mode, 0) + 1
+            per_tenant[h.env.provisioner.tenant] = {
+                "solves": len(traces),
+                "modes": modes,
+                "p50_solve_seconds": round(quantile(durs, 0.5, assume_sorted=True), 4) if durs else 0.0,
+                "p99_solve_seconds": round(quantile(durs, 0.99, assume_sorted=True), 4) if durs else 0.0,
+                "events_per_solve": round(events / (k * len(traces)), 1) if traces else 0.0,
+            }
+    finally:
+        fleet.close()
+        reset_bucket_highwater()
+        reset_tenant_labels()
+
+    eps = events / wall if wall > 0 else 0.0
+    ratio_gate = float(os.environ.get("BENCH_FLEET_TPS_RATIO_GATE", "2.0"))
+    p99_gate = float(os.environ.get("BENCH_FLEET_P99_GATE", "0.25"))
+    worst_p99 = max((t["p99_solve_seconds"] for t in per_tenant.values()), default=0.0)
+    worst_coldstart = max(coldstart.values(), default=0)
+    out = {
+        "tenants": k,
+        "n_base_per_tenant": n_base,
+        "events": events,
+        "wall_seconds": round(wall, 3),
+        "aggregate_events_per_sec": round(eps, 1),
+        "baseline_events_per_sec": round(baseline_eps, 1),
+        "throughput_ratio": round(eps / baseline_eps, 2) if baseline_eps else 0.0,
+        "per_tenant": per_tenant,
+        "worst_tenant_p99_seconds": worst_p99,
+        "steady_recompiles": steady_recompiles,
+        "coldstart_compiles": coldstart,
+        "throughput_gate": "PASS" if baseline_eps and eps >= ratio_gate * baseline_eps else "FAIL",
+        "p99_gate": "PASS" if worst_p99 < p99_gate else "FAIL",
+        "recompile_gate": "PASS" if steady_recompiles == 0 else "FAIL",
+        "coldstart_gate": "PASS" if worst_coldstart == 0 else "FAIL",
+    }
+    for name in ("throughput_gate", "p99_gate", "recompile_gate", "coldstart_gate"):
+        if out[name] == "FAIL":
+            print(f"FLEET {name.upper()} FAILED: {out}", file=sys.stderr)
+    return out
+
+
+def bench_fleet_compile_cache(n_pods: int = 800, n_types: int = 20) -> dict:
+    """The persistent-compile-cache warm-restart micro-gate: two fresh
+    PROCESSES run the same cold solve with KARPENTER_SOLVER_COMPILE_CACHE
+    pointed at one dir; the second deserializes the XLA executables instead
+    of recompiling. On real TPU hardware XLA compile dominates the cold
+    solve and the second process gates >= 5x faster
+    (BENCH_COMPILE_CACHE_SPEEDUP_GATE); on the CPU harness jax TRACING (not
+    XLA compile, which the cache does eliminate — entry count is recorded)
+    dominates, so the gate self-scopes to a measured-feasible 1.25x floor,
+    the same way the 1M/50k gates bind only at TPU scale."""
+    import tempfile
+
+    code = (
+        "import time, os, sys\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
+        f"sys.path.insert(0, {os.path.join(os.path.dirname(os.path.abspath(__file__)), 'tests')!r})\n"
+        "import bench\n"
+        f"snap = bench.build_snapshot({n_pods}, {n_types})\n"
+        "from karpenter_tpu.solver.tpu import TPUSolver\n"
+        "t0 = time.perf_counter()\n"
+        "TPUSolver(force=True).solve(snap)\n"
+        "print('COLD_SOLVE=%.4f' % (time.perf_counter() - t0))\n"
+    )
+
+    def one_process(cache_dir: str) -> float:
+        env = os.environ.copy()
+        env["KARPENTER_SOLVER_COMPILE_CACHE"] = cache_dir
+        env.setdefault("KARPENTER_SOLVER_MESH", "0")
+        out = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, timeout=600, env=env)
+        for line in out.stdout.splitlines():
+            if line.startswith("COLD_SOLVE="):
+                return float(line.split("=", 1)[1])
+        raise RuntimeError(f"cache probe rc={out.returncode}: {out.stderr[-400:]}")
+
+    with tempfile.TemporaryDirectory(prefix="karpenter-compile-cache-") as d:
+        first = one_process(d)
+        entries = len(os.listdir(d))
+        second = one_process(d)
+    speedup = first / second if second > 0 else 0.0
+    on_tpu = _RESULT["extra"].get("backend") == "tpu"
+    gate_floor = float(os.environ.get("BENCH_COMPILE_CACHE_SPEEDUP_GATE", "5.0" if on_tpu else "1.25"))
+    out = {
+        "compile_cache_first_cold_seconds": round(first, 3),
+        "compile_cache_second_cold_seconds": round(second, 3),
+        "compile_cache_speedup": round(speedup, 2),
+        "compile_cache_entries": entries,
+        "compile_cache_gate_floor": gate_floor,
+        "compile_cache_gate_scope": "tpu" if on_tpu else "cpu-relaxed",
+        "compile_cache_gate": "PASS" if (speedup >= gate_floor and entries > 0) else "FAIL",
+    }
+    if out["compile_cache_gate"] == "FAIL":
+        print(f"COMPILE CACHE GATE FAILED: {out}", file=sys.stderr)
+    return out
+
+
 def bench_trace_overhead(n_pods: int, n_types: int) -> dict:
     """The solvetrace acceptance gate: tracing is ON by default, so its cost
     must be measured and bounded. The SAME warm snapshot solves with the
@@ -959,7 +1202,22 @@ def bench_trace_overhead(n_pods: int, n_types: int) -> dict:
     on.solve(snap)  # warm: jit compile (shared cache)
     off.solve(snap)
     times = {"on": [], "off": []}
-    for _ in range(5):  # interleave so drift hits both arms equally
+    # interleave so drift hits both arms equally. The rep count matters at
+    # REDUCED scale: the 50k design point has ~100ms+ solves where 5 reps
+    # suffice, but the CPU harness's ~7ms warm solves put a single ±0.3ms
+    # scheduling wobble at the 2% gate — r07 measured -2.5% (tracing
+    # "faster" than off, i.e. pure noise), so the median runs over more
+    # samples when solves are short
+    reps_env = os.environ.get("BENCH_TRACE_OVERHEAD_REPS")
+    if reps_env is not None:
+        reps = int(reps_env)  # explicit protocol choice always wins
+    else:
+        reps = 5
+        t0 = time.perf_counter()
+        on.solve(snap)
+        if time.perf_counter() - t0 < 0.05:
+            reps = 25  # short-solve regime: buy variance down
+    for _ in range(reps):
         for label, solver in (("on", on), ("off", off)):
             t0 = time.perf_counter()
             solver.solve(snap)
@@ -1289,6 +1547,10 @@ def main():
         os.environ.setdefault("BENCH_CHURN_PODS", "2500")
         os.environ.setdefault("BENCH_CHURN_ITER", "8")
         os.environ.setdefault("BENCH_CHURN_EVENTS_GATE", "2500")
+        # fleet_multitenant smoke: K=4 tenants at ~1/160 scale each
+        os.environ.setdefault("BENCH_FLEET_PODS", "300")
+        os.environ.setdefault("BENCH_FLEET_ITER", "32")
+        os.environ.setdefault("BENCH_COMPILE_CACHE_PODS", "500")
         os.environ.setdefault("BENCH_DEADLINE_SECONDS", "1800")
         _RESULT["extra"]["smoke"] = True
     _install_guards(float(os.environ.get("BENCH_DEADLINE_SECONDS", "3300")))
@@ -1378,6 +1640,32 @@ def main():
             extra[f"churn_{k}"] = ch[k]
         extra["churn_modes"] = ch["modes"]
         extra["churn_full_solve_reasons"] = ch["full_solve_reasons"]
+    # the fleet front-end (BENCH_r08): K tenants multiplexed by one process —
+    # aggregate throughput vs the single-tenant baseline, per-tenant P99,
+    # zero steady recompiles fleet-wide, and zero cold-start compiles for
+    # every tenant past the first (shared jitted kernels)
+    n_fleet_tenants = int(os.environ.get("BENCH_FLEET_TENANTS", "4"))
+    n_fleet_base = int(os.environ.get("BENCH_FLEET_PODS", "1250"))
+    fleet_iters = int(os.environ.get("BENCH_FLEET_ITER", "48"))
+    fl = _run_scenario("fleet_multitenant", bench_fleet_multitenant, n_fleet_tenants, n_fleet_base, fleet_iters)
+    if fl is not None:
+        for key in (
+            "tenants", "n_base_per_tenant", "aggregate_events_per_sec",
+            "baseline_events_per_sec", "throughput_ratio", "worst_tenant_p99_seconds",
+            "steady_recompiles", "coldstart_compiles",
+            "throughput_gate", "p99_gate", "recompile_gate", "coldstart_gate",
+        ):
+            extra[f"fleet_{key}"] = fl[key]
+        extra["fleet_per_tenant"] = fl["per_tenant"]
+    # compile-cache warm restart: a second process's cold solve rides the
+    # persistent executable cache instead of recompiling
+    cc = _run_scenario(
+        "fleet_compile_cache", bench_fleet_compile_cache,
+        int(os.environ.get("BENCH_COMPILE_CACHE_PODS", "800")),
+        int(os.environ.get("BENCH_COMPILE_CACHE_TYPES", "20")),
+    )
+    if cc is not None:
+        extra.update(cc)
     # solvetrace on/off overhead at the headline scale (<2% gate; tracing is
     # default-on, so this is the cost every number above already paid)
     tov = _run_scenario("trace_overhead", bench_trace_overhead, n_pods, n_types)

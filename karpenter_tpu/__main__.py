@@ -28,6 +28,15 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=None, help="health + metrics port (0 = ephemeral; default --metrics-port)")
     parser.add_argument("--bind", default="0.0.0.0", help="health + metrics bind address")
     parser.add_argument("--tick-seconds", type=float, default=1.0, help="controller round interval")
+    parser.add_argument(
+        "--fleet-tenants",
+        type=int,
+        default=0,
+        help="N>0 boots the multi-tenant fleet front-end instead of the single-cluster "
+        "loop: N tenant control planes in this process, push-driven wake, shared "
+        "jitted kernels, one /metrics (tenant-labeled). Tenant ids are tenant-0..N-1; "
+        "KARPENTER_SOLVER_COMPILE_CACHE=<dir> persists compiles across restarts.",
+    )
     # every reference flag (options.go AddFlags: --metrics-port,
     # --kube-client-qps, --log-level, --disable-leader-election,
     # --enable-profiling, --feature-gates, ...) parses via Options.from_args
@@ -52,6 +61,9 @@ def main(argv=None) -> int:
         level={"debug": logging.DEBUG, "info": logging.INFO, "error": logging.ERROR}[options.log_level],
         handlers=handlers or None,
     )
+
+    if args.fleet_tenants > 0:
+        return _run_fleet(args, options, port)
 
     env = Environment(options=options, clock=Clock())
     server = OperatorServer(env, port=port, enable_profiling=options.enable_profiling, bind=args.bind)
@@ -81,6 +93,60 @@ def main(argv=None) -> int:
             leader_election=not options.disable_leader_election,
         )
     finally:
+        server.stop()
+        if health_server is not None:
+            health_server.stop()
+    return 0
+
+
+def _run_fleet(args, options, port: int) -> int:
+    """Fleet mode: one process, N tenant control planes, the push-driven
+    DRR serve loop, and a single metrics/debug endpoint over the shared
+    registry (the first tenant's environment fronts the HTTP surface — its
+    registry IS the fleet registry). Leader election is per-cluster state
+    the fleet does not arbitrate; run one fleet per shard."""
+    from .metrics import make_registry
+    from .serving.fleet import FleetFrontend
+
+    registry = make_registry()
+    fleet = FleetFrontend(registry=registry)
+    sessions = []
+    for i in range(args.fleet_tenants):
+        sessions.append(fleet.add_tenant(f"tenant-{i}", options=options, clock=Clock()))
+    server = OperatorServer(sessions[0].env, port=port, enable_profiling=options.enable_profiling, bind=args.bind)
+    port = server.start()
+    # same dedicated health-probe listener contract as the single-cluster
+    # path: k8s probes pointed at --health-probe-port must answer
+    health_server = None
+    if options.health_probe_port not in (port, 0):
+        health_server = OperatorServer(sessions[0].env, port=options.health_probe_port, enable_profiling=False, bind=args.bind)
+        try:
+            health_server.start()
+        except (OSError, OverflowError) as e:
+            print(f"health-probe port {options.health_probe_port} unavailable: {e}", flush=True)
+            health_server = None
+    print(
+        f"karpenter-tpu fleet up: tenants={args.fleet_tenants} solver={options.solver_backend} "
+        f"http={args.bind}:{port}",
+        flush=True,
+    )
+    stop = make_event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # not the main thread
+    fleet.start()
+    try:
+        # the fleet serve loop owns ALL solves; this thread runs the
+        # per-tenant controller rounds (lifecycle/bind/GC) at the tick
+        # cadence with provisioning skipped (tick(provision=False))
+        while not stop.is_set():
+            for sess in sessions:
+                sess.env.tick(provision=False)
+            stop.wait(args.tick_seconds)
+    finally:
+        fleet.close()
         server.stop()
         if health_server is not None:
             health_server.stop()

@@ -54,10 +54,13 @@ class Config:
     # solvetrace label keys; "proposer" is the consolidation proposer enum
     # (lp | anneal | binary-search); "event" is the churn serving loop's
     # {arrival | departure} enum; "lock" is racecheck's static make_lock
-    # call-site enum — all held to the same bound
-    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer", "event", "lock")
+    # call-site enum; "tenant" is the fleet front-end's capped label
+    # (serving.fleet.tenant_label collapses past-the-cap registrations to
+    # "overflow") — all held to the same bound
+    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer", "event", "lock", "tenant")
     # callees whose return value is enum-bounded by construction
-    bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family")
+    # (tenant_label caps distinct outputs at serving.fleet.TENANT_LABEL_CAP)
+    bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family", "tenant_label")
     # wrapper methods whose OWN bodies forward **labels to the registry
     metric_wrappers: tuple[str, ...] = ("_count", "_observe")
     # cap on distinct literal values per bounded label key, repo-wide
@@ -108,6 +111,11 @@ class Config:
         "PendingPrestager._on_event",
         "*.serve_forever",  # stdlib ThreadingHTTPServer worker
         "*.renew_loop",  # LeaderElector renewer (target is a non-self attr)
+        # fleet front-end (serving/fleet.py): the DRR serve loop thread and
+        # the per-tenant watch->wake callback (runs on watch delivery; marks
+        # the tenant runnable under the fleet's leaf locks)
+        "FleetFrontend._serve_loop",
+        "karpenter_tpu/serving/fleet.py:_on_watch_event",
         "karpenter_tpu/serving/churn.py:_churn_driver",
         # informer/cost watch callbacks: they only call into the
         # lock-guarded Cluster/ClusterCost/Store surfaces

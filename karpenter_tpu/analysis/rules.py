@@ -431,18 +431,18 @@ class MetricLabelCardinalityRule(Rule):
     description = "bounded metric labels must carry statically enumerable values"
     _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
 
-    # the seeded violation is a delta-reject one: the reject counter's
-    # `reason` label fed a runtime-formatted value instead of a
-    # DELTA_REJECT_REASONS literal — exactly the drift the delta-path
-    # producers (encode._try_delta_encode / TPUSolver._note_delta_reject)
-    # must never regress into
+    # the seeded violation is a fleet tenant-label one: the solve counter's
+    # `tenant` label fed a raw tenant id instead of a
+    # serving.fleet.tenant_label() output — exactly the cardinality leak the
+    # multi-tenant front-end must never regress into (a fleet admitting
+    # arbitrary cluster ids would mint one series per customer id)
     SELF_TEST_BAD = (
-        "def record(registry, why):\n"
-        '    registry.counter("karpenter_solver_delta_reject_total").inc(reason="delta-" + str(why))\n'
+        "def record(registry, session):\n"
+        '    registry.counter("karpenter_solver_solve_total").inc(backend="tpu", tenant=session.tenant_id)\n'
     )
     SELF_TEST_OK = (
-        "def record(registry, pod):\n"
-        '    registry.counter("m").inc(reason="bounded-value")\n'
+        "def record(registry, session):\n"
+        '    registry.counter("karpenter_solver_solve_total").inc(backend="tpu", tenant=tenant_label(session.tenant_id))  # noqa: F821 — fixture, parsed only\n'
     )
 
     def __init__(self):

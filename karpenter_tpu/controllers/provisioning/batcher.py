@@ -52,6 +52,14 @@ class Batcher:
         self._in_flight = False
         self._during = 0  # triggers folded into the in-flight solve's window
         self._drain = False  # a coalesced generation is waiting: fire now
+        # push-wake seam (serving/fleet.py): a zero-arg callable invoked on
+        # every trigger, AFTER the lock is released — the fleet front-end
+        # installs one per tenant to mark the tenant runnable and wake the
+        # fleet loop, so a watch-delivered arrival reaches the scheduler
+        # push-style instead of waiting for the next poll of ready(). The
+        # hook must be cheap and lock-ordered BELOW the batcher lock (the
+        # fleet's wake path takes only its own leaf lock + an Event.set).
+        self.wake_hook = None
 
     def trigger(self, uid: str = "") -> None:
         now = self.clock.now()
@@ -62,6 +70,9 @@ class Batcher:
             self._count += 1
             if self._in_flight:
                 self._during += 1
+        hook = self.wake_hook
+        if hook is not None:
+            hook()
 
     # -- in-flight coalescing (serving loop) -----------------------------------
     def take_generation(self) -> int:
@@ -103,6 +114,20 @@ class Batcher:
         """Triggers accumulated in the current (unfired) generation."""
         with self._lock:
             return self._count
+
+    def eta(self) -> float | None:
+        """Seconds until `ready()` would fire for the pending generation
+        (0.0 = ready now), or None when no generation is open. The fleet
+        front-end's push loop sleeps exactly this long instead of polling:
+        the idle/max window stays a COALESCING bound while the poll interval
+        stops being a latency floor."""
+        now = self.clock.now()
+        with self._lock:
+            if self._first is None:
+                return None
+            if self._drain:
+                return 0.0
+            return max(0.0, min(self._last + self.idle, self._first + self.max) - now)
 
     def ready(self) -> bool:
         now = self.clock.now()

@@ -40,6 +40,11 @@ class Provisioner:
         self.options = options or ProvisionerOptions()
         self.metrics = metrics
         self.batcher = Batcher(clock, self.options.batch_idle_seconds, self.options.batch_max_seconds)
+        # bounded fleet tenant label (serving.fleet.tenant_label output, set
+        # by FleetFrontend at session registration): rides the churn metric
+        # families so one shared fleet registry attributes them per tenant.
+        # "" outside a fleet — the registry renders that as the empty label.
+        self.tenant = ""
         # serving-loop double-buffer (serving/prestage.py): when installed,
         # get_pending_pods consumes pre-staged pod clones (already validated
         # and signature-stamped, by the worker that overlapped the previous
@@ -73,10 +78,10 @@ class Provisioner:
                 from ... import metrics as m
 
                 if coalesced:
-                    self.metrics.counter(m.SOLVER_CHURN_COALESCED_TOTAL).inc(coalesced)
-                self.metrics.histogram(m.SOLVER_CHURN_EVENTS_PER_SOLVE).observe(float(events))
+                    self.metrics.counter(m.SOLVER_CHURN_COALESCED_TOTAL).inc(coalesced, tenant=self.tenant)  # solverlint: ok(metric-label-cardinality): tenant is a serving.fleet.tenant_label() output stored at fleet registration — the bounded fleet enum ("" outside a fleet)
+                self.metrics.histogram(m.SOLVER_CHURN_EVENTS_PER_SOLVE).observe(float(events), tenant=self.tenant)  # solverlint: ok(metric-label-cardinality): tenant is a serving.fleet.tenant_label() output stored at fleet registration — the bounded fleet enum ("" outside a fleet)
                 # depth AFTER the solve: the coalesced generation still queued
-                self.metrics.gauge(m.SOLVER_CHURN_QUEUE_DEPTH).set(self.batcher.pending())
+                self.metrics.gauge(m.SOLVER_CHURN_QUEUE_DEPTH).set(self.batcher.pending(), tenant=self.tenant)
         return results
 
     # -- the provisioning pass (provisioner.go:350-458) ------------------------

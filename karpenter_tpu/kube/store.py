@@ -96,6 +96,16 @@ class Store:
 
     # -- watches ---------------------------------------------------------------
     def watch(self, kind: str, fn: WatchFn) -> None:
+        """Register a watch callback. Execution context contract: callbacks
+        run SYNCHRONOUSLY on whatever thread committed the store write,
+        under `_deliver_lock` — so they must be cheap and leaf-locked. This
+        is the watch->wake seam the serving stack builds on: informer
+        mirrors, the provisioner's batcher trigger, and the fleet
+        front-end's push wake (`TenantSession._on_watch_event`, which marks
+        the tenant runnable and sets the fleet loop's event) all ride it;
+        every registered callback is a reviewed entry in the
+        `[tool.solverlint] thread-shared` registry (the thread-escape rule
+        enforces that at the call site)."""
         with self._lock:
             self._watchers.setdefault(kind, []).append(fn)
 

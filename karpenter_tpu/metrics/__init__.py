@@ -92,6 +92,18 @@ SOLVER_CONSOLIDATION_PROPOSALS_TOTAL = "karpenter_solver_consolidation_proposals
 SOLVER_CONSOLIDATION_LP_ITERATIONS_TOTAL = "karpenter_solver_consolidation_lp_iterations_total"
 SOLVER_CONSOLIDATION_VALIDATION_TOTAL = "karpenter_solver_consolidation_validation_total"
 SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR = "karpenter_solver_consolidation_savings_per_hour"
+# fleet front-end (serving/fleet.py): one solver process multiplexing many
+# tenant clusters. `tenant` is the BOUNDED fleet label (serving.fleet
+# tenant_label: the first registrations keep their sanitized ids, the rest
+# collapse to "overflow"); it also rides karpenter_solver_solve_total and
+# the churn families so per-tenant serving behavior is attributable from
+# one shared registry.
+SOLVER_FLEET_RUNNABLE_TENANTS = "karpenter_solver_fleet_runnable_tenants"
+SOLVER_FLEET_WAKE_TOTAL = "karpenter_solver_fleet_wake_total"
+SOLVER_FLEET_SCHED_WAIT_SECONDS = "karpenter_solver_fleet_sched_wait_seconds"
+# wake-to-solve wait: sub-ms when the fleet loop is idle, growing under
+# multiplexing pressure — the fairness policy's observable surface
+SOLVER_FLEET_SCHED_WAIT_BUCKETS = (0.000_1, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
 # racecheck (obs/racecheck.py): lock-contention observability — wait time per
 # named serving-stack lock, emitted by the instrumented wrapper under
 # KARPENTER_SOLVER_RACECHECK=1. `lock` is the static make_lock call-site enum.
@@ -145,7 +157,7 @@ def make_registry() -> Registry:
     r.counter(NODEPOOL_COST_TRACKER_ERRORS_TOTAL, "Cost tracking errors", ("nodepool",))
     r.gauge(CLUSTER_STATE_SYNCED, "1 if cluster state is synced", ())
     r.gauge(CLUSTER_STATE_NODE_COUNT, "Nodes tracked by cluster state", ())
-    r.counter(SOLVER_SOLVE_TOTAL, "Solves by backend actually used", ("backend",))
+    r.counter(SOLVER_SOLVE_TOTAL, "Solves by backend actually used", ("backend", "tenant"))
     r.counter(SOLVER_FALLBACK_TOTAL, "Tensor-path solves that fell back to the host FFD", ("reason",))
     r.counter(SOLVER_VALIDATION_FAILURES_TOTAL, "Device placements rejected by the post-solve validator", ())
     r.counter(
@@ -198,23 +210,41 @@ def make_registry() -> Registry:
         SOLVER_CHURN_COALESCED_TOTAL,
         "Provisioner triggers that arrived during an in-flight solve and were "
         "coalesced into one batched follow-up solve instead of one solve each",
-        (),
+        ("tenant",),
     )
     r.gauge(
         SOLVER_CHURN_QUEUE_DEPTH,
         "Triggers accumulated in the batcher's pending generation after the last solve",
-        (),
+        ("tenant",),
     )
     r.histogram(
         SOLVER_CHURN_EVENTS_PER_SOLVE,
         "Trigger events drained by one provisioning solve (the coalescing ratio)",
-        (),
+        ("tenant",),
         (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
     )
     r.counter(
         SOLVER_CHURN_EVENTS_TOTAL,
         "Pod churn events applied by the serving loop, by kind (arrival | departure)",
-        ("event",),
+        ("event", "tenant"),
+    )
+    r.gauge(
+        SOLVER_FLEET_RUNNABLE_TENANTS,
+        "Tenants currently marked runnable by the fleet front-end's push wake",
+        (),
+    )
+    r.counter(
+        SOLVER_FLEET_WAKE_TOTAL,
+        "Fleet wake-ups: a watch-delivered trigger marked the tenant runnable "
+        "and woke the fleet loop (push path, no idle-window poll stall)",
+        ("tenant",),
+    )
+    r.histogram(
+        SOLVER_FLEET_SCHED_WAIT_SECONDS,
+        "Time from a tenant becoming runnable to its solve starting (the "
+        "deficit-round-robin scheduling delay under multiplexing)",
+        ("tenant",),
+        SOLVER_FLEET_SCHED_WAIT_BUCKETS,
     )
     r.counter(
         SOLVER_CONSOLIDATION_PROPOSALS_TOTAL,

@@ -227,6 +227,16 @@ def reset_bucket_highwater() -> None:
     _BUCKET_HW.clear()
 
 
+def bucket_highwater() -> dict[str, int]:
+    """Snapshot of the process-global high-water marks, by axis. This IS the
+    fleet-scoped shape ladder: every tenant a FleetFrontend multiplexes pads
+    to these marks, so a tenant warmed by ANOTHER tenant's solves hits only
+    already-compiled kernel shapes. The marks are plain axis SIZES — sharing
+    them across tenants shares compiled shapes, never tensor content (the
+    fleet's isolation audit reads this surface)."""
+    return dict(_BUCKET_HW)
+
+
 # bucket granularity per axis: small enough to keep padding waste low, large
 # enough that steady workload drift stays inside one compiled shape
 ROWS_BUCKET = 64

@@ -59,14 +59,17 @@ from .options import Options
 class Environment:
     """A fully wired in-process cluster + Karpenter control plane."""
 
-    def __init__(self, options: Options | None = None, clock=None, cloud_provider=None, instance_types=None, store=None, registration_hooks=None):
+    def __init__(self, options: Options | None = None, clock=None, cloud_provider=None, instance_types=None, store=None, registration_hooks=None, registry=None):
         """`store` lets a second Environment attach to an existing cluster
         (active/standby takeover tests): informers seed the fresh in-memory
         mirror from the shared store's current content, exactly like a new
-        leader warming its caches (operator.go:196-201)."""
+        leader warming its caches (operator.go:196-201). `registry` lets the
+        fleet front-end share ONE metrics registry across its per-tenant
+        environments (per-tenant series split on the bounded `tenant`
+        label); default is a private registry per environment."""
         self.options = options or Options()
         self.clock = clock or FakeClock()
-        self.registry = make_registry()
+        self.registry = registry if registry is not None else make_registry()
         # solvetrace flight recorder backing /debug/solves — the process-wide
         # default, so every solver this environment (or a test beside it)
         # runs is visible from the operator's debug surface
@@ -191,8 +194,13 @@ class Environment:
         return FFDSolver()
 
     # -- deterministic driver --------------------------------------------------
-    def tick(self, provision_force: bool = False) -> None:
-        """One controller round: provision -> launch/register/init -> bind."""
+    def tick(self, provision_force: bool = False, provision: bool = True) -> None:
+        """One controller round: provision -> launch/register/init -> bind.
+        `provision=False` skips the provisioner reconcile — fleet mode runs
+        controller rounds on the operator thread while ALL solves stay on
+        the fleet serve loop (one solver, one thread: the provisioner's
+        encode caches and device-resident carry are single-threaded by
+        design, the same contract ServingLoop relies on)."""
         if hasattr(self.cloud_provider, "flush_pending"):
             self.cloud_provider.flush_pending()
         self.nodeoverlay.reconcile()
@@ -204,7 +212,8 @@ class Environment:
             self.capacity_buffer.reconcile()
         self.static_provisioning.reconcile()
         self.static_deprovisioning.reconcile()
-        self.provisioner.reconcile(force=provision_force)
+        if provision:
+            self.provisioner.reconcile(force=provision_force)
         self.lifecycle.reconcile_all()
         if hasattr(self.cloud_provider, "flush_pending"):
             self.cloud_provider.flush_pending()

@@ -16,7 +16,19 @@ makes that regime first-class:
 - `churn.ChurnHarness` — drives sustained arrivals/departures against the
   live stack and reports throughput (pod-events/sec), P50/P99 re-solve
   latency, delta-hit rate, and the recompile count (the zero-steady-state
-  gate, via the solvetrace sentinel).
+  gate, via the solvetrace sentinel). Gains record/replay: the generated
+  event stream dumps as JSONL and `ChurnSpec.from_event_log()` replays it
+  deterministically (one recorded log can drive K fleet tenants).
+- `fleet.FleetFrontend` / `fleet.TenantSession` — the multi-tenant front
+  end: ONE solver process multiplexes many tenant clusters (per-tenant
+  Store/Provisioner/EncodeCache/resident carry), watch events wake the
+  fleet loop push-style (the batcher idle/max window becomes a coalescing
+  bound, not a latency floor), a deficit-round-robin policy keeps bursty
+  tenants from starving the rest, and tenants share jitted pack-kernel
+  SHAPES (process-global high-water marks + signature interning — never
+  tensors; `isolation_audit()` enforces the split). With
+  KARPENTER_SOLVER_COMPILE_CACHE=<dir> compiled executables persist across
+  process restarts and replicas.
 
 Escape hatches: KARPENTER_SOLVER_DOUBLEBUF=0 disables the prestager (clones
 rebuilt per pass, the pre-serving-loop behavior); KARPENTER_SOLVER_BUCKET=0
@@ -31,6 +43,9 @@ the serving stack's long-lived ones, every entry a reviewed seam in the
 `[tool.solverlint] thread-shared` registry:
 
 - the SOLVE thread (whoever pumps ServingLoop / Environment.tick);
+- `karpenter-fleet` (FleetFrontend._serve_loop): the multi-tenant DRR
+  scheduling loop — sleeps on the fleet wake event (or the nearest batcher
+  `eta()`), then pumps runnable tenants; all solves in fleet mode run here;
 - `karpenter-prestage` (PendingPrestager._run): drains watch events into the
   clone cache, overlapping the device pack;
 - `churn-driver` (churn._churn_driver): the harness's concurrent event
@@ -54,6 +69,11 @@ store-deliver       watch-event FIFO delivery (RLock; reentrant for
                     watchers that write back to the store)
 cluster             Cluster's node/binding/ack mirrors (RLock)
 batcher             Batcher trigger + in-flight bracket counters
+fleet               FleetFrontend tenant registry + runnable set + DRR
+                    deficits + serve-thread handle (leaf: only container
+                    ops run under it; solves always run unlocked)
+fleet-session       TenantSession wake-signal stats (leaf)
+fleet-labels        the bounded tenant-label assignment table (leaf)
 prestage            PendingPrestager clone cache + staged/reused/misses
                     stats + worker thread handle
 metric / metric-    every _Metric's series maps / Registry._metrics (RLock)
@@ -70,18 +90,25 @@ SANCTIONED ORDER (acquire left before right; the dynamic graph must stay a
 DAG, and the sanitizer raises on the first acquisition that closes a
 cycle):
 
-    store-deliver  ->  { store, cluster, batcher, prestage, clock, metric* }
+    store-deliver  ->  { store, cluster, batcher, prestage, clock, metric*,
+                         fleet-session, fleet }
     cluster        ->  { store, clock }
     trace          ->  { metric-registry, metric }
     events | store | batcher | prestage  ->  clock
+
+(The fleet edges are the push-wake path: watch delivery -> batcher trigger
+-> wake_hook -> TenantSession stats -> FleetFrontend runnable set, each
+lock RELEASED before the next is taken except the ambient store-deliver.)
 
 Everything else is leaf-only. Two rules keep it that way: (1) never WRITE
 to the store while holding `cluster` (a write drains watches under
 store-deliver — the reverse edge); (2) never solve, device-sync, or call
 `store._drain` while holding ANY lock (the lock-order rule flags those
-statically).
+statically) — the fleet loop obeys the same discipline: `FleetFrontend.pump`
+releases the fleet lock around every `ServingLoop.pump`.
 """
 
 from .churn import ChurnHarness, ChurnReport, ChurnSpec  # noqa: F401
+from .fleet import FleetFrontend, TenantSession, tenant_label  # noqa: F401
 from .loop import ServingLoop, doublebuf_enabled  # noqa: F401
 from .prestage import PendingPrestager  # noqa: F401

@@ -29,6 +29,7 @@ iterations that already pay a row-side re-encode.
 
 from __future__ import annotations
 
+import json
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -69,6 +70,16 @@ class ChurnSpec:
     # solve) is demonstrated, not just unit-tested. 0 skips the segment.
     concurrent_seconds: float = 1.5
     seed: int = 0
+    # record/replay (the ROADMAP trace-replay seed): `record_path` dumps the
+    # applied event stream as JSONL (one op per line: arrive/cancel/depart/
+    # solve/bind_flush/mark — self-contained pod params, replayable without
+    # the generator); `replay_events` drives the harness from a loaded log
+    # instead of generating events, deterministically — the multi-tenant
+    # bench replays ONE recorded log into K fleet tenants. Record with
+    # concurrent_seconds=0: the concurrent segment's thread interleaving is
+    # inherently non-replayable and is logged only in arrival order.
+    record_path: str | None = None
+    replay_events: list | None = None
     double_buffer: bool | None = None  # None = env default (on)
     # worker=False: prestage synchronously. On a CPU-only harness the pack
     # "device" shares the host cores, so a prestage thread can only contend
@@ -77,6 +88,32 @@ class ChurnSpec:
     # tunnel and the worker overlaps for free; set worker=True there.
     worker: bool = False
     trace_capacity: int = 8192
+
+    @classmethod
+    def from_event_log(cls, path: str, **overrides) -> "ChurnSpec":
+        """A replay spec: drive the harness from a recorded JSONL event log
+        instead of generating events. Scale fields are taken from the log's
+        header line when present (so gates scale consistently); overrides
+        win. The replay is deterministic: same log + same seed = the same
+        placements, which is what lets one recorded stream drive K fleet
+        tenants and be compared bit-for-bit."""
+        events = []
+        header: dict = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                op = json.loads(line)
+                if op.get("op") == "header":
+                    header = op
+                else:
+                    events.append(op)
+        kw = {k: header[k] for k in ("n_base_pods", "n_types", "arrivals", "cancels", "departures", "bind_every", "seed", "batch_idle_seconds") if k in header}
+        kw.update(overrides)
+        kw["replay_events"] = events
+        kw.setdefault("concurrent_seconds", 0.0)
+        return cls(**kw)
 
 
 @dataclass
@@ -174,7 +211,17 @@ class ChurnHarness:
         self._prebuilt: deque = deque()  # pre-constructed arrival pods
         self.env = None
         self.loop: ServingLoop | None = None
+        # fleet mode (attach): solves route through the FleetFrontend's DRR
+        # pump instead of the private ServingLoop, scoped to this tenant
+        self.fleet = None
+        self._tenant_id = None
         self.recorder = TraceRecorder(capacity=self.spec.trace_capacity, enabled=True)
+        # record/replay: the applied-event log (None = not recording)
+        self._event_log: list[dict] | None = [] if self.spec.record_path else None
+
+    def _log(self, **op) -> None:
+        if self._event_log is not None:
+            self._event_log.append(op)
 
     # -- stack -----------------------------------------------------------------
     def build(self):
@@ -218,6 +265,33 @@ class ChurnHarness:
         )
         return self
 
+    def attach(self, session, fleet=None):
+        """Attach to a fleet TenantSession instead of building a private
+        stack: the session's env/loop/recorder serve this harness, and with
+        `fleet` given, `solve()` routes through the fleet's DRR pump (the
+        push-wake path) instead of pumping the tenant loop directly. The
+        caller owns batch-window sizing via the session's Options."""
+        import random
+
+        from ..apis import labels as wk
+        from ..apis.nodepool import NodePool
+        from ..kube.objects import ObjectMeta
+
+        random.seed(self.spec.seed)
+        self.env = session.env
+        self.loop = session.loop
+        self.recorder = session.recorder
+        self.fleet = fleet
+        self._tenant_id = session.tenant_id
+        if self.env.store.try_get("NodePool", "churn-pool") is None:
+            pool = NodePool(metadata=ObjectMeta(name="churn-pool"))
+            pool.spec.template.requirements = [
+                {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
+                {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
+            ]
+            self.env.store.create(pool)
+        return self
+
     def close(self) -> None:
         if self.loop is not None:
             self.loop.close()
@@ -225,16 +299,18 @@ class ChurnHarness:
     # -- event application -----------------------------------------------------
     def _record_events(self, n: int, event: str) -> None:
         if n and self.env is not None:
+            tenant = self.env.provisioner.tenant
             if event == "arrival":
-                self.env.registry.counter(m.SOLVER_CHURN_EVENTS_TOTAL).inc(n, event="arrival")
+                self.env.registry.counter(m.SOLVER_CHURN_EVENTS_TOTAL).inc(n, event="arrival", tenant=tenant)  # solverlint: ok(metric-label-cardinality): tenant is the provisioner's fleet registration label (a tenant_label() output; "" outside a fleet)
             else:
-                self.env.registry.counter(m.SOLVER_CHURN_EVENTS_TOTAL).inc(n, event="departure")
+                self.env.registry.counter(m.SOLVER_CHURN_EVENTS_TOTAL).inc(n, event="departure", tenant=tenant)  # solverlint: ok(metric-label-cardinality): tenant is the provisioner's fleet registration label (a tenant_label() output; "" outside a fleet)
 
     def _build_pod(self):
-        cpu, mem, labels, zone = _SHAPES[self._seq % len(_SHAPES)]
+        shape = self._seq % len(_SHAPES)
+        cpu, mem, labels, zone = _SHAPES[shape]
         name = f"churn-{self._seq}"
         self._seq += 1
-        return name, _make_pod(name, cpu, mem, labels, zone)
+        return name, _make_pod(name, cpu, mem, labels, zone), shape
 
     def prebuild(self, n: int) -> None:
         """Construct n arrival pods ahead of time (a real apiserver receives
@@ -246,8 +322,12 @@ class ChurnHarness:
 
     def apply_arrivals(self, n: int) -> int:
         store = self.env.store
+        log = self._event_log is not None
         for _ in range(n):
-            name, pod = self._prebuilt.popleft() if self._prebuilt else self._build_pod()
+            name, pod, shape = self._prebuilt.popleft() if self._prebuilt else self._build_pod()
+            if log:
+                cpu, mem, labels, zone = _SHAPES[shape]
+                self._log(op="arrive", name=name, cpu=cpu, memory=mem, labels=labels, zone=zone)
             # adopt: the harness relinquishes the pod object on creation
             store.create(pod, adopt=True)
             self._pending.append(name)
@@ -260,6 +340,7 @@ class ChurnHarness:
         while done < n_new and self._pending:
             name = self._pending.pop()  # newest first
             if self.env.store.try_delete("Pod", name, namespace="default"):
+                self._log(op="cancel", name=name)
                 done += 1
         while done < n and self._pending:
             name = self._pending.popleft()  # oldest: already-placed pods
@@ -270,6 +351,7 @@ class ChurnHarness:
                 self._bound.append(name)  # bound since we last looked
                 continue
             self.env.store.try_delete("Pod", name, namespace="default")
+            self._log(op="cancel", name=name)
             done += 1
         self._record_events(done, "departure")
         return done
@@ -279,6 +361,7 @@ class ChurnHarness:
         while done < n and self._bound:
             name = self._bound.popleft()
             if self.env.store.try_delete("Pod", name, namespace="default"):
+                self._log(op="depart", name=name)
                 done += 1
         self._record_events(done, "departure")
         return done
@@ -286,6 +369,7 @@ class ChurnHarness:
     def bind_flush(self) -> None:
         """Launch claims, register nodes, bind pending pods — the controller
         work between solves. Re-files newly bound pods from pending to bound."""
+        self._log(op="bind_flush")
         env = self.env
         if hasattr(env.cloud_provider, "flush_pending"):
             env.cloud_provider.flush_pending()
@@ -307,8 +391,16 @@ class ChurnHarness:
 
     def solve(self, force: bool = False):
         """Advance the fake clock past the idle window and pump one serving
-        iteration (plus any coalesced drain generations)."""
+        iteration (plus any coalesced drain generations). In fleet mode the
+        pump goes through the FleetFrontend's DRR round — the push-wake path
+        the watch events already armed — instead of the private loop."""
+        self._log(op="solve", force=bool(force))
         self.env.clock.step(self.spec.batch_idle_seconds + 0.05)
+        if self.fleet is not None:
+            # scope to the attached tenant: a per-tenant warmup solve must
+            # not fan out as a forced reconcile of every OTHER tenant
+            served = self.fleet.pump(force=force, only=self._tenant_id)
+            return served or None
         out = self.loop.pump(force=force)
         self.loop.drain()
         return out
@@ -349,10 +441,20 @@ class ChurnHarness:
 
     def run(self) -> ChurnReport:
         """Warmup cycles (cold compiles + high-water marks), then the
-        measured steady phase."""
+        measured steady phase. With `spec.replay_events` set, the recorded
+        log drives everything instead (see run_replay)."""
         s = self.spec
+        if s.replay_events is not None:
+            return self.run_replay()
         if self.env is None:
             self.build()
+        if self._event_log is not None:
+            self._log(
+                op="header",
+                n_base_pods=s.n_base_pods, n_types=s.n_types, arrivals=s.arrivals,
+                cancels=s.cancels, departures=s.departures, bind_every=s.bind_every,
+                seed=s.seed, batch_idle_seconds=s.batch_idle_seconds,
+            )
         self.provision_base_fleet()
         # free steady-state headroom up front: arrivals land on capacity that
         # departures keep releasing; without this the first cycles would
@@ -374,6 +476,7 @@ class ChurnHarness:
             self.run_cycle()
         # -- steady phase ------------------------------------------------------
         self.prebuild(s.arrivals * s.iterations)
+        self._log(op="mark")
         mark = self.recorder.seq
         rejects0 = self._reject_counts()
         coalesced0 = self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total()
@@ -409,6 +512,86 @@ class ChurnHarness:
                         recompiles[fn] = recompiles.get(fn, 0) + cnt
             rep.recompiles = recompiles
             rep.steady_recompiles = sum(recompiles.values())
+        if self._event_log is not None and s.record_path:
+            self.dump_event_log(s.record_path)
+        return rep
+
+    # -- record/replay ---------------------------------------------------------
+    def dump_event_log(self, path: str) -> int:
+        """Write the recorded event stream as JSONL; returns ops written."""
+        ops = self._event_log or []
+        with open(path, "w") as f:
+            for op in ops:
+                f.write(json.dumps(op) + "\n")
+        return len(ops)
+
+    def apply_op(self, op: dict) -> int:
+        """Apply one non-solve replay op; returns churn events applied.
+        Solve ops are the DRIVER's job (run_replay calls self.solve; the
+        multi-tenant bench paces them through the fleet pump instead)."""
+        kind = op["op"]
+        if kind == "arrive":
+            pod = _make_pod(op["name"], op["cpu"], op["memory"], op.get("labels"), op.get("zone"))
+            self.env.store.create(pod, adopt=True)
+            self._pending.append(op["name"])
+            self._record_events(1, "arrival")
+            return 1
+        if kind in ("cancel", "depart"):
+            name = op["name"]
+            if not self.env.store.try_delete("Pod", name, namespace="default"):
+                return 0
+            try:
+                self._pending.remove(name)
+            except ValueError:
+                try:
+                    self._bound.remove(name)
+                except ValueError:
+                    pass
+            self._record_events(1, "departure")
+            return 1
+        if kind == "bind_flush":
+            self.bind_flush()
+            return 0
+        if kind in ("header", "mark"):
+            return 0
+        raise ValueError(f"unknown replay op {kind!r}")
+
+    def run_replay(self) -> ChurnReport:
+        """Drive the harness from `spec.replay_events`, deterministically:
+        the recorded arrive/cancel/depart/solve/bind_flush sequence replays
+        verbatim, the recorded `mark` op opens the measured window, and the
+        report comes from the same machinery as a generated run."""
+        s = self.spec
+        if self.env is None:
+            self.build()
+        mark = self.recorder.seq
+        rejects0 = self._reject_counts()
+        coalesced0 = self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total()
+        reused0 = self.loop.prestager.reused if self.loop.prestager is not None else 0
+        staged0 = self.loop.prestager.staged if self.loop.prestager is not None else 0
+        events = 0
+        t0 = time.perf_counter()
+        for op in s.replay_events:
+            kind = op["op"]
+            if kind == "solve":
+                self.solve(force=op.get("force", False))
+            elif kind == "mark":
+                # steady window opens HERE, exactly like the generated run
+                mark = self.recorder.seq
+                rejects0 = self._reject_counts()
+                coalesced0 = self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total()
+                reused0 = self.loop.prestager.reused if self.loop.prestager is not None else 0
+                staged0 = self.loop.prestager.staged if self.loop.prestager is not None else 0
+                events = 0
+                t0 = time.perf_counter()
+            else:
+                events += self.apply_op(op)
+        wall = time.perf_counter() - t0
+        rep = self._report(mark, events, wall, coalesced0, reused0, staged0)
+        rejects1 = self._reject_counts()
+        rep.full_solve_reasons = {
+            k: int(v - rejects0.get(k, 0)) for k, v in rejects1.items() if v > rejects0.get(k, 0)
+        }
         return rep
 
     def run_concurrent(self, seconds: float, batch: int | None = None) -> tuple[int, int]:

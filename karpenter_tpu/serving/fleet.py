@@ -1,0 +1,500 @@
+"""FleetFrontend: push-driven wake + multi-tenant solver multiplexing.
+
+PR 10/12 made the solver a long-lived service, but the arrival path's
+latency floor was still the poll-based idle-window reconcile, and one
+process served exactly one cluster. This module is the horizontal-scaling
+shape the ROADMAP names: ONE solver process multiplexing MANY tenant
+clusters, with watch events flowing push-style into each tenant's Batcher
+and on into the fleet loop.
+
+Mechanisms, in dependency order:
+
+- PUSH WAKE: every tenant Environment already routes store watch events
+  into its provisioner's Batcher (`Provisioner.trigger`). The fleet
+  completes the push path with two seams: the Batcher's `wake_hook` (fires
+  on every trigger, after the batcher lock releases) and a per-tenant store
+  watch callback (`TenantSession._on_watch_event`, so deletions — which
+  never trigger the batcher — still wake promptly). Both mark the tenant
+  RUNNABLE under the fleet lock and set the fleet's wake event; the serve
+  loop sleeps on that event with a timeout of `min(batcher.eta())` over
+  tenants with an open generation, so the idle/max batching window remains
+  a COALESCING bound while the poll interval stops being a latency floor.
+- FAIRNESS: one deficit-round-robin pass over the runnable tenants per
+  `pump()` round. Each runnable tenant is credited `quantum` solve credits
+  (banked deficit capped at `backlog_solve_cap`), and a solve costs one
+  credit — a bursty tenant whose batcher re-arms after every solve (the
+  coalesced-drain pattern) can run at most `backlog_solve_cap` solves per
+  round before the ring moves on, so it cannot starve the rest.
+- SHARED JITTED KERNELS: the bucket high-water marks
+  (models.scheduler_model._BUCKET_HW), the signature intern table and the
+  row-artifact cache (solver.encode) are process-global, i.e. FLEET-scoped.
+  Tenants share compiled pack-kernel SHAPES — tenant N+1's first solve at
+  the fleet's established marks records zero new compiles — while actual
+  tensor DATA stays per-tenant: row artifacts are keyed by each cluster's
+  process-unique epoch and every EncodeCache/resident carry lives on the
+  tenant's own solver. `isolation_audit()` verifies that discipline.
+- PERSISTENT COMPILE CACHE: ``KARPENTER_SOLVER_COMPILE_CACHE=<dir>``
+  (solver.tpu.configure_compile_cache) persists compiled executables to
+  disk, so a RESTARTED process or a fresh replica skips the cold compile
+  storm entirely — the cross-process arm of the warm-start story.
+
+Determinism contract: `pump()` runs each tenant's ordinary
+`ServingLoop.pump()` — the same reconcile the single-tenant poll loop runs
+— so push-vs-poll and fleet-vs-solo placements are bit-identical for
+identical event streams (tests pin this). The fleet changes WHEN solves
+run, never what they compute.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..obs.racecheck import make_event, make_lock, spawn_thread, touch
+from ..obs.trace import TraceRecorder
+from .loop import ServingLoop
+
+# distinct tenant label values the bounded `tenant` metric label may carry
+# before collapsing to "overflow" — kept under solverlint's
+# max-label-values cap so the fleet can never become a cardinality leak
+TENANT_LABEL_CAP = 12
+_TENANT_LABELS: dict[str, str] = {}
+# module-scoped (the label assignment is process-global like the caches it
+# labels); constructed through the sanctioned factory
+_TENANT_LABELS_LOCK = make_lock("fleet-labels")
+
+
+def tenant_label(tenant_id: str) -> str:
+    """The BOUNDED metric label for a tenant id: the first TENANT_LABEL_CAP
+    distinct ids keep their sanitized form, later ones collapse to
+    "overflow". Distinct ids NEVER share a label short of the cap — two ids
+    whose sanitized forms collide ("team/a" vs "team:a") get a numeric
+    disambiguator instead of silently merging their metric series. This is
+    the `bounded_label_producers` entry solverlint's metric-label-
+    cardinality rule trusts — every `tenant=` label value in the repo must
+    come from here (or carry a justified pragma)."""
+    tenant_id = str(tenant_id)
+    with _TENANT_LABELS_LOCK:
+        label = _TENANT_LABELS.get(tenant_id)
+        if label is not None:
+            return label
+        if len(_TENANT_LABELS) >= TENANT_LABEL_CAP:
+            label = "overflow"
+        else:
+            base = "".join(c if (c.isalnum() or c in "-_.") else "-" for c in tenant_id)[:60] or "default"
+            # "overflow" is RESERVED for the past-the-cap bucket: an in-cap
+            # tenant whose id sanitizes to it gets disambiguated instead of
+            # merging its series with every capped tenant's
+            used = set(_TENANT_LABELS.values()) | {"overflow"}
+            label, n = base, 2
+            while label in used:
+                label, n = f"{base}-{n}", n + 1
+        _TENANT_LABELS[tenant_id] = label
+        return label
+
+
+def reset_tenant_labels() -> None:
+    """Drop the process-global label assignments (test isolation)."""
+    with _TENANT_LABELS_LOCK:
+        _TENANT_LABELS.clear()
+
+
+class TenantSession:
+    """One tenant cluster resident in the fleet process: its own Store /
+    Cluster / Provisioner / solver (own EncodeCache + device-resident carry,
+    keyed per cluster the way `_row_cache_key` already keys rows) plus a
+    private TraceRecorder so latency quantiles are per-tenant. Only jitted
+    kernel SHAPES are shared with other tenants, never tensors."""
+
+    # racecheck guarded-field registry: wake stats are written from watch-
+    # delivery threads (the wake_hook / _on_watch_event seams) and read by
+    # the fleet loop and stats() callers
+    GUARDED_FIELDS = {
+        "wakes": "_lock",
+        "last_wake_monotonic": "_lock",
+    }
+
+    def __init__(self, fleet: "FleetFrontend", tenant_id: str, env, loop: ServingLoop, recorder: TraceRecorder, label: str):
+        self.fleet = fleet
+        self.tenant_id = tenant_id
+        self.label = label
+        self.env = env
+        self.loop = loop
+        self.recorder = recorder
+        self._lock = make_lock("fleet-session")
+        self.wakes = 0  # wake SIGNALS delivered (watch + trigger seams; a
+        # watch-driven trigger legitimately signals through both)
+        self.last_wake_monotonic = 0.0
+
+    # -- the push seams --------------------------------------------------------
+    def _on_watch_event(self, event: str, obj) -> None:
+        """Store watch -> fleet wake (runs on the committing thread under
+        the store's delivery lock; registered in the thread-shared registry).
+        Covers DELETED events, which never reach the batcher trigger."""
+        self.on_trigger()
+
+    def on_trigger(self) -> None:
+        """Batcher wake_hook / watch seam: record the signal and mark this
+        tenant runnable. Cheap and leaf-locked by design — it runs on watch
+        delivery threads."""
+        with self._lock:
+            touch(self, "wakes")
+            self.wakes += 1
+            self.last_wake_monotonic = time.monotonic()
+        self.fleet._mark_runnable(self.tenant_id)
+
+    # -- fleet-facing surface --------------------------------------------------
+    def ready(self) -> bool:
+        return self.env.provisioner.batcher.ready()
+
+    def pending(self) -> int:
+        return self.env.provisioner.batcher.pending()
+
+    def eta(self) -> float | None:
+        return self.env.provisioner.batcher.eta()
+
+    def wake_count(self) -> int:
+        with self._lock:
+            return self.wakes
+
+    def close(self) -> None:
+        self.env.provisioner.batcher.wake_hook = None
+        self.env.store.unwatch("Pod", self._on_watch_event)
+        self.env.store.unwatch("Node", self._on_watch_event)
+        self.loop.close()
+
+
+class FleetFrontend:
+    """The multi-tenant serving front-end: tenant registry, push wake, and
+    the deficit-round-robin scheduling loop."""
+
+    # racecheck guarded-field registry: the tenant registry and runnable/
+    # deficit state are written from watch-delivery threads (_mark_runnable)
+    # and the fleet loop concurrently
+    GUARDED_FIELDS = {
+        "_sessions": "_lock",
+        "_order": "_lock",
+        "_runnable": "_lock",
+        "_deficit": "_lock",
+        "_runnable_since": "_lock",
+        "_thread": "_lock",
+        "_stop": "_lock",
+    }
+
+    def __init__(self, registry=None, quantum: float | None = None, backlog_solve_cap: float = 4.0, poll_floor_seconds: float = 0.5):
+        """`quantum`: solve credits added per runnable tenant per `pump()`
+        round (deficit round-robin: a solve costs one credit, unspent credit
+        banks across rounds, and the bank is capped at `backlog_solve_cap` —
+        so a bursty tenant can never run more than the cap's worth of solves
+        in one round, and a fractional quantum rate-limits a tenant across
+        rounds). Default: the cap itself, so an uncontended tenant drains
+        its whole coalesced backlog in one round. `poll_floor_seconds` is
+        only the serve loop's LIVENESS backstop — arrivals wake it
+        push-style, window closes wake it via `eta()`."""
+        from ..metrics import make_registry
+        from ..solver.tpu import configure_compile_cache
+
+        self.registry = registry if registry is not None else make_registry()
+        self.backlog_solve_cap = float(backlog_solve_cap)
+        self.quantum = self.backlog_solve_cap if quantum is None else float(quantum)
+        self.poll_floor = float(poll_floor_seconds)
+        self._lock = make_lock("fleet")
+        self._wake = make_event()
+        self._sessions: dict[str, TenantSession] = {}
+        self._order: list[str] = []  # registration order = the DRR ring
+        self._runnable: set[str] = set()
+        self._deficit: dict[str, float] = {}
+        self._runnable_since: dict[str, float] = {}
+        self._thread = None
+        self._stop = make_event()
+        self.pump_rounds = 0
+        configure_compile_cache()
+
+    # -- tenant registry -------------------------------------------------------
+    def add_tenant(
+        self,
+        tenant_id: str,
+        options=None,
+        instance_types=None,
+        clock=None,
+        env=None,
+        double_buffer: bool | None = None,
+        worker: bool = False,
+        trace_capacity: int = 4096,
+    ) -> TenantSession:
+        """Build (or adopt, via `env`) a tenant control plane and wire it
+        into the fleet: shared registry, per-tenant recorder, tenant-labeled
+        solver, push-wake seams. The new tenant's first solve runs against
+        the fleet's established kernel shapes — warm-start by construction."""
+        from ..operator import Environment
+        from ..operator.options import Options
+
+        label = tenant_label(tenant_id)
+        if env is None:
+            env = Environment(
+                options=options or Options(solver_backend="tpu"),
+                clock=clock,
+                instance_types=instance_types,
+                registry=self.registry,
+            )
+        recorder = TraceRecorder(capacity=trace_capacity, enabled=True)
+        if env.options.solver_backend == "tpu":
+            from ..solver.tpu import TPUSolver
+
+            env.provisioner.solver = TPUSolver(registry=self.registry, recorder=recorder, tenant=label)
+        env.provisioner.tenant = label
+        loop = ServingLoop(env.provisioner, env.store, double_buffer=double_buffer, worker=worker)
+        sess = TenantSession(self, tenant_id, env, loop, recorder, label)
+        with self._lock:
+            if tenant_id in self._sessions:
+                raise ValueError(f"tenant {tenant_id!r} already registered")
+            self._sessions[tenant_id] = sess
+            self._order.append(tenant_id)
+            self._deficit[tenant_id] = 0.0
+        # wire the push seams only after the session is registered, so a
+        # wake racing registration can never reference an unknown tenant
+        env.provisioner.batcher.wake_hook = sess.on_trigger
+        env.store.watch("Pod", sess._on_watch_event)
+        env.store.watch("Node", sess._on_watch_event)
+        return sess
+
+    def remove_tenant(self, tenant_id: str) -> None:
+        with self._lock:
+            sess = self._sessions.pop(tenant_id, None)
+            if tenant_id in self._order:
+                self._order.remove(tenant_id)
+            self._runnable.discard(tenant_id)
+            self._deficit.pop(tenant_id, None)
+            self._runnable_since.pop(tenant_id, None)
+        if sess is not None:
+            sess.close()
+
+    def sessions(self) -> dict[str, TenantSession]:
+        with self._lock:
+            return dict(self._sessions)
+
+    def session(self, tenant_id: str) -> TenantSession | None:
+        with self._lock:
+            return self._sessions.get(tenant_id)
+
+    # -- push wake -------------------------------------------------------------
+    def _mark_runnable(self, tenant_id: str) -> None:
+        """Mark a tenant runnable and wake the fleet loop. Runs on watch-
+        delivery threads: fleet lock only (leaf), metric emission outside."""
+        with self._lock:
+            sess = self._sessions.get(tenant_id)
+            newly = sess is not None and tenant_id not in self._runnable
+            if newly:
+                self._runnable.add(tenant_id)
+                self._runnable_since.setdefault(tenant_id, time.monotonic())
+            n_runnable = len(self._runnable)
+        if newly:
+            self._wake.set()
+            from .. import metrics as m
+
+            self.registry.counter(m.SOLVER_FLEET_WAKE_TOTAL).inc(tenant=sess.label)  # solverlint: ok(metric-label-cardinality): label is a tenant_label() output fixed at session registration — the bounded fleet enum
+            self.registry.gauge(m.SOLVER_FLEET_RUNNABLE_TENANTS).set(n_runnable)
+
+    def runnable_tenants(self) -> list[str]:
+        with self._lock:
+            return [t for t in self._order if t in self._runnable]
+
+    def rearm_ready(self) -> int:
+        """Poll-fallback re-arm: mark every tenant whose batch window has
+        closed (`ready()`) runnable. The serve loop calls this after each
+        wake/timeout so a window that closed by TIME (no new event to push a
+        wake) is still served; deterministic drivers may call it directly."""
+        n = 0
+        for tid, sess in self.sessions().items():
+            if sess.ready():
+                self._mark_runnable(tid)
+                n += 1
+        return n
+
+    def next_eta(self) -> float | None:
+        """Seconds until the nearest tenant batch window closes, or None
+        when no tenant has an open generation."""
+        etas = [e for s in self.sessions().values() if (e := s.eta()) is not None]
+        return min(etas) if etas else None
+
+    # -- scheduling ------------------------------------------------------------
+    def pump(self, force: bool = False, only: str | None = None) -> dict[str, int]:
+        """One deficit-round-robin round over the runnable tenants; returns
+        {tenant_id: solves run}. At round start every runnable tenant banks
+        `quantum` solve credits (bank capped at `backlog_solve_cap`); each
+        ring pass serves one solve per tenant with a whole credit, so a
+        bursty tenant whose batcher re-arms after every solve (coalesced-
+        drain churn) runs at most the cap's worth of solves per round —
+        leftover backlog keeps it runnable for the next round — while a
+        fractional-quantum tenant accrues across rounds. `force=True`
+        treats the addressed tenants as runnable and forces their FIRST
+        reconcile (deterministic drivers: harness base-fleet provisioning,
+        bench warmup); `only` restricts the round to one tenant (the
+        attached-harness drive path — avoids fanning a per-tenant warmup
+        solve out across the whole fleet)."""
+        with self._lock:
+            if force:
+                self._runnable.update(self._sessions if only is None else [t for t in (only,) if t in self._sessions])
+            ring = [t for t in self._order if t in self._runnable and (only is None or t == only)]
+            for tid in ring:
+                self._deficit[tid] = min(self._deficit.get(tid, 0.0) + self.quantum, self.backlog_solve_cap)
+        served: dict[str, int] = {}
+        progress = True
+        while progress:
+            progress = False
+            for tid in ring:
+                with self._lock:
+                    sess = self._sessions.get(tid)
+                    active = sess is not None and tid in self._runnable
+                    credit = self._deficit.get(tid, 0.0)
+                if not active or credit < 1.0:
+                    # out-of-credit tenants STAY runnable — the next round
+                    # (or the serve loop's next wake) continues them
+                    continue
+                # force applies to the FIRST solve per tenant only: later
+                # solves in the round are the batcher's own coalesced drain,
+                # exactly like ServingLoop.pump + drain on the poll path
+                eff_force = force and served.get(tid, 0) == 0
+                if not (eff_force or sess.ready()):
+                    self._retire(tid)
+                    continue
+                self._observe_sched_wait(tid, sess)
+                results = sess.loop.pump(force=eff_force)
+                with self._lock:
+                    # a declined reconcile (e.g. cluster mid-sync) still
+                    # costs the credit, so a stuck tenant cannot pin the loop
+                    self._deficit[tid] = self._deficit.get(tid, 0.0) - 1.0
+                if results is not None:
+                    served[tid] = served.get(tid, 0) + 1
+                    progress = True
+                if not sess.ready():
+                    self._retire(tid)
+        self.pump_rounds += 1
+        self._publish_runnable()
+        return served
+
+    def _retire(self, tenant_id: str) -> None:
+        """The tenant's window is no longer ready: drop it from the runnable
+        set and zero its banked deficit (DRR resets credit on empty)."""
+        with self._lock:
+            self._runnable.discard(tenant_id)
+            self._deficit[tenant_id] = 0.0
+            self._runnable_since.pop(tenant_id, None)
+
+    def _observe_sched_wait(self, tenant_id: str, sess: TenantSession) -> None:
+        with self._lock:
+            since = self._runnable_since.pop(tenant_id, None)
+        if since is not None:
+            from .. import metrics as m
+
+            self.registry.histogram(m.SOLVER_FLEET_SCHED_WAIT_SECONDS).observe(
+                time.monotonic() - since, tenant=sess.label  # solverlint: ok(metric-label-cardinality): label is a tenant_label() output fixed at session registration — the bounded fleet enum
+            )
+
+    def _publish_runnable(self) -> None:
+        with self._lock:
+            n_runnable = len(self._runnable)
+        from .. import metrics as m
+
+        self.registry.gauge(m.SOLVER_FLEET_RUNNABLE_TENANTS).set(n_runnable)
+
+    # -- the wall-clock serve loop --------------------------------------------
+    def start(self) -> None:
+        """Spawn the fleet serve loop (wall-clock deployments; deterministic
+        drivers call `pump()` directly instead)."""
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop = make_event()
+            self._thread = spawn_thread(self._serve_loop, name="karpenter-fleet", args=(self._stop,))
+
+    def stop(self) -> None:
+        with self._lock:
+            t, self._thread = self._thread, None
+            stop = self._stop
+        stop.set()
+        self._wake.set()
+        if t is not None:
+            t.join(timeout=5)
+
+    def serving(self) -> bool:
+        with self._lock:
+            return self._thread is not None
+
+    def _serve_loop(self, stop) -> None:
+        """Push-driven: sleep on the wake event until an arrival wakes us or
+        the nearest batch window closes; then re-arm time-ready tenants and
+        run one DRR round. The poll floor is only a liveness backstop."""
+        while not stop.is_set():
+            timeout = self.poll_floor
+            eta = self.next_eta()
+            if eta is not None:
+                timeout = min(timeout, eta)
+            if timeout > 0:
+                self._wake.wait(timeout=timeout)
+            self._wake.clear()
+            if stop.is_set():
+                return
+            self.rearm_ready()
+            served = self.pump()
+            if not served and (eta := self.next_eta()) is not None and eta <= 0:
+                # a window is ready but its reconcile declined to solve —
+                # e.g. the cluster is mid-registration and unsynced while
+                # the tick thread catches up. eta()==0 would make the wait
+                # above a no-op, so back off briefly instead of hot-spinning
+                # against the very thread that clears the condition.
+                self._wake.wait(timeout=0.005)
+
+    def close(self) -> None:
+        self.stop()
+        for tid in list(self.sessions()):
+            self.remove_tenant(tid)
+
+    # -- observability ---------------------------------------------------------
+    def stats(self) -> dict:
+        """Per-tenant serving stats from each session's private recorder
+        (solves by mode, rolling quantiles, wakes, backlog)."""
+        out: dict = {}
+        for tid, sess in self.sessions().items():
+            traces = [t for t in sess.recorder.traces() if t.mode not in ("", "consolidate")]
+            modes: dict[str, int] = {}
+            for t in traces:
+                modes[t.mode] = modes.get(t.mode, 0) + 1
+            out[tid] = {
+                "label": sess.label,
+                "solves": len(traces),
+                "modes": modes,
+                "quantiles": sess.recorder.stats(),
+                "wakes": sess.wake_count(),
+                "pending_triggers": sess.pending(),
+            }
+        return out
+
+    def isolation_audit(self) -> dict:
+        """Audit the fleet-scoped (process-global) solver caches for cross-
+        tenant isolation: SHAPES and content-addressed pod-shape tuples are
+        shared by design; row TENSORS must be keyed by a process-unique
+        cluster epoch. Raises AssertionError when a registered tenant's
+        cluster epoch collides with another's, or when a row-cache key does
+        not lead with an epoch token — either would make one tenant's
+        tensors reachable from another's lookups."""
+        from ..models.scheduler_model import bucket_highwater
+        from ..solver.encode import encode_shared_stats
+
+        shared = encode_shared_stats()
+        epochs: dict[int, str] = {}
+        for tid, sess in self.sessions().items():
+            epoch = getattr(sess.env.cluster, "epoch", None)
+            assert epoch is not None, f"tenant {tid!r}: cluster has no epoch token — row-cache keys would be id()-recyclable"
+            assert epoch not in epochs, f"tenants {epochs[epoch]!r} and {tid!r} share cluster epoch {epoch} — row artifacts would alias"
+            epochs[epoch] = tid
+        for e in shared["row_global_epochs"]:
+            assert isinstance(e, int), f"row-cache key epoch {e!r} is not a process-unique token"
+        return {
+            "shared_shapes": bucket_highwater(),
+            "shared_sig_intern": shared["sig_intern"],
+            "row_artifacts": shared["row_global"],
+            "tenant_epochs": {tid: e for e, tid in epochs.items()},
+            "tenant_row_artifacts": {
+                epochs[e]: n for e, n in shared["row_global_by_epoch"].items() if e in epochs
+            },
+        }

@@ -531,6 +531,35 @@ def clear_encode_globals() -> None:
     _ROW_GLOBAL.clear()
 
 
+def encode_shared_stats() -> dict:
+    """The process-global (fleet-scoped) encode caches, for the fleet
+    front-end's cross-tenant isolation audit. What is shared and why it is
+    safe to share:
+
+    - ``sig_intern``: the signature intern table — content-addressed pod
+      SHAPE tuples (requirements/requests/ports/affinity structure). Two
+      tenants submitting equal pod shapes intern to one tuple; no tensor or
+      per-cluster data lives here, so sharing only de-duplicates grouping.
+    - ``row_global``: content-addressed row artifacts. Every key leads with
+      the owning cluster's process-unique ``epoch`` token (`_row_cache_key`),
+      so one tenant's row tensors are unreachable from another tenant's
+      lookups by construction — the audit asserts the epoch discipline.
+    - the bucket high-water marks (models.scheduler_model.bucket_highwater)
+      are plain axis sizes: shared shapes mean shared compiled kernels,
+      which is the fleet's warm-start story.
+    """
+    by_epoch: dict = {}
+    for k in _ROW_GLOBAL:
+        by_epoch[k[0]] = by_epoch.get(k[0], 0) + 1
+    return {
+        "sig_intern": len(_SIG_INTERN),
+        "row_global": len(_ROW_GLOBAL),
+        "row_global_epochs": sorted(by_epoch),
+        "row_global_by_epoch": by_epoch,
+        "group_memo": _GROUP_MEMO is not None,
+    }
+
+
 def _intern_sig(sig: tuple) -> tuple:
     if len(_SIG_INTERN) > 200_000:
         _SIG_INTERN.clear()  # bound memory; repopulates as stamps refresh
@@ -2463,6 +2492,16 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
     templates with daemon-overhead groups, and one row per existing node and
     per (template x instance type x available offering)."""
     vocab = Vocabulary()
+    # CANONICAL dom-key ids: the dom keys intern FIRST, in dom-key order, so
+    # `dom_vocab_keys` — a STATIC of the jitted pack (compat_matrix's
+    # force-allow columns) — is (0..Kd-1) for every snapshot with the same
+    # dom-key list, regardless of incidental interning order. Without this,
+    # two tenants of one fleet process reach different vocab ids for the
+    # same zone key (interning order depends on the shared signature-stamp
+    # state) and tenant N+1's first solve pays a recompile the shared
+    # high-water shapes were supposed to prevent.
+    for key in dom_keys:
+        vocab.key_id(key)
 
     # keyed domain vocabulary: per-key sentinels first (key order), so the
     # zone sentinel is id 0 (NO_ZONE) and a zone-only snapshot is laid out

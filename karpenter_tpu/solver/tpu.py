@@ -115,12 +115,74 @@ class _TensorFallback(Exception):
         self.family = family
 
 
+def configure_compile_cache() -> str | None:
+    """Point JAX's persistent compilation cache at
+    ``KARPENTER_SOLVER_COMPILE_CACHE=<dir>`` (returns the dir, or None when
+    unset/unavailable). Idempotent and crash-proof: an old jax without the
+    knobs just runs uncached. With the cache dir set, a RESTARTED process —
+    or a fresh fleet replica on the same volume — deserializes the pack
+    executables instead of re-tracing/re-compiling them, so the cold-start
+    compile storm the high-water bucket ladder amortizes within one process
+    is also amortized ACROSS processes (the fleet front-end's warm-restart
+    story; bench's compile-cache micro-gate pins the speedup)."""
+    global _COMPILE_CACHE_DIR
+    import os
+
+    path = os.environ.get("KARPENTER_SOLVER_COMPILE_CACHE", "").strip()
+    if not path or _COMPILE_CACHE_DIR == path:
+        return _COMPILE_CACHE_DIR
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # noqa: BLE001 — a jax without the cache stays uncached, never broken
+        return None
+    # cache EVERY executable: the solver's kernels are individually small/
+    # fast to compile but numerous — the default size/time floors would skip
+    # exactly the long tail the restart pays for
+    for knob, value in (
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_enable_xla_caches", "all"),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except Exception:  # noqa: BLE001 — tuning knobs vary by jax version; the dir alone suffices
+            pass
+    # the cache object memoizes the dir it was created with: a process that
+    # already compiled ANYTHING (backend probe, an import-time jit) holds a
+    # dir=None cache and silently ignores the config update — reset so the
+    # next compile re-reads the configured dir
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — internal API; without it the pre-compile config path still works
+        pass
+    _COMPILE_CACHE_DIR = path
+    return path
+
+
+_COMPILE_CACHE_DIR: str | None = None
+
+# solver metric families that carry the bounded fleet `tenant` label (the
+# rest of the _count/_observe surface stays tenant-free: reason/mode enums
+# are process-scoped, and per-tenant latency quantiles come from each
+# TenantSession's private TraceRecorder instead)
+_TENANT_LABELED = frozenset({"karpenter_solver_solve_total"})
+
+
 class TPUSolver:
     name = "tpu"
 
-    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None, mesh="auto", hybrid: bool = True, recorder=None):
+    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None, mesh="auto", hybrid: bool = True, recorder=None, tenant: str = ""):
         self.fallback = fallback or FFDSolver()
         self.force = force  # raise instead of falling back (tests)
+        # bounded fleet tenant label (serving.fleet.tenant_label output) —
+        # "" outside a fleet, which the registry renders as the empty label
+        self.tenant = tenant
+        # persistent compile cache: env-gated, idempotent, no-op when unset
+        configure_compile_cache()
         # solvetrace flight recorder (obs/trace.py): every solve begins a
         # SolveTrace on it and commits in the solve's finally — the ring,
         # rolling quantiles, and recompile sentinel all hang off this. The
@@ -234,6 +296,10 @@ class TPUSolver:
 
     def _count(self, metric: str, **labels) -> None:
         if self.registry is not None:
+            if self.tenant and metric in _TENANT_LABELED:
+                # self.tenant is a serving.fleet.tenant_label() output stored
+                # at session registration — the bounded fleet enum
+                labels.setdefault("tenant", self.tenant)
             self.registry.counter(metric).inc(**labels)
 
     def _observe(self, metric: str, value: float, **labels) -> None:

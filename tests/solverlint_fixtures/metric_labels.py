@@ -38,3 +38,13 @@ def ok_pragma(registry, why):
 def ok_identity_label(registry, node):
     # nodepool is an identity label, not in bounded-labels: must NOT be flagged
     registry.counter("m").inc(nodepool=node.pool)
+
+
+def bad_tenant_raw_id(registry, session):
+    # the fleet cardinality leak: a raw tenant id as the tenant label
+    registry.counter("karpenter_solver_solve_total").inc(backend="tpu", tenant=session.tenant_id)
+
+
+def ok_tenant_producer(registry, session):
+    # tenant_label is the bounded fleet producer (serving.fleet)
+    registry.counter("karpenter_solver_solve_total").inc(backend="tpu", tenant=tenant_label(session.tenant_id))  # noqa: F821 — fixture, parsed only

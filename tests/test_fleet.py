@@ -1,0 +1,435 @@
+"""Fleet front-end (karpenter_tpu/serving/fleet.py): push-driven wake +
+multi-tenant solver multiplexing with shared jitted kernels.
+
+Pins the subsystem's contracts:
+- push wake: a store watch event marks the tenant runnable and wakes the
+  fleet loop — no idle-window poll on the arrival path (the batcher's
+  `eta()` makes the window a coalescing bound, not a latency floor);
+- push-vs-poll parity: identical event streams through the fleet's DRR pump
+  and the legacy per-tenant serving loop produce bit-identical placements;
+- coalescing through the fleet: N mid-solve triggers still fold into ONE
+  batched follow-up solve;
+- shared kernels: tenant B's first solve after tenant A warmed the fleet
+  records ZERO new compiles (RecompileSentinel pin) — shapes/marks are
+  fleet-scoped, tensors are not (isolation audit);
+- fairness: deficit round-robin caps a bursty tenant's consecutive solves
+  so it cannot starve the rest;
+- record/replay: a recorded JSONL event stream replays deterministically
+  (ChurnSpec.from_event_log), including into fleet tenants;
+- racecheck: the threaded fleet loop under the runtime sanitizer records
+  zero violations.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from helpers import make_pod
+from test_churn_loop import placement_shape, small_spec
+from karpenter_tpu import metrics as m
+from karpenter_tpu.obs import racecheck
+from karpenter_tpu.obs.trace import sentinel
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.serving import ChurnHarness, ChurnSpec
+from karpenter_tpu.serving.fleet import (
+    TENANT_LABEL_CAP,
+    FleetFrontend,
+    reset_tenant_labels,
+    tenant_label,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_labels():
+    reset_tenant_labels()
+    yield
+    reset_tenant_labels()
+
+
+def tenant_options(spec: ChurnSpec) -> Options:
+    return Options(
+        solver_backend="tpu",
+        batch_idle_duration=spec.batch_idle_seconds,
+        batch_max_duration=10.0,
+    )
+
+
+def add_churn_tenant(fleet: FleetFrontend, tenant_id: str, spec: ChurnSpec) -> ChurnHarness:
+    """A fleet tenant wired exactly like ChurnHarness.build()'s private
+    stack (same catalog scale, same batch windows), attached to a harness
+    that solves through the fleet pump."""
+    from karpenter_tpu.cloudprovider.fake import instance_types_assorted
+
+    sess = fleet.add_tenant(
+        tenant_id,
+        options=tenant_options(spec),
+        instance_types=instance_types_assorted(spec.n_types),
+        double_buffer=spec.double_buffer,
+        worker=spec.worker,
+    )
+    return ChurnHarness(spec).attach(sess, fleet=fleet)
+
+
+class TestTenantLabel:
+    def test_cap_and_overflow(self):
+        for i in range(TENANT_LABEL_CAP):
+            assert tenant_label(f"cluster-{i}") == f"cluster-{i}"
+        assert tenant_label("one-more") == "overflow"
+        # established assignments keep their label
+        assert tenant_label("cluster-0") == "cluster-0"
+
+    def test_sanitization(self):
+        assert tenant_label("team a/prod cluster!") == "team-a-prod-cluster-"
+        assert tenant_label("") == "default"
+
+    def test_sanitize_collisions_never_merge_tenants(self):
+        # two DISTINCT ids with the same sanitized form must not share a
+        # metric label (their series would silently merge)
+        a = tenant_label("team/a")
+        b = tenant_label("team:a")
+        assert a != b
+        # and the assignment is sticky per original id
+        assert tenant_label("team/a") == a and tenant_label("team:a") == b
+
+
+class TestBatcherEta:
+    def test_eta_tracks_the_window(self):
+        from karpenter_tpu.controllers.provisioning.batcher import Batcher
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        b = Batcher(clock, idle_seconds=1.0, max_seconds=10.0)
+        assert b.eta() is None
+        b.trigger("a")
+        assert b.eta() == pytest.approx(1.0)
+        clock.step(0.4)
+        assert b.eta() == pytest.approx(0.6)
+        clock.step(1.0)
+        assert b.eta() == 0.0 and b.ready()
+        # a coalesced drain is ready NOW
+        b.reset()
+        b.begin_solve()
+        b.trigger("during")
+        b.end_solve()
+        assert b.eta() == 0.0
+
+    def test_wake_hook_fires_on_trigger(self):
+        from karpenter_tpu.controllers.provisioning.batcher import Batcher
+        from karpenter_tpu.utils.clock import FakeClock
+
+        b = Batcher(FakeClock(), idle_seconds=1.0, max_seconds=10.0)
+        hits = []
+        b.wake_hook = lambda: hits.append(1)
+        b.trigger("x")
+        b.trigger("y")
+        assert hits == [1, 1]
+
+
+class TestPushWake:
+    def test_watch_event_marks_runnable_and_wakes(self):
+        spec = small_spec(n_base_pods=0)
+        fleet = FleetFrontend()
+        try:
+            h = add_churn_tenant(fleet, "t0", spec)
+            assert fleet.runnable_tenants() == []
+            h.apply_arrivals(1)
+            # the pod create's watch delivery marked the tenant runnable —
+            # push-style, with no pump/poll having run
+            assert fleet.runnable_tenants() == ["t0"]
+            assert fleet._wake.is_set()
+            assert fleet.registry.counter(m.SOLVER_FLEET_WAKE_TOTAL).value(tenant="t0") == 1
+            assert fleet.registry.gauge(m.SOLVER_FLEET_RUNNABLE_TENANTS).value() == 1
+            sess = fleet.session("t0")
+            assert sess.wake_count() >= 1
+        finally:
+            fleet.close()
+
+    def test_pump_serves_then_retires(self):
+        spec = small_spec(n_base_pods=0)
+        fleet = FleetFrontend()
+        try:
+            h = add_churn_tenant(fleet, "t0", spec)
+            h.apply_arrivals(3)
+            # window not closed: a pump round leaves the batch coalescing
+            assert fleet.pump() == {}
+            h.env.clock.step(spec.batch_idle_seconds + 0.05)
+            fleet.rearm_ready()
+            served = fleet.pump()
+            assert served.get("t0", 0) >= 1
+            assert fleet.runnable_tenants() == []
+            # wake-to-solve wait was observed for the tenant
+            assert fleet.registry.histogram(m.SOLVER_FLEET_SCHED_WAIT_SECONDS).count(tenant="t0") >= 1
+        finally:
+            fleet.close()
+
+    def test_next_eta_surfaces_nearest_window(self):
+        spec = small_spec(n_base_pods=0)
+        fleet = FleetFrontend()
+        try:
+            h = add_churn_tenant(fleet, "t0", spec)
+            assert fleet.next_eta() is None
+            h.apply_arrivals(1)
+            eta = fleet.next_eta()
+            assert eta is not None and 0 < eta <= spec.batch_idle_seconds + 1e-6
+        finally:
+            fleet.close()
+
+
+class TestCoalescingThroughFleet:
+    def test_midsolve_burst_folds_into_one_followup(self):
+        spec = small_spec(n_base_pods=0)
+        fleet = FleetFrontend()
+        try:
+            h = add_churn_tenant(fleet, "t0", spec)
+            env = h.env
+            prov = env.provisioner
+            solver = prov.solver
+            seen: list[int] = []
+            injected = {"done": False}
+            orig_solve = solver.solve
+
+            def spying_solve(snap):
+                seen.append(len(snap.pods))
+                if not injected["done"]:
+                    injected["done"] = True
+                    h.apply_arrivals(7)  # mid-solve burst
+                return orig_solve(snap)
+
+            solver.solve = spying_solve
+            h.apply_arrivals(3)
+            env.clock.step(1.0)
+            fleet.rearm_ready()
+            served = fleet.pump()
+            # the fleet round ran the first solve AND the one coalesced
+            # follow-up (the drain armed ready() again mid-round)
+            assert served["t0"] == 2
+            assert seen == [3, 10]
+            assert env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).value(tenant="t0") == 7
+        finally:
+            fleet.close()
+
+
+class TestPushPollParity:
+    def test_fleet_pump_bit_identical_to_poll_loop(self, monkeypatch):
+        """The same scripted churn through (a) the legacy per-tenant serving
+        loop and (b) the fleet's push-wake DRR pump must place bit-
+        identically: the fleet changes WHEN solves run, never the result."""
+        monkeypatch.setenv("KARPENTER_SOLVER_DOUBLEBUF", "0")
+        shapes = []
+        for arm in ("poll", "push"):
+            spec = small_spec()
+            if arm == "poll":
+                h = ChurnHarness(spec).build()
+                fleet = None
+            else:
+                fleet = FleetFrontend()
+                h = add_churn_tenant(fleet, "solo", spec)
+            try:
+                h.provision_base_fleet()
+                h.apply_departures(40)
+                h.bind_flush()
+                for _ in range(3):
+                    h.run_cycle()
+                shapes.append(placement_shape(h.env))
+            finally:
+                h.close() if fleet is None else fleet.close()
+        assert shapes[0] == shapes[1]
+
+
+class TestSharedKernels:
+    def test_tenant_b_first_solves_record_zero_compiles(self, monkeypatch):
+        """The fleet warm-start pin: after tenant A establishes the shape
+        ladder (provisioning + churn cycles), tenant B's ENTIRE lifecycle —
+        cold provisioning through steady churn — records zero new compiles
+        on the sentinel watchlist."""
+        from karpenter_tpu.models.scheduler_model import reset_bucket_highwater
+
+        monkeypatch.setenv("KARPENTER_SOLVER_BUCKET", "1")
+        reset_bucket_highwater()
+        fleet = FleetFrontend()
+        try:
+            spec = small_spec()
+            ha = add_churn_tenant(fleet, "a", spec)
+            ha.provision_base_fleet()
+            ha.apply_departures(40)
+            ha.bind_flush()
+            ha.run_cycle()
+            ha.run_cycle()
+            mark = sentinel().snapshot()
+            hb = add_churn_tenant(fleet, "b", small_spec())
+            hb.provision_base_fleet()
+            hb.apply_departures(40)
+            hb.bind_flush()
+            hb.run_cycle()
+            delta = sentinel().delta(mark)
+            assert delta == {}, f"tenant b paid compiles after a warmed the fleet: {delta}"
+            # and tenant b actually solved (on its own tensors)
+            assert len(hb.env.cluster.nodes()) > 0
+        finally:
+            fleet.close()
+            reset_bucket_highwater()
+
+    def test_isolation_audit(self, monkeypatch):
+        from karpenter_tpu.models.scheduler_model import reset_bucket_highwater
+
+        monkeypatch.setenv("KARPENTER_SOLVER_BUCKET", "1")
+        reset_bucket_highwater()
+        fleet = FleetFrontend()
+        try:
+            specs = small_spec(n_base_pods=40)
+            ha = add_churn_tenant(fleet, "a", specs)
+            hb = add_churn_tenant(fleet, "b", small_spec(n_base_pods=40))
+            ha.provision_base_fleet()
+            hb.provision_base_fleet()
+            audit = fleet.isolation_audit()
+            # shapes/marks shared; tensors keyed per cluster epoch
+            assert audit["shared_shapes"], "high-water marks empty after two provisioned tenants"
+            assert len(audit["tenant_epochs"]) == 2
+            assert len(set(audit["tenant_epochs"].values())) == 2
+        finally:
+            fleet.close()
+            reset_bucket_highwater()
+
+    def test_per_tenant_metrics_split(self):
+        fleet = FleetFrontend()
+        try:
+            spec = small_spec(n_base_pods=40)
+            ha = add_churn_tenant(fleet, "a", spec)
+            hb = add_churn_tenant(fleet, "b", small_spec(n_base_pods=40))
+            ha.provision_base_fleet()
+            hb.provision_base_fleet()
+            c = fleet.registry.counter(m.SOLVER_SOLVE_TOTAL)
+            assert c.value(backend="tpu", tenant="a") > 0
+            assert c.value(backend="tpu", tenant="b") > 0
+            ev = fleet.registry.counter(m.SOLVER_CHURN_EVENTS_TOTAL)
+            assert ev.value(event="arrival", tenant="a") > 0
+            assert ev.value(event="arrival", tenant="b") > 0
+            # per-tenant latency quantiles come from per-session recorders
+            assert fleet.session("a").recorder is not fleet.session("b").recorder
+            stats = fleet.stats()
+            assert stats["a"]["solves"] > 0 and stats["b"]["solves"] > 0
+        finally:
+            fleet.close()
+
+
+class TestFairness:
+    def test_bursty_tenant_cannot_starve_the_rest(self):
+        """Tenant A re-arms its batcher after every solve (a continuous
+        backlog); tenant B has one small batch. One DRR round must serve B
+        and cap A at backlog_solve_cap solves."""
+        fleet = FleetFrontend(backlog_solve_cap=3.0)
+        try:
+            ha = add_churn_tenant(fleet, "bursty", small_spec(n_base_pods=0))
+            hb = add_churn_tenant(fleet, "small", small_spec(n_base_pods=0))
+            prov_a = ha.env.provisioner
+            orig = prov_a.solver.solve
+
+            def refeeding_solve(snap):
+                # a new arrival lands during EVERY solve of A: the coalesced
+                # drain re-arms ready() immediately after each solve
+                ha.apply_arrivals(1)
+                return orig(snap)
+
+            prov_a.solver.solve = refeeding_solve
+            ha.apply_arrivals(5)
+            hb.apply_arrivals(5)
+            ha.env.clock.step(1.0)
+            hb.env.clock.step(1.0)
+            fleet.rearm_ready()
+            served = fleet.pump()
+            assert served["small"] >= 1, "bursty tenant starved the small one"
+            assert served["bursty"] <= 3, f"DRR cap violated: {served}"
+        finally:
+            fleet.close()
+
+
+class TestRecordReplay:
+    def test_record_then_replay_bit_identical(self, tmp_path, monkeypatch):
+        """A recorded run replays deterministically: same placements, and
+        the replay's steady window reports through the same machinery."""
+        monkeypatch.setenv("KARPENTER_SOLVER_DOUBLEBUF", "0")
+        log = str(tmp_path / "churn.jsonl")
+        spec = small_spec(iterations=2, warmup_cycles=1, record_path=log)
+        h = ChurnHarness(spec)
+        rep = h.run()
+        shape_recorded = placement_shape(h.env)
+        h.close()
+        assert rep.events > 0
+
+        rspec = ChurnSpec.from_event_log(log)
+        assert rspec.replay_events, "log loaded empty"
+        assert rspec.n_base_pods == spec.n_base_pods  # header round-trips
+        h2 = ChurnHarness(rspec)
+        rep2 = h2.run()
+        shape_replayed = placement_shape(h2.env)
+        h2.close()
+        assert shape_replayed == shape_recorded
+        assert rep2.events == rep.events
+        assert rep2.solves == rep.solves
+
+    def test_replay_into_fleet_tenants(self, tmp_path, monkeypatch):
+        """One recorded log drives K fleet tenants (sequentially, RNG
+        re-seeded per tenant): each tenant reproduces the recorded
+        placements bit-for-bit — the multi-tenant bench's replay mode."""
+        monkeypatch.setenv("KARPENTER_SOLVER_DOUBLEBUF", "0")
+        log = str(tmp_path / "churn.jsonl")
+        spec = small_spec(iterations=2, warmup_cycles=1, record_path=log)
+        h = ChurnHarness(spec)
+        h.run()
+        shape_recorded = placement_shape(h.env)
+        h.close()
+
+        fleet = FleetFrontend()
+        try:
+            for tid in ("r0", "r1"):
+                rspec = ChurnSpec.from_event_log(log)
+                from karpenter_tpu.cloudprovider.fake import instance_types_assorted
+
+                sess = fleet.add_tenant(
+                    tid,
+                    options=tenant_options(rspec),
+                    instance_types=instance_types_assorted(rspec.n_types),
+                )
+                ht = ChurnHarness(rspec).attach(sess, fleet=fleet)
+                ht.run()
+                assert placement_shape(ht.env) == shape_recorded, tid
+        finally:
+            fleet.close()
+
+
+class TestThreadedFleetRacecheck:
+    def test_serve_loop_under_sanitizer_is_clean(self):
+        """The wall-clock fleet loop threaded against a concurrent event
+        driver: solves happen, and the runtime sanitizer (on for the whole
+        suite) records zero violations."""
+        from karpenter_tpu.utils.clock import Clock
+
+        racecheck.reset()
+        spec = small_spec(n_base_pods=0, batch_idle_seconds=0.05)
+        fleet = FleetFrontend(poll_floor_seconds=0.05)
+        try:
+            sess = fleet.add_tenant(
+                "live",
+                options=tenant_options(spec),
+                clock=Clock(),
+            )
+            h = ChurnHarness(spec).attach(sess)
+            fleet.start()
+            assert fleet.serving()
+            for _ in range(10):
+                h.apply_arrivals(5)
+                time.sleep(0.03)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not sess.recorder.traces():
+                time.sleep(0.05)
+            fleet.stop()
+            assert not fleet.serving()
+            assert sess.recorder.traces(), "fleet loop never solved"
+            snap = racecheck.snapshot()
+            assert snap["violations"] == [], snap["violations"]
+            assert sess.wake_count() > 0
+        finally:
+            fleet.close()
+            racecheck.reset()

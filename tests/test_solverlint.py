@@ -105,10 +105,14 @@ class TestRuleFixtures:
 
     def test_metric_label_cardinality(self):
         findings = _fixture_findings("metric-label-cardinality", "metric_labels.py")
-        assert len(findings) == 3, findings
+        assert len(findings) == 4, findings
         by_msg = [f.message for f in findings]
-        assert sum("not statically enumerable" in m for m in by_msg) == 2
+        # the third enumerable-value finding is the fleet tenant-label leak
+        # (a raw tenant id instead of a tenant_label() producer output)
+        assert sum("not statically enumerable" in m for m in by_msg) == 3
         assert sum("splat" in m for m in by_msg) == 1
+        src = (FIXTURES / "metric_labels.py").read_text().splitlines()
+        assert any("tenant=session.tenant_id" in src[f.line - 1] for f in findings)
 
     def test_guarded_field_access(self):
         # a read AND a write outside the declared lock are both findings;
