@@ -56,8 +56,10 @@ class Config:
     # {arrival | departure} enum; "lock" is racecheck's static make_lock
     # call-site enum; "tenant" is the fleet front-end's capped label
     # (serving.fleet.tenant_label collapses past-the-cap registrations to
-    # "overflow") — all held to the same bound
-    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer", "event", "lock", "tenant")
+    # "overflow"); "cause" is the fleet wake-attribution enum
+    # (obs.podtrace.WAKE_CAUSES) and "stage" the podtrace event-lifecycle
+    # stage enum (obs.podtrace.STAGES) — all held to the same bound
+    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer", "event", "lock", "tenant", "cause", "stage")
     # callees whose return value is enum-bounded by construction
     # (tenant_label caps distinct outputs at serving.fleet.TENANT_LABEL_CAP)
     bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family", "tenant_label")
@@ -84,6 +86,7 @@ class Config:
         "karpenter_tpu/controllers/nodeclaim/podevents.py",
         "karpenter_tpu/operator/*.py",
         "karpenter_tpu/obs/trace.py",
+        "karpenter_tpu/obs/podtrace.py",
         "karpenter_tpu/obs/racecheck.py",
         "karpenter_tpu/events/__init__.py",
         "karpenter_tpu/utils/clock.py",

@@ -431,18 +431,20 @@ class MetricLabelCardinalityRule(Rule):
     description = "bounded metric labels must carry statically enumerable values"
     _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
 
-    # the seeded violation is a fleet tenant-label one: the solve counter's
-    # `tenant` label fed a raw tenant id instead of a
-    # serving.fleet.tenant_label() output — exactly the cardinality leak the
-    # multi-tenant front-end must never regress into (a fleet admitting
-    # arbitrary cluster ids would mint one series per customer id)
+    # the seeded violation is a podtrace stage-label one: the event-stage
+    # quantile gauge's `stage` label fed a runtime-computed span name instead
+    # of iterating the static obs.podtrace.STAGES tuple — exactly the
+    # cardinality leak the event-lifecycle recorder must never regress into
+    # (arbitrary stage strings would mint one series per ad-hoc span)
     SELF_TEST_BAD = (
-        "def record(registry, session):\n"
-        '    registry.counter("karpenter_solver_solve_total").inc(backend="tpu", tenant=session.tenant_id)\n'
+        "def publish(registry, rec):\n"
+        "    for stage, dur in rec.stamps.items():\n"
+        '        registry.histogram("karpenter_solver_event_stage_seconds").observe(dur, stage=stage)\n'
     )
     SELF_TEST_OK = (
-        "def record(registry, session):\n"
-        '    registry.counter("karpenter_solver_solve_total").inc(backend="tpu", tenant=tenant_label(session.tenant_id))  # noqa: F821 — fixture, parsed only\n'
+        "def publish(registry, rec):\n"
+        '    for stage in ("coalesce", "sched_wait", "prestage", "solve", "decode", "e2e"):\n'
+        '        registry.histogram("karpenter_solver_event_stage_seconds").observe(rec.stages[stage], stage=stage)\n'
     )
 
     def __init__(self):

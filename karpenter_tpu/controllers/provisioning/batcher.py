@@ -23,6 +23,8 @@ coalesced count or in the next generation, never vanish.
 
 from __future__ import annotations
 
+import time
+
 from ...obs.racecheck import make_lock
 
 
@@ -37,6 +39,8 @@ class Batcher:
         "_in_flight": "_lock",
         "_during": "_lock",
         "_drain": "_lock",
+        "_opened_monotonic": "_lock",
+        "_last_gen": "_lock",
     }
 
     def __init__(self, clock, idle_seconds: float = 1.0, max_seconds: float = 10.0):
@@ -52,6 +56,13 @@ class Batcher:
         self._in_flight = False
         self._during = 0  # triggers folded into the in-flight solve's window
         self._drain = False  # a coalesced generation is waiting: fire now
+        # podtrace: MONOTONIC open stamp of the pending generation and the
+        # last taken generation's window summary (opened -> taken residency
+        # + trigger count) — the coalescing-window surface the event tracer
+        # links into each solve's event-batch note. The fake-clock fields
+        # above drive window POLICY; these measure wall residency.
+        self._opened_monotonic = 0.0
+        self._last_gen: dict | None = None
         # push-wake seam (serving/fleet.py): a zero-arg callable invoked on
         # every trigger, AFTER the lock is released — the fleet front-end
         # installs one per tenant to mark the tenant runnable and wake the
@@ -66,6 +77,7 @@ class Batcher:
         with self._lock:
             if self._first is None:
                 self._first = now
+                self._opened_monotonic = time.monotonic()
             self._last = now
             self._count += 1
             if self._in_flight:
@@ -84,6 +96,12 @@ class Batcher:
         cost its follow-up solve a full idle-window stall."""
         with self._lock:
             n = self._count
+            taken = time.monotonic()
+            self._last_gen = {
+                "count": n,
+                "window_s": max(0.0, taken - self._opened_monotonic) if n else 0.0,
+                "taken_monotonic": taken,
+            }
             self._first = None
             self._last = None
             self._count = 0
@@ -91,6 +109,13 @@ class Batcher:
             self._in_flight = True
             self._during = 0
             return n
+
+    def last_generation(self) -> dict | None:
+        """The most recently taken generation's wall-clock window summary
+        ({count, window_s, taken_monotonic}) — the coalescing-residency
+        surface podtrace joins into the solve's event-batch note."""
+        with self._lock:
+            return dict(self._last_gen) if self._last_gen is not None else None
 
     def begin_solve(self) -> None:
         """The provisioner is entering a solve: triggers from here to

@@ -51,6 +51,11 @@ class Provisioner:
         # solve's device pack) instead of cloning inline; None = the
         # reference clone-per-pass behavior
         self.prestager = None
+        # podtrace (obs/podtrace.py): the event-lifecycle tracer, installed
+        # by the Environment — provision() stamps dispatch/solved on every
+        # traced pod in the batch and links the batch summary into the
+        # SolveTrace. None = untraced provisioner (direct-wired tests).
+        self.podtracer = None
 
     # -- triggering (provisioning/controller.go) -------------------------------
     def trigger(self, uid: str = "") -> None:
@@ -87,7 +92,23 @@ class Provisioner:
     # -- the provisioning pass (provisioner.go:350-458) ------------------------
     def provision(self) -> Results:
         pods = self.get_pending_pods()
+        # podtrace dispatch stamp: the generation was just taken and its
+        # batch assembled — every traced event's coalescing-window residency
+        # ends HERE, and the batch summary rides the SolveTrace (explain()
+        # joins the two views through the solve seq)
+        tracer = self.podtracer
+        if tracer is not None and tracer.enabled:
+            batch = tracer.on_dispatch(pods, window=self.batcher.last_generation())
+            if batch is not None and hasattr(self.solver, "stage_event_batch"):
+                self.solver.stage_event_batch(batch)
         results = self.schedule(pods)
+        if tracer is not None and tracer.enabled:
+            tracer.on_solved(results, solve_seq=getattr(getattr(self.solver, "_trace", None), "seq", 0))
+            if hasattr(self.solver, "discard_event_batch"):
+                # schedule() may have declined to solve (no pods / no ready
+                # nodepools): a staged batch the solve never consumed must
+                # not attach to a later, unrelated solve's trace
+                self.solver.discard_event_batch()
         for claim in results.new_node_claims:
             if claim.pods:
                 self.create_node_claim(claim)

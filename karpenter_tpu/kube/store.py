@@ -10,6 +10,8 @@ to informers.
 
 from __future__ import annotations
 
+import time
+
 from ..obs.racecheck import make_rlock
 from .clone import fast_deepcopy
 from typing import Callable, Iterable, Optional
@@ -67,6 +69,7 @@ class Store:
         "_rv": "_lock",
         "_kind_rv": "_lock",
         "_pending": "_lock",
+        "_event_tracer": "_lock",
     }
 
     def __init__(self, clock=None):
@@ -75,12 +78,16 @@ class Store:
         self._watchers: dict[str, list[WatchFn]] = {}
         self._rv = 0
         self._clock = clock
-        # watch delivery: events are enqueued under self._lock (commit order)
-        # and drained FIFO under self._deliver_lock, so watchers always observe
-        # ADDED < MODIFIED < DELETED in resourceVersion order even with
-        # concurrent writers.
-        self._pending: list[tuple[str, object]] = []
+        # watch delivery: events are enqueued under self._lock (commit order,
+        # stamped with a monotonic commit time) and drained FIFO under
+        # self._deliver_lock, so watchers always observe ADDED < MODIFIED <
+        # DELETED in resourceVersion order even with concurrent writers.
+        self._pending: list[tuple[str, object, float]] = []
         self._deliver_lock = make_rlock("store-deliver")
+        # podtrace (obs/podtrace.py): the event-lifecycle tracer's arrival
+        # seam — every delivered event is stamped with its commit + delivery
+        # monotonic times before the watchers run. None = untraced store.
+        self._event_tracer = None
         # per-kind revision: the rv of the last write touching the kind.
         # Caches that depend on one kind's content (e.g. the solver's volume
         # fold on StorageClass/PV/PVC) key on this instead of the global rv,
@@ -117,9 +124,19 @@ class Store:
             if fns is not None and fn in fns:
                 fns.remove(fn)
 
+    def set_event_tracer(self, tracer) -> None:
+        """Install (or clear) the podtrace event tracer on the delivery seam."""
+        with self._lock:
+            self._event_tracer = tracer
+
+    def event_tracer(self):
+        with self._lock:
+            return self._event_tracer
+
     def _enqueue(self, event: str, obj) -> None:  # solverlint: ok(guarded-field-access): caller-holds contract — every call site sits inside `with self._lock` (create/update/delete)
-        # caller must hold self._lock
-        self._pending.append((event, obj))
+        # caller must hold self._lock; the stamp is the event's COMMIT time —
+        # podtrace measures queueing delay from commit, not from drain
+        self._pending.append((event, obj, time.monotonic()))
 
     def _drain(self) -> None:
         with self._deliver_lock:
@@ -127,8 +144,15 @@ class Store:
                 with self._lock:
                     if not self._pending:
                         return
-                    event, obj = self._pending.pop(0)
+                    event, obj, t_commit = self._pending.pop(0)
                     watchers = list(self._watchers.get(obj.kind, ()))
+                    tracer = self._event_tracer
+                if tracer is not None and obj.kind == "Pod":
+                    # arrival stamp BEFORE the watcher fan-out (and even with
+                    # no watchers registered): the tracer only reads scalar
+                    # fields off the stored object — the borrow contract.
+                    # Kind-gated HERE so non-pod deliveries pay nothing.
+                    tracer.on_delivery(event, obj, t_commit, time.monotonic())
                 if not watchers:
                     continue
                 # ONE clone shared by every watcher: watchers may read and

@@ -99,8 +99,18 @@ SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR = "karpenter_solver_consolidation_savings_
 # the churn families so per-tenant serving behavior is attributable from
 # one shared registry.
 SOLVER_FLEET_RUNNABLE_TENANTS = "karpenter_solver_fleet_runnable_tenants"
+# wake episodes split by the bounded `cause` enum (obs.podtrace.WAKE_CAUSES:
+# watch-event | batcher-window | poll-floor | rearm) so wake attribution is
+# queryable — which seam actually makes tenants runnable in production
 SOLVER_FLEET_WAKE_TOTAL = "karpenter_solver_fleet_wake_total"
 SOLVER_FLEET_SCHED_WAIT_SECONDS = "karpenter_solver_fleet_sched_wait_seconds"
+# podtrace (obs/podtrace.py): the event-lifecycle flight recorder. `stage`
+# is the static STAGES tuple (coalesce | sched_wait | prestage | solve |
+# decode | e2e), `tenant` the bounded fleet label, `quantile` the
+# three-point rolling enum — all bounded by construction.
+SOLVER_EVENT_STAGE_QUANTILE_SECONDS = "karpenter_solver_event_stage_quantile_seconds"
+SOLVER_EVENT_SLO_BREACH_TOTAL = "karpenter_solver_event_slo_breach_total"
+SOLVER_EVENT_TRACE_DROPPED_TOTAL = "karpenter_solver_event_trace_dropped_total"
 # wake-to-solve wait: sub-ms when the fleet loop is idle, growing under
 # multiplexing pressure — the fairness policy's observable surface
 SOLVER_FLEET_SCHED_WAIT_BUCKETS = (0.000_1, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
@@ -235,9 +245,27 @@ def make_registry() -> Registry:
     )
     r.counter(
         SOLVER_FLEET_WAKE_TOTAL,
-        "Fleet wake-ups: a watch-delivered trigger marked the tenant runnable "
-        "and woke the fleet loop (push path, no idle-window poll stall)",
+        "Fleet wake episodes: what marked the tenant runnable and woke the "
+        "fleet loop, by bounded cause (watch-event | batcher-window | "
+        "poll-floor | rearm)",
+        ("tenant", "cause"),
+    )
+    r.gauge(
+        SOLVER_EVENT_STAGE_QUANTILE_SECONDS,
+        "Rolling event-lifecycle latency quantiles (p50 | p90 | p99) over the "
+        "podtrace ring, per (tenant, stage) — e2e is event-to-placement",
+        ("tenant", "stage", "quantile"),
+    )
+    r.counter(
+        SOLVER_EVENT_SLO_BREACH_TOTAL,
+        "Completed events whose e2e latency exceeded the podtrace SLO target "
+        "(KARPENTER_PODTRACE_SLO) — the SLO budget burn counter",
         ("tenant",),
+    )
+    r.counter(
+        SOLVER_EVENT_TRACE_DROPPED_TOTAL,
+        "EventRecords evicted from the bounded podtrace ring or refused at the in-flight cap",
+        (),
     )
     r.histogram(
         SOLVER_FLEET_SCHED_WAIT_SECONDS,

@@ -1,11 +1,16 @@
-"""karpenter_tpu.obs — the solvetrace flight recorder.
+"""karpenter_tpu.obs — the solvetrace + podtrace flight recorders.
 
 `trace` holds the span API, SolveTrace, the JIT-recompile sentinel, and the
-bounded TraceRecorder ring with rolling P50/P90/P99; `export` renders traces
-as JSONL or Chrome/Perfetto trace_event JSON (`python -m karpenter_tpu.obs`);
+bounded TraceRecorder ring with rolling P50/P90/P99; `podtrace` is the
+event-lifecycle recorder (watch-event arrival through coalesce / DRR wait /
+prestage / solve / bind, with per-stage quantiles and the SLO budget);
+`export` renders both as JSONL or Chrome/Perfetto trace_event JSON
+(`python -m karpenter_tpu.obs`, `--events` for the podtrace tracks);
 `stats` is the repo's one nearest-rank quantile implementation, shared with
 `testing/metrics_poller`. Importing this package never initializes jax."""
 
+from .podtrace import WAKE_CAUSES, EventRecord, PodTracer, SLOBudget
+from .podtrace import STAGES as EVENT_STAGES
 from .stats import RollingQuantiles, quantile
 from .trace import (
     JIT_WATCHLIST,
@@ -19,12 +24,17 @@ from .trace import (
 )
 
 __all__ = [
+    "EVENT_STAGES",
+    "EventRecord",
     "JIT_WATCHLIST",
+    "PodTracer",
     "RecompileSentinel",
     "RollingQuantiles",
+    "SLOBudget",
     "SolveTrace",
     "Span",
     "TraceRecorder",
+    "WAKE_CAUSES",
     "current_trace",
     "default_recorder",
     "quantile",

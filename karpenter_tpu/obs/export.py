@@ -90,6 +90,124 @@ def to_trace_events(traces) -> dict:
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
+# podtrace export: the event-lifecycle tracks. Thread ids are a static
+# enum — watch delivery, the serve/fleet loop (where dispatch+solve run),
+# and the prestage worker — so one event's journey renders as slices on
+# THREE tracks joined by flow arrows (ph s/t/f sharing the event's flow id).
+EVENT_TRACKS = (("watch-delivery", 1), ("serve-loop", 2), ("prestage-worker", 3))
+
+
+def _event_dict(rec) -> dict:
+    return rec if isinstance(rec, dict) else rec.to_dict()
+
+
+def events_to_trace_events(events) -> dict:
+    """Chrome/Perfetto trace_event JSON for podtrace EventRecords: per event
+    a `coalesce` slice on the watch-delivery track, a `solve` (+`decode`
+    tail) slice on the serve-loop track, and a `prestage` slice on the
+    worker track when the double buffer staged it — with flow arrows
+    carrying the event across threads (the cross-thread stamps ARE the
+    product: arrival on a watch thread, dispatch on the fleet loop, staging
+    on the worker)."""
+    meta = [
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": tid, "args": {"name": name}}
+        for name, tid in EVENT_TRACKS
+    ]
+    tids = dict(EVENT_TRACKS)
+    out: list = []
+    for i, rec in enumerate(events):
+        d = _event_dict(rec)
+        stages = d.get("stages", {})
+        wall_us = d.get("wall_arrival", 0.0) * 1e6
+        flow_id = i + 1
+        label = d.get("name") or d.get("uid", "?")
+        args = {
+            "uid": d.get("uid", ""),
+            "tenant": d.get("tenant", ""),
+            "outcome": d.get("outcome", ""),
+            "wake_cause": d.get("wake_cause", ""),
+            "solve_seq": d.get("solve_seq", 0),
+            "staged": d.get("staged", False),
+        }
+        coalesce_us = max((stages.get("coalesce", 0.0) + stages.get("sched_wait", 0.0)) * 1e6, 0.01)
+        out.append(
+            {
+                "name": f"coalesce:{label}", "ph": "X", "ts": wall_us, "dur": coalesce_us,
+                "pid": 1, "tid": tids["watch-delivery"], "cat": "event", "args": args,
+            }
+        )
+        # flow start at the end of the coalescing window (the dispatch)...
+        out.append(
+            {"name": "event-flow", "ph": "s", "id": flow_id, "ts": wall_us + coalesce_us,
+             "pid": 1, "tid": tids["watch-delivery"], "cat": "event"}
+        )
+        if d.get("staged"):
+            out.append(
+                {
+                    "name": f"prestage:{label}", "ph": "X", "ts": wall_us,
+                    "dur": max(stages.get("prestage", 0.0) * 1e6, 0.01),
+                    "pid": 1, "tid": tids["prestage-worker"], "cat": "event", "args": args,
+                }
+            )
+            out.append(
+                {"name": "event-flow", "ph": "t", "id": flow_id,
+                 "ts": wall_us + max(stages.get("prestage", 0.0) * 1e6, 0.01),
+                 "pid": 1, "tid": tids["prestage-worker"], "cat": "event"}
+            )
+        # ... landing on the solve slice on the serve-loop track
+        solve_ts = wall_us + coalesce_us
+        out.append(
+            {
+                "name": f"solve:{label}", "ph": "X", "ts": solve_ts,
+                "dur": max(stages.get("solve", 0.0) * 1e6, 0.01),
+                "pid": 1, "tid": tids["serve-loop"], "cat": "event", "args": args,
+            }
+        )
+        out.append(
+            {"name": "event-flow", "ph": "f", "bp": "e", "id": flow_id, "ts": solve_ts,
+             "pid": 1, "tid": tids["serve-loop"], "cat": "event"}
+        )
+        if stages.get("decode", 0.0) > 0.0:
+            out.append(
+                {
+                    "name": f"decode:{label}", "ph": "X",
+                    "ts": solve_ts + max(stages.get("solve", 0.0) * 1e6, 0.01),
+                    "dur": stages["decode"] * 1e6,
+                    "pid": 1, "tid": tids["serve-loop"], "cat": "event", "args": args,
+                }
+            )
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def events_to_jsonl(events) -> str:
+    """One compact JSON object per line, one line per completed event."""
+    return "\n".join(json.dumps(_event_dict(e), sort_keys=True) for e in events)
+
+
+def parse_event_dump(text: str) -> list[dict]:
+    """Accept a /debug/events dump (object with "tenants"), a single
+    tracer dump (object with "events"), or JSONL of EventRecord dicts."""
+    text = text.strip()
+    if not text:
+        return []
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        obj = None
+    if isinstance(obj, dict):
+        if "tenants" in obj:
+            out: list[dict] = []
+            for dump in obj["tenants"].values():
+                out.extend(dump.get("events", ()))
+            return out
+        if "events" in obj:
+            return list(obj["events"])
+        return [obj]
+    if isinstance(obj, list):
+        return obj
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
 def parse_dump(text: str) -> list[dict]:
     """Accept either a /debug/solves dump (object with "solves") or JSONL
     (one trace object per line) and return the trace dicts."""

@@ -253,6 +253,14 @@ class SolveTrace:
         if enc is not None:
             row = "hit" if a.get("row_cache") else "miss"
             lines.append(f"  encode: mode={enc} row_cache={row}")
+        eb = a.get("event_batch")
+        if eb:
+            extra = f", window {eb['window_s'] * 1e3:.1f}ms" if "window_s" in eb else ""
+            extra += f", sched wait {eb['sched_wait_s'] * 1e3:.1f}ms" if "sched_wait_s" in eb else ""
+            lines.append(
+                f"  events: {eb.get('count', 0)} traced watch event(s), oldest "
+                f"{eb.get('oldest_age_s', 0.0) * 1e3:.1f}ms old at dispatch{extra} (podtrace: /debug/events)"
+            )
         if self.mode in ("hybrid", "hybrid-delta"):
             lines.append(
                 f"  why hybrid: pod-local fallback families {self.families} "

@@ -76,8 +76,18 @@ class Environment:
         from ..obs.trace import default_recorder
 
         self.trace_recorder = default_recorder()
+        # podtrace event-lifecycle tracer backing /debug/events (obs/
+        # podtrace.py, default-on via KARPENTER_PODTRACE): stamped into the
+        # store's delivery seam below and into the provisioner after it is
+        # built. Per-environment (= per-tenant in fleet mode; the fleet
+        # relabels it at session registration).
+        from ..obs.podtrace import PodTracer
+
+        self.podtracer = PodTracer(registry=self.registry)
         self.recorder = Recorder(self.clock)
         self.store = store if store is not None else Store(clock=self.clock)
+        if self.podtracer.enabled:
+            self.store.set_event_tracer(self.podtracer)
         self.cluster = Cluster(self.store, self.clock)
         start_informers(self.store, self.cluster)
 
@@ -124,6 +134,7 @@ class Environment:
                 reserved_capacity_enabled=self.options.feature_gates.reserved_capacity,
             ),
         )
+        self.provisioner.podtracer = self.podtracer
         self.device_allocation = DeviceAllocationController(self.store, self.cluster, self.clock)
         self.dra_kwok_driver = DRAKwokDriver(self.store)
         self.capacity_buffer = CapacityBufferController(self.store, self.clock, provisioner=self.provisioner)

@@ -5,8 +5,12 @@ readyz probes on --health-probe-port, pprof handlers behind
 --enable-profiling. Here one threaded stdlib server carries all routes:
 /healthz, /readyz, /metrics, /debug/solves (the solvetrace flight-recorder
 dump: recent SolveTraces + rolling per-(mode, phase) quantiles, see
-obs/trace.py; `?n=<k>` limits to the newest k solves), and /debug/profile
-(a py-spy-less stand-in that dumps running thread stacks, the diagnostic the
+obs/trace.py; `?n=<k>` limits to the newest k solves and `?tenant=<label>`
+selects a fleet tenant's private recorder), /debug/events (the podtrace
+event-lifecycle dump: completed EventRecords with the per-stage e2e
+decomposition, SLO budget, and wake-cause split, per tenant — obs/
+podtrace.py; same `?n=`/`?tenant=` filters), and /debug/profile (a
+py-spy-less stand-in that dumps running thread stacks, the diagnostic the
 reference's pprof routes serve in e2e debugging — karpenter_profiler.go:40-56).
 """
 
@@ -58,22 +62,69 @@ class OperatorServer:
                     ready = env.cluster.synced()
                     self._send(200 if ready else 503, "ok" if ready else "cluster state not synced")
                 elif self.path == "/metrics":
+                    # podtrace quantile gauges publish per SCRAPE (sorting
+                    # the stage windows rides this handler, never the
+                    # serving hot path)
+                    from ..obs.podtrace import tenant_surfaces
+
+                    own_tracer = getattr(env, "podtracer", None)
+                    if own_tracer is not None:
+                        own_tracer.publish_quantiles()
+                    for _label, (_rec, tenant_tracer) in tenant_surfaces().items():
+                        tenant_tracer.publish_quantiles()
                     self._send(200, env.registry.expose(), "text/plain; version=0.0.4")
                 elif self.path.split("?", 1)[0] == "/debug/solves":
                     # served unconditionally (unlike /debug/profile, which the
                     # reference gates behind --enable-profiling): the trace
                     # dump's sensitivity class matches the unauthenticated
                     # /metrics exposition on this same port
+                    from ..obs.podtrace import tenant_surfaces
                     from ..obs.trace import default_recorder
 
-                    rec = getattr(env, "trace_recorder", None) or default_recorder()
                     qs = parse_qs(urlparse(self.path).query)
                     try:
                         limit = int(qs["n"][0]) if "n" in qs else None
                     except ValueError:
                         self._send(400, "bad ?n= value")
                         return
+                    tenant = qs["tenant"][0] if "tenant" in qs else None
+                    if tenant is not None:
+                        # per-tenant recorders (fleet mode): resolve through
+                        # the podtrace tenant-surface registry
+                        surf = tenant_surfaces().get(tenant)
+                        if surf is None:
+                            self._send(404, f"unknown tenant {tenant!r}")
+                            return
+                        rec = surf[0]
+                    else:
+                        rec = getattr(env, "trace_recorder", None) or default_recorder()
                     self._send(200, json.dumps(rec.dump(limit=limit), indent=1), "application/json")
+                elif self.path.split("?", 1)[0] == "/debug/events":
+                    # the podtrace event-lifecycle dump: per-tenant rings of
+                    # completed EventRecords + rolling per-stage quantiles,
+                    # SLO budget, and wake-cause attribution
+                    from ..obs.podtrace import tenant_surfaces
+
+                    qs = parse_qs(urlparse(self.path).query)
+                    try:
+                        limit = int(qs["n"][0]) if "n" in qs else None
+                    except ValueError:
+                        self._send(400, "bad ?n= value")
+                        return
+                    tracers = {}
+                    own = getattr(env, "podtracer", None)
+                    if own is not None:
+                        tracers[own.tenant or "default"] = own
+                    for label, (_rec, tracer) in tenant_surfaces().items():
+                        tracers.setdefault(label, tracer)
+                    tenant = qs["tenant"][0] if "tenant" in qs else None
+                    if tenant is not None:
+                        if tenant not in tracers:
+                            self._send(404, f"unknown tenant {tenant!r}")
+                            return
+                        tracers = {tenant: tracers[tenant]}
+                    body = {"tenants": {label: t.dump(limit=limit) for label, t in sorted(tracers.items())}}
+                    self._send(200, json.dumps(body, indent=1), "application/json")
                 elif self.path == "/debug/profile" and enable_profiling:
                     frames = {}
                     for tid, frame in sys._current_frames().items():

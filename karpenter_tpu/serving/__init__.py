@@ -19,6 +19,11 @@ makes that regime first-class:
   gate, via the solvetrace sentinel). Gains record/replay: the generated
   event stream dumps as JSONL and `ChurnSpec.from_event_log()` replays it
   deterministically (one recorded log can drive K fleet tenants).
+- every stage of that journey is flight-recorded per EVENT by podtrace
+  (obs/podtrace.py): watch-event arrival (store delivery seam) ->
+  coalescing-window residency -> fleet DRR sched wait -> prestage
+  staged/missed -> solve (linked to the SolveTrace by seq) -> bind, with
+  per-tenant per-stage quantiles, an SLO budget, and `/debug/events`.
 - `fleet.FleetFrontend` / `fleet.TenantSession` — the multi-tenant front
   end: ONE solver process multiplexes many tenant clusters (per-tenant
   Store/Provisioner/EncodeCache/resident carry), watch events wake the
@@ -79,6 +84,10 @@ prestage            PendingPrestager clone cache + staged/reused/misses
 metric / metric-    every _Metric's series maps / Registry._metrics (RLock)
 registry
 trace               TraceRecorder ring, windows, seq, dropped
+podtrace            PodTracer active/awaiting maps, completed ring, stage
+                    windows, SLO + wake stats (leaf; metric emission runs
+                    OUTSIDE it), plus the module-level tenant-surface
+                    registry in obs/podtrace.py
 events              Recorder.events + dedupe map (RLock)
 clock               FakeClock._t
 leader              LeaderElector._leading/_last_renew
@@ -91,10 +100,16 @@ DAG, and the sanitizer raises on the first acquisition that closes a
 cycle):
 
     store-deliver  ->  { store, cluster, batcher, prestage, clock, metric*,
-                         fleet-session, fleet }
+                         fleet-session, fleet, podtrace }
     cluster        ->  { store, clock }
     trace          ->  { metric-registry, metric }
     events | store | batcher | prestage  ->  clock
+
+(store-deliver -> podtrace is the arrival-stamp seam: `Store._drain` hands
+every delivered event to the installed PodTracer before the watcher fan-out;
+every other podtrace touch point — dispatch/solved on the solve thread,
+prestage stamps after the prestage lock releases, wake counts after the
+fleet lock releases — acquires it as a leaf.)
 
 (The fleet edges are the push-wake path: watch delivery -> batcher trigger
 -> wake_hook -> TenantSession stats -> FleetFrontend runnable set, each

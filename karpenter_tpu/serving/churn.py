@@ -72,8 +72,10 @@ class ChurnSpec:
     seed: int = 0
     # record/replay (the ROADMAP trace-replay seed): `record_path` dumps the
     # applied event stream as JSONL (one op per line: arrive/cancel/depart/
-    # solve/bind_flush/mark — self-contained pod params, replayable without
-    # the generator); `replay_events` drives the harness from a loaded log
+    # solve/bind_flush/mark — self-contained pod params plus `t`, the op's
+    # wall offset from recording start, so a replay's podtrace latency
+    # measurements can be compared against the recorded pacing; replayable
+    # without the generator); `replay_events` drives the harness from a log
     # instead of generating events, deterministically — the multi-tenant
     # bench replays ONE recorded log into K fleet tenants. Record with
     # concurrent_seconds=0: the concurrent segment's thread interleaving is
@@ -141,6 +143,17 @@ class ChurnReport:
     prestage_staged: int = 0
     n_nodes: int = 0
     n_pending_end: int = 0
+    # podtrace (obs/podtrace.py) end-to-end columns over the steady window:
+    # event-to-PLACEMENT latency per completed EventRecord, with the
+    # per-stage decomposition and the stage that dominated the e2e mean —
+    # the number a USER of the cluster experiences, vs p50/p99_solve_seconds
+    # which only time the re-solve itself
+    e2e_events: int = 0
+    e2e_p50_seconds: float = 0.0
+    e2e_p99_seconds: float = 0.0
+    dominant_stage: str = ""
+    stage_p99_seconds: dict = field(default_factory=dict)
+    slo_breaches: int = 0
 
     def as_dict(self) -> dict:
         return {
@@ -152,6 +165,12 @@ class ChurnReport:
             "delta_hit_rate": round(self.delta_hit_rate, 4),
             "p50_solve_seconds": round(self.p50_solve_seconds, 4),
             "p99_solve_seconds": round(self.p99_solve_seconds, 4),
+            "e2e_events": self.e2e_events,
+            "e2e_p50_seconds": round(self.e2e_p50_seconds, 4),
+            "e2e_p99_seconds": round(self.e2e_p99_seconds, 4),
+            "dominant_stage": self.dominant_stage,
+            "stage_p99_seconds": {k: round(v, 4) for k, v in self.stage_p99_seconds.items()},
+            "slo_breaches": self.slo_breaches,
             "recompiles": dict(self.recompiles),
             "steady_recompiles": self.steady_recompiles,
             "full_solve_reasons": dict(self.full_solve_reasons),
@@ -216,11 +235,16 @@ class ChurnHarness:
         self.fleet = None
         self._tenant_id = None
         self.recorder = TraceRecorder(capacity=self.spec.trace_capacity, enabled=True)
-        # record/replay: the applied-event log (None = not recording)
+        # record/replay: the applied-event log (None = not recording). Every
+        # op carries `t`, its wall-clock offset from recording start — the
+        # per-event arrival timing that lets a replayed log's latency
+        # measurements be compared against the recorded run's pacing.
         self._event_log: list[dict] | None = [] if self.spec.record_path else None
+        self._log_t0 = time.perf_counter()
 
     def _log(self, **op) -> None:
         if self._event_log is not None:
+            op.setdefault("t", round(time.perf_counter() - self._log_t0, 6))
             self._event_log.append(op)
 
     # -- stack -----------------------------------------------------------------
@@ -478,6 +502,7 @@ class ChurnHarness:
         self.prebuild(s.arrivals * s.iterations)
         self._log(op="mark")
         mark = self.recorder.seq
+        emark, slo0 = self._etracer_mark()
         rejects0 = self._reject_counts()
         coalesced0 = self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total()
         reused0 = self.loop.prestager.reused if self.loop.prestager is not None else 0
@@ -489,7 +514,7 @@ class ChurnHarness:
             events += self.run_cycle()
             done += s.bind_every
         wall = time.perf_counter() - t0
-        rep = self._report(mark, events, wall, coalesced0, reused0, staged0)
+        rep = self._report(mark, events, wall, coalesced0, reused0, staged0, emark, slo0)
         rejects1 = self._reject_counts()
         rep.full_solve_reasons = {
             k: int(v - rejects0.get(k, 0)) for k, v in rejects1.items() if v > rejects0.get(k, 0)
@@ -565,6 +590,7 @@ class ChurnHarness:
         if self.env is None:
             self.build()
         mark = self.recorder.seq
+        emark, slo0 = self._etracer_mark()
         rejects0 = self._reject_counts()
         coalesced0 = self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total()
         reused0 = self.loop.prestager.reused if self.loop.prestager is not None else 0
@@ -578,6 +604,7 @@ class ChurnHarness:
             elif kind == "mark":
                 # steady window opens HERE, exactly like the generated run
                 mark = self.recorder.seq
+                emark, slo0 = self._etracer_mark()
                 rejects0 = self._reject_counts()
                 coalesced0 = self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total()
                 reused0 = self.loop.prestager.reused if self.loop.prestager is not None else 0
@@ -587,7 +614,7 @@ class ChurnHarness:
             else:
                 events += self.apply_op(op)
         wall = time.perf_counter() - t0
-        rep = self._report(mark, events, wall, coalesced0, reused0, staged0)
+        rep = self._report(mark, events, wall, coalesced0, reused0, staged0, emark, slo0)
         rejects1 = self._reject_counts()
         rep.full_solve_reasons = {
             k: int(v - rejects0.get(k, 0)) for k, v in rejects1.items() if v > rejects0.get(k, 0)
@@ -646,7 +673,18 @@ class ChurnHarness:
             out[labels.get("reason", "?")] = v
         return out
 
-    def _report(self, mark: int, events: int, wall: float, coalesced0: float = 0.0, reused0: int = 0, staged0: int = 0) -> ChurnReport:
+    def _etracer(self):
+        """The environment's podtrace event tracer (None when off/absent)."""
+        tr = getattr(self.env, "podtracer", None) if self.env is not None else None
+        return tr if tr is not None and tr.enabled else None
+
+    def _etracer_mark(self) -> tuple[int, int]:
+        """(completed-event seq, SLO breach count) at the steady mark — the
+        window the e2e report columns are computed over."""
+        tr = self._etracer()
+        return (tr.seq, tr.slo.breaches) if tr is not None else (0, 0)
+
+    def _report(self, mark: int, events: int, wall: float, coalesced0: float = 0.0, reused0: int = 0, staged0: int = 0, emark: int = 0, slo0: int = 0) -> ChurnReport:
         traces = [t for t in self.recorder.traces() if t.seq > mark and t.mode not in ("", "consolidate")]
         durs = sorted(t.duration for t in traces)
         modes: dict[str, int] = {}
@@ -678,4 +716,27 @@ class ChurnHarness:
             n_nodes=len(self.env.cluster.nodes()),
             n_pending_end=len(self._pending),
         )
+        tr = self._etracer()
+        if tr is not None:
+            recs = tr.events_since(emark)
+            if recs:
+                stage_rows = [r.stage_view() for r in recs]
+                e2e = sorted(s["e2e"] for s in stage_rows)
+                rep.e2e_events = len(e2e)
+                rep.e2e_p50_seconds = quantile(e2e, 0.50, assume_sorted=True)
+                rep.e2e_p99_seconds = quantile(e2e, 0.99, assume_sorted=True)
+                from ..obs.podtrace import STAGES
+
+                rep.stage_p99_seconds = {
+                    st: quantile(sorted(s[st] for s in stage_rows), 0.99, assume_sorted=True) for st in STAGES if st != "e2e"
+                }
+                # dominance over the ADDITIVE decomposition (coalesce +
+                # sched_wait + solve == e2e); prestage overlaps and decode
+                # trails placement, so neither can "dominate" the e2e
+                means = {
+                    st: sum(s[st] for s in stage_rows) / len(stage_rows)
+                    for st in ("coalesce", "sched_wait", "solve")
+                }
+                rep.dominant_stage = max(means, key=means.get)
+            rep.slo_breaches = tr.slo.breaches - slo0
         return rep

@@ -130,17 +130,23 @@ class TenantSession:
         """Store watch -> fleet wake (runs on the committing thread under
         the store's delivery lock; registered in the thread-shared registry).
         Covers DELETED events, which never reach the batcher trigger."""
-        self.on_trigger()
+        self.on_trigger("watch-event")
 
-    def on_trigger(self) -> None:
-        """Batcher wake_hook / watch seam: record the signal and mark this
-        tenant runnable. Cheap and leaf-locked by design — it runs on watch
-        delivery threads."""
+    def _on_batcher_trigger(self) -> None:
+        """The batcher's wake_hook seam (fires per trigger, after its lock
+        releases) — the second push path, attributed separately so the wake
+        split can tell trigger-driven wakes from raw watch deliveries."""
+        self.on_trigger("batcher-window")
+
+    def on_trigger(self, cause: str = "watch-event") -> None:
+        """Push seam: record the signal and mark this tenant runnable with
+        its bounded wake cause (obs.podtrace.WAKE_CAUSES). Cheap and
+        leaf-locked by design — it runs on watch delivery threads."""
         with self._lock:
             touch(self, "wakes")
             self.wakes += 1
             self.last_wake_monotonic = time.monotonic()
-        self.fleet._mark_runnable(self.tenant_id)
+        self.fleet._mark_runnable(self.tenant_id, cause)
 
     # -- fleet-facing surface --------------------------------------------------
     def ready(self) -> bool:
@@ -176,6 +182,7 @@ class FleetFrontend:
         "_runnable": "_lock",
         "_deficit": "_lock",
         "_runnable_since": "_lock",
+        "_runnable_cause": "_lock",
         "_thread": "_lock",
         "_stop": "_lock",
     }
@@ -204,6 +211,9 @@ class FleetFrontend:
         self._runnable: set[str] = set()
         self._deficit: dict[str, float] = {}
         self._runnable_since: dict[str, float] = {}
+        # the bounded wake cause that OPENED each runnable episode — handed
+        # to the tenant's podtrace at dispatch so per-event records carry it
+        self._runnable_cause: dict[str, str] = {}
         self._thread = None
         self._stop = make_event()
         self.pump_rounds = 0
@@ -242,6 +252,15 @@ class FleetFrontend:
 
             env.provisioner.solver = TPUSolver(registry=self.registry, recorder=recorder, tenant=label)
         env.provisioner.tenant = label
+        # relabel the environment's event tracer with the bounded fleet
+        # label (it was built tenant="" before the session existed) and
+        # register both per-tenant surfaces for ?tenant= debug routing
+        tracer = getattr(env, "podtracer", None)
+        if tracer is not None:
+            tracer.tenant = label
+            from ..obs.podtrace import register_tenant
+
+            register_tenant(label, recorder, tracer)
         loop = ServingLoop(env.provisioner, env.store, double_buffer=double_buffer, worker=worker)
         sess = TenantSession(self, tenant_id, env, loop, recorder, label)
         with self._lock:
@@ -252,7 +271,7 @@ class FleetFrontend:
             self._deficit[tenant_id] = 0.0
         # wire the push seams only after the session is registered, so a
         # wake racing registration can never reference an unknown tenant
-        env.provisioner.batcher.wake_hook = sess.on_trigger
+        env.provisioner.batcher.wake_hook = sess._on_batcher_trigger
         env.store.watch("Pod", sess._on_watch_event)
         env.store.watch("Node", sess._on_watch_event)
         return sess
@@ -265,7 +284,11 @@ class FleetFrontend:
             self._runnable.discard(tenant_id)
             self._deficit.pop(tenant_id, None)
             self._runnable_since.pop(tenant_id, None)
+            self._runnable_cause.pop(tenant_id, None)
         if sess is not None:
+            from ..obs.podtrace import unregister_tenant
+
+            unregister_tenant(sess.label)
             sess.close()
 
     def sessions(self) -> dict[str, TenantSession]:
@@ -277,36 +300,46 @@ class FleetFrontend:
             return self._sessions.get(tenant_id)
 
     # -- push wake -------------------------------------------------------------
-    def _mark_runnable(self, tenant_id: str) -> None:
-        """Mark a tenant runnable and wake the fleet loop. Runs on watch-
-        delivery threads: fleet lock only (leaf), metric emission outside."""
+    def _mark_runnable(self, tenant_id: str, cause: str = "rearm") -> int:
+        """Mark a tenant runnable and wake the fleet loop. `cause` is the
+        bounded wake attribution (obs.podtrace.WAKE_CAUSES) — only the FIRST
+        signal of a runnable episode is attributed, so the split counts wake
+        episodes, not raw triggers. Runs on watch-delivery threads: fleet
+        lock only (leaf), metric emission outside."""
         with self._lock:
             sess = self._sessions.get(tenant_id)
             newly = sess is not None and tenant_id not in self._runnable
             if newly:
                 self._runnable.add(tenant_id)
                 self._runnable_since.setdefault(tenant_id, time.monotonic())
+                self._runnable_cause.setdefault(tenant_id, cause)
             n_runnable = len(self._runnable)
         if newly:
             self._wake.set()
             from .. import metrics as m
 
-            self.registry.counter(m.SOLVER_FLEET_WAKE_TOTAL).inc(tenant=sess.label)  # solverlint: ok(metric-label-cardinality): label is a tenant_label() output fixed at session registration — the bounded fleet enum
+            self.registry.counter(m.SOLVER_FLEET_WAKE_TOTAL).inc(tenant=sess.label, cause=cause)  # solverlint: ok(metric-label-cardinality): label is a tenant_label() output fixed at session registration and cause the static WAKE_CAUSES enum threaded from the wake seams
             self.registry.gauge(m.SOLVER_FLEET_RUNNABLE_TENANTS).set(n_runnable)
+            tracer = getattr(sess.env, "podtracer", None)
+            if tracer is not None:
+                tracer.on_wake(cause)
+        return 1 if newly else 0
 
     def runnable_tenants(self) -> list[str]:
         with self._lock:
             return [t for t in self._order if t in self._runnable]
 
-    def rearm_ready(self) -> int:
+    def rearm_ready(self, cause: str = "rearm") -> int:
         """Poll-fallback re-arm: mark every tenant whose batch window has
-        closed (`ready()`) runnable. The serve loop calls this after each
-        wake/timeout so a window that closed by TIME (no new event to push a
-        wake) is still served; deterministic drivers may call it directly."""
+        closed (`ready()`) runnable, attributed to `cause` ("batcher-window"
+        when the serve loop woke because the nearest eta elapsed,
+        "poll-floor" on the liveness backstop, "rearm" for direct calls from
+        deterministic drivers). A window that closed by TIME — no new event
+        to push a wake — is still served through here."""
         n = 0
         for tid, sess in self.sessions().items():
             if sess.ready():
-                self._mark_runnable(tid)
+                self._mark_runnable(tid, cause)
                 n += 1
         return n
 
@@ -379,16 +412,26 @@ class FleetFrontend:
             self._runnable.discard(tenant_id)
             self._deficit[tenant_id] = 0.0
             self._runnable_since.pop(tenant_id, None)
+            self._runnable_cause.pop(tenant_id, None)
 
     def _observe_sched_wait(self, tenant_id: str, sess: TenantSession) -> None:
         with self._lock:
             since = self._runnable_since.pop(tenant_id, None)
+            cause = self._runnable_cause.pop(tenant_id, "")
+            credit = self._deficit.get(tenant_id, 0.0)
         if since is not None:
+            wait = time.monotonic() - since
             from .. import metrics as m
 
             self.registry.histogram(m.SOLVER_FLEET_SCHED_WAIT_SECONDS).observe(
-                time.monotonic() - since, tenant=sess.label  # solverlint: ok(metric-label-cardinality): label is a tenant_label() output fixed at session registration — the bounded fleet enum
+                wait, tenant=sess.label  # solverlint: ok(metric-label-cardinality): label is a tenant_label() output fixed at session registration — the bounded fleet enum
             )
+            # hand the DRR wait (plus round + banked credit + the episode's
+            # wake cause at dispatch) to the tenant's event tracer: the next
+            # dispatch's events carry them on their records
+            tracer = getattr(sess.env, "podtracer", None)
+            if tracer is not None:
+                tracer.note_sched_wait(wait, drr_round=self.pump_rounds, credit=credit, cause=cause)
 
     def _publish_runnable(self) -> None:
         with self._lock:
@@ -434,7 +477,14 @@ class FleetFrontend:
             self._wake.clear()
             if stop.is_set():
                 return
-            self.rearm_ready()
+            # wake attribution: push wakes attributed themselves at the
+            # trigger seams; any tenant rearm_ready marks here is one whose
+            # window closed by TIME — "batcher-window" whenever a window was
+            # open (incl. timeout<=0 and push-coincident sweeps), the
+            # "poll-floor" liveness backstop otherwise. The "rearm" cause
+            # stays reserved for deterministic drivers calling rearm_ready
+            # directly.
+            self.rearm_ready("batcher-window" if eta is not None else "poll-floor")
             served = self.pump()
             if not served and (eta := self.next_eta()) is not None and eta <= 0:
                 # a window is ready but its reconcile declined to solve —

@@ -13,10 +13,17 @@ wiring the two serving-mode mechanisms in:
 - double-buffering lives in the PendingPrestager, installed here: the next
   batch's host-side clone+stamp work overlaps the current device pack on a
   worker thread (KARPENTER_SOLVER_DOUBLEBUF=0 disables — clones rebuilt per
-  pass, restoring the pre-serving-loop provisioner behavior exactly).
+  pass, restoring the pre-serving-loop provisioner behavior exactly);
+- event-lifecycle observability rides the same wiring (obs/podtrace.py):
+  the Environment installs one PodTracer on the store's delivery seam and
+  the provisioner, and `PendingPrestager.attach` adopts it for its
+  staged-vs-missed stamps — so every pump here closes the
+  arrival -> coalesce -> [sched-wait] -> solve legs of the per-event trace
+  without the loop itself holding any tracer state.
 
-Neither mechanism may change placements: tests pin bit-identical results
-against serial one-solve-per-batch execution with both hatches off.
+None of these mechanisms may change placements: tests pin bit-identical
+results against serial one-solve-per-batch execution with the hatches off
+and with podtrace disabled.
 """
 
 from __future__ import annotations

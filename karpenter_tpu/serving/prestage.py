@@ -89,6 +89,10 @@ class PendingPrestager:
         self._wake = make_event()
         self._stop = make_event()
         self._thread = None
+        # podtrace (obs/podtrace.py): staged-vs-missed stamps per event —
+        # adopted from the attached store's delivery seam; the tracer's own
+        # lock guards its state, so stamping needs no prestage lock
+        self.podtracer = None
         # stats (read by the churn harness/loop for attribution), guarded by
         # _lock like the cache they describe
         self.staged = 0  # clones prepared by the worker ahead of a take
@@ -98,6 +102,9 @@ class PendingPrestager:
     # -- store integration -----------------------------------------------------
     def attach(self, store) -> None:
         store.watch("Pod", self._on_event)
+        tracer = store.event_tracer() if hasattr(store, "event_tracer") else None
+        if tracer is not None and getattr(tracer, "enabled", False):
+            self.podtracer = tracer
 
     def _on_event(self, event: str, pod) -> None:
         self._queue.append((event, pod))
@@ -142,6 +149,7 @@ class PendingPrestager:
         """Drain the event queue (worker body; callable inline for
         deterministic single-threaded runs). Returns pods staged."""
         n = 0
+        staged_uids: list[str] = []
         while self._queue:
             try:
                 event, pod = self._queue.popleft()
@@ -177,6 +185,10 @@ class PendingPrestager:
                     touch(self, "staged")
                     self.staged += 1
                     n += 1
+                    staged_uids.append(uid)
+        if staged_uids and self.podtracer is not None:
+            # one batched stamp OUTSIDE the prestage lock (tracer is a leaf)
+            self.podtracer.on_prestaged_batch(staged_uids)
         return n
 
     @staticmethod
@@ -234,6 +246,8 @@ class PendingPrestager:
                 self._cache[uid] = (rv, clone)
             touch(self, "misses")
             self.misses += 1
+        if self.podtracer is not None:
+            self.podtracer.on_take_miss(uid)
         return clone
 
     def __len__(self) -> int:

@@ -193,6 +193,11 @@ class TPUSolver:
         # pre-solve placeholder so the trace-derived compat properties
         # (last_solve_mode / last_phase_seconds) read empty, never raise
         self._trace = SolveTrace(enabled=False)
+        # podtrace linkage: the provisioner stages its event-batch summary
+        # here (count, oldest-event age, window residency) right before the
+        # solve; the next begun SolveTrace notes it so explain() and
+        # /debug/events join through the solve seq
+        self._staged_event_batch: dict | None = None
         # hybrid partitioned solve: when every fallback reason is pod-local,
         # pack the in-window majority on the tensor path and run the exact
         # host FFD only on the flagged residual (False = legacy whole-snapshot
@@ -322,6 +327,18 @@ class TPUSolver:
         with self._trace.span("fallback", reason=family):
             return self.fallback.solve(snap)
 
+    def stage_event_batch(self, info: dict) -> None:
+        """podtrace seam: attach the NEXT solve's event-batch summary (the
+        provisioner calls this after stamping dispatch on its batch)."""
+        self._staged_event_batch = info
+
+    def discard_event_batch(self) -> None:
+        """Drop a staged-but-unconsumed event batch (the provisioner calls
+        this after a schedule() pass that declined to solve — e.g. no ready
+        nodepools — so the stale summary can never attach to an unrelated
+        later solve's trace)."""
+        self._staged_event_batch = None
+
     def solve(self, snap: SolverSnapshot) -> Results:
         """One production solve, flight-recorded: begins a SolveTrace on the
         recorder, stamps the JIT-recompile delta and the exit path's
@@ -335,6 +352,9 @@ class TPUSolver:
         # previous solve's backend/reasons
         self.last_backend = ""
         self.last_fallback_reasons = []
+        staged, self._staged_event_batch = self._staged_event_batch, None
+        if staged is not None:
+            trace.note(event_batch=staged)
         if trace.enabled:
             trace.jit_before = sentinel().snapshot()
         try:

@@ -48,3 +48,16 @@ def bad_tenant_raw_id(registry, session):
 def ok_tenant_producer(registry, session):
     # tenant_label is the bounded fleet producer (serving.fleet)
     registry.counter("karpenter_solver_solve_total").inc(backend="tpu", tenant=tenant_label(session.tenant_id))  # noqa: F821 — fixture, parsed only
+
+
+def bad_stage_runtime_name(registry, rec):
+    # the podtrace cardinality leak: a runtime-computed span name as the
+    # stage label instead of iterating the static obs.podtrace.STAGES enum
+    for stage, dur in rec.stamps.items():
+        registry.histogram("karpenter_solver_event_stage_seconds").observe(dur, stage=stage)
+
+
+def ok_stage_static_enum(registry, rec):
+    # the sanctioned form: stage iterates the static stage tuple
+    for stage in ("coalesce", "sched_wait", "prestage", "solve", "decode", "e2e"):
+        registry.histogram("karpenter_solver_event_stage_seconds").observe(rec.stages[stage], stage=stage)

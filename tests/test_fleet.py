@@ -139,7 +139,10 @@ class TestPushWake:
             # push-style, with no pump/poll having run
             assert fleet.runnable_tenants() == ["t0"]
             assert fleet._wake.is_set()
-            assert fleet.registry.counter(m.SOLVER_FLEET_WAKE_TOTAL).value(tenant="t0") == 1
+            # wake attribution: the batcher trigger hook fires first on the
+            # create's watch delivery, so the episode is attributed to the
+            # bounded "batcher-window" cause (the ?cause= split of ISSUE 14)
+            assert fleet.registry.counter(m.SOLVER_FLEET_WAKE_TOTAL).value(tenant="t0", cause="batcher-window") == 1
             assert fleet.registry.gauge(m.SOLVER_FLEET_RUNNABLE_TENANTS).value() == 1
             sess = fleet.session("t0")
             assert sess.wake_count() >= 1

@@ -1,0 +1,484 @@
+"""podtrace (karpenter_tpu/obs/podtrace.py): end-to-end event-lifecycle
+tracing for the fleet serving path (ISSUE 14).
+
+Pins the subsystem's contracts:
+- parity: bit-identical placements with tracing on vs off (the recorder may
+  never influence a solve);
+- cross-thread stamps: the threaded fleet loop under the runtime sanitizer
+  stamps arrival (watch-delivery thread) / dispatch+solve (fleet loop) on
+  the same records with ZERO racecheck violations;
+- the additive decomposition: coalesce + sched_wait + solve == e2e exactly,
+  per completed record;
+- ring bounding + dropped counter, SLO burn accounting, wake-cause split;
+- Perfetto export: three named thread tracks joined by flow arrows, round-
+  tripping through JSON;
+- surfaces: /debug/events (+ ?tenant= + ?n=), /debug/solves?tenant=,
+  SolveTrace.explain()'s linked event-batch line, and the ChurnReport e2e
+  columns the bench prints next to delta-hit.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from helpers import make_pod
+from test_churn_loop import placement_shape, small_spec
+from karpenter_tpu import metrics as m
+from karpenter_tpu.obs import racecheck
+from karpenter_tpu.obs.export import events_to_trace_events, parse_event_dump
+from karpenter_tpu.obs.podtrace import (
+    STAGES,
+    EventRecord,
+    PodTracer,
+    register_tenant,
+    reset_tenants,
+    unregister_tenant,
+)
+from karpenter_tpu.serving import ChurnHarness, ChurnSpec
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tenant_surfaces():
+    reset_tenants()
+    yield
+    reset_tenants()
+
+
+# -- synthetic record drivers --------------------------------------------------
+class _Claim:
+    def __init__(self, pods):
+        self.pods = pods
+
+
+class _Results:
+    def __init__(self, pods):
+        self.new_node_claims = [_Claim(pods)]
+        self.existing_nodes = []
+
+
+def _deliver(tracer: PodTracer, pod, event: str = "ADDED"):
+    now = time.monotonic()
+    tracer.on_delivery(event, pod, now, now)
+
+
+def _complete(tracer: PodTracer, pods, solve_seq: int = 1):
+    tracer.on_dispatch(pods)
+    tracer.on_solved(_Results(pods), solve_seq=solve_seq)
+
+
+class TestEventRecordLifecycle:
+    def test_stages_are_additive_to_e2e(self):
+        tracer = PodTracer(enabled=True)
+        pod = make_pod(name="ev-add")
+        pod.metadata.uid = "uid-ev-add"
+        _deliver(tracer, pod)
+        tracer.on_prestaged("uid-ev-add")
+        _complete(tracer, [pod], solve_seq=7)
+        recs = tracer.events()
+        assert len(recs) == 1
+        r = recs[0]
+        assert r.outcome == "placed" and r.solve_seq == 7 and r.staged
+        s = r.stage_seconds()
+        assert s["e2e"] == pytest.approx(s["coalesce"] + s["sched_wait"] + s["solve"], abs=1e-9)
+        assert set(s) == set(STAGES)
+
+    def test_cancel_and_bind_paths(self):
+        tracer = PodTracer(enabled=True)
+        gone = make_pod(name="ev-gone")
+        gone.metadata.uid = "uid-ev-gone"
+        _deliver(tracer, gone)
+        _deliver(tracer, gone, "DELETED")
+        assert tracer.cancelled == 1 and tracer.events() == []
+        # placed then bound: the MODIFIED event carrying node_name closes
+        # the decode stage on the already-completed ring record
+        pod = make_pod(name="ev-bind")
+        pod.metadata.uid = "uid-ev-bind"
+        _deliver(tracer, pod)
+        _complete(tracer, [pod])
+        pod.spec.node_name = "node-1"
+        _deliver(tracer, pod, "MODIFIED")
+        r = tracer.events()[0]
+        assert r.outcome == "bound"
+        assert r.stage_seconds()["decode"] >= 0.0 and r.t_bound >= r.t_solved
+
+    def test_errored_and_absent_records_never_phantom_complete(self):
+        tracer = PodTracer(enabled=True)
+        pod = make_pod(name="ev-err")
+        pod.metadata.uid = "uid-ev-err"
+        _deliver(tracer, pod)
+        # dispatched but ERRORED: the record must stay in flight
+        tracer.on_dispatch([pod])
+        res = _Results([])
+        res.pod_errors = {"default/ev-err": "unschedulable"}
+        tracer.on_solved(res, solve_seq=1)
+        assert tracer.events() == []
+        # the pod then leaves the pending set WITHOUT a watch event (e.g.
+        # PVC turns invalid); a later pass solves a batch it is absent from
+        # — completion-by-inversion must not phantom-place it
+        tracer.on_dispatch([])
+        tracer.on_solved(_Results([]), solve_seq=2)
+        assert tracer.events() == [] and tracer.seq == 0
+        # re-dispatched in a clean batch: completes normally
+        tracer.on_dispatch([pod])
+        tracer.on_solved(_Results([pod]), solve_seq=3)
+        assert [r.solve_seq for r in tracer.events()] == [3]
+
+    def test_disabled_tracer_records_nothing(self):
+        tracer = PodTracer(enabled=False)
+        pod = make_pod(name="ev-off")
+        pod.metadata.uid = "uid-ev-off"
+        _deliver(tracer, pod)
+        _complete(tracer, [pod])
+        assert tracer.events() == [] and tracer.deliveries == 0
+
+    def test_non_pod_kinds_are_ignored(self):
+        tracer = PodTracer(enabled=True)
+
+        class _Node:
+            kind = "Node"
+
+        _deliver(tracer, _Node())
+        assert tracer.deliveries == 0
+
+
+class TestRingAndSlo:
+    def test_ring_bounds_and_dropped_counter(self):
+        tracer = PodTracer(enabled=True, capacity=4)
+        for i in range(7):
+            pod = make_pod(name=f"ev-ring-{i}")
+            pod.metadata.uid = f"uid-ev-ring-{i}"
+            _deliver(tracer, pod)
+            _complete(tracer, [pod], solve_seq=i + 1)
+        assert len(tracer.events()) == 4
+        assert tracer.dropped == 3
+        assert tracer.seq == 7
+        # the ring keeps the NEWEST completions, oldest first
+        assert [r.seq for r in tracer.events()] == [4, 5, 6, 7]
+        assert tracer.events_since(6) == tracer.events()[-1:]
+
+    def test_slo_burn_accounting_and_metric(self):
+        registry = m.make_registry()
+        tracer = PodTracer(enabled=True, slo_seconds=0.0, registry=registry)
+        for i in range(3):
+            pod = make_pod(name=f"ev-slo-{i}")
+            pod.metadata.uid = f"uid-ev-slo-{i}"
+            _deliver(tracer, pod)
+            _complete(tracer, [pod])
+        slo = tracer.slo.to_dict()
+        assert slo["completed"] == 3 and slo["breaches"] == 3
+        assert slo["burn_rate"] == 1.0 and slo["budget_remaining"] == 0.0
+        assert registry.counter(m.SOLVER_EVENT_SLO_BREACH_TOTAL).value(tenant="") == 3
+        # a generous target burns nothing
+        ok = PodTracer(enabled=True, slo_seconds=60.0)
+        pod = make_pod(name="ev-slo-ok")
+        pod.metadata.uid = "uid-ev-slo-ok"
+        _deliver(ok, pod)
+        _complete(ok, [pod])
+        assert ok.slo.breaches == 0 and ok.slo.to_dict()["budget_remaining"] == 1.0
+
+    def test_wake_cause_and_sched_wait_plumbing(self):
+        tracer = PodTracer(enabled=True)
+        tracer.on_wake("watch-event")
+        tracer.on_wake("poll-floor")
+        tracer.on_wake("watch-event")
+        pod = make_pod(name="ev-drr")
+        pod.metadata.uid = "uid-ev-drr"
+        _deliver(tracer, pod)
+        tracer.note_sched_wait(0.5, drr_round=3, credit=2.0, cause="watch-event")
+        _complete(tracer, [pod])
+        r = tracer.events()[0]
+        assert r.sched_wait == 0.5 and r.drr_round == 3 and r.drr_credit == 2.0
+        assert r.stage_seconds()["sched_wait"] == 0.5
+        # the episode's wake cause rides the dispatch onto the record
+        assert r.wake_cause == "watch-event"
+        assert r.to_dict()["wake_cause"] == "watch-event"
+        dump = tracer.dump()
+        assert dump["wake_causes"] == {"watch-event": 2, "poll-floor": 1}
+
+    def test_selftime_meter_arms_and_disarms(self):
+        tracer = PodTracer(enabled=True)
+        tracer.start_selftime()
+        pod = make_pod(name="ev-st")
+        pod.metadata.uid = "uid-ev-st"
+        _deliver(tracer, pod)
+        _complete(tracer, [pod])
+        cost = tracer.stop_selftime()
+        assert cost > 0.0
+        assert "on_delivery" not in tracer.__dict__  # wrappers removed
+        # disarmed: further activity does not accumulate
+        pod2 = make_pod(name="ev-st2")
+        pod2.metadata.uid = "uid-ev-st2"
+        _deliver(tracer, pod2)
+        assert tracer.selftime == cost
+        assert tracer.seq == 1 and len(tracer.events()) == 1
+
+    def test_stats_cover_every_stage(self):
+        tracer = PodTracer(enabled=True)
+        pod = make_pod(name="ev-stats")
+        pod.metadata.uid = "uid-ev-stats"
+        _deliver(tracer, pod)
+        _complete(tracer, [pod])
+        stats = tracer.stats()
+        assert set(stats) == set(STAGES)
+        for qs in stats.values():
+            assert qs["n"] == 1 and qs["p50"] <= qs["p99"]
+
+
+class TestParityOnOff:
+    def test_bit_identical_placements_tracing_on_vs_off(self, monkeypatch):
+        shapes = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv("KARPENTER_PODTRACE", flag)
+            spec = small_spec()
+            h = ChurnHarness(spec)
+            try:
+                h.run()
+                shapes[flag] = placement_shape(h.env)
+                tracer = h.env.podtracer
+                if flag == "1":
+                    assert tracer.enabled and tracer.seq > 0
+                else:
+                    assert not tracer.enabled and tracer.seq == 0
+            finally:
+                h.close()
+        assert shapes["1"] == shapes["0"]
+
+    def test_churn_report_e2e_columns(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_PODTRACE", "1")
+        rep = None
+        h = ChurnHarness(small_spec())
+        try:
+            rep = h.run()
+        finally:
+            h.close()
+        assert rep.e2e_events > 0
+        assert rep.e2e_p99_seconds >= rep.e2e_p50_seconds > 0.0
+        assert rep.dominant_stage in ("coalesce", "sched_wait", "solve")
+        assert set(rep.stage_p99_seconds) == set(STAGES) - {"e2e"}
+        d = rep.as_dict()
+        assert d["e2e_p99_seconds"] == round(rep.e2e_p99_seconds, 4)
+        # solo harness: no DRR, so sched_wait must be exactly zero
+        assert rep.stage_p99_seconds["sched_wait"] == 0.0
+
+    def test_event_batch_linked_into_solvetrace_explain(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_PODTRACE", "1")
+        h = ChurnHarness(small_spec())
+        try:
+            h.run()
+            solver = h.env.provisioner.solver
+            last = h.recorder.last()
+            assert last is not None
+            eb = last.attribution.get("event_batch") or next(
+                (t.attribution.get("event_batch") for t in reversed(h.recorder.traces()) if t.attribution.get("event_batch")),
+                None,
+            )
+            assert eb is not None and eb["count"] > 0 and "oldest_age_s" in eb
+            traced = next(t for t in reversed(h.recorder.traces()) if t.attribution.get("event_batch"))
+            assert "traced watch event" in traced.explain()
+            # the ring's solve_seq values join back to recorded solve traces
+            seqs = {t.seq for t in h.recorder.traces()}
+            assert any(r.solve_seq in seqs for r in h.env.podtracer.events())
+        finally:
+            h.close()
+
+    def test_record_replay_carries_arrival_offsets(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KARPENTER_PODTRACE", "1")
+        path = tmp_path / "events.jsonl"
+        spec = small_spec(record_path=str(path))
+        h = ChurnHarness(spec)
+        try:
+            rep = h.run()
+        finally:
+            h.close()
+        ops = [json.loads(line) for line in path.read_text().splitlines()]
+        arrives = [op for op in ops if op["op"] == "arrive"]
+        assert arrives and all("t" in op for op in ops)
+        ts = [op["t"] for op in arrives]
+        assert ts == sorted(ts), "arrival offsets must be monotone"
+        # replay: same placements, and the replayed run re-measures a live
+        # e2e distribution over the same event/solve composition
+        replay = ChurnSpec.from_event_log(str(path))
+        h2 = ChurnHarness(replay)
+        try:
+            rep2 = h2.run()
+        finally:
+            h2.close()
+        assert rep2.e2e_events > 0
+        assert rep2.solves == rep.solves
+        assert rep2.dominant_stage in ("coalesce", "sched_wait", "solve")
+
+
+class TestPerfettoExport:
+    def _records(self, n=3):
+        tracer = PodTracer(enabled=True)
+        pods = []
+        for i in range(n):
+            pod = make_pod(name=f"ev-px-{i}")
+            pod.metadata.uid = f"uid-ev-px-{i}"
+            _deliver(tracer, pod)
+            tracer.on_prestaged(pod.metadata.uid)
+            pods.append(pod)
+        _complete(tracer, pods, solve_seq=9)
+        for pod in pods:
+            pod.spec.node_name = "node-1"
+            _deliver(tracer, pod, "MODIFIED")
+        return tracer.events()
+
+    def test_flow_arrows_round_trip(self):
+        recs = self._records()
+        doc = json.loads(json.dumps(events_to_trace_events(recs)))
+        events = doc["traceEvents"]
+        names = {e["args"]["name"] for e in events if e.get("ph") == "M"}
+        assert names == {"watch-delivery", "serve-loop", "prestage-worker"}
+        flows = [e for e in events if e.get("name") == "event-flow"]
+        starts = {e["id"]: e["tid"] for e in flows if e["ph"] == "s"}
+        finishes = {e["id"]: e["tid"] for e in flows if e["ph"] == "f"}
+        steps = {e["id"]: e["tid"] for e in flows if e["ph"] == "t"}
+        assert set(starts) == set(finishes) and len(starts) == len(recs)
+        # the arrow crosses threads: watch-delivery -> serve-loop, stepping
+        # through the prestage worker for staged events
+        for fid, tid in starts.items():
+            assert tid != finishes[fid]
+        assert steps and all(fid in starts for fid in steps)
+        slices = {e["name"].split(":")[0] for e in events if e.get("ph") == "X"}
+        assert {"coalesce", "solve", "prestage", "decode"} <= slices
+
+    def test_parse_event_dump_forms(self):
+        recs = [r.to_dict() for r in self._records(2)]
+        jsonl = "\n".join(json.dumps(r) for r in recs)
+        assert parse_event_dump(jsonl) == recs
+        assert parse_event_dump(json.dumps({"tenants": {"a": {"events": recs}}})) == recs
+        assert parse_event_dump(json.dumps({"events": recs})) == recs
+        assert parse_event_dump("") == []
+
+    def test_cli_exports_event_tracks(self, tmp_path, capsys):
+        from karpenter_tpu.obs.__main__ import main
+
+        src = tmp_path / "events.jsonl"
+        src.write_text("\n".join(json.dumps(r.to_dict()) for r in self._records(2)))
+        out = tmp_path / "events.trace.json"
+        assert main([str(src), "--events", "--out", str(out)]) == 0
+        doc = json.loads(out.read_text())
+        assert any(e.get("name") == "event-flow" for e in doc["traceEvents"])
+
+
+class TestOperatorSurfaces:
+    def _get(self, port, path):
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:  # 4xx still carries a body
+            return e.code, e.read().decode()
+
+    def test_debug_events_and_tenant_filter(self, monkeypatch):
+        from karpenter_tpu.operator.server import OperatorServer
+
+        monkeypatch.setenv("KARPENTER_PODTRACE", "1")
+        h = ChurnHarness(small_spec(n_base_pods=40, iterations=2))
+        try:
+            h.run()
+            server = OperatorServer(h.env, port=0)
+            port = server.start()
+            try:
+                code, body = self._get(port, "/debug/events")
+                assert code == 200
+                dump = json.loads(body)
+                assert "default" in dump["tenants"]
+                d = dump["tenants"]["default"]
+                assert d["enabled"] and d["completed"] > 0 and d["events"]
+                assert set(d["stats"]) == set(STAGES)
+                assert "slo" in d and d["slo"]["completed"] > 0
+                code, body = self._get(port, "/debug/events?n=1")
+                assert code == 200
+                assert len(json.loads(body)["tenants"]["default"]["events"]) == 1
+                code, _ = self._get(port, "/debug/events?tenant=nope")
+                assert code == 404
+                # metrics: the stage-quantile family and SLO counter render
+                code, body = self._get(port, "/metrics")
+                assert code == 200
+                assert m.SOLVER_EVENT_STAGE_QUANTILE_SECONDS in body
+            finally:
+                server.stop()
+        finally:
+            h.close()
+
+    def test_debug_solves_tenant_filter(self):
+        from karpenter_tpu.obs.trace import TraceRecorder
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.operator.server import OperatorServer
+
+        env = Environment(options=Options())
+        rec = TraceRecorder(capacity=8, enabled=True)
+        tr = rec.begin(n_pods=1)
+        tr.mode = "full"
+        rec.commit(tr)
+        register_tenant("team-a", rec, PodTracer(enabled=True, tenant="team-a"))
+        server = OperatorServer(env, port=0)
+        port = server.start()
+        try:
+            code, body = self._get(port, "/debug/solves?tenant=team-a")
+            assert code == 200
+            dump = json.loads(body)
+            assert dump["recorded"] == 1 and dump["solves"]
+            code, _ = self._get(port, "/debug/solves?tenant=ghost")
+            assert code == 404
+            code, body = self._get(port, "/debug/events?tenant=team-a")
+            assert code == 200
+            assert json.loads(body)["tenants"]["team-a"]["completed"] == 0
+        finally:
+            server.stop()
+            unregister_tenant("team-a")
+
+
+class TestThreadedFleetPodtrace:
+    def test_cross_thread_stamps_under_sanitizer(self):
+        """The wall-clock fleet loop + watch-delivery threads stamp the SAME
+        records (arrival on the delivery thread, dispatch/solve on the fleet
+        loop) with zero racecheck violations — the cross-thread contract."""
+        from test_fleet import tenant_options
+        from karpenter_tpu.serving.fleet import FleetFrontend, reset_tenant_labels
+        from karpenter_tpu.utils.clock import Clock
+
+        racecheck.reset()
+        reset_tenant_labels()
+        spec = small_spec(n_base_pods=0, batch_idle_seconds=0.05)
+        fleet = FleetFrontend(poll_floor_seconds=0.05)
+        try:
+            sess = fleet.add_tenant("live", options=tenant_options(spec), clock=Clock())
+            tracer = sess.env.podtracer
+            assert tracer.enabled and tracer.tenant == "live"
+            h = ChurnHarness(spec).attach(sess)
+            fleet.start()
+            for _ in range(10):
+                h.apply_arrivals(5)
+                time.sleep(0.03)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not tracer.events():
+                time.sleep(0.05)
+            fleet.stop()
+            recs = tracer.events()
+            assert recs, "fleet loop never completed a traced event"
+            for r in recs:
+                assert r.t_dispatch >= r.t_arrival and r.t_solved >= r.t_dispatch
+                assert r.outcome in ("placed", "bound")
+            # fleet dispatches carry the episode's wake cause per record
+            from karpenter_tpu.obs.podtrace import WAKE_CAUSES as _WC
+
+            assert any(r.wake_cause in _WC for r in recs), [r.wake_cause for r in recs]
+            # the wake split carried a bounded cause end-to-end
+            total_wakes = sum(tracer.wake_causes.values())
+            assert total_wakes > 0
+            from karpenter_tpu.obs.podtrace import WAKE_CAUSES
+
+            assert set(tracer.wake_causes) <= set(WAKE_CAUSES)
+            snap = racecheck.snapshot()
+            assert snap["violations"] == [], snap["violations"]
+        finally:
+            fleet.close()
+            racecheck.reset()
+            reset_tenant_labels()

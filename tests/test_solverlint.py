@@ -105,14 +105,17 @@ class TestRuleFixtures:
 
     def test_metric_label_cardinality(self):
         findings = _fixture_findings("metric-label-cardinality", "metric_labels.py")
-        assert len(findings) == 4, findings
+        assert len(findings) == 5, findings
         by_msg = [f.message for f in findings]
-        # the third enumerable-value finding is the fleet tenant-label leak
-        # (a raw tenant id instead of a tenant_label() producer output)
-        assert sum("not statically enumerable" in m for m in by_msg) == 3
+        # the enumerable-value findings include the fleet tenant-label leak
+        # (a raw tenant id instead of a tenant_label() producer output) and
+        # the podtrace stage-label leak (a runtime span name instead of the
+        # static STAGES enum)
+        assert sum("not statically enumerable" in m for m in by_msg) == 4
         assert sum("splat" in m for m in by_msg) == 1
         src = (FIXTURES / "metric_labels.py").read_text().splitlines()
         assert any("tenant=session.tenant_id" in src[f.line - 1] for f in findings)
+        assert any("stage=stage" in src[f.line - 1] for f in findings)
 
     def test_guarded_field_access(self):
         # a read AND a write outside the declared lock are both findings;
@@ -199,8 +202,8 @@ class TestRuleFixtures:
 
         src = (repo_root() / "karpenter_tpu" / "serving" / "prestage.py").read_text()
         unguarded = src.replace(
-            '            touch(self, "misses")\n            self.misses += 1\n        return clone',
-            "        self.misses += 1\n        return clone",
+            '            touch(self, "misses")\n            self.misses += 1\n        if self.podtracer',
+            "        self.misses += 1\n        if self.podtracer",
         )
         assert unguarded != src
         p = tmp_path / "prestage_unguarded.py"
