@@ -1249,6 +1249,209 @@ def bench_fleet_multitenant(k: int, n_base: int, iterations: int) -> dict:
     return out
 
 
+def bench_chaos_churn(k: int, n_base: int, iterations: int) -> dict:
+    """chaos_churn (BENCH_r10): the faultline acceptance matrix at bench
+    scale. K tenants multiplexed by one fleet process, one VICTIM under a
+    seeded FaultSpec covering every seam (solve exception, decode failure,
+    watch drop/dup/reorder, prestager-worker death, spot-style capacity
+    revocation) plus an unrecoverable exception burst that trips its
+    circuit breaker. Three gates:
+
+    - survive_gate: the fleet serves the full fault matrix with ZERO loop
+      deaths — every healthy tenant's breaker never opens, and the victim
+      (quarantined mid-run) ends re-admitted (state healthy, opens >= 1);
+    - p99_gate: healthy-tenant event-to-placement e2e P99 stays inside the
+      existing fleet gate (BENCH_FLEET_P99_GATE, default 250ms) — chaos in
+      one failure domain must not show up in another's latency;
+    - rewarm_gate: after the plan exhausts, the victim's recovery ladder
+      restores mode="delta" within BENCH_CHAOS_REWARM_SOLVES solves
+      (default 8) — degradation is a detour, not a new steady state."""
+    from karpenter_tpu.cloudprovider.fake import instance_types_assorted
+    from karpenter_tpu.models.scheduler_model import reset_bucket_highwater
+    from karpenter_tpu.obs.stats import quantile
+    from karpenter_tpu.operator.options import Options
+    from karpenter_tpu.serving import ChurnHarness, ChurnSpec
+    from karpenter_tpu.serving.faults import FaultRule, FaultSpec
+    from karpenter_tpu.serving.fleet import FleetFrontend, reset_tenant_labels
+
+    churn_div = float(os.environ.get("BENCH_FLEET_CHURN_DIV", "40"))
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "42"))
+    rewarm_budget = int(os.environ.get("BENCH_CHAOS_REWARM_SOLVES", "8"))
+    p99_gate = float(os.environ.get("BENCH_FLEET_P99_GATE", "0.25"))
+
+    def mkspec(**kw):
+        base = dict(
+            n_base_pods=n_base,
+            n_types=100,
+            arrivals=max(8, int(800 / churn_div)),
+            cancels=max(6, int(600 / churn_div)),
+            departures=max(8, int(800 / churn_div)),
+            iterations=iterations,
+            concurrent_seconds=0.0,
+        )
+        base.update(kw)
+        return ChurnSpec(**base)
+
+    # the victim's plan: the full randomized seam matrix scaled to this
+    # run's solve/event/cycle counts, plus an unrecoverable exception burst
+    # sized to the breaker threshold so the run exercises quarantine ->
+    # probe -> re-admission, not just the in-solver ladder. The burst leads
+    # the tuple: the injector fires the FIRST due rule per index, and a
+    # recoverable rule shadowing one burst index would break the burst's
+    # consecutive-failure streak (the ladder absorbs it, the pump succeeds,
+    # and the breaker's consecutive count resets).
+    probe_spec = mkspec()
+    events_scale = (probe_spec.arrivals + probe_spec.cancels + probe_spec.departures) * iterations
+    matrix = FaultSpec.randomized(seed=seed, solves=iterations, events=events_scale, cycles=iterations)
+    plan = FaultSpec(
+        rules=(FaultRule("solve-exception", at=max(2, iterations // 3), every=1, count=2, ladder=0),) + matrix.rules,
+        seed=seed,
+    )
+
+    reset_bucket_highwater()
+    reset_tenant_labels()
+    fleet = FleetFrontend(breaker_failures=2, breaker_backoff_seconds=0.5)
+    harnesses: dict[str, ChurnHarness] = {}
+    try:
+        for i in range(k):
+            tid = "victim" if i == k - 1 else f"tenant-{i}"
+            # the victim runs a LIVE prestager worker so the injected
+            # prestage-death kills (and the supervisor restarts) a real
+            # thread; its fault plan installs only AFTER warmup, so the
+            # plan's solve/event indices are measured from the chaos window
+            tspec = mkspec(worker=True) if tid == "victim" else mkspec()
+            sess = fleet.add_tenant(
+                tid,
+                options=Options(
+                    solver_backend="tpu",
+                    batch_idle_duration=tspec.batch_idle_seconds,
+                    batch_max_duration=10.0,
+                ),
+                instance_types=instance_types_assorted(tspec.n_types),
+                worker=tspec.worker,
+            )
+            h = ChurnHarness(tspec).attach(sess, fleet=fleet)
+            harnesses[tid] = h
+            # fleet_multitenant's warmup discipline: provision, free
+            # headroom, one oversized bounding pass, one normal cycle — the
+            # chaos window must measure faults, not cold compiles
+            h.provision_base_fleet()
+            h.apply_departures(int((tspec.arrivals - tspec.cancels) * tspec.bind_every * 3))
+            h.bind_flush()
+            h.apply_arrivals(int(tspec.arrivals * 1.3) + 32)
+            h.apply_cancels(int(tspec.cancels * 1.5) + 32)
+            h.solve(force=True)
+            h.apply_departures(int(tspec.departures * 1.3) + 32)
+            h.bind_flush()
+            h.apply_arrivals(tspec.arrivals)
+            h.apply_cancels(tspec.cancels)
+            h.solve()
+            h.apply_departures(tspec.departures)
+            h.bind_flush()
+        healthy = [t for t in harnesses if t != "victim"]
+        hv = harnesses["victim"]
+
+        def one_cycle(measured: bool = True):
+            for h in harnesses.values():
+                h.apply_arrivals(h.spec.arrivals)
+                h.apply_cancels(h.spec.cancels)
+                h.env.clock.step(h.spec.batch_idle_seconds + 0.05)
+            fleet.rearm_ready()
+            fleet.pump()  # the survival property: must never raise
+            for h in harnesses.values():
+                h.apply_departures(h.spec.departures)
+                if measured and h.injector is not None:
+                    h.apply_revocations(h.injector.take_revocations())
+                h.bind_flush()
+
+        # one unmeasured fault-free cycle: the steady round COMPOSITION's
+        # one-time compiles land before the chaos marks
+        one_cycle(measured=False)
+        # arm the victim: from here every seam counts from index 0
+        hv.spec.faults = plan
+        hv._install_faults()
+        emarks = {tid: harnesses[tid]._etracer_mark()[0] for tid in healthy}
+        rmarks = {tid: harnesses[tid].recorder.seq for tid in healthy}
+        # -- the chaos phase: every cycle churns every tenant, one DRR pump
+        # serves the fleet, and the victim's plan fires where it fires ------
+        t0 = time.perf_counter()
+        for _cycle in range(iterations):
+            one_cycle()
+        wall = time.perf_counter() - t0
+        # -- healthy-tenant latency over the chaos window (captured BEFORE
+        # the settle phase steps the shared deterministic clocks) -----------
+        per_tenant = {}
+        worst_e2e_p99 = 0.0
+        for tid in healthy:
+            h = harnesses[tid]
+            traces = [t for t in h.recorder.traces() if t.seq > rmarks[tid] and t.mode not in ("", "consolidate")]
+            durs = sorted(t.duration for t in traces)
+            row = {
+                "solves": len(traces),
+                "p99_solve_seconds": round(quantile(durs, 0.99, assume_sorted=True), 4) if durs else 0.0,
+            }
+            tracer = h._etracer()
+            if tracer is not None:
+                e2e = sorted(r.stage_view()["e2e"] for r in tracer.events_since(emarks[tid]))
+                if e2e:
+                    row["e2e_p99_seconds"] = round(quantile(e2e, 0.99, assume_sorted=True), 4)
+                    worst_e2e_p99 = max(worst_e2e_p99, row["e2e_p99_seconds"])
+            per_tenant[tid] = row
+        # settle: quarantine may have deferred victim work past its windows
+        for _ in range(8):
+            for h in harnesses.values():
+                h.env.clock.step(1.0)
+            fleet.pump(force=True)
+            for h in harnesses.values():
+                h.bind_flush()
+        surf = fleet.debug_tenants()
+        for tid in healthy:
+            per_tenant[tid]["breaker_opens"] = surf[tid]["opens"]
+        # -- rewarm: solves until the victim classifies delta again ---------
+        solver = hv.env.provisioner.solver
+        rewarm_solves = 0
+        victim_mode = ""
+        for _ in range(rewarm_budget):
+            hv.apply_arrivals(4)
+            hv.env.clock.step(hv.spec.batch_idle_seconds + 0.05)
+            if fleet.pump(only="victim"):
+                rewarm_solves += 1
+                victim_mode = solver.last_solve_mode
+                if victim_mode == "delta":
+                    break
+        healthy_opens = sum(surf[tid]["opens"] for tid in healthy)
+        survived = (
+            healthy_opens == 0
+            and surf["victim"]["opens"] >= 1
+            and surf["victim"]["state"] == "healthy"
+        )
+        out = {
+            "tenants": k,
+            "n_base_per_tenant": n_base,
+            "chaos_wall_seconds": round(wall, 3),
+            "fault_plan": plan.to_dict(),
+            "faults_injected": hv.injector.summary(),
+            "recoveries": hv._recovery_counts(),
+            "prestage_worker_restarts": hv.loop.prestager.restarts if hv.loop is not None and hv.loop.prestager is not None else 0,
+            "victim": {k2: surf["victim"][k2] for k2 in ("state", "opens", "probes", "last_error")},
+            "per_tenant": per_tenant,
+            "worst_healthy_e2e_p99_seconds": worst_e2e_p99,
+            "rewarm_solves": rewarm_solves,
+            "rewarm_mode": victim_mode,
+            "survive_gate": "PASS" if survived else "FAIL",
+            "p99_gate": "PASS" if worst_e2e_p99 < p99_gate else "FAIL",
+            "rewarm_gate": "PASS" if victim_mode == "delta" and rewarm_solves <= rewarm_budget else "FAIL",
+        }
+    finally:
+        fleet.close()
+        reset_bucket_highwater()
+        reset_tenant_labels()
+    for name in ("survive_gate", "p99_gate", "rewarm_gate"):
+        if out[name] == "FAIL":
+            print(f"CHAOS {name.upper()} FAILED: {out}", file=sys.stderr)
+    return out
+
+
 def bench_fleet_compile_cache(n_pods: int = 800, n_types: int = 20) -> dict:
     """The persistent-compile-cache warm-restart micro-gate: two fresh
     PROCESSES run the same cold solve with KARPENTER_SOLVER_COMPILE_CACHE
@@ -1670,6 +1873,10 @@ def main():
         # fleet_multitenant smoke: K=4 tenants at ~1/160 scale each
         os.environ.setdefault("BENCH_FLEET_PODS", "300")
         os.environ.setdefault("BENCH_FLEET_ITER", "32")
+        # chaos_churn smoke: the same K=4 shape, shorter chaos window (the
+        # fault plan scales itself to the solve/event counts)
+        os.environ.setdefault("BENCH_CHAOS_PODS", "300")
+        os.environ.setdefault("BENCH_CHAOS_ITER", "12")
         os.environ.setdefault("BENCH_COMPILE_CACHE_PODS", "500")
         os.environ.setdefault("BENCH_DEADLINE_SECONDS", "1800")
         _RESULT["extra"]["smoke"] = True
@@ -1788,6 +1995,24 @@ def main():
         ):
             extra[f"fleet_{key}"] = fl[key]
         extra["fleet_per_tenant"] = fl["per_tenant"]
+    # chaos_churn (BENCH_r10): the faultline acceptance matrix — K tenants,
+    # one under a seeded revocation+exception fault plan; gates: the fleet
+    # survives the full matrix (zero loop deaths, healthy breakers never
+    # open, the quarantined victim is re-admitted), healthy-tenant e2e P99
+    # inside the fleet gate, and the recovery ladder restores mode="delta"
+    # within the rewarm budget
+    n_chaos_base = int(os.environ.get("BENCH_CHAOS_PODS", os.environ.get("BENCH_FLEET_PODS", "1250")))
+    chaos_iters = int(os.environ.get("BENCH_CHAOS_ITER", "24"))
+    cz = _run_scenario("chaos_churn", bench_chaos_churn, n_fleet_tenants, n_chaos_base, chaos_iters)
+    if cz is not None:
+        for key in (
+            "tenants", "n_base_per_tenant", "chaos_wall_seconds", "faults_injected",
+            "recoveries", "prestage_worker_restarts", "victim",
+            "worst_healthy_e2e_p99_seconds", "rewarm_solves", "rewarm_mode",
+            "survive_gate", "p99_gate", "rewarm_gate",
+        ):
+            extra[f"chaos_{key}"] = cz[key]
+        extra["chaos_per_tenant"] = cz["per_tenant"]
     # compile-cache warm restart: a second process's cold solve rides the
     # persistent executable cache instead of recompiling
     cc = _run_scenario(

@@ -38,7 +38,7 @@ def main(argv=None) -> int:
                 return 2
             print(f"solverlint self-test: {len(RULES)} rules healthy ({time.perf_counter() - t0:.2f}s)")
             return 0
-        if len(RULES) < 9:
+        if len(RULES) < 10:
             print(f"solverlint: rule registry shrank to {len(RULES)} rules", file=sys.stderr)
             return 2
         for p in args.paths:

@@ -58,8 +58,11 @@ class Config:
     # (serving.fleet.tenant_label collapses past-the-cap registrations to
     # "overflow"); "cause" is the fleet wake-attribution enum
     # (obs.podtrace.WAKE_CAUSES) and "stage" the podtrace event-lifecycle
-    # stage enum (obs.podtrace.STAGES) — all held to the same bound
-    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer", "event", "lock", "tenant", "cause", "stage")
+    # stage enum (obs.podtrace.STAGES); "state" is faultline's breaker-state
+    # enum (serving.faults.TENANT_STATES — stage also covers the recovery
+    # ladder's RECOVERY_STAGES) and "seam" its FAULT_SEAMS injection enum —
+    # all held to the same bound
+    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer", "event", "lock", "tenant", "cause", "stage", "state", "seam")
     # callees whose return value is enum-bounded by construction
     # (tenant_label caps distinct outputs at serving.fleet.TENANT_LABEL_CAP)
     bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family", "tenant_label")
@@ -144,6 +147,25 @@ class Config:
     )
     # the human-readable thread-and-lock inventory lock-order findings point at
     thread_inventory_doc: str = "karpenter_tpu/serving/__init__.py"
+    # -- swallowed-exception (faultline) ---------------------------------------
+    # modules the swallowed-exception rule scans: a bare `except Exception:`
+    # (or broader) handler must re-raise or RECORD (an events publish / a
+    # metrics emission) — a serving stack only degrades gracefully if every
+    # absorbed failure leaves a signal. Suppression needs a justified pragma.
+    exception_modules: tuple[str, ...] = ("karpenter_tpu/**/*.py",)
+    # callee patterns (fnmatch over the dotted callee and its tail) that
+    # count as RECORDING the failure inside the handler body
+    exception_recorders: tuple[str, ...] = (
+        "*.publish",  # events.Recorder
+        "*.inc",
+        "*.observe",
+        "*.record_failure",
+        "*._count",
+        "*._observe",
+        "*.warning",
+        "*.error",
+        "*.exception",
+    )
     # direct override for tests/self-test; when None the registry file is
     # parsed on first use
     shared_fields: frozenset | None = None

@@ -206,8 +206,8 @@ def run_self_test(config: Config | None = None) -> list[str]:
     from .rules import RULES
 
     failures: list[str] = []
-    if len(RULES) < 9:
-        failures.append(f"rule registry shrank to {len(RULES)} rules (expected >= 9)")
+    if len(RULES) < 10:
+        failures.append(f"rule registry shrank to {len(RULES)} rules (expected >= 10)")
     for name, cls in RULES.items():
         overrides = {"shared_fields": cls.SELF_TEST_SHARED_FIELDS, **cls.SELF_TEST_CONFIG}
         cfg = dataclasses.replace(config or Config(), **overrides)

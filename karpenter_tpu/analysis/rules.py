@@ -49,6 +49,15 @@ threaded serving stack (`thread_modules`):
                              wrapper is what lets the runtime sanitizer
                              instrument every acquisition.
 
+faultline's static arm (ISSUE 15):
+
+10. swallowed-exception      broad `except Exception:` handlers that
+                             neither re-raise nor record (an events publish
+                             or metrics emission) — a serving stack only
+                             degrades gracefully when every absorbed
+                             failure leaves a signal; deliberate swallows
+                             carry a justified pragma.
+
 Every rule ships SELF_TEST_BAD/SELF_TEST_OK snippets; `--self-test` proves
 each rule still detects its seeded violation and that the pragma suppresses
 it, so the gate fails loudly if rule discovery breaks.
@@ -431,20 +440,20 @@ class MetricLabelCardinalityRule(Rule):
     description = "bounded metric labels must carry statically enumerable values"
     _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
 
-    # the seeded violation is a podtrace stage-label one: the event-stage
-    # quantile gauge's `stage` label fed a runtime-computed span name instead
-    # of iterating the static obs.podtrace.STAGES tuple — exactly the
-    # cardinality leak the event-lifecycle recorder must never regress into
-    # (arbitrary stage strings would mint one series per ad-hoc span)
+    # the seeded violation is a faultline breaker-state one: the breaker
+    # transitions counter's `state` label fed a runtime breaker attribute
+    # instead of a literal from the static serving.faults.TENANT_STATES
+    # enum (and the tenant label a raw id instead of a tenant_label()
+    # output) — exactly the cardinality leak the failure-domain metrics
+    # must never regress into
     SELF_TEST_BAD = (
-        "def publish(registry, rec):\n"
-        "    for stage, dur in rec.stamps.items():\n"
-        '        registry.histogram("karpenter_solver_event_stage_seconds").observe(dur, stage=stage)\n'
+        "def publish(registry, breaker):\n"
+        '    registry.counter("karpenter_solver_breaker_transitions_total").inc(tenant=breaker.tenant_id, state=breaker.state)\n'
     )
     SELF_TEST_OK = (
-        "def publish(registry, rec):\n"
-        '    for stage in ("coalesce", "sched_wait", "prestage", "solve", "decode", "e2e"):\n'
-        '        registry.histogram("karpenter_solver_event_stage_seconds").observe(rec.stages[stage], stage=stage)\n'
+        "def publish(registry, breaker):\n"
+        '    state = "quarantined" if breaker.open else "healthy"\n'
+        '    registry.counter("karpenter_solver_breaker_transitions_total").inc(tenant=tenant_label(breaker.tenant_id), state=state)\n'
     )
 
     def __init__(self):
@@ -1137,6 +1146,96 @@ class BareThreadPrimitiveRule(Rule):
         return findings
 
 
+class SwallowedExceptionRule(Rule):
+    name = "swallowed-exception"
+    description = "broad except handler that neither re-raises nor records the failure"
+
+    # a serving stack only degrades gracefully when every absorbed failure
+    # leaves a signal: a bare `except Exception: pass` is an invisible
+    # failure domain. Handlers must re-raise, narrow the except to the
+    # expected exception types, call a recorder (events publish / metrics
+    # emission — config `exception_recorders`), or carry a justified pragma.
+    SELF_TEST_BAD = (
+        "def reconcile(store, nc):\n"
+        "    try:\n"
+        "        store.update(nc)\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    SELF_TEST_OK = (
+        "def reconcile(store, nc, recorder, registry):\n"
+        "    try:\n"
+        "        store.update(nc)\n"
+        "    except Exception as e:\n"
+        '        recorder.publish(nc, "ReconcileError", str(e), type_="Warning")\n'
+        "    try:\n"
+        "        store.update(nc)\n"
+        "    except Exception:\n"
+        "        registry.inc()\n"
+        "    try:\n"
+        "        store.update(nc)\n"
+        "    except Exception:\n"
+        "        raise\n"
+        "    try:\n"
+        "        store.update(nc)\n"
+        "    except ValueError:\n"
+        "        pass\n"
+        "    try:\n"
+        "        store.update(nc)\n"
+        "    except Exception:  # solverlint: ok(swallowed-exception): self-test snippet — proves the pragma form suppresses\n"
+        "        pass\n"
+    )
+
+    _BROAD = frozenset({"Exception", "BaseException"})
+
+    def globs(self, config):
+        return config.exception_modules
+
+    def check(self, mod, config, root):
+        findings: list[Finding] = []
+        for n in ast.walk(mod.tree):
+            if not isinstance(n, ast.ExceptHandler):
+                continue
+            if n.type is not None:
+                # `except (Exception, OSError):` is as broad as the unparenthesized
+                # form — check every element of a tuple handler, not just the
+                # single-name case (dotted_name returns "" for ast.Tuple)
+                types = n.type.elts if isinstance(n.type, ast.Tuple) else (n.type,)
+                if not any(dotted_name(t).rsplit(".", 1)[-1] in self._BROAD for t in types):
+                    continue
+            if any(isinstance(sub, ast.Raise) for stmt in n.body for sub in ast.walk(stmt)):
+                continue
+            def records(call: ast.Call) -> bool:
+                # callee_matches resolves Name/Attribute chains; a CHAINED
+                # call like registry.counter("m").inc(...) has a Call base,
+                # so also match the bare method tail against the patterns
+                if callee_matches(call.func, config.exception_recorders):
+                    return True
+                if isinstance(call.func, ast.Attribute):
+                    from fnmatch import fnmatch
+
+                    return any(fnmatch(f"x.{call.func.attr}", p) for p in config.exception_recorders)
+                return False
+
+            if any(
+                isinstance(sub, ast.Call) and records(sub)
+                for stmt in n.body
+                for sub in ast.walk(stmt)
+            ):
+                continue
+            caught = ", ".join(dotted_name(t) for t in types) if n.type is not None else "<bare except>"
+            findings.append(
+                Finding(
+                    self.name,
+                    mod.relpath,
+                    n.lineno,
+                    f"broad `except {caught}` handler neither re-raises nor records — narrow it, emit an "
+                    f"event/metric, or justify with a pragma (silent failures defeat the degradation ladder)",
+                )
+            )
+        return findings
+
+
 RULES: dict[str, type[Rule]] = {
     cls.name: cls
     for cls in (
@@ -1149,5 +1248,6 @@ RULES: dict[str, type[Rule]] = {
         LockOrderRule,
         ThreadEscapeRule,
         BareThreadPrimitiveRule,
+        SwallowedExceptionRule,
     )
 }

@@ -53,8 +53,12 @@ class OrchestrationQueue:
 
                 try:
                     self.store.patch("NodeClaim", c.node_claim.metadata.name, mark)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # losing the DisruptionReason condition is benign (the
+                    # claim may have been deleted out from under the command)
+                    # but never silent: the event stream records it
+                    if self.recorder is not None:
+                        self.recorder.publish(c.node_claim, "DisruptionQueueError", f"marking Disrupted failed: {e}", type_="Warning")
         self.cluster.mark_for_deletion([c.state_node.provider_id() for c in command.candidates])
 
         item = _Item(command=command)
@@ -112,8 +116,11 @@ class OrchestrationQueue:
 
                 try:
                     self.store.patch("NodeClaim", c.node_claim.metadata.name, clear)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # same contract as start_command's mark: benign (claim
+                    # may be concurrently deleted), but recorded, not silent
+                    if self.recorder is not None:
+                        self.recorder.publish(c.node_claim, "DisruptionQueueError", f"clearing DisruptionReason failed: {e}", type_="Warning")
         self.cluster.unmark_for_deletion([c.state_node.provider_id() for c in command.candidates])
         for name in created or []:
             self.store.try_delete("NodeClaim", name)

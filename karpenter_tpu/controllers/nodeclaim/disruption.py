@@ -9,6 +9,7 @@ import math
 
 from ...apis import labels as wk
 from ...apis.nodeclaim import COND_CONSOLIDATABLE, COND_DRIFTED, COND_INITIALIZED
+from ...kube.store import Conflict, NotFound
 from ...scheduling.requirements import Requirements
 
 
@@ -35,7 +36,11 @@ class NodeClaimDisruptionController:
                 try:
                     self.store.update(nc)
                     self.cluster.update_node_claim(nc)
-                except Exception:
+                except (Conflict, NotFound):
+                    # a concurrent writer won (or the claim vanished): the
+                    # next reconcile recomputes the conditions from fresh
+                    # state — only the EXPECTED optimistic-concurrency
+                    # failures are absorbed, anything else propagates
                     pass
 
     def _consolidatable(self, nc, pool) -> bool:

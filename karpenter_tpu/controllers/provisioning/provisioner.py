@@ -56,6 +56,11 @@ class Provisioner:
         # traced pod in the batch and links the batch summary into the
         # SolveTrace. None = untraced provisioner (direct-wired tests).
         self.podtracer = None
+        # watch-loss convergence (faultline): the store's Pod loss epoch
+        # seen at the last reconcile. A bump means the delivered stream
+        # lost events the Cluster mirror never saw — re-converge it from
+        # store content before the next solve reads cluster state.
+        self._watch_loss_seen = store.watch_loss_epoch("Pod") if hasattr(store, "watch_loss_epoch") else 0
 
     # -- triggering (provisioning/controller.go) -------------------------------
     def trigger(self, uid: str = "") -> None:
@@ -72,6 +77,18 @@ class Provisioner:
             return None
         if not self.cluster.synced():
             return None
+        # store content is authoritative: if the watch stream lost Pod
+        # events since the last pass (faultline watch-drop, or any real
+        # lossy transport), the event-fed Cluster mirror is stale —
+        # re-converge it BEFORE the solve reads node usage/bindings
+        loss = self.store.watch_loss_epoch("Pod") if hasattr(self.store, "watch_loss_epoch") else 0
+        if loss != self._watch_loss_seen:
+            self._watch_loss_seen = loss
+            self.cluster.resync_pods()
+            if self.metrics is not None:
+                from ... import metrics as m
+
+                self.metrics.counter(m.SOLVER_WATCH_RESYNC_TOTAL).inc()
         # one atomic handoff: close the generation and open the in-flight
         # window together, so a concurrent trigger can never fall between
         events = self.batcher.take_generation()

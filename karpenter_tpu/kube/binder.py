@@ -71,6 +71,19 @@ class Binder:
         for q in all_pods:
             if self._nn(q) and pod_utils.is_active(q):
                 self._port_usage.setdefault(self._nn(q), HostPortUsage()).add(q.key(), pod_host_ports(q))
+        # store-content authority for node usage (faultline watch-loss
+        # robustness): the cluster's per-node usage is event-fed, so a lossy
+        # watch stream (dropped bind echo, dropped departure DELETED) leaves
+        # it stale mid-pass. Track the pods ACTUALLY bound and non-terminal
+        # per store content — the same population Cluster.update_pod counts —
+        # keyed by node, so _available() can diff-correct sn.available().
+        # When the two views agree (the lossless in-process default) the key
+        # sets match and the correction is an exact no-op.
+        self._node_pods = {}
+        for q in all_pods:
+            nn = self._nn(q)
+            if nn and not pod_utils.is_terminal(q):
+                self._node_pods.setdefault(nn, {})[q.key()] = q
         self._dra_allocator = None  # fresh per pass
         self._node_domain = {n.metadata.name: n.metadata.labels for n in nodes}
         # symmetric anti-affinity (the kube-scheduler's InterPodAffinity
@@ -93,6 +106,7 @@ class Binder:
                 # for spread/affinity counting without touching the borrowed
                 # stored object
                 self._bound_now[pod.key()] = node.metadata.name
+                self._node_pods.setdefault(node.metadata.name, {})[pod.key()] = pod
                 self._port_usage.setdefault(node.metadata.name, HostPortUsage()).add(pod.key(), pod_host_ports(pod))
                 if pod.spec.affinity is not None:
                     for term in pod.spec.affinity.pod_anti_affinity_required:
@@ -200,7 +214,7 @@ class Binder:
             if node_reqs_cache[node.metadata.name].compatible(reqs) is not None:
                 continue
             sn = self.cluster.node_for_name(node.metadata.name)
-            available = sn.available() if sn is not None else node.status.allocatable
+            available = self._available(node, sn)
             if not res.fits(requests, available):
                 continue
             if not self._topology_ok(pod, node, nodes, all_pods, aff_ctx):
@@ -211,6 +225,33 @@ class Binder:
                 continue
             return node
         return None
+
+    def _available(self, node, sn) -> dict:
+        """The node's available resources with the store as the authority:
+        start from the cluster's event-fed `sn.available()` and correct it
+        for any divergence between the pods the cluster TRACKS on the node
+        and the pods the store actually has bound there (including binds
+        made earlier in this pass). A lossy watch stream is the only way
+        the two differ — when they agree this returns sn.available()
+        untouched, so no-fault placements are bit-identical by
+        construction."""
+        if sn is None:
+            return node.status.allocatable
+        available = sn.available()
+        view = self._node_pods.get(node.metadata.name, {})
+        tracked = sn.pod_requests
+        if view.keys() != tracked.keys():
+            # missed bind/create echoes: the store knows the pod is here,
+            # the cluster never saw the event — its requests are in use
+            for key, q in view.items():
+                if key not in tracked:
+                    available = res.subtract(available, res.pod_requests(q))
+            # missed departure DELETEDs: the cluster still charges a pod
+            # the store no longer has — give its recorded requests back
+            ghosts = [tracked[key] for key in tracked if key not in view]
+            if ghosts:
+                available = res.merge(available, *ghosts)
+        return available
 
     def _ports_ok(self, pod, node) -> bool:
         """The kube-scheduler NodePorts plugin: a pod with host ports cannot

@@ -81,6 +81,6 @@ def _make_cloner(t):
     try:
         probe = t.__new__(t)
         probe.__dict__  # noqa: B018 — instances must carry a plain __dict__
-    except Exception:
+    except Exception:  # solverlint: ok(swallowed-exception): capability probe — classes without a plain __dict__ route to the stdlib deepcopy fallback
         return _copy.deepcopy
     return _clone_instance

@@ -68,8 +68,13 @@ class Store:
         "_watchers": "_lock",
         "_rv": "_lock",
         "_kind_rv": "_lock",
+        "_kind_seq": "_lock",
         "_pending": "_lock",
         "_event_tracer": "_lock",
+        "_fault_injector": "_lock",
+        "_watch_loss": "_lock",
+        "_watch_gap": "_deliver_lock",
+        "_watch_base": "_deliver_lock",
     }
 
     def __init__(self, clock=None):
@@ -82,17 +87,36 @@ class Store:
         # stamped with a monotonic commit time) and drained FIFO under
         # self._deliver_lock, so watchers always observe ADDED < MODIFIED <
         # DELETED in resourceVersion order even with concurrent writers.
-        self._pending: list[tuple[str, object, float]] = []
+        self._pending: list[tuple[str, object, float, int]] = []
         self._deliver_lock = make_rlock("store-deliver")
         # podtrace (obs/podtrace.py): the event-lifecycle tracer's arrival
         # seam — every delivered event is stamped with its commit + delivery
         # monotonic times before the watchers run. None = untraced store.
         self._event_tracer = None
+        # faultline (serving/faults.py): the watch-stream fault seam — a
+        # FaultInjector may drop, duplicate, or reorder Pod deliveries to
+        # prove the serving stack treats the stream as at-least-once and
+        # unordered (the store CONTENT stays authoritative). None = the
+        # production default: zero-cost, delivery untouched.
+        self._fault_injector = None
         # per-kind revision: the rv of the last write touching the kind.
         # Caches that depend on one kind's content (e.g. the solver's volume
         # fold on StorageClass/PV/PVC) key on this instead of the global rv,
         # so unrelated writes don't invalidate them.
         self._kind_rv: dict[str, int] = {}
+        # watch-loss detection (faultline): every committed event carries a
+        # per-kind delivery SEQUENCE number, and with a fault injector
+        # installed the drain observes the delivered seqs like a real
+        # informer observes resourceVersions — a gap that survives to
+        # queue-quiet (dup and reorder resolve themselves; only a drop
+        # cannot) bumps the kind's loss epoch, which level-triggered
+        # consumers (Provisioner -> Cluster.resync_pods) poll to re-converge
+        # on store content. With no injector the in-process seam is lossless
+        # by construction and the tracker stays empty (zero hot-path cost).
+        self._kind_seq: dict[str, int] = {}
+        self._watch_loss: dict[str, int] = {}  # kind -> cumulative lost-event count
+        self._watch_gap: dict[str, list] = {}  # kind -> [watermark, out-of-order seq set]
+        self._watch_base: dict[str, int] = {}  # kind -> seq watermark at injector install
 
     def kind_revision(self, kind: str) -> int:
         with self._lock:
@@ -133,37 +157,145 @@ class Store:
         with self._lock:
             return self._event_tracer
 
+    def set_fault_injector(self, injector) -> None:
+        """Install (or clear) a faultline FaultInjector on the delivery seam
+        (serving/faults.py: watch-drop / watch-dup / watch-reorder). Taking
+        `_deliver_lock` first (the sanctioned order) means no drain is
+        mid-flight during the swap, and the gap tracker's baseline is the
+        exact seq watermark the lossy stream starts after."""
+        with self._deliver_lock:
+            with self._lock:
+                self._fault_injector = injector
+                self._watch_base = dict(self._kind_seq)
+                self._watch_gap = {}
+
+    def watch_loss_epoch(self, kind: str) -> int:
+        """Cumulative count of watch events detected LOST for `kind` (never
+        delivered; duplicates and reorders self-heal and don't count). A
+        consumer that mirrors watch events into derived state compares this
+        across polls and re-converges from store content on change — the
+        level-triggered 'store content is authoritative' contract."""
+        with self._lock:
+            return self._watch_loss.get(kind, 0)
+
     def _enqueue(self, event: str, obj) -> None:  # solverlint: ok(guarded-field-access): caller-holds contract — every call site sits inside `with self._lock` (create/update/delete)
         # caller must hold self._lock; the stamp is the event's COMMIT time —
-        # podtrace measures queueing delay from commit, not from drain
-        self._pending.append((event, obj, time.monotonic()))
+        # podtrace measures queueing delay from commit, not from drain — and
+        # the per-kind seq is the delivery sequence the gap tracker audits
+        seq = self._kind_seq.get(obj.kind, 0) + 1
+        self._kind_seq[obj.kind] = seq
+        self._pending.append((event, obj, time.monotonic(), seq))
 
     def _drain(self) -> None:
         with self._deliver_lock:
             while True:
                 with self._lock:
-                    if not self._pending:
-                        return
-                    event, obj, t_commit = self._pending.pop(0)
-                    watchers = list(self._watchers.get(obj.kind, ()))
+                    if self._pending:
+                        event, obj, t_commit, seq = self._pending.pop(0)
+                        watchers = list(self._watchers.get(obj.kind, ()))
+                    else:
+                        event, obj, t_commit, seq, watchers = "", None, 0.0, 0, ()
                     tracer = self._event_tracer
-                if tracer is not None and obj.kind == "Pod":
-                    # arrival stamp BEFORE the watcher fan-out (and even with
-                    # no watchers registered): the tracer only reads scalar
-                    # fields off the stored object — the borrow contract.
-                    # Kind-gated HERE so non-pod deliveries pay nothing.
-                    tracer.on_delivery(event, obj, t_commit, time.monotonic())
-                if not watchers:
+                    injector = self._fault_injector
+                if obj is None:
+                    if injector is None:
+                        return
+                    # a reorder fault may have deferred the LAST event of a
+                    # burst: flush it now so reordering delays delivery but
+                    # can never lose it. The flush is DIRECT — it must not
+                    # re-enter the fault matrix, where a due drop rule would
+                    # lose the event (and a re-roll would consume a watch
+                    # index, shifting every later rule vs the recorded plan)
+                    deferred = injector.take_deferred()
+                    if deferred is None:
+                        # queue AND deferral quiet: any seq still outstanding
+                        # in the gap tracker was dropped, never reordered —
+                        # publish the loss so level-triggered consumers can
+                        # re-converge on store content
+                        self._note_watch_loss()
+                        return
+                    event, obj, t_commit, seq = deferred
+                    with self._lock:
+                        watchers = list(self._watchers.get(obj.kind, ()))
+                    deliveries = ((event, obj, t_commit, seq),)
+                elif injector is not None and obj.kind == "Pod":
+                    # faultline watch-stream seam: drop / duplicate / reorder
+                    # (all deliveries share obj's kind, so `watchers` holds).
+                    # Materialize the gap-tracker entry at INTAKE: if this
+                    # very event is dropped, _note_watch_loss must still see
+                    # the kind to compare its watermark against the
+                    # committed seq (the tail-drop case)
+                    self._gap_entry(obj.kind)
+                    deliveries = injector.on_watch_event(event, obj, t_commit, seq)
+                else:
+                    deliveries = ((event, obj, t_commit, seq),)
+                for event, obj, t_commit, seq in deliveries:
+                    if injector is not None:
+                        self._observe_delivery(obj.kind, seq)
+                    if tracer is not None and obj.kind == "Pod":
+                        # arrival stamp BEFORE the watcher fan-out (and even
+                        # with no watchers registered): the tracer only reads
+                        # scalar fields off the stored object — the borrow
+                        # contract. Kind-gated HERE so non-pod deliveries pay
+                        # nothing.
+                        tracer.on_delivery(event, obj, t_commit, time.monotonic())
+                    if not watchers:
+                        continue
+                    # ONE clone shared by every watcher: watchers may read
+                    # and retain it (the stored object is replaced on update,
+                    # never mutated, and so is this snapshot) but MUST NOT
+                    # mutate — the same contract as borrow_list. Under churn
+                    # the per-watcher private clones were the dominant
+                    # per-event cost (5 pod watchers -> 5 deep clones per
+                    # arrival).
+                    c = fast_deepcopy(obj)
+                    for fn in watchers:
+                        fn(event, c)
+
+    def _gap_entry(self, kind: str) -> list:  # solverlint: ok(guarded-field-access): caller-holds contract — only called from _drain/_observe_delivery, inside `with self._deliver_lock`
+        ent = self._watch_gap.get(kind)
+        if ent is None:
+            ent = self._watch_gap[kind] = [self._watch_base.get(kind, 0), set()]
+        return ent
+
+    def _observe_delivery(self, kind: str, seq: int) -> None:  # solverlint: ok(guarded-field-access): caller-holds contract — only called from _drain, inside `with self._deliver_lock`
+        # the informer-side audit of the (possibly lossy) delivered stream:
+        # contiguous seqs advance the watermark, out-of-order seqs park in
+        # the pending set until their gap fills, and seqs at-or-below the
+        # watermark are at-least-once duplicates (ignored)
+        ent = self._gap_entry(kind)
+        if seq == ent[0] + 1:
+            ent[0] = seq
+            pending = ent[1]
+            while ent[0] + 1 in pending:
+                pending.discard(ent[0] + 1)
+                ent[0] += 1
+        elif seq > ent[0] + 1:
+            ent[1].add(seq)
+
+    def _note_watch_loss(self) -> None:  # solverlint: ok(guarded-field-access): caller-holds contract — only called from _drain, inside `with self._deliver_lock` (takes `_lock` itself for the committed-seq read + epoch bump)
+        # at queue-quiet every reorder has flushed, so any committed seq the
+        # tracker never saw delivered was DROPPED — both mid-burst gaps
+        # (seqs below max(pending)) and TAIL drops (watermark short of the
+        # committed _kind_seq with nothing pending behind it). Count them
+        # and adopt the new watermark so one drop is published exactly once.
+        with self._lock:
+            # a writer may have committed a new event between the drain's
+            # empty-queue check and here; its delivery is still coming, so
+            # only trust the committed seq as "should have arrived" when
+            # the queue is still empty NOW
+            committed = dict(self._kind_seq) if not self._pending else {}
+            for kind, ent in self._watch_gap.items():
+                pending = ent[1]
+                top = max(pending) if pending else ent[0]
+                top = max(top, committed.get(kind, 0))
+                if top <= ent[0] and not pending:
                     continue
-                # ONE clone shared by every watcher: watchers may read and
-                # retain it (the stored object is replaced on update, never
-                # mutated, and so is this snapshot) but MUST NOT mutate —
-                # the same contract as borrow_list. Under churn the
-                # per-watcher private clones were the dominant per-event
-                # cost (5 pod watchers -> 5 deep clones per arrival).
-                c = fast_deepcopy(obj)
-                for fn in watchers:
-                    fn(event, c)
+                lost = top - ent[0] - len(pending)
+                ent[0] = top
+                pending.clear()
+                if lost > 0:
+                    self._watch_loss[kind] = self._watch_loss.get(kind, 0) + lost
 
     # -- CRUD ------------------------------------------------------------------
     def create(self, obj, adopt: bool = False):

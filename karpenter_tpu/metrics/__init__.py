@@ -118,6 +118,20 @@ SOLVER_FLEET_SCHED_WAIT_BUCKETS = (0.000_1, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25,
 # named serving-stack lock, emitted by the instrumented wrapper under
 # KARPENTER_SOLVER_RACECHECK=1. `lock` is the static make_lock call-site enum.
 SOLVER_LOCK_WAIT_SECONDS = "karpenter_solver_lock_wait_seconds"
+# faultline (serving/faults.py + the recovery layer): failure-domain
+# isolation and graceful degradation. `state` is the bounded
+# faults.TENANT_STATES enum (healthy | quarantined | probing), `stage` the
+# solver.tpu.RECOVERY_STAGES ladder enum (full-reencode | host-ffd), `seam`
+# the faults.FAULT_SEAMS injection enum — all closed tuples.
+SOLVER_TENANT_STATE = "karpenter_solver_tenant_state"
+SOLVER_BREAKER_TRANSITIONS_TOTAL = "karpenter_solver_breaker_transitions_total"
+SOLVER_RECOVERY_TOTAL = "karpenter_solver_recovery_total"
+SOLVER_FLEET_SHED_TOTAL = "karpenter_solver_fleet_shed_total"
+SOLVER_FLEET_WATCHDOG_TOTAL = "karpenter_solver_fleet_watchdog_total"
+SOLVER_FLEET_OLDEST_EVENT_AGE = "karpenter_solver_fleet_oldest_event_age_seconds"
+SOLVER_FAULT_INJECTIONS_TOTAL = "karpenter_solver_fault_injections_total"
+SOLVER_PRESTAGE_WORKER_RESTARTS_TOTAL = "karpenter_solver_prestage_worker_restarts_total"
+SOLVER_WATCH_RESYNC_TOTAL = "karpenter_solver_watch_resync_total"
 # lock waits live well under the solve buckets: sub-ms is the norm, anything
 # past 100ms is contention worth a dashboard line. Shared with the wrapper's
 # emission site so a registry that skipped make_registry still gets the
@@ -300,6 +314,61 @@ def make_registry() -> Registry:
         "Time spent waiting to acquire a named serving-stack lock (racecheck wrapper)",
         ("lock",),
         SOLVER_LOCK_WAIT_BUCKETS,
+    )
+    r.gauge(
+        SOLVER_TENANT_STATE,
+        "Per-tenant circuit-breaker state (1 on the current state's series): "
+        "healthy | quarantined | probing",
+        ("tenant", "state"),
+    )
+    r.counter(
+        SOLVER_BREAKER_TRANSITIONS_TOTAL,
+        "Tenant circuit-breaker transitions INTO a state (quarantined = the "
+        "failure domain closed; probing = a half-open re-admission probe; "
+        "healthy = re-admitted)",
+        ("tenant", "state"),
+    )
+    r.counter(
+        SOLVER_RECOVERY_TOTAL,
+        "Solve-failure recovery-ladder steps taken, by stage (full-reencode = "
+        "quarantined caches + from-scratch retry; host-ffd = exact host fallback)",
+        ("stage",),
+    )
+    r.counter(
+        SOLVER_FLEET_SHED_TOTAL,
+        "Watch triggers shed by the fleet's per-tenant overload protection "
+        "(the tenant's backlog exceeded its cap; its pending pods are served "
+        "later, everyone else on time)",
+        ("tenant",),
+    )
+    r.counter(
+        SOLVER_FLEET_WATCHDOG_TOTAL,
+        "Oldest-event-age watchdog firings: a shedding tenant's backlog aged "
+        "past the watchdog bound and was force-served",
+        ("tenant",),
+    )
+    r.gauge(
+        SOLVER_FLEET_OLDEST_EVENT_AGE,
+        "Age of each runnable tenant's oldest un-served wake (the DRR "
+        "starvation surface the watchdog bounds)",
+        ("tenant",),
+    )
+    r.counter(
+        SOLVER_FAULT_INJECTIONS_TOTAL,
+        "Deterministic faults injected by the faultline FaultSpec plan, by seam",
+        ("seam",),
+    )
+    r.counter(
+        SOLVER_PRESTAGE_WORKER_RESTARTS_TOTAL,
+        "PendingPrestager worker threads restarted by the serving loop's "
+        "supervisor after a (real or injected) death",
+        (),
+    )
+    r.counter(
+        SOLVER_WATCH_RESYNC_TOTAL,
+        "Level-triggered Cluster resyncs from store content after the watch "
+        "stream's gap tracker detected lost Pod events",
+        (),
     )
     return r
 

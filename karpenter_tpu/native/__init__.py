@@ -52,7 +52,7 @@ def _load():
         return None
     try:
         lib = ctypes.CDLL(_build())
-    except Exception as e:  # g++ missing, sandboxed tmp, bad toolchain...
+    except Exception as e:  # solverlint: ok(swallowed-exception): failure recorded in _load_error and surfaced by load_error() — the python fallback path takes over
         _load_error = f"{type(e).__name__}: {e}"
         return None
     lib.rk_new.restype = ctypes.c_void_p
@@ -194,5 +194,5 @@ class ReqTable:
         try:
             if getattr(self, "_handle", None):
                 self._lib.rk_free(self._handle)
-        except Exception:
+        except Exception:  # solverlint: ok(swallowed-exception): interpreter-teardown __del__ — the ctypes lib may already be unloaded and raising would print to stderr mid-shutdown
             pass

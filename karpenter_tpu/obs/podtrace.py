@@ -530,7 +530,7 @@ class PodTracer:
 
             try:
                 self.registry.counter(SOLVER_EVENT_SLO_BREACH_TOTAL).inc(breaches, tenant=self.tenant)  # solverlint: ok(metric-label-cardinality): tenant is the fleet registration label (a serving.fleet.tenant_label output; "" outside a fleet) — the bounded fleet enum
-            except Exception:  # noqa: BLE001 — observability must never fail a solve
+            except Exception:  # noqa: BLE001  # solverlint: ok(swallowed-exception): observability must never fail a solve — a broken SLO counter drops one increment
                 pass
 
     def publish_quantiles(self) -> None:
@@ -560,7 +560,7 @@ class PodTracer:
                 self._dropped_published = self.dropped
             if delta > 0:
                 self.registry.counter(SOLVER_EVENT_TRACE_DROPPED_TOTAL).inc(delta)
-        except Exception:  # noqa: BLE001 — observability must never break a scrape
+        except Exception:  # noqa: BLE001  # solverlint: ok(swallowed-exception): observability must never break a scrape — the dropped-counter delta retries next scrape
             pass
 
     # -- reading ---------------------------------------------------------------

@@ -84,7 +84,7 @@ class RecompileSentinel:
                 continue
             try:
                 out[label] = int(size())
-            except Exception:  # noqa: BLE001 — a broken probe must never fail a solve
+            except Exception:  # noqa: BLE001  # solverlint: ok(swallowed-exception): a broken jit-cache probe must never fail a solve; the sentinel just skips the entry
                 continue
         return out
 

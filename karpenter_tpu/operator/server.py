@@ -9,9 +9,12 @@ obs/trace.py; `?n=<k>` limits to the newest k solves and `?tenant=<label>`
 selects a fleet tenant's private recorder), /debug/events (the podtrace
 event-lifecycle dump: completed EventRecords with the per-stage e2e
 decomposition, SLO budget, and wake-cause split, per tenant — obs/
-podtrace.py; same `?n=`/`?tenant=` filters), and /debug/profile (a
-py-spy-less stand-in that dumps running thread stacks, the diagnostic the
-reference's pprof routes serve in e2e debugging — karpenter_profiler.go:40-56).
+podtrace.py; same `?n=`/`?tenant=` filters), /debug/tenants (faultline:
+per-tenant circuit-breaker state, backoff, last error, and backlog across
+every live FleetFrontend — the failure-domain-isolation surface), and
+/debug/profile (a py-spy-less stand-in that dumps running thread stacks,
+the diagnostic the reference's pprof routes serve in e2e debugging —
+karpenter_profiler.go:40-56).
 """
 
 from __future__ import annotations
@@ -125,6 +128,13 @@ class OperatorServer:
                         tracers = {tenant: tracers[tenant]}
                     body = {"tenants": {label: t.dump(limit=limit) for label, t in sorted(tracers.items())}}
                     self._send(200, json.dumps(body, indent=1), "application/json")
+                elif self.path.split("?", 1)[0] == "/debug/tenants":
+                    # faultline: per-tenant failure-domain state — breaker
+                    # state/backoff/last-error, backlog, wakes — merged
+                    # across every live FleetFrontend in this process
+                    from ..serving.fleet import fleet_debug_surfaces
+
+                    self._send(200, json.dumps({"tenants": fleet_debug_surfaces()}, indent=1), "application/json")
                 elif self.path == "/debug/profile" and enable_profiling:
                     frames = {}
                     for tid, frame in sys._current_frames().items():

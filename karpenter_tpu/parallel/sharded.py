@@ -87,7 +87,7 @@ def default_mesh() -> Mesh | None:
         return None
     try:
         devices = jax.devices()
-    except Exception:  # noqa: BLE001 — no backend is a valid headless state
+    except Exception:  # noqa: BLE001  # solverlint: ok(swallowed-exception): no jax backend is a valid headless state — the caller treats None as single-device
         return None
     if len(devices) <= 1:
         return None

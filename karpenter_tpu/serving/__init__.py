@@ -34,6 +34,17 @@ makes that regime first-class:
   tensors; `isolation_audit()` enforces the split). With
   KARPENTER_SOLVER_COMPILE_CACHE=<dir> compiled executables persist across
   process restarts and replicas.
+- `faults.FaultSpec` / `faults.FaultInjector` / `faults.CircuitBreaker` —
+  faultline: deterministic seeded fault injection at the named serving
+  seams (solve exception / decode failure / slow solve, watch-stream
+  drop·dup·reorder, prestager-worker death, spot-style capacity
+  revocation), per-tenant circuit breakers at the fleet dispatch seam (K
+  consecutive pump failures QUARANTINE one tenant; exponential-backoff
+  half-open probes re-admit it; healthy tenants never miss a round), and
+  the solver's graceful-degradation ladder (delta -> quarantined full
+  re-encode -> host FFD) behind `TPUSolver.solve`. Observable via
+  `karpenter_solver_tenant_state{tenant,state}`,
+  `karpenter_solver_recovery_total{stage}`, and `/debug/tenants`.
 
 Escape hatches: KARPENTER_SOLVER_DOUBLEBUF=0 disables the prestager (clones
 rebuilt per pass, the pre-serving-loop behavior); KARPENTER_SOLVER_BUCKET=0
@@ -75,10 +86,17 @@ store-deliver       watch-event FIFO delivery (RLock; reentrant for
 cluster             Cluster's node/binding/ack mirrors (RLock)
 batcher             Batcher trigger + in-flight bracket counters
 fleet               FleetFrontend tenant registry + runnable set + DRR
-                    deficits + serve-thread handle (leaf: only container
-                    ops run under it; solves always run unlocked)
+                    deficits + breakers map + shed stamps + serve-thread
+                    handle (leaf: only container ops run under it; solves
+                    always run unlocked)
 fleet-session       TenantSession wake-signal stats (leaf)
 fleet-labels        the bounded tenant-label assignment table (leaf)
+fleet-registry      the process-global fleet list backing /debug/tenants
+                    (leaf)
+faults              FaultInjector seam indices / fired counts / reorder
+                    hold slot (leaf; metric emission runs OUTSIDE it)
+breaker             CircuitBreaker state machine — pump-loop writes,
+                    /debug/tenants HTTP reads (leaf)
 prestage            PendingPrestager clone cache + staged/reused/misses
                     stats + worker thread handle
 metric / metric-    every _Metric's series maps / Registry._metrics (RLock)
@@ -100,7 +118,7 @@ DAG, and the sanitizer raises on the first acquisition that closes a
 cycle):
 
     store-deliver  ->  { store, cluster, batcher, prestage, clock, metric*,
-                         fleet-session, fleet, podtrace }
+                         fleet-session, fleet, podtrace, faults }
     cluster        ->  { store, clock }
     trace          ->  { metric-registry, metric }
     events | store | batcher | prestage  ->  clock
@@ -109,7 +127,10 @@ cycle):
 every delivered event to the installed PodTracer before the watcher fan-out;
 every other podtrace touch point — dispatch/solved on the solve thread,
 prestage stamps after the prestage lock releases, wake counts after the
-fleet lock releases — acquires it as a leaf.)
+fleet lock releases — acquires it as a leaf. store-deliver -> faults is the
+faultline watch-stream seam: `_drain` asks the installed FaultInjector to
+drop/dup/reorder each Pod delivery; the solver/prestager/revocation seams
+acquire `faults` as a leaf from their own threads.)
 
 (The fleet edges are the push-wake path: watch delivery -> batcher trigger
 -> wake_hook -> TenantSession stats -> FleetFrontend runnable set, each
@@ -124,6 +145,7 @@ releases the fleet lock around every `ServingLoop.pump`.
 """
 
 from .churn import ChurnHarness, ChurnReport, ChurnSpec  # noqa: F401
+from .faults import CircuitBreaker, FaultInjector, FaultRule, FaultSpec  # noqa: F401
 from .fleet import FleetFrontend, TenantSession, tenant_label  # noqa: F401
 from .loop import ServingLoop, doublebuf_enabled  # noqa: F401
 from .prestage import PendingPrestager  # noqa: F401

@@ -82,6 +82,13 @@ class ChurnSpec:
     # inherently non-replayable and is logged only in arrival order.
     record_path: str | None = None
     replay_events: list | None = None
+    # faultline (serving/faults.py): a seeded FaultSpec plan installed at the
+    # named seams (solver hook, store watch delivery, prestager worker,
+    # cycle-boundary revocations). None = no injector, zero-cost seams. The
+    # spec rides the recorded JSONL header; revocations ride the log as
+    # explicit `revoke` ops, so a replay applies them verbatim instead of
+    # re-consuming the plan (run_replay never calls take_revocations).
+    faults: object | None = None
     double_buffer: bool | None = None  # None = env default (on)
     # worker=False: prestage synchronously. On a CPU-only harness the pack
     # "device" shares the host cores, so a prestage thread can only contend
@@ -112,6 +119,14 @@ class ChurnSpec:
                 else:
                     events.append(op)
         kw = {k: header[k] for k in ("n_base_pods", "n_types", "arrivals", "cancels", "departures", "bind_every", "seed", "batch_idle_seconds") if k in header}
+        if header.get("faults"):
+            from .faults import FaultSpec
+
+            # the recorded fault plan re-installs at the same seams; its
+            # solve/watch indices replay against the same op stream, and
+            # revocations apply from the logged `revoke` ops (never from the
+            # plan — run_replay bypasses take_revocations)
+            kw["faults"] = FaultSpec.from_dict(header["faults"])
         kw.update(overrides)
         kw["replay_events"] = events
         kw.setdefault("concurrent_seconds", 0.0)
@@ -154,6 +169,14 @@ class ChurnReport:
     dominant_stage: str = ""
     stage_p99_seconds: dict = field(default_factory=dict)
     slo_breaches: int = 0
+    # faultline columns: what the FaultSpec injected over the whole run, the
+    # recovery-ladder steps the solver took over the steady window, nodes
+    # revoked, and prestager worker restarts — so a chaos run's report shows
+    # both the disruption applied AND the machinery that absorbed it
+    faults_injected: dict = field(default_factory=dict)
+    recoveries: dict = field(default_factory=dict)
+    revoked_nodes: int = 0
+    prestage_worker_restarts: int = 0
 
     def as_dict(self) -> dict:
         return {
@@ -182,6 +205,10 @@ class ChurnReport:
             "prestage_staged": self.prestage_staged,
             "n_nodes": self.n_nodes,
             "n_pending_end": self.n_pending_end,
+            "faults_injected": dict(self.faults_injected),
+            "recoveries": dict(self.recoveries),
+            "revoked_nodes": self.revoked_nodes,
+            "prestage_worker_restarts": self.prestage_worker_restarts,
         }
 
 
@@ -234,6 +261,9 @@ class ChurnHarness:
         # pump instead of the private ServingLoop, scoped to this tenant
         self.fleet = None
         self._tenant_id = None
+        # faultline: the live FaultInjector when spec.faults is set (installed
+        # by _install_faults from build()/attach())
+        self.injector = None
         self.recorder = TraceRecorder(capacity=self.spec.trace_capacity, enabled=True)
         # record/replay: the applied-event log (None = not recording). Every
         # op carries `t`, its wall-clock offset from recording start — the
@@ -287,6 +317,7 @@ class ChurnHarness:
             double_buffer=self.spec.double_buffer,
             worker=self.spec.worker,
         )
+        self._install_faults()
         return self
 
     def attach(self, session, fleet=None):
@@ -314,11 +345,29 @@ class ChurnHarness:
                 {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
             ]
             self.env.store.create(pool)
+        self._install_faults()
         return self
 
     def close(self) -> None:
         if self.loop is not None:
             self.loop.close()
+
+    def _install_faults(self) -> None:
+        """Install the spec's FaultInjector at every named seam this stack
+        exposes: the solver's solve/re-encode hook, the store's watch
+        delivery, and the prestager worker loop. Revocations are pulled at
+        cycle boundaries by run_cycle."""
+        if self.spec.faults is None:
+            return
+        from .faults import FaultInjector
+
+        self.injector = FaultInjector(self.spec.faults, registry=self.env.registry)
+        solver = self.env.provisioner.solver
+        if hasattr(solver, "fault_hook"):
+            solver.fault_hook = self.injector.solver_hook
+        self.env.store.set_fault_injector(self.injector)
+        if self.loop is not None and self.loop.prestager is not None:
+            self.loop.prestager.fault_hook = self.injector.prestage_hook
 
     # -- event application -----------------------------------------------------
     def _record_events(self, n: int, event: str) -> None:
@@ -390,6 +439,63 @@ class ChurnHarness:
         self._record_events(done, "departure")
         return done
 
+    def apply_revocations(self, n: int) -> int:
+        """Spot-style capacity revocation: n nodes are reclaimed out from
+        under the fleet. Node choice is seeded (the injector's rng over the
+        sorted name list) and each revocation is logged as an explicit
+        `revoke` op, so a replayed log reproduces the exact reclaim."""
+        if n <= 0 or self.env is None:
+            return 0
+        names = sorted(nd.metadata.name for nd in self.env.store.borrow_list("Node"))
+        if not names:
+            return 0
+        rng = self.injector.rng if self.injector is not None else None
+        picks = rng.sample(names, min(n, len(names))) if rng is not None else names[: min(n, len(names))]
+        events = 0
+        for name in picks:
+            events += self.revoke_node(name)
+        return events
+
+    def revoke_node(self, name: str) -> int:
+        """Decode one capacity revocation as FORCED DEPARTURES into the
+        churn stream: the node's bound pods are deleted (the workload they
+        carried is gone with the capacity), then the Node and its NodeClaim
+        are removed with no graceful drain — exactly what a spot reclaim
+        looks like to the control plane. Returns churn events applied."""
+        store = self.env.store
+        if store.try_get("Node", name) is None:
+            return 0
+        self._log(op="revoke", node=name)
+        events = 0
+        for pname in [p.metadata.name for p in store.borrow_list("Pod") if p.spec.node_name == name]:
+            if store.try_delete("Pod", pname, namespace="default"):
+                events += 1
+                try:
+                    self._bound.remove(pname)
+                except ValueError:
+                    try:
+                        self._pending.remove(pname)
+                    except ValueError:
+                        pass
+        self._record_events(events, "departure")
+        claim = next(
+            (nc.metadata.name for nc in store.borrow_list("NodeClaim") if nc.status.node_name == name),
+            None,
+        )
+        # forced: no finalizer-gated drain (grace=False), mirror out of
+        # cluster state like the chaos node-kill idiom
+        try:
+            store.delete("Node", name, grace=False)
+        except Exception:  # solverlint: ok(swallowed-exception): NotFound race with a concurrent teardown — the node is gone either way, which is the goal
+            pass
+        self.env.cluster.delete_node(name)
+        if claim is not None:
+            try:
+                store.delete("NodeClaim", claim, grace=False)
+            except Exception:  # solverlint: ok(swallowed-exception): NotFound race with a concurrent teardown — the claim is gone either way, which is the goal
+                pass
+        return events
+
     def bind_flush(self) -> None:
         """Launch claims, register nodes, bind pending pods — the controller
         work between solves. Re-files newly bound pods from pending to bound."""
@@ -460,6 +566,11 @@ class ChurnHarness:
             self.solve()
             if i == s.bind_every - 1:
                 events += self.apply_departures(departures)
+                if self.injector is not None:
+                    # spot-style revocation at the cycle boundary: forced
+                    # departures + node teardown, then the bind flush lets
+                    # the controllers start replacing the capacity
+                    events += self.apply_revocations(self.injector.take_revocations())
                 self.bind_flush()
         return events
 
@@ -478,6 +589,7 @@ class ChurnHarness:
                 n_base_pods=s.n_base_pods, n_types=s.n_types, arrivals=s.arrivals,
                 cancels=s.cancels, departures=s.departures, bind_every=s.bind_every,
                 seed=s.seed, batch_idle_seconds=s.batch_idle_seconds,
+                faults=(s.faults.to_dict() if s.faults is not None else None),
             )
         self.provision_base_fleet()
         # free steady-state headroom up front: arrivals land on capacity that
@@ -504,6 +616,7 @@ class ChurnHarness:
         mark = self.recorder.seq
         emark, slo0 = self._etracer_mark()
         rejects0 = self._reject_counts()
+        recoveries0 = self._recovery_counts()
         coalesced0 = self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total()
         reused0 = self.loop.prestager.reused if self.loop.prestager is not None else 0
         staged0 = self.loop.prestager.staged if self.loop.prestager is not None else 0
@@ -519,6 +632,7 @@ class ChurnHarness:
         rep.full_solve_reasons = {
             k: int(v - rejects0.get(k, 0)) for k, v in rejects1.items() if v > rejects0.get(k, 0)
         }
+        self._fault_columns(rep, recoveries0)
         if s.concurrent_seconds > 0:
             cev, csolves = self.run_concurrent(s.concurrent_seconds)
             rep.concurrent_events = cev
@@ -574,6 +688,8 @@ class ChurnHarness:
                     pass
             self._record_events(1, "departure")
             return 1
+        if kind == "revoke":
+            return self.revoke_node(op["node"])
         if kind == "bind_flush":
             self.bind_flush()
             return 0
@@ -592,6 +708,7 @@ class ChurnHarness:
         mark = self.recorder.seq
         emark, slo0 = self._etracer_mark()
         rejects0 = self._reject_counts()
+        recoveries0 = self._recovery_counts()
         coalesced0 = self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total()
         reused0 = self.loop.prestager.reused if self.loop.prestager is not None else 0
         staged0 = self.loop.prestager.staged if self.loop.prestager is not None else 0
@@ -606,6 +723,7 @@ class ChurnHarness:
                 mark = self.recorder.seq
                 emark, slo0 = self._etracer_mark()
                 rejects0 = self._reject_counts()
+                recoveries0 = self._recovery_counts()
                 coalesced0 = self.env.registry.counter(m.SOLVER_CHURN_COALESCED_TOTAL).total()
                 reused0 = self.loop.prestager.reused if self.loop.prestager is not None else 0
                 staged0 = self.loop.prestager.staged if self.loop.prestager is not None else 0
@@ -619,6 +737,7 @@ class ChurnHarness:
         rep.full_solve_reasons = {
             k: int(v - rejects0.get(k, 0)) for k, v in rejects1.items() if v > rejects0.get(k, 0)
         }
+        self._fault_columns(rep, recoveries0)
         return rep
 
     def run_concurrent(self, seconds: float, batch: int | None = None) -> tuple[int, int]:
@@ -672,6 +791,26 @@ class ChurnHarness:
         for labels, v in self.env.registry.counter(m.SOLVER_DELTA_REJECT_TOTAL).collect():
             out[labels.get("reason", "?")] = v
         return out
+
+    def _recovery_counts(self) -> dict:
+        """Current recovery-ladder counter values by stage (cumulative)."""
+        out: dict = {}
+        for labels, v in self.env.registry.counter(m.SOLVER_RECOVERY_TOTAL).collect():
+            out[labels.get("stage", "?")] = v
+        return out
+
+    def _fault_columns(self, rep: "ChurnReport", recoveries0: dict) -> None:
+        """Fill the report's faultline columns (no-ops without an injector,
+        except recoveries — the ladder also absorbs REAL failures)."""
+        recov1 = self._recovery_counts()
+        rep.recoveries = {
+            k: int(v - recoveries0.get(k, 0)) for k, v in recov1.items() if v > recoveries0.get(k, 0)
+        }
+        prestager = self.loop.prestager if self.loop is not None else None
+        rep.prestage_worker_restarts = prestager.restarts if prestager is not None else 0
+        if self.injector is not None:
+            rep.faults_injected = self.injector.summary()
+            rep.revoked_nodes = int(rep.faults_injected.get("revocation", 0))
 
     def _etracer(self):
         """The environment's podtrace event tracer (None when off/absent)."""

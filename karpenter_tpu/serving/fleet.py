@@ -51,6 +51,7 @@ import time
 
 from ..obs.racecheck import make_event, make_lock, spawn_thread, touch
 from ..obs.trace import TraceRecorder
+from .faults import TENANT_STATES, CircuitBreaker
 from .loop import ServingLoop
 
 # distinct tenant label values the bounded `tenant` metric label may carry
@@ -96,6 +97,35 @@ def reset_tenant_labels() -> None:
     """Drop the process-global label assignments (test isolation)."""
     with _TENANT_LABELS_LOCK:
         _TENANT_LABELS.clear()
+
+
+# process-global fleet registry backing the operator's /debug/tenants
+# surface (mirrors obs.podtrace's tenant-surface registry): FleetFrontend
+# registers itself at construction and unregisters on close()
+_FLEETS: list = []
+_FLEETS_LOCK = make_lock("fleet-registry")
+
+
+def _register_fleet(fleet: "FleetFrontend") -> None:
+    with _FLEETS_LOCK:
+        _FLEETS.append(fleet)
+
+
+def _unregister_fleet(fleet: "FleetFrontend") -> None:
+    with _FLEETS_LOCK:
+        if fleet in _FLEETS:
+            _FLEETS.remove(fleet)
+
+
+def fleet_debug_surfaces() -> dict:
+    """{tenant_id: breaker/backlog row} merged across every live fleet in
+    this process — the /debug/tenants payload."""
+    with _FLEETS_LOCK:
+        fleets = list(_FLEETS)
+    out: dict = {}
+    for f in fleets:
+        out.update(f.debug_tenants())
+    return out
 
 
 class TenantSession:
@@ -183,11 +213,25 @@ class FleetFrontend:
         "_deficit": "_lock",
         "_runnable_since": "_lock",
         "_runnable_cause": "_lock",
+        "_breakers": "_lock",
+        "_shed_first": "_lock",
+        "_age_labels": "_lock",
         "_thread": "_lock",
         "_stop": "_lock",
     }
 
-    def __init__(self, registry=None, quantum: float | None = None, backlog_solve_cap: float = 4.0, poll_floor_seconds: float = 0.5):
+    def __init__(
+        self,
+        registry=None,
+        quantum: float | None = None,
+        backlog_solve_cap: float = 4.0,
+        poll_floor_seconds: float = 0.5,
+        breaker_failures: int = 3,
+        breaker_backoff_seconds: float = 0.5,
+        breaker_backoff_max: float = 30.0,
+        overload_backlog_cap: int | None = None,
+        watchdog_age_seconds: float = 5.0,
+    ):
         """`quantum`: solve credits added per runnable tenant per `pump()`
         round (deficit round-robin: a solve costs one credit, unspent credit
         banks across rounds, and the bank is capped at `backlog_solve_cap` —
@@ -196,7 +240,20 @@ class FleetFrontend:
         rounds). Default: the cap itself, so an uncontended tenant drains
         its whole coalesced backlog in one round. `poll_floor_seconds` is
         only the serve loop's LIVENESS backstop — arrivals wake it
-        push-style, window closes wake it via `eta()`."""
+        push-style, window closes wake it via `eta()`.
+
+        Failure domains (faultline): each tenant gets a CircuitBreaker —
+        `breaker_failures` consecutive pump exceptions QUARANTINE the tenant
+        (the fleet keeps serving everyone else) and exponential-backoff
+        half-open probes (`breaker_backoff_seconds`, doubling up to
+        `breaker_backoff_max`) re-admit it.
+
+        Overload protection: with `overload_backlog_cap` set, a tenant whose
+        pending trigger backlog exceeds the cap has its batch generation
+        SHED (its pending pods are served later — the tenant degrades
+        itself, not the fleet), bounded by the oldest-event-age watchdog:
+        once a shedding tenant's backlog ages past `watchdog_age_seconds`
+        it is force-served. None (the default) disables shedding entirely."""
         from ..metrics import make_registry
         from ..solver.tpu import configure_compile_cache
 
@@ -204,6 +261,11 @@ class FleetFrontend:
         self.backlog_solve_cap = float(backlog_solve_cap)
         self.quantum = self.backlog_solve_cap if quantum is None else float(quantum)
         self.poll_floor = float(poll_floor_seconds)
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_backoff_seconds = float(breaker_backoff_seconds)
+        self.breaker_backoff_max = float(breaker_backoff_max)
+        self.overload_backlog_cap = overload_backlog_cap
+        self.watchdog_age = float(watchdog_age_seconds)
         self._lock = make_lock("fleet")
         self._wake = make_event()
         self._sessions: dict[str, TenantSession] = {}
@@ -214,10 +276,17 @@ class FleetFrontend:
         # the bounded wake cause that OPENED each runnable episode — handed
         # to the tenant's podtrace at dispatch so per-event records carry it
         self._runnable_cause: dict[str, str] = {}
+        # per-tenant circuit breakers (failure-domain isolation) and the
+        # first-shed stamp the oldest-event-age watchdog bounds shedding by
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._shed_first: dict[str, float] = {}
+        # tenant labels with a live oldest-age gauge series (zeroed on exit)
+        self._age_labels: set = set()
         self._thread = None
         self._stop = make_event()
         self.pump_rounds = 0
         configure_compile_cache()
+        _register_fleet(self)
 
     # -- tenant registry -------------------------------------------------------
     def add_tenant(
@@ -263,12 +332,22 @@ class FleetFrontend:
             register_tenant(label, recorder, tracer)
         loop = ServingLoop(env.provisioner, env.store, double_buffer=double_buffer, worker=worker)
         sess = TenantSession(self, tenant_id, env, loop, recorder, label)
+        # the failure-domain gate at the dispatch seam; deterministic
+        # drivers get deterministic backoff through the tenant's own clock
+        breaker = CircuitBreaker(
+            failures_to_open=self.breaker_failures,
+            backoff_seconds=self.breaker_backoff_seconds,
+            backoff_max=self.breaker_backoff_max,
+            now_fn=env.clock.now,
+        )
         with self._lock:
             if tenant_id in self._sessions:
                 raise ValueError(f"tenant {tenant_id!r} already registered")
             self._sessions[tenant_id] = sess
             self._order.append(tenant_id)
             self._deficit[tenant_id] = 0.0
+            self._breakers[tenant_id] = breaker
+        self._publish_tenant_state(sess, "healthy")
         # wire the push seams only after the session is registered, so a
         # wake racing registration can never reference an unknown tenant
         env.provisioner.batcher.wake_hook = sess._on_batcher_trigger
@@ -285,9 +364,19 @@ class FleetFrontend:
             self._deficit.pop(tenant_id, None)
             self._runnable_since.pop(tenant_id, None)
             self._runnable_cause.pop(tenant_id, None)
+            self._breakers.pop(tenant_id, None)
+            self._shed_first.pop(tenant_id, None)
         if sess is not None:
+            from .. import metrics as m
             from ..obs.podtrace import unregister_tenant
 
+            # zero every state series for the departing tenant — a tenant
+            # removed while quarantined (or mid-probe) must not report a
+            # live breaker state forever (same stale-series hygiene as
+            # _publish_oldest_ages)
+            g = self.registry.gauge(m.SOLVER_TENANT_STATE)
+            for s in TENANT_STATES:
+                g.set(0.0, tenant=sess.label, state=s)
             unregister_tenant(sess.label)
             sess.close()
 
@@ -345,8 +434,21 @@ class FleetFrontend:
 
     def next_eta(self) -> float | None:
         """Seconds until the nearest tenant batch window closes, or None
-        when no tenant has an open generation."""
-        etas = [e for s in self.sessions().values() if (e := s.eta()) is not None]
+        when no tenant has an open generation. A quarantined tenant's eta is
+        floored at its breaker's remaining backoff — its ready window cannot
+        dispatch anyway, and returning its raw eta would hot-spin the serve
+        loop against a tenant nothing will serve."""
+        etas = []
+        with self._lock:
+            breakers = dict(self._breakers)
+        for tid, s in self.sessions().items():
+            e = s.eta()
+            if e is None:
+                continue
+            breaker = breakers.get(tid)
+            if breaker is not None:
+                e = max(e, breaker.remaining_backoff())
+            etas.append(e)
         return min(etas) if etas else None
 
     # -- scheduling ------------------------------------------------------------
@@ -364,12 +466,14 @@ class FleetFrontend:
         bench warmup); `only` restricts the round to one tenant (the
         attached-harness drive path — avoids fanning a per-tenant warmup
         solve out across the whole fleet)."""
+        self._rearm_overdue_shed()
         with self._lock:
             if force:
                 self._runnable.update(self._sessions if only is None else [t for t in (only,) if t in self._sessions])
             ring = [t for t in self._order if t in self._runnable and (only is None or t == only)]
             for tid in ring:
                 self._deficit[tid] = min(self._deficit.get(tid, 0.0) + self.quantum, self.backlog_solve_cap)
+        self._publish_oldest_ages(ring)
         served: dict[str, int] = {}
         progress = True
         while progress:
@@ -379,6 +483,7 @@ class FleetFrontend:
                     sess = self._sessions.get(tid)
                     active = sess is not None and tid in self._runnable
                     credit = self._deficit.get(tid, 0.0)
+                    breaker = self._breakers.get(tid)
                 if not active or credit < 1.0:
                     # out-of-credit tenants STAY runnable — the next round
                     # (or the serve loop's next wake) continues them
@@ -390,8 +495,42 @@ class FleetFrontend:
                 if not (eff_force or sess.ready()):
                     self._retire(tid)
                     continue
+                if breaker is not None and not breaker.allow():
+                    # QUARANTINED failure domain: the tenant stays registered
+                    # and runnable, but nothing dispatches until the backoff
+                    # elapses and a half-open probe re-admits it — the ring
+                    # moves on and healthy tenants keep being served
+                    continue
+                probing = breaker is not None and breaker.state_name() == "probing"
+                if probing:
+                    self._note_transition(sess, "probing")
+                    # the gauge must show the half-open window too — a probe
+                    # solve can run for seconds (e.g. a full-reencode
+                    # recovery) and /metrics reporting `quarantined` for its
+                    # whole duration would contradict the TENANT_STATES enum
+                    self._publish_tenant_state(sess, "probing")
+                # forced pumps are deterministic-driver overrides (harness
+                # provisioning, bench warmup) — they bypass load shedding
+                if not eff_force and self._should_shed(tid, sess):
+                    if probing:
+                        # the admitted probe was SHED, not dispatched:
+                        # resolve it as inconclusive (re-quarantine without
+                        # doubling) — otherwise the breaker wedges in
+                        # `probing` (allow() admits exactly one probe per
+                        # window) and the tenant can never dispatch again
+                        breaker.probe_inconclusive()
+                        self._publish_tenant_state(sess, breaker.state_name())
+                    continue
                 self._observe_sched_wait(tid, sess)
-                results = sess.loop.pump(force=eff_force)
+                try:
+                    results = sess.loop.pump(force=eff_force)
+                except Exception as e:  # solverlint: ok(swallowed-exception): the failure-domain seam — _on_tenant_failure records it on the breaker, the transitions counter, and the tenant-state gauge
+                    self._on_tenant_failure(tid, sess, breaker, e)
+                    with self._lock:
+                        self._deficit[tid] = self._deficit.get(tid, 0.0) - 1.0
+                    if not sess.ready():
+                        self._retire(tid)
+                    continue
                 with self._lock:
                     # a declined reconcile (e.g. cluster mid-sync) still
                     # costs the credit, so a stuck tenant cannot pin the loop
@@ -399,11 +538,146 @@ class FleetFrontend:
                 if results is not None:
                     served[tid] = served.get(tid, 0) + 1
                     progress = True
+                    self._on_tenant_success(tid, sess, breaker)
+                elif probing:
+                    # the probe never produced a verdict — re-quarantine
+                    # without doubling so the next window probes again
+                    breaker.probe_inconclusive()
+                    self._publish_tenant_state(sess, breaker.state_name())
                 if not sess.ready():
                     self._retire(tid)
         self.pump_rounds += 1
         self._publish_runnable()
         return served
+
+    # -- failure domains + overload protection (faultline) ---------------------
+    def _on_tenant_failure(self, tenant_id: str, sess: TenantSession, breaker: CircuitBreaker | None, err: BaseException) -> None:
+        """A tenant pump RAISED past the solver's own degradation ladder:
+        record it on the tenant's breaker and publish the state — the
+        exception is contained here, the fleet loop never dies."""
+        if breaker is None:
+            return
+        opened = breaker.record_failure(err)
+        if opened is not None:
+            self._note_transition(sess, "quarantined")
+        self._publish_tenant_state(sess, breaker.state_name())
+
+    def _on_tenant_success(self, tenant_id: str, sess: TenantSession, breaker: CircuitBreaker | None) -> None:
+        with self._lock:
+            self._shed_first.pop(tenant_id, None)
+        if breaker is None:
+            return
+        if breaker.record_success():
+            self._note_transition(sess, "healthy")
+            self._publish_tenant_state(sess, "healthy")
+
+    def _note_transition(self, sess: TenantSession, state: str) -> None:
+        from .. import metrics as m
+
+        self.registry.counter(m.SOLVER_BREAKER_TRANSITIONS_TOTAL).inc(tenant=sess.label, state=state)  # solverlint: ok(metric-label-cardinality): tenant is a tenant_label() output fixed at registration; state literals at every call site come from the static TENANT_STATES enum
+
+    def _publish_tenant_state(self, sess: TenantSession, state: str) -> None:
+        from .. import metrics as m
+
+        g = self.registry.gauge(m.SOLVER_TENANT_STATE)
+        for s in TENANT_STATES:
+            g.set(1.0 if s == state else 0.0, tenant=sess.label, state=s)
+
+    def _should_shed(self, tenant_id: str, sess: TenantSession) -> bool:
+        """Per-tenant overload protection: when the tenant's pending trigger
+        backlog exceeds the cap, SHED its batch generation (the triggers are
+        dropped; the pods stay pending in the store and are served by a
+        later, larger window) instead of solving — the overloaded tenant
+        degrades its own latency, not the fleet's. Shedding is bounded by
+        the oldest-event-age watchdog: once the tenant has been shedding
+        for `watchdog_age` seconds it is force-served."""
+        cap = self.overload_backlog_cap
+        if not cap:
+            return False
+        pending = sess.pending()
+        if pending <= cap:
+            with self._lock:
+                self._shed_first.pop(tenant_id, None)
+            return False
+        from .. import metrics as m
+
+        # the tenant's OWN clock, same as its breaker's backoff: shedding
+        # stays deterministic under a fake-clock driver and a slow CI
+        # machine cannot trip the watchdog mid-test on wall time
+        now = sess.env.clock.now()
+        with self._lock:
+            first = self._shed_first.setdefault(tenant_id, now)
+        if now - first >= self.watchdog_age:
+            # the watchdog bound: serve now, open the next shed window
+            self.registry.counter(m.SOLVER_FLEET_WATCHDOG_TOTAL).inc(tenant=sess.label)  # solverlint: ok(metric-label-cardinality): tenant is a tenant_label() output fixed at session registration — the bounded fleet enum
+            with self._lock:
+                self._shed_first[tenant_id] = now
+            return False
+        sess.env.provisioner.batcher.reset()
+        self.registry.counter(m.SOLVER_FLEET_SHED_TOTAL).inc(pending, tenant=sess.label)  # solverlint: ok(metric-label-cardinality): tenant is a tenant_label() output fixed at session registration — the bounded fleet enum
+        self._retire(tenant_id)
+        return True
+
+    def _rearm_overdue_shed(self) -> None:
+        """The watchdog's out-of-band half: a shed dropped the tenant's
+        batch generation, so if arrivals then STOP nothing would ever
+        re-open its window and the shed backlog (pods still pending in the
+        store) would strand forever. For every shed-stamped tenant whose
+        stamp aged past the watchdog bound with no window pending, fire one
+        batcher trigger — the stranded pods are served by the normal window
+        one idle-duration later. The stamp advances to now, so the re-arm
+        fires at most once per watchdog period."""
+        from .. import metrics as m
+
+        with self._lock:
+            stamped = [(t, self._sessions[t], f) for t, f in self._shed_first.items() if t in self._sessions]
+        for tid, sess, first in stamped:
+            now = sess.env.clock.now()
+            if now - first < self.watchdog_age or sess.pending():
+                continue
+            with self._lock:
+                self._shed_first[tid] = now
+            self.registry.counter(m.SOLVER_FLEET_WATCHDOG_TOTAL).inc(tenant=sess.label)  # solverlint: ok(metric-label-cardinality): tenant is a tenant_label() output fixed at session registration — the bounded fleet enum
+            sess.env.provisioner.trigger("shed-watchdog")
+
+    def _publish_oldest_ages(self, ring: list) -> None:
+        from .. import metrics as m
+
+        now = time.monotonic()
+        with self._lock:
+            ages = {
+                self._sessions[t].label: now - self._runnable_since.get(t, now)
+                for t in ring
+                if t in self._sessions
+            }
+            # zero the series of tenants that LEFT the ring: a drained
+            # tenant's gauge must not freeze at its last nonzero age
+            stale = self._age_labels - set(ages)
+            self._age_labels = set(ages)
+        g = self.registry.gauge(m.SOLVER_FLEET_OLDEST_EVENT_AGE)
+        for label in stale:
+            g.set(0.0, tenant=label)
+        for label, age in ages.items():
+            g.set(age, tenant=label)
+
+    def debug_tenants(self) -> dict:
+        """The /debug/tenants rows: per-tenant breaker state, backlog, and
+        wake stats — the observable half of failure-domain isolation."""
+        out: dict = {}
+        for tid, sess in self.sessions().items():
+            with self._lock:
+                breaker = self._breakers.get(tid)
+                runnable = tid in self._runnable
+            row = {
+                "label": sess.label,
+                "runnable": runnable,
+                "pending_triggers": sess.pending(),
+                "wakes": sess.wake_count(),
+            }
+            if breaker is not None:
+                row.update(breaker.snapshot())
+            out[tid] = row
+        return out
 
     def _retire(self, tenant_id: str) -> None:
         """The tenant's window is no longer ready: drop it from the runnable
@@ -498,6 +772,7 @@ class FleetFrontend:
         self.stop()
         for tid in list(self.sessions()):
             self.remove_tenant(tid)
+        _unregister_fleet(self)
 
     # -- observability ---------------------------------------------------------
     def stats(self) -> dict:

@@ -51,6 +51,7 @@ class ServingLoop:
         if self.double_buffer:
             self.prestager = PendingPrestager()
             self.prestager.attach(store)
+            self.prestager.metrics = provisioner.metrics
             provisioner.prestager = self.prestager
             if worker:
                 self.prestager.start()
@@ -58,8 +59,13 @@ class ServingLoop:
     def pump(self, force: bool = False):
         """One serving iteration. Returns the solve's Results or None when
         the batcher window has not closed."""
-        if self.prestager is not None and not self.prestager.worker_running():
-            self.prestager.pump()  # synchronous mode: drain before the solve
+        if self.prestager is not None:
+            # supervision (faultline): a worker thread that DIED (injected
+            # fault or real crash) is restarted here — detected and counted,
+            # never a silent permanent downgrade to synchronous prep
+            self.prestager.ensure_worker()
+            if not self.prestager.worker_alive():
+                self.prestager.pump()  # synchronous mode: drain before the solve
         results = self.provisioner.reconcile(force=force)
         if results is not None:
             self.solves += 1

@@ -77,9 +77,11 @@ class PendingPrestager:
         "_cache": "_lock",
         "_thread": "_lock",
         "_stop": "_lock",
+        "_worker_wanted": "_lock",
         "staged": "_lock",
         "reused": "_lock",
         "misses": "_lock",
+        "restarts": "_lock",
     }
 
     def __init__(self):
@@ -98,6 +100,18 @@ class PendingPrestager:
         self.staged = 0  # clones prepared by the worker ahead of a take
         self.reused = 0  # takes served by an existing clone (delta identity)
         self.misses = 0  # takes that cloned inline (arrived un-staged)
+        # supervision (faultline): start() records that a worker is WANTED;
+        # ensure_worker() restarts a dead-but-wanted worker and counts it —
+        # before this, a worker death silently degraded every later solve to
+        # synchronous prep with no signal
+        self._worker_wanted = False
+        self.restarts = 0
+        # metrics registry for the restart counter (installed by ServingLoop)
+        self.metrics = None
+        # fault-injection seam (serving/faults.FaultInjector.prestage_hook):
+        # called once per worker loop iteration; an injected death raises
+        # SystemExit so the thread exits exactly like an unhandled crash
+        self.fault_hook = None
 
     # -- store integration -----------------------------------------------------
     def attach(self, store) -> None:
@@ -113,6 +127,8 @@ class PendingPrestager:
     # -- worker ----------------------------------------------------------------
     def start(self) -> None:
         with self._lock:
+            touch(self, "_worker_wanted")
+            self._worker_wanted = True
             if self._thread is not None:
                 return
             # a FRESH stop event per worker generation: a start() racing the
@@ -127,6 +143,8 @@ class PendingPrestager:
         atomically, so two racing stop() calls join once and a stop() after
         stop() is a no-op (the operator shutdown path can hit both)."""
         with self._lock:
+            touch(self, "_worker_wanted")
+            self._worker_wanted = False
             t, self._thread = self._thread, None
             stop = self._stop
         stop.set()
@@ -138,9 +156,49 @@ class PendingPrestager:
         with self._lock:
             return self._thread is not None
 
+    def worker_alive(self) -> bool:
+        """True only when the worker THREAD is actually alive — a dead
+        thread leaves the handle set, which is exactly the silent-death
+        state worker_running() cannot see."""
+        with self._lock:
+            t = self._thread
+        return t is not None and t.is_alive()
+
+    def ensure_worker(self) -> bool:
+        """Supervision: restart a wanted-but-dead worker (injected fault or
+        real crash). Called by the serving loop before every pump, so a
+        death costs at most one solve of synchronous prep — detected,
+        counted (karpenter_solver_prestage_worker_restarts_total), and
+        healed instead of silently degrading forever. Returns True when a
+        restart happened."""
+        with self._lock:
+            t = self._thread
+            if not self._worker_wanted or (t is not None and t.is_alive()):
+                return False
+            touch(self, "restarts")
+            self.restarts += 1
+            # a fresh generation, exactly like start(): new stop event so a
+            # racing stop() of the DEAD generation cannot stop this one
+            self._stop = make_event()
+            self._thread = spawn_thread(self._run, name="karpenter-prestage", args=(self._stop,))
+        if self.metrics is not None:
+            from ..metrics import SOLVER_PRESTAGE_WORKER_RESTARTS_TOTAL
+
+            self.metrics.counter(SOLVER_PRESTAGE_WORKER_RESTARTS_TOTAL).inc()
+        return True
+
     def _run(self, stop) -> None:
         # `stop` is this worker generation's own event (see start)
         while not stop.is_set():
+            hook = self.fault_hook
+            if hook is not None:
+                try:
+                    hook()
+                except SystemExit:
+                    # the injected worker death: the thread exits exactly
+                    # like an unhandled crash would leave it (dead, handle
+                    # still set, no signal) — ensure_worker must notice
+                    return
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             self.pump()

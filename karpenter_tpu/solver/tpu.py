@@ -135,7 +135,7 @@ def configure_compile_cache() -> str | None:
 
     try:
         jax.config.update("jax_compilation_cache_dir", path)
-    except Exception:  # noqa: BLE001 — a jax without the cache stays uncached, never broken
+    except Exception:  # noqa: BLE001  # solverlint: ok(swallowed-exception): a jax without the cache knobs stays uncached, never broken — nothing to record pre-registry
         return None
     # cache EVERY executable: the solver's kernels are individually small/
     # fast to compile but numerous — the default size/time floors would skip
@@ -147,7 +147,7 @@ def configure_compile_cache() -> str | None:
     ):
         try:
             jax.config.update(knob, value)
-        except Exception:  # noqa: BLE001 — tuning knobs vary by jax version; the dir alone suffices
+        except Exception:  # noqa: BLE001  # solverlint: ok(swallowed-exception): tuning knobs vary by jax version; the dir alone suffices
             pass
     # the cache object memoizes the dir it was created with: a process that
     # already compiled ANYTHING (backend probe, an import-time jit) holds a
@@ -157,7 +157,7 @@ def configure_compile_cache() -> str | None:
         from jax._src import compilation_cache as _cc
 
         _cc.reset_cache()
-    except Exception:  # noqa: BLE001 — internal API; without it the pre-compile config path still works
+    except Exception:  # noqa: BLE001  # solverlint: ok(swallowed-exception): jax-internal API; without it the pre-compile config path still works
         pass
     _COMPILE_CACHE_DIR = path
     return path
@@ -171,13 +171,29 @@ _COMPILE_CACHE_DIR: str | None = None
 # TenantSession's private TraceRecorder instead)
 _TENANT_LABELED = frozenset({"karpenter_solver_solve_total"})
 
+# the graceful-degradation ladder's bounded `stage` enum
+# (karpenter_solver_recovery_total): a failed solve retries as a full
+# re-encode with every cross-solve cache quarantined; a failed retry
+# degrades to the exact host FFD — slower, never wrong
+RECOVERY_STAGES = ("full-reencode", "host-ffd")
+
 
 class TPUSolver:
     name = "tpu"
 
-    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None, mesh="auto", hybrid: bool = True, recorder=None, tenant: str = ""):
+    def __init__(self, fallback: FFDSolver | None = None, force: bool = False, registry=None, mesh="auto", hybrid: bool = True, recorder=None, tenant: str = "", recover: bool = True):
         self.fallback = fallback or FFDSolver()
         self.force = force  # raise instead of falling back (tests)
+        # graceful-degradation ladder (faultline): an exception escaping the
+        # solve body retries as a quarantined full re-encode, then the host
+        # FFD — a transient tensor-path failure degrades to a slower-but-
+        # correct answer instead of an outage. Disabled under force (tests
+        # that pin raise behavior) and for faults marked unrecoverable.
+        self.recover = recover
+        # fault-injection seam (serving/faults.FaultInjector.solver_hook):
+        # called with "solve" before each attempt and "reencode" before the
+        # ladder's retry; None (production default) costs one attribute read
+        self.fault_hook = None
         # bounded fleet tenant label (serving.fleet.tenant_label output) —
         # "" outside a fleet, which the registry renders as the empty label
         self.tenant = tenant
@@ -358,13 +374,90 @@ class TPUSolver:
         if trace.enabled:
             trace.jit_before = sentinel().snapshot()
         try:
-            return self._solve_inner(snap, trace)
+            try:
+                hook = self.fault_hook
+                if hook is not None:
+                    hook("solve")
+                return self._solve_inner(snap, trace)
+            except Exception as e:
+                # the graceful-degradation ladder (faultline). force-mode
+                # raise behavior and unrecoverable faults propagate: the
+                # fleet's dispatch seam (per-tenant circuit breaker) is the
+                # containment layer for what the ladder cannot absorb.
+                if self.force or not self.recover or getattr(e, "unrecoverable", False):
+                    raise
+                return self._recover(snap, trace, e)
         finally:
             if trace.enabled:
                 trace.recompiles = sentinel().delta(trace.jit_before)
             trace.backend = self.last_backend
             trace.fallback_reasons = list(self.last_fallback_reasons)
             self.recorder.commit(trace, registry=self.registry)
+
+    def quarantine_caches(self) -> None:
+        """Drop every cross-solve cached artifact a failed solve may have
+        poisoned: the EncodeCache (delta base + row cache), the device-
+        resident pack carry, and the hybrid partition carry. A poisoned
+        cached base must never serve a second solve — the next encode
+        rebuilds everything from the live snapshot (and becomes the next
+        delta base, so the delta path re-warms after one full solve).
+        Process-global state (signature interning, high-water bucket marks,
+        row artifacts) is content-addressed and keyed by cluster epoch, so
+        it cannot carry a per-solve corruption and stays."""
+        from .encode import EncodeCache
+
+        self.encode_cache = EncodeCache()
+        self._resident = None
+        self._hybrid_state = None
+
+    def _recover(self, snap: SolverSnapshot, trace: SolveTrace, err: BaseException) -> Results:
+        """The degradation ladder, engaged only when a solve RAISED (the
+        no-fault path never enters here, so placements stay bit-identical):
+
+        1. full-reencode — quarantine every cross-solve cache and retry as a
+           from-scratch full encode + pack (a corrupted delta base or carry
+           cannot reach the retry);
+        2. host-ffd — if the retry raises too, re-quarantine and serve the
+           exact host FFD answer (slow, never wrong).
+
+        Each step is attributed on the SolveTrace (`recovery`,
+        `recovery_error`) and karpenter_solver_recovery_total{stage}."""
+        from ..metrics import SOLVER_RECOVERY_TOTAL
+
+        self.quarantine_caches()
+        trace.note(recovery_error=f"{type(err).__name__}: {err}"[:200])
+        self._count(SOLVER_RECOVERY_TOTAL, stage="full-reencode")
+        try:
+            hook = self.fault_hook
+            if hook is not None:
+                hook("reencode")
+            with trace.span("encode", mode="full"):
+                enc = encode(snap, cache=self.encode_cache)
+            trace.n_sigs = int(getattr(enc, "n_sigs", 0) or 0)
+            trace.note(recovery="full-reencode", encode_mode="full", row_cache=False)
+            self.last_fallback_reasons = enc.fallback_reasons
+            if enc.fallback_reasons or enc.n_pods == 0 or enc.n_rows == 0:
+                route = (enc.fallback_reasons or ["empty snapshot"], None)
+            else:
+                self.last_solve_mode = "full"
+                try:
+                    results = self._solve_full(snap, enc)
+                    self._hybrid_state = None
+                    return results
+                except _TensorFallback as tf:
+                    route = (tf.reasons, tf.family)
+        except Exception as e2:
+            if getattr(e2, "unrecoverable", False):
+                raise
+            # stage 2: the retry itself failed — quarantine again (the retry
+            # may have poisoned fresh caches) and take the exact host path
+            self.quarantine_caches()
+            trace.note(recovery="host-ffd", recovery_error2=f"{type(e2).__name__}: {e2}"[:200])
+            self._count(SOLVER_RECOVERY_TOTAL, stage="host-ffd")
+            return self._fall_back(
+                snap, [f"recovery: {type(err).__name__}", f"recovery-retry: {type(e2).__name__}"], family="recovery"
+            )
+        return self._fall_back(snap, route[0], family=route[1])
 
     def solve_prepared(self, snap: SolverSnapshot, enc) -> Results:
         """One flight-recorded solve over an EXTERNALLY-DERIVED encode — the

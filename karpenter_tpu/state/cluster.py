@@ -38,6 +38,7 @@ class Cluster:
         "_bindings": "_lock",
         "_anti_affinity_pods": "_lock",
         "_pod_acks": "_lock",
+        "_pod_rvs": "_lock",
         "_consolidated_at": "_lock",
         "_buffer_pod_counts": "_lock",
     }
@@ -52,6 +53,12 @@ class Cluster:
         self._bindings: dict[str, str] = {}  # pod key -> node name
         self._anti_affinity_pods: set[str] = set()  # pod keys with required anti-affinity
         self._pod_acks: dict[str, float] = {}  # pod key -> first-seen-pending time
+        # pod key -> last resourceVersion applied via update_pod. The
+        # watch-loss resync (faultline) diffs this against store content to
+        # find exactly the pods whose events a lossy stream lost — untouched
+        # pods are never re-applied, so a resync with no drift mutates
+        # nothing (no generation bump, delta caches intact).
+        self._pod_rvs: dict[str, int] = {}
         self._pod_scheduling_decisions: dict[str, float] = {}
         self._pod_to_node_claim: dict[str, str] = {}
         self._consolidated_at: float = 0.0
@@ -280,6 +287,7 @@ class Cluster:
     def update_pod(self, pod) -> None:
         with self._lock:
             key = pod.key()
+            self._pod_rvs[key] = pod.metadata.resource_version
             terminating = pod.metadata.deletion_timestamp is not None
             # row impact: released/recorded usage or bindings, or a change of
             # anti-affinity membership (the encoder's inverse-anti entries
@@ -331,7 +339,36 @@ class Cluster:
             self._remove_pod_usage(key)
             self._anti_affinity_pods.discard(key)
             self._pod_acks.pop(key, None)
+            self._pod_rvs.pop(key, None)
             self._bump(rows=rows)
+
+    def resync_pods(self) -> tuple[int, int]:
+        """Level-triggered convergence after watch loss: re-derive the pod
+        mirror from store CONTENT (the authority) instead of the delivered
+        event stream. Only pods whose resourceVersion differs from the last
+        one applied are re-played through update_pod, and mirrored pods the
+        store no longer holds are deleted — so when nothing was actually
+        lost this is a pure read (zero mutations, placements untouched).
+        Returns (stale_updated, gone_deleted)."""
+        from ..kube.clone import fast_deepcopy
+
+        with self._lock:
+            known = dict(self._pod_rvs)
+        stale, seen = [], set()
+        for pod in self.store.borrow_list("Pod"):
+            key = pod.key()
+            seen.add(key)
+            if known.get(key) != pod.metadata.resource_version:
+                # clone before applying: update_pod may retain the object
+                # (StateNode pod usage), and borrowed store objects must
+                # never escape the borrow contract
+                stale.append(fast_deepcopy(pod))
+        gone = [key for key in known if key not in seen]
+        for pod in stale:
+            self.update_pod(pod)
+        for key in gone:
+            self.delete_pod(key)
+        return len(stale), len(gone)
 
     # -- helpers ---------------------------------------------------------------
     def _state_node_for(self, node_name: str) -> Optional[StateNode]:  # solverlint: ok(guarded-field-access): caller-holds contract — every call site sits inside `with self._lock`

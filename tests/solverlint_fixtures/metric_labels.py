@@ -50,6 +50,19 @@ def ok_tenant_producer(registry, session):
     registry.counter("karpenter_solver_solve_total").inc(backend="tpu", tenant=tenant_label(session.tenant_id))  # noqa: F821 — fixture, parsed only
 
 
+def bad_breaker_state_runtime(registry, breaker):
+    # the faultline cardinality leak: the breaker-transitions counter's
+    # `state` label fed a runtime breaker attribute instead of a literal
+    # from the static serving.faults.TENANT_STATES enum
+    registry.counter("karpenter_solver_breaker_transitions_total").inc(tenant=tenant_label(breaker.tenant_id), state=breaker.state)  # noqa: F821 — fixture, parsed only
+
+
+def ok_breaker_state_enum(registry, breaker):
+    # the sanctioned form: a literal/ternary over the static state enum
+    state = "quarantined" if breaker.open else "healthy"
+    registry.counter("karpenter_solver_breaker_transitions_total").inc(tenant=tenant_label(breaker.tenant_id), state=state)  # noqa: F821 — fixture, parsed only
+
+
 def bad_stage_runtime_name(registry, rec):
     # the podtrace cardinality leak: a runtime-computed span name as the
     # stage label instead of iterating the static obs.podtrace.STAGES enum

@@ -1,0 +1,66 @@
+"""solverlint fixture: swallowed-exception. Never imported — parsed only."""
+
+
+def bad_silent_pass(store, nc):
+    try:
+        store.update(nc)
+    except Exception:
+        pass
+
+
+def bad_bare_except(store, nc):
+    try:
+        store.update(nc)
+    except:  # noqa: E722 — fixture, parsed only
+        return None
+
+
+def bad_base_exception_continue(store, items):
+    for nc in items:
+        try:
+            store.update(nc)
+        except BaseException:
+            continue
+
+
+def bad_tuple_broad(store, nc):
+    # parenthesizing the broad type must not evade the rule
+    try:
+        store.update(nc)
+    except (Exception, OSError):
+        pass
+
+
+def ok_reraise(store, nc):
+    try:
+        store.update(nc)
+    except Exception:
+        raise
+
+
+def ok_event_emission(store, nc, recorder):
+    try:
+        store.update(nc)
+    except Exception as e:
+        recorder.publish(nc, "ReconcileError", str(e), type_="Warning")
+
+
+def ok_metric_emission(store, nc, registry):
+    try:
+        store.update(nc)
+    except Exception:
+        registry.counter("m").inc(reason="update-failed")
+
+
+def ok_narrowed(store, nc):
+    try:
+        store.update(nc)
+    except (ValueError, KeyError):
+        pass
+
+
+def ok_pragma(store, nc):
+    try:
+        store.update(nc)
+    except Exception:  # solverlint: ok(swallowed-exception): fixture — proves the pragma form suppresses
+        pass

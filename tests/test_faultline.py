@@ -1,0 +1,643 @@
+"""faultline (ISSUE 15): fault injection, failure-domain isolation, and the
+graceful-degradation ladder.
+
+Pins the subsystem's contracts:
+- circuit breaker: K consecutive failures quarantine ONE tenant, exponential
+  -backoff half-open probes re-admit it, and the state machine is observable
+  (tenant_state gauge, transitions counter, /debug/tenants surface);
+- degradation ladder: a solve that RAISES retries as a quarantined full
+  re-encode (a poisoned delta base never serves a second solve), then the
+  exact host FFD; each step is attributed (SolveTrace + recovery_total), the
+  answer matches a clean solver's, and the delta path re-warms afterward;
+- fault injection: a seeded FaultSpec fires deterministically at the named
+  seams; watch drop/dup/reorder leave placements bit-identical (the store
+  is authoritative; the stream is at-least-once and unordered);
+- prestager supervision: a worker death (injected SystemExit) is detected,
+  counted, and healed by restart instead of silently degrading;
+- overload protection: a tenant past its backlog cap sheds its own window
+  (bounded by the oldest-event-age watchdog) — never the fleet's;
+- chaos soak: a randomized seeded FaultSpec under run_concurrent with the
+  racecheck sanitizer ON — zero loop deaths, healthy-tenant placements
+  bit-identical to a no-fault fleet run, delta-hit recovery after
+  quarantine.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from test_churn_loop import placement_shape, small_spec
+from test_fleet import add_churn_tenant
+from test_solver import make_snapshot
+from helpers import make_pod
+from karpenter_tpu import metrics as m
+from karpenter_tpu.metrics import make_registry
+from karpenter_tpu.serving import ChurnHarness, ChurnSpec
+from karpenter_tpu.serving.faults import (
+    FAULT_SEAMS,
+    TENANT_STATES,
+    CircuitBreaker,
+    FaultInjected,
+    FaultInjector,
+    FaultRule,
+    FaultSpec,
+)
+from karpenter_tpu.serving.fleet import FleetFrontend, fleet_debug_surfaces, reset_tenant_labels
+from karpenter_tpu.solver.tpu import RECOVERY_STAGES, TPUSolver
+
+
+@pytest.fixture(autouse=True)
+def _fresh_labels():
+    reset_tenant_labels()
+    yield
+    reset_tenant_labels()
+
+
+def claim_shape(results) -> set:
+    """Placement identity for solver-level parity: pods grouped per claim."""
+    return {frozenset(p.metadata.name for p in nc.pods) for nc in results.new_node_claims}
+
+
+class TestCircuitBreaker:
+    def test_opens_after_k_failures_then_probe_readmits(self):
+        t = [0.0]
+        b = CircuitBreaker(failures_to_open=3, backoff_seconds=1.0, backoff_max=8.0, now_fn=lambda: t[0])
+        assert b.allow() and b.state_name() == "healthy"
+        assert b.record_failure(RuntimeError("a")) is None
+        assert b.record_failure(RuntimeError("b")) is None
+        assert b.allow(), "under K failures the tenant still dispatches"
+        assert b.record_failure(RuntimeError("c")) == "quarantined"
+        assert not b.allow(), "quarantined + backoff pending: no dispatch"
+        t[0] = 1.0
+        assert b.allow(), "backoff elapsed: one half-open probe admitted"
+        assert b.state_name() == "probing"
+        assert not b.allow(), "only ONE probe per window"
+        assert b.record_success() is True
+        assert b.state_name() == "healthy"
+        assert b.snapshot()["backoff_seconds"] == 1.0, "success resets the backoff"
+
+    def test_probe_failure_doubles_backoff_capped(self):
+        t = [0.0]
+        b = CircuitBreaker(failures_to_open=1, backoff_seconds=1.0, backoff_max=3.0, now_fn=lambda: t[0])
+        assert b.record_failure("x") == "quarantined"
+        backoffs = []
+        for _ in range(4):
+            t[0] += b.remaining_backoff() + 1e-9
+            assert b.allow()
+            assert b.record_failure("probe failed") == "quarantined"
+            backoffs.append(b.snapshot()["backoff_seconds"])
+        assert backoffs == [2.0, 3.0, 3.0, 3.0], "exponential, capped"
+        assert b.snapshot()["opens"] == 5
+
+    def test_probe_inconclusive_requarantines_without_doubling(self):
+        t = [0.0]
+        b = CircuitBreaker(failures_to_open=1, backoff_seconds=1.0, now_fn=lambda: t[0])
+        b.record_failure("x")
+        t[0] = 1.0
+        assert b.allow() and b.state_name() == "probing"
+        b.probe_inconclusive()
+        assert b.state_name() == "quarantined"
+        assert b.snapshot()["backoff_seconds"] == 1.0
+        t[0] = 2.0
+        assert b.allow(), "the NEXT window probes again"
+
+
+class TestFaultSpec:
+    def test_rule_schedule_semantics(self):
+        r = FaultRule("watch-drop", at=3, every=4, count=2)
+        fired = 0
+        hits = []
+        for i in range(20):
+            if r.due(i, fired):
+                fired += 1
+                hits.append(i)
+        assert hits == [3, 7], "at + every, bounded by count"
+        one_shot = FaultRule("watch-drop", at=5)
+        assert [i for i in range(10) if one_shot.due(i, 0)] == [5]
+
+    def test_unknown_seam_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault seam"):
+            FaultRule("not-a-seam")
+
+    def test_roundtrip_and_randomized(self):
+        spec = FaultSpec.randomized(seed=5)
+        assert FaultSpec.from_dict(spec.to_dict()) == spec
+        assert FaultSpec.randomized(seed=5) == spec, "seeded: reproducible"
+        assert {r.seam for r in spec.rules} <= set(FAULT_SEAMS)
+
+    def test_reorder_swaps_at_unit_level(self):
+        class _O:
+            kind = "Pod"
+
+        a, b = _O(), _O()
+        fi = FaultInjector(FaultSpec(rules=(FaultRule("watch-reorder", at=0),)))
+        assert fi.on_watch_event("ADDED", a, 1.0) == []
+        out = fi.on_watch_event("ADDED", b, 2.0)
+        assert [o[1] for o in out] == [b, a], "successor delivered first, deferred after"
+        assert fi.take_deferred() is None
+
+
+class TestRecoveryLadder:
+    def _solver_with_faults(self, rules, registry=None):
+        registry = registry or make_registry()
+        solver = TPUSolver(registry=registry)
+        fi = FaultInjector(FaultSpec(rules=tuple(rules)), registry=registry)
+        solver.fault_hook = fi.solver_hook
+        return solver, fi, registry
+
+    def test_solve_exception_recovers_via_full_reencode(self):
+        pods = [make_pod(cpu="1", name=f"r-{i}") for i in range(8)]
+        clean = TPUSolver().solve(make_snapshot(pods))
+        solver, fi, registry = self._solver_with_faults([FaultRule("solve-exception", at=0, ladder=1)])
+        results = solver.solve(make_snapshot(pods))
+        assert claim_shape(results) == claim_shape(clean), "recovered answer matches a clean solver's"
+        assert solver.last_backend == "tpu"
+        assert registry.counter(m.SOLVER_RECOVERY_TOTAL).value(stage="full-reencode") == 1
+        assert registry.counter(m.SOLVER_RECOVERY_TOTAL).value(stage="host-ffd") == 0
+        tr = solver.recorder.last()
+        assert tr.attribution.get("recovery") == "full-reencode"
+        assert "FaultInjected" in tr.attribution.get("recovery_error", "")
+        assert fi.summary() == {"solve-exception": 1}
+
+    def test_double_fault_degrades_to_host_ffd(self):
+        pods = [make_pod(cpu="1", name=f"h-{i}") for i in range(8)]
+        solver, fi, registry = self._solver_with_faults([FaultRule("decode-failure", at=0, ladder=2)])
+        results = solver.solve(make_snapshot(pods))
+        assert solver.last_backend == "ffd-fallback"
+        assert not results.pod_errors
+        assert registry.counter(m.SOLVER_RECOVERY_TOTAL).value(stage="full-reencode") == 1
+        assert registry.counter(m.SOLVER_RECOVERY_TOTAL).value(stage="host-ffd") == 1
+        assert solver.recorder.last().attribution.get("recovery") == "host-ffd"
+        assert tuple(RECOVERY_STAGES) == ("full-reencode", "host-ffd")
+
+    def test_unrecoverable_fault_escapes_the_ladder(self):
+        solver, fi, _ = self._solver_with_faults([FaultRule("solve-exception", at=0, ladder=0)])
+        with pytest.raises(FaultInjected):
+            solver.solve(make_snapshot([make_pod(cpu="1")]))
+
+    def test_force_mode_still_raises(self):
+        registry = make_registry()
+        solver = TPUSolver(force=True, registry=registry)
+        fi = FaultInjector(FaultSpec(rules=(FaultRule("solve-exception", at=0),)))
+        solver.fault_hook = fi.solver_hook
+        with pytest.raises(FaultInjected):
+            solver.solve(make_snapshot([make_pod(cpu="1")]))
+
+    def test_poisoned_carry_never_serves_again_and_delta_rewarns(self):
+        # warm a delta base, fault the next solve, and pin: the recovery
+        # quarantined every cache (the poisoned base cannot serve again),
+        # and the solve AFTER the recovery classifies as delta off the
+        # RECOVERED encode — the re-warm contract
+        pods = [make_pod(cpu="500m", name=f"w-{i}") for i in range(12)]
+        solver, fi, registry = self._solver_with_faults([FaultRule("solve-exception", at=1, ladder=1)])
+        snap = make_snapshot(list(pods))
+        solver.solve(snap)  # warm: full, establishes carry + delta base
+        base_cache = solver.encode_cache
+        assert solver.last_solve_mode == "full"
+        snap.pods.append(make_pod(cpu="500m", name="w-extra"))
+        solver.solve(snap)  # the fault fires here -> ladder recovery
+        assert solver.encode_cache is not base_cache, "quarantine replaced the EncodeCache"
+        assert registry.counter(m.SOLVER_RECOVERY_TOTAL).value(stage="full-reencode") == 1
+        snap.pods.append(make_pod(cpu="500m", name="w-extra2"))
+        results = solver.solve(snap)
+        assert solver.last_solve_mode == "delta", "delta path re-warmed after recovery"
+        assert not results.pod_errors
+
+    def test_slow_solve_injects_latency_only(self):
+        pods = [make_pod(cpu="1", name=f"s-{i}") for i in range(4)]
+        solver, fi, registry = self._solver_with_faults([FaultRule("slow-solve", at=0, arg=0.05)])
+        t0 = time.perf_counter()
+        solver.solve(make_snapshot(pods))
+        assert time.perf_counter() - t0 >= 0.05
+        assert registry.counter(m.SOLVER_RECOVERY_TOTAL).total() == 0
+        assert fi.summary() == {"slow-solve": 1}
+
+
+class TestPrestagerSupervision:
+    def test_worker_death_detected_counted_restarted(self):
+        from karpenter_tpu.kube import Store
+        from karpenter_tpu.serving.prestage import PendingPrestager
+
+        registry = make_registry()
+        p = PendingPrestager()
+        p.metrics = registry
+        p.attach(Store())
+        fi = FaultInjector(FaultSpec(rules=(FaultRule("prestage-death", at=1),)), registry=registry)
+        p.fault_hook = fi.prestage_hook
+        p.start()
+        deadline = time.time() + 5
+        while p.worker_alive() and time.time() < deadline:
+            time.sleep(0.01)
+        assert not p.worker_alive(), "injected SystemExit killed the worker"
+        assert p.worker_running(), "the DEAD thread still holds the handle — the silent-death state"
+        assert p.ensure_worker() is True
+        assert p.worker_alive()
+        assert p.restarts == 1
+        assert registry.counter(m.SOLVER_PRESTAGE_WORKER_RESTARTS_TOTAL).total() == 1
+        assert p.ensure_worker() is False, "a live worker is not restarted"
+        p.stop()
+        assert p.ensure_worker() is False, "a stopped prestager stays stopped"
+
+    def test_serving_loop_supervises_on_pump(self):
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+        from karpenter_tpu.serving.loop import ServingLoop
+
+        env = Environment(options=Options(solver_backend="tpu"))
+        loop = ServingLoop(env.provisioner, env.store, double_buffer=True, worker=True)
+        try:
+            fi = FaultInjector(FaultSpec(rules=(FaultRule("prestage-death", at=0),)), registry=env.registry)
+            loop.prestager.fault_hook = fi.prestage_hook
+            deadline = time.time() + 5
+            while loop.prestager.worker_alive() and time.time() < deadline:
+                time.sleep(0.01)
+            assert not loop.prestager.worker_alive()
+            loop.pump()
+            assert loop.prestager.worker_alive(), "pump's supervisor restarted the worker"
+            assert env.registry.counter(m.SOLVER_PRESTAGE_WORKER_RESTARTS_TOTAL).total() >= 1
+        finally:
+            loop.close()
+
+
+def tiny_spec(**kw) -> ChurnSpec:
+    base = dict(
+        n_base_pods=100,
+        n_types=10,
+        arrivals=24,
+        cancels=18,
+        departures=24,
+        bind_every=2,
+        iterations=4,
+        warmup_cycles=1,
+        concurrent_seconds=0.0,
+    )
+    base.update(kw)
+    return ChurnSpec(**base)
+
+
+class TestWatchStreamFaults:
+    def test_drop_dup_reorder_placements_bit_identical(self):
+        """The store CONTENT is authoritative: a lossy, at-least-once,
+        unordered watch stream must not change placements."""
+        shapes = []
+        for faults in (
+            None,
+            FaultSpec(
+                rules=(
+                    FaultRule("watch-drop", at=20, every=23, count=5),
+                    FaultRule("watch-dup", at=11, every=17, count=5),
+                    FaultRule("watch-reorder", at=5, every=29, count=4),
+                ),
+                seed=3,
+            ),
+        ):
+            h = ChurnHarness(tiny_spec(faults=faults))
+            try:
+                h.run()
+                # settle: a dropped trigger may leave a window un-fired
+                for _ in range(3):
+                    h.solve(force=True)
+                    h.bind_flush()
+                shapes.append(placement_shape(h.env))
+            finally:
+                h.close()
+        assert shapes[0] == shapes[1], "watch faults changed placements"
+
+    def test_store_level_drop_and_dup_counts(self):
+        from karpenter_tpu.kube import Store
+        from karpenter_tpu.kube.objects import ObjectMeta, Pod, PodSpec
+
+        registry = make_registry()
+        fi = FaultInjector(
+            FaultSpec(rules=(FaultRule("watch-drop", at=1), FaultRule("watch-dup", at=3))),
+            registry=registry,
+        )
+        store = Store()
+        seen: list[str] = []
+        store.watch("Pod", lambda e, p: seen.append(p.metadata.name))  # solverlint: ok(thread-escape): single-threaded test callback appending to a local list
+        store.set_fault_injector(fi)
+        for i in range(5):
+            store.create(Pod(metadata=ObjectMeta(name=f"p{i}", namespace="default", uid=f"u{i}"), spec=PodSpec()))
+        # event 1 dropped, event 3 duplicated
+        assert seen == ["p0", "p2", "p3", "p3", "p4"]
+        c = registry.counter(m.SOLVER_FAULT_INJECTIONS_TOTAL)
+        assert c.value(seam="watch-drop") == 1 and c.value(seam="watch-dup") == 1
+        # the store's gap tracker publishes exactly the DROP as loss (the
+        # dup self-heals): this is the level-trigger Provisioner.reconcile
+        # polls to re-converge the Cluster mirror from store content
+        assert store.watch_loss_epoch("Pod") == 1
+
+    def test_loss_epoch_only_counts_drops(self):
+        """Dup and reorder are at-least-once/unordered noise the stream
+        contract absorbs; only a drop — an event that NEVER arrives — may
+        bump the loss epoch and trigger a resync."""
+        from karpenter_tpu.kube import Store
+        from karpenter_tpu.kube.objects import ObjectMeta, Pod, PodSpec
+
+        for rule, lost in (
+            (FaultRule("watch-dup", at=1, every=2, count=3), 0),
+            (FaultRule("watch-reorder", at=1, every=3, count=2), 0),
+            (FaultRule("watch-drop", at=1, every=3, count=2), 2),
+        ):
+            store = Store()
+            store.set_fault_injector(FaultInjector(FaultSpec(rules=(rule,))))
+            for i in range(8):
+                store.create(Pod(metadata=ObjectMeta(name=f"p{i}", namespace="default", uid=f"u{i}"), spec=PodSpec()))
+            assert store.watch_loss_epoch("Pod") == lost, rule.seam
+
+    def test_resync_converges_cluster_after_drop(self):
+        """A dropped bind echo leaves the Cluster mirror stale; the next
+        reconcile's level-triggered resync re-derives it from store content
+        — and with nothing lost, resync_pods mutates nothing."""
+        from karpenter_tpu.operator import Environment
+        from karpenter_tpu.operator.options import Options
+
+        env = Environment(options=Options(solver_backend="host"))
+        # no drift: a resync is a pure read (no generation bump)
+        gen0 = env.cluster.generation
+        assert env.cluster.resync_pods() == (0, 0)
+        assert env.cluster.generation == gen0
+        # drop the NEXT Pod event (a create), then converge
+        env.store.set_fault_injector(FaultInjector(FaultSpec(rules=(FaultRule("watch-drop", at=0),)), registry=env.registry))
+        from helpers import make_pod
+
+        env.store.create(make_pod("lost-pod"))
+        key = "default/lost-pod"
+        with env.cluster._lock:
+            assert key not in env.cluster._pod_rvs, "event was dropped"
+        # even a TAIL drop (no successor seq behind it) is caught at
+        # queue-quiet: the drain compares its watermark against the
+        # committed per-kind seq, so a lost final event can't hide
+        assert env.store.watch_loss_epoch("Pod") == 1
+        assert env.cluster.resync_pods() == (1, 0)
+        with env.cluster._lock:
+            assert key in env.cluster._pod_rvs, "resync converged on store content"
+        # with the injector cleared the DELETED is delivered normally, so
+        # the mirror tracks it at once and resync has nothing to repair
+        env.store.set_fault_injector(None)
+        env.store.try_delete("Pod", "lost-pod", namespace="default")
+        assert env.cluster.resync_pods() == (0, 0)
+
+    def test_reorder_at_tail_is_flushed_never_lost(self):
+        from karpenter_tpu.kube import Store
+        from karpenter_tpu.kube.objects import ObjectMeta, Pod, PodSpec
+
+        fi = FaultInjector(FaultSpec(rules=(FaultRule("watch-reorder", at=2),)))
+        store = Store()
+        seen: list[str] = []
+        store.watch("Pod", lambda e, p: seen.append(p.metadata.name))  # solverlint: ok(thread-escape): single-threaded test callback appending to a local list
+        store.set_fault_injector(fi)
+        for i in range(3):
+            store.create(Pod(metadata=ObjectMeta(name=f"p{i}", namespace="default", uid=f"u{i}"), spec=PodSpec()))
+        assert seen == ["p0", "p1", "p2"], "tail reorder flushed at queue-empty"
+        assert fi.take_deferred() is None
+
+
+class TestFleetFailureDomains:
+    def test_quarantine_isolates_and_probe_readmits(self):
+        """Tenant b's solver hard-fails (unrecoverable); the fleet loop never
+        dies, tenant a keeps serving, b quarantines after K failures, and a
+        backoff probe re-admits b once the fault plan is exhausted."""
+        fleet = FleetFrontend(breaker_failures=2, breaker_backoff_seconds=1.0)
+        try:
+            sa = tiny_spec()
+            sb = tiny_spec(faults=FaultSpec(rules=(FaultRule("solve-exception", at=0, every=1, count=2, ladder=0),)))
+            ha = add_churn_tenant(fleet, "good", sa)
+            hb = add_churn_tenant(fleet, "bad", sb)
+            ha.provision_base_fleet()
+            # drive tenant b: its first K=2 solves raise unrecoverably
+            for _ in range(2):
+                hb.apply_arrivals(8)
+                hb.env.clock.step(sb.batch_idle_seconds + 0.05)
+                fleet.pump(only="bad")  # contained: never raises
+            surf = fleet.debug_tenants()
+            assert surf["bad"]["state"] == "quarantined", surf["bad"]
+            assert surf["bad"]["consecutive_failures"] >= 2
+            assert "FaultInjected" in surf["bad"]["last_error"]
+            assert surf["good"]["state"] == "healthy"
+            assert fleet_debug_surfaces()["bad"]["state"] == "quarantined"
+            # the state gauge + transition counter carry the bounded enum
+            g = fleet.registry.gauge(m.SOLVER_TENANT_STATE)
+            assert g.value(tenant="bad", state="quarantined") == 1.0
+            assert g.value(tenant="bad", state="healthy") == 0.0
+            assert fleet.registry.counter(m.SOLVER_BREAKER_TRANSITIONS_TOTAL).value(tenant="bad", state="quarantined") >= 1
+            assert set(TENANT_STATES) == {"healthy", "quarantined", "probing"}
+            # tenant a is UNAFFECTED: its pump still serves
+            ha.apply_arrivals(8)
+            ha.env.clock.step(sa.batch_idle_seconds + 0.05)
+            assert fleet.pump(only="good"), "healthy tenant starved by b's quarantine"
+            # quarantined: b's window is ready but nothing dispatches
+            hb.apply_arrivals(4)
+            hb.env.clock.step(sb.batch_idle_seconds + 0.05)
+            assert fleet.pump(only="bad") == {}
+            # fault plan exhausted (count=2): advance past the backoff and
+            # the half-open probe re-admits b
+            hb.env.clock.step(1.1)
+            served = fleet.pump(only="bad")
+            assert served.get("bad", 0) >= 1, "probe did not re-admit"
+            assert fleet.debug_tenants()["bad"]["state"] == "healthy"
+            assert fleet.registry.counter(m.SOLVER_BREAKER_TRANSITIONS_TOTAL).value(tenant="bad", state="healthy") == 1
+        finally:
+            fleet.close()
+
+    def test_ladder_absorbs_recoverable_fault_without_tripping_breaker(self):
+        fleet = FleetFrontend(breaker_failures=1)
+        try:
+            sb = tiny_spec(faults=FaultSpec(rules=(FaultRule("solve-exception", at=1, ladder=1),)))
+            hb = add_churn_tenant(fleet, "t", sb)
+            hb.provision_base_fleet()
+            assert fleet.debug_tenants()["t"]["state"] == "healthy", "ladder-recovered fault must not count as a pump failure"
+            assert fleet.registry.counter(m.SOLVER_RECOVERY_TOTAL).value(stage="full-reencode") >= 1
+        finally:
+            fleet.close()
+
+    def test_overload_shed_and_watchdog(self):
+        fleet = FleetFrontend(watchdog_age_seconds=3600.0)
+        try:
+            s = tiny_spec()
+            h = add_churn_tenant(fleet, "hot", s)
+            h.provision_base_fleet()
+            fleet.overload_backlog_cap = 5
+            sess = fleet.session("hot")
+            # flood: way past the backlog cap, then a ready window
+            for i in range(40):
+                sess.env.provisioner.trigger(f"flood-{i}")
+            sess.env.clock.step(s.batch_idle_seconds + 0.05)
+            assert sess.pending() > 5
+            served = fleet.pump(only="hot")
+            assert served == {}, "overloaded tenant must be shed, not served"
+            assert sess.pending() == 0, "shed drops the batch generation"
+            assert fleet.registry.counter(m.SOLVER_FLEET_SHED_TOTAL).value(tenant="hot") >= 40
+            # watchdog bound: with age 0 the next flood is force-served
+            fleet.watchdog_age = 0.0
+            for i in range(40):
+                sess.env.provisioner.trigger(f"flood2-{i}")
+            sess.env.clock.step(s.batch_idle_seconds + 0.05)
+            served = fleet.pump(only="hot")
+            assert served.get("hot", 0) >= 1, "watchdog must bound shedding"
+            assert fleet.registry.counter(m.SOLVER_FLEET_WATCHDOG_TOTAL).value(tenant="hot") >= 1
+        finally:
+            fleet.close()
+
+    def test_pump_contains_arbitrary_loop_exceptions(self):
+        fleet = FleetFrontend(breaker_failures=1)
+        try:
+            h = add_churn_tenant(fleet, "t", tiny_spec())
+            sess = fleet.session("t")
+
+            def boom(force=False):
+                raise RuntimeError("not a solver failure at all")
+
+            sess.loop.pump = boom
+            sess.env.provisioner.trigger("x")
+            sess.env.clock.step(1.0)
+            assert fleet.pump(only="t") == {}, "exception contained at the dispatch seam"
+            assert fleet.debug_tenants()["t"]["state"] == "quarantined"
+        finally:
+            fleet.close()
+
+
+class TestRecordReplayWithFaults:
+    def test_fault_plan_rides_the_log_and_replays(self, tmp_path):
+        path = str(tmp_path / "chaos.jsonl")
+        faults = FaultSpec(
+            rules=(
+                FaultRule("solve-exception", at=6, ladder=1),
+                FaultRule("watch-dup", at=30, every=31, count=3),
+                FaultRule("revocation", at=2, count=1, arg=1),
+            ),
+            seed=11,
+        )
+        h = ChurnHarness(tiny_spec(faults=faults, record_path=path))
+        try:
+            rep = h.run()
+            for _ in range(3):
+                h.solve(force=True)
+                h.bind_flush()
+            shape_recorded = placement_shape(h.env)
+        finally:
+            h.close()
+        assert rep.revoked_nodes == 1
+        assert rep.faults_injected.get("revocation") == 1
+        rspec = ChurnSpec.from_event_log(path)
+        assert rspec.faults is not None and rspec.faults == faults, "plan rides the header"
+        h2 = ChurnHarness(rspec)
+        try:
+            h2.run()
+            for _ in range(3):
+                h2.solve(force=True)
+                h2.bind_flush()
+            assert placement_shape(h2.env) == shape_recorded, "faulted replay diverged"
+            # revocations came from the LOGGED revoke ops, not the plan
+            assert h2.injector is not None
+            assert h2.injector.summary().get("revocation", 0) == 0
+        finally:
+            h2.close()
+
+
+class TestChaosSoak:
+    def test_randomized_faultspec_chaos_soak(self):
+        """The acceptance matrix (tier-1 scale): a 4-tenant fleet under the
+        racecheck sanitizer (suite-wide), one tenant under a randomized
+        seeded FaultSpec covering every seam (solve exception, decode
+        failure, watch drop/dup/reorder, prestager death, revocation) plus
+        an unrecoverable burst that quarantines it; asserts zero fleet-loop
+        deaths, healthy-tenant placements bit-identical to a no-fault fleet
+        run, and post-quarantine delta-hit recovery."""
+        from karpenter_tpu.models.scheduler_model import reset_bucket_highwater
+
+        healthy_ids = ["t0", "t1", "t2"]
+
+        def run_fleet(victim_faults):
+            reset_tenant_labels()
+            fleet = FleetFrontend(breaker_failures=2, breaker_backoff_seconds=0.5)
+            try:
+                harnesses = {tid: add_churn_tenant(fleet, tid, tiny_spec()) for tid in healthy_ids}
+                # the victim runs a LIVE prestager worker so the injected
+                # prestage-death kills (and the supervisor heals) a real
+                # thread under the sanitizer
+                harnesses["victim"] = add_churn_tenant(fleet, "victim", tiny_spec(faults=victim_faults, worker=True))
+                for h in harnesses.values():
+                    h.provision_base_fleet()
+                for _cycle in range(6):
+                    for h in harnesses.values():
+                        h.apply_arrivals(h.spec.arrivals)
+                        h.apply_cancels(h.spec.cancels)
+                        h.env.clock.step(h.spec.batch_idle_seconds + 0.05)
+                    fleet.rearm_ready()
+                    fleet.pump()  # must never raise — zero loop deaths
+                    for h in harnesses.values():
+                        h.apply_departures(h.spec.departures)
+                        if h.injector is not None:
+                            h.apply_revocations(h.injector.take_revocations())
+                        h.bind_flush()
+                # settle (forced; quarantine may have deferred victim work)
+                for _ in range(8):
+                    for h in harnesses.values():
+                        h.env.clock.step(1.0)
+                    fleet.pump(force=True)
+                    for h in harnesses.values():
+                        h.bind_flush()
+                # post-quarantine delta-hit recovery: within a few arrival
+                # batches the re-admitted victim must serve as a delta again.
+                # The victim's solve counter stalled while quarantined, so
+                # residual solver faults from the plan (each bounded by its
+                # rule count, each absorbed by the ladder as an attributed
+                # full re-encode) may still fire here before the plan
+                # exhausts — the loop bound covers the worst-case residue
+                # plus the one legitimate full re-encode for settle churn.
+                hv = harnesses["victim"]
+                victim_mode = ""
+                for _ in range(6):
+                    hv.apply_arrivals(4)
+                    hv.env.clock.step(hv.spec.batch_idle_seconds + 0.05)
+                    fleet.pump(only="victim")
+                    victim_mode = hv.env.provisioner.solver.last_solve_mode
+                    if victim_mode == "delta":
+                        break
+                shapes = {tid: placement_shape(harnesses[tid].env) for tid in healthy_ids}
+                return shapes, placement_shape(hv.env), fleet.debug_tenants(), victim_mode
+            finally:
+                fleet.close()
+
+        base = FaultSpec.randomized(seed=42, solves=24, events=800, cycles=6)
+        chaos = FaultSpec(
+            rules=base.rules + (FaultRule("solve-exception", at=8, every=1, count=2, ladder=0),),
+            seed=base.seed,
+        )
+        shapes_clean, _, _, _ = run_fleet(None)
+        reset_bucket_highwater()
+        shapes_chaos, shape_victim, surf, victim_mode = run_fleet(chaos)
+        assert shapes_chaos == shapes_clean, "chaos leaked across the failure domain"
+        assert surf["victim"]["opens"] >= 1, "the unrecoverable burst never quarantined the victim"
+        assert surf["victim"]["state"] == "healthy", "victim was not re-admitted after the plan exhausted"
+        assert shape_victim, "victim never converged"
+        assert victim_mode == "delta", f"victim's delta path did not re-warm: {victim_mode!r}"
+
+    def test_concurrent_churn_with_faults_under_racecheck(self):
+        """run_concurrent with a live driver thread + prestager death +
+        watch faults, sanitizer ON (conftest): no violations, no loop death,
+        backlog settles."""
+        spec = tiny_spec(
+            worker=True,
+            concurrent_seconds=1.0,
+            faults=FaultSpec(
+                rules=(
+                    FaultRule("prestage-death", at=2),
+                    FaultRule("watch-drop", at=50, every=41, count=4),
+                    FaultRule("watch-dup", at=60, every=43, count=4),
+                    FaultRule("solve-exception", at=10, ladder=1),
+                ),
+                seed=9,
+            ),
+        )
+        h = ChurnHarness(spec)
+        try:
+            rep = h.run()
+            assert rep.concurrent_solves >= 1
+            assert rep.prestage_worker_restarts >= 1, "the dead worker was never healed"
+            assert not h._pending, "backlog did not settle after the chaos segment"
+        finally:
+            h.close()

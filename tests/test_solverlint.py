@@ -1,8 +1,8 @@
-"""Tier-1 gate for solverlint (ISSUE 4 + the ISSUE 11 concurrency rules):
-the repo is clean under all nine rules, each rule catches its seeded fixture
-violation and honors the pragma suppression form, the --self-test discovery
-gate is healthy, and the runtime shape contracts (solver/contracts.py) catch
-seeded drifts."""
+"""Tier-1 gate for solverlint (ISSUE 4 + the ISSUE 11 concurrency rules +
+the ISSUE 15 swallowed-exception rule): the repo is clean under all ten
+rules, each rule catches its seeded fixture violation and honors the pragma
+suppression form, the --self-test discovery gate is healthy, and the runtime
+shape contracts (solver/contracts.py) catch seeded drifts."""
 
 import os
 from pathlib import Path
@@ -45,7 +45,7 @@ class TestRepoGate:
         assert lint_main([str(tmp_path)]) == 2
 
     def test_rule_registry_holds_all_rules(self):
-        assert len(RULES) >= 9
+        assert len(RULES) >= 10
         assert set(RULES) == {
             "shared-array-mutation",
             "host-sync-in-hot-path",
@@ -56,6 +56,7 @@ class TestRepoGate:
             "lock-order",
             "thread-escape",
             "bare-thread-primitive",
+            "swallowed-exception",
         }
 
     def test_shared_field_registry_extraction(self):
@@ -105,17 +106,19 @@ class TestRuleFixtures:
 
     def test_metric_label_cardinality(self):
         findings = _fixture_findings("metric-label-cardinality", "metric_labels.py")
-        assert len(findings) == 5, findings
+        assert len(findings) == 6, findings
         by_msg = [f.message for f in findings]
         # the enumerable-value findings include the fleet tenant-label leak
-        # (a raw tenant id instead of a tenant_label() producer output) and
-        # the podtrace stage-label leak (a runtime span name instead of the
-        # static STAGES enum)
-        assert sum("not statically enumerable" in m for m in by_msg) == 4
+        # (a raw tenant id instead of a tenant_label() producer output), the
+        # podtrace stage-label leak (a runtime span name instead of the
+        # static STAGES enum), and the faultline breaker-state leak (a
+        # runtime breaker attribute instead of the TENANT_STATES enum)
+        assert sum("not statically enumerable" in m for m in by_msg) == 5
         assert sum("splat" in m for m in by_msg) == 1
         src = (FIXTURES / "metric_labels.py").read_text().splitlines()
         assert any("tenant=session.tenant_id" in src[f.line - 1] for f in findings)
         assert any("stage=stage" in src[f.line - 1] for f in findings)
+        assert any("state=breaker.state" in src[f.line - 1] for f in findings)
 
     def test_guarded_field_access(self):
         # a read AND a write outside the declared lock are both findings;
@@ -147,6 +150,18 @@ class TestRuleFixtures:
         assert "thread target self._other" in msgs  # renamed from-import resolved
         assert "watch callback self._on_pod" in msgs
         assert "lambda" in msgs
+
+    def test_swallowed_exception(self):
+        findings = _fixture_findings("swallowed-exception", "swallowed_exception.py")
+        assert len(findings) == 4, findings
+        msgs = " | ".join(f.message for f in findings)
+        # the broad forms are flagged; re-raise, recording (event publish or
+        # metric emission), narrowing, and the pragma are the sanctioned outs
+        assert "except Exception" in msgs
+        assert "<bare except>" in msgs
+        assert "except BaseException" in msgs
+        # tuple form: `except (Exception, OSError):` is just as broad
+        assert "except Exception, OSError" in msgs
 
     def test_bare_thread_primitive(self):
         findings = _fixture_findings("bare-thread-primitive", "bare_primitive.py")
