@@ -1726,7 +1726,7 @@ def bench_consolidation(n_nodes: int):
     return best, extra
 
 
-def _build_consolidation_fleet(n_nodes: int):
+def _build_consolidation_fleet(n_nodes: int, hetero_prices: bool = False):
     """A bench-scale underutilized fleet WITHOUT the O(n^2) e2e build: the
     NodeClaims are fabricated directly in the provisioner's API shape and
     materialized through the REAL kwok provider + lifecycle/registration/
@@ -1735,7 +1735,10 @@ def _build_consolidation_fleet(n_nodes: int):
     disruption side — candidate construction, Consolidatable conditions, the
     consolidation round itself — is the production path, untouched.
     Mixed shapes (2 sizes x 3 zones) keep the LP's compatibility classes and
-    replacement rows non-trivial."""
+    replacement rows non-trivial. hetero_prices=True additionally alternates
+    spot/on-demand capacity per claim (the catalog's 30% spot discount), so
+    the fleet has a real price spread for the global repack objective to
+    exploit instead of a flat on-demand surface."""
     from helpers import make_nodepool, make_pod
     from karpenter_tpu.apis import labels as wk
     from karpenter_tpu.apis.nodeclaim import NodeClaim as APINodeClaim
@@ -1745,18 +1748,26 @@ def _build_consolidation_fleet(n_nodes: int):
     from karpenter_tpu.operator import Environment
     from karpenter_tpu.operator.options import Options
 
-    OD_ONLY = [
+    pool_reqs = [
         {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
         {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
-        {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_ON_DEMAND]},
     ]
+    if not hetero_prices:
+        pool_reqs.append(
+            {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_ON_DEMAND]}
+        )
     env = Environment(options=Options(solver_backend="tpu"))
-    np_ = make_nodepool(requirements=OD_ONLY)
+    np_ = make_nodepool(requirements=pool_reqs)
     np_.spec.disruption.consolidate_after = "30s"
     np_.spec.disruption.budgets = [Budget(nodes="100%")]
     env.store.create(np_)
     zones = ["test-zone-a", "test-zone-b", "test-zone-c"]
     sizes = ["s-2x-amd64-linux", "s-4x-amd64-linux"]
+    cap_types = (
+        [wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND]
+        if hetero_prices
+        else [wk.CAPACITY_TYPE_ON_DEMAND, wk.CAPACITY_TYPE_ON_DEMAND]
+    )
     for i in range(n_nodes):
         claim = APINodeClaim(
             metadata=ObjectMeta(
@@ -1768,7 +1779,7 @@ def _build_consolidation_fleet(n_nodes: int):
                 requirements=[
                     {"key": wk.INSTANCE_TYPE_LABEL_KEY, "operator": "In", "values": [sizes[i % 2]]},
                     {"key": wk.ZONE_LABEL_KEY, "operator": "In", "values": [zones[i % 3]]},
-                    {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [wk.CAPACITY_TYPE_ON_DEMAND]},
+                    {"key": wk.CAPACITY_TYPE_LABEL_KEY, "operator": "In", "values": [cap_types[i % 2]]},
                     {"key": wk.ARCH_LABEL_KEY, "operator": "In", "values": ["amd64"]},
                     {"key": wk.OS_LABEL_KEY, "operator": "In", "values": ["linux"]},
                 ],
@@ -1839,6 +1850,94 @@ def bench_consolidation_lp(n_nodes: int):
     return best, extra
 
 
+def _global_repack_revocation_smoke() -> dict:
+    """The revocation-aware repack gate at fixed smoke scale: build a small
+    churn fleet, reclaim one node out from under it spot-style
+    (ChurnHarness.revoke_node — the workload it carried is gone, survivors
+    and any re-arrived pending mass are what the proposers see), then
+    compare the $/hr each proposer's best EXACT-VALIDATED consolidation
+    command recovers on the shrunken fleet. The joint solve must match or
+    beat the greedy two-phase ladder."""
+    from karpenter_tpu.serving import ChurnHarness, ChurnSpec
+
+    h = ChurnHarness(ChurnSpec(n_base_pods=48, n_types=8, seed=11, concurrent_seconds=0.0)).build()
+    try:
+        h.provision_base_fleet()
+        # drain half the workload first: a freshly provisioned fleet is
+        # bin-packed tight, so without departures both proposers would
+        # vacuously report 0 — the gate needs real slack to recover
+        h.apply_departures(h.spec.n_base_pods // 2)
+        names = sorted(nd.metadata.name for nd in h.env.store.borrow_list("Node"))
+        assert names, "churn fleet built no nodes"
+        h.revoke_node(names[0])
+        two = h.repack_savings(mode="two-phase")
+        glob = h.repack_savings(mode="global")
+    finally:
+        h.close()
+    return {
+        "revoke_two_phase_savings_per_hour": round(two, 4),
+        "revoke_global_savings_per_hour": round(glob, 4),
+        "revoke_gate": "PASS" if glob >= two - 1e-6 else "FAIL",
+    }
+
+
+def bench_global_repack(n_nodes: int):
+    """ISSUE 16 (BENCH_r11): ONE joint provisioning+retirement decision —
+    the globalpack convex solve co-optimizing pending placement and node
+    retirement, host rounding, and masked sub-encode exact validation until
+    a command is accepted — on a heterogeneous-price (spot/on-demand) fleet
+    through the production MultiNodeConsolidation._globalpack_option path.
+    Headline metric: `global_repack_<n>nodes_e2e_seconds` (best of 2 warm
+    rounds), gated < 5s at the canonical 5000-node scale with zero warm
+    recompiles sentinel-verified, PLUS the objective gate: the global
+    solve's exact-validated savings must be >= the two-phase baseline on
+    the same fleet, and the revocation smoke must recover >= two-phase
+    $/hr after a spot reclaim."""
+    from karpenter_tpu.controllers.disruption.methods import (
+        MultiNodeConsolidation,
+        _command_savings_per_hour,
+    )
+    from karpenter_tpu.obs.trace import sentinel
+
+    env = _build_consolidation_fleet(n_nodes, hetero_prices=True)
+    cands = env.disruption.get_candidates()
+    assert len(cands) >= n_nodes * 0.9, f"only {len(cands)} candidates"
+    ctx = env.disruption.ctx
+    ctx.round_candidates = cands
+    ctx.node_pool_totals = None
+    m = MultiNodeConsolidation(ctx)
+    deadline = env.clock.now() + 1e9  # wall time is the measurement, not the budget
+    # the two-phase baseline the global solve must not lose to: the greedy
+    # LP ladder on the SAME fleet, scored by the one production savings
+    # accounting both arms share
+    two_cmd = m._lp_option(cands, deadline)
+    savings_two_phase = _command_savings_per_hour(two_cmd) if two_cmd.candidates else 0.0
+    cmd = m._globalpack_option(cands, deadline)  # cold: jit compiles allowed
+    assert cmd.candidates, "global repack found no command on an idle hetero fleet"
+    jit_before = sentinel().snapshot()
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        cmd = m._globalpack_option(cands, deadline)
+        best = min(best, time.perf_counter() - t0)
+    recompiles = sentinel().delta(jit_before)
+    savings_global = _command_savings_per_hour(cmd)
+    extra = {
+        "n_candidates": len(cands),
+        "command_size": len(cmd.candidates),
+        "global_savings_per_hour": round(savings_global, 4),
+        "two_phase_savings_per_hour": round(savings_two_phase, 4),
+        "warm_recompiles": recompiles,
+        "zero_warm_recompiles": "PASS" if not recompiles else "FAIL",
+        "objective_gate": "PASS" if savings_global >= savings_two_phase - 1e-6 else "FAIL",
+        "gate": "PASS" if best < 5.0 or n_nodes < 5000 else "FAIL",
+    }
+    extra.update(_global_repack_revocation_smoke())
+    if n_nodes >= 5000 and best >= 5.0:
+        print(f"GLOBAL REPACK 5K GATE FAILED: {best:.2f}s >= 5s", file=sys.stderr)
+    return best, extra
+
+
 def _command_savings(cmd) -> float:
     """Hourly price removed minus the replacement's launch price — the ONE
     savings accounting (methods._command_savings_per_hour), so the bench's
@@ -1858,6 +1957,9 @@ def main():
         os.environ.setdefault("BENCH_NODES", "12")
         # the 5k LP consolidation scenario's 1/20-scale smoke variant
         os.environ.setdefault("BENCH_CONS_LP_NODES", "256")
+        # global_repack (BENCH_r11): same 1/20 smoke scale on the
+        # heterogeneous-price fleet, incl. the revocation smoke gate
+        os.environ.setdefault("BENCH_GLOBALPACK_NODES", "256")
         os.environ.setdefault("BENCH_FALLBACK_PODS", "500")
         os.environ.setdefault("BENCH_SKIP_XL", "1")
         os.environ.setdefault("BENCH_SKIP_SHARDED", "1")
@@ -1905,6 +2007,7 @@ def main():
         os.environ.setdefault("BENCH_TYPES", "100")
         os.environ.setdefault("BENCH_NODES", "24")
         os.environ.setdefault("BENCH_CONS_LP_NODES", "128")
+        os.environ.setdefault("BENCH_GLOBALPACK_NODES", "128")
         os.environ.setdefault("BENCH_SKIP_XL", "1")
         os.environ.setdefault("BENCH_SKIP_SHARDED", "1")
         os.environ.setdefault("BENCH_WORST_TARGET", "1e9")
@@ -1942,6 +2045,11 @@ def main():
     # synthetic fleet (smoke runs the 1/20-scale 256-node variant)
     n_lp_nodes = int(os.environ.get("BENCH_CONS_LP_NODES", "5000"))
     cons_lp = _run_scenario("consolidation_lp", bench_consolidation_lp, n_lp_nodes)
+    # global_repack (BENCH_r11): the joint provisioning+retirement convex
+    # solve on a heterogeneous-price fleet — warm wall time, objective >=
+    # two-phase, zero warm recompiles, and the revocation smoke gate
+    n_gp_nodes = int(os.environ.get("BENCH_GLOBALPACK_NODES", "5000"))
+    gp = _run_scenario("global_repack", bench_global_repack, n_gp_nodes)
     # the same scale with 15% required-pod-affinity pods, still on-device
     aff = _run_scenario("affinity", bench_affinity, n_pods, n_types)
     if aff is not None:
@@ -2157,6 +2265,10 @@ def main():
         lp_secs, lp_extra = cons_lp
         extra[f"consolidation_{n_lp_nodes}nodes_e2e_seconds"] = round(lp_secs, 4)
         extra.update({f"consolidation_lp_{k}": v for k, v in lp_extra.items()})
+    if gp is not None:
+        gp_secs, gp_extra = gp
+        extra[f"global_repack_{n_gp_nodes}nodes_e2e_seconds"] = round(gp_secs, 4)
+        extra.update({f"global_repack_{k}": v for k, v in gp_extra.items()})
     _emit_result()
 
 

@@ -440,20 +440,19 @@ class MetricLabelCardinalityRule(Rule):
     description = "bounded metric labels must carry statically enumerable values"
     _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
 
-    # the seeded violation is a faultline breaker-state one: the breaker
-    # transitions counter's `state` label fed a runtime breaker attribute
-    # instead of a literal from the static serving.faults.TENANT_STATES
-    # enum (and the tenant label a raw id instead of a tenant_label()
-    # output) — exactly the cardinality leak the failure-domain metrics
+    # the seeded violation is a globalpack one: the consolidation proposals
+    # counter's `proposer` label fed a runtime trace attribute instead of a
+    # literal from the static proposer enum (lp | anneal | binary-search |
+    # globalpack) — exactly the cardinality leak the global-repack rollout
     # must never regress into
     SELF_TEST_BAD = (
-        "def publish(registry, breaker):\n"
-        '    registry.counter("karpenter_solver_breaker_transitions_total").inc(tenant=breaker.tenant_id, state=breaker.state)\n'
+        "def publish(registry, trace):\n"
+        '    registry.counter("karpenter_solver_consolidation_proposals_total").inc(8, proposer=trace.backend)\n'
     )
     SELF_TEST_OK = (
-        "def publish(registry, breaker):\n"
-        '    state = "quarantined" if breaker.open else "healthy"\n'
-        '    registry.counter("karpenter_solver_breaker_transitions_total").inc(tenant=tenant_label(breaker.tenant_id), state=state)\n'
+        "def publish(registry, trace):\n"
+        '    proposer = "globalpack" if trace.backend == "globalpack" else "lp"\n'
+        '    registry.counter("karpenter_solver_consolidation_proposals_total").inc(8, proposer=proposer)\n'
     )
 
     def __init__(self):

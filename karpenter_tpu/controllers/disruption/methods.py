@@ -367,7 +367,16 @@ class MultiNodeConsolidation(_ConsolidationBase):
     the cost-sorted prefix (multinodeconsolidation.go:52-191),
     `KARPENTER_CONSOLIDATE_LP=anneal` the r02 annealed subset search; the
     binary search also remains the in-band fallback whenever the device
-    proposer produces no valid command."""
+    proposer produces no valid command.
+
+    OPT-IN GLOBAL REPACK (`KARPENTER_SOLVER_GLOBALPACK=1`): one convex solve
+    (models/globalpack via solver/consolidation.propose_subsets_global)
+    co-optimizes pending-pod placement and node retirement — the round's
+    pending pods enter the relaxation as unconditionally-placed class mass,
+    so retirement choices see the provisioning they'd force. Defaults OFF,
+    in which case this path is never entered and behavior is bit-identical
+    to the two-phase default; when the global proposer yields no valid
+    command the two-phase ladder below still runs unchanged."""
 
     consolidation_type = "multi"
 
@@ -398,13 +407,19 @@ class MultiNodeConsolidation(_ConsolidationBase):
         # exact-validated through the same simulation before use (stage 8)
         cmd = Command()
         lp_mode = os.environ.get("KARPENTER_CONSOLIDATE_LP", "1").strip().lower()
+        gp_mode = os.environ.get("KARPENTER_SOLVER_GLOBALPACK", "0").strip().lower()
         if getattr(self.ctx.options, "solver_backend", "ffd") == "tpu" and lp_mode not in ("0", "false", "off"):
-            if lp_mode == "anneal":
-                cmd = self._annealed_option(filtered_bs, deadline)
-            else:
-                cmd = self._lp_option(filtered, deadline)
-            if not (cmd.candidates and self._passes_balanced(cmd)):
-                cmd = Command()
+            if gp_mode in ("1", "true", "on"):
+                cmd = self._globalpack_option(filtered, deadline)
+                if not (cmd.candidates and self._passes_balanced(cmd)):
+                    cmd = Command()
+            if not cmd.candidates:
+                if lp_mode == "anneal":
+                    cmd = self._annealed_option(filtered_bs, deadline)
+                else:
+                    cmd = self._lp_option(filtered, deadline)
+                if not (cmd.candidates and self._passes_balanced(cmd)):
+                    cmd = Command()
         if not cmd.candidates:
             if self.ctx.clock.now() > deadline:
                 # the device stage consumed the whole budget (and counted
@@ -481,6 +496,74 @@ class MultiNodeConsolidation(_ConsolidationBase):
                         if ctx.metrics is not None:
                             ctx.metrics.gauge(m.SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR).set(
                                 _command_savings_per_hour(cmd), proposer="lp"
+                            )
+                        trace.note(accepted_subset=len(subset))
+                        return cmd
+            return Command()
+        finally:
+            trace.note(
+                sim_masked=reuse.masked_probes,
+                sim_scratch=reuse.scratch_probes,
+                sim_why_scratch=reuse.why_scratch,
+            )
+            recorder.commit(trace, registry=ctx.metrics)
+
+    def _globalpack_option(self, candidates, deadline: float) -> Command:
+        """The opt-in GLOBAL repack proposer (KARPENTER_SOLVER_GLOBALPACK=1):
+        one convex solve over pending placement + retirement, then the same
+        per-proposal exact validation ladder as `_lp_option` — the round's
+        ConsolidationSimulator already carries the pending pods in every
+        probe, so an accepted command is exact for BOTH sides of the joint
+        objective. Publishes the bounded karpenter_solver_globalpack_*
+        family and rides the proposer="globalpack" enum value."""
+        import logging
+
+        from ... import metrics as m
+        from ...obs.trace import default_recorder
+        from ...solver.consolidation import LP_SOLVE_ITERATIONS, propose_subsets_global
+        from ...solver.simulate import ConsolidationSimulator
+
+        ctx = self.ctx
+        solver = ctx.provisioner.solver
+        recorder = getattr(solver, "recorder", None) or default_recorder()
+        trace = recorder.begin(n_pods=sum(len(c.reschedulable_pods) for c in candidates))
+        trace.mode = "consolidate"
+        trace.backend = "globalpack"
+        reuse = ConsolidationSimulator(ctx.provisioner, ctx.cluster, ctx.clock, candidates)
+        try:
+            its = self._candidate_instance_types(candidates)
+            pending = ctx.provisioner.get_pending_pods()
+            try:
+                proposals, info = propose_subsets_global(candidates, its, pending_pods=pending, trace=trace)
+            except (ValueError, TypeError, RuntimeError) as e:
+                logging.getLogger("karpenter.disruption").warning(
+                    "global repack failed, falling back to two-phase: %s", e
+                )
+                return Command()
+            if ctx.metrics is not None:
+                ctx.metrics.counter(m.SOLVER_GLOBALPACK_ROUNDS_TOTAL).inc()
+                ctx.metrics.counter(m.SOLVER_GLOBALPACK_ITERATIONS_TOTAL).inc(LP_SOLVE_ITERATIONS)
+                ctx.metrics.gauge(m.SOLVER_GLOBALPACK_OBJECTIVE_IMPROVEMENT).set(info["objective_improvement"])
+                if proposals:
+                    ctx.metrics.counter(m.SOLVER_CONSOLIDATION_PROPOSALS_TOTAL).inc(
+                        len(proposals), proposer="globalpack"
+                    )
+            with trace.span("validate", proposals=len(proposals)):
+                for subset in proposals:
+                    if ctx.clock.now() > deadline:
+                        self._count_timeout()
+                        return Command()
+                    chosen = [candidates[i] for i in subset]
+                    cmd = self.compute_consolidation(chosen, reuse=reuse)
+                    accepted = bool(cmd.candidates) and not self._is_pointless_churn(cmd)
+                    if ctx.metrics is not None:
+                        ctx.metrics.counter(m.SOLVER_CONSOLIDATION_VALIDATION_TOTAL).inc(
+                            decision="accept" if accepted else "reject"
+                        )
+                    if accepted:
+                        if ctx.metrics is not None:
+                            ctx.metrics.gauge(m.SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR).set(
+                                _command_savings_per_hour(cmd), proposer="globalpack"
                             )
                         trace.note(accepted_subset=len(subset))
                         return cmd

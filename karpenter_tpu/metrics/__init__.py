@@ -86,12 +86,20 @@ SOLVER_CHURN_QUEUE_DEPTH = "karpenter_solver_churn_queue_depth"
 SOLVER_CHURN_EVENTS_PER_SOLVE = "karpenter_solver_churn_events_per_solve"
 SOLVER_CHURN_EVENTS_TOTAL = "karpenter_solver_churn_events_total"
 # tensor-native consolidation (the relaxed-LP repack + masked simulations):
-# proposer is the bounded {lp | anneal | binary-search} enum, decision the
-# exact-validation verdict {accept | reject}
+# proposer is the bounded {lp | anneal | binary-search | globalpack} enum,
+# decision the exact-validation verdict {accept | reject}
 SOLVER_CONSOLIDATION_PROPOSALS_TOTAL = "karpenter_solver_consolidation_proposals_total"
 SOLVER_CONSOLIDATION_LP_ITERATIONS_TOTAL = "karpenter_solver_consolidation_lp_iterations_total"
 SOLVER_CONSOLIDATION_VALIDATION_TOTAL = "karpenter_solver_consolidation_validation_total"
 SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR = "karpenter_solver_consolidation_savings_per_hour"
+# globalpack (models/globalpack): the joint provisioning + consolidation
+# convex solve behind KARPENTER_SOLVER_GLOBALPACK. All label-free or riding
+# the bounded proposer enum above — one rounds counter per global solve, the
+# iterations spent inside it, and the newest solve's discrete objective
+# improvement over the empty delete-set (the two-phase-equivalent base).
+SOLVER_GLOBALPACK_ROUNDS_TOTAL = "karpenter_solver_globalpack_rounds_total"
+SOLVER_GLOBALPACK_ITERATIONS_TOTAL = "karpenter_solver_globalpack_iterations_total"
+SOLVER_GLOBALPACK_OBJECTIVE_IMPROVEMENT = "karpenter_solver_globalpack_objective_improvement"
 # fleet front-end (serving/fleet.py): one solver process multiplexing many
 # tenant clusters. `tenant` is the BOUNDED fleet label (serving.fleet
 # tenant_label: the first registrations keep their sanitized ids, the rest
@@ -291,8 +299,23 @@ def make_registry() -> Registry:
     r.counter(
         SOLVER_CONSOLIDATION_PROPOSALS_TOTAL,
         "Candidate delete-sets proposed per consolidation round, by proposer "
-        "(lp | anneal | binary-search)",
+        "(lp | anneal | binary-search | globalpack)",
         ("proposer",),
+    )
+    r.counter(
+        SOLVER_GLOBALPACK_ROUNDS_TOTAL,
+        "Joint provisioning+consolidation global repack solves run",
+        (),
+    )
+    r.counter(
+        SOLVER_GLOBALPACK_ITERATIONS_TOTAL,
+        "Projected-gradient iterations spent by the global repack (inits x steps per solve)",
+        (),
+    )
+    r.gauge(
+        SOLVER_GLOBALPACK_OBJECTIVE_IMPROVEMENT,
+        "Newest global solve's discrete objective improvement over the empty delete-set base",
+        (),
     )
     r.counter(
         SOLVER_CONSOLIDATION_LP_ITERATIONS_TOTAL,

@@ -141,107 +141,22 @@ def anneal(t: ConsolidationTensors, key, n_chains: int = 64, n_steps: int = 512)
 
 
 # -- relaxed-LP repack ---------------------------------------------------------
-
-# replacement-row sentinel prices (BIG) clamp to this inside the LP so the
-# fractional cost stays finite/differentiable; rounded subsets are re-scored
-# by the discrete objective (which keeps the true BIG infeasibility) anyway
-_LP_PRICE_CAP = jnp.float32(1e6)
-
-
-def _lp_objective(t: ConsolidationTensors, onehot, compat_qn, d, y, yr, inv_alloc, norm_r, price_safe):
-    """The relaxed repack objective (maximize). d [N] fractional deletion;
-    y [Q, Nsink=N] routes class-q displaced mass onto surviving nodes, yr
-    [Q, T] onto replacement rows; rows of (y | yr) live on the simplex.
-
-    savings  = sum_i d_i * price_i  -  churn_weight * sum_i d_i * cost_i
-    rep cost = sum_t price_t * z_t,  z_t = max_r (routed mass)_tr / alloc_tr
-               (the fractional count of replacement nodes of row t needed)
-    capacity = quadratic hinge on routed mass exceeding surviving slack
-               (1 - d_j) * slack_jr, per resource, normalized per axis
-    """
-    keep = 1.0 - d
-    disp = jnp.einsum("nq,nr->qr", onehot * d[:, None], t.node_used)  # [Q, R] displaced mass per class
-    routed = jnp.einsum("qn,qr->nr", y * compat_qn, disp)  # [N, R] mass onto node j
-    over = jnp.maximum(routed - keep[:, None] * t.node_slack, 0.0) * norm_r[None, :]
-    cap_pen = jnp.sum(over * over)
-    rep = jnp.einsum("qt,qr->tr", yr, disp)  # [T, R]
-    z = jnp.max(rep * inv_alloc, axis=1)  # [T] fractional replacement count
-    rep_cost = jnp.sum(price_safe * z)
-    # unrouted displaced mass (compat-zeroed routes renormalize on projection,
-    # but the gradient step can momentarily leave the simplex): penalize so
-    # "vanishing" pods can never fund savings
-    route_total = jnp.sum(y * compat_qn, axis=1) + jnp.sum(yr, axis=1)  # [Q]
-    class_mass = jnp.sum(disp * norm_r[None, :], axis=1)  # [Q]
-    unrouted_pen = jnp.sum(jnp.maximum(1.0 - route_total, 0.0) * class_mass)
-    savings = jnp.sum(d * t.node_price) - t.churn_weight * jnp.sum(d * t.node_cost)
-    return savings - rep_cost - 10.0 * cap_pen - 10.0 * unrouted_pen
-
-
-def _lp_project(y, yr, compat_qn):
-    """Project routing rows back onto {>=0, compat-masked, sum == 1}."""
-    y = jnp.maximum(y, 0.0) * compat_qn
-    yr = jnp.maximum(yr, 0.0)
-    s = jnp.sum(y, axis=1, keepdims=True) + jnp.sum(yr, axis=1, keepdims=True)
-    scale = 1.0 / jnp.maximum(s, 1e-9)
-    return y * scale, yr * scale
-
-
-@partial(jax.jit, static_argnames=("n_iters",))
-def _lp_repack_impl(t: ConsolidationTensors, onehot, compat_qn, keys, n_iters: int = 300):
-    """Projected-gradient (Adam) ascent on the relaxed repack, vmapped over
-    an explicit key batch of independent random inits. Returns
-    (d [C, N], score [C]) — the host thresholds/rounds d into candidate
-    subsets and re-scores them with the discrete objective."""
-    N = t.node_price.shape[0]
-    Q = onehot.shape[1]
-    T = t.row_price.shape[0]
-    price_safe = jnp.minimum(t.row_price, _LP_PRICE_CAP)
-    inv_alloc = jnp.where(t.row_alloc > 0, 1.0 / jnp.maximum(t.row_alloc, 1e-9), _LP_PRICE_CAP)
-    # per-resource normalization so cpu-milli and byte-scaled axes penalize
-    # comparably regardless of unit
-    scale_r = jnp.maximum(jnp.max(t.node_used, axis=0, initial=0.0), jnp.max(t.node_slack, axis=0, initial=0.0))
-    norm_r = 1.0 / jnp.maximum(scale_r, 1e-9)
-
-    grad_fn = jax.grad(
-        lambda d, y, yr: -_lp_objective(t, onehot, compat_qn, d, y, yr, inv_alloc, norm_r, price_safe),
-        argnums=(0, 1, 2),
-    )
-
-    def one_init(key):
-        k_d, k_y = jax.random.split(key)
-        d = jax.random.uniform(k_d, (N,), minval=0.05, maxval=0.95)
-        y = jax.random.uniform(k_y, (Q, N), minval=0.1, maxval=1.0)
-        yr = jnp.full((Q, T), 0.5)
-        y, yr = _lp_project(y, yr, compat_qn)
-        # Adam state per variable
-        zeros = (jnp.zeros_like(d), jnp.zeros_like(y), jnp.zeros_like(yr))
-        b1, b2, lr, eps = 0.9, 0.999, 0.05, 1e-8
-
-        def step(i, carry):
-            d, y, yr, m, v = carry
-            g = grad_fn(d, y, yr)
-            it = i + 1
-            m = tuple(b1 * mi + (1 - b1) * gi for mi, gi in zip(m, g))
-            v = tuple(b2 * vi + (1 - b2) * gi * gi for vi, gi in zip(v, g))
-            corr1 = 1 - b1**it
-            corr2 = 1 - b2**it
-            upd = tuple((mi / corr1) / (jnp.sqrt(vi / corr2) + eps) for mi, vi in zip(m, v))
-            d = jnp.clip(d - lr * upd[0], 0.0, 1.0)
-            y, yr = _lp_project(y - lr * upd[1], yr - lr * upd[2], compat_qn)
-            return (d, y, yr, m, v)
-
-        d, y, yr, _, _ = jax.lax.fori_loop(0, n_iters, step, (d, y, yr, zeros, zeros))
-        return d, _lp_objective(t, onehot, compat_qn, d, y, yr, inv_alloc, norm_r, price_safe)
-
-    return jax.vmap(one_init)(keys)
+#
+# The relaxed repack kernels were PROMOTED to `models/globalpack` (ISSUE 16):
+# the same convex relaxation now co-optimizes pending-pod placement and node
+# retirement in one solve. Consolidation-only callers keep these entry points,
+# which delegate at the degenerate point (zero pending mass, unit unplaced
+# weights) — exactly the old objective, sharing one jit cache with the global
+# mode so warm rounds of either caller never retrace.
 
 
 def lp_repack(t: ConsolidationTensors, onehot, compat_qn, key, n_inits: int = 8, n_iters: int = 300):
     """Run the relaxed-LP repack from `n_inits` independent starts; returns
     (d [n_inits, N] fractional deletions, score [n_inits])."""
-    import jax.random as jr
+    from .globalpack import global_repack, zero_pending
 
-    return _lp_repack_impl(t, onehot, compat_qn, jr.split(key, n_inits), n_iters)
+    pend_mass, pend_weight = zero_pending(onehot.shape[1], t.node_used.shape[1])
+    return global_repack(t, onehot, compat_qn, pend_mass, pend_weight, key, n_inits=n_inits, n_iters=n_iters)
 
 
 # host rounding evaluates up to this many candidate subsets per LP solve in
@@ -249,59 +164,19 @@ def lp_repack(t: ConsolidationTensors, onehot, compat_qn, key, n_inits: int = 8,
 LP_SCORE_BATCH = 32
 
 
-def _objective_factored(t: ConsolidationTensors, onehot, compat_nq, x):
-    """`_objective` with the compatibility matrix in FACTORED form:
-    compat[j, i] == compat_nq[j, class(i)] with onehot the class indicator.
-    Exactly equivalent for every kept node j (a deleted j's slack is zeroed
-    by the keep factor, so the dense form's zero diagonal never matters) —
-    and O(N x Q) instead of O(N^2), which is what lets the scorer run on
-    full 5k-node fleets without materializing the dense matrix."""
-    xf = x.astype(jnp.float32)
-    keep = 1.0 - xf
-
-    displaced = (t.node_used * xf[:, None]).sum(axis=0)  # [R]
-    n_displaced = jnp.maximum((t.node_npods * xf).sum(), 1.0)
-    avg_pod = displaced / n_displaced
-    deleted_class = jnp.max(onehot * xf[:, None], axis=0)  # [Q]
-    compat_to_any_deleted = jnp.max(compat_nq * deleted_class[None, :], axis=1)  # [N]
-    can_host_one = jnp.all(t.node_slack >= avg_pod[None, :], axis=1).astype(jnp.float32)
-    usable_slack = (t.node_slack * (keep * compat_to_any_deleted * can_host_one)[:, None]).sum(axis=0)
-
-    shortfall = jnp.maximum(displaced - usable_slack, 0.0)
-    needs_replacement = jnp.any(shortfall > 0)
-    row_fits = jnp.all(t.row_alloc >= shortfall[None, :], axis=1)
-    row_cost = jnp.where(row_fits, t.row_price, BIG)
-    best_row_cost = jnp.min(row_cost)
-    replacement_cost = jnp.where(needs_replacement, best_row_cost, 0.0)
-    feasible = jnp.logical_or(~needs_replacement, best_row_cost < BIG)
-
-    savings = (t.node_price * xf).sum() - replacement_cost
-    churn = t.churn_weight * (t.node_cost * xf).sum()
-    score = jnp.where(feasible, savings - churn, -BIG)
-    return score, feasible
-
-
-@jax.jit
-def _score_subsets_impl(t: ConsolidationTensors, onehot, compat_nq, X):
-    """X [M, N] bool delete-sets -> (score [M], feasible [M]) under the
-    DISCRETE relaxed objective (factored-compat form) — the same feasibility
-    the annealer optimizes, so LP-rounded and annealed proposals rank on one
-    scale."""
-    return jax.vmap(lambda x: _objective_factored(t, onehot, compat_nq, x))(X)
-
-
 def score_subsets(t: ConsolidationTensors, onehot, compat_nq, X):
     """Batch-score candidate delete-sets (host rounding helper); pads the
     batch axis to LP_SCORE_BATCH so repeated rounds never retrace."""
-    import numpy as np
+    from .globalpack import score_subsets_global
 
-    X = np.asarray(X, dtype=bool)
-    m = X.shape[0]
-    pad = ((0, LP_SCORE_BATCH - (m % LP_SCORE_BATCH or LP_SCORE_BATCH)), (0, 0))
-    Xp = np.pad(X, pad) if pad[0][1] else X
-    scores, feas = [], []
-    for i in range(0, Xp.shape[0], LP_SCORE_BATCH):
-        s, f = _score_subsets_impl(t, onehot, compat_nq, jnp.asarray(Xp[i : i + LP_SCORE_BATCH]))
-        scores.append(np.asarray(s))
-        feas.append(np.asarray(f))
-    return np.concatenate(scores)[:m], np.concatenate(feas)[:m]
+    R = t.node_used.shape[1]
+    Q = onehot.shape[1]
+    return score_subsets_global(
+        t,
+        onehot,
+        compat_nq,
+        jnp.zeros((R,), dtype=jnp.float32),
+        jnp.float32(0.0),
+        jnp.zeros((Q,), dtype=jnp.float32),
+        X,
+    )

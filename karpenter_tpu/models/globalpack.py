@@ -1,0 +1,237 @@
+"""globalpack: ONE convex relaxation for provisioning + consolidation.
+
+The CvxCluster-style relaxed repack (PAPERS.md "Solving Large, Complex,
+Granular Resource Allocation Problems 100-1000x Faster") promoted out of the
+consolidation-only proposer into the shared relaxed-solve core. Decision
+variables cover, simultaneously:
+
+* fractional node deletion ``d[i] in [0, 1]`` per retirement candidate,
+* fractional routing ``y[q, j]`` of class-q pod mass onto surviving node j,
+* fractional routing ``yr[q, t]`` onto replacement (offering) row t,
+
+where the class axis q now spans BOTH the displaced mass of candidate nodes
+(mass appears only as d_i rises — consolidation) AND the pending-pod mass
+that must be placed regardless of any deletion (provisioning). The objective
+maximizes price savings minus churn minus fractional replacement cost under
+per-resource capacity hinges, with an unplaced-mass hinge weighted per class
+(`pend_weight`) so savings can never be funded by dropping pending pods.
+
+With ``pend_mass == 0`` and ``pend_weight == 1`` every term reduces exactly
+to the consolidation-only repack (0 + x and x * 1.0 are exact in fp32), so
+`models/consolidation_model.lp_repack` / `score_subsets` delegate here and
+share ONE jit cache with the global mode — warm rounds of either caller
+record zero recompiles (JIT_WATCHLIST `lp_repack` / `lp_score`).
+
+Everything device-side remains a RELAXATION: rounded delete-subsets are
+re-scored by the discrete factored objective and then exact-validated on the
+host through `compute_consolidation` -> `simulate_scheduling` (whose probes
+already carry the pending pods) before any command exists.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .consolidation_model import BIG, ConsolidationTensors
+
+# replacement-row sentinel prices (BIG) clamp to this inside the relaxation
+# so the fractional cost stays finite/differentiable; rounded subsets are
+# re-scored by the discrete objective (which keeps the true BIG
+# infeasibility) anyway
+_PRICE_CAP = jnp.float32(1e6)
+
+# unplaced-mass hinge weight for PENDING classes (displaced classes weigh
+# 1.0): large enough that no price saving in the normalized objective can
+# fund leaving pending mass unrouted
+PENDING_WEIGHT = 100.0
+
+
+def _gp_objective(t: ConsolidationTensors, onehot, compat_qn, pend_mass, pend_weight, d, y, yr, inv_alloc, norm_r, price_safe):
+    """The relaxed global-repack objective (maximize). d [N] fractional
+    deletion; y [Q, Nsink=N] routes class-q mass onto surviving nodes, yr
+    [Q, T] onto replacement rows; rows of (y | yr) live on the simplex.
+
+    savings  = sum_i d_i * price_i  -  churn_weight * sum_i d_i * cost_i
+    rep cost = sum_t price_t * z_t,  z_t = max_r (routed mass)_tr / alloc_tr
+               (the fractional count of replacement nodes of row t needed —
+               this is where provisioning cost for pending mass lands)
+    capacity = quadratic hinge on routed mass exceeding surviving slack
+               (1 - d_j) * slack_jr, per resource, normalized per axis
+    unplaced = per-class hinge on mass that routes nowhere, weighted by
+               `pend_weight` (1.0 displaced, PENDING_WEIGHT pending)
+    """
+    keep = 1.0 - d
+    # class mass: the pending component is unconditional; the displaced
+    # component materializes as its node's fractional deletion rises
+    disp = pend_mass + jnp.einsum("nq,nr->qr", onehot * d[:, None], t.node_used)  # [Q, R]
+    routed = jnp.einsum("qn,qr->nr", y * compat_qn, disp)  # [N, R] mass onto node j
+    over = jnp.maximum(routed - keep[:, None] * t.node_slack, 0.0) * norm_r[None, :]
+    cap_pen = jnp.sum(over * over)
+    rep = jnp.einsum("qt,qr->tr", yr, disp)  # [T, R]
+    z = jnp.max(rep * inv_alloc, axis=1)  # [T] fractional replacement count
+    rep_cost = jnp.sum(price_safe * z)
+    # unrouted mass (compat-zeroed routes renormalize on projection, but the
+    # gradient step can momentarily leave the simplex): penalize so
+    # "vanishing" pods can never fund savings — and pending classes carry
+    # the heavy weight so provisioning can't be skipped
+    route_total = jnp.sum(y * compat_qn, axis=1) + jnp.sum(yr, axis=1)  # [Q]
+    class_mass = jnp.sum(disp * norm_r[None, :], axis=1)  # [Q]
+    unrouted_pen = jnp.sum(jnp.maximum(1.0 - route_total, 0.0) * class_mass * pend_weight)
+    savings = jnp.sum(d * t.node_price) - t.churn_weight * jnp.sum(d * t.node_cost)
+    return savings - rep_cost - 10.0 * cap_pen - 10.0 * unrouted_pen
+
+
+def _gp_project(y, yr, compat_qn):
+    """Project routing rows back onto {>=0, compat-masked, sum == 1}."""
+    y = jnp.maximum(y, 0.0) * compat_qn
+    yr = jnp.maximum(yr, 0.0)
+    s = jnp.sum(y, axis=1, keepdims=True) + jnp.sum(yr, axis=1, keepdims=True)
+    scale = 1.0 / jnp.maximum(s, 1e-9)
+    return y * scale, yr * scale
+
+
+@partial(jax.jit, static_argnames=("n_iters",))
+def _globalpack_impl(t: ConsolidationTensors, onehot, compat_qn, pend_mass, pend_weight, keys, n_iters: int = 300):
+    """Projected-gradient (Adam) ascent on the relaxed global repack, vmapped
+    over an explicit key batch of independent random inits. Returns
+    (d [C, N], score [C]) — the host thresholds/rounds d into candidate
+    subsets and re-scores them with the discrete objective."""
+    N = t.node_price.shape[0]
+    Q = onehot.shape[1]
+    T = t.row_price.shape[0]
+    price_safe = jnp.minimum(t.row_price, _PRICE_CAP)
+    inv_alloc = jnp.where(t.row_alloc > 0, 1.0 / jnp.maximum(t.row_alloc, 1e-9), _PRICE_CAP)
+    # per-resource normalization so cpu-milli and byte-scaled axes penalize
+    # comparably regardless of unit
+    scale_r = jnp.maximum(jnp.max(t.node_used, axis=0, initial=0.0), jnp.max(t.node_slack, axis=0, initial=0.0))
+    scale_r = jnp.maximum(scale_r, jnp.max(pend_mass, axis=0, initial=0.0))
+    norm_r = 1.0 / jnp.maximum(scale_r, 1e-9)
+
+    grad_fn = jax.grad(
+        lambda d, y, yr: -_gp_objective(t, onehot, compat_qn, pend_mass, pend_weight, d, y, yr, inv_alloc, norm_r, price_safe),
+        argnums=(0, 1, 2),
+    )
+
+    def one_init(key):
+        k_d, k_y = jax.random.split(key)
+        d = jax.random.uniform(k_d, (N,), minval=0.05, maxval=0.95)
+        y = jax.random.uniform(k_y, (Q, N), minval=0.1, maxval=1.0)
+        yr = jnp.full((Q, T), 0.5)
+        y, yr = _gp_project(y, yr, compat_qn)
+        # Adam state per variable
+        zeros = (jnp.zeros_like(d), jnp.zeros_like(y), jnp.zeros_like(yr))
+        b1, b2, lr, eps = 0.9, 0.999, 0.05, 1e-8
+
+        def step(i, carry):
+            d, y, yr, m, v = carry
+            g = grad_fn(d, y, yr)
+            it = i + 1
+            m = tuple(b1 * mi + (1 - b1) * gi for mi, gi in zip(m, g))
+            v = tuple(b2 * vi + (1 - b2) * gi * gi for vi, gi in zip(v, g))
+            corr1 = 1 - b1**it
+            corr2 = 1 - b2**it
+            upd = tuple((mi / corr1) / (jnp.sqrt(vi / corr2) + eps) for mi, vi in zip(m, v))
+            d = jnp.clip(d - lr * upd[0], 0.0, 1.0)
+            y, yr = _gp_project(y - lr * upd[1], yr - lr * upd[2], compat_qn)
+            return (d, y, yr, m, v)
+
+        d, y, yr, _, _ = jax.lax.fori_loop(0, n_iters, step, (d, y, yr, zeros, zeros))
+        return d, _gp_objective(t, onehot, compat_qn, pend_mass, pend_weight, d, y, yr, inv_alloc, norm_r, price_safe)
+
+    return jax.vmap(one_init)(keys)
+
+
+def global_repack(t: ConsolidationTensors, onehot, compat_qn, pend_mass, pend_weight, key, n_inits: int = 8, n_iters: int = 300):
+    """Run the relaxed global repack from `n_inits` independent starts;
+    returns (d [n_inits, N] fractional deletions, score [n_inits])."""
+    import jax.random as jr
+
+    return _globalpack_impl(t, onehot, compat_qn, pend_mass, pend_weight, jr.split(key, n_inits), n_iters)
+
+
+def zero_pending(n_classes: int, n_resources: int):
+    """The consolidation-only degenerate point: no pending mass, unit
+    unplaced weights — `lp_repack`'s delegation arguments."""
+    return jnp.zeros((n_classes, n_resources), dtype=jnp.float32), jnp.ones((n_classes,), dtype=jnp.float32)
+
+
+# host rounding evaluates up to this many candidate subsets per solve in ONE
+# jitted batch (padded with all-False rows, which score the empty-set base)
+LP_SCORE_BATCH = 32
+
+
+def _objective_factored(t: ConsolidationTensors, onehot, compat_nq, pend_req, pend_npods, pend_active, x):
+    """The discrete relaxed objective with the compatibility matrix in
+    FACTORED form (compat[j, i] == compat_nq[j, class(i)]) and the pending
+    mass folded into the displaced side: pending pods must land exactly like
+    evicted ones, so a subset's replacement need covers both. Exactly
+    equivalent to the dense form for every kept node j (a deleted j's slack
+    is zeroed by the keep factor) — and O(N x Q) instead of O(N^2), which is
+    what lets the scorer run on full 5k-node fleets."""
+    xf = x.astype(jnp.float32)
+    keep = 1.0 - xf
+
+    displaced = pend_req + (t.node_used * xf[:, None]).sum(axis=0)  # [R]
+    n_displaced = jnp.maximum(pend_npods + (t.node_npods * xf).sum(), 1.0)
+    avg_pod = displaced / n_displaced
+    deleted_class = jnp.maximum(jnp.max(onehot * xf[:, None], axis=0), pend_active)  # [Q]
+    compat_to_any_deleted = jnp.max(compat_nq * deleted_class[None, :], axis=1)  # [N]
+    can_host_one = jnp.all(t.node_slack >= avg_pod[None, :], axis=1).astype(jnp.float32)
+    usable_slack = (t.node_slack * (keep * compat_to_any_deleted * can_host_one)[:, None]).sum(axis=0)
+
+    shortfall = jnp.maximum(displaced - usable_slack, 0.0)
+    needs_replacement = jnp.any(shortfall > 0)
+    # legacy single-row cost: the cheapest row whose allocatable covers the
+    # WHOLE shortfall — the consolidation-only delegation's exact semantics
+    # (score_subsets with zero pending must stay bit-identical)
+    row_fits = jnp.all(t.row_alloc >= shortfall[None, :], axis=1)
+    single_cost = jnp.where(row_fits, t.row_price, BIG)
+    # multi-node group cost: ceil count of identical row-t nodes covering the
+    # shortfall — pending mass routinely exceeds any single catalog node, so
+    # the global mode prices a replacement GROUP instead of rejecting. This
+    # mirrors the relaxation's fractional count z_t = max_r rep_tr / alloc_tr.
+    row_ok = jnp.all((t.row_alloc > 0) | (shortfall[None, :] <= 0), axis=1)
+    ratio = shortfall[None, :] / jnp.maximum(t.row_alloc, 1e-9)
+    count = jnp.ceil(jnp.max(jnp.where(shortfall[None, :] > 0, ratio, 0.0), axis=1))
+    multi_cost = jnp.where(row_ok, t.row_price * jnp.maximum(count, 1.0), BIG)
+    row_cost = jnp.where(pend_npods > 0, multi_cost, single_cost)
+    best_row_cost = jnp.min(row_cost)
+    replacement_cost = jnp.where(needs_replacement, best_row_cost, 0.0)
+    feasible = jnp.logical_or(~needs_replacement, best_row_cost < BIG)
+
+    savings = (t.node_price * xf).sum() - replacement_cost
+    churn = t.churn_weight * (t.node_cost * xf).sum()
+    score = jnp.where(feasible, savings - churn, -BIG)
+    return score, feasible
+
+
+@jax.jit
+def _score_subsets_impl(t: ConsolidationTensors, onehot, compat_nq, pend_req, pend_npods, pend_active, X):
+    """X [M, N] bool delete-sets -> (score [M], feasible [M]) under the
+    DISCRETE relaxed objective (factored-compat form) — the same feasibility
+    the annealer optimizes, so LP-rounded, globally-repacked, and annealed
+    proposals rank on one scale."""
+    return jax.vmap(lambda x: _objective_factored(t, onehot, compat_nq, pend_req, pend_npods, pend_active, x))(X)
+
+
+def score_subsets_global(t: ConsolidationTensors, onehot, compat_nq, pend_req, pend_npods, pend_active, X):
+    """Batch-score candidate delete-sets against a FIXED pending load (host
+    rounding helper); pads the batch axis to LP_SCORE_BATCH so repeated
+    rounds never retrace. Pending mass shifts every subset's score by the
+    same provisioning cost, so callers filter on improvement over the
+    empty-set base, not on sign."""
+    import numpy as np
+
+    X = np.asarray(X, dtype=bool)
+    m = X.shape[0]
+    pad = ((0, LP_SCORE_BATCH - (m % LP_SCORE_BATCH or LP_SCORE_BATCH)), (0, 0))
+    Xp = np.pad(X, pad) if pad[0][1] else X
+    scores, feas = [], []
+    for i in range(0, Xp.shape[0], LP_SCORE_BATCH):
+        s, f = _score_subsets_impl(t, onehot, compat_nq, pend_req, pend_npods, pend_active, jnp.asarray(Xp[i : i + LP_SCORE_BATCH]))
+        scores.append(np.asarray(s))
+        feas.append(np.asarray(f))
+    return np.concatenate(scores)[:m], np.concatenate(feas)[:m]

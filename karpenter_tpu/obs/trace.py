@@ -54,8 +54,8 @@ JIT_WATCHLIST = (
     ("recredit", "karpenter_tpu.models.scheduler_model_grouped", "_recredit_impl"),
     ("pack_perpod", "karpenter_tpu.models.scheduler_model", "_greedy_pack_impl"),
     ("anneal", "karpenter_tpu.models.consolidation_model", "anneal_chains"),
-    ("lp_repack", "karpenter_tpu.models.consolidation_model", "_lp_repack_impl"),
-    ("lp_score", "karpenter_tpu.models.consolidation_model", "_score_subsets_impl"),
+    ("lp_repack", "karpenter_tpu.models.globalpack", "_globalpack_impl"),
+    ("lp_score", "karpenter_tpu.models.globalpack", "_score_subsets_impl"),
     ("pack_sharded", "karpenter_tpu.parallel.sharded", "pack_sharded_probe"),
     ("shard_feas", "karpenter_tpu.parallel.sharded", "shard_compat_probe"),
 )

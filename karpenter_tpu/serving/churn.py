@@ -496,6 +496,36 @@ class ChurnHarness:
                 pass
         return events
 
+    def repack_savings(self, mode: str = "global", seed: int = 0) -> float:
+        """faultline's revocation path as globalpack's second customer: after
+        a spot reclaim (`revoke_node` / `apply_revocations`), measure the
+        $/hr the chosen proposer's best EXACT-VALIDATED consolidation
+        command would recover over the shrunken fleet. mode="global" runs
+        the joint provisioning+retirement convex solve (the
+        KARPENTER_SOLVER_GLOBALPACK path — orphaned pods still pending enter
+        the solve as unconditional mass), mode="two-phase" the default
+        greedy LP ladder. Nothing executes — the command is computed and
+        scored only, so a bench gate can compare both modes on one fleet.
+        Advances the fake clock past consolidate_after to surface candidates."""
+        from ..controllers.disruption.methods import MultiNodeConsolidation, _command_savings_per_hour
+
+        env = self.env
+        env.clock.step(40)
+        env.nodeclaim_disruption.reconcile()
+        ctx = env.disruption.ctx
+        ctx.round_candidates = env.disruption.get_candidates()
+        ctx.node_pool_totals = None
+        method = MultiNodeConsolidation(ctx)
+        candidates = method.sort_candidates([c for c in ctx.round_candidates if method.should_disrupt(c)])
+        if len(candidates) < 2:
+            return 0.0
+        deadline = ctx.clock.now() + 60.0
+        if mode == "global":
+            cmd = method._globalpack_option(candidates, deadline)
+        else:
+            cmd = method._lp_option(candidates, deadline)
+        return _command_savings_per_hour(cmd) if cmd.candidates else 0.0
+
     def bind_flush(self) -> None:
         """Launch claims, register nodes, bind pending pods — the controller
         work between solves. Re-files newly bound pods from pending to bound."""

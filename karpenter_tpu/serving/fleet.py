@@ -451,6 +451,42 @@ class FleetFrontend:
             etas.append(e)
         return min(etas) if etas else None
 
+    def rebalance(self, tenant_id: str, seed: int = 0) -> dict:
+        """Opt-in global repack probe for ONE tenant (gated on the same
+        KARPENTER_SOLVER_GLOBALPACK hatch as the disruption controller): ask
+        the tenant's solver for a joint provisioning+retirement plan over
+        its current consolidation candidates and pending pods, via
+        `TPUSolver.global_repack_plan`. Returns the plan summary
+        ({proposals, objective_improvement, rounded}) WITHOUT executing
+        anything — the disruption controller owns exact validation and
+        execution; this seam exists so fleet operators can see what a global
+        solve would buy a tenant before enabling it there. Empty dict when
+        the hatch is off, the tenant is unknown, or its solver lacks the
+        tensor seam. Must run on the thread that owns the tenant's solver
+        (the pump/operator thread) — same single-threaded solver contract as
+        `pump`."""
+        import os
+
+        if os.environ.get("KARPENTER_SOLVER_GLOBALPACK", "0").strip().lower() not in ("1", "true", "on"):
+            return {}
+        sess = self.session(tenant_id)
+        if sess is None:
+            return {}
+        env = sess.env
+        solver = env.provisioner.solver
+        if not hasattr(solver, "global_repack_plan"):
+            return {}
+        candidates = env.disruption.get_candidates()
+        pending = env.provisioner.get_pending_pods()
+        if len(candidates) < 2:
+            return {"proposals": 0, "objective_improvement": 0.0, "rounded": 0}
+        pools = {c.node_pool.metadata.name: c.node_pool for c in candidates}
+        its = []
+        for pool in pools.values():
+            its.extend(env.provisioner.cloud_provider.get_instance_types(pool))
+        subsets, info = solver.global_repack_plan(candidates, its, pending_pods=pending, seed=seed)
+        return {"proposals": len(subsets), **info}
+
     # -- scheduling ------------------------------------------------------------
     def pump(self, force: bool = False, only: str | None = None) -> dict[str, int]:
         """One deficit-round-robin round over the runnable tenants; returns

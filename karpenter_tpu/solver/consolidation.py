@@ -40,9 +40,12 @@ from ..utils import resources as res
 from .encode import _scale
 
 
-def _candidate_vectors(candidates, instance_types):
+def _candidate_vectors(candidates, instance_types, pending_pods=None):
     """Per-candidate resource vectors + the (label-set, requirement-class)
-    grouping that makes compatibility O(L x Q) instead of O(N^2)."""
+    grouping that makes compatibility O(L x Q) instead of O(N^2). With
+    `pending_pods`, each unique pending-pod signature becomes an EXTRA class
+    on the same axis whose mass is unconditional (globalpack's provisioning
+    side) instead of gated by a node's fractional deletion."""
     rnames = ["cpu", "memory", "pods", "ephemeral-storage"]
     seen = set(rnames)
     for c in candidates:
@@ -51,6 +54,11 @@ def _candidate_vectors(candidates, instance_types):
                 if k not in seen:
                     seen.add(k)
                     rnames.append(k)  # extended resources (accelerators etc.)
+    for p in pending_pods or ():
+        for k in res.pod_requests(p):
+            if k not in seen:
+                seen.add(k)
+                rnames.append(k)
     ridx = {k: i for i, k in enumerate(rnames)}
     R = len(rnames)
 
@@ -105,7 +113,36 @@ def _candidate_vectors(candidates, instance_types):
                 merged.add(*req_by_content[k].values())
             class_reqs.append(merged)
         class_of_node[i] = q
+
+    # pending classes: one per unique pod-signature content (a singleton of
+    # the same frozenset key space, so a pending class COINCIDING with a
+    # single-signature node class shares its routing row — same requirements,
+    # same sinks). Mass/weight arrays are sized after the final Q below.
+    pend_class_mass: dict = {}  # class id -> accumulated resource vector
+    pend_npods = 0.0
+    for p in pending_pods or ():
+        k = pod_signature_cached(p)[0]
+        if k not in req_by_content:
+            req_by_content[k] = Requirements.from_pod(p, strict=True)
+        ck = frozenset((k,))
+        q = class_ids.get(ck)
+        if q is None:
+            q = len(class_ids)
+            class_ids[ck] = q
+            merged = Requirements()
+            merged.add(*req_by_content[k].values())
+            class_reqs.append(merged)
+        acc = pend_class_mass.get(q)
+        if acc is None:
+            acc = pend_class_mass[q] = np.zeros(R, dtype=np.float32)
+        acc += vec(res.pod_requests(p))
+        pend_npods += 1.0
     Q = len(class_reqs)
+    pend_mass = np.zeros((Q, R), dtype=np.float32)
+    pend_active = np.zeros(Q, dtype=np.float32)
+    for q, acc in pend_class_mass.items():
+        pend_mass[q] = acc
+        pend_active[q] = 1.0
 
     label_ids: dict = {}
     label_of_node = np.zeros(N, dtype=np.int64)
@@ -154,6 +191,10 @@ def _candidate_vectors(candidates, instance_types):
         rows_alloc=rows_alloc_arr,
         rows_price=rows_price_arr,
         n_classes=Q,
+        pend_mass=pend_mass,
+        pend_active=pend_active,
+        pend_req=pend_mass.sum(axis=0),
+        pend_npods=pend_npods,
     )
 
 
@@ -164,11 +205,18 @@ def encode_candidates(candidates, instance_types):
     return t
 
 
-def encode_candidates_lp(candidates, instance_types, dense_compat: bool = False):
+def encode_candidates_lp(candidates, instance_types, dense_compat: bool = False, pending_pods=None):
     """Like `encode_candidates`, additionally returning the LP's class
     structures: (tensors, aux) with aux = {onehot [Np, Qp], compat_qn
     [Qp, Np], compat_nq [Np, Qp], n, n_classes} — class axes padded to
     `_bucket_small` so the LP jit signature is stable across rounds.
+
+    With `pending_pods` (the globalpack mode), aux additionally carries the
+    pending side of the joint solve: `pend_mass` [Qp, R] unconditional class
+    mass, `pend_weight` [Qp] unplaced-hinge weights (PENDING_WEIGHT on
+    pending classes, 1.0 elsewhere — all-ones at the zero-pending degenerate
+    point, so both callers share one jit signature), plus the discrete
+    scorer's `pend_req` [R] / `pend_npods` / `pend_active` [Qp].
 
     The dense [N, N] matrix is O(N^2) memory (270MB at a padded 8k fleet) and
     only the anneal arm reads it; the LP and the discrete subset scorer use
@@ -177,8 +225,9 @@ def encode_candidates_lp(candidates, instance_types, dense_compat: bool = False)
     import jax.numpy as jnp
 
     from ..models.consolidation_model import ConsolidationTensors
+    from ..models.globalpack import PENDING_WEIGHT
 
-    v = _candidate_vectors(candidates, instance_types)
+    v = _candidate_vectors(candidates, instance_types, pending_pods=pending_pods)
     N = len(candidates)
     node_price, node_cost = v["node_price"], v["node_cost"]
     node_slack, node_used, node_npods = v["node_slack"], v["node_used"], v["node_npods"]
@@ -231,12 +280,24 @@ def encode_candidates_lp(candidates, instance_types, dense_compat: bool = False)
         row_price=jnp.asarray(rows_price_arr),
     )
     compat_nq_j = jnp.asarray(compat_nq)
+    R = node_used.shape[1]
+    pend_mass = np.zeros((Qp, R), dtype=np.float32)
+    pend_mass[:Q] = v["pend_mass"]
+    pend_weight = np.ones(Qp, dtype=np.float32)
+    pend_weight[:Q] = np.where(v["pend_active"] > 0, np.float32(PENDING_WEIGHT), np.float32(1.0))
+    pend_active = np.zeros(Qp, dtype=np.float32)
+    pend_active[:Q] = v["pend_active"]
     aux = dict(
         onehot=jnp.asarray(onehot),
         compat_qn=compat_nq_j.T,
         compat_nq=compat_nq_j,
         n=N,
         n_classes=Q,
+        pend_mass=jnp.asarray(pend_mass),
+        pend_weight=jnp.asarray(pend_weight),
+        pend_active=jnp.asarray(pend_active),
+        pend_req=jnp.asarray(v["pend_req"]),
+        pend_npods=jnp.float32(v["pend_npods"]),
     )
     return t, aux
 
@@ -297,6 +358,35 @@ def propose_subsets(candidates, instance_types, seed: int = 0, max_proposals: in
 # fractional-deletion cutoffs the host rounds at, per LP init
 _ROUND_THRESHOLDS = (0.9, 0.7, 0.5, 0.3)
 
+
+def _round_fractional(d: np.ndarray, n: int) -> list[np.ndarray]:
+    """Fractional deletions [C, Np] -> deduped boolean delete-set rows:
+    threshold cuts plus top-k prefixes along each init's deletion order
+    (nested subsets the thresholds skip on plateaued solutions). Only the
+    real-candidate columns [:n] participate; pad columns stay False."""
+    N = d.shape[1]
+    rows: list[np.ndarray] = []
+    seen: set[tuple] = set()
+
+    def add(mask: np.ndarray) -> None:
+        key = tuple(np.nonzero(mask[:n])[0].tolist())
+        if key and key not in seen:
+            seen.add(key)
+            m = np.zeros(N, dtype=bool)
+            m[list(key)] = True
+            rows.append(m)
+
+    for c in range(d.shape[0]):
+        dc = np.where(np.arange(N) < n, d[c], 0.0)
+        for tau in _ROUND_THRESHOLDS:
+            add(dc > tau)
+        order = np.argsort(-dc)
+        for k in {2, max(2, n // 4), max(2, n // 2), n}:
+            m = np.zeros(N, dtype=bool)
+            m[order[:k]] = True
+            add(m)
+    return rows
+
 # LP solve shape: independent random inits x projected-gradient iterations
 # (the karpenter_solver_consolidation_lp_iterations_total increment per solve)
 LP_INITS = 8
@@ -332,30 +422,7 @@ def propose_subsets_lp(
         )
         d = np.asarray(d)  # [C, Np] — one device->host landing for the round
     with tr.span("round"):
-        N = d.shape[1]
-        rows: list[np.ndarray] = []
-        seen: set[tuple] = set()
-
-        def add(mask: np.ndarray) -> None:
-            key = tuple(np.nonzero(mask[:n])[0].tolist())
-            if key and key not in seen:
-                seen.add(key)
-                m = np.zeros(N, dtype=bool)
-                m[list(key)] = True
-                rows.append(m)
-
-        for c in range(d.shape[0]):
-            dc = d[c]
-            dc = np.where(np.arange(N) < n, dc, 0.0)
-            for tau in _ROUND_THRESHOLDS:
-                add(dc > tau)
-            # top-k prefixes along the fractional-deletion order: nested
-            # subsets the thresholds may skip on plateaued solutions
-            order = np.argsort(-dc)
-            for k in {2, max(2, n // 4), max(2, n // 2), n}:
-                m = np.zeros(N, dtype=bool)
-                m[order[:k]] = True
-                add(m)
+        rows = _round_fractional(d, n)
         if not rows:
             return []
         X = np.stack(rows)
@@ -379,3 +446,94 @@ def propose_subsets_lp(
             out.append(list(full))
         tr.note(lp_proposals=len(out), lp_rounded=len(rows))
     return out
+
+
+def propose_subsets_global(
+    candidates, instance_types, pending_pods=None, seed: int = 0, max_proposals: int = 8, trace=None
+) -> tuple[list[list[int]], dict]:
+    """The GLOBAL repack proposer (models/globalpack): one convex solve
+    co-optimizes pending-pod placement and node retirement — pending classes
+    carry unconditional mass and a heavy unplaced hinge, so savings can never
+    be funded by dropping provisioning work. Rounding/scoring mirror the LP
+    proposer, except subsets are ranked by IMPROVEMENT over the empty
+    delete-set's score (pending mass shifts every subset by the same
+    provisioning cost, so sign is meaningless here).
+
+    Returns (subsets best-first, info) with info carrying the bounded
+    globalpack stats the caller publishes: `objective_improvement` (best
+    discrete score minus the empty-set base) and `rounded` (subsets scored).
+    Exact validation stays the caller's job — every subset goes through
+    compute_consolidation -> simulate_scheduling before any command exists,
+    and those probes already carry the pending pods."""
+    import jax
+
+    from ..models.globalpack import global_repack
+    from ..obs.trace import SolveTrace
+
+    info = dict(objective_improvement=0.0, rounded=0)
+    if len(candidates) < 2:
+        return [], info
+    tr = trace if trace is not None else SolveTrace(enabled=False)
+    n = len(candidates)
+    with tr.span("encode_candidates", n_candidates=n, n_pending=len(pending_pods or ())):
+        t, aux = encode_candidates_lp(candidates, instance_types, pending_pods=pending_pods)
+    with tr.span("globalpack"):
+        d, _scores = global_repack(
+            t,
+            aux["onehot"],
+            aux["compat_qn"],
+            aux["pend_mass"],
+            aux["pend_weight"],
+            jax.random.PRNGKey(seed),
+            n_inits=LP_INITS,
+            n_iters=LP_ITERS,
+        )
+        d = np.asarray(d)  # [C, Np] — one device->host landing for the round
+    with tr.span("round"):
+        from ..models.globalpack import score_subsets_global
+
+        N = d.shape[1]
+        rows = [np.zeros(N, dtype=bool)] + _round_fractional(d, n)  # row 0: the empty-set base
+        # the joint objective's validated winner is often a mid-size prefix
+        # the legacy quarter-ladder skips (pending mass shifts where the
+        # savings/replacement crossover lands) — densify to eighths HERE,
+        # leaving the two-phase proposer's rounding bit-identical
+        seen_rows = {tuple(np.nonzero(r[:n])[0].tolist()) for r in rows}
+        for c in range(d.shape[0]):
+            order = np.argsort(-np.where(np.arange(N) < n, d[c], 0.0))
+            for k in sorted({max(2, (n * f) // 8) for f in range(1, 9)}):
+                mrow = np.zeros(N, dtype=bool)
+                mrow[order[:k]] = True
+                key = tuple(np.nonzero(mrow[:n])[0].tolist())
+                if key and key not in seen_rows:
+                    seen_rows.add(key)
+                    rows.append(mrow)
+        X = np.stack(rows)
+        scores, feas = score_subsets_global(
+            t, aux["onehot"], aux["compat_nq"], aux["pend_req"], aux["pend_npods"], aux["pend_active"], X
+        )
+        base = scores[0]
+        out: list[list[int]] = []
+        emitted: set[tuple] = set()
+        best = base
+        for i in np.argsort(-scores):
+            if i == 0 or scores[i] <= base or not feas[i]:
+                continue
+            subset = tuple(np.nonzero(X[i][:n])[0].tolist())
+            if not subset or subset in emitted:
+                continue
+            emitted.add(subset)
+            out.append(list(subset))
+            best = max(best, float(scores[i]))
+            if len(out) >= max_proposals:
+                break
+        full = tuple(range(n))
+        if out and full not in emitted:
+            out.append(list(full))
+        if best > base:
+            # an infeasible (-BIG) base means ANY feasible subset is the win;
+            # report its absolute score so the gauge stays meaningful
+            info["objective_improvement"] = float(best - base) if base > -1e37 else float(best)
+        info["rounded"] = len(rows) - 1
+        tr.note(globalpack_proposals=len(out), globalpack_rounded=len(rows) - 1)
+    return out, info

@@ -20,18 +20,31 @@ rows — handed to `TPUSolver.solve_prepared`. The same trick that made
 hybrid re-solves ~free in PR 2 (mask the encode, re-pack only the delta),
 applied to the disruption controller's hot loop.
 
+TOPOLOGY AND INVERSE ANTI-AFFINITY are probe-dependent (a surviving
+candidate's bound pods count toward group skews and block anti-affinity
+peers; a deleted one's don't), which PR 9 handled by refusing the masked
+path outright. The per-node decomposition pays that debt: at base time the
+round decomposes every candidate's bound-pod contribution to each group's
+counts (`encode.sim_group_count_contrib`) and each reschedulable
+required-anti pod into inverse blocking entries
+(`encode.sim_inverse_entries_for`); per probe the simulator assembles the
+EXACT from-scratch group counts / registries / inverse blocks by
+adding the surviving candidates' contributions and dropping the batch's
+(including the deleted nodes' domains from each registry), handing them to
+`sim_mask_encode` as overrides.
+
 CORRECTNESS ENVELOPE — the masked path engages only when it is placement-
 equivalent to the from-scratch simulation, checked once per round on the
 base encode:
 
   * clean capability report (no fallback reasons: no flagged families whose
     host handling could depend on the probe's node set),
-  * zero topology groups (group domain universes and bound-pod counts are
-    probe-dependent: a surviving candidate's bound pods count, a deleted
-    one's don't),
-  * zero inverse anti-affinity entries AND no required anti-affinity on any
-    candidate's reschedulable pods (a pod evicted in one probe is a RUNNING
-    blocker in another),
+  * no HOSTNAME-spread groups (a blocked row is an extra zero-count
+    hostname domain the from-scratch probe never sees, which skews the
+    spread minimum),
+  * no candidate-only topology domains while groups exist (a from-scratch
+    probe without that candidate never interns the domain, so bound pods
+    counted into it would diverge),
   * the provisioner's solver exposes the tensor path (`solve_prepared`).
 
 Anything outside the envelope — and any probe whose masked solve falls off
@@ -42,6 +55,8 @@ simulator, so executed commands never depend on this reuse at all.
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 from ..utils import pods as pod_utils
 
@@ -99,13 +114,6 @@ class ConsolidationSimulator:
         solver = self.provisioner.solver
         if not hasattr(solver, "solve_prepared") or not hasattr(solver, "encode_cache"):
             return self._ineligible("solver has no tensor path")
-        for c in self.candidates:
-            for p in c.reschedulable_pods:
-                aff = p.spec.affinity
-                if aff is not None and getattr(aff, "pod_anti_affinity_required", None):
-                    # evicted in one probe, a running inverse-anti blocker in
-                    # another — the base encode can't represent both
-                    return self._ineligible("candidate pod carries required anti-affinity")
         pending, deleting_pods, state_nodes = _pending_and_deleting(
             self.provisioner, self.cluster, self._names
         )
@@ -125,12 +133,41 @@ class ConsolidationSimulator:
             return self._ineligible(f"base encode failed: {e}")
         if enc.fallback_reasons:
             return self._ineligible(f"base encode flagged: {enc.fallback_reasons[:2]}")
-        if enc.n_groups:
-            return self._ineligible("topology groups present")
-        if enc.sig_host_blocked.any():
-            return self._ineligible("inverse anti-affinity entries present")
         if enc.n_rows == 0 or enc.n_pods == 0:
             return self._ineligible("empty base encode")
+
+        row_of = {}
+        for j in range(enc.n_existing):
+            if enc.row_meta[j][0] == "existing":
+                row_of[enc.row_meta[j][1].name()] = j
+        cand_rows = {}
+        for c in self.candidates:
+            j = row_of.get(c.name())
+            if j is None:
+                return self._ineligible("candidate node missing from base rows")
+            cand_rows[c.name()] = j
+
+        group_state = self._decompose_groups(enc, cand_rows)
+        if group_state is False:
+            return False  # _decompose_groups already recorded why
+
+        # surviving candidates' reschedulable required-anti pods are RUNNING
+        # inverse blockers in every probe that keeps them (solve pods in the
+        # base, so the base encode carries no entry for them)
+        from .encode import sim_inverse_entries_for
+
+        cand_inverse = {}
+        for c in self.candidates:
+            anti = [
+                p
+                for p in c.reschedulable_pods
+                if p.spec.affinity is not None and getattr(p.spec.affinity, "pod_anti_affinity_required", None)
+            ]
+            if anti:
+                cand_inverse[c.name()] = sim_inverse_entries_for(
+                    self.provisioner.store, anti, c.state_node.labels(), c.name()
+                )
+
         idx_of = {id(p): i for i, p in enumerate(enc.pods)}
         if len(idx_of) != len(enc.pods):
             return self._ineligible("duplicate pod objects in base")
@@ -139,8 +176,92 @@ class ConsolidationSimulator:
             enc=enc,
             idx_of=idx_of,
             invariant_idx=[idx_of[id(p)] for p in pending + deleting_pods if id(p) in idx_of],
+            cand_rows=cand_rows,
+            group_state=group_state,
+            cand_inverse=cand_inverse,
         )
         return self._base
+
+    def _decompose_groups(self, enc, cand_rows):
+        """Per-candidate decomposition of bound-pod group counts (module
+        docstring): returns None (no groups), False (ineligible — reason
+        recorded), or the dict of base totals + per-candidate contributions
+        `simulate` assembles probe counts from."""
+        if not enc.n_groups:
+            return None
+        from .encode import KIND_HOST_SPREAD, sim_group_count_contrib
+
+        if (np.asarray(enc.group_kind) == KIND_HOST_SPREAD).any():
+            return self._ineligible("hostname spread groups present")
+        if enc.universe_dom is None:
+            return self._ineligible("base encode lacks a domain universe")
+        Kd = len(enc.dom_key_names)
+        D = enc.universe_dom.shape[0]
+        dom_occ = np.zeros(D, dtype=np.int64)
+        row_doms: dict[int, np.ndarray] = {}
+        for j in range(enc.n_existing):
+            if enc.row_meta[j][0] != "existing":
+                continue
+            ds = np.unique(enc.row_dom[j])
+            ds = ds[ds >= Kd]  # ids < Kd are the per-key absent sentinels
+            row_doms[j] = ds
+            dom_occ[ds] += 1
+        for name, j in cand_rows.items():
+            ds = row_doms.get(j)
+            if ds is not None and ds.size and ((dom_occ[ds] == 1) & ~enc.universe_dom[ds]).any():
+                return self._ineligible("candidate-only topology domain")
+        # "every candidate survives" totals; probes subtract the batch's
+        cdi_all = np.array(enc.counts_dom_init, dtype=np.int64)
+        che_all = np.array(enc.counts_host_existing, dtype=np.int64)
+        cand_dom: dict[str, list] = {}
+        cand_host: dict[str, list] = {}
+        for c in self.candidates:
+            j = cand_rows[c.name()]
+            dom_list, host_list = sim_group_count_contrib(enc, c.reschedulable_pods, j)
+            cand_dom[c.name()] = dom_list
+            cand_host[c.name()] = host_list
+            for g, did, n in dom_list:
+                cdi_all[g, did] += n
+            for g, n in host_list:
+                che_all[g, j] += n
+        return dict(
+            cdi_all=cdi_all,
+            che_all=che_all,
+            dom_occ=dom_occ,
+            row_doms=row_doms,
+            cand_dom=cand_dom,
+            cand_host=cand_host,
+        )
+
+    def _probe_group_counts(self, enc, base, batch_names):
+        """Assemble the EXACT from-scratch group state for one probe: counts
+        include surviving candidates' bound pods and not the batch's; the
+        registry loses the batch nodes' existing-node domains (and keeps
+        every domain that still counts pods)."""
+        gs = base["group_state"]
+        if gs is None:
+            return None
+        cdi = gs["cdi_all"].copy()
+        che = gs["che_all"].copy()
+        occ = gs["dom_occ"].copy()
+        for name in batch_names:
+            j = base["cand_rows"][name]
+            for g, did, n in gs["cand_dom"][name]:
+                cdi[g, did] -= n
+            che[:, j] = 0  # the blocked row is absent from-scratch
+            ds = gs["row_doms"].get(j)
+            if ds is not None:
+                occ[ds] -= 1
+        existing_dom = occ > 0
+        dko = np.asarray(enc.dom_key_of)
+        G = enc.n_groups
+        reg = np.zeros((G, existing_dom.shape[0]), dtype=bool)
+        for g in range(G):
+            dk = int(enc.group_dom_key[g])
+            if dk >= 0:
+                reg[g] = (enc.universe_dom | existing_dom) & (dko == dk)
+        reg |= cdi > 0
+        return (cdi.astype(np.int32), che.astype(np.int32), reg)
 
     # -- probes ----------------------------------------------------------------
     def _scratch(self, batch):
@@ -170,8 +291,18 @@ class ConsolidationSimulator:
         batch_names = {c.name() for c in batch}
         from .encode import sim_mask_encode
 
+        entries = []
+        for name, es in base["cand_inverse"].items():
+            if name not in batch_names:  # surviving candidates block; deleted ones evict
+                entries.extend(es)
         try:
-            sim_enc = sim_mask_encode(enc, keep, batch_names)
+            sim_enc = sim_mask_encode(
+                enc,
+                keep,
+                batch_names,
+                group_counts=self._probe_group_counts(enc, base, batch_names),
+                inverse_entries=entries or None,
+            )
         except (ValueError, TypeError):  # flagged sig / out-of-range: exact path decides
             return self._scratch(batch)
 

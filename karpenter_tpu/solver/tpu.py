@@ -497,6 +497,32 @@ class TPUSolver:
             trace.fallback_reasons = list(self.last_fallback_reasons)
             self.recorder.commit(trace, registry=self.registry)
 
+    def global_repack_plan(self, candidates, instance_types, pending_pods=None, seed: int = 0):
+        """One flight-recorded GLOBAL repack proposal pass
+        (solver/consolidation.propose_subsets_global): candidate retirement
+        co-optimized with the given pending pods' placement in a single
+        convex solve. Returns (subsets best-first, info) — PROPOSALS only;
+        the caller owns exact validation before acting on any subset. The
+        seam serving customers use (churn revocation recovery, fleet
+        rebalance) without constructing a disruption controller; warm calls
+        share the globalpack jit cache, so repeated plans record zero
+        recompiles on the flight record."""
+        from .consolidation import propose_subsets_global
+
+        trace = self.recorder.begin(n_pods=len(pending_pods or ()))
+        trace.mode = "consolidate"
+        trace.backend = "globalpack"
+        if trace.enabled:
+            trace.jit_before = sentinel().snapshot()
+        try:
+            return propose_subsets_global(
+                candidates, instance_types, pending_pods=pending_pods, seed=seed, trace=trace
+            )
+        finally:
+            if trace.enabled:
+                trace.recompiles = sentinel().delta(trace.jit_before)
+            self.recorder.commit(trace, registry=self.registry)
+
     def _note_delta_reject(self, reason: str) -> None:
         """Record WHY a delta-capable solve routed to the full path — on the
         SolveTrace (explain() / /debug/solves) and the per-reason counter the

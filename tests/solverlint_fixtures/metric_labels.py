@@ -74,3 +74,16 @@ def ok_stage_static_enum(registry, rec):
     # the sanctioned form: stage iterates the static stage tuple
     for stage in ("coalesce", "sched_wait", "prestage", "solve", "decode", "e2e"):
         registry.histogram("karpenter_solver_event_stage_seconds").observe(rec.stages[stage], stage=stage)
+
+
+def bad_proposer_runtime(registry, trace):
+    # the globalpack cardinality leak: the proposals counter's `proposer`
+    # label fed a runtime trace attribute instead of a literal from the
+    # static proposer enum (lp | anneal | binary-search | globalpack)
+    registry.counter("karpenter_solver_consolidation_proposals_total").inc(8, proposer=trace.backend)
+
+
+def ok_proposer_enum(registry, trace):
+    # the sanctioned form: a literal/ternary over the static proposer enum
+    proposer = "globalpack" if trace.backend == "globalpack" else "lp"
+    registry.counter("karpenter_solver_consolidation_proposals_total").inc(8, proposer=proposer)

@@ -120,10 +120,12 @@ class TestMaskedSimulationParity:
 
 
 class TestSimulatorGuards:
-    def test_spread_fleet_routes_to_scratch(self):
+    def test_spread_fleet_rides_masked_with_probe_counts(self):
         """Topology groups are probe-dependent (bound-pod counts differ per
-        surviving set) — the envelope must refuse and the from-scratch path
-        must serve the probe identically either way."""
+        surviving set). The per-node count decomposition (ISSUE 16, paying
+        PR 9's named debt) hands every probe the exact from-scratch group
+        counts/registries, so spread fleets now ride the masked path —
+        bit-identical to `simulate_scheduling`."""
         env = Environment(options=Options(solver_backend="tpu"))
         np_ = make_nodepool(requirements=OD_ONLY)
         np_.spec.disruption.consolidate_after = "30s"
@@ -145,17 +147,28 @@ class TestSimulatorGuards:
         env.settle(rounds=4)
         flip_consolidatable(env)
         cands = env.disruption.get_candidates()
-        assert len(cands) >= 2
+        # batches need at least one reschedulable pod (an all-empty batch has
+        # nothing to simulate and correctly short-circuits to scratch), and
+        # which candidates host the spread pods varies with interning order
+        withpods = [c for c in cands if c.reschedulable_pods]
+        empties = [c for c in cands if not c.reschedulable_pods]
+        assert len(withpods) >= 2
         sim = ConsolidationSimulator(env.provisioner, env.cluster, env.clock, cands)
-        r = sim.simulate(cands[:2])
-        assert sim.last_mode == "scratch"
-        assert "topology" in sim.why_scratch
-        scratch = simulate_scheduling(env.provisioner, env.cluster, cands[:2], env.clock)
-        assert canon_results(r) == canon_results(scratch)
+        batches = [withpods[:2], withpods[1:], cands]
+        if empties:
+            batches.append([withpods[0], empties[0]])
+        for batch in batches:
+            r = sim.simulate(batch)
+            assert sim.last_mode == "masked", sim.why_scratch
+            scratch = simulate_scheduling(env.provisioner, env.cluster, batch, env.clock)
+            assert canon_results(r) == canon_results(scratch)
+        assert sim.masked_probes == len(batches)
 
-    def test_anti_affinity_candidate_pods_route_to_scratch(self):
-        # keep the anti-affinity pods AS the workload (no swap): evicting one
-        # makes it a running inverse-anti blocker of another probe
+    def test_anti_affinity_candidate_pods_ride_masked(self):
+        # the anti-affinity pods ARE the workload (no swap): evicting one
+        # makes it a running inverse-anti blocker of another probe — the
+        # per-candidate inverse-entry decomposition lowers exactly the
+        # surviving candidates' blockers per probe
         env2 = Environment(options=Options(solver_backend="tpu"))
         np_ = make_nodepool(requirements=OD_ONLY)
         np_.spec.disruption.consolidate_after = "30s"
@@ -172,9 +185,44 @@ class TestSimulatorGuards:
         if len(cands) < 2:
             pytest.skip("anti-affinity fleet produced too few candidates")
         sim = ConsolidationSimulator(env2.provisioner, env2.cluster, env2.clock, cands)
-        sim.simulate(cands[:2])
+        for batch in (cands[:2], [cands[0]], cands):
+            r = sim.simulate(batch)
+            assert sim.last_mode == "masked", sim.why_scratch
+            scratch = simulate_scheduling(env2.provisioner, env2.cluster, batch, env2.clock)
+            assert canon_results(r) == canon_results(scratch)
+
+    def test_hostname_spread_routes_to_scratch(self):
+        """The one topology family still outside the envelope: a blocked row
+        is an extra zero-count hostname domain the from-scratch probe never
+        sees, which skews the spread minimum — refuse, and the from-scratch
+        path serves the probe identically either way."""
+        env = Environment(options=Options(solver_backend="tpu"))
+        np_ = make_nodepool(requirements=OD_ONLY)
+        np_.spec.disruption.consolidate_after = "30s"
+        np_.spec.disruption.budgets = [Budget(nodes="100%")]
+        env.store.create(np_)
+        sel = {"matchLabels": {"app": "x"}}
+        for i in range(4):
+            env.store.create(
+                make_pod(cpu="500m", name=f"s{i}", labels={"app": "x"}, anti_affinity=[hostname_anti_affinity(sel)])
+            )
+        env.settle()
+        for i in range(4):
+            env.store.delete("Pod", f"s{i}")
+        host_tsc = zone_spread(selector={"matchLabels": {"app": "w"}})
+        host_tsc.topology_key = wk.HOSTNAME_LABEL_KEY
+        for i in range(4):
+            env.store.create(make_pod(cpu="250m", name=f"w{i}", labels={"app": "w"}, tsc=[host_tsc]))
+        env.settle(rounds=4)
+        flip_consolidatable(env)
+        cands = env.disruption.get_candidates()
+        assert len(cands) >= 2
+        sim = ConsolidationSimulator(env.provisioner, env.cluster, env.clock, cands)
+        r = sim.simulate(cands[:2])
         assert sim.last_mode == "scratch"
-        assert "anti-affinity" in sim.why_scratch
+        assert "hostname spread" in sim.why_scratch
+        scratch = simulate_scheduling(env.provisioner, env.cluster, cands[:2], env.clock)
+        assert canon_results(r) == canon_results(scratch)
 
     def test_ffd_backend_routes_to_scratch(self):
         env = build_fleet(4, solver_backend="ffd")

@@ -1,0 +1,238 @@
+"""globalpack acceptance suite (ISSUE 16).
+
+The joint provisioning+consolidation convex solve (`models/globalpack`) is a
+RELAXATION riding the same exact hosts as the two-phase LP: every contract
+here pins that turning the global mode on can only improve the objective,
+never correctness —
+
+  * the global solve's exact-validated best command saves at least what the
+    two-phase LP ladder's does (randomized fleets),
+  * every emitted command passed `compute_consolidation` exact validation
+    (no proposal becomes a command without a simulation verdict),
+  * `KARPENTER_SOLVER_GLOBALPACK` off (the default) preserves bit-identical
+    two-phase behavior — `_globalpack_option` is never entered,
+  * repeated global rounds record ZERO warm recompiles (sentinel-verified),
+    including when two-phase and global rounds interleave (shared jit cache
+    via the zero-pending delegation),
+  * the bounded karpenter_solver_globalpack_* family and the
+    proposer="globalpack" enum value are published,
+  * the second customers work: `FleetFrontend.rebalance` (hatch-gated probe)
+    and faultline's revocation path (`ChurnHarness.repack_savings`).
+"""
+
+import random
+
+import pytest
+
+from helpers import make_pod
+from karpenter_tpu.controllers.disruption.methods import (
+    MultiNodeConsolidation,
+    _command_savings_per_hour,
+)
+
+from test_consolidation_lp import consolidation_method, flip_consolidatable
+from test_consolidation_tpu import build_fleet
+
+
+class TestGlobalObjective:
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_global_savings_at_least_two_phase_randomized(self, seed):
+        """Randomized underutilized fleets: the global solve's first
+        exact-validated command must save at least what the two-phase LP
+        ladder's does on the same fleet."""
+        rng = random.Random(seed)
+        n = rng.randrange(4, 8)
+        env = build_fleet(n, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        deadline = env.clock.now() + 60.0
+        global_cmd = m._globalpack_option(cands, deadline)
+        lp_cmd = m._lp_option(cands, deadline)
+        global_savings = _command_savings_per_hour(global_cmd)
+        lp_savings = _command_savings_per_hour(lp_cmd)
+        assert global_savings >= lp_savings - 1e-9, (n, global_savings, lp_savings)
+        assert global_savings > 0
+
+    def test_pending_pods_enter_the_joint_solve(self):
+        """With pending pods in the cluster the global round still emits a
+        validated command, and the proposer's encode saw the pending axis
+        (trace span attribution n_pending > 0)."""
+        env = build_fleet(6, solver_backend="tpu")
+        flip_consolidatable(env)
+        for i in range(3):
+            env.store.create(make_pod(cpu="300m", name=f"gp-pend-{i}"))
+        m, cands = consolidation_method(env)
+        rec = env.provisioner.solver.recorder
+        cmd = m._globalpack_option(cands, env.clock.now() + 60.0)
+        assert cmd.candidates
+        traces = [t for t in rec.traces() if t.backend == "globalpack"]
+        assert traces, "no globalpack flight record"
+        t = traces[-1]
+        for phase in ("encode_candidates", "globalpack", "round", "validate"):
+            assert phase in t.phase_totals, (phase, t.phase_totals)
+        assert t.attribution.get("globalpack_proposals", 0) >= 1
+
+
+class TestEveryProposalValidated:
+    def test_emitted_command_is_a_validated_verdict(self, monkeypatch):
+        """The global arm may only return what compute_consolidation
+        produced: spy every exact-validation probe and require the emitted
+        command to be one of the spy's verdicts, candidate-set included."""
+        env = build_fleet(6, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        validated = []
+        orig = MultiNodeConsolidation.compute_consolidation
+
+        def spy(self, candidates, reuse=None):
+            cmd = orig(self, candidates, reuse=reuse)
+            validated.append(cmd)
+            return cmd
+
+        monkeypatch.setattr(MultiNodeConsolidation, "compute_consolidation", spy)
+        cmd = m._globalpack_option(cands, env.clock.now() + 60.0)
+        assert cmd.candidates, "global repack found no command on an idle fleet"
+        assert validated, "command emitted without any exact-validation probe"
+        assert any(v is cmd for v in validated), "emitted command bypassed validation"
+        from karpenter_tpu.controllers.disruption.helpers import (
+            all_non_pending_scheduled,
+            simulate_scheduling,
+        )
+
+        results = simulate_scheduling(env.provisioner, env.cluster, cmd.candidates, env.clock)
+        assert all_non_pending_scheduled(results, cmd.candidates)
+
+
+class TestEscapeHatch:
+    def test_hatch_off_is_bit_identical_two_phase(self, monkeypatch):
+        """Default (hatch off): compute_commands must run EXACTLY the
+        two-phase LP ladder — `_globalpack_option` is never entered — and
+        emit its verdict verbatim."""
+        monkeypatch.delenv("KARPENTER_SOLVER_GLOBALPACK", raising=False)
+        env = build_fleet(5, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        reference = m._lp_option(cands, env.clock.now() + 60.0)
+        assert reference.candidates
+
+        monkeypatch.setattr(MultiNodeConsolidation, "_globalpack_option", None)  # must not be called
+        captured = {}
+        orig = MultiNodeConsolidation._lp_option
+
+        def spy(self, candidates, deadline):
+            cmd = orig(self, candidates, deadline)
+            captured["cmd"] = cmd
+            return cmd
+
+        monkeypatch.setattr(MultiNodeConsolidation, "_lp_option", spy)
+        budgets = {env.store.list("NodePool")[0].metadata.name: 100}
+        m2, cands2 = consolidation_method(env)
+        m2.compute_commands(cands2, budgets)
+        assert "cmd" in captured, "two-phase LP did not run with the hatch off"
+        assert captured["cmd"].candidate_names() == reference.candidate_names()
+        assert abs(_command_savings_per_hour(captured["cmd"]) - _command_savings_per_hour(reference)) < 1e-9
+
+    def test_hatch_on_routes_through_globalpack(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_GLOBALPACK", "1")
+        env = build_fleet(5, solver_backend="tpu")
+        flip_consolidatable(env)
+        captured = {}
+        orig = MultiNodeConsolidation._globalpack_option
+
+        def spy(self, candidates, deadline):
+            cmd = orig(self, candidates, deadline)
+            captured["cmd"] = cmd
+            return cmd
+
+        monkeypatch.setattr(MultiNodeConsolidation, "_globalpack_option", spy)
+        budgets = {env.store.list("NodePool")[0].metadata.name: 100}
+        m, cands = consolidation_method(env)
+        m.compute_commands(cands, budgets)
+        assert "cmd" in captured, "hatch on did not route through the global arm"
+        assert captured["cmd"].candidates
+
+
+class TestZeroWarmRecompiles:
+    def test_repeated_global_rounds_record_zero_recompiles(self):
+        """Shape bucketing holds across global rounds on a stable fleet —
+        AND across interleaved two-phase rounds, because the zero-pending
+        delegation shares one jit cache with the global kernels."""
+        from karpenter_tpu.obs.trace import sentinel
+
+        env = build_fleet(5, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        deadline = env.clock.now() + 60.0
+        m._globalpack_option(cands, deadline)  # cold: compiles allowed
+        m._lp_option(cands, deadline)
+        before = sentinel().snapshot()
+        for _ in range(2):
+            cmd = m._globalpack_option(cands, deadline)
+            assert cmd.candidates
+            m._lp_option(cands, deadline)
+        delta = sentinel().delta(before)
+        assert not delta, f"warm global rounds recompiled: {delta}"
+
+
+class TestGlobalpackMetrics:
+    def test_bounded_family_and_proposer_enum_published(self):
+        from karpenter_tpu import metrics as mm
+
+        env = build_fleet(4, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        cmd = m._globalpack_option(cands, env.clock.now() + 60.0)
+        assert cmd.candidates
+        reg = env.disruption.ctx.metrics
+        assert reg.counter(mm.SOLVER_GLOBALPACK_ROUNDS_TOTAL).total() > 0
+        assert reg.counter(mm.SOLVER_GLOBALPACK_ITERATIONS_TOTAL).total() > 0
+        assert reg.gauge(mm.SOLVER_GLOBALPACK_OBJECTIVE_IMPROVEMENT).value() >= 0.0
+        assert reg.counter(mm.SOLVER_CONSOLIDATION_PROPOSALS_TOTAL).value(proposer="globalpack") > 0
+        assert reg.gauge(mm.SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR).value(proposer="globalpack") > 0
+
+
+class TestSecondCustomers:
+    def test_fleet_rebalance_hatch_gated(self, monkeypatch):
+        """FleetFrontend.rebalance: {} with the hatch off; a plan summary
+        (proposals/objective_improvement/rounded) with it on — computed via
+        TPUSolver.global_repack_plan, nothing executed."""
+        from karpenter_tpu.serving import ChurnSpec
+        from karpenter_tpu.serving.fleet import FleetFrontend, reset_tenant_labels
+
+        from test_fleet import add_churn_tenant
+
+        reset_tenant_labels()
+        fleet = FleetFrontend()
+        try:
+            h = add_churn_tenant(fleet, "t-gp", ChurnSpec(n_base_pods=12, n_types=6, concurrent_seconds=0.0))
+            h.provision_base_fleet()
+            h.env.clock.step(40)
+            h.env.nodeclaim_disruption.reconcile()
+            monkeypatch.delenv("KARPENTER_SOLVER_GLOBALPACK", raising=False)
+            assert fleet.rebalance("t-gp") == {}
+            monkeypatch.setenv("KARPENTER_SOLVER_GLOBALPACK", "1")
+            assert fleet.rebalance("no-such-tenant") == {}
+            out = fleet.rebalance("t-gp")
+            assert set(out) >= {"proposals", "objective_improvement", "rounded"}
+        finally:
+            fleet.close()
+            reset_tenant_labels()
+
+    def test_revocation_repack_recovers_at_least_two_phase(self):
+        """faultline's revocation path: after a spot reclaim the global
+        solve's exact-validated recovery must match or beat the greedy
+        two-phase ladder on the shrunken fleet."""
+        from karpenter_tpu.serving import ChurnHarness, ChurnSpec
+
+        h = ChurnHarness(ChurnSpec(n_base_pods=24, n_types=8, seed=11, concurrent_seconds=0.0)).build()
+        try:
+            h.provision_base_fleet()
+            h.apply_departures(12)
+            names = sorted(nd.metadata.name for nd in h.env.store.borrow_list("Node"))
+            assert names
+            h.revoke_node(names[0])
+            two = h.repack_savings(mode="two-phase")
+            glob = h.repack_savings(mode="global")
+        finally:
+            h.close()
+        assert glob >= two - 1e-9, (glob, two)

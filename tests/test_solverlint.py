@@ -106,19 +106,22 @@ class TestRuleFixtures:
 
     def test_metric_label_cardinality(self):
         findings = _fixture_findings("metric-label-cardinality", "metric_labels.py")
-        assert len(findings) == 6, findings
+        assert len(findings) == 7, findings
         by_msg = [f.message for f in findings]
         # the enumerable-value findings include the fleet tenant-label leak
         # (a raw tenant id instead of a tenant_label() producer output), the
         # podtrace stage-label leak (a runtime span name instead of the
-        # static STAGES enum), and the faultline breaker-state leak (a
-        # runtime breaker attribute instead of the TENANT_STATES enum)
-        assert sum("not statically enumerable" in m for m in by_msg) == 5
+        # static STAGES enum), the faultline breaker-state leak (a runtime
+        # breaker attribute instead of the TENANT_STATES enum), and the
+        # globalpack proposer leak (a runtime trace backend instead of the
+        # static proposer enum)
+        assert sum("not statically enumerable" in m for m in by_msg) == 6
         assert sum("splat" in m for m in by_msg) == 1
         src = (FIXTURES / "metric_labels.py").read_text().splitlines()
         assert any("tenant=session.tenant_id" in src[f.line - 1] for f in findings)
         assert any("stage=stage" in src[f.line - 1] for f in findings)
         assert any("state=breaker.state" in src[f.line - 1] for f in findings)
+        assert any("proposer=trace.backend" in src[f.line - 1] for f in findings)
 
     def test_guarded_field_access(self):
         # a read AND a write outside the declared lock are both findings;
