@@ -1507,6 +1507,187 @@ def bench_fleet_compile_cache(n_pods: int = 800, n_types: int = 20) -> dict:
     return out
 
 
+def bench_fleet_sharded(n_shards: int, tenants_per: int, n_base: int, iterations: int) -> dict:
+    """shardfleet (BENCH_r12): the multi-process scale-out gate. One
+    recorded churn log drives the same K tenants twice — through a SINGLE
+    worker process (the fleet front-end's one-serve-loop ceiling) and
+    through N shard worker processes replaying in parallel under the
+    ShardRouter — both over one shared persistent compile cache. Gates:
+
+    - THROUGHPUT: sharded aggregate STEADY-window events/sec >= single-
+      process x BENCH_SHARD_TPS_RATIO_GATE (default 1.5) — the process
+      fan-out must actually buy throughput past one serve loop (the
+      designated proxy for validating >= 50k ev/s off one process on real
+      hardware). The gate self-scopes to the harness (the fleet_compile_
+      cache pattern): with fewer than 2 cores per shard the arms timeshare
+      one CPU and wall-clock scale-out is physically impossible, so the
+      gate becomes a no-collapse floor (BENCH_SHARD_TPS_SERIAL_FLOOR,
+      default 0.7: serialized sharding may not cost >30% steady
+      throughput) and the 1.5x gate binds on multi-core/TPU;
+    - WARM-CACHE SCALE-OUT: the sharded arm's FRESH worker processes add
+      zero new entries to the already-warm shared compile cache (shard N+1
+      cold-starts compile-free);
+    - SHARD DEATH: killing one shard quarantines it through its breaker and
+      its tenants re-home by tenant-filtered log replay with BIT-IDENTICAL
+      placement digests;
+    - zero steady-window recompiles in every report, both arms (the log is
+      recorded at the zero-steady-recompile test shape: warmup_cycles=2
+      puts the cold consolidation traces pre-mark)."""
+    import tempfile
+
+    from karpenter_tpu.serving import ChurnHarness, ChurnSpec
+    from karpenter_tpu.serving.shard import ShardRing, ShardRouter
+
+    k = n_shards * tenants_per
+    # gate self-scoping: wall-clock fan-out needs the shards to actually run
+    # in parallel. With < 2 cores per shard (the CI harness is 1-core) the
+    # arms timeshare one CPU, so the ratio gate degrades to a no-collapse
+    # floor over the same steady windows
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-linux
+        cores = os.cpu_count() or 1
+    parallel_capable = cores >= 2 * n_shards
+    if parallel_capable:
+        scope = "parallel"
+        ratio_gate = float(os.environ.get("BENCH_SHARD_TPS_RATIO_GATE", "1.5"))
+    else:
+        scope = "cpu-serialized"
+        ratio_gate = float(os.environ.get("BENCH_SHARD_TPS_SERIAL_FLOOR", "0.7"))
+    # a RING-BALANCED tenant set (tenants_per seated on each shard): at
+    # bench K (4 tenants on 2 shards) raw hash luck can pile every tenant
+    # onto one shard and measure nothing — the statistical T>>N balance is
+    # the ring tests' business; this arm measures the process fan-out
+    probe = ShardRing([f"shard-{i}" for i in range(n_shards)])
+    seats: dict[str, list] = {f"shard-{i}": [] for i in range(n_shards)}
+    i = 0
+    while any(len(v) < tenants_per for v in seats.values()):
+        tid = f"tenant-{i}"
+        i += 1
+        if len(seats[probe.assign(tid)]) < tenants_per:
+            seats[probe.assign(tid)].append(tid)
+    tenant_ids = sorted(t for v in seats.values() for t in v)
+
+    def arm(cache: str, log: str, shards: int, reports: list) -> tuple[float, float]:
+        """Spawn a fresh router, replay every tenant, return (events, wall)
+        over the STEADY measurement windows only: events is the aggregate
+        post-warmup event count, wall the slowest shard's summed steady
+        window (shards run in parallel, tenants within a shard serially —
+        that max-of-sums IS the fleet's steady critical path, and it
+        excludes the per-process cold setup the warm-cache gate already
+        pins to zero compiles). The router is handed back via arm.router
+        for the shard-death leg."""
+        router = ShardRouter(
+            n_shards=shards, solver="tpu", cache_dir=cache,
+            worker_env={"KARPENTER_SOLVER_MESH": "0"},
+            breaker_failures=1, breaker_backoff_seconds=0.1,
+        )
+        arm.router = router
+        router.spawn()
+        for tid in tenant_ids:
+            router.add_tenant(tid, log_path=log)
+        results = router.run_all()
+        bad = {sid: r for sid, r in results.items() if not r.get("ok")}
+        if bad:
+            raise RuntimeError(f"shard arm failed: {bad}")
+        events = 0.0
+        wall = 0.0
+        for r in results.values():
+            shard_reports = [row["report"] for row in r["tenants"].values()]
+            events += sum(rep["events"] for rep in shard_reports)
+            wall = max(wall, sum(rep["wall_seconds"] for rep in shard_reports))
+            reports.extend(shard_reports)
+        return events, wall
+
+    with tempfile.TemporaryDirectory(prefix="karpenter-shardfleet-") as tmp:
+        log = os.path.join(tmp, "churn.jsonl")
+        cache = os.path.join(tmp, "compile-cache")
+        rec = ChurnHarness(
+            ChurnSpec(
+                n_base_pods=n_base, n_types=12,
+                arrivals=40, cancels=30, departures=40,
+                bind_every=2, iterations=iterations, warmup_cycles=2,
+                concurrent_seconds=0.0, record_path=log,
+            )
+        )
+        try:
+            rec.run()
+        finally:
+            rec.close()
+
+        reports: list[dict] = []
+        # warm the shared cache once (1 shard x 1 tenant) so BOTH measured
+        # arms run cache-warm — otherwise the baseline would pay the XLA
+        # compiles the sharded arm rides for free and inflate the ratio
+        warm_router = ShardRouter(
+            n_shards=1, solver="tpu", cache_dir=cache,
+            worker_env={"KARPENTER_SOLVER_MESH": "0"},
+        )
+        try:
+            warm_router.spawn()
+            warm_router.add_tenant(tenant_ids[0], log_path=log)
+            warm_router.run_all()
+        finally:
+            warm_router.close()
+        entries_warm = len(os.listdir(cache)) if os.path.isdir(cache) else 0
+
+        # single-process baseline: ONE worker serves all K tenants
+        try:
+            events_b, wall_b = arm(cache, log, 1, reports)
+        finally:
+            arm.router.close()
+        entries_base = len(os.listdir(cache)) if os.path.isdir(cache) else 0
+
+        # the sharded arm: N workers replay their ring slices in parallel
+        router = None
+        try:
+            events_s, wall_s = arm(cache, log, n_shards, reports)
+            router = arm.router
+            entries_sharded = len(os.listdir(cache)) if os.path.isdir(cache) else 0
+
+            # shard death + re-homing, on the still-live sharded fleet
+            owners = router.tenants()
+            victim = next(sid for sid in router.shards() if any(s == sid for s in owners.values()))
+            router._handle(victim).kill()
+            states = router.check_shards()
+            rehomed = router.rehome_tenants(victim)
+            rehome_ok = (
+                states.get(victim) == "quarantined"
+                and len(rehomed) >= 1
+                and all(row.get("matches") for row in rehomed.values())
+            )
+        finally:
+            if getattr(arm, "router", None) is not None:
+                arm.router.close()
+
+    eps_b = events_b / wall_b if wall_b > 0 else 0.0
+    eps_s = events_s / wall_s if wall_s > 0 else 0.0
+    ratio = eps_s / eps_b if eps_b > 0 else 0.0
+    new_entries = entries_sharded - entries_base
+    steady_recompiles = sum(int(r.get("steady_recompiles", 0)) for r in reports)
+    out = {
+        "shard_n": n_shards,
+        "shard_tenants": k,
+        "shard_singleproc_events_per_sec": round(eps_b, 1),
+        "shard_sharded_events_per_sec": round(eps_s, 1),
+        "shard_tps_ratio": round(ratio, 2),
+        "shard_tps_gate_floor": ratio_gate,
+        "shard_tps_gate_scope": scope,
+        "shard_cache_entries": entries_warm,
+        "shard_coldstart_new_entries": new_entries,
+        "shard_rehomed_tenants": len(rehomed),
+        "shard_steady_recompiles": steady_recompiles,
+        "shard_tps_gate": "PASS" if ratio >= ratio_gate else "FAIL",
+        "shard_coldstart_gate": "PASS" if (new_entries == 0 and entries_warm > 0) else "FAIL",
+        "shard_rehome_gate": "PASS" if rehome_ok else "FAIL",
+        "shard_recompile_gate": "PASS" if steady_recompiles == 0 else "FAIL",
+    }
+    for gate in ("shard_tps_gate", "shard_coldstart_gate", "shard_rehome_gate", "shard_recompile_gate"):
+        if out[gate] == "FAIL":
+            print(f"SHARDED FLEET {gate.upper()} FAILED: {out}", file=sys.stderr)
+    return out
+
+
 def bench_trace_overhead(n_pods: int, n_types: int) -> dict:
     """The solvetrace acceptance gate: tracing is ON by default, so its cost
     must be measured and bounded. The SAME warm snapshot solves with the
@@ -1980,6 +2161,9 @@ def main():
         os.environ.setdefault("BENCH_CHAOS_PODS", "300")
         os.environ.setdefault("BENCH_CHAOS_ITER", "12")
         os.environ.setdefault("BENCH_COMPILE_CACHE_PODS", "500")
+        # fleet_sharded smoke: 2 shards x 2 tenants at tier-1 churn scale
+        os.environ.setdefault("BENCH_SHARD_PODS", "160")
+        os.environ.setdefault("BENCH_SHARD_ITER", "6")
         os.environ.setdefault("BENCH_DEADLINE_SECONDS", "1800")
         _RESULT["extra"]["smoke"] = True
     _install_guards(float(os.environ.get("BENCH_DEADLINE_SECONDS", "3300")))
@@ -2130,6 +2314,18 @@ def main():
     )
     if cc is not None:
         extra.update(cc)
+    # shardfleet (BENCH_r12): the multi-process scale-out arm — N shard
+    # worker processes vs ONE worker on the same recorded tenant set and
+    # shared compile cache, plus the shard-death re-homing gate
+    shf = _run_scenario(
+        "fleet_sharded", bench_fleet_sharded,
+        int(os.environ.get("BENCH_SHARD_N", "2")),
+        int(os.environ.get("BENCH_SHARD_TENANTS_PER", "2")),
+        int(os.environ.get("BENCH_SHARD_PODS", "1250")),
+        int(os.environ.get("BENCH_SHARD_ITER", "8")),
+    )
+    if shf is not None:
+        extra.update(shf)
     # solvetrace on/off overhead at the headline scale (<2% gate; tracing is
     # default-on, so this is the cost every number above already paid)
     tov = _run_scenario("trace_overhead", bench_trace_overhead, n_pods, n_types)

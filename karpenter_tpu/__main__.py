@@ -37,6 +37,16 @@ def main(argv=None) -> int:
         "jitted kernels, one /metrics (tenant-labeled). Tenant ids are tenant-0..N-1; "
         "KARPENTER_SOLVER_COMPILE_CACHE=<dir> persists compiles across restarts.",
     )
+    parser.add_argument(
+        "--fleet-shards",
+        type=int,
+        default=0,
+        help="N>0 boots the SHARDED fleet (serving/shard.py): N shard worker "
+        "processes, each a FleetFrontend over its consistent-hash slice of the "
+        "--fleet-tenants tenants, sharing one KARPENTER_SOLVER_COMPILE_CACHE. "
+        "This process runs the ShardRouter + the aggregated /metrics, "
+        "/debug/tenants, /debug/shards, and ?tenant=-proxied debug surfaces.",
+    )
     # every reference flag (options.go AddFlags: --metrics-port,
     # --kube-client-qps, --log-level, --disable-leader-election,
     # --enable-profiling, --feature-gates, ...) parses via Options.from_args
@@ -62,6 +72,8 @@ def main(argv=None) -> int:
         handlers=handlers or None,
     )
 
+    if args.fleet_shards > 0:
+        return _run_sharded(args, options, port)
     if args.fleet_tenants > 0:
         return _run_fleet(args, options, port)
 
@@ -96,6 +108,51 @@ def main(argv=None) -> int:
         server.stop()
         if health_server is not None:
             health_server.stop()
+    return 0
+
+
+def _run_sharded(args, options, port: int) -> int:
+    """Sharded fleet mode: this process is the ShardRouter — it spawns
+    --fleet-shards worker processes (each its own FleetFrontend serve loop
+    over a consistent-hash slice of the tenants, sharing one persistent
+    compile cache and a contiguous device slice), seats tenant-0..K-1 on
+    the ring, starts every shard serving, and fronts the aggregated
+    debug/metrics surfaces plus the breaker-driven health monitor."""
+    import os
+
+    from .serving.shard import ShardRouter
+
+    n_tenants = args.fleet_tenants if args.fleet_tenants > 0 else args.fleet_shards
+    router = ShardRouter(
+        n_shards=args.fleet_shards,
+        solver=options.solver_backend,
+        cache_dir=os.environ.get("KARPENTER_SOLVER_COMPILE_CACHE", "").strip() or None,
+    )
+    router.spawn()
+    server = None
+    try:
+        for i in range(n_tenants):
+            router.add_tenant(f"tenant-{i}")
+        router.start_serving(tick_seconds=args.tick_seconds)
+        router.start_monitor()
+        server = OperatorServer(None, port=port, enable_profiling=options.enable_profiling, bind=args.bind, router=router)
+        port = server.start()
+        print(
+            f"karpenter-tpu sharded fleet up: shards={args.fleet_shards} tenants={n_tenants} "
+            f"solver={options.solver_backend} http={args.bind}:{port}",
+            flush=True,
+        )
+        stop = make_event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                signal.signal(sig, lambda *_: stop.set())
+            except ValueError:
+                pass  # not the main thread
+        stop.wait()
+    finally:
+        if server is not None:
+            server.stop()
+        router.close()
     return 0
 
 

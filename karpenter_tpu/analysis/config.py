@@ -60,12 +60,15 @@ class Config:
     # (obs.podtrace.WAKE_CAUSES) and "stage" the podtrace event-lifecycle
     # stage enum (obs.podtrace.STAGES); "state" is faultline's breaker-state
     # enum (serving.faults.TENANT_STATES — stage also covers the recovery
-    # ladder's RECOVERY_STAGES) and "seam" its FAULT_SEAMS injection enum —
-    # all held to the same bound
-    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer", "event", "lock", "tenant", "cause", "stage", "state", "seam")
+    # ladder's RECOVERY_STAGES) and "seam" its FAULT_SEAMS injection enum;
+    # "shard" is the shardfleet router's capped label (serving.shard
+    # shard_label — same overflow contract as tenant) — all held to the
+    # same bound
+    bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer", "event", "lock", "tenant", "cause", "stage", "state", "seam", "shard")
     # callees whose return value is enum-bounded by construction
-    # (tenant_label caps distinct outputs at serving.fleet.TENANT_LABEL_CAP)
-    bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family", "tenant_label")
+    # (tenant_label caps distinct outputs at serving.fleet.TENANT_LABEL_CAP;
+    # shard_label at serving.shard.SHARD_LABEL_CAP)
+    bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family", "tenant_label", "shard_label")
     # wrapper methods whose OWN bodies forward **labels to the registry
     metric_wrappers: tuple[str, ...] = ("_count", "_observe")
     # cap on distinct literal values per bounded label key, repo-wide
@@ -123,6 +126,12 @@ class Config:
         "FleetFrontend._serve_loop",
         "karpenter_tpu/serving/fleet.py:_on_watch_event",
         "karpenter_tpu/serving/churn.py:_churn_driver",
+        # shardfleet (serving/shard.py): the router's per-shard run_all
+        # driver threads (one writer per results key), the breaker-driven
+        # health monitor, and the worker-side live env tick loop
+        "ShardRouter._drive_shard",
+        "ShardRouter._monitor_loop",
+        "karpenter_tpu/serving/shard.py:_tick_loop",
         # informer/cost watch callbacks: they only call into the
         # lock-guarded Cluster/ClusterCost/Store surfaces
         "karpenter_tpu/state/informer.py:on_*",

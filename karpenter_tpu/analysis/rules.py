@@ -440,19 +440,19 @@ class MetricLabelCardinalityRule(Rule):
     description = "bounded metric labels must carry statically enumerable values"
     _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
 
-    # the seeded violation is a globalpack one: the consolidation proposals
-    # counter's `proposer` label fed a runtime trace attribute instead of a
-    # literal from the static proposer enum (lp | anneal | binary-search |
-    # globalpack) — exactly the cardinality leak the global-repack rollout
-    # must never regress into
+    # the seeded violation is a shardfleet one: the router's re-homed-tenants
+    # counter's `shard` label fed a raw shard id straight from a runtime row
+    # instead of the bounded serving.shard.shard_label producer (capped at
+    # SHARD_LABEL_CAP distinct outputs, past-the-cap ids collapse to
+    # "overflow") — exactly the cardinality leak a fleet that respawns
+    # shards under churn must never regress into
     SELF_TEST_BAD = (
-        "def publish(registry, trace):\n"
-        '    registry.counter("karpenter_solver_consolidation_proposals_total").inc(8, proposer=trace.backend)\n'
+        "def publish(registry, row):\n"
+        '    registry.counter("karpenter_solver_shard_rehomed_tenants_total").inc(1, shard=row["shard"])\n'
     )
     SELF_TEST_OK = (
-        "def publish(registry, trace):\n"
-        '    proposer = "globalpack" if trace.backend == "globalpack" else "lp"\n'
-        '    registry.counter("karpenter_solver_consolidation_proposals_total").inc(8, proposer=proposer)\n'
+        "def publish(registry, row):\n"
+        '    registry.counter("karpenter_solver_shard_rehomed_tenants_total").inc(1, shard=shard_label(row["shard"]))\n'
     )
 
     def __init__(self):

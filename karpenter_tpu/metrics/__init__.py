@@ -140,6 +140,16 @@ SOLVER_FLEET_OLDEST_EVENT_AGE = "karpenter_solver_fleet_oldest_event_age_seconds
 SOLVER_FAULT_INJECTIONS_TOTAL = "karpenter_solver_fault_injections_total"
 SOLVER_PRESTAGE_WORKER_RESTARTS_TOTAL = "karpenter_solver_prestage_worker_restarts_total"
 SOLVER_WATCH_RESYNC_TOTAL = "karpenter_solver_watch_resync_total"
+# shardfleet (serving/shard.py): the multi-process fleet router. `shard` is
+# the BOUNDED shard label (serving.shard.shard_label — same cap/overflow
+# contract as tenant_label); `state` reuses the faults.TENANT_STATES enum
+# for the router's per-shard circuit breakers. The router also re-exposes
+# every shard's karpenter_solver_fleet_* samples with an injected `shard`
+# label via ShardRouter.merged_metrics.
+SOLVER_FLEET_SHARDS = "karpenter_solver_fleet_shards"
+SOLVER_SHARD_STATE = "karpenter_solver_shard_state"
+SOLVER_SHARD_REHOMED_TOTAL = "karpenter_solver_shard_rehomed_tenants_total"
+SOLVER_SHARD_RESTARTS_TOTAL = "karpenter_solver_shard_restarts_total"
 # lock waits live well under the solve buckets: sub-ms is the norm, anything
 # past 100ms is contention worth a dashboard line. Shared with the wrapper's
 # emission site so a registry that skipped make_registry still gets the
@@ -392,6 +402,24 @@ def make_registry() -> Registry:
         "Level-triggered Cluster resyncs from store content after the watch "
         "stream's gap tracker detected lost Pod events",
         (),
+    )
+    r.gauge(SOLVER_FLEET_SHARDS, "Shard worker processes currently seated on the router's ring", ())
+    r.gauge(
+        SOLVER_SHARD_STATE,
+        "Per-shard router circuit-breaker state (1 on the current state's "
+        "series): healthy | quarantined | probing",
+        ("shard", "state"),
+    )
+    r.counter(
+        SOLVER_SHARD_REHOMED_TOTAL,
+        "Tenants re-homed onto a shard after their home shard died (recorded-"
+        "log replay, bit-identical placement contract)",
+        ("shard",),
+    )
+    r.counter(
+        SOLVER_SHARD_RESTARTS_TOTAL,
+        "Shard worker processes respawned by the router after a death",
+        ("shard",),
     )
     return r
 

@@ -33,8 +33,16 @@ class OperatorServer:
     # vs. shutdown path), so the server/thread handles are claimed under a lock
     GUARDED_FIELDS = {"_httpd": "_lock", "_thread": "_lock"}
 
-    def __init__(self, env, port: int = 8080, enable_profiling: bool = False, bind: str = "0.0.0.0"):
+    def __init__(self, env, port: int = 8080, enable_profiling: bool = False, bind: str = "0.0.0.0", router=None):
+        """With `router` (a serving.shard.ShardRouter), this server is the
+        fleet-of-fleets AGGREGATION front: /metrics merges every shard's
+        fleet families (bounded `shard` label injected), /debug/tenants
+        merges shard-stamped rows, /debug/solves|events proxy by ?tenant=
+        to the owning shard, /readyz reflects shard breaker health, and
+        /debug/shards exposes the router's per-shard breaker rows. `env`
+        may be None in router mode (the router has no local tenants)."""
         self.env = env
+        self.router = router
         self.port = port
         self.bind = bind  # probes/scrapes come from off-host (operator.go:180-183)
         self.enable_profiling = enable_profiling
@@ -44,6 +52,7 @@ class OperatorServer:
 
     def start(self) -> int:
         env = self.env
+        router = self.router
         enable_profiling = self.enable_profiling
 
         class Handler(BaseHTTPRequestHandler):
@@ -62,8 +71,16 @@ class OperatorServer:
                 if self.path == "/healthz":
                     self._send(200, "ok")
                 elif self.path == "/readyz":
+                    if router is not None:
+                        ready = router.ready()
+                        self._send(200 if ready else 503, "ok" if ready else "shard fleet not healthy")
+                        return
                     ready = env.cluster.synced()
                     self._send(200 if ready else 503, "ok" if ready else "cluster state not synced")
+                elif self.path == "/metrics" and router is not None:
+                    # router mode: the shard-merged exposition (every shard's
+                    # fleet families with the bounded `shard` label injected)
+                    self._send(200, router.merged_metrics(), "text/plain; version=0.0.4")
                 elif self.path == "/metrics":
                     # podtrace quantile gauges publish per SCRAPE (sorting
                     # the stage windows rides this handler, never the
@@ -76,6 +93,26 @@ class OperatorServer:
                     for _label, (_rec, tenant_tracer) in tenant_surfaces().items():
                         tenant_tracer.publish_quantiles()
                     self._send(200, env.registry.expose(), "text/plain; version=0.0.4")
+                elif router is not None and self.path.split("?", 1)[0] in ("/debug/solves", "/debug/events"):
+                    # router mode: proxy the per-tenant dump to the shard
+                    # that serves that tenant (?tenant= is REQUIRED — the
+                    # router has no local recorder to fall back on)
+                    route = self.path.split("?", 1)[0]
+                    qs = parse_qs(urlparse(self.path).query)
+                    tenant = qs["tenant"][0] if "tenant" in qs else None
+                    try:
+                        limit = int(qs["n"][0]) if "n" in qs else None
+                    except ValueError:
+                        self._send(400, "bad ?n= value")
+                        return
+                    if tenant is None:
+                        self._send(400, f"router mode: {route} requires ?tenant=")
+                        return
+                    try:
+                        proxy = router.debug_solves if route == "/debug/solves" else router.debug_events
+                        self._send(200, proxy(tenant, n=limit), "application/json")
+                    except KeyError:
+                        self._send(404, f"unknown tenant {tenant!r}")
                 elif self.path.split("?", 1)[0] == "/debug/solves":
                     # served unconditionally (unlike /debug/profile, which the
                     # reference gates behind --enable-profiling): the trace
@@ -131,10 +168,19 @@ class OperatorServer:
                 elif self.path.split("?", 1)[0] == "/debug/tenants":
                     # faultline: per-tenant failure-domain state — breaker
                     # state/backoff/last-error, backlog, wakes — merged
-                    # across every live FleetFrontend in this process
+                    # across every live FleetFrontend in this process, or in
+                    # router mode across every SHARD (rows stamped with the
+                    # owning shard id)
+                    if router is not None:
+                        self._send(200, json.dumps({"tenants": router.debug_tenants()}, indent=1), "application/json")
+                        return
                     from ..serving.fleet import fleet_debug_surfaces
 
                     self._send(200, json.dumps({"tenants": fleet_debug_surfaces()}, indent=1), "application/json")
+                elif self.path == "/debug/shards" and router is not None:
+                    # shardfleet: per-shard liveness, breaker snapshot, debug
+                    # port, ring index, and seated tenants
+                    self._send(200, json.dumps({"shards": router.debug_shards()}, indent=1), "application/json")
                 elif self.path == "/debug/profile" and enable_profiling:
                     frames = {}
                     for tid, frame in sys._current_frames().items():

@@ -77,8 +77,31 @@ def make_mesh(devices=None, axis: str = "batch") -> Mesh:
     return Mesh(np.array(devices), (axis,))
 
 
+def shard_device_slice(devices):
+    """shardfleet device partitioning: with ``KARPENTER_SOLVER_SHARD_DEVICES=
+    "<i>/<n>"`` set (the ShardRouter stamps each worker process with its
+    shard index), keep only contiguous chunk i of the visible devices split
+    into n chunks — each shard's fleet runs on its own device slice instead
+    of N shard processes contending for every chip. Malformed specs and
+    out-of-range indices fall back to all devices; a ≤1-device slice
+    degenerates to the unsharded path exactly like a 1-device host."""
+    spec = os.environ.get("KARPENTER_SOLVER_SHARD_DEVICES", "").strip()
+    if not spec:
+        return devices
+    try:
+        i_s, n_s = spec.split("/", 1)
+        i, n = int(i_s), int(n_s)
+    except ValueError:
+        return devices
+    if n <= 0 or not 0 <= i < n:
+        return devices
+    chunk = -(-len(devices) // n)
+    return devices[i * chunk : (i + 1) * chunk]
+
+
 def default_mesh() -> Mesh | None:
-    """The production-default mesh: every visible device, engaged whenever
+    """The production-default mesh: every visible device (restricted to this
+    shard's slice under KARPENTER_SOLVER_SHARD_DEVICES), engaged whenever
     more than one exists. ``KARPENTER_SOLVER_MESH=0`` (or off/false/none)
     forces the unsharded path; a 1-device mesh degenerates to None (the
     caller then runs the plain single-device kernels)."""
@@ -89,6 +112,7 @@ def default_mesh() -> Mesh | None:
         devices = jax.devices()
     except Exception:  # noqa: BLE001  # solverlint: ok(swallowed-exception): no jax backend is a valid headless state — the caller treats None as single-device
         return None
+    devices = shard_device_slice(devices)
     if len(devices) <= 1:
         return None
     return make_mesh(devices)

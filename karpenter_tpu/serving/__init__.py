@@ -34,6 +34,15 @@ makes that regime first-class:
   tensors; `isolation_audit()` enforces the split). With
   KARPENTER_SOLVER_COMPILE_CACHE=<dir> compiled executables persist across
   process restarts and replicas.
+- `shard.ShardRouter` / `shard.ShardRing` — shardfleet: the tenant→PROCESS
+  scale-out. The router spawns N shard worker processes (each its own
+  FleetFrontend serve loop over a consistent-hash slice of the tenants),
+  shares one persistent compile cache so shard N+1 cold-starts
+  compile-free, partitions visible devices per shard
+  (KARPENTER_SOLVER_SHARD_DEVICES), aggregates /debug/tenants +
+  /debug/solves + /debug/events + the fleet metric families across shards
+  (bounded `shard` label), and re-homes a dead shard's tenants by
+  tenant-filtered recorded-log replay under per-shard circuit breakers.
 - `faults.FaultSpec` / `faults.FaultInjector` / `faults.CircuitBreaker` —
   faultline: deterministic seeded fault injection at the named serving
   seams (solve exception / decode failure / slow solve, watch-stream
@@ -71,6 +80,15 @@ the serving stack's long-lived ones, every entry a reviewed seam in the
   state;
 - `karpenter-lease-renewer` (LeaderElector.renew_loop): renews the lease
   through the store's optimistic concurrency;
+- `karpenter-shard-drive-*` (ShardRouter._drive_shard): shardfleet run_all
+  fan-out — one thread per shard, each exclusively owning its results key
+  and its shard's handle (pipe I/O serialized under shard-handle);
+- `karpenter-shard-monitor` (ShardRouter._monitor_loop): the router's
+  breaker-driven shard health prober — pings through ShardHandle.call and
+  mutates only breaker/registry state;
+- `karpenter-shard-tick` (shard._tick_loop, worker process): live-mode
+  controller rounds (env.tick(provision=False)) over the shard's tenant
+  sessions, same division of labor as __main__._run_fleet's main loop;
 - watch DELIVERY runs on whatever thread committed the store write, under
   `Store._deliver_lock` — every watch callback executes there.
 
@@ -111,6 +129,13 @@ clock               FakeClock._t
 leader              LeaderElector._leading/_last_renew
 nodepool-health     registration-health trackers (RLock)
 operator-server     OperatorServer httpd/thread handles
+shard-router        ShardRouter handle/port/tenant/breaker maps + ring +
+                    monitor-thread handle (leaf: shard calls and breaker
+                    methods always run unlocked)
+shard-handle        one ShardHandle's Popen + pipe protocol framing (leaf;
+                    the readline is plain pipe I/O, not a blocking call in
+                    the lock-order sense)
+shard-labels        the bounded shard-label assignment table (leaf)
 ==================  =======================================================
 
 SANCTIONED ORDER (acquire left before right; the dynamic graph must stay a
@@ -149,3 +174,4 @@ from .faults import CircuitBreaker, FaultInjector, FaultRule, FaultSpec  # noqa:
 from .fleet import FleetFrontend, TenantSession, tenant_label  # noqa: F401
 from .loop import ServingLoop, doublebuf_enabled  # noqa: F401
 from .prestage import PendingPrestager  # noqa: F401
+from .shard import ShardRing, ShardRouter, placement_digest, shard_label  # noqa: F401

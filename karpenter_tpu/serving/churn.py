@@ -99,13 +99,23 @@ class ChurnSpec:
     trace_capacity: int = 8192
 
     @classmethod
-    def from_event_log(cls, path: str, **overrides) -> "ChurnSpec":
+    def from_event_log(cls, path: str, tenant=None, **overrides) -> "ChurnSpec":
         """A replay spec: drive the harness from a recorded JSONL event log
         instead of generating events. Scale fields are taken from the log's
         header line when present (so gates scale consistently); overrides
         win. The replay is deterministic: same log + same seed = the same
         placements, which is what lets one recorded stream drive K fleet
-        tenants and be compared bit-for-bit."""
+        tenants and be compared bit-for-bit.
+
+        `tenant` (a tenant id or a collection of them) replays a NAMED
+        SUBSET of a tenant-stamped log: ops whose `tenant` tag names a
+        different tenant are dropped, while untagged ops (single-tenant
+        recordings, shared pacing skeleton) always replay. This is the
+        shard re-homing contract — "replay only tenant-7's ops" into a
+        surviving shard after its home shard dies."""
+        tenants = None
+        if tenant is not None:
+            tenants = {tenant} if isinstance(tenant, str) else set(tenant)
         events = []
         header: dict = {}
         with open(path) as f:
@@ -116,7 +126,7 @@ class ChurnSpec:
                 op = json.loads(line)
                 if op.get("op") == "header":
                     header = op
-                else:
+                elif tenants is None or op.get("tenant") is None or op["tenant"] in tenants:
                     events.append(op)
         kw = {k: header[k] for k in ("n_base_pods", "n_types", "arrivals", "cancels", "departures", "bind_every", "seed", "batch_idle_seconds") if k in header}
         if header.get("faults"):
@@ -275,6 +285,11 @@ class ChurnHarness:
     def _log(self, **op) -> None:
         if self._event_log is not None:
             op.setdefault("t", round(time.perf_counter() - self._log_t0, 6))
+            # fleet-attached recordings stamp every op with the owning tenant
+            # so a merged/fleet log can later be replayed for a NAMED subset
+            # (from_event_log(tenant=...)) — the shard re-homing contract
+            if self._tenant_id is not None:
+                op.setdefault("tenant", self._tenant_id)
             self._event_log.append(op)
 
     # -- stack -----------------------------------------------------------------

@@ -131,6 +131,25 @@ def configure_compile_cache() -> str | None:
     path = os.environ.get("KARPENTER_SOLVER_COMPILE_CACHE", "").strip()
     if not path or _COMPILE_CACHE_DIR == path:
         return _COMPILE_CACHE_DIR
+    # RACE-SAFE multi-process init (shardfleet): N shard processes point at
+    # the same dir concurrently at startup. makedirs is idempotent, and the
+    # stamp file is claimed with O_CREAT|O_EXCL so exactly ONE process is
+    # the first writer — everyone else adopts the established dir. jax's
+    # own entry writes are tmp-file+rename atomic, so concurrent warmers
+    # interleave without corrupt entries; this guard gives the DIRECTORY
+    # itself one well-defined creator (tests/test_shardfleet.py races two
+    # processes through here against a fresh dir).
+    try:
+        os.makedirs(path, exist_ok=True)
+        fd = os.open(os.path.join(path, ".karpenter-cache-stamp"), os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        try:
+            os.write(fd, f"pid={os.getpid()}\n".encode())
+        finally:
+            os.close(fd)
+    except FileExistsError:
+        pass  # another process won the first-writer claim: adopt its dir
+    except OSError:
+        return None  # unwritable cache dir: run uncached, never broken
     import jax
 
     try:

@@ -1,0 +1,316 @@
+"""shardfleet (karpenter_tpu/serving/shard.py): horizontal multi-process
+fleet sharding with warm-cache scale-out.
+
+Pins the subsystem's contracts:
+- ring stability: adding a shard moves ONLY tenants onto the new shard (and
+  no more than ~T/N of them); removing a shard moves EXACTLY its orphans;
+  the assignment is a pure bit-stable function of the shard-id set,
+  identical across processes regardless of PYTHONHASHSEED (blake2b, never
+  the builtin hash());
+- bounded shard labels: shard_label mirrors tenant_label's cap/overflow/
+  collision-disambiguation contract;
+- tenant-filtered replay: ChurnSpec.from_event_log(tenant=...) replays a
+  NAMED subset of a tenant-stamped log (untagged ops always replay), and a
+  fleet-attached harness stamps every recorded op with its tenant — the
+  shard re-homing substrate;
+- race-safe compile-cache claim: two processes configuring the same fresh
+  KARPENTER_SOLVER_COMPILE_CACHE dir both succeed (first-writer wins, the
+  loser adopts), and an unwritable dir degrades to uncached, never broken;
+- the router end-to-end: N worker processes replay the same recorded log
+  deterministically (bit-identical placement digests ACROSS processes),
+  aggregation surfaces merge shard-stamped, a killed shard quarantines via
+  its breaker, and its tenants re-home with bit-identical placements —
+  both onto a surviving shard and onto a respawned one.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import pytest
+
+from test_churn_loop import small_spec
+from karpenter_tpu.serving import ChurnHarness, ChurnSpec
+from karpenter_tpu.serving.shard import (
+    SHARD_LABEL_CAP,
+    ShardRing,
+    ShardRouter,
+    reset_shard_labels,
+    shard_label,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_shard_labels():
+    reset_shard_labels()
+    yield
+    reset_shard_labels()
+
+
+def ring_assignments(n_shards: int, tenants) -> dict:
+    return ShardRing([f"shard-{i}" for i in range(n_shards)]).assignments(tenants)
+
+
+class TestShardRing:
+    T = 200
+
+    def test_add_moves_only_onto_the_new_shard(self):
+        """Scale-out movement bound: growing N -> N+1 re-homes only tenants
+        whose successor became the NEW shard — every moved tenant lands on
+        it, and the moved count stays near T/(N+1)."""
+        tenants = [f"tenant-{i}" for i in range(self.T)]
+        before = ring_assignments(4, tenants)
+        after = ring_assignments(5, tenants)
+        moved = [t for t in tenants if before[t] != after[t]]
+        assert moved, "a new shard must take some tenants"
+        assert all(after[t] == "shard-4" for t in moved)
+        # ceil(T/N) + slack: vnode placement is uneven (64 replicas), but
+        # nowhere near a full reshuffle
+        bound = math.ceil(self.T / 5) + math.ceil(self.T / 10)
+        assert len(moved) <= bound, f"moved {len(moved)} > {bound}"
+
+    def test_remove_moves_exactly_the_orphans(self):
+        """Shard death re-homes EXACTLY the dead shard's tenants; every
+        surviving tenant keeps its assignment bit-for-bit."""
+        tenants = [f"tenant-{i}" for i in range(self.T)]
+        before = ring_assignments(5, tenants)
+        ring = ShardRing([f"shard-{i}" for i in range(5)])
+        ring.remove("shard-2")
+        after = ring.assignments(tenants)
+        moved = {t for t in tenants if before[t] != after[t]}
+        orphans = {t for t in tenants if before[t] == "shard-2"}
+        assert moved == orphans
+        assert all(after[t] != "shard-2" for t in orphans)
+
+    def test_bit_stable_across_rebuilds_and_insertion_order(self):
+        """The assignment is a pure function of the shard-id SET: a rebuilt
+        ring (router restart) and a permuted insertion order agree on every
+        tenant."""
+        tenants = [f"tenant-{i}" for i in range(self.T)]
+        a = ShardRing(["shard-0", "shard-1", "shard-2"]).assignments(tenants)
+        b = ShardRing(["shard-2", "shard-0", "shard-1"]).assignments(tenants)
+        assert a == b
+        # add/remove round-trip restores the original assignment exactly
+        ring = ShardRing(["shard-0", "shard-1", "shard-2"])
+        ring.add("shard-3")
+        ring.remove("shard-3")
+        assert ring.assignments(tenants) == a
+
+    def test_seed_independent_across_processes(self):
+        """A subprocess with a DIFFERENT PYTHONHASHSEED computes the same
+        assignments — the ring must never lean on the builtin hash()."""
+        tenants = [f"tenant-{i}" for i in range(50)]
+        local = ring_assignments(3, tenants)
+        code = (
+            "import json, sys\n"
+            "from karpenter_tpu.serving.shard import ShardRing\n"
+            "ring = ShardRing(['shard-0', 'shard-1', 'shard-2'])\n"
+            "tenants = [f'tenant-{i}' for i in range(50)]\n"
+            "print(json.dumps(ring.assignments(tenants)))\n"
+        )
+        env = dict(os.environ, PYTHONHASHSEED="12345", JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True, text=True, timeout=60
+        )
+        assert out.returncode == 0, out.stderr
+        assert json.loads(out.stdout.strip()) == local
+
+    def test_empty_ring_raises(self):
+        with pytest.raises(ValueError):
+            ShardRing().assign("tenant-0")
+
+
+class TestShardLabel:
+    def test_cap_and_overflow(self):
+        for i in range(SHARD_LABEL_CAP):
+            assert shard_label(f"shard-{i}") == f"shard-{i}"
+        assert shard_label("one-more") == "overflow"
+        # established assignments stay sticky past the cap
+        assert shard_label("shard-0") == "shard-0"
+
+    def test_sanitize_collisions_never_merge_shards(self):
+        a = shard_label("zone/a")
+        b = shard_label("zone:a")
+        assert a != b
+        assert shard_label("zone/a") == a and shard_label("zone:a") == b
+
+    def test_empty_id_gets_default(self):
+        assert shard_label("") == "default"
+
+
+class TestTenantFilteredReplay:
+    def _write_log(self, path: str) -> None:
+        ops = [
+            {"op": "header", "n_base_pods": 3, "n_types": 2, "arrivals": 1, "cancels": 0,
+             "departures": 1, "bind_every": 1, "seed": 7, "batch_idle_seconds": 0.01},
+            {"op": "base", "t": 0.0},  # untagged: the shared pacing skeleton
+            {"op": "arrive", "t": 0.1, "tenant": "alpha"},
+            {"op": "arrive", "t": 0.2, "tenant": "beta"},
+            {"op": "depart", "t": 0.3, "tenant": "alpha"},
+            {"op": "mark", "t": 0.4},
+        ]
+        with open(path, "w") as f:
+            for op in ops:
+                f.write(json.dumps(op) + "\n")
+
+    def test_named_subset_filter(self, tmp_path):
+        """tenant= replays only that tenant's tagged ops plus every
+        untagged op — the shard re-homing contract."""
+        log = str(tmp_path / "fleet.jsonl")
+        self._write_log(log)
+        spec = ChurnSpec.from_event_log(log, tenant="alpha")
+        kinds = [(op["op"], op.get("tenant")) for op in spec.replay_events]
+        assert kinds == [("base", None), ("arrive", "alpha"), ("depart", "alpha"), ("mark", None)]
+        # header scale fields round-trip through the filter
+        assert spec.n_base_pods == 3 and spec.seed == 7
+
+    def test_collection_and_unfiltered(self, tmp_path):
+        log = str(tmp_path / "fleet.jsonl")
+        self._write_log(log)
+        both = ChurnSpec.from_event_log(log, tenant={"alpha", "beta"})
+        assert len(both.replay_events) == 5
+        unfiltered = ChurnSpec.from_event_log(log)
+        assert len(unfiltered.replay_events) == 5
+        nobody = ChurnSpec.from_event_log(log, tenant="gamma")
+        assert [op["op"] for op in nobody.replay_events] == ["base", "mark"]
+
+    def test_attached_harness_stamps_tenant(self, tmp_path):
+        """A fleet-attached recording tags every op with the owning tenant
+        id, so a merged log can later replay a named subset."""
+        h = ChurnHarness(small_spec(record_path=str(tmp_path / "rec.jsonl")))
+        assert h._event_log == []
+        h._log(op="solve")
+        assert "tenant" not in h._event_log[-1]  # standalone: untagged
+        h._tenant_id = "tenant-7"  # what attach() sets
+        h._log(op="solve")
+        assert h._event_log[-1]["tenant"] == "tenant-7"
+
+
+class TestCompileCacheRace:
+    def test_two_process_first_writer_wins(self, tmp_path):
+        """Two processes racing configure_compile_cache on the same FRESH
+        dir both succeed: one wins the O_EXCL stamp claim, the loser adopts
+        the dir — the shard scale-out boot path."""
+        cache = str(tmp_path / "shared-cache")
+        code = (
+            "import os\n"
+            "from karpenter_tpu.solver.tpu import configure_compile_cache\n"
+            "path = configure_compile_cache()\n"
+            "assert path == os.environ['KARPENTER_SOLVER_COMPILE_CACHE'], path\n"
+            "print('CLAIMED', path)\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", KARPENTER_SOLVER_COMPILE_CACHE=cache)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", code],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            for _ in range(2)
+        ]
+        outs = [p.communicate(timeout=120) for p in procs]
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, err
+            assert "CLAIMED" in out
+        assert os.path.exists(os.path.join(cache, ".karpenter-cache-stamp"))
+
+    def test_unwritable_dir_degrades_to_uncached(self, tmp_path, monkeypatch):
+        from karpenter_tpu.solver.tpu import configure_compile_cache
+
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("")
+        monkeypatch.setenv("KARPENTER_SOLVER_COMPILE_CACHE", str(blocker / "cache"))
+        assert configure_compile_cache() is None
+
+
+class TestShardRouterEndToEnd:
+    """The full multi-process path: spawn, replay, aggregate, kill,
+    re-home. Worker processes run the ffd backend (jax-free), so the test
+    exercises every router mechanism without paying XLA compiles; the
+    tpu-backend warm-cache gates live in bench fleet_sharded."""
+
+    def test_router_replay_aggregation_and_rehoming(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_DOUBLEBUF", "0")
+        log = str(tmp_path / "churn.jsonl")
+        rec = ChurnHarness(
+            small_spec(
+                n_base_pods=60, n_types=6, arrivals=12, cancels=8, departures=12,
+                iterations=2, warmup_cycles=1, record_path=log,
+            )
+        )
+        rec.run()
+        rec.close()
+
+        router = ShardRouter(
+            n_shards=2, solver="ffd", breaker_failures=1, breaker_backoff_seconds=0.05
+        )
+        try:
+            assert router.spawn() == ["shard-0", "shard-1"]
+            tenants = ["t0", "t1", "t2"]
+            for tid in tenants:
+                sid = router.add_tenant(tid, log_path=log)
+                assert sid == router.assign(tid)
+            assert router.ready()
+
+            results = router.run_all()
+            assert all(r.get("ok") for r in results.values()), results
+            digests = {t: router._tenants[t]["digest"] for t in tenants}
+            assert all(digests.values())
+            # the same log replays to BIT-IDENTICAL placements in every
+            # worker process — the cross-process determinism pin
+            assert len(set(digests.values())) == 1, digests
+
+            # aggregation: shard-stamped tenant rows, the merged exposition,
+            # and the ?tenant=-proxied solve dump
+            rows = router.debug_tenants()
+            owners = router.tenants()
+            for tid in tenants:
+                assert rows[tid]["shard"] == owners[tid]
+            merged = router.merged_metrics()
+            assert 'shard="' in merged
+            assert "karpenter_solver_fleet_shards 2" in merged
+            assert merged.count("# TYPE karpenter_solver_fleet_shards") == 1
+            solves = json.loads(router.debug_solves(tenants[0]))
+            assert isinstance(solves, dict)
+            shards_dump = router.debug_shards()
+            assert set(shards_dump) == {"shard-0", "shard-1"}
+            assert all(row["alive"] for row in shards_dump.values())
+
+            # shard death: the breaker quarantines, readiness drops, and the
+            # orphans re-home onto the survivor with matching digests
+            victim = owners[tenants[0]]
+            survivor = "shard-1" if victim == "shard-0" else "shard-0"
+            router._handle(victim).kill()
+            states = router.check_shards()
+            assert states[victim] == "quarantined"
+            assert not router.ready()
+            rehomed = router.rehome_tenants(victim)
+            orphans = [t for t, s in owners.items() if s == victim]
+            assert sorted(rehomed) == sorted(orphans)
+            for tid, row in rehomed.items():
+                assert row["shard"] == survivor
+                assert row["matches"], (tid, row, digests[tid])
+            assert router.tenants()[orphans[0]] == survivor
+
+            # second death, this time RESPAWNED in place: a fresh process
+            # under the same shard id replays the orphans back to the same
+            # placements, and the restart is counted
+            router._handle(survivor).kill()
+            router.check_shards()
+            rehomed2 = router.rehome_tenants(survivor, respawn=True)
+            assert sorted(rehomed2) == sorted(tenants)
+            assert all(row["matches"] for row in rehomed2.values())
+            assert all(row["shard"] == survivor for row in rehomed2.values())
+            from karpenter_tpu import metrics as m
+
+            restarts = sum(v for _labels, v in router.registry.counter(m.SOLVER_SHARD_RESTARTS_TOTAL).collect())
+            assert restarts >= 1
+            # a probe pass after the respawn re-admits the shard
+            assert router.check_shards()[survivor] in ("probing", "healthy")
+        finally:
+            router.close()
