@@ -67,8 +67,9 @@ class Config:
     bounded_labels: tuple[str, ...] = ("reason", "backend", "mode", "decision", "kind", "phase", "fn", "quantile", "proposer", "event", "lock", "tenant", "cause", "stage", "state", "seam", "shard")
     # callees whose return value is enum-bounded by construction
     # (tenant_label caps distinct outputs at serving.fleet.TENANT_LABEL_CAP;
-    # shard_label at serving.shard.SHARD_LABEL_CAP)
-    bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family", "tenant_label", "shard_label")
+    # shard_label at serving.shard.SHARD_LABEL_CAP; demotion_label collapses
+    # anything outside scheduler_model_grouped.DEMOTION_REASONS to "other")
+    bounded_label_producers: tuple[str, ...] = ("reason_family", "_reason_family", "tenant_label", "shard_label", "demotion_label")
     # wrapper methods whose OWN bodies forward **labels to the registry
     metric_wrappers: tuple[str, ...] = ("_count", "_observe")
     # cap on distinct literal values per bounded label key, repo-wide

@@ -440,19 +440,21 @@ class MetricLabelCardinalityRule(Rule):
     description = "bounded metric labels must carry statically enumerable values"
     _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
 
-    # the seeded violation is a shardfleet one: the router's re-homed-tenants
-    # counter's `shard` label fed a raw shard id straight from a runtime row
-    # instead of the bounded serving.shard.shard_label producer (capped at
-    # SHARD_LABEL_CAP distinct outputs, past-the-cap ids collapse to
-    # "overflow") — exactly the cardinality leak a fleet that respawns
-    # shards under churn must never regress into
+    # the seeded violation is an lrapack one: the pack's item-demotions
+    # counter's `reason` label fed a raw dict key straight from build_items'
+    # info payload instead of the bounded
+    # scheduler_model_grouped.demotion_label producer (anything outside
+    # DEMOTION_REASONS collapses to "other") — exactly the cardinality leak
+    # a future demotion reason added without the enum would regress into
     SELF_TEST_BAD = (
-        "def publish(registry, row):\n"
-        '    registry.counter("karpenter_solver_shard_rehomed_tenants_total").inc(1, shard=row["shard"])\n'
+        "def publish(registry, info):\n"
+        "    for why, pods in info['demotions'].items():\n"
+        '        registry.counter("karpenter_solver_pack_item_demotions_total").inc(pods, reason=why)\n'
     )
     SELF_TEST_OK = (
-        "def publish(registry, row):\n"
-        '    registry.counter("karpenter_solver_shard_rehomed_tenants_total").inc(1, shard=shard_label(row["shard"]))\n'
+        "def publish(registry, info):\n"
+        "    for why, pods in info['demotions'].items():\n"
+        '        registry.counter("karpenter_solver_pack_item_demotions_total").inc(pods, reason=demotion_label(why))\n'
     )
 
     def __init__(self):

@@ -71,6 +71,14 @@ SOLVER_DECODE_REPAIR_TOTAL = "karpenter_solver_decode_repair_total"
 # pvc, cap, reorder, fallback-global, irreversible, slot-exhausted,
 # validate, no-carry}) — the churn harness's per-reason full-solve breakdown
 SOLVER_DELTA_REJECT_TOTAL = "karpenter_solver_delta_reject_total"
+# why a multi-group pod shape stayed a count=1 item instead of merging
+# (signature compression switched off for it); reason is the bounded
+# scheduler_model_grouped.DEMOTION_REASONS enum ({multi-key,
+# aff-pin-conflict, hatch-off}) — the LRA regime's compression attribution
+SOLVER_PACK_ITEM_DEMOTIONS_TOTAL = "karpenter_solver_pack_item_demotions_total"
+# pods-per-item compression of the newest full pack (n_pods / n_items):
+# ~1.0 means the grouped scan degenerated to per-pod steps
+SOLVER_PACK_ITEM_COMPRESSION = "karpenter_solver_pack_item_compression"
 SOLVER_ENCODE_SECONDS = "karpenter_solver_encode_seconds"
 SOLVER_FFD_MEMO_TOTAL = "karpenter_solver_ffd_memo_total"
 SOLVER_FFD_PHASE_SECONDS = "karpenter_solver_ffd_phase_seconds"
@@ -216,6 +224,19 @@ def make_registry() -> Registry:
         SOLVER_DELTA_REJECT_TOTAL,
         "Delta-capable solves routed to the full path, by reject reason",
         ("reason",),
+    )
+    r.counter(
+        SOLVER_PACK_ITEM_DEMOTIONS_TOTAL,
+        "Pods whose multi-group shape stayed a count=1 pack item instead of "
+        "merging, by bounded demotion reason (multi-key | aff-pin-conflict | "
+        "hatch-off)",
+        ("reason",),
+    )
+    r.gauge(
+        SOLVER_PACK_ITEM_COMPRESSION,
+        "Pods-per-item compression of the newest full grouped pack "
+        "(n_pods / n_items; ~1.0 = the scan degenerated to per-pod steps)",
+        (),
     )
     # backend label values for SOLVER_SOLVE_TOTAL include "hybrid-delta":
     # a warm hybrid re-solve that re-packed only the pod delta against the

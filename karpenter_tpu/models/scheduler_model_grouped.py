@@ -18,14 +18,27 @@ pods in ONE step with closed-form vector math:
   closed form of "repeatedly add to the min-count feasible zone"
   (topology.go nextDomainTopologySpread), then per-zone prefix-sum fills.
 
-Pods whose membership spans multiple zone-spread groups batch with count=1,
-where water-fill degenerates to the per-pod min-count choice. Equivalence to
-the host FFD is by the simulation contract (SURVEY.md §7: all-pods-scheduled
-parity, cost <=, constraints valid), not bit-identical placement.
+Pods whose membership spans multiple keyed-domain groups keep their count>1
+merge: `zone_path` runs a JOINT water-fill (`_waterfill_multi`) whose
+per-domain placement cap is the elementwise min over every member group's
+skew headroom, and one scan step updates counts_dom rows for ALL member
+groups at once. Only memberships that genuinely force per-replica decisions
+demote to count=1 items, with a bounded reason from DEMOTION_REASONS:
+"multi-key" (member groups span more than one domain key — the kernel
+commits one k* per step) and "aff-pin-conflict" (two required-affinity
+groups may pin conflicting single domains). `KARPENTER_SOLVER_MULTIGROUP=0`
+is the seed-faithful escape hatch: it demotes EVERY multi-group pod
+("hatch-off"), restoring the original per-pod keys where water-fill
+degenerates to the per-pod min-count choice. Merged or demoted, equivalence
+to the host FFD is by the simulation contract (SURVEY.md §7:
+all-pods-scheduled parity, cost <=, constraints valid), not bit-identical
+placement; the merged multi-group fill itself reproduces the per-pod
+(count=1) kernel's placements exactly up to fresh-slot index order.
 """
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 
@@ -106,25 +119,77 @@ jax.tree_util.register_dataclass(
 )
 
 
-def build_items(enc):
+# Why a multi-group pod shape stayed a count=1 item — the bounded value set
+# of the `karpenter_solver_pack_item_demotions_total{reason}` counter and the
+# SolveTrace's `item_demotions` attribution. Producers (`sig_demotions` — the
+# single demotion oracle shared by build_items and the solver's delta item
+# builder) must only emit these literals.
+DEMOTION_REASONS = (
+    "multi-key",  # member dom groups span >1 domain key; the kernel commits one k* per step
+    "aff-pin-conflict",  # >=2 required dom-affinity groups may pin conflicting single domains
+    "hatch-off",  # KARPENTER_SOLVER_MULTIGROUP=0: seed-faithful per-pod keys for every multi-group shape
+)
+
+
+def demotion_label(reason) -> str:
+    """Collapse a demotion reason to the bounded DEMOTION_REASONS vocabulary
+    ("other" for anything unrecognized) — the metric-label guard pattern of
+    reason_family/tenant_label/shard_label."""
+    return reason if reason in DEMOTION_REASONS else "other"
+
+
+def multigroup_enabled() -> bool:
+    """The `KARPENTER_SOLVER_MULTIGROUP` escape hatch (default on): off
+    restores the seed's per-pod keys for every multi-group pod shape."""
+    return os.environ.get("KARPENTER_SOLVER_MULTIGROUP", "1") not in ("0", "false", "no")
+
+
+def sig_demotions(enc):
+    """Per-signature demotion oracle: (demote [S] bool, reason_code [S] i32
+    index into DEMOTION_REASONS, valid only where demote). Shared by
+    build_items and the solver's delta item builder so the full and delta
+    paths split the SAME shapes per-pod. Pure vectorized index work — no
+    per-pod Python loops."""
+    S = enc.n_sigs
+    G = enc.sig_member.shape[1] if enc.sig_member.size else 0
+    if not S or not G:
+        return np.zeros(max(S, 1), bool), np.zeros(max(S, 1), np.int32)
+    sig_member = enc.sig_member
+    kinds = np.asarray(enc.group_kind)
+    zone_groups = (kinds == KIND_DOM_SPREAD) | (kinds == KIND_DOM_ANTI) | (kinds == KIND_DOM_AFF)
+    zone_member = sig_member & zone_groups[None, :]  # [S, G]
+    multi_zone = zone_member.sum(axis=1) > 1
+    dom_key = np.asarray(enc.group_dom_key)
+    keys_lo = np.where(zone_member, dom_key[None, :], 2**30).min(axis=1)
+    keys_hi = np.where(zone_member, dom_key[None, :], -1).max(axis=1)
+    multi_key = multi_zone & (keys_lo != keys_hi)
+    aff_conflict = (sig_member & (kinds == KIND_DOM_AFF)[None, :]).sum(axis=1) > 1
+    if multigroup_enabled():
+        demote = multi_zone & (multi_key | aff_conflict)
+        reason = np.where(multi_key, 0, 1).astype(np.int32)
+    else:
+        demote = multi_zone
+        reason = np.where(multi_key, 0, np.where(aff_conflict, 1, 2)).astype(np.int32)
+    return demote, reason
+
+
+def build_items(enc, with_info: bool = False):
     """Group pods into work items from the encoder's signature ids (encode
     already deduplicated pod shapes — this is pure integer index work, no
     tensor hashing). Returns (ItemTensors arrays as numpy,
-    pod_indices_per_item as arrays). Pods in >1 keyed-domain group stay
-    count=1 (water-fill is single-level for them)."""
+    pod_indices_per_item as arrays); with_info=True appends a stats dict
+    (n_pods / n_items / per-reason demotion pod counts) for trace and metric
+    attribution. Pods in >1 keyed-domain group MERGE like any other replica
+    set (zone_path's joint multi-group water-fill handles them) unless
+    `sig_demotions` demotes their shape to per-pod count=1 items."""
     P = enc.n_pods
     S = enc.n_sigs
     G = enc.sig_member.shape[1] if enc.sig_member.size else 0
     sig_member = enc.sig_member if G else np.zeros((max(S, 1), 1), bool)
-    zone_groups = (
-        ((enc.group_kind == KIND_DOM_SPREAD) | (enc.group_kind == KIND_DOM_ANTI) | (enc.group_kind == KIND_DOM_AFF))
-        if G
-        else np.zeros(1, bool)
-    )
-    multi_zone_sig = (sig_member & zone_groups[None, :]).sum(axis=1) > 1  # [S]
+    demote_sig, reason_sig = sig_demotions(enc)
     sig = np.asarray(enc.sig_of_pod, dtype=np.int64)
-    # multi-zone pods get a distinct per-pod key so they never merge
-    key = np.where(multi_zone_sig[sig] if S else False, S + np.arange(P, dtype=np.int64), sig)
+    # demoted shapes get a distinct per-pod key so they never merge
+    key = np.where(demote_sig[sig] if S else False, S + np.arange(P, dtype=np.int64), sig)
     _, first_idx, inverse, counts = np.unique(key, return_index=True, return_inverse=True, return_counts=True)
     # keep first-appearance order so FFD's big-pods-first queue order survives
     order = np.argsort(first_idx, kind="stable")
@@ -152,7 +217,17 @@ def build_items(enc):
     )
     arrays = pad_item_arrays(arrays, ITEM_AXIS_BUCKET, item_axis="items")
     item_pods += [np.zeros(0, np.int64)] * (len(arrays["item_count"]) - len(item_pods))
-    return arrays, item_pods
+    if not with_info:
+        return arrays, item_pods
+    demoted_pods = demote_sig[sig] if S else np.zeros(0, bool)
+    by_reason = np.bincount(reason_sig[sig[demoted_pods]], minlength=len(DEMOTION_REASONS)) if P else np.zeros(len(DEMOTION_REASONS), np.int64)
+    info = dict(
+        n_pods=int(P),
+        n_items=int(len(reps)),
+        demotions={DEMOTION_REASONS[r]: int(by_reason[r]) for r in range(len(DEMOTION_REASONS)) if by_reason[r]},
+        multigroup=multigroup_enabled(),
+    )
+    return arrays, item_pods, info
 
 
 ITEM_AXIS_BUCKET = 64  # full-solve item axis bucket (DELTA_ITEM_BUCKET for deltas)
@@ -266,6 +341,110 @@ def _waterfill(v, finite, c, cap):
     pos = jnp.cumsum(is_min.astype(jnp.int32)) - 1
     inc = inc + jnp.where(is_min & (pos < rem), 1, 0)
     return jnp.where(finite, inc, 0)
+
+
+def _waterfill_multi(counts_g, member, skew_g, reg_g, min_domains_g, za, avail, c):
+    """Joint multi-group integer water-fill: distribute `c` pods that are
+    members of SEVERAL keyed spread groups at once, reproducing exactly what
+    c sequential per-pod placements do — each pod goes to the current argmin
+    (ties to lowest index) of the SUMMED member-group level among domains
+    where EVERY member group's skew check passes (spread_ok_of, recomputed as
+    counts evolve) — but in O(events) chunked laps instead of O(pods) steps.
+
+    A full lap pours d pods into every current-min domain; d is bounded by
+    (a) the summed level catching the next distinct active level, (b) the
+    tightest member group's exact headroom credit skew_g + u_g - count_g
+    (u_g = the group's lowest count OUTSIDE the poured set — the poured
+    floor rises in lockstep below it, so pours are free until the credit
+    runs out), (c) the earliest lap at which a currently skew-capped domain
+    becomes feasible again (its blocking groups' floors rise as laps pour),
+    and (d) the remaining quota. When a capped domain could re-enter BELOW
+    the current level mid-lap, or fewer pods than min-domains remain, the
+    round degrades to one sequential pod (lowest-index min) — exactness
+    over lap atomicity. Every round pours >= 1 pod or stops, so the
+    lax.while_loop terminates; typical fleets see O(groups + domains)
+    rounds. Availability is frozen at step entry (same fidelity class as
+    the single-group arm); zone_path's per-group redistribution pass
+    catches slot-dry drift."""
+    D = counts_g.shape[1]
+    sel = member[:, None]  # [G, 1]
+    regm = reg_g & za[None, :]  # [G, D] registered & allowed
+    m = jnp.maximum(jnp.sum(member.astype(jnp.int32)), 1)  # summed level rises m per pod
+    supported = jnp.sum(regm.astype(jnp.int32), axis=1)
+    force_zero = (min_domains_g > 0) & (supported < min_domains_g)  # [G] minDomains pins zmin at 0
+    idx = jnp.arange(D, dtype=jnp.int32)
+
+    def body(carry):
+        inc, rem, _ = carry
+        cg = counts_g + jnp.where(sel, inc[None, :], 0)  # [G, D]
+        # per-group spread_ok, identical formula to spread_ok_of but over the
+        # EVOLVING counts: zmin over registered+allowed (frozen/unavailable
+        # domains included — their static counts pin the floor exactly as the
+        # per-pod check sees them)
+        zc = jnp.where(regm, cg, INF_I)
+        zmin = jnp.min(zc, axis=1)
+        zmin = jnp.where(zmin >= INF_I, 0, zmin)
+        zmin = jnp.where(force_zero, 0, zmin)
+        ok_g = ((cg + 1 - zmin[:, None]) <= skew_g[:, None]) & reg_g  # [G, D]
+        ok = jnp.all(jnp.where(sel, ok_g, True), axis=0)  # [D]
+        lvl = jnp.sum(jnp.where(sel, cg, 0), axis=0)  # [D] summed level
+        active = avail & ok
+        cur = jnp.where(active, lvl, INF_I)
+        mlvl = jnp.min(cur)
+        is_min = active & (cur == mlvl)
+        kmin = jnp.sum(is_min.astype(jnp.int32))
+        # (a) laps until the poured set's level reaches the next active level
+        nxt = jnp.min(jnp.where(active & (cur > mlvl), cur, INF_I))
+        d_gap = jnp.where(nxt < INF_I, -(-(nxt - mlvl) // m), INF_I)
+        # (b) exact per-group headroom credit over the poured set: p_g = the
+        # group's floor INSIDE the poured set, u_g = its floor outside it
+        # (INF = unbounded: every registered domain is being poured, so the
+        # floor rises in lockstep and the skew gap never closes)
+        p_g = jnp.min(jnp.where(regm & is_min[None, :], cg, INF_I), axis=1)  # [G]
+        u_g = jnp.min(jnp.where(regm & ~is_min[None, :], cg, INF_I), axis=1)  # [G]
+        u_g = jnp.where(force_zero, 0, u_g)
+        dcap_gz = jnp.where((u_g < INF_I)[:, None], skew_g[:, None] + u_g[:, None] - cg, INF_I)  # [G, D]
+        d_head = jnp.min(jnp.where(sel & is_min[None, :], dcap_gz, INF_I))
+        # (c) re-feasibility: a capped domain z rejoins once every blocking
+        # member group's floor min(p_g + laps, u_g) reaches cg[g, z]+1-skew_g
+        thr = cg + 1 - skew_g[:, None]  # [G, D] floor each blocker needs
+        k_g = jnp.where(
+            (u_g[:, None] >= thr) & (p_g < INF_I)[:, None] & ~force_zero[:, None],
+            jnp.maximum(thr - p_g[:, None], 1),
+            INF_I,
+        )  # [G, D] laps until group g unblocks z (INF = never via pours)
+        blocking = sel & ~ok_g & reg_g
+        react = jnp.max(jnp.where(blocking, k_g, 0), axis=0)  # [D]
+        react = jnp.where(jnp.any(blocking & (k_g >= INF_I), axis=0), INF_I, react)
+        # only domains every member group registers can ever pass the joint gate
+        reg_all = jnp.all(jnp.where(sel, reg_g, True), axis=0)
+        rejoinable = avail & ~ok & reg_all
+        # a group's floor can cross the release threshold MID-lap `react`
+        # (its poured min-count domains may all come early in index order), so
+        # a domain whose level sits below that lap's pour level would capture
+        # pods mid-lap: shave the chunk to react-1 laps there and let the
+        # next round (where react recomputes to <= 1) take the sequential
+        # single-pod path. Arithmetic is clipped so the INF sentinel never
+        # overflows int32.
+        react_c = jnp.minimum(react, 2**20)
+        mid_capture = lvl < jnp.minimum(mlvl, 2**20) + (react_c - 1) * m
+        safe_lap = jnp.where(react >= INF_I, INF_I, jnp.where(mid_capture, react - 1, react))
+        d_react = jnp.min(jnp.where(rejoinable, safe_lap, INF_I))
+        unsafe = d_react < 1
+        partial = (rem < kmin) | unsafe
+        d = jnp.minimum(jnp.minimum(d_gap, d_head), jnp.minimum(d_react, rem // jnp.maximum(kmin, 1)))
+        d = jnp.maximum(d, 1)
+        first = jnp.argmin(jnp.where(is_min, idx, INF_I)).astype(jnp.int32)
+        pour = jnp.where(partial, jnp.where(is_min & (idx == first), 1, 0), jnp.where(is_min, d, 0))
+        pour = jnp.where(kmin > 0, pour, 0)
+        return inc + pour, rem - jnp.sum(pour), kmin == 0
+
+    def cond(carry):
+        _, rem, stop = carry
+        return (~stop) & (rem > 0)
+
+    inc, _, _ = jax.lax.while_loop(cond, body, (jnp.zeros((D,), jnp.int32), c, False))
+    return inc
 
 
 def _pack_body(
@@ -581,13 +760,13 @@ def _pack_body(
             # global minimum: no available domain may rise above
             # frozen_min + skew (per-pod check, scheduler_model.py).
             available = allowed_real & (openable_z | slotcap_z)
-            # items in MULTIPLE keyed-domain groups are count=1 by
-            # construction (build_items splits them): level-raising doesn't
-            # apply to a single pod, and the summed-across-groups vsum can't
-            # express per-group skew — gate such items on the exact per-group
-            # step-entry check (spread_ok) and give flat unit capacity
-            strict = jnp.sum(zone_member_mask) > 1
-            finite = available & jnp.where(strict, spread_ok, True)
+            # items in MULTIPLE keyed-domain groups run the JOINT water-fill:
+            # the summed-across-groups vsum can't express per-group skew, so
+            # _waterfill_multi recomputes every member group's spread_ok as
+            # its counts evolve — the per-domain cap is the elementwise min
+            # over member headrooms, exactly the sequential per-pod check
+            multi = jnp.sum(zone_member_mask) > 1
+            finite = available & jnp.where(multi, spread_ok, True)
             frozen = allowed_real & ~available
             frozen_min = jnp.min(jnp.where(frozen, vsum, INF_I))
             # minDomains force-zero: fewer pod-supported registered domains
@@ -597,8 +776,31 @@ def _pack_body(
             force_zero = (md_star > 0) & (supported < md_star)
             frozen_min = jnp.where(force_zero, 0, frozen_min)
             cap = jnp.clip(frozen_min + skew_star - vsum, 0, INF_I)
-            cap = jnp.where(strict, jnp.where(finite, 1, 0), cap)
-            inc = _waterfill(vsum, finite, c, cap)
+            inc = jax.lax.cond(
+                multi,
+                lambda _: _waterfill_multi(
+                    counts_zone, zone_member_mask, t.group_skew, t.group_registered,
+                    t.group_min_domains, za, available, c,
+                ),
+                lambda _: _waterfill(vsum, finite, c, cap),
+                None,
+            )
+            # joint per-domain headroom for the redistribution pass: the
+            # elementwise min over member groups of skew_g + zmin_g - count_g
+            # at the given poured state (single-group items use the summed
+            # skew_star formula below — bit-identical to the seed)
+            reg_all_members = jnp.all(jnp.where(zone_member_mask[:, None], t.group_registered, True), axis=0)
+
+            def multi_headroom(placed):
+                cg_u = counts_zone + jnp.where(zone_member_mask[:, None], placed[None, :], 0)
+                zc_u = jnp.where(za[None, :] & t.group_registered, cg_u, INF_I)
+                zmin_g = jnp.min(zc_u, axis=1)
+                zmin_g = jnp.where(zmin_g >= INF_I, 0, zmin_g)
+                sup_g = jnp.sum((za[None, :] & t.group_registered).astype(jnp.int32), axis=1)
+                zmin_g = jnp.where((t.group_min_domains > 0) & (sup_g < t.group_min_domains), 0, zmin_g)
+                head_g = zmin_g[:, None] + t.group_skew[:, None] - cg_u  # [G, D]
+                head = jnp.min(jnp.where(zone_member_mask[:, None], head_g, INF_I), axis=0)
+                return jnp.clip(jnp.where(reg_all_members & available, head, 0), 0, INF_I)
             take_all = jnp.zeros((N_loc,), jnp.int32)
             pending = c - jnp.sum(inc)  # skew/availability-capped remainder
             placed_z = jnp.zeros((D,), jnp.int32)
@@ -623,7 +825,8 @@ def _pack_body(
                 zmin_u = jnp.where(zmin_u >= INF_I, 0, zmin_u)
                 zmin_u = jnp.where(force_zero, 0, zmin_u)
                 headroom = jnp.clip(zmin_u + skew_star - vsum_u[z], 0, INF_I)
-                cz = jnp.minimum(pending, jnp.where(finite[z], headroom, 0))
+                headroom = jnp.where(multi, multi_headroom(placed_z)[z], jnp.where(finite[z], headroom, 0))
+                cz = jnp.minimum(pending, headroom)
                 narrow_z = jnp.where(kmask, jnp.arange(D) == z, za)
                 elig = slot_compat_of(slot_basis) & slot_zoneset[:, z] & other_ok_of(slot_zoneset)
                 take, left, slot_rem, slot_zoneset, slot_basis, slot_rank, counts_host, open_count, ports = place(

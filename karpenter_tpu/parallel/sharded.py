@@ -161,7 +161,15 @@ def _state_specs(axis: str):
     """PartitionSpecs for the pack scan's carry, in _pack_body state order:
     (slot_basis, slot_rem, slot_zoneset, slot_rank, counts_zone, counts_host,
     open_count, (port_any, port_wild, port_spec)) — slot-axis leaves shard,
-    group/domain counts and the open counter are device-invariant."""
+    group/domain counts and the open counter are device-invariant.
+
+    counts_zone replicated (P()) is also what makes the multi-group joint
+    water-fill (_waterfill_multi) shard-transparent: the fill is pure
+    [G, D] math over the replicated group counts, its while_loop predicate
+    derives from replicated operands (the availability inputs are psum'd
+    before the fill), so every device runs the identical loop in lockstep —
+    the multi-group merge adds ZERO new exchange to the bounded per-place()
+    collective step documented in the module docstring."""
     s = P(axis)
     return (s, s, s, s, P(), P(None, axis), P(), (s, s, s))
 

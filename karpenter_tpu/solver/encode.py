@@ -1058,7 +1058,20 @@ def _pod_window_reasons(snap, pod, respect: bool, resolve_comp) -> list[str]:
     """The in-window gate for ONE pod shape: returns its fallback reasons
     (empty = in-window). Checks short-circuit at the pod level — the first
     offending constraint family describes the pod — but the caller scans
-    every representative, so the snapshot-wide picture is complete."""
+    every representative, so the snapshot-wide picture is complete.
+
+    Layering vs the grouped kernel's multi-group merge: this window is the
+    OUTER gate (multi-key topology / affinity-combined shapes route to the
+    host FFD path before the kernel ever sees them), while
+    `scheduler_model_grouped.sig_demotions` is the INNER safety net — it
+    demotes the same families to per-pod count=1 items so `build_items`
+    stays correct on any encode handed to it directly (the encode below
+    still fully lowers an out-of-window shape; fallback_reasons only steer
+    the solver). In-window multi-group shapes — several spreads/anti/aff
+    groups over ONE domain key (hostname is exempt from `used_keys`) —
+    merge count>1 and take the joint water-fill; with the
+    `KARPENTER_SOLVER_MULTIGROUP=0` hatch they demote with reason
+    `hatch-off`, the only demotion reachable in-window."""
     aff = pod.spec.affinity
     if aff is not None:
         if aff.pod_affinity_preferred:

@@ -334,6 +334,26 @@ class TPUSolver:
         out["t"] = t
         return out
 
+    def _note_item_info(self, info: dict) -> None:
+        """Item-compression attribution for the grouped pack: how well
+        signature merging held up (pods per item) and which pods stayed
+        count=1, by bounded demotion reason — the LRA regime's observable
+        surface (build_items with_info)."""
+        from ..metrics import SOLVER_PACK_ITEM_COMPRESSION, SOLVER_PACK_ITEM_DEMOTIONS_TOTAL
+        from ..models.scheduler_model_grouped import demotion_label
+
+        self._trace.note(
+            pack_items=info["n_items"],
+            pack_pods=info["n_pods"],
+            item_demotions=dict(info["demotions"]),
+        )
+        if self.registry is None:
+            return
+        for reason, pods in info["demotions"].items():
+            self.registry.counter(SOLVER_PACK_ITEM_DEMOTIONS_TOTAL).inc(pods, reason=demotion_label(reason))
+        if info["n_items"]:
+            self.registry.gauge(SOLVER_PACK_ITEM_COMPRESSION).set(info["n_pods"] / max(info["n_items"], 1))
+
     def _count(self, metric: str, **labels) -> None:
         if self.registry is not None:
             if self.tenant and metric in _TENANT_LABELED:
@@ -620,7 +640,8 @@ class TPUSolver:
         # not pods (scheduler_model_grouped.py). Slot axis capped; retry
         # uncapped on the rare overflow (every slot opened AND pods unplaced).
         with self._trace.span("pack", mode="full"):
-            item_arrays, item_pods = build_items(enc)
+            item_arrays, item_pods, item_info = build_items(enc, with_info=True)
+            self._note_item_info(item_info)
             items = make_item_tensors(item_arrays)
             cap = enc.n_existing + min(enc.n_pods, 4096)
             t = make_tensors(enc, n_slots=cap, with_pods=False)
@@ -1032,7 +1053,19 @@ class TPUSolver:
         n_prev = int(prev_assignment.shape[0])  # == enc.n_pods - n_added
         out = dict(state=state)
         if n_added:
-            sigs_u, inv = np.unique(added_sigs, return_inverse=True)
+            # the SAME demotion split as build_items (shared sig_demotions
+            # oracle): a demoted multi-group shape packs per-pod on the delta
+            # path too — without this, a delta add of a demoted shape would
+            # merge into one count>1 item and place differently than the
+            # full solve it must be equivalent to
+            from ..models.scheduler_model_grouped import sig_demotions
+
+            S_enc = int(enc.n_sigs)
+            demote_sig, _dreason = sig_demotions(enc)
+            asig = np.asarray(added_sigs, dtype=np.int64)
+            akey = np.where(demote_sig[asig], S_enc + np.arange(n_added, dtype=np.int64), asig)
+            keys_u, inv = np.unique(akey, return_inverse=True)
+            sigs_u = np.where(keys_u < S_enc, keys_u, asig[np.clip(keys_u - S_enc, 0, n_added - 1)])
             W_real = int(sigs_u.shape[0])
             arrays = pad_item_arrays(
                 dict(
@@ -1086,6 +1119,7 @@ class TPUSolver:
         self._trace.note(
             delta_added=n_added,
             delta_removed=int(removed.size) if removed is not None else 0,
+            delta_demoted=int(demote_sig[asig].sum()) if n_added else 0,
             row_refresh=bool(row_diff is not None),
         )
         return self._finish(snap, enc, assignment, slot_basis, slot_zoneset, t, out, validated=True, count=count)

@@ -27,3 +27,20 @@ def ok_signature_scale(rep_pods):
     for pod in rep_pods:
         out.append(pod)
     return out
+
+
+def bad_multigroup_items(enc, demote):
+    # seeded multi-group item-builder violation: deciding each pod's merge
+    # key with a Python loop over the pod axis — the O(pods) host work the
+    # vectorized sig_demotions/np.unique path exists to avoid
+    keys = []
+    for i, p in enumerate(enc.pods):
+        keys.append(enc.n_sigs + i if demote[p.sig] else p.sig)
+    return keys
+
+
+def ok_multigroup_items(np, enc, demote, sig):
+    # the sanctioned form: pure np.unique/segment work, items scale with
+    # unique shapes — never with pods
+    key = np.where(demote[sig], enc.n_sigs + np.arange(sig.shape[0]), sig)
+    return np.unique(key, return_index=True, return_inverse=True, return_counts=True)

@@ -1,0 +1,457 @@
+"""Multi-group water-fill (lrapack): pods in MULTIPLE keyed-domain groups
+keep their count>1 merge via `_waterfill_multi`'s joint fill.
+
+Five contract families from the lrapack PR:
+  1. randomized dense-graph parity — merged multi-group items vs the per-pod
+     (count=1) reference expansion, spread+anti+required-affinity mixed with
+     host ports and taints, compared canonically (placed set, per-slot
+     composition multiset, final counts_zone state);
+  2. demotion-reason attribution — every DEMOTION_REASONS value reachable
+     and counted in build_items' with_info stats;
+  3. delta-path chaining over a GROWN multi-group item (replicas of an
+     already-merged shape arriving on the warm path);
+  4. escape-hatch bit-parity — KARPENTER_SOLVER_MULTIGROUP=0 reproduces the
+     seed's per-pod keys exactly (inline reference reimplementation);
+  5. zero-recompile sentinel pin — identical resubmit and under-high-water
+     shrink of a multi-group fleet must not retrace any watched kernel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from helpers import hostname_anti_affinity, make_nodepool, make_pod
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.scheduling.taints import Taint
+from karpenter_tpu.solver.encode import encode
+from karpenter_tpu.solver.tpu import TPUSolver
+from test_domain_topology import LINUX_AMD64, anti, make_snapshot, spread
+
+ZONE = wk.ZONE_LABEL_KEY
+CT = wk.CAPACITY_TYPE_LABEL_KEY
+
+
+def _sel(**kv):
+    return {"matchLabels": kv}
+
+
+def _merged_set(g, n, tier, cpu="500m", ports=False):
+    """n replicas that are members of TWO zone-key spread groups (own app
+    selector + shared tier selector): the merged multi-group shape."""
+    labels = {"app": f"g{g}", "tier": tier}
+    tsc = [spread(ZONE, 1, _sel(app=f"g{g}")), spread(ZONE, 2, _sel(tier=tier))]
+    pods = [make_pod(cpu=cpu, name=f"g{g}-{i}", labels=labels, tsc=tsc) for i in range(n)]
+    if ports:
+        for p in pods:
+            p.spec.containers[0].ports = [{"containerPort": 8080, "hostPort": 8080, "protocol": "TCP"}]
+    return pods
+
+
+def _perpod_items(enc):
+    """The reference expansion: EVERY pod its own count=1 item, in MERGED
+    ITEM ORDER (all replicas of a shape consecutively, at the shape's first
+    queue position). Grouping collapses an item's replicas to its first
+    pod's scan position — the seed's count>1 merge already reorders
+    interleaved queues this way — so the per-pod reference must process the
+    same pod sequence for placement parity to be well-defined. Distinct
+    item_axis so the reference arm never pollutes the production 'items'
+    high-water mark."""
+    from karpenter_tpu.models.scheduler_model import sig_restrict_of
+    from karpenter_tpu.models.scheduler_model_grouped import (
+        ITEM_AXIS_BUCKET,
+        build_items,
+        pad_item_arrays,
+    )
+
+    _, merged_pods = build_items(enc)
+    order = np.concatenate([p for p in merged_pods if p.size]).astype(np.int64)
+    P = enc.n_pods
+    S = enc.n_sigs
+    G = enc.sig_member.shape[1] if enc.sig_member.size else 0
+    sig_member = enc.sig_member if G else np.zeros((max(S, 1), 1), bool)
+    sig_owner = enc.sig_owner if G else np.zeros((max(S, 1), 1), bool)
+    sig = np.asarray(enc.sig_of_pod, dtype=np.int64)[order]
+    arrays = dict(
+        item_req=enc.sig_req[sig],
+        item_mask=enc.sig_mask[sig],
+        item_taint_ok=enc.sig_taint_ok[sig],
+        item_dom_allowed=enc.sig_dom_allowed[sig],
+        item_restrict=sig_restrict_of(enc)[sig],
+        item_member=sig_member[sig],
+        item_owner=sig_owner[sig],
+        item_count=np.ones(P, np.int32),
+        item_port_any=enc.sig_port_any[sig],
+        item_port_wild=enc.sig_port_wild[sig],
+        item_port_spec=enc.sig_port_spec[sig],
+        item_host_blocked=enc.sig_host_blocked[sig],
+    )
+    arrays = pad_item_arrays(arrays, ITEM_AXIS_BUCKET, item_axis="ref_items")
+    item_pods = [np.array([i], np.int64) for i in order]
+    item_pods += [np.zeros(0, np.int64)] * (len(arrays["item_count"]) - P)
+    return arrays, item_pods
+
+
+def _pack(enc, arrays, item_pods):
+    from karpenter_tpu.models.scheduler_model import make_tensors
+    from karpenter_tpu.models.scheduler_model_grouped import (
+        assignment_from_triples,
+        greedy_pack_grouped_compressed,
+        make_item_tensors,
+    )
+
+    items = make_item_tensors(arrays)
+    t = make_tensors(enc, n_slots=enc.n_existing + min(enc.n_pods, 4096), with_pods=False)
+    out = greedy_pack_grouped_compressed(t, items, enc.n_pods)
+    assignment = assignment_from_triples(out["nz_item"], out["nz_slot"], out["nz_count"], item_pods, enc.n_pods)
+    return out, assignment
+
+
+def _canonical(enc, out, assignment):
+    """Placement up to fresh-slot index order AND within-item pod identity:
+    (placed pod set, multiset of per-slot (basis, sig-composition), final
+    counts_zone). Pods inside one item are interchangeable, so WHICH replica
+    carries WHICH name on a slot is not part of the contract; the slot's
+    shape composition and the group-count state are — exactly."""
+    sig = np.asarray(enc.sig_of_pod)
+    placed = np.nonzero(assignment >= 0)[0]
+    slots: dict[int, list[int]] = {}
+    for p in placed:
+        slots.setdefault(int(assignment[p]), []).append(int(sig[p]))
+    comp = sorted((int(out["slot_basis"][s]), tuple(sorted(v))) for s, v in slots.items())
+    return set(placed.tolist()), comp, np.asarray(out["state"][4])
+
+
+def _mg_zone_counts(enc, out, assignment):
+    """Per-(multi-group sig, slot zoneset) pod counts — the joint fill's
+    OWN placements must match per-pod sequential placement exactly (not just
+    in aggregate): same zones, same per-zone counts."""
+    from karpenter_tpu.models.scheduler_model_grouped import (
+        KIND_DOM_AFF,
+        KIND_DOM_ANTI,
+        KIND_DOM_SPREAD,
+    )
+
+    kinds = np.asarray(enc.group_kind)
+    zone_groups = (kinds == KIND_DOM_SPREAD) | (kinds == KIND_DOM_ANTI) | (kinds == KIND_DOM_AFF)
+    zm = (enc.sig_member & zone_groups[None, :]).sum(axis=1)
+    sig = np.asarray(enc.sig_of_pod)
+    zs = np.asarray(out["slot_zoneset"])
+    counts: dict[tuple, int] = {}
+    for p in np.nonzero(assignment >= 0)[0]:
+        s_ = int(sig[p])
+        if zm[s_] <= 1:
+            continue
+        z = tuple(np.nonzero(zs[int(assignment[p])])[0].tolist())
+        counts[(s_, z)] = counts.get((s_, z), 0) + 1
+    return counts
+
+
+def _lra_fleet(rng, n_sets=7):
+    """Dense LRA-style cross-membership: every set spreads over its own app
+    selector, rolls extra zone/tier/capacity-type constraints, hostname
+    anti-affinity, required zone affinity, ports, and taints."""
+    tiers = ("gold", "silver")
+    pods, tolerating = [], []
+    for g in range(n_sets):
+        tier = tiers[g % 2]
+        n = int(rng.integers(2, 6))
+        cpu = ["300m", "500m", "700m", "1"][int(rng.integers(0, 4))]
+        labels = {"app": f"g{g}", "tier": tier}
+        tsc = [spread(ZONE, 1, _sel(app=f"g{g}"))]
+        anti_aff = None
+        pod_aff = None
+        roll = int(rng.integers(0, 6))
+        if roll in (0, 1):
+            # merged multi-group: second zone-key spread over the shared
+            # `mg=<tier>` label. Carried ONLY by sets that also declare the
+            # constraint, so membership stays symmetric (every matched pod
+            # declares it) while still crossing replica-set boundaries.
+            labels["mg"] = tier
+            tsc.append(spread(ZONE, 2, _sel(mg=tier)))
+            if roll == 1:  # plus hostname spread (hostname key is in-window)
+                tsc.append(spread(wk.HOSTNAME_LABEL_KEY, 1, _sel(app=f"g{g}")))
+        elif roll == 2:  # zone spread + hostname anti (anti_path, count>1)
+            anti_aff = [hostname_anti_affinity(_sel(app=f"g{g}"))]
+        elif roll == 3:  # required zone co-location only (dom_aff_path)
+            tsc = []
+            pod_aff = [anti(_sel(app=f"g{g}"), ZONE)]
+        # roll 4/5: plain single-group spread
+        for i in range(n):
+            p = make_pod(
+                cpu=cpu,
+                name=f"g{g}-{i}",
+                labels=labels,
+                tsc=list(tsc),
+                anti_affinity=anti_aff,
+                pod_affinity=pod_aff,
+                tolerations=[{"key": "dedicated", "operator": "Equal", "value": "lra", "effect": "NoSchedule"}]
+                if g % 3 == 0
+                else None,
+            )
+            if roll == 2 and int(rng.integers(0, 2)):
+                p.spec.containers[0].ports = [{"containerPort": 9000, "hostPort": 9000 + g, "protocol": "TCP"}]
+            pods.append(p)
+            if g % 3 == 0:
+                tolerating.append(p.metadata.name)
+    return pods
+
+
+def _pools():
+    return [
+        make_nodepool(name="default-pool", requirements=LINUX_AMD64),
+        make_nodepool(
+            name="tainted-pool",
+            requirements=LINUX_AMD64,
+            taints=[Taint(key="dedicated", value="lra", effect="NoSchedule")],
+        ),
+    ]
+
+
+class TestMultiGroupKernelParity:
+    @pytest.mark.parametrize("seed", [1, 7, 23, 61])
+    def test_randomized_dense_graph_matches_perpod_reference(self, seed):
+        """Merged multi-group items place bit-identically (up to fresh-slot
+        index order) to the per-pod count=1 reference expansion."""
+        from karpenter_tpu.models.scheduler_model_grouped import build_items
+        from karpenter_tpu.solver.check import fast_validate
+
+        rng = np.random.default_rng(seed)
+        snap = make_snapshot(_lra_fleet(rng), node_pools=_pools())
+        enc = encode(snap)
+        assert enc.fallback_reasons == []
+
+        merged_arrays, merged_pods = build_items(enc)
+        ref_arrays, ref_pods = _perpod_items(enc)
+        out_m, asg_m = _pack(enc, merged_arrays, merged_pods)
+        out_r, asg_r = _pack(enc, ref_arrays, ref_pods)
+        assert fast_validate(enc, asg_m, out_m["slot_basis"], out_m["slot_zoneset"]) == []
+        assert fast_validate(enc, asg_r, out_r["slot_basis"], out_r["slot_zoneset"]) == []
+
+        placed_m, comp_m, cz_m = _canonical(enc, out_m, asg_m)
+        placed_r, comp_r, cz_r = _canonical(enc, out_r, asg_r)
+        assert placed_m == placed_r
+        assert comp_m == comp_r
+        np.testing.assert_array_equal(cz_m, cz_r)
+        assert _mg_zone_counts(enc, out_m, asg_m) == _mg_zone_counts(enc, out_r, asg_r)
+
+    def test_merged_items_compress_multi_group_replicas(self):
+        from karpenter_tpu.models.scheduler_model_grouped import build_items
+
+        pods = _merged_set(0, 12, "gold") + _merged_set(1, 12, "gold")
+        enc = encode(make_snapshot(pods))
+        assert enc.fallback_reasons == []
+        _, _, info = build_items(enc, with_info=True)
+        assert info["n_pods"] == 24
+        assert info["demotions"] == {}
+        # 24 pods in 2 shapes -> 2 items: the whole point of the merge
+        assert info["n_items"] == 2
+
+    def test_merged_ports_fleet_matches_reference(self):
+        """hostPort forces one-per-host inside a merged multi-group item."""
+        from karpenter_tpu.models.scheduler_model_grouped import build_items
+
+        pods = _merged_set(0, 5, "gold", ports=True) + _merged_set(1, 4, "silver", cpu="300m")
+        enc = encode(make_snapshot(pods))
+        assert enc.fallback_reasons == []
+        out_m, asg_m = _pack(enc, *build_items(enc))
+        out_r, asg_r = _pack(enc, *_perpod_items(enc))
+        placed_m, comp_m, cz_m = _canonical(enc, out_m, asg_m)
+        placed_r, comp_r, cz_r = _canonical(enc, out_r, asg_r)
+        assert placed_m == placed_r
+        assert comp_m == comp_r
+        np.testing.assert_array_equal(cz_m, cz_r)
+        assert _mg_zone_counts(enc, out_m, asg_m) == _mg_zone_counts(enc, out_r, asg_r)
+
+
+class TestDemotionAttribution:
+    def test_multi_key_demotes_with_reason(self):
+        from karpenter_tpu.models.scheduler_model_grouped import build_items
+
+        labels = {"app": "mk"}
+        tsc = [spread(ZONE, 1, _sel(app="mk")), spread(CT, 1, _sel(app="mk"))]
+        pods = [make_pod(cpu="500m", name=f"mk-{i}", labels=labels, tsc=tsc) for i in range(6)]
+        enc = encode(make_snapshot(pods))
+        _, _, info = build_items(enc, with_info=True)
+        assert info["demotions"] == {"multi-key": 6}
+        assert info["n_items"] == 6  # every pod its own item
+
+    def test_aff_pin_conflict_demotes_with_reason(self):
+        from karpenter_tpu.models.scheduler_model_grouped import build_items
+
+        labels = {"a": "1", "b": "1"}
+        pod_aff = [anti(_sel(a="1"), ZONE), anti(_sel(b="1"), ZONE)]
+        pods = [make_pod(cpu="500m", name=f"ap-{i}", labels=labels, pod_affinity=pod_aff) for i in range(4)]
+        enc = encode(make_snapshot(pods))
+        _, _, info = build_items(enc, with_info=True)
+        assert info["demotions"] == {"aff-pin-conflict": 4}
+
+    def test_hatch_off_demotes_mergeable_shapes(self, monkeypatch):
+        from karpenter_tpu.models.scheduler_model_grouped import build_items
+
+        monkeypatch.setenv("KARPENTER_SOLVER_MULTIGROUP", "0")
+        enc = encode(make_snapshot(_merged_set(0, 5, "gold")))
+        _, _, info = build_items(enc, with_info=True)
+        assert info["multigroup"] is False
+        assert info["demotions"] == {"hatch-off": 5}
+        assert info["n_items"] == 5
+
+    def test_demotion_label_is_bounded(self):
+        from karpenter_tpu.models.scheduler_model_grouped import DEMOTION_REASONS, demotion_label
+
+        for r in DEMOTION_REASONS:
+            assert demotion_label(r) == r
+        assert demotion_label("surprise-new-reason") == "other"
+
+    def test_solver_emits_demotion_metrics(self, monkeypatch):
+        from karpenter_tpu.metrics import (
+            SOLVER_PACK_ITEM_COMPRESSION,
+            SOLVER_PACK_ITEM_DEMOTIONS_TOTAL,
+            make_registry,
+        )
+
+        # hatch off: the merged shape demotes per-pod and the counter/gauge
+        # record it (in-window shapes never demote with the hatch on)
+        monkeypatch.setenv("KARPENTER_SOLVER_MULTIGROUP", "0")
+        reg = make_registry()
+        solver = TPUSolver(force=True, registry=reg)
+        solver.solve(make_snapshot(_merged_set(0, 5, "gold")))
+        assert reg.counter(SOLVER_PACK_ITEM_DEMOTIONS_TOTAL).value(reason="hatch-off") == 5
+        assert reg.gauge(SOLVER_PACK_ITEM_COMPRESSION).value() == 1.0  # 5 pods / 5 items
+
+        # hatch on: same fleet merges to ONE item, no demotions
+        monkeypatch.setenv("KARPENTER_SOLVER_MULTIGROUP", "1")
+        reg2 = make_registry()
+        solver2 = TPUSolver(force=True, registry=reg2)
+        solver2.solve(make_snapshot(_merged_set(0, 5, "gold")))
+        assert reg2.counter(SOLVER_PACK_ITEM_DEMOTIONS_TOTAL).total() == 0
+        assert reg2.gauge(SOLVER_PACK_ITEM_COMPRESSION).value() == 5.0
+
+
+class TestDeltaChaining:
+    def test_grown_multi_group_item_stays_delta_and_matches_full(self):
+        """Replicas of an already-merged multi-group shape arriving on the
+        warm path must ride the delta kernel (the merged item GROWS), chain
+        across batches, and land where a fresh full solve lands them."""
+        pods = _merged_set(0, 6, "gold") + _merged_set(1, 4, "gold", cpu="300m")
+        snap = make_snapshot(list(pods))
+        solver = TPUSolver(force=True)
+        r = solver.solve(snap)
+        assert solver.last_solve_mode == "full"
+        assert not r.pod_errors
+
+        for batch in range(2):
+            snap.pods.extend(_merged_set(0, 2, "gold")[:2])
+            for i, p in enumerate(snap.pods[-2:]):
+                p.metadata.name = f"grow{batch}-{i}"
+            r = solver.solve(snap)
+            assert solver.last_solve_mode == "delta", (
+                solver.last_solve_mode,
+                solver.encode_cache.last_delta_reject,
+            )
+            assert not r.pod_errors
+
+        from test_delta_compose import _claims, _placed_pod_names
+
+        fresh = TPUSolver(force=True)
+        full = fresh.solve(make_snapshot(list(snap.pods)))
+        assert not full.pod_errors
+        assert _placed_pod_names(r) == _placed_pod_names(full)
+        assert len(_claims(r)) <= len(_claims(full)) + 1
+
+    def test_delta_demotes_same_shapes_as_full(self, monkeypatch):
+        """A demoted shape arriving as a delta add must split per-pod exactly
+        like the full path (shared sig_demotions oracle): hatch off, new
+        replicas of a multi-group shape stay count=1 on the delta path."""
+        from karpenter_tpu.obs.trace import TraceRecorder
+
+        monkeypatch.setenv("KARPENTER_SOLVER_MULTIGROUP", "0")
+        pods = _merged_set(0, 5, "gold")
+        snap = make_snapshot(list(pods))
+        solver = TPUSolver(force=True, recorder=TraceRecorder(enabled=True))
+        solver.solve(snap)
+        assert solver.last_solve_mode == "full"
+        grow = _merged_set(0, 2, "gold")
+        for i, p in enumerate(grow):
+            p.metadata.name = f"late-{i}"
+        snap.pods.extend(grow)
+        r = solver.solve(snap)
+        assert not r.pod_errors
+        if solver.last_solve_mode == "delta":
+            assert solver._trace.attribution.get("delta_demoted") == 2
+
+
+class TestEscapeHatch:
+    def test_hatch_off_bit_parity_with_seed_reference(self, monkeypatch):
+        """MULTIGROUP=0 must reproduce the seed's item keys EXACTLY: per-pod
+        keys for every multi-zone-membership shape, merge for the rest."""
+        from karpenter_tpu.models.scheduler_model_grouped import build_items
+        from karpenter_tpu.models.scheduler_model_grouped import KIND_DOM_AFF, KIND_DOM_ANTI, KIND_DOM_SPREAD
+
+        monkeypatch.setenv("KARPENTER_SOLVER_MULTIGROUP", "0")
+        rng = np.random.default_rng(5)
+        snap = make_snapshot(_lra_fleet(rng), node_pools=_pools())
+        enc = encode(snap)
+        assert enc.fallback_reasons == []
+        arrays, item_pods = build_items(enc)
+
+        # inline seed reference: per-pod keys for ALL multi-zone sigs
+        kinds = np.asarray(enc.group_kind)
+        zone_groups = (kinds == KIND_DOM_SPREAD) | (kinds == KIND_DOM_ANTI) | (kinds == KIND_DOM_AFF)
+        multi_zone = (enc.sig_member & zone_groups[None, :]).sum(axis=1) > 1
+        sig = np.asarray(enc.sig_of_pod, dtype=np.int64)
+        P = enc.n_pods
+        key = np.where(multi_zone[sig], enc.n_sigs + np.arange(P, dtype=np.int64), sig)
+        _, first_idx, inverse, counts = np.unique(key, return_index=True, return_inverse=True, return_counts=True)
+        order = np.argsort(first_idx, kind="stable")
+        np.testing.assert_array_equal(
+            arrays["item_count"][: len(order)], counts[order].astype(np.int32)
+        )
+        rep_sig = sig[first_idx[order]]
+        np.testing.assert_array_equal(arrays["item_req"][: len(order)], enc.sig_req[rep_sig])
+        rank = np.empty_like(order)
+        rank[order] = np.arange(order.size)
+        item_of_pod = rank[inverse]
+        for w in range(len(order)):
+            np.testing.assert_array_equal(item_pods[w], np.nonzero(item_of_pod == w)[0])
+
+    def test_hatch_arms_place_equivalently(self, monkeypatch):
+        """Solver-level: MULTIGROUP on/off schedule the same pods onto the
+        same claim shapes (composition multiset), differing only in item
+        compression."""
+
+        def run():
+            solver = TPUSolver(force=True)
+            res = solver.solve(make_snapshot(_merged_set(0, 8, "gold") + _merged_set(1, 6, "silver", cpu="300m")))
+            assert not res.pod_errors
+            comp = sorted(
+                tuple(sorted(p.metadata.labels["app"] for p in nc.pods)) for nc in res.new_node_claims if nc.pods
+            )
+            names = {p.metadata.name for nc in res.new_node_claims for p in nc.pods}
+            names |= {p.metadata.name for en in res.existing_nodes for p in en.pods}
+            return comp, names
+
+        monkeypatch.setenv("KARPENTER_SOLVER_MULTIGROUP", "1")
+        comp_on, names_on = run()
+        monkeypatch.setenv("KARPENTER_SOLVER_MULTIGROUP", "0")
+        comp_off, names_off = run()
+        assert names_on == names_off
+        assert comp_on == comp_off
+
+
+class TestRecompilePin:
+    def test_warm_multigroup_resubmit_zero_recompiles(self):
+        """Identical resubmit AND an under-high-water shrink of a merged
+        multi-group fleet must not retrace any watched kernel: item counts
+        are traced data, never static shape."""
+        from karpenter_tpu.obs.trace import TraceRecorder
+
+        pods = _merged_set(0, 10, "gold") + _merged_set(1, 8, "silver", cpu="300m")
+        solver = TPUSolver(force=True, recorder=TraceRecorder(enabled=True))
+        solver.solve(make_snapshot(list(pods)))
+        # identical resubmit: zero
+        solver.solve(make_snapshot(list(pods)))
+        assert solver._trace.recompiles == {}, solver._trace.recompiles
+        # shrink below the high-water mark (same shapes, fewer replicas): zero
+        solver.solve(make_snapshot(list(pods[:-3])))
+        assert solver._trace.recompiles == {}, solver._trace.recompiles
