@@ -1943,8 +1943,9 @@ def bench_trace_overhead(n_pods: int, n_types: int) -> dict:
 
 def bench_lint_wall() -> dict:
     """The solverlint wall-time gate (ISSUE 11 satellite): the gate runs in
-    tier-1 and pre-commit loops, so the full 9-rule scan — now including the
-    cross-module racecheck rules — must stay fast despite scanning the whole
+    tier-1 and pre-commit loops, so the full 15-rule scan — now including the
+    cross-module racecheck rules and the four determinism rules plus the
+    stale-pragma post-pass — must stay fast despite scanning the whole
     package for labels plus the threaded serving stack three more times.
     Parsed-module caching across rules is the mechanism; this measures and
     bounds the result (median of 3 in-process runs, plus a --jobs 4 arm)."""
@@ -1982,6 +1983,116 @@ def bench_lint_wall() -> dict:
         "lint_selftest_seconds": round(self_test_s, 3),
         "lint_findings": len(findings),
         "lint_gate": gate,
+    }
+
+
+def bench_detcheck(n_pods: int, n_types: int) -> dict:
+    """The detcheck smoke gate (`--detcheck`, ISSUE 19): record a short warm
+    solve sequence (full -> delta -> delta) with KARPENTER_SOLVER_DETCHECK=1
+    and run the dual-run sanitizer — the subprocess replay under a perturbed
+    PYTHONHASHSEED + reversed dict/set insertion order must retrace the SAME
+    mode sequence and reproduce every placement digest. The full exit-path
+    matrix (hybrid/hybrid-delta/grouped/fallback) is pinned in tier-1
+    (tests/test_detcheck.py); this gate proves the sanitizer itself stays
+    runnable against the bench-scale encoder."""
+    from helpers import make_pod
+
+    from karpenter_tpu.obs import detcheck
+    from karpenter_tpu.solver.tpu import TPUSolver
+
+    prev = os.environ.get("KARPENTER_SOLVER_DETCHECK")
+    os.environ["KARPENTER_SOLVER_DETCHECK"] = "1"
+    detcheck._refresh()
+    try:
+        snap = build_snapshot(n_pods, n_types)
+        solver = TPUSolver(force=True)
+        t0 = time.perf_counter()
+        solver.solve(snap)  # full
+        snap.pods.append(make_pod(cpu="500m", memory="512Mi"))
+        solver.solve(snap)  # delta
+        snap.pods.pop()
+        solver.solve(snap)  # removal delta
+        record_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        try:
+            out = solver.check_determinism()
+            gate, detail = "PASS", ""
+        except detcheck.DetCheckError as exc:
+            out, gate, detail = {"solves": 0, "parent_modes": [], "child_modes": []}, "FAIL", str(exc)
+        dual_s = time.perf_counter() - t0
+        if gate == "PASS" and out["child_modes"] != out["parent_modes"]:
+            # vacuous pass: digests matched but the replay re-derived them on
+            # a different path (e.g. cold full encode where the parent ran delta)
+            gate = "FAIL"
+            detail = f"mode drift: parent={out['parent_modes']} child={out['child_modes']}"
+        if gate == "FAIL":
+            print(f"DETCHECK SMOKE GATE FAILED: {detail}", file=sys.stderr)
+        return {
+            "detcheck_solves": out["solves"],
+            "detcheck_parent_modes": out["parent_modes"],
+            "detcheck_child_modes": out["child_modes"],
+            "detcheck_record_seconds": round(record_s, 4),
+            "detcheck_dual_run_seconds": round(dual_s, 4),
+            "detcheck_gate": gate,
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("KARPENTER_SOLVER_DETCHECK", None)
+        else:
+            os.environ["KARPENTER_SOLVER_DETCHECK"] = prev
+        detcheck._refresh()
+
+
+def bench_detcheck_overhead(n_pods: int, n_types: int) -> dict:
+    """The detcheck off-switch micro-gate: with the env flag UNSET (the
+    default everywhere), `solve()` must cost the same as the un-instrumented
+    `_solve_flight` it wraps — one cached-bool read, no snapshot pickling, no
+    log attach. Same interleaved-median protocol as bench_trace_overhead;
+    also reports the per-call cost of the `detcheck_enabled()` gate itself."""
+    import statistics
+
+    from karpenter_tpu.obs import detcheck
+    from karpenter_tpu.solver.tpu import TPUSolver
+
+    assert not detcheck.detcheck_enabled(), "overhead arm must run with the flag off"
+    snap = build_snapshot(n_pods, n_types)
+    solver = TPUSolver(force=True)
+    solver.solve(snap)  # warm: jit compile (shared cache)
+    times = {"seam": [], "direct": []}
+    reps_env = os.environ.get("BENCH_DETCHECK_OVERHEAD_REPS")
+    if reps_env is not None:
+        reps = int(reps_env)
+    else:
+        reps = 5
+        t0 = time.perf_counter()
+        solver.solve(snap)
+        if time.perf_counter() - t0 < 0.05:
+            reps = 25  # short-solve regime: buy variance down
+    for _ in range(reps):
+        for label, fn in (("seam", solver.solve), ("direct", solver._solve_flight)):
+            t0 = time.perf_counter()
+            fn(snap)
+            times[label].append(time.perf_counter() - t0)
+    med_seam = statistics.median(times["seam"])
+    med_direct = statistics.median(times["direct"])
+    pct = (med_seam - med_direct) / med_direct * 100.0 if med_direct > 0 else 0.0
+    n_gate_calls = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n_gate_calls):
+        detcheck.detcheck_enabled()
+    gate_ns = (time.perf_counter() - t0) / n_gate_calls * 1e9
+    target = float(os.environ.get("BENCH_DETCHECK_OVERHEAD_TARGET", "2.0"))
+    gate = "PASS" if pct < target and gate_ns < 1000.0 else "FAIL"
+    if gate == "FAIL":
+        print(
+            f"DETCHECK OVERHEAD GATE FAILED: {pct:.2f}% (target <{target}%), "
+            f"enabled() {gate_ns:.0f}ns/call (target <1000ns)",
+            file=sys.stderr,
+        )
+    return {
+        "detcheck_overhead_pct": round(pct, 3),
+        "detcheck_enabled_ns_per_call": round(gate_ns, 1),
+        "detcheck_overhead_gate": gate,
     }
 
 
@@ -2419,6 +2530,24 @@ def main():
         _emit_result()
         return
 
+    if "--detcheck" in sys.argv:
+        # standalone determinism smoke: record + dual-run + off-switch
+        # overhead at a small scale, nothing else (CI hook / pre-commit use)
+        n_dc = int(os.environ.get("BENCH_DETCHECK_PODS", "2000"))
+        n_dc_types = int(os.environ.get("BENCH_DETCHECK_TYPES", "25"))
+        dc = _run_scenario("detcheck", bench_detcheck, n_dc, n_dc_types)
+        if dc is not None:
+            extra.update(dc)
+        dov = _run_scenario("detcheck_overhead", bench_detcheck_overhead, n_dc, n_dc_types)
+        if dov is not None:
+            extra.update(dov)
+        _RESULT.update(
+            metric=f"detcheck_{n_dc}pods_dual_run_seconds",
+            value=extra.get("detcheck_dual_run_seconds", 0.0), unit="s", vs_baseline=1.0,
+        )
+        _emit_result()
+        return
+
     sched = _run_scenario("scheduler", bench_scheduler, n_pods, n_types)
     if sched is not None:
         pods_per_sec, sched_extra = sched
@@ -2545,11 +2674,17 @@ def main():
     tov = _run_scenario("trace_overhead", bench_trace_overhead, n_pods, n_types)
     if tov is not None:
         extra.update(tov)
-    # solverlint wall time (9 rules incl. the racecheck concurrency rules):
-    # the static gate itself is on a <5s budget, same style as trace_overhead
+    # solverlint wall time (15 rules incl. the racecheck concurrency rules
+    # and the detlint determinism rules): the static gate itself is on a <5s
+    # budget, same style as trace_overhead
     lint = _run_scenario("lint_wall", bench_lint_wall)
     if lint is not None:
         extra.update(lint)
+    # detcheck off-switch cost: the solve() recording seam must be free when
+    # KARPENTER_SOLVER_DETCHECK is unset (every number above ran with it off)
+    dov = _run_scenario("detcheck_overhead", bench_detcheck_overhead, n_pods, n_types)
+    if dov is not None:
+        extra.update(dov)
     # 20% of pods carry a dynamically-provisioned PVC (tensor path, r5)
     pvc = _run_scenario("pvc", bench_pvc, n_pods, n_types)
     if pvc is not None:

@@ -23,6 +23,7 @@ def main(argv=None) -> int:
     parser.add_argument("--root", type=Path, default=None, help="repo root (default: auto-detected)")
     parser.add_argument("--rule", action="append", dest="rules", help="run only this rule (repeatable)")
     parser.add_argument("--jobs", type=int, default=1, help="run rules concurrently on N threads (parsed modules are shared either way)")
+    parser.add_argument("--format", choices=("text", "json"), default="text", help="finding output format (json: file/line/rule/message/pragma-status, for CI diffing)")
     parser.add_argument("paths", nargs="*", type=Path, help="restrict the scan to these files")
     args = parser.parse_args(argv)
 
@@ -38,7 +39,7 @@ def main(argv=None) -> int:
                 return 2
             print(f"solverlint self-test: {len(RULES)} rules healthy ({time.perf_counter() - t0:.2f}s)")
             return 0
-        if len(RULES) < 10:
+        if len(RULES) < 15:
             print(f"solverlint: rule registry shrank to {len(RULES)} rules", file=sys.stderr)
             return 2
         for p in args.paths:
@@ -51,10 +52,37 @@ def main(argv=None) -> int:
     except ConfigError as e:
         print(f"solverlint: broken configuration: {e}", file=sys.stderr)
         return 2
-    if findings:
-        for f in sorted(findings, key=lambda f: (f.path, f.line)):
+    ordered = sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    if args.format == "json":
+        import json
+
+        # machine-readable surface for CI and the bench lint_wall scenario:
+        # finding counts diff cleanly across runs instead of being grepped
+        # out of text. pragma_status distinguishes the pragma machinery's own
+        # findings from ordinary unsuppressed ones (suppressed findings are
+        # never emitted at all).
+        status = {"solverlint-pragma": "malformed", "stale-pragma": "stale"}
+        payload = {
+            "rules": sorted(RULES),
+            "count": len(ordered),
+            "elapsed_s": round(time.perf_counter() - t0, 3),
+            "findings": [
+                {
+                    "file": f.path,
+                    "line": f.line,
+                    "rule": f.rule,
+                    "message": f.message,
+                    "pragma_status": status.get(f.rule, "unsuppressed"),
+                }
+                for f in ordered
+            ],
+        }
+        print(json.dumps(payload, indent=2, sort_keys=True))
+        return 1 if ordered else 0
+    if ordered:
+        for f in ordered:
             print(f)
-        print(f"\nsolverlint: {len(findings)} finding(s) ({time.perf_counter() - t0:.2f}s)", file=sys.stderr)
+        print(f"\nsolverlint: {len(ordered)} finding(s) ({time.perf_counter() - t0:.2f}s)", file=sys.stderr)
         return 1
     print(f"solverlint: clean ({len(RULES)} rules, {time.perf_counter() - t0:.2f}s)")
     return 0

@@ -40,7 +40,9 @@ class Config:
     # callees whose results live on device: coercing them is a host sync.
     # fnmatch patterns over the dotted callee (and its last segment).
     device_producers: tuple[str, ...] = (
-        "greedy_pack_grouped_sharded",
+        # the trailing * also covers greedy_pack_grouped_sharded_state — the
+        # meshed pack's carry-state variant returns device arrays just the same
+        "greedy_pack_grouped_sharded*",
         "recredit_removals",
         "make_tensors",
         "make_item_tensors",
@@ -175,6 +177,61 @@ class Config:
         "*.warning",
         "*.error",
         "*.exception",
+    )
+    # -- detlint (the determinism rules, ISSUE 19) -----------------------------
+    # modules on the BIT-IDENTICAL-PLACEMENT path: the unordered-iteration
+    # rule runs here (solver encode/decode, the pack models, the serving
+    # stack whose replay/re-homing contracts pin placement digests, and the
+    # mesh-sharded pack)
+    det_modules: tuple[str, ...] = (
+        "karpenter_tpu/solver/*.py",
+        "karpenter_tpu/models/*.py",
+        "karpenter_tpu/serving/*.py",
+        "karpenter_tpu/parallel/*.py",
+    )
+    # modules reachable from solve/encode/decode/consolidation entry points:
+    # wallclock-and-rng-in-solve-path and env-dependent-branch run here (the
+    # obs/tracing seams live outside these globs by design — a trace span's
+    # perf_counter is observability, not solve input)
+    solve_path_modules: tuple[str, ...] = (
+        "karpenter_tpu/solver/*.py",
+        "karpenter_tpu/models/*.py",
+        "karpenter_tpu/parallel/*.py",
+    )
+    # the reviewed seeded-RNG registry: callee patterns (fnmatch over the
+    # dotted callee, its tail, and "<relpath>:<name>") whose randomness is
+    # seed-derived and replay-stable — jax.random's key-passing API is
+    # deterministic by construction, and the serving FaultSpec / bench RNG
+    # producers are reviewed seeded streams
+    seeded_rng: tuple[str, ...] = (
+        "jax.random.*",
+        "jr.*",
+    )
+    # float-reduction-order scans the host-side accumulation sites adjacent
+    # to the sharded pack and the models' host folds
+    float_order_modules: tuple[str, ...] = (
+        "karpenter_tpu/parallel/sharded.py",
+        "karpenter_tpu/models/*.py",
+    )
+    # canonical-order reduction helpers: a host float accumulation routed
+    # through one of these is order-stable by construction (math.fsum is
+    # exact; stable_host_sum sorts its operands first)
+    canonical_reduce_helpers: tuple[str, ...] = ("fsum", "math.fsum", "stable_host_sum")
+    # the registered environment-knob table: every os.environ read in the
+    # solve-path modules must name one of these reviewed KARPENTER_* knobs —
+    # an unregistered env probe can silently fork behavior between shard
+    # workers (env-dependent-branch)
+    env_knobs: tuple[str, ...] = (
+        "KARPENTER_SOLVER_TYPECHECK",
+        "KARPENTER_SOLVER_RACECHECK",
+        "KARPENTER_SOLVER_DETCHECK",
+        "KARPENTER_SOLVER_COMPILE_CACHE",
+        "KARPENTER_SOLVER_MESH",
+        "KARPENTER_SOLVER_SHARD_DEVICES",
+        "KARPENTER_SOLVER_BUCKET",
+        "KARPENTER_SOLVER_MULTIGROUP",
+        "KARPENTER_SOLVER_GLOBALPACK",
+        "KARPENTER_ENCODE_COLUMNAR",
     )
     # direct override for tests/self-test; when None the registry file is
     # parsed on first use

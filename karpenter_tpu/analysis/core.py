@@ -51,6 +51,10 @@ class ParsedModule:
         # tokens only (docstrings describing the syntax never count)
         self.pragmas: dict[int, list[tuple[str, str]]] = {}
         self.malformed: list[Finding] = []
+        # (line, rule) pairs whose pragma did real work this scan — either
+        # suppressed a finding or was consulted as a contract marker
+        # (lock-order / guarded-field caller-holds). stale-pragma reads this.
+        self.used: set[tuple[int, str]] = set()
         try:
             tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
         except tokenize.TokenError:
@@ -78,9 +82,14 @@ class ParsedModule:
 
     def suppressed(self, finding: Finding) -> bool:
         lo, hi = finding.span if finding.span != (0, 0) else (finding.line, finding.line)
-        for line in range(lo - 1, hi + 1):
+        # own-span lines BEFORE the line above: when adjacent lines each
+        # carry their own pragma for the same rule, each finding must mark
+        # its own pragma as used, not shadow its neighbor's (stale-pragma
+        # would otherwise report the second of two back-to-back pragmas)
+        for line in (*range(lo, hi + 1), lo - 1):
             for rule, _why in self.pragmas.get(line, ()):
                 if rule == finding.rule:
+                    self.used.add((line, rule))
                     return True
         return False
 
@@ -131,6 +140,12 @@ def run_analysis(
     unknown = [r for r in selected if r not in RULES]
     if unknown:
         raise ConfigError(f"unknown rules requested: {unknown} (have {sorted(RULES)})")
+    # default scans handle stale-pragma via the cheap post-pass below (usage
+    # was already marked while the other rules ran); only an EXPLICIT --rule
+    # selection runs the rule's standalone re-derivation
+    stale_post = rules is None and "stale-pragma" in selected
+    if stale_post:
+        selected = [r for r in selected if r != "stale-pragma"]
 
     # path -> module, or None once it failed to parse (the parse finding is
     # emitted exactly once, not once per rule that scans the file); shared
@@ -138,7 +153,7 @@ def run_analysis(
     cache: dict[Path, ParsedModule | None] = {}
     cache_lock = threading.Lock()
     parse_findings: list[Finding] = []
-    scanned: set[Path] = set()
+    scanned: dict[Path, set[str]] = {}
 
     def parsed(path: Path) -> ParsedModule | None:
         # parse INSIDE the lock: concurrent rules glob overlapping module
@@ -174,7 +189,7 @@ def run_analysis(
             if mod is None:
                 continue
             with cache_lock:
-                scanned.add(Path(path))
+                scanned.setdefault(Path(path), set()).add(name)
             for f in rule.check(mod, config, root):
                 if not mod.suppressed(f):
                     out.append(f)
@@ -191,11 +206,37 @@ def run_analysis(
 
     findings: list[Finding] = [f for fs in per_rule for f in fs]
     findings.extend(parse_findings)
-    for path in scanned:
+    for path, rulenames in scanned.items():
         mod = cache.get(path)
-        if mod is not None:
-            findings.extend(mod.malformed)
+        if mod is None:
+            continue
+        findings.extend(mod.malformed)
+        if stale_post:
+            # the stale post-pass: every rule that scans this file has run
+            # and marked the pragmas it used; whatever is left did no work
+            findings.extend(f for f in stale_pragma_findings(mod, rulenames) if not mod.suppressed(f))
     return findings
+
+
+def stale_pragma_findings(mod: ParsedModule, checked: set[str]) -> list[Finding]:
+    """Pragmas of `mod` that did no work during a scan where the rules in
+    `checked` ran over it — dead suppressions rot into false confidence, so
+    each one is a finding of its own."""
+    from .rules import RULES
+
+    out: list[Finding] = []
+    for line in sorted(mod.pragmas):
+        for rule, _why in mod.pragmas[line]:
+            if rule == "stale-pragma" or (line, rule) in mod.used:
+                continue
+            if rule not in RULES:
+                msg = f"pragma names unknown rule {rule!r} — it can never suppress anything; delete it"
+            elif rule not in checked:
+                msg = f"pragma for {rule!r} sits in a file that rule never scans — a dead suppression; delete it"
+            else:
+                msg = f"pragma for {rule!r} no longer suppresses any finding — dead suppressions rot; delete it"
+            out.append(Finding("stale-pragma", mod.relpath, line, msg))
+    return out
 
 
 def run_self_test(config: Config | None = None) -> list[str]:
@@ -206,8 +247,8 @@ def run_self_test(config: Config | None = None) -> list[str]:
     from .rules import RULES
 
     failures: list[str] = []
-    if len(RULES) < 10:
-        failures.append(f"rule registry shrank to {len(RULES)} rules (expected >= 10)")
+    if len(RULES) < 15:
+        failures.append(f"rule registry shrank to {len(RULES)} rules (expected >= 15)")
     for name, cls in RULES.items():
         overrides = {"shared_fields": cls.SELF_TEST_SHARED_FIELDS, **cls.SELF_TEST_CONFIG}
         cfg = dataclasses.replace(config or Config(), **overrides)

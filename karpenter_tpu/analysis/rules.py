@@ -58,6 +58,29 @@ faultline's static arm (ISSUE 15):
                              failure leaves a signal; deliberate swallows
                              carry a justified pragma.
 
+detlint's static arm (ISSUE 19) — the determinism rules protecting the
+bit-identical-placement contract (obs/detcheck.py is the runtime arm):
+
+11. unordered-iteration-escape  iteration over a set/frozenset of
+                             non-literal origin (or id()-keyed ordering)
+                             landing in an ordered output — hash order
+                             varies with PYTHONHASHSEED; sanctioned sites
+                             use sorted(...) or a justified pragma.
+12. wallclock-and-rng-in-solve-path  time.*/random/np.random/uuid4/secrets
+                             reachable from solve/encode/decode entry
+                             points, outside the reviewed seeded-RNG
+                             registry ([tool.solverlint] seeded-rng).
+13. float-reduction-order    host float accumulations over device-derived
+                             or unordered operands not routed through a
+                             canonical-order helper (fsum/stable_host_sum)
+                             — protects mesh-N-vs-mesh-1 bit-parity.
+14. env-dependent-branch     os.environ reads in solve-path modules
+                             outside the registered KARPENTER_* knob table
+                             ([tool.solverlint] env-knobs).
+15. stale-pragma             a suppression pragma that no longer
+                             suppresses any finding (dead suppressions
+                             rot; usage is tracked live during the scan).
+
 Every rule ships SELF_TEST_BAD/SELF_TEST_OK snippets; `--self-test` proves
 each rule still detects its seeded violation and that the pragma suppresses
 it, so the gate fails loudly if rule discovery breaks.
@@ -596,7 +619,9 @@ class MetricLabelCardinalityRule(Rule):
             func = call.func
             if not isinstance(func, ast.Attribute):
                 continue
-            if func.attr not in ("inc", "observe") and func.attr not in wrappers:
+            # gauge .set carries labels exactly like counter .inc / histogram
+            # .observe — an unbounded gauge label leaks series just the same
+            if func.attr not in ("inc", "observe", "set") and func.attr not in wrappers:
                 continue
             if fname in wrappers:
                 continue  # the wrapper's own **labels forwarding
@@ -702,26 +727,31 @@ def _module_lock_attrs(tree: ast.Module, config: Config) -> dict[str, tuple[set[
     return out
 
 
-def _threading_imports(tree: ast.Module) -> tuple[set[str], dict[str, str]]:
-    """(aliases the threading MODULE is bound to, {local name: threading
-    attr} for from-imports) — so `import threading as t; t.Lock()` and
-    `from threading import Lock as L; L()` resolve instead of evading the
-    concurrency rules via a rename."""
+def _import_table(tree: ast.Module, module: str) -> tuple[set[str], dict[str, str]]:
+    """(aliases `module` is bound to, {local name: module attr} for
+    from-imports) — so `import random as rnd; rnd.shuffle()` and
+    `from random import shuffle as sh; sh()` resolve instead of evading a
+    rule via a rename. The same table serves threading (racecheck's rules)
+    and time/random/os/uuid (detlint's)."""
     mods: set[str] = set()
     names: dict[str, str] = {}
     for n in ast.walk(tree):
         if isinstance(n, ast.Import):
             for a in n.names:
-                if a.name == "threading":
-                    mods.add(a.asname or "threading")
-        elif isinstance(n, ast.ImportFrom) and n.module == "threading":
+                if a.name == module:
+                    mods.add(a.asname or module)
+        elif isinstance(n, ast.ImportFrom) and n.module == module:
             for a in n.names:
                 names[a.asname or a.name] = a.name
     return mods, names
 
 
-def _threading_construct(call: ast.Call, mods: set[str], names: dict[str, str]) -> str | None:
-    """The threading primitive this call constructs ("Lock", "Thread", ...),
+def _threading_imports(tree: ast.Module) -> tuple[set[str], dict[str, str]]:
+    return _import_table(tree, "threading")
+
+
+def _module_construct(call: ast.Call, mods: set[str], names: dict[str, str]) -> str | None:
+    """The module attribute this call invokes ("Lock", "shuffle", ...),
     resolved through module aliases and from-imports; None otherwise."""
     name = dotted_name(call.func)
     if not name:
@@ -734,11 +764,19 @@ def _threading_construct(call: ast.Call, mods: set[str], names: dict[str, str]) 
     return None
 
 
+# racecheck's rules predate the generic table; keep their vocabulary
+_threading_construct = _module_construct
+
+
 def _has_pragma(mod: ParsedModule, rule: str, line: int) -> bool:
-    """A justified pragma for `rule` on `line` or the line directly above."""
+    """A justified pragma for `rule` on `line` or the line directly above.
+    Consultation counts as usage: a caller-holds / ordering-contract pragma
+    never flows through mod.suppressed(), so it is marked live here lest
+    stale-pragma report every contract marker as dead."""
     for i in (line, line - 1):
         for r, _why in mod.pragmas.get(i, ()):
             if r == rule:
+                mod.used.add((i, rule))
                 return True
     return False
 
@@ -1237,6 +1275,507 @@ class SwallowedExceptionRule(Rule):
         return findings
 
 
+# -- detlint: the determinism rules (ISSUE 19) --------------------------------
+
+
+_SET_ANN_NAMES = frozenset({"set", "frozenset", "Set", "FrozenSet", "AbstractSet", "MutableSet"})
+# set methods whose result is itself a set (order re-randomized, still unordered)
+_SET_RETURNING_METHODS = frozenset({"union", "intersection", "difference", "symmetric_difference", "copy"})
+
+
+def _ann_is_set(ann: ast.AST | None) -> bool:
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Subscript):
+        ann = ann.value
+    return dotted_name(ann).rsplit(".", 1)[-1] in _SET_ANN_NAMES
+
+
+def _set_expr(node: ast.AST, setnames, self_attrs=frozenset()) -> bool:
+    """Statically set-typed expression of non-literal origin. Literal
+    `{a, b}` displays are the author's explicit enumeration and stay exempt;
+    everything reaching here iterates in hash order."""
+    if isinstance(node, ast.Name):
+        return node.id in setnames
+    if isinstance(node, ast.SetComp):
+        return True
+    if isinstance(node, ast.Attribute):
+        return isinstance(node.value, ast.Name) and node.value.id == "self" and node.attr in self_attrs
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in ("set", "frozenset"):
+            return True
+        return (
+            isinstance(f, ast.Attribute)
+            and f.attr in _SET_RETURNING_METHODS
+            and _set_expr(f.value, setnames, self_attrs)
+        )
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+        return _set_expr(node.left, setnames, self_attrs) or _set_expr(node.right, setnames, self_attrs)
+    if isinstance(node, ast.IfExp):
+        return _set_expr(node.body, setnames, self_attrs) or _set_expr(node.orelse, setnames, self_attrs)
+    return False
+
+
+def _set_names(scope: ast.AST) -> set[str]:
+    """Names of one scope that are set-typed on EVERY binding (the same
+    flow-insensitive discipline as SharedArrayMutationRule's alias pass),
+    grown to a fixpoint so `a = set(x); b = a | other` resolves."""
+    entries: dict[str, list] = {}
+
+    def note(name: str, kind: str, value=None):
+        entries.setdefault(name, []).append((kind, value))
+
+    if isinstance(scope, _SCOPE_KINDS):
+        a = scope.args
+        for arg in [*a.posonlyargs, *a.args, *a.kwonlyargs, a.vararg, a.kwarg]:
+            if arg is not None:
+                note(arg.arg, "set" if _ann_is_set(arg.annotation) else "other")
+    for n in _walk_scope(scope):
+        if isinstance(n, ast.Assign):
+            if len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
+                note(n.targets[0].id, "expr", n.value)
+            else:
+                for t in n.targets:
+                    for leaf in _flat_targets(t):
+                        if isinstance(leaf, ast.Name):
+                            note(leaf.id, "other")
+        elif isinstance(n, ast.AnnAssign) and isinstance(n.target, ast.Name):
+            if _ann_is_set(n.annotation):
+                note(n.target.id, "set")
+            elif n.value is not None:
+                note(n.target.id, "expr", n.value)
+            else:
+                note(n.target.id, "other")
+        elif isinstance(n, ast.AugAssign):
+            # |=, &=, -=, ^= are kind-preserving on sets: no note, so
+            # `s = set(); s |= more` keeps `s` set-typed
+            if not isinstance(n.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+                for leaf in _flat_targets(n.target):
+                    if isinstance(leaf, ast.Name):
+                        note(leaf.id, "other")
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            for leaf in _flat_targets(n.target):
+                if isinstance(leaf, ast.Name):
+                    note(leaf.id, "other")
+        elif isinstance(n, ast.comprehension):
+            for leaf in _flat_targets(n.target):
+                if isinstance(leaf, ast.Name):
+                    note(leaf.id, "other")
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            for leaf in _flat_targets(n.optional_vars):
+                if isinstance(leaf, ast.Name):
+                    note(leaf.id, "other")
+
+    setnames: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, ents in entries.items():
+            if name in setnames:
+                continue
+            if ents and all(
+                kind == "set" or (kind == "expr" and _set_expr(value, setnames)) for kind, value in ents
+            ):
+                setnames.add(name)
+                changed = True
+    return setnames
+
+
+class UnorderedIterationEscapeRule(Rule):
+    name = "unordered-iteration-escape"
+    description = "set/frozenset iteration (or id()-keyed ordering) escaping into ordered solver outputs"
+
+    # callees that materialize/expose their argument's iteration order
+    _ORDER_SENSITIVE_FUNCS = frozenset({"list", "tuple", "enumerate", "iter", "reversed", "map", "zip", "filter"})
+    _ORDER_SENSITIVE_TAILS = frozenset({"array", "asarray", "fromiter", "fromkeys", "join", "extend"})
+    # order-insensitive consumers: a generator over a set feeding one of
+    # these never lands hash order in an output
+    _ORDER_OK_FUNCS = frozenset({"sorted", "set", "frozenset", "sum", "len", "any", "all", "min", "max", "bool", "fsum", "stable_host_sum"})
+
+    SELF_TEST_BAD = (
+        "def decode(enc):\n"
+        "    pending = set(enc.pending)\n"
+        "    order = []\n"
+        "    for p in pending:\n"
+        "        order.append(p)\n"
+        "    return order\n"
+    )
+    SELF_TEST_OK = (
+        "def decode(enc):\n"
+        "    pending = set(enc.pending)\n"
+        "    order = []\n"
+        "    for p in sorted(pending):\n"
+        "        order.append(p)\n"
+        "    for p in pending:  # solverlint: ok(unordered-iteration-escape): self-test snippet, never imported\n"
+        "        order.append(p)\n"
+        "    return order\n"
+    )
+
+    def globs(self, config):
+        return config.det_modules
+
+    @staticmethod
+    def _id_key(key: ast.AST) -> bool:
+        if isinstance(key, ast.Name) and key.id == "id":
+            return True
+        return (
+            isinstance(key, ast.Lambda)
+            and isinstance(key.body, ast.Call)
+            and isinstance(key.body.func, ast.Name)
+            and key.body.func.id == "id"
+        )
+
+    def check(self, mod, config, root):
+        findings: list[Finding] = []
+        # per-class: self attrs set-typed on every assignment module-wide,
+        # so `self._groups = set()` in __init__ covers method bodies
+        class_attrs: dict[int, frozenset] = {}
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            per: dict[str, list] = {}
+            for n in ast.walk(cls):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                    a = _self_lock_attr(n.targets[0])
+                    if a is not None:
+                        per.setdefault(a, []).append(("expr", n.value))
+                elif isinstance(n, ast.AnnAssign):
+                    a = _self_lock_attr(n.target)
+                    if a is not None:
+                        per.setdefault(a, []).append(("set", None) if _ann_is_set(n.annotation) else ("expr", n.value))
+            attrs = frozenset(
+                a
+                for a, ents in per.items()
+                if all(k == "set" or (v is not None and _set_expr(v, frozenset())) for k, v in ents)
+            )
+            if attrs:
+                for meth in cls.body:
+                    if isinstance(meth, _SCOPE_KINDS):
+                        class_attrs[id(meth)] = attrs
+
+        suggest = "iterate sorted(...), or justify with a pragma"
+        for scope in _scopes(mod.tree):
+            setnames = _set_names(scope)
+            self_attrs = class_attrs.get(id(scope), frozenset())
+
+            def is_set(node) -> bool:
+                return _set_expr(node, setnames, self_attrs)
+
+            # generator expressions whose sole consumer is order-insensitive
+            exempt: set[int] = set()
+            for n in _walk_scope(scope):
+                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and n.func.id in self._ORDER_OK_FUNCS:
+                    for a in n.args:
+                        if isinstance(a, ast.GeneratorExp):
+                            exempt.add(id(a))
+
+            for n in _walk_scope(scope):
+                if isinstance(n, (ast.For, ast.AsyncFor)) and is_set(n.iter):
+                    findings.append(
+                        Finding(
+                            self.name,
+                            mod.relpath,
+                            n.lineno,
+                            f"for-loop iterates a set: hash order escapes into the loop body — {suggest}",
+                            span=(n.lineno, n.iter.end_lineno or n.lineno),
+                        )
+                    )
+                elif isinstance(n, (ast.ListComp, ast.GeneratorExp, ast.DictComp)):
+                    # a SetComp over a set stays unordered; list/dict/generator
+                    # comprehensions freeze the hash order into their output
+                    if isinstance(n, ast.GeneratorExp) and id(n) in exempt:
+                        continue
+                    if any(is_set(gen.iter) for gen in n.generators):
+                        findings.append(
+                            self._finding(mod, n, f"comprehension over a set freezes hash order into an ordered result — {suggest}")
+                        )
+                elif isinstance(n, ast.Call):
+                    f = n.func
+                    if isinstance(f, ast.Name) and f.id in ("sorted", "min", "max"):
+                        key = next((kw.value for kw in n.keywords if kw.arg == "key"), None)
+                        if key is not None and self._id_key(key):
+                            findings.append(
+                                self._finding(mod, n, f"{f.id}(..., key=id) orders by memory address — address order varies run to run; key on content instead")
+                            )
+                    elif isinstance(f, ast.Name) and f.id in self._ORDER_SENSITIVE_FUNCS and any(is_set(a) for a in n.args):
+                        findings.append(
+                            self._finding(mod, n, f"{f.id}() materializes a set's hash order into an ordered value — {suggest}")
+                        )
+                    elif isinstance(f, ast.Attribute) and f.attr in self._ORDER_SENSITIVE_TAILS and any(is_set(a) for a in n.args):
+                        findings.append(
+                            self._finding(mod, n, f".{f.attr}() materializes a set's hash order into an ordered value — {suggest}")
+                        )
+                    elif isinstance(f, ast.Attribute) and f.attr == "pop" and not n.args and is_set(f.value):
+                        findings.append(
+                            self._finding(mod, n, "set.pop() takes a hash-order-arbitrary element — pick by sorted order or justify with a pragma")
+                        )
+                elif isinstance(n, ast.Starred) and is_set(n.value):
+                    findings.append(
+                        self._finding(mod, n, f"*-unpacking a set materializes hash order — {suggest}")
+                    )
+                elif isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(n.targets[0], (ast.Tuple, ast.List)) and is_set(n.value):
+                    findings.append(
+                        self._finding(mod, n, "unpacking a set binds hash-order-arbitrary elements — sort first")
+                    )
+        return findings
+
+
+class WallclockRngRule(Rule):
+    name = "wallclock-and-rng-in-solve-path"
+    description = "wallclock read or unseeded randomness reachable from the solve path"
+
+    _TIME_FUNCS = frozenset({
+        "time", "time_ns", "perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns",
+        "process_time", "process_time_ns", "thread_time", "thread_time_ns",
+        "clock_gettime", "localtime", "gmtime", "ctime",
+    })
+    _DATETIME_FUNCS = frozenset({"now", "utcnow", "today"})
+    _UUID_FUNCS = frozenset({"uuid1", "uuid4"})
+    # constructors that are deterministic WHEN handed an explicit seed
+    _SEEDED_WITH_ARG = frozenset({"Random", "default_rng", "RandomState", "seed", "SeedSequence", "Generator", "PRNGKey"})
+
+    SELF_TEST_BAD = (
+        "import random as rnd\n"
+        "def tiebreak(order):\n"
+        "    rnd.shuffle(order)\n"
+        "    return order\n"
+    )
+    SELF_TEST_OK = (
+        "import random as rnd\n"
+        "def tiebreak(order, seed):\n"
+        "    rng = rnd.Random(seed)\n"
+        "    rng.shuffle(order)\n"
+        "    rnd.shuffle(order)  # solverlint: ok(wallclock-and-rng-in-solve-path): self-test snippet, never imported\n"
+        "    return order\n"
+    )
+
+    def globs(self, config):
+        return config.solve_path_modules
+
+    def check(self, mod, config, root):
+        findings: list[Finding] = []
+        tree = mod.tree
+        tm = _import_table(tree, "time")
+        rd = _import_table(tree, "random")
+        uu = _import_table(tree, "uuid")
+        sec = _import_table(tree, "secrets")
+        dt = _import_table(tree, "datetime")
+        np_mods, np_names = _import_table(tree, "numpy")
+        npr_mods, npr_names = _import_table(tree, "numpy.random")
+        # names the numpy.random MODULE itself is bound to (import numpy.random
+        # as npr / from numpy import random as nr)
+        npr_aliases = set(npr_mods) | {local for local, attr in np_names.items() if attr == "random"}
+
+        def flag(call, what):
+            findings.append(self._finding(mod, call, what))
+
+        for call in [n for n in ast.walk(tree) if isinstance(n, ast.Call)]:
+            name = dotted_name(call.func)
+            if not name:
+                continue
+            if callee_matches(call.func, config.seeded_rng):
+                continue  # the reviewed seeded-RNG registry
+            parts = name.split(".")
+            tail = parts[-1]
+            seeded = tail in self._SEEDED_WITH_ARG and bool(call.args or call.keywords)
+
+            if _module_construct(call, *tm) in self._TIME_FUNCS:
+                flag(call, f"{name}() reads the wallclock on the solve path — solve inputs must be replay-stable; take time from the injected clock seam or justify with a pragma")
+            elif (len(parts) == 2 and parts[0] in rd[0]) or (len(parts) == 1 and parts[0] in rd[1]):
+                resolved = rd[1].get(parts[0], tail) if len(parts) == 1 else tail
+                if not (resolved in self._SEEDED_WITH_ARG and bool(call.args or call.keywords)) or resolved == "SystemRandom":
+                    flag(call, f"{name}() draws unseeded randomness on the solve path — seed it explicitly or register the producer in [tool.solverlint] seeded-rng")
+            elif (len(parts) >= 3 and parts[0] in np_mods and parts[1] == "random") or (len(parts) >= 2 and parts[0] in npr_aliases):
+                if not seeded:
+                    flag(call, f"{name}() draws from numpy's global/unseeded RNG on the solve path — use a seeded default_rng(seed) or register in seeded-rng")
+            elif len(parts) == 1 and parts[0] in npr_names:
+                if not (npr_names[parts[0]] in self._SEEDED_WITH_ARG and bool(call.args or call.keywords)):
+                    flag(call, f"{name}() (from numpy.random) draws unseeded randomness on the solve path")
+            elif _module_construct(call, *uu) in self._UUID_FUNCS:
+                flag(call, f"{name}() mints a nondeterministic id on the solve path — derive ids from solve inputs (uuid5 over content, or a counter) or justify with a pragma")
+            elif _module_construct(call, *sec) is not None:
+                flag(call, f"{name}() reads OS entropy on the solve path — never replay-stable")
+            elif tail in self._DATETIME_FUNCS and (
+                (len(parts) >= 3 and parts[0] in dt[0]) or (len(parts) == 2 and dt[1].get(parts[0]) in ("datetime", "date"))
+            ):
+                flag(call, f"{name}() reads the wallclock on the solve path — take time from the injected clock seam")
+        return findings
+
+
+class FloatReductionOrderRule(Rule):
+    name = "float-reduction-order"
+    description = "order-sensitive float accumulation not routed through a canonical-order helper"
+
+    SELF_TEST_BAD = (
+        "def total(ts, items):\n"
+        "    takes = greedy_pack_grouped_sharded(ts, items)\n"
+        "    return sum(takes)\n"
+    )
+    SELF_TEST_OK = (
+        "import math\n"
+        "def total(ts, items):\n"
+        "    takes = greedy_pack_grouped_sharded(ts, items)\n"
+        "    a = math.fsum(takes)\n"
+        "    b = sum(sorted(takes))\n"
+        "    c = sum(takes)  # solverlint: ok(float-reduction-order): self-test snippet, never imported\n"
+        "    return a + b + c\n"
+    )
+
+    def globs(self, config):
+        return config.float_order_modules
+
+    def check(self, mod, config, root):
+        findings: list[Finding] = []
+        helpers = ", ".join(config.canonical_reduce_helpers)
+        for scope in _scopes(mod.tree):
+            setnames = _set_names(scope)
+            # the HostSyncRule taint discipline: names assigned from device
+            # producers, plus one fixpoint pass for name-to-name copies
+            tainted: set[str] = set()
+            copies: list[tuple[str, str]] = []
+            for n in _walk_scope(scope):
+                if not isinstance(n, ast.Assign):
+                    continue
+                if isinstance(n.value, ast.Call) and callee_matches(n.value.func, config.device_producers):
+                    for t in n.targets:
+                        for leaf in _flat_targets(t):
+                            if isinstance(leaf, ast.Name):
+                                tainted.add(leaf.id)
+                elif isinstance(n.value, ast.Name) and len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
+                    copies.append((n.targets[0].id, n.value.id))
+            changed = True
+            while changed:
+                changed = False
+                for dst, src in copies:
+                    if src in tainted and dst not in tainted:
+                        tainted.add(dst)
+                        changed = True
+
+            def device_expr(node) -> bool:
+                if isinstance(node, ast.Name):
+                    return node.id in tainted
+                if isinstance(node, ast.Call) and callee_matches(node.func, config.device_producers):
+                    return True
+                return any(device_expr(child) for child in ast.iter_child_nodes(node))
+
+            for n in _walk_scope(scope):
+                if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and n.func.id == "sum" and n.args):
+                    continue
+                arg = n.args[0]
+                if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name) and arg.func.id == "sorted":
+                    continue  # canonical order imposed in place
+                unordered = _set_expr(arg, setnames) or (
+                    isinstance(arg, (ast.GeneratorExp, ast.ListComp))
+                    and any(_set_expr(gen.iter, setnames) for gen in arg.generators)
+                )
+                if device_expr(arg):
+                    findings.append(
+                        self._finding(mod, n, f"builtin sum() folds device-derived floats in argument order — float addition does not commute bitwise; route through a canonical-order helper ({helpers}) or sum(sorted(...))")
+                    )
+                elif unordered:
+                    findings.append(
+                        self._finding(mod, n, f"builtin sum() folds floats in set hash order — route through a canonical-order helper ({helpers}) or sum(sorted(...))")
+                    )
+        return findings
+
+
+class EnvDependentBranchRule(Rule):
+    name = "env-dependent-branch"
+    description = "os.environ read outside the registered KARPENTER_* knob table"
+
+    SELF_TEST_BAD = (
+        "import os as o\n"
+        "def pick_mode():\n"
+        '    return o.environ.get("KARPENTER_SOLVER_SECRET", "")\n'
+    )
+    SELF_TEST_OK = (
+        "import os\n"
+        "def pick_mode():\n"
+        '    a = os.environ.get("KARPENTER_SOLVER_MESH", "")\n'
+        '    b = os.getenv("KARPENTER_SOLVER_BUCKET")\n'
+        '    c = os.environ.get("KARPENTER_SOLVER_SECRET", "")  # solverlint: ok(env-dependent-branch): self-test snippet, never imported\n'
+        "    return a + (b or \"\") + c\n"
+    )
+
+    def globs(self, config):
+        return config.solve_path_modules
+
+    def check(self, mod, config, root):
+        findings: list[Finding] = []
+        mods, names = _import_table(mod.tree, "os")
+        knobs = set(config.env_knobs)
+
+        def environ_expr(node) -> bool:
+            if isinstance(node, ast.Attribute) and node.attr == "environ":
+                return isinstance(node.value, ast.Name) and node.value.id in mods
+            return isinstance(node, ast.Name) and names.get(node.id) == "environ"
+
+        def check_key(node, key):
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                if key.value not in knobs:
+                    findings.append(
+                        self._finding(mod, node, f"env knob {key.value!r} is not in the registered knob table ([tool.solverlint] env-knobs) — an unregistered env probe can fork behavior between shard workers; register it or justify with a pragma")
+                    )
+            else:
+                findings.append(
+                    self._finding(mod, node, "os.environ read with a non-literal key — the knob table cannot review dynamic env probes; use a literal registered knob or justify with a pragma")
+                )
+
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Call):
+                f = n.func
+                if _module_construct(n, mods, names) == "getenv":
+                    check_key(n, n.args[0] if n.args else None)
+                elif isinstance(f, ast.Attribute) and f.attr in ("get", "pop", "setdefault") and environ_expr(f.value):
+                    check_key(n, n.args[0] if n.args else None)
+                elif isinstance(f, ast.Attribute) and f.attr in ("items", "keys", "values", "copy") and environ_expr(f.value):
+                    findings.append(
+                        self._finding(mod, n, "bulk os.environ read on the solve path — enumerate registered knobs explicitly instead")
+                    )
+            elif isinstance(n, ast.Subscript) and environ_expr(n.value):
+                check_key(n, n.slice)
+            elif isinstance(n, ast.Compare) and any(isinstance(op, (ast.In, ast.NotIn)) for op in n.ops):
+                if any(environ_expr(c) for c in n.comparators):
+                    check_key(n, n.left)
+        return findings
+
+
+class StalePragmaRule(Rule):
+    name = "stale-pragma"
+    description = "a suppression pragma that no longer suppresses any finding"
+
+    SELF_TEST_SHARED_FIELDS = frozenset({"sig_req"})
+    SELF_TEST_BAD = (
+        "def f(enc):\n"
+        "    x = 1  # solverlint: ok(shared-array-mutation): suppresses nothing here — a dead pragma\n"
+        "    return x\n"
+    )
+    SELF_TEST_OK = (
+        "def f(enc):\n"
+        "    enc.sig_req[0] = 1.0  # solverlint: ok(shared-array-mutation): live suppression — the pragma is load-bearing\n"
+        "    return enc\n"
+    )
+
+    def globs(self, config):
+        # standalone mode (--rule stale-pragma / fixture runs) re-derives
+        # pragma usage by running every other rule on the module; the full
+        # scan instead uses the driver's cheap post-pass over already-marked
+        # modules (see core.run_analysis)
+        return ("karpenter_tpu/**/*.py",)
+
+    def check(self, mod, config, root):
+        from .core import stale_pragma_findings
+
+        for name, cls in RULES.items():
+            if name == self.name:
+                continue
+            rule = cls()
+            for f in rule.check(mod, config, root):
+                mod.suppressed(f)  # marks pragma usage; the findings belong to the other rules
+        return stale_pragma_findings(mod, set(RULES))
+
+
 RULES: dict[str, type[Rule]] = {
     cls.name: cls
     for cls in (
@@ -1250,5 +1789,10 @@ RULES: dict[str, type[Rule]] = {
         ThreadEscapeRule,
         BareThreadPrimitiveRule,
         SwallowedExceptionRule,
+        UnorderedIterationEscapeRule,
+        WallclockRngRule,
+        FloatReductionOrderRule,
+        EnvDependentBranchRule,
+        StalePragmaRule,
     )
 }

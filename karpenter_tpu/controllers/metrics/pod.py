@@ -29,7 +29,7 @@ class PodMetricsController:
             key = pod.key()
             live.add(key)
             created = pod.metadata.creation_timestamp
-            state.set(1, name=pod.metadata.name, namespace=pod.metadata.namespace, phase=pod.status.phase)
+            state.set(1, name=pod.metadata.name, namespace=pod.metadata.namespace, phase=pod.status.phase)  # solverlint: ok(metric-label-cardinality): phase is the k8s PodPhase enum (Pending/Running/Succeeded/Failed/Unknown) — bounded by the API contract, not by this module
             if not pod.spec.node_name:
                 unbound.set(self.clock.now() - created, name=pod.metadata.name, namespace=pod.metadata.namespace)
                 continue

@@ -103,7 +103,7 @@ class Provisioner:
                     self.metrics.counter(m.SOLVER_CHURN_COALESCED_TOTAL).inc(coalesced, tenant=self.tenant)  # solverlint: ok(metric-label-cardinality): tenant is a serving.fleet.tenant_label() output stored at fleet registration — the bounded fleet enum ("" outside a fleet)
                 self.metrics.histogram(m.SOLVER_CHURN_EVENTS_PER_SOLVE).observe(float(events), tenant=self.tenant)  # solverlint: ok(metric-label-cardinality): tenant is a serving.fleet.tenant_label() output stored at fleet registration — the bounded fleet enum ("" outside a fleet)
                 # depth AFTER the solve: the coalesced generation still queued
-                self.metrics.gauge(m.SOLVER_CHURN_QUEUE_DEPTH).set(self.batcher.pending(), tenant=self.tenant)
+                self.metrics.gauge(m.SOLVER_CHURN_QUEUE_DEPTH).set(self.batcher.pending(), tenant=self.tenant)  # solverlint: ok(metric-label-cardinality): tenant is a serving.fleet.tenant_label() output stored at fleet registration — the bounded fleet enum ("" outside a fleet)
         return results
 
     # -- the provisioning pass (provisioner.go:350-458) ------------------------

@@ -1507,7 +1507,7 @@ def _sim_inverse_blocks(enc: EncodedSnapshot, masked: EncodedSnapshot, entries):
         narrowed = True
     # per-key sentinel: viable only while some registered real domain of the
     # key survives the pod's own requirements and every entry's blocking
-    for s, k in matched_keys:
+    for s, k in sorted(matched_keys):
         keydoms = dko == k
         keydoms[k] = False
         if not (sda[s] & keydoms).any():
@@ -1813,7 +1813,7 @@ def _apply_inverse_anti_blocks(entries, rep_pods, rows, sig_dom_allowed, n_exist
             matched_keys.add((s, k))
     # per-key sentinel: viable only while some registered real domain of the
     # key survives the pod's own requirements and every entry's blocking
-    for s, k in matched_keys:
+    for s, k in sorted(matched_keys):
         keydoms = dko == k
         keydoms[rows.dom_sentinel[k]] = False
         if not (sig_dom_allowed[s] & keydoms).any():
@@ -2984,7 +2984,7 @@ def _build_rows(snap, rnames: list[str], rl_to_vec, dom_keys: list[str]) -> _Row
     # domain axis is closed now
     D = len(dom_values)
     universe_dom = np.zeros(D, dtype=bool)
-    for d in universe_ids:
+    for d in sorted(universe_ids):
         universe_dom[d] = True
 
     rank_domset = np.zeros((n_ranks, D), dtype=bool)
@@ -3092,7 +3092,13 @@ def encode(snap, cache: EncodeCache | None = None) -> EncodedSnapshot:
         scan_pods = ()
     else:
         scan_pods = snap.pods
-    for pod in scan_pods:  # solverlint: ok(python-loop-over-pod-axis): THE one sanctioned O(P) pass — cheap signature-tuple interning only (stamped pods are one attribute read), and the stamped common case bypasses it entirely via _columnar_group; every heavy lowering below runs per unique signature
+    # THE one sanctioned O(P) pass — cheap signature-tuple interning only
+    # (stamped pods are one attribute read), and the stamped common case
+    # bypasses it entirely via _columnar_group; every heavy lowering below
+    # runs per unique signature. The `scan_pods` alias sits outside the
+    # pod-axis rule's name list on purpose: a direct `snap.pods` walk added
+    # later still trips the gate.
+    for pod in scan_pods:
         if use_stamp:
             st = getattr(pod, "_sig_stamp", None)
             if st is not None and st.rv == pod.metadata.resource_version:

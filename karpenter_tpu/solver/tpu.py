@@ -399,7 +399,35 @@ class TPUSolver:
         recorder, stamps the JIT-recompile delta and the exit path's
         mode/backend/attribution, and commits the trace in the finally — so
         even a raising solve leaves a record. Recording never influences the
-        result (tests pin bit-identical placements tracing on vs off)."""
+        result (tests pin bit-identical placements tracing on vs off).
+
+        Under ``KARPENTER_SOLVER_DETCHECK=1`` every solve additionally
+        records a replayable dump of its inputs plus its placement digest
+        for `check_determinism` (obs/detcheck.py); with the env var off the
+        seam is one cached-bool read."""
+        from ..obs.detcheck import detcheck_enabled
+
+        if not detcheck_enabled():
+            return self._solve_flight(snap)
+        from ..obs import detcheck
+
+        blob = detcheck.dump_snapshot(snap, detcheck.solve_log(self).token_of)
+        results = self._solve_flight(snap)
+        detcheck.record_solve(self, blob, results)
+        return results
+
+    def check_determinism(self, clear: bool = True) -> dict:
+        """The dual-run determinism sanitizer: replay every recorded solve
+        (KARPENTER_SOLVER_DETCHECK=1) in a subprocess under a perturbed
+        PYTHONHASHSEED with every dict/set insertion order adversarially
+        reversed, and compare placement digests. Raises
+        `obs.detcheck.DetCheckError` on any divergence; returns the summary
+        (digests, parent/child modes, child hash seed) on success."""
+        from ..obs import detcheck
+
+        return detcheck.run_dual(self, clear=clear)
+
+    def _solve_flight(self, snap: SolverSnapshot) -> Results:
         trace = self.recorder.begin(n_pods=len(snap.pods))
         self._trace = trace
         # reset the per-solve surfaces BEFORE the body runs: a solve that
@@ -1504,7 +1532,7 @@ class TPUSolver:
             self._count(SOLVER_DECODE_REPAIR_TOTAL, reason="min-values")
             self._trace.note(repair_pods=len(repair_pods), repair_sigs=len(repair_sigs), repair_reason="min-values")
             keep = np.ones(enc.n_sigs, dtype=bool)
-            keep[list(repair_sigs)] = False
+            keep[sorted(repair_sigs)] = False
             results = solve_residual(
                 snap, repair_pods, results,
                 seam_records=self._seam_records(enc, keep, results, require_cross=False, all_kinds=True),

@@ -1,0 +1,55 @@
+"""solverlint fixture: float-reduction-order. Never imported — parsed only.
+
+Seeds order-sensitive float folds: builtin sum() over device-derived values
+and over set hash order. The canonical-order twins (math.fsum,
+stable_host_sum, sum(sorted(...))) and the pragma'd twin must NOT be
+flagged.
+"""
+
+import math
+
+
+def bad_device_fold(ts, items):
+    takes = greedy_pack_grouped_sharded(ts, items)  # noqa: F821 — fixture, parsed only
+    return sum(takes)
+
+
+def bad_device_fold_via_copy(ts, items):
+    takes = greedy_pack_grouped_sharded(ts, items)  # noqa: F821
+    parts = takes
+    return sum(parts)
+
+
+def bad_set_order_fold(costs):
+    pool = set(costs)
+    return sum(pool)
+
+
+def bad_genexp_over_set(rows):
+    pool = set(rows)
+    return sum(r.cost for r in pool)
+
+
+def ok_fsum(ts, items):
+    takes = greedy_pack_grouped_sharded(ts, items)  # noqa: F821
+    return math.fsum(takes)
+
+
+def ok_canonical_helper(ts, items):
+    takes = greedy_pack_grouped_sharded(ts, items)  # noqa: F821
+    return stable_host_sum(takes)  # noqa: F821
+
+
+def ok_sorted_fold(ts, items):
+    takes = greedy_pack_grouped_sharded(ts, items)  # noqa: F821
+    return sum(sorted(takes))
+
+
+def ok_host_only_fold(weights):
+    # a plain host list in its given order is deterministic — not flagged
+    return sum(weights)
+
+
+def ok_pragma(ts, items):
+    takes = greedy_pack_grouped_sharded(ts, items)  # noqa: F821
+    return sum(takes)  # solverlint: ok(float-reduction-order): fixture — proves the pragma form suppresses

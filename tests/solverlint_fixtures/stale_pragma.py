@@ -1,0 +1,22 @@
+"""solverlint fixture: stale-pragma. Never imported — parsed only.
+
+Seeds dead suppressions: a pragma whose finding no longer exists, and a
+pragma naming a rule that was never registered. The load-bearing pragma
+(one that suppresses a live finding) must NOT be reported.
+"""
+
+
+def stale_suppression(enc):
+    # the mutation this pragma once excused was refactored away; the pragma
+    # rotted in place — exactly what the rule reports
+    x = enc.read_only_view()  # solverlint: ok(shared-array-mutation): nothing left to suppress here
+    return x
+
+
+def unknown_rule(enc):
+    return enc.x  # solverlint: ok(rule-that-never-existed): names a rule that is not registered
+
+
+def live_suppression(enc):
+    enc.sig_req[0] = 1.0  # solverlint: ok(shared-array-mutation): load-bearing — suppresses a real finding, must not be reported
+    return enc

@@ -1,0 +1,86 @@
+"""solverlint fixture: unordered-iteration-escape. Never imported — parsed only.
+
+Seeds the hash-order escape routes: a for-loop over a set, a list()
+materialization, a comprehension freezing set order, set.pop(), an
+id()-keyed sort, and *-unpacking. The sorted()/order-insensitive twins and
+the pragma'd twin must NOT be flagged.
+"""
+
+
+def bad_for_loop(enc):
+    pending = set(enc.pending)
+    order = []
+    for p in pending:
+        order.append(p)
+    return order
+
+
+def bad_list_materialize(enc):
+    sigs = frozenset(enc.sigs)
+    return list(sigs)
+
+
+def bad_comprehension(enc):
+    domains = set(enc.domains)
+    return [d.name for d in domains]
+
+
+def bad_set_pop(enc):
+    pending = set(enc.pending)
+    return pending.pop()
+
+
+def bad_id_key(rows):
+    return sorted(rows, key=id)
+
+
+def bad_star_unpack(enc):
+    sigs = set(enc.sigs)
+    return [*sigs]
+
+
+def bad_aliased_union(enc):
+    # set-typedness flows through the | operator and name copies
+    a = set(enc.a)
+    b = a | set(enc.b)
+    for x in b:
+        yield x
+
+
+def bad_self_attr(enc):
+    class Walker:
+        def __init__(self):
+            self._groups = set()
+
+        def emit(self):
+            return list(self._groups)
+
+    return Walker
+
+
+def ok_sorted(enc):
+    pending = set(enc.pending)
+    order = []
+    for p in sorted(pending):
+        order.append(p)
+    return order
+
+
+def ok_order_insensitive(enc):
+    pending = set(enc.pending)
+    # membership, len, min/max and order-insensitive folds never leak order
+    total = len(pending) + min(pending) + max(pending)
+    covered = all(p in pending for p in enc.required)
+    return total, covered, frozenset(pending)
+
+
+def ok_literal_display(enc):
+    # a literal display is the author's explicit enumeration — exempt
+    for kind in {"cpu", "tpu"}:
+        enc.note(kind)
+
+
+def ok_pragma(enc):
+    pending = set(enc.pending)
+    for p in pending:  # solverlint: ok(unordered-iteration-escape): fixture — proves the pragma form suppresses
+        enc.note(p)

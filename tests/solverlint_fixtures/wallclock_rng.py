@@ -1,0 +1,69 @@
+"""solverlint fixture: wallclock-and-rng-in-solve-path. Never imported — parsed only.
+
+Seeds wallclock reads and unseeded randomness through every import shape
+the rule resolves (the PR 11 `import threading as t` alias pattern applied
+to time/random/numpy.random/uuid). Seeded constructors and the jax.random
+key-passing API must NOT be flagged.
+"""
+
+import random as rnd
+import time as clk
+import uuid
+from random import shuffle as sneaky_shuffle
+from time import perf_counter
+
+import jax.random as jr
+import numpy as np
+
+
+def bad_wallclock():
+    return clk.time()
+
+
+def bad_from_import_wallclock():
+    return perf_counter()
+
+
+def bad_module_rng(order):
+    rnd.shuffle(order)
+    return order
+
+
+def bad_from_import_rng(order):
+    # a renamed from-import must not evade the solve-path RNG check
+    sneaky_shuffle(order)
+    return order
+
+
+def bad_unseeded_random_ctor():
+    return rnd.Random()
+
+
+def bad_numpy_global_rng(n):
+    return np.random.rand(n)
+
+
+def bad_numpy_unseeded_default_rng():
+    return np.random.default_rng()
+
+
+def bad_uuid(claim):
+    return f"{claim}-{uuid.uuid4()}"
+
+
+def ok_seeded(order, seed):
+    rng = rnd.Random(seed)
+    rng.shuffle(order)
+    gen = np.random.default_rng(seed)
+    return gen.random()
+
+
+def ok_jax_keyed(seed):
+    # jax.random is deterministic by construction: randomness flows from an
+    # explicit key, never ambient state (the seeded-rng registry entry)
+    key = jr.PRNGKey(seed)
+    return jr.uniform(key)
+
+
+def ok_pragma():
+    return clk.time()  # solverlint: ok(wallclock-and-rng-in-solve-path): fixture — proves the pragma form suppresses

@@ -1,8 +1,9 @@
 """Tier-1 gate for solverlint (ISSUE 4 + the ISSUE 11 concurrency rules +
-the ISSUE 15 swallowed-exception rule): the repo is clean under all ten
-rules, each rule catches its seeded fixture violation and honors the pragma
-suppression form, the --self-test discovery gate is healthy, and the runtime
-shape contracts (solver/contracts.py) catch seeded drifts."""
+the ISSUE 15 swallowed-exception rule + the ISSUE 19 determinism rules):
+the repo is clean under all fifteen rules, each rule catches its seeded
+fixture violation and honors the pragma suppression form, the --self-test
+discovery gate is healthy, and the runtime shape contracts
+(solver/contracts.py) catch seeded drifts."""
 
 import os
 from pathlib import Path
@@ -45,7 +46,7 @@ class TestRepoGate:
         assert lint_main([str(tmp_path)]) == 2
 
     def test_rule_registry_holds_all_rules(self):
-        assert len(RULES) >= 10
+        assert len(RULES) >= 15
         assert set(RULES) == {
             "shared-array-mutation",
             "host-sync-in-hot-path",
@@ -57,6 +58,11 @@ class TestRepoGate:
             "thread-escape",
             "bare-thread-primitive",
             "swallowed-exception",
+            "unordered-iteration-escape",
+            "wallclock-and-rng-in-solve-path",
+            "float-reduction-order",
+            "env-dependent-branch",
+            "stale-pragma",
         }
 
     def test_shared_field_registry_extraction(self):
@@ -180,6 +186,115 @@ class TestRuleFixtures:
         # threading.local is exempt by design
         assert "threading.local" not in msgs
 
+    def test_unordered_iteration_escape(self):
+        findings = _fixture_findings("unordered-iteration-escape", "unordered_iter.py")
+        assert len(findings) == 8, findings
+        msgs = " | ".join(f.message for f in findings)
+        assert "for-loop iterates a set" in msgs
+        assert "list() materializes" in msgs
+        assert "comprehension over a set" in msgs
+        assert "set.pop()" in msgs
+        assert "key=id" in msgs
+        assert "*-unpacking" in msgs
+        src = (FIXTURES / "unordered_iter.py").read_text().splitlines()
+        lines = {f.line for f in findings}
+        # set-typedness flows through the | operator and name copies...
+        assert any("for x in b:" in src[ln - 1] for ln in lines)
+        # ...and through self-attributes initialized as set() in __init__
+        assert any("self._groups" in src[ln - 1] for ln in lines)
+        # the sorted/order-insensitive/literal-display twins stay clean
+        for ln, text in enumerate(src, 1):
+            if "def ok_" in text:
+                assert all(f.line < ln for f in findings), (ln, findings)
+
+    def test_wallclock_rng(self):
+        findings = _fixture_findings("wallclock-and-rng-in-solve-path", "wallclock_rng.py")
+        assert len(findings) == 8, findings
+        msgs = " | ".join(f.message for f in findings)
+        # the alias-import pattern (PR 11's `import threading as t`, applied
+        # to time/random): renamed modules and renamed from-imports resolve
+        assert "clk.time()" in msgs
+        assert "perf_counter()" in msgs
+        assert "rnd.shuffle()" in msgs
+        assert "sneaky_shuffle()" in msgs
+        # unseeded constructors are flagged; the seeded twins are not
+        assert "rnd.Random()" in msgs
+        assert "np.random.default_rng()" in msgs
+        assert "np.random.rand()" in msgs
+        assert "uuid.uuid4()" in msgs
+        src = (FIXTURES / "wallclock_rng.py").read_text().splitlines()
+        for f in findings:
+            assert "ok_" not in src[f.line - 1], f
+
+    def test_float_reduction_order(self):
+        findings = _fixture_findings("float-reduction-order", "float_order.py")
+        assert len(findings) == 4, findings
+        msgs = " | ".join(f.message for f in findings)
+        assert "device-derived" in msgs
+        assert "set hash order" in msgs
+        # the message names the registered canonical-order helpers
+        assert "fsum" in msgs and "stable_host_sum" in msgs
+        src = (FIXTURES / "float_order.py").read_text().splitlines()
+        # taint flows through name copies; the fsum/sorted/host-only twins
+        # and the pragma'd twin stay clean
+        assert any("sum(parts)" in src[f.line - 1] for f in findings)
+        for f in findings:
+            assert "bad_" in src[f.line - 1] or src[f.line - 1].strip().startswith("return"), f
+
+    def test_env_dependent_branch(self):
+        findings = _fixture_findings("env-dependent-branch", "env_branch.py")
+        assert len(findings) == 8, findings
+        msgs = " | ".join(f.message for f in findings)
+        # unregistered literal knobs are named; the alias import
+        # (`import os as sneaky_os`) and from-imported environ/getenv resolve
+        assert "'KARPENTER_SOLVER_SECRET'" in msgs
+        assert "'SOLVER_EXPERIMENT'" in msgs
+        assert "'SOLVER_FORK_BEHAVIOR'" in msgs
+        assert "'SOLVER_TUNING'" in msgs
+        assert "non-literal key" in msgs
+        assert "bulk os.environ read" in msgs
+        src = (FIXTURES / "env_branch.py").read_text().splitlines()
+        lines = {f.line for f in findings}
+        # the registered KARPENTER_* knobs and the pragma'd twin stay clean
+        assert not any("KARPENTER_SOLVER_MESH" in src[ln - 1] for ln in lines)
+        assert not any("KARPENTER_SOLVER_DETCHECK" in src[ln - 1] for ln in lines)
+
+    def test_stale_pragma(self):
+        findings = _fixture_findings("stale-pragma", "stale_pragma.py")
+        assert len(findings) == 2, findings
+        msgs = sorted(f.message for f in findings)
+        assert any("no longer suppresses any finding" in m for m in msgs)
+        assert any("unknown rule 'rule-that-never-existed'" in m for m in msgs)
+        # the load-bearing pragma (suppressing a live shared-array-mutation
+        # finding) is NOT reported
+        src = (FIXTURES / "stale_pragma.py").read_text().splitlines()
+        for f in findings:
+            assert "live_suppression" not in src[f.line - 1]
+            assert "sig_req" not in src[f.line - 1]
+
+    def test_stale_pragma_in_full_scan_mode(self, tmp_path):
+        # the default-scan path (rules=None) reaches stale pragmas through
+        # the cheap post-pass (usage marked while the other rules ran), not
+        # the standalone rule — prove that path too. paths-only mode holds
+        # each file to the rules whose globs cover it, so mirror the repo
+        # layout under a tmp root.
+        import dataclasses
+
+        from karpenter_tpu.analysis.config import Config
+
+        p = tmp_path / "karpenter_tpu" / "obs" / "rotted.py"
+        p.parent.mkdir(parents=True)
+        p.write_text(
+            "def f(registry, why):\n"
+            '    registry.counter("m").inc(reason=why)  # solverlint: ok(metric-label-cardinality): live — suppresses the non-enumerable-label finding\n'
+            "    return registry.snapshot()  # solverlint: ok(swallowed-exception): rotted — nothing here to suppress\n"
+        )
+        cfg = dataclasses.replace(Config(), shared_fields=frozenset({"sig_req"}))
+        findings = run_analysis(root=tmp_path, config=cfg, paths=[p])
+        assert [f.rule for f in findings] == ["stale-pragma"], findings
+        assert "'swallowed-exception'" in findings[0].message
+        assert findings[0].line == 3
+
     def test_lock_order_catches_seeded_store_inversion(self, tmp_path):
         """Seeded REAL-module regressions: the store's own `_deliver_lock`
         -> `_lock` edge (the `_drain` pop) is live in the graph, so (a) an
@@ -215,6 +330,37 @@ class TestRuleFixtures:
         findings = run_analysis(rules=["lock-order"], paths=[p2])
         assert any("blocking call self._drain()" in f.message for f in findings), findings
         assert any("cycle" in f.message for f in findings), findings
+
+    def test_unordered_iter_catches_seeded_encode_reverts(self, tmp_path):
+        """Seeded REAL-module regressions pinning the detlint burn-down: the
+        canonical-order fixes (sorted matched_keys / universe_ids sentinel
+        scatters in encode.py, the sorted repair_sigs mask write in tpu.py)
+        are findings the moment any of them is reverted to raw set order."""
+        from karpenter_tpu.analysis.core import repo_root
+
+        src = (repo_root() / "karpenter_tpu" / "solver" / "encode.py").read_text()
+        unsorted_keys = src.replace("for s, k in sorted(matched_keys):", "for s, k in matched_keys:")
+        assert unsorted_keys != src
+        p = tmp_path / "encode_unsorted_keys.py"
+        p.write_text(unsorted_keys)
+        findings = run_analysis(rules=["unordered-iteration-escape"], paths=[p])
+        # the sentinel pass appears in both the row and column encoders
+        assert sum("for-loop iterates a set" in f.message for f in findings) == 2, findings
+
+        unsorted_universe = src.replace("for d in sorted(universe_ids):", "for d in universe_ids:")
+        assert unsorted_universe != src
+        p2 = tmp_path / "encode_unsorted_universe.py"
+        p2.write_text(unsorted_universe)
+        findings = run_analysis(rules=["unordered-iteration-escape"], paths=[p2])
+        assert len(findings) == 1 and "hash order" in findings[0].message, findings
+
+        tsrc = (repo_root() / "karpenter_tpu" / "solver" / "tpu.py").read_text()
+        unsorted_sigs = tsrc.replace("keep[sorted(repair_sigs)] = False", "keep[list(repair_sigs)] = False")
+        assert unsorted_sigs != tsrc
+        p3 = tmp_path / "tpu_unsorted_sigs.py"
+        p3.write_text(unsorted_sigs)
+        findings = run_analysis(rules=["unordered-iteration-escape"], paths=[p3])
+        assert len(findings) == 1 and "list() materializes" in findings[0].message, findings
 
     def test_guarded_field_catches_seeded_prestage_unguard(self, tmp_path):
         """Seeded real-module regression: the PR's original race — a
