@@ -437,6 +437,78 @@ def _median_warm_solve(snap, runs: int = 3, require_tensor: bool = False) -> flo
     return statistics.median(times)
 
 
+def _decode_hatch_arms(n_pods: int, n_types: int, steps: int = 6) -> dict:
+    """ISSUE 20 decode-delta gate arm: interleave TWO warm solvers over ONE
+    snapshot — the memo solver (KARPENTER_SOLVER_FASTDECODE=1) and the
+    exact-reference solver (=0, re-materializes every slot every solve) —
+    through `steps` one-pod removals. Self-relative by construction: both
+    arms run the same chain on the same box in the same process, so the
+    decode-phase ratio is immune to the machine drift that makes absolute
+    BENCH_rNN numbers non-portable. Also asserts the acceptance contract's
+    other two legs: bit-identical `results_digest` per step, and zero warm
+    recompiles across the measured window."""
+    from karpenter_tpu.obs.detcheck import results_digest
+    from karpenter_tpu.obs.trace import sentinel
+    from karpenter_tpu.solver.tpu import TPUSolver
+
+    snap = build_snapshot(n_pods, n_types)
+    s_on, s_off = TPUSolver(force=True), TPUSolver(force=True)
+    prev = os.environ.get("KARPENTER_SOLVER_FASTDECODE")
+
+    def _solve(solver, hatch):
+        os.environ["KARPENTER_SOLVER_FASTDECODE"] = hatch
+        r = solver.solve(snap)
+        return r, solver._trace.phase_totals.get("decode", 0.0)
+
+    dec_on = dec_off = 0.0
+    delta_steps = 0
+    parity_fail = ""
+    try:
+        _solve(s_on, "1")
+        _solve(s_off, "0")
+        snap.pods.pop()  # compiles the removal-delta kernel off the clock
+        _solve(s_on, "1")
+        _solve(s_off, "0")
+        jit_before = sentinel().snapshot()
+        for i in range(steps):
+            snap.pods.pop()
+            r_on, d_on = _solve(s_on, "1")
+            r_off, d_off = _solve(s_off, "0")
+            if not parity_fail and results_digest(r_on) != results_digest(r_off):
+                parity_fail = f"digest@step{i}"
+            if not parity_fail and s_on.last_solve_mode != s_off.last_solve_mode:
+                parity_fail = f"mode@step{i}:{s_on.last_solve_mode}/{s_off.last_solve_mode}"
+            # the decode ratio is a DELTA-path contract: a step the stale-
+            # carry fast-validate bounced to a full re-solve (documented
+            # re-warm behavior, both arms bounce identically) has no memo to
+            # measure — keep it out of both sums, count the steps that held
+            if s_on.last_solve_mode == s_off.last_solve_mode == "delta":
+                dec_on += d_on
+                dec_off += d_off
+                delta_steps += 1
+        recompiles = sentinel().delta(jit_before)
+    finally:
+        if prev is None:
+            os.environ.pop("KARPENTER_SOLVER_FASTDECODE", None)
+        else:
+            os.environ["KARPENTER_SOLVER_FASTDECODE"] = prev
+    speedup = dec_off / max(dec_on, 1e-9)
+    gate = float(os.environ.get("BENCH_DECODE_SPEEDUP_GATE", "3.0"))
+    enough = delta_steps >= max(2, steps // 2)
+    out = {
+        "decode_delta_seconds": round(dec_on, 4),
+        "decode_hatch_off_seconds": round(dec_off, 4),
+        "decode_delta_steps": delta_steps,
+        "decode_speedup": round(speedup, 2),
+        "decode_parity": "PASS" if not parity_fail else f"FAIL:{parity_fail}",
+        "decode_warm_recompiles": recompiles,
+        "decode_speedup_gate": "PASS" if speedup >= gate and enough and not parity_fail and not recompiles else "FAIL",
+    }
+    if out["decode_speedup_gate"] == "FAIL":
+        print(f"DECODE SPEEDUP GATE FAILED: {out}", file=sys.stderr)
+    return out
+
+
 def bench_removal_delta(n_pods: int, n_types: int) -> dict:
     """Steady-state churn in the REMOVAL direction (VERDICT r4 #4): warm the
     solver on the full set, then ONE pending pod leaves (it bound) — the
@@ -496,6 +568,9 @@ def bench_removal_delta(n_pods: int, n_types: int) -> dict:
         out[f"mixed_{kind}_gate"] = "PASS" if ok else "FAIL"
         if not ok:
             print(f"MIXED-CHURN {kind.upper()} GATE FAILED: {out}", file=sys.stderr)
+    # decode-delta tail (ISSUE 20): the warm delta's decode phase vs the
+    # exact-reference hatch, bit-identical and >=3x on the same chain
+    out.update(_decode_hatch_arms(n_pods, n_types))
     return out
 
 
@@ -1152,6 +1227,10 @@ def bench_churn_sustained(n_base: int, iterations: int) -> dict:
             print(f"CHURN {name.upper()} FAILED: {out}", file=sys.stderr)
     if rep.full_solve_reasons:
         print(f"churn full-solve breakdown by delta-reject reason: {rep.full_solve_reasons}", file=sys.stderr)
+    # decode-delta tail (ISSUE 20) at the churn scale: the sustained loop's
+    # hit-rate gate above says deltas are SERVED; this one says their decode
+    # phase actually got cheap (>=3x the exact-reference hatch, bit-identical)
+    out.update(_decode_hatch_arms(n_base, spec.n_types, steps=4))
     return out
 
 
@@ -2290,6 +2369,25 @@ def _build_consolidation_fleet(n_nodes: int, hetero_prices: bool = False):
     return env
 
 
+def _validate_tail_gate(trace, lp_phases) -> dict:
+    """ISSUE 20 validate-tail gate: with the ranked ladder validating the
+    WINNER only (probes share one scheduler seed; losers never see the 15s
+    Validator), the round's exact-validate phase must sit BELOW the solve
+    phase it rides on. Self-relative — both phases come from the same flight
+    record — so the gate pins the shape BENCH_r13 showed inverted (validate
+    0.72s vs LP 0.29s) without depending on that box's absolute numbers."""
+    validate = trace.phase_totals.get("validate", 0.0)
+    solve = sum(trace.phase_totals.get(p, 0.0) for p in lp_phases)
+    out = {
+        "validate_phase_seconds": round(validate, 4),
+        "solve_phase_seconds": round(solve, 4),
+        "validate_below_solve_gate": "PASS" if validate <= solve else "FAIL",
+    }
+    if out["validate_below_solve_gate"] == "FAIL":
+        print(f"VALIDATE TAIL GATE FAILED: {out}", file=sys.stderr)
+    return out
+
+
 def bench_consolidation_lp(n_nodes: int):
     """The ROADMAP 5k target: ONE full multi-node consolidation DECISION —
     relaxed-LP repack over the whole fleet, host rounding, and masked
@@ -2303,8 +2401,14 @@ def bench_consolidation_lp(n_nodes: int):
         MultiNodeConsolidation,
         _command_savings_per_hour,
     )
+    from karpenter_tpu.models.scheduler_model import reset_bucket_highwater
     from karpenter_tpu.obs.trace import sentinel
 
+    # earlier scenarios (5k/50k-pod solves) leave process-global high-water
+    # marks that would pad every masked sim probe's pack to FLEET scale — the
+    # same scenario isolation churn_sustained does; the cold round below
+    # re-establishes the round's own shape ladder
+    reset_bucket_highwater()
     env = _build_consolidation_fleet(n_nodes)
     cands = env.disruption.get_candidates()
     assert len(cands) >= n_nodes * 0.9, f"only {len(cands)} candidates"
@@ -2337,6 +2441,7 @@ def bench_consolidation_lp(n_nodes: int):
         extra["phase_split"] = {k: round(v, 4) for k, v in trace.phase_totals.items()}
         extra["sim_masked_probes"] = trace.attribution.get("sim_masked")
         extra["sim_scratch_probes"] = trace.attribution.get("sim_scratch")
+        extra.update(_validate_tail_gate(trace, lp_phases=("encode_candidates", "lp_repack", "round")))
     if n_nodes >= 5000 and best >= 5.0:
         print(f"CONSOLIDATION 5K GATE FAILED: {best:.2f}s >= 5s", file=sys.stderr)
     return best, extra
@@ -2389,8 +2494,10 @@ def bench_global_repack(n_nodes: int):
         MultiNodeConsolidation,
         _command_savings_per_hour,
     )
+    from karpenter_tpu.models.scheduler_model import reset_bucket_highwater
     from karpenter_tpu.obs.trace import sentinel
 
+    reset_bucket_highwater()  # scenario isolation — see bench_consolidation_lp
     env = _build_consolidation_fleet(n_nodes, hetero_prices=True)
     cands = env.disruption.get_candidates()
     assert len(cands) >= n_nodes * 0.9, f"only {len(cands)} candidates"
@@ -2424,6 +2531,11 @@ def bench_global_repack(n_nodes: int):
         "objective_gate": "PASS" if savings_global >= savings_two_phase - 1e-6 else "FAIL",
         "gate": "PASS" if best < 5.0 or n_nodes < 5000 else "FAIL",
     }
+    rec = env.provisioner.solver.recorder
+    trace = next((t for t in reversed(rec.traces()) if t.backend == "globalpack"), None)
+    if trace is not None:
+        extra["phase_split"] = {k: round(v, 4) for k, v in trace.phase_totals.items()}
+        extra.update(_validate_tail_gate(trace, lp_phases=("encode_candidates", "globalpack", "round")))
     extra.update(_global_repack_revocation_smoke())
     if n_nodes >= 5000 and best >= 5.0:
         print(f"GLOBAL REPACK 5K GATE FAILED: {best:.2f}s >= 5s", file=sys.stderr)
@@ -2464,6 +2576,10 @@ def main():
         os.environ.setdefault("BENCH_CHURN_PODS", "2500")
         os.environ.setdefault("BENCH_CHURN_ITER", "8")
         os.environ.setdefault("BENCH_CHURN_EVENTS_GATE", "2500")
+        # decode-delta ratio at 1/20 scale: fixed per-solve costs (claim
+        # rebuilds, template ctx) dominate both arms below ~5k pods — same
+        # reason the encode-speedup smoke gates scale down
+        os.environ.setdefault("BENCH_DECODE_SPEEDUP_GATE", "2.0")
         # fleet_multitenant smoke: K=4 tenants at ~1/160 scale each
         os.environ.setdefault("BENCH_FLEET_PODS", "300")
         os.environ.setdefault("BENCH_FLEET_ITER", "32")
@@ -2592,8 +2708,14 @@ def main():
             # event-to-placement distribution + its dominant stage
             "e2e_events", "e2e_p50_seconds", "e2e_p99_seconds", "dominant_stage",
             "slo_breaches",
+            # decode-delta hatch columns (ISSUE 20): the churn variant of the
+            # removal_delta decode gate — same keys, churn_ prefixed
+            "decode_delta_seconds", "decode_hatch_off_seconds", "decode_delta_steps",
+            "decode_speedup", "decode_parity", "decode_warm_recompiles",
+            "decode_speedup_gate",
         ):
-            extra[f"churn_{k}"] = ch[k]
+            if k in ch:
+                extra[f"churn_{k}"] = ch[k]
         extra["churn_modes"] = ch["modes"]
         extra["churn_full_solve_reasons"] = ch["full_solve_reasons"]
         extra["churn_stage_p99_seconds"] = ch["stage_p99_seconds"]

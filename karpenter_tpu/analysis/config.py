@@ -26,6 +26,11 @@ class Config:
         "karpenter_tpu/solver/encode.py",
         "karpenter_tpu/solver/tpu.py",
         "karpenter_tpu/solver/check.py",
+        # the decode/validate tail rides the same hot path: the consolidation
+        # round's masked-sim probes and the LP/global rounding ladder are
+        # per-round host work the pod-loop/host-sync rules must see
+        "karpenter_tpu/solver/simulate.py",
+        "karpenter_tpu/solver/consolidation.py",
     )
     # "<file>:<constant>" — the frozenset of EncodedSnapshot field names that
     # derived encodes share by reference
@@ -232,6 +237,12 @@ class Config:
         "KARPENTER_SOLVER_MULTIGROUP",
         "KARPENTER_SOLVER_GLOBALPACK",
         "KARPENTER_ENCODE_COLUMNAR",
+        # decode-delta escape hatch (tpu._decode re-materializes every slot
+        # when off) and the consolidation round's shared-scheduler hatch
+        # (simulate.ConsolidationSimulator skips the SchedulerRoundSeed carry
+        # when off) — both are exact-reference toggles, placement-identical
+        "KARPENTER_SOLVER_FASTDECODE",
+        "KARPENTER_SIM_SHARED_SCHED",
     )
     # direct override for tests/self-test; when None the registry file is
     # parsed on first use

@@ -314,11 +314,23 @@ class PodAxisLoopRule(Rule):
     name = "python-loop-over-pod-axis"
     description = "Python-level `for` statement iterating a pod-scaled collection in a tensor module"
 
-    SELF_TEST_BAD = "def f(enc):\n    for p in enc.pods:\n        p.key()\n"
+    # seeded on the decode-materialization shape: grouping pods into slots by
+    # walking the pod axis in Python is exactly the O(pods) host tail the
+    # decode-delta memo + columnar gather removed (bad_decode_loop /
+    # ok_decode_columnar in the fixture file carry the full pair)
+    SELF_TEST_BAD = (
+        "def decode(enc, assignment):\n"
+        "    slots = {}\n"
+        "    for i, p in enumerate(enc.pods):\n"
+        "        slots.setdefault(assignment[i], []).append(p)\n"
+        "    return slots\n"
+    )
     SELF_TEST_OK = (
-        "def f(enc):\n"
-        "    for p in enc.pods:  # solverlint: ok(python-loop-over-pod-axis): self-test snippet, never imported\n"
-        "        p.key()\n"
+        "def decode(enc, assignment):\n"
+        "    slots = {}\n"
+        "    for i, p in enumerate(enc.pods):  # solverlint: ok(python-loop-over-pod-axis): self-test snippet, never imported\n"
+        "        slots.setdefault(assignment[i], []).append(p)\n"
+        "    return slots\n"
     )
 
     def check(self, mod, config, root):
@@ -463,21 +475,18 @@ class MetricLabelCardinalityRule(Rule):
     description = "bounded metric labels must carry statically enumerable values"
     _ITER_WRAPPERS = frozenset({"sorted", "set", "list", "tuple"})
 
-    # the seeded violation is an lrapack one: the pack's item-demotions
-    # counter's `reason` label fed a raw dict key straight from build_items'
-    # info payload instead of the bounded
-    # scheduler_model_grouped.demotion_label producer (anything outside
-    # DEMOTION_REASONS collapses to "other") — exactly the cardinality leak
-    # a future demotion reason added without the enum would regress into
+    # the seeded violation is a decode-delta one: the decode counter's `mode`
+    # label fed a runtime trace-attribution value instead of the two-literal
+    # {full | delta-reuse} enum the decode itself branches on — exactly the
+    # cardinality leak a future decode mode added without a literal at the
+    # call site would regress into
     SELF_TEST_BAD = (
-        "def publish(registry, info):\n"
-        "    for why, pods in info['demotions'].items():\n"
-        '        registry.counter("karpenter_solver_pack_item_demotions_total").inc(pods, reason=why)\n'
+        "def publish(registry, trace):\n"
+        '    registry.counter("karpenter_solver_decode_total").inc(mode=trace.attribution["decode_mode"])\n'
     )
     SELF_TEST_OK = (
-        "def publish(registry, info):\n"
-        "    for why, pods in info['demotions'].items():\n"
-        '        registry.counter("karpenter_solver_pack_item_demotions_total").inc(pods, reason=demotion_label(why))\n'
+        "def publish(registry, reused_slots):\n"
+        '    registry.counter("karpenter_solver_decode_total").inc(mode="delta-reuse" if reused_slots else "full")\n'
     )
 
     def __init__(self):

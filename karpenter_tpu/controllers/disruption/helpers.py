@@ -7,7 +7,7 @@ from ...utils import pods as pod_utils
 from .types import REASON_DRIFTED, REASON_EMPTY, REASON_UNDERUTILIZED
 
 
-def simulate_scheduling(provisioner, cluster, candidates: list, clock, reuse=None):
+def simulate_scheduling(provisioner, cluster, candidates: list, clock, reuse=None, sched_seed=None):
     """Clone state minus the candidates, add their reschedulable pods to the
     pending set, and Solve (helpers.go:53-154). The Solver plugin (FFD or TPU)
     is reused for free — the simulation IS a solve on a modified snapshot.
@@ -16,8 +16,11 @@ def simulate_scheduling(provisioner, cluster, candidates: list, clock, reuse=Non
     masked sub-encode of its round-base encode when the batch sits inside the
     simulator's correctness envelope — placement-identical, at a fraction of
     the per-probe host cost — and falls back to this from-scratch path
-    otherwise. The 15s command Validator never passes one: executed commands
-    always re-validate against a from-scratch simulation."""
+    otherwise. `sched_seed` (a scheduling.SchedulerRoundSeed) rides the probe
+    snapshot so a from-scratch host build within the round reuses the
+    probe-invariant fit-memo/PodData layers. The 15s command Validator never
+    passes either: executed commands always re-validate against a fully
+    independent from-scratch simulation."""
     if reuse is not None:
         return reuse.simulate(candidates)
     candidate_names = {c.name() for c in candidates}
@@ -45,6 +48,8 @@ def simulate_scheduling(provisioner, cluster, candidates: list, clock, reuse=Non
     # reserve (consolidation.go:45 DisableReservedCapacityFallback)
     snapshot.reserved_offering_mode = "strict"
     snapshot.collect_zone_metrics = False
+    if sched_seed is not None:
+        snapshot.sched_seed = sched_seed
     results = provisioner.solver.solve(snapshot)
     # prune claims that ended up empty
     results.new_node_claims = [nc for nc in results.new_node_claims if nc.pods]

@@ -20,6 +20,11 @@ MULTI_NODE_CONSOLIDATION_CANDIDATE_CAP = 100  # multinodeconsolidation.go:35
 # 10s rounds forever (multinodeconsolidation.go:35, singlenodeconsolidation.go:33)
 MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS = 60.0
 SINGLE_NODE_CONSOLIDATION_TIMEOUT_SECONDS = 180.0
+# how many ranked proposals the 15s exact Validator may be run against in one
+# multi-node round: the winner plus fallbacks pulled lazily from the proposer's
+# ladder when validation rejects (each attempt pays the full 15s wait, so the
+# cap also bounds wall-clock alongside the shared deadline)
+MULTI_NODE_VALIDATION_ATTEMPTS = 3
 
 
 class Emptiness:
@@ -191,6 +196,9 @@ class _ConsolidationBase:
         the round's ConsolidationSimulator: proposal checks inside its
         correctness envelope run as masked sub-encode simulations; the 15s
         Validator never passes one."""
+        if not candidates:
+            # nothing to consolidate — don't burn a simulation on it
+            return Command()
         ctx = self.ctx
         results = simulate_scheduling(ctx.provisioner, ctx.cluster, candidates, ctx.clock, reuse=reuse)
         if not all_non_pending_scheduled(results, candidates):
@@ -403,41 +411,68 @@ class MultiNodeConsolidation(_ConsolidationBase):
         # device search and the binary-search fallback share it, so a slow
         # pool can't starve rounds regardless of backend
         deadline = self.ctx.clock.now() + MULTI_NODE_CONSOLIDATION_TIMEOUT_SECONDS
-        # TPU backend: device search proposes candidate sets; each is
-        # exact-validated through the same simulation before use (stage 8)
+        # TPU backend: device search proposes candidate sets; the winner is
+        # exact-validated through the same simulation before use (stage 8).
+        # The device proposers hand back LAZY ranked ladders — `producer`
+        # holds the suspended continuation so a 15s-validation failure can
+        # pull the next accepted proposal instead of abandoning the round.
         cmd = Command()
+        producer = None
         lp_mode = os.environ.get("KARPENTER_CONSOLIDATE_LP", "1").strip().lower()
         gp_mode = os.environ.get("KARPENTER_SOLVER_GLOBALPACK", "0").strip().lower()
-        if getattr(self.ctx.options, "solver_backend", "ffd") == "tpu" and lp_mode not in ("0", "false", "off"):
-            if gp_mode in ("1", "true", "on"):
-                cmd = self._globalpack_option(filtered, deadline)
-                if not (cmd.candidates and self._passes_balanced(cmd)):
-                    cmd = Command()
-            if not cmd.candidates:
-                if lp_mode == "anneal":
-                    cmd = self._annealed_option(filtered_bs, deadline)
-                else:
-                    cmd = self._lp_option(filtered, deadline)
-                if not (cmd.candidates and self._passes_balanced(cmd)):
-                    cmd = Command()
-        if not cmd.candidates:
-            if self.ctx.clock.now() > deadline:
-                # the device stage consumed the whole budget (and counted
-                # its timeout) — don't start the binary search, and never
-                # hand an empty command to the 15s validator
-                return []
-            cmd = self._first_n_consolidation_option(filtered_bs, deadline)
-            if not (cmd.candidates and self._passes_balanced(cmd)):
-                return []
-        # 15s wait + re-simulation before execution
-        # (multinodeconsolidation.go:103, validation.go:192-263)
-        from .validation import ValidationError, Validator
-
         try:
-            Validator(self.ctx, self, mode="strict", metrics=self.ctx.metrics).validate(cmd)
-        except ValidationError:
+            if getattr(self.ctx.options, "solver_backend", "ffd") == "tpu" and lp_mode not in ("0", "false", "off"):
+                if gp_mode in ("1", "true", "on"):
+                    producer = self._globalpack_option_iter(filtered, deadline)
+                    cmd = next(producer, Command())
+                    if not (cmd.candidates and self._passes_balanced(cmd)):
+                        cmd = Command()
+                        producer.close()
+                        producer = None
+                if not cmd.candidates:
+                    if lp_mode == "anneal":
+                        cmd = self._annealed_option(filtered_bs, deadline)
+                        if not (cmd.candidates and self._passes_balanced(cmd)):
+                            cmd = Command()
+                    else:
+                        producer = self._lp_option_iter(filtered, deadline)
+                        cmd = next(producer, Command())
+                        if not (cmd.candidates and self._passes_balanced(cmd)):
+                            cmd = Command()
+                            producer.close()
+                            producer = None
+            if not cmd.candidates:
+                if self.ctx.clock.now() > deadline:
+                    # the device stage consumed the whole budget (and counted
+                    # its timeout) — don't start the binary search, and never
+                    # hand an empty command to the 15s validator
+                    return []
+                cmd = self._first_n_consolidation_option(filtered_bs, deadline)
+                if not (cmd.candidates and self._passes_balanced(cmd)):
+                    return []
+            # 15s wait + re-simulation before execution
+            # (multinodeconsolidation.go:103, validation.go:192-263). Every
+            # emitted command passes this exact gate; when a ranked ladder is
+            # live, a rejection falls back to the next accepted proposal
+            # (bounded by MULTI_NODE_VALIDATION_ATTEMPTS and the deadline)
+            # rather than ending the round empty-handed.
+            from .validation import ValidationError, Validator
+
+            validator = Validator(self.ctx, self, mode="strict", metrics=self.ctx.metrics)
+            for _attempt in range(MULTI_NODE_VALIDATION_ATTEMPTS):
+                try:
+                    validator.validate(cmd)
+                    return [cmd]
+                except ValidationError:
+                    if producer is None or self.ctx.clock.now() > deadline:
+                        return []
+                    cmd = next(producer, Command())
+                    if not (cmd.candidates and self._passes_balanced(cmd)):
+                        return []
             return []
-        return [cmd]
+        finally:
+            if producer is not None:
+                producer.close()
 
     def _candidate_instance_types(self, candidates) -> list:
         pools = {c.node_pool.metadata.name: c.node_pool for c in candidates}
@@ -447,13 +482,33 @@ class MultiNodeConsolidation(_ConsolidationBase):
         return its
 
     def _lp_option(self, candidates, deadline: float) -> Command:
-        """The relaxed-LP repack proposer + per-proposal exact validation,
-        under the shared 1-minute compute budget. The whole round is flight-
-        recorded as one mode="consolidate" SolveTrace with per-phase spans
+        """Best accepted command from the ranked LP ladder (compat surface
+        over `_lp_option_iter` for callers that want exactly one proposal —
+        the bench harness drives this directly)."""
+        it = self._lp_option_iter(candidates, deadline)
+        try:
+            for cmd in it:
+                return cmd
+            return Command()
+        finally:
+            it.close()
+
+    def _lp_option_iter(self, candidates, deadline: float):
+        """The relaxed-LP repack proposer as a lazy ladder: yields every
+        exactly-simulated ACCEPTED command in the proposer's ranked
+        (best-first) order. The ladder is already ranked by the cheap
+        masked-sim scores inside `propose_subsets_lp`, so the happy path
+        pulls ONE command, hands it to the 15s exact Validator, and never
+        simulates the rest; a validation failure resumes the generator to
+        pull the next accepted proposal. The whole round is flight-recorded
+        as one mode="consolidate" SolveTrace with per-phase spans
         (encode_candidates / lp_repack / round inside propose_subsets_lp,
-        validate around the exact checks), and every proposal's simulation
-        runs through the round's ConsolidationSimulator (masked sub-encodes
-        where its envelope allows, from-scratch otherwise)."""
+        one "validate" span per exact probe — NOT around the yields, so the
+        Validator's 15s wait while the generator is suspended never accrues
+        into the phase split), and every proposal's simulation runs through
+        the round's ConsolidationSimulator (masked sub-encodes where its
+        envelope allows, from-scratch otherwise) plus its shared
+        SchedulerRoundSeed for the from-scratch builds."""
         import logging
 
         from ... import metrics as m
@@ -476,44 +531,57 @@ class MultiNodeConsolidation(_ConsolidationBase):
                 logging.getLogger("karpenter.disruption").warning(
                     "LP consolidation repack failed, falling back: %s", e
                 )
-                return Command()
+                return
             if ctx.metrics is not None and proposals:
                 ctx.metrics.counter(m.SOLVER_CONSOLIDATION_PROPOSALS_TOTAL).inc(len(proposals), proposer="lp")
                 ctx.metrics.counter(m.SOLVER_CONSOLIDATION_LP_ITERATIONS_TOTAL).inc(LP_SOLVE_ITERATIONS)
-            with trace.span("validate", proposals=len(proposals)):
-                for subset in proposals:
-                    if ctx.clock.now() > deadline:
-                        self._count_timeout()
-                        return Command()
-                    chosen = [candidates[i] for i in subset]
+            trace.note(proposals=len(proposals))
+            for subset in proposals:
+                if ctx.clock.now() > deadline:
+                    self._count_timeout()
+                    return
+                chosen = [candidates[i] for i in subset]
+                with trace.span("validate"):
                     cmd = self.compute_consolidation(chosen, reuse=reuse)
                     accepted = bool(cmd.candidates) and not self._is_pointless_churn(cmd)
+                if ctx.metrics is not None:
+                    ctx.metrics.counter(m.SOLVER_CONSOLIDATION_VALIDATION_TOTAL).inc(
+                        decision="accept" if accepted else "reject"
+                    )
+                if accepted:
                     if ctx.metrics is not None:
-                        ctx.metrics.counter(m.SOLVER_CONSOLIDATION_VALIDATION_TOTAL).inc(
-                            decision="accept" if accepted else "reject"
+                        ctx.metrics.gauge(m.SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR).set(
+                            _command_savings_per_hour(cmd), proposer="lp"
                         )
-                    if accepted:
-                        if ctx.metrics is not None:
-                            ctx.metrics.gauge(m.SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR).set(
-                                _command_savings_per_hour(cmd), proposer="lp"
-                            )
-                        trace.note(accepted_subset=len(subset))
-                        return cmd
-            return Command()
+                    trace.note(accepted_subset=len(subset))
+                    yield cmd
         finally:
             trace.note(
                 sim_masked=reuse.masked_probes,
                 sim_scratch=reuse.scratch_probes,
                 sim_why_scratch=reuse.why_scratch,
+                sched_seed_rejects=len(reuse.sched_seed.static_rejects) if reuse.sched_seed is not None else 0,
             )
             recorder.commit(trace, registry=ctx.metrics)
 
     def _globalpack_option(self, candidates, deadline: float) -> Command:
-        """The opt-in GLOBAL repack proposer (KARPENTER_SOLVER_GLOBALPACK=1):
-        one convex solve over pending placement + retirement, then the same
-        per-proposal exact validation ladder as `_lp_option` — the round's
+        """Best accepted command from the global-repack ladder (compat
+        surface over `_globalpack_option_iter`, mirrors `_lp_option`)."""
+        it = self._globalpack_option_iter(candidates, deadline)
+        try:
+            for cmd in it:
+                return cmd
+            return Command()
+        finally:
+            it.close()
+
+    def _globalpack_option_iter(self, candidates, deadline: float):
+        """The opt-in GLOBAL repack proposer (KARPENTER_SOLVER_GLOBALPACK=1)
+        as the same lazy accepted-command ladder as `_lp_option_iter`: one
+        convex solve over pending placement + retirement, then exact
+        simulation per pulled proposal only — the round's
         ConsolidationSimulator already carries the pending pods in every
-        probe, so an accepted command is exact for BOTH sides of the joint
+        probe, so a yielded command is exact for BOTH sides of the joint
         objective. Publishes the bounded karpenter_solver_globalpack_*
         family and rides the proposer="globalpack" enum value."""
         import logging
@@ -539,7 +607,7 @@ class MultiNodeConsolidation(_ConsolidationBase):
                 logging.getLogger("karpenter.disruption").warning(
                     "global repack failed, falling back to two-phase: %s", e
                 )
-                return Command()
+                return
             if ctx.metrics is not None:
                 ctx.metrics.counter(m.SOLVER_GLOBALPACK_ROUNDS_TOTAL).inc()
                 ctx.metrics.counter(m.SOLVER_GLOBALPACK_ITERATIONS_TOTAL).inc(LP_SOLVE_ITERATIONS)
@@ -548,31 +616,32 @@ class MultiNodeConsolidation(_ConsolidationBase):
                     ctx.metrics.counter(m.SOLVER_CONSOLIDATION_PROPOSALS_TOTAL).inc(
                         len(proposals), proposer="globalpack"
                     )
-            with trace.span("validate", proposals=len(proposals)):
-                for subset in proposals:
-                    if ctx.clock.now() > deadline:
-                        self._count_timeout()
-                        return Command()
-                    chosen = [candidates[i] for i in subset]
+            trace.note(proposals=len(proposals))
+            for subset in proposals:
+                if ctx.clock.now() > deadline:
+                    self._count_timeout()
+                    return
+                chosen = [candidates[i] for i in subset]
+                with trace.span("validate"):
                     cmd = self.compute_consolidation(chosen, reuse=reuse)
                     accepted = bool(cmd.candidates) and not self._is_pointless_churn(cmd)
+                if ctx.metrics is not None:
+                    ctx.metrics.counter(m.SOLVER_CONSOLIDATION_VALIDATION_TOTAL).inc(
+                        decision="accept" if accepted else "reject"
+                    )
+                if accepted:
                     if ctx.metrics is not None:
-                        ctx.metrics.counter(m.SOLVER_CONSOLIDATION_VALIDATION_TOTAL).inc(
-                            decision="accept" if accepted else "reject"
+                        ctx.metrics.gauge(m.SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR).set(
+                            _command_savings_per_hour(cmd), proposer="globalpack"
                         )
-                    if accepted:
-                        if ctx.metrics is not None:
-                            ctx.metrics.gauge(m.SOLVER_CONSOLIDATION_SAVINGS_PER_HOUR).set(
-                                _command_savings_per_hour(cmd), proposer="globalpack"
-                            )
-                        trace.note(accepted_subset=len(subset))
-                        return cmd
-            return Command()
+                    trace.note(accepted_subset=len(subset))
+                    yield cmd
         finally:
             trace.note(
                 sim_masked=reuse.masked_probes,
                 sim_scratch=reuse.scratch_probes,
                 sim_why_scratch=reuse.why_scratch,
+                sched_seed_rejects=len(reuse.sched_seed.static_rejects) if reuse.sched_seed is not None else 0,
             )
             recorder.commit(trace, registry=ctx.metrics)
 

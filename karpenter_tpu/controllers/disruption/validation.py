@@ -59,6 +59,12 @@ class Validator:
 
     def validate(self, cmd: Command, delay_seconds: float = VALIDATION_DELAY_SECONDS) -> Command:
         """Returns the validated command or raises ValidationError."""
+        if not cmd.candidates:
+            # a commandless validate can only ever raise — don't pay the 15s
+            # wait to learn it. Same outcome (_count_failure bump + churn
+            # raise) that _validate_candidates([]) produces after the sleep.
+            self._count_failure(0)
+            raise ValidationError("churn", "0 candidates are no longer valid")
         if delay_seconds > 0:
             self.ctx.clock.sleep(delay_seconds)
         validated = self._validate_candidates(cmd.candidates)

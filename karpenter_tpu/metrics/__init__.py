@@ -66,6 +66,12 @@ SOLVER_FALLBACK_TOTAL = "karpenter_solver_fallback_total"
 SOLVER_VALIDATION_FAILURES_TOTAL = "karpenter_solver_validation_failures_total"
 SOLVER_HYBRID_RESIDUAL_TOTAL = "karpenter_solver_hybrid_residual_total"
 SOLVER_DECODE_REPAIR_TOTAL = "karpenter_solver_decode_repair_total"
+# decode materialization mode per solve; mode is the bounded {full,
+# delta-reuse} enum — a warm delta chain should sit at delta-reuse
+SOLVER_DECODE_TOTAL = "karpenter_solver_decode_total"
+# per-slot reuse attribution: claims served from the decode-delta memo
+# instead of re-materialized (the decode-tail analogue of delta-hit)
+SOLVER_DECODE_REUSED_SLOTS_TOTAL = "karpenter_solver_decode_reused_slots_total"
 # why a delta-capable solve routed to the full path anyway; reason is the
 # bounded encode.DELTA_REJECT_REASONS enum ({unseen-sig, row-key, vol-rv,
 # pvc, cap, reorder, fallback-global, irreversible, slot-exhausted,
@@ -224,6 +230,16 @@ def make_registry() -> Registry:
         SOLVER_DELTA_REJECT_TOTAL,
         "Delta-capable solves routed to the full path, by reject reason",
         ("reason",),
+    )
+    r.counter(
+        SOLVER_DECODE_TOTAL,
+        "Tensor decodes by materialization mode (full | delta-reuse)",
+        ("mode",),
+    )
+    r.counter(
+        SOLVER_DECODE_REUSED_SLOTS_TOTAL,
+        "Slots served from the decode-delta memo instead of re-materialized",
+        (),
     )
     r.counter(
         SOLVER_PACK_ITEM_DEMOTIONS_TOTAL,

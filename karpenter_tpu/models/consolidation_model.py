@@ -30,6 +30,7 @@ execution"), so relaxation can only cost optimality, never correctness.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from functools import partial
 
@@ -164,19 +165,18 @@ def lp_repack(t: ConsolidationTensors, onehot, compat_qn, key, n_inits: int = 8,
 LP_SCORE_BATCH = 32
 
 
+@functools.lru_cache(maxsize=32)
+def _zero_pend(R: int, Q: int):
+    """The two-phase proposer's fixed no-pending operands, cached per shape —
+    consolidation rounds score one rounding ladder per probeless round, and
+    rebuilding identical zero buffers each time is pure dispatch overhead."""
+    return jnp.zeros((R,), dtype=jnp.float32), jnp.float32(0.0), jnp.zeros((Q,), dtype=jnp.float32)
+
+
 def score_subsets(t: ConsolidationTensors, onehot, compat_nq, X):
     """Batch-score candidate delete-sets (host rounding helper); pads the
     batch axis to LP_SCORE_BATCH so repeated rounds never retrace."""
     from .globalpack import score_subsets_global
 
-    R = t.node_used.shape[1]
-    Q = onehot.shape[1]
-    return score_subsets_global(
-        t,
-        onehot,
-        compat_nq,
-        jnp.zeros((R,), dtype=jnp.float32),
-        jnp.float32(0.0),
-        jnp.zeros((Q,), dtype=jnp.float32),
-        X,
-    )
+    pend_req, pend_npods, pend_active = _zero_pend(t.node_used.shape[1], onehot.shape[1])
+    return score_subsets_global(t, onehot, compat_nq, pend_req, pend_npods, pend_active, X)

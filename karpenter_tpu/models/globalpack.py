@@ -235,3 +235,34 @@ def score_subsets_global(t: ConsolidationTensors, onehot, compat_nq, pend_req, p
         scores.append(np.asarray(s))
         feas.append(np.asarray(f))
     return np.concatenate(scores)[:m], np.concatenate(feas)[:m]
+
+
+def rank_ladder(scores, feas, X, n, max_proposals, floor=0.0, skip_rows=frozenset()):
+    """Best-first deduped delete-set ladder from one scored rounding batch:
+    walk rows by descending relaxed score, keep feasible rows strictly above
+    `floor` (0 for the two-phase proposer; the empty-set base score for the
+    global one, where pending mass shifts every subset uniformly), dedup on
+    the real-candidate member set, and stop at `max_proposals`. This rank IS
+    the consolidation round's validation order — the caller exact-validates
+    the top rung and only falls down the ladder when the 15s Validator
+    rejects it, so rung order decides which proposals ever pay an exact
+    simulation. Returns (ladder, best) where ladder is [(subset, score), ...]
+    best-first and `best` is max(floor, top score) for the caller's
+    objective-improvement gauge."""
+    import numpy as np
+
+    out: list[tuple[list[int], float]] = []
+    emitted: set[tuple] = set()
+    best = float(floor)
+    for i in np.argsort(-scores):
+        if int(i) in skip_rows or scores[i] <= floor or not feas[i]:
+            continue
+        subset = tuple(np.nonzero(X[i][:n])[0].tolist())
+        if not subset or subset in emitted:
+            continue
+        emitted.add(subset)
+        out.append((list(subset), float(scores[i])))
+        best = max(best, float(scores[i]))
+        if len(out) >= max_proposals:
+            break
+    return out, best

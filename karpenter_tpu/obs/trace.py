@@ -421,6 +421,15 @@ class TraceRecorder:
             last = traces[-1]
             out["last_phases"] = {k: round(v, 6) for k, v in last.phase_totals.items()}
             out["last_duration_s"] = round(last.duration, 6)
+            # the tail shares (ISSUE 20): what fraction of the window's solve
+            # wall the decode and exact-validate phases claim — the two
+            # columns the decode-delta memo and the ranked-ladder validation
+            # exist to shrink
+            total = sum(t.duration for t in traces)
+            if total > 0:
+                for phase in ("decode", "validate"):
+                    spent = sum(t.phase_totals.get(phase, 0.0) for t in traces)
+                    out[f"{phase}_share"] = round(spent / total, 4)
         return out
 
     def dump(self, limit: int | None = None) -> dict:

@@ -22,6 +22,16 @@ the cap widened, but it re-credits and re-packs the entire backlog every
 solve). With the prestager, a pod pending across two solves IS the same
 object and the delta is exactly the true arrivals/cancellations.
 
+The decode-delta memo (`TPUSolver._decode`) leans on the same contract from
+the other end: a reused slot hands back the PRIOR decode's claim built over
+the prior solve's pod objects, and its correctness argument — "slot count
+unchanged + no assignment row touched it ⇒ identical member set" — holds
+because an unchanged pod ((uid, resourceVersion) stable) is the same clone
+in both solves. A pod whose content changed gets a NEW clone here, which
+re-keys its encode signature and moves its assignment row, so the decode
+marks every slot it touches dirty and re-materializes them; clone identity
+is what makes "row untouched" equivalent to "membership unchanged".
+
 Safety:
 - Clones are never mutated by a solve: the host scheduler deep-copies a pod
   before its first preference relaxation and leaves the caller's object

@@ -408,6 +408,7 @@ def propose_subsets_lp(
     import jax
 
     from ..models.consolidation_model import lp_repack, score_subsets
+    from ..models.globalpack import rank_ladder
     from ..obs.trace import SolveTrace
 
     if len(candidates) < 2:
@@ -427,24 +428,18 @@ def propose_subsets_lp(
             return []
         X = np.stack(rows)
         scores, feas = score_subsets(t, aux["onehot"], aux["compat_nq"], X)
-        out: list[list[int]] = []
-        emitted: set[tuple] = set()
-        for i in np.argsort(-scores):
-            if scores[i] <= 0 or not feas[i]:
-                continue
-            subset = tuple(np.nonzero(X[i][:n])[0].tolist())
-            if subset in emitted:
-                continue
-            emitted.add(subset)
-            out.append(list(subset))
-            if len(out) >= max_proposals:
-                break
+        ladder, _ = rank_ladder(scores, feas, X, n, max_proposals)
+        out: list[list[int]] = [s for s, _sc in ladder]
         # like the annealer: with any profitable signal, also offer the full
         # set (exact validation may churn-reject the LP's preferred subset)
-        full = tuple(range(n))
-        if out and full not in emitted:
-            out.append(list(full))
-        tr.note(lp_proposals=len(out), lp_rounded=len(rows))
+        full = list(range(n))
+        if out and full not in out:
+            out.append(full)
+        tr.note(
+            lp_proposals=len(out),
+            lp_rounded=len(rows),
+            ladder_scores=[round(sc, 3) for _s, sc in ladder],
+        )
     return out
 
 
@@ -490,7 +485,7 @@ def propose_subsets_global(
         )
         d = np.asarray(d)  # [C, Np] — one device->host landing for the round
     with tr.span("round"):
-        from ..models.globalpack import score_subsets_global
+        from ..models.globalpack import rank_ladder, score_subsets_global
 
         N = d.shape[1]
         rows = [np.zeros(N, dtype=bool)] + _round_fractional(d, n)  # row 0: the empty-set base
@@ -513,27 +508,19 @@ def propose_subsets_global(
             t, aux["onehot"], aux["compat_nq"], aux["pend_req"], aux["pend_npods"], aux["pend_active"], X
         )
         base = scores[0]
-        out: list[list[int]] = []
-        emitted: set[tuple] = set()
-        best = base
-        for i in np.argsort(-scores):
-            if i == 0 or scores[i] <= base or not feas[i]:
-                continue
-            subset = tuple(np.nonzero(X[i][:n])[0].tolist())
-            if not subset or subset in emitted:
-                continue
-            emitted.add(subset)
-            out.append(list(subset))
-            best = max(best, float(scores[i]))
-            if len(out) >= max_proposals:
-                break
-        full = tuple(range(n))
-        if out and full not in emitted:
-            out.append(list(full))
+        ladder, best = rank_ladder(scores, feas, X, n, max_proposals, floor=float(base), skip_rows=frozenset((0,)))
+        out: list[list[int]] = [s for s, _sc in ladder]
+        full = list(range(n))
+        if out and full not in out:
+            out.append(full)
         if best > base:
             # an infeasible (-BIG) base means ANY feasible subset is the win;
             # report its absolute score so the gauge stays meaningful
             info["objective_improvement"] = float(best - base) if base > -1e37 else float(best)
         info["rounded"] = len(rows) - 1
-        tr.note(globalpack_proposals=len(out), globalpack_rounded=len(rows) - 1)
+        tr.note(
+            globalpack_proposals=len(out),
+            globalpack_rounded=len(rows) - 1,
+            ladder_scores=[round(sc, 3) for _s, sc in ladder],
+        )
     return out, info

@@ -87,6 +87,8 @@ class ConsolidationSimulator:
     candidates — anything else routes to the from-scratch path)."""
 
     def __init__(self, provisioner, cluster, clock, candidates):
+        import os
+
         self.provisioner = provisioner
         self.cluster = cluster
         self.clock = clock
@@ -97,6 +99,17 @@ class ConsolidationSimulator:
         self.last_mode = ""  # "masked" | "scratch" — per-probe attribution
         self.masked_probes = 0
         self.scratch_probes = 0
+        # one SchedulerRoundSeed shared by every scratch probe of this round:
+        # probe-invariant host-scheduler layers (PodData, signatures, and
+        # version-0 static rejects) carry across builds instead of being
+        # re-derived per probe. KARPENTER_SIM_SHARED_SCHED=0 is the exact-
+        # reference escape hatch (placements are identical either way — the
+        # carry only skips re-deriving verdicts that cannot differ).
+        self.sched_seed = None
+        if os.environ.get("KARPENTER_SIM_SHARED_SCHED", "1").strip().lower() not in ("0", "false", "off"):
+            from ..controllers.provisioning.scheduling.scheduler import SchedulerRoundSeed
+
+            self.sched_seed = SchedulerRoundSeed()
 
     @property
     def why_scratch(self) -> str:
@@ -269,7 +282,7 @@ class ConsolidationSimulator:
 
         self.last_mode = "scratch"
         self.scratch_probes += 1
-        return simulate_scheduling(self.provisioner, self.cluster, batch, self.clock)
+        return simulate_scheduling(self.provisioner, self.cluster, batch, self.clock, sched_seed=self.sched_seed)
 
     def simulate(self, batch):
         base = self._build_base()

@@ -9,6 +9,8 @@ BASELINE.json ("the Go FFD path stays the default").
 
 from __future__ import annotations
 
+import os as _os
+
 import numpy as np
 
 from ..apis import labels as wk
@@ -78,6 +80,14 @@ def _requests_from_sigs(enc, sig_counts: dict[int, int]) -> dict:
         for k, q in enc.sig_requests[s].items():
             acc[k] = acc.get(k, 0) + q.milli * n
     return {k: Quantity(v) for k, v in acc.items()}
+
+
+def _fastdecode_enabled() -> bool:
+    """KARPENTER_SOLVER_FASTDECODE (default on): on delta solves, reuse the
+    previous decode's per-slot materializations for slots whose assignment
+    rows did not change. =0 is the exact-reference escape hatch — every slot
+    re-materializes from scratch (bit-identical Results pinned by tests)."""
+    return _os.environ.get("KARPENTER_SOLVER_FASTDECODE", "1").strip().lower() not in ("0", "false", "off")
 
 
 class _NullTopology:
@@ -267,6 +277,26 @@ class TPUSolver:
         # against the masked device-resident state instead of re-encoding
         # and re-packing the whole tensor majority
         self._hybrid_state: dict | None = None
+        # decode-delta carry: the previous SUCCESSFUL decode's per-slot
+        # materializations (claim specs for new-claim slots, pod/request
+        # bundles for existing slots), keyed to the encode object they were
+        # decoded from. A delta solve whose base is that same encode reuses
+        # every slot whose assignment row provably did not change; the reuse
+        # key per slot is (basis row, zoneset row, member multiset via the
+        # per-slot count + removal/addition touch set). Reused claim objects
+        # are REBUILT from the memo's frozen copies — the binder can mutate
+        # adopted claims freely without poisoning the carry.
+        self._decode_memo: dict | None = None
+        # the instance-type catalog (by object identity) last proven to hold
+        # ZERO reserved offerings — lets steady-state decodes skip the full
+        # per-offering reservation scan (see _decode); None whenever the last
+        # scanned catalog had reserved capacity or none was scanned yet
+        self._resv_empty_memo: dict | None = None
+        # set by _solve_delta_inner immediately before _finish: the delta's
+        # (base encode, removed base-pod indices, survivor count) — what
+        # _decode needs to prove which slots were untouched. Consumed (and
+        # cleared) by the next _decode call.
+        self._decode_delta_ctx: dict | None = None
         # last_solve_mode ("full" | "delta" | "hybrid" | "hybrid-delta" |
         # "fallback") and last_phase_seconds are trace-derived properties
         # below — the SolveTrace is the source of truth; the attributes
@@ -476,6 +506,9 @@ class TPUSolver:
         self.encode_cache = EncodeCache()
         self._resident = None
         self._hybrid_state = None
+        self._decode_memo = None
+        self._decode_delta_ctx = None
+        self._resv_empty_memo = None
 
     def _recover(self, snap: SolverSnapshot, trace: SolveTrace, err: BaseException) -> Results:
         """The degradation ladder, engaged only when a solve RAISED (the
@@ -544,7 +577,7 @@ class TPUSolver:
         self.last_fallback_reasons = []
         if trace.enabled:
             trace.jit_before = sentinel().snapshot()
-        resident, hybrid_state = self._resident, self._hybrid_state
+        resident, hybrid_state, decode_memo = self._resident, self._hybrid_state, self._decode_memo
         try:
             trace.n_sigs = int(getattr(enc, "n_sigs", 0) or 0)
             trace.note(encode_mode="sim-masked", row_cache=True)
@@ -558,6 +591,7 @@ class TPUSolver:
             # snapshot — restore the provisioning solver's warm state
             self._resident = resident
             self._hybrid_state = hybrid_state
+            self._decode_memo = decode_memo
             if trace.enabled:
                 trace.recompiles = sentinel().delta(trace.jit_before)
             trace.backend = self.last_backend
@@ -1150,6 +1184,15 @@ class TPUSolver:
             delta_demoted=int(demote_sig[asig].sum()) if n_added else 0,
             row_refresh=bool(row_diff is not None),
         )
+        # decode-delta handoff: the validated assignment continues the base
+        # decode's slot layout — tell _decode which base the memo must match
+        # and which slots the delta touched (removed base pods' slots + the
+        # appended tail's slots); everything else is provably unchanged
+        self._decode_delta_ctx = dict(
+            base=base,
+            removed=np.asarray(removed, dtype=np.int64) if removed is not None and removed.size else None,
+            n_prev=n_prev,
+        )
         return self._finish(snap, enc, assignment, slot_basis, slot_zoneset, t, out, validated=True, count=count)
 
     @staticmethod
@@ -1289,20 +1332,31 @@ class TPUSolver:
         repair_sigs: set[int] = set()
         null_topo = _NullTopology()
 
-        # group pods by slot — one vectorized argsort/unique pass instead of
-        # an O(pods) Python loop (this was ~40% of decode at 50k pods)
+        # decode-delta carry: consume the delta handoff (if any) and the
+        # previous decode's memo; both are re-established on a successful
+        # decode, so any raising path below leaves no stale carry behind
+        fastdecode = _fastdecode_enabled()
+        delta_ctx, self._decode_delta_ctx = self._decode_delta_ctx, None
+        memo, self._decode_memo = (self._decode_memo if fastdecode else None), None
+
         assignment = np.asarray(assignment)
+        slot_basis = np.asarray(slot_basis)
+        slot_zoneset = np.asarray(slot_zoneset)
+        n_slots = int(slot_basis.shape[0])
         pod_errors: dict[str, str] = {}
         for i in np.nonzero(assignment < 0)[0]:
             pod_errors[enc.pods[i].key()] = "no feasible placement found by tensor solver"
         valid_idx = np.nonzero(assignment >= 0)[0]
-        order = valid_idx[np.argsort(assignment[valid_idx], kind="stable")]
-        slots_sorted = assignment[order]
-        uniq_slots, starts = np.unique(slots_sorted, return_index=True)
-        bounds = np.append(starts[1:], len(order))
-        pods_by_slot: dict[int, np.ndarray] = {
-            int(s): order[a:b] for s, a, b in zip(uniq_slots, starts, bounds)
-        }
+        # per-slot pod counts: one bincount — drives slot totals AND the
+        # delta dirty mask (a survivor keeps its slot by construction, so
+        # count-equal + untouched-by-the-delta == identical membership; pod
+        # identity across solves is the prestager's (uid, resourceVersion)
+        # clone-identity contract)
+        counts = (
+            np.bincount(assignment[valid_idx].astype(np.int64), minlength=n_slots)
+            if valid_idx.size
+            else np.zeros(n_slots, dtype=np.int64)
+        )
 
         existing_nodes: list[ExistingNode] = []
         existing_by_slot: dict[int, ExistingNode] = {}
@@ -1323,9 +1377,74 @@ class TPUSolver:
         if snap.reserved_capacity_enabled:
             from ..controllers.provisioning.scheduling.reservationmanager import ReservationManager
 
-            reservation_manager = ReservationManager(snap.instance_types)
-            if not reservation_manager.capacity:
-                reservation_manager = None  # no reserved offerings anywhere
+            # the no-reserved-offerings verdict is a pure function of the
+            # instance-type catalog, but discovering it walks every offering's
+            # requirements (~ms per solve at fleet scale) — memoize it on the
+            # catalog OBJECT (steady-state deltas reuse the snapshot's dict;
+            # a fresh GetInstanceTypes hands decode a fresh dict and re-scans)
+            memo_empty = self._resv_empty_memo
+            if memo_empty is None or memo_empty is not snap.instance_types:
+                reservation_manager = ReservationManager(snap.instance_types)
+                if not reservation_manager.capacity:
+                    reservation_manager = None  # no reserved offerings anywhere
+                    self._resv_empty_memo = snap.instance_types
+                else:
+                    self._resv_empty_memo = None
+
+        # decode-delta reuse gate: the memo must describe exactly the encode
+        # this delta continued from, and reservations must be off (the
+        # reservation walk is sequential cross-slot state — one reused slot
+        # would shift every later slot's reservation outcome)
+        reusable: np.ndarray | None = None
+        if (
+            memo is not None
+            and delta_ctx is not None
+            and reservation_manager is None
+            and memo["enc"] is delta_ctx["base"]
+            and memo["counts"].shape[0] == n_slots
+            and memo["slot_zoneset"].shape == slot_zoneset.shape
+        ):
+            # a slot is dirty iff its membership/basis/zoneset could have
+            # changed: count drift, basis/zoneset row drift, a removed base
+            # pod's slot, or an appended (delta-added) pod's slot — all
+            # columnar over the slot axis
+            dirty = memo["counts"] != counts
+            dirty |= memo["slot_basis"] != slot_basis
+            dirty |= np.any(memo["slot_zoneset"] != slot_zoneset, axis=1)
+            dirty |= (counts > 0) & ~memo["has_entry"]
+            removed_base = delta_ctx.get("removed")
+            if removed_base is not None:
+                rs = memo["assignment"][removed_base]
+                dirty[rs[rs >= 0]] = True
+            n_prev = int(delta_ctx["n_prev"])
+            if assignment.shape[0] > n_prev:
+                tail = assignment[n_prev:]
+                dirty[tail[tail >= 0]] = True
+            # offering availability flips in place between solves (same
+            # hazard _template_ctx guards against): a new-claim slot whose
+            # template's availability vector moved must re-filter
+            avail_now: dict[int, tuple] = {}
+            for j_m, ent_m in sorted(memo["new"].items()):
+                sig_m = avail_now.get(id(ent_m["template"]))
+                if sig_m is None:
+                    sig_m = avail_now[id(ent_m["template"])] = tuple(
+                        o.available for x in ent_m["template"].instance_type_options for o in x.offerings
+                    )
+                if sig_m != ent_m["avail"]:
+                    dirty[j_m] = True
+            reusable = ~dirty
+
+        # group pods by slot — one vectorized argsort/unique pass instead of
+        # an O(pods) Python loop (this was ~40% of decode at 50k pods); on
+        # the reuse path only DIRTY slots' pods are gathered at all
+        gather_idx = valid_idx if reusable is None else valid_idx[~reusable[assignment[valid_idx]]]
+        order = gather_idx[np.argsort(assignment[gather_idx], kind="stable")]
+        slots_sorted = assignment[order]
+        uniq_slots, starts = np.unique(slots_sorted, return_index=True)
+        bounds = np.append(starts[1:], len(order))
+        pods_by_slot: dict[int, np.ndarray] = {
+            int(s): order[a:b] for s, a, b in zip(uniq_slots, starts, bounds)
+        }
 
         # per-dom-key vocab views for requirement pinning (zone is key 0)
         dko = np.asarray(enc.dom_key_of)
@@ -1362,28 +1481,88 @@ class TPUSolver:
         # per claim (at 1M pods decode produces thousands of claims over a
         # handful of templates; the per-claim scan was the decode hot spot)
         tmpl_solve_cache: dict[int, tuple] = {}
+        # per-signature-multiset request-total interning: churny fleets
+        # re-derive the same slot request vector thousands of times (replica
+        # sets share one signature); build each distinct total once and hand
+        # every slot its own shallow copy (Quantities are treated immutable)
+        reqtot_cache: dict[tuple, dict] = dc.setdefault("reqtot", {})
         new_claims: list[SchedulingNodeClaim] = []
 
-        # slot total request vectors, one bincount per resource axis
-        slot_ids = assignment.copy()
-        valid = slot_ids >= 0
-        n_slots = int(slot_basis.shape[0])
+        # the NEXT memo, built alongside this decode (carried entries for
+        # reused slots, fresh entries for materialized ones); disabled with
+        # the hatch off or under reservations
+        save_new: dict[int, dict] | None = {} if fastdecode and reservation_manager is None else None
+        save_existing: dict[int, dict] | None = {} if save_new is not None else None
+        avail_sig_cache: dict[int, tuple] = {}
+
+        def _avail_of(template):
+            sig = avail_sig_cache.get(id(template))
+            if sig is None:
+                sig = avail_sig_cache[id(template)] = tuple(
+                    o.available for x in template.instance_type_options for o in x.offerings
+                )
+            return sig
+
+        # slot total request vectors, one bincount per resource axis — only
+        # over the gathered (dirty) pods; reused slots never need totals
         R = enc.sig_req.shape[1]
         total_mat = np.zeros((n_slots, R), dtype=np.float64)
-        if valid.any():
-            pr = enc.sig_req[sig_of_pod]
+        if gather_idx.size:
+            pr = enc.sig_req[sig_of_pod[gather_idx]]
+            gslots = assignment[gather_idx]
             for r in range(R):
-                total_mat[:, r] = np.bincount(slot_ids[valid], weights=pr[valid, r], minlength=n_slots)
+                total_mat[:, r] = np.bincount(gslots, weights=pr[:, r], minlength=n_slots)
 
-        for j, pod_idxs in sorted(pods_by_slot.items()):
+        reused_slots = 0
+        slot_list = sorted(pods_by_slot)
+        if reusable is not None:
+            reuse_j = np.nonzero(reusable & (counts > 0))[0]
+            slot_list = sorted(set(slot_list) | {int(x) for x in reuse_j})
+        for j in slot_list:
+            if reusable is not None and reusable[j] and j not in pods_by_slot:
+                # clean slot: serve it from the memo. New-claim slots REBUILD
+                # the claim object from the memo's frozen copies (tuple pods,
+                # copied requests/requirements/options) — downstream adopters
+                # mutate claims in place, so handing out the previous solve's
+                # object would poison the carry
+                ent = memo["new"].get(j)
+                if ent is not None:
+                    claim = SchedulingNodeClaim.__new__(SchedulingNodeClaim)
+                    claim.template = ent["template"]
+                    claim.topology = null_topo
+                    claim.daemon_overhead_groups = ent["groups"]
+                    claim.pods = list(ent["pods"])
+                    claim.hostname = f"tpu-slot-{j}"
+                    claim.spec_requests = dict(ent["requests"])
+                    claim.requirements = ent["reqs"].copy()
+                    claim.instance_type_options = list(ent["its"])
+                    new_claims.append(claim)
+                    if save_new is not None:
+                        save_new[j] = ent
+                else:
+                    ent = memo["existing"][j]
+                    en = existing_by_slot[j]
+                    en.pods.extend(ent["pods"])
+                    en.remaining_resources = res.subtract(en.remaining_resources, ent["requests"])
+                    if save_existing is not None:
+                        save_existing[j] = ent
+                reused_slots += 1
+                continue
+            pod_idxs = pods_by_slot[j]
             pods = [enc.pods[i] for i in pod_idxs]
             usigs, ucounts = np.unique(sig_of_pod[pod_idxs], return_counts=True)
             sig_counts = {int(s): int(n) for s, n in zip(usigs, ucounts)}
-            requests = _requests_from_sigs(enc, sig_counts)
+            rt_key = tuple(zip(usigs.tolist(), ucounts.tolist()))
+            rt = reqtot_cache.get(rt_key)
+            if rt is None:
+                rt = reqtot_cache[rt_key] = _requests_from_sigs(enc, sig_counts)
+            requests = dict(rt)
             if j < enc.n_existing:
                 en = existing_by_slot[j]
                 en.pods.extend(pods)
                 en.remaining_resources = res.subtract(en.remaining_resources, requests)
+                if save_existing is not None:
+                    save_existing[j] = dict(pods=tuple(pods), requests=requests)
                 continue
 
             row = int(slot_basis[j])
@@ -1510,6 +1689,46 @@ class TPUSolver:
             if reservation_manager is not None:
                 self._apply_reservations(claim, reservation_manager)
             new_claims.append(claim)
+            if save_new is not None:
+                # frozen copies only: the adopted claim's pods/requests/
+                # requirements/options are all mutated downstream
+                save_new[j] = dict(
+                    template=template,
+                    groups=claim.daemon_overhead_groups,
+                    pods=tuple(pods),
+                    requests=dict(requests),
+                    reqs=claim.requirements.copy(),
+                    its=tuple(remaining),
+                    avail=_avail_of(template),
+                )
+
+        decode_mode = "delta-reuse" if reused_slots else "full"
+        self._trace.note(
+            decode_mode=decode_mode,
+            decode_reused_slots=reused_slots,
+            decode_dirty_slots=len(pods_by_slot),
+        )
+        from ..metrics import SOLVER_DECODE_REUSED_SLOTS_TOTAL, SOLVER_DECODE_TOTAL
+
+        self._count(SOLVER_DECODE_TOTAL, mode=decode_mode)
+        if reused_slots and self.registry is not None:
+            self.registry.counter(SOLVER_DECODE_REUSED_SLOTS_TOTAL).inc(reused_slots)
+        if save_new is not None and not repair_pods:
+            has_entry = np.zeros(n_slots, dtype=bool)
+            if save_new:
+                has_entry[list(save_new)] = True
+            if save_existing:
+                has_entry[list(save_existing)] = True
+            self._decode_memo = dict(
+                enc=enc,
+                assignment=assignment.copy(),
+                counts=counts,
+                slot_basis=slot_basis.copy(),
+                slot_zoneset=slot_zoneset.copy(),
+                has_entry=has_entry,
+                new=save_new,
+                existing=save_existing,
+            )
 
         results = Results(
             new_node_claims=new_claims,

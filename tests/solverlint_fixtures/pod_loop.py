@@ -44,3 +44,22 @@ def ok_multigroup_items(np, enc, demote, sig):
     # unique shapes — never with pods
     key = np.where(demote[sig], enc.n_sigs + np.arange(sig.shape[0]), sig)
     return np.unique(key, return_index=True, return_inverse=True, return_counts=True)
+
+
+def bad_decode_loop(enc, assignment):
+    # seeded decode violation: materializing per-slot membership by walking
+    # the pod axis in Python — the O(pods) host tail the decode-delta memo
+    # and the columnar gather exist to kill
+    slots = {}
+    for i, p in enumerate(enc.pods):
+        slots.setdefault(assignment[i], []).append(p)
+    return slots
+
+
+def ok_decode_columnar(np, enc, assignment, dirty):
+    # the sanctioned columnar decode: one vectorized gather over the dirty
+    # rows only — per-slot grouping comes from the sorted assignment column,
+    # never from a per-pod Python walk
+    valid = np.nonzero(dirty[assignment])[0]
+    order = np.argsort(assignment[valid], kind="stable")
+    return valid[order], np.bincount(assignment[valid], minlength=enc.n_slots)

@@ -1,0 +1,233 @@
+"""Decode-delta parity & safety (ISSUE 20 tentpole, part 1).
+
+`TPUSolver._decode` keeps the prior decode's per-slot claim objects and
+re-materializes only slots whose assignment rows changed. Every contract
+here pins the memo against its exact-reference escape hatch
+(`KARPENTER_SOLVER_FASTDECODE=0` re-materializes every slot, every solve):
+
+  * randomized full -> delta -> delta chains with adds/removes/port/anti/
+    min-values mixes produce bit-identical `Results` on vs off
+    (`results_digest`: claims, placements, errors — node-name-free),
+  * reuse is ATTRIBUTED: the SolveTrace carries decode_mode/
+    decode_reused_slots and the bounded decode counters tick,
+  * reuse is SAFE against mutation at the binder adopt seam: corrupting an
+    emitted claim's pods/requirements between solves cannot leak into the
+    next delta's reused slots (the memo holds frozen copies and rebuilds),
+  * the detcheck dual-run arm replays a warm chain bit-identically with the
+    memo live.
+
+Harness invariant (learned the hard way): parity MUST interleave TWO solvers
+over ONE snapshot, flipping the env hatch around each solve — two separately
+built snapshots draw different pod names from the helpers._seq counter and
+diverge on pack tie-breaks, which is name noise, not a decode bug.
+"""
+
+import os
+import random
+
+import pytest
+
+from helpers import hostname_anti_affinity, make_pod
+from karpenter_tpu.metrics import (
+    SOLVER_DECODE_REUSED_SLOTS_TOTAL,
+    SOLVER_DECODE_TOTAL,
+    make_registry,
+)
+from karpenter_tpu.obs import detcheck
+from karpenter_tpu.obs.detcheck import results_digest
+from karpenter_tpu.solver.tpu import TPUSolver
+from test_minvalues_tensor import minvalues_pool, random_pods
+from test_solver import make_snapshot
+
+
+def _solve_pair(snap, s_on, s_off):
+    """Interleaved one-snapshot parity step: solve with the memo solver
+    (hatch on), then the exact-reference solver (hatch off), restoring the
+    ambient env either way."""
+    prev = os.environ.get("KARPENTER_SOLVER_FASTDECODE")
+    try:
+        os.environ["KARPENTER_SOLVER_FASTDECODE"] = "1"
+        r_on = s_on.solve(snap)
+        os.environ["KARPENTER_SOLVER_FASTDECODE"] = "0"
+        r_off = s_off.solve(snap)
+    finally:
+        if prev is None:
+            os.environ.pop("KARPENTER_SOLVER_FASTDECODE", None)
+        else:
+            os.environ["KARPENTER_SOLVER_FASTDECODE"] = prev
+    return r_on, r_off
+
+
+def _assert_step_parity(snap, s_on, s_off, step=""):
+    r_on, r_off = _solve_pair(snap, s_on, s_off)
+    assert s_on.last_solve_mode == s_off.last_solve_mode, (step, s_on.last_solve_mode, s_off.last_solve_mode)
+    assert results_digest(r_on) == results_digest(r_off), step
+    return r_on, r_off
+
+
+def _mutate(rng, snap, step):
+    """One churn step: removals and/or uniquely-named additions."""
+    op = rng.random()
+    if op < 0.4 and len(snap.pods) > 4:
+        for _ in range(rng.randrange(1, 4)):
+            snap.pods.pop(rng.randrange(len(snap.pods)))
+    elif op < 0.7:
+        snap.pods.extend(make_pod(cpu=rng.choice(["250m", "500m", "1"]), name=f"add{step}-{i}") for i in range(rng.randrange(1, 4)))
+    else:
+        snap.pods.pop(rng.randrange(len(snap.pods)))
+        snap.pods.append(make_pod(cpu="500m", name=f"swap{step}"))
+
+
+class TestParityChains:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_randomized_chain_bit_identical(self, seed):
+        rng = random.Random(seed)
+        snap = make_snapshot(random_pods(rng, 24))
+        s_on, s_off = TPUSolver(force=True), TPUSolver(force=True)
+        _assert_step_parity(snap, s_on, s_off, "warmup")
+        assert s_on.last_solve_mode == "full"
+        for step in range(5):
+            _mutate(rng, snap, step)
+            _assert_step_parity(snap, s_on, s_off, f"step{step}")
+
+    def test_anti_affinity_chain(self):
+        sel = {"matchLabels": {"app": "aa"}}
+        pods = [
+            make_pod(cpu="500m", name=f"aa{i}", labels={"app": "aa"}, anti_affinity=[hostname_anti_affinity(sel)])
+            for i in range(6)
+        ] + [make_pod(cpu="250m", name=f"fill{i}") for i in range(10)]
+        snap = make_snapshot(pods)
+        s_on, s_off = TPUSolver(force=True), TPUSolver(force=True)
+        _assert_step_parity(snap, s_on, s_off, "warmup")
+        snap.pods.pop(2)  # an anti-affinity member leaves
+        _assert_step_parity(snap, s_on, s_off, "remove-anti")
+        snap.pods.append(make_pod(cpu="500m", name="aa9", labels={"app": "aa"}, anti_affinity=[hostname_anti_affinity(sel)]))
+        _assert_step_parity(snap, s_on, s_off, "add-anti")
+
+    def test_host_port_repair_chain(self):
+        """Port-conflict decode repair forces the no-memo-save gate: the
+        repaired solve and the steps after it must still hold parity."""
+        pods = [make_pod(cpu="250m", name=f"pp{i}") for i in range(10)]
+        for i in (0, 1):
+            pods[i].spec.containers[0].ports = [{"containerPort": 8080, "hostPort": 8080, "protocol": "TCP"}]
+        snap = make_snapshot(pods)
+        s_on, s_off = TPUSolver(force=True), TPUSolver(force=True)
+        _assert_step_parity(snap, s_on, s_off, "warmup")
+        snap.pods.pop()
+        _assert_step_parity(snap, s_on, s_off, "remove")
+        ported = make_pod(cpu="250m", name="pp-late")
+        ported.spec.containers[0].ports = [{"containerPort": 8080, "hostPort": 8080, "protocol": "TCP"}]
+        snap.pods.append(ported)
+        _assert_step_parity(snap, s_on, s_off, "add-ported")
+        snap.pods.append(make_pod(cpu="250m", name="pp-after"))
+        _assert_step_parity(snap, s_on, s_off, "after-repair")
+
+    def test_min_values_chain(self):
+        snap = make_snapshot([make_pod(cpu="500m", name=f"mv{i}") for i in range(12)], node_pools=[minvalues_pool(mv=2)])
+        s_on, s_off = TPUSolver(force=True), TPUSolver(force=True)
+        _assert_step_parity(snap, s_on, s_off, "warmup")
+        for step in range(3):
+            snap.pods.pop(0)
+            snap.pods.append(make_pod(cpu="500m", name=f"mv-add{step}"))
+            _assert_step_parity(snap, s_on, s_off, f"step{step}")
+
+
+def _multi_slot_pods(prefix, n_spread=8, n_fill=6):
+    """Pods guaranteed to span many slots: a hostname-anti-affinity group
+    (one pod per node, one slot each) plus small fillers that share one slot
+    — popping a filler dirties its slot and leaves the rest reusable. (A
+    dozen plain pods all fit ONE catalog instance, which leaves nothing to
+    reuse once that lone slot is dirtied.)"""
+    sel = {"matchLabels": {"app": f"{prefix}-spread"}}
+    return [
+        make_pod(cpu="1", name=f"{prefix}{i}", labels={"app": f"{prefix}-spread"}, anti_affinity=[hostname_anti_affinity(sel)])
+        for i in range(n_spread)
+    ] + [make_pod(cpu="250m", name=f"{prefix}-fill{i}") for i in range(n_fill)]
+
+
+class TestReuseAttribution:
+    def test_trace_and_counters_attribute_reuse(self):
+        reg = make_registry()
+        snap = make_snapshot(_multi_slot_pods("r"))
+        solver = TPUSolver(force=True, registry=reg)
+        solver.solve(snap)
+        assert solver._trace.attribution.get("decode_mode") == "full"
+        assert reg.counter(SOLVER_DECODE_TOTAL).value(mode="full") == 1
+        snap.pods.pop()  # one slot dirtied, the rest reusable
+        solver.solve(snap)
+        assert solver.last_solve_mode == "delta"
+        att = solver._trace.attribution
+        assert att.get("decode_mode") == "delta-reuse", att
+        assert att.get("decode_reused_slots", 0) >= 1
+        assert reg.counter(SOLVER_DECODE_TOTAL).value(mode="delta-reuse") == 1
+        assert reg.counter(SOLVER_DECODE_REUSED_SLOTS_TOTAL).total() >= 1
+
+    def test_hatch_off_never_reuses(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_FASTDECODE", "0")
+        reg = make_registry()
+        snap = make_snapshot([make_pod(cpu="4", name=f"h{i}") for i in range(10)])
+        solver = TPUSolver(force=True, registry=reg)
+        solver.solve(snap)
+        snap.pods.pop()
+        solver.solve(snap)
+        assert solver.last_solve_mode == "delta"
+        assert solver._trace.attribution.get("decode_mode") == "full"
+        assert reg.counter(SOLVER_DECODE_TOTAL).value(mode="delta-reuse") == 0
+        assert reg.counter(SOLVER_DECODE_TOTAL).value(mode="full") == 2
+
+
+class TestAdoptSeamMutationSafety:
+    def test_adopted_claim_mutation_cannot_leak_into_reuse(self):
+        """The binder/residual seam mutates emitted claims (pods.extend,
+        requirements.add, option narrowing). Corrupt an emitted claim hard
+        between solves; the next delta's reused slots must still be
+        bit-identical to the exact-reference arm."""
+        snap = make_snapshot(_multi_slot_pods("m"))
+        s_on, s_off = TPUSolver(force=True), TPUSolver(force=True)
+        r_on, _ = _assert_step_parity(snap, s_on, s_off, "warmup")
+        victims = [nc for nc in r_on.new_node_claims if nc.pods]
+        assert victims
+        for nc in victims:
+            nc.pods.append(make_pod(cpu="250m", name="intruder"))
+            nc.pods.pop(0)
+            nc.instance_type_options = []
+            nc.requests = {}
+        snap.pods.pop()
+        r_on2, _ = _assert_step_parity(snap, s_on, s_off, "post-mutation")
+        assert s_on._trace.attribution.get("decode_mode") == "delta-reuse"
+        assert all(p.metadata.name != "intruder" for nc in r_on2.new_node_claims for p in nc.pods)
+
+    def test_reused_claims_are_fresh_objects_per_solve(self):
+        """Two consecutive deltas must not hand out the SAME claim object
+        for a reused slot — downstream owns what it's given."""
+        snap = make_snapshot(_multi_slot_pods("f"))
+        solver = TPUSolver(force=True)
+        solver.solve(snap)
+        snap.pods.pop()
+        r1 = solver.solve(snap)
+        snap.pods.pop()
+        r2 = solver.solve(snap)
+        assert solver._trace.attribution.get("decode_mode") == "delta-reuse"
+        ids1 = {id(nc) for nc in r1.new_node_claims}
+        assert not ids1 & {id(nc) for nc in r2.new_node_claims}
+
+
+class TestDetcheckDualRun:
+    def test_warm_chain_replays_bit_identical(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_SOLVER_DETCHECK", "1")
+        detcheck._refresh()
+        try:
+            solver = TPUSolver(force=True)
+            snap = make_snapshot([make_pod(cpu="500m", name=f"d{i}") for i in range(10)])
+            solver.solve(snap)
+            snap.pods.pop(3)
+            solver.solve(snap)
+            snap.pods.append(make_pod(cpu="500m", name="d-add"))
+            solver.solve(snap)
+            assert solver.last_solve_mode == "delta"
+            out = solver.check_determinism()
+            assert out["solves"] == 3
+            assert out["parent_modes"] == out["child_modes"] == ["full", "delta", "delta"]
+        finally:
+            monkeypatch.delenv("KARPENTER_SOLVER_DETCHECK", raising=False)
+            detcheck._refresh()

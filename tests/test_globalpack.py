@@ -115,16 +115,16 @@ class TestEscapeHatch:
         reference = m._lp_option(cands, env.clock.now() + 60.0)
         assert reference.candidates
 
-        monkeypatch.setattr(MultiNodeConsolidation, "_globalpack_option", None)  # must not be called
+        monkeypatch.setattr(MultiNodeConsolidation, "_globalpack_option_iter", None)  # must not be called
         captured = {}
-        orig = MultiNodeConsolidation._lp_option
+        orig = MultiNodeConsolidation._lp_option_iter
 
         def spy(self, candidates, deadline):
-            cmd = orig(self, candidates, deadline)
-            captured["cmd"] = cmd
-            return cmd
+            for cmd in orig(self, candidates, deadline):
+                captured.setdefault("cmd", cmd)
+                yield cmd
 
-        monkeypatch.setattr(MultiNodeConsolidation, "_lp_option", spy)
+        monkeypatch.setattr(MultiNodeConsolidation, "_lp_option_iter", spy)
         budgets = {env.store.list("NodePool")[0].metadata.name: 100}
         m2, cands2 = consolidation_method(env)
         m2.compute_commands(cands2, budgets)
@@ -137,14 +137,14 @@ class TestEscapeHatch:
         env = build_fleet(5, solver_backend="tpu")
         flip_consolidatable(env)
         captured = {}
-        orig = MultiNodeConsolidation._globalpack_option
+        orig = MultiNodeConsolidation._globalpack_option_iter
 
         def spy(self, candidates, deadline):
-            cmd = orig(self, candidates, deadline)
-            captured["cmd"] = cmd
-            return cmd
+            for cmd in orig(self, candidates, deadline):
+                captured.setdefault("cmd", cmd)
+                yield cmd
 
-        monkeypatch.setattr(MultiNodeConsolidation, "_globalpack_option", spy)
+        monkeypatch.setattr(MultiNodeConsolidation, "_globalpack_option_iter", spy)
         budgets = {env.store.list("NodePool")[0].metadata.name: 100}
         m, cands = consolidation_method(env)
         m.compute_commands(cands, budgets)

@@ -99,12 +99,20 @@ class TestRuleFixtures:
 
     def test_pod_axis_loop(self):
         findings = _fixture_findings("python-loop-over-pod-axis", "pod_loop.py")
-        assert len(findings) == 2, findings
+        assert len(findings) == 3, findings
         assert all("enc.pods" in f.message for f in findings)
-        # the seeded multi-group item-builder loop is one of them; the
-        # vectorized np.unique form right below it must stay clean
+        # the seeded multi-group item-builder and decode-materialization
+        # loops are flagged; the vectorized np.unique and columnar-gather
+        # forms right below each must stay clean
         src = (FIXTURES / "pod_loop.py").read_text().splitlines()
-        assert any("enumerate(enc.pods)" in src[f.line - 1] for f in findings)
+        assert sum("enumerate(enc.pods)" in src[f.line - 1] for f in findings) == 2
+        flagged_fns = set()
+        for f in findings:
+            for ln in range(f.line - 1, -1, -1):
+                if src[ln].startswith("def "):
+                    flagged_fns.add(src[ln].split("(")[0][4:])
+                    break
+        assert "bad_decode_loop" in flagged_fns and "ok_decode_columnar" not in flagged_fns
 
     def test_reason_family_tiers(self):
         findings = _fixture_findings("reason-family-tiers", "fallback_registry.py")

@@ -1,0 +1,140 @@
+"""Incremental consolidation validation (ISSUE 20 tentpole, part 2).
+
+The multi-node round now (a) shares one SchedulerRoundSeed across a round's
+from-scratch probes (probe-invariant fit-memo verdicts carry between host
+scheduler builds), and (b) treats the proposer's ranked ladder as a LAZY
+continuation: the 15s exact Validator runs on the best proposal only, and a
+validation failure pulls the next accepted proposal instead of abandoning
+the round. Contracts pinned here:
+
+  * `KARPENTER_SIM_SHARED_SCHED=0` (hatch off) emits the identical command —
+    the seed only skips re-deriving verdicts that cannot differ,
+  * the round's flight record attributes the shared seed
+    (`sched_seed_rejects`) and the simulator toggles it with the hatch,
+  * a forced ValidationError on the winner falls back to the NEXT accepted
+    ladder proposal, still exactly validated (never an unvalidated emit),
+  * empty candidate sets short-circuit: `compute_consolidation` never
+    simulates, `Validator.validate` never sleeps the 15s delay.
+"""
+
+import pytest
+
+from karpenter_tpu.controllers.disruption import methods as methods_mod
+from karpenter_tpu.controllers.disruption.methods import (
+    MultiNodeConsolidation,
+    _command_savings_per_hour,
+)
+from karpenter_tpu.controllers.disruption.types import Command
+from karpenter_tpu.controllers.disruption.validation import ValidationError, Validator
+from karpenter_tpu.solver.simulate import ConsolidationSimulator
+
+from test_consolidation_lp import consolidation_method, flip_consolidatable
+from test_consolidation_tpu import build_fleet
+
+
+class TestSharedSchedulerSeed:
+    def test_hatch_off_emits_identical_command(self, monkeypatch):
+        env = build_fleet(6, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        deadline = env.clock.now() + 60.0
+        monkeypatch.setenv("KARPENTER_SIM_SHARED_SCHED", "0")
+        cmd_off = m._lp_option(cands, deadline)
+        monkeypatch.delenv("KARPENTER_SIM_SHARED_SCHED")
+        cmd_on = m._lp_option(cands, deadline)
+        assert cmd_on.candidates, "no consolidation command on an underutilized fleet"
+        assert cmd_on.candidate_names() == cmd_off.candidate_names()
+        assert abs(_command_savings_per_hour(cmd_on) - _command_savings_per_hour(cmd_off)) < 1e-9
+
+    def test_simulator_seed_toggles_with_hatch(self, monkeypatch):
+        env = build_fleet(4, solver_backend="tpu")
+        flip_consolidatable(env)
+        cands = env.disruption.get_candidates()
+        sim = ConsolidationSimulator(env.provisioner, env.cluster, env.clock, cands)
+        assert sim.sched_seed is not None
+        monkeypatch.setenv("KARPENTER_SIM_SHARED_SCHED", "0")
+        sim_off = ConsolidationSimulator(env.provisioner, env.cluster, env.clock, cands)
+        assert sim_off.sched_seed is None
+
+    def test_round_trace_attributes_seed(self):
+        env = build_fleet(5, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        m._lp_option(cands, env.clock.now() + 60.0)
+        rec = env.provisioner.solver.recorder
+        traces = [t for t in rec.traces() if t.backend == "lp"]
+        assert traces, "no lp flight record"
+        att = traces[-1].attribution
+        assert "sched_seed_rejects" in att
+        assert isinstance(att["sched_seed_rejects"], int)
+
+
+class TestRankedValidationFallback:
+    def _flaky_validator(self, monkeypatch, fail_first_n):
+        calls = {"n": 0, "validated": []}
+        orig = Validator.validate
+
+        def flaky(self, cmd, delay_seconds=15.0):
+            calls["n"] += 1
+            calls["validated"].append(cmd.candidate_names())
+            if calls["n"] <= fail_first_n:
+                raise ValidationError("churn", "forced by test")
+            return orig(self, cmd, delay_seconds)
+
+        monkeypatch.setattr(Validator, "validate", flaky)
+        return calls
+
+    def test_winner_rejection_pulls_next_ladder_rung(self, monkeypatch):
+        env = build_fleet(6, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, cands = consolidation_method(env)
+        # precondition: the ladder must hold >= 2 accepted proposals for the
+        # fallback to have anywhere to go
+        probe = m._lp_option_iter(cands, env.clock.now() + 60.0)
+        accepted = [cmd.candidate_names() for cmd in probe]
+        assert len(accepted) >= 2, f"fleet too simple for a fallback test: {accepted}"
+
+        calls = self._flaky_validator(monkeypatch, fail_first_n=1)
+        m2, cands2 = consolidation_method(env)
+        budgets = {env.store.list("NodePool")[0].metadata.name: 100}
+        cmds = m2.compute_commands(cands2, budgets)
+        assert calls["n"] == 2, calls
+        assert cmds and cmds[0].candidates, "fallback rung was not emitted"
+        # the emitted command is the SECOND validation attempt's — and the
+        # ladder genuinely advanced (deduped subsets can't repeat)
+        assert cmds[0].candidate_names() == calls["validated"][1]
+        assert calls["validated"][0] != calls["validated"][1]
+
+    def test_every_rung_rejected_emits_nothing(self, monkeypatch):
+        env = build_fleet(5, solver_backend="tpu")
+        flip_consolidatable(env)
+        calls = self._flaky_validator(monkeypatch, fail_first_n=10**6)
+        m, cands = consolidation_method(env)
+        budgets = {env.store.list("NodePool")[0].metadata.name: 100}
+        cmds = m.compute_commands(cands, budgets)
+        assert cmds == []
+        # bounded: at most MULTI_NODE_VALIDATION_ATTEMPTS exact validations
+        assert calls["n"] <= methods_mod.MULTI_NODE_VALIDATION_ATTEMPTS
+
+
+class TestEmptyShortCircuits:
+    def test_compute_consolidation_empty_never_simulates(self, monkeypatch):
+        env = build_fleet(3, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, _ = consolidation_method(env)
+
+        def boom(*a, **k):
+            raise AssertionError("empty candidate set reached simulate_scheduling")
+
+        monkeypatch.setattr(methods_mod, "simulate_scheduling", boom)
+        cmd = m.compute_consolidation([])
+        assert not cmd.candidates and not cmd.replacements
+
+    def test_validator_empty_command_skips_the_sleep(self):
+        env = build_fleet(3, solver_backend="tpu")
+        flip_consolidatable(env)
+        m, _ = consolidation_method(env)
+        before = env.clock.now()
+        with pytest.raises(ValidationError):
+            Validator(m.ctx, m, mode="strict").validate(Command())
+        assert env.clock.now() == before, "empty command paid the 15s validation delay"
